@@ -1,0 +1,29 @@
+"""risingwave_tpu — a TPU-native streaming-SQL dataflow framework.
+
+A ground-up re-design of the capabilities of RisingWave (reference:
+/root/reference, Rust) for TPU hardware via JAX/XLA/Pallas:
+
+- Columnar ``StreamChunk`` batches (reference: src/common/src/array/
+  stream_chunk.rs:98) become padded, fixed-capacity device arrays with
+  validity + op masks so every operator compiles once under ``jax.jit``.
+- Stateful streaming operators (HashAgg / HashJoin / TopN; reference:
+  src/stream/src/executor/) are pure functions
+  ``(state, chunk) -> (state', delta)`` over device-resident,
+  open-addressing hash-table state in HBM.
+- The epoch/barrier checkpoint model (reference: docs/checkpoint.md,
+  src/meta/src/barrier/) is a host-driven step loop: a fragment is a
+  jit-compiled per-epoch step function; a barrier is a step boundary at
+  which state tables commit epoch deltas into a Hummock-style LSM
+  (host <-> HBM staging).
+- Parallelism is vnode hash partitioning (256 vnodes, reference:
+  src/common/src/hash/consistent_hash/vnode.rs:54) mapped onto a
+  ``jax.sharding.Mesh``: the hash exchange between fragments is an
+  on-device all-to-all inside a ``shard_map``-ped step, riding ICI.
+"""
+
+__version__ = "0.1.0"
+
+from risingwave_tpu.types import DataType, Op
+from risingwave_tpu.array.chunk import DataChunk, StreamChunk
+
+__all__ = ["DataType", "Op", "DataChunk", "StreamChunk", "__version__"]

@@ -1,0 +1,3 @@
+from risingwave_tpu.array.chunk import DataChunk, StreamChunk
+
+__all__ = ["DataChunk", "StreamChunk"]

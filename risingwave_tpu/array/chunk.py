@@ -1,0 +1,201 @@
+"""Columnar chunk model — the unit of dataflow.
+
+Reference: src/common/src/array/data_chunk.rs (DataChunk = columns +
+visibility bitmap) and src/common/src/array/stream_chunk.rs:98
+(StreamChunk = DataChunk + ops column).
+
+TPU-first re-design: a chunk is a *fixed-capacity* struct-of-arrays.
+Row count never appears in any array shape — instead a boolean ``valid``
+lane marks live rows and padding lanes carry null values. This is what
+lets an entire fragment chain compile once under ``jax.jit`` and re-run
+every epoch with zero recompiles (XLA requires static shapes; see
+SURVEY.md §7 "Dynamic shapes vs. XLA").
+
+Chunks are registered pytrees, so they flow through ``jit`` /
+``shard_map`` / ``lax.scan`` directly, and the column dict maps onto
+``jax.sharding`` PartitionSpecs per column for the vnode-sharded
+multi-chip path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.types import DataType, Op, Schema, op_sign
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class DataChunk:
+    """Fixed-capacity columnar batch with a validity (visibility) mask.
+
+    ``columns`` maps column name -> (capacity,) device array.
+    ``valid`` is the visibility bitmap (reference: data_chunk.rs
+    ``Bitmap``), also covering padding lanes.
+    """
+
+    columns: Dict[str, jnp.ndarray]
+    valid: jnp.ndarray  # (capacity,) bool
+
+    # -- pytree protocol ------------------------------------------------
+    def tree_flatten(self):
+        names = tuple(sorted(self.columns))
+        return (tuple(self.columns[n] for n in names) + (self.valid,), names)
+
+    @classmethod
+    def tree_unflatten(cls, names, children):
+        *cols, valid = children
+        return cls(columns=dict(zip(names, cols)), valid=valid)
+
+    # -- basics ---------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self.valid.shape[0]
+
+    def num_rows(self) -> jnp.ndarray:
+        """Dynamic count of live rows (a traced scalar under jit)."""
+        return jnp.sum(self.valid.astype(jnp.int32))
+
+    def col(self, name: str) -> jnp.ndarray:
+        return self.columns[name]
+
+    def with_columns(self, **cols: jnp.ndarray) -> "DataChunk":
+        new = dict(self.columns)
+        new.update(cols)
+        return DataChunk(new, self.valid)
+
+    def select(self, names) -> "DataChunk":
+        return DataChunk({n: self.columns[n] for n in names}, self.valid)
+
+    def rename(self, mapping: Mapping[str, str]) -> "DataChunk":
+        return DataChunk(
+            {mapping.get(n, n): a for n, a in self.columns.items()}, self.valid
+        )
+
+    def mask(self, keep: jnp.ndarray) -> "DataChunk":
+        """Narrow visibility (filter) without moving data."""
+        return DataChunk(self.columns, self.valid & keep)
+
+    # -- host interop ---------------------------------------------------
+    @staticmethod
+    def from_numpy(
+        cols: Mapping[str, np.ndarray], capacity: int, schema: Optional[Schema] = None
+    ) -> "DataChunk":
+        n = _common_len(cols)
+        if n > capacity:
+            raise ValueError(f"{n} rows exceed capacity {capacity}")
+        out = {}
+        for name, arr in cols.items():
+            arr = np.asarray(arr)
+            dtype = (
+                schema.field(name).dtype.device_dtype if schema is not None else arr.dtype
+            )
+            pad = np.zeros(capacity, dtype=dtype)
+            pad[:n] = arr.astype(dtype)
+            out[name] = jnp.asarray(pad)
+        valid = np.zeros(capacity, dtype=np.bool_)
+        valid[:n] = True
+        return DataChunk(out, jnp.asarray(valid))
+
+    def to_numpy(self) -> Dict[str, np.ndarray]:
+        """Compact live rows back to host (drops padding)."""
+        valid = np.asarray(self.valid)
+        return {n: np.asarray(a)[valid] for n, a in self.columns.items()}
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class StreamChunk(DataChunk):
+    """DataChunk + per-row change op (reference: stream_chunk.rs:98)."""
+
+    ops: jnp.ndarray = None  # (capacity,) int32 of types.Op
+
+    def tree_flatten(self):
+        names = tuple(sorted(self.columns))
+        return (
+            tuple(self.columns[n] for n in names) + (self.valid, self.ops),
+            names,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, names, children):
+        *cols, valid, ops = children
+        return cls(columns=dict(zip(names, cols)), valid=valid, ops=ops)
+
+    # -- construction ---------------------------------------------------
+    @staticmethod
+    def from_data(chunk: DataChunk, ops: Optional[jnp.ndarray] = None) -> "StreamChunk":
+        if ops is None:
+            ops = jnp.zeros(chunk.capacity, dtype=jnp.int32)  # all INSERT
+        return StreamChunk(columns=chunk.columns, valid=chunk.valid, ops=ops)
+
+    @staticmethod
+    def from_numpy(
+        cols: Mapping[str, np.ndarray],
+        capacity: int,
+        ops: Optional[np.ndarray] = None,
+        schema: Optional[Schema] = None,
+    ) -> "StreamChunk":
+        base = DataChunk.from_numpy(cols, capacity, schema)
+        if ops is None:
+            dev_ops = jnp.zeros(capacity, dtype=jnp.int32)
+        else:
+            pad = np.zeros(capacity, dtype=np.int32)
+            pad[: len(ops)] = np.asarray(ops, dtype=np.int32)
+            dev_ops = jnp.asarray(pad)
+        return StreamChunk(columns=base.columns, valid=base.valid, ops=dev_ops)
+
+    # -- semantics ------------------------------------------------------
+    def signs(self) -> jnp.ndarray:
+        """+1 / -1 per row; 0 contribution is handled via ``valid``."""
+        return op_sign(self.ops)
+
+    def effective_signs(self) -> jnp.ndarray:
+        """Signs with padding zeroed — the canonical retraction weight."""
+        return jnp.where(self.valid, self.signs(), jnp.int32(0))
+
+    def with_columns(self, **cols: jnp.ndarray) -> "StreamChunk":
+        new = dict(self.columns)
+        new.update(cols)
+        return StreamChunk(new, self.valid, self.ops)
+
+    def select(self, names) -> "StreamChunk":
+        return StreamChunk({n: self.columns[n] for n in names}, self.valid, self.ops)
+
+    def rename(self, mapping: Mapping[str, str]) -> "StreamChunk":
+        return StreamChunk(
+            {mapping.get(n, n): a for n, a in self.columns.items()},
+            self.valid,
+            self.ops,
+        )
+
+    def mask(self, keep: jnp.ndarray) -> "StreamChunk":
+        return StreamChunk(self.columns, self.valid & keep, self.ops)
+
+    def to_numpy(self, with_ops: bool = True) -> Dict[str, np.ndarray]:
+        out = super().to_numpy()
+        if with_ops:
+            out["__op__"] = np.asarray(self.ops)[np.asarray(self.valid)]
+        return out
+
+
+def _common_len(cols: Mapping[str, np.ndarray]) -> int:
+    lens = {len(np.asarray(a)) for a in cols.values()}
+    if len(lens) > 1:
+        raise ValueError(f"ragged columns: {lens}")
+    return lens.pop() if lens else 0
+
+
+def concat_chunks(chunks, capacity: Optional[int] = None) -> StreamChunk:
+    """Host-side helper: stack chunks into one wider chunk (test utility)."""
+    nps = [c.to_numpy(with_ops=True) for c in chunks]
+    names = [n for n in nps[0] if n != "__op__"]
+    cols = {n: np.concatenate([d[n] for d in nps]) for n in names}
+    ops = np.concatenate([d["__op__"] for d in nps])
+    cap = capacity or max(1, len(ops))
+    return StreamChunk.from_numpy(cols, cap, ops=ops)

@@ -1,0 +1,247 @@
+"""Device-resident open-addressing hash table — the state substrate.
+
+Reference roles replaced:
+- ``JoinHashMap`` (src/stream/src/executor/join/hash_join.rs:157)
+- HashAgg's dirty-group map / ``AggGroupCache``
+  (src/stream/src/executor/hash_agg.rs:49-62)
+- GroupTopN's per-group cache (src/stream/src/executor/top_n/group_top_n.rs:63)
+
+Those are CPU pointer-chasing hash maps; on TPU the equivalent must be a
+*flat array program*: a power-of-two slot table in HBM, linear probing,
+and a batched insert that resolves intra-chunk collisions without locks.
+
+Insert algorithm ("scatter-claim-verify"): all rows probe in lockstep.
+At probe step t each unresolved row computes its candidate slot
+``(h + t) & mask``. Rows whose candidate already holds their fingerprint
+resolve to it. Rows pointing at an EMPTY slot *claim* it with one scatter
+(XLA picks an arbitrary winner per slot among duplicates); re-reading the
+slot tells each row whether it (or a same-key twin) won — losers advance
+to the next probe step. The loop is a ``lax.fori_loop`` with a static
+bound, so the whole thing jits into one fused program with no
+data-dependent shapes.
+
+Keys are stored as fingerprints (two independent 32-bit hashes, see
+ops/hashing.hash128) plus the raw key lanes for exact verification —
+fingerprint match alone would admit false merges at ~2^-64 rates, but
+exact lanes make collisions impossible, matching the reference's exact
+`HashKey` equality (src/common/src/hash/key.rs).
+
+Deletion marks slots TOMBSTONE; tombstones are *not* reusable by insert
+within an epoch (they still break probe chains only at rehash), and the
+host-side StateTable rebuilds/rehashes the table when live+tombstone load
+crosses the resize threshold — the TPU analogue of the reference growing
+its hash maps on the heap.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from risingwave_tpu.ops.hashing import hash128
+
+EMPTY = jnp.uint32(0)  # slot status: fingerprint 0 reserved for "empty"
+TOMBSTONE_FLAG = 0x1  # bit in `status` lane
+
+# Static probe bound. With load factor <= 0.5 the expected max probe
+# length for linear probing is O(log n); 64 is comfortably beyond it for
+# the table sizes we run (2^14..2^20) and keeps the fori_loop cheap.
+MAX_PROBE = 64
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class HashTable:
+    """A set of key slots; payload arrays live next to it, indexed by slot.
+
+    Arrays (all length = capacity, power of two):
+      fp1, fp2   uint32 fingerprints (fp1 == 0 means EMPTY slot)
+      keys       (n_key_cols, capacity) raw key lanes for exact equality
+      live       bool — True once inserted, False again when deleted
+    """
+
+    fp1: jnp.ndarray
+    fp2: jnp.ndarray
+    keys: Tuple[jnp.ndarray, ...]
+    live: jnp.ndarray
+
+    def tree_flatten(self):
+        return ((self.fp1, self.fp2, self.keys, self.live), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def capacity(self) -> int:
+        return self.fp1.shape[0]
+
+    @staticmethod
+    def create(capacity: int, key_dtypes: Sequence[jnp.dtype]) -> "HashTable":
+        if capacity & (capacity - 1):
+            raise ValueError("capacity must be a power of two")
+        return HashTable(
+            fp1=jnp.zeros(capacity, jnp.uint32),
+            fp2=jnp.zeros(capacity, jnp.uint32),
+            keys=tuple(jnp.zeros(capacity, d) for d in key_dtypes),
+            live=jnp.zeros(capacity, jnp.bool_),
+        )
+
+    def occupancy(self) -> jnp.ndarray:
+        """Slots ever claimed (live + tombstones) — drives host rehash."""
+        return jnp.sum((self.fp1 != EMPTY).astype(jnp.int32))
+
+    def num_live(self) -> jnp.ndarray:
+        return jnp.sum(self.live.astype(jnp.int32))
+
+
+def _keys_match(table: HashTable, slot: jnp.ndarray, key_cols) -> jnp.ndarray:
+    ok = jnp.ones(slot.shape, jnp.bool_)
+    for tk, k in zip(table.keys, key_cols):
+        ok &= tk[slot] == k
+    return ok
+
+
+@partial(jax.jit, static_argnames=("insert_missing",), donate_argnums=(0,))
+def lookup_or_insert(
+    table: HashTable,
+    key_cols: Tuple[jnp.ndarray, ...],
+    valid: jnp.ndarray,
+    insert_missing: bool = True,
+):
+    """Batched find-or-insert. Returns (table', slots, found, inserted).
+
+    slots[i] == -1 iff row i is invalid, or the key was absent and
+    ``insert_missing`` is False, or the table overflowed MAX_PROBE
+    (callers treat -1 slots of valid rows as an overflow signal and
+    trigger a host-side rehash; see state/state_table.py).
+    """
+    cap = table.capacity
+    mask = jnp.uint32(cap - 1)
+    h1, h2 = hash128(key_cols)
+    # fingerprint 0 is reserved for EMPTY: remap to 1
+    fp1 = jnp.where(h1 == 0, jnp.uint32(1), h1)
+    fp2 = h2
+
+    n = valid.shape[0]
+    slots = jnp.full(n, -1, jnp.int32)
+    found = jnp.zeros(n, jnp.bool_)
+    inserted = jnp.zeros(n, jnp.bool_)
+    unresolved = valid
+
+    def body(t, carry):
+        table, slots, found, inserted, unresolved = carry
+        cand = ((h1 + jnp.uint32(t)) & mask).astype(jnp.int32)
+
+        slot_fp1 = table.fp1[cand]
+        slot_fp2 = table.fp2[cand]
+        is_empty = slot_fp1 == EMPTY
+        fp_match = (slot_fp1 == fp1) & (slot_fp2 == fp2)
+        exact = fp_match & _keys_match(table, cand, key_cols)
+
+        # 1) resolve matches (live or tombstoned — caller reads `live`)
+        hit = unresolved & exact
+        slots = jnp.where(hit, cand, slots)
+        found = found | (hit & table.live[cand])
+        unresolved = unresolved & ~hit
+
+        if insert_missing:
+            # 2) claim empty slots; one scatter, arbitrary winner per slot
+            want = unresolved & is_empty
+            idx = jnp.where(want, cand, cap)  # cap = drop lane
+            new_fp1 = table.fp1.at[idx].set(fp1, mode="drop")
+            new_fp2 = table.fp2.at[idx].set(fp2, mode="drop")
+            new_keys = tuple(
+                tk.at[idx].set(k, mode="drop")
+                for tk, k in zip(table.keys, key_cols)
+            )
+            table = HashTable(new_fp1, new_fp2, new_keys, table.live)
+            # 3) verify: did my (or a same-key twin's) write land?
+            won = (
+                want
+                & (table.fp1[cand] == fp1)
+                & (table.fp2[cand] == fp2)
+                & _keys_match(table, cand, key_cols)
+            )
+            slots = jnp.where(won, cand, slots)
+            inserted = inserted | won
+            unresolved = unresolved & ~won
+            # NOTE: two rows with the SAME key can both claim-win the same
+            # slot in one step — both get `inserted`; dedup is by
+            # first-occurrence masks downstream, slot identity is what
+            # matters for correctness.
+
+        # rows that neither matched nor claimed advance to probe t+1
+        return table, slots, found, inserted, unresolved
+
+    table, slots, found, inserted, _ = jax.lax.fori_loop(
+        0, MAX_PROBE, body, (table, slots, found, inserted, unresolved)
+    )
+    return table, slots, found, inserted
+
+
+@jax.jit
+def lookup(table: HashTable, key_cols, valid):
+    """Read-only probe: returns (slots, found_live). slots -1 if absent."""
+    cap = table.capacity
+    mask = jnp.uint32(cap - 1)
+    h1, h2 = hash128(key_cols)
+    fp1 = jnp.where(h1 == 0, jnp.uint32(1), h1)
+    fp2 = h2
+    n = valid.shape[0]
+
+    def body(t, carry):
+        slots, found, unresolved = carry
+        cand = ((h1 + jnp.uint32(t)) & mask).astype(jnp.int32)
+        slot_fp1 = table.fp1[cand]
+        exact = (
+            (slot_fp1 == fp1)
+            & (table.fp2[cand] == fp2)
+            & _keys_match(table, cand, key_cols)
+        )
+        hit = unresolved & exact
+        slots = jnp.where(hit, cand, slots)
+        found = found | (hit & table.live[cand])
+        # probe chain ends at a truly EMPTY slot -> key absent
+        dead_end = unresolved & (slot_fp1 == EMPTY)
+        unresolved = unresolved & ~hit & ~dead_end
+        return slots, found, unresolved
+
+    slots = jnp.full(n, -1, jnp.int32)
+    found = jnp.zeros(n, jnp.bool_)
+    slots, found, _ = jax.lax.fori_loop(
+        0, MAX_PROBE, body, (slots, found, valid)
+    )
+    return slots, found
+
+
+def set_live(table: HashTable, slots: jnp.ndarray, live_value: jnp.ndarray) -> HashTable:
+    """Mark slots live/dead (dead = logical delete, slot stays claimed)."""
+    cap = table.capacity
+    idx = jnp.where(slots >= 0, slots, cap)
+    new_live = table.live.at[idx].set(live_value, mode="drop")
+    return HashTable(table.fp1, table.fp2, table.keys, new_live)
+
+
+def first_occurrence_mask(slots: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """True for the first valid row of each distinct slot in the batch.
+
+    Used to dedupe per-group work (e.g. one U-/U+ emission per group per
+    chunk, mirroring the reference's per-barrier dirty-group flush,
+    hash_agg.rs:406). Sort-based, shape-static.
+    """
+    n = slots.shape[0]
+    order = jnp.argsort(
+        jnp.where(valid & (slots >= 0), slots, jnp.int32(2**30)), stable=True
+    )
+    s_sorted = slots[order]
+    v_sorted = (valid & (slots >= 0))[order]
+    first_sorted = v_sorted & jnp.concatenate(
+        [jnp.ones(1, jnp.bool_), s_sorted[1:] != s_sorted[:-1]]
+    )
+    mask = jnp.zeros(n, jnp.bool_).at[order].set(first_sorted)
+    return mask

@@ -1,0 +1,77 @@
+"""Vectorized hashing: compound keys and vnode partitioning.
+
+Reference:
+- src/common/src/hash/consistent_hash/vnode.rs:34,54-56 — 256 virtual
+  nodes (``VirtualNode::BITS = 8``); a row maps to a vnode by hashing its
+  distribution key; vnode -> worker via a mapping owned by the control
+  plane (docs/consistent-hash.md).
+- src/common/src/hash/key.rs — pre-serialized compound hash keys.
+
+TPU re-design: keys are never serialized to bytes on device. A compound
+key is a tuple of int32/float32 lanes; we mix them with a murmur3-style
+finalizer chain entirely in uint32 vector ops (VPU-friendly, no i64).
+The 64-bit reference hash (XxHash64) is replaced by two independent
+32-bit mixes when a wider fingerprint is needed (see ``hash128``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+VNODE_COUNT = 256  # parity with VirtualNode::COUNT (vnode.rs:54-56)
+
+
+def _mix32(h: jnp.ndarray) -> jnp.ndarray:
+    """fmix32 from murmur3 — avalanche finalizer on uint32 lanes."""
+    h = h.astype(jnp.uint32)
+    h ^= h >> 16
+    h = h * jnp.uint32(0x85EBCA6B)
+    h ^= h >> 13
+    h = h * jnp.uint32(0xC2B2AE35)
+    h ^= h >> 16
+    return h
+
+
+def _to_u32_lanes(col: jnp.ndarray) -> jnp.ndarray:
+    """Bit-cast any supported column dtype to uint32 lanes."""
+    if col.dtype == jnp.bool_:
+        return col.astype(jnp.uint32)
+    if col.dtype in (jnp.float32,):
+        # canonicalize -0.0 to +0.0 so equal SQL values hash equally
+        col = jnp.where(col == 0.0, 0.0, col)
+        return jax.lax.bitcast_convert_type(col, jnp.uint32)
+    if col.dtype in (jnp.int64, jnp.uint64):
+        lo = (col & 0xFFFFFFFF).astype(jnp.uint32)
+        hi = (col >> 32).astype(jnp.uint32)
+        return _mix32(lo) ^ (hi * jnp.uint32(0x9E3779B9))
+    return col.astype(jnp.uint32)
+
+
+def hash_columns(cols: Sequence[jnp.ndarray], seed: int = 0) -> jnp.ndarray:
+    """Hash a compound key column-set to uint32, row-wise.
+
+    Equivalent role to ``HashKey::hash`` over the distribution/group key
+    (reference: src/common/src/hash/key.rs); boost-style hash_combine
+    chains the per-column mixes.
+    """
+    h = jnp.full(cols[0].shape, jnp.uint32(0x811C9DC5 ^ seed), dtype=jnp.uint32)
+    for c in cols:
+        lanes = _to_u32_lanes(c)
+        h = h ^ (_mix32(lanes) + jnp.uint32(0x9E3779B9) + (h << 6) + (h >> 2))
+    return _mix32(h)
+
+
+def hash128(cols: Sequence[jnp.ndarray]) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Two independent 32-bit hashes (fingerprint + probe seed)."""
+    return hash_columns(cols, seed=0), hash_columns(cols, seed=0x5BD1E995)
+
+
+def vnode_of(cols: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """Row -> virtual node in [0, 256) (reference: vnode.rs:34,
+
+    TableDistribution::compute_vnode, src/common/src/hash/table_distribution.rs).
+    """
+    return (hash_columns(cols, seed=0xC0FFEE) % VNODE_COUNT).astype(jnp.int32)

@@ -1,0 +1,139 @@
+"""Type system for the TPU dataflow plane.
+
+Reference: src/common/src/types/ (DataType / ScalarImpl, 20+ SQL types).
+
+The device plane is deliberately narrower than the reference's SQL type
+zoo: TPUs want fixed-width vector lanes, so every device column is one of
+a small set of JAX dtypes. Wider SQL types are mapped at the host edge:
+
+- INT16/INT32          -> int32
+- INT64                -> int64 (stored as int64 on host; on device we
+                          keep int32 where the framework knows values fit,
+                          else a (hi, lo) int32 pair — see Int64Col)
+- FLOAT32/FLOAT64      -> float32 (bf16 on request for agg payloads)
+- BOOLEAN              -> bool_
+- TIMESTAMP            -> int32 milliseconds relative to the stream base
+                          epoch (windows only ever subtract timestamps,
+                          so a relative encoding keeps them in int32 lanes)
+- VARCHAR              -> int32 dictionary code (dictionary lives host-side)
+- DECIMAL              -> scaled int32/int64 at the host edge
+
+Ops on a StreamChunk follow the reference exactly
+(src/common/src/array/stream_chunk.rs:45): Insert / Delete /
+UpdateDelete / UpdateInsert. ``Op.sign`` maps these to +1/-1 retraction
+signs used by every aggregation kernel.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class Op(enum.IntEnum):
+    """Row-level change op (reference: stream_chunk.rs:45)."""
+
+    INSERT = 0
+    DELETE = 1
+    UPDATE_DELETE = 2
+    UPDATE_INSERT = 3
+
+
+def op_sign(ops: jnp.ndarray) -> jnp.ndarray:
+    """+1 for Insert/UpdateInsert, -1 for Delete/UpdateDelete."""
+    retract = (ops == Op.DELETE) | (ops == Op.UPDATE_DELETE)
+    return jnp.where(retract, jnp.int32(-1), jnp.int32(1))
+
+
+class DataType(enum.Enum):
+    """Logical column types at the SQL/host edge."""
+
+    INT32 = "int32"
+    INT64 = "int64"
+    FLOAT32 = "float32"
+    FLOAT64 = "float64"
+    BOOLEAN = "boolean"
+    TIMESTAMP = "timestamp"  # ms relative to stream base, int32 on device
+    VARCHAR = "varchar"  # dictionary-encoded int32 on device
+
+    @property
+    def device_dtype(self) -> np.dtype:
+        return {
+            DataType.INT32: np.dtype(np.int32),
+            DataType.INT64: np.dtype(np.int64),
+            DataType.FLOAT32: np.dtype(np.float32),
+            DataType.FLOAT64: np.dtype(np.float32),
+            DataType.BOOLEAN: np.dtype(np.bool_),
+            DataType.TIMESTAMP: np.dtype(np.int32),
+            DataType.VARCHAR: np.dtype(np.int32),
+        }[self]
+
+    @property
+    def null_value(self):
+        """Padding value used in invalid lanes (never observed by kernels)."""
+        if self in (DataType.FLOAT32, DataType.FLOAT64):
+            return np.float32(0.0)
+        if self is DataType.BOOLEAN:
+            return np.bool_(False)
+        return self.device_dtype.type(0)
+
+
+@dataclass(frozen=True)
+class Field:
+    """A named, typed column in a schema."""
+
+    name: str
+    dtype: DataType
+
+    def __repr__(self) -> str:  # compact for schema dumps
+        return f"{self.name}:{self.dtype.value}"
+
+
+@dataclass(frozen=True)
+class Schema:
+    """Ordered list of fields (reference: src/common/src/catalog/schema.rs)."""
+
+    fields: tuple[Field, ...]
+
+    def __init__(self, fields):
+        object.__setattr__(
+            self,
+            "fields",
+            tuple(
+                f if isinstance(f, Field) else Field(f[0], f[1]) for f in fields
+            ),
+        )
+
+    @property
+    def names(self) -> tuple[str, ...]:
+        return tuple(f.name for f in self.fields)
+
+    def field(self, name: str) -> Field:
+        for f in self.fields:
+            if f.name == name:
+                return f
+        raise KeyError(name)
+
+    def index(self, name: str) -> int:
+        for i, f in enumerate(self.fields):
+            if f.name == name:
+                return i
+        raise KeyError(name)
+
+    def __len__(self) -> int:
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def select(self, names) -> "Schema":
+        return Schema(tuple(self.field(n) for n in names))
+
+    def concat(self, other: "Schema", prefix: str = "") -> "Schema":
+        return Schema(
+            self.fields
+            + tuple(Field(prefix + f.name, f.dtype) for f in other.fields)
+        )

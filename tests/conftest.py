@@ -1,0 +1,34 @@
+"""Test config: force a virtual 8-device CPU platform.
+
+Mirrors the reference's approach of testing multi-node behavior without a
+cluster (madsim simulation, src/tests/simulation/): we test multi-chip
+sharding on a virtual CPU mesh; the real-TPU path is exercised by
+bench.py / __graft_entry__.py on hardware.
+
+NOTE: the environment ships a sitecustomize that registers the `axon`
+TPU plugin and *forces* JAX_PLATFORMS=axon via an in-process hook, so
+setting the env var alone is not enough — we must also flip jax's
+config after import. Tests must never touch the real TPU: the tunnel
+admits one client and a killed test run can wedge it.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,53 @@
+"""Chunk model unit tests (reference behavior: data_chunk.rs / stream_chunk.rs)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.array.chunk import DataChunk, StreamChunk
+from risingwave_tpu.types import DataType, Op, Schema
+
+
+def test_roundtrip_padding():
+    c = DataChunk.from_numpy({"a": np.arange(5), "b": np.ones(5) * 0.5}, capacity=8)
+    assert c.capacity == 8
+    assert int(c.num_rows()) == 5
+    out = c.to_numpy()
+    np.testing.assert_array_equal(out["a"], np.arange(5))
+    assert out["b"].shape == (5,)
+
+
+def test_stream_chunk_signs():
+    ops = np.array([Op.INSERT, Op.DELETE, Op.UPDATE_DELETE, Op.UPDATE_INSERT])
+    c = StreamChunk.from_numpy({"x": np.arange(4)}, capacity=6, ops=ops)
+    np.testing.assert_array_equal(
+        np.asarray(c.effective_signs()), [1, -1, -1, 1, 0, 0]
+    )
+
+
+def test_mask_filter():
+    c = StreamChunk.from_numpy({"x": np.arange(6)}, capacity=8)
+    filtered = c.mask(c.col("x") % 2 == 0)
+    out = filtered.to_numpy()
+    np.testing.assert_array_equal(out["x"], [0, 2, 4])
+
+
+def test_chunk_is_pytree():
+    c = StreamChunk.from_numpy({"x": np.arange(4), "y": np.arange(4)}, capacity=4)
+
+    @jax.jit
+    def double(ch):
+        return ch.with_columns(x=ch.col("x") * 2)
+
+    out = double(c)
+    np.testing.assert_array_equal(out.to_numpy()["x"], [0, 2, 4, 6])
+    # ops and valid survive the pytree roundtrip
+    assert out.ops.shape == (4,)
+
+
+def test_schema_types():
+    s = Schema([("id", DataType.INT64), ("price", DataType.FLOAT32)])
+    assert s.field("price").dtype.device_dtype == np.float32
+    assert s.index("id") == 0
+    c = DataChunk.from_numpy({"id": np.arange(3), "price": np.arange(3)}, 4, schema=s)
+    assert c.col("price").dtype == jnp.float32
