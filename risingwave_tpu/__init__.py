@@ -21,7 +21,24 @@ A ground-up re-design of the capabilities of RisingWave (reference:
   on-device all-to-all inside a ``shard_map``-ped step, riding ICI.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
+
+import jax as _jax
+
+# SQL semantics demand real 64-bit integers (BIGINT ids in every Nexmark
+# stream) and real f64 accumulation (SUM over DOUBLE). Without this flag
+# jnp silently truncates int64 -> int32, which merges distinct group/join
+# keys (see ADVICE.md r1, high). XLA:TPU emulates 64-bit lanes with
+# 32-bit pairs; the hot hash path bit-splits to u32 lanes up front, so
+# only wide aggregation payloads pay the emulation cost.
+_jax.config.update("jax_enable_x64", True)
+if not _jax.config.jax_enable_x64:  # e.g. JAX_ENABLE_X64=0 overrides
+    raise RuntimeError(
+        "risingwave_tpu requires 64-bit JAX types (jax_enable_x64); "
+        "unset JAX_ENABLE_X64 or remove the conflicting override — "
+        "without it BIGINT keys silently truncate and distinct group/"
+        "join keys merge."
+    )
 
 from risingwave_tpu.types import DataType, Op
 from risingwave_tpu.array.chunk import DataChunk, StreamChunk

@@ -113,7 +113,8 @@ class DataChunk:
 class StreamChunk(DataChunk):
     """DataChunk + per-row change op (reference: stream_chunk.rs:98)."""
 
-    ops: jnp.ndarray = None  # (capacity,) int32 of types.Op
+    ops: jnp.ndarray  # (capacity,) int32 of types.Op — required; use
+    # ``from_data``/``from_numpy`` to default to all-INSERT
 
     def tree_flatten(self):
         names = tuple(sorted(self.columns))

@@ -132,9 +132,13 @@ def lookup_or_insert(
     found = jnp.zeros(n, jnp.bool_)
     inserted = jnp.zeros(n, jnp.bool_)
     unresolved = valid
+    # claim scratch is allocated ONCE and carried through the loop —
+    # refilling O(capacity) per probe step would dominate the insert for
+    # big tables. Entries are wiped after each election (O(n) scatter).
+    claim = jnp.full(cap, n, jnp.int32)
 
     def body(t, carry):
-        table, slots, found, inserted, unresolved = carry
+        table, slots, found, inserted, unresolved, claim = carry
         cand = ((h1 + jnp.uint32(t)) & mask).astype(jnp.int32)
 
         slot_fp1 = table.fp1[cand]
@@ -150,36 +154,45 @@ def lookup_or_insert(
         unresolved = unresolved & ~hit
 
         if insert_missing:
-            # 2) claim empty slots; one scatter, arbitrary winner per slot
+            # 2) elect ONE winner per contended empty slot with a single
+            # scatter of the row index; the winner then writes fp + every
+            # key lane uncontended. (Four independent scatters could pick
+            # different winners per lane, leaving a torn chimera slot that
+            # matches no key and leaks capacity — ADVICE.md r1, medium.)
             want = unresolved & is_empty
             idx = jnp.where(want, cand, cap)  # cap = drop lane
-            new_fp1 = table.fp1.at[idx].set(fp1, mode="drop")
-            new_fp2 = table.fp2.at[idx].set(fp2, mode="drop")
+            row_ids = jnp.arange(n, dtype=jnp.int32)
+            claim = claim.at[idx].set(row_ids, mode="drop")
+            won = want & (claim[cand] == row_ids)
+            # wipe this round's entries so the scratch stays all-sentinel
+            claim = claim.at[idx].set(n, mode="drop")
+            widx = jnp.where(won, cand, cap)
+            new_fp1 = table.fp1.at[widx].set(fp1, mode="drop")
+            new_fp2 = table.fp2.at[widx].set(fp2, mode="drop")
             new_keys = tuple(
-                tk.at[idx].set(k, mode="drop")
+                tk.at[widx].set(k, mode="drop")
                 for tk, k in zip(table.keys, key_cols)
             )
             table = HashTable(new_fp1, new_fp2, new_keys, table.live)
-            # 3) verify: did my (or a same-key twin's) write land?
-            won = (
+            # 3) same-key twins of the winner resolve to the slot too
+            landed = (
                 want
                 & (table.fp1[cand] == fp1)
                 & (table.fp2[cand] == fp2)
                 & _keys_match(table, cand, key_cols)
             )
-            slots = jnp.where(won, cand, slots)
-            inserted = inserted | won
-            unresolved = unresolved & ~won
-            # NOTE: two rows with the SAME key can both claim-win the same
-            # slot in one step — both get `inserted`; dedup is by
-            # first-occurrence masks downstream, slot identity is what
-            # matters for correctness.
+            slots = jnp.where(landed, cand, slots)
+            inserted = inserted | landed
+            unresolved = unresolved & ~landed
+            # NOTE: a winner and its same-key twins all get `inserted`;
+            # dedup is by first-occurrence masks downstream, slot identity
+            # is what matters for correctness.
 
         # rows that neither matched nor claimed advance to probe t+1
-        return table, slots, found, inserted, unresolved
+        return table, slots, found, inserted, unresolved, claim
 
-    table, slots, found, inserted, _ = jax.lax.fori_loop(
-        0, MAX_PROBE, body, (table, slots, found, inserted, unresolved)
+    table, slots, found, inserted, _, _ = jax.lax.fori_loop(
+        0, MAX_PROBE, body, (table, slots, found, inserted, unresolved, claim)
     )
     return table, slots, found, inserted
 

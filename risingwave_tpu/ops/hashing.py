@@ -8,10 +8,12 @@ Reference:
 - src/common/src/hash/key.rs — pre-serialized compound hash keys.
 
 TPU re-design: keys are never serialized to bytes on device. A compound
-key is a tuple of int32/float32 lanes; we mix them with a murmur3-style
-finalizer chain entirely in uint32 vector ops (VPU-friendly, no i64).
-The 64-bit reference hash (XxHash64) is replaced by two independent
-32-bit mixes when a wider fingerprint is needed (see ``hash128``).
+key is a tuple of typed lanes; 64-bit columns are bit-split into (lo, hi)
+uint32 lane pairs up front so the mixing chain itself runs entirely in
+uint32 vector ops (VPU-friendly) while every key bit still reaches every
+mix. The 64-bit reference hash (XxHash64) is replaced by two
+independently-seeded 32-bit mixes when a wider fingerprint is needed
+(see ``hash128``).
 """
 
 from __future__ import annotations
@@ -35,19 +37,35 @@ def _mix32(h: jnp.ndarray) -> jnp.ndarray:
     return h
 
 
-def _to_u32_lanes(col: jnp.ndarray) -> jnp.ndarray:
-    """Bit-cast any supported column dtype to uint32 lanes."""
+def _to_u32_lanes(col: jnp.ndarray) -> list[jnp.ndarray]:
+    """Bit-cast any supported column dtype to one or more uint32 lane sets.
+
+    64-bit columns yield BOTH halves as separate lanes (lo, hi) so the
+    full 64 bits of the key flow into every downstream mix — folding to a
+    single u32 would make the "independent" fingerprints of ``hash128``
+    collide together for int64 ids, the most common key type in Nexmark
+    (ADVICE.md r1 weak #6).
+    """
     if col.dtype == jnp.bool_:
-        return col.astype(jnp.uint32)
-    if col.dtype in (jnp.float32,):
+        return [col.astype(jnp.uint32)]
+    if col.dtype == jnp.float32:
         # canonicalize -0.0 to +0.0 so equal SQL values hash equally
-        col = jnp.where(col == 0.0, 0.0, col)
-        return jax.lax.bitcast_convert_type(col, jnp.uint32)
+        col = jnp.where(col == 0.0, jnp.float32(0.0), col)
+        return [jax.lax.bitcast_convert_type(col, jnp.uint32)]
+    if col.dtype == jnp.float64:
+        col = jnp.where(col == 0.0, jnp.float64(0.0), col)
+        bits = jax.lax.bitcast_convert_type(col, jnp.uint64)
+        return [
+            (bits & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32),
+            (bits >> jnp.uint64(32)).astype(jnp.uint32),
+        ]
     if col.dtype in (jnp.int64, jnp.uint64):
-        lo = (col & 0xFFFFFFFF).astype(jnp.uint32)
-        hi = (col >> 32).astype(jnp.uint32)
-        return _mix32(lo) ^ (hi * jnp.uint32(0x9E3779B9))
-    return col.astype(jnp.uint32)
+        u = col.astype(jnp.uint64)
+        return [
+            (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32),
+            (u >> jnp.uint64(32)).astype(jnp.uint32),
+        ]
+    return [col.astype(jnp.uint32)]
 
 
 def hash_columns(cols: Sequence[jnp.ndarray], seed: int = 0) -> jnp.ndarray:
@@ -55,12 +73,12 @@ def hash_columns(cols: Sequence[jnp.ndarray], seed: int = 0) -> jnp.ndarray:
 
     Equivalent role to ``HashKey::hash`` over the distribution/group key
     (reference: src/common/src/hash/key.rs); boost-style hash_combine
-    chains the per-column mixes.
+    chains the per-lane mixes.
     """
     h = jnp.full(cols[0].shape, jnp.uint32(0x811C9DC5 ^ seed), dtype=jnp.uint32)
     for c in cols:
-        lanes = _to_u32_lanes(c)
-        h = h ^ (_mix32(lanes) + jnp.uint32(0x9E3779B9) + (h << 6) + (h >> 2))
+        for lanes in _to_u32_lanes(c):
+            h = h ^ (_mix32(lanes) + jnp.uint32(0x9E3779B9) + (h << 6) + (h >> 2))
     return _mix32(h)
 
 

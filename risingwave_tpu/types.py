@@ -7,16 +7,18 @@ zoo: TPUs want fixed-width vector lanes, so every device column is one of
 a small set of JAX dtypes. Wider SQL types are mapped at the host edge:
 
 - INT16/INT32          -> int32
-- INT64                -> int64 (stored as int64 on host; on device we
-                          keep int32 where the framework knows values fit,
-                          else a (hi, lo) int32 pair — see Int64Col)
-- FLOAT32/FLOAT64      -> float32 (bf16 on request for agg payloads)
+- INT64                -> int64 (real 64-bit lanes; the package enables
+                          jax x64 so these never silently truncate)
+- FLOAT32              -> float32
+- FLOAT64              -> float64 (real f64 — SQL DOUBLE sums must not
+                          drift; XLA emulates f64 on TPU, and hot agg
+                          payloads may opt into f32/bf16 explicitly)
 - BOOLEAN              -> bool_
-- TIMESTAMP            -> int32 milliseconds relative to the stream base
-                          epoch (windows only ever subtract timestamps,
-                          so a relative encoding keeps them in int32 lanes)
-- VARCHAR              -> int32 dictionary code (dictionary lives host-side)
-- DECIMAL              -> scaled int32/int64 at the host edge
+- TIMESTAMP            -> int64 milliseconds since epoch (Nexmark and the
+                          reference both carry ms timestamps)
+- VARCHAR              -> int32 dictionary code (dictionary lives host-side,
+                          see array/dictionary.py)
+- DECIMAL              -> scaled int64 at the host edge
 
 Ops on a StreamChunk follow the reference exactly
 (src/common/src/array/stream_chunk.rs:45): Insert / Delete /
@@ -56,7 +58,7 @@ class DataType(enum.Enum):
     FLOAT32 = "float32"
     FLOAT64 = "float64"
     BOOLEAN = "boolean"
-    TIMESTAMP = "timestamp"  # ms relative to stream base, int32 on device
+    TIMESTAMP = "timestamp"  # ms since epoch, int64 on device
     VARCHAR = "varchar"  # dictionary-encoded int32 on device
 
     @property
@@ -65,17 +67,19 @@ class DataType(enum.Enum):
             DataType.INT32: np.dtype(np.int32),
             DataType.INT64: np.dtype(np.int64),
             DataType.FLOAT32: np.dtype(np.float32),
-            DataType.FLOAT64: np.dtype(np.float32),
+            DataType.FLOAT64: np.dtype(np.float64),
             DataType.BOOLEAN: np.dtype(np.bool_),
-            DataType.TIMESTAMP: np.dtype(np.int32),
+            DataType.TIMESTAMP: np.dtype(np.int64),
             DataType.VARCHAR: np.dtype(np.int32),
         }[self]
 
     @property
     def null_value(self):
         """Padding value used in invalid lanes (never observed by kernels)."""
-        if self in (DataType.FLOAT32, DataType.FLOAT64):
+        if self is DataType.FLOAT32:
             return np.float32(0.0)
+        if self is DataType.FLOAT64:
+            return np.float64(0.0)
         if self is DataType.BOOLEAN:
             return np.bool_(False)
         return self.device_dtype.type(0)

@@ -99,6 +99,35 @@ def test_high_load(rng):
     assert len(np.unique(slots)) == 512
 
 
+def test_no_torn_slots_under_contention(rng):
+    # Many distinct keys fighting for slots in a small table: every
+    # claimed slot must hold the fp+keys of ONE real inserted key (the r1
+    # four-scatter claim could interleave lanes from different rows).
+    table = _mk(capacity=128)
+    keys = rng.choice(1 << 20, size=64, replace=False).astype(np.int32)
+    table, slots, _, inserted = _insert(table, keys)
+    assert (slots >= 0).all() and inserted.all()
+    claimed = np.flatnonzero(np.asarray(table.fp1) != 0)
+    stored = np.asarray(table.keys[0])[claimed]
+    assert set(stored) <= set(keys.tolist()), "chimera slot detected"
+    # every claimed slot is one a row actually resolved to — no leaks
+    assert set(claimed.tolist()) == set(slots.tolist())
+
+
+def test_int64_keys_distinct_above_bit32():
+    # BIGINT keys differing only in the high word must not merge
+    table = ht.HashTable.create(256, (jnp.int64,))
+    keys = np.array([5, 2**33 + 5, 2**40 + 5], np.int64)
+    k = (jnp.asarray(keys),)
+    valid = jnp.ones(3, jnp.bool_)
+    table, slots, found, ins = ht.lookup_or_insert(table, k, valid)
+    slots = np.asarray(slots)
+    assert len(np.unique(slots)) == 3
+    assert not np.asarray(found).any()
+    stored = np.asarray(table.keys[0])[slots]
+    np.testing.assert_array_equal(stored, keys)
+
+
 def test_first_occurrence_mask():
     slots = jnp.asarray(np.array([3, 5, 3, 7, 5, 3], np.int32))
     valid = jnp.asarray(np.array([1, 1, 1, 1, 1, 0], np.bool_))

@@ -41,5 +41,32 @@ def test_float_negative_zero():
 
 def test_int64_lanes():
     big = jnp.asarray(np.array([2**40, 2**40 + 1, 5], np.int64))
+    # guard against silent truncation (ADVICE r1 high): values above bit
+    # 31 must survive the device round-trip with their dtype intact
+    assert big.dtype == jnp.int64
+    np.testing.assert_array_equal(
+        np.asarray(big), np.array([2**40, 2**40 + 1, 5], np.int64)
+    )
     h = np.asarray(hash_columns([big]))
     assert len(np.unique(h)) == 3
+
+
+def test_int64_high_bits_reach_both_fingerprints():
+    # keys differing ONLY above bit 31 must differ in BOTH hash128 mixes;
+    # the r1 folding scheme collapsed them to one folded u32, weakening
+    # the pair to <64 bits for BIGINT ids
+    a = jnp.asarray(np.array([5, 2**33 + 5, 2**34 + 5], np.int64))
+    h1, h2 = hash128([a])
+    assert len(np.unique(np.asarray(h1))) == 3
+    assert len(np.unique(np.asarray(h2))) == 3
+
+
+def test_float64_hash_precision():
+    # doubles differing only below f32 precision must hash differently
+    x = jnp.asarray(np.array([1.0, 1.0 + 1e-12], np.float64))
+    assert x.dtype == jnp.float64
+    h = np.asarray(hash_columns([x]))
+    assert h[0] != h[1]
+    z = jnp.asarray(np.array([0.0, -0.0], np.float64))
+    hz = np.asarray(hash_columns([z]))
+    assert hz[0] == hz[1]
