@@ -31,16 +31,25 @@ import jax as _jax
 # keys (see ADVICE.md r1, high). XLA:TPU emulates 64-bit lanes with
 # 32-bit pairs; the hot hash path bit-splits to u32 lanes up front, so
 # only wide aggregation payloads pay the emulation cost.
+#
+# This is a process-global setting: importing risingwave_tpu opts the
+# whole process into x64 (framework-style, like importing torch sets its
+# global state). Embedders co-hosting other x32 JAX code should isolate
+# processes; flipping the flag back off after import silently re-enables
+# BIGINT truncation and is unsupported.
 _jax.config.update("jax_enable_x64", True)
-if not _jax.config.jax_enable_x64:  # e.g. JAX_ENABLE_X64=0 overrides
-    raise RuntimeError(
-        "risingwave_tpu requires 64-bit JAX types (jax_enable_x64); "
-        "unset JAX_ENABLE_X64 or remove the conflicting override — "
-        "without it BIGINT keys silently truncate and distinct group/"
-        "join keys merge."
-    )
 
-from risingwave_tpu.types import DataType, Op
+from risingwave_tpu.types import DataType, Field, Op, Schema
 from risingwave_tpu.array.chunk import DataChunk, StreamChunk
+from risingwave_tpu.array.dictionary import StringDictionary
 
-__all__ = ["DataType", "Op", "DataChunk", "StreamChunk", "__version__"]
+__all__ = [
+    "DataType",
+    "Field",
+    "Op",
+    "Schema",
+    "DataChunk",
+    "StreamChunk",
+    "StringDictionary",
+    "__version__",
+]

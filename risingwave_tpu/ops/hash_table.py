@@ -102,7 +102,16 @@ class HashTable:
 def _keys_match(table: HashTable, slot: jnp.ndarray, key_cols) -> jnp.ndarray:
     ok = jnp.ones(slot.shape, jnp.bool_)
     for tk, k in zip(table.keys, key_cols):
-        ok &= tk[slot] == k
+        stored = tk[slot]
+        eq = stored == k
+        if jnp.issubdtype(tk.dtype, jnp.floating):
+            # ordered-float total equality: NaN == NaN (reference treats
+            # float keys via total ordering, src/common/src/types/). IEEE
+            # `==` would make a NaN key unresolvable: it claims a slot,
+            # fails its own verify, and re-claims forever — leaking
+            # MAX_PROBE slots and returning -1 (a bogus rehash signal).
+            eq |= jnp.isnan(stored) & jnp.isnan(k)
+        ok &= eq
     return ok
 
 

@@ -49,11 +49,15 @@ def _to_u32_lanes(col: jnp.ndarray) -> list[jnp.ndarray]:
     if col.dtype == jnp.bool_:
         return [col.astype(jnp.uint32)]
     if col.dtype == jnp.float32:
-        # canonicalize -0.0 to +0.0 so equal SQL values hash equally
+        # canonicalize -0.0 to +0.0 and all NaN payloads to one NaN so
+        # equal-under-total-order SQL values hash equally (the reference
+        # uses ordered-float total ordering, src/common/src/types/)
         col = jnp.where(col == 0.0, jnp.float32(0.0), col)
+        col = jnp.where(jnp.isnan(col), jnp.float32(jnp.nan), col)
         return [jax.lax.bitcast_convert_type(col, jnp.uint32)]
     if col.dtype == jnp.float64:
         col = jnp.where(col == 0.0, jnp.float64(0.0), col)
+        col = jnp.where(jnp.isnan(col), jnp.float64(jnp.nan), col)
         bits = jax.lax.bitcast_convert_type(col, jnp.uint64)
         return [
             (bits & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32),
@@ -85,6 +89,36 @@ def hash_columns(cols: Sequence[jnp.ndarray], seed: int = 0) -> jnp.ndarray:
 def hash128(cols: Sequence[jnp.ndarray]) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Two independent 32-bit hashes (fingerprint + probe seed)."""
     return hash_columns(cols, seed=0), hash_columns(cols, seed=0x5BD1E995)
+
+
+def group_key_lanes(chunk, names: Sequence[str]) -> tuple[jnp.ndarray, ...]:
+    """Key lanes for GROUP BY / distribution with SQL NULL semantics.
+
+    SQL GROUP BY puts all NULLs in ONE group, distinct from every real
+    value (reference: hash keys serialize a null tag before the datum,
+    src/common/src/hash/key.rs). We realize that as: canonicalize the
+    value lane to its zero where NULL (so NULL rows agree bit-for-bit)
+    and append the bool null lane itself as an extra key lane (so the
+    NULL group never merges with the real zero-valued group).
+
+    The returned tuple plugs directly into hash_columns / hash128 and
+    into HashTable key columns — exact-compare over these lanes IS
+    SQL group-key equality.
+
+    NOTE: equi-JOIN keys have different semantics (NULL matches nothing);
+    join operators must pre-filter null-keyed rows instead.
+    """
+    lanes = []
+    for name in names:
+        col = chunk.col(name)
+        if chunk.is_nullable(name):
+            null = chunk.nulls[name]
+            zero = jnp.zeros((), dtype=col.dtype)
+            lanes.append(jnp.where(null, zero, col))
+            lanes.append(null)
+        else:
+            lanes.append(col)
+    return tuple(lanes)
 
 
 def vnode_of(cols: Sequence[jnp.ndarray]) -> jnp.ndarray:

@@ -128,6 +128,23 @@ def test_int64_keys_distinct_above_bit32():
     np.testing.assert_array_equal(stored, keys)
 
 
+def test_nan_float_keys_resolve():
+    # NaN group keys must behave as ONE group (ordered-float totality);
+    # IEEE NaN != NaN would livelock the claim-verify loop and leak slots
+    table = ht.HashTable.create(256, (jnp.float64,))
+    keys = np.array([np.nan, 1.5, np.nan, -0.0], np.float64)
+    k = (jnp.asarray(keys),)
+    table, slots, found, ins = ht.lookup_or_insert(table, k, jnp.ones(4, bool))
+    slots = np.asarray(slots)
+    assert (slots >= 0).all()
+    assert slots[0] == slots[2], "all NaNs are one key"
+    assert len({slots[0], slots[1], slots[3]}) == 3
+    # exactly 3 slots claimed — no leaked chimera/NaN-retry slots
+    assert int(np.sum(np.asarray(table.fp1) != 0)) == 3
+    s2, f2 = ht.lookup(table, k, jnp.ones(4, bool))
+    np.testing.assert_array_equal(np.asarray(s2), slots)
+
+
 def test_first_occurrence_mask():
     slots = jnp.asarray(np.array([3, 5, 3, 7, 5, 3], np.int32))
     valid = jnp.asarray(np.array([1, 1, 1, 1, 1, 0], np.bool_))
