@@ -33,6 +33,7 @@ class StringDictionary:
     def __init__(self, values: Iterable[str] = ()):  # restore path
         self._strings: List[str] = []
         self._codes: dict[str, int] = {}
+        self._table: np.ndarray | None = None  # decode cache
         for s in values:
             self.encode_one(s)
 
@@ -58,8 +59,11 @@ class StringDictionary:
 
     def decode(self, codes: np.ndarray) -> np.ndarray:
         """Vector decode to a numpy object array of str."""
-        table = np.asarray(self._strings, dtype=object)
-        return table[np.asarray(codes, dtype=np.int64)]
+        # cache the lookup table; rebuild only after growth (decoding a
+        # few codes per barrier must not pay O(dictionary) each time)
+        if self._table is None or len(self._table) != len(self._strings):
+            self._table = np.asarray(self._strings, dtype=object)
+        return self._table[np.asarray(codes, dtype=np.int64)]
 
     # -- persistence (used by state checkpointing) ----------------------
     def dump(self) -> List[str]:

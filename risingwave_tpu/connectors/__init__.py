@@ -1,0 +1,7 @@
+"""Connector sources/sinks (reference: src/connector/).
+
+v0 scope: the Nexmark generator source (the benchmark workhorse,
+reference src/connector/src/source/nexmark/) and a datagen-style random
+source; external systems (Kafka etc.) are out of scope until the
+network edge exists.
+"""

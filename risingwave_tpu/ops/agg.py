@@ -1,0 +1,384 @@
+"""Grouped aggregation state kernel — the core of HashAgg.
+
+Reference roles replaced:
+- per-group agg state + apply_chunk
+  (src/stream/src/executor/hash_agg.rs:326, executor/aggregation/
+  {agg_group.rs, agg_state.rs})
+- dirty-group tracking + per-barrier flush_data emitting one
+  retraction/update row pair per changed group (hash_agg.rs:406).
+
+TPU re-design: agg state is NOT a map of per-group objects — it is a
+struct-of-arrays indexed by hash-table slot (ops/hash_table.py assigns
+slots). Applying a chunk is a handful of masked segment-scatters:
+
+    count[slot]  += sign                  (COUNT(*) / group liveness)
+    sum[slot]    += sign * value          (SUM / COUNT(col))
+    min[slot]     = min(min[slot], value) (append-only MIN/MAX)
+
+so a whole chunk of any size updates all its groups in O(chunk) scatter
+work with zero host round-trips, and the whole thing fuses under jit.
+
+Retraction: sum/count invert exactly via the sign. MIN/MAX cannot be
+retracted without per-group materialized input (reference keeps a sorted
+state table per extreme agg call, executor/aggregation/minput.rs); this
+kernel maintains them append-only and *flags* any retraction touching a
+MIN/MAX call in ``state.minmax_retracted`` so the host can reject or
+escalate (windowed Nexmark plans delete whole groups, never individual
+rows, so the append-only path covers q5/q7/q8).
+
+Flush: per-barrier delta emission compacts dirty slots to the front
+(static shapes) and emits, per dirty group:
+
+    previously emitted & still live  -> (U-, old row) + (U+, new row)
+    previously emitted & dead        -> (D,  old row)
+    never emitted      & live        -> (I,  new row)
+
+matching the reference's AggChangesEmitter semantics (hash_agg.rs:406).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from risingwave_tpu.types import Op
+
+KINDS = ("count_star", "count", "sum", "min", "max")
+
+
+@dataclass(frozen=True)
+class AggCall:
+    """One aggregate call: kind + input column -> output column.
+
+    Mirrors the reference's ``AggCall`` (src/expr/core/src/aggregate/)
+    narrowed to the kernel-supported kinds.
+    """
+
+    kind: str
+    input: Optional[str]  # None for count_star
+    output: str
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unsupported agg kind {self.kind!r}")
+        if (self.input is None) != (self.kind == "count_star"):
+            raise ValueError(f"{self.kind} input mismatch")
+
+
+# sentinel init values for extreme aggs, per payload dtype
+def _extreme_init(dtype, kind: str):
+    info = jnp.iinfo(dtype)
+    return jnp.array(info.max if kind == "min" else info.min, dtype)
+
+
+# -- ordered-float total-order encoding ---------------------------------
+# Float MIN/MAX accumulators are stored as UNSIGNED total-order keys, not
+# floats: scatter-min over raw floats lets one NaN poison a group forever
+# (min(NaN, x) = NaN and append-only extremes can never retract it). The
+# reference's ordered-float total ordering (src/common/src/types/, also
+# used for the minput.rs sorted state) places NaN as the single largest
+# value; the classic bit trick below realizes exactly that ordering on
+# integer lanes, which the TPU scatters natively.
+
+_FLOAT_ORDER = {
+    jnp.dtype(jnp.float32): (jnp.uint32, jnp.uint32(1) << 31),
+    jnp.dtype(jnp.float64): (jnp.uint64, jnp.uint64(1) << 63),
+}
+
+
+def _float_to_order_key(v: jnp.ndarray) -> jnp.ndarray:
+    udtype, sign = _FLOAT_ORDER[jnp.dtype(v.dtype)]
+    # canonicalize: one zero, one (positive quiet) NaN
+    v = jnp.where(v == 0.0, jnp.zeros((), v.dtype), v)
+    v = jnp.where(jnp.isnan(v), jnp.full((), jnp.nan, v.dtype), v)
+    bits = jax.lax.bitcast_convert_type(v, udtype)
+    neg = (bits & sign) != 0
+    return jnp.where(neg, ~bits, bits | sign)
+
+
+def _order_key_to_float(k: jnp.ndarray, float_dtype) -> jnp.ndarray:
+    udtype, sign = _FLOAT_ORDER[jnp.dtype(float_dtype)]
+    was_pos = (k & sign) != 0
+    bits = jnp.where(was_pos, k & ~sign, ~k)
+    return jax.lax.bitcast_convert_type(bits.astype(udtype), float_dtype)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class AggState:
+    """Slot-indexed aggregation state (all arrays length = capacity).
+
+    ``row_count`` is the implicit COUNT(*) that determines group
+    liveness (reference: AggGroup keeps row_count to decide emit vs
+    delete, agg_group.rs). ``accums[name]`` holds one accumulator lane
+    per AggCall output. ``emitted*`` snapshot what downstream has seen,
+    so flush can produce exact U-/U+ retractions. ``dirty`` marks slots
+    touched since the last flush. ``minmax_retracted`` latches the
+    unsupported-retraction condition for host-side checking.
+    """
+
+    row_count: jnp.ndarray  # int64
+    accums: Dict[str, jnp.ndarray]
+    emitted: Dict[str, jnp.ndarray]
+    emitted_valid: jnp.ndarray  # bool
+    dirty: jnp.ndarray  # bool
+    minmax_retracted: jnp.ndarray  # () bool
+
+    def tree_flatten(self):
+        anames = tuple(sorted(self.accums))
+        children = (
+            self.row_count,
+            tuple(self.accums[n] for n in anames),
+            tuple(self.emitted[n] for n in anames),
+            self.emitted_valid,
+            self.dirty,
+            self.minmax_retracted,
+        )
+        return children, anames
+
+    @classmethod
+    def tree_unflatten(cls, anames, children):
+        row_count, accums, emitted, emitted_valid, dirty, mr = children
+        return cls(
+            row_count=row_count,
+            accums=dict(zip(anames, accums)),
+            emitted=dict(zip(anames, emitted)),
+            emitted_valid=emitted_valid,
+            dirty=dirty,
+            minmax_retracted=mr,
+        )
+
+    @property
+    def capacity(self) -> int:
+        return self.row_count.shape[0]
+
+
+def _accum_dtype(call: AggCall, input_dtype) -> jnp.dtype:
+    if call.kind in ("count_star", "count"):
+        return jnp.int64
+    if call.kind == "sum" and jnp.issubdtype(input_dtype, jnp.integer):
+        return jnp.int64  # SQL SUM(int) widens to bigint
+    if call.kind in ("min", "max") and jnp.issubdtype(input_dtype, jnp.floating):
+        return _FLOAT_ORDER[jnp.dtype(input_dtype)][0]  # total-order key
+    return input_dtype
+
+
+def float_extreme_meta(calls: Sequence[AggCall], input_dtypes) -> tuple:
+    """Static metadata for flush(): which outputs are float extremes and
+    their original float dtype (needed to decode order keys back)."""
+    out = []
+    for c in calls:
+        if c.kind in ("min", "max") and jnp.issubdtype(
+            input_dtypes.get(c.input, jnp.int64), jnp.floating
+        ):
+            out.append((c.output, str(jnp.dtype(input_dtypes[c.input]))))
+    return tuple(out)
+
+
+def create_state(capacity: int, calls: Sequence[AggCall], input_dtypes) -> AggState:
+    """``input_dtypes`` maps input column name -> jnp dtype."""
+    accums, emitted = {}, {}
+    for c in calls:
+        dt = _accum_dtype(c, None if c.input is None else input_dtypes[c.input])
+        if c.kind in ("min", "max"):
+            init = jnp.full(capacity, _extreme_init(dt, c.kind), dt)
+        else:
+            init = jnp.zeros(capacity, dt)
+        accums[c.output] = init
+        emitted[c.output] = jnp.zeros(capacity, dt)
+    return AggState(
+        row_count=jnp.zeros(capacity, jnp.int64),
+        accums=accums,
+        emitted=emitted,
+        emitted_valid=jnp.zeros(capacity, jnp.bool_),
+        dirty=jnp.zeros(capacity, jnp.bool_),
+        minmax_retracted=jnp.zeros((), jnp.bool_),
+    )
+
+
+def apply(
+    state: AggState,
+    calls: Tuple[AggCall, ...],
+    slots: jnp.ndarray,  # (n,) int32, -1 = skip
+    signs: jnp.ndarray,  # (n,) int32 in {-1, 0, +1}; 0 for padding
+    values: Dict[str, jnp.ndarray],
+    nulls: Dict[str, jnp.ndarray],  # input-null lanes (may be absent)
+) -> AggState:
+    """Apply one chunk's rows to the state (pure; jit-composable).
+
+    ``signs`` must already fold visibility (StreamChunk.effective_signs).
+    NULL inputs contribute to nothing but COUNT(*) (SQL: aggregates skip
+    NULLs; reference agg_state.rs null handling).
+    """
+    cap = state.capacity
+    active = (slots >= 0) & (signs != 0)
+    idx = jnp.where(active, slots, cap)  # cap = drop lane
+    w = jnp.where(active, signs, 0).astype(jnp.int64)
+
+    row_count = state.row_count.at[idx].add(w, mode="drop")
+    dirty = state.dirty.at[idx].set(True, mode="drop")
+
+    accums = dict(state.accums)
+    mr = state.minmax_retracted
+    for c in calls:
+        acc = accums[c.output]
+        if c.kind == "count_star":
+            accums[c.output] = acc.at[idx].add(w, mode="drop")
+            continue
+        v = values[c.input]
+        notnull = ~nulls.get(c.input, jnp.zeros(v.shape, jnp.bool_))
+        if c.kind == "count":
+            accums[c.output] = acc.at[idx].add(
+                jnp.where(notnull, w, 0), mode="drop"
+            )
+        elif c.kind == "sum":
+            contrib = jnp.where(notnull, v.astype(acc.dtype) * w.astype(acc.dtype), 0)
+            accums[c.output] = acc.at[idx].add(contrib, mode="drop")
+        else:  # min / max — append-only
+            sentinel = _extreme_init(acc.dtype, c.kind)
+            use = active & notnull & (w > 0)
+            if jnp.issubdtype(v.dtype, jnp.floating):
+                v = _float_to_order_key(v)  # NaN-safe total order
+            vv = jnp.where(use, v.astype(acc.dtype), sentinel)
+            uidx = jnp.where(use, slots, cap)
+            if c.kind == "min":
+                accums[c.output] = acc.at[uidx].min(vv, mode="drop")
+            else:
+                accums[c.output] = acc.at[uidx].max(vv, mode="drop")
+            mr = mr | jnp.any(active & notnull & (w < 0))
+
+    return AggState(
+        row_count=row_count,
+        accums=accums,
+        emitted=state.emitted,
+        emitted_valid=state.emitted_valid,
+        dirty=dirty,
+        minmax_retracted=mr,
+    )
+
+
+def delete_groups(
+    state: AggState, calls: Tuple[AggCall, ...], slots: jnp.ndarray
+) -> AggState:
+    """Drop whole groups (window expiry): reset their state, mark dirty.
+
+    The per-barrier flush then emits a Delete row for each if it had
+    been emitted. This is how windowed plans retract — group-wise, never
+    row-wise — which keeps MIN/MAX append-only sound. Accumulators reset
+    to their init (sentinels for extremes) so a reused slot starts clean.
+    """
+    cap = state.capacity
+    idx = jnp.where(slots >= 0, slots, cap)
+    row_count = state.row_count.at[idx].set(0, mode="drop")
+    dirty = state.dirty.at[idx].set(True, mode="drop")
+    kinds = {c.output: c.kind for c in calls}
+    accums = {}
+    for name, acc in state.accums.items():
+        init = (
+            _extreme_init(acc.dtype, kinds[name])
+            if kinds[name] in ("min", "max")
+            else jnp.zeros((), acc.dtype)
+        )
+        accums[name] = acc.at[idx].set(init, mode="drop")
+    return AggState(
+        row_count=row_count,
+        accums=accums,
+        emitted=state.emitted,
+        emitted_valid=state.emitted_valid,
+        dirty=dirty,
+        minmax_retracted=state.minmax_retracted,
+    )
+
+
+@partial(
+    jax.jit, static_argnames=("out_cap", "float_extremes"), donate_argnums=(0,)
+)
+def flush(
+    state: AggState,
+    table_keys: Tuple[jnp.ndarray, ...],
+    out_cap: int,
+    float_extremes: tuple = (),
+):
+    """Emit the per-barrier delta for dirty groups (hash_agg.rs:406).
+
+    Returns ``(state', delta)`` where delta is a dict of fixed-capacity
+    (2 * out_cap) arrays:
+      ``ops``       int32 Op lane
+      ``valid``     bool row-validity lane
+      ``key<i>``    the i-th group-key lane (gathered from table_keys)
+      ``<output>``  one lane per agg output
+      ``overflow``  () bool — True if more than out_cap dirty groups
+                    existed; host must flush again.
+
+    Old (U-/D) rows carry the previously-emitted accums; new (U+/I)
+    rows carry the current ones. Rows interleave (old_i, new_i) so
+    downstream sees retraction-before-insert per group, matching
+    StreamChunk update-pair ordering (stream_chunk.rs:45).
+
+    ``float_extremes`` (static, from ``float_extreme_meta``) lists agg
+    outputs stored as float total-order keys; their lanes are decoded
+    back to floats on emission.
+    """
+    cap = state.capacity
+    # compact dirty slot ids to the front: sort puts False (0) last
+    order = jnp.argsort(~state.dirty, stable=True)
+    dirty_sorted = state.dirty[order]
+    n_dirty = jnp.sum(state.dirty.astype(jnp.int32))
+    take = dirty_sorted[:out_cap]
+    slot_ids = order[:out_cap]
+    overflow = n_dirty > out_cap
+
+    live = take & (state.row_count[slot_ids] > 0)
+    was = take & state.emitted_valid[slot_ids]
+
+    minus_valid = was  # emit old row as U- or D
+    plus_valid = live  # emit new row as U+ or I
+    minus_op = jnp.where(live, jnp.int32(Op.UPDATE_DELETE), jnp.int32(Op.DELETE))
+    plus_op = jnp.where(was, jnp.int32(Op.UPDATE_INSERT), jnp.int32(Op.INSERT))
+
+    def interleave(a, b):
+        return jnp.stack([a, b], axis=1).reshape(-1)
+
+    delta = {
+        "ops": interleave(minus_op, plus_op),
+        "valid": interleave(minus_valid, plus_valid),
+        "overflow": overflow,
+    }
+    for i, lane in enumerate(table_keys):
+        kv = lane[slot_ids]
+        delta[f"key{i}"] = interleave(kv, kv)
+    decode = dict(float_extremes)
+    for name, acc in state.accums.items():
+        old = state.emitted[name][slot_ids]
+        new = acc[slot_ids]
+        if name in decode:
+            old = _order_key_to_float(old, jnp.dtype(decode[name]))
+            new = _order_key_to_float(new, jnp.dtype(decode[name]))
+        delta[name] = interleave(old, new)
+
+    # snapshot what we just emitted (only for flushed slots)
+    fidx = jnp.where(take, slot_ids, cap)
+    emitted = {
+        name: state.emitted[name]
+        .at[fidx]
+        .set(state.accums[name][slot_ids], mode="drop")
+        for name in state.accums
+    }
+    emitted_valid = state.emitted_valid.at[fidx].set(
+        state.row_count[slot_ids] > 0, mode="drop"
+    )
+    dirty = state.dirty.at[fidx].set(False, mode="drop")
+
+    state = AggState(
+        row_count=state.row_count,
+        accums=state.accums,
+        emitted=emitted,
+        emitted_valid=emitted_valid,
+        dirty=dirty,
+        minmax_retracted=state.minmax_retracted,
+    )
+    return state, delta
