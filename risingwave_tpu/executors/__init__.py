@@ -1,0 +1,29 @@
+"""Streaming executors — the dataflow operators.
+
+Reference: src/stream/src/executor/ — each operator is an async stream
+transformer over Message::{Chunk, Barrier, Watermark}
+(src/stream/src/executor/mod.rs:180,871).
+
+TPU re-design: an executor is a thin host object owning device state
+(pytrees) and calling pure jit-compiled step kernels. The host drives
+epochs; barriers are plain step boundaries, not async events. Chains of
+stateless executors fuse into single XLA programs.
+"""
+
+from risingwave_tpu.executors.base import Barrier, Executor, Watermark
+from risingwave_tpu.executors.filter import FilterExecutor
+from risingwave_tpu.executors.project import ProjectExecutor
+from risingwave_tpu.executors.hop_window import HopWindowExecutor
+from risingwave_tpu.executors.hash_agg import HashAggExecutor
+from risingwave_tpu.executors.materialize import MaterializeExecutor
+
+__all__ = [
+    "Barrier",
+    "Watermark",
+    "Executor",
+    "FilterExecutor",
+    "ProjectExecutor",
+    "HopWindowExecutor",
+    "HashAggExecutor",
+    "MaterializeExecutor",
+]

@@ -1,0 +1,78 @@
+"""Executor protocol + control messages.
+
+Reference: src/stream/src/executor/mod.rs —
+- ``Execute`` trait (:180): an executor transforms a stream of
+  ``Message::{Chunk, Barrier, Watermark}`` (:871);
+- ``Barrier { epoch: EpochPair, kind }`` (:276) with checkpoint kinds;
+- ``Watermark`` messages carry per-column monotonic lower bounds that
+  drive state cleaning (executor/watermark_filter.rs).
+
+TPU re-design: no async streams — the host epoch loop calls, in
+dataflow order, ``apply(chunk)`` for data and ``on_barrier`` /
+``on_watermark`` for control, collecting output chunks to feed the next
+executor. Device state lives inside each executor as jax pytrees; all
+math happens in pure jitted kernels so a whole chain runs as a few fused
+XLA programs per chunk.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+from risingwave_tpu.array.chunk import StreamChunk
+
+
+@dataclass(frozen=True)
+class Epoch:
+    """EpochPair analogue (reference: src/common/src/util/epoch.rs:31).
+
+    ``curr`` is the epoch being sealed by this barrier; ``prev`` is the
+    previous sealed epoch. Values are physical-ms << 16 | seq in the
+    runtime; tests may use small ints.
+    """
+
+    prev: int
+    curr: int
+
+
+@dataclass(frozen=True)
+class Barrier:
+    """A barrier message (reference: executor/mod.rs:276)."""
+
+    epoch: Epoch
+    checkpoint: bool = True
+
+
+@dataclass(frozen=True)
+class Watermark:
+    """Monotonic per-column lower bound (reference: executor/mod.rs:871,
+    watermark_filter.rs): no future row will carry ``column < value``."""
+
+    column: str
+    value: int
+
+
+class Executor:
+    """Base executor. Subclasses override what they react to.
+
+    ``apply`` must be cheap on the host: stage device work, return
+    fixed-capacity chunks. ``on_barrier`` flushes per-epoch deltas
+    (reference: flush_data on barrier, e.g. hash_agg.rs:406).
+    """
+
+    def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
+        return [chunk]
+
+    def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
+        return []
+
+    def on_watermark(self, watermark: Watermark):
+        """Returns ``(downstream_watermark | None, output_chunks)``.
+
+        Executors TRANSFORM watermarks as they pass (reference: derived
+        watermarks through projections, watermark_filter.rs + plan-node
+        watermark derivation): e.g. HopWindow maps an event-time
+        watermark to a window_start watermark. None stops propagation.
+        """
+        return watermark, []

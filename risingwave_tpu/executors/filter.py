@@ -1,0 +1,53 @@
+"""Filter executor — predicate over visibility, zero data movement.
+
+Reference: src/stream/src/executor/filter.rs (234 LoC). The reference
+also downgrades broken UpdateDelete/UpdateInsert pairs (where only one
+half passes) to plain Delete/Insert; with columnar ops that is a pure
+elementwise op-lane rewrite, done here in the same fused step.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.executors.base import Executor
+from risingwave_tpu.expr import Expr
+from risingwave_tpu.types import Op
+
+
+@partial(jax.jit, static_argnames=("pred",))
+def _filter_step(chunk: StreamChunk, pred: Expr) -> StreamChunk:
+    keep_v, keep_n = pred.eval(chunk)
+    keep = keep_v.astype(jnp.bool_)
+    if keep_n is not None:
+        keep = keep & ~keep_n  # NULL predicate drops the row (SQL WHERE)
+    out = chunk.mask(keep)
+
+    # Fix torn update pairs: U- at row i pairs with U+ at row i+1 (chunk
+    # construction invariant, stream_chunk.rs:45). If exactly one half
+    # survives, downgrade it to a plain Delete/Insert.
+    ops = out.ops
+    is_ud = ops == Op.UPDATE_DELETE
+    is_ui = ops == Op.UPDATE_INSERT
+    partner_alive_for_ud = jnp.roll(out.valid, -1) & jnp.roll(is_ui, -1)
+    partner_alive_for_ui = jnp.roll(out.valid, 1) & jnp.roll(is_ud, 1)
+    new_ops = jnp.where(
+        is_ud & out.valid & ~partner_alive_for_ud, jnp.int32(Op.DELETE), ops
+    )
+    new_ops = jnp.where(
+        is_ui & out.valid & ~partner_alive_for_ui, jnp.int32(Op.INSERT), new_ops
+    )
+    return StreamChunk(out.columns, out.valid, out.nulls, new_ops)
+
+
+class FilterExecutor(Executor):
+    def __init__(self, pred: Expr):
+        self.pred = pred
+
+    def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
+        return [_filter_step(chunk, self.pred)]

@@ -1,0 +1,81 @@
+"""Hop (sliding) window executor — row expansion.
+
+Reference: src/stream/src/executor/hop_window.rs — each input row falls
+into ``size/slide`` overlapping windows and is emitted once per window
+with (window_start, window_end) columns attached.
+
+TPU re-design: the expansion factor is static, so a chunk of capacity C
+becomes one chunk of capacity C * factor by tiling every lane and
+computing each copy's window start arithmetically — no loops, no
+dynamic shapes. Rows whose k-th window would not contain their
+timestamp are masked invalid (only possible for negative timestamps;
+kept for safety).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.executors.base import Executor
+
+
+@partial(jax.jit, static_argnames=("ts_col", "size_ms", "slide_ms", "out_start"))
+def _hop_step(
+    chunk: StreamChunk, ts_col: str, size_ms: int, slide_ms: int, out_start: str
+) -> StreamChunk:
+    factor = -(-size_ms // slide_ms)  # ceil
+    cap = chunk.capacity
+
+    def tile(a):
+        return jnp.repeat(a, factor, axis=0)
+
+    ts = chunk.col(ts_col)
+    # earliest aligned window start strictly greater than ts - size
+    first = (jnp.floor_divide(ts - size_ms, slide_ms) + 1) * slide_ms
+    k = jnp.tile(jnp.arange(factor, dtype=ts.dtype), cap)
+    starts = tile(first) + k * slide_ms
+    in_window = starts <= tile(ts)  # start + size > ts holds by choice of first
+
+    cols = {n: tile(a) for n, a in chunk.columns.items()}
+    cols[out_start] = starts
+    nulls = {n: tile(a) for n, a in chunk.nulls.items()}
+    valid = tile(chunk.valid) & in_window
+    ops = tile(chunk.ops)
+    return StreamChunk(cols, valid, nulls, ops)
+
+
+class HopWindowExecutor(Executor):
+    def __init__(
+        self,
+        ts_col: str,
+        size_ms: int,
+        slide_ms: int,
+        out_start: str = "window_start",
+    ):
+        if size_ms % slide_ms:
+            raise ValueError("size must be a multiple of slide")
+        self.ts_col = ts_col
+        self.size_ms = size_ms
+        self.slide_ms = slide_ms
+        self.out_start = out_start
+
+    def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
+        return [
+            _hop_step(chunk, self.ts_col, self.size_ms, self.slide_ms, self.out_start)
+        ]
+
+    def on_watermark(self, watermark):
+        """Translate an event-time watermark into a window_start
+        watermark: a future row (ts >= wm) lands only in windows with
+        start >= first_start(wm)."""
+        from risingwave_tpu.executors.base import Watermark
+
+        if watermark.column != self.ts_col:
+            return watermark, []
+        first = ((watermark.value - self.size_ms) // self.slide_ms + 1) * self.slide_ms
+        return Watermark(self.out_start, first), []

@@ -1,0 +1,75 @@
+"""Materialize executor — applies the change stream to a queryable MV.
+
+Reference: src/stream/src/executor/mview/materialize.rs:44 — applies
+chunks to the MV StateTable with pk-conflict handling (:192-230).
+
+v0 TPU design note: the MV snapshot is a host-side dict (pk tuple ->
+row tuple) updated from the compacted delta chunks that stateful
+operators emit at barriers. Downstream batch reads / tests query it via
+``snapshot()``. The storage-backed version (device-staged columnar MV +
+Hummock-lite persistence) replaces the dict when state/ lands; the
+executor API stays the same.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.executors.base import Barrier, Executor
+from risingwave_tpu.types import Op
+
+
+class MaterializeExecutor(Executor):
+    def __init__(self, pk: Sequence[str], columns: Sequence[str]):
+        self.pk = tuple(pk)
+        self.columns = tuple(columns)
+        self.rows: Dict[Tuple, Tuple] = {}
+
+    def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
+        data = chunk.to_numpy(with_ops=True)
+        ops = data["__op__"]
+        n = len(ops)
+        if n == 0:
+            return [chunk]
+        pk_cols = [data[k] for k in self.pk]
+        # NULL pk components must stay distinct from real zeros: fold the
+        # null lane into the key tuple as None (SQL: NULL group keys form
+        # their own group; reference pk serde writes a null tag first,
+        # row_serde_util.rs)
+        pk_nulls = [data.get(k + "__null") for k in self.pk]
+        val_cols = [data[c] for c in self.columns]
+        null_lanes = {
+            c: data[c + "__null"] for c in self.columns if c + "__null" in data
+        }
+        for i in range(n):
+            key = tuple(
+                None if nl is not None and nl[i] else c[i]
+                for c, nl in zip(pk_cols, pk_nulls)
+            )
+            if ops[i] in (Op.DELETE, Op.UPDATE_DELETE):
+                # pk-conflict handling "overwrite": tolerate deleting a
+                # missing row (reference ConflictBehavior::Overwrite)
+                self.rows.pop(key, None)
+            else:
+                row = tuple(
+                    None if null_lanes.get(c) is not None and null_lanes[c][i] else v[i]
+                    for c, v in zip(self.columns, val_cols)
+                )
+                self.rows[key] = row
+        return [chunk]
+
+    def snapshot(self) -> Dict[Tuple, Tuple]:
+        return dict(self.rows)
+
+    def to_numpy(self) -> Dict[str, np.ndarray]:
+        """Snapshot as column arrays (pk cols + value cols)."""
+        keys = list(self.rows)
+        out = {}
+        for j, name in enumerate(self.pk):
+            out[name] = np.array([k[j] for k in keys])
+        for j, name in enumerate(self.columns):
+            out[name] = np.array([self.rows[k][j] for k in keys])
+        return out

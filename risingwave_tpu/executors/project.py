@@ -1,0 +1,40 @@
+"""Project executor — computed columns.
+
+Reference: src/stream/src/executor/project.rs (non-strict expression
+evaluation over whole chunks). Output columns replace the chunk's
+column set; ops/visibility pass through untouched.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Tuple
+
+import jax
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.executors.base import Executor
+from risingwave_tpu.expr import Expr
+
+
+@partial(jax.jit, static_argnames=("outputs",))
+def _project_step(
+    chunk: StreamChunk, outputs: Tuple[Tuple[str, Expr], ...]
+) -> StreamChunk:
+    cols, nulls = {}, {}
+    for name, expr in outputs:
+        v, n = expr.eval(chunk)
+        cols[name] = v
+        if n is not None:
+            nulls[name] = n
+    return StreamChunk(cols, chunk.valid, nulls, chunk.ops)
+
+
+class ProjectExecutor(Executor):
+    """``outputs`` maps output column name -> expression."""
+
+    def __init__(self, outputs: Dict[str, Expr]):
+        self.outputs = tuple(outputs.items())
+
+    def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
+        return [_project_step(chunk, self.outputs)]

@@ -1,0 +1,46 @@
+"""Expression mini-framework (vectorized, jit-composable).
+
+Reference role: src/expr/core/src/expr/ — the ``Expression`` trait whose
+impls evaluate over a whole ``DataChunk`` at once, plus the non-strict
+NULL semantics baked into the #[function] codegen (src/expr/macro/).
+
+TPU re-design: an expression is a tiny AST of pure-jnp node objects.
+``Expr.eval(chunk) -> (values, nulls)`` returns a fixed-capacity value
+lane and a bool NULL lane; everything composes under ``jax.jit`` with no
+data-dependent shapes. Three-valued logic (AND/OR/NOT over NULL) follows
+SQL exactly; arithmetic and comparison are NULL-strict.
+"""
+
+from risingwave_tpu.expr.expr import (
+    And,
+    Between,
+    BinOp,
+    Case,
+    Col,
+    Expr,
+    InList,
+    IsNull,
+    Lit,
+    Not,
+    Or,
+    TumbleStart,
+    col,
+    lit,
+)
+
+__all__ = [
+    "Expr",
+    "Col",
+    "Lit",
+    "BinOp",
+    "And",
+    "Or",
+    "Not",
+    "IsNull",
+    "Case",
+    "Between",
+    "InList",
+    "TumbleStart",
+    "col",
+    "lit",
+]

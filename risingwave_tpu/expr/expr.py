@@ -1,0 +1,288 @@
+"""Expression AST evaluated column-at-a-time on DataChunks.
+
+Reference: the ``Expression`` trait (src/expr/core/src/expr/) evaluates
+on a whole DataChunk; scalar kernels come from the #[function] macro
+(src/expr/macro/src/). Here every node is a dataclass whose ``eval``
+is pure jnp, so whole expression trees fuse under ``jax.jit``.
+
+NULL semantics:
+- arithmetic / comparison are NULL-strict: any NULL input -> NULL out;
+- AND / OR implement SQL three-valued logic
+  (TRUE OR NULL = TRUE, FALSE AND NULL = FALSE, else NULL);
+- predicates used by Filter keep only rows that are TRUE (NULL drops),
+  matching the reference FilterExecutor (src/stream/src/executor/filter.rs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from risingwave_tpu.array.chunk import DataChunk
+
+# (values, null_lane) — null lane may be None meaning "no NULLs"
+EvalResult = Tuple[jnp.ndarray, Optional[jnp.ndarray]]
+
+
+def _null_or(a: Optional[jnp.ndarray], b: Optional[jnp.ndarray]):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a | b
+
+
+class Expr:
+    """Base node. Subclasses implement ``eval(chunk) -> EvalResult``."""
+
+    def eval(self, chunk: DataChunk) -> EvalResult:  # pragma: no cover
+        raise NotImplementedError
+
+    def eval_notnull(self, chunk: DataChunk) -> jnp.ndarray:
+        """Values with NULLs treated as absent (caller ignores them)."""
+        return self.eval(chunk)[0]
+
+    # -- operator sugar --------------------------------------------------
+    def __add__(self, o):
+        return BinOp("+", self, _wrap(o))
+
+    def __sub__(self, o):
+        return BinOp("-", self, _wrap(o))
+
+    def __mul__(self, o):
+        return BinOp("*", self, _wrap(o))
+
+    def __floordiv__(self, o):
+        return BinOp("//", self, _wrap(o))
+
+    def __mod__(self, o):
+        return BinOp("%", self, _wrap(o))
+
+    def __eq__(self, o):  # type: ignore[override]
+        return BinOp("==", self, _wrap(o))
+
+    def __ne__(self, o):  # type: ignore[override]
+        return BinOp("!=", self, _wrap(o))
+
+    def __lt__(self, o):
+        return BinOp("<", self, _wrap(o))
+
+    def __le__(self, o):
+        return BinOp("<=", self, _wrap(o))
+
+    def __gt__(self, o):
+        return BinOp(">", self, _wrap(o))
+
+    def __ge__(self, o):
+        return BinOp(">=", self, _wrap(o))
+
+    def __and__(self, o):
+        return And(self, _wrap(o))
+
+    def __or__(self, o):
+        return Or(self, _wrap(o))
+
+    def __invert__(self):
+        return Not(self)
+
+    __hash__ = object.__hash__  # __eq__ override would otherwise kill it
+
+
+def _wrap(v) -> "Expr":
+    return v if isinstance(v, Expr) else Lit(v)
+
+
+def col(name: str) -> "Col":
+    return Col(name)
+
+
+def lit(v) -> "Lit":
+    return Lit(v)
+
+
+@dataclass(frozen=True, eq=False)
+class Col(Expr):
+    name: str
+
+    def eval(self, chunk: DataChunk) -> EvalResult:
+        return chunk.col(self.name), chunk.nulls.get(self.name)
+
+
+@dataclass(frozen=True, eq=False)
+class Lit(Expr):
+    value: object  # python scalar; None = SQL NULL literal
+
+    def eval(self, chunk: DataChunk) -> EvalResult:
+        if self.value is None:
+            zero = jnp.zeros(chunk.capacity, jnp.int32)
+            return zero, jnp.ones(chunk.capacity, jnp.bool_)
+        return jnp.full(chunk.capacity, self.value), None
+
+
+_BIN_FNS: dict[str, Callable] = {
+    "+": jnp.add,
+    "-": jnp.subtract,
+    "*": jnp.multiply,
+    "//": jnp.floor_divide,
+    "%": jnp.remainder,
+    "==": jnp.equal,
+    "!=": jnp.not_equal,
+    "<": jnp.less,
+    "<=": jnp.less_equal,
+    ">": jnp.greater,
+    ">=": jnp.greater_equal,
+}
+
+
+@dataclass(frozen=True, eq=False)
+class BinOp(Expr):
+    op: str
+    left: Expr
+    right: Expr
+
+    def eval(self, chunk: DataChunk) -> EvalResult:
+        lv, ln = self.left.eval(chunk)
+        rv, rn = self.right.eval(chunk)
+        nulls = _null_or(ln, rn)
+        if self.op in ("//", "%"):
+            # guard div-by-zero on padding/NULL lanes; SQL raises on a
+            # *visible* non-null zero divisor — the host checks that via
+            # Filter/Project error lanes later; here we make it NULL so
+            # no trap fires inside jit (non-strict eval, reference
+            # src/expr/core/src/expr/non_strict.rs turns errors to NULL)
+            zero_div = rv == 0
+            nulls = _null_or(nulls, zero_div)
+            rv = jnp.where(zero_div, jnp.ones((), rv.dtype), rv)
+        return _BIN_FNS[self.op](lv, rv), nulls
+
+
+@dataclass(frozen=True, eq=False)
+class And(Expr):
+    left: Expr
+    right: Expr
+
+    def eval(self, chunk: DataChunk) -> EvalResult:
+        lv, ln = self.left.eval(chunk)
+        rv, rn = self.right.eval(chunk)
+        lv = lv.astype(jnp.bool_)
+        rv = rv.astype(jnp.bool_)
+        val = lv & rv
+        if ln is None and rn is None:
+            return val, None
+        # SQL 3VL: NULL unless one side is a definite FALSE
+        l_def_false = (~lv) & ~(ln if ln is not None else jnp.zeros_like(lv))
+        r_def_false = (~rv) & ~(rn if rn is not None else jnp.zeros_like(rv))
+        any_null = _null_or(ln, rn)
+        nulls = any_null & ~l_def_false & ~r_def_false
+        return val & ~nulls, nulls
+
+
+@dataclass(frozen=True, eq=False)
+class Or(Expr):
+    left: Expr
+    right: Expr
+
+    def eval(self, chunk: DataChunk) -> EvalResult:
+        lv, ln = self.left.eval(chunk)
+        rv, rn = self.right.eval(chunk)
+        lv = lv.astype(jnp.bool_)
+        rv = rv.astype(jnp.bool_)
+        val = lv | rv
+        if ln is None and rn is None:
+            return val, None
+        l_def_true = lv & ~(ln if ln is not None else jnp.zeros_like(lv))
+        r_def_true = rv & ~(rn if rn is not None else jnp.zeros_like(rv))
+        any_null = _null_or(ln, rn)
+        nulls = any_null & ~l_def_true & ~r_def_true
+        return (val | l_def_true | r_def_true) & ~nulls, nulls
+
+
+@dataclass(frozen=True, eq=False)
+class Not(Expr):
+    inner: Expr
+
+    def eval(self, chunk: DataChunk) -> EvalResult:
+        v, n = self.inner.eval(chunk)
+        return ~v.astype(jnp.bool_), n
+
+
+@dataclass(frozen=True, eq=False)
+class IsNull(Expr):
+    inner: Expr
+    negate: bool = False
+
+    def eval(self, chunk: DataChunk) -> EvalResult:
+        _, n = self.inner.eval(chunk)
+        isnull = n if n is not None else jnp.zeros(chunk.capacity, jnp.bool_)
+        return (~isnull if self.negate else isnull), None
+
+
+@dataclass(frozen=True, eq=False)
+class Between(Expr):
+    """lo <= v <= hi (inclusive, SQL BETWEEN)."""
+
+    inner: Expr
+    lo: Expr
+    hi: Expr
+
+    def eval(self, chunk: DataChunk) -> EvalResult:
+        v, n = self.inner.eval(chunk)
+        lo, ln = self.lo.eval(chunk)
+        hi, hn = self.hi.eval(chunk)
+        return (v >= lo) & (v <= hi), _null_or(n, _null_or(ln, hn))
+
+
+@dataclass(frozen=True, eq=False)
+class InList(Expr):
+    inner: Expr
+    values: Tuple
+
+    def eval(self, chunk: DataChunk) -> EvalResult:
+        v, n = self.inner.eval(chunk)
+        hit = jnp.zeros(chunk.capacity, jnp.bool_)
+        for item in self.values:
+            hit = hit | (v == item)
+        return hit, n
+
+
+@dataclass(frozen=True, eq=False)
+class Case(Expr):
+    """CASE WHEN cond THEN val ... ELSE default END."""
+
+    branches: Tuple[Tuple[Expr, Expr], ...]
+    default: Expr
+
+    def eval(self, chunk: DataChunk) -> EvalResult:
+        val, nulls = self.default.eval(chunk)
+        # evaluate in reverse so earlier branches win via jnp.where
+        for cond, out in reversed(self.branches):
+            cv, cn = cond.eval(chunk)
+            cv = cv.astype(jnp.bool_)
+            if cn is not None:
+                cv = cv & ~cn  # NULL condition does not fire a branch
+            ov, on = out.eval(chunk)
+            val = jnp.where(cv, ov.astype(val.dtype), val)
+            if nulls is not None or on is not None:
+                base = nulls if nulls is not None else jnp.zeros_like(cv)
+                bn = on if on is not None else jnp.zeros_like(cv)
+                nulls = jnp.where(cv, bn, base)
+        return val, nulls
+
+
+@dataclass(frozen=True, eq=False)
+class TumbleStart(Expr):
+    """Tumbling-window bucket start: (ts // size) * size.
+
+    Reference: the tumble() table function lowered into projections by
+    the frontend (src/frontend/src/optimizer — window TVFs); Nexmark q7
+    groups by tumble(date_time, 10s).
+    """
+
+    ts: Expr
+    size_ms: int
+
+    def eval(self, chunk: DataChunk) -> EvalResult:
+        v, n = self.ts.eval(chunk)
+        return (v // self.size_ms) * self.size_ms, n

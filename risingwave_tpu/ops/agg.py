@@ -18,6 +18,11 @@ slots). Applying a chunk is a handful of masked segment-scatters:
 so a whole chunk of any size updates all its groups in O(chunk) scatter
 work with zero host round-trips, and the whole thing fuses under jit.
 
+SQL NULL outputs: SUM/MIN/MAX over a group whose inputs are all NULL is
+NULL (COUNT is 0). Each such call keeps a per-group non-null input
+counter; flush emits a null lane from ``counter == 0`` (reference:
+agg_state.rs null handling / Datum outputs).
+
 Retraction: sum/count invert exactly via the sign. MIN/MAX cannot be
 retracted without per-group materialized input (reference keeps a sorted
 state table per extreme agg call, executor/aggregation/minput.rs); this
@@ -48,6 +53,8 @@ import jax.numpy as jnp
 from risingwave_tpu.types import Op
 
 KINDS = ("count_star", "count", "sum", "min", "max")
+# kinds whose SQL result is NULL when no non-NULL input exists
+NULLABLE_KINDS = ("sum", "min", "max")
 
 
 @dataclass(frozen=True)
@@ -69,10 +76,16 @@ class AggCall:
             raise ValueError(f"{self.kind} input mismatch")
 
 
-# sentinel init values for extreme aggs, per payload dtype
 def _extreme_init(dtype, kind: str):
     info = jnp.iinfo(dtype)
     return jnp.array(info.max if kind == "min" else info.min, dtype)
+
+
+def accum_init(kind: str, dtype) -> jnp.ndarray:
+    """The empty-group accumulator value for one agg kind (scalar)."""
+    if kind in ("min", "max"):
+        return _extreme_init(dtype, kind)
+    return jnp.zeros((), dtype)
 
 
 # -- ordered-float total-order encoding ---------------------------------
@@ -115,38 +128,50 @@ class AggState:
     ``row_count`` is the implicit COUNT(*) that determines group
     liveness (reference: AggGroup keeps row_count to decide emit vs
     delete, agg_group.rs). ``accums[name]`` holds one accumulator lane
-    per AggCall output. ``emitted*`` snapshot what downstream has seen,
-    so flush can produce exact U-/U+ retractions. ``dirty`` marks slots
-    touched since the last flush. ``minmax_retracted`` latches the
-    unsupported-retraction condition for host-side checking.
+    per AggCall output; ``nonnull[name]`` counts non-NULL inputs for
+    NULLABLE_KINDS calls (0 -> SQL NULL output). ``emitted*`` snapshot
+    what downstream has seen, so flush can produce exact U-/U+
+    retractions. ``dirty`` marks slots touched since the last flush.
+    ``minmax_retracted`` latches the unsupported-retraction condition
+    for host-side checking.
     """
 
     row_count: jnp.ndarray  # int64
     accums: Dict[str, jnp.ndarray]
+    nonnull: Dict[str, jnp.ndarray]  # int64, subset of accum names
     emitted: Dict[str, jnp.ndarray]
+    emitted_isnull: Dict[str, jnp.ndarray]  # bool, same keys as nonnull
     emitted_valid: jnp.ndarray  # bool
     dirty: jnp.ndarray  # bool
     minmax_retracted: jnp.ndarray  # () bool
 
     def tree_flatten(self):
         anames = tuple(sorted(self.accums))
+        nnames = tuple(sorted(self.nonnull))
         children = (
             self.row_count,
             tuple(self.accums[n] for n in anames),
+            tuple(self.nonnull[n] for n in nnames),
             tuple(self.emitted[n] for n in anames),
+            tuple(self.emitted_isnull[n] for n in nnames),
             self.emitted_valid,
             self.dirty,
             self.minmax_retracted,
         )
-        return children, anames
+        return children, (anames, nnames)
 
     @classmethod
-    def tree_unflatten(cls, anames, children):
-        row_count, accums, emitted, emitted_valid, dirty, mr = children
+    def tree_unflatten(cls, aux, children):
+        anames, nnames = aux
+        row_count, accums, nonnull, emitted, e_isnull, emitted_valid, dirty, mr = (
+            children
+        )
         return cls(
             row_count=row_count,
             accums=dict(zip(anames, accums)),
+            nonnull=dict(zip(nnames, nonnull)),
             emitted=dict(zip(anames, emitted)),
+            emitted_isnull=dict(zip(nnames, e_isnull)),
             emitted_valid=emitted_valid,
             dirty=dirty,
             minmax_retracted=mr,
@@ -181,19 +206,20 @@ def float_extreme_meta(calls: Sequence[AggCall], input_dtypes) -> tuple:
 
 def create_state(capacity: int, calls: Sequence[AggCall], input_dtypes) -> AggState:
     """``input_dtypes`` maps input column name -> jnp dtype."""
-    accums, emitted = {}, {}
+    accums, nonnull, emitted, e_isnull = {}, {}, {}, {}
     for c in calls:
         dt = _accum_dtype(c, None if c.input is None else input_dtypes[c.input])
-        if c.kind in ("min", "max"):
-            init = jnp.full(capacity, _extreme_init(dt, c.kind), dt)
-        else:
-            init = jnp.zeros(capacity, dt)
-        accums[c.output] = init
+        accums[c.output] = jnp.full(capacity, accum_init(c.kind, dt), dt)
         emitted[c.output] = jnp.zeros(capacity, dt)
+        if c.kind in NULLABLE_KINDS:
+            nonnull[c.output] = jnp.zeros(capacity, jnp.int64)
+            e_isnull[c.output] = jnp.zeros(capacity, jnp.bool_)
     return AggState(
         row_count=jnp.zeros(capacity, jnp.int64),
         accums=accums,
+        nonnull=nonnull,
         emitted=emitted,
+        emitted_isnull=e_isnull,
         emitted_valid=jnp.zeros(capacity, jnp.bool_),
         dirty=jnp.zeros(capacity, jnp.bool_),
         minmax_retracted=jnp.zeros((), jnp.bool_),
@@ -223,6 +249,7 @@ def apply(
     dirty = state.dirty.at[idx].set(True, mode="drop")
 
     accums = dict(state.accums)
+    nonnull = dict(state.nonnull)
     mr = state.minmax_retracted
     for c in calls:
         acc = accums[c.output]
@@ -231,15 +258,15 @@ def apply(
             continue
         v = values[c.input]
         notnull = ~nulls.get(c.input, jnp.zeros(v.shape, jnp.bool_))
+        wn = jnp.where(notnull, w, 0)
         if c.kind == "count":
-            accums[c.output] = acc.at[idx].add(
-                jnp.where(notnull, w, 0), mode="drop"
-            )
+            accums[c.output] = acc.at[idx].add(wn, mode="drop")
         elif c.kind == "sum":
             contrib = jnp.where(notnull, v.astype(acc.dtype) * w.astype(acc.dtype), 0)
             accums[c.output] = acc.at[idx].add(contrib, mode="drop")
+            nonnull[c.output] = nonnull[c.output].at[idx].add(wn, mode="drop")
         else:  # min / max — append-only
-            sentinel = _extreme_init(acc.dtype, c.kind)
+            sentinel = accum_init(c.kind, acc.dtype)
             use = active & notnull & (w > 0)
             if jnp.issubdtype(v.dtype, jnp.floating):
                 v = _float_to_order_key(v)  # NaN-safe total order
@@ -249,49 +276,84 @@ def apply(
                 accums[c.output] = acc.at[uidx].min(vv, mode="drop")
             else:
                 accums[c.output] = acc.at[uidx].max(vv, mode="drop")
+            nonnull[c.output] = (
+                nonnull[c.output]
+                .at[uidx]
+                .add(jnp.where(use, jnp.int64(1), jnp.int64(0)), mode="drop")
+            )
             mr = mr | jnp.any(active & notnull & (w < 0))
 
     return AggState(
         row_count=row_count,
         accums=accums,
+        nonnull=nonnull,
         emitted=state.emitted,
+        emitted_isnull=state.emitted_isnull,
         emitted_valid=state.emitted_valid,
         dirty=dirty,
         minmax_retracted=mr,
     )
 
 
-def delete_groups(
-    state: AggState, calls: Tuple[AggCall, ...], slots: jnp.ndarray
+def _reset_groups(
+    state: AggState,
+    calls: Tuple[AggCall, ...],
+    slots: jnp.ndarray,
+    *,
+    mark_dirty: bool,
 ) -> AggState:
-    """Drop whole groups (window expiry): reset their state, mark dirty.
+    """Zero out groups' accumulators.
 
-    The per-barrier flush then emits a Delete row for each if it had
-    been emitted. This is how windowed plans retract — group-wise, never
-    row-wise — which keeps MIN/MAX append-only sound. Accumulators reset
-    to their init (sentinels for extremes) so a reused slot starts clean.
+    ``mark_dirty=True`` (delete_groups): the next flush emits a Delete
+    for each previously-emitted group — windowed retraction.
+    ``mark_dirty=False`` (forget_groups): silent finalization — the
+    flush emits nothing; downstream keeps the last emitted row as the
+    window's final result while the operator frees the state (EOWC
+    cleanup; reference hash_agg.rs emit-on-window-close mode +
+    state_table.rs:1133 watermark cleaning). Callers must flush dirty
+    groups FIRST or pending updates would be silently discarded.
     """
     cap = state.capacity
     idx = jnp.where(slots >= 0, slots, cap)
     row_count = state.row_count.at[idx].set(0, mode="drop")
-    dirty = state.dirty.at[idx].set(True, mode="drop")
+    if mark_dirty:
+        dirty = state.dirty.at[idx].set(True, mode="drop")
+        emitted_valid = state.emitted_valid
+    else:
+        dirty = state.dirty.at[idx].set(False, mode="drop")
+        emitted_valid = state.emitted_valid.at[idx].set(False, mode="drop")
     kinds = {c.output: c.kind for c in calls}
-    accums = {}
-    for name, acc in state.accums.items():
-        init = (
-            _extreme_init(acc.dtype, kinds[name])
-            if kinds[name] in ("min", "max")
-            else jnp.zeros((), acc.dtype)
-        )
-        accums[name] = acc.at[idx].set(init, mode="drop")
+    accums = {
+        name: acc.at[idx].set(accum_init(kinds[name], acc.dtype), mode="drop")
+        for name, acc in state.accums.items()
+    }
+    nonnull = {
+        name: nn.at[idx].set(0, mode="drop") for name, nn in state.nonnull.items()
+    }
     return AggState(
         row_count=row_count,
         accums=accums,
+        nonnull=nonnull,
         emitted=state.emitted,
-        emitted_valid=state.emitted_valid,
+        emitted_isnull=state.emitted_isnull,
+        emitted_valid=emitted_valid,
         dirty=dirty,
         minmax_retracted=state.minmax_retracted,
     )
+
+
+def delete_groups(
+    state: AggState, calls: Tuple[AggCall, ...], slots: jnp.ndarray
+) -> AggState:
+    """Drop whole groups (window expiry) WITH downstream retraction."""
+    return _reset_groups(state, calls, slots, mark_dirty=True)
+
+
+def forget_groups(
+    state: AggState, calls: Tuple[AggCall, ...], slots: jnp.ndarray
+) -> AggState:
+    """Silently free groups (EOWC finalization). See _reset_groups."""
+    return _reset_groups(state, calls, slots, mark_dirty=False)
 
 
 @partial(
@@ -307,12 +369,13 @@ def flush(
 
     Returns ``(state', delta)`` where delta is a dict of fixed-capacity
     (2 * out_cap) arrays:
-      ``ops``       int32 Op lane
-      ``valid``     bool row-validity lane
-      ``key<i>``    the i-th group-key lane (gathered from table_keys)
-      ``<output>``  one lane per agg output
-      ``overflow``  () bool — True if more than out_cap dirty groups
-                    existed; host must flush again.
+      ``ops``                int32 Op lane
+      ``valid``              bool row-validity lane
+      ``key<i>``             the i-th group-key lane (from table_keys)
+      ``<output>``           one lane per agg output
+      ``<output>__isnull``   bool SQL-NULL lane (NULLABLE_KINDS only)
+      ``overflow``           () bool — True if more than out_cap dirty
+                             groups existed; host must flush again.
 
     Old (U-/D) rows carry the previously-emitted accums; new (U+/I)
     rows carry the current ones. Rows interleave (old_i, new_i) so
@@ -359,6 +422,10 @@ def flush(
             old = _order_key_to_float(old, jnp.dtype(decode[name]))
             new = _order_key_to_float(new, jnp.dtype(decode[name]))
         delta[name] = interleave(old, new)
+    for name, nn in state.nonnull.items():
+        old_isnull = state.emitted_isnull[name][slot_ids]
+        new_isnull = nn[slot_ids] == 0
+        delta[name + "__isnull"] = interleave(old_isnull, new_isnull)
 
     # snapshot what we just emitted (only for flushed slots)
     fidx = jnp.where(take, slot_ids, cap)
@@ -368,6 +435,12 @@ def flush(
         .set(state.accums[name][slot_ids], mode="drop")
         for name in state.accums
     }
+    emitted_isnull = {
+        name: state.emitted_isnull[name]
+        .at[fidx]
+        .set(state.nonnull[name][slot_ids] == 0, mode="drop")
+        for name in state.nonnull
+    }
     emitted_valid = state.emitted_valid.at[fidx].set(
         state.row_count[slot_ids] > 0, mode="drop"
     )
@@ -376,7 +449,9 @@ def flush(
     state = AggState(
         row_count=state.row_count,
         accums=state.accums,
+        nonnull=state.nonnull,
         emitted=emitted,
+        emitted_isnull=emitted_isnull,
         emitted_valid=emitted_valid,
         dirty=dirty,
         minmax_retracted=state.minmax_retracted,

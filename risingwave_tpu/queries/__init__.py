@@ -1,0 +1,10 @@
+"""Prebuilt Nexmark query pipelines (the BASELINE.md benchmark set).
+
+Until the SQL frontend lands, these builders play the role of the
+planner output: hand-assembled executor chains for the Nexmark queries
+(reference DDL: e2e_test/nexmark/ *.slt.part).
+"""
+
+from risingwave_tpu.queries.nexmark_q import build_q5_lite
+
+__all__ = ["build_q5_lite"]

@@ -1,0 +1,70 @@
+"""Nexmark query pipelines.
+
+Reference queries (e2e_test/nexmark/):
+- q5 (hot items): bids per auction per hop window (size 10s, slide 2s),
+  then the max-count auction(s) per window. "q5-lite" is the stateful
+  core: the hop-window bid count per auction — the HashAgg stage that
+  dominates runtime (VERDICT r1 next-step 1).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+from risingwave_tpu.executors import (
+    HashAggExecutor,
+    HopWindowExecutor,
+    MaterializeExecutor,
+)
+from risingwave_tpu.ops.agg import AggCall
+from risingwave_tpu.runtime import Pipeline
+
+Q5_WINDOW_MS = 10_000
+Q5_SLIDE_MS = 2_000
+
+
+@dataclass
+class Q5Lite:
+    pipeline: Pipeline
+    agg: HashAggExecutor
+    mview: MaterializeExecutor
+
+
+def build_q5_lite(
+    capacity: int = 1 << 16,
+    window_ms: int = Q5_WINDOW_MS,
+    slide_ms: int = Q5_SLIDE_MS,
+    state_cleaning: bool = True,
+) -> Q5Lite:
+    """bids -> hop window -> COUNT(*) per (auction, window_start) -> MV.
+
+    With ``state_cleaning``, an event-time watermark issued as
+    ``pipeline.watermark("date_time", wm)`` is translated by the hop
+    executor into a ``window_start`` watermark, which closes windows
+    that can receive no further rows: pending updates are flushed
+    downstream, then state is freed silently (EOWC-final — the MV keeps
+    closed windows' final counts). Mirrors the reference's
+    watermark-driven state cleaning on q5's agg state
+    (state_table.rs:1133).
+    """
+    hop = HopWindowExecutor("date_time", window_ms, slide_ms)
+    agg = HashAggExecutor(
+        group_keys=("auction", "window_start"),
+        calls=(AggCall("count_star", None, "num"),),
+        schema_dtypes={
+            "auction": jnp.int64,
+            "window_start": jnp.int64,
+        },
+        capacity=capacity,
+        # HopWindowExecutor already translates the event-time watermark
+        # into a window_start watermark (start >= first_start(wm) for any
+        # future row), so windows below it are closed as-is: retention 0
+        window_key=("window_start", 0, False) if state_cleaning else None,
+    )
+    mview = MaterializeExecutor(
+        pk=("auction", "window_start"), columns=("num",)
+    )
+    return Q5Lite(Pipeline([hop, agg, mview]), agg, mview)

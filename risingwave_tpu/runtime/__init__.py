@@ -1,0 +1,5 @@
+"""Runtime — epoch loop, pipelines, barriers (meta-lite, single node)."""
+
+from risingwave_tpu.runtime.pipeline import Pipeline
+
+__all__ = ["Pipeline"]

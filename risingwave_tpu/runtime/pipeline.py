@@ -1,0 +1,78 @@
+"""Single-fragment pipeline: an ordered executor chain + epoch driver.
+
+Reference roles:
+- the actor's executor chain (src/stream/src/executor/mod.rs:180 — each
+  executor wraps its input stream; here the host feeds messages down an
+  ordered list instead);
+- barrier flow-through: a barrier entering the chain flushes each
+  executor in turn, and an executor's flush output is DATA for every
+  executor below it (src/stream/src/task/barrier_manager.rs:634 +
+  executor flush_data patterns);
+- watermark propagation (executor/watermark_filter.rs): watermarks pass
+  through every executor, letting stateful ones clean closed state.
+
+The epoch counter follows the reference epoch encoding
+(physical ms << 16, src/common/src/util/epoch.rs:36).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.executors.base import Barrier, Epoch, Executor, Watermark
+
+
+class Pipeline:
+    """An ordered chain of executors driven by the host epoch loop."""
+
+    def __init__(self, executors: Sequence[Executor]):
+        self.executors = list(executors)
+        self._epoch = 0
+
+    # -- message plumbing -------------------------------------------------
+    def push(self, chunk: StreamChunk, start: int = 0) -> List[StreamChunk]:
+        """Feed one data chunk into the chain; returns what falls out."""
+        pending = [chunk]
+        for ex in self.executors[start:]:
+            nxt: List[StreamChunk] = []
+            for c in pending:
+                nxt.extend(ex.apply(c))
+            pending = nxt
+        return pending
+
+    def barrier(self, checkpoint: bool = True) -> List[StreamChunk]:
+        """Inject a barrier; each executor's flush output becomes data
+        for the rest of the chain. Returns chunks exiting the chain."""
+        prev = self._epoch
+        self._epoch = max(int(time.time() * 1000) << 16, prev + 1)
+        b = Barrier(Epoch(prev, self._epoch), checkpoint)
+        pending: List[StreamChunk] = []
+        for i, ex in enumerate(self.executors):
+            nxt: List[StreamChunk] = []
+            for c in pending:
+                nxt.extend(ex.apply(c))
+            nxt.extend(ex.on_barrier(b))
+            pending = nxt
+        return pending
+
+    def watermark(self, column: str, value: int) -> List[StreamChunk]:
+        """Propagate a watermark; executors may transform it (e.g. hop
+        window: event time -> window_start) or consume it; their flush
+        outputs flow downstream as data."""
+        wm: Optional[Watermark] = Watermark(column, value)
+        pending: List[StreamChunk] = []
+        for ex in self.executors:
+            nxt: List[StreamChunk] = []
+            for c in pending:
+                nxt.extend(ex.apply(c))
+            if wm is not None:
+                wm, outs = ex.on_watermark(wm)
+                nxt.extend(outs)
+            pending = nxt
+        return pending
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch

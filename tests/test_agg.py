@@ -198,6 +198,61 @@ def test_null_inputs_skipped():
     assert np.asarray(delta["total"])[val][-1] == 30  # SUM skips
 
 
+def test_all_null_inputs_emit_sql_null_outputs():
+    """SUM/MIN/MAX over a group with only NULL inputs is SQL NULL, not
+    0 / the sentinel (code-review r2 finding #2); COUNT stays 0."""
+    calls = (
+        AggCall("count", "v", "cnt"),
+        AggCall("sum", "v", "total"),
+        AggCall("min", "v", "lo"),
+    )
+    table = ht.HashTable.create(64, (jnp.int64,))
+    state = agg_mod.create_state(64, calls, {"v": jnp.int64})
+    keys = jnp.asarray([1, 1, 2], jnp.int64)
+    table, slots, _, _ = ht.lookup_or_insert(table, (keys,), jnp.ones(3, bool))
+    state = agg_mod.apply(
+        state, calls, slots, jnp.ones(3, jnp.int32),
+        {"v": jnp.asarray([10, 99, 20], jnp.int64)},
+        {"v": jnp.asarray([True, True, False])},  # key 1: all-NULL inputs
+    )
+    state, delta = agg_mod.flush(state, table.keys, 8)
+    val = np.asarray(delta["valid"])
+    k = np.asarray(delta["key0"])[val]
+    res = {
+        kk: (c, t, tn, lo, ln)
+        for kk, c, t, tn, lo, ln in zip(
+            k,
+            np.asarray(delta["cnt"])[val],
+            np.asarray(delta["total"])[val],
+            np.asarray(delta["total__isnull"])[val],
+            np.asarray(delta["lo"])[val],
+            np.asarray(delta["lo__isnull"])[val],
+        )
+    }
+    assert res[1][0] == 0  # COUNT(v) = 0, not NULL
+    assert res[1][2] and res[1][4]  # SUM / MIN are NULL
+    assert res[2] == (1, 20, False, 20, False)
+    # retraction of the only non-null input turns SUM back to NULL
+    state = agg_mod.apply(
+        state, calls, slots[2:], jnp.asarray([-1], jnp.int32),
+        {"v": jnp.asarray([20], jnp.int64)},
+        {"v": jnp.asarray([False])},
+    )
+    # group 2 still live? row_count 0 -> dead; add a NULL row to keep it
+    state = agg_mod.apply(
+        state, calls, slots[2:], jnp.asarray([1], jnp.int32),
+        {"v": jnp.asarray([0], jnp.int64)},
+        {"v": jnp.asarray([True])},
+    )
+    state, delta = agg_mod.flush(state, table.keys, 8)
+    val = np.asarray(delta["valid"])
+    k = np.asarray(delta["key0"])[val]
+    ops = np.asarray(delta["ops"])[val]
+    keep = ops != Op.UPDATE_DELETE
+    res2 = dict(zip(k[keep], np.asarray(delta["total__isnull"])[val][keep]))
+    assert res2[2]  # SUM(v) for key 2 is NULL again
+
+
 def test_delete_groups_resets_extremes():
     table, state = _setup()
     table, state = _apply(table, state, [3], [42])

@@ -1,0 +1,107 @@
+"""Expression framework: SQL semantics vs hand-computed oracles
+(reference: src/expr/core vectorized eval + non-strict NULL handling)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.executors import FilterExecutor, ProjectExecutor
+from risingwave_tpu.expr import Case, IsNull, TumbleStart, col, lit
+from risingwave_tpu.types import Op
+
+
+def make_chunk(**kw):
+    nulls = kw.pop("nulls", None)
+    n = len(next(iter(kw.values())))
+    return StreamChunk.from_numpy(
+        {k: np.asarray(v) for k, v in kw.items()}, capacity=8, nulls=nulls
+    )
+
+
+def test_arith_and_compare():
+    c = make_chunk(a=[1, 2, 3, 4], b=[10, 20, 30, 40])
+    v, n = ((col("a") + col("b")) * lit(2)).eval(c)
+    assert n is None
+    np.testing.assert_array_equal(np.asarray(v)[:4], [22, 44, 66, 88])
+    v, _ = (col("a") >= lit(3)).eval(c)
+    np.testing.assert_array_equal(np.asarray(v)[:4], [False, False, True, True])
+
+
+def test_null_strict_arith_and_3vl():
+    c = make_chunk(
+        a=[1, 2, 3, 4], b=[5, 6, 7, 8], nulls={"a": [False, True, False, True]}
+    )
+    _, n = (col("a") + col("b")).eval(c)
+    np.testing.assert_array_equal(np.asarray(n)[:4], [False, True, False, True])
+
+    # (a > 0) OR (b > 100): NULL OR FALSE = NULL; NULL OR TRUE = TRUE
+    pred = (col("a") > lit(0)) | (col("b") > lit(100))
+    v, n = pred.eval(c)
+    np.testing.assert_array_equal(np.asarray(n)[:4], [False, True, False, True])
+    # (a > 0) AND (b > 0): NULL AND TRUE = NULL
+    pred = (col("a") > lit(0)) & (col("b") > lit(0))
+    v, n = pred.eval(c)
+    np.testing.assert_array_equal(np.asarray(n)[:4], [False, True, False, True])
+    # FALSE AND NULL = FALSE (definite)
+    pred = (col("b") > lit(100)) & (col("a") > lit(0))
+    v, n = pred.eval(c)
+    assert not bool(n[1])
+    assert not bool(v[1])
+
+
+def test_div_by_zero_is_null_not_trap():
+    c = make_chunk(a=[10, 20], b=[2, 0])
+    v, n = (col("a") // col("b")).eval(c)
+    assert int(v[0]) == 5
+    assert bool(n[1])
+
+
+def test_case_and_is_null():
+    c = make_chunk(a=[1, 2, 3, 4], nulls={"a": [False, False, True, False]})
+    e = Case(
+        branches=((col("a") > lit(2), lit(100)), (col("a") > lit(1), lit(50))),
+        default=lit(0),
+    )
+    v, n = e.eval(c)
+    np.testing.assert_array_equal(np.asarray(v)[:4], [0, 50, 0, 100])
+    v, n = IsNull(col("a")).eval(c)
+    assert n is None
+    np.testing.assert_array_equal(np.asarray(v)[:4], [False, False, True, False])
+
+
+def test_tumble_start():
+    c = make_chunk(ts=[0, 999, 10_000, 25_500])
+    v, _ = TumbleStart(col("ts"), 10_000).eval(c)
+    np.testing.assert_array_equal(np.asarray(v)[:4], [0, 0, 10_000, 20_000])
+
+
+def test_filter_executor_drops_null_and_false():
+    c = make_chunk(a=[1, 5, 3, 7], nulls={"a": [False, False, True, False]})
+    (out,) = FilterExecutor(col("a") > lit(2)).apply(c)
+    data = out.to_numpy()
+    np.testing.assert_array_equal(data["a"], [5, 7])
+
+
+def test_filter_fixes_torn_update_pairs():
+    c = StreamChunk.from_numpy(
+        {"a": np.asarray([1, 10, 2, 20])},
+        capacity=4,
+        ops=np.asarray(
+            [Op.UPDATE_DELETE, Op.UPDATE_INSERT, Op.UPDATE_DELETE, Op.UPDATE_INSERT]
+        ),
+    )
+    # keeps rows > 5: first pair loses its U- half, second keeps only U-
+    (out,) = FilterExecutor(col("a") > lit(5)).apply(c)
+    data = out.to_numpy(with_ops=True)
+    np.testing.assert_array_equal(data["a"], [10, 20])
+    np.testing.assert_array_equal(data["__op__"], [Op.INSERT, Op.INSERT])
+
+
+def test_project_executor():
+    c = make_chunk(price=[100, 200], qty=[2, 3])
+    (out,) = ProjectExecutor(
+        {"total": col("price") * col("qty"), "price": col("price")}
+    ).apply(c)
+    data = out.to_numpy()
+    np.testing.assert_array_equal(data["total"], [200, 600])
+    np.testing.assert_array_equal(data["price"], [100, 200])

@@ -1,0 +1,162 @@
+"""End-to-end Nexmark q5-lite: generator -> hop window -> hash agg -> MV,
+replayed through a pandas oracle (reference test discipline:
+executor chain tests vs expected chunks, src/stream/src/executor/
+test_utils.rs; e2e nexmark slt, e2e_test/nexmark/).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from risingwave_tpu.connectors.nexmark import NexmarkConfig, NexmarkGenerator
+from risingwave_tpu.queries.nexmark_q import Q5_SLIDE_MS, Q5_WINDOW_MS, build_q5_lite
+
+
+def _oracle_counts(bids: pd.DataFrame) -> dict:
+    """Expand each bid into its hop windows and count per (auction, ws)."""
+    size, slide = Q5_WINDOW_MS, Q5_SLIDE_MS
+    factor = size // slide
+    rows = []
+    ts = bids["date_time"].to_numpy()
+    first = ((ts - size) // slide + 1) * slide
+    for k in range(factor):
+        ws = first + k * slide
+        ok = ws <= ts
+        rows.append(
+            pd.DataFrame(
+                {"auction": bids["auction"].to_numpy()[ok], "window_start": ws[ok]}
+            )
+        )
+    expanded = pd.concat(rows)
+    g = expanded.groupby(["auction", "window_start"]).size()
+    return {k: (v,) for k, v in g.items()}
+
+
+def _run_pipeline(q5, gen, *, epochs, events_per_epoch, chunk_events, cap):
+    all_bids = []
+    for _ in range(epochs):
+        done = 0
+        while done < events_per_epoch:
+            n = min(chunk_events, events_per_epoch - done)
+            done += n
+            chunks = gen.next_chunks(n, cap)
+            if chunks["bid"] is not None:
+                q5.pipeline.push(chunks["bid"])
+                all_bids.append(
+                    pd.DataFrame(
+                        {
+                            k: v
+                            for k, v in chunks["bid"].to_numpy().items()
+                            if k != "__op__"
+                        }
+                    )
+                )
+        q5.pipeline.barrier()
+    return pd.concat(all_bids) if all_bids else pd.DataFrame()
+
+
+def test_q5_lite_matches_pandas_oracle():
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=50_000))
+    q5 = build_q5_lite(capacity=1 << 14, state_cleaning=False)
+    bids = _run_pipeline(
+        q5, gen, epochs=4, events_per_epoch=2000, chunk_events=500, cap=512
+    )
+    assert len(bids) > 1000
+    assert q5.mview.snapshot() == _oracle_counts(bids)
+
+
+def test_q5_lite_rehash_growth_preserves_results():
+    """Tiny initial table forces repeated 2x rehash mid-stream."""
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=200_000))
+    q5 = build_q5_lite(capacity=1 << 8, state_cleaning=False)
+    bids = _run_pipeline(
+        q5, gen, epochs=3, events_per_epoch=3000, chunk_events=600, cap=600
+    )
+    assert q5.agg.table.capacity > 1 << 8  # growth actually happened
+    assert q5.mview.snapshot() == _oracle_counts(bids)
+
+
+def test_q5_lite_state_cleaning_frees_closed_windows():
+    """Watermarks close old windows: MV keeps their final counts while
+    live device state shrinks (reference: watermark state cleaning)."""
+    # 200 ev/s -> each 2000-event batch spans 10s of event time, so six
+    # batches cover 60s and most 10s windows close under the watermark
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=200))
+    q5 = build_q5_lite(capacity=1 << 14, state_cleaning=True)
+    all_bids = []
+    max_ts = 0
+    for _ in range(6):
+        chunks = gen.next_chunks(2000, 2048)
+        bid = chunks["bid"]
+        if bid is not None:
+            q5.pipeline.push(bid)
+            data = bid.to_numpy()
+            max_ts = max(max_ts, int(data["date_time"].max()))
+            all_bids.append(
+                pd.DataFrame({k: v for k, v in data.items() if k != "__op__"})
+            )
+        q5.pipeline.barrier()
+        # event-time watermark: HopWindowExecutor translates it into a
+        # window_start watermark for the agg's state cleaning
+        q5.pipeline.watermark("date_time", max_ts)
+    bids = pd.concat(all_bids)
+    # results still exact: closed windows keep final counts in the MV
+    assert q5.mview.snapshot() == _oracle_counts(bids)
+    # state actually freed: live groups only cover the last window span
+    live = int(q5.agg.table.num_live())
+    total = len(q5.mview.snapshot())
+    assert live < total
+
+
+def test_q5_lite_mid_epoch_watermark_loses_nothing():
+    """A watermark arriving BETWEEN barriers (the normal streaming case)
+    must not discard dirty un-flushed updates on expiring windows
+    (code-review r2 finding #1)."""
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=200))
+    q5 = build_q5_lite(capacity=1 << 14, state_cleaning=True)
+    all_bids = []
+    max_ts = 0
+    for i in range(6):
+        chunks = gen.next_chunks(2000, 2048)
+        bid = chunks["bid"]
+        if bid is not None:
+            q5.pipeline.push(bid)
+            data = bid.to_numpy()
+            max_ts = max(max_ts, int(data["date_time"].max()))
+            all_bids.append(
+                pd.DataFrame({k: v for k, v in data.items() if k != "__op__"})
+            )
+        # watermark BEFORE the barrier — dirty groups expire mid-epoch
+        q5.pipeline.watermark("date_time", max_ts)
+        if i % 2 == 1:
+            q5.pipeline.barrier()
+    q5.pipeline.barrier()
+    bids = pd.concat(all_bids)
+    assert q5.mview.snapshot() == _oracle_counts(bids)
+
+
+def test_q5_lite_no_recompile_across_epochs():
+    """The fixed-capacity design must compile once and replay every
+    epoch with zero recompiles (chunk.py design premise; VERDICT r1
+    weak #8)."""
+    from risingwave_tpu.executors import hash_agg, hop_window
+    from risingwave_tpu.ops import agg as agg_ops
+
+    kernels = (hash_agg._agg_step, hop_window._hop_step, agg_ops.flush)
+
+    def cache_sizes():
+        return tuple(k._cache_size() for k in kernels)
+
+    gen = NexmarkGenerator(NexmarkConfig())
+    q5 = build_q5_lite(capacity=1 << 12, state_cleaning=False)
+    # warm up: one chunk + one barrier compiles everything
+    chunks = gen.next_chunks(500, 512)
+    q5.pipeline.push(chunks["bid"])
+    q5.pipeline.barrier()
+    before = cache_sizes()
+    for _ in range(3):
+        chunks = gen.next_chunks(500, 512)
+        if chunks["bid"] is not None:
+            q5.pipeline.push(chunks["bid"])
+        q5.pipeline.barrier()
+    assert cache_sizes() == before
