@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Nexmark q5-lite throughput benchmark (the BASELINE.md headline path).
+
+Measures the streaming HashAgg pipeline — bids -> hop window (10s/2s)
+-> COUNT(*) per (auction, window_start) -> per-barrier delta flush ->
+MV — in events/sec on the default JAX device (the TPU under the
+driver; ``--smoke`` forces CPU), against a vectorized single-core
+numpy "CPU actor" baseline doing identical work (our stand-in for the
+reference's per-actor CPU throughput; the reference publishes no
+absolute numbers, BASELINE.md).
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N, ...}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def cpu_actor_baseline(host_chunks, window_ms, slide_ms):
+    """Single-threaded numpy actor: hop-expand + dict groupby-count per
+    chunk, barrier no-op (state already materialized). Vectorized with
+    np.unique — a strong CPU actor, not a per-row straw man."""
+    import numpy as np
+
+    factor = window_ms // slide_ms
+    counts = {}
+    t0 = time.perf_counter()
+    n_rows = 0
+    for cols in host_chunks:
+        auction = cols["auction"]
+        ts = cols["date_time"]
+        n_rows += len(ts)
+        first = ((ts - window_ms) // slide_ms + 1) * slide_ms
+        for k in range(factor):
+            ws = first + k * slide_ms
+            ok = ws <= ts
+            pairs = np.stack([auction[ok], ws[ok]], axis=1)
+            uniq, cnt = np.unique(pairs, axis=0, return_counts=True)
+            for (a, w), c in zip(uniq, cnt):
+                counts[(a, w)] = counts.get((a, w), 0) + int(c)
+    dt = time.perf_counter() - t0
+    return n_rows / dt, counts
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="small run on CPU")
+    ap.add_argument("--epochs", type=int, default=None)
+    ap.add_argument("--events-per-epoch", type=int, default=None)
+    ap.add_argument("--chunk-events", type=int, default=None)
+    args = ap.parse_args()
+
+    if args.smoke:
+        import os
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    if args.smoke:
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from risingwave_tpu.connectors.nexmark import NexmarkConfig, NexmarkGenerator
+    from risingwave_tpu.queries.nexmark_q import (
+        Q5_SLIDE_MS,
+        Q5_WINDOW_MS,
+        build_q5_lite,
+    )
+
+    epochs = args.epochs or (3 if args.smoke else 10)
+    events_per_epoch = args.events_per_epoch or (20_000 if args.smoke else 200_000)
+    chunk_events = args.chunk_events or (2_048 if args.smoke else 8_192)
+
+    device = jax.devices()[0]
+    platform = device.platform
+
+    # -- pre-generate the workload (host) --------------------------------
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=10_000))
+    host_chunks = []  # numpy column dicts, one per push
+    for _ in range(epochs):
+        done = 0
+        per_epoch = []
+        while done < events_per_epoch:
+            n = min(chunk_events, events_per_epoch - done)
+            done += n
+            ev = gen.next_events(n)
+            bid = ev["bid"]
+            if bid and len(bid["auction"]):
+                per_epoch.append(
+                    {
+                        "auction": bid["auction"],
+                        "date_time": bid["date_time"],
+                    }
+                )
+        host_chunks.append(per_epoch)
+    flat_host = [c for ep in host_chunks for c in ep]
+    total_bids = sum(len(c["auction"]) for c in flat_host)
+
+    # -- CPU actor baseline ----------------------------------------------
+    cpu_rows_s, cpu_counts = cpu_actor_baseline(
+        flat_host, Q5_WINDOW_MS, Q5_SLIDE_MS
+    )
+
+    # -- device pipeline --------------------------------------------------
+    from risingwave_tpu.array.chunk import StreamChunk
+
+    cap = chunk_events  # bids per chunk <= events per chunk
+    q5 = build_q5_lite(capacity=1 << 18, state_cleaning=False)
+    dev_chunks = [
+        [StreamChunk.from_numpy(c, cap) for c in ep] for ep in host_chunks
+    ]
+
+    # warmup: compile every kernel in the chain
+    q5.pipeline.push(dev_chunks[0][0])
+    q5.pipeline.barrier()
+    warm = build_q5_lite(capacity=1 << 18, state_cleaning=False)
+    q5 = warm  # fresh state, warm jit caches
+
+    barrier_times = []
+    t0 = time.perf_counter()
+    for ep in dev_chunks:
+        for c in ep:
+            q5.pipeline.push(c)
+        tb = time.perf_counter()
+        q5.pipeline.barrier()
+        barrier_times.append(time.perf_counter() - tb)
+    jax.block_until_ready(q5.agg.state.row_count)
+    dt = time.perf_counter() - t0
+
+    rows_s = total_bids / dt
+    p99_barrier_ms = float(np.percentile(np.asarray(barrier_times) * 1e3, 99))
+
+    # -- correctness cross-check vs the CPU actor ------------------------
+    mv = {k: v[0] for k, v in q5.mview.snapshot().items()}
+    ok = mv == {k: v for k, v in cpu_counts.items()}
+    if not ok:
+        print(
+            f"MISMATCH: device MV {len(mv)} groups vs cpu {len(cpu_counts)}",
+            file=sys.stderr,
+        )
+
+    print(
+        json.dumps(
+            {
+                "metric": "nexmark_q5_lite_throughput",
+                "value": round(rows_s, 1),
+                "unit": "bids/sec",
+                "vs_baseline": round(rows_s / cpu_rows_s, 3),
+                "platform": platform,
+                "cpu_actor_rows_per_sec": round(cpu_rows_s, 1),
+                "p99_barrier_ms": round(p99_barrier_ms, 2),
+                "total_bids": total_bids,
+                "epochs": epochs,
+                "correct": ok,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -59,12 +59,7 @@ def _build_key_lanes(
     return tuple(lanes)
 
 
-@partial(
-    jax.jit,
-    static_argnames=("calls", "group_keys", "nullable"),
-    donate_argnums=(0, 1),
-)
-def _agg_step(
+def agg_step_fn(
     table: HashTable,
     state: AggState,
     dropped: jnp.ndarray,
@@ -73,7 +68,7 @@ def _agg_step(
     group_keys: Tuple[str, ...],
     nullable: Tuple[bool, ...],
 ):
-    """One chunk through the group map + agg update. Fully fused."""
+    """One chunk through the group map + agg update (pure; jit it)."""
     keys = _build_key_lanes(chunk, group_keys, nullable)
     table, slots, _, _ = lookup_or_insert(table, keys, chunk.valid)
     signs = chunk.effective_signs()
@@ -87,6 +82,13 @@ def _agg_step(
     state = agg_ops.apply(state, calls, slots, signs, values, nulls)
     table = set_live(table, slots, state.row_count[slots] > 0)
     return table, state, dropped
+
+
+_agg_step = jax.jit(
+    agg_step_fn,
+    static_argnames=("calls", "group_keys", "nullable"),
+    donate_argnums=(0, 1),
+)
 
 
 @partial(jax.jit, static_argnames=("calls", "new_cap"))
@@ -236,8 +238,20 @@ class HashAggExecutor(Executor):
         # off the hot path) before deciding to pay for a rebuild
         claimed = int(self.table.occupancy())
         if claimed + incoming > cap * GROW_AT:
+            # size the new table from what SURVIVES the rebuild, not from
+            # pre-rebuild occupancy: steady-state windowed workloads churn
+            # tombstones, and sizing by `claimed` would double capacity on
+            # every compaction forever (code-review r2). new_cap == cap is
+            # a pure tombstone compaction.
+            keep = int(
+                jnp.sum(
+                    (
+                        self.table.live | self.state.emitted_valid | self.state.dirty
+                    ).astype(jnp.int32)
+                )
+            )
             new_cap = cap
-            while claimed + incoming > new_cap * GROW_AT:
+            while keep + incoming > new_cap * GROW_AT:
                 new_cap *= 2
             self.table, self.state = _rehash(
                 self.table, self.state, self.calls, new_cap

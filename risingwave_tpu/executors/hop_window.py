@@ -24,29 +24,40 @@ from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Executor
 
 
-@partial(jax.jit, static_argnames=("ts_col", "size_ms", "slide_ms", "out_start"))
-def _hop_step(
+def hop_step_fn(
     chunk: StreamChunk, ts_col: str, size_ms: int, slide_ms: int, out_start: str
 ) -> StreamChunk:
     factor = -(-size_ms // slide_ms)  # ceil
     cap = chunk.capacity
 
+    # block layout: copy k of every row forms one contiguous cap-sized
+    # block, so adjacent rows STAY adjacent within each block — the
+    # U-/U+ update-pair invariant (stream_chunk.rs:45) that FilterExecutor
+    # and sinks rely on survives the expansion (jnp.repeat would tear
+    # every pair apart; code-review r2).
     def tile(a):
-        return jnp.repeat(a, factor, axis=0)
+        return jnp.tile(a, factor)
 
     ts = chunk.col(ts_col)
     # earliest aligned window start strictly greater than ts - size
     first = (jnp.floor_divide(ts - size_ms, slide_ms) + 1) * slide_ms
-    k = jnp.tile(jnp.arange(factor, dtype=ts.dtype), cap)
+    k = jnp.repeat(jnp.arange(factor, dtype=ts.dtype), cap)
     starts = tile(first) + k * slide_ms
     in_window = starts <= tile(ts)  # start + size > ts holds by choice of first
 
     cols = {n: tile(a) for n, a in chunk.columns.items()}
     cols[out_start] = starts
-    nulls = {n: tile(a) for n, a in chunk.nulls.items()}
+    # a pre-existing null lane on the output column must not survive the
+    # replacement (freshly computed starts are never NULL)
+    nulls = {n: tile(a) for n, a in chunk.nulls.items() if n != out_start}
     valid = tile(chunk.valid) & in_window
     ops = tile(chunk.ops)
     return StreamChunk(cols, valid, nulls, ops)
+
+
+_hop_step = partial(jax.jit, static_argnames=("ts_col", "size_ms", "slide_ms", "out_start"))(
+    hop_step_fn
+)
 
 
 class HopWindowExecutor(Executor):

@@ -34,31 +34,42 @@ class MaterializeExecutor(Executor):
         n = len(ops)
         if n == 0:
             return [chunk]
-        pk_cols = [data[k] for k in self.pk]
         # NULL pk components must stay distinct from real zeros: fold the
         # null lane into the key tuple as None (SQL: NULL group keys form
         # their own group; reference pk serde writes a null tag first,
-        # row_serde_util.rs)
-        pk_nulls = [data.get(k + "__null") for k in self.pk]
-        val_cols = [data[c] for c in self.columns]
-        null_lanes = {
-            c: data[c + "__null"] for c in self.columns if c + "__null" in data
-        }
-        for i in range(n):
-            key = tuple(
-                None if nl is not None and nl[i] else c[i]
-                for c, nl in zip(pk_cols, pk_nulls)
-            )
-            if ops[i] in (Op.DELETE, Op.UPDATE_DELETE):
-                # pk-conflict handling "overwrite": tolerate deleting a
-                # missing row (reference ConflictBehavior::Overwrite)
-                self.rows.pop(key, None)
-            else:
-                row = tuple(
-                    None if null_lanes.get(c) is not None and null_lanes[c][i] else v[i]
-                    for c, v in zip(self.columns, val_cols)
-                )
-                self.rows[key] = row
+        # row_serde_util.rs). Same for NULL values. Built column-wise so
+        # the per-barrier delta apply is C-speed zip/dict ops, not a
+        # per-row Python loop.
+        def tuples(names):
+            lanes = []
+            for name in names:
+                col = data[name].tolist()
+                nl = data.get(name + "__null")
+                if nl is not None:
+                    col = [None if isnull else v for v, isnull in zip(col, nl)]
+                lanes.append(col)
+            return list(zip(*lanes))
+
+        keys = tuples(self.pk)
+        vals = tuples(self.columns)
+        is_del = (ops == Op.DELETE) | (ops == Op.UPDATE_DELETE)
+        # Sequentially applying a chunk's ops leaves each pk in the state
+        # of its LAST op (delete -> absent, insert/update -> that row), so
+        # "last op per pk wins" replaces the per-row loop: the dict
+        # comprehension keeps the last index per key at C speed.
+        last = {k: i for i, k in enumerate(keys)}
+        if is_del.any():
+            rows = self.rows
+            keys_u = list(last.keys())
+            idx = np.fromiter(last.values(), dtype=np.int64, count=len(last))
+            dmask = is_del[idx]
+            for j in np.flatnonzero(dmask):
+                # "overwrite" conflict behavior: tolerate missing rows
+                # (reference ConflictBehavior::Overwrite)
+                rows.pop(keys_u[j], None)
+            rows.update((keys_u[j], vals[idx[j]]) for j in np.flatnonzero(~dmask))
+        else:
+            self.rows.update((k, vals[i]) for k, i in last.items())
         return [chunk]
 
     def snapshot(self) -> Dict[Tuple, Tuple]:

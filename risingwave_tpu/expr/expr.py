@@ -40,10 +40,6 @@ class Expr:
     def eval(self, chunk: DataChunk) -> EvalResult:  # pragma: no cover
         raise NotImplementedError
 
-    def eval_notnull(self, chunk: DataChunk) -> jnp.ndarray:
-        """Values with NULLs treated as absent (caller ignores them)."""
-        return self.eval(chunk)[0]
-
     # -- operator sugar --------------------------------------------------
     def __add__(self, o):
         return BinOp("+", self, _wrap(o))
@@ -255,15 +251,21 @@ class Case(Expr):
     default: Expr
 
     def eval(self, chunk: DataChunk) -> EvalResult:
+        evaluated = [
+            (cond.eval(chunk), out.eval(chunk)) for cond, out in self.branches
+        ]
         val, nulls = self.default.eval(chunk)
+        # SQL CASE result type is promoted across ALL branches and the
+        # default — coercing to the default's dtype would silently
+        # truncate wider branch values (code-review r2)
+        rdtype = jnp.result_type(val, *(ov for _, (ov, _) in evaluated))
+        val = val.astype(rdtype)
         # evaluate in reverse so earlier branches win via jnp.where
-        for cond, out in reversed(self.branches):
-            cv, cn = cond.eval(chunk)
+        for (cv, cn), (ov, on) in reversed(evaluated):
             cv = cv.astype(jnp.bool_)
             if cn is not None:
                 cv = cv & ~cn  # NULL condition does not fire a branch
-            ov, on = out.eval(chunk)
-            val = jnp.where(cv, ov.astype(val.dtype), val)
+            val = jnp.where(cv, ov.astype(rdtype), val)
             if nulls is not None or on is not None:
                 base = nulls if nulls is not None else jnp.zeros_like(cv)
                 bn = on if on is not None else jnp.zeros_like(cv)

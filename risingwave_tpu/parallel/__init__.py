@@ -1,0 +1,18 @@
+"""Parallelism — vnode-sharded operators over a device mesh.
+
+Reference model (SURVEY.md §2.11): RisingWave parallelizes a fragment
+into N actors; rows route to actors by vnode of the distribution key
+(256 vnodes, hash dispatcher src/stream/src/executor/dispatch.rs:683),
+with gRPC exchange between actors.
+
+TPU re-design: one fragment = ONE pjit/shard_map-compiled step over a
+``jax.sharding.Mesh``; the hash exchange is an on-device
+``lax.all_to_all`` riding ICI inside the step, and per-actor state is
+the same slot-table arrays sharded along the mesh axis. Cross-host
+(DCN) edges and the control plane stay host-driven, as the reference
+keeps gRPC between compute nodes.
+"""
+
+from risingwave_tpu.parallel.sharded_agg import ShardedHashAgg, make_mesh
+
+__all__ = ["ShardedHashAgg", "make_mesh"]

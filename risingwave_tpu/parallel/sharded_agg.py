@@ -1,0 +1,295 @@
+"""Vnode-sharded HashAgg: hash exchange + grouped state on a mesh.
+
+Reference roles replaced (SURVEY.md §2.11, §3.3):
+- ``HashDataDispatcher`` — rows route to the downstream actor owning
+  their key's vnode (src/stream/src/executor/dispatch.rs:683,
+  vnode mapping src/common/src/hash/consistent_hash/vnode.rs:34);
+- the exchange channel / gRPC GetStream between actors
+  (src/stream/src/executor/exchange/permit.rs:35) — here a single
+  ``lax.all_to_all`` over the mesh's ICI links inside the jit step;
+- N parallel HashAgg actors, each owning its vnode slice of group
+  state (src/stream/src/executor/hash_agg.rs:62).
+
+Design: state lives STACKED — every per-slot array gains a leading
+``(n_shards,)`` axis sharded over the mesh. The step runs under
+``shard_map``; inside, each shard:
+
+1. computes each local row's destination shard ``vnode(key) % n``;
+2. packs rows into per-destination buckets of static capacity
+   (compaction by cumulative count — no sort on the hot path);
+3. exchanges buckets with ``lax.all_to_all`` (the ICI shuffle);
+4. runs the SAME single-chip kernels (lookup_or_insert + agg apply)
+   on the received rows against its local slot table.
+
+Each group key lives on exactly one shard, so per-barrier flush is
+shard-local and the concatenated deltas are globally exact. Bucket
+overflow (static capacity exceeded by a skewed chunk) latches the
+``dropped`` flag — the same correctness backstop as MAX_PROBE
+overflow, surfaced at the next barrier.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.executors.base import Barrier, Executor
+from risingwave_tpu.executors.hash_agg import _build_key_lanes
+from risingwave_tpu.ops import agg as agg_ops
+from risingwave_tpu.ops.agg import AggCall, AggState
+from risingwave_tpu.ops.hash_table import HashTable, lookup_or_insert, set_live
+from risingwave_tpu.ops.hashing import VNODE_COUNT, hash_columns
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "shard") -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def _dest_shard(key_lanes, n_shards: int) -> jnp.ndarray:
+    """Row -> owning shard via vnode (vnode.rs:34 + vnode mapping):
+    256 vnodes round-robin over shards, so scaling the mesh only remaps
+    vnodes, never rehashes rows."""
+    vnode = (hash_columns(key_lanes, seed=0xC0FFEE) % VNODE_COUNT).astype(jnp.int32)
+    return vnode % n_shards
+
+
+def _pack_buckets(chunk_cols: Dict[str, jnp.ndarray], valid, dest, n_shards, bucket_cap):
+    """Scatter rows into an (n_shards, bucket_cap) buffer per column.
+
+    Position within a destination bucket = number of earlier valid rows
+    with the same destination (a cumsum per destination — n_shards is
+    static and small, so this is n_shards vectorized passes, no sort).
+    Returns (buffers, valid_buffer, overflow).
+    """
+    n = valid.shape[0]
+    pos = jnp.zeros(n, jnp.int32)
+    counts = []
+    for d in range(n_shards):
+        m = valid & (dest == d)
+        pos = jnp.where(m, jnp.cumsum(m.astype(jnp.int32)) - 1, pos)
+        counts.append(jnp.sum(m.astype(jnp.int32)))
+    overflow = jnp.any(jnp.stack(counts) > bucket_cap)
+
+    in_cap = valid & (pos < bucket_cap)
+    flat = dest * bucket_cap + pos  # index into (n_shards*bucket_cap,)
+    idx = jnp.where(in_cap, flat, n_shards * bucket_cap)  # drop lane
+
+    out = {}
+    for name, col in chunk_cols.items():
+        buf = jnp.zeros(n_shards * bucket_cap, col.dtype)
+        out[name] = buf.at[idx].set(col, mode="drop").reshape(n_shards, bucket_cap)
+    vbuf = (
+        jnp.zeros(n_shards * bucket_cap, jnp.bool_)
+        .at[idx]
+        .set(in_cap, mode="drop")
+        .reshape(n_shards, bucket_cap)
+    )
+    return out, vbuf, overflow
+
+
+class ShardedHashAgg(Executor):
+    """Mesh-parallel HashAgg with on-device hash exchange.
+
+    The executor owns stacked (n_shards, capacity) state sharded over
+    ``mesh``; ``apply`` expects stacked (n_shards, chunk_cap) input
+    chunks (each shard's source slice — e.g. one Nexmark split per
+    shard); flush returns host-side StreamChunks.
+
+    Capacity is per-shard. Resize is not yet wired for the sharded
+    path (the single-chip executor grows; here size generously).
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        group_keys: Sequence[str],
+        calls: Sequence[AggCall],
+        schema_dtypes: Dict[str, object],
+        capacity: int = 1 << 16,
+        out_cap: int = 1 << 14,
+        bucket_cap: Optional[int] = None,
+        chunk_cap: Optional[int] = None,
+    ):
+        self.mesh = mesh
+        self.axis = mesh.axis_names[0]
+        self.n_shards = mesh.devices.size
+        self.group_keys = tuple(group_keys)
+        self.calls = tuple(calls)
+        self.nullable = tuple(False for _ in self.group_keys)
+        self.out_cap = out_cap
+        self._dtypes = dict(schema_dtypes)
+        self._float_extremes = agg_ops.float_extreme_meta(
+            self.calls, {k: jnp.dtype(v) for k, v in self._dtypes.items()}
+        )
+        self.bucket_cap = bucket_cap
+
+        key_dtypes = tuple(jnp.dtype(self._dtypes[k]) for k in self.group_keys)
+        table1 = HashTable.create(capacity, key_dtypes)
+        state1 = agg_ops.create_state(capacity, self.calls, self._dtypes)
+
+        def stack(a):
+            return jnp.broadcast_to(a[None], (self.n_shards,) + a.shape)
+
+        shard0 = NamedSharding(mesh, P(self.axis))
+        self.table = jax.device_put(jax.tree.map(stack, table1), shard0)
+        self.state = jax.device_put(jax.tree.map(stack, state1), shard0)
+        self.dropped = jax.device_put(
+            jnp.zeros(self.n_shards, jnp.bool_), shard0
+        )
+        self._step = None  # built lazily (needs bucket_cap from chunk)
+
+    # -- the sharded step -------------------------------------------------
+    def _build_step(self, chunk_cap: int):
+        n_shards = self.n_shards
+        bucket_cap = self.bucket_cap or max(64, (2 * chunk_cap) // n_shards)
+        calls, group_keys, nullable = self.calls, self.group_keys, self.nullable
+        axis = self.axis
+
+        def local_step(table, state, dropped, chunk: StreamChunk):
+            # shard_map gives each shard its (1, ...) slice; drop the axis
+            table = jax.tree.map(lambda a: a[0], table)
+            state = jax.tree.map(lambda a: a[0], state)
+            dropped = dropped[0]
+            chunk = jax.tree.map(lambda a: a[0], chunk)
+
+            # 1) destination shard per row (vnode of group key)
+            keys = _build_key_lanes(chunk, group_keys, nullable)
+            dest = _dest_shard(keys, n_shards)
+
+            # 2) pack per-destination buckets (ops folded into a column)
+            cols = dict(chunk.columns)
+            cols["__ops__"] = chunk.ops
+            bufs, vbuf, overflow = _pack_buckets(
+                cols, chunk.valid, dest, n_shards, bucket_cap
+            )
+
+            # 3) the ICI shuffle: every shard sends bucket d to shard d
+            ex = {
+                n: jax.lax.all_to_all(b, axis, 0, 0, tiled=False)
+                for n, b in bufs.items()
+            }
+            exv = jax.lax.all_to_all(vbuf, axis, 0, 0, tiled=False)
+
+            # 4) local agg over the received rows
+            flatten = lambda a: a.reshape(n_shards * bucket_cap)
+            rchunk = StreamChunk(
+                columns={
+                    n: flatten(b) for n, b in ex.items() if n != "__ops__"
+                },
+                valid=flatten(exv),
+                nulls={},
+                ops=flatten(ex["__ops__"]),
+            )
+            rkeys = _build_key_lanes(rchunk, group_keys, nullable)
+            table, slots, _, _ = lookup_or_insert(table, rkeys, rchunk.valid)
+            signs = rchunk.effective_signs()
+            dropped = (
+                dropped
+                | overflow
+                | jnp.any(rchunk.valid & (slots < 0))
+            )
+            values = {
+                c.input: rchunk.col(c.input) for c in calls if c.input is not None
+            }
+            state = agg_ops.apply(state, calls, slots, signs, values, {})
+            table = set_live(table, slots, state.row_count[slots] > 0)
+
+            expand = lambda a: a[None]
+            return (
+                jax.tree.map(expand, table),
+                jax.tree.map(expand, state),
+                dropped[None],
+            )
+
+        spec = P(self.axis)
+        shmapped = jax.shard_map(
+            local_step,
+            mesh=self.mesh,
+            in_specs=(spec, spec, spec, spec),
+            out_specs=(spec, spec, spec),
+            check_vma=False,
+        )
+        return jax.jit(shmapped, donate_argnums=(0, 1))
+
+    def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
+        """``chunk`` must be stacked: every array (n_shards, chunk_cap),
+        sharded or shardable over the mesh axis."""
+        cap = chunk.capacity  # leading dim = n_shards; capacity property
+        if self._step is None:
+            self._step = self._build_step(chunk.valid.shape[-1])
+        self.table, self.state, self.dropped = self._step(
+            self.table, self.state, self.dropped, chunk
+        )
+        return []
+
+    # -- barrier flush ----------------------------------------------------
+    def _build_flush(self):
+        out_cap, fx = self.out_cap, self._float_extremes
+
+        def local_flush(state, table_keys):
+            state = jax.tree.map(lambda a: a[0], state)
+            table_keys = jax.tree.map(lambda a: a[0], table_keys)
+            state, delta = agg_ops.flush(state, table_keys, out_cap, fx)
+            expand = lambda a: a[None]
+            return jax.tree.map(expand, state), jax.tree.map(expand, delta)
+
+        spec = P(self.axis)
+        return jax.jit(
+            jax.shard_map(
+                local_flush,
+                mesh=self.mesh,
+                in_specs=(spec, spec),
+                out_specs=(spec, spec),
+                check_vma=False,
+            )
+        )
+
+    def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
+        if bool(jnp.any(self.dropped)):
+            raise RuntimeError(
+                "sharded agg overflowed (bucket or probe); grow capacities"
+            )
+        if not hasattr(self, "_flush"):
+            self._flush = self._build_flush()
+        outs: List[StreamChunk] = []
+        for _ in range(64):  # overflow loop bound
+            self.state, delta = self._flush(self.state, self.table.keys)
+            outs.append(self._delta_to_chunk(delta))
+            if not bool(jnp.any(delta["overflow"])):
+                break
+        return outs
+
+    def _delta_to_chunk(self, delta) -> StreamChunk:
+        """Stacked (n_shards, 2*out_cap) delta -> one flat StreamChunk."""
+        flat = lambda a: np.asarray(a).reshape(-1)
+        cols = {}
+        for i, name in enumerate(self.group_keys):
+            cols[name] = flat(delta[f"key{i}"])
+        nulls = {}
+        for c in self.calls:
+            cols[c.output] = flat(delta[c.output])
+            lane = delta.get(c.output + "__isnull")
+            if lane is not None:
+                nulls[c.output] = flat(lane)
+        return StreamChunk(
+            columns={k: jnp.asarray(v) for k, v in cols.items()},
+            valid=jnp.asarray(flat(delta["valid"])),
+            nulls={k: jnp.asarray(v) for k, v in nulls.items()},
+            ops=jnp.asarray(flat(delta["ops"])),
+        )
+
+
+def stack_chunks(chunks: Sequence[StreamChunk]) -> StreamChunk:
+    """Stack per-shard chunks (same capacity/columns) into one stacked
+    chunk with a leading shard axis — the input format ShardedHashAgg
+    expects (each shard = one source split)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *chunks)
