@@ -118,13 +118,14 @@ class ShardedHashAgg(Executor):
         out_cap: int = 1 << 14,
         bucket_cap: Optional[int] = None,
         chunk_cap: Optional[int] = None,
+        nullable_keys: Sequence[str] = (),
     ):
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
         self.n_shards = mesh.devices.size
         self.group_keys = tuple(group_keys)
         self.calls = tuple(calls)
-        self.nullable = tuple(False for _ in self.group_keys)
+        self.nullable = tuple(k in set(nullable_keys) for k in self.group_keys)
         self.out_cap = out_cap
         self._dtypes = dict(schema_dtypes)
         self._float_extremes = agg_ops.float_extreme_meta(
@@ -132,7 +133,11 @@ class ShardedHashAgg(Executor):
         )
         self.bucket_cap = bucket_cap
 
-        key_dtypes = tuple(jnp.dtype(self._dtypes[k]) for k in self.group_keys)
+        key_dtypes = []
+        for k, nb in zip(self.group_keys, self.nullable):
+            key_dtypes.append(jnp.dtype(self._dtypes[k]))
+            if nb:
+                key_dtypes.append(jnp.dtype(jnp.bool_))
         table1 = HashTable.create(capacity, key_dtypes)
         state1 = agg_ops.create_state(capacity, self.calls, self._dtypes)
 
@@ -165,9 +170,12 @@ class ShardedHashAgg(Executor):
             keys = _build_key_lanes(chunk, group_keys, nullable)
             dest = _dest_shard(keys, n_shards)
 
-            # 2) pack per-destination buckets (ops folded into a column)
+            # 2) pack per-destination buckets (ops and null lanes folded in
+            #    as extra columns so they ride the same exchange)
             cols = dict(chunk.columns)
             cols["__ops__"] = chunk.ops
+            for name, lane in chunk.nulls.items():
+                cols["__null__" + name] = lane
             bufs, vbuf, overflow = _pack_buckets(
                 cols, chunk.valid, dest, n_shards, bucket_cap
             )
@@ -183,10 +191,16 @@ class ShardedHashAgg(Executor):
             flatten = lambda a: a.reshape(n_shards * bucket_cap)
             rchunk = StreamChunk(
                 columns={
-                    n: flatten(b) for n, b in ex.items() if n != "__ops__"
+                    n: flatten(b)
+                    for n, b in ex.items()
+                    if n != "__ops__" and not n.startswith("__null__")
                 },
                 valid=flatten(exv),
-                nulls={},
+                nulls={
+                    n[len("__null__"):]: flatten(b)
+                    for n, b in ex.items()
+                    if n.startswith("__null__")
+                },
                 ops=flatten(ex["__ops__"]),
             )
             rkeys = _build_key_lanes(rchunk, group_keys, nullable)
@@ -200,7 +214,12 @@ class ShardedHashAgg(Executor):
             values = {
                 c.input: rchunk.col(c.input) for c in calls if c.input is not None
             }
-            state = agg_ops.apply(state, calls, slots, signs, values, {})
+            in_nulls = {
+                c.input: rchunk.nulls[c.input]
+                for c in calls
+                if c.input is not None and c.input in rchunk.nulls
+            }
+            state = agg_ops.apply(state, calls, slots, signs, values, in_nulls)
             table = set_live(table, slots, state.row_count[slots] > 0)
 
             expand = lambda a: a[None]
@@ -223,7 +242,12 @@ class ShardedHashAgg(Executor):
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         """``chunk`` must be stacked: every array (n_shards, chunk_cap),
         sharded or shardable over the mesh axis."""
-        cap = chunk.capacity  # leading dim = n_shards; capacity property
+        for k, nb in zip(self.group_keys, self.nullable):
+            if not nb and k in chunk.nulls:
+                raise ValueError(
+                    f"group key {k!r} carries a null lane but was not "
+                    "declared in nullable_keys"
+                )
         if self._step is None:
             self._step = self._build_step(chunk.valid.shape[-1])
         self.table, self.state, self.dropped = self._step(
@@ -261,7 +285,7 @@ class ShardedHashAgg(Executor):
         if not hasattr(self, "_flush"):
             self._flush = self._build_flush()
         outs: List[StreamChunk] = []
-        for _ in range(64):  # overflow loop bound
+        while True:
             self.state, delta = self._flush(self.state, self.table.keys)
             outs.append(self._delta_to_chunk(delta))
             if not bool(jnp.any(delta["overflow"])):
@@ -271,10 +295,14 @@ class ShardedHashAgg(Executor):
     def _delta_to_chunk(self, delta) -> StreamChunk:
         """Stacked (n_shards, 2*out_cap) delta -> one flat StreamChunk."""
         flat = lambda a: np.asarray(a).reshape(-1)
-        cols = {}
-        for i, name in enumerate(self.group_keys):
+        cols, nulls = {}, {}
+        i = 0
+        for name, nb in zip(self.group_keys, self.nullable):
             cols[name] = flat(delta[f"key{i}"])
-        nulls = {}
+            i += 1
+            if nb:
+                nulls[name] = flat(delta[f"key{i}"])
+                i += 1
         for c in self.calls:
             cols[c.output] = flat(delta[c.output])
             lane = delta.get(c.output + "__isnull")

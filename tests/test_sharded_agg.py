@@ -5,7 +5,6 @@ dispatch.rs:683; multi-node testing via simulation, SURVEY.md §4)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pandas as pd
 import pytest
 
 from risingwave_tpu.array.chunk import StreamChunk
@@ -110,3 +109,40 @@ def test_sharded_agg_state_is_actually_sharded(mesh):
         snap = _mv_replay(snap, out)
     assert {k[0] for k in snap} == set(range(64))
     assert all(v == (N_SHARDS,) for v in snap.values())  # 8 rows per key
+
+def test_sharded_agg_null_inputs_match_single_chip(mesh):
+    """NULL lanes must ride the exchange: SUM/COUNT skip NULL inputs
+    identically on the sharded and single-chip paths (hash_agg.rs:326
+    apply_chunk NULL semantics)."""
+    calls = (
+        AggCall("count", "price", "cnt"),
+        AggCall("sum", "price", "total"),
+    )
+    dtypes = {"k": jnp.int64, "price": jnp.int64}
+    sharded = ShardedHashAgg(
+        mesh, ("k",), calls, dtypes, capacity=1 << 10, out_cap=1 << 9
+    )
+    single = HashAggExecutor(
+        ("k",), calls, dtypes, capacity=1 << 12, out_cap=1 << 10
+    )
+
+    rng = np.random.default_rng(7)
+    per_shard = []
+    for s in range(N_SHARDS):
+        k = rng.integers(0, 40, 128).astype(np.int64)
+        price = rng.integers(1, 1000, 128).astype(np.int64)
+        isnull = rng.random(128) < 0.3
+        chunk = StreamChunk.from_numpy(
+            {"k": k, "price": price}, 128, nulls={"price": isnull}
+        )
+        per_shard.append(chunk)
+        single.apply(chunk)
+    sharded.apply(stack_chunks(per_shard))
+
+    snap_sharded, snap_single = {}, {}
+    for out in sharded.on_barrier(None):
+        snap_sharded = _mv_replay(snap_sharded, out)
+    for out in single.on_barrier(None):
+        snap_single = _mv_replay(snap_single, out)
+    assert len(snap_single) > 0
+    assert snap_sharded == snap_single
