@@ -15,6 +15,9 @@ from risingwave_tpu.executors.filter import FilterExecutor
 from risingwave_tpu.executors.project import ProjectExecutor
 from risingwave_tpu.executors.hop_window import HopWindowExecutor
 from risingwave_tpu.executors.hash_agg import HashAggExecutor
+from risingwave_tpu.executors.dedup import AppendOnlyDedupExecutor
+from risingwave_tpu.executors.dynamic_filter import DynamicMaxFilterExecutor
+from risingwave_tpu.executors.hash_join import HashJoinExecutor
 from risingwave_tpu.executors.materialize import MaterializeExecutor
 
 __all__ = [
@@ -25,5 +28,8 @@ __all__ = [
     "ProjectExecutor",
     "HopWindowExecutor",
     "HashAggExecutor",
+    "AppendOnlyDedupExecutor",
+    "DynamicMaxFilterExecutor",
+    "HashJoinExecutor",
     "MaterializeExecutor",
 ]

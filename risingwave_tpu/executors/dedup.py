@@ -1,0 +1,130 @@
+"""Append-only dedup executor — streaming DISTINCT on a key.
+
+Reference: src/stream/src/executor/dedup/append_only_dedup.rs — emits
+each pk's FIRST row and drops later duplicates; state is the set of
+seen pks, cleaned by watermark.
+
+TPU re-design: the seen-set is ops/hash_table.HashTable; one jitted
+step does batched lookup-or-insert and emits rows that claimed a new
+slot (intra-chunk twins dedupe via first_occurrence_mask). Append-only
+by contract: a DELETE in the input latches ``inconsistent`` and raises
+at the barrier, like the reference's append-only executors.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.executors.base import Barrier, Executor, Watermark
+from risingwave_tpu.ops.hash_table import (
+    HashTable,
+    first_occurrence_mask,
+    lookup_or_insert,
+    plan_rehash,
+    set_live,
+)
+
+GROW_AT = 0.5
+
+
+@partial(jax.jit, static_argnames=("keys",), donate_argnums=(0,))
+def _dedup_step(table: HashTable, chunk: StreamChunk, keys: Tuple[str, ...]):
+    key_cols = tuple(chunk.col(k) for k in keys)
+    signs = chunk.effective_signs()
+    saw_delete = jnp.any(chunk.valid & (signs < 0))
+    valid = chunk.valid & (signs > 0)
+    table, slots, _, inserted = lookup_or_insert(table, key_cols, valid)
+    table = set_live(table, jnp.where(inserted, slots, -1), True)
+    dropped = jnp.any(valid & (slots < 0))
+    # `inserted` marks a claim's winner AND its same-key twins; keep one
+    emit = inserted & first_occurrence_mask(slots, inserted)
+    return table, chunk.mask(emit), saw_delete, dropped
+
+
+@partial(jax.jit, static_argnames=("new_cap",))
+def _rebuild(table: HashTable, new_cap: int) -> HashTable:
+    keep = table.live
+    new = HashTable.create(new_cap, tuple(k.dtype for k in table.keys))
+    new, slots, _, _ = lookup_or_insert(new, table.keys, keep)
+    return set_live(new, jnp.where(keep, slots, -1), True)
+
+
+class AppendOnlyDedupExecutor(Executor):
+    """DISTINCT ON (keys): first row per key passes, duplicates drop.
+
+    ``window_key``: optional (column, retention_ms) — a watermark on
+    that key column marks seen-set entries below ``wm - retention``
+    dead; the next table rebuild reclaims them (until then late
+    duplicates stay suppressed — strictly more exact than the
+    reference's cache eviction, never less).
+    """
+
+    def __init__(
+        self,
+        keys: Sequence[str],
+        schema_dtypes: Dict[str, object],
+        capacity: int = 1 << 16,
+        window_key: Optional[Tuple[str, int]] = None,
+    ):
+        self.keys = tuple(keys)
+        self.table = HashTable.create(
+            capacity, tuple(jnp.dtype(schema_dtypes[k]) for k in self.keys)
+        )
+        self.window_key = window_key
+        self._bound = 0
+        self._saw_delete = jnp.zeros((), jnp.bool_)
+        self._dropped = jnp.zeros((), jnp.bool_)
+
+    def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
+        for k in self.keys:
+            if k in chunk.nulls:
+                raise ValueError(
+                    f"dedup key {k!r} carries a null lane (unsupported)"
+                )
+        self._maybe_grow(chunk.capacity)
+        self._bound += chunk.capacity
+        self.table, out, saw_delete, dropped = _dedup_step(
+            self.table, chunk, self.keys
+        )
+        self._saw_delete = self._saw_delete | saw_delete
+        self._dropped = self._dropped | dropped
+        return [out]
+
+    def _maybe_grow(self, incoming: int):
+        cap = self.table.capacity
+        if self._bound + incoming <= cap * GROW_AT:
+            return
+        claimed = int(self.table.occupancy())
+        new_cap = plan_rehash(
+            cap, incoming, claimed, int(self.table.num_live()), GROW_AT
+        )
+        if new_cap is not None:
+            self.table = _rebuild(self.table, new_cap)
+            claimed = int(self.table.occupancy())
+        self._bound = claimed
+
+    def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
+        if bool(self._saw_delete):
+            raise RuntimeError("append-only dedup received a DELETE")
+        if bool(self._dropped):
+            raise RuntimeError("dedup table overflowed MAX_PROBE; grow capacity")
+        return []
+
+    def on_watermark(self, watermark: Watermark):
+        if self.window_key is None or watermark.column != self.window_key[0]:
+            return watermark, []
+        cutoff = jnp.asarray(
+            watermark.value - self.window_key[1], jnp.int64
+        )
+        lane = self.table.keys[self.keys.index(self.window_key[0])]
+        expired = self.table.live & (lane < cutoff)
+        slots = jnp.where(
+            expired, jnp.arange(self.table.capacity, dtype=jnp.int32), -1
+        )
+        self.table = set_live(self.table, slots, False)
+        return watermark, []

@@ -33,7 +33,12 @@ from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Barrier, Executor, Watermark
 from risingwave_tpu.ops import agg as agg_ops
 from risingwave_tpu.ops.agg import AggCall, AggState
-from risingwave_tpu.ops.hash_table import HashTable, lookup_or_insert, set_live
+from risingwave_tpu.ops.hash_table import (
+    HashTable,
+    lookup_or_insert,
+    plan_rehash,
+    set_live,
+)
 
 GROW_AT = 0.5  # rehash when claimed slots may exceed this load factor
 
@@ -237,22 +242,17 @@ class HashAggExecutor(Executor):
         # refresh the bound with the true claimed count (one device read,
         # off the hot path) before deciding to pay for a rebuild
         claimed = int(self.table.occupancy())
-        if claimed + incoming > cap * GROW_AT:
-            # size the new table from what SURVIVES the rebuild, not from
-            # pre-rebuild occupancy: steady-state windowed workloads churn
-            # tombstones, and sizing by `claimed` would double capacity on
-            # every compaction forever (code-review r2). new_cap == cap is
-            # a pure tombstone compaction.
-            keep = int(
-                jnp.sum(
-                    (
-                        self.table.live | self.state.emitted_valid | self.state.dirty
-                    ).astype(jnp.int32)
-                )
+        # survivors = what the rebuild keeps (live | emitted | dirty),
+        # not pre-rebuild occupancy — see plan_rehash
+        keep = int(
+            jnp.sum(
+                (
+                    self.table.live | self.state.emitted_valid | self.state.dirty
+                ).astype(jnp.int32)
             )
-            new_cap = cap
-            while keep + incoming > new_cap * GROW_AT:
-                new_cap *= 2
+        )
+        new_cap = plan_rehash(cap, incoming, claimed, keep, GROW_AT)
+        if new_cap is not None:
             self.table, self.state = _rehash(
                 self.table, self.state, self.calls, new_cap
             )

@@ -1,0 +1,287 @@
+"""HashJoin executor — streaming two-sided equi-join with retraction.
+
+Reference: src/stream/src/executor/hash_join.rs:129 (3,252 LoC) +
+executor/join/hash_join.rs:157 (JoinHashMap). Semantics matched (inner
+join):
+- each arriving chunk updates its own side's multiset state and probes
+  the other side, emitting one output row per (probe row, stored match)
+  with the probe row's sign (execute_inner / hash_eq_match,
+  hash_join.rs:462-729);
+- barrier-aligned two-input operator: the runtime feeds chunks in
+  arrival order via ``apply_left`` / ``apply_right`` and calls
+  ``on_barrier`` once both inputs hit the barrier (barrier_align.rs);
+- watermark on the window column cleans closed-window state on both
+  sides (state cleaning via table watermarks, state_table.rs:1133).
+
+TPU re-design: no per-key Vec + LRU cache — each side is a JoinSide
+(ops/join.py): a device hash table over the join key plus fixed-fanout
+row buckets, so one chunk's insert+delete+probe+emit runs as one fused
+jitted program per side. Output pairs are compacted into fixed
+``out_cap`` chunks (static shapes; overflow latches and raises at the
+barrier, the capacity-growth contract shared with HashAgg).
+
+Inner join needs no degree state; LEFT/RIGHT/FULL outer variants add a
+degree lane to the same bucket layout when they land (degree table,
+join/hash_join.rs).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.executors.base import Barrier, Executor, Watermark
+from risingwave_tpu.ops.hash_table import plan_rehash
+from risingwave_tpu.ops.join import (
+    JoinSide,
+    apply_side,
+    compact_pairs,
+    expire_keys,
+    gather_matches,
+    probe_side,
+    regrow,
+)
+from risingwave_tpu.types import Op
+
+GROW_AT = 0.5
+
+
+@partial(
+    jax.jit,
+    static_argnames=("own_keys", "other_keys", "own_names", "other_names", "out_cap"),
+    donate_argnums=(0,),
+)
+def _join_step(
+    own: JoinSide,
+    other: JoinSide,
+    chunk: StreamChunk,
+    own_keys: Tuple[str, ...],
+    other_keys: Tuple[str, ...],
+    own_names: Tuple[str, ...],
+    other_names: Tuple[str, ...],
+    out_cap: int,
+):
+    """One chunk through its own side + probe of the other side.
+
+    Returns (own', out_cols, out_nulls, out_ops, out_valid, overflow).
+    """
+    key_cols = tuple(chunk.col(k) for k in own_keys)
+    # SQL equi-join: NULL keys match nothing and need no state
+    key_ok = jnp.ones(chunk.capacity, jnp.bool_)
+    for k in own_keys:
+        lane = chunk.nulls.get(k)
+        if lane is not None:
+            key_ok &= ~lane
+    valid = chunk.valid & key_ok
+    signs = chunk.effective_signs()
+
+    # probe the other side (read-only) and stage the emission
+    sl, match = probe_side(other, key_cols, valid & (signs != 0))
+    o_cols, o_nulls = gather_matches(other, sl, other_names)
+
+    n, fanout = match.shape
+    flat = lambda a: a.reshape(n * fanout)
+    bcast = lambda a: jnp.broadcast_to(a[:, None], (n, fanout))
+
+    flat_cols = {name: flat(bcast(chunk.col(name))) for name in own_names}
+    flat_cols.update({name: flat(o_cols[name]) for name in other_names})
+    flat_nulls = {
+        name: flat(bcast(lane))
+        for name, lane in chunk.nulls.items()
+        if name in own_names
+    }
+    flat_nulls.update({name: flat(lane) for name, lane in o_nulls.items()})
+    flat_ops = flat(
+        bcast(
+            jnp.where(
+                signs > 0,
+                jnp.int32(Op.INSERT),
+                jnp.int32(Op.DELETE),
+            )
+        )
+    )
+    out_cols, out_nulls, out_ops, out_valid, em_overflow = compact_pairs(
+        flat_cols, flat_nulls, flat_ops, flat(match), out_cap
+    )
+
+    # then fold the chunk into our own state
+    payload = {name: chunk.col(name) for name in own_names}
+    pnulls = {
+        name: lane for name, lane in chunk.nulls.items() if name in own_names
+    }
+    own = apply_side(own, key_cols, payload, pnulls, valid, signs, own_names)
+    return own, out_cols, out_nulls, out_ops, out_valid, em_overflow
+
+
+class HashJoinExecutor(Executor):
+    """Streaming INNER equi-join.
+
+    Args:
+      left_keys / right_keys: equi-join column names, positionally
+        paired; dtypes of each pair must match (the hash is computed on
+        raw lanes).
+      left_dtypes / right_dtypes: column name -> dtype per side; ALL
+        listed columns are stored as state and emitted. Names across the
+        two sides must be disjoint (rename upstream).
+      capacity: per-side key-table capacity (grows 2x at 50% load).
+      fanout: per-key stored-row bound (grows 2x when exceeded... at
+        the next barrier's raise; size for the workload's key skew).
+      out_cap: per-chunk emission capacity.
+      left_nullable / right_nullable: nullable payload columns.
+      window_cols: optional (left_col, right_col) event-window lanes —
+        a watermark on either clears state of both sides below it.
+    """
+
+    def __init__(
+        self,
+        left_keys: Sequence[str],
+        right_keys: Sequence[str],
+        left_dtypes: Dict[str, object],
+        right_dtypes: Dict[str, object],
+        capacity: int = 1 << 15,
+        fanout: int = 16,
+        out_cap: int = 1 << 14,
+        left_nullable: Sequence[str] = (),
+        right_nullable: Sequence[str] = (),
+        window_cols: Optional[Tuple[str, str]] = None,
+    ):
+        if set(left_dtypes) & set(right_dtypes):
+            raise ValueError(
+                f"overlapping output columns: {set(left_dtypes) & set(right_dtypes)}"
+            )
+        self.left_keys = tuple(left_keys)
+        self.right_keys = tuple(right_keys)
+        self.left_names = tuple(sorted(left_dtypes))
+        self.right_names = tuple(sorted(right_dtypes))
+        self.out_cap = out_cap
+        self.window_cols = window_cols
+
+        lk_dtypes = tuple(jnp.dtype(left_dtypes[k]) for k in self.left_keys)
+        rk_dtypes = tuple(jnp.dtype(right_dtypes[k]) for k in self.right_keys)
+        if lk_dtypes != rk_dtypes:
+            raise ValueError(f"join key dtype mismatch: {lk_dtypes} vs {rk_dtypes}")
+
+        self.left = JoinSide.create(
+            capacity,
+            fanout,
+            lk_dtypes,
+            {n: jnp.dtype(left_dtypes[n]) for n in self.left_names},
+            nullable=left_nullable,
+        )
+        self.right = JoinSide.create(
+            capacity,
+            fanout,
+            rk_dtypes,
+            {n: jnp.dtype(right_dtypes[n]) for n in self.right_names},
+            nullable=right_nullable,
+        )
+        self._bound = {"l": 0, "r": 0}
+        self._em_overflow = jnp.zeros((), jnp.bool_)
+        self._wm = {"l": None, "r": None, "out": None}
+
+    # -- data ------------------------------------------------------------
+    def apply_left(self, chunk: StreamChunk) -> List[StreamChunk]:
+        return self._apply("l", chunk)
+
+    def apply_right(self, chunk: StreamChunk) -> List[StreamChunk]:
+        return self._apply("r", chunk)
+
+    def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
+        raise TypeError("HashJoin is two-input: use apply_left/apply_right")
+
+    def _apply(self, side: str, chunk: StreamChunk) -> List[StreamChunk]:
+        own = self.left if side == "l" else self.right
+        own = self._maybe_grow(side, own, chunk.capacity)
+        other = self.right if side == "l" else self.left
+        own_keys = self.left_keys if side == "l" else self.right_keys
+        other_keys = self.right_keys if side == "l" else self.left_keys
+        own_names = self.left_names if side == "l" else self.right_names
+        other_names = self.right_names if side == "l" else self.left_names
+
+        own, cols, nulls, ops, valid, em_overflow = _join_step(
+            own,
+            other,
+            chunk,
+            own_keys,
+            other_keys,
+            own_names,
+            other_names,
+            self.out_cap,
+        )
+        if side == "l":
+            self.left = own
+        else:
+            self.right = own
+        self._bound[side] += chunk.capacity
+        # latch on device; checked once per barrier (a bool() here would
+        # force a host sync on every chunk and stall the pipeline)
+        self._em_overflow = self._em_overflow | em_overflow
+        return [StreamChunk(columns=cols, valid=valid, nulls=nulls, ops=ops)]
+
+    def _maybe_grow(self, side: str, own: JoinSide, incoming: int) -> JoinSide:
+        cap = own.capacity
+        if self._bound[side] + incoming <= cap * GROW_AT:
+            return own
+        claimed = int(own.table.occupancy())
+        new_cap = plan_rehash(
+            cap, incoming, claimed, int(own.table.num_live()), GROW_AT
+        )
+        if new_cap is not None:
+            own = regrow(own, new_cap, own.fanout)
+            claimed = int(own.table.occupancy())
+        self._bound[side] = claimed
+        return own
+
+    # -- control ---------------------------------------------------------
+    def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
+        if bool(self._em_overflow):
+            raise RuntimeError(
+                "join emission overflowed out_cap within one chunk; "
+                "raise out_cap or shrink source chunks"
+            )
+        for name, side in (("left", self.left), ("right", self.right)):
+            if bool(side.overflow):
+                raise RuntimeError(
+                    f"{name} join side overflowed (bucket fanout or probe "
+                    "chain); grow fanout/capacity"
+                )
+            if bool(side.inconsistent):
+                raise RuntimeError(
+                    f"{name} join side saw a DELETE matching no stored row "
+                    "(inconsistent input stream)"
+                )
+        return []
+
+    def on_watermark(self, watermark: Watermark):
+        """Expire the matching side's closed windows; emit a downstream
+        watermark on the LEFT window column once BOTH sides passed a new
+        minimum (the reference's per-input watermark alignment on
+        joins: output wm = min over inputs)."""
+        if self.window_cols is None or watermark.column not in self.window_cols:
+            return watermark, []
+        cutoff = jnp.asarray(watermark.value, jnp.int64)
+        if watermark.column == self.window_cols[0]:
+            self.left = expire_keys(
+                self.left, self._key_index("l", self.window_cols[0]), cutoff
+            )
+            self._wm["l"] = watermark.value
+        else:
+            self.right = expire_keys(
+                self.right, self._key_index("r", self.window_cols[1]), cutoff
+            )
+            self._wm["r"] = watermark.value
+        if self._wm["l"] is None or self._wm["r"] is None:
+            return None, []
+        aligned = min(self._wm["l"], self._wm["r"])
+        if self._wm["out"] is not None and aligned <= self._wm["out"]:
+            return None, []
+        self._wm["out"] = aligned
+        return Watermark(self.window_cols[0], aligned), []
+
+    def _key_index(self, side: str, name: str) -> int:
+        keys = self.left_keys if side == "l" else self.right_keys
+        return keys.index(name)

@@ -249,6 +249,27 @@ def set_live(table: HashTable, slots: jnp.ndarray, live_value: jnp.ndarray) -> H
     return HashTable(table.fp1, table.fp2, table.keys, new_live)
 
 
+def plan_rehash(
+    cap: int, incoming: int, claimed: int, survivors: int, grow_at: float = 0.5
+):
+    """The shared growth policy behind every host-side ``_maybe_grow``
+    (HashAgg / Dedup / HashJoin sides): given true occupancy, decide
+    whether to rebuild and at what capacity.
+
+    Returns None (no rebuild: the next chunk still fits under the load
+    factor) or the new capacity — sized from ``survivors`` (what the
+    rebuild will actually keep), NOT from pre-rebuild occupancy, so
+    steady-state tombstone churn compacts in place instead of doubling
+    forever. ``new_cap == cap`` is a pure tombstone compaction.
+    """
+    if claimed + incoming <= cap * grow_at:
+        return None
+    new_cap = cap
+    while survivors + incoming > new_cap * grow_at:
+        new_cap *= 2
+    return new_cap
+
+
 def first_occurrence_mask(slots: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
     """True for the first valid row of each distinct slot in the batch.
 

@@ -1,0 +1,399 @@
+"""Two-sided streaming join state kernel — the core of HashJoin.
+
+Reference roles replaced:
+- ``JoinHashMap`` — per-key row lists with cached entry state
+  (src/stream/src/executor/join/hash_join.rs:157);
+- the per-row probe/emit loop of ``hash_eq_match`` / ``execute_inner``
+  (src/stream/src/executor/hash_join.rs:462-729).
+
+The reference keeps, per join key, a heap ``Vec`` of rows (plus degree
+counters) behind an LRU cache over a state table. On TPU the state must
+be a flat array program, so a join side is TWO levels of static arrays:
+
+    key table  : ops/hash_table.HashTable over the join-key lanes —
+                 maps a key to a slot s in [0, capacity)
+    row buckets: per payload column, a (capacity, fanout) array;
+                 bucket s holds every live row whose key owns slot s,
+                 with a (capacity, fanout) ``row_valid`` mask
+
+Insert scatters each row into the first free bucket position; delete
+finds the matching stored row (exact multi-column equality, NULL==NULL)
+and clears it; probe gathers the *other* side's whole bucket per probe
+row — a (chunk, fanout) gather — and emits one output pair per live
+match. All three are batched over the chunk with no host round trips,
+and intra-chunk collisions (two rows of one key in one chunk) are
+resolved by an O(n log n) intra-chunk rank, not a serial loop.
+
+Fanout is the static per-key row bound (the reference's Vec grows on
+the heap; we latch ``overflow`` and the host executor rebuilds with a
+doubled fanout — same contract as hash-table growth). Inner joins need
+no degree state; degrees for outer joins ride the same bucket layout as
+an extra int lane when those join types land.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from risingwave_tpu.ops.hash_table import (
+    HashTable,
+    lookup,
+    lookup_or_insert,
+    set_live,
+)
+from risingwave_tpu.ops.hashing import hash128
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class JoinSide:
+    """One side's state: key table + row buckets (see module doc).
+
+    ``rows``/``row_nulls`` map payload column name -> (capacity, fanout)
+    arrays; ``row_valid`` marks occupied bucket entries. ``overflow``
+    latches bucket exhaustion; ``inconsistent`` latches a delete that
+    matched no stored row (the reference's consistency sanity check,
+    src/stream/src/executor/mod.rs update_check wrapper).
+    """
+
+    table: HashTable
+    rows: Dict[str, jnp.ndarray]
+    row_nulls: Dict[str, jnp.ndarray]
+    row_valid: jnp.ndarray
+    overflow: jnp.ndarray  # () bool
+    inconsistent: jnp.ndarray  # () bool
+
+    def tree_flatten(self):
+        names = tuple(sorted(self.rows))
+        null_names = tuple(sorted(self.row_nulls))
+        children = (
+            self.table,
+            tuple(self.rows[n] for n in names),
+            tuple(self.row_nulls[n] for n in null_names),
+            self.row_valid,
+            self.overflow,
+            self.inconsistent,
+        )
+        return children, (names, null_names)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        names, null_names = aux
+        table, rows, nulls, row_valid, overflow, inconsistent = children
+        return cls(
+            table=table,
+            rows=dict(zip(names, rows)),
+            row_nulls=dict(zip(null_names, nulls)),
+            row_valid=row_valid,
+            overflow=overflow,
+            inconsistent=inconsistent,
+        )
+
+    @property
+    def capacity(self) -> int:
+        return self.row_valid.shape[0]
+
+    @property
+    def fanout(self) -> int:
+        return self.row_valid.shape[1]
+
+    @staticmethod
+    def create(
+        capacity: int,
+        fanout: int,
+        key_dtypes: Sequence[jnp.dtype],
+        payload_dtypes: Dict[str, jnp.dtype],
+        nullable: Sequence[str] = (),
+    ) -> "JoinSide":
+        return JoinSide(
+            table=HashTable.create(capacity, key_dtypes),
+            rows={
+                n: jnp.zeros((capacity, fanout), d)
+                for n, d in payload_dtypes.items()
+            },
+            row_nulls={
+                n: jnp.zeros((capacity, fanout), jnp.bool_) for n in nullable
+            },
+            row_valid=jnp.zeros((capacity, fanout), jnp.bool_),
+            overflow=jnp.zeros((), jnp.bool_),
+            inconsistent=jnp.zeros((), jnp.bool_),
+        )
+
+
+def _intra_chunk_rank(
+    slots: jnp.ndarray, h1: jnp.ndarray, h2: jnp.ndarray, m: jnp.ndarray
+) -> jnp.ndarray:
+    """rank[i] = #earlier masked rows with the same (slot, h1, h2).
+
+    Insert ranking passes constant h1/h2 (group by SLOT alone: every
+    insert into a bucket needs a distinct free position, whatever its
+    content); delete ranking passes the row fingerprint (identical
+    delete rows clear distinct matching entries, while distinct rows
+    sharing a bucket rank independently against their own matches).
+    Sort-based, shape-static; stable so ranks follow chunk order.
+    """
+    n = slots.shape[0]
+    big = jnp.int64(1) << 62
+    key = (
+        slots.astype(jnp.int64) << jnp.int64(32)
+        | h1.astype(jnp.int64)
+    )
+    key = jnp.where(m, key, big)
+    # lexsort by (h2, composite) — h2 breaks 32-bit h1 ties
+    order = jnp.lexsort((h2.astype(jnp.int64), key))
+    k_sorted = key[order]
+    h2_sorted = h2[order]
+    seq = jnp.arange(n, dtype=jnp.int32)
+    is_new = jnp.concatenate(
+        [
+            jnp.ones(1, jnp.bool_),
+            (k_sorted[1:] != k_sorted[:-1]) | (h2_sorted[1:] != h2_sorted[:-1]),
+        ]
+    )
+    # start index of each run, propagated forward (starts are increasing)
+    start = jnp.where(is_new, seq, jnp.int32(0))
+    start = jax.lax.associative_scan(jnp.maximum, start)
+    rank_sorted = seq - start
+    return jnp.zeros(n, jnp.int32).at[order].set(rank_sorted)
+
+
+def _row_fingerprint(payload_cols, payload_nulls, names):
+    """64 bits over all payload lanes (values canonicalized under NULL)
+    — only used to RANK same-bucket rows; equality stays exact."""
+    lanes = []
+    for name in names:
+        col = payload_cols[name]
+        null = payload_nulls.get(name)
+        if null is not None:
+            col = jnp.where(null, jnp.zeros((), col.dtype), col)
+            lanes.append(null)
+        lanes.append(col)
+    return hash128(tuple(lanes))
+
+
+def _entry_matches(side: JoinSide, slots, payload_cols, payload_nulls, names):
+    """(n, fanout) exact row equality against bucket entries (NULL==NULL)."""
+    sl = jnp.maximum(slots, 0)
+    ok = side.row_valid[sl]
+    for name in names:
+        stored = side.rows[name][sl]  # (n, fanout)
+        val = payload_cols[name][:, None]
+        eq = stored == val
+        if jnp.issubdtype(stored.dtype, jnp.floating):
+            eq |= jnp.isnan(stored) & jnp.isnan(val)
+        snull = side.row_nulls.get(name)
+        if snull is not None:
+            stored_null = snull[sl]
+            row_null = payload_nulls.get(name)
+            if row_null is None:
+                row_null = jnp.zeros(val.shape, jnp.bool_)
+            else:
+                row_null = row_null[:, None]
+            eq = jnp.where(stored_null | row_null, stored_null == row_null, eq)
+        ok &= eq
+    return ok
+
+
+def apply_side(
+    side: JoinSide,
+    key_cols: Tuple[jnp.ndarray, ...],
+    payload_cols: Dict[str, jnp.ndarray],
+    payload_nulls: Dict[str, jnp.ndarray],
+    valid: jnp.ndarray,
+    signs: jnp.ndarray,
+    names: Tuple[str, ...],
+):
+    """Apply one chunk to its own side: inserts then deletes.
+
+    ``signs``: +1 insert / -1 delete per row (0 = skip). Rows are
+    multiset entries; inserts fill the first free bucket positions,
+    deletes clear the rank-th matching entry (so an insert+delete of
+    the same row in one chunk nets out). Returns the updated side.
+    """
+    ins = valid & (signs > 0)
+    dele = valid & (signs < 0)
+    touch = ins | dele
+
+    # slot per row (deletes of absent keys fall through to inconsistent)
+    table, slots, _, _ = lookup_or_insert(side.table, key_cols, touch)
+    side = JoinSide(
+        table, side.rows, side.row_nulls, side.row_valid,
+        side.overflow | jnp.any(touch & (slots < 0)), side.inconsistent,
+    )
+
+    h1, h2 = _row_fingerprint(payload_cols, payload_nulls, names)
+    cap, fanout = side.capacity, side.fanout
+    n = valid.shape[0]
+    sl = jnp.maximum(slots, 0)
+
+    # ---- inserts: rank-th free position in the bucket (rank by slot
+    # only — ANY two inserts into one bucket need distinct positions) --
+    zero = jnp.zeros_like(h1)
+    rank_i = _intra_chunk_rank(slots, zero, zero, ins)
+    bv = side.row_valid[sl]  # (n, fanout)
+    free_rank = jnp.cumsum((~bv).astype(jnp.int32), axis=1)
+    one_hot = (~bv) & (free_rank == (rank_i + 1)[:, None]) & ins[:, None]
+    pos = jnp.argmax(one_hot, axis=1).astype(jnp.int32)
+    placed = jnp.any(one_hot, axis=1) & ins & (slots >= 0)
+    overflow = side.overflow | jnp.any(ins & (slots >= 0) & ~placed)
+
+    flat_idx = jnp.where(placed, sl * fanout + pos, cap * fanout)
+    rows = {
+        name: side.rows[name]
+        .reshape(-1)
+        .at[flat_idx]
+        .set(payload_cols[name], mode="drop")
+        .reshape(cap, fanout)
+        for name in names
+    }
+    row_nulls = {}
+    for name, lane in side.row_nulls.items():
+        src = payload_nulls.get(name)
+        if src is None:
+            src = jnp.zeros(n, jnp.bool_)
+        row_nulls[name] = (
+            lane.reshape(-1).at[flat_idx].set(src, mode="drop").reshape(cap, fanout)
+        )
+    row_valid = (
+        side.row_valid.reshape(-1)
+        .at[flat_idx]
+        .set(True, mode="drop")
+        .reshape(cap, fanout)
+    )
+    side = JoinSide(
+        side.table, rows, row_nulls, row_valid, overflow, side.inconsistent
+    )
+
+    # ---- deletes: rank-th matching entry -------------------------------
+    rank_d = _intra_chunk_rank(slots, h1, h2, dele)
+    match = _entry_matches(side, slots, payload_cols, payload_nulls, names)
+    match = match & dele[:, None] & (slots >= 0)[:, None]
+    mrank = jnp.cumsum(match.astype(jnp.int32), axis=1)
+    one_hot_d = match & (mrank == (rank_d + 1)[:, None])
+    dpos = jnp.argmax(one_hot_d, axis=1).astype(jnp.int32)
+    hit = jnp.any(one_hot_d, axis=1)
+    inconsistent = side.inconsistent | jnp.any(dele & (slots >= 0) & ~hit)
+
+    dflat = jnp.where(hit, sl * fanout + dpos, cap * fanout)
+    row_valid = (
+        side.row_valid.reshape(-1)
+        .at[dflat]
+        .set(False, mode="drop")
+        .reshape(cap, fanout)
+    )
+
+    # key liveness = bucket non-empty (drives rehash survival + probes)
+    touched_slots = jnp.where(touch & (slots >= 0), slots, -1)
+    any_live = jnp.any(row_valid[sl], axis=1)
+    table = set_live(side.table, touched_slots, any_live)
+    return JoinSide(
+        table, side.rows, side.row_nulls, row_valid, side.overflow, inconsistent
+    )
+
+
+def probe_side(
+    other: JoinSide,
+    key_cols: Tuple[jnp.ndarray, ...],
+    valid: jnp.ndarray,
+):
+    """Probe the other side: returns (slots, match) where match is the
+    (n, fanout) mask of live stored rows joining each probe row."""
+    slots, found = lookup(other.table, key_cols, valid)
+    sl = jnp.maximum(slots, 0)
+    match = other.row_valid[sl] & (found & valid)[:, None]
+    return sl, match
+
+
+def gather_matches(
+    other: JoinSide, sl: jnp.ndarray, names: Sequence[str]
+) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
+    """Gather (n, fanout) bucket payloads for probed slots."""
+    cols = {n: other.rows[n][sl] for n in names}
+    nulls = {n: lane[sl] for n, lane in other.row_nulls.items()}
+    return cols, nulls
+
+
+def compact_pairs(
+    flat_cols: Dict[str, jnp.ndarray],
+    flat_nulls: Dict[str, jnp.ndarray],
+    flat_ops: jnp.ndarray,
+    flat_valid: jnp.ndarray,
+    out_cap: int,
+):
+    """Compact sparse (n*fanout) join pairs into a fixed out_cap chunk.
+
+    Returns (cols, nulls, ops, valid, overflow). Order-stable: pair i
+    lands before pair j if i < j (cumsum positions), matching the
+    reference's emission order per probe chunk.
+    """
+    pos = jnp.cumsum(flat_valid.astype(jnp.int32)) - 1
+    overflow = jnp.any(flat_valid & (pos >= out_cap))
+    idx = jnp.where(flat_valid & (pos < out_cap), pos, out_cap)
+
+    def scatter(src, dtype=None):
+        buf = jnp.zeros(out_cap, dtype or src.dtype)
+        return buf.at[idx].set(src, mode="drop")
+
+    cols = {n: scatter(a) for n, a in flat_cols.items()}
+    nulls = {n: scatter(a) for n, a in flat_nulls.items()}
+    ops = scatter(flat_ops)
+    valid = jnp.zeros(out_cap, jnp.bool_).at[idx].set(flat_valid, mode="drop")
+    return cols, nulls, ops, valid, overflow
+
+
+@partial(jax.jit, static_argnames=("new_cap", "new_fanout"))
+def regrow(side: JoinSide, new_cap: int, new_fanout: int) -> JoinSide:
+    """Rebuild into a larger table and/or wider buckets, dropping
+    tombstoned keys and compacting bucket holes (the heap-growth
+    analogue; cf. executors/hash_agg._rehash)."""
+    cap, fanout = side.capacity, side.fanout
+    keep = side.table.live & (side.table.fp1 != jnp.uint32(0))
+
+    new_table = HashTable.create(new_cap, tuple(k.dtype for k in side.table.keys))
+    new_table, new_slots, _, _ = lookup_or_insert(new_table, side.table.keys, keep)
+    new_table = set_live(new_table, jnp.where(keep, new_slots, -1), True)
+
+    # compact each bucket's live entries to the front of the new bucket
+    entry_pos = jnp.cumsum(side.row_valid.astype(jnp.int32), axis=1) - 1
+    entry_ok = side.row_valid & keep[:, None] & (entry_pos < new_fanout)
+    dest_slot = jnp.broadcast_to(new_slots[:, None], (cap, fanout))
+    flat_idx = jnp.where(
+        entry_ok,
+        dest_slot * new_fanout + entry_pos,
+        new_cap * new_fanout,
+    ).reshape(-1)
+
+    def move(src, dtype):
+        buf = jnp.zeros(new_cap * new_fanout, dtype)
+        return (
+            buf.at[flat_idx].set(src.reshape(-1), mode="drop")
+            .reshape(new_cap, new_fanout)
+        )
+
+    rows = {n: move(a, a.dtype) for n, a in side.rows.items()}
+    row_nulls = {n: move(a, jnp.bool_) for n, a in side.row_nulls.items()}
+    row_valid = move(side.row_valid & entry_ok, jnp.bool_)
+    return JoinSide(
+        new_table, rows, row_nulls, row_valid, side.overflow, side.inconsistent
+    )
+
+
+@partial(jax.jit, static_argnames=("key_index",))
+def expire_keys(side: JoinSide, key_index: int, cutoff: jnp.ndarray) -> JoinSide:
+    """Watermark state cleaning: drop every key whose key lane
+    ``key_index`` < cutoff (reference: state cleaning via table
+    watermarks, state_table.rs:1133 + skip_watermark.rs)."""
+    lane = side.table.keys[key_index]
+    expired = side.table.live & (lane < cutoff)
+    slots = jnp.where(expired, jnp.arange(side.capacity, dtype=jnp.int32), -1)
+    table = set_live(side.table, slots, False)
+    row_valid = side.row_valid & ~expired[:, None]
+    return JoinSide(
+        table, side.rows, side.row_nulls, row_valid, side.overflow,
+        side.inconsistent,
+    )

@@ -1,5 +1,5 @@
 """Runtime — epoch loop, pipelines, barriers (meta-lite, single node)."""
 
-from risingwave_tpu.runtime.pipeline import Pipeline
+from risingwave_tpu.runtime.pipeline import Pipeline, TwoInputPipeline
 
-__all__ = ["Pipeline"]
+__all__ = ["Pipeline", "TwoInputPipeline"]

@@ -61,17 +61,113 @@ class Pipeline:
         """Propagate a watermark; executors may transform it (e.g. hop
         window: event time -> window_start) or consume it; their flush
         outputs flow downstream as data."""
-        wm: Optional[Watermark] = Watermark(column, value)
-        pending: List[StreamChunk] = []
-        for ex in self.executors:
+        _, pending = _walk_watermark(self.executors, Watermark(column, value))
+        return pending
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+
+def _walk_watermark(chain: Sequence[Executor], wm: Optional[Watermark]):
+    """Walk a watermark down an executor chain, feeding each executor's
+    flushed output chunks through the rest of the chain as data.
+    Returns (surviving watermark | None, chunks exiting the chain)."""
+    pending: List[StreamChunk] = []
+    for ex in chain:
+        nxt: List[StreamChunk] = []
+        for c in pending:
+            nxt.extend(ex.apply(c))
+        if wm is not None:
+            wm, outs = ex.on_watermark(wm)
+            nxt.extend(outs)
+        pending = nxt
+    return wm, pending
+
+
+class TwoInputPipeline:
+    """Two upstream chains joined by a two-input executor, then a tail.
+
+    Reference shape: a join actor's two MergeExecutor inputs aligned on
+    barriers (executor/barrier_align.rs) — the host driver is the
+    aligner: it feeds each side's chunks in arrival order and calls
+    ``barrier`` only when both sides reached it.
+    """
+
+    def __init__(
+        self,
+        left: Sequence[Executor],
+        right: Sequence[Executor],
+        join,
+        tail: Sequence[Executor],
+    ):
+        self.left = list(left)
+        self.right = list(right)
+        self.join = join
+        self.tail = list(tail)
+        self._epoch = 0
+
+    def _through(self, chain, chunks, barrier=None):
+        pending = list(chunks)
+        for ex in chain:
             nxt: List[StreamChunk] = []
             for c in pending:
                 nxt.extend(ex.apply(c))
-            if wm is not None:
-                wm, outs = ex.on_watermark(wm)
-                nxt.extend(outs)
+            if barrier is not None:
+                nxt.extend(ex.on_barrier(barrier))
             pending = nxt
         return pending
+
+    def push_left(self, chunk: StreamChunk) -> List[StreamChunk]:
+        outs = []
+        for c in self._through(self.left, [chunk]):
+            outs.extend(self.join.apply_left(c))
+        return self._through(self.tail, outs)
+
+    def push_right(self, chunk: StreamChunk) -> List[StreamChunk]:
+        outs = []
+        for c in self._through(self.right, [chunk]):
+            outs.extend(self.join.apply_right(c))
+        return self._through(self.tail, outs)
+
+    def barrier(self, checkpoint: bool = True) -> List[StreamChunk]:
+        prev = self._epoch
+        self._epoch = max(int(time.time() * 1000) << 16, prev + 1)
+        b = Barrier(Epoch(prev, self._epoch), checkpoint)
+        joined: List[StreamChunk] = []
+        for c in self._through(self.left, [], barrier=b):
+            joined.extend(self.join.apply_left(c))
+        for c in self._through(self.right, [], barrier=b):
+            joined.extend(self.join.apply_right(c))
+        joined.extend(self.join.on_barrier(b))
+        return self._through(self.tail, joined, barrier=b)
+
+    def watermark(self, column: str, value: int) -> List[StreamChunk]:
+        """Send a watermark down both input chains; each side's
+        (possibly transformed) watermark reaches the join, which cleans
+        that side's window state and emits an ALIGNED downstream
+        watermark (min over both inputs) once both sides advanced —
+        which then walks the tail chain (reference: per-input watermark
+        alignment on multi-input executors)."""
+        outs: List[StreamChunk] = []
+        aligned: Optional[Watermark] = None
+        for side_chain, feed in (
+            (self.left, self.join.apply_left),
+            (self.right, self.join.apply_right),
+        ):
+            wm, pending = _walk_watermark(side_chain, Watermark(column, value))
+            for c in pending:
+                outs.extend(feed(c))
+            if wm is not None:
+                down, flushed = self.join.on_watermark(wm)
+                outs.extend(flushed)
+                if down is not None:
+                    aligned = down
+        # data chunks enter the tail BEFORE the aligned watermark closes
+        # anything they belong to
+        data_outs = self._through(self.tail, outs)
+        _, tail_outs = _walk_watermark(self.tail, aligned)
+        return data_outs + tail_outs
 
     @property
     def epoch(self) -> int:

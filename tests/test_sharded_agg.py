@@ -146,3 +146,46 @@ def test_sharded_agg_null_inputs_match_single_chip(mesh):
         snap_single = _mv_replay(snap_single, out)
     assert len(snap_single) > 0
     assert snap_sharded == snap_single
+
+def test_sharded_agg_nullable_group_key(mesh):
+    """NULL group keys form their own group across the exchange,
+    identically to the single-chip executor."""
+    calls = (AggCall("count_star", None, "cnt"),)
+    dtypes = {"k": jnp.int64}
+    sharded = ShardedHashAgg(
+        mesh, ("k",), calls, dtypes, capacity=1 << 10, out_cap=1 << 9,
+        nullable_keys=("k",),
+    )
+    single = HashAggExecutor(
+        ("k",), calls, dtypes, capacity=1 << 12, out_cap=1 << 10,
+        nullable_keys=("k",),
+    )
+
+    rng = np.random.default_rng(11)
+    per_shard = []
+    for s in range(N_SHARDS):
+        k = rng.integers(0, 10, 64).astype(np.int64)
+        isnull = rng.random(64) < 0.25
+        # NULL rows carry k=0 values: must NOT merge with the real 0 group
+        k[isnull] = 0
+        chunk = StreamChunk.from_numpy({"k": k}, 64, nulls={"k": isnull})
+        per_shard.append(chunk)
+        single.apply(chunk)
+    sharded.apply(stack_chunks(per_shard))
+
+    def replay_nullkey(outs):
+        snap = {}
+        for out in outs:
+            d = out.to_numpy(with_ops=True)
+            for i in range(len(d["__op__"])):
+                key = None if d["k__null"][i] else d["k"][i]
+                if d["__op__"][i] in (Op.DELETE, Op.UPDATE_DELETE):
+                    snap.pop(key, None)
+                else:
+                    snap[key] = d["cnt"][i]
+        return snap
+
+    got = replay_nullkey(sharded.on_barrier(None))
+    want = replay_nullkey(single.on_barrier(None))
+    assert None in want  # the NULL group exists and is separate
+    assert got == want
