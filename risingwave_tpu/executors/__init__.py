@@ -19,6 +19,7 @@ from risingwave_tpu.executors.dedup import AppendOnlyDedupExecutor
 from risingwave_tpu.executors.dynamic_filter import DynamicMaxFilterExecutor
 from risingwave_tpu.executors.hash_join import HashJoinExecutor
 from risingwave_tpu.executors.materialize import MaterializeExecutor
+from risingwave_tpu.executors.top_n import GroupTopNExecutor
 
 __all__ = [
     "Barrier",
@@ -31,5 +32,6 @@ __all__ = [
     "AppendOnlyDedupExecutor",
     "DynamicMaxFilterExecutor",
     "HashJoinExecutor",
+    "GroupTopNExecutor",
     "MaterializeExecutor",
 ]

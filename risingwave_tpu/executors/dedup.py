@@ -28,33 +28,49 @@ from risingwave_tpu.ops.hash_table import (
     plan_rehash,
     set_live,
 )
+from risingwave_tpu.storage.state_table import (
+    Checkpointable,
+    StateDelta,
+    grow_pow2,
+    pull_rows,
+    stage_marks,
+)
 
 GROW_AT = 0.5
 
 
-@partial(jax.jit, static_argnames=("keys",), donate_argnums=(0,))
-def _dedup_step(table: HashTable, chunk: StreamChunk, keys: Tuple[str, ...]):
+@partial(jax.jit, static_argnames=("keys",), donate_argnums=(0, 1))
+def _dedup_step(
+    table: HashTable, sdirty, chunk: StreamChunk, keys: Tuple[str, ...]
+):
     key_cols = tuple(chunk.col(k) for k in keys)
     signs = chunk.effective_signs()
     saw_delete = jnp.any(chunk.valid & (signs < 0))
     valid = chunk.valid & (signs > 0)
     table, slots, _, inserted = lookup_or_insert(table, key_cols, valid)
     table = set_live(table, jnp.where(inserted, slots, -1), True)
+    sdirty = sdirty.at[
+        jnp.where(inserted, slots, table.capacity)
+    ].set(True, mode="drop")
     dropped = jnp.any(valid & (slots < 0))
     # `inserted` marks a claim's winner AND its same-key twins; keep one
     emit = inserted & first_occurrence_mask(slots, inserted)
-    return table, chunk.mask(emit), saw_delete, dropped
+    return table, sdirty, chunk.mask(emit), saw_delete, dropped
 
 
 @partial(jax.jit, static_argnames=("new_cap",))
-def _rebuild(table: HashTable, new_cap: int) -> HashTable:
-    keep = table.live
+def _rebuild(table: HashTable, sdirty, stored, new_cap: int):
+    keep = table.live | sdirty  # sdirty dead keys carry pending tombstones
     new = HashTable.create(new_cap, tuple(k.dtype for k in table.keys))
     new, slots, _, _ = lookup_or_insert(new, table.keys, keep)
-    return set_live(new, jnp.where(keep, slots, -1), True)
+    new = set_live(new, jnp.where(keep, slots, -1), table.live)
+    idx = jnp.where(keep, slots, new_cap)
+    new_sdirty = jnp.zeros(new_cap, jnp.bool_).at[idx].set(sdirty, mode="drop")
+    new_stored = jnp.zeros(new_cap, jnp.bool_).at[idx].set(stored, mode="drop")
+    return new, new_sdirty, new_stored
 
 
-class AppendOnlyDedupExecutor(Executor):
+class AppendOnlyDedupExecutor(Executor, Checkpointable):
     """DISTINCT ON (keys): first row per key passes, duplicates drop.
 
     ``window_key``: optional (column, retention_ms) — a watermark on
@@ -70,11 +86,15 @@ class AppendOnlyDedupExecutor(Executor):
         schema_dtypes: Dict[str, object],
         capacity: int = 1 << 16,
         window_key: Optional[Tuple[str, int]] = None,
+        table_id: str = "dedup",
     ):
         self.keys = tuple(keys)
+        self.table_id = table_id
         self.table = HashTable.create(
             capacity, tuple(jnp.dtype(schema_dtypes[k]) for k in self.keys)
         )
+        self.sdirty = jnp.zeros(capacity, jnp.bool_)
+        self.stored = jnp.zeros(capacity, jnp.bool_)
         self.window_key = window_key
         self._bound = 0
         self._saw_delete = jnp.zeros((), jnp.bool_)
@@ -88,8 +108,8 @@ class AppendOnlyDedupExecutor(Executor):
                 )
         self._maybe_grow(chunk.capacity)
         self._bound += chunk.capacity
-        self.table, out, saw_delete, dropped = _dedup_step(
-            self.table, chunk, self.keys
+        self.table, self.sdirty, out, saw_delete, dropped = _dedup_step(
+            self.table, self.sdirty, chunk, self.keys
         )
         self._saw_delete = self._saw_delete | saw_delete
         self._dropped = self._dropped | dropped
@@ -100,11 +120,14 @@ class AppendOnlyDedupExecutor(Executor):
         if self._bound + incoming <= cap * GROW_AT:
             return
         claimed = int(self.table.occupancy())
-        new_cap = plan_rehash(
-            cap, incoming, claimed, int(self.table.num_live()), GROW_AT
+        survivors = int(
+            jnp.sum((self.table.live | self.sdirty).astype(jnp.int32))
         )
+        new_cap = plan_rehash(cap, incoming, claimed, survivors, GROW_AT)
         if new_cap is not None:
-            self.table = _rebuild(self.table, new_cap)
+            self.table, self.sdirty, self.stored = _rebuild(
+                self.table, self.sdirty, self.stored, new_cap
+            )
             claimed = int(self.table.occupancy())
         self._bound = claimed
 
@@ -127,4 +150,53 @@ class AppendOnlyDedupExecutor(Executor):
             expired, jnp.arange(self.table.capacity, dtype=jnp.int32), -1
         )
         self.table = set_live(self.table, slots, False)
+        self.sdirty = self.sdirty | expired
         return watermark, []
+
+    # -- checkpoint/restore ----------------------------------------------
+    def checkpoint_delta(self):
+        import numpy as np
+
+        sdirty = np.asarray(self.sdirty)
+        if not sdirty.any():
+            return []
+        upsert, tomb, sel = stage_marks(
+            sdirty, np.asarray(self.table.live), np.asarray(self.stored)
+        )
+        lanes = {f"k{i}": l for i, l in enumerate(self.table.keys)}
+        keys = pull_rows(lanes, sel)
+        self.stored = (self.stored | jnp.asarray(upsert)) & ~jnp.asarray(tomb)
+        self.sdirty = jnp.zeros_like(self.sdirty)
+        return [
+            StateDelta(
+                self.table_id,
+                keys,
+                {},
+                tomb[sel],
+                tuple(f"k{i}" for i in range(len(self.table.keys))),
+            )
+        ]
+
+    def restore_state(self, table_id, key_cols, value_cols):
+        import numpy as np
+
+        n = len(next(iter(key_cols.values()))) if key_cols else 0
+        key_dtypes = tuple(k.dtype for k in self.table.keys)
+        cap = grow_pow2(n, self.table.capacity, GROW_AT)
+        table = HashTable.create(cap, key_dtypes)
+        self.sdirty = jnp.zeros(cap, jnp.bool_)
+        self.stored = jnp.zeros(cap, jnp.bool_)
+        if n:
+            lanes = tuple(
+                jnp.asarray(np.asarray(key_cols[f"k{i}"], dtype=d))
+                for i, d in enumerate(key_dtypes)
+            )
+            table, slots, _, _ = lookup_or_insert(
+                table, lanes, jnp.ones(n, jnp.bool_)
+            )
+            table = set_live(table, slots, True)
+            self.stored = self.stored.at[slots].set(True)
+        self.table = table
+        self._bound = int(n)
+        self._saw_delete = jnp.zeros((), jnp.bool_)
+        self._dropped = jnp.zeros((), jnp.bool_)

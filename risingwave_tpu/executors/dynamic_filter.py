@@ -35,14 +35,24 @@ from risingwave_tpu.ops.hash_table import (
     plan_rehash,
     set_live,
 )
+from risingwave_tpu.storage.state_table import (
+    Checkpointable,
+    StateDelta,
+    grow_pow2,
+    pull_rows,
+    stage_marks,
+)
 
 GROW_AT = 0.5
 
 
-@partial(jax.jit, static_argnames=("group_col", "value_col"), donate_argnums=(0, 1))
+@partial(
+    jax.jit, static_argnames=("group_col", "value_col"), donate_argnums=(0, 1, 2)
+)
 def _filter_step(
     table: HashTable,
     maxes: jnp.ndarray,
+    sdirty: jnp.ndarray,
     chunk: StreamChunk,
     group_col: str,
     value_col: str,
@@ -68,22 +78,25 @@ def _filter_step(
         jnp.where(inserted, init, maxes[sl]), mode="drop"
     )
     maxes = cleared.at[idx].max(value, mode="drop")
-    return table, maxes, chunk.mask(ok), saw_delete, dropped
+    sdirty = sdirty.at[idx].set(True, mode="drop")
+    return table, maxes, sdirty, chunk.mask(ok), saw_delete, dropped
 
 
 @partial(jax.jit, static_argnames=("new_cap",))
-def _rebuild(table: HashTable, maxes: jnp.ndarray, new_cap: int):
-    keep = table.live
+def _rebuild(table: HashTable, maxes: jnp.ndarray, sdirty, stored, new_cap: int):
+    keep = table.live | sdirty
     new = HashTable.create(new_cap, tuple(k.dtype for k in table.keys))
     new, slots, _, _ = lookup_or_insert(new, table.keys, keep)
-    new = set_live(new, jnp.where(keep, slots, -1), True)
+    new = set_live(new, jnp.where(keep, slots, -1), table.live)
     idx = jnp.where(keep, slots, new_cap)
     new_maxes = jnp.full(new_cap, jnp.iinfo(maxes.dtype).min, maxes.dtype)
     new_maxes = new_maxes.at[idx].set(maxes, mode="drop")
-    return new, new_maxes
+    new_sdirty = jnp.zeros(new_cap, jnp.bool_).at[idx].set(sdirty, mode="drop")
+    new_stored = jnp.zeros(new_cap, jnp.bool_).at[idx].set(stored, mode="drop")
+    return new, new_maxes, new_sdirty, new_stored
 
 
-class DynamicMaxFilterExecutor(Executor):
+class DynamicMaxFilterExecutor(Executor, Checkpointable):
     """Append-only: pass rows with ``value_col >= running max`` of their
     ``group_col`` group. Conservative (may pass superseded rows; never
     drops a row that could still match a future group max)."""
@@ -95,14 +108,18 @@ class DynamicMaxFilterExecutor(Executor):
         schema_dtypes: Dict[str, object],
         capacity: int = 1 << 14,
         window_key: Optional[Tuple[str, int]] = None,
+        table_id: str = "dynfilter",
     ):
         self.group_col = group_col
         self.value_col = value_col
+        self.table_id = table_id
         self.table = HashTable.create(
             capacity, (jnp.dtype(schema_dtypes[group_col]),)
         )
         vdtype = jnp.dtype(schema_dtypes[value_col])
         self.maxes = jnp.full(capacity, jnp.iinfo(vdtype).min, vdtype)
+        self.sdirty = jnp.zeros(capacity, jnp.bool_)
+        self.stored = jnp.zeros(capacity, jnp.bool_)
         self.window_key = window_key
         self._bound = 0
         self._saw_delete = jnp.zeros((), jnp.bool_)
@@ -113,8 +130,20 @@ class DynamicMaxFilterExecutor(Executor):
             raise ValueError("dynamic filter columns must be non-nullable")
         self._maybe_grow(chunk.capacity)
         self._bound += chunk.capacity
-        self.table, self.maxes, out, saw_delete, dropped = _filter_step(
-            self.table, self.maxes, chunk, self.group_col, self.value_col
+        (
+            self.table,
+            self.maxes,
+            self.sdirty,
+            out,
+            saw_delete,
+            dropped,
+        ) = _filter_step(
+            self.table,
+            self.maxes,
+            self.sdirty,
+            chunk,
+            self.group_col,
+            self.value_col,
         )
         self._saw_delete = self._saw_delete | saw_delete
         self._dropped = self._dropped | dropped
@@ -125,11 +154,14 @@ class DynamicMaxFilterExecutor(Executor):
         if self._bound + incoming <= cap * GROW_AT:
             return
         claimed = int(self.table.occupancy())
-        new_cap = plan_rehash(
-            cap, incoming, claimed, int(self.table.num_live()), GROW_AT
+        survivors = int(
+            jnp.sum((self.table.live | self.sdirty).astype(jnp.int32))
         )
+        new_cap = plan_rehash(cap, incoming, claimed, survivors, GROW_AT)
         if new_cap is not None:
-            self.table, self.maxes = _rebuild(self.table, self.maxes, new_cap)
+            self.table, self.maxes, self.sdirty, self.stored = _rebuild(
+                self.table, self.maxes, self.sdirty, self.stored, new_cap
+            )
             claimed = int(self.table.occupancy())
         self._bound = claimed
 
@@ -152,4 +184,50 @@ class DynamicMaxFilterExecutor(Executor):
             expired, jnp.arange(self.table.capacity, dtype=jnp.int32), -1
         )
         self.table = set_live(self.table, slots, False)
+        self.sdirty = self.sdirty | expired
         return watermark, []
+
+    # -- checkpoint/restore ----------------------------------------------
+    def checkpoint_delta(self):
+        import numpy as np
+
+        sdirty = np.asarray(self.sdirty)
+        if not sdirty.any():
+            return []
+        upsert, tomb, sel = stage_marks(
+            sdirty, np.asarray(self.table.live), np.asarray(self.stored)
+        )
+        pulled = pull_rows(
+            {"k0": self.table.keys[0], "max": self.maxes}, sel
+        )
+        keys = {"k0": pulled["k0"]}
+        vals = {"max": pulled["max"]}
+        self.stored = (self.stored | jnp.asarray(upsert)) & ~jnp.asarray(tomb)
+        self.sdirty = jnp.zeros_like(self.sdirty)
+        return [StateDelta(self.table_id, keys, vals, tomb[sel], ("k0",))]
+
+    def restore_state(self, table_id, key_cols, value_cols):
+        import numpy as np
+
+        n = len(next(iter(key_cols.values()))) if key_cols else 0
+        kd = self.table.keys[0].dtype
+        vdtype = self.maxes.dtype
+        cap = grow_pow2(n, self.table.capacity, GROW_AT)
+        table = HashTable.create(cap, (kd,))
+        maxes = jnp.full(cap, jnp.iinfo(vdtype).min, vdtype)
+        self.sdirty = jnp.zeros(cap, jnp.bool_)
+        self.stored = jnp.zeros(cap, jnp.bool_)
+        if n:
+            lanes = (jnp.asarray(np.asarray(key_cols["k0"], dtype=kd)),)
+            table, slots, _, _ = lookup_or_insert(
+                table, lanes, jnp.ones(n, jnp.bool_)
+            )
+            table = set_live(table, slots, True)
+            maxes = maxes.at[slots].set(
+                jnp.asarray(value_cols["max"].astype(vdtype))
+            )
+            self.stored = self.stored.at[slots].set(True)
+        self.table, self.maxes = table, maxes
+        self._bound = int(n)
+        self._saw_delete = jnp.zeros((), jnp.bool_)
+        self._dropped = jnp.zeros((), jnp.bool_)

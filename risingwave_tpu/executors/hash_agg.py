@@ -29,8 +29,17 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+import numpy as np
+
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Barrier, Executor, Watermark
+from risingwave_tpu.storage.state_table import (
+    Checkpointable,
+    StateDelta,
+    grow_pow2,
+    pull_rows,
+    stage_marks,
+)
 from risingwave_tpu.ops import agg as agg_ops
 from risingwave_tpu.ops.agg import AggCall, AggState
 from risingwave_tpu.ops.hash_table import (
@@ -108,9 +117,11 @@ def _rehash(
 
     A slot must survive iff it still matters to anyone:
       live (row_count>0) | emitted_valid (downstream saw it; a future
-      delete must retract it) | dirty (unflushed change pending).
+      delete must retract it) | dirty (unflushed change pending) |
+      sdirty (unpersisted change — its KEY must survive so the next
+      checkpoint can name the upsert/tombstone).
     """
-    keep = table.live | state.emitted_valid | state.dirty
+    keep = table.live | state.emitted_valid | state.dirty | state.sdirty
     keep = keep & (table.fp1 != jnp.uint32(0))
 
     new_table = HashTable.create(new_cap, tuple(k.dtype for k in table.keys))
@@ -144,6 +155,8 @@ def _rehash(
         emitted_valid=rescatter(state.emitted_valid, jnp.zeros((), jnp.bool_)),
         dirty=rescatter(state.dirty, jnp.zeros((), jnp.bool_)),
         minmax_retracted=state.minmax_retracted,
+        sdirty=rescatter(state.sdirty, jnp.zeros((), jnp.bool_)),
+        stored=rescatter(state.stored, jnp.zeros((), jnp.bool_)),
     )
     return new_table, new_state
 
@@ -169,7 +182,7 @@ def _expire(
     return table, state
 
 
-class HashAggExecutor(Executor):
+class HashAggExecutor(Executor, Checkpointable):
     """Streaming GROUP BY.
 
     Args:
@@ -194,7 +207,9 @@ class HashAggExecutor(Executor):
         out_cap: int = 1 << 15,
         nullable_keys: Sequence[str] = (),
         window_key: Optional[Tuple[str, int, bool]] = None,
+        table_id: str = "hash_agg",
     ):
+        self.table_id = table_id
         self.group_keys = tuple(group_keys)
         self.calls = tuple(calls)
         self.out_cap = out_cap
@@ -242,12 +257,16 @@ class HashAggExecutor(Executor):
         # refresh the bound with the true claimed count (one device read,
         # off the hot path) before deciding to pay for a rebuild
         claimed = int(self.table.occupancy())
-        # survivors = what the rebuild keeps (live | emitted | dirty),
-        # not pre-rebuild occupancy — see plan_rehash
+        # survivors = what the rebuild keeps (live | emitted | dirty |
+        # sdirty), not pre-rebuild occupancy — see plan_rehash; sdirty
+        # must count or pending-tombstone keys overflow the new table
         keep = int(
             jnp.sum(
                 (
-                    self.table.live | self.state.emitted_valid | self.state.dirty
+                    self.table.live
+                    | self.state.emitted_valid
+                    | self.state.dirty
+                    | self.state.sdirty
                 ).astype(jnp.int32)
             )
         )
@@ -336,3 +355,131 @@ class HashAggExecutor(Executor):
         return StreamChunk(
             columns=cols, valid=delta["valid"], nulls=nulls, ops=delta["ops"]
         )
+
+
+# -- checkpoint/restore (StateTable integration) -------------------------
+@jax.jit
+def _mark_checkpointed(state: AggState, upsert, tomb):
+    """Flip storage marks after a successful commit: persisted slots
+    become stored, tombstoned slots forget their stored bit, and every
+    sdirty mark clears (mem_table seal analogue)."""
+    return AggState(
+        row_count=state.row_count,
+        accums=state.accums,
+        nonnull=state.nonnull,
+        emitted=state.emitted,
+        emitted_isnull=state.emitted_isnull,
+        emitted_valid=state.emitted_valid,
+        dirty=state.dirty,
+        minmax_retracted=state.minmax_retracted,
+        sdirty=jnp.zeros_like(state.sdirty),
+        stored=(state.stored | upsert) & ~tomb,
+    )
+
+
+def _agg_checkpoint_delta(self) -> List[StateDelta]:
+    """Stage rows changed since the last checkpoint (device -> host).
+
+    upsert  = sdirty & alive        (new/changed group state)
+    tombstone = sdirty & stored & dead  (a persisted group died)
+    Rows carry the FULL slot state (accums + emitted snapshots), so
+    restore rebuilds byte-identical operator state. Only the selected
+    rows cross the device boundary (pull_rows).
+    """
+    sdirty = np.asarray(self.state.sdirty)
+    if not sdirty.any():
+        return []
+    alive = (
+        np.asarray(self.table.live)
+        | np.asarray(self.state.emitted_valid)
+        | np.asarray(self.state.dirty)
+    )
+    upsert, tomb, sel = stage_marks(sdirty, alive, np.asarray(self.state.stored))
+    lanes = {
+        f"k{i}": lane for i, lane in enumerate(self.table.keys)
+    }
+    key_names = tuple(lanes)
+    lanes["row_count"] = self.state.row_count
+    for n, a in self.state.accums.items():
+        lanes[f"acc_{n}"] = a
+        lanes[f"em_{n}"] = self.state.emitted[n]
+    for n, a in self.state.nonnull.items():
+        lanes[f"nn_{n}"] = a
+        lanes[f"ei_{n}"] = self.state.emitted_isnull[n]
+    lanes["ev"] = self.state.emitted_valid
+    pulled = pull_rows(lanes, sel)
+    keys = {k: pulled[k] for k in key_names}
+    vals = {k: v for k, v in pulled.items() if k not in key_names}
+    # eager flip — see StateDelta's durability contract
+    self.state = _mark_checkpointed(
+        self.state, jnp.asarray(upsert), jnp.asarray(tomb)
+    )
+    return [
+        StateDelta(
+            self.table_id,
+            keys,
+            vals,
+            tomb[sel],
+            # positional lane order, NOT sorted() ("k10" < "k2" lexically)
+            key_names,
+        )
+    ]
+
+
+def _agg_restore_state(self, table_id, key_cols, value_cols) -> None:
+    """Rebuild device table + state from recovered rows."""
+    n = len(next(iter(key_cols.values()))) if key_cols else 0
+    key_dtypes = tuple(k.dtype for k in self.table.keys)
+    cap = grow_pow2(n, self.table.capacity, GROW_AT)
+    table = HashTable.create(cap, key_dtypes)
+    state = agg_ops.create_state(cap, self.calls, self._dtypes)
+    if n:
+        lanes = tuple(
+            jnp.asarray(np.asarray(key_cols[f"k{i}"], dtype=d))
+            for i, d in enumerate(key_dtypes)
+        )
+        valid = jnp.ones(n, jnp.bool_)
+        table, slots, _, _ = lookup_or_insert(table, lanes, valid)
+
+        def put(dst, src):
+            return dst.at[slots].set(jnp.asarray(src))
+
+        row_count = put(state.row_count, value_cols["row_count"])
+        accums = {
+            name: put(a, value_cols[f"acc_{name}"].astype(a.dtype))
+            for name, a in state.accums.items()
+        }
+        emitted = {
+            name: put(a, value_cols[f"em_{name}"].astype(a.dtype))
+            for name, a in state.emitted.items()
+        }
+        nonnull = {
+            name: put(a, value_cols[f"nn_{name}"])
+            for name, a in state.nonnull.items()
+        }
+        e_isnull = {
+            name: put(a, value_cols[f"ei_{name}"])
+            for name, a in state.emitted_isnull.items()
+        }
+        emitted_valid = put(state.emitted_valid, value_cols["ev"])
+        stored = state.stored.at[slots].set(True)
+        state = AggState(
+            row_count=row_count,
+            accums=accums,
+            nonnull=nonnull,
+            emitted=emitted,
+            emitted_isnull=e_isnull,
+            emitted_valid=emitted_valid,
+            dirty=jnp.zeros(cap, jnp.bool_),
+            minmax_retracted=jnp.zeros((), jnp.bool_),
+            sdirty=jnp.zeros(cap, jnp.bool_),
+            stored=stored,
+        )
+        table = set_live(table, slots, row_count[slots] > 0)
+    self.table, self.state = table, state
+    self.dropped = jnp.zeros((), jnp.bool_)
+    self._insert_bound = int(n)
+
+
+HashAggExecutor.checkpoint_delta = _agg_checkpoint_delta
+HashAggExecutor.restore_state = _agg_restore_state

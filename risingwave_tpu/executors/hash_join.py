@@ -36,6 +36,14 @@ import jax.numpy as jnp
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Barrier, Executor, Watermark
 from risingwave_tpu.ops.hash_table import plan_rehash
+from risingwave_tpu.ops.hash_table import lookup_or_insert, set_live
+from risingwave_tpu.storage.state_table import (
+    Checkpointable,
+    StateDelta,
+    grow_pow2,
+    pull_rows,
+    stage_marks,
+)
 from risingwave_tpu.ops.join import (
     JoinSide,
     apply_side,
@@ -117,7 +125,7 @@ def _join_step(
     return own, out_cols, out_nulls, out_ops, out_valid, em_overflow
 
 
-class HashJoinExecutor(Executor):
+class HashJoinExecutor(Executor, Checkpointable):
     """Streaming INNER equi-join.
 
     Args:
@@ -148,7 +156,9 @@ class HashJoinExecutor(Executor):
         left_nullable: Sequence[str] = (),
         right_nullable: Sequence[str] = (),
         window_cols: Optional[Tuple[str, str]] = None,
+        table_id: str = "hash_join",
     ):
+        self.table_id = table_id
         if set(left_dtypes) & set(right_dtypes):
             raise ValueError(
                 f"overlapping output columns: {set(left_dtypes) & set(right_dtypes)}"
@@ -227,9 +237,10 @@ class HashJoinExecutor(Executor):
         if self._bound[side] + incoming <= cap * GROW_AT:
             return own
         claimed = int(own.table.occupancy())
-        new_cap = plan_rehash(
-            cap, incoming, claimed, int(own.table.num_live()), GROW_AT
+        survivors = int(
+            jnp.sum((own.table.live | own.sdirty).astype(jnp.int32))
         )
+        new_cap = plan_rehash(cap, incoming, claimed, survivors, GROW_AT)
         if new_cap is not None:
             own = regrow(own, new_cap, own.fanout)
             claimed = int(own.table.occupancy())
@@ -285,3 +296,132 @@ class HashJoinExecutor(Executor):
     def _key_index(self, side: str, name: str) -> int:
         keys = self.left_keys if side == "l" else self.right_keys
         return keys.index(name)
+
+
+# -- checkpoint/restore (StateTable integration) -------------------------
+@jax.jit
+def _side_mark_checkpointed(side: JoinSide, upsert, tomb) -> JoinSide:
+    return JoinSide(
+        side.table,
+        side.rows,
+        side.row_nulls,
+        side.row_valid,
+        side.overflow,
+        side.inconsistent,
+        jnp.zeros_like(side.sdirty),
+        (side.stored | upsert) & ~tomb,
+    )
+
+
+def _side_delta(side: JoinSide, table_id: str):
+    """Stage one side's changed keys: the whole bucket rides as 2D
+    value lanes (rows re-land at the same in-bucket positions on
+    restore, so emitted pair identity is stable). Marks flip eagerly
+    (see StateDelta's durability contract). Returns (delta, new_side)
+    or None."""
+    import numpy as np
+
+    sdirty = np.asarray(side.sdirty)
+    if not sdirty.any():
+        return None
+    upsert, tomb, sel = stage_marks(
+        sdirty, np.asarray(side.table.live), np.asarray(side.stored)
+    )
+    lanes = {
+        f"k{i}": lane for i, lane in enumerate(side.table.keys)
+    }
+    key_names = tuple(lanes)
+    lanes["rv"] = side.row_valid
+    for n, a in side.rows.items():
+        lanes[f"r_{n}"] = a
+    for n, a in side.row_nulls.items():
+        lanes[f"n_{n}"] = a
+    pulled = pull_rows(lanes, sel)
+    keys = {k: pulled[k] for k in key_names}
+    vals = {k: v for k, v in pulled.items() if k not in key_names}
+    new_side = _side_mark_checkpointed(
+        side, jnp.asarray(upsert), jnp.asarray(tomb)
+    )
+    return StateDelta(table_id, keys, vals, tomb[sel], key_names), new_side
+
+
+def _side_restore(side: JoinSide, key_cols, value_cols) -> JoinSide:
+    """Rebuild a JoinSide from recovered rows (fresh table, same
+    capacity/fanout unless growth is needed)."""
+    import numpy as np
+
+    n = len(next(iter(key_cols.values()))) if key_cols else 0
+    fanout = side.fanout
+    cap = grow_pow2(n, side.capacity, GROW_AT)
+    fresh = JoinSide.create(
+        cap,
+        fanout,
+        tuple(k.dtype for k in side.table.keys),
+        {name: a.dtype for name, a in side.rows.items()},
+        nullable=tuple(side.row_nulls),
+    )
+    if not n:
+        return fresh
+    lanes = tuple(
+        jnp.asarray(np.asarray(key_cols[f"k{i}"], dtype=d.dtype))
+        for i, d in enumerate(side.table.keys)
+    )
+    table, slots, _, _ = lookup_or_insert(
+        fresh.table, lanes, jnp.ones(n, jnp.bool_)
+    )
+    table = set_live(table, slots, True)
+
+    def put2d(dst, src):
+        return dst.at[slots].set(jnp.asarray(src))
+
+    rows = {
+        name: put2d(a, value_cols[f"r_{name}"].astype(a.dtype))
+        for name, a in fresh.rows.items()
+    }
+    row_nulls = {
+        name: put2d(a, value_cols[f"n_{name}"])
+        for name, a in fresh.row_nulls.items()
+    }
+    row_valid = put2d(fresh.row_valid, value_cols["rv"])
+    stored = fresh.stored.at[slots].set(True)
+    return JoinSide(
+        table,
+        rows,
+        row_nulls,
+        row_valid,
+        jnp.zeros((), jnp.bool_),
+        jnp.zeros((), jnp.bool_),
+        jnp.zeros(cap, jnp.bool_),
+        stored,
+    )
+
+
+def _join_checkpoint_table_ids(self):
+    return [f"{self.table_id}.left", f"{self.table_id}.right"]
+
+
+def _join_checkpoint_delta(self):
+    out = []
+    got = _side_delta(self.left, f"{self.table_id}.left")
+    if got is not None:
+        out.append(got[0])
+        self.left = got[1]
+    got = _side_delta(self.right, f"{self.table_id}.right")
+    if got is not None:
+        out.append(got[0])
+        self.right = got[1]
+    return out
+
+
+def _join_restore_state(self, table_id, key_cols, value_cols):
+    if table_id.endswith(".left"):
+        self.left = _side_restore(self.left, key_cols, value_cols)
+        self._bound["l"] = int(self.left.table.occupancy())
+    else:
+        self.right = _side_restore(self.right, key_cols, value_cols)
+        self._bound["r"] = int(self.right.table.occupancy())
+
+
+HashJoinExecutor.checkpoint_table_ids = _join_checkpoint_table_ids
+HashJoinExecutor.checkpoint_delta = _join_checkpoint_delta
+HashJoinExecutor.restore_state = _join_restore_state

@@ -19,14 +19,23 @@ import numpy as np
 
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Barrier, Executor
+from risingwave_tpu.storage.state_table import Checkpointable, StateDelta
 from risingwave_tpu.types import Op
 
 
-class MaterializeExecutor(Executor):
-    def __init__(self, pk: Sequence[str], columns: Sequence[str]):
+class MaterializeExecutor(Executor, Checkpointable):
+    def __init__(
+        self,
+        pk: Sequence[str],
+        columns: Sequence[str],
+        table_id: str = "mview",
+    ):
         self.pk = tuple(pk)
         self.columns = tuple(columns)
         self.rows: Dict[Tuple, Tuple] = {}
+        self.table_id = table_id
+        self._changed: set = set()  # pks touched since last checkpoint
+        self._dtypes: Dict[str, np.dtype] = {}
 
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         data = chunk.to_numpy(with_ops=True)
@@ -34,6 +43,9 @@ class MaterializeExecutor(Executor):
         n = len(ops)
         if n == 0:
             return [chunk]
+        for name in self.pk + self.columns:
+            if name not in self._dtypes:
+                self._dtypes[name] = data[name].dtype
         # NULL pk components must stay distinct from real zeros: fold the
         # null lane into the key tuple as None (SQL: NULL group keys form
         # their own group; reference pk serde writes a null tag first,
@@ -52,6 +64,7 @@ class MaterializeExecutor(Executor):
 
         keys = tuples(self.pk)
         vals = tuples(self.columns)
+        self._changed.update(keys)
         is_del = (ops == Op.DELETE) | (ops == Op.UPDATE_DELETE)
         # Sequentially applying a chunk's ops leaves each pk in the state
         # of its LAST op (delete -> absent, insert/update -> that row), so
@@ -84,3 +97,67 @@ class MaterializeExecutor(Executor):
         for j, name in enumerate(self.columns):
             out[name] = np.array([self.rows[k][j] for k in keys])
         return out
+
+    # -- checkpoint/restore ----------------------------------------------
+    def checkpoint_delta(self):
+        """Persist MV rows whose pk changed since the last checkpoint
+        (reference: the MV's own StateTable commit, materialize.rs:44).
+        v0 restriction: NULL pk/values are not persisted (none of the
+        benchmark MVs produce them); a None raises loudly."""
+        if not self._changed:
+            return []
+        ups, tombs = [], []
+        for k in self._changed:
+            if any(v is None for v in k):
+                raise ValueError("NULL pk persistence not supported yet")
+            row = self.rows.get(k)
+            if row is None:
+                tombs.append(k)
+            elif any(v is None for v in row):
+                raise ValueError("NULL value persistence not supported yet")
+            else:
+                ups.append((k, row))
+        n = len(ups) + len(tombs)
+        key_cols = {}
+        for j, name in enumerate(self.pk):
+            key_cols[f"k{j}"] = np.array(
+                [k[j] for k, _ in ups] + [k[j] for k in tombs],
+                dtype=self._dtypes[name],
+            )
+        value_cols = {}
+        for j, name in enumerate(self.columns):
+            pad = np.zeros(len(tombs), dtype=self._dtypes[name])
+            value_cols[f"v{j}"] = np.concatenate(
+                [
+                    np.array([r[j] for _, r in ups], dtype=self._dtypes[name]),
+                    pad,
+                ]
+            ) if ups else pad
+        tombstone = np.zeros(n, bool)
+        tombstone[len(ups):] = True
+        self._changed.clear()
+        return [
+            StateDelta(
+                self.table_id,
+                key_cols,
+                value_cols,
+                tombstone,
+                tuple(f"k{j}" for j in range(len(self.pk))),
+            )
+        ]
+
+    def restore_state(self, table_id, key_cols, value_cols):
+        self.rows = {}
+        self._changed = set()
+        if not key_cols:
+            return
+        n = len(next(iter(key_cols.values())))
+        for i in range(n):
+            k = tuple(
+                key_cols[f"k{j}"][i].item() for j in range(len(self.pk))
+            )
+            v = tuple(
+                value_cols[f"v{j}"][i].item()
+                for j in range(len(self.columns))
+            )
+            self.rows[k] = v

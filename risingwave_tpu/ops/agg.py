@@ -134,6 +134,11 @@ class AggState:
     retractions. ``dirty`` marks slots touched since the last flush.
     ``minmax_retracted`` latches the unsupported-retraction condition
     for host-side checking.
+
+    Storage lanes (the memtable-dirty analogue, mem_table.rs):
+    ``sdirty`` marks slots changed since the last CHECKPOINT (cleared
+    by StateTable commit); ``stored`` marks slots present in the object
+    store (drives tombstone emission when a stored group dies).
     """
 
     row_count: jnp.ndarray  # int64
@@ -144,6 +149,8 @@ class AggState:
     emitted_valid: jnp.ndarray  # bool
     dirty: jnp.ndarray  # bool
     minmax_retracted: jnp.ndarray  # () bool
+    sdirty: jnp.ndarray  # bool — changed since last checkpoint
+    stored: jnp.ndarray  # bool — persisted in the object store
 
     def tree_flatten(self):
         anames = tuple(sorted(self.accums))
@@ -157,15 +164,26 @@ class AggState:
             self.emitted_valid,
             self.dirty,
             self.minmax_retracted,
+            self.sdirty,
+            self.stored,
         )
         return children, (anames, nnames)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         anames, nnames = aux
-        row_count, accums, nonnull, emitted, e_isnull, emitted_valid, dirty, mr = (
-            children
-        )
+        (
+            row_count,
+            accums,
+            nonnull,
+            emitted,
+            e_isnull,
+            emitted_valid,
+            dirty,
+            mr,
+            sdirty,
+            stored,
+        ) = children
         return cls(
             row_count=row_count,
             accums=dict(zip(anames, accums)),
@@ -175,6 +193,8 @@ class AggState:
             emitted_valid=emitted_valid,
             dirty=dirty,
             minmax_retracted=mr,
+            sdirty=sdirty,
+            stored=stored,
         )
 
     @property
@@ -223,6 +243,8 @@ def create_state(capacity: int, calls: Sequence[AggCall], input_dtypes) -> AggSt
         emitted_valid=jnp.zeros(capacity, jnp.bool_),
         dirty=jnp.zeros(capacity, jnp.bool_),
         minmax_retracted=jnp.zeros((), jnp.bool_),
+        sdirty=jnp.zeros(capacity, jnp.bool_),
+        stored=jnp.zeros(capacity, jnp.bool_),
     )
 
 
@@ -247,6 +269,7 @@ def apply(
 
     row_count = state.row_count.at[idx].add(w, mode="drop")
     dirty = state.dirty.at[idx].set(True, mode="drop")
+    sdirty = state.sdirty.at[idx].set(True, mode="drop")
 
     accums = dict(state.accums)
     nonnull = dict(state.nonnull)
@@ -292,6 +315,8 @@ def apply(
         emitted_valid=state.emitted_valid,
         dirty=dirty,
         minmax_retracted=mr,
+        sdirty=sdirty,
+        stored=state.stored,
     )
 
 
@@ -316,6 +341,7 @@ def _reset_groups(
     cap = state.capacity
     idx = jnp.where(slots >= 0, slots, cap)
     row_count = state.row_count.at[idx].set(0, mode="drop")
+    sdirty = state.sdirty.at[idx].set(True, mode="drop")
     if mark_dirty:
         dirty = state.dirty.at[idx].set(True, mode="drop")
         emitted_valid = state.emitted_valid
@@ -339,6 +365,8 @@ def _reset_groups(
         emitted_valid=emitted_valid,
         dirty=dirty,
         minmax_retracted=state.minmax_retracted,
+        sdirty=sdirty,
+        stored=state.stored,
     )
 
 
@@ -455,5 +483,7 @@ def flush(
         emitted_valid=emitted_valid,
         dirty=dirty,
         minmax_retracted=state.minmax_retracted,
+        sdirty=state.sdirty,
+        stored=state.stored,
     )
     return state, delta

@@ -67,6 +67,8 @@ class JoinSide:
     row_valid: jnp.ndarray
     overflow: jnp.ndarray  # () bool
     inconsistent: jnp.ndarray  # () bool
+    sdirty: jnp.ndarray  # (capacity,) bool — changed since last checkpoint
+    stored: jnp.ndarray  # (capacity,) bool — persisted in the object store
 
     def tree_flatten(self):
         names = tuple(sorted(self.rows))
@@ -78,13 +80,16 @@ class JoinSide:
             self.row_valid,
             self.overflow,
             self.inconsistent,
+            self.sdirty,
+            self.stored,
         )
         return children, (names, null_names)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         names, null_names = aux
-        table, rows, nulls, row_valid, overflow, inconsistent = children
+        (table, rows, nulls, row_valid, overflow, inconsistent, sdirty,
+         stored) = children
         return cls(
             table=table,
             rows=dict(zip(names, rows)),
@@ -92,6 +97,8 @@ class JoinSide:
             row_valid=row_valid,
             overflow=overflow,
             inconsistent=inconsistent,
+            sdirty=sdirty,
+            stored=stored,
         )
 
     @property
@@ -122,6 +129,8 @@ class JoinSide:
             row_valid=jnp.zeros((capacity, fanout), jnp.bool_),
             overflow=jnp.zeros((), jnp.bool_),
             inconsistent=jnp.zeros((), jnp.bool_),
+            sdirty=jnp.zeros(capacity, jnp.bool_),
+            stored=jnp.zeros(capacity, jnp.bool_),
         )
 
 
@@ -221,9 +230,13 @@ def apply_side(
 
     # slot per row (deletes of absent keys fall through to inconsistent)
     table, slots, _, _ = lookup_or_insert(side.table, key_cols, touch)
+    sdirty = side.sdirty.at[
+        jnp.where(touch & (slots >= 0), slots, side.capacity)
+    ].set(True, mode="drop")
     side = JoinSide(
         table, side.rows, side.row_nulls, side.row_valid,
         side.overflow | jnp.any(touch & (slots < 0)), side.inconsistent,
+        sdirty, side.stored,
     )
 
     h1, h2 = _row_fingerprint(payload_cols, payload_nulls, names)
@@ -266,7 +279,8 @@ def apply_side(
         .reshape(cap, fanout)
     )
     side = JoinSide(
-        side.table, rows, row_nulls, row_valid, overflow, side.inconsistent
+        side.table, rows, row_nulls, row_valid, overflow, side.inconsistent,
+        side.sdirty, side.stored,
     )
 
     # ---- deletes: rank-th matching entry -------------------------------
@@ -292,7 +306,8 @@ def apply_side(
     any_live = jnp.any(row_valid[sl], axis=1)
     table = set_live(side.table, touched_slots, any_live)
     return JoinSide(
-        table, side.rows, side.row_nulls, row_valid, side.overflow, inconsistent
+        table, side.rows, side.row_nulls, row_valid, side.overflow,
+        inconsistent, side.sdirty, side.stored,
     )
 
 
@@ -352,11 +367,22 @@ def regrow(side: JoinSide, new_cap: int, new_fanout: int) -> JoinSide:
     tombstoned keys and compacting bucket holes (the heap-growth
     analogue; cf. executors/hash_agg._rehash)."""
     cap, fanout = side.capacity, side.fanout
-    keep = side.table.live & (side.table.fp1 != jnp.uint32(0))
+    # live keys survive; sdirty dead keys survive too (the next
+    # checkpoint needs their key lanes to write tombstones)
+    keep = (side.table.live | side.sdirty) & (side.table.fp1 != jnp.uint32(0))
 
     new_table = HashTable.create(new_cap, tuple(k.dtype for k in side.table.keys))
     new_table, new_slots, _, _ = lookup_or_insert(new_table, side.table.keys, keep)
-    new_table = set_live(new_table, jnp.where(keep, new_slots, -1), True)
+    new_table = set_live(
+        new_table, jnp.where(keep, new_slots, -1), side.table.live
+    )
+    nidx = jnp.where(keep, new_slots, new_cap)
+    new_sdirty = jnp.zeros(new_cap, jnp.bool_).at[nidx].set(
+        side.sdirty, mode="drop"
+    )
+    new_stored = jnp.zeros(new_cap, jnp.bool_).at[nidx].set(
+        side.stored, mode="drop"
+    )
 
     # compact each bucket's live entries to the front of the new bucket
     entry_pos = jnp.cumsum(side.row_valid.astype(jnp.int32), axis=1) - 1
@@ -379,7 +405,8 @@ def regrow(side: JoinSide, new_cap: int, new_fanout: int) -> JoinSide:
     row_nulls = {n: move(a, jnp.bool_) for n, a in side.row_nulls.items()}
     row_valid = move(side.row_valid & entry_ok, jnp.bool_)
     return JoinSide(
-        new_table, rows, row_nulls, row_valid, side.overflow, side.inconsistent
+        new_table, rows, row_nulls, row_valid, side.overflow,
+        side.inconsistent, new_sdirty, new_stored,
     )
 
 
@@ -395,5 +422,5 @@ def expire_keys(side: JoinSide, key_index: int, cutoff: jnp.ndarray) -> JoinSide
     row_valid = side.row_valid & ~expired[:, None]
     return JoinSide(
         table, side.rows, side.row_nulls, row_valid, side.overflow,
-        side.inconsistent,
+        side.inconsistent, side.sdirty | expired, side.stored,
     )

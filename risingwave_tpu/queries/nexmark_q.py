@@ -67,13 +67,14 @@ def build_q5_lite(
             "window_start": jnp.int64,
         },
         capacity=capacity,
+        table_id="q5.agg",
         # HopWindowExecutor already translates the event-time watermark
         # into a window_start watermark (start >= first_start(wm) for any
         # future row), so windows below it are closed as-is: retention 0
         window_key=("window_start", 0, False) if state_cleaning else None,
     )
     mview = MaterializeExecutor(
-        pk=("auction", "window_start"), columns=("num",)
+        pk=("auction", "window_start"), columns=("num",), table_id="q5.mview"
     )
     return Q5Lite(Pipeline([hop, agg, mview]), agg, mview)
 
@@ -118,6 +119,7 @@ def build_q8(
             },
             capacity=capacity,
             window_key=("starttime", 0) if state_cleaning else None,
+            table_id="q8.dedup_person",
         ),
     ]
     auction_chain = [
@@ -127,6 +129,7 @@ def build_q8(
             schema_dtypes={"seller": jnp.int64, "astarttime": jnp.int64},
             capacity=capacity,
             window_key=("astarttime", 0) if state_cleaning else None,
+            table_id="q8.dedup_auction",
         ),
     ]
     join = HashJoinExecutor(
@@ -142,8 +145,11 @@ def build_q8(
         fanout=fanout,
         out_cap=out_cap,
         window_cols=("starttime", "astarttime") if state_cleaning else None,
+        table_id="q8.join",
     )
-    mview = MaterializeExecutor(pk=("id", "starttime"), columns=("name",))
+    mview = MaterializeExecutor(
+        pk=("id", "starttime"), columns=("name",), table_id="q8.mview"
+    )
     pipeline = TwoInputPipeline(person_chain, auction_chain, join, [mview])
     return Q8(pipeline, join, mview)
 
@@ -197,6 +203,7 @@ def build_q7(
             schema_dtypes={"wstart": jnp.int64, "price": jnp.int64},
             capacity=max(1 << 10, capacity >> 6),
             window_key=("wstart", 0) if state_cleaning else None,
+            table_id="q7.maxfilter",
         ),
     ]
     right_chain = [
@@ -207,6 +214,7 @@ def build_q7(
             schema_dtypes={"mwstart": jnp.int64, "price": jnp.int64},
             capacity=max(1 << 12, capacity >> 4),
             window_key=("mwstart", 0, False) if state_cleaning else None,
+            table_id="q7.maxagg",
         ),
     ]
     join = HashJoinExecutor(
@@ -227,9 +235,11 @@ def build_q7(
         # would round-trip NULLs faithfully if that ever changes
         right_nullable=("maxprice",),
         window_cols=("wstart", "mwstart") if state_cleaning else None,
+        table_id="q7.join",
     )
     mview = MaterializeExecutor(
-        pk=("wstart", "auction", "bidder"), columns=("price",)
+        pk=("wstart", "auction", "bidder"), columns=("price",),
+        table_id="q7.mview",
     )
     pipeline = TwoInputPipeline(left_chain, right_chain, join, [mview])
     agg = right_chain[1]

@@ -1,5 +1,6 @@
 """Runtime — epoch loop, pipelines, barriers (meta-lite, single node)."""
 
 from risingwave_tpu.runtime.pipeline import Pipeline, TwoInputPipeline
+from risingwave_tpu.runtime.runtime import StreamingRuntime
 
-__all__ = ["Pipeline", "TwoInputPipeline"]
+__all__ = ["Pipeline", "TwoInputPipeline", "StreamingRuntime"]

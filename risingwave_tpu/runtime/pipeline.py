@@ -170,5 +170,10 @@ class TwoInputPipeline:
         return data_outs + tail_outs
 
     @property
+    def executors(self) -> List[Executor]:
+        """Every executor in the fragment, for checkpoint enumeration."""
+        return self.left + self.right + [self.join] + self.tail
+
+    @property
     def epoch(self) -> int:
         return self._epoch

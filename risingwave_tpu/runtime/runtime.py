@@ -1,0 +1,212 @@
+"""StreamingRuntime — the meta-lite control plane for one process.
+
+Reference roles replaced:
+- ``GlobalBarrierManager`` event loop + ``ScheduledBarriers`` min-
+  interval tick (src/meta/src/barrier/mod.rs:532, barrier/schedule.rs:348);
+- ``CheckpointControl`` in-flight epoch tracking + ``complete_barrier``
+  -> ``HummockManager::commit_epoch`` (barrier/mod.rs:845);
+- the async uploader overlapping checkpoint IO with the next epoch's
+  compute (src/storage/src/hummock/event_handler/uploader.rs:548);
+- recovery from max_committed_epoch (barrier/recovery.rs:353).
+
+TPU re-design: fragments are host-driven pipelines over device state,
+so the runtime is a synchronous epoch clock plus an ASYNC checkpoint
+lane: at a checkpoint barrier the runtime stages every executor's
+delta (the only device-touching step, O(changed rows) and mark flips
+happen HERE, on the main thread), then hands SST build + upload +
+manifest commit to a background worker that preserves epoch order. A
+worker failure is fatal for live state (marks are already flipped):
+the next barrier raises and the driver must recover() from the last
+durable manifest — the reference's failed-barrier recovery contract.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.storage.object_store import ObjectStore
+from risingwave_tpu.storage.sstable import build_sst
+from risingwave_tpu.storage.state_table import Checkpointable, CheckpointManager
+
+
+class StreamingRuntime:
+    """Owns fragments (pipelines), the barrier clock, and checkpoints.
+
+    Args:
+      store: object store for checkpoints (None = no persistence).
+      barrier_interval_ms: the reference's ``barrier_interval_ms``
+        system param (default 1000) — used by ``tick()`` pacing.
+      checkpoint_frequency: every Nth barrier is a checkpoint
+        (system_param/mod.rs:78).
+      async_checkpoint: overlap SST build/upload with the next epochs'
+        compute (uploader analogue). ``wait_checkpoints()`` joins.
+    """
+
+    def __init__(
+        self,
+        store: Optional[ObjectStore] = None,
+        barrier_interval_ms: int = 1000,
+        checkpoint_frequency: int = 1,
+        async_checkpoint: bool = True,
+    ):
+        self.fragments: Dict[str, object] = {}
+        self.barrier_interval_ms = barrier_interval_ms
+        self.checkpoint_frequency = checkpoint_frequency
+        self.mgr = CheckpointManager(store) if store is not None else None
+        self.async_checkpoint = async_checkpoint
+        self._epoch = self.mgr.max_committed_epoch if self.mgr else 0
+        self._barrier_seq = 0
+        self._last_barrier_at = 0.0
+        self.barrier_latencies_ms: List[float] = []
+        self._worker: Optional[threading.Thread] = None
+        self._work_q: deque = deque()
+        self._work_event = threading.Event()
+        self._work_err: List[BaseException] = []
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+
+    # -- fragments -------------------------------------------------------
+    def register(self, name: str, pipeline) -> None:
+        self.fragments[name] = pipeline
+
+    def executors(self) -> List[object]:
+        out = []
+        for p in self.fragments.values():
+            out.extend(p.executors)
+        return out
+
+    # -- barrier clock ---------------------------------------------------
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    def next_epoch(self) -> int:
+        return max(int(time.time() * 1000) << 16, self._epoch + 1)
+
+    def barrier(self) -> Dict[str, List[StreamChunk]]:
+        """Inject one barrier into every fragment; commit a checkpoint
+        every ``checkpoint_frequency``-th barrier. Returns each
+        fragment's emitted chunks."""
+        t0 = time.perf_counter()
+        prev, self._epoch = self._epoch, self.next_epoch()
+        self._barrier_seq += 1
+        outs = {}
+        for name, p in self.fragments.items():
+            p._epoch = prev  # fragments share the runtime's clock
+            outs[name] = p.barrier()
+            p._epoch = self._epoch
+        if self.mgr and self._barrier_seq % self.checkpoint_frequency == 0:
+            self._commit(self._epoch)
+        self.barrier_latencies_ms.append((time.perf_counter() - t0) * 1e3)
+        return outs
+
+    def tick(self) -> bool:
+        """Barrier iff ``barrier_interval_ms`` elapsed since the last
+        one (ScheduledBarriers min-interval tick). Returns whether a
+        barrier fired."""
+        now = time.time()
+        if (now - self._last_barrier_at) * 1000 < self.barrier_interval_ms:
+            return False
+        self._last_barrier_at = now
+        self.barrier()
+        return True
+
+    def p99_barrier_ms(self) -> float:
+        if not self.barrier_latencies_ms:
+            return 0.0
+        return float(np.percentile(self.barrier_latencies_ms, 99))
+
+    # -- checkpoint lane -------------------------------------------------
+    def _commit(self, epoch: int) -> None:
+        self._raise_worker_error()
+        if not self.async_checkpoint:
+            self.mgr.commit_epoch(epoch, self.executors())
+            return
+        # stage synchronously on the main thread (device pull + eager
+        # mark flips), upload asynchronously
+        staged = []
+        for ex in self.executors():
+            if isinstance(ex, Checkpointable):
+                staged.extend(ex.checkpoint_delta())
+        with self._inflight_lock:
+            self._inflight += 1
+        self._work_q.append((epoch, staged))
+        self._ensure_worker()
+        self._work_event.set()
+
+    def _ensure_worker(self):
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._worker_loop, daemon=True
+            )
+            self._worker.start()
+
+    def _worker_loop(self):
+        while True:
+            self._work_event.wait(timeout=0.5)
+            self._work_event.clear()
+            while self._work_q:
+                epoch, staged = self._work_q.popleft()
+                try:
+                    self._upload_epoch(epoch, staged)
+                except BaseException as e:  # surfaced on main thread
+                    self._work_err.append(e)
+                finally:
+                    with self._inflight_lock:
+                        self._inflight -= 1
+
+    def _upload_epoch(self, epoch: int, staged) -> None:
+        """Worker-side: SSTs + manifest, in epoch order (the queue is
+        FIFO and single-worker, so order holds)."""
+        mgr = self.mgr
+        tables = mgr.version["tables"]
+        for delta in staged:
+            if len(delta.tombstone) == 0:
+                continue
+            blob = build_sst(
+                delta.table_id,
+                epoch,
+                delta.key_cols,
+                delta.value_cols,
+                delta.tombstone,
+                delta.key_order,
+            )
+            path = f"{mgr.prefix}/sst/{delta.table_id}/{epoch:020d}.sst"
+            mgr.store.put(path, blob)
+            tables.setdefault(delta.table_id, []).append(
+                {"path": path, "epoch": epoch}
+            )
+        mgr.version["max_committed_epoch"] = epoch
+        mgr._persist_version()
+        mgr._maybe_compact(epoch)
+
+    def wait_checkpoints(self) -> None:
+        """Join the async lane (the FLUSH / sync-epoch analogue)."""
+        while True:
+            with self._inflight_lock:
+                if self._inflight == 0:
+                    break
+            time.sleep(0.002)
+        self._raise_worker_error()
+
+    def _raise_worker_error(self):
+        if self._work_err:
+            raise RuntimeError(
+                "async checkpoint failed"
+            ) from self._work_err[0]
+
+    # -- recovery --------------------------------------------------------
+    def recover(self) -> None:
+        """Rebuild all fragment state from the last committed epoch."""
+        if not self.mgr:
+            raise RuntimeError("no object store configured")
+        self.mgr.recover(self.executors())
+        self._epoch = self.mgr.max_committed_epoch
+        for p in self.fragments.values():
+            p._epoch = self._epoch

@@ -1,0 +1,24 @@
+"""Storage — object store, SSTs, checkpoint/recovery (Hummock-lite).
+
+Reference: src/object_store/, src/storage/ (Hummock). See module docs.
+"""
+
+from risingwave_tpu.storage.object_store import (
+    LocalFsObjectStore,
+    MemObjectStore,
+    ObjectStore,
+)
+from risingwave_tpu.storage.state_table import (
+    Checkpointable,
+    CheckpointManager,
+    StateDelta,
+)
+
+__all__ = [
+    "ObjectStore",
+    "MemObjectStore",
+    "LocalFsObjectStore",
+    "Checkpointable",
+    "CheckpointManager",
+    "StateDelta",
+]

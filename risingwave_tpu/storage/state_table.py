@@ -1,0 +1,245 @@
+"""StateTable checkpoint layer — Hummock-lite version + commit_epoch.
+
+Reference roles replaced:
+- ``StateTable::commit`` staging an epoch's memtable into the shared
+  buffer for upload (src/stream/src/common/table/state_table.rs:1140,
+  src/storage/src/hummock/event_handler/uploader.rs:548);
+- ``HummockManager::commit_epoch`` pinning uploaded SSTs into a new
+  HummockVersion (src/meta/src/hummock/manager/commit_epoch.rs:93);
+- full-merge compaction (src/storage/src/hummock/compactor/).
+
+TPU re-design: executor state lives in HBM as slot-indexed arrays;
+``sdirty``/``stored`` lanes on the device state track what changed
+since the last checkpoint. At a checkpoint barrier each Checkpointable
+executor stages its delta (device→host pull, compacted to the changed
+rows), the manager writes one SST per table, then commits the MANIFEST
+atomically — the epoch is durable iff the manifest says so (a crash
+between SST puts and manifest write recovers to the previous epoch;
+orphan SSTs are ignored and reclaimed by compaction GC).
+
+Recovery: ``recover(executors)`` merge-reads each table's SSTs
+(newest-epoch-wins, tombstones drop) and hands the surviving rows to
+the executor's ``restore_state`` to rebuild device state.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.storage.object_store import ObjectStore
+from risingwave_tpu.storage.sstable import build_sst, merge_ssts, read_sst
+
+MANIFEST = "MANIFEST"
+COMPACT_AT = 8  # SSTs per table before a full-merge compaction
+
+
+@dataclass
+class StateDelta:
+    """One table's staged epoch delta (host-side, compacted).
+
+    Staging flips the executor's device sdirty/stored marks EAGERLY —
+    slot indices shift on rehash, so a deferred flip would hit wrong
+    slots. The durability contract is therefore the reference's
+    (barrier/mod.rs:676): if a commit FAILS, in-memory marks are ahead
+    of storage and the process MUST recover() from the last durable
+    manifest — never retry the commit against live state.
+    """
+
+    table_id: str
+    key_cols: Dict[str, np.ndarray]
+    value_cols: Dict[str, np.ndarray]
+    tombstone: np.ndarray
+    key_order: Tuple[str, ...]
+
+
+def stage_marks(
+    sdirty: np.ndarray, alive: np.ndarray, stored: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """The shared upsert/tombstone classification every Checkpointable
+    executor uses: returns (upsert_mask, tombstone_mask, sel_indices)."""
+    upsert = sdirty & alive
+    tomb = sdirty & stored & ~alive
+    return upsert, tomb, np.flatnonzero(upsert | tomb)
+
+
+def grow_pow2(n: int, cap: int, grow_at: float = 0.5) -> int:
+    """Smallest power-of-two capacity >= cap holding n under grow_at."""
+    while n > cap * grow_at:
+        cap *= 2
+    return cap
+
+
+def pull_rows(device_lanes: Dict[str, object], sel: np.ndarray) -> Dict[str, np.ndarray]:
+    """Device->host transfer of SELECTED rows only (checkpoint staging
+    must be O(changed rows), not O(capacity)). ``sel`` is padded to a
+    power-of-two bucket so jit caches one gather program per bucket
+    size instead of recompiling per distinct count."""
+    n = len(sel)
+    if n == 0:
+        return {k: np.asarray(a)[:0] for k, a in device_lanes.items()}
+    pad = 1 << (n - 1).bit_length()
+    idx = np.zeros(pad, np.int32)
+    idx[:n] = sel
+    gathered = _gather(dict(device_lanes), jnp.asarray(idx))
+    return {k: np.asarray(a)[:n] for k, a in gathered.items()}
+
+
+@jax.jit
+def _gather(lanes, idx):
+    return jax.tree.map(lambda a: a[idx], lanes)
+
+
+class Checkpointable:
+    """Executor mixin: stateful executors that persist through the
+    checkpoint manager implement these three members."""
+
+    table_id: str = ""
+
+    def checkpoint_table_ids(self) -> List[str]:
+        return [self.table_id]
+
+    def checkpoint_delta(self) -> List[StateDelta]:
+        """Stage rows changed since the last checkpoint and CLEAR the
+        device-side sdirty marks (update stored marks)."""
+        raise NotImplementedError
+
+    def restore_state(
+        self, table_id: str, key_cols: Dict[str, np.ndarray],
+        value_cols: Dict[str, np.ndarray],
+    ) -> None:
+        raise NotImplementedError
+
+
+class CheckpointManager:
+    """Version authority + per-epoch committer (meta-lite)."""
+
+    def __init__(self, store: ObjectStore, prefix: str = "hummock"):
+        self.store = store
+        self.prefix = prefix
+        self.version = {"max_committed_epoch": 0, "tables": {}}
+        self._load()
+
+    # -- version ---------------------------------------------------------
+    def _manifest_path(self) -> str:
+        return f"{self.prefix}/{MANIFEST}"
+
+    def _load(self):
+        if self.store.exists(self._manifest_path()):
+            self.version = json.loads(self.store.read(self._manifest_path()))
+
+    def _persist_version(self):
+        self.store.put(
+            self._manifest_path(), json.dumps(self.version).encode()
+        )
+
+    @property
+    def max_committed_epoch(self) -> int:
+        return int(self.version["max_committed_epoch"])
+
+    # -- commit path -----------------------------------------------------
+    def commit_epoch(self, epoch: int, executors: Sequence[object]) -> int:
+        """Stage every Checkpointable executor's delta, upload SSTs,
+        then commit the manifest. Staging flips device marks eagerly
+        (see StateDelta), so if this raises, the caller must recover()
+        from the last durable manifest before continuing — matching the
+        reference's failed-barrier -> global recovery contract.
+        Returns the number of SSTs written."""
+        if epoch <= self.max_committed_epoch:
+            raise ValueError(
+                f"epoch {epoch} <= committed {self.max_committed_epoch}"
+            )
+        staged: List[StateDelta] = []
+        seen_ids = set()
+        for ex in executors:
+            if not isinstance(ex, Checkpointable):
+                continue
+            for delta in ex.checkpoint_delta():
+                if delta.table_id in seen_ids:
+                    raise ValueError(
+                        f"duplicate table_id {delta.table_id!r} in one "
+                        "commit — give each executor a unique table_id"
+                    )
+                seen_ids.add(delta.table_id)
+                staged.append(delta)
+
+        n = 0
+        tables = self.version["tables"]
+        for delta in staged:
+            if len(delta.tombstone) == 0:
+                continue
+            blob = build_sst(
+                delta.table_id,
+                epoch,
+                delta.key_cols,
+                delta.value_cols,
+                delta.tombstone,
+                delta.key_order,
+            )
+            path = f"{self.prefix}/sst/{delta.table_id}/{epoch:020d}.sst"
+            self.store.put(path, blob)
+            tables.setdefault(delta.table_id, []).append(
+                {"path": path, "epoch": epoch}
+            )
+            n += 1
+        self.version["max_committed_epoch"] = epoch
+        self._persist_version()
+        self._maybe_compact(epoch)
+        return n
+
+    # -- compaction ------------------------------------------------------
+    def _maybe_compact(self, epoch: int):
+        """Full-merge compaction per table once its L0 run gets long
+        (fast_compactor_runner analogue, synchronous v0): merge every
+        SST into one at the current epoch; tombstones drop entirely
+        (nothing older survives a full merge)."""
+        for table_id, entries in self.version["tables"].items():
+            if len(entries) < COMPACT_AT:
+                continue
+            ssts = [read_sst(self.store.read(e["path"])) for e in entries]
+            key_order = ssts[-1].meta.key_names
+            keys, values = merge_ssts(ssts, key_order)
+            n_rows = len(next(iter(keys.values()))) if keys else 0
+            blob = build_sst(
+                table_id,
+                epoch,
+                keys,
+                values,
+                np.zeros(n_rows, bool),
+                key_order,
+            )
+            path = f"{self.prefix}/sst/{table_id}/{epoch:020d}.compact.sst"
+            self.store.put(path, blob)
+            old = list(entries)
+            self.version["tables"][table_id] = [
+                {"path": path, "epoch": epoch}
+            ]
+            self._persist_version()
+            for e in old:  # GC after the new version is durable
+                self.store.delete(e["path"])
+
+    # -- recovery --------------------------------------------------------
+    def read_table(
+        self, table_id: str
+    ) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
+        entries = self.version["tables"].get(table_id, [])
+        ssts = [read_sst(self.store.read(e["path"])) for e in entries]
+        if not ssts:
+            return {}, {}
+        return merge_ssts(ssts, ssts[-1].meta.key_names)
+
+    def recover(self, executors: Sequence[object]) -> None:
+        """Rebuild every Checkpointable executor's device state from
+        the last committed version (recovery from max_committed_epoch,
+        barrier/recovery.rs:353)."""
+        for ex in executors:
+            if not isinstance(ex, Checkpointable):
+                continue
+            for table_id in ex.checkpoint_table_ids():
+                keys, values = self.read_table(table_id)
+                ex.restore_state(table_id, keys, values)

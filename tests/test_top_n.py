@@ -1,0 +1,154 @@
+"""GroupTopN oracle tests — emitted deltas replay to exactly each
+group's top-k (reference: top_n executor tests, top_n_cache.rs)."""
+
+import numpy as np
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.executors import Barrier, GroupTopNExecutor, Watermark
+from risingwave_tpu.executors.base import Epoch
+from risingwave_tpu.types import Op
+
+import jax.numpy as jnp
+
+
+def _replay(outs, snap, names=("g", "v", "p")):
+    for out in outs:
+        d = out.to_numpy(with_ops=True)
+        for i in range(len(d["__op__"])):
+            row = tuple(int(d[n][i]) for n in names)
+            delta = 1 if d["__op__"][i] == Op.INSERT else -1
+            snap[row] = snap.get(row, 0) + delta
+            if snap[row] == 0:
+                del snap[row]
+    return snap
+
+
+def _chunk(g, v, p, cap=64, ops=None):
+    return StreamChunk.from_numpy(
+        {
+            "g": np.asarray(g, np.int64),
+            "v": np.asarray(v, np.int64),
+            "p": np.asarray(p, np.int64),
+        },
+        cap,
+        ops=ops,
+    )
+
+
+def _oracle(rows, k, desc=True):
+    """rows: list of (g, v, p) -> expected multiset of top-k rows."""
+    from collections import defaultdict
+
+    groups = defaultdict(list)
+    for i, (g, v, p) in enumerate(rows):
+        groups[g].append((v, i, p))
+    want = {}
+    for g, items in groups.items():
+        items.sort(key=lambda t: (-t[0], t[1]) if desc else (t[0], t[1]))
+        for v, _, p in items[:k]:
+            key = (g, v, p)
+            want[key] = want.get(key, 0) + 1
+    return want
+
+
+def test_topn_basic_and_eviction():
+    ex = GroupTopNExecutor(
+        ("g",), "v", k=2,
+        schema_dtypes={"g": jnp.int64, "v": jnp.int64, "p": jnp.int64},
+        payload=("p",), desc=True, capacity=1 << 8, out_cap=1 << 8,
+    )
+    snap = {}
+    _replay(ex.apply(_chunk([1, 1, 1], [10, 30, 20], [100, 101, 102])), snap)
+    ex.on_barrier(Barrier(Epoch(0, 1)))
+    assert snap == {(1, 30, 101): 1, (1, 20, 102): 1}
+
+    # a higher row evicts the current #2
+    _replay(ex.apply(_chunk([1], [25], [103])), snap)
+    assert snap == {(1, 30, 101): 1, (1, 25, 103): 1}
+    # a lower row changes nothing
+    _replay(ex.apply(_chunk([1], [5], [104])), snap)
+    assert snap == {(1, 30, 101): 1, (1, 25, 103): 1}
+
+
+def test_topn_random_vs_oracle(rng):
+    k = 4
+    ex = GroupTopNExecutor(
+        ("g",), "v", k=k,
+        schema_dtypes={"g": jnp.int64, "v": jnp.int64, "p": jnp.int64},
+        payload=("p",), desc=True, capacity=1 << 6,  # force regrows
+        out_cap=1 << 10,
+    )
+    snap, rows = {}, []
+    for _ in range(12):
+        n = int(rng.integers(5, 60))
+        g = rng.integers(0, 30, n).astype(np.int64)
+        v = rng.integers(0, 10_000, n).astype(np.int64)  # ~unique orders
+        p = rng.integers(0, 1000, n).astype(np.int64)
+        rows += list(zip(g.tolist(), v.tolist(), p.tolist()))
+        _replay(ex.apply(_chunk(g, v, p)), snap)
+        ex.on_barrier(Barrier(Epoch(0, 1)))
+    assert snap == _oracle(rows, k)
+    assert len(snap) > 50
+
+
+def test_topn_asc_order(rng):
+    k = 3
+    ex = GroupTopNExecutor(
+        ("g",), "v", k=k,
+        schema_dtypes={"g": jnp.int64, "v": jnp.int64, "p": jnp.int64},
+        payload=("p",), desc=False, capacity=1 << 8, out_cap=1 << 10,
+    )
+    snap, rows = {}, []
+    for _ in range(5):
+        n = 40
+        g = rng.integers(0, 10, n).astype(np.int64)
+        v = rng.integers(-5000, 5000, n).astype(np.int64)
+        p = rng.integers(0, 100, n).astype(np.int64)
+        rows += list(zip(g.tolist(), v.tolist(), p.tolist()))
+        _replay(ex.apply(_chunk(g, v, p)), snap)
+    ex.on_barrier(Barrier(Epoch(0, 1)))
+    assert snap == _oracle(rows, k, desc=False)
+
+
+def test_topn_checkpoint_recovery(rng):
+    from risingwave_tpu.storage import CheckpointManager, MemObjectStore
+
+    store = MemObjectStore()
+    mgr = CheckpointManager(store)
+
+    def mk():
+        return GroupTopNExecutor(
+            ("g",), "v", k=3,
+            schema_dtypes={"g": jnp.int64, "v": jnp.int64, "p": jnp.int64},
+            payload=("p",), capacity=1 << 8, out_cap=1 << 10,
+            table_id="topn",
+        )
+
+    ex = mk()
+    snap = {}
+    epoch = 0
+    for _ in range(4):
+        n = 50
+        g = rng.integers(0, 20, n).astype(np.int64)
+        v = rng.integers(0, 100_000, n).astype(np.int64)
+        p = rng.integers(0, 100, n).astype(np.int64)
+        _replay(ex.apply(_chunk(g, v, p)), snap)
+        ex.on_barrier(Barrier(Epoch(epoch, epoch + 1)))
+        epoch += 1
+        mgr.commit_epoch(epoch, [ex])
+
+    ex2 = mk()
+    CheckpointManager(store).recover([ex2])
+    # both see identical emissions for identical future input
+    g = rng.integers(0, 20, 30).astype(np.int64)
+    v = rng.integers(0, 100_000, 30).astype(np.int64)
+    p = rng.integers(0, 100, 30).astype(np.int64)
+    out_a = {}
+    out_b = {}
+    _replay(ex.apply(_chunk(g, v, p)), out_a)
+    _replay(ex2.apply(_chunk(g, v, p)), out_b)
+    assert out_a == out_b
+    assert np.array_equal(
+        np.sort(np.asarray(ex.state["order"])[np.asarray(ex.table.live)], axis=None),
+        np.sort(np.asarray(ex2.state["order"])[np.asarray(ex2.table.live)], axis=None),
+    )
