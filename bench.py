@@ -362,30 +362,43 @@ def main():
     )
 
     # -- device pipeline --------------------------------------------------
+    import functools
+
     from risingwave_tpu.array.chunk import StreamChunk
+    from risingwave_tpu.executors.hop_window import hop_step_fn
+    from risingwave_tpu.parallel.sharded_agg import stack_chunks
 
     cap = chunk_events  # bids per chunk <= events per chunk
-    q5 = build_q5_lite(capacity=1 << 18, state_cleaning=False)
-    dev_chunks = [
-        [StreamChunk.from_numpy(c, cap) for c in ep] for ep in host_chunks
-    ]
+    # one fused lax.scan per epoch: hop + agg over every chunk in ONE
+    # device dispatch (per-chunk Python dispatch dominates on TPU)
+    pre = functools.partial(
+        hop_step_fn,
+        ts_col="date_time",
+        size_ms=Q5_WINDOW_MS,
+        slide_ms=Q5_SLIDE_MS,
+        out_start="window_start",
+    )
 
-    # warmup: compile every kernel in the chain
-    q5.pipeline.push(dev_chunks[0][0])
-    q5.pipeline.barrier()
-    warm = build_q5_lite(capacity=1 << 18, state_cleaning=False)
-    q5 = warm  # fresh state, warm jit caches
+    def run_q5(epochs_chunks):
+        q5 = build_q5_lite(capacity=1 << 18, state_cleaning=False)
+        barrier_times = []
+        t0 = time.perf_counter()
+        for stacked in epochs_chunks:
+            q5.agg.apply_stacked(stacked, pre=pre)
+            tb = time.perf_counter()
+            q5.pipeline.barrier()
+            barrier_times.append(time.perf_counter() - tb)
+        jax.block_until_ready(q5.agg.state.row_count)
+        return q5, time.perf_counter() - t0, barrier_times
 
-    barrier_times = []
-    t0 = time.perf_counter()
-    for ep in dev_chunks:
-        for c in ep:
-            q5.pipeline.push(c)
-        tb = time.perf_counter()
-        q5.pipeline.barrier()
-        barrier_times.append(time.perf_counter() - tb)
-    jax.block_until_ready(q5.agg.state.row_count)
-    dt = time.perf_counter() - t0
+    def mk_stacked():
+        return [
+            stack_chunks([StreamChunk.from_numpy(c, cap) for c in ep])
+            for ep in host_chunks
+        ]
+
+    run_q5(mk_stacked()[:1])  # warmup: compile scan + flush
+    q5, dt, barrier_times = run_q5(mk_stacked())
 
     rows_s = total_bids / dt
     p99_barrier_ms = float(np.percentile(np.asarray(barrier_times) * 1e3, 99))

@@ -1,0 +1,10 @@
+"""Batch engine — ad-hoc queries over materialized state.
+
+Reference: src/batch/ (21.6k LoC: BatchTaskExecution + executors) and
+the local execution mode (docs/batch-local-execution-mode.md) — here
+the LOCAL mode only: one-shot queries over MV snapshots.
+"""
+
+from risingwave_tpu.batch.engine import BatchQueryEngine
+
+__all__ = ["BatchQueryEngine"]

@@ -1,0 +1,136 @@
+"""Local-mode batch engine: SELECT over MV snapshots.
+
+Reference: the batch executor chain (src/batch/src/executor/: RowSeqScan
+-> filter -> project -> agg -> order/limit) in local execution mode
+(scheduler/local.rs:60). The scan source is a MaterializeExecutor
+snapshot (the queryable MV) or a recovered storage table; filtering and
+projection run through the same expression framework as streaming.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from risingwave_tpu.array.chunk import DataChunk
+from risingwave_tpu.executors.materialize import MaterializeExecutor
+from risingwave_tpu.sql import parser as P
+from risingwave_tpu.sql.planner import AGG_FUNCS, Binder, compile_scalar
+
+
+class BatchQueryEngine:
+    """``tables`` maps name -> MaterializeExecutor (the MV catalog)."""
+
+    def __init__(self, tables: Dict[str, MaterializeExecutor]):
+        self.tables = dict(tables)
+
+    def register(self, name: str, mview: MaterializeExecutor) -> None:
+        self.tables[name] = mview
+
+    def query(self, sql: str) -> Dict[str, np.ndarray]:
+        stmt = P.parse(sql)
+        if not isinstance(stmt, P.Select):
+            raise ValueError("batch engine runs SELECT only")
+        if isinstance(stmt.from_, P.Join):
+            raise ValueError("batch joins not supported yet")
+        if not isinstance(stmt.from_, P.TableRef):
+            raise ValueError("batch FROM must be an MV name")
+        mv = self.tables[stmt.from_.name]
+        cols = mv.to_numpy()
+        n = len(next(iter(cols.values()))) if cols else 0
+
+        # RowSeqScan -> chunk -> Filter via the shared expr framework
+        schema = {k: v.dtype for k, v in cols.items()}
+        binder = Binder(schema, stmt.from_.alias)
+        if n and stmt.where is not None:
+            cap = max(1, 1 << (n - 1).bit_length())
+            chunk = DataChunk.from_numpy(cols, cap)
+            keep_v, keep_n = compile_scalar(stmt.where, binder).eval(chunk)
+            keep = np.asarray(keep_v).astype(bool)
+            if keep_n is not None:
+                keep &= ~np.asarray(keep_n)
+            keep = keep[:n] & np.asarray(chunk.valid)[:n]
+            cols = {k: v[keep] for k, v in cols.items()}
+            n = int(keep.sum())
+
+        # aggregation / projection
+        if stmt.group_by:
+            keys = [binder.resolve(g) for g in stmt.group_by]
+            out = self._group_agg(stmt, cols, keys, binder)
+        else:
+            out = {}
+            for i, item in enumerate(stmt.items):
+                if isinstance(item.expr, P.FuncCall) and item.expr.name in AGG_FUNCS:
+                    name = item.alias or f"{item.expr.name}_{i}"
+                    out[name] = self._scalar_agg(item.expr, cols, n, binder)
+                else:
+                    name = item.alias or (
+                        item.expr.name if isinstance(item.expr, P.Ident) else f"col{i}"
+                    )
+                    out[name] = self._eval_item(item.expr, cols, n, binder)
+
+        # OrderBy + Limit (src/batch/src/executor/{order_by,limit}.rs)
+        if stmt.order_by:
+            lanes = []
+            for ident, desc in reversed(stmt.order_by):
+                lane = out[ident.name]
+                lanes.append(-lane if desc else lane)
+            order = np.lexsort(tuple(lanes))
+            out = {k: v[order] for k, v in out.items()}
+        if stmt.limit is not None:
+            out = {k: v[: stmt.limit] for k, v in out.items()}
+        return out
+
+    def _eval_item(self, ast, cols, n, binder):
+        if isinstance(ast, P.Ident):
+            return cols[binder.resolve(ast)]
+        cap = max(1, 1 << max(0, (n - 1)).bit_length()) if n else 1
+        chunk = DataChunk.from_numpy(cols, cap)
+        v, _ = compile_scalar(ast, binder).eval(chunk)
+        return np.asarray(v)[:n]
+
+    def _scalar_agg(self, fc, cols, n, binder):
+        if fc.args == ("*",):
+            return np.array([n])
+        x = cols[binder.resolve(fc.args[0])]
+        fn = {"count": len, "sum": np.sum, "min": np.min, "max": np.max}[
+            fc.name
+        ]
+        return np.array([fn(x) if len(x) else 0])
+
+    def _group_agg(self, stmt, cols, keys, binder):
+        import pandas as pd
+
+        df = pd.DataFrame(cols)
+        gb = df.groupby(keys, sort=False)
+        out: Dict[str, np.ndarray] = {}
+        frames = {}
+        for i, item in enumerate(stmt.items):
+            if isinstance(item.expr, P.Ident):
+                name = binder.resolve(item.expr)
+                if name not in keys:
+                    raise ValueError(f"{name!r} not in GROUP BY")
+                continue
+            fc = item.expr
+            if not (isinstance(fc, P.FuncCall) and fc.name in AGG_FUNCS):
+                raise ValueError("items must be keys or aggregates")
+            name = item.alias or f"{fc.name}_{i}"
+            if fc.args == ("*",):
+                frames[name] = gb.size()
+            else:
+                col = binder.resolve(fc.args[0])
+                frames[name] = getattr(gb[col], {
+                    "count": "count", "sum": "sum", "min": "min", "max": "max"
+                }[fc.name])()
+        if frames:
+            res = pd.DataFrame(frames).reset_index()
+        else:  # batch DISTINCT: GROUP BY with no aggregates
+            res = df[keys].drop_duplicates()
+        for item in stmt.items:
+            if isinstance(item.expr, P.Ident):
+                nm = binder.resolve(item.expr)
+                out[item.alias or nm] = res[nm].to_numpy()
+        for name in frames:
+            out[name] = res[name].to_numpy()
+        return out

@@ -1,0 +1,88 @@
+"""Layered configuration — the RwConfig analogue.
+
+Reference: src/common/src/config.rs:138 (``RwConfig { server,
+streaming, storage, ... }``, TOML + serde defaults + an
+``unrecognized`` capture) and src/common/src/system_param/mod.rs:77
+(cluster-wide MUTABLE system params: ``barrier_interval_ms``,
+``checkpoint_frequency``).
+
+Layering (config.rs order): dataclass defaults <- TOML file <-
+explicit overrides. Unknown TOML keys are collected, not fatal —
+matching the reference's forward-compatible `#[serde(default)]` +
+unrecognized-capture pattern.
+"""
+
+from __future__ import annotations
+
+import tomllib
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class StreamingConfig:
+    """config.rs:546 StreamingConfig (the knobs our runtime consumes)."""
+
+    chunk_capacity: int = 4096  # fixed chunk shape (stream_chunk size)
+    in_flight_checkpoints: int = 8  # async upload lane depth
+
+
+@dataclass
+class StorageConfig:
+    """config.rs:631 StorageConfig subset."""
+
+    object_store_root: str = "./rw_state"
+    compact_at: int = 8  # SSTs per table before full-merge compaction
+    bloom_bits_per_key: int = 10
+
+
+@dataclass
+class SystemParams:
+    """Mutable cluster params (system_param/mod.rs:77-78)."""
+
+    barrier_interval_ms: int = 1000
+    checkpoint_frequency: int = 1
+
+
+@dataclass
+class RwConfig:
+    streaming: StreamingConfig = field(default_factory=StreamingConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
+    system: SystemParams = field(default_factory=SystemParams)
+    unrecognized: Dict[str, Any] = field(default_factory=dict)
+
+
+def _apply(section_obj, values: Dict[str, Any], unrecognized: Dict[str, Any], prefix: str):
+    known = {f.name for f in fields(section_obj)}
+    for k, v in values.items():
+        if k in known:
+            setattr(section_obj, k, v)
+        else:
+            unrecognized[f"{prefix}.{k}"] = v
+
+
+def load_config(
+    path: Optional[str] = None, overrides: Optional[Dict[str, Any]] = None
+) -> RwConfig:
+    """TOML file (optional) + dotted-path overrides, e.g.
+    ``{"system.barrier_interval_ms": 250}``."""
+    cfg = RwConfig()
+    if path is not None:
+        with open(path, "rb") as f:
+            data = tomllib.load(f)
+        for section in ("streaming", "storage", "system"):
+            if section in data:
+                _apply(
+                    getattr(cfg, section), data.pop(section),
+                    cfg.unrecognized, section,
+                )
+        for k, v in data.items():
+            cfg.unrecognized[k] = v
+    for dotted, v in (overrides or {}).items():
+        section, _, key = dotted.partition(".")
+        obj = getattr(cfg, section, None)
+        if obj is None or not hasattr(obj, key):
+            cfg.unrecognized[dotted] = v
+        else:
+            setattr(obj, key, v)
+    return cfg

@@ -5,3 +5,8 @@ reference src/connector/src/source/nexmark/) and a datagen-style random
 source; external systems (Kafka etc.) are out of scope until the
 network edge exists.
 """
+
+from risingwave_tpu.connectors.nexmark import NexmarkConfig, NexmarkGenerator
+from risingwave_tpu.connectors.source import NexmarkSourceExecutor
+
+__all__ = ["NexmarkConfig", "NexmarkGenerator", "NexmarkSourceExecutor"]

@@ -101,6 +101,20 @@ class NexmarkConfig:
     auction_duration_ms: int = 10_000
 
 
+_M64 = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def _mix64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer — the counter-based RNG core: randomness is
+    a PURE function of (seed, split, event ordinal, use-site), so the
+    stream is identical no matter how generation is batched (offset
+    resume replays exactly; code-review r2 finding #6)."""
+    x = (x + np.uint64(0x9E3779B97F4A7C15)) & _M64
+    x = ((x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)) & _M64
+    x = ((x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)) & _M64
+    return x ^ (x >> np.uint64(31))
+
+
 def _last_base0_person_id(event_id: np.ndarray) -> np.ndarray:
     epoch = event_id // PROPORTION_DENOMINATOR
     offset = event_id % PROPORTION_DENOMINATOR
@@ -139,8 +153,8 @@ class NexmarkGenerator:
         self.config = config if config is not None else NexmarkConfig()
         self.split_index = split_index
         self.split_num = split_num
+        self.seed = seed
         self._next_ordinal = 0  # ordinal within this split
-        self._rng = np.random.default_rng((seed, split_index))
         # VARCHAR codes are only equality-complete if every split shares
         # ONE dictionary set; private per-split dictionaries would assign
         # diverging codes to the same string and silently break
@@ -161,6 +175,24 @@ class NexmarkGenerator:
         self._name_codes = self.dicts["name"].encode(
             [f"{f} {l}" for f in _FIRST for l in _LAST]
         )
+        self._item_codes = self.dicts["item_name"].encode(
+            [f"item-{c}" for c in range(997)]
+        )
+
+    def _h(self, eid: np.ndarray, site: int) -> np.ndarray:
+        """64 random bits per EVENT for one use site."""
+        seed_mix = (self.seed * 0xC2B2AE3D27D4EB4F) & 0xFFFFFFFFFFFFFFFF
+        salt = (seed_mix ^ (site << 32)) & 0xFFFFFFFFFFFFFFFF
+        x = eid.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
+        return _mix64(x ^ np.uint64(salt))
+
+    def _randbelow(self, eid: np.ndarray, site: int, n) -> np.ndarray:
+        return (self._h(eid, site) % np.asarray(n).astype(np.uint64)).astype(
+            np.int64
+        )
+
+    def _u01(self, eid: np.ndarray, site: int) -> np.ndarray:
+        return (self._h(eid, site) >> np.uint64(11)) * (2.0 ** -53)
 
     @staticmethod
     def make_dictionaries() -> Dict[str, StringDictionary]:
@@ -203,13 +235,13 @@ class NexmarkGenerator:
         return {
             "id": pid,
             "name": self._name_codes[
-                self._rng.integers(0, len(self._name_codes), n)
+                self._randbelow(eid, 1, len(self._name_codes))
             ].astype(np.int32),
             "city": self._city_codes[
-                self._rng.integers(0, len(self._city_codes), n)
+                self._randbelow(eid, 2, len(self._city_codes))
             ].astype(np.int32),
             "state": self._state_codes[
-                self._rng.integers(0, len(self._state_codes), n)
+                self._randbelow(eid, 3, len(self._state_codes))
             ].astype(np.int32),
             "date_time": ts,
         }
@@ -220,61 +252,66 @@ class NexmarkGenerator:
         aid = _last_base0_auction_id(eid) + FIRST_AUCTION_ID
         # seller: mostly the most recent "hot" person, else a recent one
         last_p = _last_base0_person_id(eid)
-        hot = self._rng.integers(0, cfg.hot_seller_ratio, n) > 0
+        hot = self._randbelow(eid, 4, cfg.hot_seller_ratio) > 0
         hot_seller = (last_p // cfg.hot_seller_ratio) * cfg.hot_seller_ratio
         active = np.minimum(last_p + 1, cfg.num_active_people)
-        cold_seller = last_p - self._rng.integers(0, np.maximum(active, 1))
+        cold_seller = last_p - self._randbelow(eid, 5, np.maximum(active, 1))
         seller = np.where(hot, hot_seller, cold_seller) + FIRST_PERSON_ID
-        initial = self._next_price(n)
-        item = self.dicts["item_name"].encode(
-            [f"item-{c}" for c in (aid % 997).tolist()]
-        )
+        initial = self._price(eid, 6)
+        item = self._item_codes[aid % 997]
         return {
             "id": aid,
             "item_name": item.astype(np.int32),
             "initial_bid": initial,
-            "reserve": initial
-            + self._next_price(n) // 10,
+            "reserve": initial + self._price(eid, 7) // 10,
             "date_time": ts,
             "expires": ts + cfg.auction_duration_ms,
             "seller": seller,
-            "category": FIRST_CATEGORY_ID + self._rng.integers(0, 5, n),
+            "category": FIRST_CATEGORY_ID + self._randbelow(eid, 8, 5),
         }
 
     def _bids(self, eid: np.ndarray, ts: np.ndarray):
         n = len(eid)
         cfg = self.config
         last_a = _last_base0_auction_id(eid)
-        hot_a = self._rng.integers(0, cfg.hot_auction_ratio, n) > 0
+        hot_a = self._randbelow(eid, 9, cfg.hot_auction_ratio) > 0
         hot_auction = (last_a // cfg.hot_auction_ratio) * cfg.hot_auction_ratio
         in_flight = np.maximum(np.minimum(last_a + 1, cfg.num_in_flight_auctions), 1)
-        cold_auction = last_a - self._rng.integers(0, in_flight)
+        cold_auction = last_a - self._randbelow(eid, 10, in_flight)
         auction = np.where(hot_a, hot_auction, cold_auction) + FIRST_AUCTION_ID
 
         last_p = _last_base0_person_id(eid)
-        hot_b = self._rng.integers(0, cfg.hot_bidder_ratio, n) > 0
+        hot_b = self._randbelow(eid, 11, cfg.hot_bidder_ratio) > 0
         hot_bidder = (last_p // cfg.hot_bidder_ratio) * cfg.hot_bidder_ratio + 1
         active = np.maximum(np.minimum(last_p + 1, cfg.num_active_people), 1)
-        cold_bidder = last_p - self._rng.integers(0, active)
+        cold_bidder = last_p - self._randbelow(eid, 12, active)
         bidder = np.where(hot_b, hot_bidder, cold_bidder) + FIRST_PERSON_ID
 
         return {
             "auction": auction,
             "bidder": bidder,
-            "price": self._next_price(n),
+            "price": self._price(eid, 13),
             "channel": self._chan_codes[
-                self._rng.integers(0, len(self._chan_codes), n)
+                self._randbelow(eid, 14, len(self._chan_codes))
             ].astype(np.int32),
             "date_time": ts,
         }
 
-    def _next_price(self, n: int) -> np.ndarray:
+    def _price(self, eid: np.ndarray, site: int) -> np.ndarray:
         """Spec price distribution: round(10^(U[0,1)*6) * 100) cents."""
         return np.round(
-            np.power(10.0, self._rng.random(n) * 6.0) * 100.0
+            np.power(10.0, self._u01(eid, site) * 6.0) * 100.0
         ).astype(np.int64)
 
     # -- chunk-producing source edge ------------------------------------
+    # -- seekable-split offset API (reader.rs:42 offset semantics) ------
+    @property
+    def offset(self) -> int:
+        return self._next_ordinal
+
+    def seek(self, offset: int) -> None:
+        self._next_ordinal = int(offset)
+
     def next_chunks(
         self, count: int, capacity: int
     ) -> Dict[str, Optional[StreamChunk]]:

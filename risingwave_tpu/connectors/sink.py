@@ -1,0 +1,144 @@
+"""Sink framework — the stream's exit edge.
+
+Reference: src/connector/src/sink/ (``Sink``/``SinkWriter`` traits,
+sink/mod.rs:337, writer.rs:35), ``trivial.rs`` blackhole, and
+``common/compact_chunk.rs`` (collapse +/- churn per pk before
+emitting downstream systems).
+
+v0 scope: blackhole + local file (jsonl) sinks behind a SinkExecutor
+with per-pk chunk compaction; epoch-batched delivery commits at
+barrier (the decoupled log-store path arrives with the network edge).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.array.dictionary import StringDictionary
+from risingwave_tpu.executors.base import Barrier, Executor
+from risingwave_tpu.types import Op
+
+
+def compact_rows(rows: List[Tuple[Tuple, Tuple, int]]) -> List[Tuple[Tuple, Tuple, int]]:
+    """Collapse a barrier's buffered (pk, row, op) sequence to the net
+    effect per pk (compact_chunk.rs): the last surviving state wins —
+    insert+delete cancels, delete+insert becomes an update pair."""
+    first_op: Dict[Tuple, int] = {}
+    last: Dict[Tuple, Optional[Tuple]] = {}
+    order: List[Tuple] = []
+    for pk, row, op in rows:
+        if pk not in last:
+            order.append(pk)
+            first_op[pk] = op
+        if op in (Op.DELETE, Op.UPDATE_DELETE):
+            last[pk] = None
+        else:
+            last[pk] = row
+    out: List[Tuple[Tuple, Tuple, int]] = []
+    for pk in order:
+        row = last[pk]
+        came_in_as_insert = first_op[pk] in (Op.INSERT, Op.UPDATE_INSERT)
+        if row is None:
+            if not came_in_as_insert:
+                # existed before the barrier, gone now -> delete
+                out.append((pk, None, Op.DELETE))
+            # else: appeared and vanished within the epoch -> nothing
+        else:
+            out.append((pk, row, Op.INSERT))
+    return out
+
+
+class Sink:
+    """Reference ``Sink`` trait narrowed to the epoch-batched path."""
+
+    def write_batch(self, rows, epoch: int) -> None:
+        raise NotImplementedError
+
+    def commit(self, epoch: int) -> None:
+        pass
+
+
+class BlackholeSink(Sink):
+    """sink/trivial.rs — counts and drops."""
+
+    def __init__(self):
+        self.rows_written = 0
+        self.commits = 0
+
+    def write_batch(self, rows, epoch: int) -> None:
+        self.rows_written += len(rows)
+
+    def commit(self, epoch: int) -> None:
+        self.commits += 1
+
+
+class FileSink(Sink):
+    """Append-only jsonl file sink with epoch markers; VARCHAR columns
+    decode through their dictionary when provided."""
+
+    def __init__(
+        self,
+        path: str,
+        columns: Sequence[str],
+        dictionaries: Optional[Dict[str, StringDictionary]] = None,
+    ):
+        self.path = path
+        self.columns = tuple(columns)
+        self.dicts = dictionaries or {}
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "a", buffering=1 << 16)
+
+    def write_batch(self, rows, epoch: int) -> None:
+        for pk, row, op in rows:
+            if row is None:
+                rec = {"op": "delete", "pk": list(pk)}
+            else:
+                vals = []
+                for name, v in zip(self.columns, row):
+                    d = self.dicts.get(name)
+                    vals.append(d.decode_one(int(v)) if d is not None else v)
+                rec = {"op": "insert", "pk": list(pk), "row": vals}
+            self._f.write(json.dumps(rec, default=int) + "\n")
+
+    def commit(self, epoch: int) -> None:
+        self._f.write(json.dumps({"op": "commit", "epoch": epoch}) + "\n")
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self):
+        self._f.close()
+
+
+class SinkExecutor(Executor):
+    """Chain-tail executor: buffers the epoch's deltas, compacts per
+    pk at the barrier, delivers one batch, commits (reference:
+    executor/sink.rs:40 + compact_chunk re-ordering)."""
+
+    def __init__(self, sink: Sink, pk: Sequence[str], columns: Sequence[str]):
+        self.sink = sink
+        self.pk = tuple(pk)
+        self.columns = tuple(columns)
+        self._buffer: List[Tuple[Tuple, Tuple, int]] = []
+
+    def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
+        d = chunk.to_numpy(with_ops=True)
+        ops = d["__op__"]
+        for i in range(len(ops)):
+            pk = tuple(d[n][i].item() for n in self.pk)
+            row = tuple(d[n][i].item() for n in self.columns)
+            self._buffer.append((pk, row, int(ops[i])))
+        return [chunk]
+
+    def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
+        batch = compact_rows(self._buffer)
+        self._buffer = []
+        epoch = barrier.epoch.curr if barrier else 0
+        self.sink.write_batch(batch, epoch)
+        if barrier is None or barrier.checkpoint:
+            self.sink.commit(epoch)
+        return []

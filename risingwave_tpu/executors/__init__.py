@@ -19,6 +19,7 @@ from risingwave_tpu.executors.dedup import AppendOnlyDedupExecutor
 from risingwave_tpu.executors.dynamic_filter import DynamicMaxFilterExecutor
 from risingwave_tpu.executors.hash_join import HashJoinExecutor
 from risingwave_tpu.executors.materialize import MaterializeExecutor
+from risingwave_tpu.executors.row_id_gen import RowIdGenExecutor
 from risingwave_tpu.executors.top_n import GroupTopNExecutor
 
 __all__ = [
@@ -34,4 +35,5 @@ __all__ = [
     "HashJoinExecutor",
     "GroupTopNExecutor",
     "MaterializeExecutor",
+    "RowIdGenExecutor",
 ]

@@ -105,6 +105,32 @@ _agg_step = jax.jit(
 )
 
 
+@partial(
+    jax.jit,
+    static_argnames=("calls", "group_keys", "nullable", "pre"),
+    donate_argnums=(0, 1),
+)
+def _agg_scan(
+    table, state, dropped, stacked, calls, group_keys, nullable, pre
+):
+    """lax.scan over a (n_chunks, ...) stacked chunk batch — one fused
+    device program per epoch (see HashAggExecutor.apply_stacked)."""
+
+    def body(carry, chunk):
+        table, state, dropped = carry
+        if pre is not None:
+            chunk = pre(chunk)
+        table, state, dropped = agg_step_fn(
+            table, state, dropped, chunk, calls, group_keys, nullable
+        )
+        return (table, state, dropped), None
+
+    (table, state, dropped), _ = jax.lax.scan(
+        body, (table, state, dropped), stacked
+    )
+    return table, state, dropped
+
+
 @partial(jax.jit, static_argnames=("calls", "new_cap"))
 def _rehash(
     table: HashTable,
@@ -247,6 +273,37 @@ class HashAggExecutor(Executor, Checkpointable):
             self.calls,
             self.group_keys,
             self.nullable,
+        )
+        return []
+
+    def apply_stacked(self, stacked: StreamChunk, pre=None) -> List[StreamChunk]:
+        """Apply a whole BATCH of chunks in one device dispatch.
+
+        ``stacked`` carries a leading (n_chunks,) axis on every lane
+        (see array.chunk stacking); the agg step runs as a
+        ``lax.scan`` over that axis with the state as carry, so an
+        entire epoch costs ONE dispatch instead of n_chunks (the
+        per-chunk Python dispatch dominates on TPU otherwise).
+        ``pre`` is an optional pure chunk->chunk function (e.g. the hop
+        expansion) traced INSIDE the scan body, fusing the upstream
+        stateless operators into the same program.
+        """
+        n_chunks, cap = stacked.valid.shape[:2]
+        probe = jax.eval_shape(
+            pre if pre is not None else (lambda c: c),
+            jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), stacked),
+        )
+        self._maybe_grow(n_chunks * probe.valid.shape[0])
+        self._insert_bound += n_chunks * probe.valid.shape[0]
+        self.table, self.state, self.dropped = _agg_scan(
+            self.table,
+            self.state,
+            self.dropped,
+            stacked,
+            self.calls,
+            self.group_keys,
+            self.nullable,
+            pre,
         )
         return []
 

@@ -53,6 +53,8 @@ class MaterializeExecutor(Executor, Checkpointable):
         # the per-barrier delta apply is C-speed zip/dict ops, not a
         # per-row Python loop.
         def tuples(names):
+            if not names:  # value-less MV (pk covers every column)
+                return [()] * n
             lanes = []
             for name in names:
                 col = data[name].tolist()

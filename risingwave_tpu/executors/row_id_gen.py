@@ -1,0 +1,53 @@
+"""RowIdGen executor — hidden serial pk for pk-less streams.
+
+Reference: src/stream/src/executor/row_id_gen.rs — assigns a serial
+row id per vnode so append-only tables without a user pk still have a
+stable one. Here: ids are ``base + lane`` per chunk with a host-side
+base counter. The counter CHECKPOINTS (the reference persists row-id
+state the same way): a recovered pipeline continues the id sequence
+instead of colliding with restored MV pks.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.executors.base import Executor
+from risingwave_tpu.storage.state_table import Checkpointable, StateDelta
+
+
+class RowIdGenExecutor(Executor, Checkpointable):
+    def __init__(self, out_col: str = "_row_id", table_id: str = "row_id_gen"):
+        self.out_col = out_col
+        self.table_id = table_id
+        self._base = 0
+        self._committed = -1
+
+    def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
+        ids = self._base + jnp.arange(chunk.capacity, dtype=jnp.int64)
+        self._base += chunk.capacity
+        return [chunk.with_columns(**{self.out_col: ids})]
+
+    # -- checkpoint/restore ----------------------------------------------
+    def checkpoint_delta(self) -> List[StateDelta]:
+        if self._base == self._committed:
+            return []
+        self._committed = self._base
+        return [
+            StateDelta(
+                self.table_id,
+                {"k": np.zeros(1, np.int64)},
+                {"base": np.asarray([self._base], np.int64)},
+                np.zeros(1, bool),
+                ("k",),
+            )
+        ]
+
+    def restore_state(self, table_id, key_cols, value_cols) -> None:
+        if key_cols:
+            self._base = int(value_cols["base"][0])
+            self._committed = self._base

@@ -1,0 +1,95 @@
+"""Metrics kernel — counters/histograms with labels.
+
+Reference: src/common/metrics/ (prometheus registry + label-guarded
+metrics, guarded_metrics.rs) and the per-executor ``StreamingMetrics``
+struct (src/stream/src/executor/monitor/streaming_stats.rs:44).
+
+v0: an in-process registry with the prometheus text exposition format
+(``render()``), no HTTP endpoint yet. Counters are plain floats on the
+host — metric updates must NEVER force a device sync, so executors
+record shapes/capacities and host-side timings only.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+_Labels = Tuple[Tuple[str, str], ...]
+
+
+def _labels(kv: Dict[str, str]) -> _Labels:
+    return tuple(sorted(kv.items()))
+
+
+class Counter:
+    def __init__(self, registry, name: str):
+        self.name = name
+        self._values: Dict[_Labels, float] = defaultdict(float)
+        self._lock = registry._lock
+
+    def inc(self, value: float = 1.0, **labels: str) -> None:
+        with self._lock:
+            self._values[_labels(labels)] += value
+
+    def get(self, **labels: str) -> float:
+        return self._values.get(_labels(labels), 0.0)
+
+
+class Histogram:
+    def __init__(self, registry, name: str):
+        self.name = name
+        self._obs: Dict[_Labels, List[float]] = defaultdict(list)
+        self._lock = registry._lock
+
+    def observe(self, value: float, **labels: str) -> None:
+        with self._lock:
+            self._obs[_labels(labels)].append(value)
+
+    def percentile(self, q: float, **labels: str) -> float:
+        obs = self._obs.get(_labels(labels))
+        return float(np.percentile(obs, q)) if obs else 0.0
+
+    def count(self, **labels: str) -> int:
+        return len(self._obs.get(_labels(labels), ()))
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: Dict[str, Counter] = {}
+        self.histograms: Dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        if name not in self.counters:
+            self.counters[name] = Counter(self, name)
+        return self.counters[name]
+
+    def histogram(self, name: str) -> Histogram:
+        if name not in self.histograms:
+            self.histograms[name] = Histogram(self, name)
+        return self.histograms[name]
+
+    def render(self) -> str:
+        """Prometheus text exposition."""
+        lines = []
+        for name, c in sorted(self.counters.items()):
+            lines.append(f"# TYPE {name} counter")
+            for labels, v in sorted(c._values.items()):
+                lbl = ",".join(f'{k}="{val}"' for k, val in labels)
+                lines.append(f"{name}{{{lbl}}} {v}" if lbl else f"{name} {v}")
+        for name, h in sorted(self.histograms.items()):
+            lines.append(f"# TYPE {name} summary")
+            for labels, obs in sorted(h._obs.items()):
+                lbl = ",".join(f'{k}="{val}"' for k, val in labels)
+                base = f"{name}{{{lbl}}}" if lbl else name
+                lines.append(f"{base}_count {len(obs)}")
+                lines.append(f"{base}_sum {sum(obs)}")
+        return "\n".join(lines) + "\n"
+
+
+# the process-default registry (reference: GLOBAL_METRICS_REGISTRY)
+REGISTRY = MetricsRegistry()

@@ -30,6 +30,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.metrics import REGISTRY
 from risingwave_tpu.storage.object_store import ObjectStore
 from risingwave_tpu.storage.sstable import build_sst
 from risingwave_tpu.storage.state_table import Checkpointable, CheckpointManager
@@ -48,17 +49,39 @@ class StreamingRuntime:
         compute (uploader analogue). ``wait_checkpoints()`` joins.
     """
 
+    @classmethod
+    def from_config(cls, cfg, store: Optional[ObjectStore] = None):
+        """Build from an RwConfig (config.rs load path): the system
+        params drive the barrier clock; storage config drives the
+        store root + compaction cadence."""
+        from risingwave_tpu.storage.object_store import LocalFsObjectStore
+
+        if store is None:
+            store = LocalFsObjectStore(cfg.storage.object_store_root)
+        return cls(
+            store,
+            barrier_interval_ms=cfg.system.barrier_interval_ms,
+            checkpoint_frequency=cfg.system.checkpoint_frequency,
+            compact_at=cfg.storage.compact_at,
+        )
+
     def __init__(
         self,
         store: Optional[ObjectStore] = None,
         barrier_interval_ms: int = 1000,
         checkpoint_frequency: int = 1,
         async_checkpoint: bool = True,
+        compact_at: int = 8,
     ):
         self.fragments: Dict[str, object] = {}
+        self._aux_state: List[object] = []
         self.barrier_interval_ms = barrier_interval_ms
         self.checkpoint_frequency = checkpoint_frequency
-        self.mgr = CheckpointManager(store) if store is not None else None
+        self.mgr = (
+            CheckpointManager(store, compact_at=compact_at)
+            if store is not None
+            else None
+        )
         self.async_checkpoint = async_checkpoint
         self._epoch = self.mgr.max_committed_epoch if self.mgr else 0
         self._barrier_seq = 0
@@ -75,10 +98,16 @@ class StreamingRuntime:
     def register(self, name: str, pipeline) -> None:
         self.fragments[name] = pipeline
 
+    def register_state(self, obj) -> None:
+        """Register a non-pipeline Checkpointable (e.g. a source's
+        split offsets) into the checkpoint/recovery cycle."""
+        self._aux_state.append(obj)
+
     def executors(self) -> List[object]:
         out = []
         for p in self.fragments.values():
             out.extend(p.executors)
+        out.extend(self._aux_state)
         return out
 
     # -- barrier clock ---------------------------------------------------
@@ -96,14 +125,23 @@ class StreamingRuntime:
         t0 = time.perf_counter()
         prev, self._epoch = self._epoch, self.next_epoch()
         self._barrier_seq += 1
+        is_ckpt = (
+            self.mgr is not None
+            and self._barrier_seq % self.checkpoint_frequency == 0
+        )
         outs = {}
         for name, p in self.fragments.items():
             p._epoch = prev  # fragments share the runtime's clock
-            outs[name] = p.barrier()
+            # non-checkpoint barriers must NOT commit sinks (exactly-
+            # once: sink commits may never run ahead of durability)
+            outs[name] = p.barrier(checkpoint=is_ckpt)
             p._epoch = self._epoch
-        if self.mgr and self._barrier_seq % self.checkpoint_frequency == 0:
+        if is_ckpt:
             self._commit(self._epoch)
-        self.barrier_latencies_ms.append((time.perf_counter() - t0) * 1e3)
+        ms = (time.perf_counter() - t0) * 1e3
+        self.barrier_latencies_ms.append(ms)
+        REGISTRY.histogram("barrier_latency_ms").observe(ms)
+        REGISTRY.counter("barriers_total").inc()
         return outs
 
     def tick(self) -> bool:

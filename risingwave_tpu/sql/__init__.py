@@ -1,0 +1,10 @@
+"""SQL frontend — parser, binder, streaming planner.
+
+Reference: src/sqlparser/ (parser), src/frontend/src/{binder,planner,
+optimizer,stream_fragmenter}/. See parser.py / planner.py docs.
+"""
+
+from risingwave_tpu.sql.parser import parse
+from risingwave_tpu.sql.planner import Catalog, PlannedMV, StreamPlanner
+
+__all__ = ["parse", "Catalog", "StreamPlanner", "PlannedMV"]

@@ -1,0 +1,428 @@
+"""SQL lexer + recursive-descent parser (Postgres-dialect subset).
+
+Reference: src/sqlparser/ (21.5k LoC forked Postgres parser). This is
+the subset the streaming planner consumes — CREATE MATERIALIZED VIEW,
+SELECT with window TVFs (TUMBLE/HOP), JOIN ... ON, WHERE, GROUP BY,
+aggregate calls, CASE, and the usual scalar operators. The AST mirrors
+the reference's sqlparser AST shapes (Statement/Query/SetExpr/
+TableFactor) collapsed to what the planner needs.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple, Union
+
+# ---------------------------------------------------------------- AST --
+
+
+@dataclass(frozen=True)
+class Ident:
+    name: str
+    qualifier: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class Literal:
+    value: object  # int | float | str | bool | None
+
+
+@dataclass(frozen=True)
+class FuncCall:
+    name: str  # lowercased
+    args: Tuple[object, ...]  # exprs; ("*",) for COUNT(*)
+
+
+@dataclass(frozen=True)
+class UnaryOp:
+    op: str
+    operand: object
+
+
+@dataclass(frozen=True)
+class BinaryOp:
+    op: str  # +,-,*,/,%,=,<>,<,<=,>,>=,and,or
+    left: object
+    right: object
+
+
+@dataclass(frozen=True)
+class CaseExpr:
+    branches: Tuple[Tuple[object, object], ...]
+    default: Optional[object]
+
+
+@dataclass(frozen=True)
+class SelectItem:
+    expr: object
+    alias: Optional[str]
+
+
+@dataclass(frozen=True)
+class TableRef:
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class WindowTVF:
+    kind: str  # "tumble" | "hop"
+    table: TableRef
+    ts_col: str
+    size_ms: int
+    slide_ms: int  # == size_ms for tumble
+    alias: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class SubQuery:
+    select: "Select"
+    alias: str
+
+
+@dataclass(frozen=True)
+class Join:
+    left: object  # relation
+    right: object
+    on: object  # expr
+
+
+@dataclass(frozen=True)
+class Select:
+    items: Tuple[SelectItem, ...]
+    from_: object  # relation or Join
+    where: Optional[object]
+    group_by: Tuple[Ident, ...]
+    order_by: Tuple[Tuple[Ident, bool], ...] = ()  # (col, desc)
+    limit: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class CreateMaterializedView:
+    name: str
+    select: Select
+
+
+Statement = Union[CreateMaterializedView, Select]
+
+# -------------------------------------------------------------- lexer --
+
+_TOKEN_RE = re.compile(
+    r"""\s*(?:
+      (?P<num>\d+(?:\.\d+)?)
+    | (?P<str>'(?:[^']|'')*')
+    | (?P<ident>[A-Za-z_][A-Za-z_0-9]*)
+    | (?P<op><>|<=|>=|!=|\|\||[-+*/%(),.=<>])
+    )""",
+    re.VERBOSE,
+)
+
+_KEYWORDS = {
+    "select", "from", "where", "group", "by", "as", "join", "inner", "on",
+    "and", "or", "not", "create", "materialized", "view", "tumble", "hop",
+    "interval", "second", "seconds", "millisecond", "milliseconds",
+    "minute", "minutes", "case", "when", "then", "else", "end", "null", "order", "limit", "asc", "desc",
+    "true", "false", "is", "between", "in", "distinct",
+}
+
+
+@dataclass
+class _Tok:
+    kind: str  # num | str | ident | kw | op | eof
+    value: str
+
+
+def _lex(sql: str) -> List[_Tok]:
+    out, pos = [], 0
+    while pos < len(sql):
+        m = _TOKEN_RE.match(sql, pos)
+        if not m or m.end() == pos:
+            if sql[pos:].strip() == "":
+                break
+            raise SyntaxError(f"cannot tokenize at: {sql[pos:pos+20]!r}")
+        pos = m.end()
+        if m.lastgroup == "num":
+            out.append(_Tok("num", m.group("num")))
+        elif m.lastgroup == "str":
+            out.append(_Tok("str", m.group("str")[1:-1].replace("''", "'")))
+        elif m.lastgroup == "ident":
+            word = m.group("ident").lower()
+            out.append(_Tok("kw" if word in _KEYWORDS else "ident", word))
+        else:
+            out.append(_Tok("op", m.group("op")))
+    out.append(_Tok("eof", ""))
+    return out
+
+
+# ------------------------------------------------------------- parser --
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.toks = _lex(sql)
+        self.i = 0
+
+    # -- token helpers ---------------------------------------------------
+    def peek(self) -> _Tok:
+        return self.toks[self.i]
+
+    def next(self) -> _Tok:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def accept(self, kind: str, value: Optional[str] = None) -> Optional[_Tok]:
+        t = self.peek()
+        if t.kind == kind and (value is None or t.value == value):
+            return self.next()
+        return None
+
+    def expect(self, kind: str, value: Optional[str] = None) -> _Tok:
+        t = self.accept(kind, value)
+        if t is None:
+            raise SyntaxError(
+                f"expected {value or kind}, got {self.peek().value!r}"
+            )
+        return t
+
+    # -- entry -----------------------------------------------------------
+    def parse(self) -> Statement:
+        if self.accept("kw", "create"):
+            self.expect("kw", "materialized")
+            self.expect("kw", "view")
+            name = self.expect("ident").value
+            self.expect("kw", "as")
+            sel = self.select()
+            self.expect("eof")
+            return CreateMaterializedView(name, sel)
+        sel = self.select()
+        self.expect("eof")
+        return sel
+
+    # -- select ----------------------------------------------------------
+    def select(self) -> Select:
+        self.expect("kw", "select")
+        items = [self.select_item()]
+        while self.accept("op", ","):
+            items.append(self.select_item())
+        self.expect("kw", "from")
+        rel = self.relation()
+        while self.accept("kw", "join") or (
+            self.accept("kw", "inner") and self.expect("kw", "join")
+        ):
+            right = self.relation()
+            self.expect("kw", "on")
+            rel = Join(rel, right, self.expr())
+        where = self.expr() if self.accept("kw", "where") else None
+        group: Tuple[Ident, ...] = ()
+        if self.accept("kw", "group"):
+            self.expect("kw", "by")
+            cols = [self.qualified_ident()]
+            while self.accept("op", ","):
+                cols.append(self.qualified_ident())
+            group = tuple(cols)
+        order: Tuple[Tuple[Ident, bool], ...] = ()
+        if self.accept("kw", "order"):
+            self.expect("kw", "by")
+            obs = []
+            while True:
+                ident = self.qualified_ident()
+                desc = bool(self.accept("kw", "desc"))
+                if not desc:
+                    self.accept("kw", "asc")
+                obs.append((ident, desc))
+                if not self.accept("op", ","):
+                    break
+            order = tuple(obs)
+        limit = None
+        if self.accept("kw", "limit"):
+            limit = int(self.expect("num").value)
+        return Select(tuple(items), rel, where, group, order, limit)
+
+    def select_item(self) -> SelectItem:
+        e = self.expr()
+        alias = None
+        if self.accept("kw", "as"):
+            alias = self.expect("ident").value
+        elif self.peek().kind == "ident":
+            alias = self.next().value
+        return SelectItem(e, alias)
+
+    # -- relations -------------------------------------------------------
+    def relation(self):
+        if self.accept("op", "("):
+            sel = self.select()
+            self.expect("op", ")")
+            self.accept("kw", "as")
+            alias = self.expect("ident").value
+            return SubQuery(sel, alias)
+        if self.peek().kind == "kw" and self.peek().value in ("tumble", "hop"):
+            kind = self.next().value
+            self.expect("op", "(")
+            table = TableRef(self.expect("ident").value)
+            self.expect("op", ",")
+            ts_col = self.expect("ident").value
+            self.expect("op", ",")
+            first = self.interval_ms()
+            slide = size = first
+            if kind == "hop":
+                self.expect("op", ",")
+                size = self.interval_ms()
+                slide = first  # HOP(tbl, ts, slide, size) — pg/RW order
+            self.expect("op", ")")
+            alias = None
+            if self.accept("kw", "as"):
+                alias = self.expect("ident").value
+            elif self.peek().kind == "ident":
+                alias = self.next().value
+            return WindowTVF(kind, table, ts_col, size, slide, alias)
+        name = self.expect("ident").value
+        alias = None
+        if self.accept("kw", "as"):
+            alias = self.expect("ident").value
+        elif self.peek().kind == "ident":
+            alias = self.next().value
+        return TableRef(name, alias)
+
+    def interval_ms(self) -> int:
+        self.expect("kw", "interval")
+        raw = self.expect("str").value
+        unit_tok = self.accept("kw")
+        text = raw.strip()
+        m = re.fullmatch(r"(\d+)(?:\s+(\w+))?", text)
+        if not m:
+            raise SyntaxError(f"bad interval {raw!r}")
+        n = int(m.group(1))
+        unit = (unit_tok.value if unit_tok else (m.group(2) or "second")).lower()
+        scale = {
+            "millisecond": 1, "milliseconds": 1,
+            "second": 1000, "seconds": 1000,
+            "minute": 60_000, "minutes": 60_000,
+        }.get(unit)
+        if scale is None:
+            raise SyntaxError(f"bad interval unit {unit!r}")
+        return n * scale
+
+    def qualified_ident(self) -> Ident:
+        a = self.expect("ident").value
+        if self.accept("op", "."):
+            return Ident(self.expect("ident").value, qualifier=a)
+        return Ident(a)
+
+    # -- expressions (precedence climbing) -------------------------------
+    def expr(self):
+        return self.or_expr()
+
+    def or_expr(self):
+        e = self.and_expr()
+        while self.accept("kw", "or"):
+            e = BinaryOp("or", e, self.and_expr())
+        return e
+
+    def and_expr(self):
+        e = self.not_expr()
+        while self.accept("kw", "and"):
+            e = BinaryOp("and", e, self.not_expr())
+        return e
+
+    def not_expr(self):
+        if self.accept("kw", "not"):
+            return UnaryOp("not", self.not_expr())
+        return self.cmp_expr()
+
+    def cmp_expr(self):
+        e = self.add_expr()
+        t = self.peek()
+        if t.kind == "op" and t.value in ("=", "<>", "!=", "<", "<=", ">", ">="):
+            op = self.next().value
+            return BinaryOp("=" if op == "=" else op, e, self.add_expr())
+        if self.accept("kw", "is"):
+            neg = bool(self.accept("kw", "not"))
+            self.expect("kw", "null")
+            return UnaryOp("is not null" if neg else "is null", e)
+        if self.accept("kw", "between"):
+            lo = self.add_expr()
+            self.expect("kw", "and")
+            hi = self.add_expr()
+            return FuncCall("between", (e, lo, hi))
+        if self.accept("kw", "in"):
+            self.expect("op", "(")
+            vals = [self.expr()]
+            while self.accept("op", ","):
+                vals.append(self.expr())
+            self.expect("op", ")")
+            return FuncCall("in", (e, *vals))
+        return e
+
+    def add_expr(self):
+        e = self.mul_expr()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in ("+", "-"):
+                e = BinaryOp(self.next().value, e, self.mul_expr())
+            else:
+                return e
+
+    def mul_expr(self):
+        e = self.unary()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in ("*", "/", "%"):
+                e = BinaryOp(self.next().value, e, self.unary())
+            else:
+                return e
+
+    def unary(self):
+        if self.accept("op", "-"):
+            return UnaryOp("-", self.unary())
+        return self.primary()
+
+    def primary(self):
+        t = self.peek()
+        if t.kind == "num":
+            self.next()
+            return Literal(float(t.value) if "." in t.value else int(t.value))
+        if t.kind == "str":
+            self.next()
+            return Literal(t.value)
+        if self.accept("kw", "null"):
+            return Literal(None)
+        if self.accept("kw", "true"):
+            return Literal(True)
+        if self.accept("kw", "false"):
+            return Literal(False)
+        if self.accept("kw", "case"):
+            branches = []
+            while self.accept("kw", "when"):
+                cond = self.expr()
+                self.expect("kw", "then")
+                branches.append((cond, self.expr()))
+            default = self.expr() if self.accept("kw", "else") else None
+            self.expect("kw", "end")
+            return CaseExpr(tuple(branches), default)
+        if self.accept("op", "("):
+            e = self.expr()
+            self.expect("op", ")")
+            return e
+        if t.kind == "ident":
+            self.next()
+            if self.accept("op", "("):
+                if self.accept("op", "*"):
+                    self.expect("op", ")")
+                    return FuncCall(t.value, ("*",))
+                args = []
+                if not self.accept("op", ")"):
+                    args.append(self.expr())
+                    while self.accept("op", ","):
+                        args.append(self.expr())
+                    self.expect("op", ")")
+                return FuncCall(t.value, tuple(args))
+            if self.accept("op", "."):
+                return Ident(self.expect("ident").value, qualifier=t.value)
+            return Ident(t.value)
+        raise SyntaxError(f"unexpected token {t.value!r}")
+
+
+def parse(sql: str) -> Statement:
+    return Parser(sql).parse()

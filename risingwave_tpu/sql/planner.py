@@ -1,0 +1,448 @@
+"""Binder + streaming planner: SQL AST -> executor pipeline.
+
+Reference roles:
+- Binder (src/frontend/src/binder/): name resolution against a catalog;
+- Planner + optimizer (src/frontend/src/planner/, optimizer/): bound
+  query -> stream plan. This v0 is a PATTERN planner: it recognizes the
+  streaming shapes our executors implement (the same specializations
+  RW's rules produce on these queries) instead of a rewrite engine:
+    * window TVF         -> HopWindowExecutor
+    * WHERE              -> FilterExecutor
+    * computed items     -> ProjectExecutor
+    * GROUP BY + aggs    -> HashAggExecutor
+    * GROUP BY, no aggs  -> AppendOnlyDedupExecutor (append-only DISTINCT)
+    * JOIN ... ON eq     -> HashJoinExecutor (TwoInputPipeline)
+    * no pk available    -> RowIdGenExecutor (hidden _row_id, row_id_gen.rs)
+- Stream fragmenter (src/frontend/src/stream_fragmenter/): here one
+  fragment per input stream — the TwoInputPipeline split.
+
+The planner returns a PlannedMV: pipeline + materialize + the input
+stream name(s) the driver feeds.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+
+from risingwave_tpu.executors import (
+    AppendOnlyDedupExecutor,
+    Executor,
+    FilterExecutor,
+    HashAggExecutor,
+    HashJoinExecutor,
+    HopWindowExecutor,
+    MaterializeExecutor,
+    ProjectExecutor,
+)
+from risingwave_tpu.executors.row_id_gen import RowIdGenExecutor
+from risingwave_tpu.expr import expr as E
+from risingwave_tpu.ops.agg import AggCall
+from risingwave_tpu.runtime import Pipeline, TwoInputPipeline
+from risingwave_tpu.sql import parser as P
+from risingwave_tpu.types import Schema
+
+AGG_FUNCS = {"count": "count", "sum": "sum", "min": "min", "max": "max"}
+
+
+@dataclass
+class BoundRel:
+    """One planned input chain: executors + output schema + pk."""
+
+    chain: List[Executor]
+    schema: Dict[str, object]  # col name -> jnp dtype
+    pk: Tuple[str, ...]
+    source: str  # base stream name the driver pushes into
+    alias: Optional[str]
+
+
+@dataclass
+class PlannedMV:
+    name: str
+    pipeline: Union[Pipeline, TwoInputPipeline]
+    mview: MaterializeExecutor
+    inputs: Dict[str, str]  # base stream name -> "single"|"left"|"right"
+
+
+class Catalog:
+    """Stream catalog: name -> Schema (reference: frontend catalog)."""
+
+    def __init__(self, tables: Dict[str, Schema]):
+        self.tables = dict(tables)
+
+    def schema_dtypes(self, name: str) -> Dict[str, object]:
+        sch = self.tables[name]
+        return {f.name: jnp.dtype(f.dtype.device_dtype) for f in sch.fields}
+
+
+class Binder:
+    """Column resolution over a rel's output schema."""
+
+    def __init__(self, schema: Dict[str, object], alias: Optional[str]):
+        self.schema = schema
+        self.alias = alias
+
+    def resolve(self, ident: P.Ident) -> str:
+        if ident.qualifier is not None and self.alias is not None:
+            if ident.qualifier != self.alias:
+                raise KeyError(f"unknown qualifier {ident.qualifier!r}")
+        if ident.name not in self.schema:
+            raise KeyError(f"unknown column {ident.name!r}")
+        return ident.name
+
+
+def compile_scalar(ast, binder: Binder) -> E.Expr:
+    """Scalar AST -> expr framework node (no aggregates allowed)."""
+    if isinstance(ast, P.Literal):
+        return E.lit(ast.value)
+    if isinstance(ast, P.Ident):
+        return E.col(binder.resolve(ast))
+    if isinstance(ast, P.UnaryOp):
+        if ast.op == "-":
+            return E.lit(0) - compile_scalar(ast.operand, binder)
+        if ast.op == "not":
+            return E.Not(compile_scalar(ast.operand, binder))
+        if ast.op == "is null":
+            return E.IsNull(compile_scalar(ast.operand, binder))
+        if ast.op == "is not null":
+            return E.IsNull(compile_scalar(ast.operand, binder), negate=True)
+    if isinstance(ast, P.BinaryOp):
+        lhs = compile_scalar(ast.left, binder)
+        rhs = compile_scalar(ast.right, binder)
+        ops = {
+            "+": lambda: lhs + rhs,
+            "-": lambda: lhs - rhs,
+            "*": lambda: lhs * rhs,
+            "/": lambda: lhs // rhs,  # int division v0 (Nexmark is ints)
+            "%": lambda: lhs % rhs,
+            "=": lambda: lhs == rhs,
+            "<>": lambda: lhs != rhs,
+            "!=": lambda: lhs != rhs,
+            "<": lambda: lhs < rhs,
+            "<=": lambda: lhs <= rhs,
+            ">": lambda: lhs > rhs,
+            ">=": lambda: lhs >= rhs,
+            "and": lambda: E.And(lhs, rhs),
+            "or": lambda: E.Or(lhs, rhs),
+        }
+        return ops[ast.op]()
+    if isinstance(ast, P.CaseExpr):
+        branches = tuple(
+            (compile_scalar(c, binder), compile_scalar(v, binder))
+            for c, v in ast.branches
+        )
+        default = (
+            compile_scalar(ast.default, binder)
+            if ast.default is not None
+            else E.lit(None)
+        )
+        return E.Case(branches, default)
+    if isinstance(ast, P.FuncCall):
+        if ast.name == "between":
+            e, lo, hi = (compile_scalar(a, binder) for a in ast.args)
+            return E.Between(e, lo, hi)
+        if ast.name == "in":
+            e = compile_scalar(ast.args[0], binder)
+            vals = tuple(
+                a.value for a in ast.args[1:] if isinstance(a, P.Literal)
+            )
+            return E.InList(e, vals)
+        if ast.name in AGG_FUNCS:
+            raise ValueError(f"aggregate {ast.name}() outside GROUP BY select")
+        raise ValueError(f"unknown function {ast.name!r}")
+    raise TypeError(f"cannot compile {ast!r}")
+
+
+def _is_agg(ast) -> bool:
+    return isinstance(ast, P.FuncCall) and ast.name in AGG_FUNCS
+
+
+class StreamPlanner:
+    def __init__(self, catalog: Catalog, capacity: int = 1 << 14):
+        self.catalog = catalog
+        self.capacity = capacity
+        self._ids = 0
+
+    def _tid(self, mv: str, what: str) -> str:
+        self._ids += 1
+        return f"{mv}.{what}{self._ids}"
+
+    # -- entry -----------------------------------------------------------
+    def plan(self, sql: str) -> PlannedMV:
+        stmt = P.parse(sql)
+        if isinstance(stmt, P.CreateMaterializedView):
+            name, select = stmt.name, stmt.select
+        else:
+            name, select = "anon_mv", stmt
+        if isinstance(select.from_, P.Join):
+            return self._plan_join(name, select)
+        return self._plan_single(name, select)
+
+    # -- single-input ----------------------------------------------------
+    def _plan_single(self, name: str, select: P.Select) -> PlannedMV:
+        rel = self._plan_rel(name, select)
+        mview = MaterializeExecutor(
+            pk=rel.pk,
+            columns=tuple(c for c in rel.schema if c not in rel.pk),
+            table_id=f"{name}.mview",
+        )
+        pipeline = Pipeline(rel.chain + [mview])
+        return PlannedMV(name, pipeline, mview, {rel.source: "single"})
+
+    def _plan_rel(self, name: str, select: P.Select) -> BoundRel:
+        """Plan one select over a single (possibly windowed) input."""
+        src = select.from_
+        chain: List[Executor] = []
+        alias = None
+        if isinstance(src, P.SubQuery):
+            inner = self._plan_rel(name, src.select)
+            chain = inner.chain
+            schema = inner.schema
+            pk = inner.pk
+            source = inner.source
+            alias = src.alias
+        elif isinstance(src, P.WindowTVF):
+            source = src.table.name
+            schema = dict(self.catalog.schema_dtypes(source))
+            chain.append(
+                HopWindowExecutor(
+                    src.ts_col, src.size_ms, src.slide_ms,
+                    out_start="window_start",
+                )
+            )
+            schema["window_start"] = jnp.dtype(jnp.int64)
+            pk = ()
+            alias = src.alias
+        elif isinstance(src, P.TableRef):
+            source = src.name
+            schema = dict(self.catalog.schema_dtypes(source))
+            pk = ()
+            alias = src.alias
+        else:
+            raise TypeError(f"unsupported FROM {src!r}")
+
+        binder = Binder(schema, alias)
+        if select.where is not None:
+            chain.append(FilterExecutor(compile_scalar(select.where, binder)))
+
+        if select.group_by:
+            keys = tuple(binder.resolve(g) for g in select.group_by)
+            aggs: List[AggCall] = []
+            out_schema: Dict[str, object] = {}
+            for i, item in enumerate(select.items):
+                ast = item.expr
+                if _is_agg(ast):
+                    out = item.alias or f"{ast.name}_{i}"
+                    if ast.args == ("*",):
+                        if ast.name != "count":
+                            raise ValueError(f"{ast.name}(*) unsupported")
+                        aggs.append(AggCall("count_star", None, out))
+                        out_schema[out] = jnp.dtype(jnp.int64)
+                    else:
+                        arg = ast.args[0]
+                        if not isinstance(arg, P.Ident):
+                            raise ValueError(
+                                "aggregate args must be bare columns "
+                                "(project first)"
+                            )
+                        incol = binder.resolve(arg)
+                        aggs.append(AggCall(AGG_FUNCS[ast.name], incol, out))
+                        out_schema[out] = schema[incol]
+                elif isinstance(ast, P.Ident):
+                    colname = binder.resolve(ast)
+                    if colname not in keys:
+                        raise ValueError(
+                            f"non-aggregate item {colname!r} not in GROUP BY"
+                        )
+                    out_schema[item.alias or colname] = schema[colname]
+                else:
+                    raise ValueError(
+                        "GROUP BY select items must be keys or aggregates"
+                    )
+            renames = {
+                binder.resolve(it.expr): it.alias
+                for it in select.items
+                if isinstance(it.expr, P.Ident) and it.alias
+            }
+            if aggs:
+                agg = HashAggExecutor(
+                    group_keys=keys,
+                    calls=tuple(aggs),
+                    schema_dtypes=schema,
+                    capacity=self.capacity,
+                    table_id=self._tid(name, "agg"),
+                )
+                chain.append(agg)
+            else:
+                chain.append(
+                    AppendOnlyDedupExecutor(
+                        keys=keys,
+                        schema_dtypes=schema,
+                        capacity=self.capacity,
+                        table_id=self._tid(name, "dedup"),
+                    )
+                )
+            if renames:
+                chain.append(
+                    ProjectExecutor(
+                        {
+                            renames.get(c, c): E.col(c)
+                            for c in (
+                                list(keys) + [a.output for a in aggs]
+                            )
+                        }
+                    )
+                )
+            pk = tuple(renames.get(k, k) for k in keys)
+            if not aggs:
+                # dedup passes the full row; schema = selected items
+                out_schema = {renames.get(k, k): schema[k] for k in keys}
+            else:
+                out_schema = {
+                    **{renames.get(k, k): schema[k] for k in keys},
+                    **out_schema,
+                }
+            return BoundRel(chain, out_schema, pk, source, alias)
+
+        # no GROUP BY: projection (+ hidden row id when no pk exists)
+        outputs: Dict[str, E.Expr] = {}
+        out_schema2: Dict[str, object] = {}
+        for i, item in enumerate(select.items):
+            out = item.alias or (
+                item.expr.name if isinstance(item.expr, P.Ident) else f"col{i}"
+            )
+            outputs[out] = compile_scalar(item.expr, binder)
+            if isinstance(item.expr, P.Ident):
+                out_schema2[out] = schema[binder.resolve(item.expr)]
+            else:
+                out_schema2[out] = jnp.dtype(jnp.int64)
+        if not pk:
+            chain.append(
+                RowIdGenExecutor(
+                    out_col="_row_id", table_id=self._tid(name, "rowid")
+                )
+            )
+            outputs["_row_id"] = E.col("_row_id")
+            out_schema2["_row_id"] = jnp.dtype(jnp.int64)
+            pk = ("_row_id",)
+        else:
+            # an inherited subquery pk must survive the projection or
+            # the MV cannot key its rows (join path does the same)
+            for pcol in pk:
+                if pcol not in outputs:
+                    outputs[pcol] = E.col(pcol)
+                    out_schema2[pcol] = schema[pcol]
+        chain.append(ProjectExecutor(outputs))
+        return BoundRel(chain, out_schema2, pk, source, alias)
+
+    # -- joins -----------------------------------------------------------
+    def _plan_join(self, name: str, select: P.Select) -> PlannedMV:
+        join: P.Join = select.from_
+        if isinstance(join.left, P.Join):
+            raise ValueError("multi-way joins not supported yet")
+        left = self._rel_of(name, join.left)
+        right = self._rel_of(name, join.right)
+        if set(left.schema) & set(right.schema):
+            raise ValueError(
+                f"join sides share column names: "
+                f"{set(left.schema) & set(right.schema)} — alias them apart"
+            )
+
+        lkeys, rkeys = self._equi_keys(join.on, left, right)
+        hj = HashJoinExecutor(
+            left_keys=lkeys,
+            right_keys=rkeys,
+            left_dtypes=left.schema,
+            right_dtypes=right.schema,
+            capacity=self.capacity,
+            table_id=self._tid(name, "join"),
+        )
+        binder = Binder({**left.schema, **right.schema}, None)
+        tail: List[Executor] = []
+        if select.where is not None:
+            tail.append(FilterExecutor(compile_scalar(select.where, binder)))
+        if select.group_by:
+            raise ValueError("GROUP BY over a join not supported yet")
+        out_names = []
+        for i, item in enumerate(select.items):
+            if not isinstance(item.expr, P.Ident):
+                raise ValueError("join select items must be bare columns v0")
+            out_names.append((self._join_resolve(item.expr, left, right),
+                              item.alias))
+        pk = tuple(left.pk) + tuple(right.pk)
+        proj = {alias or n: E.col(n) for n, alias in out_names}
+        for p in pk:  # pk columns must survive into the MV
+            proj.setdefault(p, E.col(p))
+        tail.append(ProjectExecutor(proj))
+        rename = {n: (alias or n) for n, alias in out_names}
+        mview = MaterializeExecutor(
+            pk=tuple(rename.get(p, p) for p in pk),
+            columns=tuple(
+                alias or n for n, alias in out_names
+                if (alias or n) not in {rename.get(p, p) for p in pk}
+            ),
+            table_id=f"{name}.mview",
+        )
+        tail.append(mview)
+        pipeline = TwoInputPipeline(left.chain, right.chain, hj, tail)
+        return PlannedMV(
+            name,
+            pipeline,
+            mview,
+            {left.source: "left", right.source: "right"},
+        )
+
+    def _rel_of(self, name: str, rel) -> BoundRel:
+        if isinstance(rel, P.SubQuery):
+            bound = self._plan_rel(name, rel.select)
+            bound.alias = rel.alias
+            return bound
+        raise TypeError(
+            "join sides must be subqueries with explicit columns "
+            f"(got {type(rel).__name__})"
+        )
+
+    def _join_resolve(self, ident: P.Ident, left: BoundRel, right: BoundRel):
+        if ident.qualifier == left.alias and ident.name in left.schema:
+            return ident.name
+        if ident.qualifier == right.alias and ident.name in right.schema:
+            return ident.name
+        if ident.qualifier is None:
+            if (ident.name in left.schema) != (ident.name in right.schema):
+                return ident.name
+            raise KeyError(f"ambiguous or unknown column {ident.name!r}")
+        raise KeyError(f"cannot resolve {ident.qualifier}.{ident.name}")
+
+    def _equi_keys(self, on, left: BoundRel, right: BoundRel):
+        """Flatten AND-ed equality conditions into positional key lists."""
+        pairs: List[Tuple[str, str]] = []
+
+        def walk(e):
+            if isinstance(e, P.BinaryOp) and e.op == "and":
+                walk(e.left)
+                walk(e.right)
+                return
+            if (
+                isinstance(e, P.BinaryOp)
+                and e.op == "="
+                and isinstance(e.left, P.Ident)
+                and isinstance(e.right, P.Ident)
+            ):
+                a, b = e.left, e.right
+                an = self._join_resolve(a, left, right)
+                bn = self._join_resolve(b, left, right)
+                if an in left.schema and bn in right.schema:
+                    pairs.append((an, bn))
+                elif bn in left.schema and an in right.schema:
+                    pairs.append((bn, an))
+                else:
+                    raise ValueError("join condition must cross sides")
+                return
+            raise ValueError("ON must be AND-ed equality conditions")
+
+        walk(on)
+        if not pairs:
+            raise ValueError("no equi-join keys found")
+        return tuple(p[0] for p in pairs), tuple(p[1] for p in pairs)

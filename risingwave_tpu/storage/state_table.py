@@ -119,9 +119,15 @@ class Checkpointable:
 class CheckpointManager:
     """Version authority + per-epoch committer (meta-lite)."""
 
-    def __init__(self, store: ObjectStore, prefix: str = "hummock"):
+    def __init__(
+        self,
+        store: ObjectStore,
+        prefix: str = "hummock",
+        compact_at: int = COMPACT_AT,
+    ):
         self.store = store
         self.prefix = prefix
+        self.compact_at = compact_at
         self.version = {"max_committed_epoch": 0, "tables": {}}
         self._load()
 
@@ -199,7 +205,7 @@ class CheckpointManager:
         SST into one at the current epoch; tombstones drop entirely
         (nothing older survives a full merge)."""
         for table_id, entries in self.version["tables"].items():
-            if len(entries) < COMPACT_AT:
+            if len(entries) < self.compact_at:
                 continue
             ssts = [read_sst(self.store.read(e["path"])) for e in entries]
             key_order = ssts[-1].meta.key_names

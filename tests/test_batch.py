@@ -1,0 +1,55 @@
+"""Batch local-mode queries over MV snapshots (reference: batch
+executors + local execution mode)."""
+
+import numpy as np
+
+from risingwave_tpu.batch import BatchQueryEngine
+from risingwave_tpu.connectors.nexmark import BID_SCHEMA, NexmarkConfig, NexmarkGenerator
+from risingwave_tpu.sql import Catalog, StreamPlanner
+
+
+def _mv_with_data():
+    planner = StreamPlanner(Catalog({"bid": BID_SCHEMA}), capacity=1 << 12)
+    mv = planner.plan(
+        "CREATE MATERIALIZED VIEW counts AS "
+        "SELECT auction, window_start, count(*) AS num "
+        "FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND) "
+        "GROUP BY auction, window_start"
+    )
+    gen = NexmarkGenerator(NexmarkConfig())
+    for _ in range(3):
+        mv.pipeline.push(gen.next_chunks(1500, 2048)["bid"])
+        mv.pipeline.barrier()
+    return mv
+
+
+def test_batch_scan_filter_order_limit():
+    mv = _mv_with_data()
+    eng = BatchQueryEngine({"counts": mv.mview})
+    out = eng.query(
+        "SELECT auction, num FROM counts WHERE num >= 3 "
+        "ORDER BY num DESC LIMIT 5"
+    )
+    snap = mv.mview.snapshot()
+    want = sorted((v[0] for v in snap.values() if v[0] >= 3), reverse=True)[:5]
+    assert out["num"].tolist() == want
+    assert len(out["auction"]) == len(want)
+
+
+def test_batch_scalar_and_group_agg():
+    mv = _mv_with_data()
+    eng = BatchQueryEngine({"counts": mv.mview})
+    snap = mv.mview.snapshot()
+
+    total = eng.query("SELECT sum(num) AS s, count(*) AS c FROM counts")
+    assert total["s"][0] == sum(v[0] for v in snap.values())
+    assert total["c"][0] == len(snap)
+
+    per_auction = eng.query(
+        "SELECT auction, sum(num) AS s FROM counts GROUP BY auction"
+    )
+    want = {}
+    for (a, w), (num,) in snap.items():
+        want[a] = want.get(a, 0) + num
+    got = dict(zip(per_auction["auction"].tolist(), per_auction["s"].tolist()))
+    assert got == want

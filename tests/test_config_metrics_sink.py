@@ -1,0 +1,109 @@
+"""Config layering, metrics registry, and the sink framework
+(reference: config.rs:138, guarded_metrics.rs, sink/mod.rs:337,
+compact_chunk.rs)."""
+
+import json
+
+import numpy as np
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.config import RwConfig, load_config
+from risingwave_tpu.connectors.sink import (
+    BlackholeSink,
+    FileSink,
+    SinkExecutor,
+    compact_rows,
+)
+from risingwave_tpu.executors import Barrier
+from risingwave_tpu.executors.base import Epoch
+from risingwave_tpu.metrics import MetricsRegistry
+from risingwave_tpu.types import Op
+
+
+def test_config_layering(tmp_path):
+    toml = tmp_path / "rw.toml"
+    toml.write_text(
+        """
+[system]
+barrier_interval_ms = 250
+
+[streaming]
+chunk_capacity = 8192
+future_knob = 7
+
+[brand_new_section]
+x = 1
+"""
+    )
+    cfg = load_config(str(toml), overrides={"system.checkpoint_frequency": 5})
+    assert cfg.system.barrier_interval_ms == 250
+    assert cfg.system.checkpoint_frequency == 5
+    assert cfg.streaming.chunk_capacity == 8192
+    assert cfg.storage.compact_at == 8  # untouched default
+    assert cfg.unrecognized["streaming.future_knob"] == 7
+    assert "brand_new_section" in cfg.unrecognized
+
+
+def test_runtime_from_config(tmp_path):
+    from risingwave_tpu.runtime import StreamingRuntime
+
+    cfg = RwConfig()
+    cfg.storage.object_store_root = str(tmp_path / "state")
+    cfg.system.barrier_interval_ms = 123
+    cfg.storage.compact_at = 3
+    rt = StreamingRuntime.from_config(cfg)
+    assert rt.barrier_interval_ms == 123
+    assert rt.mgr.compact_at == 3
+
+
+def test_metrics_registry():
+    reg = MetricsRegistry()
+    reg.counter("rows_total").inc(5, fragment="q5")
+    reg.counter("rows_total").inc(2, fragment="q5")
+    reg.histogram("lat_ms").observe(10.0)
+    reg.histogram("lat_ms").observe(30.0)
+    assert reg.counter("rows_total").get(fragment="q5") == 7
+    assert reg.histogram("lat_ms").percentile(50) == 20.0
+    text = reg.render()
+    assert 'rows_total{fragment="q5"} 7' in text
+    assert "lat_ms_count 2" in text
+
+
+def test_compact_rows_net_effect():
+    rows = [
+        ((1,), (10,), Op.INSERT),
+        ((1,), (10,), Op.UPDATE_DELETE),
+        ((1,), (11,), Op.UPDATE_INSERT),   # 1: insert then update -> (11,)
+        ((2,), (20,), Op.DELETE),          # 2: pre-existing delete
+        ((3,), (30,), Op.INSERT),
+        ((3,), (30,), Op.DELETE),          # 3: appeared+vanished -> nothing
+    ]
+    out = compact_rows(rows)
+    assert out == [((1,), (11,), Op.INSERT), ((2,), None, Op.DELETE)]
+
+
+def test_sink_executor_file_and_blackhole(tmp_path):
+    bh = BlackholeSink()
+    ex = SinkExecutor(bh, pk=("k",), columns=("k", "v"))
+    chunk = StreamChunk.from_numpy(
+        {"k": np.array([1, 2, 1], np.int64), "v": np.array([5, 6, 7], np.int64)},
+        8,
+        ops=np.array([Op.INSERT, Op.INSERT, Op.UPDATE_DELETE], np.int32),
+    )
+    ex.apply(chunk)
+    ex.on_barrier(Barrier(Epoch(0, 1)))
+    # pk 1: insert then update-delete -> vanished within epoch; pk 2 stays
+    assert bh.rows_written == 1 and bh.commits == 1
+
+    path = str(tmp_path / "out.jsonl")
+    fs = FileSink(path, columns=("k", "v"))
+    ex2 = SinkExecutor(fs, pk=("k",), columns=("k", "v"))
+    ex2.apply(
+        StreamChunk.from_numpy(
+            {"k": np.array([9], np.int64), "v": np.array([90], np.int64)}, 4
+        )
+    )
+    ex2.on_barrier(Barrier(Epoch(1, 2)))
+    lines = [json.loads(l) for l in open(path)]
+    assert lines[0] == {"op": "insert", "pk": [9], "row": [9, 90]}
+    assert lines[1]["op"] == "commit"
