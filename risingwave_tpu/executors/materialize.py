@@ -3,17 +3,20 @@
 Reference: src/stream/src/executor/mview/materialize.rs:44 — applies
 chunks to the MV StateTable with pk-conflict handling (:192-230).
 
-v0 TPU design note: the MV snapshot is a host-side dict (pk tuple ->
-row tuple) updated from the compacted delta chunks that stateful
-operators emit at barriers. Downstream batch reads / tests query it via
-``snapshot()``. The storage-backed version (device-staged columnar MV +
-Hummock-lite persistence) replaces the dict when state/ lands; the
-executor API stays the same.
+Two host backends behind one API (the reference's row map is native
+Rust; ours is native C++ where the layout allows):
+- NATIVE (risingwave_tpu/native.py): all pk/value columns are
+  NULL-free integers -> a C++ unordered_map applies each delta batch
+  at ~ns/row, and checkpoint staging is pure numpy net-effect over the
+  buffered batches (no per-row Python at all). Integer lanes widen to
+  int64 in the map (dictionary codes included), which is lossless.
+- PYTHON dict fallback: any other layout (floats, NULLs) — identical
+  semantics, interpreter speed.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -34,9 +37,32 @@ class MaterializeExecutor(Executor, Checkpointable):
         self.columns = tuple(columns)
         self.rows: Dict[Tuple, Tuple] = {}
         self.table_id = table_id
-        self._changed: set = set()  # pks touched since last checkpoint
+        self._changed: set = set()  # python path: pks since checkpoint
         self._dtypes: Dict[str, np.dtype] = {}
+        self._native = None  # NativeMvMap once eligible
+        self._backend: Optional[str] = None
+        self._pending: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
 
+    # -- backend selection ----------------------------------------------
+    def _pick_backend(self, chunk: StreamChunk, data) -> None:
+        names = self.pk + self.columns
+        eligible = all(
+            np.issubdtype(data[name].dtype, np.integer)
+            and name not in chunk.nulls
+            for name in names
+        )
+        if eligible:
+            try:
+                from risingwave_tpu.native import NativeMvMap
+
+                self._native = NativeMvMap(len(self.pk), len(self.columns))
+                self._backend = "native"
+                return
+            except (RuntimeError, OSError):
+                pass
+        self._backend = "python"
+
+    # -- data ------------------------------------------------------------
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         data = chunk.to_numpy(with_ops=True)
         ops = data["__op__"]
@@ -46,14 +72,35 @@ class MaterializeExecutor(Executor, Checkpointable):
         for name in self.pk + self.columns:
             if name not in self._dtypes:
                 self._dtypes[name] = data[name].dtype
-        # NULL pk components must stay distinct from real zeros: fold the
-        # null lane into the key tuple as None (SQL: NULL group keys form
-        # their own group; reference pk serde writes a null tag first,
-        # row_serde_util.rs). Same for NULL values. Built column-wise so
-        # the per-barrier delta apply is C-speed zip/dict ops, not a
-        # per-row Python loop.
+        if self._backend is None:
+            self._pick_backend(chunk, data)
+        is_del = (ops == Op.DELETE) | (ops == Op.UPDATE_DELETE)
+        if self._backend == "native":
+            keys = (
+                np.stack([data[nm] for nm in self.pk], axis=1).astype(np.int64)
+                if self.pk
+                else np.zeros((n, 0), np.int64)
+            )
+            vals = (
+                np.stack([data[nm] for nm in self.columns], axis=1).astype(
+                    np.int64
+                )
+                if self.columns
+                else np.zeros((n, 0), np.int64)
+            )
+            self._native.apply(keys, vals, is_del)
+            self._pending.append((keys, vals, is_del.astype(np.uint8)))
+            return [chunk]
+        self._apply_python(data, ops, is_del, n)
+        return [chunk]
+
+    def _apply_python(self, data, ops, is_del, n):
+        # NULL pk components fold into the key tuple as None (SQL NULL
+        # group keys are distinct; reference pk serde writes a null tag
+        # first, row_serde_util.rs). "Last op per pk wins" replaces the
+        # per-row loop.
         def tuples(names):
-            if not names:  # value-less MV (pk covers every column)
+            if not names:
                 return [()] * n
             lanes = []
             for name in names:
@@ -67,11 +114,6 @@ class MaterializeExecutor(Executor, Checkpointable):
         keys = tuples(self.pk)
         vals = tuples(self.columns)
         self._changed.update(keys)
-        is_del = (ops == Op.DELETE) | (ops == Op.UPDATE_DELETE)
-        # Sequentially applying a chunk's ops leaves each pk in the state
-        # of its LAST op (delete -> absent, insert/update -> that row), so
-        # "last op per pk wins" replaces the per-row loop: the dict
-        # comprehension keeps the last index per key at C speed.
         last = {k: i for i, k in enumerate(keys)}
         if is_del.any():
             rows = self.rows
@@ -79,19 +121,33 @@ class MaterializeExecutor(Executor, Checkpointable):
             idx = np.fromiter(last.values(), dtype=np.int64, count=len(last))
             dmask = is_del[idx]
             for j in np.flatnonzero(dmask):
-                # "overwrite" conflict behavior: tolerate missing rows
-                # (reference ConflictBehavior::Overwrite)
-                rows.pop(keys_u[j], None)
-            rows.update((keys_u[j], vals[idx[j]]) for j in np.flatnonzero(~dmask))
+                rows.pop(keys_u[j], None)  # ConflictBehavior::Overwrite
+            rows.update(
+                (keys_u[j], vals[idx[j]]) for j in np.flatnonzero(~dmask)
+            )
         else:
             self.rows.update((k, vals[i]) for k, i in last.items())
-        return [chunk]
 
+    # -- reads ------------------------------------------------------------
     def snapshot(self) -> Dict[Tuple, Tuple]:
+        if self._backend == "native":
+            keys, vals = self._native.dump()
+            return {
+                tuple(k): tuple(v)
+                for k, v in zip(keys.tolist(), vals.tolist())
+            }
         return dict(self.rows)
 
     def to_numpy(self) -> Dict[str, np.ndarray]:
         """Snapshot as column arrays (pk cols + value cols)."""
+        if self._backend == "native":
+            keys, vals = self._native.dump()
+            out = {}
+            for j, name in enumerate(self.pk):
+                out[name] = keys[:, j]
+            for j, name in enumerate(self.columns):
+                out[name] = vals[:, j]
+            return out
         keys = list(self.rows)
         out = {}
         for j, name in enumerate(self.pk):
@@ -102,10 +158,50 @@ class MaterializeExecutor(Executor, Checkpointable):
 
     # -- checkpoint/restore ----------------------------------------------
     def checkpoint_delta(self):
-        """Persist MV rows whose pk changed since the last checkpoint
-        (reference: the MV's own StateTable commit, materialize.rs:44).
-        v0 restriction: NULL pk/values are not persisted (none of the
-        benchmark MVs produce them); a None raises loudly."""
+        """Persist rows whose pk changed since the last checkpoint
+        (reference: the MV's own StateTable commit). Native path: pure
+        numpy net-effect over the buffered delta batches — last
+        occurrence per pk wins; its is_del becomes the tombstone."""
+        if self._backend == "native":
+            return self._native_delta()
+        return self._python_delta()
+
+    def _native_delta(self):
+        if not self._pending:
+            return []
+        keys = np.concatenate([k for k, _, _ in self._pending])
+        vals = np.concatenate([v for _, v, _ in self._pending])
+        dels = np.concatenate([d for _, _, d in self._pending])
+        self._pending = []
+        if len(keys) == 0:
+            return []
+        # last occurrence per pk: stable sort on key rows, keep run ends
+        order = np.lexsort(tuple(keys[:, j] for j in reversed(range(keys.shape[1]))))
+        ks = keys[order]
+        is_last = np.ones(len(order), bool)
+        if len(order) > 1:
+            same = (ks[1:] == ks[:-1]).all(axis=1)
+            is_last[:-1] = ~same
+        sel = order[is_last]
+        key_cols = {
+            f"k{j}": keys[sel, j].astype(self._dtypes[self.pk[j]])
+            for j in range(len(self.pk))
+        }
+        value_cols = {
+            f"v{j}": vals[sel, j].astype(self._dtypes[self.columns[j]])
+            for j in range(len(self.columns))
+        }
+        return [
+            StateDelta(
+                self.table_id,
+                key_cols,
+                value_cols,
+                dels[sel].astype(bool),
+                tuple(f"k{j}" for j in range(len(self.pk))),
+            )
+        ]
+
+    def _python_delta(self):
         if not self._changed:
             return []
         ups, tombs = [], []
@@ -151,9 +247,50 @@ class MaterializeExecutor(Executor, Checkpointable):
     def restore_state(self, table_id, key_cols, value_cols):
         self.rows = {}
         self._changed = set()
+        self._pending = []
+        self._native = None
+        self._backend = None
         if not key_cols:
             return
         n = len(next(iter(key_cols.values())))
+        ints = all(
+            np.issubdtype(np.asarray(a).dtype, np.integer)
+            for a in list(key_cols.values()) + list(value_cols.values())
+        )
+        if ints:
+            try:
+                from risingwave_tpu.native import NativeMvMap
+
+                self._native = NativeMvMap(len(self.pk), len(self.columns))
+                self._backend = "native"
+                keys = (
+                    np.stack(
+                        [key_cols[f"k{j}"] for j in range(len(self.pk))], axis=1
+                    ).astype(np.int64)
+                    if self.pk
+                    else np.zeros((n, 0), np.int64)
+                )
+                vals = (
+                    np.stack(
+                        [value_cols[f"v{j}"] for j in range(len(self.columns))],
+                        axis=1,
+                    ).astype(np.int64)
+                    if self.columns
+                    else np.zeros((n, 0), np.int64)
+                )
+                for j in range(len(self.pk)):
+                    self._dtypes.setdefault(
+                        self.pk[j], np.asarray(key_cols[f"k{j}"]).dtype
+                    )
+                for j in range(len(self.columns)):
+                    self._dtypes.setdefault(
+                        self.columns[j], np.asarray(value_cols[f"v{j}"]).dtype
+                    )
+                self._native.apply(keys, vals, np.zeros(n, np.uint8))
+                return
+            except (RuntimeError, OSError):
+                self._backend = None
+        self._backend = "python"
         for i in range(n):
             k = tuple(
                 key_cols[f"k{j}"][i].item() for j in range(len(self.pk))
