@@ -26,6 +26,20 @@ from risingwave_tpu.storage.state_table import Checkpointable, StateDelta
 from risingwave_tpu.types import Op
 
 
+def _last_per_key(keys: np.ndarray) -> np.ndarray:
+    """Indices of the LAST occurrence of each distinct key row (stable
+    sort on key columns, keep run ends)."""
+    order = np.lexsort(
+        tuple(keys[:, j] for j in reversed(range(keys.shape[1])))
+    )
+    ks = keys[order]
+    is_last = np.ones(len(order), bool)
+    if len(order) > 1:
+        same = (ks[1:] == ks[:-1]).all(axis=1)
+        is_last[:-1] = ~same
+    return order[is_last]
+
+
 class MaterializeExecutor(Executor, Checkpointable):
     def __init__(
         self,
@@ -42,6 +56,9 @@ class MaterializeExecutor(Executor, Checkpointable):
         self._native = None  # NativeMvMap once eligible
         self._backend: Optional[str] = None
         self._pending: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        # set by StreamingRuntime.register when a checkpoint store will
+        # drain _pending every checkpoint barrier
+        self.checkpoint_enabled = False
 
     # -- backend selection ----------------------------------------------
     def _pick_backend(self, chunk: StreamChunk, data) -> None:
@@ -156,6 +173,28 @@ class MaterializeExecutor(Executor, Checkpointable):
             out[name] = np.array([self.rows[k][j] for k in keys])
         return out
 
+    # -- barrier ---------------------------------------------------------
+    def on_barrier(self, barrier) -> List[StreamChunk]:
+        """Compact the native path's pending delta buffer to its net
+        effect per pk (last op wins). Keeps memory bounded by distinct
+        keys touched since the last checkpoint instead of total stream
+        length — pipelines driven without a CheckpointManager (bench,
+        store=None runtimes) never drain _pending otherwise (ADVICE r2
+        medium). Runtime-managed executors skip this: checkpoint
+        staging drains _pending with the same net-effect pass, so
+        compacting here would sort the same rows twice per barrier."""
+        if not self.checkpoint_enabled and len(self._pending) > 1:
+            self._pending = [self._net_pending()]
+        return []
+
+    def _net_pending(self):
+        """Fold _pending batches into one (keys, vals, dels) net batch."""
+        keys = np.concatenate([k for k, _, _ in self._pending])
+        vals = np.concatenate([v for _, v, _ in self._pending])
+        dels = np.concatenate([d for _, _, d in self._pending])
+        sel = _last_per_key(keys)
+        return keys[sel], vals[sel], dels[sel]
+
     # -- checkpoint/restore ----------------------------------------------
     def checkpoint_delta(self):
         """Persist rows whose pk changed since the last checkpoint
@@ -175,14 +214,7 @@ class MaterializeExecutor(Executor, Checkpointable):
         self._pending = []
         if len(keys) == 0:
             return []
-        # last occurrence per pk: stable sort on key rows, keep run ends
-        order = np.lexsort(tuple(keys[:, j] for j in reversed(range(keys.shape[1]))))
-        ks = keys[order]
-        is_last = np.ones(len(order), bool)
-        if len(order) > 1:
-            same = (ks[1:] == ks[:-1]).all(axis=1)
-            is_last[:-1] = ~same
-        sel = order[is_last]
+        sel = _last_per_key(keys)
         key_cols = {
             f"k{j}": keys[sel, j].astype(self._dtypes[self.pk[j]])
             for j in range(len(self.pk))

@@ -25,10 +25,17 @@ _TRIED = False
 
 
 def _build_and_load() -> Optional[ctypes.CDLL]:
-    so = os.path.join(_BUILD_DIR, "librw_native.so")
     src = os.path.join(_SRC_DIR, "mv_map.cpp")
     try:
-        if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+        # Rebuilds are gated on a source-content hash (not mtime): git
+        # does not preserve mtimes, so a stale checked-out .so could
+        # otherwise load silently after a clone (ADVICE r2, medium).
+        import hashlib
+
+        with open(src, "rb") as f:
+            tag = hashlib.sha256(f.read()).hexdigest()[:12]
+        so = os.path.join(_BUILD_DIR, f"librw_native_{tag}.so")
+        if not os.path.exists(so):
             os.makedirs(_BUILD_DIR, exist_ok=True)
             tmp = so + ".tmp"
             subprocess.run(
@@ -37,6 +44,19 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
                 capture_output=True,
             )
             os.replace(tmp, so)
+            # only after the new build landed: drop artifacts of prior
+            # source versions (a failed compile must not delete the
+            # last working library)
+            import glob
+
+            for old in glob.glob(
+                os.path.join(_BUILD_DIR, "librw_native*.so")
+            ):
+                if old != so:
+                    try:
+                        os.remove(old)
+                    except OSError:
+                        pass
         lib = ctypes.CDLL(so)
         lib.mv_new.restype = ctypes.c_void_p
         lib.mv_new.argtypes = [ctypes.c_int64, ctypes.c_int64]

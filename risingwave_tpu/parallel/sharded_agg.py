@@ -126,6 +126,7 @@ class ShardedHashAgg(Executor):
         self.group_keys = tuple(group_keys)
         self.calls = tuple(calls)
         self.nullable = tuple(k in set(nullable_keys) for k in self.group_keys)
+        self.capacity = capacity
         self.out_cap = out_cap
         self._dtypes = dict(schema_dtypes)
         self._float_extremes = agg_ops.float_extreme_meta(
@@ -285,12 +286,19 @@ class ShardedHashAgg(Executor):
         if not hasattr(self, "_flush"):
             self._flush = self._build_flush()
         outs: List[StreamChunk] = []
-        while True:
+        # each round drains up to out_cap dirty groups per shard, so
+        # capacity/out_cap rounds always suffice; a persistently-set
+        # overflow flag (kernel bug) must raise, not hang (ADVICE r2)
+        max_rounds = max(2, self.capacity // max(1, self.out_cap)) + 2
+        for _ in range(max_rounds):
             self.state, delta = self._flush(self.state, self.table.keys)
             outs.append(self._delta_to_chunk(delta))
             if not bool(jnp.any(delta["overflow"])):
-                break
-        return outs
+                return outs
+        raise RuntimeError(
+            f"sharded agg flush did not drain in {max_rounds} rounds — "
+            "overflow flag appears stuck"
+        )
 
     def _delta_to_chunk(self, delta) -> StreamChunk:
         """Stacked (n_shards, 2*out_cap) delta -> one flat StreamChunk."""

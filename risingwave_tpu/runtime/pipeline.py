@@ -42,11 +42,20 @@ class Pipeline:
             pending = nxt
         return pending
 
-    def barrier(self, checkpoint: bool = True) -> List[StreamChunk]:
+    def barrier(
+        self, checkpoint: bool = True, epoch: Optional[int] = None
+    ) -> List[StreamChunk]:
         """Inject a barrier; each executor's flush output becomes data
-        for the rest of the chain. Returns chunks exiting the chain."""
+        for the rest of the chain. Returns chunks exiting the chain.
+        ``epoch`` pins the barrier's curr epoch (the runtime passes its
+        own clock so held sink batches key by the COMMIT epoch);
+        standalone pipelines derive one from the wall clock."""
         prev = self._epoch
-        self._epoch = max(int(time.time() * 1000) << 16, prev + 1)
+        self._epoch = (
+            epoch
+            if epoch is not None
+            else max(int(time.time() * 1000) << 16, prev + 1)
+        )
         b = Barrier(Epoch(prev, self._epoch), checkpoint)
         pending: List[StreamChunk] = []
         for i, ex in enumerate(self.executors):
@@ -130,9 +139,15 @@ class TwoInputPipeline:
             outs.extend(self.join.apply_right(c))
         return self._through(self.tail, outs)
 
-    def barrier(self, checkpoint: bool = True) -> List[StreamChunk]:
+    def barrier(
+        self, checkpoint: bool = True, epoch: Optional[int] = None
+    ) -> List[StreamChunk]:
         prev = self._epoch
-        self._epoch = max(int(time.time() * 1000) << 16, prev + 1)
+        self._epoch = (
+            epoch
+            if epoch is not None
+            else max(int(time.time() * 1000) << 16, prev + 1)
+        )
         b = Barrier(Epoch(prev, self._epoch), checkpoint)
         joined: List[StreamChunk] = []
         for c in self._through(self.left, [], barrier=b):

@@ -32,8 +32,7 @@ import numpy as np
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.metrics import REGISTRY
 from risingwave_tpu.storage.object_store import ObjectStore
-from risingwave_tpu.storage.sstable import build_sst
-from risingwave_tpu.storage.state_table import Checkpointable, CheckpointManager
+from risingwave_tpu.storage.state_table import CheckpointManager
 
 
 class StreamingRuntime:
@@ -87,16 +86,35 @@ class StreamingRuntime:
         self._barrier_seq = 0
         self._last_barrier_at = 0.0
         self.barrier_latencies_ms: List[float] = []
+        self.checkpoint_sync_ms: List[float] = []  # stage->durable, per ckpt
         self._worker: Optional[threading.Thread] = None
         self._work_q: deque = deque()
         self._work_event = threading.Event()
         self._work_err: List[BaseException] = []
         self._inflight = 0
         self._inflight_lock = threading.Lock()
+        self._compactor: Optional[threading.Thread] = None
+        self._compact_event = threading.Event()
+        self._compact_pause = threading.Event()  # set = paused (recovery)
+        self._compact_idle = threading.Event()
+        self._compact_idle.set()
+        self.compaction_errors: List[BaseException] = []
+        self._work_abort = threading.Event()
 
     # -- fragments -------------------------------------------------------
     def register(self, name: str, pipeline) -> None:
         self.fragments[name] = pipeline
+        if self.mgr is not None:
+            for ex in pipeline.executors:
+                # sinks: delivery is deferred until the epoch's manifest
+                # is durable (ADVICE r2: sink commits may never run
+                # ahead of durability)
+                if hasattr(ex, "deliver_on_durable"):
+                    ex.deliver_on_durable = True
+                # checkpoint staging will drain pending buffers, so
+                # executors skip their own per-barrier compaction
+                if hasattr(ex, "checkpoint_enabled"):
+                    ex.checkpoint_enabled = True
 
     def register_state(self, obj) -> None:
         """Register a non-pipeline Checkpointable (e.g. a source's
@@ -133,9 +151,10 @@ class StreamingRuntime:
         for name, p in self.fragments.items():
             p._epoch = prev  # fragments share the runtime's clock
             # non-checkpoint barriers must NOT commit sinks (exactly-
-            # once: sink commits may never run ahead of durability)
-            outs[name] = p.barrier(checkpoint=is_ckpt)
-            p._epoch = self._epoch
+            # once: sink commits may never run ahead of durability);
+            # the runtime's epoch is passed down so held sink batches
+            # key by the exact epoch _commit/_on_epoch_durable will use
+            outs[name] = p.barrier(checkpoint=is_ckpt, epoch=self._epoch)
         if is_ckpt:
             self._commit(self._epoch)
         ms = (time.perf_counter() - t0) * 1e3
@@ -163,18 +182,22 @@ class StreamingRuntime:
     # -- checkpoint lane -------------------------------------------------
     def _commit(self, epoch: int) -> None:
         self._raise_worker_error()
+        # stage on the main thread (device pull + eager mark flips, with
+        # the duplicate-table_id check) — ONE code path with the sync
+        # commit (CheckpointManager.stage / commit_staged)
+        t_staged = time.perf_counter()
+        staged = self.mgr.stage(self.executors())
         if not self.async_checkpoint:
-            self.mgr.commit_epoch(epoch, self.executors())
+            self.mgr.commit_staged(epoch, staged)
+            self.checkpoint_sync_ms.append(
+                (time.perf_counter() - t_staged) * 1e3
+            )
+            self._on_epoch_durable(epoch)
+            self._kick_compactor()
             return
-        # stage synchronously on the main thread (device pull + eager
-        # mark flips), upload asynchronously
-        staged = []
-        for ex in self.executors():
-            if isinstance(ex, Checkpointable):
-                staged.extend(ex.checkpoint_delta())
         with self._inflight_lock:
             self._inflight += 1
-        self._work_q.append((epoch, staged))
+        self._work_q.append((epoch, staged, t_staged))
         self._ensure_worker()
         self._work_event.set()
 
@@ -190,42 +213,96 @@ class StreamingRuntime:
             self._work_event.wait(timeout=0.5)
             self._work_event.clear()
             while self._work_q:
-                epoch, staged = self._work_q.popleft()
+                epoch, staged, t_staged = self._work_q.popleft()
                 try:
-                    self._upload_epoch(epoch, staged)
+                    if self._work_err or self._work_abort.is_set():
+                        # a prior epoch failed to commit (or recovery is
+                        # aborting the lane): committing later epochs
+                        # would persist a manifest covering a hole
+                        # (silent data loss on recovery) and release
+                        # sink output for unpersisted state — drop
+                        # everything until the caller recover()s
+                        continue
+                    # single-worker FIFO queue -> epoch order holds
+                    self.mgr.commit_staged(epoch, staged)
+                    self.checkpoint_sync_ms.append(
+                        (time.perf_counter() - t_staged) * 1e3
+                    )
+                    self._on_epoch_durable(epoch)
+                    self._kick_compactor()
                 except BaseException as e:  # surfaced on main thread
                     self._work_err.append(e)
                 finally:
                     with self._inflight_lock:
                         self._inflight -= 1
 
-    def _upload_epoch(self, epoch: int, staged) -> None:
-        """Worker-side: SSTs + manifest, in epoch order (the queue is
-        FIFO and single-worker, so order holds)."""
-        mgr = self.mgr
-        tables = mgr.version["tables"]
-        for delta in staged:
-            if len(delta.tombstone) == 0:
-                continue
-            blob = build_sst(
-                delta.table_id,
-                epoch,
-                delta.key_cols,
-                delta.value_cols,
-                delta.tombstone,
-                delta.key_order,
+    def _on_epoch_durable(self, epoch: int) -> None:
+        """The epoch's manifest is persisted: release deferred sink
+        deliveries (exactly-once: sink output never precedes the
+        durability of the state that produced it)."""
+        for ex in self.executors():
+            fn = getattr(ex, "on_epoch_durable", None)
+            if fn is not None:
+                fn(epoch)
+
+    # -- compaction lane (off the commit path) ---------------------------
+    def _kick_compactor(self):
+        if self.mgr is None:
+            return
+        if not self.mgr.tables_needing_compaction():
+            return
+        if self._compactor is None or not self._compactor.is_alive():
+            self._compactor = threading.Thread(
+                target=self._compactor_loop, daemon=True
             )
-            path = f"{mgr.prefix}/sst/{delta.table_id}/{epoch:020d}.sst"
-            mgr.store.put(path, blob)
-            tables.setdefault(delta.table_id, []).append(
-                {"path": path, "epoch": epoch}
-            )
-        mgr.version["max_committed_epoch"] = epoch
-        mgr._persist_version()
-        mgr._maybe_compact(epoch)
+            self._compactor.start()
+        self._compact_event.set()
+
+    def _compactor_loop(self):
+        """Dedicated compaction worker (compactor_runner.rs:62 role):
+        full-merges long SST runs without ever blocking the commit lane
+        or FLUSH."""
+        while True:
+            self._compact_event.wait(timeout=0.5)
+            self._compact_event.clear()
+            # clear idle BEFORE checking pause: recover() sets pause
+            # then waits for idle, so the reverse order here closes the
+            # window where compaction slips past a just-set pause
+            self._compact_idle.clear()
+            try:
+                if self._compact_pause.is_set():
+                    continue
+                for table_id in self.mgr.tables_needing_compaction():
+                    if self._compact_pause.is_set():
+                        break
+                    self.mgr.compact_once(table_id, self.mgr.max_committed_epoch)
+            except Exception as e:
+                # best-effort (next commit re-kicks) but never silent:
+                # a persistently failing compaction must be visible
+                self.compaction_errors.append(e)
+                REGISTRY.counter("compaction_errors_total").inc()
+            finally:
+                self._compact_idle.set()
+
+    def wait_compaction(self) -> None:
+        """Block until no table needs compaction (or compaction is
+        failing/paused — a doomed compaction must not hang callers)."""
+        while (
+            self.mgr is not None
+            and self.mgr.tables_needing_compaction()
+            and not self.compaction_errors
+            and not self._compact_pause.is_set()
+            and self._compactor is not None
+            and self._compactor.is_alive()
+        ):
+            self._compact_event.set()
+            time.sleep(0.002)
+        self._compact_idle.wait()
 
     def wait_checkpoints(self) -> None:
-        """Join the async lane (the FLUSH / sync-epoch analogue)."""
+        """Join the async lane (the FLUSH / sync-epoch analogue).
+        Compaction intentionally does NOT block this (it runs on its
+        own worker — ADVICE r2: inline compaction stalled FLUSH)."""
         while True:
             with self._inflight_lock:
                 if self._inflight == 0:
@@ -239,12 +316,44 @@ class StreamingRuntime:
                 "async checkpoint failed"
             ) from self._work_err[0]
 
+    def p99_checkpoint_sync_ms(self) -> float:
+        """p99 of stage->durable latency (what the reference's <1s
+        checkpoint target measures — includes SST build + upload +
+        manifest commit, not just staging)."""
+        if not self.checkpoint_sync_ms:
+            return 0.0
+        return float(np.percentile(self.checkpoint_sync_ms, 99))
+
     # -- recovery --------------------------------------------------------
     def recover(self) -> None:
         """Rebuild all fragment state from the last committed epoch."""
         if not self.mgr:
             raise RuntimeError("no object store configured")
-        self.mgr.recover(self.executors())
+        # quiesce compaction: its GC deletes SSTs that recovery's
+        # read_table may be about to read
+        # abort the async lane FIRST: staged epochs still queued refer
+        # to pre-recovery state; committing one after the restore would
+        # advance the manifest past the epoch we just recovered to
+        self._work_abort.set()
+        while True:
+            with self._inflight_lock:
+                if self._inflight == 0:
+                    break
+            time.sleep(0.002)
+        self._compact_pause.set()
+        try:
+            self._compact_idle.wait()
+            self.mgr.recover(self.executors())
+        finally:
+            self._compact_pause.clear()
+            self._work_abort.clear()
+        # rolled-back epochs must not leave stale sink batches behind:
+        # replay would re-hold the same rows -> duplicate delivery
+        for ex in self.executors():
+            fn = getattr(ex, "discard_pending", None)
+            if fn is not None:
+                fn()
+        self._work_err.clear()
         self._epoch = self.mgr.max_committed_epoch
         for p in self.fragments.values():
             p._epoch = self._epoch

@@ -25,6 +25,7 @@ the executor's ``restore_state`` to rebuild device state.
 from __future__ import annotations
 
 import json
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -117,7 +118,16 @@ class Checkpointable:
 
 
 class CheckpointManager:
-    """Version authority + per-epoch committer (meta-lite)."""
+    """Version authority + per-epoch committer (meta-lite).
+
+    Thread model (uploader.rs:548 + commit_epoch.rs:93 analogue): the
+    version is guarded by one RLock; ``stage`` (validation + device
+    pull) and ``commit_staged`` (SST build + manifest) are the single
+    commit path shared by the sync caller and the runtime's async lane.
+    Compaction never runs inside a commit — it is scheduled separately
+    (``compact_once``) and swaps the version CAS-style under the lock,
+    so a racing commit can never be lost.
+    """
 
     def __init__(
         self,
@@ -128,6 +138,7 @@ class CheckpointManager:
         self.store = store
         self.prefix = prefix
         self.compact_at = compact_at
+        self._lock = threading.RLock()
         self.version = {"max_committed_epoch": 0, "tables": {}}
         self._load()
 
@@ -146,20 +157,15 @@ class CheckpointManager:
 
     @property
     def max_committed_epoch(self) -> int:
-        return int(self.version["max_committed_epoch"])
+        with self._lock:
+            return int(self.version["max_committed_epoch"])
 
     # -- commit path -----------------------------------------------------
-    def commit_epoch(self, epoch: int, executors: Sequence[object]) -> int:
-        """Stage every Checkpointable executor's delta, upload SSTs,
-        then commit the manifest. Staging flips device marks eagerly
-        (see StateDelta), so if this raises, the caller must recover()
-        from the last durable manifest before continuing — matching the
-        reference's failed-barrier -> global recovery contract.
-        Returns the number of SSTs written."""
-        if epoch <= self.max_committed_epoch:
-            raise ValueError(
-                f"epoch {epoch} <= committed {self.max_committed_epoch}"
-            )
+    def stage(self, executors: Sequence[object]) -> List[StateDelta]:
+        """Pull every Checkpointable executor's delta (the only device-
+        touching step) with the duplicate-table_id check. Mark flips are
+        eager (see StateDelta): a later commit failure requires
+        recover(), never a retry against live state."""
         staged: List[StateDelta] = []
         seen_ids = set()
         for ex in executors:
@@ -173,9 +179,20 @@ class CheckpointManager:
                     )
                 seen_ids.add(delta.table_id)
                 staged.append(delta)
+        return staged
 
+    def commit_staged(self, epoch: int, staged: Sequence[StateDelta]) -> int:
+        """Build + upload SSTs for a staged epoch, then commit the
+        manifest. The single commit implementation behind both the sync
+        path and the runtime's async worker. Returns SSTs written."""
+        with self._lock:
+            if epoch <= int(self.version["max_committed_epoch"]):
+                raise ValueError(
+                    f"epoch {epoch} <= committed "
+                    f"{self.version['max_committed_epoch']}"
+                )
         n = 0
-        tables = self.version["tables"]
+        new_entries = []  # (table_id, entry) — registered under lock below
         for delta in staged:
             if len(delta.tombstone) == 0:
                 continue
@@ -189,51 +206,102 @@ class CheckpointManager:
             )
             path = f"{self.prefix}/sst/{delta.table_id}/{epoch:020d}.sst"
             self.store.put(path, blob)
-            tables.setdefault(delta.table_id, []).append(
-                {"path": path, "epoch": epoch}
+            new_entries.append(
+                (delta.table_id, {"path": path, "epoch": epoch})
             )
             n += 1
-        self.version["max_committed_epoch"] = epoch
-        self._persist_version()
+        with self._lock:
+            # re-validate under the lock: a concurrent commit may have
+            # advanced the epoch while our SSTs uploaded; publishing
+            # unconditionally could regress max_committed_epoch
+            if epoch <= int(self.version["max_committed_epoch"]):
+                for _, entry in new_entries:
+                    self.store.delete(entry["path"])
+                raise ValueError(
+                    f"epoch {epoch} <= committed "
+                    f"{self.version['max_committed_epoch']} (lost race)"
+                )
+            for table_id, entry in new_entries:
+                self.version["tables"].setdefault(table_id, []).append(entry)
+            self.version["max_committed_epoch"] = epoch
+            self._persist_version()
+        return n
+
+    def commit_epoch(self, epoch: int, executors: Sequence[object]) -> int:
+        """stage + commit_staged in one call (the standalone sync path;
+        compacts inline afterwards — the runtime's async lane instead
+        defers compaction to its dedicated worker)."""
+        # early epoch check so a stale epoch fails before mark flips
+        with self._lock:
+            if epoch <= int(self.version["max_committed_epoch"]):
+                raise ValueError(
+                    f"epoch {epoch} <= committed "
+                    f"{self.version['max_committed_epoch']}"
+                )
+        n = self.commit_staged(epoch, self.stage(executors))
         self._maybe_compact(epoch)
         return n
 
     # -- compaction ------------------------------------------------------
-    def _maybe_compact(self, epoch: int):
-        """Full-merge compaction per table once its L0 run gets long
-        (fast_compactor_runner analogue, synchronous v0): merge every
-        SST into one at the current epoch; tombstones drop entirely
-        (nothing older survives a full merge)."""
-        for table_id, entries in self.version["tables"].items():
-            if len(entries) < self.compact_at:
-                continue
-            ssts = [read_sst(self.store.read(e["path"])) for e in entries]
-            key_order = ssts[-1].meta.key_names
-            keys, values = merge_ssts(ssts, key_order)
-            n_rows = len(next(iter(keys.values()))) if keys else 0
-            blob = build_sst(
-                table_id,
-                epoch,
-                keys,
-                values,
-                np.zeros(n_rows, bool),
-                key_order,
-            )
-            path = f"{self.prefix}/sst/{table_id}/{epoch:020d}.compact.sst"
-            self.store.put(path, blob)
-            old = list(entries)
+    def tables_needing_compaction(self) -> List[str]:
+        with self._lock:
+            return [
+                t
+                for t, entries in self.version["tables"].items()
+                if len(entries) >= self.compact_at
+            ]
+
+    def compact_once(self, table_id: str, epoch: int) -> bool:
+        """Full-merge one table's SST run into a single SST
+        (fast_compactor_runner analogue), OFF the commit path: the
+        merge runs without the lock; the version swap is CAS-style —
+        if a concurrent commit appended new SSTs meanwhile, they are
+        preserved as the new run's suffix. Returns True if compacted."""
+        with self._lock:
+            entries = list(self.version["tables"].get(table_id, []))
+        if len(entries) < self.compact_at:
+            return False
+        ssts = [read_sst(self.store.read(e["path"])) for e in entries]
+        key_order = ssts[-1].meta.key_names
+        keys, values = merge_ssts(ssts, key_order)
+        n_rows = len(next(iter(keys.values()))) if keys else 0
+        blob = build_sst(
+            table_id,
+            epoch,
+            keys,
+            values,
+            np.zeros(n_rows, bool),
+            key_order,
+        )
+        path = f"{self.prefix}/sst/{table_id}/{epoch:020d}.compact.sst"
+        self.store.put(path, blob)
+        with self._lock:
+            cur = self.version["tables"].get(table_id, [])
+            if cur[: len(entries)] != entries:
+                # someone else rewrote the run (another compactor);
+                # abandon ours — the orphan SST is unreferenced
+                self.store.delete(path)
+                return False
             self.version["tables"][table_id] = [
                 {"path": path, "epoch": epoch}
-            ]
+            ] + cur[len(entries):]
             self._persist_version()
-            for e in old:  # GC after the new version is durable
-                self.store.delete(e["path"])
+        for e in entries:  # GC after the new version is durable
+            self.store.delete(e["path"])
+        return True
+
+    def _maybe_compact(self, epoch: int):
+        """Compact every over-long table run (synchronous helper for
+        tests and for runtimes without a compaction thread)."""
+        for table_id in self.tables_needing_compaction():
+            self.compact_once(table_id, epoch)
 
     # -- recovery --------------------------------------------------------
     def read_table(
         self, table_id: str
     ) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
-        entries = self.version["tables"].get(table_id, [])
+        with self._lock:
+            entries = list(self.version["tables"].get(table_id, []))
         ssts = [read_sst(self.store.read(e["path"])) for e in entries]
         if not ssts:
             return {}, {}

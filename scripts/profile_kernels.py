@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Profile the hot kernels at bench shape on the real device.
+
+Usage: python scripts/profile_kernels.py [--n 40960] [--cap 262144]
+Each section warms up (compile) then times K repetitions with
+block_until_ready. Prints one line per kernel.
+"""
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import risingwave_tpu  # noqa: F401  (enables x64)
+from risingwave_tpu.ops.hash_table import HashTable, lookup_or_insert
+from risingwave_tpu.ops.hashing import hash128
+from risingwave_tpu.ops import agg as agg_ops
+from risingwave_tpu.ops.agg import AggCall
+
+
+def timeit(name, fn, *args, reps=5, **kw):
+    out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:40s} {dt*1e3:10.3f} ms")
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=40960)
+    ap.add_argument("--cap", type=int, default=1 << 18)
+    args = ap.parse_args()
+    n, cap = args.n, args.cap
+    print(f"device={jax.devices()[0]} n={n} cap={cap}")
+
+    rng = np.random.default_rng(0)
+    auction = jnp.asarray(rng.integers(1000, 2000, n, dtype=np.int64))
+    wstart = jnp.asarray(
+        (rng.integers(0, 50, n, dtype=np.int64)) * 2000 + 1_600_000_000_000
+    )
+    valid = jnp.ones(n, jnp.bool_)
+    keys = (auction, wstart)
+
+    timeit("hash128(int64 x2)", jax.jit(lambda k: hash128(k)), keys)
+
+    # single gather / scatter at shape
+    big = jnp.zeros(cap, jnp.int64)
+    idx = jnp.asarray(rng.integers(0, cap, n, dtype=np.int32))
+    timeit("gather int64 [n from cap]", jax.jit(lambda b, i: b[i]), big, idx)
+    big32 = jnp.zeros(cap, jnp.int32)
+    timeit("gather int32 [n from cap]", jax.jit(lambda b, i: b[i]), big32, idx)
+    vals = jnp.ones(n, jnp.int64)
+    timeit(
+        "scatter int64 [n into cap]",
+        jax.jit(lambda b, i, v: b.at[i].set(v, mode="drop")),
+        big, idx, vals,
+    )
+
+    # one full lookup_or_insert
+    def mk_table():
+        return HashTable.create(cap, (auction.dtype, wstart.dtype))
+
+    t = mk_table()
+    t, slots, _, _ = lookup_or_insert(t, keys, valid)
+    jax.block_until_ready(t.fp1)
+    t0 = time.perf_counter()
+    reps = 5
+    for _ in range(reps):
+        t2 = mk_table()
+        t2, slots, _, _ = lookup_or_insert(t2, keys, valid)
+    jax.block_until_ready(t2.fp1)
+    print(f"{'lookup_or_insert (fresh table)':40s} {(time.perf_counter()-t0)/reps*1e3:10.3f} ms")
+
+    # agg apply at shape
+    calls = (AggCall(kind="count", input=None, output="cnt"),)
+    dtypes = {"auction": jnp.int64, "window_start": jnp.int64}
+    state = agg_ops.create_state(cap, calls, dtypes)
+    signs = jnp.ones(n, jnp.int64)
+    slots_c = jnp.asarray(rng.integers(0, cap, n, dtype=np.int32))
+    f = jax.jit(lambda s, sl, sg: agg_ops.apply(s, calls, sl, sg, {}, {}))
+    timeit("agg_ops.apply count", f, state, slots_c, signs)
+
+
+if __name__ == "__main__":
+    main()
