@@ -131,6 +131,56 @@ def _agg_scan(
     return table, state, dropped
 
 
+@partial(
+    jax.jit,
+    static_argnames=("calls", "group_keys", "nullable", "pre"),
+    donate_argnums=(0, 1),
+)
+def _agg_epoch_reduced(
+    table, state, dropped, stacked, calls, group_keys, nullable, pre
+):
+    """The TPU-first epoch path: vmap the stateless prefix over the
+    chunk axis, flatten the whole epoch into one row batch, pre-reduce
+    by key (sort + segment combine, ops/agg.reduce_by_key), then touch
+    the hash table ONCE per distinct key.
+
+    Replaces the lax.scan of per-chunk probe loops: the scan serialized
+    n_chunks × MAX_PROBE gather/scatter rounds, which real-TPU profiling
+    (BENCH_r02 fault analysis) showed running 20-50x slower than the
+    CPU actor. Commutativity across one epoch's rows makes the
+    reordering exact (sum/count; append-only min/max latch retractions
+    either way)."""
+    if pre is not None:
+        chunks = jax.vmap(pre)(stacked)
+    else:
+        chunks = stacked
+    flat = jax.tree.map(
+        lambda a: a.reshape((-1,) + a.shape[2:]), chunks
+    )
+    keys = _build_key_lanes(flat, group_keys, nullable)
+    signs = flat.effective_signs()
+    values = {c.input: flat.col(c.input) for c in calls if c.input is not None}
+    nulls = {
+        c.input: flat.nulls[c.input]
+        for c in calls
+        if c.input is not None and c.input in flat.nulls
+    }
+    sorted_keys, rep_valid, w, reduced, mret = agg_ops.reduce_by_key(
+        keys, signs, calls, values, nulls
+    )
+    table, slots, _, _ = lookup_or_insert(table, sorted_keys, rep_valid)
+    dropped = dropped | jnp.any(rep_valid & (slots < 0))
+    state = agg_ops.apply_reduced(
+        state, calls, slots, rep_valid, w, reduced, mret
+    )
+    table = set_live(
+        table,
+        jnp.where(rep_valid, slots, -1),
+        state.row_count[jnp.where(slots >= 0, slots, 0)] > 0,
+    )
+    return table, state, dropped
+
+
 @partial(jax.jit, static_argnames=("calls", "new_cap"))
 def _rehash(
     table: HashTable,
@@ -276,17 +326,23 @@ class HashAggExecutor(Executor, Checkpointable):
         )
         return []
 
-    def apply_stacked(self, stacked: StreamChunk, pre=None) -> List[StreamChunk]:
+    def apply_stacked(
+        self, stacked: StreamChunk, pre=None, mode: str = "reduce"
+    ) -> List[StreamChunk]:
         """Apply a whole BATCH of chunks in one device dispatch.
 
         ``stacked`` carries a leading (n_chunks,) axis on every lane
-        (see array.chunk stacking); the agg step runs as a
-        ``lax.scan`` over that axis with the state as carry, so an
-        entire epoch costs ONE dispatch instead of n_chunks (the
-        per-chunk Python dispatch dominates on TPU otherwise).
-        ``pre`` is an optional pure chunk->chunk function (e.g. the hop
-        expansion) traced INSIDE the scan body, fusing the upstream
-        stateless operators into the same program.
+        (see array.chunk stacking). ``pre`` is an optional pure
+        chunk->chunk function (e.g. the hop expansion) traced into the
+        same program, fusing the upstream stateless operators.
+
+        ``mode``:
+          "reduce" (default): flatten the epoch, sort + segment-reduce
+            by key, touch the table once per distinct key
+            (_agg_epoch_reduced) — the fast path on real TPU;
+          "scan": lax.scan of the per-chunk step (state as carry) —
+            kept for differential testing and for plans that need
+            strict intra-epoch chunk ordering.
         """
         n_chunks, cap = stacked.valid.shape[:2]
         probe = jax.eval_shape(
@@ -295,7 +351,8 @@ class HashAggExecutor(Executor, Checkpointable):
         )
         self._maybe_grow(n_chunks * probe.valid.shape[0])
         self._insert_bound += n_chunks * probe.valid.shape[0]
-        self.table, self.state, self.dropped = _agg_scan(
+        step = _agg_epoch_reduced if mode == "reduce" else _agg_scan
+        self.table, self.state, self.dropped = step(
             self.table,
             self.state,
             self.dropped,

@@ -320,6 +320,201 @@ def apply(
     )
 
 
+def reduce_by_key(
+    key_lanes: Tuple[jnp.ndarray, ...],
+    signs: jnp.ndarray,
+    calls: Tuple[AggCall, ...],
+    values: Dict[str, jnp.ndarray],
+    nulls: Dict[str, jnp.ndarray],
+):
+    """Pre-reduce a row batch by group key (pure; jit-composable).
+
+    The TPU-first answer to per-row hash probing: ``lax.sort`` (a
+    vectorized compare-exchange network — no serialized gathers)
+    clusters equal keys, segments split at any exact key change, and
+    every aggregate contribution is segment-reduced, so the hash table
+    downstream is probed and scattered once per DISTINCT key instead of
+    once per row. This is the StatelessSimpleAgg-before-shuffle shape
+    (src/stream/src/executor/stateless_simple_agg.rs) fused into the
+    operator, applied per epoch rather than per actor.
+
+    All agg kinds here are commutative across rows of one epoch batch
+    (sum/count exactly; min/max append-only with the retraction latch),
+    so reordering by sort is semantics-preserving.
+
+    Returns ``(sorted_keys, rep_valid, w, reduced, minmax_ret)``:
+      sorted_keys  key lanes in sort order (feed to lookup_or_insert)
+      rep_valid    bool (n,) — True on each segment's first row
+      w            int64 (n,) — Σ sign per segment, on rep rows
+      reduced      dict of per-call reduced lanes (on rep rows):
+                   'cnt_<out>' / 'sum_<out>' / 'nn_<out>' /
+                   'ext_<out>' / 'nnp_<out>'
+      minmax_ret   () bool — a retraction touched a MIN/MAX call
+    """
+    from risingwave_tpu.ops.hashing import hash128
+
+    n = signs.shape[0]
+    h1, h2 = hash128(key_lanes)
+    vmask = signs != 0
+    # invisible rows sort to the end (max fingerprint) and never become
+    # segment representatives
+    h1s = jnp.where(vmask, h1, jnp.uint32(0xFFFFFFFF))
+    h2s = jnp.where(vmask, h2, jnp.uint32(0xFFFFFFFF))
+
+    val_names = tuple(sorted(values))
+    null_names = tuple(sorted(nulls))
+    operands = (
+        [h1s, h2s]
+        + list(key_lanes)
+        + [signs.astype(jnp.int32), vmask]
+        + [values[nm] for nm in val_names]
+        + [nulls[nm] for nm in null_names]
+    )
+    sorted_ops = jax.lax.sort(tuple(operands), num_keys=2)
+    h1s, h2s = sorted_ops[0], sorted_ops[1]
+    nk = len(key_lanes)
+    sorted_keys = tuple(sorted_ops[2 : 2 + nk])
+    s_sign = sorted_ops[2 + nk].astype(jnp.int64)
+    s_vmask = sorted_ops[3 + nk]
+    s_vals = {
+        nm: sorted_ops[4 + nk + i] for i, nm in enumerate(val_names)
+    }
+    s_nulls = {
+        nm: sorted_ops[4 + nk + len(val_names) + i]
+        for i, nm in enumerate(null_names)
+    }
+
+    # segment boundary: first row, or ANY exact lane change (fingerprint
+    # collisions between different keys split correctly because the raw
+    # key lanes participate)
+    def lane_change(lane):
+        return jnp.concatenate(
+            [jnp.ones(1, jnp.bool_), lane[1:] != lane[:-1]]
+        )
+
+    boundary = lane_change(h1s) | lane_change(h2s) | lane_change(s_vmask)
+    for lane in sorted_keys:
+        ch = lane_change(lane)
+        if jnp.issubdtype(lane.dtype, jnp.floating):
+            both_nan = jnp.concatenate(
+                [
+                    jnp.zeros(1, jnp.bool_),
+                    jnp.isnan(lane[1:]) & jnp.isnan(lane[:-1]),
+                ]
+            )
+            ch = ch & ~both_nan  # NaN == NaN for grouping (total order)
+        boundary = boundary | ch
+    rep_valid = boundary & s_vmask
+    seg_id = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+
+    def segsum(x):
+        return jax.ops.segment_sum(x, seg_id, num_segments=n)[seg_id]
+
+    w = segsum(s_sign)
+    reduced: Dict[str, jnp.ndarray] = {}
+    minmax_ret = jnp.zeros((), jnp.bool_)
+    for c in calls:
+        if c.kind == "count_star":
+            continue  # uses w directly
+        v = s_vals[c.input]
+        notnull = ~s_nulls.get(c.input, jnp.zeros(v.shape, jnp.bool_))
+        wn = jnp.where(notnull, s_sign, 0)
+        if c.kind == "count":
+            reduced[f"cnt_{c.output}"] = segsum(wn)
+        elif c.kind == "sum":
+            acc_dt = _accum_dtype(c, v.dtype)
+            contrib = jnp.where(
+                notnull, v.astype(acc_dt) * s_sign.astype(acc_dt), 0
+            )
+            reduced[f"sum_{c.output}"] = segsum(contrib)
+            reduced[f"nn_{c.output}"] = segsum(wn)
+        else:  # min / max (append-only)
+            use = s_vmask & notnull & (s_sign > 0)
+            if jnp.issubdtype(v.dtype, jnp.floating):
+                v = _float_to_order_key(v)
+            acc_dt = _accum_dtype(c, s_vals[c.input].dtype)
+            sentinel = accum_init(c.kind, acc_dt)
+            vv = jnp.where(use, v.astype(acc_dt), sentinel)
+            seg_red = (
+                jax.ops.segment_min
+                if c.kind == "min"
+                else jax.ops.segment_max
+            )(vv, seg_id, num_segments=n)
+            reduced[f"ext_{c.output}"] = seg_red[seg_id]
+            reduced[f"nnp_{c.output}"] = segsum(
+                jnp.where(use, jnp.int64(1), jnp.int64(0))
+            )
+            minmax_ret = minmax_ret | jnp.any(s_vmask & notnull & (s_sign < 0))
+    return sorted_keys, rep_valid, w, reduced, minmax_ret
+
+
+def apply_reduced(
+    state: AggState,
+    calls: Tuple[AggCall, ...],
+    slots: jnp.ndarray,
+    rep_valid: jnp.ndarray,
+    w: jnp.ndarray,
+    reduced: Dict[str, jnp.ndarray],
+    minmax_ret: jnp.ndarray,
+) -> AggState:
+    """Apply ``reduce_by_key`` output to the state: one scatter per
+    lane, indices touched once per distinct key."""
+    cap = state.capacity
+    active = rep_valid & (slots >= 0)
+    idx = jnp.where(active, slots, cap)
+    ww = jnp.where(active, w, 0)
+
+    row_count = state.row_count.at[idx].add(ww, mode="drop")
+    dirty = state.dirty.at[idx].set(True, mode="drop")
+    sdirty = state.sdirty.at[idx].set(True, mode="drop")
+
+    accums = dict(state.accums)
+    nonnull = dict(state.nonnull)
+    for c in calls:
+        acc = accums[c.output]
+        if c.kind == "count_star":
+            accums[c.output] = acc.at[idx].add(ww, mode="drop")
+        elif c.kind == "count":
+            accums[c.output] = acc.at[idx].add(
+                jnp.where(active, reduced[f"cnt_{c.output}"], 0), mode="drop"
+            )
+        elif c.kind == "sum":
+            accums[c.output] = acc.at[idx].add(
+                jnp.where(active, reduced[f"sum_{c.output}"], 0).astype(
+                    acc.dtype
+                ),
+                mode="drop",
+            )
+            nonnull[c.output] = nonnull[c.output].at[idx].add(
+                jnp.where(active, reduced[f"nn_{c.output}"], 0), mode="drop"
+            )
+        else:  # min / max
+            sentinel = accum_init(c.kind, acc.dtype)
+            ext = jnp.where(
+                active, reduced[f"ext_{c.output}"].astype(acc.dtype), sentinel
+            )
+            if c.kind == "min":
+                accums[c.output] = acc.at[idx].min(ext, mode="drop")
+            else:
+                accums[c.output] = acc.at[idx].max(ext, mode="drop")
+            nonnull[c.output] = nonnull[c.output].at[idx].add(
+                jnp.where(active, reduced[f"nnp_{c.output}"], 0), mode="drop"
+            )
+
+    return AggState(
+        row_count=row_count,
+        accums=accums,
+        nonnull=nonnull,
+        emitted=state.emitted,
+        emitted_isnull=state.emitted_isnull,
+        emitted_valid=state.emitted_valid,
+        dirty=dirty,
+        minmax_retracted=state.minmax_retracted | minmax_ret,
+        sdirty=sdirty,
+        stored=state.stored,
+    )
+
+
 def _reset_groups(
     state: AggState,
     calls: Tuple[AggCall, ...],

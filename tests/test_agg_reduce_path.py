@@ -1,0 +1,224 @@
+"""Epoch pre-reduction agg path (ops/agg.reduce_by_key +
+hash_agg._agg_epoch_reduced) — differential vs the lax.scan path and
+the numpy oracle, plus a bench-shape tier so the suite exercises the
+shapes bench.py runs (VERDICT r2 #1: the suite was green while the
+bench crashed at untested shapes)."""
+
+import jax
+import numpy as np
+import pytest
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.executors.hash_agg import HashAggExecutor
+from risingwave_tpu.ops.agg import AggCall
+from risingwave_tpu.parallel.sharded_agg import stack_chunks
+
+
+CALLS = (
+    AggCall("count_star", None, "cnt"),
+    AggCall("count", "v", "cv"),
+    AggCall("sum", "v", "s"),
+    AggCall("min", "v", "mn"),
+    AggCall("max", "f", "mx"),
+)
+DTYPES = {"k": np.int64, "v": np.int64, "f": np.float64}
+
+
+def _mk_chunks(rng, n_chunks, cap, nkeys=40, with_nulls=True):
+    chunks = []
+    for _ in range(n_chunks):
+        n = int(rng.integers(cap // 2, cap + 1))
+        cols = {
+            "k": rng.integers(0, nkeys, n).astype(np.int64),
+            "v": rng.integers(-50, 100, n).astype(np.int64),
+            "f": rng.normal(size=n),
+        }
+        nulls = (
+            {"v": rng.random(n) < 0.2, "f": rng.random(n) < 0.2}
+            if with_nulls
+            else None
+        )
+        chunks.append(StreamChunk.from_numpy(cols, cap, nulls=nulls))
+    return chunks
+
+
+def _state_snapshot(ex):
+    live = np.asarray(ex.table.live)
+    k = np.asarray(ex.table.keys[0])[live]
+    out = {}
+    for name in ("cnt", "cv", "s", "mn", "mx"):
+        out[name] = dict(
+            zip(k.tolist(), np.asarray(ex.state.accums[name])[live].tolist())
+        )
+    for name in ("s", "mn", "mx"):
+        out[f"nn_{name}"] = dict(
+            zip(k.tolist(), np.asarray(ex.state.nonnull[name])[live].tolist())
+        )
+    return out
+
+
+def _run(mode, seed, epochs=3, n_chunks=4, cap=128):
+    rng = np.random.default_rng(seed)
+    ex = HashAggExecutor(
+        ["k"], CALLS, DTYPES, capacity=1 << 10, out_cap=1 << 9
+    )
+    for _ in range(epochs):
+        chunks = _mk_chunks(rng, n_chunks, cap)
+        ex.apply_stacked(stack_chunks(chunks), mode=mode)
+        ex.on_barrier(None)
+    return _state_snapshot(ex)
+
+
+def test_reduce_matches_scan():
+    assert _run("reduce", 3) == _run("scan", 3)
+
+
+def test_reduce_matches_oracle_append_only():
+    rng = np.random.default_rng(11)
+    ex = HashAggExecutor(
+        ["k"], CALLS, DTYPES, capacity=1 << 10, out_cap=1 << 9
+    )
+    cnt, cv, s = {}, {}, {}
+    rng2 = np.random.default_rng(11)
+    for _ in range(2):
+        chunks = _mk_chunks(rng, 3, 64)
+        ex.apply_stacked(stack_chunks(chunks), mode="reduce")
+        ex.on_barrier(None)
+        for c in _mk_chunks(rng2, 3, 64):
+            d = c.to_numpy(with_ops=True)
+            valid_n = len(d["k"])
+            for i in range(valid_n):
+                key = int(d["k"][i])
+                cnt[key] = cnt.get(key, 0) + 1
+                if not d.get("v__null", np.zeros(valid_n, bool))[i]:
+                    cv[key] = cv.get(key, 0) + 1
+                    s[key] = s.get(key, 0) + int(d["v"][i])
+    got = _state_snapshot(ex)
+    assert got["cnt"] == cnt
+    assert got["cv"] == cv
+    assert got["s"] == s
+
+
+def test_reduce_with_retractions_sum_count():
+    """Mixed +/- rows on sum/count only (min/max absent) — exact."""
+    calls = (AggCall("count_star", None, "cnt"), AggCall("sum", "v", "s"))
+    ex = HashAggExecutor(
+        ["k"], calls, {"k": np.int64, "v": np.int64}, capacity=256
+    )
+    from risingwave_tpu.types import Op
+
+    cols = {
+        "k": np.array([1, 1, 2, 2, 1], np.int64),
+        "v": np.array([10, 20, 5, 7, 10], np.int64),
+    }
+    ops = np.array(
+        [Op.INSERT, Op.INSERT, Op.INSERT, Op.DELETE, Op.DELETE], np.int32
+    )
+    c = StreamChunk.from_numpy(cols, 8, ops=ops)
+    ex.apply_stacked(stack_chunks([c]), mode="reduce")
+    ex.on_barrier(None)
+    snap_live = np.asarray(ex.table.live)
+    keys = np.asarray(ex.table.keys[0])[snap_live].tolist()
+    cnts = np.asarray(ex.state.accums["cnt"])[snap_live].tolist()
+    sums = np.asarray(ex.state.accums["s"])[snap_live].tolist()
+    got = dict(zip(keys, zip(cnts, sums)))
+    assert got == {1: (1, 20)}  # k=2 netted to zero rows -> dead group
+
+
+def test_reduce_minmax_retraction_latches():
+    ex = HashAggExecutor(
+        ["k"], (AggCall("min", "v", "mn"),),
+        {"k": np.int64, "v": np.int64}, capacity=256,
+    )
+    from risingwave_tpu.types import Op
+
+    c = StreamChunk.from_numpy(
+        {"k": np.array([1, 1], np.int64), "v": np.array([5, 5], np.int64)},
+        4,
+        ops=np.array([Op.INSERT, Op.DELETE], np.int32),
+    )
+    ex.apply_stacked(stack_chunks([c]), mode="reduce")
+    with pytest.raises(RuntimeError, match="materialized-input"):
+        ex.on_barrier(None)
+
+
+def test_fingerprint_collision_keys_not_merged(monkeypatch):
+    """Two different keys forced onto the SAME fingerprint must stay
+    separate groups (the raw key lanes split the sorted segment)."""
+    import risingwave_tpu.ops.agg as agg_mod
+
+    real_hash128 = None
+    from risingwave_tpu.ops import hashing
+
+    real_hash128 = hashing.hash128
+
+    def colliding(key_cols):
+        h1, h2 = real_hash128(key_cols)
+        return jax.numpy.zeros_like(h1) + 7, jax.numpy.zeros_like(h2) + 9
+
+    monkeypatch.setattr(hashing, "hash128", colliding)
+    try:
+        from risingwave_tpu.ops.agg import reduce_by_key
+
+        keys = (jax.numpy.asarray(np.array([3, 5, 3, 5, 5], np.int64)),)
+        signs = jax.numpy.ones(5, jax.numpy.int64)
+        sorted_keys, rep_valid, w, reduced, _ = reduce_by_key(
+            keys, signs, (AggCall("count_star", None, "c"),), {}, {}
+        )
+        # colliding fingerprints may split one key into several
+        # segments (unstable sort interleaves) — each hits the SAME
+        # table slot downstream, so the invariant is that per-key
+        # contributions SUM correctly and never cross keys
+        reps = np.asarray(sorted_keys[0])[np.asarray(rep_valid)]
+        ws = np.asarray(w)[np.asarray(rep_valid)]
+        got = {}
+        for k, v in zip(reps.tolist(), ws.tolist()):
+            got[k] = got.get(k, 0) + v
+        assert got == {3: 2, 5: 3}
+    finally:
+        monkeypatch.undo()
+
+
+def test_bench_shape_q5_epoch_compiles_and_runs():
+    """The exact q5 bench configuration (capacity 2^18, stacked epoch,
+    hop pre-fusion) must be exercised by the suite — a green suite with
+    a crashing bench is how round 2 ended."""
+    import functools
+
+    from risingwave_tpu.executors.hop_window import hop_step_fn
+    from risingwave_tpu.queries.nexmark_q import (
+        Q5_SLIDE_MS,
+        Q5_WINDOW_MS,
+        build_q5_lite,
+    )
+    from risingwave_tpu.connectors.nexmark import (
+        NexmarkConfig,
+        NexmarkGenerator,
+    )
+
+    pre = functools.partial(
+        hop_step_fn,
+        ts_col="date_time",
+        size_ms=Q5_WINDOW_MS,
+        slide_ms=Q5_SLIDE_MS,
+        out_start="window_start",
+    )
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=10_000))
+    cap = 8_192
+    chunks = []
+    done = 0
+    while done < 60_000:  # a few full-size chunks, not the whole epoch
+        ev = gen.next_events(cap)
+        done += cap
+        b = ev["bid"]
+        if b and len(b["auction"]):
+            chunks.append(
+                StreamChunk.from_numpy(
+                    {"auction": b["auction"], "date_time": b["date_time"]},
+                    cap,
+                )
+            )
+    q5 = build_q5_lite(capacity=1 << 18, state_cleaning=False)
+    q5.agg.apply_stacked(stack_chunks(chunks), pre=pre, mode="reduce")
+    q5.pipeline.barrier()
+    assert len(q5.mview.snapshot()) > 0
