@@ -1,12 +1,19 @@
 """HashJoin executor — streaming two-sided equi-join with retraction.
 
 Reference: src/stream/src/executor/hash_join.rs:129 (3,252 LoC) +
-executor/join/hash_join.rs:157 (JoinHashMap). Semantics matched (inner
-join):
+executor/join/hash_join.rs:157 (JoinHashMap + degree table). Semantics
+matched for INNER / LEFT / RIGHT / FULL OUTER / LEFT|RIGHT SEMI /
+LEFT|RIGHT ANTI:
 - each arriving chunk updates its own side's multiset state and probes
   the other side, emitting one output row per (probe row, stored match)
   with the probe row's sign (execute_inner / hash_eq_match,
   hash_join.rs:462-729);
+- outer/semi/anti variants ride per-stored-row DEGREE state: a row's
+  degree is its current match count on the other side; zero-crossings
+  drive NULL-pad retraction/revival (outer) or bare-row emission
+  (semi/anti) — the reference's degree table semantics
+  (join/hash_join.rs:157) realized as one extra (capacity, fanout)
+  int32 lane updated by batched scatter (ops/join.degree_apply);
 - barrier-aligned two-input operator: the runtime feeds chunks in
   arrival order via ``apply_left`` / ``apply_right`` and calls
   ``on_barrier`` once both inputs hit the barrier (barrier_align.rs);
@@ -19,10 +26,6 @@ row buckets, so one chunk's insert+delete+probe+emit runs as one fused
 jitted program per side. Output pairs are compacted into fixed
 ``out_cap`` chunks (static shapes; overflow latches and raises at the
 barrier, the capacity-growth contract shared with HashAgg).
-
-Inner join needs no degree state; LEFT/RIGHT/FULL outer variants add a
-degree lane to the same bucket layout when they land (degree table,
-join/hash_join.rs).
 """
 
 from __future__ import annotations
@@ -48,7 +51,9 @@ from risingwave_tpu.ops.join import (
     JoinSide,
     apply_side,
     compact_pairs,
+    degree_apply,
     expire_keys,
+    gather_flat,
     gather_matches,
     probe_side,
     regrow,
@@ -58,10 +63,31 @@ from risingwave_tpu.types import Op
 GROW_AT = 0.5
 
 
+JOIN_TYPES = (
+    "inner",
+    "left",
+    "right",
+    "full",
+    "left_semi",
+    "left_anti",
+    "right_semi",
+    "right_anti",
+)
+
+
 @partial(
     jax.jit,
-    static_argnames=("own_keys", "other_keys", "own_names", "other_names", "out_cap"),
-    donate_argnums=(0,),
+    static_argnames=(
+        "own_keys",
+        "other_keys",
+        "own_names",
+        "other_names",
+        "out_cap",
+        "join_type",
+        "arrival",
+        "out_names",
+    ),
+    donate_argnums=(0, 1),
 )
 def _join_step(
     own: JoinSide,
@@ -72,11 +98,40 @@ def _join_step(
     own_names: Tuple[str, ...],
     other_names: Tuple[str, ...],
     out_cap: int,
+    join_type: str = "inner",
+    arrival: str = "l",
+    out_names: Tuple[str, ...] = (),
 ):
-    """One chunk through its own side + probe of the other side.
+    """One chunk through its own side + probe of the other side, with
+    the full join-type matrix (reference hash_join.rs:129 inner/outer/
+    semi/anti variants + degree tables join/hash_join.rs:157).
 
-    Returns (own', out_cols, out_nulls, out_ops, out_valid, overflow).
+    Emission groups (all static-shape, compacted together):
+    1. PAIRS (inner/outer): one row per (probe row, stored match),
+       probe row's sign.
+    2. OWN NULL-PAD / SEMI / ANTI on arrival: probe rows judged by
+       their CURRENT match count mc (outer: mc==0 -> row + NULLs; semi:
+       mc>0 -> row; anti: mc==0 -> row), probe row's sign.
+    3. TRANSITIONS on the other side's stored rows whose degree crossed
+       zero (degree_apply): outer -> retract/revive the NULL-padded
+       row; semi/anti -> emit/retract the bare row.
+
+    Returns (own', other', out_cols, out_nulls, out_ops, out_valid,
+    overflow).
     """
+    semi_anti = join_type.endswith("semi") or join_type.endswith("anti")
+    drive = "l" if join_type.startswith("left") else "r"
+    pairs_on = not semi_anti
+    own_outer = join_type == "full" or (
+        (join_type == "left" and arrival == "l")
+        or (join_type == "right" and arrival == "r")
+    )
+    other_outer = join_type == "full" or (
+        (join_type == "left" and arrival == "r")
+        or (join_type == "right" and arrival == "l")
+    )
+    need_degree = join_type != "inner"
+
     key_cols = tuple(chunk.col(k) for k in own_keys)
     # SQL equi-join: NULL keys match nothing and need no state
     key_ok = jnp.ones(chunk.capacity, jnp.bool_)
@@ -86,43 +141,135 @@ def _join_step(
             key_ok &= ~lane
     valid = chunk.valid & key_ok
     signs = chunk.effective_signs()
+    active = valid & (signs != 0)
 
-    # probe the other side (read-only) and stage the emission
-    sl, match = probe_side(other, key_cols, valid & (signs != 0))
+    # probe the other side (read-only) and stage the emissions
+    sl, match = probe_side(other, key_cols, active)
     o_cols, o_nulls = gather_matches(other, sl, other_names)
+    mc = jnp.sum(match.astype(jnp.int32), axis=1)
 
     n, fanout = match.shape
-    flat = lambda a: a.reshape(n * fanout)
+    flatm = lambda a: a.reshape(n * fanout)
     bcast = lambda a: jnp.broadcast_to(a[:, None], (n, fanout))
 
-    flat_cols = {name: flat(bcast(chunk.col(name))) for name in own_names}
-    flat_cols.update({name: flat(o_cols[name]) for name in other_names})
-    flat_nulls = {
-        name: flat(bcast(lane))
-        for name, lane in chunk.nulls.items()
-        if name in own_names
-    }
-    flat_nulls.update({name: flat(lane) for name, lane in o_nulls.items()})
-    flat_ops = flat(
-        bcast(
-            jnp.where(
-                signs > 0,
-                jnp.int32(Op.INSERT),
-                jnp.int32(Op.DELETE),
+    groups = []  # (cols, nulls, ops, valid) of flat lanes
+
+    if pairs_on:
+        g_cols = {name: flatm(bcast(chunk.col(name))) for name in own_names}
+        g_cols.update({name: flatm(o_cols[name]) for name in other_names})
+        g_nulls = {
+            name: flatm(bcast(lane))
+            for name, lane in chunk.nulls.items()
+            if name in own_names
+        }
+        g_nulls.update({name: flatm(lane) for name, lane in o_nulls.items()})
+        g_ops = flatm(
+            bcast(
+                jnp.where(
+                    signs > 0, jnp.int32(Op.INSERT), jnp.int32(Op.DELETE)
+                )
             )
         )
-    )
+        groups.append((g_cols, g_nulls, g_ops, flatm(match)))
+
+    # group 2: judged by current match count, on arrival rows
+    if own_outer or (semi_anti and arrival == drive):
+        if own_outer:
+            cond = active & (mc == 0)
+        elif join_type.endswith("semi"):
+            cond = active & (mc > 0)
+        else:  # anti
+            cond = active & (mc == 0)
+        g_cols = {name: chunk.col(name) for name in own_names}
+        g_nulls = {
+            name: lane
+            for name, lane in chunk.nulls.items()
+            if name in own_names
+        }
+        if own_outer:  # NULL-pad the other side
+            for name in other_names:
+                g_cols[name] = jnp.zeros(n, other.rows[name].dtype)
+                g_nulls[name] = jnp.ones(n, jnp.bool_)
+        g_ops = jnp.where(
+            signs > 0, jnp.int32(Op.INSERT), jnp.int32(Op.DELETE)
+        )
+        groups.append((g_cols, g_nulls, g_ops, cond))
+
+    # degree maintenance + group 3: zero-crossing transitions
+    if need_degree:
+        other, trans_pid, went_pos, went_zero = degree_apply(
+            other, match, sl, jnp.where(active, signs, 0)
+        )
+        emit_trans = other_outer or (semi_anti and arrival != drive)
+        if emit_trans:
+            t_cols, t_nulls = gather_flat(other, trans_pid, other_names)
+            g_cols = dict(t_cols)
+            g_nulls = dict(t_nulls)
+            if other_outer:  # NULL-pad the arrival side
+                for name in own_names:
+                    g_cols[name] = jnp.zeros(
+                        trans_pid.shape[0], chunk.col(name).dtype
+                    )
+                    g_nulls[name] = jnp.ones(trans_pid.shape[0], jnp.bool_)
+            if other_outer or join_type.endswith("anti"):
+                # matched for the first time -> retract pad/bare row;
+                # unmatched again -> emit it
+                g_ops = jnp.where(
+                    went_pos, jnp.int32(Op.DELETE), jnp.int32(Op.INSERT)
+                )
+            else:  # semi: matched -> emit; unmatched -> retract
+                g_ops = jnp.where(
+                    went_pos, jnp.int32(Op.INSERT), jnp.int32(Op.DELETE)
+                )
+            groups.append((g_cols, g_nulls, g_ops, went_pos | went_zero))
+
+    # concatenate groups into one flat emission (schema = out_names)
+    flat_cols: Dict[str, jnp.ndarray] = {}
+    flat_nulls: Dict[str, jnp.ndarray] = {}
+    col_dtype = {}
+    for g_cols, _, _, _ in groups:
+        for name, a in g_cols.items():
+            col_dtype.setdefault(name, a.dtype)
+    null_names = set()
+    for _, g_nulls, _, _ in groups:
+        null_names.update(g_nulls)
+    for name in out_names:
+        parts, nparts = [], []
+        for g_cols, g_nulls, _, _ in groups:
+            m = next(iter(g_cols.values())).shape[0]
+            if name in g_cols:
+                parts.append(g_cols[name])
+            else:
+                parts.append(jnp.zeros(m, col_dtype[name]))
+            if name in null_names:
+                nparts.append(g_nulls.get(name, jnp.zeros(m, jnp.bool_)))
+        flat_cols[name] = jnp.concatenate(parts)
+        if nparts:
+            flat_nulls[name] = jnp.concatenate(nparts)
+    flat_ops = jnp.concatenate([g[2] for g in groups])
+    flat_valid = jnp.concatenate([g[3] for g in groups])
+
     out_cols, out_nulls, out_ops, out_valid, em_overflow = compact_pairs(
-        flat_cols, flat_nulls, flat_ops, flat(match), out_cap
+        flat_cols, flat_nulls, flat_ops, flat_valid, out_cap
     )
 
-    # then fold the chunk into our own state
+    # then fold the chunk into our own state (seeding degrees with the
+    # current match count for outer/semi/anti)
     payload = {name: chunk.col(name) for name in own_names}
     pnulls = {
         name: lane for name, lane in chunk.nulls.items() if name in own_names
     }
-    own = apply_side(own, key_cols, payload, pnulls, valid, signs, own_names)
-    return own, out_cols, out_nulls, out_ops, out_valid, em_overflow
+    own = apply_side(
+        own,
+        key_cols,
+        payload,
+        pnulls,
+        valid,
+        signs,
+        own_names,
+        init_degree=mc if need_degree else None,
+    )
+    return own, other, out_cols, out_nulls, out_ops, out_valid, em_overflow
 
 
 class HashJoinExecutor(Executor, Checkpointable):
@@ -156,9 +303,13 @@ class HashJoinExecutor(Executor, Checkpointable):
         left_nullable: Sequence[str] = (),
         right_nullable: Sequence[str] = (),
         window_cols: Optional[Tuple[str, str]] = None,
+        join_type: str = "inner",
         table_id: str = "hash_join",
     ):
         self.table_id = table_id
+        if join_type not in JOIN_TYPES:
+            raise ValueError(f"unknown join type {join_type!r}")
+        self.join_type = join_type
         if set(left_dtypes) & set(right_dtypes):
             raise ValueError(
                 f"overlapping output columns: {set(left_dtypes) & set(right_dtypes)}"
@@ -167,6 +318,14 @@ class HashJoinExecutor(Executor, Checkpointable):
         self.right_keys = tuple(right_keys)
         self.left_names = tuple(sorted(left_dtypes))
         self.right_names = tuple(sorted(right_dtypes))
+        if join_type.endswith("semi") or join_type.endswith("anti"):
+            self.out_names = (
+                self.left_names
+                if join_type.startswith("left")
+                else self.right_names
+            )
+        else:
+            self.out_names = self.left_names + self.right_names
         self.out_cap = out_cap
         self.window_cols = window_cols
 
@@ -212,7 +371,7 @@ class HashJoinExecutor(Executor, Checkpointable):
         own_names = self.left_names if side == "l" else self.right_names
         other_names = self.right_names if side == "l" else self.left_names
 
-        own, cols, nulls, ops, valid, em_overflow = _join_step(
+        own, other, cols, nulls, ops, valid, em_overflow = _join_step(
             own,
             other,
             chunk,
@@ -221,11 +380,14 @@ class HashJoinExecutor(Executor, Checkpointable):
             own_names,
             other_names,
             self.out_cap,
+            self.join_type,
+            side,
+            self.out_names,
         )
         if side == "l":
-            self.left = own
+            self.left, self.right = own, other
         else:
-            self.right = own
+            self.right, self.left = own, other
         self._bound[side] += chunk.capacity
         # latch on device; checked once per barrier (a bool() here would
         # force a host sync on every chunk and stall the pipeline)
@@ -310,6 +472,7 @@ def _side_mark_checkpointed(side: JoinSide, upsert, tomb) -> JoinSide:
         side.inconsistent,
         jnp.zeros_like(side.sdirty),
         (side.stored | upsert) & ~tomb,
+        side.degree,
     )
 
 
@@ -332,6 +495,7 @@ def _side_delta(side: JoinSide, table_id: str):
     }
     key_names = tuple(lanes)
     lanes["rv"] = side.row_valid
+    lanes["deg"] = side.degree
     for n, a in side.rows.items():
         lanes[f"r_{n}"] = a
     for n, a in side.row_nulls.items():
@@ -383,6 +547,12 @@ def _side_restore(side: JoinSide, key_cols, value_cols) -> JoinSide:
         for name, a in fresh.row_nulls.items()
     }
     row_valid = put2d(fresh.row_valid, value_cols["rv"])
+    # older checkpoints predate the degree lane; default to zeros
+    degree = (
+        put2d(fresh.degree, value_cols["deg"].astype(jnp.int32))
+        if "deg" in value_cols
+        else fresh.degree
+    )
     stored = fresh.stored.at[slots].set(True)
     return JoinSide(
         table,
@@ -393,6 +563,7 @@ def _side_restore(side: JoinSide, key_cols, value_cols) -> JoinSide:
         jnp.zeros((), jnp.bool_),
         jnp.zeros(cap, jnp.bool_),
         stored,
+        degree,
     )
 
 

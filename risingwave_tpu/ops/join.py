@@ -35,7 +35,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
-from typing import Dict, Sequence, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -69,6 +69,7 @@ class JoinSide:
     inconsistent: jnp.ndarray  # () bool
     sdirty: jnp.ndarray  # (capacity,) bool — changed since last checkpoint
     stored: jnp.ndarray  # (capacity,) bool — persisted in the object store
+    degree: jnp.ndarray  # (capacity, fanout) int32 — matches on other side
 
     def tree_flatten(self):
         names = tuple(sorted(self.rows))
@@ -82,6 +83,7 @@ class JoinSide:
             self.inconsistent,
             self.sdirty,
             self.stored,
+            self.degree,
         )
         return children, (names, null_names)
 
@@ -89,7 +91,7 @@ class JoinSide:
     def tree_unflatten(cls, aux, children):
         names, null_names = aux
         (table, rows, nulls, row_valid, overflow, inconsistent, sdirty,
-         stored) = children
+         stored, degree) = children
         return cls(
             table=table,
             rows=dict(zip(names, rows)),
@@ -99,6 +101,7 @@ class JoinSide:
             inconsistent=inconsistent,
             sdirty=sdirty,
             stored=stored,
+            degree=degree,
         )
 
     @property
@@ -131,6 +134,7 @@ class JoinSide:
             inconsistent=jnp.zeros((), jnp.bool_),
             sdirty=jnp.zeros(capacity, jnp.bool_),
             stored=jnp.zeros(capacity, jnp.bool_),
+            degree=jnp.zeros((capacity, fanout), jnp.int32),
         )
 
 
@@ -216,13 +220,17 @@ def apply_side(
     valid: jnp.ndarray,
     signs: jnp.ndarray,
     names: Tuple[str, ...],
+    init_degree: Optional[jnp.ndarray] = None,
 ):
     """Apply one chunk to its own side: inserts then deletes.
 
     ``signs``: +1 insert / -1 delete per row (0 = skip). Rows are
     multiset entries; inserts fill the first free bucket positions,
     deletes clear the rank-th matching entry (so an insert+delete of
-    the same row in one chunk nets out). Returns the updated side.
+    the same row in one chunk nets out). ``init_degree`` (outer joins)
+    seeds each inserted row's degree — its current match count on the
+    other side (reference degree table, join/hash_join.rs:157).
+    Returns the updated side.
     """
     ins = valid & (signs > 0)
     dele = valid & (signs < 0)
@@ -236,7 +244,7 @@ def apply_side(
     side = JoinSide(
         table, side.rows, side.row_nulls, side.row_valid,
         side.overflow | jnp.any(touch & (slots < 0)), side.inconsistent,
-        sdirty, side.stored,
+        sdirty, side.stored, side.degree,
     )
 
     h1, h2 = _row_fingerprint(payload_cols, payload_nulls, names)
@@ -278,9 +286,20 @@ def apply_side(
         .set(True, mode="drop")
         .reshape(cap, fanout)
     )
+    deg0 = (
+        init_degree.astype(jnp.int32)
+        if init_degree is not None
+        else jnp.zeros(n, jnp.int32)
+    )
+    degree = (
+        side.degree.reshape(-1)
+        .at[flat_idx]
+        .set(deg0, mode="drop")
+        .reshape(cap, fanout)
+    )
     side = JoinSide(
         side.table, rows, row_nulls, row_valid, overflow, side.inconsistent,
-        side.sdirty, side.stored,
+        side.sdirty, side.stored, degree,
     )
 
     # ---- deletes: rank-th matching entry -------------------------------
@@ -300,6 +319,12 @@ def apply_side(
         .set(False, mode="drop")
         .reshape(cap, fanout)
     )
+    degree = (
+        side.degree.reshape(-1)
+        .at[dflat]
+        .set(jnp.int32(0), mode="drop")
+        .reshape(cap, fanout)
+    )
 
     # key liveness = bucket non-empty (drives rehash survival + probes)
     touched_slots = jnp.where(touch & (slots >= 0), slots, -1)
@@ -307,8 +332,76 @@ def apply_side(
     table = set_live(side.table, touched_slots, any_live)
     return JoinSide(
         table, side.rows, side.row_nulls, row_valid, side.overflow,
-        inconsistent, side.sdirty, side.stored,
+        inconsistent, side.sdirty, side.stored, degree,
     )
+
+
+def degree_apply(
+    other: JoinSide,
+    match: jnp.ndarray,  # (n, fanout) live matches of this chunk's rows
+    sl: jnp.ndarray,  # (n,) probed slots (clamped >= 0)
+    signs: jnp.ndarray,  # (n,) ±1/0 per probe row
+):
+    """Bump the OTHER side's per-row degrees by this chunk's net effect
+    and report transitions (reference: degree table updates inside
+    hash_eq_match, join/hash_join.rs).
+
+    Returns ``(other', trans_pid, went_pos, went_zero)``:
+      trans_pid   (n*fanout,) int32 — flat (slot*fanout+pos) id of each
+                  DISTINCT matched stored row, on representative lanes;
+                  sentinel cap*fanout elsewhere
+      went_pos    bool — degree crossed 0 -> >0 (matched for the first
+                  time: outer joins retract the NULL-padded row)
+      went_zero   bool — degree crossed >0 -> 0 (NULL-pad comes back)
+    """
+    cap, fanout = other.capacity, other.fanout
+    n = match.shape[0]
+    sent = jnp.int32(cap * fanout)
+    pos_j = jnp.arange(fanout, dtype=jnp.int32)[None, :]
+    pid = jnp.where(match, sl[:, None] * fanout + pos_j, sent).reshape(-1)
+    delta = jnp.broadcast_to(signs[:, None], (n, fanout)).reshape(-1)
+    delta = jnp.where(pid != sent, delta, 0).astype(jnp.int32)
+
+    # distinct pids via sort + segment sum (multiple probe rows can hit
+    # the same stored row in one chunk; the TRANSITION is per stored
+    # row, over the chunk's net delta)
+    spid, sdelta = jax.lax.sort((pid, delta), num_keys=1)
+    boundary = jnp.concatenate(
+        [jnp.ones(1, jnp.bool_), spid[1:] != spid[:-1]]
+    )
+    seg_id = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    net = jax.ops.segment_sum(
+        sdelta, seg_id, num_segments=spid.shape[0]
+    )[seg_id]
+    rep = boundary & (spid != sent)
+
+    flat_deg = other.degree.reshape(-1)
+    old = flat_deg[jnp.minimum(spid, sent - 1)]
+    upd_idx = jnp.where(rep, spid, sent)
+    new_flat = flat_deg.at[upd_idx].add(jnp.where(rep, net, 0), mode="drop")
+    other = JoinSide(
+        other.table, other.rows, other.row_nulls, other.row_valid,
+        other.overflow, other.inconsistent, other.sdirty, other.stored,
+        new_flat.reshape(cap, fanout),
+    )
+    new = old + net
+    went_pos = rep & (old == 0) & (new > 0)
+    went_zero = rep & (old > 0) & (new <= 0)
+    trans_pid = jnp.where(rep, spid, sent)
+    return other, trans_pid, went_pos, went_zero
+
+
+def gather_flat(
+    side: JoinSide, pid: jnp.ndarray, names: Sequence[str]
+) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
+    """Gather payload at flat (slot*fanout+pos) ids (sentinel-safe)."""
+    cap, fanout = side.capacity, side.fanout
+    safe = jnp.minimum(pid, cap * fanout - 1)
+    cols = {n: side.rows[n].reshape(-1)[safe] for n in names}
+    nulls = {
+        n: lane.reshape(-1)[safe] for n, lane in side.row_nulls.items()
+    }
+    return cols, nulls
 
 
 def probe_side(
@@ -404,9 +497,10 @@ def regrow(side: JoinSide, new_cap: int, new_fanout: int) -> JoinSide:
     rows = {n: move(a, a.dtype) for n, a in side.rows.items()}
     row_nulls = {n: move(a, jnp.bool_) for n, a in side.row_nulls.items()}
     row_valid = move(side.row_valid & entry_ok, jnp.bool_)
+    degree = move(side.degree, jnp.int32)
     return JoinSide(
         new_table, rows, row_nulls, row_valid, side.overflow,
-        side.inconsistent, new_sdirty, new_stored,
+        side.inconsistent, new_sdirty, new_stored, degree,
     )
 
 
@@ -420,7 +514,8 @@ def expire_keys(side: JoinSide, key_index: int, cutoff: jnp.ndarray) -> JoinSide
     slots = jnp.where(expired, jnp.arange(side.capacity, dtype=jnp.int32), -1)
     table = set_live(side.table, slots, False)
     row_valid = side.row_valid & ~expired[:, None]
+    degree = jnp.where(expired[:, None], jnp.int32(0), side.degree)
     return JoinSide(
         table, side.rows, side.row_nulls, row_valid, side.overflow,
-        side.inconsistent, side.sdirty | expired, side.stored,
+        side.inconsistent, side.sdirty | expired, side.stored, degree,
     )

@@ -86,6 +86,7 @@ class Join:
     left: object  # relation
     right: object
     on: object  # expr
+    join_type: str = "inner"  # inner|left|right|full|{left,right}_{semi,anti}
 
 
 @dataclass(frozen=True)
@@ -208,12 +209,13 @@ class Parser:
             items.append(self.select_item())
         self.expect("kw", "from")
         rel = self.relation()
-        while self.accept("kw", "join") or (
-            self.accept("kw", "inner") and self.expect("kw", "join")
-        ):
+        while True:
+            jt = self._join_type()
+            if jt is None:
+                break
             right = self.relation()
             self.expect("kw", "on")
-            rel = Join(rel, right, self.expr())
+            rel = Join(rel, right, self.expr(), jt)
         where = self.expr() if self.accept("kw", "where") else None
         group: Tuple[Ident, ...] = ()
         if self.accept("kw", "group"):
