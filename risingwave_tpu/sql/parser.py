@@ -125,6 +125,7 @@ _KEYWORDS = {
     "interval", "second", "seconds", "millisecond", "milliseconds",
     "minute", "minutes", "case", "when", "then", "else", "end", "null", "order", "limit", "asc", "desc",
     "true", "false", "is", "between", "in", "distinct",
+    "left", "right", "full", "outer", "semi", "anti",
 }
 
 
@@ -200,6 +201,36 @@ class Parser:
         sel = self.select()
         self.expect("eof")
         return sel
+
+    def _join_type(self) -> Optional[str]:
+        """Consume a join-type prefix + JOIN keyword; None if no join follows.
+
+        Grammar (ref src/sqlparser parses the same surface forms):
+          [INNER] JOIN | LEFT [OUTER] JOIN | RIGHT [OUTER] JOIN
+          | FULL [OUTER] JOIN | LEFT SEMI JOIN | LEFT ANTI JOIN
+          | RIGHT SEMI JOIN | RIGHT ANTI JOIN
+        """
+        t = self.peek()
+        if t.kind != "kw" or t.value not in (
+            "join", "inner", "left", "right", "full"
+        ):
+            return None
+        if self.accept("kw", "join"):
+            return "inner"
+        if self.accept("kw", "inner"):
+            self.expect("kw", "join")
+            return "inner"
+        side = self.next().value  # left | right | full
+        if side in ("left", "right"):
+            if self.accept("kw", "semi"):
+                self.expect("kw", "join")
+                return f"{side}_semi"
+            if self.accept("kw", "anti"):
+                self.expect("kw", "join")
+                return f"{side}_anti"
+        self.accept("kw", "outer")
+        self.expect("kw", "join")
+        return side
 
     # -- select ----------------------------------------------------------
     def select(self) -> Select:
