@@ -125,8 +125,12 @@ _KEYWORDS = {
     "interval", "second", "seconds", "millisecond", "milliseconds",
     "minute", "minutes", "case", "when", "then", "else", "end", "null", "order", "limit", "asc", "desc",
     "true", "false", "is", "between", "in", "distinct",
-    "left", "right", "full", "outer", "semi", "anti",
 }
+
+# Contextual words (NOT reserved — usable as identifiers; recognized by
+# value only in join-type position, like the reference sqlparser's
+# non-reserved keywords after LEFT/RIGHT):
+_JOIN_WORDS = {"left", "right", "full", "outer", "semi", "anti"}
 
 
 @dataclass
@@ -202,6 +206,14 @@ class Parser:
         self.expect("eof")
         return sel
 
+    def _accept_word(self, value: str) -> bool:
+        """Accept a contextual word: matches a kw OR ident token by value."""
+        t = self.peek()
+        if t.kind in ("kw", "ident") and t.value == value:
+            self.next()
+            return True
+        return False
+
     def _join_type(self) -> Optional[str]:
         """Consume a join-type prefix + JOIN keyword; None if no join follows.
 
@@ -209,10 +221,13 @@ class Parser:
           [INNER] JOIN | LEFT [OUTER] JOIN | RIGHT [OUTER] JOIN
           | FULL [OUTER] JOIN | LEFT SEMI JOIN | LEFT ANTI JOIN
           | RIGHT SEMI JOIN | RIGHT ANTI JOIN
+        LEFT/RIGHT/FULL/OUTER/SEMI/ANTI are contextual (valid identifiers
+        elsewhere); only a trailing JOIN keyword commits the parse.
         """
         t = self.peek()
-        if t.kind != "kw" or t.value not in (
-            "join", "inner", "left", "right", "full"
+        if not (
+            (t.kind == "kw" and t.value in ("join", "inner"))
+            or (t.kind in ("kw", "ident") and t.value in ("left", "right", "full"))
         ):
             return None
         if self.accept("kw", "join"):
@@ -222,13 +237,13 @@ class Parser:
             return "inner"
         side = self.next().value  # left | right | full
         if side in ("left", "right"):
-            if self.accept("kw", "semi"):
+            if self._accept_word("semi"):
                 self.expect("kw", "join")
                 return f"{side}_semi"
-            if self.accept("kw", "anti"):
+            if self._accept_word("anti"):
                 self.expect("kw", "join")
                 return f"{side}_anti"
-        self.accept("kw", "outer")
+        self._accept_word("outer")
         self.expect("kw", "join")
         return side
 
@@ -304,19 +319,21 @@ class Parser:
                 size = self.interval_ms()
                 slide = first  # HOP(tbl, ts, slide, size) — pg/RW order
             self.expect("op", ")")
-            alias = None
-            if self.accept("kw", "as"):
-                alias = self.expect("ident").value
-            elif self.peek().kind == "ident":
-                alias = self.next().value
-            return WindowTVF(kind, table, ts_col, size, slide, alias)
+            return WindowTVF(
+                kind, table, ts_col, size, slide, self._rel_alias()
+            )
         name = self.expect("ident").value
-        alias = None
+        return TableRef(name, self._rel_alias())
+
+    def _rel_alias(self) -> Optional[str]:
+        """[AS] alias after a relation. A bare LEFT/RIGHT/FULL is a join
+        prefix, not an alias (contextual words; use AS to force)."""
         if self.accept("kw", "as"):
-            alias = self.expect("ident").value
-        elif self.peek().kind == "ident":
-            alias = self.next().value
-        return TableRef(name, alias)
+            return self.expect("ident").value
+        t = self.peek()
+        if t.kind == "ident" and t.value not in ("left", "right", "full"):
+            return self.next().value
+        return None
 
     def interval_ms(self) -> int:
         self.expect("kw", "interval")

@@ -159,6 +159,27 @@ def _is_agg(ast) -> bool:
     return isinstance(ast, P.FuncCall) and ast.name in AGG_FUNCS
 
 
+def _idents_in(ast):
+    """Yield every column reference in a scalar AST."""
+    if isinstance(ast, P.Ident):
+        yield ast
+    elif isinstance(ast, P.UnaryOp):
+        yield from _idents_in(ast.operand)
+    elif isinstance(ast, P.BinaryOp):
+        yield from _idents_in(ast.left)
+        yield from _idents_in(ast.right)
+    elif isinstance(ast, P.CaseExpr):
+        for c, v in ast.branches:
+            yield from _idents_in(c)
+            yield from _idents_in(v)
+        if ast.default is not None:
+            yield from _idents_in(ast.default)
+    elif isinstance(ast, P.FuncCall):
+        for a in ast.args:
+            if not isinstance(a, str):
+                yield from _idents_in(a)
+
+
 class StreamPlanner:
     def __init__(self, catalog: Catalog, capacity: int = 1 << 14):
         self.catalog = catalog
@@ -350,6 +371,7 @@ class StreamPlanner:
                 f"{set(left.schema) & set(right.schema)} — alias them apart"
             )
 
+        jt = join.join_type
         lkeys, rkeys = self._equi_keys(join.on, left, right)
         hj = HashJoinExecutor(
             left_keys=lkeys,
@@ -357,11 +379,27 @@ class StreamPlanner:
             left_dtypes=left.schema,
             right_dtypes=right.schema,
             capacity=self.capacity,
+            join_type=jt,
             table_id=self._tid(name, "join"),
         )
+        # output column set per join type (hash_join.rs:129 variants):
+        # semi/anti emit only the driving side; outer joins emit both
+        # with the padded side's columns nullable.
+        semi_anti = jt.endswith("_semi") or jt.endswith("_anti")
+        if semi_anti:
+            emit_side = left if jt.startswith("left") else right
+            visible = set(emit_side.schema)
+        else:
+            visible = set(left.schema) | set(right.schema)
         binder = Binder({**left.schema, **right.schema}, None)
         tail: List[Executor] = []
         if select.where is not None:
+            for ident in _idents_in(select.where):
+                n = self._join_resolve(ident, left, right)
+                if n not in visible:
+                    raise ValueError(
+                        f"WHERE references {n!r}, not emitted by a {jt} join"
+                    )
             tail.append(FilterExecutor(compile_scalar(select.where, binder)))
         if select.group_by:
             raise ValueError("GROUP BY over a join not supported yet")
@@ -369,9 +407,16 @@ class StreamPlanner:
         for i, item in enumerate(select.items):
             if not isinstance(item.expr, P.Ident):
                 raise ValueError("join select items must be bare columns v0")
-            out_names.append((self._join_resolve(item.expr, left, right),
-                              item.alias))
-        pk = tuple(left.pk) + tuple(right.pk)
+            n = self._join_resolve(item.expr, left, right)
+            if n not in visible:
+                raise ValueError(
+                    f"column {n!r} is not emitted by a {jt} join"
+                )
+            out_names.append((n, item.alias))
+        if semi_anti:
+            pk = tuple(emit_side.pk)
+        else:
+            pk = tuple(left.pk) + tuple(right.pk)
         proj = {alias or n: E.col(n) for n, alias in out_names}
         for p in pk:  # pk columns must survive into the MV
             proj.setdefault(p, E.col(p))

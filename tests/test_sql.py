@@ -147,6 +147,125 @@ def test_sql_q8_join_matches_pandas(catalog):
     assert set(got) == set(want)
 
 
+def _q8ish_inputs():
+    gen = NexmarkGenerator(NexmarkConfig())
+    all_p = {"id": [], "name": [], "date_time": []}
+    all_a = {"seller": [], "date_time": []}
+    feeds = []
+    for _ in range(6):
+        chunks = gen.next_chunks(2000, 2048)
+        feeds.append(chunks)
+        if chunks["person"] is not None:
+            d = chunks["person"].to_numpy(False)
+            for k in all_p:
+                all_p[k].extend(d[k].tolist())
+        if chunks["auction"] is not None:
+            d = chunks["auction"].to_numpy(False)
+            for k in all_a:
+                all_a[k].extend(d[k].tolist())
+    pdf = pd.DataFrame(all_p)
+    adf = pd.DataFrame(all_a)
+    pdf["starttime"] = (pdf.date_time // 10_000) * 10_000
+    adf["astarttime"] = (adf.date_time // 10_000) * 10_000
+    p = pdf[["id", "name", "starttime"]].drop_duplicates()
+    a = adf[["seller", "astarttime"]].drop_duplicates()
+    return feeds, p, a
+
+
+def _feed(mv, feeds):
+    for chunks in feeds:
+        if chunks["person"] is not None:
+            mv.pipeline.push_left(chunks["person"])
+        if chunks["auction"] is not None:
+            mv.pipeline.push_right(chunks["auction"])
+        mv.pipeline.barrier()
+
+
+_JOIN_SQL = (
+    "CREATE MATERIALIZED VIEW j AS "
+    "SELECT p.id, p.name, p.starttime{sel_a} FROM "
+    "(SELECT id, name, window_start AS starttime "
+    " FROM TUMBLE(person, date_time, INTERVAL '10' SECOND) "
+    " GROUP BY id, name, window_start) AS p "
+    "{jt} JOIN "
+    "(SELECT seller, window_start AS astarttime "
+    " FROM TUMBLE(auction, date_time, INTERVAL '10' SECOND) "
+    " GROUP BY seller, window_start) AS a "
+    "ON p.id = a.seller AND p.starttime = a.astarttime"
+)
+
+
+def test_sql_left_outer_join_matches_pandas(catalog):
+    planner = StreamPlanner(catalog, capacity=1 << 12)
+    mv = planner.plan(_JOIN_SQL.format(jt="LEFT OUTER", sel_a=", a.seller"))
+    feeds, p, a = _q8ish_inputs()
+    _feed(mv, feeds)
+    m = p.merge(
+        a, left_on=["id", "starttime"], right_on=["seller", "astarttime"],
+        how="left",
+    )
+    # pk = left pk + right pk; unmatched rows carry NULL (None) right pks
+    want = {}
+    for r in m.itertuples():
+        if pd.isna(r.seller):
+            want[(int(r.id), int(r.name), int(r.starttime), None, None)] = ()
+        else:
+            want[
+                (int(r.id), int(r.name), int(r.starttime), int(r.seller),
+                 int(r.astarttime))
+            ] = ()
+    got = mv.mview.snapshot()
+    assert len(want) > 20 and any(k[3] is None for k in want)
+    assert got == want
+
+
+def test_sql_left_semi_anti_join_matches_pandas(catalog):
+    feeds, p, a = _q8ish_inputs()
+    matched = p.merge(
+        a, left_on=["id", "starttime"], right_on=["seller", "astarttime"]
+    )[["id", "name", "starttime"]].drop_duplicates()
+    mkey = {
+        (int(r.id), int(r.name), int(r.starttime))
+        for r in matched.itertuples()
+    }
+    allp = {
+        (int(r.id), int(r.name), int(r.starttime)) for r in p.itertuples()
+    }
+    for jt, want_keys in (("LEFT SEMI", mkey), ("LEFT ANTI", allp - mkey)):
+        planner = StreamPlanner(Catalog(catalog.tables), capacity=1 << 12)
+        mv = planner.plan(_JOIN_SQL.format(jt=jt, sel_a=""))
+        _feed(mv, feeds)
+        got = mv.mview.snapshot()
+        assert set(got) == want_keys, jt
+    # anti+semi partition the left side
+    assert mkey and (allp - mkey)
+
+
+def test_sql_semi_join_rejects_other_side_columns(catalog):
+    planner = StreamPlanner(catalog, capacity=1 << 12)
+    with pytest.raises(ValueError, match="not emitted"):
+        planner.plan(_JOIN_SQL.format(jt="LEFT SEMI", sel_a=", a.seller"))
+    # ... and in WHERE (would KeyError at runtime if planned)
+    with pytest.raises(ValueError, match="not emitted"):
+        planner.plan(
+            _JOIN_SQL.format(jt="LEFT SEMI", sel_a="")
+            + " WHERE a.astarttime > 0"
+        )
+
+
+def test_join_words_stay_contextual():
+    """LEFT/RIGHT/FULL/OUTER/SEMI/ANTI are not reserved: still valid as
+    column names and aliases elsewhere."""
+    sel = parse("SELECT anti, semi FROM t WHERE outer > 1")
+    assert sel.items[0].expr == P.Ident("anti")
+    sel = parse("SELECT x FROM t AS left")  # AS forces the alias
+    assert sel.from_.alias == "left"
+    assert (
+        parse("SELECT x FROM t LEFT OUTER JOIN u ON t.a = u.b").from_.join_type
+        == "left"
+    )
+
+
 def test_sql_errors(catalog):
     planner = StreamPlanner(catalog)
     with pytest.raises(ValueError, match="not in GROUP BY"):
