@@ -39,8 +39,7 @@ from risingwave_tpu.storage.state_table import (
 GROW_AT = 0.5
 
 
-@partial(jax.jit, static_argnames=("keys",), donate_argnums=(0, 1))
-def _dedup_step(
+def dedup_step_fn(
     table: HashTable, sdirty, chunk: StreamChunk, keys: Tuple[str, ...]
 ):
     key_cols = tuple(chunk.col(k) for k in keys)
@@ -56,6 +55,11 @@ def _dedup_step(
     # `inserted` marks a claim's winner AND its same-key twins; keep one
     emit = inserted & first_occurrence_mask(slots, inserted)
     return table, sdirty, chunk.mask(emit), saw_delete, dropped
+
+
+_dedup_step = partial(
+    jax.jit, static_argnames=("keys",), donate_argnums=(0, 1)
+)(dedup_step_fn)
 
 
 @partial(jax.jit, static_argnames=("new_cap",))

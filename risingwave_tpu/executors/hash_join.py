@@ -75,21 +75,7 @@ JOIN_TYPES = (
 )
 
 
-@partial(
-    jax.jit,
-    static_argnames=(
-        "own_keys",
-        "other_keys",
-        "own_names",
-        "other_names",
-        "out_cap",
-        "join_type",
-        "arrival",
-        "out_names",
-    ),
-    donate_argnums=(0, 1),
-)
-def _join_step(
+def join_step_fn(
     own: JoinSide,
     other: JoinSide,
     chunk: StreamChunk,
@@ -270,6 +256,22 @@ def _join_step(
         init_degree=mc if need_degree else None,
     )
     return own, other, out_cols, out_nulls, out_ops, out_valid, em_overflow
+
+
+_join_step = partial(
+    jax.jit,
+    static_argnames=(
+        "own_keys",
+        "other_keys",
+        "own_names",
+        "other_names",
+        "out_cap",
+        "join_type",
+        "arrival",
+        "out_names",
+    ),
+    donate_argnums=(0, 1),
+)(join_step_fn)
 
 
 class HashJoinExecutor(Executor, Checkpointable):

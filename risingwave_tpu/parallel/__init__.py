@@ -14,5 +14,18 @@ keeps gRPC between compute nodes.
 """
 
 from risingwave_tpu.parallel.sharded_agg import ShardedHashAgg, make_mesh
+from risingwave_tpu.parallel.sharded_join import (
+    ShardedDedup,
+    ShardedHashJoin,
+    flatten_stacked,
+    stack_for_mesh,
+)
 
-__all__ = ["ShardedHashAgg", "make_mesh"]
+__all__ = [
+    "ShardedDedup",
+    "ShardedHashAgg",
+    "ShardedHashJoin",
+    "flatten_stacked",
+    "make_mesh",
+    "stack_for_mesh",
+]

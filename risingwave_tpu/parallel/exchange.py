@@ -1,0 +1,124 @@
+"""Vnode hash exchange — the generalized shuffle between sharded
+fragments.
+
+Reference roles replaced (SURVEY.md §2.11, §3.3):
+- ``HashDataDispatcher`` routing rows by key vnode to the actor that
+  owns them (src/stream/src/executor/dispatch.rs:683, vnode mapping
+  src/common/src/hash/consistent_hash/vnode.rs:34);
+- the exchange channel / gRPC GetStream between fragments
+  (src/stream/src/executor/exchange/permit.rs:35).
+
+TPU re-design: the "channel" is one ``lax.all_to_all`` over the mesh's
+ICI links, issued inside a ``shard_map``-ed program. Rows are packed
+into per-destination buckets of STATIC capacity (cumulative-count
+compaction, no sort), exchanged, and re-flattened — so the whole
+dispatcher+channel+merge stack of the reference becomes a few fused
+XLA collectives on device. Every sharded operator
+(``sharded_agg.ShardedHashAgg``, ``sharded_join.ShardedHashJoin``,
+``sharded_dedup.ShardedDedup``) builds on these primitives.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.ops.hashing import VNODE_COUNT, hash_columns
+
+
+def dest_shard(key_lanes, n_shards: int) -> jnp.ndarray:
+    """Row -> owning shard via vnode (vnode.rs:34 + vnode mapping):
+    256 vnodes round-robin over shards, so scaling the mesh only remaps
+    vnodes, never rehashes rows."""
+    vnode = (hash_columns(key_lanes, seed=0xC0FFEE) % VNODE_COUNT).astype(
+        jnp.int32
+    )
+    return vnode % n_shards
+
+
+def pack_buckets(
+    chunk_cols: Dict[str, jnp.ndarray], valid, dest, n_shards, bucket_cap
+):
+    """Scatter rows into an (n_shards, bucket_cap) buffer per column.
+
+    Position within a destination bucket = number of earlier valid rows
+    with the same destination (a cumsum per destination — n_shards is
+    static and small, so this is n_shards vectorized passes, no sort).
+    Returns (buffers, valid_buffer, overflow).
+    """
+    n = valid.shape[0]
+    pos = jnp.zeros(n, jnp.int32)
+    counts = []
+    for d in range(n_shards):
+        m = valid & (dest == d)
+        pos = jnp.where(m, jnp.cumsum(m.astype(jnp.int32)) - 1, pos)
+        counts.append(jnp.sum(m.astype(jnp.int32)))
+    overflow = jnp.any(jnp.stack(counts) > bucket_cap)
+
+    in_cap = valid & (pos < bucket_cap)
+    flat = dest * bucket_cap + pos  # index into (n_shards*bucket_cap,)
+    idx = jnp.where(in_cap, flat, n_shards * bucket_cap)  # drop lane
+
+    out = {}
+    for name, col in chunk_cols.items():
+        buf = jnp.zeros(n_shards * bucket_cap, col.dtype)
+        out[name] = (
+            buf.at[idx].set(col, mode="drop").reshape(n_shards, bucket_cap)
+        )
+    vbuf = (
+        jnp.zeros(n_shards * bucket_cap, jnp.bool_)
+        .at[idx]
+        .set(in_cap, mode="drop")
+        .reshape(n_shards, bucket_cap)
+    )
+    return out, vbuf, overflow
+
+
+def exchange_chunk(
+    chunk: StreamChunk,
+    key_lanes: Tuple[jnp.ndarray, ...],
+    n_shards: int,
+    bucket_cap: int,
+    axis: str,
+) -> Tuple[StreamChunk, jnp.ndarray]:
+    """Route a per-shard chunk's rows to their key-owning shards.
+
+    Call INSIDE a shard_map-ed program (per-shard view, no leading
+    shard axis). Ops and null lanes ride the same buckets as extra
+    columns. Returns (received_chunk of capacity n_shards*bucket_cap,
+    overflow_flag). Every row of the result lives on the shard that
+    owns vnode(key), so downstream keyed state is shard-local.
+    """
+    dest = dest_shard(key_lanes, n_shards)
+    cols = dict(chunk.columns)
+    cols["__ops__"] = chunk.ops
+    for name, lane in chunk.nulls.items():
+        cols["__null__" + name] = lane
+    bufs, vbuf, overflow = pack_buckets(
+        cols, chunk.valid, dest, n_shards, bucket_cap
+    )
+    ex = {
+        n: jax.lax.all_to_all(b, axis, 0, 0, tiled=False)
+        for n, b in bufs.items()
+    }
+    exv = jax.lax.all_to_all(vbuf, axis, 0, 0, tiled=False)
+
+    flatten = lambda a: a.reshape(n_shards * bucket_cap)
+    received = StreamChunk(
+        columns={
+            n: flatten(b)
+            for n, b in ex.items()
+            if n != "__ops__" and not n.startswith("__null__")
+        },
+        valid=flatten(exv),
+        nulls={
+            n[len("__null__"):]: flatten(b)
+            for n, b in ex.items()
+            if n.startswith("__null__")
+        },
+        ops=flatten(ex["__ops__"]),
+    )
+    return received, overflow

@@ -44,7 +44,11 @@ from risingwave_tpu.executors.hash_agg import _build_key_lanes
 from risingwave_tpu.ops import agg as agg_ops
 from risingwave_tpu.ops.agg import AggCall, AggState
 from risingwave_tpu.ops.hash_table import HashTable, lookup_or_insert, set_live
-from risingwave_tpu.ops.hashing import VNODE_COUNT, hash_columns
+from risingwave_tpu.parallel.exchange import (
+    dest_shard as _dest_shard,
+    exchange_chunk,
+    pack_buckets as _pack_buckets,
+)
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "shard") -> Mesh:
@@ -52,48 +56,6 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "shard") -> Mesh:
     if n_devices is not None:
         devs = devs[:n_devices]
     return Mesh(np.asarray(devs), (axis,))
-
-
-def _dest_shard(key_lanes, n_shards: int) -> jnp.ndarray:
-    """Row -> owning shard via vnode (vnode.rs:34 + vnode mapping):
-    256 vnodes round-robin over shards, so scaling the mesh only remaps
-    vnodes, never rehashes rows."""
-    vnode = (hash_columns(key_lanes, seed=0xC0FFEE) % VNODE_COUNT).astype(jnp.int32)
-    return vnode % n_shards
-
-
-def _pack_buckets(chunk_cols: Dict[str, jnp.ndarray], valid, dest, n_shards, bucket_cap):
-    """Scatter rows into an (n_shards, bucket_cap) buffer per column.
-
-    Position within a destination bucket = number of earlier valid rows
-    with the same destination (a cumsum per destination — n_shards is
-    static and small, so this is n_shards vectorized passes, no sort).
-    Returns (buffers, valid_buffer, overflow).
-    """
-    n = valid.shape[0]
-    pos = jnp.zeros(n, jnp.int32)
-    counts = []
-    for d in range(n_shards):
-        m = valid & (dest == d)
-        pos = jnp.where(m, jnp.cumsum(m.astype(jnp.int32)) - 1, pos)
-        counts.append(jnp.sum(m.astype(jnp.int32)))
-    overflow = jnp.any(jnp.stack(counts) > bucket_cap)
-
-    in_cap = valid & (pos < bucket_cap)
-    flat = dest * bucket_cap + pos  # index into (n_shards*bucket_cap,)
-    idx = jnp.where(in_cap, flat, n_shards * bucket_cap)  # drop lane
-
-    out = {}
-    for name, col in chunk_cols.items():
-        buf = jnp.zeros(n_shards * bucket_cap, col.dtype)
-        out[name] = buf.at[idx].set(col, mode="drop").reshape(n_shards, bucket_cap)
-    vbuf = (
-        jnp.zeros(n_shards * bucket_cap, jnp.bool_)
-        .at[idx]
-        .set(in_cap, mode="drop")
-        .reshape(n_shards, bucket_cap)
-    )
-    return out, vbuf, overflow
 
 
 class ShardedHashAgg(Executor):
@@ -167,43 +129,13 @@ class ShardedHashAgg(Executor):
             dropped = dropped[0]
             chunk = jax.tree.map(lambda a: a[0], chunk)
 
-            # 1) destination shard per row (vnode of group key)
+            # 1-3) vnode route + bucket pack + all_to_all ICI shuffle
             keys = _build_key_lanes(chunk, group_keys, nullable)
-            dest = _dest_shard(keys, n_shards)
-
-            # 2) pack per-destination buckets (ops and null lanes folded in
-            #    as extra columns so they ride the same exchange)
-            cols = dict(chunk.columns)
-            cols["__ops__"] = chunk.ops
-            for name, lane in chunk.nulls.items():
-                cols["__null__" + name] = lane
-            bufs, vbuf, overflow = _pack_buckets(
-                cols, chunk.valid, dest, n_shards, bucket_cap
+            rchunk, overflow = exchange_chunk(
+                chunk, keys, n_shards, bucket_cap, axis
             )
-
-            # 3) the ICI shuffle: every shard sends bucket d to shard d
-            ex = {
-                n: jax.lax.all_to_all(b, axis, 0, 0, tiled=False)
-                for n, b in bufs.items()
-            }
-            exv = jax.lax.all_to_all(vbuf, axis, 0, 0, tiled=False)
 
             # 4) local agg over the received rows
-            flatten = lambda a: a.reshape(n_shards * bucket_cap)
-            rchunk = StreamChunk(
-                columns={
-                    n: flatten(b)
-                    for n, b in ex.items()
-                    if n != "__ops__" and not n.startswith("__null__")
-                },
-                valid=flatten(exv),
-                nulls={
-                    n[len("__null__"):]: flatten(b)
-                    for n, b in ex.items()
-                    if n.startswith("__null__")
-                },
-                ops=flatten(ex["__ops__"]),
-            )
             rkeys = _build_key_lanes(rchunk, group_keys, nullable)
             table, slots, _, _ = lookup_or_insert(table, rkeys, rchunk.valid)
             signs = rchunk.effective_signs()
