@@ -411,17 +411,38 @@ def bench_q5(args_epochs, events_per_epoch, chunk_events, smoke, agg_mode):
 
 # ---------------------------------------------------------------------------
 # Orchestration: each query benches in an isolated SUBPROCESS with a
-# timeout and tiered fallback shapes, so one kernel fault / hang cannot
-# zero out the whole benchmark (VERDICT r2 #1). The parent always prints
-# ONE JSON line.
+# timeout and tiered ESCALATION, so one kernel fault / hang cannot zero
+# out the whole benchmark (VERDICT r2 #1). Tiers run smallest-first:
+# each success is banked before risking a bigger shape, because a
+# killed/timed-out TPU process can wedge the single-client tunnel and
+# starve every later attempt. The parent always prints ONE JSON line.
 # ---------------------------------------------------------------------------
 
 TIERS = {
     # (epochs, events_per_epoch, chunk_events, timeout_s)
     "full": (10, 200_000, 8_192, 900),
-    "mid": (5, 50_000, 4_096, 600),
-    "smoke_dev": (2, 10_000, 2_048, 420),
+    "mid": (5, 50_000, 4_096, 420),
+    "smoke_dev": (2, 10_000, 2_048, 300),
 }
+TIER_ORDER = ["smoke_dev", "mid", "full"]  # escalate, banking each success
+
+
+def _device_alive(timeout_s: int = 90) -> bool:
+    """Fresh-process probe: can a client still acquire the device? A
+    SIGKILLed bench child can wedge the single-client TPU tunnel; when
+    that happens every later jax.devices() hangs, so detect it cheaply
+    instead of burning each tier's full timeout."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True,
+            timeout=timeout_s,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
 
 
 def _run_child(query: str, tier: str, smoke: bool, agg_mode: str):
@@ -534,18 +555,28 @@ def main():
         print(json.dumps(result))
         return
 
-    # orchestrator: subprocess per query with tier fallback
-    tiers = ["smoke_dev"] if args.smoke else ["full", "mid", "smoke_dev"]
+    # orchestrator: subprocess per query, escalating tiers smallest-first
+    tiers = ["smoke_dev"] if args.smoke else TIER_ORDER
     merged = {}
     errors = []
+    dead = False
     for query in ("q5", "q8", "q7"):
         got = None
         for tier in tiers:
-            got, err = _run_child(query, tier, args.smoke, args.agg_mode)
-            if got is not None:
-                got[f"{query}_tier" if query != "q5" else "tier"] = tier
+            if dead:
                 break
+            sub, err = _run_child(query, tier, args.smoke, args.agg_mode)
+            if sub is not None:
+                sub[f"{query}_tier" if query != "q5" else "tier"] = tier
+                got = sub  # bank the largest successful tier
+                continue
             errors.append(err)
+            if not args.smoke and not _device_alive():
+                # the failed child wedged the tunnel: stop risking the
+                # banked results; report what we have
+                errors.append(f"{query}/{tier}: device wedged; stopping")
+                dead = True
+            break  # don't escalate past a failure
         if got is not None:
             merged.update(got)
     if "metric" not in merged:
