@@ -41,9 +41,11 @@ from risingwave_tpu.storage.state_table import (
     stage_marks,
 )
 from risingwave_tpu.ops import agg as agg_ops
+from risingwave_tpu.ops import minput as mi_ops
 from risingwave_tpu.ops.agg import AggCall, AggState
 from risingwave_tpu.ops.hash_table import (
     HashTable,
+    lookup,
     lookup_or_insert,
     plan_rehash,
     set_live,
@@ -73,6 +75,32 @@ def _build_key_lanes(
     return tuple(lanes)
 
 
+def _minput_pass(state, minput, mi_bad, calls, slots, signs, chunk):
+    """Fold a row batch into every materialized MIN/MAX multiset and
+    write each touched group's new extreme / live count back into the
+    ordinary accumulator lanes (so flush is unchanged)."""
+    cap = state.capacity
+    for c in calls:
+        if not c.materialized:
+            continue
+        v = chunk.col(c.input)
+        notnull = ~chunk.nulls.get(c.input, jnp.zeros(v.shape, jnp.bool_))
+        vals, cnt = minput[c.output]
+        vals, cnt, rep_slots, extreme, total, ovf, inc = mi_ops.minput_apply(
+            vals, cnt, slots, signs, v, notnull, c.kind
+        )
+        minput[c.output] = (vals, cnt)
+        idx = jnp.where(rep_slots >= 0, rep_slots, cap)
+        state.accums[c.output] = (
+            state.accums[c.output].at[idx].set(extreme, mode="drop")
+        )
+        state.nonnull[c.output] = (
+            state.nonnull[c.output].at[idx].set(total, mode="drop")
+        )
+        mi_bad = mi_bad | ovf | inc
+    return state, minput, mi_bad
+
+
 def agg_step_fn(
     table: HashTable,
     state: AggState,
@@ -81,8 +109,15 @@ def agg_step_fn(
     calls: Tuple[AggCall, ...],
     group_keys: Tuple[str, ...],
     nullable: Tuple[bool, ...],
+    minput=None,
+    mi_bad=None,
 ):
-    """One chunk through the group map + agg update (pure; jit it)."""
+    """One chunk through the group map + agg update (pure; jit it).
+
+    With ``minput`` (materialized MIN/MAX multisets, ops/minput.py) the
+    same dispatch also folds the batch into those and returns
+    ``(table, state, dropped, minput, mi_bad)``; otherwise the classic
+    3-tuple."""
     keys = _build_key_lanes(chunk, group_keys, nullable)
     table, slots, _, _ = lookup_or_insert(table, keys, chunk.valid)
     signs = chunk.effective_signs()
@@ -95,7 +130,12 @@ def agg_step_fn(
     }
     state = agg_ops.apply(state, calls, slots, signs, values, nulls)
     table = set_live(table, slots, state.row_count[slots] > 0)
-    return table, state, dropped
+    if minput is None:
+        return table, state, dropped
+    state, minput, mi_bad = _minput_pass(
+        state, dict(minput), mi_bad, calls, slots, signs, chunk
+    )
+    return table, state, dropped, minput, mi_bad
 
 
 _agg_step = jax.jit(
@@ -103,6 +143,18 @@ _agg_step = jax.jit(
     static_argnames=("calls", "group_keys", "nullable"),
     donate_argnums=(0, 1),
 )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("calls", "group_keys", "nullable"),
+    donate_argnums=(0, 1, 3, 4),
+)
+def _agg_step_mi(table, state, dropped, minput, mi_bad, chunk, calls, group_keys, nullable):
+    return agg_step_fn(
+        table, state, dropped, chunk, calls, group_keys, nullable,
+        minput, mi_bad,
+    )
 
 
 @partial(
@@ -131,13 +183,9 @@ def _agg_scan(
     return table, state, dropped
 
 
-@partial(
-    jax.jit,
-    static_argnames=("calls", "group_keys", "nullable", "pre"),
-    donate_argnums=(0, 1),
-)
-def _agg_epoch_reduced(
-    table, state, dropped, stacked, calls, group_keys, nullable, pre
+def _epoch_reduced_fn(
+    table, state, dropped, stacked, calls, group_keys, nullable, pre,
+    minput=None, mi_bad=None,
 ):
     """The TPU-first epoch path: vmap the stateless prefix over the
     chunk axis, flatten the whole epoch into one row batch, pre-reduce
@@ -178,13 +226,46 @@ def _agg_epoch_reduced(
         jnp.where(rep_valid, slots, -1),
         state.row_count[jnp.where(slots >= 0, slots, 0)] > 0,
     )
-    return table, state, dropped
+    if minput is None:
+        return table, state, dropped
+    # materialized MIN/MAX: re-probe (read-only) for EVERY flat row's
+    # slot — the rep insert above guarantees hits — then fold the raw
+    # rows into the multisets
+    row_signs = flat.effective_signs()
+    row_slots, _ = lookup(table, keys, flat.valid & (row_signs != 0))
+    state, minput, mi_bad = _minput_pass(
+        state, dict(minput), mi_bad, calls, row_slots, row_signs, flat
+    )
+    return table, state, dropped, minput, mi_bad
+
+
+_agg_epoch_reduced = partial(
+    jax.jit,
+    static_argnames=("calls", "group_keys", "nullable", "pre"),
+    donate_argnums=(0, 1),
+)(_epoch_reduced_fn)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("calls", "group_keys", "nullable", "pre"),
+    donate_argnums=(0, 1, 8, 9),
+)
+def _agg_epoch_reduced_mi(
+    table, state, dropped, stacked, calls, group_keys, nullable, pre,
+    minput, mi_bad,
+):
+    return _epoch_reduced_fn(
+        table, state, dropped, stacked, calls, group_keys, nullable, pre,
+        minput, mi_bad,
+    )
 
 
 @partial(jax.jit, static_argnames=("calls", "new_cap"))
 def _rehash(
     table: HashTable,
     state: AggState,
+    minput,
     calls: Tuple[AggCall, ...],
     new_cap: int,
 ):
@@ -234,7 +315,11 @@ def _rehash(
         sdirty=rescatter(state.sdirty, jnp.zeros((), jnp.bool_)),
         stored=rescatter(state.stored, jnp.zeros((), jnp.bool_)),
     )
-    return new_table, new_state
+    new_minput = {
+        name: mi_ops.minput_rescatter(v, c, keep, new_slots, new_cap)
+        for name, (v, c) in minput.items()
+    }
+    return new_table, new_state, new_minput
 
 
 @partial(jax.jit, static_argnames=("calls", "key_index", "emit_deletes"))
@@ -284,6 +369,7 @@ class HashAggExecutor(Executor, Checkpointable):
         nullable_keys: Sequence[str] = (),
         window_key: Optional[Tuple[str, int, bool]] = None,
         table_id: str = "hash_agg",
+        minput_k: int = 32,
     ):
         self.table_id = table_id
         self.group_keys = tuple(group_keys)
@@ -304,6 +390,12 @@ class HashAggExecutor(Executor, Checkpointable):
         self._float_extremes = agg_ops.float_extreme_meta(
             self.calls, {k: jnp.dtype(v) for k, v in self._dtypes.items()}
         )
+        # materialized-input MIN/MAX multisets (minput.rs analogue)
+        self.minput_k = minput_k
+        self.minput = mi_ops.create_minput(
+            capacity, minput_k, self.calls, self._dtypes
+        )
+        self.mi_bad = jnp.zeros((), jnp.bool_)
 
     # -- data ------------------------------------------------------------
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
@@ -315,15 +407,34 @@ class HashAggExecutor(Executor, Checkpointable):
                 )
         self._maybe_grow(chunk.capacity)
         self._insert_bound += chunk.capacity
-        self.table, self.state, self.dropped = _agg_step(
-            self.table,
-            self.state,
-            self.dropped,
-            chunk,
-            self.calls,
-            self.group_keys,
-            self.nullable,
-        )
+        if self.minput:
+            (
+                self.table,
+                self.state,
+                self.dropped,
+                self.minput,
+                self.mi_bad,
+            ) = _agg_step_mi(
+                self.table,
+                self.state,
+                self.dropped,
+                self.minput,
+                self.mi_bad,
+                chunk,
+                self.calls,
+                self.group_keys,
+                self.nullable,
+            )
+        else:
+            self.table, self.state, self.dropped = _agg_step(
+                self.table,
+                self.state,
+                self.dropped,
+                chunk,
+                self.calls,
+                self.group_keys,
+                self.nullable,
+            )
         return []
 
     def apply_stacked(
@@ -351,6 +462,31 @@ class HashAggExecutor(Executor, Checkpointable):
         )
         self._maybe_grow(n_chunks * probe.valid.shape[0])
         self._insert_bound += n_chunks * probe.valid.shape[0]
+        if self.minput:
+            if mode != "reduce":
+                raise ValueError(
+                    "materialized MIN/MAX supports apply_stacked only in "
+                    "'reduce' mode (use apply for per-chunk ordering)"
+                )
+            (
+                self.table,
+                self.state,
+                self.dropped,
+                self.minput,
+                self.mi_bad,
+            ) = _agg_epoch_reduced_mi(
+                self.table,
+                self.state,
+                self.dropped,
+                stacked,
+                self.calls,
+                self.group_keys,
+                self.nullable,
+                pre,
+                self.minput,
+                self.mi_bad,
+            )
+            return []
         step = _agg_epoch_reduced if mode == "reduce" else _agg_scan
         self.table, self.state, self.dropped = step(
             self.table,
@@ -386,8 +522,8 @@ class HashAggExecutor(Executor, Checkpointable):
         )
         new_cap = plan_rehash(cap, incoming, claimed, keep, GROW_AT)
         if new_cap is not None:
-            self.table, self.state = _rehash(
-                self.table, self.state, self.calls, new_cap
+            self.table, self.state, self.minput = _rehash(
+                self.table, self.state, self.minput, self.calls, new_cap
             )
             claimed = int(self.table.occupancy())
         self._insert_bound = claimed
@@ -406,7 +542,14 @@ class HashAggExecutor(Executor, Checkpointable):
             # the MaterializedInput escalation path)
             raise RuntimeError(
                 "row-level retraction hit an append-only MIN/MAX aggregate; "
-                "this plan requires materialized-input extremes"
+                "set AggCall(materialized=True) for materialized-input "
+                "extremes"
+            )
+        if bool(self.mi_bad):
+            raise RuntimeError(
+                "materialized MIN/MAX state overflowed minput_k distinct "
+                "values per group, or a value was retracted that was never "
+                "inserted"
             )
         return self._flush_all()
 
@@ -436,6 +579,16 @@ class HashAggExecutor(Executor, Checkpointable):
             outs = self._flush_all()
         cutoff = jnp.asarray(watermark.value - retention, dtype=jnp.int64)
         key_index = self._key_lane_index(colname)
+        if self.minput:
+            lane = self.table.keys[key_index]
+            expired = self.table.live & (lane < cutoff)
+            slots = jnp.where(
+                expired, jnp.arange(self.table.capacity, dtype=jnp.int32), -1
+            )
+            self.minput = {
+                name: mi_ops.minput_clear(v, c, slots)
+                for name, (v, c) in self.minput.items()
+            }
         self.table, self.state = _expire(
             self.table, self.state, cutoff, self.calls, key_index, emit_deletes
         )
@@ -520,6 +673,9 @@ def _agg_checkpoint_delta(self) -> List[StateDelta]:
     for n, a in self.state.nonnull.items():
         lanes[f"nn_{n}"] = a
         lanes[f"ei_{n}"] = self.state.emitted_isnull[n]
+    for n, (v, c) in self.minput.items():
+        lanes[f"miv_{n}"] = v  # 2D (rows re-land whole)
+        lanes[f"mic_{n}"] = c
     lanes["ev"] = self.state.emitted_valid
     pulled = pull_rows(lanes, sel)
     keys = {k: pulled[k] for k in key_names}
@@ -547,6 +703,7 @@ def _agg_restore_state(self, table_id, key_cols, value_cols) -> None:
     cap = grow_pow2(n, self.table.capacity, GROW_AT)
     table = HashTable.create(cap, key_dtypes)
     state = agg_ops.create_state(cap, self.calls, self._dtypes)
+    minput = mi_ops.create_minput(cap, self.minput_k, self.calls, self._dtypes)
     if n:
         lanes = tuple(
             jnp.asarray(np.asarray(key_cols[f"k{i}"], dtype=d))
@@ -576,6 +733,13 @@ def _agg_restore_state(self, table_id, key_cols, value_cols) -> None:
             for name, a in state.emitted_isnull.items()
         }
         emitted_valid = put(state.emitted_valid, value_cols["ev"])
+        minput = {
+            name: (
+                put(v, value_cols[f"miv_{name}"].astype(v.dtype)),
+                put(c, value_cols[f"mic_{name}"].astype(c.dtype)),
+            )
+            for name, (v, c) in minput.items()
+        }
         stored = state.stored.at[slots].set(True)
         state = AggState(
             row_count=row_count,
@@ -591,7 +755,9 @@ def _agg_restore_state(self, table_id, key_cols, value_cols) -> None:
         )
         table = set_live(table, slots, row_count[slots] > 0)
     self.table, self.state = table, state
+    self.minput = minput
     self.dropped = jnp.zeros((), jnp.bool_)
+    self.mi_bad = jnp.zeros((), jnp.bool_)
     self._insert_bound = int(n)
 
 

@@ -62,18 +62,24 @@ class AggCall:
     """One aggregate call: kind + input column -> output column.
 
     Mirrors the reference's ``AggCall`` (src/expr/core/src/aggregate/)
-    narrowed to the kernel-supported kinds.
+    narrowed to the kernel-supported kinds. ``materialized`` selects the
+    materialized-input MIN/MAX state (ops/minput.py, reference
+    minput.rs) so row-level retractions are exact; append-only plans
+    leave it False and pay no extra state.
     """
 
     kind: str
     input: Optional[str]  # None for count_star
     output: str
+    materialized: bool = False
 
     def __post_init__(self):
         if self.kind not in KINDS:
             raise ValueError(f"unsupported agg kind {self.kind!r}")
         if (self.input is None) != (self.kind == "count_star"):
             raise ValueError(f"{self.kind} input mismatch")
+        if self.materialized and self.kind not in ("min", "max"):
+            raise ValueError("materialized only applies to min/max")
 
 
 def _extreme_init(dtype, kind: str):
@@ -288,6 +294,11 @@ def apply(
             contrib = jnp.where(notnull, v.astype(acc.dtype) * w.astype(acc.dtype), 0)
             accums[c.output] = acc.at[idx].add(contrib, mode="drop")
             nonnull[c.output] = nonnull[c.output].at[idx].add(wn, mode="drop")
+        elif c.materialized:
+            # materialized-input MIN/MAX: the minput pass (ops/minput.py)
+            # owns accum + nonnull maintenance; retraction is exact, so
+            # no latch here
+            continue
         else:  # min / max — append-only
             sentinel = accum_init(c.kind, acc.dtype)
             use = active & notnull & (w > 0)
@@ -428,6 +439,8 @@ def reduce_by_key(
             )
             reduced[f"sum_{c.output}"] = segsum(contrib)
             reduced[f"nn_{c.output}"] = segsum(wn)
+        elif c.materialized:
+            continue  # minput pass maintains these (ops/minput.py)
         else:  # min / max (append-only)
             use = s_vmask & notnull & (s_sign > 0)
             if jnp.issubdtype(v.dtype, jnp.floating):
@@ -488,6 +501,8 @@ def apply_reduced(
             nonnull[c.output] = nonnull[c.output].at[idx].add(
                 jnp.where(active, reduced[f"nn_{c.output}"], 0), mode="drop"
             )
+        elif c.materialized:
+            continue  # minput pass maintains these (ops/minput.py)
         else:  # min / max
             sentinel = accum_init(c.kind, acc.dtype)
             ext = jnp.where(

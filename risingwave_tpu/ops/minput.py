@@ -1,0 +1,185 @@
+"""Materialized-input MIN/MAX — retractable extremes.
+
+Reference: src/stream/src/executor/aggregation/minput.rs — RisingWave
+keeps EVERY input value of a MIN/MAX call in a sorted per-group state
+table so a retraction of the current extreme can fall back to the next
+value. Kyry/risingwave's hash_agg calls into that MaterializedInputState
+whenever the input stream is not append-only.
+
+TPU re-design: no per-group BTree. Each materialized call owns a
+``(capacity, K)`` DISTINCT-VALUE multiset per group slot:
+
+    vals[slot, lane]   value (floats as total-order keys)
+    cnt[slot, lane]    multiplicity (0 = free lane)
+
+One chunk (or whole epoch batch) updates it in a single fused pass:
+
+1. sort rows by (slot, value) — equal (group, value) pairs cluster;
+2. segment-reduce the net weight dw per distinct pair;
+3. each surviving pair touches exactly ONE (slot, lane): its matching
+   lane (cnt>0 & vals==v) or, for new values, the j-th free lane where
+   j is the pair's rank among the group's new values this batch — so
+   every scatter index is unique and the whole update is one
+   scatter-add + one scatter-set, no loops;
+4. re-reduce each touched group's lanes (min/max over cnt>0) and write
+   the result into the ordinary accumulator lane — flush / NULL /
+   emitted-retraction machinery is unchanged.
+
+K bounds DISTINCT live values per group, not rows: exceeding it latches
+``overflow`` (the capacity-growth contract shared with HashAgg /
+join fanout). A delete of a value that was never stored latches
+``inconsistent`` (reference: update_check wrapper).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from risingwave_tpu.ops.agg import (
+    AggCall,
+    _accum_dtype,
+    _float_to_order_key,
+    accum_init,
+)
+
+
+def create_minput(
+    capacity: int, k: int, calls: Tuple[AggCall, ...], input_dtypes
+) -> Dict[str, Tuple[jnp.ndarray, jnp.ndarray]]:
+    """(vals, cnt) pair per materialized MIN/MAX call output."""
+    out = {}
+    for c in calls:
+        if not getattr(c, "materialized", False):
+            continue
+        dt = _accum_dtype(c, input_dtypes[c.input])
+        out[c.output] = (
+            jnp.zeros((capacity, k), dt),
+            jnp.zeros((capacity, k), jnp.int32),
+        )
+    return out
+
+
+def minput_apply(
+    vals: jnp.ndarray,  # (capacity, K)
+    cnt: jnp.ndarray,  # (capacity, K) int32
+    slots: jnp.ndarray,  # (n,) int32 group slot per row (-1 = skip)
+    signs: jnp.ndarray,  # (n,) int in {-1,0,+1}
+    v: jnp.ndarray,  # (n,) raw input values
+    notnull: jnp.ndarray,  # (n,) bool
+    kind: str,  # "min" | "max"
+):
+    """Fold one row batch into the multiset; returns
+    ``(vals', cnt', rep_slots, extreme, total, overflow, inconsistent)``
+    where ``rep_slots``/(n,) marks one representative row per TOUCHED
+    group carrying its new ``extreme`` (accum dtype, sentinel when the
+    group holds no values) and ``total`` live multiplicity."""
+    n = v.shape[0]
+    capacity, K = cnt.shape
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        v = _float_to_order_key(v)
+    v = v.astype(vals.dtype)
+
+    active = (slots >= 0) & (signs != 0) & notnull
+    # inactive rows sort last (slot = capacity)
+    s_key = jnp.where(active, slots, capacity).astype(jnp.int32)
+    sorted_ops = jax.lax.sort(
+        (s_key, v, signs.astype(jnp.int32), active), num_keys=2
+    )
+    sl, sv, sw, sa = sorted_ops
+
+    def lane_change(lane):
+        return jnp.concatenate([jnp.ones(1, jnp.bool_), lane[1:] != lane[:-1]])
+
+    group_b = lane_change(sl)
+    pair_b = group_b | lane_change(sv)
+    pair_id = jnp.cumsum(pair_b.astype(jnp.int32)) - 1
+    dw = jax.ops.segment_sum(
+        jnp.where(sa, sw, 0), pair_id, num_segments=n
+    )[pair_id]
+    pair_rep = pair_b & sa
+
+    # pre-state per pair: does the value already hold a lane?
+    gslot = jnp.where(sa, sl, 0)
+    row_cnt = cnt[gslot]  # (n, K)
+    row_vals = vals[gslot]
+    match = (row_cnt > 0) & (row_vals == sv[:, None])
+    exists = jnp.any(match, axis=1)
+    match_lane = jnp.argmax(match, axis=1)
+
+    # j-th NEW pair of a group claims the j-th free lane (argsort of
+    # occupied-flags ascending lists free lanes first, stable).
+    # Segment-local 0-based rank among new pairs = global cumsum minus
+    # the cumsum base at the group's first row.
+    is_new = pair_rep & ~exists & (dw > 0)
+    gid = jnp.cumsum(group_b.astype(jnp.int32)) - 1
+    c = jnp.cumsum(is_new.astype(jnp.int32))
+    base = jax.ops.segment_max(
+        jnp.where(group_b, c - is_new.astype(jnp.int32), 0),
+        gid,
+        num_segments=n,
+    )[gid]
+    new_rank = c - 1 - base
+    free_order = jnp.argsort(row_cnt > 0, axis=1, stable=True)  # (n, K)
+    j = jnp.clip(new_rank, 0, K - 1)
+    claim_lane = jnp.take_along_axis(free_order, j[:, None], axis=1)[:, 0]
+    claim_free = (
+        jnp.take_along_axis(row_cnt, claim_lane[:, None], axis=1)[:, 0] == 0
+    )
+    overflow = jnp.any(is_new & ((new_rank >= K) | ~claim_free))
+
+    lane = jnp.where(exists, match_lane, claim_lane)
+    touch = pair_rep & (dw != 0) & (exists | (is_new & claim_free))
+    # a negative dw on a value with no lane, or driving cnt below zero,
+    # is an inconsistent stream
+    old_c = jnp.take_along_axis(row_cnt, lane[:, None], axis=1)[:, 0]
+    new_c = jnp.where(exists, old_c, 0) + dw.astype(jnp.int32)
+    inconsistent = jnp.any(pair_rep & (dw < 0) & ~exists) | jnp.any(
+        touch & (new_c < 0)
+    )
+    new_c = jnp.maximum(new_c, 0)
+
+    flat = jnp.where(touch, gslot * K + lane, capacity * K)
+    cnt2 = (
+        cnt.reshape(-1)
+        .at[flat]
+        .set(new_c, mode="drop")
+        .reshape(capacity, K)
+    )
+    vals2 = (
+        vals.reshape(-1)
+        .at[flat]
+        .set(sv, mode="drop")
+        .reshape(capacity, K)
+    )
+
+    # re-reduce touched groups from the POST state
+    grp_rep = group_b & sa
+    g_cnt = cnt2[gslot]
+    g_vals = vals2[gslot]
+    sentinel = accum_init(kind, vals.dtype)
+    masked = jnp.where(g_cnt > 0, g_vals, sentinel)
+    extreme = (
+        jnp.min(masked, axis=1) if kind == "min" else jnp.max(masked, axis=1)
+    )
+    total = jnp.sum(g_cnt, axis=1).astype(jnp.int64)
+    rep_slots = jnp.where(grp_rep, sl, -1)
+    return vals2, cnt2, rep_slots, extreme, total, overflow, inconsistent
+
+
+def minput_clear(vals, cnt, slots):
+    """Free whole groups (window expiry / delete_groups)."""
+    capacity, K = cnt.shape
+    idx = jnp.where(slots >= 0, slots, capacity)
+    return vals, cnt.at[idx].set(0, mode="drop")
+
+
+def minput_rescatter(vals, cnt, keep, new_slots, new_cap):
+    """Rehash support: move rows to their new slots (2x growth)."""
+    K = cnt.shape[1]
+    idx = jnp.where(keep, new_slots, new_cap)
+    nv = jnp.zeros((new_cap, K), vals.dtype).at[idx].set(vals, mode="drop")
+    nc = jnp.zeros((new_cap, K), cnt.dtype).at[idx].set(cnt, mode="drop")
+    return nv, nc

@@ -87,6 +87,10 @@ class ShardedHashAgg(Executor):
         self.n_shards = mesh.devices.size
         self.group_keys = tuple(group_keys)
         self.calls = tuple(calls)
+        if any(c.materialized for c in self.calls):
+            raise NotImplementedError(
+                "materialized MIN/MAX is single-chip only for now"
+            )
         self.nullable = tuple(k in set(nullable_keys) for k in self.group_keys)
         self.capacity = capacity
         self.out_cap = out_cap
