@@ -21,8 +21,10 @@ from risingwave_tpu.executors.hash_join import HashJoinExecutor
 from risingwave_tpu.executors.materialize import MaterializeExecutor
 from risingwave_tpu.executors.row_id_gen import RowIdGenExecutor
 from risingwave_tpu.executors.top_n import GroupTopNExecutor
+from risingwave_tpu.executors.watermark_filter import WatermarkFilterExecutor
 
 __all__ = [
+    "WatermarkFilterExecutor",
     "Barrier",
     "Watermark",
     "Executor",

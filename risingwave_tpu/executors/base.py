@@ -76,3 +76,9 @@ class Executor:
         watermark to a window_start watermark. None stops propagation.
         """
         return watermark, []
+
+    def emit_watermark(self):
+        """GENERATED watermark, polled by the pipeline after each
+        barrier (WatermarkFilterExecutor overrides; reference:
+        watermark_filter.rs emits into its output stream)."""
+        return None

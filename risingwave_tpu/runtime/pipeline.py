@@ -64,6 +64,13 @@ class Pipeline:
                 nxt.extend(ex.apply(c))
             nxt.extend(ex.on_barrier(b))
             pending = nxt
+        # executor-GENERATED watermarks (watermark_filter.rs) walk the
+        # rest of the chain after the barrier flushes
+        for i, ex in enumerate(self.executors):
+            wm = ex.emit_watermark()
+            if wm is not None:
+                _, outs = _walk_watermark(self.executors[i + 1 :], wm)
+                pending.extend(outs)
         return pending
 
     def watermark(self, column: str, value: int) -> List[StreamChunk]:
@@ -155,7 +162,41 @@ class TwoInputPipeline:
         for c in self._through(self.right, [], barrier=b):
             joined.extend(self.join.apply_right(c))
         joined.extend(self.join.on_barrier(b))
-        return self._through(self.tail, joined, barrier=b)
+        outs = self._through(self.tail, joined, barrier=b)
+        outs.extend(self._generated_watermarks())
+        return outs
+
+    def _generated_watermarks(self) -> List[StreamChunk]:
+        """Poll emit_watermark on every executor; a side-chain watermark
+        walks the rest of its chain, through the join's alignment, then
+        the tail (the same route a driver-injected one takes)."""
+        outs: List[StreamChunk] = []
+        aligned: Optional[Watermark] = None
+        for chain, feed in (
+            (self.left, self.join.apply_left),
+            (self.right, self.join.apply_right),
+        ):
+            for i, ex in enumerate(chain):
+                wm = ex.emit_watermark()
+                if wm is None:
+                    continue
+                wm, pending = _walk_watermark(chain[i + 1 :], wm)
+                for c in pending:
+                    outs.extend(feed(c))
+                if wm is not None:
+                    down, flushed = self.join.on_watermark(wm)
+                    outs.extend(flushed)
+                    if down is not None:
+                        aligned = down
+        outs = self._through(self.tail, outs)
+        _, tail_outs = _walk_watermark(self.tail, aligned)
+        outs.extend(tail_outs)
+        for i, ex in enumerate(self.tail):
+            wm = ex.emit_watermark()
+            if wm is not None:
+                _, touts = _walk_watermark(self.tail[i + 1 :], wm)
+                outs.extend(touts)
+        return outs
 
     def watermark(self, column: str, value: int) -> List[StreamChunk]:
         """Send a watermark down both input chains; each side's

@@ -1,0 +1,81 @@
+"""WatermarkFilterExecutor — generated watermarks + late-row filtering
+(VERDICT r2 weak #8; reference watermark_filter.rs:39): the pipeline
+cleans state without the driver ever calling pipeline.watermark()."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.executors import (
+    HashAggExecutor,
+    HopWindowExecutor,
+    MaterializeExecutor,
+    WatermarkFilterExecutor,
+)
+from risingwave_tpu.ops.agg import AggCall
+from risingwave_tpu.runtime import Pipeline
+
+CAP = 64
+
+
+def _chunk(ts_vals):
+    n = len(ts_vals)
+    return StreamChunk.from_numpy(
+        {
+            "k": np.arange(n, dtype=np.int64) % 3,
+            "date_time": np.asarray(ts_vals, np.int64),
+        },
+        CAP,
+    )
+
+
+def test_late_rows_dropped_and_watermark_advances():
+    wf = WatermarkFilterExecutor("date_time", lag_ms=1000)
+    outs = wf.apply(_chunk([5000, 6000, 7000]))
+    assert int(np.asarray(outs[0].valid).sum()) == 3
+    assert wf.emit_watermark().value == 6000  # 7000 - 1000
+
+    # rows below wm=6000 are now late and dropped
+    outs = wf.apply(_chunk([5999, 6000, 10_000]))
+    d = outs[0].to_numpy(False)
+    assert sorted(d["date_time"].tolist()) == [6000, 10_000]
+    assert wf.emit_watermark().value == 9000
+    assert wf.emit_watermark() is None  # monotonic: no re-emit
+
+
+def test_pipeline_self_cleaning_without_driver_watermarks():
+    """hop -> agg(window_key, EOWC) fed via a generating filter: closed
+    windows are finalized (state freed) with NO driver watermark call,
+    and the MV keeps their final counts."""
+    W, S = 10_000, 10_000
+    agg = HashAggExecutor(
+        group_keys=("k", "window_start"),
+        calls=(AggCall("count_star", None, "cnt"),),
+        schema_dtypes={"k": jnp.int64, "window_start": jnp.int64},
+        capacity=1 << 8,
+        out_cap=1 << 7,
+        window_key=("window_start", 0, False),  # EOWC finalize
+    )
+    mv = MaterializeExecutor(pk=("k", "window_start"), columns=("cnt",))
+    pipe = Pipeline(
+        [
+            WatermarkFilterExecutor("date_time", lag_ms=0),
+            HopWindowExecutor("date_time", W, S, out_start="window_start"),
+            agg,
+            mv,
+        ]
+    )
+    # window 0 rows, then jump 3 windows ahead: wm = 40_000 closes w0
+    pipe.push(_chunk([1000, 2000, 3000]))
+    pipe.barrier()
+    occupied_before = int(jnp.sum(agg.table.live.astype(jnp.int32)))
+    assert occupied_before == 3  # 3 keys in window 0
+
+    pipe.push(_chunk([40_000, 41_000]))
+    pipe.barrier()
+    live_after = int(jnp.sum(agg.table.live.astype(jnp.int32)))
+    # window-0 groups were finalized and freed; only window-40000 live
+    assert live_after == 2
+    snap = mv.snapshot()
+    # final counts for window 0 survive in the MV
+    assert snap[(0, 0)] == (1,) and snap[(1, 0)] == (1,) and snap[(2, 0)] == (1,)
