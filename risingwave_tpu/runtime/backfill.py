@@ -1,0 +1,57 @@
+"""Backfill — creating an MV over an existing MV.
+
+Reference: src/stream/src/executor/backfill/no_shuffle_backfill.rs:66 —
+a new downstream MV first consumes a SNAPSHOT of the upstream
+materialized state, then switches to the upstream's live change stream;
+the snapshot and the stream stitch exactly because the snapshot is
+taken at a barrier boundary.
+
+TPU re-design: fragments are host-driven and barriers are synchronous,
+so the stitch point is trivial to realize: ``snapshot_chunks`` reads
+the upstream MaterializeExecutor's committed rows between two barriers
+(no in-flight deltas exist then), emits them as INSERT chunks, and the
+runtime's fragment subscription (StreamingRuntime.register(upstream=…))
+routes every later upstream delta into the downstream pipeline — the
+"no-shuffle" upstream-to-backfill edge.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from risingwave_tpu.array.chunk import StreamChunk
+
+
+def snapshot_chunks(
+    mview, capacity: int = 1024, dictionaries=None
+) -> List[StreamChunk]:
+    """Upstream MV rows -> INSERT chunks (the backfill snapshot phase).
+
+    ``mview`` is a MaterializeExecutor; its snapshot is keyed
+    pk-tuple -> value-tuple. NULL components become null lanes.
+    """
+    snap = mview.snapshot()
+    names = list(mview.pk) + list(mview.columns)
+    dtypes = {
+        name: mview._dtypes.get(name, np.dtype(np.int64)) for name in names
+    }
+    rows = [list(k) + list(v) for k, v in snap.items()]
+    out: List[StreamChunk] = []
+    for at in range(0, len(rows), capacity):
+        part = rows[at : at + capacity]
+        cols, nulls = {}, {}
+        for j, name in enumerate(names):
+            vals = [r[j] for r in part]
+            isnull = np.array([v is None for v in vals], bool)
+            filled = np.asarray(
+                [0 if v is None else v for v in vals], dtypes[name]
+            )
+            cols[name] = filled
+            if isnull.any():
+                nulls[name] = isnull
+        out.append(
+            StreamChunk.from_numpy(cols, capacity, nulls=nulls or None)
+        )
+    return out

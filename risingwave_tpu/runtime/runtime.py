@@ -73,6 +73,7 @@ class StreamingRuntime:
         compact_at: int = 8,
     ):
         self.fragments: Dict[str, object] = {}
+        self._subs: Dict[str, List[str]] = {}  # upstream -> downstreams
         self._aux_state: List[object] = []
         self.barrier_interval_ms = barrier_interval_ms
         self.checkpoint_frequency = checkpoint_frequency
@@ -102,7 +103,25 @@ class StreamingRuntime:
         self._work_abort = threading.Event()
 
     # -- fragments -------------------------------------------------------
-    def register(self, name: str, pipeline) -> None:
+    def register(
+        self,
+        name: str,
+        pipeline,
+        upstream: Optional[str] = None,
+        backfill: bool = True,
+    ) -> None:
+        """Register a fragment. With ``upstream`` (an already-registered
+        fragment name), this is MV-on-MV: the upstream's emitted deltas
+        are routed into this pipeline after every push/barrier, and —
+        unless ``backfill=False`` (recovery re-registration: the state
+        is already checkpointed) — the upstream MV's current rows are
+        snapshot-backfilled first (no_shuffle_backfill.rs:66; see
+        runtime/backfill.py)."""
+        if upstream is not None:
+            if upstream not in self.fragments:
+                raise KeyError(f"unknown upstream fragment {upstream!r}")
+            if name in self.fragments:
+                raise ValueError(f"fragment {name!r} already registered")
         self.fragments[name] = pipeline
         if self.mgr is not None:
             for ex in pipeline.executors:
@@ -115,6 +134,44 @@ class StreamingRuntime:
                 # executors skip their own per-barrier compaction
                 if hasattr(ex, "checkpoint_enabled"):
                     ex.checkpoint_enabled = True
+        if upstream is not None:
+            self._subs.setdefault(upstream, []).append(name)
+            if backfill:
+                from risingwave_tpu.runtime.backfill import snapshot_chunks
+
+                up_mv = self._fragment_mview(upstream)
+                for chunk in snapshot_chunks(up_mv):
+                    self._route(name, pipeline.push(chunk))
+
+    def _fragment_mview(self, name: str):
+        from risingwave_tpu.executors.materialize import MaterializeExecutor
+
+        for ex in reversed(self.fragments[name].executors):
+            if isinstance(ex, MaterializeExecutor):
+                return ex
+        raise ValueError(f"fragment {name!r} has no materialize stage")
+
+    def push(self, name: str, chunk: StreamChunk, side: str = "single"):
+        """Feed one chunk into a fragment and route its emitted deltas
+        into every subscribed downstream fragment (the exchange edge an
+        MV-on-MV chain rides)."""
+        p = self.fragments[name]
+        if side == "left":
+            outs = p.push_left(chunk)
+        elif side == "right":
+            outs = p.push_right(chunk)
+        else:
+            outs = p.push(chunk)
+        self._route(name, outs)
+        return outs
+
+    def _route(self, upstream: str, chunks) -> None:
+        for sub in self._subs.get(upstream, ()):
+            p = self.fragments[sub]
+            outs = []
+            for c in chunks:
+                outs.extend(p.push(c))
+            self._route(sub, outs)
 
     def register_state(self, obj) -> None:
         """Register a non-pipeline Checkpointable (e.g. a source's
@@ -148,6 +205,9 @@ class StreamingRuntime:
             and self._barrier_seq % self.checkpoint_frequency == 0
         )
         outs = {}
+        # registration order is topological (downstreams register after
+        # their upstream), so an upstream's barrier-flush deltas reach a
+        # subscriber BEFORE the subscriber's own barrier runs
         for name, p in self.fragments.items():
             p._epoch = prev  # fragments share the runtime's clock
             # non-checkpoint barriers must NOT commit sinks (exactly-
@@ -155,6 +215,7 @@ class StreamingRuntime:
             # the runtime's epoch is passed down so held sink batches
             # key by the exact epoch _commit/_on_epoch_durable will use
             outs[name] = p.barrier(checkpoint=is_ckpt, epoch=self._epoch)
+            self._route(name, outs[name])
         if is_ckpt:
             self._commit(self._epoch)
         ms = (time.perf_counter() - t0) * 1e3

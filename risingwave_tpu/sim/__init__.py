@@ -1,0 +1,16 @@
+"""Deterministic chaos/simulation harness.
+
+Reference: src/tests/simulation/ (madsim cluster, random kill/restart
+nexmark recovery suites, src/tests/simulation/src/cluster.rs:47).
+
+TPU re-design: fragments are host-driven, so "a node crash" is
+droppable in-process: abandon every live object mid-write, keep only
+the object store's committed bytes, rebuild executors, recover. The
+``CrashingStore`` injects the crash at an exact put — including BETWEEN
+a checkpoint's SST uploads and its manifest commit, the torn-upload
+window the manifest protocol must tolerate.
+"""
+
+from risingwave_tpu.sim.chaos import ChaosRunner, CrashPoint, CrashingStore
+
+__all__ = ["ChaosRunner", "CrashPoint", "CrashingStore"]

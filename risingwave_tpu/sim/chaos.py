@@ -1,0 +1,119 @@
+"""Crash-injecting object store + kill/recover chaos runner.
+
+Reference model: the madsim simulation tier kills arbitrary nodes at
+arbitrary times and asserts the cluster converges to the same result
+as an undisturbed run (src/tests/simulation/tests/integration_tests/
+recovery/). Here the unit of failure is the process: a crash abandons
+all live state mid-operation; durability is exactly what the object
+store holds. Recovery = rebuild executors + ``CheckpointManager.
+recover`` + source offsets resume (exactly-once's two halves).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, Optional
+
+from risingwave_tpu.storage.object_store import MemObjectStore, ObjectStore
+from risingwave_tpu.storage.state_table import CheckpointManager
+
+
+class CrashPoint(BaseException):
+    """The injected process death (BaseException: nothing may catch and
+    'handle' a crash on the way out)."""
+
+
+class CrashingStore(ObjectStore):
+    """Wraps the durable store; ``arm(n)`` makes the n-th subsequent
+    write raise CrashPoint and poisons every later write — the process
+    is dead; only ``inner``'s already-committed bytes survive."""
+
+    def __init__(self, inner: ObjectStore):
+        self.inner = inner
+        self._countdown: Optional[int] = None
+        self.dead = False
+
+    def arm(self, nth_write: int) -> None:
+        self._countdown = nth_write
+
+    def _write_gate(self):
+        if self.dead:
+            raise CrashPoint("process already dead")
+        if self._countdown is not None:
+            self._countdown -= 1
+            if self._countdown <= 0:
+                self.dead = True
+                self._countdown = None
+                raise CrashPoint("injected crash at write")
+
+    def put(self, path: str, data: bytes) -> None:
+        self._write_gate()
+        self.inner.put(path, data)
+
+    def delete(self, path: str) -> None:
+        self._write_gate()
+        self.inner.delete(path)
+
+    def read(self, path: str) -> bytes:
+        return self.inner.read(path)
+
+    def exists(self, path: str) -> bool:
+        return self.inner.exists(path)
+
+    def list(self, prefix: str):
+        return self.inner.list(prefix)
+
+
+class ChaosRunner:
+    """Run a build+feed workload for ``n_epochs`` COMMITTED epochs with
+    seeded random crashes; compare against an undisturbed twin outside.
+
+    ``make()`` returns a fresh workload object exposing ``executors``
+    (incl. its source, so offsets checkpoint+restore) and is driven by
+    ``feed(obj)`` for one epoch's data+barrier (NO commit — the runner
+    owns commits so it can crash them). Epoch numbers encode the
+    committed count, so recovery knows where to resume.
+    """
+
+    def __init__(
+        self,
+        make: Callable[[], object],
+        feed: Callable[[object], None],
+        seed: int = 0,
+        crash_prob: float = 0.25,
+        disk: Optional[ObjectStore] = None,
+    ):
+        self.make = make
+        self.feed = feed
+        self.rng = random.Random(seed)
+        self.crash_prob = crash_prob
+        self.disk = disk if disk is not None else MemObjectStore()
+        self.crashes = 0
+
+    def run(self, n_epochs: int, max_attempts: int = 200) -> object:
+        obj = self.make()
+        store = CrashingStore(self.disk)
+        mgr = CheckpointManager(store)
+        mgr.recover(obj.executors)  # no-op on a fresh disk
+        done = mgr.max_committed_epoch >> 16
+        attempts = 0
+        while done < n_epochs:
+            attempts += 1
+            if attempts > max_attempts:
+                raise RuntimeError("chaos run did not converge")
+            if self.rng.random() < self.crash_prob:
+                # land the crash anywhere in the commit's write window:
+                # SST put(s) or the manifest put itself (torn upload)
+                store.arm(self.rng.randint(1, 3))
+            try:
+                self.feed(obj)
+                mgr.commit_epoch((done + 1) << 16, obj.executors)
+                done += 1
+            except CrashPoint:
+                self.crashes += 1
+                obj = self.make()
+                store = CrashingStore(self.disk)
+                mgr = CheckpointManager(store)
+                mgr.recover(obj.executors)
+                done = mgr.max_committed_epoch >> 16
+        return obj

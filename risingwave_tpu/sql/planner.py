@@ -64,17 +64,34 @@ class PlannedMV:
     pipeline: Union[Pipeline, TwoInputPipeline]
     mview: MaterializeExecutor
     inputs: Dict[str, str]  # base stream name -> "single"|"left"|"right"
+    schema: Optional[Dict[str, object]] = None  # output col -> dtype
 
 
 class Catalog:
-    """Stream catalog: name -> Schema (reference: frontend catalog)."""
+    """Stream catalog: name -> Schema (reference: frontend catalog).
+
+    Planned MVs register their output schema with ``add_mv`` so later
+    statements can ``FROM <mv_name>`` (MV-on-MV; the runtime backfills
+    the new MV from the upstream's snapshot, runtime/backfill.py)."""
 
     def __init__(self, tables: Dict[str, Schema]):
         self.tables = dict(tables)
+        self.mvs: Dict[str, "PlannedMV"] = {}
 
     def schema_dtypes(self, name: str) -> Dict[str, object]:
         sch = self.tables[name]
         return {f.name: jnp.dtype(f.dtype.device_dtype) for f in sch.fields}
+
+    def add_mv(self, planned: "PlannedMV") -> None:
+        from risingwave_tpu.types import schema_from_dtypes
+
+        if planned.schema is None:
+            raise ValueError("planned MV carries no output schema")
+        self.tables[planned.name] = schema_from_dtypes(planned.schema)
+        self.mvs[planned.name] = planned
+
+    def is_mv(self, name: str) -> bool:
+        return name in self.mvs
 
 
 class Binder:
@@ -159,6 +176,14 @@ def _is_agg(ast) -> bool:
     return isinstance(ast, P.FuncCall) and ast.name in AGG_FUNCS
 
 
+def _idents_in_select(select: P.Select):
+    """Column references in select items + GROUP BY (not WHERE)."""
+    for item in select.items:
+        yield from _idents_in(item.expr)
+    for g in select.group_by:
+        yield g
+
+
 def _idents_in(ast):
     """Yield every column reference in a scalar AST."""
     if isinstance(ast, P.Ident):
@@ -210,7 +235,9 @@ class StreamPlanner:
             table_id=f"{name}.mview",
         )
         pipeline = Pipeline(rel.chain + [mview])
-        return PlannedMV(name, pipeline, mview, {rel.source: "single"})
+        return PlannedMV(
+            name, pipeline, mview, {rel.source: "single"}, schema=rel.schema
+        )
 
     def _plan_rel(self, name: str, select: P.Select) -> BoundRel:
         """Plan one select over a single (possibly windowed) input."""
@@ -239,7 +266,13 @@ class StreamPlanner:
         elif isinstance(src, P.TableRef):
             source = src.name
             schema = dict(self.catalog.schema_dtypes(source))
-            pk = ()
+            # scanning an MV: its change stream carries retractions keyed
+            # by the MV pk — downstream state must key the same way
+            pk = (
+                tuple(self.catalog.mvs[source].mview.pk)
+                if self.catalog.is_mv(source)
+                else ()
+            )
             alias = src.alias
         else:
             raise TypeError(f"unsupported FROM {src!r}")
@@ -249,82 +282,10 @@ class StreamPlanner:
             chain.append(FilterExecutor(compile_scalar(select.where, binder)))
 
         if select.group_by:
-            keys = tuple(binder.resolve(g) for g in select.group_by)
-            aggs: List[AggCall] = []
-            out_schema: Dict[str, object] = {}
-            for i, item in enumerate(select.items):
-                ast = item.expr
-                if _is_agg(ast):
-                    out = item.alias or f"{ast.name}_{i}"
-                    if ast.args == ("*",):
-                        if ast.name != "count":
-                            raise ValueError(f"{ast.name}(*) unsupported")
-                        aggs.append(AggCall("count_star", None, out))
-                        out_schema[out] = jnp.dtype(jnp.int64)
-                    else:
-                        arg = ast.args[0]
-                        if not isinstance(arg, P.Ident):
-                            raise ValueError(
-                                "aggregate args must be bare columns "
-                                "(project first)"
-                            )
-                        incol = binder.resolve(arg)
-                        aggs.append(AggCall(AGG_FUNCS[ast.name], incol, out))
-                        out_schema[out] = schema[incol]
-                elif isinstance(ast, P.Ident):
-                    colname = binder.resolve(ast)
-                    if colname not in keys:
-                        raise ValueError(
-                            f"non-aggregate item {colname!r} not in GROUP BY"
-                        )
-                    out_schema[item.alias or colname] = schema[colname]
-                else:
-                    raise ValueError(
-                        "GROUP BY select items must be keys or aggregates"
-                    )
-            renames = {
-                binder.resolve(it.expr): it.alias
-                for it in select.items
-                if isinstance(it.expr, P.Ident) and it.alias
-            }
-            if aggs:
-                agg = HashAggExecutor(
-                    group_keys=keys,
-                    calls=tuple(aggs),
-                    schema_dtypes=schema,
-                    capacity=self.capacity,
-                    table_id=self._tid(name, "agg"),
-                )
-                chain.append(agg)
-            else:
-                chain.append(
-                    AppendOnlyDedupExecutor(
-                        keys=keys,
-                        schema_dtypes=schema,
-                        capacity=self.capacity,
-                        table_id=self._tid(name, "dedup"),
-                    )
-                )
-            if renames:
-                chain.append(
-                    ProjectExecutor(
-                        {
-                            renames.get(c, c): E.col(c)
-                            for c in (
-                                list(keys) + [a.output for a in aggs]
-                            )
-                        }
-                    )
-                )
-            pk = tuple(renames.get(k, k) for k in keys)
-            if not aggs:
-                # dedup passes the full row; schema = selected items
-                out_schema = {renames.get(k, k): schema[k] for k in keys}
-            else:
-                out_schema = {
-                    **{renames.get(k, k): schema[k] for k in keys},
-                    **out_schema,
-                }
+            chain2, out_schema, pk = self._plan_groupby(
+                name, select, binder, schema, retractable=False
+            )
+            chain.extend(chain2)
             return BoundRel(chain, out_schema, pk, source, alias)
 
         # no GROUP BY: projection (+ hidden row id when no pk exists)
@@ -357,6 +318,121 @@ class StreamPlanner:
                     out_schema2[pcol] = schema[pcol]
         chain.append(ProjectExecutor(outputs))
         return BoundRel(chain, out_schema2, pk, source, alias)
+
+    def _plan_groupby(
+        self,
+        name: str,
+        select: P.Select,
+        binder: Binder,
+        schema: Dict[str, object],
+        retractable: bool,
+        nullable_cols: frozenset = frozenset(),
+    ):
+        """GROUP BY + aggregates (or DISTINCT) over an already-planned
+        input with ``schema``. Returns (executors, out_schema, pk).
+
+        ``retractable``: the input stream can carry row-level deletes
+        (e.g. downstream of a non-append-only join); MIN/MAX calls then
+        use materialized-input state (ops/minput.py, minput.rs) instead
+        of the append-only latch. ``nullable_cols``: columns that can
+        carry SQL NULL (e.g. an outer join's padded side) — group keys
+        among them get a NULL group.
+        """
+        keys = tuple(binder.resolve(g) for g in select.group_by)
+        aggs: List[AggCall] = []
+        out_schema: Dict[str, object] = {}
+        chain: List[Executor] = []
+        for i, item in enumerate(select.items):
+            ast = item.expr
+            if _is_agg(ast):
+                out = item.alias or f"{ast.name}_{i}"
+                if ast.args == ("*",):
+                    if ast.name != "count":
+                        raise ValueError(f"{ast.name}(*) unsupported")
+                    aggs.append(AggCall("count_star", None, out))
+                    out_schema[out] = jnp.dtype(jnp.int64)
+                else:
+                    arg = ast.args[0]
+                    if not isinstance(arg, P.Ident):
+                        raise ValueError(
+                            "aggregate args must be bare columns "
+                            "(project first)"
+                        )
+                    incol = binder.resolve(arg)
+                    kind = AGG_FUNCS[ast.name]
+                    aggs.append(
+                        AggCall(
+                            kind,
+                            incol,
+                            out,
+                            materialized=retractable
+                            and kind in ("min", "max"),
+                        )
+                    )
+                    out_schema[out] = schema[incol]
+            elif isinstance(ast, P.Ident):
+                colname = binder.resolve(ast)
+                if colname not in keys:
+                    raise ValueError(
+                        f"non-aggregate item {colname!r} not in GROUP BY"
+                    )
+                out_schema[item.alias or colname] = schema[colname]
+            else:
+                raise ValueError(
+                    "GROUP BY select items must be keys or aggregates"
+                )
+        renames = {
+            binder.resolve(it.expr): it.alias
+            for it in select.items
+            if isinstance(it.expr, P.Ident) and it.alias
+        }
+        if aggs:
+            chain.append(
+                HashAggExecutor(
+                    group_keys=keys,
+                    calls=tuple(aggs),
+                    schema_dtypes=schema,
+                    capacity=self.capacity,
+                    nullable_keys=tuple(k for k in keys if k in nullable_cols),
+                    table_id=self._tid(name, "agg"),
+                    # materialized extremes hold DISTINCT values per
+                    # group; SQL plans can't bound that statically, so
+                    # size generously (the overflow latch still guards)
+                    minput_k=256,
+                )
+            )
+        elif retractable:
+            raise ValueError(
+                "DISTINCT over a retractable stream needs retractable "
+                "dedup (unsupported); add an aggregate"
+            )
+        else:
+            chain.append(
+                AppendOnlyDedupExecutor(
+                    keys=keys,
+                    schema_dtypes=schema,
+                    capacity=self.capacity,
+                    table_id=self._tid(name, "dedup"),
+                )
+            )
+        if renames:
+            chain.append(
+                ProjectExecutor(
+                    {
+                        renames.get(c, c): E.col(c)
+                        for c in (list(keys) + [a.output for a in aggs])
+                    }
+                )
+            )
+        pk = tuple(renames.get(k, k) for k in keys)
+        if not aggs:
+            out_schema = {renames.get(k, k): schema[k] for k in keys}
+        else:
+            out_schema = {
+                **{renames.get(k, k): schema[k] for k in keys},
+                **out_schema,
+            }
+        return chain, out_schema, pk
 
     # -- joins -----------------------------------------------------------
     def _plan_join(self, name: str, select: P.Select) -> PlannedMV:
@@ -402,7 +478,42 @@ class StreamPlanner:
                     )
             tail.append(FilterExecutor(compile_scalar(select.where, binder)))
         if select.group_by:
-            raise ValueError("GROUP BY over a join not supported yet")
+            # GROUP BY over the joined stream (the q7 shape;
+            # reference optimizer: StreamHashAgg over StreamHashJoin).
+            # Join output can retract (deletes / NULL-pad transitions),
+            # so MIN/MAX escalate to materialized-input state; inner
+            # joins of append-only sides retract too (a dedup upstream
+            # or U- pairs), keep it on unconditionally.
+            for ident in _idents_in_select(select):
+                n = self._join_resolve(ident, left, right)
+                if n not in visible:
+                    raise ValueError(
+                        f"column {n!r} is not emitted by a {jt} join"
+                    )
+            padded: frozenset = frozenset()
+            if jt in ("left", "full"):
+                padded |= frozenset(right.schema)
+            if jt in ("right", "full"):
+                padded |= frozenset(left.schema)
+            gchain, gout, gpk = self._plan_groupby(
+                name, select, binder, {**left.schema, **right.schema},
+                retractable=True, nullable_cols=padded,
+            )
+            tail.extend(gchain)
+            mview = MaterializeExecutor(
+                pk=gpk,
+                columns=tuple(c for c in gout if c not in gpk),
+                table_id=f"{name}.mview",
+            )
+            tail.append(mview)
+            pipeline = TwoInputPipeline(left.chain, right.chain, hj, tail)
+            return PlannedMV(
+                name,
+                pipeline,
+                mview,
+                {left.source: "left", right.source: "right"},
+                schema=gout,
+            )
         out_names = []
         for i, item in enumerate(select.items):
             if not isinstance(item.expr, P.Ident):
@@ -432,11 +543,16 @@ class StreamPlanner:
         )
         tail.append(mview)
         pipeline = TwoInputPipeline(left.chain, right.chain, hj, tail)
+        merged = {**left.schema, **right.schema}
+        out_schema = {alias or n: merged[n] for n, alias in out_names}
+        for p in pk:
+            out_schema.setdefault(rename.get(p, p), merged[p])
         return PlannedMV(
             name,
             pipeline,
             mview,
             {left.source: "left", right.source: "right"},
+            schema=out_schema,
         )
 
     def _rel_of(self, name: str, rel) -> BoundRel:

@@ -132,9 +132,42 @@ class Sst:
     values: Dict[str, np.ndarray]
     tombstone: np.ndarray
     bloom: np.ndarray
+    _index: Optional[dict] = None  # lazy hash -> row indices
 
     def may_contain(self, key_cols: Sequence[np.ndarray]) -> np.ndarray:
         return _bloom_may_contain(self.bloom, key_hashes(key_cols))
+
+    def lookup_rows(
+        self, key_cols: Sequence[np.ndarray], mask: np.ndarray
+    ) -> np.ndarray:
+        """Point lookup (sstable block-index analogue): row index per
+        query, -1 if absent. Only queries with ``mask`` are resolved.
+        The lazy hash index plays the role of the reference's block
+        index + binary search (sstable/block.rs) on columnar rows."""
+        lanes = [np.asarray(self.keys[k]) for k in self.meta.key_names]
+        if self._index is None:
+            idx: dict = {}
+            for i, h in enumerate(key_hashes(lanes)):
+                idx.setdefault(int(h), []).append(i)
+            self._index = idx
+        qh = key_hashes(key_cols)
+        out = np.full(len(mask), -1, np.int64)
+        qlanes = [np.asarray(c) for c in key_cols]
+        for i in np.flatnonzero(mask):
+            for row in self._index.get(int(qh[i]), ()):
+                if all(l[row] == q[i] for l, q in zip(lanes, qlanes)):
+                    out[i] = row
+                    break
+        return out
+
+    def prefix_mask(self, prefix_cols: Dict[str, object]) -> np.ndarray:
+        """Vectorized equality mask over a key-lane prefix (range scan
+        within the SST; prefix scans are what backfill/temporal joins
+        issue, store.rs:298)."""
+        ok = np.ones(self.meta.n_rows, bool)
+        for name, v in prefix_cols.items():
+            ok &= np.asarray(self.keys[name]) == v
+        return ok
 
 
 def read_sst(blob: bytes) -> Sst:
@@ -172,10 +205,20 @@ def merge_ssts(
     epochs = np.concatenate(
         [np.full(s.meta.n_rows, s.meta.epoch, np.int64) for s in ssts]
     )
+    return newest_wins(keys, vals, tomb, epochs, key_names)
 
-    # newest-wins per key: sort by (key, epoch) and keep each key's last
+
+def newest_wins(
+    keys: Dict[str, np.ndarray],
+    vals: Dict[str, np.ndarray],
+    tomb: np.ndarray,
+    epochs: np.ndarray,
+    key_names: Sequence[str],
+) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
+    """Resolve a multi-epoch row soup: keep each key's newest row,
+    dropping tombstoned keys (UserIterator semantics)."""
     order = np.lexsort(
-        tuple([epochs] + [_order_key(keys[k]) for k in reversed(key_names)])
+        tuple([epochs] + [_order_key(keys[k]) for k in reversed(list(key_names))])
     )
     k_sorted = {n: a[order] for n, a in keys.items()}
     is_last = np.ones(len(order), bool)

@@ -34,7 +34,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from risingwave_tpu.storage.object_store import ObjectStore
-from risingwave_tpu.storage.sstable import build_sst, merge_ssts, read_sst
+from risingwave_tpu.storage.sstable import (
+    build_sst,
+    merge_ssts,
+    newest_wins,
+    read_sst,
+)
 
 MANIFEST = "MANIFEST"
 COMPACT_AT = 8  # SSTs per table before a full-merge compaction
@@ -140,6 +145,7 @@ class CheckpointManager:
         self.compact_at = compact_at
         self._lock = threading.RLock()
         self.version = {"max_committed_epoch": 0, "tables": {}}
+        self._sst_cache: Dict[str, object] = {}  # path -> parsed Sst
         self._load()
 
     # -- version ---------------------------------------------------------
@@ -288,6 +294,7 @@ class CheckpointManager:
             self._persist_version()
         for e in entries:  # GC after the new version is durable
             self.store.delete(e["path"])
+            self._sst_cache.pop(e["path"], None)
         return True
 
     def _maybe_compact(self, epoch: int):
@@ -306,6 +313,91 @@ class CheckpointManager:
         if not ssts:
             return {}, {}
         return merge_ssts(ssts, ssts[-1].meta.key_names)
+
+    def _ssts_newest_first(self, table_id: str):
+        with self._lock:
+            entries = list(self.version["tables"].get(table_id, []))
+        out = []
+        for e in reversed(entries):
+            sst = self._sst_cache.get(e["path"])
+            if sst is None:
+                sst = self._sst_cache[e["path"]] = read_sst(
+                    self.store.read(e["path"])
+                )
+            out.append(sst)
+        return out
+
+    def get_rows(
+        self, table_id: str, key_cols: Dict[str, np.ndarray]
+    ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+        """MVCC-style point reads at the committed version
+        (StateStore::get, store.rs:218): per queried key, newest SST
+        containing it wins; tombstones resolve to absent. Blooms prune
+        whole SSTs per query batch — no full-table materialization.
+
+        Returns ``(found_mask, value_cols)``; value lanes are only
+        meaningful where ``found_mask``."""
+        ssts = self._ssts_newest_first(table_id)
+        n = len(next(iter(key_cols.values()))) if key_cols else 0
+        found = np.zeros(n, bool)
+        unresolved = np.ones(n, bool)
+        values: Dict[str, np.ndarray] = {}
+        for sst in ssts:
+            if not unresolved.any():
+                break
+            lanes = [np.asarray(key_cols[k]) for k in sst.meta.key_names]
+            cand = unresolved & sst.may_contain(lanes)
+            if not cand.any():
+                continue
+            rows = sst.lookup_rows(lanes, cand)
+            hit = cand & (rows >= 0)
+            if not hit.any():
+                continue
+            live = hit & ~sst.tombstone[np.where(hit, rows, 0)]
+            for name, col in sst.values.items():
+                if name not in values:
+                    values[name] = np.zeros(n, col.dtype)
+                values[name][live] = col[rows[live]]
+            found |= live
+            unresolved &= ~hit  # tombstone = resolved absent
+        return found, values
+
+    def scan_prefix(
+        self, table_id: str, prefix_cols: Dict[str, object]
+    ) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
+        """Prefix range scan at the committed version (StateStore::iter,
+        store.rs:298): touches only rows matching the key-lane prefix in
+        each SST, then resolves newest-wins — the read path backfill and
+        lookup joins build on."""
+        ssts = self._ssts_newest_first(table_id)
+        if not ssts:
+            return {}, {}
+        key_names = ssts[0].meta.key_names
+        value_names = ssts[0].meta.value_names
+        k_parts: Dict[str, list] = {k: [] for k in key_names}
+        v_parts: Dict[str, list] = {v: [] for v in value_names}
+        t_parts, e_parts = [], []
+        for sst in ssts:
+            m = sst.prefix_mask(prefix_cols)
+            if not m.any():
+                continue
+            for k in key_names:
+                k_parts[k].append(np.asarray(sst.keys[k])[m])
+            for v in value_names:
+                v_parts[v].append(np.asarray(sst.values[v])[m])
+            t_parts.append(sst.tombstone[m])
+            e_parts.append(np.full(int(m.sum()), sst.meta.epoch, np.int64))
+        if not t_parts:
+            return {k: np.zeros(0) for k in key_names}, {}
+        keys = {k: np.concatenate(p) for k, p in k_parts.items()}
+        vals = {v: np.concatenate(p) for v, p in v_parts.items()}
+        return newest_wins(
+            keys,
+            vals,
+            np.concatenate(t_parts),
+            np.concatenate(e_parts),
+            key_names,
+        )
 
     def recover(self, executors: Sequence[object]) -> None:
         """Rebuild every Checkpointable executor's device state from

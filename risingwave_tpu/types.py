@@ -141,3 +141,19 @@ class Schema:
             self.fields
             + tuple(Field(prefix + f.name, f.dtype) for f in other.fields)
         )
+
+
+def schema_from_dtypes(dtypes: dict) -> Schema:
+    """Device dtypes -> logical Schema (the reverse edge mapping; used
+    when registering a planned MV's output as a catalog relation for
+    MV-on-MV queries)."""
+    rev = {
+        np.dtype(np.int32): DataType.INT32,
+        np.dtype(np.int64): DataType.INT64,
+        np.dtype(np.float32): DataType.FLOAT32,
+        np.dtype(np.float64): DataType.FLOAT64,
+        np.dtype(np.bool_): DataType.BOOLEAN,
+    }
+    return Schema(
+        tuple(Field(n, rev[np.dtype(d)]) for n, d in dtypes.items())
+    )

@@ -148,7 +148,8 @@ def test_sql_q8_join_matches_pandas(catalog):
 
 
 def _q8ish_inputs():
-    gen = NexmarkGenerator(NexmarkConfig())
+    # low event rate -> event time spans several 10s tumble windows
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=400))
     all_p = {"id": [], "name": [], "date_time": []}
     all_a = {"seller": [], "date_time": []}
     feeds = []
@@ -239,6 +240,44 @@ def test_sql_left_semi_anti_join_matches_pandas(catalog):
         assert set(got) == want_keys, jt
     # anti+semi partition the left side
     assert mkey and (allp - mkey)
+
+
+def test_sql_group_by_over_left_join_matches_pandas(catalog):
+    """The q7 shape: HashAgg over a (retractable) join output —
+    previously rejected with 'GROUP BY over a join not supported'."""
+    planner = StreamPlanner(catalog, capacity=1 << 12)
+    mv = planner.plan(
+        "CREATE MATERIALIZED VIEW g AS "
+        "SELECT p.starttime, count(*) AS cnt, max(a.seller) AS mx FROM "
+        "(SELECT id, name, window_start AS starttime "
+        " FROM TUMBLE(person, date_time, INTERVAL '10' SECOND) "
+        " GROUP BY id, name, window_start) AS p "
+        "LEFT JOIN "
+        "(SELECT seller, window_start AS astarttime "
+        " FROM TUMBLE(auction, date_time, INTERVAL '10' SECOND) "
+        " GROUP BY seller, window_start) AS a "
+        "ON p.id = a.seller AND p.starttime = a.astarttime "
+        "GROUP BY p.starttime"
+    )
+    feeds, p, a = _q8ish_inputs()
+    _feed(mv, feeds)
+    m = p.merge(
+        a, left_on=["id", "starttime"], right_on=["seller", "astarttime"],
+        how="left",
+    )
+    grp = m.groupby("starttime").agg(
+        cnt=("id", "size"), mx=("seller", "max")
+    )
+    want = {
+        (int(w),): (
+            int(r.cnt),
+            None if pd.isna(r.mx) else int(r.mx),
+        )
+        for w, r in grp.iterrows()
+    }
+    got = mv.mview.snapshot()
+    assert len(want) > 2
+    assert got == want
 
 
 def test_sql_semi_join_rejects_other_side_columns(catalog):

@@ -1,0 +1,83 @@
+"""Point/range reads over the committed SST set (VERDICT r2 #7;
+StateStore::get/iter, store.rs:218,298): bloom-pruned per-key newest-
+wins resolution without full-table materialization."""
+
+import numpy as np
+
+from risingwave_tpu.storage.object_store import MemObjectStore
+from risingwave_tpu.storage.state_table import CheckpointManager, StateDelta
+
+
+def _delta(table, keys, vals, tomb, epoch=None):
+    return StateDelta(
+        table,
+        {"k0": np.asarray(keys[0], np.int64), "k1": np.asarray(keys[1], np.int64)},
+        {"v": np.asarray(vals, np.int64)},
+        np.asarray(tomb, bool),
+        ("k0", "k1"),
+    )
+
+
+def _mgr():
+    mgr = CheckpointManager(MemObjectStore(), compact_at=100)
+    e = 1 << 16
+    # epoch 1: keys (0..9, 0) -> v=k*10
+    mgr.commit_staged(
+        e,
+        [_delta("t", (np.arange(10), np.zeros(10)), np.arange(10) * 10,
+                np.zeros(10))],
+    )
+    # epoch 2: overwrite k=3 -> 999; tombstone k=5; new key (100, 7)
+    mgr.commit_staged(
+        2 * e,
+        [_delta("t", ([3, 5, 100], [0, 0, 7]), [999, 0, 777],
+                [False, True, False])],
+    )
+    return mgr
+
+
+def test_point_reads_newest_wins_and_tombstones():
+    mgr = _mgr()
+    found, vals = mgr.get_rows(
+        "t",
+        {
+            "k0": np.asarray([0, 3, 5, 100, 42], np.int64),
+            "k1": np.asarray([0, 0, 0, 7, 0], np.int64),
+        },
+    )
+    assert found.tolist() == [True, True, False, True, False]
+    assert vals["v"][[0, 1, 3]].tolist() == [0, 999, 777]
+
+
+def test_point_reads_match_full_merge():
+    mgr = _mgr()
+    keys, vals = mgr.read_table("t")  # the full-merge oracle
+    found, got = mgr.get_rows("t", keys)
+    assert found.all()
+    assert got["v"].tolist() == vals["v"].tolist()
+
+
+def test_scan_prefix():
+    mgr = _mgr()
+    keys, vals = mgr.scan_prefix("t", {"k1": 0})
+    got = dict(zip(keys["k0"].tolist(), vals["v"].tolist()))
+    # k=5 tombstoned, k=3 overwritten, (100,7) excluded by prefix
+    want = {k: k * 10 for k in range(10) if k != 5}
+    want[3] = 999
+    assert got == want
+
+    keys, vals = mgr.scan_prefix("t", {"k1": 7})
+    assert keys["k0"].tolist() == [100] and vals["v"].tolist() == [777]
+
+
+def test_reads_survive_compaction():
+    mgr = _mgr()
+    assert mgr.compact_at == 100
+    mgr.compact_at = 2
+    assert mgr.compact_once("t", 3 << 16)
+    found, vals = mgr.get_rows(
+        "t", {"k0": np.asarray([3, 5], np.int64),
+              "k1": np.asarray([0, 0], np.int64)}
+    )
+    assert found.tolist() == [True, False]
+    assert vals["v"][0] == 999
