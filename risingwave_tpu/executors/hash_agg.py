@@ -696,66 +696,97 @@ def _agg_checkpoint_delta(self) -> List[StateDelta]:
     ]
 
 
+def build_restored_agg(
+    cap: int,
+    calls,
+    dtypes,
+    key_dtypes,
+    key_cols,
+    value_cols,
+    minput_k: int = 32,
+    sel: Optional[np.ndarray] = None,
+):
+    """Rebuild (table, state, minput) at capacity ``cap`` from recovered
+    rows (optionally the ``sel`` subset — the sharded restore partitions
+    rows by vnode and rebuilds each shard with this same core)."""
+    if not key_cols:
+        idx = np.zeros(0, np.int64)
+    elif sel is None:
+        idx = np.arange(len(next(iter(key_cols.values()))))
+    else:
+        idx = np.asarray(sel)
+    n = len(idx)
+    table = HashTable.create(cap, key_dtypes)
+    state = agg_ops.create_state(cap, calls, dtypes)
+    minput = mi_ops.create_minput(cap, minput_k, calls, dtypes)
+    if not n:
+        return table, state, minput
+    lanes = tuple(
+        jnp.asarray(np.asarray(key_cols[f"k{i}"], dtype=d)[idx])
+        for i, d in enumerate(key_dtypes)
+    )
+    valid = jnp.ones(n, jnp.bool_)
+    table, slots, _, _ = lookup_or_insert(table, lanes, valid)
+
+    def put(dst, src):
+        return dst.at[slots].set(jnp.asarray(np.asarray(src)[idx]))
+
+    row_count = put(state.row_count, value_cols["row_count"])
+    accums = {
+        name: put(a, np.asarray(value_cols[f"acc_{name}"]).astype(a.dtype))
+        for name, a in state.accums.items()
+    }
+    emitted = {
+        name: put(a, np.asarray(value_cols[f"em_{name}"]).astype(a.dtype))
+        for name, a in state.emitted.items()
+    }
+    nonnull = {
+        name: put(a, value_cols[f"nn_{name}"])
+        for name, a in state.nonnull.items()
+    }
+    e_isnull = {
+        name: put(a, value_cols[f"ei_{name}"])
+        for name, a in state.emitted_isnull.items()
+    }
+    emitted_valid = put(state.emitted_valid, value_cols["ev"])
+    minput = {
+        name: (
+            put(v, np.asarray(value_cols[f"miv_{name}"]).astype(v.dtype)),
+            put(c, np.asarray(value_cols[f"mic_{name}"]).astype(c.dtype)),
+        )
+        for name, (v, c) in minput.items()
+    }
+    stored = state.stored.at[slots].set(True)
+    state = AggState(
+        row_count=row_count,
+        accums=accums,
+        nonnull=nonnull,
+        emitted=emitted,
+        emitted_isnull=e_isnull,
+        emitted_valid=emitted_valid,
+        dirty=jnp.zeros(cap, jnp.bool_),
+        minmax_retracted=jnp.zeros((), jnp.bool_),
+        sdirty=jnp.zeros(cap, jnp.bool_),
+        stored=stored,
+    )
+    table = set_live(table, slots, row_count[slots] > 0)
+    return table, state, minput
+
+
 def _agg_restore_state(self, table_id, key_cols, value_cols) -> None:
     """Rebuild device table + state from recovered rows."""
     n = len(next(iter(key_cols.values()))) if key_cols else 0
     key_dtypes = tuple(k.dtype for k in self.table.keys)
     cap = grow_pow2(n, self.table.capacity, GROW_AT)
-    table = HashTable.create(cap, key_dtypes)
-    state = agg_ops.create_state(cap, self.calls, self._dtypes)
-    minput = mi_ops.create_minput(cap, self.minput_k, self.calls, self._dtypes)
-    if n:
-        lanes = tuple(
-            jnp.asarray(np.asarray(key_cols[f"k{i}"], dtype=d))
-            for i, d in enumerate(key_dtypes)
-        )
-        valid = jnp.ones(n, jnp.bool_)
-        table, slots, _, _ = lookup_or_insert(table, lanes, valid)
-
-        def put(dst, src):
-            return dst.at[slots].set(jnp.asarray(src))
-
-        row_count = put(state.row_count, value_cols["row_count"])
-        accums = {
-            name: put(a, value_cols[f"acc_{name}"].astype(a.dtype))
-            for name, a in state.accums.items()
-        }
-        emitted = {
-            name: put(a, value_cols[f"em_{name}"].astype(a.dtype))
-            for name, a in state.emitted.items()
-        }
-        nonnull = {
-            name: put(a, value_cols[f"nn_{name}"])
-            for name, a in state.nonnull.items()
-        }
-        e_isnull = {
-            name: put(a, value_cols[f"ei_{name}"])
-            for name, a in state.emitted_isnull.items()
-        }
-        emitted_valid = put(state.emitted_valid, value_cols["ev"])
-        minput = {
-            name: (
-                put(v, value_cols[f"miv_{name}"].astype(v.dtype)),
-                put(c, value_cols[f"mic_{name}"].astype(c.dtype)),
-            )
-            for name, (v, c) in minput.items()
-        }
-        stored = state.stored.at[slots].set(True)
-        state = AggState(
-            row_count=row_count,
-            accums=accums,
-            nonnull=nonnull,
-            emitted=emitted,
-            emitted_isnull=e_isnull,
-            emitted_valid=emitted_valid,
-            dirty=jnp.zeros(cap, jnp.bool_),
-            minmax_retracted=jnp.zeros((), jnp.bool_),
-            sdirty=jnp.zeros(cap, jnp.bool_),
-            stored=stored,
-        )
-        table = set_live(table, slots, row_count[slots] > 0)
-    self.table, self.state = table, state
-    self.minput = minput
+    self.table, self.state, self.minput = build_restored_agg(
+        cap,
+        self.calls,
+        self._dtypes,
+        key_dtypes,
+        key_cols,
+        value_cols,
+        self.minput_k,
+    )
     self.dropped = jnp.zeros((), jnp.bool_)
     self.mi_bad = jnp.zeros((), jnp.bool_)
     self._insert_bound = int(n)

@@ -40,7 +40,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Barrier, Executor
-from risingwave_tpu.executors.hash_agg import _build_key_lanes
+from risingwave_tpu.executors.hash_agg import (
+    _build_key_lanes,
+    _mark_checkpointed,
+    _rehash,
+    build_restored_agg,
+)
 from risingwave_tpu.ops import agg as agg_ops
 from risingwave_tpu.ops.agg import AggCall, AggState
 from risingwave_tpu.ops.hash_table import HashTable, lookup_or_insert, set_live
@@ -49,6 +54,14 @@ from risingwave_tpu.parallel.exchange import (
     exchange_chunk,
     pack_buckets as _pack_buckets,
 )
+from risingwave_tpu.storage.state_table import (
+    Checkpointable,
+    StateDelta,
+    pull_rows,
+    stage_marks,
+)
+
+GROW_AT = 0.5
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "shard") -> Mesh:
@@ -58,7 +71,7 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "shard") -> Mesh:
     return Mesh(np.asarray(devs), (axis,))
 
 
-class ShardedHashAgg(Executor):
+class ShardedHashAgg(Executor, Checkpointable):
     """Mesh-parallel HashAgg with on-device hash exchange.
 
     The executor owns stacked (n_shards, capacity) state sharded over
@@ -66,8 +79,12 @@ class ShardedHashAgg(Executor):
     chunks (each shard's source slice — e.g. one Nexmark split per
     shard); flush returns host-side StreamChunks.
 
-    Capacity is per-shard. Resize is not yet wired for the sharded
-    path (the single-chip executor grows; here size generously).
+    Capacity is per-shard and GROWS 2x when the per-shard insert bound
+    trips 50% load (per-shard rehash under one shard_map program).
+    Checkpoints stage ONE table of all shards' changed rows (keys are
+    globally unique — each lives on exactly one shard); restore
+    re-partitions rows by vnode, so recovery works across DIFFERENT
+    mesh sizes (vnode.rs:34 remap semantics).
     """
 
     def __init__(
@@ -81,7 +98,9 @@ class ShardedHashAgg(Executor):
         bucket_cap: Optional[int] = None,
         chunk_cap: Optional[int] = None,
         nullable_keys: Sequence[str] = (),
+        table_id: str = "sharded_agg",
     ):
+        self.table_id = table_id
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
         self.n_shards = mesh.devices.size
@@ -112,12 +131,15 @@ class ShardedHashAgg(Executor):
             return jnp.broadcast_to(a[None], (self.n_shards,) + a.shape)
 
         shard0 = NamedSharding(mesh, P(self.axis))
+        self._shard0 = shard0
+        self._key_dtypes = tuple(key_dtypes)
         self.table = jax.device_put(jax.tree.map(stack, table1), shard0)
         self.state = jax.device_put(jax.tree.map(stack, state1), shard0)
         self.dropped = jax.device_put(
             jnp.zeros(self.n_shards, jnp.bool_), shard0
         )
         self._step = None  # built lazily (needs bucket_cap from chunk)
+        self._insert_bound = 0  # per-shard upper bound of claimed slots
 
     # -- the sharded step -------------------------------------------------
     def _build_step(self, chunk_cap: int):
@@ -185,12 +207,65 @@ class ShardedHashAgg(Executor):
                     f"group key {k!r} carries a null lane but was not "
                     "declared in nullable_keys"
                 )
+        chunk_cap = chunk.valid.shape[-1]
         if self._step is None:
-            self._step = self._build_step(chunk.valid.shape[-1])
+            self._step = self._build_step(chunk_cap)
+        # worst case a shard receives every row of the exchange
+        bucket_cap = self.bucket_cap or max(64, (2 * chunk_cap) // self.n_shards)
+        self._maybe_grow(self.n_shards * bucket_cap)
+        self._insert_bound += self.n_shards * bucket_cap
         self.table, self.state, self.dropped = self._step(
             self.table, self.state, self.dropped, chunk
         )
         return []
+
+    def _maybe_grow(self, incoming: int) -> None:
+        """Per-shard 2x rehash when the insert bound trips GROW_AT load
+        (the single-chip growth contract, applied per shard under one
+        shard_map program)."""
+        cap = self.capacity
+        if self._insert_bound + incoming <= cap * GROW_AT:
+            return
+        claimed = int(jnp.max(jnp.sum(
+            (self.table.fp1 != jnp.uint32(0)).astype(jnp.int32), axis=1
+        )))
+        keep = (
+            self.table.live
+            | self.state.emitted_valid
+            | self.state.dirty
+            | self.state.sdirty
+        ) & (self.table.fp1 != jnp.uint32(0))
+        survivors = int(jnp.max(jnp.sum(keep.astype(jnp.int32), axis=1)))
+        from risingwave_tpu.ops.hash_table import plan_rehash
+
+        new_cap = plan_rehash(cap, incoming, claimed, survivors, GROW_AT)
+        if new_cap is not None:
+            calls = self.calls
+            spec = P(self.axis)
+
+            def local(table, state):
+                table = jax.tree.map(lambda a: a[0], table)
+                state = jax.tree.map(lambda a: a[0], state)
+                t2, s2, _ = _rehash(table, state, {}, calls, new_cap)
+                ex = lambda t: jax.tree.map(lambda a: a[None], t)
+                return ex(t2), ex(s2)
+
+            grow = jax.jit(
+                jax.shard_map(
+                    local,
+                    mesh=self.mesh,
+                    in_specs=(spec, spec),
+                    out_specs=(spec, spec),
+                    check_vma=False,
+                ),
+                donate_argnums=(0, 1),
+            )
+            self.table, self.state = grow(self.table, self.state)
+            self.capacity = new_cap
+            claimed = int(jnp.max(jnp.sum(
+                (self.table.fp1 != jnp.uint32(0)).astype(jnp.int32), axis=1
+            )))
+        self._insert_bound = claimed
 
     # -- barrier flush ----------------------------------------------------
     def _build_flush(self):
@@ -258,6 +333,89 @@ class ShardedHashAgg(Executor):
             nulls={k: jnp.asarray(v) for k, v in nulls.items()},
             ops=jnp.asarray(flat(delta["ops"])),
         )
+
+
+def _sharded_agg_checkpoint_delta(self) -> List[StateDelta]:
+    """Stage ALL shards' changed rows as ONE table (keys are globally
+    unique across shards); same lane naming as the single-chip agg so
+    either executor can restore the other's checkpoint."""
+    shape = (self.n_shards, self.capacity)
+    sdirty = np.asarray(self.state.sdirty).reshape(-1)
+    if not sdirty.any():
+        return []
+    alive = (
+        np.asarray(self.table.live)
+        | np.asarray(self.state.emitted_valid)
+        | np.asarray(self.state.dirty)
+    ).reshape(-1)
+    upsert, tomb, sel = stage_marks(
+        sdirty, alive, np.asarray(self.state.stored).reshape(-1)
+    )
+    flat = lambda a: a.reshape((-1,) + a.shape[2:])
+    lanes = {f"k{i}": flat(lane) for i, lane in enumerate(self.table.keys)}
+    key_names = tuple(lanes)
+    lanes["row_count"] = flat(self.state.row_count)
+    for n, a in self.state.accums.items():
+        lanes[f"acc_{n}"] = flat(a)
+        lanes[f"em_{n}"] = flat(self.state.emitted[n])
+    for n, a in self.state.nonnull.items():
+        lanes[f"nn_{n}"] = flat(a)
+        lanes[f"ei_{n}"] = flat(self.state.emitted_isnull[n])
+    lanes["ev"] = flat(self.state.emitted_valid)
+    pulled = pull_rows(lanes, sel)
+    keys = {k: pulled[k] for k in key_names}
+    vals = {k: v for k, v in pulled.items() if k not in key_names}
+    self.state = _mark_checkpointed(
+        self.state,
+        jnp.asarray(upsert.reshape(shape)),
+        jnp.asarray(tomb.reshape(shape)),
+    )
+    return [StateDelta(self.table_id, keys, vals, tomb[sel], key_names)]
+
+
+def _sharded_agg_restore_state(self, table_id, key_cols, value_cols) -> None:
+    """Re-partition recovered rows by vnode and rebuild every shard —
+    works across mesh sizes (a key's shard is vnode % n_shards, so a
+    different mesh just remaps vnodes; vnode.rs:34)."""
+    n = len(next(iter(key_cols.values()))) if key_cols else 0
+    if n:
+        lanes = tuple(
+            jnp.asarray(np.asarray(key_cols[f"k{i}"], dtype=d))
+            for i, d in enumerate(self._key_dtypes)
+        )
+        dest = np.asarray(_dest_shard(lanes, self.n_shards))
+    cap = self.capacity
+    while n and max(
+        np.bincount(dest, minlength=self.n_shards).max(), 1
+    ) > cap * GROW_AT:
+        cap *= 2
+    tables, states = [], []
+    for k in range(self.n_shards):
+        sel = np.flatnonzero(dest == k) if n else np.zeros(0, np.int64)
+        t, s, _ = build_restored_agg(
+            cap, self.calls, self._dtypes, self._key_dtypes,
+            key_cols, value_cols, sel=sel,
+        )
+        tables.append(t)
+        states.append(s)
+    stack = lambda *xs: jnp.stack(xs)
+    self.table = jax.device_put(
+        jax.tree.map(stack, *tables), self._shard0
+    )
+    self.state = jax.device_put(
+        jax.tree.map(stack, *states), self._shard0
+    )
+    self.capacity = cap
+    self.dropped = jax.device_put(
+        jnp.zeros(self.n_shards, jnp.bool_), self._shard0
+    )
+    self._insert_bound = int(
+        np.bincount(dest, minlength=self.n_shards).max()
+    ) if n else 0
+
+
+ShardedHashAgg.checkpoint_delta = _sharded_agg_checkpoint_delta
+ShardedHashAgg.restore_state = _sharded_agg_restore_state
 
 
 def stack_chunks(chunks: Sequence[StreamChunk]) -> StreamChunk:

@@ -189,3 +189,110 @@ def test_sharded_agg_nullable_group_key(mesh):
     want = replay_nullkey(single.on_barrier(None))
     assert None in want  # the NULL group exists and is separate
     assert got == want
+
+
+def test_sharded_agg_checkpoint_restore_across_mesh_sizes(mesh):
+    """Kill-recover the sharded agg, restoring onto a DIFFERENT mesh
+    size (vnode remap; VERDICT r2 #6) — continued output matches an
+    unkilled single-chip twin."""
+    from risingwave_tpu.storage.object_store import MemObjectStore
+    from risingwave_tpu.storage.state_table import CheckpointManager
+
+    calls = (AggCall("count_star", None, "cnt"), AggCall("sum", "price", "total"))
+    dtypes = {"auction": jnp.int64, "price": jnp.int64}
+
+    def mk_sharded(m, n):
+        return ShardedHashAgg(
+            m, ("auction",), calls, dtypes,
+            capacity=1 << 10, out_cap=1 << 9, table_id="sagg",
+        )
+
+    store = MemObjectStore()
+    mgr = CheckpointManager(store)
+    sharded = mk_sharded(mesh, N_SHARDS)
+    single = HashAggExecutor(
+        ("auction",), calls, dtypes, capacity=1 << 12, out_cap=1 << 11
+    )
+
+    dicts = NexmarkGenerator.make_dictionaries()
+
+    def gens(n):
+        return [
+            NexmarkGenerator(
+                NexmarkConfig(), split_index=i, split_num=n, dictionaries=dicts
+            )
+            for i in range(n)
+        ]
+
+    g8 = gens(N_SHARDS)
+    snap_sharded, snap_single = {}, {}
+    for epoch in range(2):
+        per_shard = []
+        for g in g8:
+            bid = g.next_chunks(400, 512)["bid"].select(["auction", "price"])
+            per_shard.append(bid)
+            single.apply(bid)
+        sharded.apply(stack_chunks(per_shard))
+        for out in sharded.on_barrier(None):
+            snap_sharded = _mv_replay(snap_sharded, out)
+        for out in single.on_barrier(None):
+            snap_single = _mv_replay(snap_single, out)
+        mgr.commit_epoch((epoch + 1) << 16, [sharded])
+    assert snap_sharded == snap_single
+
+    # restore onto a 4-device mesh
+    mesh4 = make_mesh(4)
+    restored = mk_sharded(mesh4, 4)
+    CheckpointManager(store).recover([restored])
+
+    # continue feeding: same global rows re-split 8 -> re-stacked as 4
+    for _ in range(2):
+        per8 = [
+            g.next_chunks(400, 512)["bid"].select(["auction", "price"])
+            for g in g8
+        ]
+        for bid in per8:
+            single.apply(bid)
+        # merge 8 splits into 4 shard inputs (2 splits each, stacked
+        # along capacity: concat the raw numpy then rebuild chunks)
+        per4 = []
+        for k in range(4):
+            a, b = per8[2 * k].to_numpy(False), per8[2 * k + 1].to_numpy(False)
+            cols = {
+                n: np.concatenate([a[n], b[n]]) for n in ("auction", "price")
+            }
+            per4.append(StreamChunk.from_numpy(cols, 1024))
+        restored.apply(stack_chunks(per4))
+        for out in restored.on_barrier(None):
+            snap_sharded = _mv_replay(snap_sharded, out)
+        for out in single.on_barrier(None):
+            snap_single = _mv_replay(snap_single, out)
+    assert snap_sharded == snap_single
+
+
+def test_sharded_agg_grows(mesh):
+    """Per-shard rehash: tiny initial capacity must grow instead of
+    latching dropped."""
+    calls = (AggCall("count_star", None, "cnt"),)
+    dtypes = {"k": jnp.int64}
+    sharded = ShardedHashAgg(
+        mesh, ("k",), calls, dtypes, capacity=64, out_cap=1 << 12,
+        bucket_cap=512,
+    )
+    single = HashAggExecutor(("k",), calls, dtypes, capacity=1 << 12, out_cap=1 << 12)
+    rng = np.random.default_rng(5)
+    snap_s, snap_1 = {}, {}
+    for _ in range(4):
+        per_shard = []
+        for i in range(N_SHARDS):
+            k = rng.integers(0, 3000, 256).astype(np.int64)
+            c = StreamChunk.from_numpy({"k": k}, 256)
+            per_shard.append(c)
+            single.apply(c)
+        sharded.apply(stack_chunks(per_shard))
+        for out in sharded.on_barrier(None):
+            snap_s = _mv_replay(snap_s, out)
+        for out in single.on_barrier(None):
+            snap_1 = _mv_replay(snap_1, out)
+    assert sharded.capacity > 64
+    assert snap_s == snap_1
