@@ -174,15 +174,32 @@ class DataChunk:
             dev_nulls[name] = jnp.asarray(pad)
         return DataChunk(out, jnp.asarray(valid), dev_nulls)
 
+    def _live_slice(self):
+        """(valid_prefix, pad): transfer the 1-byte valid lane first,
+        then move only the prefix holding live rows — emission chunks
+        compact valid rows to the front (compact_pairs / agg flush), so
+        this turns O(capacity) device->host copies into O(live). The
+        pow2 pad bounds distinct slice programs; scattered-valid chunks
+        degrade to the full copy, never worse."""
+        valid = np.asarray(self.valid)
+        nz = np.flatnonzero(valid)
+        if len(nz) == 0:
+            return valid[:0], 0
+        k = int(nz[-1]) + 1
+        pad = min(len(valid), max(2, 1 << (k - 1).bit_length()))
+        return valid[:pad], pad
+
     def to_numpy(self) -> Dict[str, np.ndarray]:
         """Compact live rows back to host (drops padding).
 
         NULL lanes come back as ``<name>__null`` bool columns.
         """
-        valid = np.asarray(self.valid)
-        out = {n: np.asarray(a)[valid] for n, a in self.columns.items()}
+        valid, pad = self._live_slice()
+        out = {
+            n: np.asarray(a[:pad])[valid] for n, a in self.columns.items()
+        }
         for n, lane in self.nulls.items():
-            out[n + "__null"] = np.asarray(lane)[valid]
+            out[n + "__null"] = np.asarray(lane[:pad])[valid]
         return out
 
 
@@ -294,7 +311,8 @@ class StreamChunk(DataChunk):
     def to_numpy(self, with_ops: bool = True) -> Dict[str, np.ndarray]:
         out = super().to_numpy()
         if with_ops:
-            out["__op__"] = np.asarray(self.ops)[np.asarray(self.valid)]
+            valid, pad = self._live_slice()
+            out["__op__"] = np.asarray(self.ops[:pad])[valid]
         return out
 
 

@@ -20,10 +20,14 @@ from risingwave_tpu.executors.dynamic_filter import DynamicMaxFilterExecutor
 from risingwave_tpu.executors.hash_join import HashJoinExecutor
 from risingwave_tpu.executors.materialize import MaterializeExecutor
 from risingwave_tpu.executors.row_id_gen import RowIdGenExecutor
+from risingwave_tpu.executors.simple_agg import SimpleAggExecutor
 from risingwave_tpu.executors.top_n import GroupTopNExecutor
+from risingwave_tpu.executors.top_n_plain import TopNExecutor
 from risingwave_tpu.executors.watermark_filter import WatermarkFilterExecutor
 
 __all__ = [
+    "SimpleAggExecutor",
+    "TopNExecutor",
     "WatermarkFilterExecutor",
     "Barrier",
     "Watermark",

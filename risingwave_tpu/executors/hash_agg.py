@@ -530,22 +530,26 @@ class HashAggExecutor(Executor, Checkpointable):
 
     # -- control ---------------------------------------------------------
     def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
-        if bool(self.dropped):
+        # ONE packed device read for all three latches (each bool() on a
+        # device scalar is a full round-trip on a tunneled TPU)
+        dropped, mret, mi_bad = np.asarray(
+            jnp.stack([self.dropped, self.state.minmax_retracted, self.mi_bad])
+        ).tolist()
+        if dropped:
             raise RuntimeError(
                 "hash table overflowed MAX_PROBE mid-epoch; grow capacity"
             )
-        if bool(self.state.minmax_retracted):
+        if mret:
             # the append-only MIN/MAX kernel cannot undo a retraction;
             # emitting would be silently wrong (agg.py latches the flag
             # for exactly this host-side rejection; the reference instead
-            # keeps sorted per-group input state, minput.rs — planned as
-            # the MaterializedInput escalation path)
+            # keeps sorted per-group input state, minput.rs)
             raise RuntimeError(
                 "row-level retraction hit an append-only MIN/MAX aggregate; "
                 "set AggCall(materialized=True) for materialized-input "
                 "extremes"
             )
-        if bool(self.mi_bad):
+        if mi_bad:
             raise RuntimeError(
                 "materialized MIN/MAX state overflowed minput_k distinct "
                 "values per group, or a value was retracted that was never "
@@ -562,8 +566,9 @@ class HashAggExecutor(Executor, Checkpointable):
                 self.out_cap,
                 self._float_extremes,
             )
-            outs.append(self._delta_to_chunk(delta))
-            if not bool(delta["overflow"]):
+            n_take, overflow = np.asarray(delta["status"]).tolist()
+            outs.append(self._delta_to_chunk(delta, n_take))
+            if not overflow:
                 break
         return outs
 
@@ -605,22 +610,33 @@ class HashAggExecutor(Executor, Checkpointable):
             i += 2 if nb else 1
         raise KeyError(name)
 
-    def _delta_to_chunk(self, delta) -> StreamChunk:
+    def _delta_to_chunk(self, delta, n_take: Optional[int] = None) -> StreamChunk:
+        if n_take is None:
+            sl = lambda a: a
+        else:
+            # every emitted row sits in the first 2*n_take slots (dirty
+            # slots compact to the front); slice before transfer so the
+            # device->host copy is O(emitted), pow2-padded to bound the
+            # number of distinct slice programs
+            pad = max(2, 1 << max(0, (2 * n_take - 1)).bit_length())
+            pad = min(pad, 2 * self.out_cap)
+            sl = lambda a: a[:pad]
         cols, nulls = {}, {}
         i = 0
         for name, nb in zip(self.group_keys, self.nullable):
-            cols[name] = delta[f"key{i}"]
+            cols[name] = sl(delta[f"key{i}"])
             i += 1
             if nb:
-                nulls[name] = delta[f"key{i}"]
+                nulls[name] = sl(delta[f"key{i}"])
                 i += 1
         for c in self.calls:
-            cols[c.output] = delta[c.output]
+            cols[c.output] = sl(delta[c.output])
             lane = delta.get(c.output + "__isnull")
             if lane is not None:
-                nulls[c.output] = lane
+                nulls[c.output] = sl(lane)
         return StreamChunk(
-            columns=cols, valid=delta["valid"], nulls=nulls, ops=delta["ops"]
+            columns=cols, valid=sl(delta["valid"]), nulls=nulls,
+            ops=sl(delta["ops"]),
         )
 
 

@@ -413,18 +413,33 @@ class HashJoinExecutor(Executor, Checkpointable):
 
     # -- control ---------------------------------------------------------
     def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
-        if bool(self._em_overflow):
+        import numpy as np
+
+        # ONE packed device read for all five latches (tunneled-TPU
+        # round-trips dominate small barriers)
+        em, lo, li, ro, ri = np.asarray(
+            jnp.stack(
+                [
+                    self._em_overflow,
+                    self.left.overflow,
+                    self.left.inconsistent,
+                    self.right.overflow,
+                    self.right.inconsistent,
+                ]
+            )
+        ).tolist()
+        if em:
             raise RuntimeError(
                 "join emission overflowed out_cap within one chunk; "
                 "raise out_cap or shrink source chunks"
             )
-        for name, side in (("left", self.left), ("right", self.right)):
-            if bool(side.overflow):
+        for name, ovf, inc in (("left", lo, li), ("right", ro, ri)):
+            if ovf:
                 raise RuntimeError(
                     f"{name} join side overflowed (bucket fanout or probe "
                     "chain); grow fanout/capacity"
                 )
-            if bool(side.inconsistent):
+            if inc:
                 raise RuntimeError(
                     f"{name} join side saw a DELETE matching no stored row "
                     "(inconsistent input stream)"

@@ -1,0 +1,267 @@
+"""Plain TopN — retractable ORDER BY ... LIMIT n maintenance.
+
+Reference: src/stream/src/executor/top_n/top_n_plain.rs:77 — keeps all
+input rows in a state table ordered by (order key, pk) and emits
+deltas so downstream always holds exactly the current top n.
+
+TPU re-design: the row store is a pk-keyed slot table (HashTable +
+one lane per column); inserts/deletes are one fused scatter step per
+chunk. The barrier ranks live rows ON DEVICE (ordered-float/int total
+order + pk tiebreak via lexsort), pulls only the top n rows, and
+diffs them against the host mirror of the previously-emitted top n —
+so per-barrier host traffic is O(n), not O(state).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.executors.base import Barrier, Executor
+from risingwave_tpu.ops.hash_table import (
+    HashTable,
+    lookup_or_insert,
+    plan_rehash,
+    set_live,
+)
+from risingwave_tpu.storage.state_table import (
+    Checkpointable,
+    StateDelta,
+    grow_pow2,
+    pull_rows,
+    stage_marks,
+)
+from risingwave_tpu.types import Op
+
+GROW_AT = 0.5
+
+
+@partial(jax.jit, static_argnames=("pk", "names"), donate_argnums=(0, 1, 2))
+def _upsert_step(table, rows, sdirty, chunk: StreamChunk, pk, names):
+    keys = tuple(chunk.col(k) for k in pk)
+    signs = chunk.effective_signs()
+    active = chunk.valid & (signs != 0)
+    table, slots, _, _ = lookup_or_insert(table, keys, active)
+    dropped = jnp.any(active & (slots < 0))
+    idx = jnp.where(active, slots, table.capacity)
+    rows = {
+        n: rows[n].at[idx].set(chunk.col(n), mode="drop") for n in names
+    }
+    table = set_live(table, jnp.where(active, slots, -1), signs > 0)
+    sdirty = sdirty.at[idx].set(True, mode="drop")
+    return table, rows, sdirty, dropped
+
+
+@partial(jax.jit, static_argnames=("n", "desc"))
+def _rank_top(table: HashTable, order_lane, n: int, desc: bool):
+    """Indices of the top-n live rows by (order, pk-lanes) total order.
+    The order lane maps to an unsigned memcomparable key (the same
+    transform the SST sort uses) so int/float/asc/desc all reduce to
+    one uint64 comparison."""
+    v = order_lane
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        from risingwave_tpu.ops.agg import _float_to_order_key
+
+        key = _float_to_order_key(v).astype(jnp.uint64)
+    elif jnp.issubdtype(v.dtype, jnp.unsignedinteger):
+        key = v.astype(jnp.uint64)
+    else:
+        key = jax.lax.bitcast_convert_type(
+            v.astype(jnp.int64), jnp.uint64
+        ) ^ (jnp.uint64(1) << jnp.uint64(63))
+    if desc:
+        key = ~key
+    # dead rows rank last; pk lanes tiebreak for determinism
+    key = jnp.where(table.live, key, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+    sort_ops = jax.lax.sort(
+        (key,) + tuple(k for k in table.keys)
+        + (jnp.arange(table.capacity, dtype=jnp.int32),),
+        num_keys=1 + len(table.keys),
+    )
+    idx = sort_ops[-1][:n]
+    alive = table.live[idx]
+    return idx, alive
+
+
+class TopNExecutor(Executor, Checkpointable):
+    """ORDER BY order_col [DESC] LIMIT n with full retraction support."""
+
+    def __init__(
+        self,
+        order_col: str,
+        limit: int,
+        pk: Sequence[str],
+        schema_dtypes: Dict[str, object],
+        desc: bool = False,
+        capacity: int = 1 << 14,
+        table_id: str = "top_n",
+    ):
+        self.order_col = order_col
+        self.limit = int(limit)
+        self.desc = desc
+        self.pk = tuple(pk)
+        self.names = tuple(sorted(schema_dtypes))
+        self._dtypes = {n: jnp.dtype(schema_dtypes[n]) for n in self.names}
+        self.table = HashTable.create(
+            capacity, tuple(self._dtypes[k] for k in self.pk)
+        )
+        self.rows = {
+            n: jnp.zeros(capacity, self._dtypes[n]) for n in self.names
+        }
+        self.sdirty = jnp.zeros(capacity, jnp.bool_)
+        self.stored = jnp.zeros(capacity, jnp.bool_)
+        self.table_id = table_id
+        self._bound = 0
+        self._dropped = jnp.zeros((), jnp.bool_)
+        self._emitted: Dict[Tuple, Tuple] = {}  # pk -> full row
+
+    def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
+        for k in self.pk + (self.order_col,):
+            if k in chunk.nulls:
+                raise ValueError(f"TopN key column {k!r} cannot be NULL")
+        self._maybe_grow(chunk.capacity)
+        self._bound += chunk.capacity
+        self.table, self.rows, self.sdirty, dropped = _upsert_step(
+            self.table, self.rows, self.sdirty, chunk, self.pk, self.names
+        )
+        self._dropped = self._dropped | dropped
+        return []
+
+    def _maybe_grow(self, incoming: int):
+        cap = self.table.capacity
+        if self._bound + incoming <= cap * GROW_AT:
+            return
+        claimed = int(self.table.occupancy())
+        survivors = int(
+            jnp.sum((self.table.live | self.sdirty).astype(jnp.int32))
+        )
+        new_cap = plan_rehash(cap, incoming, claimed, survivors, GROW_AT)
+        if new_cap is not None:
+            keep = self.table.live | self.sdirty
+            new = HashTable.create(
+                new_cap, tuple(k.dtype for k in self.table.keys)
+            )
+            new, slots, _, _ = lookup_or_insert(new, self.table.keys, keep)
+            new = set_live(new, jnp.where(keep, slots, -1), self.table.live)
+            idx = jnp.where(keep, slots, new_cap)
+
+            def move(a, init_dtype):
+                return (
+                    jnp.zeros(new_cap, init_dtype)
+                    .at[idx]
+                    .set(a, mode="drop")
+                )
+
+            self.rows = {
+                n: move(a, a.dtype) for n, a in self.rows.items()
+            }
+            self.sdirty = move(self.sdirty, jnp.bool_)
+            self.stored = move(self.stored, jnp.bool_)
+            self.table = new
+            claimed = int(self.table.occupancy())
+        self._bound = claimed
+
+    def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
+        if bool(self._dropped):
+            raise RuntimeError("TopN row store overflowed; grow capacity")
+        idx, alive = _rank_top(
+            self.table, self.rows[self.order_col], self.limit, self.desc
+        )
+        # pull exactly n rows (one packed gather)
+        lanes = {n: self.rows[n][idx] for n in self.names}
+        lanes["__alive__"] = alive
+        pulled = {k: np.asarray(v) for k, v in lanes.items()}
+        top: Dict[Tuple, Tuple] = {}
+        for i in range(self.limit):
+            if not pulled["__alive__"][i]:
+                break  # dead rows rank last: first dead = end of live
+            pkv = tuple(pulled[k][i].item() for k in self.pk)
+            top[pkv] = tuple(pulled[n][i].item() for n in self.names)
+        outs = []
+        dels = [v for k, v in self._emitted.items() if top.get(k) != v]
+        ins = [v for k, v in top.items() if self._emitted.get(k) != v]
+        for vals, op in ((dels, Op.DELETE), (ins, Op.INSERT)):
+            if not vals:
+                continue
+            cols = {
+                n: np.asarray([r[j] for r in vals], self._dtypes[n])
+                for j, n in enumerate(self.names)
+            }
+            outs.append(
+                StreamChunk.from_numpy(
+                    cols,
+                    max(2, len(vals)),
+                    ops=np.full(len(vals), int(op), np.int32),
+                )
+            )
+        self._emitted = top
+        return outs
+
+    # -- checkpoint -------------------------------------------------------
+    def checkpoint_delta(self) -> List[StateDelta]:
+        sdirty = np.asarray(self.sdirty)
+        if not sdirty.any():
+            return []
+        upsert, tomb, sel = stage_marks(
+            sdirty, np.asarray(self.table.live), np.asarray(self.stored)
+        )
+        lanes = {f"k{i}": lane for i, lane in enumerate(self.table.keys)}
+        key_names = tuple(lanes)
+        for n in self.names:
+            lanes[f"r_{n}"] = self.rows[n]
+        pulled = pull_rows(lanes, sel)
+        keys = {k: pulled[k] for k in key_names}
+        vals = {k: v for k, v in pulled.items() if k not in key_names}
+        self.stored = (self.stored | jnp.asarray(upsert)) & ~jnp.asarray(tomb)
+        self.sdirty = jnp.zeros_like(self.sdirty)
+        return [StateDelta(self.table_id, keys, vals, tomb[sel], key_names)]
+
+    def restore_state(self, table_id, key_cols, value_cols) -> None:
+        n = len(next(iter(key_cols.values()))) if key_cols else 0
+        cap = grow_pow2(n, self.table.capacity, GROW_AT)
+        key_dtypes = tuple(k.dtype for k in self.table.keys)
+        table = HashTable.create(cap, key_dtypes)
+        rows = {nm: jnp.zeros(cap, self._dtypes[nm]) for nm in self.names}
+        self.sdirty = jnp.zeros(cap, jnp.bool_)
+        self.stored = jnp.zeros(cap, jnp.bool_)
+        if n:
+            lanes = tuple(
+                jnp.asarray(np.asarray(key_cols[f"k{i}"], dtype=d))
+                for i, d in enumerate(key_dtypes)
+            )
+            table, slots, _, _ = lookup_or_insert(
+                table, lanes, jnp.ones(n, jnp.bool_)
+            )
+            table = set_live(table, slots, True)
+            rows = {
+                nm: a.at[slots].set(
+                    jnp.asarray(
+                        np.asarray(value_cols[f"r_{nm}"]).astype(a.dtype)
+                    )
+                )
+                for nm, a in rows.items()
+            }
+            self.stored = self.stored.at[slots].set(True)
+        self.table = table
+        self.rows = rows
+        self._bound = int(n)
+        self._dropped = jnp.zeros((), jnp.bool_)
+        # downstream MV was restored consistently; recompute its view
+        idx, alive = _rank_top(
+            table, rows[self.order_col], self.limit, self.desc
+        )
+        pulled = {nm: np.asarray(rows[nm][idx]) for nm in self.names}
+        al = np.asarray(alive)
+        self._emitted = {}
+        for i in range(self.limit):
+            if not al[i]:
+                break
+            pkv = tuple(pulled[k][i].item() for k in self.pk)
+            self._emitted[pkv] = tuple(
+                pulled[nm][i].item() for nm in self.names
+            )

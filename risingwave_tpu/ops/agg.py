@@ -648,6 +648,12 @@ def flush(
         "ops": interleave(minus_op, plus_op),
         "valid": interleave(minus_valid, plus_valid),
         "overflow": overflow,
+        # [n dirty slots taken, overflow] — ONE host read serves both
+        # the emit-size slice and the continue-flush check (each device
+        # read is a full round-trip on a tunneled TPU)
+        "status": jnp.stack(
+            [jnp.sum(take.astype(jnp.int32)), overflow.astype(jnp.int32)]
+        ),
     }
     for i, lane in enumerate(table_keys):
         kv = lane[slot_ids]

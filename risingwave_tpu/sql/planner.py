@@ -286,7 +286,42 @@ class StreamPlanner:
                 name, select, binder, schema, retractable=False
             )
             chain.extend(chain2)
-            return BoundRel(chain, out_schema, pk, source, alias)
+            return self._maybe_topn(
+                name, select, binder,
+                BoundRel(chain, out_schema, pk, source, alias),
+            )
+
+        if any(_is_agg(it.expr) for it in select.items):
+            # no GROUP BY + aggregates -> global SimpleAgg (one row)
+            from risingwave_tpu.executors.simple_agg import SimpleAggExecutor
+
+            calls: List[AggCall] = []
+            out_schema = {}
+            for i, item in enumerate(select.items):
+                ast = item.expr
+                if not _is_agg(ast):
+                    raise ValueError(
+                        "ungrouped aggregate selects must be all-aggregate"
+                    )
+                out = item.alias or f"{ast.name}_{i}"
+                if ast.args == ("*",):
+                    if ast.name != "count":
+                        raise ValueError(f"{ast.name}(*) unsupported")
+                    calls.append(AggCall("count_star", None, out))
+                    out_schema[out] = jnp.dtype(jnp.int64)
+                else:
+                    arg = ast.args[0]
+                    if not isinstance(arg, P.Ident):
+                        raise ValueError("aggregate args must be bare columns")
+                    incol = binder.resolve(arg)
+                    calls.append(AggCall(AGG_FUNCS[ast.name], incol, out))
+                    out_schema[out] = schema[incol]
+            chain.append(
+                SimpleAggExecutor(
+                    tuple(calls), schema, table_id=self._tid(name, "sagg")
+                )
+            )
+            return BoundRel(chain, out_schema, (), source, alias)
 
         # no GROUP BY: projection (+ hidden row id when no pk exists)
         outputs: Dict[str, E.Expr] = {}
@@ -317,7 +352,41 @@ class StreamPlanner:
                     outputs[pcol] = E.col(pcol)
                     out_schema2[pcol] = schema[pcol]
         chain.append(ProjectExecutor(outputs))
-        return BoundRel(chain, out_schema2, pk, source, alias)
+        return self._maybe_topn(
+            name, select, binder,
+            BoundRel(chain, out_schema2, pk, source, alias),
+        )
+
+    def _maybe_topn(
+        self, name: str, select: P.Select, binder: Binder, rel: BoundRel
+    ) -> BoundRel:
+        """ORDER BY <col> [DESC] LIMIT n -> retractable TopN maintenance
+        (top_n_plain.rs:77). ORDER BY without LIMIT is a no-op for an MV
+        (unordered relation), matching the reference planner."""
+        if select.limit is None:
+            return rel
+        if len(select.order_by) != 1:
+            raise ValueError(
+                "streaming LIMIT needs ORDER BY exactly one column"
+            )
+        from risingwave_tpu.executors.top_n_plain import TopNExecutor
+
+        ident, desc = select.order_by[0]
+        ocol = ident.name if ident.name in rel.schema else None
+        if ocol is None:
+            raise KeyError(f"ORDER BY column {ident.name!r} not in output")
+        rel.chain.append(
+            TopNExecutor(
+                ocol,
+                select.limit,
+                rel.pk,
+                rel.schema,
+                desc=desc,
+                capacity=self.capacity,
+                table_id=self._tid(name, "topn"),
+            )
+        )
+        return rel
 
     def _plan_groupby(
         self,
