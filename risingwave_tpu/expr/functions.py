@@ -1,0 +1,341 @@
+"""Scalar function library + registry.
+
+Reference: src/expr/impl/src/scalar/ (hundreds of #[function] kernels
+registered into a global FUNCTION_REGISTRY the binder resolves against,
+src/expr/core/src/sig/). Here each function is a pure jnp kernel over
+(values, null_lane) pairs; the registry maps (name, arity) to it and
+``Func`` nodes fuse into the same jitted expression trees as every
+other node.
+
+NULL policy mirrors the reference: strict by default (any NULL input
+-> NULL output); COALESCE/NULLIF/IS-DISTINCT handle NULLs explicitly;
+domain errors (div 0, sqrt(-x), log(0)) go NULL in non-strict stream
+eval (src/expr/core/src/expr/non_strict.rs).
+
+Temporal kernels treat TIMESTAMP as int64 ms since the Unix epoch and
+use the classic civil-from-days integer algorithm, so EXTRACT /
+DATE_TRUNC run vectorized on device — no host datetime objects.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.array.chunk import DataChunk
+from risingwave_tpu.expr.expr import EvalResult, Expr, _null_or
+
+# name -> (min_arity, max_arity, impl(values...) -> (value, extra_null))
+_REGISTRY: Dict[str, Tuple[int, int, Callable]] = {}
+
+
+def register(name, min_arity, max_arity=None):
+    def deco(fn):
+        _REGISTRY[name] = (min_arity, max_arity or min_arity, fn)
+        return fn
+
+    return deco
+
+
+def lookup(name: str) -> Optional[Tuple[int, int, Callable]]:
+    return _REGISTRY.get(name)
+
+
+def registry_names():
+    return sorted(_REGISTRY)
+
+
+# -- numeric --------------------------------------------------------------
+@register("abs", 1)
+def _abs(v):
+    return jnp.abs(v), None
+
+
+@register("sign", 1)
+def _sign(v):
+    return jnp.sign(v), None
+
+
+@register("ceil", 1)
+def _ceil(v):
+    return jnp.ceil(v) if jnp.issubdtype(v.dtype, jnp.floating) else v, None
+
+
+@register("floor", 1)
+def _floor(v):
+    return jnp.floor(v) if jnp.issubdtype(v.dtype, jnp.floating) else v, None
+
+
+@register("round", 1, 2)
+def _round(v, digits=None):
+    if not jnp.issubdtype(v.dtype, jnp.floating):
+        return v, None
+    if digits is None:
+        return jnp.round(v), None
+    scale = 10.0 ** digits
+    return jnp.round(v * scale) / scale, None
+
+
+@register("mod", 2)
+def _mod(a, b):
+    bad = b == 0
+    safe = jnp.where(bad, jnp.ones((), b.dtype), b)
+    return jnp.remainder(a, safe), bad
+
+
+@register("pow", 2)
+@register("power", 2)
+def _pow(a, b):
+    return jnp.power(a.astype(jnp.float64), b.astype(jnp.float64)), None
+
+
+@register("sqrt", 1)
+def _sqrt(v):
+    f = v.astype(jnp.float64)
+    bad = f < 0
+    return jnp.sqrt(jnp.where(bad, 0.0, f)), bad
+
+
+@register("exp", 1)
+def _exp(v):
+    return jnp.exp(v.astype(jnp.float64)), None
+
+
+@register("ln", 1)
+def _ln(v):
+    f = v.astype(jnp.float64)
+    bad = f <= 0
+    return jnp.log(jnp.where(bad, 1.0, f)), bad
+
+
+@register("log10", 1)
+def _log10(v):
+    f = v.astype(jnp.float64)
+    bad = f <= 0
+    return jnp.log10(jnp.where(bad, 1.0, f)), bad
+
+
+@register("greatest", 2, 8)
+def _greatest(*vs):
+    out = vs[0]
+    for v in vs[1:]:
+        out = jnp.maximum(out, v)
+    return out, None
+
+
+@register("least", 2, 8)
+def _least(*vs):
+    out = vs[0]
+    for v in vs[1:]:
+        out = jnp.minimum(out, v)
+    return out, None
+
+
+# -- temporal (int64 ms since epoch) ---------------------------------------
+_MS_DAY = 86_400_000
+_MS_HOUR = 3_600_000
+_MS_MIN = 60_000
+_MS_SEC = 1_000
+
+
+def _civil_from_days(days):
+    """days since 1970-01-01 -> (year, month, day); the classic integer
+    civil-calendar algorithm, fully vectorized."""
+    z = days + 719_468
+    era = jnp.floor_divide(jnp.where(z >= 0, z, z - 146_096), 146_097)
+    doe = z - era * 146_097  # [0, 146096]
+    yoe = jnp.floor_divide(
+        doe - jnp.floor_divide(doe, 1460)
+        + jnp.floor_divide(doe, 36_524)
+        - jnp.floor_divide(doe, 146_096),
+        365,
+    )
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + jnp.floor_divide(yoe, 4) - jnp.floor_divide(yoe, 100))
+    mp = jnp.floor_divide(5 * doy + 2, 153)
+    d = doy - jnp.floor_divide(153 * mp + 2, 5) + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    return jnp.where(m <= 2, y + 1, y), m, d
+
+
+def _days_from_civil(y, m, d):
+    y = jnp.where(m <= 2, y - 1, y)
+    era = jnp.floor_divide(jnp.where(y >= 0, y, y - 399), 400)
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = jnp.floor_divide(153 * mp + 2, 5) + d - 1
+    doe = yoe * 365 + jnp.floor_divide(yoe, 4) - jnp.floor_divide(yoe, 100) + doy
+    return era * 146_097 + doe - 719_468
+
+
+_EXTRACT_FIELDS = (
+    "epoch", "millisecond", "second", "minute", "hour",
+    "day", "month", "year", "dow", "doy",
+)
+
+
+def extract_field(field: str, ts: jnp.ndarray) -> jnp.ndarray:
+    ts = ts.astype(jnp.int64)
+    days = jnp.floor_divide(ts, _MS_DAY)
+    ms_of_day = ts - days * _MS_DAY
+    if field == "epoch":
+        return jnp.floor_divide(ts, _MS_SEC)
+    if field == "millisecond":
+        return jnp.remainder(ms_of_day, _MS_SEC)
+    if field == "second":
+        return jnp.remainder(jnp.floor_divide(ms_of_day, _MS_SEC), 60)
+    if field == "minute":
+        return jnp.remainder(jnp.floor_divide(ms_of_day, _MS_MIN), 60)
+    if field == "hour":
+        return jnp.floor_divide(ms_of_day, _MS_HOUR)
+    if field == "dow":  # 0 = Sunday (postgres)
+        return jnp.remainder(days + 4, 7)
+    y, m, d = _civil_from_days(days)
+    if field == "year":
+        return y
+    if field == "month":
+        return m
+    if field == "day":
+        return d
+    if field == "doy":
+        jan1 = _days_from_civil(y, jnp.ones_like(m), jnp.ones_like(d))
+        return days - jan1 + 1
+    raise ValueError(f"unknown EXTRACT field {field!r}")
+
+
+def date_trunc_field(field: str, ts: jnp.ndarray) -> jnp.ndarray:
+    ts = ts.astype(jnp.int64)
+    if field == "second":
+        return (ts // _MS_SEC) * _MS_SEC
+    if field == "minute":
+        return (ts // _MS_MIN) * _MS_MIN
+    if field == "hour":
+        return (ts // _MS_HOUR) * _MS_HOUR
+    if field == "day":
+        return (ts // _MS_DAY) * _MS_DAY
+    if field == "week":  # Monday start (postgres)
+        days = jnp.floor_divide(ts, _MS_DAY)
+        dow_mon = jnp.remainder(days + 3, 7)  # 0 = Monday
+        return (days - dow_mon) * _MS_DAY
+    days = jnp.floor_divide(ts, _MS_DAY)
+    y, m, d = _civil_from_days(days)
+    if field == "month":
+        return _days_from_civil(y, m, jnp.ones_like(d)) * _MS_DAY
+    if field == "year":
+        return (
+            _days_from_civil(y, jnp.ones_like(m), jnp.ones_like(d)) * _MS_DAY
+        )
+    raise ValueError(f"unknown date_trunc field {field!r}")
+
+
+# -- expr nodes -------------------------------------------------------------
+@dataclass(frozen=True, eq=False)
+class Func(Expr):
+    """Registry-dispatched scalar function, NULL-strict."""
+
+    name: str
+    args: Tuple[Expr, ...]
+
+    def eval(self, chunk: DataChunk) -> EvalResult:
+        entry = lookup(self.name)
+        if entry is None:
+            raise KeyError(f"unknown function {self.name!r}")
+        lo, hi, impl = entry
+        if not (lo <= len(self.args) <= hi):
+            raise TypeError(
+                f"{self.name}() takes {lo}..{hi} args, got {len(self.args)}"
+            )
+        vals, nulls = [], None
+        for a in self.args:
+            v, n = a.eval(chunk)
+            vals.append(v)
+            nulls = _null_or(nulls, n)
+        out, extra = impl(*vals)
+        return out, _null_or(nulls, extra)
+
+
+@dataclass(frozen=True, eq=False)
+class Extract(Expr):
+    field: str
+    ts: Expr
+
+    def eval(self, chunk: DataChunk) -> EvalResult:
+        v, n = self.ts.eval(chunk)
+        return extract_field(self.field, v), n
+
+
+@dataclass(frozen=True, eq=False)
+class DateTrunc(Expr):
+    field: str
+    ts: Expr
+
+    def eval(self, chunk: DataChunk) -> EvalResult:
+        v, n = self.ts.eval(chunk)
+        return date_trunc_field(self.field, v), n
+
+
+@dataclass(frozen=True, eq=False)
+class Coalesce(Expr):
+    args: Tuple[Expr, ...]
+
+    def eval(self, chunk: DataChunk) -> EvalResult:
+        val, nulls = self.args[0].eval(chunk)
+        for a in self.args[1:]:
+            if nulls is None:
+                break
+            v, n = a.eval(chunk)
+            rdtype = jnp.result_type(val, v)
+            val = jnp.where(nulls, v.astype(rdtype), val.astype(rdtype))
+            nulls = (
+                nulls & n if n is not None else jnp.zeros_like(nulls)
+            )
+        return val, nulls
+
+
+@dataclass(frozen=True, eq=False)
+class NullIf(Expr):
+    a: Expr
+    b: Expr
+
+    def eval(self, chunk: DataChunk) -> EvalResult:
+        av, an = self.a.eval(chunk)
+        bv, bn = self.b.eval(chunk)
+        eq = av == bv
+        if bn is not None:
+            eq = eq & ~bn  # NULL never equals
+        if an is not None:
+            eq = eq & ~an
+        return av, _null_or(an, eq)
+
+
+# -- dictionary-backed string functions ------------------------------------
+@dataclass(frozen=True, eq=False)
+class StringFunc(Expr):
+    """VARCHAR function over dictionary codes (array/dictionary.py):
+    the host maps the (small) dictionary once — upper/lower yield a
+    code->code table, length a code->int table — and the device applies
+    it as one gather. Amortized O(dictionary), not O(rows)."""
+
+    name: str  # upper | lower | length
+    inner: Expr
+    dictionary: object  # StringDictionary
+
+    def _table(self):
+        d = self.dictionary
+        strings = [d.decode_one(i) for i in range(len(d))]
+        if self.name == "length":
+            return jnp.asarray(
+                np.fromiter((len(s) for s in strings), np.int64,
+                            count=len(strings))
+            )
+        fn = str.upper if self.name == "upper" else str.lower
+        return jnp.asarray(d.encode([fn(s) for s in strings]))
+
+    def eval(self, chunk: DataChunk) -> EvalResult:
+        v, n = self.inner.eval(chunk)
+        table = self._table()
+        safe = jnp.clip(v, 0, table.shape[0] - 1)
+        return table[safe], n

@@ -458,6 +458,15 @@ class Parser:
         if t.kind == "ident":
             self.next()
             if self.accept("op", "("):
+                if t.value == "extract":
+                    # EXTRACT(FIELD FROM expr) — pg special form
+                    f = self.next()
+                    if f.kind not in ("ident", "kw"):
+                        raise SyntaxError("EXTRACT needs a field name")
+                    self.expect("kw", "from")
+                    inner = self.expr()
+                    self.expect("op", ")")
+                    return FuncCall("extract", (Literal(f.value), inner))
                 if self.accept("op", "*"):
                     self.expect("op", ")")
                     return FuncCall(t.value, ("*",))

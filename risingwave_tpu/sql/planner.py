@@ -157,6 +157,8 @@ def compile_scalar(ast, binder: Binder) -> E.Expr:
         )
         return E.Case(branches, default)
     if isinstance(ast, P.FuncCall):
+        from risingwave_tpu.expr import functions as F
+
         if ast.name == "between":
             e, lo, hi = (compile_scalar(a, binder) for a in ast.args)
             return E.Between(e, lo, hi)
@@ -168,6 +170,31 @@ def compile_scalar(ast, binder: Binder) -> E.Expr:
             return E.InList(e, vals)
         if ast.name in AGG_FUNCS:
             raise ValueError(f"aggregate {ast.name}() outside GROUP BY select")
+        if ast.name == "coalesce":
+            return F.Coalesce(
+                tuple(compile_scalar(a, binder) for a in ast.args)
+            )
+        if ast.name == "nullif":
+            a, b = (compile_scalar(x, binder) for x in ast.args)
+            return F.NullIf(a, b)
+        if ast.name == "extract":
+            field = ast.args[0]
+            if not isinstance(field, P.Literal):
+                raise ValueError("EXTRACT field must be a name")
+            return F.Extract(
+                str(field.value).lower(), compile_scalar(ast.args[1], binder)
+            )
+        if ast.name == "date_trunc":
+            field = ast.args[0]
+            if not isinstance(field, P.Literal):
+                raise ValueError("date_trunc field must be a string literal")
+            return F.DateTrunc(
+                str(field.value).lower(), compile_scalar(ast.args[1], binder)
+            )
+        if F.lookup(ast.name) is not None:
+            return F.Func(
+                ast.name, tuple(compile_scalar(a, binder) for a in ast.args)
+            )
         raise ValueError(f"unknown function {ast.name!r}")
     raise TypeError(f"cannot compile {ast!r}")
 
