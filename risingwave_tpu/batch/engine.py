@@ -33,16 +33,17 @@ class BatchQueryEngine:
         if not isinstance(stmt, P.Select):
             raise ValueError("batch engine runs SELECT only")
         if isinstance(stmt.from_, P.Join):
-            raise ValueError("batch joins not supported yet")
-        if not isinstance(stmt.from_, P.TableRef):
-            raise ValueError("batch FROM must be an MV name")
-        mv = self.tables[stmt.from_.name]
-        cols = mv.to_numpy()
+            cols, alias = self._join_scan(stmt.from_), None
+        elif isinstance(stmt.from_, P.TableRef):
+            mv = self.tables[stmt.from_.name]
+            cols, alias = mv.to_numpy(), stmt.from_.alias
+        else:
+            raise ValueError("batch FROM must be an MV name or join")
         n = len(next(iter(cols.values()))) if cols else 0
 
         # RowSeqScan -> chunk -> Filter via the shared expr framework
         schema = {k: v.dtype for k, v in cols.items()}
-        binder = Binder(schema, stmt.from_.alias)
+        binder = Binder(schema, alias)
         if n and stmt.where is not None:
             cap = max(1, 1 << (n - 1).bit_length())
             chunk = DataChunk.from_numpy(cols, cap)
@@ -81,6 +82,89 @@ class BatchQueryEngine:
         if stmt.limit is not None:
             out = {k: v[: stmt.limit] for k, v in out.items()}
         return out
+
+    def _join_scan(self, join: P.Join) -> Dict[str, np.ndarray]:
+        """Two-way batch join over MV scans (reference: the batch
+        HashJoinExecutor, src/batch/src/executor/join/). Column names
+        must be disjoint across sides (alias/rename upstream); outer
+        joins surface missing ints as NaN-capable float lanes."""
+        import pandas as pd
+
+        if isinstance(join.left, P.Join):
+            raise ValueError("multi-way batch joins not supported yet")
+
+        def side(rel):
+            if not isinstance(rel, P.TableRef):
+                raise ValueError("batch join sides must be MV names")
+            return rel.alias or rel.name, pd.DataFrame(
+                self.tables[rel.name].to_numpy()
+            )
+        lname, ldf = side(join.left)
+        rname, rdf = side(join.right)
+        overlap = set(ldf.columns) & set(rdf.columns)
+        if overlap:
+            raise ValueError(
+                f"join sides share column names {overlap}; alias them apart"
+            )
+
+        pairs = []
+
+        def resolve(ident: P.Ident) -> str:
+            if ident.qualifier == lname and ident.name in ldf.columns:
+                return ident.name
+            if ident.qualifier == rname and ident.name in rdf.columns:
+                return ident.name
+            if ident.qualifier is None and (
+                (ident.name in ldf.columns) != (ident.name in rdf.columns)
+            ):
+                return ident.name
+            raise KeyError(f"cannot resolve join column {ident}")
+
+        def walk(e):
+            if isinstance(e, P.BinaryOp) and e.op == "and":
+                walk(e.left)
+                walk(e.right)
+                return
+            if (
+                isinstance(e, P.BinaryOp)
+                and e.op == "="
+                and isinstance(e.left, P.Ident)
+                and isinstance(e.right, P.Ident)
+            ):
+                a, b = resolve(e.left), resolve(e.right)
+                if a in ldf.columns and b in rdf.columns:
+                    pairs.append((a, b))
+                elif b in ldf.columns and a in rdf.columns:
+                    pairs.append((b, a))
+                else:
+                    raise ValueError("join condition must cross sides")
+                return
+            raise ValueError("batch ON must be AND-ed equalities")
+
+        walk(join.on)
+        if not pairs:
+            raise ValueError("no equi-join keys found")
+        lk = [p[0] for p in pairs]
+        rk = [p[1] for p in pairs]
+        jt = join.join_type
+        if jt in ("inner", "left", "right", "full"):
+            how = {"full": "outer"}.get(jt, jt)
+            m = ldf.merge(rdf, left_on=lk, right_on=rk, how=how)
+        elif jt in ("left_semi", "left_anti"):
+            hit = ldf.merge(
+                rdf[rk].drop_duplicates(), left_on=lk, right_on=rk,
+                how="left", indicator=True,
+            )["_merge"] == "both"
+            m = ldf[hit.values] if jt == "left_semi" else ldf[~hit.values]
+        elif jt in ("right_semi", "right_anti"):
+            hit = rdf.merge(
+                ldf[lk].drop_duplicates(), left_on=rk, right_on=lk,
+                how="left", indicator=True,
+            )["_merge"] == "both"
+            m = rdf[hit.values] if jt == "right_semi" else rdf[~hit.values]
+        else:
+            raise ValueError(f"unknown join type {jt!r}")
+        return {c: m[c].to_numpy() for c in m.columns if c != "_merge"}
 
     def _eval_item(self, ast, cols, n, binder):
         if isinstance(ast, P.Ident):

@@ -19,6 +19,7 @@ from risingwave_tpu.executors.dedup import AppendOnlyDedupExecutor
 from risingwave_tpu.executors.dynamic_filter import DynamicMaxFilterExecutor
 from risingwave_tpu.executors.hash_join import HashJoinExecutor
 from risingwave_tpu.executors.materialize import MaterializeExecutor
+from risingwave_tpu.executors.generators import NowExecutor, ValuesExecutor
 from risingwave_tpu.executors.row_id_gen import RowIdGenExecutor
 from risingwave_tpu.executors.simple_agg import SimpleAggExecutor
 from risingwave_tpu.executors.top_n import GroupTopNExecutor
@@ -26,6 +27,8 @@ from risingwave_tpu.executors.top_n_plain import TopNExecutor
 from risingwave_tpu.executors.watermark_filter import WatermarkFilterExecutor
 
 __all__ = [
+    "NowExecutor",
+    "ValuesExecutor",
     "SimpleAggExecutor",
     "TopNExecutor",
     "WatermarkFilterExecutor",

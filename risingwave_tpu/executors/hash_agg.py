@@ -322,6 +322,70 @@ def _rehash(
     return new_table, new_state, new_minput
 
 
+@partial(jax.jit, static_argnames=("calls", "new_cap"))
+def _evict(
+    table: HashTable,
+    state: AggState,
+    minput,
+    calls: Tuple[AggCall, ...],
+    new_cap: int,
+):
+    """Drop fully-durable groups from HBM (the LRU-eviction analogue —
+    reference: stream executors spill via state-table LRU caches over
+    Hummock, hash_agg.rs:49). A group is evictable iff the object store
+    holds its exact state: stored & ~sdirty & ~dirty. Its key leaves
+    the table entirely; if the group is touched again, the slot
+    re-inserts fresh and the next barrier's cold-merge folds the
+    durable state back in (see _merge_cold)."""
+    hot = (
+        (table.live | state.emitted_valid | state.dirty | state.sdirty)
+        & (table.fp1 != jnp.uint32(0))
+        & ~(state.stored & ~state.sdirty & ~state.dirty)
+    )
+    n_evicted = jnp.sum(
+        ((table.live | state.emitted_valid) & ~hot).astype(jnp.int32)
+    )
+    new_table = HashTable.create(new_cap, tuple(k.dtype for k in table.keys))
+    new_table, new_slots, _, _ = lookup_or_insert(new_table, table.keys, hot)
+    idx = jnp.where(hot, new_slots, new_cap)
+
+    def rescatter(src, init):
+        dst = jnp.full(new_cap, init, src.dtype)
+        return dst.at[idx].set(src, mode="drop")
+
+    new_table = set_live(new_table, jnp.where(hot, new_slots, -1), table.live)
+    kinds = {c.output: c.kind for c in calls}
+    new_state = AggState(
+        row_count=rescatter(state.row_count, jnp.zeros((), jnp.int64)),
+        accums={
+            n: rescatter(a, agg_ops.accum_init(kinds[n], a.dtype))
+            for n, a in state.accums.items()
+        },
+        nonnull={
+            n: rescatter(a, jnp.zeros((), jnp.int64))
+            for n, a in state.nonnull.items()
+        },
+        emitted={
+            n: rescatter(a, jnp.zeros((), a.dtype))
+            for n, a in state.emitted.items()
+        },
+        emitted_isnull={
+            n: rescatter(a, jnp.zeros((), jnp.bool_))
+            for n, a in state.emitted_isnull.items()
+        },
+        emitted_valid=rescatter(state.emitted_valid, jnp.zeros((), jnp.bool_)),
+        dirty=rescatter(state.dirty, jnp.zeros((), jnp.bool_)),
+        minmax_retracted=state.minmax_retracted,
+        sdirty=rescatter(state.sdirty, jnp.zeros((), jnp.bool_)),
+        stored=rescatter(state.stored, jnp.zeros((), jnp.bool_)),
+    )
+    new_minput = {
+        name: mi_ops.minput_rescatter(v, c, hot, new_slots, new_cap)
+        for name, (v, c) in minput.items()
+    }
+    return new_table, new_state, new_minput, n_evicted
+
+
 @partial(jax.jit, static_argnames=("calls", "key_index", "emit_deletes"))
 def _expire(
     table: HashTable,
@@ -396,6 +460,9 @@ class HashAggExecutor(Executor, Checkpointable):
             capacity, minput_k, self.calls, self._dtypes
         )
         self.mi_bad = jnp.zeros((), jnp.bool_)
+        # cold tier: set by the runtime to CheckpointManager.get_rows so
+        # evicted (durable) groups fold back in on their next touch
+        self.cold_reader = None
 
     # -- data ------------------------------------------------------------
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
@@ -555,7 +622,79 @@ class HashAggExecutor(Executor, Checkpointable):
                 "values per group, or a value was retracted that was never "
                 "inserted"
             )
+        if self.cold_reader is not None:
+            self._merge_cold()
         return self._flush_all()
+
+    # -- cold tier (state >> HBM) -----------------------------------------
+    def state_nbytes(self) -> int:
+        """Device bytes held (host-side estimate; no sync)."""
+        return sum(
+            leaf.nbytes
+            for leaf in jax.tree.leaves((self.table, self.state, self.minput))
+        )
+
+    def evict_cold(self) -> int:
+        """Free every fully-durable group from HBM (LRU-spill analogue;
+        see _evict). Returns groups evicted. Requires a cold_reader so
+        evicted groups can come back."""
+        if self.cold_reader is None:
+            raise RuntimeError("evict_cold needs a cold_reader (runtime)")
+        if self.minput:
+            raise NotImplementedError(
+                "cold tiering with materialized MIN/MAX multisets is not "
+                "supported (multiset merge)"
+            )
+        # shrink to fit the surviving hot set — eviction must actually
+        # free HBM, not just slots
+        hot = (
+            (
+                self.table.live
+                | self.state.emitted_valid
+                | self.state.dirty
+                | self.state.sdirty
+            )
+            & (self.table.fp1 != jnp.uint32(0))
+            & ~(self.state.stored & ~self.state.sdirty & ~self.state.dirty)
+        )
+        n_hot = int(jnp.sum(hot.astype(jnp.int32)))
+        new_cap = grow_pow2(n_hot, 1 << 10, GROW_AT)
+        self.table, self.state, self.minput, n = _evict(
+            self.table, self.state, self.minput, self.calls, new_cap
+        )
+        n = int(n)
+        self._insert_bound = int(self.table.occupancy())
+        return n
+
+    def _merge_cold(self) -> int:
+        """Fold durable state into groups (re)created since the last
+        checkpoint: candidates are sdirty & ~stored; a cold-store hit
+        means the key was evicted earlier and its persisted accumulators
+        must combine with what accrued since (merge-on-return; the
+        reference reloads through its state-table cache instead)."""
+        cand = np.asarray(self.state.sdirty & ~self.state.stored)
+        sel = np.flatnonzero(cand)
+        if not len(sel):
+            return 0
+        lanes = {f"k{i}": lane for i, lane in enumerate(self.table.keys)}
+        keys = pull_rows(lanes, sel)
+        found, vals = self.cold_reader(keys)
+        if not found.any():
+            return 0
+        hit = sel[found]
+        cold = {k: v[found] for k, v in vals.items()}
+        self.state = _cold_merge(
+            self.state, jnp.asarray(hit.astype(np.int32)),
+            {k: jnp.asarray(v) for k, v in cold.items()},
+            self.calls,
+        )
+        # liveness may have flipped (e.g. deletes landed on a fresh slot
+        # before the merge restored the cold row_count)
+        slots = jnp.asarray(hit.astype(np.int32))
+        self.table = set_live(
+            self.table, slots, self.state.row_count[slots] > 0
+        )
+        return int(found.sum())
 
     def _flush_all(self) -> List[StreamChunk]:
         outs = []
@@ -638,6 +777,50 @@ class HashAggExecutor(Executor, Checkpointable):
             columns=cols, valid=sl(delta["valid"]), nulls=nulls,
             ops=sl(delta["ops"]),
         )
+
+
+@partial(jax.jit, static_argnames=("calls",), donate_argnums=(0,))
+def _cold_merge(state: AggState, slots, cold, calls):
+    """Combine persisted group state into freshly-recreated slots.
+    Additive kinds add; extremes min/max in the raw (order-key) lane
+    domain; emitted snapshots REPLACE (the fresh slot never emitted)."""
+    idx = slots
+    row_count = state.row_count.at[idx].add(cold["row_count"])
+    accums = dict(state.accums)
+    nonnull = dict(state.nonnull)
+    for c in calls:
+        acc = accums[c.output]
+        cv = cold[f"acc_{c.output}"].astype(acc.dtype)
+        if c.kind in ("count_star", "count", "sum"):
+            accums[c.output] = acc.at[idx].add(cv)
+        elif c.kind == "min":
+            accums[c.output] = acc.at[idx].min(cv)
+        else:
+            accums[c.output] = acc.at[idx].max(cv)
+        if c.output in nonnull:
+            nonnull[c.output] = nonnull[c.output].at[idx].add(
+                cold[f"nn_{c.output}"]
+            )
+    emitted = {
+        n: a.at[idx].set(cold[f"em_{n}"].astype(a.dtype))
+        for n, a in state.emitted.items()
+    }
+    emitted_isnull = {
+        n: a.at[idx].set(cold[f"ei_{n}"])
+        for n, a in state.emitted_isnull.items()
+    }
+    return AggState(
+        row_count=row_count,
+        accums=accums,
+        nonnull=nonnull,
+        emitted=emitted,
+        emitted_isnull=emitted_isnull,
+        emitted_valid=state.emitted_valid.at[idx].set(cold["ev"]),
+        dirty=state.dirty.at[idx].set(True),
+        minmax_retracted=state.minmax_retracted,
+        sdirty=state.sdirty.at[idx].set(True),
+        stored=state.stored.at[idx].set(True),
+    )
 
 
 # -- checkpoint/restore (StateTable integration) -------------------------

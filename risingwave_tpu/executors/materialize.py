@@ -29,6 +29,9 @@ from risingwave_tpu.types import Op
 def _last_per_key(keys: np.ndarray) -> np.ndarray:
     """Indices of the LAST occurrence of each distinct key row (stable
     sort on key columns, keep run ends)."""
+    if keys.shape[1] == 0:
+        # pk = (): a single-row table; the last op wins outright
+        return np.asarray([len(keys) - 1]) if len(keys) else np.zeros(0, np.int64)
     order = np.lexsort(
         tuple(keys[:, j] for j in reversed(range(keys.shape[1])))
     )

@@ -1,0 +1,226 @@
+"""OverWindow — window functions over partitions (append-only).
+
+Reference: src/stream/src/executor/over_window/general.rs:49 — per
+partition, per order-key window functions; the general executor
+retracts and re-emits affected frames on any change. This executor is
+the APPEND-ONLY + arrival-ordered specialization (RW's planner also
+specializes this case): each row gets its window value at arrival and
+is never revisited — exactly right for ROW_NUMBER / running COUNT /
+running SUM over monotonically arriving streams.
+
+TPU re-design: partition state is a hash table + per-slot running
+accumulators. One fused step per chunk: lookup partitions, sort rows
+by (slot, arrival) to rank intra-chunk duplicates, gather partition
+bases, segment-prefix-scan the chunk's own contribution, scatter the
+updated accumulators back — no per-row host work.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.executors.base import Barrier, Executor
+from risingwave_tpu.ops.hash_table import (
+    HashTable,
+    lookup_or_insert,
+    plan_rehash,
+    set_live,
+)
+
+GROW_AT = 0.5
+
+KINDS = ("row_number", "count", "sum")
+
+
+@dataclass(frozen=True)
+class WindowCall:
+    kind: str
+    input: Optional[str]  # None for row_number / count(*)
+    output: str
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unsupported window kind {self.kind!r}")
+        if (self.input is None) != (self.kind in ("row_number", "count")):
+            raise ValueError(f"{self.kind} input mismatch")
+
+
+@partial(jax.jit, static_argnames=("calls", "part_keys"), donate_argnums=(0, 1))
+def _over_step(
+    table: HashTable,
+    accums: Dict[str, jnp.ndarray],
+    chunk: StreamChunk,
+    calls: Tuple[WindowCall, ...],
+    part_keys: Tuple[str, ...],
+):
+    n = chunk.capacity
+    keys = tuple(chunk.col(k) for k in part_keys)
+    signs = chunk.effective_signs()
+    active = chunk.valid & (signs > 0)
+    saw_delete = jnp.any(chunk.valid & (signs < 0))
+    table, slots, _, _ = lookup_or_insert(table, keys, active)
+    dropped = jnp.any(active & (slots < 0))
+    table = set_live(table, jnp.where(active, slots, -1), True)
+
+    # rank rows of one partition within the chunk (arrival order)
+    skey = jnp.where(active, slots, table.capacity).astype(jnp.int32)
+    pos = jnp.arange(n, dtype=jnp.int32)
+    val_lanes = {
+        c.input: chunk.col(c.input).astype(jnp.int64)
+        for c in calls
+        if c.input is not None
+    }
+    null_lanes = {
+        c.input: chunk.nulls[c.input]
+        for c in calls
+        if c.input is not None and c.input in chunk.nulls
+    }
+    names = tuple(sorted(val_lanes))
+    nnames = tuple(sorted(null_lanes))
+    sorted_ops = jax.lax.sort(
+        (skey, pos)
+        + tuple(val_lanes[m] for m in names)
+        + tuple(null_lanes[m] for m in nnames),
+        num_keys=2,
+    )
+    s_slot, s_pos = sorted_ops[0], sorted_ops[1]
+    s_vals = {m: sorted_ops[2 + i] for i, m in enumerate(names)}
+    s_nulls = {
+        m: sorted_ops[2 + len(names) + i] for i, m in enumerate(nnames)
+    }
+    boundary = jnp.concatenate(
+        [jnp.ones(1, jnp.bool_), s_slot[1:] != s_slot[:-1]]
+    )
+    gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    arange = jnp.arange(n, dtype=jnp.int64)
+    seg_start = jax.ops.segment_max(
+        jnp.where(boundary, arange, 0), gid, num_segments=n
+    )[gid]
+    rank = arange - seg_start  # 0-based within (partition, chunk)
+    s_active = s_slot < table.capacity
+    gslot = jnp.where(s_active, s_slot, 0)
+
+    out_sorted: Dict[str, jnp.ndarray] = {}
+    new_accums = dict(accums)
+    for c in calls:
+        acc = new_accums[c.output]
+        base = acc[gslot]
+        if c.kind == "row_number":
+            o = base + rank + 1
+            contrib = jnp.where(s_active, jnp.int64(1), jnp.int64(0))
+        elif c.kind == "count":
+            o = base + rank + 1
+            contrib = jnp.where(s_active, jnp.int64(1), jnp.int64(0))
+        else:  # running sum (NULL inputs contribute 0, SQL skips them)
+            v = s_vals[c.input]
+            nn = ~s_nulls.get(c.input, jnp.zeros(n, jnp.bool_))
+            v = jnp.where(s_active & nn, v, 0)
+            # inclusive prefix within the segment (sentinel, not 0: the
+            # boundary's exclusive prefix may be negative)
+            csum = jnp.cumsum(v)
+            seg_base = jax.ops.segment_max(
+                jnp.where(boundary, csum - v, jnp.iinfo(jnp.int64).min),
+                gid,
+                num_segments=n,
+            )[gid]
+            o = base + (csum - seg_base)
+            contrib = v
+        out_sorted[c.output] = o
+        # per-partition totals land on the segment's last row
+        totals = jax.ops.segment_sum(contrib, gid, num_segments=n)[gid]
+        is_last = jnp.concatenate(
+            [s_slot[1:] != s_slot[:-1], jnp.ones(1, jnp.bool_)]
+        )
+        upd = jnp.where(s_active & is_last, gslot, table.capacity)
+        new_accums[c.output] = acc.at[upd].add(totals, mode="drop")
+
+    # unsort back to arrival positions
+    cols = dict(chunk.columns)
+    for name, o in out_sorted.items():
+        buf = jnp.zeros(n, jnp.int64)
+        cols[name] = buf.at[s_pos].set(o)
+    out = StreamChunk(
+        columns=cols, valid=chunk.valid & active, nulls=dict(chunk.nulls),
+        ops=chunk.ops,
+    )
+    return table, new_accums, out, saw_delete, dropped
+
+
+class OverWindowExecutor(Executor):
+    """Append-only window functions: ROW_NUMBER / running COUNT / SUM
+    per partition in arrival order."""
+
+    def __init__(
+        self,
+        partition_by: Sequence[str],
+        calls: Sequence[WindowCall],
+        schema_dtypes: Dict[str, object],
+        capacity: int = 1 << 14,
+    ):
+        self.part_keys = tuple(partition_by)
+        self.calls = tuple(calls)
+        self.table = HashTable.create(
+            capacity,
+            tuple(jnp.dtype(schema_dtypes[k]) for k in self.part_keys),
+        )
+        self.accums = {
+            c.output: jnp.zeros(capacity, jnp.int64) for c in self.calls
+        }
+        self._bound = 0
+        self._saw_delete = jnp.zeros((), jnp.bool_)
+        self._dropped = jnp.zeros((), jnp.bool_)
+
+    def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
+        self._maybe_grow(chunk.capacity)
+        self._bound += chunk.capacity
+        self.table, self.accums, out, sd, dr = _over_step(
+            self.table, self.accums, chunk, self.calls, self.part_keys
+        )
+        self._saw_delete = self._saw_delete | sd
+        self._dropped = self._dropped | dr
+        return [out]
+
+    def _maybe_grow(self, incoming: int):
+        cap = self.table.capacity
+        if self._bound + incoming <= cap * GROW_AT:
+            return
+        claimed = int(self.table.occupancy())
+        new_cap = plan_rehash(cap, incoming, claimed, claimed, GROW_AT)
+        if new_cap is not None:
+            keep = self.table.fp1 != jnp.uint32(0)
+            new = HashTable.create(
+                new_cap, tuple(k.dtype for k in self.table.keys)
+            )
+            new, slots, _, _ = lookup_or_insert(new, self.table.keys, keep)
+            new = set_live(new, jnp.where(keep, slots, -1), self.table.live)
+            idx = jnp.where(keep, slots, new_cap)
+            self.accums = {
+                name: jnp.zeros(new_cap, jnp.int64)
+                .at[idx]
+                .set(a, mode="drop")
+                for name, a in self.accums.items()
+            }
+            self.table = new
+            claimed = int(self.table.occupancy())
+        self._bound = claimed
+
+    def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
+        import numpy as np
+
+        sd, dr = np.asarray(
+            jnp.stack([self._saw_delete, self._dropped])
+        ).tolist()
+        if sd:
+            raise RuntimeError(
+                "append-only OverWindow received a DELETE (the general "
+                "retractable executor is not implemented)"
+            )
+        if dr:
+            raise RuntimeError("OverWindow partition table overflowed")
+        return []

@@ -1,0 +1,6 @@
+"""Frontend — SQL session + Postgres wire protocol surface."""
+
+from risingwave_tpu.frontend.session import SqlSession
+from risingwave_tpu.frontend.pgwire import PgServer
+
+__all__ = ["PgServer", "SqlSession"]

@@ -1,0 +1,184 @@
+"""pgwire — the Postgres wire protocol (v3) server.
+
+Reference: src/utils/pgwire/src/pg_server.rs:250 (+ pg_protocol.rs
+message codec): startup handshake, cleartext-free auth OK, the simple
+query cycle Q -> RowDescription/DataRow*/CommandComplete ->
+ReadyForQuery, ErrorResponse on failure, SSLRequest politely refused.
+Enough protocol for psql / psycopg simple queries to work against the
+SqlSession.
+
+This is a host control-plane surface — no device work happens here, so
+a plain threaded TCP server (one thread per connection, like the
+reference's per-session task) is the right shape.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import Optional
+
+import numpy as np
+
+from risingwave_tpu.frontend.session import SqlSession
+
+_SSL_REQUEST = 80877103
+_CANCEL_REQUEST = 80877102
+
+# type OIDs (pg catalog)
+_OID_BOOL, _OID_INT8, _OID_FLOAT8, _OID_TEXT = 16, 20, 701, 25
+
+
+def _oid_of(dtype: np.dtype) -> int:
+    if dtype == np.bool_:
+        return _OID_BOOL
+    if np.issubdtype(dtype, np.integer):
+        return _OID_INT8
+    if np.issubdtype(dtype, np.floating):
+        return _OID_FLOAT8
+    return _OID_TEXT
+
+
+def _msg(tag: bytes, payload: bytes = b"") -> bytes:
+    return tag + struct.pack("!I", len(payload) + 4) + payload
+
+
+class _Conn(socketserver.BaseRequestHandler):
+    def _recv_exact(self, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            got = self.request.recv(n - len(buf))
+            if not got:
+                return None
+            buf += got
+        return buf
+
+    def _startup(self) -> bool:
+        while True:
+            head = self._recv_exact(8)
+            if head is None:
+                return False
+            length, code = struct.unpack("!II", head)
+            body = self._recv_exact(length - 8)
+            if body is None:
+                return False
+            if code == _SSL_REQUEST:
+                self.request.sendall(b"N")  # no TLS; client retries plain
+                continue
+            if code == _CANCEL_REQUEST:
+                return False
+            # normal StartupMessage (protocol 3.0) — params ignored
+            return True
+
+    def handle(self):
+        if not self._startup():
+            return
+        out = self.request.sendall
+        out(_msg(b"R", struct.pack("!I", 0)))  # AuthenticationOk
+        for k, v in (
+            ("server_version", "13.0 (risingwave-tpu)"),
+            ("client_encoding", "UTF8"),
+        ):
+            out(_msg(b"S", k.encode() + b"\0" + v.encode() + b"\0"))
+        out(_msg(b"K", struct.pack("!II", 0, 0)))  # BackendKeyData
+        out(_msg(b"Z", b"I"))
+
+        session: SqlSession = self.server.session  # type: ignore[attr-defined]
+        while True:
+            head = self._recv_exact(5)
+            if head is None:
+                return
+            tag, length = head[:1], struct.unpack("!I", head[1:])[0]
+            body = self._recv_exact(length - 4)
+            if body is None:
+                return
+            if tag == b"X":  # Terminate
+                return
+            if tag != b"Q":  # only the simple query protocol
+                out(
+                    _err(f"unsupported message {tag!r}")
+                    + _msg(b"Z", b"I")
+                )
+                continue
+            sql = body.rstrip(b"\0").decode()
+            try:
+                with self.server.lock:  # type: ignore[attr-defined]
+                    cols, tag_str = session.execute(sql)
+                if cols:
+                    names = list(cols)
+                    fields = b""
+                    for name in names:
+                        fields += (
+                            name.encode() + b"\0"
+                            + struct.pack(
+                                "!IhIhih",
+                                0, 0, _oid_of(cols[name].dtype), -1, -1, 0,
+                            )
+                        )
+                    out(
+                        _msg(
+                            b"T",
+                            struct.pack("!h", len(names)) + fields,
+                        )
+                    )
+                    n = len(cols[names[0]])
+                    for i in range(n):
+                        row = b""
+                        for name in names:
+                            v = cols[name][i]
+                            if v is None or (
+                                isinstance(v, float) and np.isnan(v)
+                            ):
+                                row += struct.pack("!i", -1)
+                            else:
+                                s = str(
+                                    v.item() if hasattr(v, "item") else v
+                                ).encode()
+                                row += struct.pack("!i", len(s)) + s
+                        out(
+                            _msg(
+                                b"D",
+                                struct.pack("!h", len(names)) + row,
+                            )
+                        )
+                out(_msg(b"C", tag_str.encode() + b"\0"))
+            except Exception as e:  # noqa: BLE001 — surface as pg error
+                out(_err(str(e)))
+            out(_msg(b"Z", b"I"))
+
+
+def _err(message: str) -> bytes:
+    payload = (
+        b"SERROR\0"
+        + b"CXX000\0"
+        + b"M" + message.encode() + b"\0"
+        + b"\0"
+    )
+    return _msg(b"E", payload)
+
+
+class PgServer:
+    """Serve a SqlSession over pgwire on 127.0.0.1."""
+
+    def __init__(self, session: SqlSession, port: int = 0):
+        class _Srv(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = _Srv(("127.0.0.1", port), _Conn)
+        self._srv.session = session  # type: ignore[attr-defined]
+        self._srv.lock = threading.Lock()  # type: ignore[attr-defined]
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True
+        )
+
+    def start(self) -> "PgServer":
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()

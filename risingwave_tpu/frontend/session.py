@@ -1,0 +1,66 @@
+"""SqlSession — one entry point for every statement kind.
+
+Reference: src/frontend/src/handler/mod.rs routes parsed statements to
+handlers (create_mv, dml, query); the session owns the catalog and
+talks to meta/batch/stream. Here it ties together:
+
+- CREATE MATERIALIZED VIEW -> StreamPlanner -> runtime.register
+  (with MV-on-MV backfill when the input is itself an MV) +
+  catalog/DML/batch registration;
+- INSERT INTO -> DmlManager (rows pushed into consuming fragments);
+- SELECT -> BatchQueryEngine over MV snapshots.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from risingwave_tpu.batch.engine import BatchQueryEngine
+from risingwave_tpu.runtime import DmlManager, StreamingRuntime
+from risingwave_tpu.sql import Catalog, StreamPlanner
+from risingwave_tpu.sql import parser as P
+
+
+class SqlSession:
+    def __init__(
+        self,
+        catalog: Catalog,
+        runtime: Optional[StreamingRuntime] = None,
+        capacity: int = 1 << 14,
+    ):
+        self.catalog = catalog
+        self.runtime = runtime or StreamingRuntime(store=None)
+        self.planner = StreamPlanner(catalog, capacity=capacity)
+        self.batch = BatchQueryEngine({})
+        self.dml = DmlManager(self.runtime, catalog)
+
+    def execute(self, sql: str) -> Tuple[Dict[str, np.ndarray], str]:
+        """Returns (result columns, command tag). Non-queries return an
+        empty column dict."""
+        stmt = P.parse(sql)
+        if isinstance(stmt, P.CreateMaterializedView):
+            planned = self.planner.plan(sql)
+            upstreams = [
+                s for s in planned.inputs if self.catalog.is_mv(s)
+            ]
+            self.runtime.register(
+                planned.name,
+                planned.pipeline,
+                upstream=upstreams[0] if upstreams else None,
+            )
+            self.catalog.add_mv(planned)
+            self.dml.attach(planned)
+            self.batch.register(planned.name, planned.mview)
+            return {}, "CREATE_MATERIALIZED_VIEW"
+        if isinstance(stmt, P.InsertValues):
+            n = self.dml.execute(sql)
+            # DML visibility: the reference commits DML at the next
+            # checkpoint barrier; interactive sessions read their own
+            # writes, so advance the barrier clock here
+            self.runtime.barrier()
+            return {}, f"INSERT 0 {n}"
+        out = self.batch.query(sql)
+        n = len(next(iter(out.values()))) if out else 0
+        return out, f"SELECT {n}"

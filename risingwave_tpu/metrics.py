@@ -57,11 +57,27 @@ class Histogram:
         return len(self._obs.get(_labels(labels), ()))
 
 
+class Gauge:
+    def __init__(self, registry, name: str):
+        self.name = name
+        self._values: Dict[_Labels, float] = defaultdict(float)
+        self._lock = registry._lock
+
+    def set(self, value: float, **labels: str) -> None:
+        with self._lock:
+            self._values[_labels(labels)] = value
+
+    def get(self, **labels: str) -> float:
+        return self._values.get(_labels(labels), 0.0)
+
+
 class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self.counters: Dict[str, Counter] = {}
         self.histograms: Dict[str, Histogram] = {}
+        self.gauges: Dict[str, Gauge] = {}
+        self._server = None
 
     def counter(self, name: str) -> Counter:
         if name not in self.counters:
@@ -73,6 +89,11 @@ class MetricsRegistry:
             self.histograms[name] = Histogram(self, name)
         return self.histograms[name]
 
+    def gauge(self, name: str) -> Gauge:
+        if name not in self.gauges:
+            self.gauges[name] = Gauge(self, name)
+        return self.gauges[name]
+
     def render(self) -> str:
         """Prometheus text exposition."""
         lines = []
@@ -81,14 +102,65 @@ class MetricsRegistry:
             for labels, v in sorted(c._values.items()):
                 lbl = ",".join(f'{k}="{val}"' for k, val in labels)
                 lines.append(f"{name}{{{lbl}}} {v}" if lbl else f"{name} {v}")
+        for name, g in sorted(self.gauges.items()):
+            lines.append(f"# TYPE {name} gauge")
+            for labels, v in sorted(g._values.items()):
+                lbl = ",".join(f'{k}="{val}"' for k, val in labels)
+                lines.append(f"{name}{{{lbl}}} {v}" if lbl else f"{name} {v}")
         for name, h in sorted(self.histograms.items()):
             lines.append(f"# TYPE {name} summary")
             for labels, obs in sorted(h._obs.items()):
                 lbl = ",".join(f'{k}="{val}"' for k, val in labels)
                 base = f"{name}{{{lbl}}}" if lbl else name
+                for q in (0.5, 0.9, 0.99):
+                    ql = (
+                        f'{{{lbl},quantile="{q}"}}'
+                        if lbl
+                        else f'{{quantile="{q}"}}'
+                    )
+                    lines.append(
+                        f"{name}{ql} {float(np.percentile(obs, q * 100))}"
+                    )
                 lines.append(f"{base}_count {len(obs)}")
                 lines.append(f"{base}_sum {sum(obs)}")
         return "\n".join(lines) + "\n"
+
+    def serve(self, port: int = 0) -> int:
+        """Expose ``/metrics`` over HTTP (the prometheus scrape surface
+        the reference serves from each node). Returns the bound port."""
+        import http.server
+
+        registry = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib API name)
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = registry.render().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self._server = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", port), Handler
+        )
+        t = threading.Thread(target=self._server.serve_forever, daemon=True)
+        t.start()
+        return self._server.server_address[1]
+
+    def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
 
 
 # the process-default registry (reference: GLOBAL_METRICS_REGISTRY)

@@ -1,6 +1,7 @@
 """Runtime — epoch loop, pipelines, barriers (meta-lite, single node)."""
 
 from risingwave_tpu.runtime.pipeline import Pipeline, TwoInputPipeline
+from risingwave_tpu.runtime.dml import DmlManager
 from risingwave_tpu.runtime.runtime import StreamingRuntime
 
-__all__ = ["Pipeline", "TwoInputPipeline", "StreamingRuntime"]
+__all__ = ["DmlManager", "Pipeline", "TwoInputPipeline", "StreamingRuntime"]

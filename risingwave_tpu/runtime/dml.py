@@ -1,0 +1,75 @@
+"""DML — INSERT INTO routed to the streams' consuming fragments.
+
+Reference: src/frontend/src/handler/dml.rs + src/dml/ (table source
+channel: DML rows enter the stream graph through the table's source
+executor). Here the host IS the channel: DmlManager turns an
+InsertValues statement into one StreamChunk (schema-coerced via the
+catalog) and pushes it into every fragment registered as consuming
+that stream, with downstream MV deltas routed as usual.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.sql import parser as P
+
+
+class DmlManager:
+    def __init__(self, runtime, catalog):
+        self.runtime = runtime
+        self.catalog = catalog
+        # stream name -> [(fragment, side)]
+        self._targets: Dict[str, List[Tuple[str, str]]] = {}
+
+    def attach(self, planned) -> None:
+        """Register a planned (and runtime-registered) MV's inputs as
+        DML-reachable write targets."""
+        for stream, side in planned.inputs.items():
+            if stream in self.catalog.tables and not self.catalog.is_mv(stream):
+                self._targets.setdefault(stream, []).append(
+                    (planned.name, side)
+                )
+
+    def execute(self, sql: str) -> int:
+        stmt = P.parse(sql)
+        if not isinstance(stmt, P.InsertValues):
+            raise ValueError("DmlManager executes INSERT statements only")
+        schema = self.catalog.tables[stmt.table]
+        names = list(stmt.columns or schema.names)
+        if set(names) - set(schema.names):
+            raise KeyError(
+                f"unknown columns {set(names) - set(schema.names)}"
+            )
+        n = len(stmt.rows)
+        cols: Dict[str, np.ndarray] = {}
+        nulls: Dict[str, np.ndarray] = {}
+        for j, name in enumerate(names):
+            field = schema.field(name)
+            vals = [r[j] for r in stmt.rows]
+            isnull = np.asarray([v is None for v in vals], bool)
+            dt = field.dtype.device_dtype
+            if dt.kind not in "iufb":
+                raise NotImplementedError(
+                    f"DML into {field.dtype} column {name!r} not supported"
+                )
+            filled = np.asarray(
+                [0 if v is None else v for v in vals], dt
+            )
+            cols[name] = filled
+            if isnull.any():
+                nulls[name] = isnull
+        missing = set(schema.names) - set(names)
+        if missing:
+            raise ValueError(
+                f"INSERT must supply all columns (missing {missing}); "
+                "column defaults are not implemented"
+            )
+        cap = max(2, 1 << (max(1, n) - 1).bit_length())
+        chunk = StreamChunk.from_numpy(cols, cap, nulls=nulls or None)
+        for frag, side in self._targets.get(stmt.table, ()):
+            self.runtime.push(frag, chunk, side)
+        return n

@@ -71,7 +71,13 @@ class StreamingRuntime:
         checkpoint_frequency: int = 1,
         async_checkpoint: bool = True,
         compact_at: int = 8,
+        memory_budget_bytes: Optional[int] = None,
     ):
+        # state >> HBM control (the reference's LRU memory controller,
+        # src/compute/src/memory/controller.rs role): when accounted
+        # device state exceeds the budget, fully-durable groups are
+        # evicted to the object store and fold back on next touch
+        self.memory_budget_bytes = memory_budget_bytes
         self.fragments: Dict[str, object] = {}
         self._subs: Dict[str, List[str]] = {}  # upstream -> downstreams
         self._aux_state: List[object] = []
@@ -134,14 +140,36 @@ class StreamingRuntime:
                 # executors skip their own per-barrier compaction
                 if hasattr(ex, "checkpoint_enabled"):
                     ex.checkpoint_enabled = True
+                # cold tier: evicted durable groups read back through
+                # the manager's point-read path (storage get_rows)
+                if hasattr(ex, "cold_reader") and hasattr(ex, "table_id"):
+                    ex.cold_reader = (
+                        lambda keys, _tid=ex.table_id: self.mgr.get_rows(
+                            _tid, keys
+                        )
+                    )
         if upstream is not None:
-            self._subs.setdefault(upstream, []).append(name)
-            if backfill:
-                from risingwave_tpu.runtime.backfill import snapshot_chunks
+            self.subscribe(upstream, name, backfill=backfill)
 
-                up_mv = self._fragment_mview(upstream)
-                for chunk in snapshot_chunks(up_mv):
-                    self._route(name, pipeline.push(chunk))
+    def subscribe(
+        self, upstream: str, name: str, backfill: bool = True
+    ) -> None:
+        """Add a delta edge upstream -> name. Multiple subscriptions of
+        one fragment realize UNION ALL (the reference's UnionExecutor,
+        union.rs: n inputs merged into one stream — here the host
+        routes every upstream's chunks into the same pipeline)."""
+        if upstream not in self.fragments:
+            raise KeyError(f"unknown upstream fragment {upstream!r}")
+        if name not in self.fragments:
+            raise KeyError(f"unknown fragment {name!r}")
+        self._subs.setdefault(upstream, []).append(name)
+        if backfill:
+            from risingwave_tpu.runtime.backfill import snapshot_chunks
+
+            up_mv = self._fragment_mview(upstream)
+            pipeline = self.fragments[name]
+            for chunk in snapshot_chunks(up_mv):
+                self._route(name, pipeline.push(chunk))
 
     def _fragment_mview(self, name: str):
         from risingwave_tpu.executors.materialize import MaterializeExecutor
@@ -162,6 +190,7 @@ class StreamingRuntime:
             outs = p.push_right(chunk)
         else:
             outs = p.push(chunk)
+        REGISTRY.counter("chunks_pushed_total").inc(fragment=name)
         self._route(name, outs)
         return outs
 
@@ -218,11 +247,40 @@ class StreamingRuntime:
             self._route(name, outs[name])
         if is_ckpt:
             self._commit(self._epoch)
+        if self.memory_budget_bytes is not None:
+            self._enforce_memory_budget()
         ms = (time.perf_counter() - t0) * 1e3
         self.barrier_latencies_ms.append(ms)
         REGISTRY.histogram("barrier_latency_ms").observe(ms)
         REGISTRY.counter("barriers_total").inc()
         return outs
+
+    def state_nbytes(self) -> int:
+        """Accounted device state across all fragments (host estimate)."""
+        return sum(
+            ex.state_nbytes()
+            for ex in self.executors()
+            if hasattr(ex, "state_nbytes")
+        )
+
+    def _enforce_memory_budget(self) -> None:
+        total = self.state_nbytes()
+        REGISTRY.gauge("state_bytes").set(float(total))
+        if total <= self.memory_budget_bytes:
+            return
+        # eviction frees only durable slots; an in-flight async commit
+        # has flipped stored marks for state that is not durable YET —
+        # join the lane first so evict never races durability
+        self.wait_checkpoints()
+        evicted = 0
+        for ex in self.executors():
+            fn = getattr(ex, "evict_cold", None)
+            if fn is not None and getattr(ex, "cold_reader", None) is not None:
+                if getattr(ex, "minput", None):
+                    continue  # multiset cold-merge unsupported
+                evicted += fn()
+        REGISTRY.counter("cold_evictions_total").inc(evicted)
+        REGISTRY.gauge("state_bytes").set(float(self.state_nbytes()))
 
     def tick(self) -> bool:
         """Barrier iff ``barrier_interval_ms`` elapsed since the last
@@ -248,6 +306,8 @@ class StreamingRuntime:
         # commit (CheckpointManager.stage / commit_staged)
         t_staged = time.perf_counter()
         staged = self.mgr.stage(self.executors())
+        REGISTRY.counter("checkpoints_total").inc()
+        REGISTRY.gauge("checkpoint_staged_tables").set(len(staged))
         if not self.async_checkpoint:
             self.mgr.commit_staged(epoch, staged)
             self.checkpoint_sync_ms.append(

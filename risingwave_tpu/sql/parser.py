@@ -105,7 +105,17 @@ class CreateMaterializedView:
     select: Select
 
 
-Statement = Union[CreateMaterializedView, Select]
+@dataclass(frozen=True)
+class InsertValues:
+    """INSERT INTO t [(cols)] VALUES (...), (...) — the DML surface
+    (reference: src/frontend/src/handler/dml.rs -> dml executor)."""
+
+    table: str
+    rows: Tuple[Tuple[object, ...], ...]
+    columns: Optional[Tuple[str, ...]] = None
+
+
+Statement = Union[CreateMaterializedView, Select, InsertValues]
 
 # -------------------------------------------------------------- lexer --
 
@@ -125,6 +135,7 @@ _KEYWORDS = {
     "interval", "second", "seconds", "millisecond", "milliseconds",
     "minute", "minutes", "case", "when", "then", "else", "end", "null", "order", "limit", "asc", "desc",
     "true", "false", "is", "between", "in", "distinct",
+    "insert", "into", "values",
 }
 
 # Contextual words (NOT reserved — usable as identifiers; recognized by
@@ -202,9 +213,54 @@ class Parser:
             sel = self.select()
             self.expect("eof")
             return CreateMaterializedView(name, sel)
+        if self.accept("kw", "insert"):
+            self.expect("kw", "into")
+            table = self.expect("ident").value
+            cols = None
+            if self.accept("op", "("):
+                cols = [self.expect("ident").value]
+                while self.accept("op", ","):
+                    cols.append(self.expect("ident").value)
+                self.expect("op", ")")
+            self.expect("kw", "values")
+            rows = []
+            while True:
+                self.expect("op", "(")
+                row = [self._literal_value()]
+                while self.accept("op", ","):
+                    row.append(self._literal_value())
+                self.expect("op", ")")
+                rows.append(tuple(row))
+                if not self.accept("op", ","):
+                    break
+            self.expect("eof")
+            return InsertValues(
+                table, tuple(rows), tuple(cols) if cols else None
+            )
         sel = self.select()
         self.expect("eof")
         return sel
+
+    def _literal_value(self):
+        """A literal (optionally negated) inside VALUES."""
+        neg = bool(self.accept("op", "-"))
+        t = self.peek()
+        if t.kind == "num":
+            self.next()
+            v = float(t.value) if "." in t.value else int(t.value)
+            return -v if neg else v
+        if neg:
+            raise SyntaxError("'-' needs a numeric literal")
+        if t.kind == "str":
+            self.next()
+            return t.value
+        if self.accept("kw", "null"):
+            return None
+        if self.accept("kw", "true"):
+            return True
+        if self.accept("kw", "false"):
+            return False
+        raise SyntaxError(f"expected literal, got {t.value!r}")
 
     def _accept_word(self, value: str) -> bool:
         """Accept a contextual word: matches a kw OR ident token by value."""

@@ -32,3 +32,14 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Drop compiled executables between test modules: hundreds of
+    accumulated CPU executables have produced in-compile segfaults deep
+    into the full suite (observed in jax backend_compile during a late
+    module); modules are self-contained, so bounding the live cache
+    costs only per-module recompiles."""
+    yield
+    jax.clear_caches()

@@ -53,3 +53,42 @@ def test_batch_scalar_and_group_agg():
         want[a] = want.get(a, 0) + num
     got = dict(zip(per_auction["auction"].tolist(), per_auction["s"].tolist()))
     assert got == want
+
+
+def test_batch_join_and_agg_over_join():
+    import numpy as np
+
+    from risingwave_tpu.batch.engine import BatchQueryEngine
+    from risingwave_tpu.executors.materialize import MaterializeExecutor
+    from risingwave_tpu.array.chunk import StreamChunk
+
+    a = MaterializeExecutor(pk=("ak",), columns=("av",))
+    b = MaterializeExecutor(pk=("bk", "bv"), columns=())
+    a.apply(StreamChunk.from_numpy(
+        {"ak": np.asarray([1, 2, 3], np.int64),
+         "av": np.asarray([10, 20, 30], np.int64)}, 8))
+    b.apply(StreamChunk.from_numpy(
+        {"bk": np.asarray([2, 3, 3, 5], np.int64),
+         "bv": np.asarray([7, 8, 9, 99], np.int64)}, 8))
+    eng = BatchQueryEngine({"a": a, "b": b})
+
+    out = eng.query(
+        "SELECT ak, av, bv FROM a JOIN b ON ak = bk ORDER BY bv"
+    )
+    assert out["ak"].tolist() == [2, 3, 3]
+    assert out["bv"].tolist() == [7, 8, 9]
+
+    out = eng.query(
+        "SELECT ak, count(*) AS n FROM a LEFT JOIN b ON ak = bk "
+        "GROUP BY ak ORDER BY ak"
+    )
+    assert out["ak"].tolist() == [1, 2, 3]
+    assert out["n"].tolist() == [1, 1, 2]
+
+    out = eng.query("SELECT ak FROM a LEFT ANTI JOIN b ON ak = bk")
+    assert out["ak"].tolist() == [1]
+
+    out = eng.query(
+        "SELECT bk, bv FROM a RIGHT SEMI JOIN b ON ak = bk ORDER BY bv"
+    )
+    assert out["bv"].tolist() == [7, 8, 9]

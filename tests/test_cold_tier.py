@@ -1,0 +1,162 @@
+"""State >> HBM: evict durable groups, fold them back on next touch
+(VERDICT r2 missing #6; reference: LRU state-table caches over Hummock,
+hash_agg.rs:49 + compute memory controller)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.executors.hash_agg import HashAggExecutor
+from risingwave_tpu.ops.agg import AggCall
+from risingwave_tpu.runtime import StreamingRuntime
+from risingwave_tpu.runtime.pipeline import Pipeline
+from risingwave_tpu.executors.materialize import MaterializeExecutor
+from risingwave_tpu.storage.object_store import MemObjectStore
+from risingwave_tpu.storage.state_table import CheckpointManager
+from risingwave_tpu.types import Op
+
+DT = {"k": jnp.int64, "v": jnp.int64}
+CAP = 64
+
+
+def _chunk(rows):
+    return StreamChunk.from_numpy(
+        {
+            "k": np.asarray([r[0] for r in rows], np.int64),
+            "v": np.asarray([r[1] for r in rows], np.int64),
+        },
+        CAP,
+        ops=np.asarray([r[2] for r in rows], np.int32),
+    )
+
+
+def _mk(cap=1 << 12):
+    return HashAggExecutor(
+        group_keys=("k",),
+        calls=(
+            AggCall("count_star", None, "cnt"),
+            AggCall("sum", "v", "s"),
+        ),
+        schema_dtypes=DT,
+        capacity=cap,
+        out_cap=1 << 10,
+        table_id="cold1",
+    )
+
+
+def _replay(snap, chunks):
+    for c in chunks:
+        d = c.to_numpy(with_ops=True)
+        for i in range(len(d["__op__"])):
+            key = (int(d["k"][i]),)
+            if d["__op__"][i] in (Op.DELETE, Op.UPDATE_DELETE):
+                snap.pop(key, None)
+            else:
+                row = []
+                for n in ("cnt", "s"):
+                    nl = d.get(n + "__null")
+                    row.append(None if nl is not None and nl[i] else int(d[n][i]))
+                snap[key] = tuple(row)
+    return snap
+
+
+def test_evict_then_merge_on_return():
+    store = MemObjectStore()
+    mgr = CheckpointManager(store)
+    ex = _mk()
+    ex.cold_reader = lambda keys: mgr.get_rows("cold1", keys)
+    snap = {}
+
+    # 500 groups, checkpoint -> all durable
+    rows = [(k, k * 3, Op.INSERT) for k in range(500)]
+    for at in range(0, len(rows), CAP):
+        _replay(snap, ex.apply(_chunk(rows[at : at + CAP])))
+    _replay(snap, ex.on_barrier(None))
+    mgr.commit_epoch(1 << 16, [ex])
+
+    before = ex.state_nbytes()
+    evicted = ex.evict_cold()
+    assert evicted == 500
+    assert ex.state_nbytes() < before  # capacity shrank: HBM freed
+    assert int(ex.table.occupancy()) == 0
+
+    # touch 40 evicted groups (+ some deletes) and 10 brand-new ones:
+    # merged results must continue exactly from the durable state
+    upd = [(k, 1, Op.INSERT) for k in range(40)]
+    upd += [(k, k * 3, Op.DELETE) for k in range(5)]  # retract cold rows
+    upd += [(k, 7, Op.INSERT) for k in range(1000, 1010)]
+    _replay(snap, ex.apply(_chunk(upd[:CAP])))
+    _replay(snap, ex.apply(_chunk(upd[CAP:])))
+    _replay(snap, ex.on_barrier(None))
+
+    want = {}
+    for k in range(500):
+        cnt, s = 1, k * 3
+        if k < 40:
+            cnt, s = cnt + 1, s + 1
+        if k < 5:
+            cnt, s = cnt - 1, s - k * 3
+        want[(k,)] = (cnt, s)
+    for k in range(1000, 1010):
+        want[(k,)] = (1, 7)
+    assert snap == want
+
+    # checkpoint again, kill, recover: merged state must round-trip
+    mgr.commit_epoch(2 << 16, [ex])
+    ex2 = _mk()
+    CheckpointManager(store).recover([ex2])
+    snap2 = {}
+    _replay(snap2, ex2.on_barrier(None))  # nothing dirty -> no emissions
+    assert snap2 == {}
+    _replay(snap2, ex2.apply(_chunk([(3, 100, Op.INSERT)])))
+    _replay(snap2, ex2.on_barrier(None))
+    assert snap2[(3,)][0] == want[(3,)][0] + 1
+
+
+def test_runtime_memory_budget_triggers_eviction():
+    store = MemObjectStore()
+    rt = StreamingRuntime(store, async_checkpoint=False,
+                          memory_budget_bytes=1)  # absurdly small
+    agg = _mk()
+    mv = MaterializeExecutor(pk=("k",), columns=("cnt", "s"),
+                             table_id="cold1.mv")
+    rt.register("f", Pipeline([agg, mv]))
+    rt.push("f", _chunk([(k, k, Op.INSERT) for k in range(50)]))
+    rt.barrier()  # checkpoint -> durable -> budget forces eviction
+    assert int(agg.table.occupancy()) == 0  # everything evicted
+    rt.push("f", _chunk([(7, 5, Op.INSERT)]))
+    rt.barrier()
+    assert mv.snapshot()[(7,)] == (2, 12)  # merged back exactly
+
+
+def test_cold_min_max_merge_append_only():
+    """Extremes merge in the order-key domain on return from cold."""
+    store = MemObjectStore()
+    mgr = CheckpointManager(store)
+    ex = HashAggExecutor(
+        group_keys=("k",),
+        calls=(AggCall("min", "v", "mn"), AggCall("max", "v", "mx")),
+        schema_dtypes=DT, capacity=1 << 10, out_cap=1 << 9,
+        table_id="cold1",
+    )
+    ex.cold_reader = lambda keys: mgr.get_rows("cold1", keys)
+    snap = {}
+
+    def rep(chunks):
+        for c in chunks:
+            d = c.to_numpy(with_ops=True)
+            for i in range(len(d["__op__"])):
+                key = (int(d["k"][i]),)
+                if d["__op__"][i] in (Op.DELETE, Op.UPDATE_DELETE):
+                    snap.pop(key, None)
+                else:
+                    snap[key] = (int(d["mn"][i]), int(d["mx"][i]))
+
+    rep(ex.apply(_chunk([(1, 50, Op.INSERT), (1, 10, Op.INSERT)])))
+    rep(ex.on_barrier(None))
+    mgr.commit_epoch(1 << 16, [ex])
+    assert ex.evict_cold() == 1
+
+    rep(ex.apply(_chunk([(1, 30, Op.INSERT), (1, 99, Op.INSERT)])))
+    rep(ex.on_barrier(None))
+    assert snap[(1,)] == (10, 99)  # cold min=10 survives, new max=99

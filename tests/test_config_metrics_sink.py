@@ -107,3 +107,29 @@ def test_sink_executor_file_and_blackhole(tmp_path):
     lines = [json.loads(l) for l in open(path)]
     assert lines[0] == {"op": "insert", "pk": [9], "row": [9, 90]}
     assert lines[1]["op"] == "commit"
+
+
+def test_metrics_gauge_and_http_exposition():
+    import urllib.request
+
+    from risingwave_tpu.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.counter("rows_total").inc(5, fragment="q5")
+    reg.gauge("state_bytes").set(1234.0)
+    reg.histogram("lat_ms").observe(2.0)
+    reg.histogram("lat_ms").observe(4.0)
+    text = reg.render()
+    assert '# TYPE rows_total counter' in text
+    assert 'rows_total{fragment="q5"} 5.0' in text
+    assert '# TYPE state_bytes gauge' in text
+    assert 'lat_ms_count 2' in text and 'quantile="0.5"' in text
+
+    port = reg.serve(0)
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ).read().decode()
+        assert body == text
+    finally:
+        reg.shutdown()

@@ -1,0 +1,136 @@
+"""DML (INSERT INTO), UNION-ALL subscription edges, VALUES and NOW
+generator executors (reference: dml.rs, union.rs, values.rs, now.rs)."""
+
+import numpy as np
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.executors import (
+    MaterializeExecutor,
+    NowExecutor,
+    ValuesExecutor,
+)
+from risingwave_tpu.runtime import DmlManager, Pipeline, StreamingRuntime
+from risingwave_tpu.sql import Catalog, StreamPlanner
+from risingwave_tpu.sql import parser as P
+from risingwave_tpu.types import DataType, Schema
+
+T_SCHEMA = Schema([("k", DataType.INT64), ("v", DataType.INT64)])
+
+
+def test_insert_parse_and_route():
+    catalog = Catalog({"t": T_SCHEMA})
+    planner = StreamPlanner(catalog, capacity=1 << 8)
+    runtime = StreamingRuntime(store=None)
+    mv = planner.plan(
+        "CREATE MATERIALIZED VIEW s AS SELECT k, sum(v) AS s FROM t GROUP BY k"
+    )
+    runtime.register("s", mv.pipeline)
+    dml = DmlManager(runtime, catalog)
+    dml.attach(mv)
+
+    stmt = P.parse("INSERT INTO t (k, v) VALUES (1, 10), (2, -5), (1, 3)")
+    assert isinstance(stmt, P.InsertValues)
+    assert stmt.rows == ((1, 10), (2, -5), (1, 3))
+
+    n = dml.execute("INSERT INTO t (k, v) VALUES (1, 10), (2, -5), (1, 3)")
+    assert n == 3
+    runtime.barrier()
+    assert mv.mview.snapshot() == {(1,): (13,), (2,): (-5,)}
+
+    dml.execute("INSERT INTO t VALUES (2, 5)")
+    runtime.barrier()
+    assert mv.mview.snapshot() == {(1,): (13,), (2,): (0,)}
+
+
+def test_union_all_via_subscriptions():
+    """Two upstream MVs feeding one downstream = UNION ALL (union.rs)."""
+    catalog = Catalog({"a": T_SCHEMA, "b": T_SCHEMA})
+    planner = StreamPlanner(catalog, capacity=1 << 8)
+    runtime = StreamingRuntime(store=None)
+    mva = planner.plan(
+        "CREATE MATERIALIZED VIEW ma AS SELECT k, v FROM a GROUP BY k, v"
+    )
+    mvb = planner.plan(
+        "CREATE MATERIALIZED VIEW mb AS SELECT k, v FROM b GROUP BY k, v"
+    )
+    runtime.register("ma", mva.pipeline)
+    runtime.register("mb", mvb.pipeline)
+    catalog.add_mv(mva)
+
+    un = planner.plan(
+        "CREATE MATERIALIZED VIEW u AS SELECT k, sum(v) AS s FROM ma GROUP BY k"
+    )
+    runtime.register("u", un.pipeline, upstream="ma")
+    runtime.subscribe("mb", "u", backfill=False)  # the second union input
+
+    def push(name, rows):
+        chunk = StreamChunk.from_numpy(
+            {
+                "k": np.asarray([r[0] for r in rows], np.int64),
+                "v": np.asarray([r[1] for r in rows], np.int64),
+            },
+            8,
+        )
+        runtime.push(name, chunk)
+
+    push("ma", [(1, 5), (2, 7)])
+    push("mb", [(1, 100), (3, 9)])
+    runtime.barrier()
+    assert un.mview.snapshot() == {(1,): (105,), (2,): (7,), (3,): (9,)}
+
+
+def test_values_and_now_executors():
+    vals = ValuesExecutor({"x": np.asarray([3, 1, 4], np.int64)})
+    mv = MaterializeExecutor(pk=("_row_id",), columns=("x",))
+    pipe = Pipeline([vals, mv])
+    pipe.barrier()
+    assert {v[0] for v in mv.snapshot().values()} == {3, 1, 4}
+    pipe.barrier()  # emits once, not per barrier
+    assert len(mv.snapshot()) == 3
+
+    now = NowExecutor()
+    mvn = MaterializeExecutor(pk=(), columns=("now",))
+    pipe = Pipeline([now, mvn])
+    pipe.barrier(epoch=1000 << 16)
+    assert mvn.snapshot() == {(): (1000,)}
+    pipe.barrier(epoch=2000 << 16)
+    assert mvn.snapshot() == {(): (2000,)}
+
+
+def test_over_window_matches_pandas():
+    import pandas as pd
+    import jax.numpy as jnp
+
+    from risingwave_tpu.executors.over_window import (
+        OverWindowExecutor,
+        WindowCall,
+    )
+
+    rng = np.random.default_rng(9)
+    ex = OverWindowExecutor(
+        ("p",),
+        (
+            WindowCall("row_number", None, "rn"),
+            WindowCall("sum", "v", "rsum"),
+        ),
+        {"p": jnp.int64, "v": jnp.int64},
+        capacity=64,  # forces growth across chunks
+    )
+    all_p, all_v, got = [], [], {"rn": [], "rsum": []}
+    for _ in range(6):
+        p = rng.integers(0, 40, 50).astype(np.int64)
+        v = rng.integers(-20, 20, 50).astype(np.int64)
+        all_p.extend(p.tolist())
+        all_v.extend(v.tolist())
+        chunk = StreamChunk.from_numpy({"p": p, "v": v}, 64)
+        for out in ex.apply(chunk):
+            d = out.to_numpy(False)
+            got["rn"].extend(d["rn"].tolist())
+            got["rsum"].extend(d["rsum"].tolist())
+        ex.on_barrier(None)
+
+    df = pd.DataFrame({"p": all_p, "v": all_v})
+    want_rn = df.groupby("p").cumcount() + 1
+    want_rsum = df.groupby("p")["v"].cumsum()
+    assert got["rn"] == want_rn.tolist()
+    assert got["rsum"] == want_rsum.tolist()
