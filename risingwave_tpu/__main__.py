@@ -1,0 +1,91 @@
+"""CLI — `python -m risingwave_tpu serve` starts a single-node cluster.
+
+Reference roles: the `risingwave` all-in-one launcher + `risectl`
+basics (src/cmd_all/, src/ctl/). One process hosts the frontend
+(pgwire), the streaming runtime (barrier clock on a thread), and the
+metrics endpoint; `CREATE TABLE` / `CREATE MATERIALIZED VIEW` /
+`INSERT` / `SELECT` all work from any pg client.
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+
+
+def serve(args) -> None:
+    if args.device == "cpu":
+        import os
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from risingwave_tpu.config import load_config
+    from risingwave_tpu.frontend import PgServer, SqlSession
+    from risingwave_tpu.metrics import REGISTRY
+    from risingwave_tpu.runtime import StreamingRuntime
+    from risingwave_tpu.sql import Catalog
+    from risingwave_tpu.storage.object_store import LocalFsObjectStore
+
+    cfg = load_config(args.config) if args.config else None
+    store = (
+        LocalFsObjectStore(args.state_dir) if args.state_dir else None
+    )
+    runtime = (
+        StreamingRuntime.from_config(cfg, store)
+        if cfg is not None
+        else StreamingRuntime(store)
+    )
+    session = SqlSession(Catalog({}), runtime)
+    pg = PgServer(session, port=args.port).start()
+    mport = REGISTRY.serve(args.metrics_port)
+    print(
+        f"risingwave-tpu serving: pgwire on 127.0.0.1:{pg.port}, "
+        f"metrics on http://127.0.0.1:{mport}/metrics"
+        + (f", state in {args.state_dir}" if args.state_dir else " (no store)")
+    )
+
+    stop = threading.Event()
+
+    def clock():
+        while not stop.is_set():
+            try:
+                runtime.tick()
+            except Exception as e:  # noqa: BLE001 — keep serving
+                print(f"barrier error: {e}")
+            time.sleep(runtime.barrier_interval_ms / 1000 / 4)
+
+    t = threading.Thread(target=clock, daemon=True)
+    t.start()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        stop.set()
+        pg.shutdown()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(prog="risingwave_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    s = sub.add_parser("serve", help="start a single-node cluster")
+    s.add_argument("--port", type=int, default=4566)
+    s.add_argument("--metrics-port", type=int, default=0)
+    s.add_argument("--state-dir", default=None, help="object store root")
+    s.add_argument("--config", default=None, help="TOML config path")
+    s.add_argument(
+        "--device",
+        choices=["auto", "cpu"],
+        default="auto",
+        help="auto = whatever jax finds (the TPU under axon); cpu forces "
+        "the host backend",
+    )
+    s.set_defaults(fn=serve)
+    args = ap.parse_args()
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,17 @@ from risingwave_tpu.batch.engine import BatchQueryEngine
 from risingwave_tpu.runtime import DmlManager, StreamingRuntime
 from risingwave_tpu.sql import Catalog, StreamPlanner
 from risingwave_tpu.sql import parser as P
+from risingwave_tpu.types import DataType, Schema
+
+_TYPE_WORDS = {
+    "int": DataType.INT32, "integer": DataType.INT32, "int4": DataType.INT32,
+    "bigint": DataType.INT64, "int8": DataType.INT64, "int64": DataType.INT64,
+    "real": DataType.FLOAT32, "float4": DataType.FLOAT32,
+    "double": DataType.FLOAT64, "float8": DataType.FLOAT64,
+    "boolean": DataType.BOOLEAN, "bool": DataType.BOOLEAN,
+    "timestamp": DataType.TIMESTAMP,
+    "varchar": DataType.VARCHAR, "text": DataType.VARCHAR,
+}
 
 
 class SqlSession:
@@ -40,10 +51,51 @@ class SqlSession:
         """Returns (result columns, command tag). Non-queries return an
         empty column dict."""
         stmt = P.parse(sql)
+        if isinstance(stmt, P.CreateTable):
+            fields = []
+            for cname, tword in stmt.columns:
+                dt = _TYPE_WORDS.get(tword.lower())
+                if dt is None:
+                    raise ValueError(f"unknown type {tword!r}")
+                fields.append((cname, dt))
+            schema = Schema(fields)
+            self.catalog.tables[stmt.name] = schema
+            # a table IS a materialized relation (create_table.rs makes
+            # the same plan: dml -> row-id gen -> materialize): give it
+            # a fragment so INSERTs land somewhere queryable and
+            # downstream MVs backfill from its snapshot
+            from risingwave_tpu.executors.materialize import (
+                MaterializeExecutor,
+            )
+            from risingwave_tpu.executors.row_id_gen import RowIdGenExecutor
+            from risingwave_tpu.runtime import Pipeline
+
+            mview = MaterializeExecutor(
+                pk=("_row_id",),
+                columns=schema.names,
+                table_id=f"{stmt.name}.table",
+            )
+            self.runtime.register(
+                stmt.name,
+                Pipeline(
+                    [
+                        RowIdGenExecutor(
+                            out_col="_row_id",
+                            table_id=f"{stmt.name}.rowid",
+                        ),
+                        mview,
+                    ]
+                ),
+            )
+            self.batch.register(stmt.name, mview)
+            self.dml.add_target(stmt.name, stmt.name, "single")
+            return {}, "CREATE_TABLE"
         if isinstance(stmt, P.CreateMaterializedView):
             planned = self.planner.plan(sql)
             upstreams = [
-                s for s in planned.inputs if self.catalog.is_mv(s)
+                s
+                for s in planned.inputs
+                if self.catalog.is_mv(s) or s in self.runtime.fragments
             ]
             self.runtime.register(
                 planned.name,
@@ -51,8 +103,14 @@ class SqlSession:
                 upstream=upstreams[0] if upstreams else None,
             )
             self.catalog.add_mv(planned)
-            self.dml.attach(planned)
+            if not upstreams:
+                # base streams fed directly (driver/DML) — route INSERTs
+                # straight into the MV pipeline
+                self.dml.attach(planned)
             self.batch.register(planned.name, planned.mview)
+            # CREATE returns once the backfill snapshot is visible
+            # (the reference blocks DDL on backfill completion)
+            self.runtime.barrier()
             return {}, "CREATE_MATERIALIZED_VIEW"
         if isinstance(stmt, P.InsertValues):
             n = self.dml.execute(sql)

@@ -34,6 +34,11 @@ class DmlManager:
                     (planned.name, side)
                 )
 
+    def add_target(self, stream: str, fragment: str, side: str) -> None:
+        """Route INSERTs on ``stream`` into ``fragment`` (the table's
+        own materializing fragment; MVs over it ride subscriptions)."""
+        self._targets.setdefault(stream, []).append((fragment, side))
+
     def execute(self, sql: str) -> int:
         stmt = P.parse(sql)
         if not isinstance(stmt, P.InsertValues):
@@ -52,9 +57,10 @@ class DmlManager:
             vals = [r[j] for r in stmt.rows]
             isnull = np.asarray([v is None for v in vals], bool)
             dt = field.dtype.device_dtype
-            if dt.kind not in "iufb":
+            if field.dtype.value == "varchar":
                 raise NotImplementedError(
-                    f"DML into {field.dtype} column {name!r} not supported"
+                    f"DML into VARCHAR column {name!r} not supported yet "
+                    "(needs a session string dictionary)"
                 )
             filled = np.asarray(
                 [0 if v is None else v for v in vals], dt

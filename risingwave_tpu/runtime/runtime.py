@@ -31,6 +31,7 @@ import numpy as np
 
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.metrics import REGISTRY
+from risingwave_tpu.trace import span
 from risingwave_tpu.storage.object_store import ObjectStore
 from risingwave_tpu.storage.state_table import CheckpointManager
 
@@ -243,7 +244,8 @@ class StreamingRuntime:
             # once: sink commits may never run ahead of durability);
             # the runtime's epoch is passed down so held sink batches
             # key by the exact epoch _commit/_on_epoch_durable will use
-            outs[name] = p.barrier(checkpoint=is_ckpt, epoch=self._epoch)
+            with span("barrier.fragment", fragment=name):
+                outs[name] = p.barrier(checkpoint=is_ckpt, epoch=self._epoch)
             self._route(name, outs[name])
         if is_ckpt:
             self._commit(self._epoch)
@@ -305,7 +307,8 @@ class StreamingRuntime:
         # the duplicate-table_id check) — ONE code path with the sync
         # commit (CheckpointManager.stage / commit_staged)
         t_staged = time.perf_counter()
-        staged = self.mgr.stage(self.executors())
+        with span("checkpoint.stage"):
+            staged = self.mgr.stage(self.executors())
         REGISTRY.counter("checkpoints_total").inc()
         REGISTRY.gauge("checkpoint_staged_tables").set(len(staged))
         if not self.async_checkpoint:
@@ -345,7 +348,8 @@ class StreamingRuntime:
                         # everything until the caller recover()s
                         continue
                     # single-worker FIFO queue -> epoch order holds
-                    self.mgr.commit_staged(epoch, staged)
+                    with span("checkpoint.commit", epoch=epoch):
+                        self.mgr.commit_staged(epoch, staged)
                     self.checkpoint_sync_ms.append(
                         (time.perf_counter() - t_staged) * 1e3
                     )

@@ -106,6 +106,15 @@ class CreateMaterializedView:
 
 
 @dataclass(frozen=True)
+class CreateTable:
+    """CREATE TABLE t (col type, ...) — the DML-writable relation DDL
+    (reference: src/frontend/src/handler/create_table.rs)."""
+
+    name: str
+    columns: Tuple[Tuple[str, str], ...]  # (name, type word)
+
+
+@dataclass(frozen=True)
 class InsertValues:
     """INSERT INTO t [(cols)] VALUES (...), (...) — the DML surface
     (reference: src/frontend/src/handler/dml.rs -> dml executor)."""
@@ -115,7 +124,7 @@ class InsertValues:
     columns: Optional[Tuple[str, ...]] = None
 
 
-Statement = Union[CreateMaterializedView, Select, InsertValues]
+Statement = Union[CreateMaterializedView, CreateTable, Select, InsertValues]
 
 # -------------------------------------------------------------- lexer --
 
@@ -206,6 +215,21 @@ class Parser:
     # -- entry -----------------------------------------------------------
     def parse(self) -> Statement:
         if self.accept("kw", "create"):
+            if self._accept_word("table"):
+                name = self.expect("ident").value
+                self.expect("op", "(")
+                cols = []
+                while True:
+                    cname = self.expect("ident").value
+                    t = self.next()
+                    if t.kind not in ("ident", "kw"):
+                        raise SyntaxError(f"expected a type, got {t.value!r}")
+                    cols.append((cname, t.value))
+                    if not self.accept("op", ","):
+                        break
+                self.expect("op", ")")
+                self.expect("eof")
+                return CreateTable(name, tuple(cols))
             self.expect("kw", "materialized")
             self.expect("kw", "view")
             name = self.expect("ident").value

@@ -1,0 +1,82 @@
+"""Tracing — lightweight spans with chrome-trace export.
+
+Reference: the reference threads `tracing` spans through every actor/
+executor and exports via opentelemetry (src/utils/runtime/src/, await
+tree dumps). Here spans are host-side (device work is opaque inside
+XLA programs anyway): a context manager records (name, start, dur,
+args) per thread into a bounded ring, renders chrome://tracing JSON,
+and mirrors durations into the metrics registry.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Optional
+
+from risingwave_tpu.metrics import REGISTRY
+
+_MAX_EVENTS = 65_536
+
+
+class Tracer:
+    def __init__(self, max_events: int = _MAX_EVENTS):
+        self._events: deque = deque(maxlen=max_events)
+        self._lock = threading.Lock()
+        self.enabled = True
+
+    @contextmanager
+    def span(self, name: str, **args):
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dur = time.perf_counter() - t0
+            with self._lock:
+                self._events.append(
+                    (
+                        name,
+                        threading.get_ident(),
+                        t0,
+                        dur,
+                        args or None,
+                    )
+                )
+            REGISTRY.histogram("span_ms").observe(dur * 1e3, span=name)
+
+    def chrome_trace(self) -> str:
+        """chrome://tracing / perfetto 'traceEvents' JSON."""
+        with self._lock:
+            events = list(self._events)
+        out = []
+        for name, tid, t0, dur, args in events:
+            ev = {
+                "name": name,
+                "ph": "X",
+                "pid": 1,
+                "tid": tid % 1_000_000,
+                "ts": t0 * 1e6,
+                "dur": dur * 1e6,
+            }
+            if args:
+                ev["args"] = args
+            out.append(ev)
+        return json.dumps({"traceEvents": out})
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.chrome_trace())
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+
+TRACER = Tracer()
+span = TRACER.span

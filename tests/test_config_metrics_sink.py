@@ -133,3 +133,54 @@ def test_metrics_gauge_and_http_exposition():
         assert body == text
     finally:
         reg.shutdown()
+
+
+def test_tracer_spans_and_chrome_export(tmp_path):
+    import json
+
+    from risingwave_tpu.trace import TRACER
+
+    TRACER.clear()
+    with TRACER.span("unit.outer", k=1):
+        with TRACER.span("unit.inner"):
+            pass
+    doc = json.loads(TRACER.chrome_trace())
+    names = [e["name"] for e in doc["traceEvents"]]
+    assert "unit.outer" in names and "unit.inner" in names
+    path = tmp_path / "trace.json"
+    TRACER.dump(str(path))
+    assert json.loads(path.read_text())["traceEvents"]
+
+
+def test_arrow_roundtrip():
+    import numpy as np
+    import pyarrow as pa
+
+    from risingwave_tpu.array.arrow import chunk_from_arrow, chunk_to_arrow
+    from risingwave_tpu.array.chunk import StreamChunk
+    from risingwave_tpu.array.dictionary import StringDictionary
+
+    d = StringDictionary()
+    codes = d.encode(["alpha", "beta", "alpha"])
+    chunk = StreamChunk.from_numpy(
+        {
+            "k": np.asarray([1, 2, 3], np.int64),
+            "s": codes.astype(np.int32),
+            "v": np.asarray([1.5, 0.0, -2.25], np.float64),
+        },
+        8,
+        nulls={"v": np.asarray([False, True, False])},
+    )
+    batch = chunk_to_arrow(chunk, dictionaries={"s": d})
+    assert batch.num_rows == 3
+    assert batch.column("s").to_pylist() == ["alpha", "beta", "alpha"]
+    assert batch.column("v").to_pylist()[1] is None
+
+    dicts = {}
+    back = chunk_from_arrow(batch, dictionaries=dicts)
+    got = back.to_numpy(False)
+    assert got["k"].tolist() == [1, 2, 3]
+    assert [dicts["s"].decode_one(c) for c in got["s"].tolist()] == [
+        "alpha", "beta", "alpha",
+    ]
+    assert got["v__null"].tolist() == [False, True, False]

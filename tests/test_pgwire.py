@@ -113,6 +113,39 @@ def test_pgwire_end_to_end(server):
     c.close()
 
 
+def test_pgwire_create_table_full_workflow(server):
+    """The psql workflow with no pre-seeded catalog: CREATE TABLE ->
+    INSERT -> SELECT the table -> CREATE MV over it (backfilled) ->
+    more INSERTs -> MV stays exact."""
+    c = PgClient(server.port)
+    _, _, tag, err = c.query(
+        "CREATE TABLE orders (uid BIGINT, amount BIGINT)"
+    )
+    assert err is None and tag == "CREATE_TABLE"
+    _, _, tag, err = c.query(
+        "INSERT INTO orders VALUES (1, 10), (2, 20), (1, 5)"
+    )
+    assert err is None and tag == "INSERT 0 3"
+    names, rows, tag, _ = c.query(
+        "SELECT uid, amount FROM orders ORDER BY amount"
+    )
+    assert [r[1] for r in rows] == ["5", "10", "20"]
+
+    # MV over the table backfills the 3 existing rows
+    _, _, tag, err = c.query(
+        "CREATE MATERIALIZED VIEW spend AS "
+        "SELECT uid, sum(amount) AS total FROM orders GROUP BY uid"
+    )
+    assert err is None
+    names, rows, _, err = c.query("SELECT uid, total FROM spend ORDER BY uid")
+    assert err is None and rows == [("1", "15"), ("2", "20")]
+
+    c.query("INSERT INTO orders VALUES (2, 1)")
+    names, rows, _, err = c.query("SELECT uid, total FROM spend ORDER BY uid")
+    assert err is None and rows == [("1", "15"), ("2", "21")]
+    c.close()
+
+
 def test_pgwire_concurrent_clients(server):
     a, b = PgClient(server.port), PgClient(server.port)
     a.query("CREATE MATERIALIZED VIEW m AS SELECT k, count(*) AS n FROM t GROUP BY k")
