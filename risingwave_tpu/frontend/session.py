@@ -50,8 +50,17 @@ class SqlSession:
     def execute(self, sql: str) -> Tuple[Dict[str, np.ndarray], str]:
         """Returns (result columns, command tag). Non-queries return an
         empty column dict."""
+        with self.runtime.lock:
+            return self._execute_locked(sql)
+
+    def _execute_locked(self, sql: str) -> Tuple[Dict[str, np.ndarray], str]:
         stmt = P.parse(sql)
         if isinstance(stmt, P.CreateTable):
+            if (
+                stmt.name in self.catalog.tables
+                or stmt.name in self.runtime.fragments
+            ):
+                raise ValueError(f"relation {stmt.name!r} already exists")
             fields = []
             for cname, tword in stmt.columns:
                 dt = _TYPE_WORDS.get(tword.lower())
@@ -92,21 +101,31 @@ class SqlSession:
             return {}, "CREATE_TABLE"
         if isinstance(stmt, P.CreateMaterializedView):
             planned = self.planner.plan(sql)
-            upstreams = [
-                s
-                for s in planned.inputs
-                if self.catalog.is_mv(s) or s in self.runtime.fragments
-            ]
-            self.runtime.register(
-                planned.name,
-                planned.pipeline,
-                upstream=upstreams[0] if upstreams else None,
-            )
+            if planned.name in self.runtime.fragments:
+                raise ValueError(
+                    f"relation {planned.name!r} already exists"
+                )
+            # each input is either an existing fragment (table / MV):
+            # subscribe its delta edge with the correct join side and
+            # backfill from its snapshot — or a raw base stream: attach
+            # a DML target so INSERTs land in this MV directly
+            frag_inputs = {
+                s: side
+                for s, side in planned.inputs.items()
+                if s in self.runtime.fragments
+            }
+            self.runtime.register(planned.name, planned.pipeline)
+            try:
+                for s, side in frag_inputs.items():
+                    self.runtime.subscribe(s, planned.name, side=side)
+            except BaseException:
+                # keep the graph consistent on backfill failure: a
+                # half-registered fragment would crash later barriers
+                self.runtime.unregister(planned.name)
+                raise
             self.catalog.add_mv(planned)
-            if not upstreams:
-                # base streams fed directly (driver/DML) — route INSERTs
-                # straight into the MV pipeline
-                self.dml.attach(planned)
+            if len(frag_inputs) < len(planned.inputs):
+                self.dml.attach(planned, skip=frag_inputs.keys())
             self.batch.register(planned.name, planned.mview)
             # CREATE returns once the backfill snapshot is visible
             # (the reference blocks DDL on backfill completion)

@@ -25,10 +25,14 @@ class DmlManager:
         # stream name -> [(fragment, side)]
         self._targets: Dict[str, List[Tuple[str, str]]] = {}
 
-    def attach(self, planned) -> None:
+    def attach(self, planned, skip=()) -> None:
         """Register a planned (and runtime-registered) MV's inputs as
-        DML-reachable write targets."""
+        DML-reachable write targets. ``skip`` lists inputs already fed
+        through fragment subscriptions (tables/MVs) — adding a direct
+        target too would double-deliver every INSERT."""
         for stream, side in planned.inputs.items():
+            if stream in skip:
+                continue
             if stream in self.catalog.tables and not self.catalog.is_mv(stream):
                 self._targets.setdefault(stream, []).append(
                     (planned.name, side)

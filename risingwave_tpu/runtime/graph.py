@@ -42,7 +42,7 @@ import jax.numpy as jnp
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Barrier, Epoch, Executor, Watermark
 from risingwave_tpu.ops.hashing import VNODE_COUNT, hash_columns
-from risingwave_tpu.runtime.pipeline import _walk_watermark
+from risingwave_tpu.runtime.pipeline import _walk_watermark, walk_chain
 
 # message kinds flowing through channels
 CHUNK, BARRIER, WATERMARK, STOP = "chunk", "barrier", "watermark", "stop"
@@ -61,6 +61,7 @@ class PermitChannel:
         self,
         record_permits: int = 1 << 16,
         cv: Optional[threading.Condition] = None,
+        abort: Optional[threading.Event] = None,
     ):
         self._budget = record_permits
         self._avail = record_permits
@@ -69,12 +70,18 @@ class PermitChannel:
         # channels to support wait-on-any (the reference's select over
         # upstream inputs, merge.rs:32)
         self._cv = cv if cv is not None else threading.Condition()
+        # set when the graph is failing/being killed: blocked senders
+        # must wake and drop instead of wedging forever on a dead
+        # consumer's permits
+        self._abort = abort
 
     def send_chunk(self, chunk: StreamChunk) -> None:
         cost = min(chunk.capacity, self._budget)
         with self._cv:
             while self._avail < cost:
-                self._cv.wait()
+                if self._abort is not None and self._abort.is_set():
+                    return  # graph aborting: drop data, never wedge
+                self._cv.wait(timeout=0.1)
             self._avail -= cost
             self._q.append((CHUNK, chunk, cost))
             self._cv.notify_all()
@@ -260,15 +267,7 @@ class FragmentActor(threading.Thread):
 
     # -- chain plumbing ---------------------------------------------------
     def _through(self, chain, chunks, barrier=None):
-        pending = list(chunks)
-        for ex in chain:
-            nxt: List[StreamChunk] = []
-            for c in pending:
-                nxt.extend(ex.apply(c))
-            if barrier is not None:
-                nxt.extend(ex.on_barrier(barrier))
-            pending = nxt
-        return pending
+        return walk_chain(chain, chunks, barrier)
 
     def _emit(self, chunks: Sequence[StreamChunk]) -> None:
         for c in chunks:
@@ -289,9 +288,13 @@ class FragmentActor(threading.Thread):
         self._emit(self._through(self.tail, outs))
 
     def _process_barrier(self, b: Barrier) -> None:
+        # watermarks generated behind the barrier are sent AFTER the
+        # flushed data chunks: channels are FIFO, so sending the
+        # watermark first would let it overtake the very rows it covers
+        # and a downstream window/filter would drop them as late
+        wms: List[Watermark] = []
         if self.join_exec is None:
             outs = self._through(self.chain, [], barrier=b)
-            # executor-generated watermarks ride behind the barrier
             gen: List[StreamChunk] = []
             for i, ex in enumerate(self.chain):
                 wm = ex.emit_watermark()
@@ -299,7 +302,7 @@ class FragmentActor(threading.Thread):
                     down, flushed = _walk_watermark(self.chain[i + 1 :], wm)
                     gen.extend(flushed)
                     if down is not None:
-                        self._send_watermark_downstream(down)
+                        wms.append(down)
             self._emit(outs + gen)
         else:
             joined: List[StreamChunk] = []
@@ -308,9 +311,54 @@ class FragmentActor(threading.Thread):
             for c in self._through(self.right_chain, [], barrier=b):
                 joined.extend(self.join_exec.apply_right(c))
             joined.extend(self.join_exec.on_barrier(b))
-            self._emit(self._through(self.tail, joined, barrier=b))
+            outs = self._through(self.tail, joined, barrier=b)
+            gen, gwms = self._generated_watermarks_join()
+            wms.extend(gwms)
+            self._emit(outs + gen)
+        for wm in wms:
+            self._send_watermark_downstream(wm)
         self.dispatcher.control(BARRIER, b)
         self.mgr._collect(self.actor_name, b)
+
+    def _generated_watermarks_join(self):
+        """Poll emit_watermark across a two-input fragment's chains
+        (mirrors TwoInputPipeline._generated_watermarks): side-chain
+        watermarks walk the rest of their chain, through the join's
+        per-side cleanup/alignment, then the tail. Returns
+        (chunks_to_emit, watermarks_for_downstream)."""
+        outs: List[StreamChunk] = []
+        wms: List[Watermark] = []
+        aligned: Optional[Watermark] = None
+        for chain, feed in (
+            (self.chain, self.join_exec.apply_left),
+            (self.right_chain, self.join_exec.apply_right),
+        ):
+            for i, ex in enumerate(chain):
+                wm = ex.emit_watermark()
+                if wm is None:
+                    continue
+                wm, pending = _walk_watermark(chain[i + 1 :], wm)
+                for c in pending:
+                    outs.extend(feed(c))
+                if wm is not None:
+                    down, flushed = self.join_exec.on_watermark(wm)
+                    outs.extend(flushed)
+                    if down is not None:
+                        aligned = down
+        outs = self._through(self.tail, outs)
+        if aligned is not None:
+            dt, touts = _walk_watermark(self.tail, aligned)
+            outs.extend(touts)
+            if dt is not None:
+                wms.append(dt)
+        for i, ex in enumerate(self.tail):
+            wm = ex.emit_watermark()
+            if wm is not None:
+                dt, touts = _walk_watermark(self.tail[i + 1 :], wm)
+                outs.extend(touts)
+                if dt is not None:
+                    wms.append(dt)
+        return outs, wms
 
     def _process_watermark(self, chan_idx: int, wm: Watermark) -> None:
         """Min-align watermarks across input channels (the reference
@@ -471,6 +519,7 @@ class GraphRuntime:
         self._failure: Optional[BaseException] = None
         self._epoch = 0
         self._source_rr: Dict[str, int] = {}
+        self._abort = threading.Event()
         self._build(specs)
 
     # -- graph build (ActorGraphBuilder analogue, actor.rs:648) ----------
@@ -494,7 +543,9 @@ class GraphRuntime:
                 chans = []
                 for di in range(s.parallelism):
                     ch = PermitChannel(
-                        self._channel_permits, cv=cvs[(s.name, di)]
+                        self._channel_permits,
+                        cv=cvs[(s.name, di)],
+                        abort=self._abort,
                     )
                     in_channels[s.name][di].append((port, ch))
                     chans.append(ch)
@@ -507,7 +558,9 @@ class GraphRuntime:
                 srcs = []
                 for inst in range(s.parallelism):
                     ch = PermitChannel(
-                        self._channel_permits, cv=cvs[(s.name, inst)]
+                        self._channel_permits,
+                        cv=cvs[(s.name, inst)],
+                        abort=self._abort,
                     )
                     in_channels[s.name][inst].append((0, ch))
                     srcs.append(ch)
@@ -614,6 +667,12 @@ class GraphRuntime:
                 ch.send_control(STOP)
         for a in self.actors:
             a.join(timeout=timeout)
+        if any(a.is_alive() for a in self.actors):
+            # graceful drain failed (e.g. an actor died and its upstream
+            # is wedged on permits): abort wakes blocked senders to drop
+            self._abort.set()
+            for a in self.actors:
+                a.join(timeout=5.0)
 
     def drain(self, name: str) -> List[StreamChunk]:
         return self.collectors[name].drain()
@@ -635,6 +694,7 @@ class GraphRuntime:
                 self._collect_lock.notify_all()
 
     def _actor_failed(self, actor_name: str, err: BaseException) -> None:
+        self._abort.set()  # wake senders blocked on the dead consumer
         with self._collect_lock:
             self._failure = err
             self._collect_lock.notify_all()

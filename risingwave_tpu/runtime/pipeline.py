@@ -24,6 +24,22 @@ from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Barrier, Epoch, Executor, Watermark
 
 
+def walk_chain(chain: Sequence[Executor], chunks, barrier=None):
+    """Feed chunks (then optionally a barrier) down an executor chain;
+    every executor's output — including its barrier flush — is data for
+    the executors below it. The single chain-walking loop shared by
+    Pipeline, TwoInputPipeline and the graph runtime's FragmentActor."""
+    pending = list(chunks)
+    for ex in chain:
+        nxt: List[StreamChunk] = []
+        for c in pending:
+            nxt.extend(ex.apply(c))
+        if barrier is not None:
+            nxt.extend(ex.on_barrier(barrier))
+        pending = nxt
+    return pending
+
+
 class Pipeline:
     """An ordered chain of executors driven by the host epoch loop."""
 
@@ -34,13 +50,7 @@ class Pipeline:
     # -- message plumbing -------------------------------------------------
     def push(self, chunk: StreamChunk, start: int = 0) -> List[StreamChunk]:
         """Feed one data chunk into the chain; returns what falls out."""
-        pending = [chunk]
-        for ex in self.executors[start:]:
-            nxt: List[StreamChunk] = []
-            for c in pending:
-                nxt.extend(ex.apply(c))
-            pending = nxt
-        return pending
+        return walk_chain(self.executors[start:], [chunk])
 
     def barrier(
         self, checkpoint: bool = True, epoch: Optional[int] = None
@@ -124,15 +134,7 @@ class TwoInputPipeline:
         self._epoch = 0
 
     def _through(self, chain, chunks, barrier=None):
-        pending = list(chunks)
-        for ex in chain:
-            nxt: List[StreamChunk] = []
-            for c in pending:
-                nxt.extend(ex.apply(c))
-            if barrier is not None:
-                nxt.extend(ex.on_barrier(barrier))
-            pending = nxt
-        return pending
+        return walk_chain(chain, chunks, barrier)
 
     def push_left(self, chunk: StreamChunk) -> List[StreamChunk]:
         outs = []

@@ -25,7 +25,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -80,7 +80,9 @@ class StreamingRuntime:
         # evicted to the object store and fold back on next touch
         self.memory_budget_bytes = memory_budget_bytes
         self.fragments: Dict[str, object] = {}
-        self._subs: Dict[str, List[str]] = {}  # upstream -> downstreams
+        # upstream -> [(downstream, side)]; side targets one input of a
+        # two-input fragment ("left"/"right") or "single"
+        self._subs: Dict[str, List[Tuple[str, str]]] = {}
         self._aux_state: List[object] = []
         self.barrier_interval_ms = barrier_interval_ms
         self.checkpoint_frequency = checkpoint_frequency
@@ -108,6 +110,10 @@ class StreamingRuntime:
         self._compact_idle.set()
         self.compaction_errors: List[BaseException] = []
         self._work_abort = threading.Event()
+        # serializes barrier/DDL/DML against a background barrier clock
+        # (the CLI's tick thread vs pgwire sessions — the reference
+        # serializes via the meta barrier scheduler's command queue)
+        self.lock = threading.RLock()
 
     # -- fragments -------------------------------------------------------
     def register(
@@ -124,11 +130,10 @@ class StreamingRuntime:
         is already checkpointed) — the upstream MV's current rows are
         snapshot-backfilled first (no_shuffle_backfill.rs:66; see
         runtime/backfill.py)."""
-        if upstream is not None:
-            if upstream not in self.fragments:
-                raise KeyError(f"unknown upstream fragment {upstream!r}")
-            if name in self.fragments:
-                raise ValueError(f"fragment {name!r} already registered")
+        if name in self.fragments:
+            raise ValueError(f"fragment {name!r} already registered")
+        if upstream is not None and upstream not in self.fragments:
+            raise KeyError(f"unknown upstream fragment {upstream!r}")
         self.fragments[name] = pipeline
         if self.mgr is not None:
             for ex in pipeline.executors:
@@ -153,24 +158,43 @@ class StreamingRuntime:
             self.subscribe(upstream, name, backfill=backfill)
 
     def subscribe(
-        self, upstream: str, name: str, backfill: bool = True
+        self,
+        upstream: str,
+        name: str,
+        backfill: bool = True,
+        side: str = "single",
     ) -> None:
         """Add a delta edge upstream -> name. Multiple subscriptions of
         one fragment realize UNION ALL (the reference's UnionExecutor,
         union.rs: n inputs merged into one stream — here the host
-        routes every upstream's chunks into the same pipeline)."""
+        routes every upstream's chunks into the same pipeline).
+        ``side`` targets one input of a two-input fragment ("left" /
+        "right"), so joins over two upstream MVs/tables work."""
         if upstream not in self.fragments:
             raise KeyError(f"unknown upstream fragment {upstream!r}")
         if name not in self.fragments:
             raise KeyError(f"unknown fragment {name!r}")
-        self._subs.setdefault(upstream, []).append(name)
+        self._subs.setdefault(upstream, []).append((name, side))
         if backfill:
             from risingwave_tpu.runtime.backfill import snapshot_chunks
 
             up_mv = self._fragment_mview(upstream)
-            pipeline = self.fragments[name]
             for chunk in snapshot_chunks(up_mv):
-                self._route(name, pipeline.push(chunk))
+                self._route(name, self._push_into(name, chunk, side))
+
+    def unregister(self, name: str) -> None:
+        """Remove a fragment and every subscription edge touching it —
+        the rollback path when CREATE fails mid-registration (the
+        reference cleans dirty streaming jobs the same way,
+        ddl_controller.rs + barrier/recovery.rs 'clean dirty jobs')."""
+        self.fragments.pop(name, None)
+        self._subs.pop(name, None)
+        for up, edges in list(self._subs.items()):
+            kept = [e for e in edges if e[0] != name]
+            if kept:
+                self._subs[up] = kept
+            else:
+                del self._subs[up]
 
     def _fragment_mview(self, name: str):
         from risingwave_tpu.executors.materialize import MaterializeExecutor
@@ -180,27 +204,28 @@ class StreamingRuntime:
                 return ex
         raise ValueError(f"fragment {name!r} has no materialize stage")
 
+    def _push_into(self, name: str, chunk: StreamChunk, side: str):
+        p = self.fragments[name]
+        if side == "left":
+            return p.push_left(chunk)
+        if side == "right":
+            return p.push_right(chunk)
+        return p.push(chunk)
+
     def push(self, name: str, chunk: StreamChunk, side: str = "single"):
         """Feed one chunk into a fragment and route its emitted deltas
         into every subscribed downstream fragment (the exchange edge an
         MV-on-MV chain rides)."""
-        p = self.fragments[name]
-        if side == "left":
-            outs = p.push_left(chunk)
-        elif side == "right":
-            outs = p.push_right(chunk)
-        else:
-            outs = p.push(chunk)
+        outs = self._push_into(name, chunk, side)
         REGISTRY.counter("chunks_pushed_total").inc(fragment=name)
         self._route(name, outs)
         return outs
 
     def _route(self, upstream: str, chunks) -> None:
-        for sub in self._subs.get(upstream, ()):
-            p = self.fragments[sub]
+        for sub, side in self._subs.get(upstream, ()):
             outs = []
             for c in chunks:
-                outs.extend(p.push(c))
+                outs.extend(self._push_into(sub, c, side))
             self._route(sub, outs)
 
     def register_state(self, obj) -> None:
@@ -227,6 +252,10 @@ class StreamingRuntime:
         """Inject one barrier into every fragment; commit a checkpoint
         every ``checkpoint_frequency``-th barrier. Returns each
         fragment's emitted chunks."""
+        with self.lock:
+            return self._barrier_locked()
+
+    def _barrier_locked(self) -> Dict[str, List[StreamChunk]]:
         t0 = time.perf_counter()
         prev, self._epoch = self._epoch, self.next_epoch()
         self._barrier_seq += 1
@@ -288,12 +317,15 @@ class StreamingRuntime:
         """Barrier iff ``barrier_interval_ms`` elapsed since the last
         one (ScheduledBarriers min-interval tick). Returns whether a
         barrier fired."""
-        now = time.time()
-        if (now - self._last_barrier_at) * 1000 < self.barrier_interval_ms:
-            return False
-        self._last_barrier_at = now
-        self.barrier()
-        return True
+        with self.lock:
+            now = time.time()
+            if (
+                now - self._last_barrier_at
+            ) * 1000 < self.barrier_interval_ms:
+                return False
+            self._last_barrier_at = now
+            self.barrier()
+            return True
 
     def p99_barrier_ms(self) -> float:
         if not self.barrier_latencies_ms:

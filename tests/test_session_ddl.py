@@ -1,0 +1,76 @@
+"""Session DDL/DML consistency: duplicate relations, MVs joining two
+tables (two-sided subscriptions), no double-delivery of INSERTs.
+
+Regressions for the r3 code-review findings on frontend/session.py.
+"""
+
+import pytest
+
+from risingwave_tpu.frontend.session import SqlSession
+from risingwave_tpu.sql import Catalog
+
+
+@pytest.fixture
+def session():
+    return SqlSession(Catalog({}), capacity=1 << 10)
+
+
+def test_duplicate_create_table_rejected(session):
+    session.execute("CREATE TABLE t (k BIGINT)")
+    with pytest.raises(ValueError, match="already exists"):
+        session.execute("CREATE TABLE t (k BIGINT)")
+    # the duplicate did not double the DML targets
+    session.execute("INSERT INTO t VALUES (1)")
+    out, tag = session.execute("SELECT k FROM t")
+    assert tag == "SELECT 1"
+    assert list(out["k"]) == [1]
+
+
+def test_duplicate_mv_rejected(session):
+    session.execute("CREATE TABLE t (k BIGINT)")
+    session.execute(
+        "CREATE MATERIALIZED VIEW m AS "
+        "SELECT k, count(*) AS n FROM t GROUP BY k"
+    )
+    with pytest.raises(ValueError, match="already exists"):
+        session.execute(
+            "CREATE MATERIALIZED VIEW m AS "
+            "SELECT k, count(*) AS n FROM t GROUP BY k"
+        )
+    # graph stayed consistent: barriers and inserts still work
+    session.execute("INSERT INTO t VALUES (3)")
+    out, _ = session.execute("SELECT k, n FROM m")
+    assert list(out["k"]) == [3] and list(out["n"]) == [1]
+
+
+def test_mv_joining_two_tables(session):
+    """A join MV over two CREATE TABLEs: both sides must subscribe to
+    their table's delta edge (left/right), and later INSERTs into
+    either table must update the join."""
+    session.execute("CREATE TABLE a (k BIGINT, x BIGINT)")
+    session.execute("CREATE TABLE b (kk BIGINT, y BIGINT)")
+    session.execute("INSERT INTO a VALUES (1, 10), (2, 20)")
+    session.execute("INSERT INTO b VALUES (1, 7)")
+    session.execute(
+        "CREATE MATERIALIZED VIEW j AS "
+        "SELECT l.k, l.xs, r.ys FROM "
+        "(SELECT k, sum(x) AS xs FROM a GROUP BY k) AS l "
+        "JOIN "
+        "(SELECT kk, sum(y) AS ys FROM b GROUP BY kk) AS r "
+        "ON l.k = r.kk"
+    )
+    out, _ = session.execute("SELECT k, xs, ys FROM j")
+    assert list(out["k"]) == [1]
+    assert list(out["xs"]) == [10] and list(out["ys"]) == [7]
+
+    # delta on the LEFT side: sum retracts 10, inserts 15
+    session.execute("INSERT INTO a VALUES (1, 5)")
+    out, _ = session.execute("SELECT k, xs, ys FROM j")
+    assert list(out["k"]) == [1] and list(out["xs"]) == [15]
+
+    # delta on the RIGHT side: new key joins existing left row
+    session.execute("INSERT INTO b VALUES (2, 3)")
+    out, _ = session.execute("SELECT k, xs, ys FROM j ORDER BY k")
+    assert list(out["k"]) == [1, 2]
+    assert list(out["xs"]) == [15, 20]
+    assert list(out["ys"]) == [7, 3]
