@@ -78,6 +78,17 @@ def cpu_actor_q8(stream, window_ms):
     return n_rows / dt, out
 
 
+def _state_cap(expected_rows: int, floor: int) -> int:
+    """Table capacity whose growth margin covers the expected volume:
+    growth REBUILDS tables at new capacities, and every new capacity
+    recompiles the fused step programs (~30s each on TPU) — size state
+    up front so a bench run never grows mid-flight."""
+    cap = floor
+    while expected_rows * 2.5 > cap:
+        cap *= 2
+    return cap
+
+
 def bench_q8(gen_cfg, epochs, events_per_epoch, chunk_events):
     """Returns the q8 result dict (device run + CPU actor baseline)."""
     import jax
@@ -153,12 +164,15 @@ def bench_q8(gen_cfg, epochs, events_per_epoch, chunk_events):
         ]
 
     chunks = dev_chunks()
-    q8 = build_q8(capacity=1 << 16, fanout=8, out_cap=1 << 14)
+    # q8 state accumulates across the run (no watermarks driven here):
+    # persons+auctions ~8%% of events, all retained
+    c8 = _state_cap(int(epochs * events_per_epoch * 0.09), 1 << 16)
+    q8 = build_q8(capacity=c8, fanout=8, out_cap=1 << 14)
     # warmup epoch compiles every kernel, then fresh state + warm caches
     for side, c in chunks[0]:
         (q8.pipeline.push_left if side == "p" else q8.pipeline.push_right)(c)
     q8.pipeline.barrier()
-    q8 = build_q8(capacity=1 << 16, fanout=8, out_cap=1 << 14)
+    q8 = build_q8(capacity=c8, fanout=8, out_cap=1 << 14)
 
     barrier_times = []
     t0 = time.perf_counter()
@@ -271,11 +285,20 @@ def bench_q7(gen_cfg, epochs, events_per_epoch, chunk_events):
         jax.block_until_ready(q7.join.left.row_valid)
         return time.perf_counter() - t0, barrier_times
 
-    q7 = build_q7(capacity=1 << 16, fanout=16, out_cap=1 << 14)
+    # watermarks bound q7 state to open windows, but the growth
+    # heuristic is volume-driven: margin must cover one epoch's pushes
+    c7 = _state_cap(events_per_epoch, 1 << 16)
+    mk_q7 = lambda: build_q7(
+        capacity=c7,
+        fanout=16,
+        out_cap=1 << 14,
+        agg_capacity=c7,
+        filter_capacity=c7,
+    )
+    q7 = mk_q7()
     run(q7, mk()[:1])  # warmup epoch: compile everything
-    from risingwave_tpu.queries.nexmark_q import build_q7 as _b
 
-    q7 = _b(capacity=1 << 16, fanout=16, out_cap=1 << 14)
+    q7 = mk_q7()
     dt, barrier_times = run(q7, mk())
 
     got = q7.mview.snapshot()
@@ -361,8 +384,10 @@ def bench_q5(args_epochs, events_per_epoch, chunk_events, smoke, agg_mode):
         out_start="window_start",
     )
 
+    c5 = _state_cap(2 * events_per_epoch, 1 << 18)
+
     def run_q5(epochs_chunks):
-        q5 = build_q5_lite(capacity=1 << 18, state_cleaning=False)
+        q5 = build_q5_lite(capacity=c5, state_cleaning=False)
         barrier_times = []
         t0 = time.perf_counter()
         for stacked in epochs_chunks:

@@ -18,6 +18,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Barrier, Executor, Watermark
@@ -26,6 +27,7 @@ from risingwave_tpu.ops.hash_table import (
     first_occurrence_mask,
     lookup_or_insert,
     plan_rehash,
+    read_scalars,
     set_live,
 )
 from risingwave_tpu.storage.state_table import (
@@ -123,9 +125,10 @@ class AppendOnlyDedupExecutor(Executor, Checkpointable):
         cap = self.table.capacity
         if self._bound + incoming <= cap * GROW_AT:
             return
-        claimed = int(self.table.occupancy())
-        survivors = int(
-            jnp.sum((self.table.live | self.sdirty).astype(jnp.int32))
+        # ONE packed read: tunneled-TPU round-trips dominate
+        claimed, survivors = read_scalars(
+            self.table.occupancy(),
+            jnp.sum((self.table.live | self.sdirty).astype(jnp.int32)),
         )
         new_cap = plan_rehash(cap, incoming, claimed, survivors, GROW_AT)
         if new_cap is not None:
@@ -136,9 +139,15 @@ class AppendOnlyDedupExecutor(Executor, Checkpointable):
         self._bound = claimed
 
     def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
-        if bool(self._saw_delete):
+        # ONE packed read for both latches + occupancy (refreshes the
+        # growth bound for free, same discipline as HashAgg.on_barrier)
+        saw_delete, dropped, claimed = read_scalars(
+            self._saw_delete, self._dropped, self.table.occupancy()
+        )
+        self._bound = int(claimed)
+        if saw_delete:
             raise RuntimeError("append-only dedup received a DELETE")
-        if bool(self._dropped):
+        if dropped:
             raise RuntimeError("dedup table overflowed MAX_PROBE; grow capacity")
         return []
 

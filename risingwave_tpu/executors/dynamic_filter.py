@@ -26,6 +26,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Barrier, Executor, Watermark
@@ -33,6 +34,7 @@ from risingwave_tpu.ops.hash_table import (
     HashTable,
     lookup_or_insert,
     plan_rehash,
+    read_scalars,
     set_live,
 )
 from risingwave_tpu.storage.state_table import (
@@ -153,9 +155,10 @@ class DynamicMaxFilterExecutor(Executor, Checkpointable):
         cap = self.table.capacity
         if self._bound + incoming <= cap * GROW_AT:
             return
-        claimed = int(self.table.occupancy())
-        survivors = int(
-            jnp.sum((self.table.live | self.sdirty).astype(jnp.int32))
+        # ONE packed read: tunneled-TPU round-trips dominate
+        claimed, survivors = read_scalars(
+            self.table.occupancy(),
+            jnp.sum((self.table.live | self.sdirty).astype(jnp.int32)),
         )
         new_cap = plan_rehash(cap, incoming, claimed, survivors, GROW_AT)
         if new_cap is not None:
@@ -166,9 +169,14 @@ class DynamicMaxFilterExecutor(Executor, Checkpointable):
         self._bound = claimed
 
     def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
-        if bool(self._saw_delete):
+        # ONE packed read for both latches + occupancy (bound refresh)
+        saw_delete, dropped, claimed = read_scalars(
+            self._saw_delete, self._dropped, self.table.occupancy()
+        )
+        self._bound = int(claimed)
+        if saw_delete:
             raise RuntimeError("dynamic max filter received a DELETE")
-        if bool(self._dropped):
+        if dropped:
             raise RuntimeError(
                 "dynamic filter table overflowed MAX_PROBE; grow capacity"
             )

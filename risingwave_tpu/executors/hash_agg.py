@@ -48,6 +48,7 @@ from risingwave_tpu.ops.hash_table import (
     lookup,
     lookup_or_insert,
     plan_rehash,
+    read_scalars,
     set_live,
 )
 
@@ -567,25 +568,28 @@ class HashAggExecutor(Executor, Checkpointable):
         )
         return []
 
+    def _survivor_count(self):
+        """Device scalar: what a rebuild keeps (live | emitted | dirty |
+        sdirty — sdirty must count or pending-tombstone keys overflow
+        the new table)."""
+        return jnp.sum(
+            (
+                self.table.live
+                | self.state.emitted_valid
+                | self.state.dirty
+                | self.state.sdirty
+            ).astype(jnp.int32)
+        )
+
     def _maybe_grow(self, incoming: int):
         cap = self.table.capacity
         if self._insert_bound + incoming <= cap * GROW_AT:
             return
-        # refresh the bound with the true claimed count (one device read,
-        # off the hot path) before deciding to pay for a rebuild
-        claimed = int(self.table.occupancy())
-        # survivors = what the rebuild keeps (live | emitted | dirty |
-        # sdirty), not pre-rebuild occupancy — see plan_rehash; sdirty
-        # must count or pending-tombstone keys overflow the new table
-        keep = int(
-            jnp.sum(
-                (
-                    self.table.live
-                    | self.state.emitted_valid
-                    | self.state.dirty
-                    | self.state.sdirty
-                ).astype(jnp.int32)
-            )
+        # refresh the bound with the true claimed count before deciding
+        # to pay for a rebuild — ONE packed device read (every sync is a
+        # full round-trip on a tunneled TPU, ~100ms)
+        claimed, keep = read_scalars(
+            self.table.occupancy(), self._survivor_count()
         )
         new_cap = plan_rehash(cap, incoming, claimed, keep, GROW_AT)
         if new_cap is not None:
@@ -598,10 +602,17 @@ class HashAggExecutor(Executor, Checkpointable):
     # -- control ---------------------------------------------------------
     def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
         # ONE packed device read for all three latches (each bool() on a
-        # device scalar is a full round-trip on a tunneled TPU)
-        dropped, mret, mi_bad = np.asarray(
-            jnp.stack([self.dropped, self.state.minmax_retracted, self.mi_bad])
-        ).tolist()
+        # device scalar is a full round-trip on a tunneled TPU). The
+        # true occupancy piggybacks on the same transfer, refreshing
+        # _insert_bound so the NEXT epoch's _maybe_grow usually decides
+        # from this cached value without its own round-trip.
+        dropped, mret, mi_bad, claimed = read_scalars(
+            self.dropped,
+            self.state.minmax_retracted,
+            self.mi_bad,
+            self.table.occupancy(),
+        )
+        self._insert_bound = int(claimed)
         if dropped:
             raise RuntimeError(
                 "hash table overflowed MAX_PROBE mid-epoch; grow capacity"
@@ -755,10 +766,14 @@ class HashAggExecutor(Executor, Checkpointable):
         else:
             # every emitted row sits in the first 2*n_take slots (dirty
             # slots compact to the front); slice before transfer so the
-            # device->host copy is O(emitted), pow2-padded to bound the
-            # number of distinct slice programs
-            pad = max(2, 1 << max(0, (2 * n_take - 1)).bit_length())
-            pad = min(pad, 2 * self.out_cap)
+            # device->host copy is O(emitted). Quantize to exactly TWO
+            # capacities (small | full): every DOWNSTREAM device program
+            # (device MV step, join step) compiles once per distinct
+            # input capacity — pow2 bucketing here caused a recompile
+            # (~30s on TPU) on first sight of each bucket.
+            full = 2 * self.out_cap
+            small = min(256, full)
+            pad = small if 2 * n_take <= small else full
             sl = lambda a: a[:pad]
         cols, nulls = {}, {}
         i = 0

@@ -38,7 +38,7 @@ import jax.numpy as jnp
 
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Barrier, Executor, Watermark
-from risingwave_tpu.ops.hash_table import plan_rehash
+from risingwave_tpu.ops.hash_table import plan_rehash, read_scalars
 from risingwave_tpu.ops.hash_table import lookup_or_insert, set_live
 from risingwave_tpu.storage.state_table import (
     Checkpointable,
@@ -400,9 +400,10 @@ class HashJoinExecutor(Executor, Checkpointable):
         cap = own.capacity
         if self._bound[side] + incoming <= cap * GROW_AT:
             return own
-        claimed = int(own.table.occupancy())
-        survivors = int(
-            jnp.sum((own.table.live | own.sdirty).astype(jnp.int32))
+        # ONE packed read: tunneled-TPU round-trips dominate
+        claimed, survivors = read_scalars(
+            own.table.occupancy(),
+            jnp.sum((own.table.live | own.sdirty).astype(jnp.int32)),
         )
         new_cap = plan_rehash(cap, incoming, claimed, survivors, GROW_AT)
         if new_cap is not None:
@@ -416,18 +417,19 @@ class HashJoinExecutor(Executor, Checkpointable):
         import numpy as np
 
         # ONE packed device read for all five latches (tunneled-TPU
-        # round-trips dominate small barriers)
-        em, lo, li, ro, ri = np.asarray(
-            jnp.stack(
-                [
-                    self._em_overflow,
-                    self.left.overflow,
-                    self.left.inconsistent,
-                    self.right.overflow,
-                    self.right.inconsistent,
-                ]
-            )
-        ).tolist()
+        # round-trips dominate small barriers); both sides' occupancy
+        # piggybacks to refresh the growth bounds for free
+        em, lo, li, ro, ri, cl, cr = read_scalars(
+            self._em_overflow,
+            self.left.overflow,
+            self.left.inconsistent,
+            self.right.overflow,
+            self.right.inconsistent,
+            self.left.table.occupancy(),
+            self.right.table.occupancy(),
+        )
+        self._bound["l"] = int(cl)
+        self._bound["r"] = int(cr)
         if em:
             raise RuntimeError(
                 "join emission overflowed out_cap within one chunk; "

@@ -335,3 +335,331 @@ class MaterializeExecutor(Executor, Checkpointable):
                 for j in range(len(self.columns))
             )
             self.rows[k] = v
+
+
+# ---------------------------------------------------------------------------
+# Device-resident MV (the TPU-first materialize)
+# ---------------------------------------------------------------------------
+
+import jax
+import jax.numpy as jnp
+from dataclasses import dataclass, field as dc_field
+from functools import partial
+
+from risingwave_tpu.ops.hash_table import (
+    HashTable,
+    last_occurrence_mask,
+    lookup_or_insert,
+    plan_rehash,
+    read_scalars,
+)
+from risingwave_tpu.storage.state_table import (
+    grow_pow2,
+    pull_rows,
+    stage_marks,
+)
+
+GROW_AT = 0.5
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class MvDeviceState:
+    """Value lanes + checkpoint marks, slot-indexed next to the pk table."""
+
+    values: dict  # name -> (capacity,) lane
+    vnulls: dict  # name -> (capacity,) bool lane (SQL NULL)
+    sdirty: jnp.ndarray  # touched since last checkpoint stage
+    stored: jnp.ndarray  # durable in the state store
+    dropped: jnp.ndarray  # bool scalar: overflow latch
+
+    def tree_flatten(self):
+        vn = tuple(sorted(self.values))
+        nn = tuple(sorted(self.vnulls))
+        children = (
+            tuple(self.values[k] for k in vn)
+            + tuple(self.vnulls[k] for k in nn)
+            + (self.sdirty, self.stored, self.dropped)
+        )
+        return children, (vn, nn)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        vn, nn = aux
+        values = dict(zip(vn, children[: len(vn)]))
+        vnulls = dict(zip(nn, children[len(vn) : len(vn) + len(nn)]))
+        sdirty, stored, dropped = children[-3:]
+        return cls(values, vnulls, sdirty, stored, dropped)
+
+
+@partial(jax.jit, static_argnames=("pk", "cols"), donate_argnums=(0, 1))
+def _mv_step(table, state, chunk, pk, cols):
+    """One chunk applied to the device MV: find-or-insert pk, last row
+    per pk wins (Overwrite conflict behavior), deletes flip live off.
+    Entirely on device — zero host syncs (the tunneled-TPU contract)."""
+    keys = tuple(chunk.col(k) for k in pk)
+    table, slots, found, inserted = lookup_or_insert(table, keys, chunk.valid)
+    dropped = state.dropped | jnp.any(chunk.valid & (slots < 0))
+    last = last_occurrence_mask(slots, chunk.valid)
+    is_del = (chunk.ops == 1) | (chunk.ops == 2)  # DELETE | UPDATE_DELETE
+    cap = table.capacity
+    lidx = jnp.where(last, slots, cap)
+    live = table.live.at[lidx].set(~is_del, mode="drop")
+    table = HashTable(table.fp1, table.fp2, table.keys, live)
+    uidx = jnp.where(last & ~is_del, slots, cap)
+    values = {
+        c: state.values[c].at[uidx].set(
+            chunk.col(c).astype(state.values[c].dtype), mode="drop"
+        )
+        for c in cols
+    }
+    vnulls = {
+        c: state.vnulls[c].at[uidx].set(chunk.null_of(c), mode="drop")
+        for c in state.vnulls
+    }
+    sdirty = state.sdirty.at[lidx].set(True, mode="drop")
+    return table, MvDeviceState(values, vnulls, sdirty, state.stored, dropped)
+
+
+@partial(jax.jit, static_argnames=("new_cap",), donate_argnums=())
+def _mv_rebuild(table, state, new_cap):
+    """Re-insert surviving slots into a fresh table (host-decided
+    capacity; the TPU analogue of growing the MV cache)."""
+    keep = table.live | state.sdirty | state.stored
+    new_table = HashTable.create(new_cap, tuple(k.dtype for k in table.keys))
+    new_table, slots, _, _ = lookup_or_insert(new_table, table.keys, keep)
+    idx = jnp.where(keep, slots, new_cap)
+    live = new_table.live.at[idx].set(table.live, mode="drop")
+    new_table = HashTable(new_table.fp1, new_table.fp2, new_table.keys, live)
+    put = lambda a: jnp.zeros(new_cap, a.dtype).at[idx].set(a, mode="drop")
+    values = {c: put(state.values[c]) for c in state.values}
+    vnulls = {c: put(state.vnulls[c]) for c in state.vnulls}
+    sdirty = jnp.zeros(new_cap, jnp.bool_).at[idx].set(state.sdirty, mode="drop")
+    stored = jnp.zeros(new_cap, jnp.bool_).at[idx].set(state.stored, mode="drop")
+    return new_table, MvDeviceState(
+        values, vnulls, sdirty, stored, jnp.zeros((), jnp.bool_)
+    )
+
+
+class DeviceMaterializeExecutor(Executor, Checkpointable):
+    """Device-resident MV: pk-keyed hash table + value lanes in HBM.
+
+    Reference: src/stream/src/executor/mview/materialize.rs:44 with
+    ConflictBehavior::Overwrite (:192-230). The host-map backends above
+    pull every chunk to the host — on a tunneled TPU that is ~100ms per
+    chunk; this executor applies deltas entirely on device and reaches
+    the host only at snapshot/checkpoint time (the "columnar MV staged
+    in HBM" north star, BASELINE.md).
+
+    Schema constraint: pk and value lanes must be fixed-width device
+    dtypes (ints/floats/bool — varchar/jsonb ride their dictionary
+    codes). NULLs in VALUE columns ride per-column null lanes; NULL pk
+    components are not supported (the reference serializes a null tag;
+    here use the host-map executor for nullable pks).
+    """
+
+    def __init__(
+        self,
+        pk,
+        columns,
+        schema_dtypes,
+        table_id: str = "mview",
+        capacity: int = 1 << 16,
+        nullable=(),
+    ):
+        self.pk = tuple(pk)
+        self.columns = tuple(columns)
+        self.table_id = table_id
+        self.dtypes = {n: jnp.dtype(schema_dtypes[n]) for n in pk + tuple(columns)}
+        self.table = HashTable.create(
+            capacity, tuple(self.dtypes[k] for k in self.pk)
+        )
+        self.state = MvDeviceState(
+            values={
+                c: jnp.zeros(capacity, self.dtypes[c]) for c in self.columns
+            },
+            vnulls={
+                c: jnp.zeros(capacity, jnp.bool_)
+                for c in nullable
+                if c in self.columns
+            },
+            sdirty=jnp.zeros(capacity, jnp.bool_),
+            stored=jnp.zeros(capacity, jnp.bool_),
+            dropped=jnp.zeros((), jnp.bool_),
+        )
+        self._bound = 0
+        self.checkpoint_enabled = False
+
+    # -- data -------------------------------------------------------------
+    def apply(self, chunk: StreamChunk):
+        self._maybe_grow(chunk.capacity)
+        self.table, self.state = _mv_step(
+            self.table, self.state, chunk, self.pk, self.columns
+        )
+        self._bound += chunk.capacity
+        return [chunk]
+
+    def _maybe_grow(self, incoming: int) -> None:
+        cap = self.table.capacity
+        if self._bound + incoming <= cap * GROW_AT:
+            return
+        # ONE packed transfer for both counters (tunnel RTT dominates)
+        claimed, survivors = read_scalars(
+            self.table.occupancy(),
+            jnp.sum(
+                (
+                    self.table.live
+                    | self.state.sdirty
+                    | self.state.stored
+                ).astype(jnp.int32)
+            ),
+        )
+        new_cap = plan_rehash(cap, incoming, claimed, survivors, GROW_AT)
+        if new_cap is not None:
+            self.table, self.state = _mv_rebuild(
+                self.table, self.state, new_cap
+            )
+            claimed = survivors
+        self._bound = claimed
+
+    # -- control ----------------------------------------------------------
+    def on_barrier(self, barrier) -> list:
+        # ONE packed read: overflow latch + occupancy (the occupancy
+        # refreshes the growth bound so steady state has no mid-epoch
+        # refresh syncs — the bound heuristic assumes every incoming
+        # row is a new key; the true claimed count corrects it for free)
+        dropped, claimed = read_scalars(
+            self.state.dropped, self.table.occupancy()
+        )
+        self._bound = int(claimed)
+        if dropped:
+            raise RuntimeError(
+                "device MV hash table overflowed MAX_PROBE; grow capacity"
+            )
+        return []
+
+    def state_nbytes(self) -> int:
+        return sum(
+            leaf.nbytes
+            for leaf in jax.tree.leaves((self.table, self.state))
+        )
+
+    # -- reads ------------------------------------------------------------
+    def _host_rows(self):
+        live = np.asarray(self.table.live)
+        sel = np.flatnonzero(live)
+        lanes = {f"k{j}": k for j, k in enumerate(self.table.keys)}
+        lanes.update(
+            {f"v{j}": self.state.values[c] for j, c in enumerate(self.columns)}
+        )
+        lanes.update(
+            {f"n_{c}": lane for c, lane in self.state.vnulls.items()}
+        )
+        return sel, pull_rows(lanes, sel)
+
+    def snapshot(self):
+        """pk tuple -> value tuple (NULL -> None), matching the host-map
+        executors' interface. One bulk transfer, on demand."""
+        _, rows = self._host_rows()
+        n = len(rows["k0"]) if self.pk else 0
+        out = {}
+        for i in range(n):
+            k = tuple(rows[f"k{j}"][i].item() for j in range(len(self.pk)))
+            v = tuple(
+                None
+                if (f"n_{c}" in rows and rows[f"n_{c}"][i])
+                else rows[f"v{j}"][i].item()
+                for j, c in enumerate(self.columns)
+            )
+            out[k] = v
+        return out
+
+    def to_numpy(self):
+        _, rows = self._host_rows()
+        out = {}
+        for j, name in enumerate(self.pk):
+            out[name] = rows[f"k{j}"]
+        for j, name in enumerate(self.columns):
+            out[name] = rows[f"v{j}"]
+            if f"n_{name}" in rows:
+                out[name + "__null"] = rows[f"n_{name}"]
+        return out
+
+    # -- checkpoint/restore -----------------------------------------------
+    def checkpoint_delta(self):
+        sdirty = np.asarray(self.state.sdirty)
+        if not sdirty.any():
+            return []
+        alive = np.asarray(self.table.live)
+        stored = np.asarray(self.state.stored)
+        upsert, tomb, sel = stage_marks(sdirty, alive, stored)
+        if not len(sel):
+            self.state.sdirty = jnp.zeros_like(self.state.sdirty)
+            return []
+        lanes = {f"k{j}": k for j, k in enumerate(self.table.keys)}
+        lanes.update(
+            {f"v{j}": self.state.values[c] for j, c in enumerate(self.columns)}
+        )
+        lanes.update(
+            {f"n_{c}": lane for c, lane in self.state.vnulls.items()}
+        )
+        rows = pull_rows(lanes, sel)
+        key_cols = {f"k{j}": rows[f"k{j}"] for j in range(len(self.pk))}
+        value_cols = {
+            f"v{j}": rows[f"v{j}"] for j in range(len(self.columns))
+        }
+        for c in self.state.vnulls:
+            value_cols[f"n_{c}"] = rows[f"n_{c}"].astype(np.uint8)
+        tombstone = tomb[sel]
+        # eager mark flip (same discipline as the other executors: the
+        # runtime stages on the main thread before the async commit)
+        dev_sel = jnp.asarray(sel.astype(np.int32))
+        self.state.stored = (
+            self.state.stored.at[dev_sel].set(jnp.asarray(upsert[sel]))
+        )
+        self.state.sdirty = jnp.zeros_like(self.state.sdirty)
+        return [
+            StateDelta(
+                self.table_id,
+                key_cols,
+                value_cols,
+                tombstone,
+                tuple(f"k{j}" for j in range(len(self.pk))),
+            )
+        ]
+
+    def restore_state(self, table_id, key_cols, value_cols):
+        n = len(next(iter(key_cols.values()))) if key_cols else 0
+        cap = grow_pow2(n, 1 << 10, GROW_AT)
+        self.table = HashTable.create(
+            cap, tuple(self.dtypes[k] for k in self.pk)
+        )
+        self.state = MvDeviceState(
+            values={c: jnp.zeros(cap, self.dtypes[c]) for c in self.columns},
+            vnulls={c: jnp.zeros(cap, jnp.bool_) for c in self.state.vnulls},
+            sdirty=jnp.zeros(cap, jnp.bool_),
+            stored=jnp.zeros(cap, jnp.bool_),
+            dropped=jnp.zeros((), jnp.bool_),
+        )
+        self._bound = 0
+        if n == 0:
+            return
+        cols = {
+            name: np.asarray(key_cols[f"k{j}"]).astype(self.dtypes[name])
+            for j, name in enumerate(self.pk)
+        }
+        nulls = {}
+        for j, name in enumerate(self.columns):
+            cols[name] = np.asarray(value_cols[f"v{j}"]).astype(
+                self.dtypes[name]
+            )
+            if f"n_{name}" in value_cols:
+                nulls[name] = np.asarray(value_cols[f"n_{name}"]).astype(bool)
+        chunk = StreamChunk.from_numpy(cols, cap, nulls=nulls or None)
+        self.table, self.state = _mv_step(
+            self.table, self.state, chunk, self.pk, self.columns
+        )
+        # restored rows are durable, not dirty
+        self.state.stored = self.state.sdirty
+        self.state.sdirty = jnp.zeros_like(self.state.sdirty)
+        self._bound = n

@@ -278,6 +278,18 @@ def set_live(table: HashTable, slots: jnp.ndarray, live_value: jnp.ndarray) -> H
     return HashTable(table.fp1, table.fp2, table.keys, new_live)
 
 
+def read_scalars(*xs) -> list:
+    """ONE packed device->host read of several scalars (latches,
+    occupancy counters). On a tunneled TPU every sync is a full
+    round-trip (~100ms), so every barrier/growth check packs its
+    scalars into a single transfer through this helper."""
+    import numpy as np
+
+    return np.asarray(
+        jnp.stack([jnp.asarray(x).astype(jnp.int64) for x in xs])
+    ).tolist()
+
+
 def plan_rehash(
     cap: int, incoming: int, claimed: int, survivors: int, grow_at: float = 0.5
 ):
@@ -297,6 +309,14 @@ def plan_rehash(
     while survivors + incoming > new_cap * grow_at:
         new_cap *= 2
     return new_cap
+
+
+def last_occurrence_mask(slots: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """True for the LAST valid row of each distinct slot in the batch —
+    pk-conflict "last write wins" (materialize.rs:192 Overwrite) needs a
+    deterministic winner; XLA scatter picks an arbitrary one among
+    duplicate indices."""
+    return first_occurrence_mask(slots[::-1], valid[::-1])[::-1]
 
 
 def first_occurrence_mask(slots: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:

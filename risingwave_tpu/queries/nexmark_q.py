@@ -26,6 +26,7 @@ from risingwave_tpu.executors import (
     HopWindowExecutor,
     MaterializeExecutor,
 )
+from risingwave_tpu.executors.materialize import DeviceMaterializeExecutor
 from risingwave_tpu.ops.agg import AggCall
 from risingwave_tpu.runtime import Pipeline, TwoInputPipeline
 
@@ -38,7 +39,7 @@ Q8_WINDOW_MS = 10_000
 class Q5Lite:
     pipeline: Pipeline
     agg: HashAggExecutor
-    mview: MaterializeExecutor
+    mview: object  # Materialize or DeviceMaterialize
 
 
 def build_q5_lite(
@@ -73,8 +74,18 @@ def build_q5_lite(
         # future row), so windows below it are closed as-is: retention 0
         window_key=("window_start", 0, False) if state_cleaning else None,
     )
-    mview = MaterializeExecutor(
-        pk=("auction", "window_start"), columns=("num",), table_id="q5.mview"
+    # device-resident MV: the host-map executor pulls every flush chunk
+    # over the tunnel (~100ms/chunk); this one stays in HBM end to end
+    mview = DeviceMaterializeExecutor(
+        pk=("auction", "window_start"),
+        columns=("num",),
+        schema_dtypes={
+            "auction": jnp.int64,
+            "window_start": jnp.int64,
+            "num": jnp.int64,
+        },
+        table_id="q5.mview",
+        capacity=max(1 << 12, capacity),
     )
     return Q5Lite(Pipeline([hop, agg, mview]), agg, mview)
 
@@ -83,7 +94,7 @@ def build_q5_lite(
 class Q8:
     pipeline: TwoInputPipeline
     join: HashJoinExecutor
-    mview: MaterializeExecutor
+    mview: object  # Materialize or DeviceMaterialize
 
 
 def build_q8(
@@ -147,8 +158,16 @@ def build_q8(
         window_cols=("starttime", "astarttime") if state_cleaning else None,
         table_id="q8.join",
     )
-    mview = MaterializeExecutor(
-        pk=("id", "starttime"), columns=("name",), table_id="q8.mview"
+    mview = DeviceMaterializeExecutor(
+        pk=("id", "starttime"),
+        columns=("name",),
+        schema_dtypes={
+            "id": jnp.int64,
+            "starttime": jnp.int64,
+            "name": jnp.int32,
+        },
+        table_id="q8.mview",
+        capacity=max(1 << 12, capacity),
     )
     pipeline = TwoInputPipeline(person_chain, auction_chain, join, [mview])
     return Q8(pipeline, join, mview)
@@ -159,7 +178,7 @@ class Q7:
     pipeline: TwoInputPipeline
     join: HashJoinExecutor
     agg: HashAggExecutor
-    mview: MaterializeExecutor
+    mview: object  # Materialize or DeviceMaterialize
 
 
 def build_q7(
@@ -168,6 +187,8 @@ def build_q7(
     out_cap: int = 1 << 14,
     window_ms: int = 10_000,
     state_cleaning: bool = True,
+    agg_capacity: Optional[int] = None,
+    filter_capacity: Optional[int] = None,
 ) -> Q7:
     """Highest bid per 10s tumble window (Nexmark q7, e2e_test/nexmark/).
 
@@ -201,7 +222,10 @@ def build_q7(
             group_col="wstart",
             value_col="price",
             schema_dtypes={"wstart": jnp.int64, "price": jnp.int64},
-            capacity=max(1 << 10, capacity >> 6),
+            # growth REBUILDS the table at a new capacity, which
+            # recompiles every fused program touching it (~30s each on
+            # TPU) — callers that know their volume size this up front
+            capacity=filter_capacity or max(1 << 10, capacity >> 6),
             window_key=("wstart", 0) if state_cleaning else None,
             table_id="q7.maxfilter",
         ),
@@ -212,7 +236,7 @@ def build_q7(
             group_keys=("mwstart",),
             calls=(AggCall("max", "price", "maxprice"),),
             schema_dtypes={"mwstart": jnp.int64, "price": jnp.int64},
-            capacity=max(1 << 12, capacity >> 4),
+            capacity=agg_capacity or max(1 << 12, capacity >> 4),
             window_key=("mwstart", 0, False) if state_cleaning else None,
             table_id="q7.maxagg",
         ),
@@ -237,9 +261,17 @@ def build_q7(
         window_cols=("wstart", "mwstart") if state_cleaning else None,
         table_id="q7.join",
     )
-    mview = MaterializeExecutor(
-        pk=("wstart", "auction", "bidder"), columns=("price",),
+    mview = DeviceMaterializeExecutor(
+        pk=("wstart", "auction", "bidder"),
+        columns=("price",),
+        schema_dtypes={
+            "wstart": jnp.int64,
+            "auction": jnp.int64,
+            "bidder": jnp.int64,
+            "price": jnp.int64,
+        },
         table_id="q7.mview",
+        capacity=max(1 << 12, capacity),
     )
     pipeline = TwoInputPipeline(left_chain, right_chain, join, [mview])
     agg = right_chain[1]

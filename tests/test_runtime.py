@@ -92,13 +92,22 @@ def test_runtime_checkpoint_frequency():
 
 def test_runtime_tick_paces_barriers():
     rt = StreamingRuntime(None, barrier_interval_ms=50)
-    q5 = build_q5_lite(capacity=1 << 10, state_cleaning=False)
+    # sized so the table never grows inside the timed window: a growth
+    # rebuild legitimately recompiles the agg programs (~seconds) and
+    # this test is about tick pacing, not compile latency
+    q5 = build_q5_lite(capacity=1 << 14, state_cleaning=False)
     rt.register("q5", q5.pipeline)
     gen = NexmarkGenerator(NexmarkConfig())
     # warm the jit caches so compile time doesn't eat the tick window
     bid = gen.next_chunks(200, 256)["bid"]
     q5.pipeline.push(bid.select(["auction", "date_time"]))
-    rt.barrier()
+    # three warm barriers: flush + device-MV + packed-latch programs
+    # compile across the first couple of barriers, not just the first
+    for _ in range(3):
+        bid = gen.next_chunks(200, 256)["bid"]
+        if bid is not None:
+            q5.pipeline.push(bid.select(["auction", "date_time"]))
+        rt.barrier()
     fired = 0
     t_end = time.time() + 0.55
     while time.time() < t_end:
