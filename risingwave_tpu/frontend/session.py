@@ -21,7 +21,7 @@ from risingwave_tpu.batch.engine import BatchQueryEngine
 from risingwave_tpu.runtime import DmlManager, StreamingRuntime
 from risingwave_tpu.sql import Catalog, StreamPlanner
 from risingwave_tpu.sql import parser as P
-from risingwave_tpu.types import DataType, Schema
+from risingwave_tpu.types import DataType, Field, Schema
 
 _TYPE_WORDS = {
     "int": DataType.INT32, "integer": DataType.INT32, "int4": DataType.INT32,
@@ -31,7 +31,32 @@ _TYPE_WORDS = {
     "boolean": DataType.BOOLEAN, "bool": DataType.BOOLEAN,
     "timestamp": DataType.TIMESTAMP,
     "varchar": DataType.VARCHAR, "text": DataType.VARCHAR,
+    "decimal": DataType.DECIMAL, "numeric": DataType.DECIMAL,
+    "interval": DataType.INTERVAL,
+    "jsonb": DataType.JSONB, "json": DataType.JSONB,
 }
+
+
+def _parse_type_word(cname: str, tword: str):
+    """'decimal(10,2)' / 'varchar(64)' / plain words -> Field."""
+    base, _, args = tword.partition("(")
+    dt = _TYPE_WORDS.get(base.lower())
+    if dt is None:
+        raise ValueError(f"unknown type {tword!r}")
+    if dt.is_composite:
+        # interval/struct/list decompose into multiple device lanes;
+        # the SELECT result edge and the MV planner do not reassemble
+        # them yet — usable via the Python chunk API (array/composite),
+        # not via DDL (accepting them here made SELECT crash later)
+        raise NotImplementedError(
+            f"column {cname!r}: composite type {base.upper()} is not "
+            "SQL-addressable yet (supported via the Python chunk API)"
+        )
+    scale = None
+    if dt is DataType.DECIMAL and args:
+        parts = args.rstrip(")").split(",")
+        scale = int(parts[1]) if len(parts) > 1 else 0
+    return Field(cname, dt, scale=scale)
 
 
 class SqlSession:
@@ -41,11 +66,17 @@ class SqlSession:
         runtime: Optional[StreamingRuntime] = None,
         capacity: int = 1 << 14,
     ):
+        from risingwave_tpu.array.dictionary import StringDictionary
+
         self.catalog = catalog
         self.runtime = runtime or StreamingRuntime(store=None)
         self.planner = StreamPlanner(catalog, capacity=capacity)
         self.batch = BatchQueryEngine({})
-        self.dml = DmlManager(self.runtime, catalog)
+        # one session dictionary backs every VARCHAR/JSONB column: codes
+        # are equality-complete across relations, so joins/group-bys on
+        # strings compare codes (array/dictionary.py)
+        self.strings = StringDictionary()
+        self.dml = DmlManager(self.runtime, catalog, strings=self.strings)
 
     def execute(self, sql: str) -> Tuple[Dict[str, np.ndarray], str]:
         """Returns (result columns, command tag). Non-queries return an
@@ -61,27 +92,32 @@ class SqlSession:
                 or stmt.name in self.runtime.fragments
             ):
                 raise ValueError(f"relation {stmt.name!r} already exists")
-            fields = []
-            for cname, tword in stmt.columns:
-                dt = _TYPE_WORDS.get(tword.lower())
-                if dt is None:
-                    raise ValueError(f"unknown type {tword!r}")
-                fields.append((cname, dt))
+            fields = [
+                _parse_type_word(cname, tword)
+                for cname, tword in stmt.columns
+            ]
             schema = Schema(fields)
             self.catalog.tables[stmt.name] = schema
             # a table IS a materialized relation (create_table.rs makes
             # the same plan: dml -> row-id gen -> materialize): give it
             # a fragment so INSERTs land somewhere queryable and
             # downstream MVs backfill from its snapshot
+            from risingwave_tpu.array.composite import expand_field
             from risingwave_tpu.executors.materialize import (
                 MaterializeExecutor,
             )
             from risingwave_tpu.executors.row_id_gen import RowIdGenExecutor
             from risingwave_tpu.runtime import Pipeline
 
+            # composite columns (interval/struct/list) expand to their
+            # leaf device lanes; the MV stores lanes, the result edge
+            # reassembles values (array/composite.py)
+            lane_names = tuple(
+                ln for f in schema.fields for (ln, _) in expand_field(f)
+            )
             mview = MaterializeExecutor(
                 pk=("_row_id",),
-                columns=schema.names,
+                columns=lane_names,
                 table_id=f"{stmt.name}.table",
             )
             self.runtime.register(
@@ -124,6 +160,16 @@ class SqlSession:
                 self.runtime.unregister(planned.name)
                 raise
             self.catalog.add_mv(planned)
+            # overlay inferred LOGICAL types (decimal scale, varchar,
+            # jsonb) over the MV's physical schema so SELECTs over it
+            # decode correctly (sql/typing.py)
+            from risingwave_tpu.sql.typing import infer_output_fields
+
+            inferred = infer_output_fields(stmt.select, self.catalog)
+            sch = self.catalog.tables[planned.name]
+            self.catalog.tables[planned.name] = Schema(
+                tuple(inferred.get(f.name, f) for f in sch.fields)
+            )
             if len(frag_inputs) < len(planned.inputs):
                 self.dml.attach(planned, skip=frag_inputs.keys())
             self.batch.register(planned.name, planned.mview)
@@ -139,5 +185,57 @@ class SqlSession:
             self.runtime.barrier()
             return {}, f"INSERT 0 {n}"
         out = self.batch.query(sql)
+        out = self._decode_output(stmt, out)
         n = len(next(iter(out.values()))) if out else 0
         return out, f"SELECT {n}"
+
+    def _decode_output(self, stmt, out):
+        """Decode device lanes back to SQL values at the result edge:
+        DECIMAL scaled ints -> Decimal, VARCHAR/JSONB dictionary codes
+        -> strings/objects. Columns with no inferred logical type (or
+        plain numerics) pass through raw."""
+        from risingwave_tpu.array.composite import decode_column
+        from risingwave_tpu.sql.typing import infer_output_fields
+
+        fields = infer_output_fields(stmt, self.catalog)
+        decoded = {}
+        for name, arr in out.items():
+            if name.endswith("__null"):
+                continue
+            f = fields.get(name)
+            if f is not None and f.dtype in (
+                DataType.DECIMAL,
+                DataType.VARCHAR,
+                DataType.JSONB,
+            ):
+                nl = out.get(name + "__null")
+                raw = np.asarray(arr)
+                if raw.dtype == object:
+                    # python-backend MVs surface NULL as embedded None
+                    vals = raw.tolist()
+                    embedded = np.asarray([v is None for v in vals], bool)
+                    nl = embedded if nl is None else (np.asarray(nl) | embedded)
+                    raw = np.asarray(
+                        [0 if v is None else v for v in vals]
+                    )
+                decoded[name] = np.asarray(
+                    decode_column(
+                        Field(name, f.dtype, scale=f.scale),
+                        {name: raw.astype(f.dtype.device_dtype)},
+                        lambda _ln: nl,
+                        self.strings,
+                    ),
+                    dtype=object,
+                )
+            else:
+                decoded[name] = arr
+                nl = out.get(name + "__null")
+                if nl is not None:
+                    decoded[name] = np.asarray(
+                        [
+                            None if m else v
+                            for v, m in zip(np.asarray(arr).tolist(), nl)
+                        ],
+                        dtype=object,
+                    )
+        return decoded

@@ -18,10 +18,27 @@ from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.sql import parser as P
 
 
+def _coerce_value(field, v):
+    """SQL literal -> the python value composite.encode_column expects:
+    JSONB string literals parse as JSON text; everything else passes
+    through (decimal accepts int/float/str/Decimal natively)."""
+    from risingwave_tpu.types import DataType
+
+    if v is None:
+        return None
+    if field.dtype is DataType.JSONB and isinstance(v, str):
+        import json
+
+        return json.loads(v)
+    return v
+
+
 class DmlManager:
-    def __init__(self, runtime, catalog):
+    def __init__(self, runtime, catalog, strings=None):
         self.runtime = runtime
         self.catalog = catalog
+        # VARCHAR/JSONB dictionary shared with the session result edge
+        self.strings = strings
         # stream name -> [(fragment, side)]
         self._targets: Dict[str, List[Tuple[str, str]]] = {}
 
@@ -54,30 +71,31 @@ class DmlManager:
                 f"unknown columns {set(names) - set(schema.names)}"
             )
         n = len(stmt.rows)
-        cols: Dict[str, np.ndarray] = {}
-        nulls: Dict[str, np.ndarray] = {}
-        for j, name in enumerate(names):
-            field = schema.field(name)
-            vals = [r[j] for r in stmt.rows]
-            isnull = np.asarray([v is None for v in vals], bool)
-            dt = field.dtype.device_dtype
-            if field.dtype.value == "varchar":
-                raise NotImplementedError(
-                    f"DML into VARCHAR column {name!r} not supported yet "
-                    "(needs a session string dictionary)"
-                )
-            filled = np.asarray(
-                [0 if v is None else v for v in vals], dt
-            )
-            cols[name] = filled
-            if isnull.any():
-                nulls[name] = isnull
         missing = set(schema.names) - set(names)
         if missing:
             raise ValueError(
                 f"INSERT must supply all columns (missing {missing}); "
                 "column defaults are not implemented"
             )
+        from risingwave_tpu.array.composite import encode_rows
+        from risingwave_tpu.types import DataType
+
+        sub = schema.select(names)
+        for f in sub.fields:
+            if (
+                f.dtype in (DataType.VARCHAR, DataType.JSONB)
+                and self.strings is None
+            ):
+                raise ValueError(
+                    f"column {f.name!r} needs a session string dictionary"
+                )
+        rows = [
+            tuple(
+                _coerce_value(sub.fields[j], r[j]) for j in range(len(names))
+            )
+            for r in stmt.rows
+        ]
+        cols, nulls = encode_rows(sub, rows, self.strings)
         cap = max(2, 1 << (max(1, n) - 1).bit_length())
         chunk = StreamChunk.from_numpy(cols, cap, nulls=nulls or None)
         for frag, side in self._targets.get(stmt.table, ()):

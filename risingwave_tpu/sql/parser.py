@@ -224,7 +224,15 @@ class Parser:
                     t = self.next()
                     if t.kind not in ("ident", "kw"):
                         raise SyntaxError(f"expected a type, got {t.value!r}")
-                    cols.append((cname, t.value))
+                    tword = t.value
+                    # parameterized types: DECIMAL(10, 2), VARCHAR(64)
+                    if self.accept("op", "("):
+                        args = [self.expect("num").value]
+                        while self.accept("op", ","):
+                            args.append(self.expect("num").value)
+                        self.expect("op", ")")
+                        tword += "(" + ",".join(args) + ")"
+                    cols.append((cname, tword))
                     if not self.accept("op", ","):
                         break
                 self.expect("op", ")")

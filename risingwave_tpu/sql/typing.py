@@ -1,0 +1,80 @@
+"""Logical output-type inference for SELECT / CREATE MV.
+
+Reference: the binder/type-inference pass (src/frontend/src/binder/ +
+src/frontend/src/expr/type_inference/) — here a deliberately small,
+best-effort version: enough to know which output columns are DECIMAL /
+VARCHAR / JSONB / INTERVAL so the session can decode device lanes
+(scaled ints, dictionary codes) back to SQL values at the result edge.
+
+Columns whose type cannot be inferred (complex expressions) return no
+entry and surface as their raw device values.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from risingwave_tpu.sql import parser as P
+from risingwave_tpu.types import DataType, Field
+
+
+def _from_env(env: Dict[str, Field], name: str) -> Optional[Field]:
+    return env.get(name)
+
+
+def _env_of_rel(rel, catalog) -> Dict[str, Field]:
+    """Visible columns (name -> logical Field) of a FROM clause."""
+    if isinstance(rel, P.TableRef):
+        sch = catalog.tables.get(rel.name)
+        if sch is None:
+            return {}
+        return {f.name: f for f in sch.fields}
+    if isinstance(rel, P.Join):
+        env = _env_of_rel(rel.left, catalog)
+        env.update(_env_of_rel(rel.right, catalog))
+        return env
+    if isinstance(rel, P.SubQuery):
+        inner = infer_output_fields(rel.select, catalog)
+        return {n: Field(n, f.dtype, scale=f.scale) for n, f in inner.items()}
+    if isinstance(rel, P.WindowTVF):
+        env = _env_of_rel(rel.table, catalog)
+        # window columns are timestamps
+        for extra in ("window_start", "window_end"):
+            env.setdefault(extra, Field(extra, DataType.TIMESTAMP))
+        return env
+    return {}
+
+
+def infer_output_fields(stmt, catalog) -> Dict[str, Field]:
+    """Best-effort output column name -> logical Field for a Select."""
+    if not isinstance(stmt, P.Select):
+        return {}
+    env = _env_of_rel(stmt.from_, catalog) if stmt.from_ is not None else {}
+    out: Dict[str, Field] = {}
+    for i, item in enumerate(stmt.items):
+        expr = item.expr
+        if isinstance(expr, P.Ident):
+            f = _from_env(env, expr.name)
+            if f is not None:
+                name = item.alias or expr.name
+                out[name] = Field(name, f.dtype, scale=f.scale)
+            continue
+        if isinstance(expr, P.FuncCall):
+            name = item.alias or f"{expr.name}_{i}"
+            if expr.name in ("count",):
+                out[name] = Field(name, DataType.INT64)
+            elif expr.name in ("sum", "min", "max", "avg") and expr.args:
+                arg = expr.args[0]
+                if isinstance(arg, P.Ident):
+                    f = _from_env(env, arg.name)
+                    if f is not None:
+                        if expr.name == "avg":
+                            out[name] = Field(name, DataType.FLOAT64)
+                        else:
+                            # sum/min/max keep the argument's logical
+                            # type; DECIMAL keeps its scale (scaled-int
+                            # sums stay exact at the same scale)
+                            out[name] = Field(
+                                name, f.dtype, scale=f.scale
+                            )
+    return out

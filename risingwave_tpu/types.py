@@ -51,7 +51,26 @@ def op_sign(ops: jnp.ndarray) -> jnp.ndarray:
 
 
 class DataType(enum.Enum):
-    """Logical column types at the SQL/host edge."""
+    """Logical column types at the SQL/host edge.
+
+    Wider SQL types map onto fixed-width device lanes
+    (src/common/src/types/ has the same split between logical DataType
+    and physical array repr):
+    - DECIMAL(p, s) -> scaled int64 (value * 10^s); +,-,sum,compare run
+      directly on the scaled lane, exact (Field.scale carries s);
+    - INTERVAL -> two lanes, ``name.months`` int32 + ``name.usecs``
+      int64 (days folded into usecs; the reference keeps months apart
+      for calendar arithmetic, interval months are not a fixed usec
+      count);
+    - JSONB -> int32 dictionary code over the canonical JSON text
+      (sort_keys serialization => equality on codes IS jsonb equality);
+    - STRUCT -> one device lane per leaf field, named ``parent.child``
+      (columnar decomposition — idiomatic struct-of-arrays);
+    - LIST -> ``name.<i>`` element lanes padded to Field.list_cap plus
+      a ``name.#`` length lane (static shapes; ragged data is hostile
+      to XLA).
+    Composite expansion lives in array/composite.py.
+    """
 
     INT32 = "int32"
     INT64 = "int64"
@@ -60,10 +79,15 @@ class DataType(enum.Enum):
     BOOLEAN = "boolean"
     TIMESTAMP = "timestamp"  # ms since epoch, int64 on device
     VARCHAR = "varchar"  # dictionary-encoded int32 on device
+    DECIMAL = "decimal"  # scaled int64 on device (Field.scale)
+    INTERVAL = "interval"  # composite: months int32 + usecs int64
+    JSONB = "jsonb"  # dictionary-encoded canonical JSON, int32
+    STRUCT = "struct"  # composite: child lanes (Field.children)
+    LIST = "list"  # composite: padded element lanes (Field.elem/cap)
 
     @property
     def device_dtype(self) -> np.dtype:
-        return {
+        d = {
             DataType.INT32: np.dtype(np.int32),
             DataType.INT64: np.dtype(np.int64),
             DataType.FLOAT32: np.dtype(np.float32),
@@ -71,7 +95,18 @@ class DataType(enum.Enum):
             DataType.BOOLEAN: np.dtype(np.bool_),
             DataType.TIMESTAMP: np.dtype(np.int64),
             DataType.VARCHAR: np.dtype(np.int32),
-        }[self]
+            DataType.DECIMAL: np.dtype(np.int64),
+            DataType.JSONB: np.dtype(np.int32),
+        }.get(self)
+        if d is None:
+            raise TypeError(
+                f"{self} is composite: expand via array/composite.py"
+            )
+        return d
+
+    @property
+    def is_composite(self) -> bool:
+        return self in (DataType.INTERVAL, DataType.STRUCT, DataType.LIST)
 
     @property
     def null_value(self):
@@ -86,11 +121,57 @@ class DataType(enum.Enum):
 
 
 @dataclass(frozen=True)
+class Interval:
+    """SQL INTERVAL value (reference: src/common/src/types/interval.rs
+    keeps months/days/usecs; days fold into usecs here — no calendar
+    DST modelling on the dataflow plane)."""
+
+    months: int = 0
+    usecs: int = 0
+
+    @staticmethod
+    def of(months=0, days=0, hours=0, minutes=0, seconds=0, usecs=0):
+        return Interval(
+            months=months,
+            usecs=usecs
+            + int(seconds * 1_000_000)
+            + minutes * 60_000_000
+            + hours * 3_600_000_000
+            + days * 86_400_000_000,
+        )
+
+    def total_usecs(self) -> int:
+        """Fixed-usec view; months use the reference's 30-day estimate
+        (interval.rs comparison semantics)."""
+        return self.months * 30 * 86_400_000_000 + self.usecs
+
+
+@dataclass(frozen=True)
 class Field:
-    """A named, typed column in a schema."""
+    """A named, typed column in a schema.
+
+    Type parameters ride on the field (the reference puts them inside
+    DataType variants): ``scale`` for DECIMAL(p, s); ``children`` (a
+    Schema) for STRUCT; ``elem`` + ``list_cap`` for LIST.
+    """
 
     name: str
     dtype: DataType
+    scale: "int | None" = None
+    children: "Schema | None" = None
+    elem: "DataType | None" = None
+    list_cap: "int | None" = None
+
+    def __post_init__(self):
+        if self.dtype is DataType.DECIMAL and self.scale is None:
+            object.__setattr__(self, "scale", 6)  # pg-ish default
+        if self.dtype is DataType.STRUCT and self.children is None:
+            raise ValueError(f"STRUCT field {self.name!r} needs children")
+        if self.dtype is DataType.LIST:
+            if self.elem is None:
+                raise ValueError(f"LIST field {self.name!r} needs elem")
+            if self.list_cap is None:
+                object.__setattr__(self, "list_cap", 16)
 
     def __repr__(self) -> str:  # compact for schema dumps
         return f"{self.name}:{self.dtype.value}"

@@ -1,0 +1,180 @@
+"""Wide SQL types on fixed-width device lanes: decimal, interval,
+jsonb, struct, list — round-trips, SQL DDL/DML/SELECT, exactness.
+
+Reference: src/common/src/types/ (ScalarImpl variants) and the arrays
+in src/common/src/array/{struct_array,list_array,jsonb_array}.rs.
+"""
+
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.array.composite import (
+    decode_column,
+    encode_column,
+    encode_rows,
+    expand_field,
+)
+from risingwave_tpu.array.dictionary import StringDictionary
+from risingwave_tpu.frontend.session import SqlSession
+from risingwave_tpu.sql import Catalog
+from risingwave_tpu.types import DataType, Field, Interval, Schema
+
+
+def _roundtrip(field, values, strings=None):
+    lanes, nulls = encode_column(field, values, strings)
+    null_of = lambda ln: (nulls or {}).get(ln)
+    return decode_column(field, lanes, null_of, strings)
+
+
+def test_decimal_roundtrip_exact():
+    f = Field("amt", DataType.DECIMAL, scale=2)
+    vals = [Decimal("1.23"), Decimal("-0.01"), "99.99", 7, None]
+    got = _roundtrip(f, vals)
+    assert got == [
+        Decimal("1.23"),
+        Decimal("-0.01"),
+        Decimal("99.99"),
+        Decimal("7.00"),
+        None,
+    ]
+    # scaled-int lanes sum exactly (0.1 + 0.2 == 0.3, no float drift)
+    lanes, _ = encode_column(f, [Decimal("0.1"), Decimal("0.2")])
+    assert int(lanes["amt"].sum()) == 30  # 0.30 at scale 2
+
+
+def test_interval_roundtrip():
+    f = Field("dur", DataType.INTERVAL)
+    vals = [
+        Interval.of(months=2, days=1),
+        Interval.of(hours=3, seconds=1.5),
+        None,
+    ]
+    got = _roundtrip(f, vals)
+    assert got[0] == Interval(2, 86_400_000_000)
+    assert got[1] == Interval(0, 3 * 3_600_000_000 + 1_500_000)
+    assert got[2] is None
+    assert [ln for ln, _ in expand_field(f)] == ["dur.months", "dur.usecs"]
+
+
+def test_jsonb_roundtrip_and_equality_codes():
+    f = Field("doc", DataType.JSONB)
+    d = StringDictionary()
+    vals = [{"b": 1, "a": [1, 2]}, {"a": [1, 2], "b": 1}, None, 42]
+    lanes, nulls = encode_column(f, vals, d)
+    # canonical serialization: key order does not matter -> same code
+    assert lanes["doc"][0] == lanes["doc"][1]
+    got = decode_column(f, lanes, lambda ln: (nulls or {}).get(ln), d)
+    assert got[0] == {"a": [1, 2], "b": 1}
+    assert got[2] is None and got[3] == 42
+
+
+def test_struct_decomposes_to_child_lanes():
+    f = Field(
+        "addr",
+        DataType.STRUCT,
+        children=Schema([("zip", DataType.INT32), ("street", DataType.VARCHAR)]),
+    )
+    d = StringDictionary()
+    vals = [
+        {"zip": 94110, "street": "valencia"},
+        {"zip": 10001, "street": None},
+        None,
+    ]
+    lanes, nulls = encode_column(f, vals, d)
+    assert set(lanes) == {"addr.zip", "addr.street"}
+    got = decode_column(f, lanes, lambda ln: (nulls or {}).get(ln), d)
+    assert got[0] == {"zip": 94110, "street": "valencia"}
+    assert got[1]["zip"] == 10001 and got[1]["street"] is None
+    # NULL struct == all children NULL (no struct-level lane)
+    assert got[2] == {"zip": None, "street": None}
+
+
+def test_list_pads_to_cap_and_errors_past_it():
+    f = Field("xs", DataType.LIST, elem=DataType.INT64, list_cap=4)
+    vals = [[1, 2, 3], [], None, [9, 9, 9, 9]]
+    got = _roundtrip(f, vals)
+    assert got == [[1, 2, 3], [], None, [9, 9, 9, 9]]
+    with pytest.raises(ValueError, match="cap"):
+        encode_column(f, [[1, 2, 3, 4, 5]])
+
+
+def test_encode_rows_mixed_schema():
+    schema = Schema(
+        [
+            Field("k", DataType.INT64),
+            Field("amt", DataType.DECIMAL, scale=3),
+            Field("tag", DataType.VARCHAR),
+        ]
+    )
+    d = StringDictionary()
+    lanes, nulls = encode_rows(
+        schema, [(1, "2.5", "a"), (2, None, "b")], d
+    )
+    assert lanes["amt"].tolist() == [2500, 0]
+    assert nulls["amt"].tolist() == [False, True]
+    assert d.decode(lanes["tag"]).tolist() == ["a", "b"]
+
+
+# -- SQL surface ----------------------------------------------------------
+
+
+@pytest.fixture
+def session():
+    return SqlSession(Catalog({}), capacity=1 << 10)
+
+
+def test_sql_decimal_end_to_end(session):
+    session.execute("CREATE TABLE pay (uid BIGINT, amount DECIMAL(10,2))")
+    session.execute(
+        "INSERT INTO pay VALUES (1, 0.10), (1, 0.20), (2, 99.99)"
+    )
+    out, _ = session.execute("SELECT uid, amount FROM pay ORDER BY uid")
+    assert sorted(out["amount"][:2]) == [Decimal("0.10"), Decimal("0.20")]
+
+    # streaming MV: SUM over DECIMAL stays exact (no 0.30000000004)
+    session.execute(
+        "CREATE MATERIALIZED VIEW spend AS "
+        "SELECT uid, sum(amount) AS total FROM pay GROUP BY uid"
+    )
+    out, _ = session.execute("SELECT uid, total FROM spend ORDER BY uid")
+    assert list(out["total"]) == [Decimal("0.30"), Decimal("99.99")]
+
+    session.execute("INSERT INTO pay VALUES (1, 0.40)")
+    out, _ = session.execute("SELECT uid, total FROM spend ORDER BY uid")
+    assert out["total"][0] == Decimal("0.70")
+
+
+def test_sql_varchar_end_to_end(session):
+    session.execute("CREATE TABLE ev (name VARCHAR, n BIGINT)")
+    session.execute(
+        "INSERT INTO ev VALUES ('click', 1), ('view', 2), ('click', 3)"
+    )
+    out, _ = session.execute("SELECT name, n FROM ev ORDER BY n")
+    assert list(out["name"]) == ["click", "view", "click"]
+
+    session.execute(
+        "CREATE MATERIALIZED VIEW byname AS "
+        "SELECT name, count(*) AS c FROM ev GROUP BY name"
+    )
+    out, _ = session.execute("SELECT name, c FROM byname ORDER BY c DESC")
+    assert list(out["name"]) == ["click", "view"]
+    assert list(out["c"]) == [2, 1]
+
+
+def test_sql_jsonb_roundtrip(session):
+    session.execute("CREATE TABLE logs (id BIGINT, doc JSONB)")
+    session.execute(
+        'INSERT INTO logs VALUES (1, \'{"k": [1, 2]}\'), (2, NULL)'
+    )
+    out, _ = session.execute("SELECT id, doc FROM logs ORDER BY id")
+    assert out["doc"][0] == {"k": [1, 2]}
+    assert out["doc"][1] is None
+
+
+def test_sql_nulls_decode_as_none(session):
+    session.execute("CREATE TABLE t (k BIGINT, v BIGINT)")
+    session.execute("INSERT INTO t VALUES (1, NULL), (2, 5)")
+    out, _ = session.execute("SELECT k, v FROM t ORDER BY k")
+    assert out["v"][0] is None and out["v"][1] == 5
