@@ -34,6 +34,20 @@ from risingwave_tpu.types import DataType, Field, Interval
 LIST_LEN_SUFFIX = ".#"
 
 
+def _child_field(parent: Field, child: Field) -> Field:
+    """Child Field re-rooted under its parent's lane prefix — the one
+    place the prefixed reconstruction lives (expand/encode/decode all
+    route through it, so new Field parameters thread automatically)."""
+    return Field(
+        f"{parent.name}.{child.name}",
+        child.dtype,
+        scale=child.scale,
+        children=child.children,
+        elem=child.elem,
+        list_cap=child.list_cap,
+    )
+
+
 def expand_field(field: Field) -> List[Tuple[str, np.dtype]]:
     """Leaf device lanes (name, dtype) for one logical column."""
     dt = field.dtype
@@ -45,15 +59,7 @@ def expand_field(field: Field) -> List[Tuple[str, np.dtype]]:
     if dt is DataType.STRUCT:
         out: List[Tuple[str, np.dtype]] = []
         for child in field.children:
-            nested = Field(
-                f"{field.name}.{child.name}",
-                child.dtype,
-                scale=child.scale,
-                children=child.children,
-                elem=child.elem,
-                list_cap=child.list_cap,
-            )
-            out.extend(expand_field(nested))
+            out.extend(expand_field(_child_field(field, child)))
         return out
     if dt is DataType.LIST:
         ed = field.elem.device_dtype
@@ -141,15 +147,9 @@ def encode_column(
             cvals = [
                 None if v is None else v.get(child.name) for v in values
             ]
-            sub = Field(
-                f"{field.name}.{child.name}",
-                child.dtype,
-                scale=child.scale,
-                children=child.children,
-                elem=child.elem,
-                list_cap=child.list_cap,
+            clanes, cnulls = encode_column(
+                _child_field(field, child), cvals, strings
             )
-            clanes, cnulls = encode_column(sub, cvals, strings)
             lanes.update(clanes)
             if cnulls:
                 all_nulls.update(cnulls)
@@ -230,16 +230,8 @@ def decode_column(
     if dt is DataType.STRUCT:
         per_child = {}
         for child in field.children:
-            sub = Field(
-                f"{field.name}.{child.name}",
-                child.dtype,
-                scale=child.scale,
-                children=child.children,
-                elem=child.elem,
-                list_cap=child.list_cap,
-            )
             per_child[child.name] = decode_column(
-                sub, lanes, null_of, strings
+                _child_field(field, child), lanes, null_of, strings
             )
         n = len(next(iter(per_child.values())))
         rows = [

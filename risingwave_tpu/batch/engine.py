@@ -28,8 +28,9 @@ class BatchQueryEngine:
     def register(self, name: str, mview: MaterializeExecutor) -> None:
         self.tables[name] = mview
 
-    def query(self, sql: str) -> Dict[str, np.ndarray]:
-        stmt = P.parse(sql)
+    def query(self, sql: str, stmt: "P.Select" = None) -> Dict[str, np.ndarray]:
+        if stmt is None:
+            stmt = P.parse(sql)
         if not isinstance(stmt, P.Select):
             raise ValueError("batch engine runs SELECT only")
         if isinstance(stmt.from_, P.Join):

@@ -76,6 +76,7 @@ class SqlSession:
         # are equality-complete across relations, so joins/group-bys on
         # strings compare codes (array/dictionary.py)
         self.strings = StringDictionary()
+        self.planner.strings = self.strings  # literal -> code rewriting
         self.dml = DmlManager(self.runtime, catalog, strings=self.strings)
 
     def execute(self, sql: str) -> Tuple[Dict[str, np.ndarray], str]:
@@ -85,6 +86,16 @@ class SqlSession:
             return self._execute_locked(sql)
 
     def _execute_locked(self, sql: str) -> Tuple[Dict[str, np.ndarray], str]:
+        stripped = sql.lstrip()
+        if stripped[:8].lower() == "explain ":
+            from risingwave_tpu.sql.optimizer import explain_sql
+
+            plan = explain_sql(stripped[8:], catalog=self.catalog)
+            return {
+                "QUERY PLAN": np.asarray(
+                    plan.rstrip("\n").split("\n"), dtype=object
+                )
+            }, "EXPLAIN"
         stmt = P.parse(sql)
         if isinstance(stmt, P.CreateTable):
             if (
@@ -184,7 +195,10 @@ class SqlSession:
             # writes, so advance the barrier clock here
             self.runtime.barrier()
             return {}, f"INSERT 0 {n}"
-        out = self.batch.query(sql)
+        from risingwave_tpu.sql.typing import typecheck_select
+
+        stmt = typecheck_select(stmt, self.catalog, self.strings)
+        out = self.batch.query(sql, stmt=stmt)
         out = self._decode_output(stmt, out)
         n = len(next(iter(out.values()))) if out else 0
         return out, f"SELECT {n}"
@@ -218,6 +232,14 @@ class SqlSession:
                     raw = np.asarray(
                         [0 if v is None else v for v in vals]
                     )
+                elif np.issubdtype(raw.dtype, np.floating):
+                    # batch outer joins surface missing rows as NaN in
+                    # float lanes; casting NaN to int64 would decode as
+                    # garbage (INT64_MIN-scaled Decimals) instead of NULL
+                    nan = np.isnan(raw)
+                    if nan.any():
+                        nl = nan if nl is None else (np.asarray(nl) | nan)
+                        raw = np.where(nan, 0, raw)
                 decoded[name] = np.asarray(
                     decode_column(
                         Field(name, f.dtype, scale=f.scale),

@@ -249,6 +249,17 @@ class StreamPlanner:
             name, select = stmt.name, stmt.select
         else:
             name, select = "anon_mv", stmt
+        # type-directed pass first (decimal literal scaling, dictionary
+        # collation guards), then logical optimization (predicate
+        # pushdown into derived tables, outer-join simplification,
+        # constant folding) — then lower the optimized AST as before
+        from risingwave_tpu.sql.optimizer import optimize_select
+        from risingwave_tpu.sql.typing import typecheck_select
+
+        select = typecheck_select(
+            select, self.catalog, getattr(self, "strings", None)
+        )
+        select = optimize_select(select, catalog=self.catalog)
         if isinstance(select.from_, P.Join):
             return self._plan_join(name, select)
         return self._plan_single(name, select)

@@ -78,3 +78,165 @@ def infer_output_fields(stmt, catalog) -> Dict[str, Field]:
                                 name, f.dtype, scale=f.scale
                             )
     return out
+
+
+# ---------------------------------------------------------------------------
+# Type-directed statement rewriting / checking
+# ---------------------------------------------------------------------------
+
+_CMP_OPS = ("=", "<>", "!=", "<", "<=", ">", ">=", "+", "-")
+
+
+def _scale_lit(lit: P.Literal, scale: int) -> P.Literal:
+    from decimal import Decimal
+
+    if lit.value is None:
+        return lit
+    return P.Literal(
+        int(Decimal(repr(lit.value)).scaleb(scale).to_integral_value())
+    )
+
+
+def _field_of(env, ident: P.Ident):
+    return env.get(ident.name)
+
+
+def _lane_lit(lit: P.Literal, field, strings) -> P.Literal:
+    """A literal compared against a column, rewritten into the column's
+    LANE domain: DECIMAL scales; VARCHAR/JSONB encode to a dictionary
+    code (a fresh code matches no stored row — exactly right for
+    equality on an unseen string)."""
+    if lit.value is None:
+        return lit
+    if field.dtype is DataType.DECIMAL:
+        return _scale_lit(lit, field.scale)
+    if field.dtype is DataType.VARCHAR and isinstance(lit.value, str):
+        if strings is None:
+            raise ValueError("VARCHAR literal needs the session dictionary")
+        return P.Literal(int(strings.encode_one(lit.value)))
+    if field.dtype is DataType.JSONB and isinstance(lit.value, str):
+        import json
+
+        if strings is None:
+            raise ValueError("JSONB literal needs the session dictionary")
+        canon = json.dumps(
+            json.loads(lit.value), sort_keys=True, separators=(",", ":")
+        )
+        return P.Literal(int(strings.encode_one(canon)))
+    return lit
+
+
+def _rewrite_pred(pred, env, strings=None):
+    """Rewrite literals compared against DECIMAL/VARCHAR/JSONB columns
+    into the lane domain (scaled ints / dictionary codes) — a raw
+    literal would silently compare at the wrong magnitude or crash on
+    the int32 code lane."""
+    if isinstance(pred, P.BinaryOp):
+        left = _rewrite_pred(pred.left, env, strings)
+        right = _rewrite_pred(pred.right, env, strings)
+        if pred.op in _CMP_OPS:
+            lf = _field_of(env, left) if isinstance(left, P.Ident) else None
+            rf = _field_of(env, right) if isinstance(right, P.Ident) else None
+            if lf is not None and isinstance(right, P.Literal):
+                right = _lane_lit(right, lf, strings)
+            elif rf is not None and isinstance(left, P.Literal):
+                left = _lane_lit(left, rf, strings)
+        return P.BinaryOp(pred.op, left, right)
+    if isinstance(pred, P.UnaryOp):
+        return P.UnaryOp(pred.op, _rewrite_pred(pred.operand, env, strings))
+    if isinstance(pred, P.FuncCall):
+        args = [
+            a if isinstance(a, str) else _rewrite_pred(a, env, strings)
+            for a in pred.args
+        ]
+        if pred.name in ("between", "in") and args:
+            f = _field_of(env, args[0]) if isinstance(args[0], P.Ident) else None
+            if f is not None:
+                args = [args[0]] + [
+                    _lane_lit(a, f, strings) if isinstance(a, P.Literal) else a
+                    for a in args[1:]
+                ]
+        return P.FuncCall(pred.name, tuple(args))
+    if isinstance(pred, P.CaseExpr):
+        return P.CaseExpr(
+            tuple(
+                (_rewrite_pred(c, env, strings), _rewrite_pred(v, env, strings))
+                for c, v in pred.branches
+            ),
+            _rewrite_pred(pred.default, env, strings)
+            if pred.default is not None
+            else None,
+        )
+    return pred
+
+
+def _check_collation(select: P.Select, env, out_fields) -> None:
+    """Dictionary codes are equality-complete but NOT ordered: min/max
+    and ORDER BY over VARCHAR/JSONB would return the insertion-order
+    winner as if it were the collation winner — refuse loudly instead
+    (array/dictionary.py documents the limitation)."""
+    dict_types = (DataType.VARCHAR, DataType.JSONB)
+    for item in select.items:
+        e = item.expr
+        if (
+            isinstance(e, P.FuncCall)
+            and e.name in ("min", "max")
+            and e.args
+            and isinstance(e.args[0], P.Ident)
+        ):
+            f = _field_of(env, e.args[0])
+            if f is not None and f.dtype in dict_types:
+                raise NotImplementedError(
+                    f"{e.name}() over {f.dtype.value} is not supported: "
+                    "dictionary codes are not collation-ordered"
+                )
+    for ident, _desc in select.order_by:
+        f = out_fields.get(ident.name) or _field_of(env, ident)
+        if f is not None and f.dtype in dict_types:
+            raise NotImplementedError(
+                f"ORDER BY {ident.name} ({f.dtype.value}) is not "
+                "supported: dictionary codes are not collation-ordered"
+            )
+
+
+def typecheck_select(select: P.Select, catalog, strings=None) -> P.Select:
+    """Type-directed pass run before planning/execution: rewrites
+    DECIMAL/VARCHAR/JSONB literals into the lane domain and rejects
+    unordered-dictionary min/max/ORDER BY. Recurses into derived
+    tables."""
+    new_from = _typecheck_rel(select.from_, catalog, strings)
+    env = _env_of_rel(new_from, catalog)
+    where = (
+        _rewrite_pred(select.where, env, strings)
+        if select.where is not None
+        else None
+    )
+    items = tuple(
+        P.SelectItem(_rewrite_pred(i.expr, env, strings), i.alias)
+        for i in select.items
+    )
+    out = P.Select(
+        items=items,
+        from_=new_from,
+        where=where,
+        group_by=select.group_by,
+        order_by=select.order_by,
+        limit=select.limit,
+    )
+    _check_collation(out, env, infer_output_fields(out, catalog))
+    return out
+
+
+def _typecheck_rel(rel, catalog, strings=None):
+    if isinstance(rel, P.SubQuery):
+        return P.SubQuery(
+            typecheck_select(rel.select, catalog, strings), rel.alias
+        )
+    if isinstance(rel, P.Join):
+        return P.Join(
+            _typecheck_rel(rel.left, catalog, strings),
+            _typecheck_rel(rel.right, catalog, strings),
+            rel.on,
+            rel.join_type,
+        )
+    return rel
