@@ -70,7 +70,10 @@ class BatchQueryEngine:
                     name = item.alias or (
                         item.expr.name if isinstance(item.expr, P.Ident) else f"col{i}"
                     )
-                    out[name] = self._eval_item(item.expr, cols, n, binder)
+                    vals, nl = self._eval_item(item.expr, cols, n, binder)
+                    out[name] = vals
+                    if nl is not None and nl.any():
+                        out[name + "__null"] = nl
 
         # OrderBy + Limit (src/batch/src/executor/{order_by,limit}.rs)
         if stmt.order_by:
@@ -168,12 +171,16 @@ class BatchQueryEngine:
         return {c: m[c].to_numpy() for c in m.columns if c != "_merge"}
 
     def _eval_item(self, ast, cols, n, binder):
+        """-> (values, null_lane | None): computed items keep their SQL
+        NULLs (a UDF error row, NULL-strict arithmetic)."""
         if isinstance(ast, P.Ident):
-            return cols[binder.resolve(ast)]
+            return cols[binder.resolve(ast)], None
         cap = max(1, 1 << max(0, (n - 1)).bit_length()) if n else 1
         chunk = DataChunk.from_numpy(cols, cap)
-        v, _ = compile_scalar(ast, binder).eval(chunk)
-        return np.asarray(v)[:n]
+        v, nl = compile_scalar(ast, binder).eval(chunk)
+        return np.asarray(v)[:n], (
+            np.asarray(nl)[:n] if nl is not None else None
+        )
 
     def _scalar_agg(self, fc, cols, n, binder):
         if fc.args == ("*",):

@@ -1,0 +1,126 @@
+"""Temporal join — stream rows enriched against a versioned table.
+
+Reference: src/stream/src/executor/temporal_join.rs:44 — the stream
+(left) side probes the right TABLE at the row's processing epoch; the
+right side keeps NO join state and emits nothing on its own. Used for
+`JOIN t FOR SYSTEM_TIME AS OF PROCTIME()` lookups (dimension tables).
+
+TPU re-design: the right side is the table's MATERIALIZE executor.
+When it is a DeviceMaterializeExecutor the probe is one fused device
+program — ``ops.hash_table.lookup`` over the MV's pk table + gathers
+from its value lanes — so enrichment never leaves HBM. Host-map MVs
+fall back to a snapshot dict probe (interpreter speed, same
+semantics).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.executors.base import Executor
+from risingwave_tpu.executors.materialize import DeviceMaterializeExecutor
+from risingwave_tpu.ops.hash_table import lookup
+
+
+@partial(jax.jit, static_argnames=("out_cols", "jt"))
+def _probe_step(table, values, vnulls, chunk, key_lanes, out_cols, jt):
+    slots, found = lookup(table, key_lanes, chunk.valid)
+    cap = table.capacity
+    idx = jnp.where(found, slots, cap - 1)  # safe gather lane
+    cols = dict(chunk.columns)
+    nulls = dict(chunk.nulls)
+    for name in out_cols:
+        cols[name] = values[name][idx]
+        miss = ~found
+        lane = vnulls.get(name)
+        if lane is not None:
+            miss = miss | lane[idx]
+        nulls[name] = miss
+    valid = chunk.valid if jt == "left" else (chunk.valid & found)
+    return StreamChunk(cols, valid, nulls, chunk.ops)
+
+
+class TemporalJoinExecutor(Executor):
+    """``stream JOIN table FOR SYSTEM_TIME AS OF PROCTIME()``.
+
+    ``right``: the table's materialize executor (device or host map).
+    ``left_keys``: stream columns equi-matched against the table's pk
+    (in pk order). ``output_cols``: table value columns appended to
+    every matched row. ``join_type``: "inner" drops misses, "left"
+    keeps them with NULL-padded table columns.
+    """
+
+    def __init__(
+        self,
+        right,
+        left_keys: Sequence[str],
+        output_cols: Sequence[str],
+        join_type: str = "inner",
+    ):
+        if join_type not in ("inner", "left"):
+            raise ValueError("temporal join supports inner/left")
+        self.right = right
+        self.left_keys = tuple(left_keys)
+        self.output_cols = tuple(output_cols)
+        self.join_type = join_type
+
+    def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
+        if isinstance(self.right, DeviceMaterializeExecutor):
+            if len(self.right.pk) != len(self.left_keys):
+                raise ValueError("left_keys must match the table pk")
+            key_lanes = tuple(
+                chunk.col(k).astype(tk.dtype)
+                for k, tk in zip(self.left_keys, self.right.table.keys)
+            )
+            return [
+                _probe_step(
+                    self.right.table,
+                    self.right.state.values,
+                    self.right.state.vnulls,
+                    chunk,
+                    key_lanes,
+                    self.output_cols,
+                    self.join_type,
+                )
+            ]
+        return [self._probe_host(chunk)]
+
+    def _probe_host(self, chunk: StreamChunk) -> StreamChunk:
+        snap = self.right.snapshot()  # pk tuple -> value tuple
+        col_pos = {c: i for i, c in enumerate(self.right.columns)}
+        data = chunk.to_numpy(with_ops=True)
+        n = len(data["__op__"])
+        found = np.zeros(chunk.capacity, np.bool_)
+        outs = {
+            c: np.zeros(chunk.capacity, object) for c in self.output_cols
+        }
+        live = np.flatnonzero(np.asarray(chunk.valid))
+        for j, i in enumerate(live[:n]):
+            key = tuple(data[k][j].item() for k in self.left_keys)
+            row = snap.get(key)
+            if row is not None:
+                found[i] = True
+                for c in self.output_cols:
+                    outs[c][i] = row[col_pos[c]]
+        cols = dict(chunk.columns)
+        nulls = dict(chunk.nulls)
+        for c in self.output_cols:
+            vals = np.asarray(
+                [0 if v is None else v for v in outs[c].tolist()]
+            )
+            cols[c] = jnp.asarray(vals)
+            nulls[c] = jnp.asarray(
+                ~found | np.asarray([v is None for v in outs[c].tolist()])
+            )
+        valid = (
+            chunk.valid
+            if self.join_type == "left"
+            else chunk.valid & jnp.asarray(found)
+        )
+        return StreamChunk(cols, valid, nulls, chunk.ops)

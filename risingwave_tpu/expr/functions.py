@@ -30,6 +30,8 @@ from risingwave_tpu.expr.expr import EvalResult, Expr, _null_or
 
 # name -> (min_arity, max_arity, impl(values...) -> (value, extra_null))
 _REGISTRY: Dict[str, Tuple[int, int, Callable]] = {}
+# UDF name -> (out Field, arg Fields) for type inference at the edges
+_UDF_SIGS: Dict[str, Tuple[object, Tuple[object, ...]]] = {}
 
 
 def register(name, min_arity, max_arity=None):
@@ -339,3 +341,120 @@ class StringFunc(Expr):
         table = self._table()
         safe = jnp.clip(v, 0, table.shape[0] - 1)
         return table[safe], n
+
+
+# -- user-defined functions ------------------------------------------------
+# Reference: src/expr/impl/src/udf/python.rs (embedded python UDFs,
+# batched over arrow arrays). TPU re-design: the UDF body runs host-side
+# through jax.pure_callback, so Func nodes containing a UDF still trace
+# into jitted expression programs — XLA suspends at the callback, ships
+# the operand lanes to the host, and resumes with the result lane.
+# Row-level exceptions become SQL NULL (the reference's non-strict
+# error->NULL policy) via the extra-null lane.
+
+
+def register_py_udf(
+    name: str,
+    fn: Callable,
+    out_field,
+    arg_fields,
+    strings=None,
+) -> None:
+    """Register a scalar python UDF callable under ``name`` (lowercased
+    — SQL identifiers fold to lower case in the lexer).
+
+    ``fn`` is row-scalar. ``out_field``/``arg_fields`` are logical
+    Fields: VARCHAR/JSONB args decode dictionary codes to python
+    strings/objects before the call and the return value encodes back;
+    DECIMAL crosses as Decimal. Vectorization happens in the callback;
+    error rows yield SQL NULL."""
+    import json as _json
+    from decimal import Decimal as _Dec
+
+    from risingwave_tpu.types import DataType as _DT
+
+    if not arg_fields:
+        raise NotImplementedError(
+            "zero-argument UDFs are not supported (use a literal)"
+        )
+    dict_types = (_DT.VARCHAR, _DT.JSONB)
+    if strings is None and (
+        out_field.dtype in dict_types
+        or any(f.dtype in dict_types for f in arg_fields)
+    ):
+        raise ValueError(
+            "VARCHAR/JSONB UDF signatures need the session dictionary"
+        )
+    out_np = np.dtype(out_field.dtype.device_dtype)
+
+    def _in(field, v):
+        if field.dtype is _DT.VARCHAR:
+            return strings.decode_one(int(v))
+        if field.dtype is _DT.JSONB:
+            return _json.loads(strings.decode_one(int(v)))
+        if field.dtype is _DT.DECIMAL:
+            return _Dec(int(v)).scaleb(-field.scale)
+        return v
+
+    def _out(v):
+        if out_field.dtype is _DT.VARCHAR:
+            return strings.encode_one(str(v))
+        if out_field.dtype is _DT.JSONB:
+            return strings.encode_one(
+                _json.dumps(v, sort_keys=True, separators=(",", ":"))
+            )
+        if out_field.dtype is _DT.DECIMAL:
+            return int(
+                _Dec(repr(v) if not isinstance(v, _Dec) else v)
+                .scaleb(out_field.scale)
+                .to_integral_value()
+            )
+        return v
+
+    def impl(*values):
+        import jax
+
+        n = values[0].shape[0]
+
+        def host(*arrs):
+            out = np.zeros(n, out_np)
+            err = np.zeros(n, np.bool_)
+            cols = [np.asarray(a) for a in arrs]
+            for i in range(n):
+                try:
+                    out[i] = _out(
+                        fn(
+                            *(
+                                _in(f, c[i].item())
+                                for f, c in zip(arg_fields, cols)
+                            )
+                        )
+                    )
+                except Exception:  # noqa: BLE001 — row error -> NULL
+                    err[i] = True
+            return out, err
+
+        val, err = jax.pure_callback(
+            host,
+            (
+                jax.ShapeDtypeStruct((n,), out_np),
+                jax.ShapeDtypeStruct((n,), np.bool_),
+            ),
+            *values,
+        )
+        return val, err
+
+    arity = len(arg_fields)
+    _REGISTRY[name.lower()] = (arity, arity, impl)
+    _UDF_SIGS[name.lower()] = (out_field, tuple(arg_fields))
+
+
+def drop_function(name: str) -> bool:
+    _UDF_SIGS.pop(name.lower(), None)
+    return _REGISTRY.pop(name.lower(), None) is not None
+
+
+def udf_signature(name: str):
+    """(out_field, arg_fields) | None — lets the result edge decode
+    UDF outputs (dictionary codes / scaled decimals) by logical type."""
+    return _UDF_SIGS.get(name.lower())

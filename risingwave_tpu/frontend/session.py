@@ -77,6 +77,8 @@ class SqlSession:
         # strings compare codes (array/dictionary.py)
         self.strings = StringDictionary()
         self.planner.strings = self.strings  # literal -> code rewriting
+        # temporal joins probe a relation's materialize state directly
+        self.planner.mviews = self.batch.tables
         self.dml = DmlManager(self.runtime, catalog, strings=self.strings)
 
     def execute(self, sql: str) -> Tuple[Dict[str, np.ndarray], str]:
@@ -87,6 +89,19 @@ class SqlSession:
 
     def _execute_locked(self, sql: str) -> Tuple[Dict[str, np.ndarray], str]:
         stripped = sql.lstrip()
+        if stripped[:15].lower().startswith("create function"):
+            return self._create_function(stripped)
+        if stripped[:13].lower().startswith("drop function"):
+            import re
+
+            from risingwave_tpu.expr import functions as F
+
+            m = re.match(r"(?is)^drop\s+function\s+(\w+)\s*;?\s*$", stripped)
+            if not m:
+                raise SyntaxError("DROP FUNCTION <name>")
+            if not F.drop_function(m.group(1)):
+                raise KeyError(f"unknown function {m.group(1)!r}")
+            return {}, "DROP_FUNCTION"
         if stripped[:8].lower() == "explain ":
             from risingwave_tpu.sql.optimizer import explain_sql
 
@@ -126,23 +141,31 @@ class SqlSession:
             lane_names = tuple(
                 ln for f in schema.fields for (ln, _) in expand_field(f)
             )
-            mview = MaterializeExecutor(
-                pk=("_row_id",),
-                columns=lane_names,
-                table_id=f"{stmt.name}.table",
-            )
-            self.runtime.register(
-                stmt.name,
-                Pipeline(
-                    [
-                        RowIdGenExecutor(
-                            out_col="_row_id",
-                            table_id=f"{stmt.name}.rowid",
-                        ),
-                        mview,
-                    ]
-                ),
-            )
+            if stmt.pk:
+                # user pk: upsert table (create_table.rs pk handling) —
+                # probe-able by temporal joins; no hidden row id
+                mview = MaterializeExecutor(
+                    pk=stmt.pk,
+                    columns=tuple(
+                        ln for ln in lane_names if ln not in stmt.pk
+                    ),
+                    table_id=f"{stmt.name}.table",
+                )
+                chain = [mview]
+            else:
+                mview = MaterializeExecutor(
+                    pk=("_row_id",),
+                    columns=lane_names,
+                    table_id=f"{stmt.name}.table",
+                )
+                chain = [
+                    RowIdGenExecutor(
+                        out_col="_row_id",
+                        table_id=f"{stmt.name}.rowid",
+                    ),
+                    mview,
+                ]
+            self.runtime.register(stmt.name, Pipeline(chain))
             self.batch.register(stmt.name, mview)
             self.dml.add_target(stmt.name, stmt.name, "single")
             return {}, "CREATE_TABLE"
@@ -202,6 +225,49 @@ class SqlSession:
         out = self._decode_output(stmt, out)
         n = len(next(iter(out.values()))) if out else 0
         return out, f"SELECT {n}"
+
+    def _create_function(self, sql: str):
+        """CREATE FUNCTION name(args) RETURNS type LANGUAGE python AS
+        $$ <python source defining def name(...) > $$ — the embedded
+        python UDF surface (reference: src/expr/impl/src/udf/python.rs,
+        handler/create_function.rs). The body runs host-side through
+        jax.pure_callback inside jitted expression programs."""
+        import re
+
+        from risingwave_tpu.expr import functions as F
+
+        m = re.match(
+            r"(?is)^create\s+function\s+(\w+)\s*\(([^)]*)\)\s*"
+            r"returns\s+(\w+)\s*language\s+python\s+as\s+\$\$(.*)\$\$\s*;?\s*$",
+            sql,
+        )
+        if not m:
+            raise SyntaxError(
+                "CREATE FUNCTION name(arg TYPE, ...) RETURNS TYPE "
+                "LANGUAGE python AS $$ def name(...): ... $$"
+            )
+        name, args, ret, body = m.groups()
+        arg_fields = []
+        for a in args.split(","):
+            a = a.strip()
+            if not a:
+                continue
+            parts = a.split()
+            if len(parts) != 2:
+                raise SyntaxError(f"argument {a!r}: expected 'name TYPE'")
+            arg_fields.append(_parse_type_word(parts[0], parts[1]))
+        ret_field = _parse_type_word("__ret__", ret)
+        ns: Dict[str, object] = {}
+        exec(body, ns)  # noqa: S102 — embedded UDFs run user code by design
+        fn = ns.get(name)
+        if not callable(fn):
+            raise ValueError(
+                f"UDF body must define a python function named {name!r}"
+            )
+        F.register_py_udf(
+            name, fn, ret_field, arg_fields, strings=self.strings
+        )
+        return {}, "CREATE_FUNCTION"
 
     def _decode_output(self, stmt, out):
         """Decode device lanes back to SQL values at the result edge:

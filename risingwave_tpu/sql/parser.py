@@ -112,6 +112,7 @@ class CreateTable:
 
     name: str
     columns: Tuple[Tuple[str, str], ...]  # (name, type word)
+    pk: Tuple[str, ...] = ()  # PRIMARY KEY (cols); empty -> hidden row id
 
 
 @dataclass(frozen=True)
@@ -219,7 +220,20 @@ class Parser:
                 name = self.expect("ident").value
                 self.expect("op", "(")
                 cols = []
+                pk: Tuple[str, ...] = ()
                 while True:
+                    if self._accept_word("primary"):
+                        if not self._accept_word("key"):
+                            raise SyntaxError("expected KEY after PRIMARY")
+                        self.expect("op", "(")
+                        pkc = [self.expect("ident").value]
+                        while self.accept("op", ","):
+                            pkc.append(self.expect("ident").value)
+                        self.expect("op", ")")
+                        pk = tuple(pkc)
+                        if not self.accept("op", ","):
+                            break
+                        continue
                     cname = self.expect("ident").value
                     t = self.next()
                     if t.kind not in ("ident", "kw"):
@@ -232,12 +246,20 @@ class Parser:
                             args.append(self.expect("num").value)
                         self.expect("op", ")")
                         tword += "(" + ",".join(args) + ")"
+                    # inline single-column PRIMARY KEY
+                    if self._accept_word("primary"):
+                        if not self._accept_word("key"):
+                            raise SyntaxError("expected KEY after PRIMARY")
+                        pk = (cname,)
                     cols.append((cname, tword))
                     if not self.accept("op", ","):
                         break
                 self.expect("op", ")")
                 self.expect("eof")
-                return CreateTable(name, tuple(cols))
+                unknown = set(pk) - {c for c, _ in cols}
+                if unknown:
+                    raise SyntaxError(f"PRIMARY KEY over unknown {unknown}")
+                return CreateTable(name, tuple(cols), pk)
             self.expect("kw", "materialized")
             self.expect("kw", "view")
             name = self.expect("ident").value
@@ -348,6 +370,29 @@ class Parser:
             if jt is None:
                 break
             right = self.relation()
+            # temporal lookup: JOIN t FOR SYSTEM_TIME AS OF PROCTIME()
+            # (reference: temporal_join.rs:44; sqlparser table factor)
+            if self._accept_word("for"):
+                if not self._accept_word("system_time"):
+                    raise SyntaxError("expected SYSTEM_TIME after FOR")
+                self.expect("kw", "as")
+                if not self._accept_word("of"):
+                    raise SyntaxError("expected OF")
+                if not self._accept_word("proctime"):
+                    raise SyntaxError("expected PROCTIME()")
+                self.expect("op", "(")
+                self.expect("op", ")")
+                if jt not in ("inner", "left"):
+                    raise SyntaxError(
+                        "temporal joins support INNER / LEFT only"
+                    )
+                jt = "temporal" if jt == "inner" else "temporal_left"
+                # the alias may follow the whole FOR SYSTEM_TIME clause
+                alias = self._rel_alias()
+                if alias is not None:
+                    if not isinstance(right, TableRef):
+                        raise SyntaxError("temporal side must be a table")
+                    right = TableRef(right.name, alias)
             self.expect("kw", "on")
             rel = Join(rel, right, self.expr(), jt)
         where = self.expr() if self.accept("kw", "where") else None
@@ -419,7 +464,7 @@ class Parser:
         if self.accept("kw", "as"):
             return self.expect("ident").value
         t = self.peek()
-        if t.kind == "ident" and t.value not in ("left", "right", "full"):
+        if t.kind == "ident" and t.value not in ("left", "right", "full", "for"):
             return self.next().value
         return None
 

@@ -95,15 +95,22 @@ class Catalog:
 
 
 class Binder:
-    """Column resolution over a rel's output schema."""
+    """Column resolution over a rel's output schema. ``alias`` may be a
+    single name or a set of names (an enriched temporal-join schema is
+    addressable through either side's qualifier)."""
 
-    def __init__(self, schema: Dict[str, object], alias: Optional[str]):
+    def __init__(self, schema: Dict[str, object], alias):
         self.schema = schema
         self.alias = alias
 
     def resolve(self, ident: P.Ident) -> str:
         if ident.qualifier is not None and self.alias is not None:
-            if ident.qualifier != self.alias:
+            ok = (
+                ident.qualifier in self.alias
+                if isinstance(self.alias, (set, frozenset))
+                else ident.qualifier == self.alias
+            )
+            if not ok:
                 raise KeyError(f"unknown qualifier {ident.qualifier!r}")
         if ident.name not in self.schema:
             raise KeyError(f"unknown column {ident.name!r}")
@@ -261,6 +268,8 @@ class StreamPlanner:
         )
         select = optimize_select(select, catalog=self.catalog)
         if isinstance(select.from_, P.Join):
+            if select.from_.join_type.startswith("temporal"):
+                return self._plan_temporal(name, select)
             return self._plan_join(name, select)
         return self._plan_single(name, select)
 
@@ -277,19 +286,17 @@ class StreamPlanner:
             name, pipeline, mview, {rel.source: "single"}, schema=rel.schema
         )
 
-    def _plan_rel(self, name: str, select: P.Select) -> BoundRel:
-        """Plan one select over a single (possibly windowed) input."""
-        src = select.from_
+    def _from_bound(self, name: str, src) -> BoundRel:
+        """FROM clause -> BoundRel (source chain + schema, no select
+        logic applied yet)."""
         chain: List[Executor] = []
         alias = None
         if isinstance(src, P.SubQuery):
             inner = self._plan_rel(name, src.select)
-            chain = inner.chain
-            schema = inner.schema
-            pk = inner.pk
-            source = inner.source
-            alias = src.alias
-        elif isinstance(src, P.WindowTVF):
+            return BoundRel(
+                inner.chain, inner.schema, inner.pk, inner.source, src.alias
+            )
+        if isinstance(src, P.WindowTVF):
             source = src.table.name
             schema = dict(self.catalog.schema_dtypes(source))
             chain.append(
@@ -299,9 +306,8 @@ class StreamPlanner:
                 )
             )
             schema["window_start"] = jnp.dtype(jnp.int64)
-            pk = ()
-            alias = src.alias
-        elif isinstance(src, P.TableRef):
+            return BoundRel(chain, schema, (), source, src.alias)
+        if isinstance(src, P.TableRef):
             source = src.name
             schema = dict(self.catalog.schema_dtypes(source))
             # scanning an MV: its change stream carries retractions keyed
@@ -311,9 +317,21 @@ class StreamPlanner:
                 if self.catalog.is_mv(source)
                 else ()
             )
-            alias = src.alias
-        else:
-            raise TypeError(f"unsupported FROM {src!r}")
+            return BoundRel(chain, schema, pk, source, src.alias)
+        raise TypeError(f"unsupported FROM {src!r}")
+
+    def _plan_rel(
+        self, name: str, select: P.Select, pre: Optional[BoundRel] = None
+    ) -> BoundRel:
+        """Plan one select over a single (possibly windowed) input.
+        ``pre`` overrides FROM processing with an already-bound input
+        (the temporal-join path enriches the stream first)."""
+        bound = pre if pre is not None else self._from_bound(name, select.from_)
+        chain = bound.chain
+        schema = bound.schema
+        pk = bound.pk
+        source = bound.source
+        alias = bound.alias
 
         binder = Binder(schema, alias)
         if select.where is not None:
@@ -542,6 +560,117 @@ class StreamPlanner:
         return chain, out_schema, pk
 
     # -- joins -----------------------------------------------------------
+    def _plan_temporal(self, name: str, select: P.Select) -> PlannedMV:
+        """stream JOIN table FOR SYSTEM_TIME AS OF PROCTIME() ON ... —
+        the stream side probes the table's materialize state at apply
+        time; no join state (temporal_join.rs:44). The probe executor
+        joins the left chain, then the ordinary single-input select
+        logic (WHERE / GROUP BY / items) runs over the enriched schema.
+        """
+        from risingwave_tpu.executors.temporal_join import (
+            TemporalJoinExecutor,
+        )
+
+        join: P.Join = select.from_
+        jt = "inner" if join.join_type == "temporal" else "left"
+        if not isinstance(join.right, P.TableRef):
+            raise ValueError(
+                "the temporal side must be a table / MV name"
+            )
+        rname = join.right.name
+        mv = getattr(self, "mviews", {}).get(rname)
+        if mv is None and self.catalog.is_mv(rname):
+            mv = self.catalog.mvs[rname].mview
+        if mv is None:
+            raise KeyError(
+                f"temporal side {rname!r} is not a materialized relation"
+            )
+        left = self._from_bound(name, join.left)
+        r_alias = join.right.alias or rname
+        r_schema = dict(self.catalog.schema_dtypes(rname))
+        overlap = set(left.schema) & set(r_schema)
+        if overlap:
+            raise ValueError(
+                f"temporal join sides share column names {overlap}; "
+                "alias them apart"
+            )
+
+        # ON: left_col = right_pk_col conjuncts, matched to pk order
+        pairs: Dict[str, str] = {}
+
+        def walk(e):
+            if isinstance(e, P.BinaryOp) and e.op == "and":
+                walk(e.left)
+                walk(e.right)
+                return
+            if (
+                isinstance(e, P.BinaryOp)
+                and e.op == "="
+                and isinstance(e.left, P.Ident)
+                and isinstance(e.right, P.Ident)
+            ):
+                a, b = e.left, e.right
+                if a.qualifier == r_alias or (
+                    a.qualifier is None and a.name in r_schema
+                ):
+                    a, b = b, a
+                if b.name not in mv.pk:
+                    raise ValueError(
+                        f"temporal ON must match the table pk; {b.name!r} "
+                        f"is not in {mv.pk}"
+                    )
+                pairs[b.name] = a.name
+                return
+            raise ValueError("temporal ON must be AND-ed equalities")
+
+        walk(join.on)
+        if set(pairs) != set(mv.pk):
+            raise ValueError(
+                f"temporal ON must cover the full pk {mv.pk}, got "
+                f"{sorted(pairs)}"
+            )
+        left_keys = tuple(pairs[k] for k in mv.pk)
+        output_cols = tuple(
+            c for c in mv.columns if not c.startswith("_")
+        )
+        tj = TemporalJoinExecutor(
+            mv, left_keys, output_cols, join_type=jt
+        )
+        # mv.columns are expanded LEAF lane names (composite columns
+        # decompose); resolve lane dtypes through expand_field, never
+        # default silently
+        from risingwave_tpu.array.composite import expand_field
+
+        lane_dtypes = {
+            ln: jnp.dtype(d)
+            for f in self.catalog.tables[rname].fields
+            for (ln, d) in expand_field(f)
+        }
+        schema = dict(left.schema)
+        for c in output_cols:
+            if c not in lane_dtypes:
+                raise KeyError(
+                    f"temporal side lane {c!r} has no declared dtype"
+                )
+            schema[c] = lane_dtypes[c]
+        # the enriched row is addressable via either side's qualifier
+        quals = frozenset(
+            q for q in (left.alias or left.source, r_alias) if q
+        )
+        enriched = BoundRel(
+            left.chain + [tj], schema, left.pk, left.source, quals
+        )
+        rel = self._plan_rel(name, select, pre=enriched)
+        mview = MaterializeExecutor(
+            pk=rel.pk,
+            columns=tuple(c for c in rel.schema if c not in rel.pk),
+            table_id=f"{name}.mview",
+        )
+        pipeline = Pipeline(rel.chain + [mview])
+        return PlannedMV(
+            name, pipeline, mview, {rel.source: "single"}, schema=rel.schema
+        )
+
     def _plan_join(self, name: str, select: P.Select) -> PlannedMV:
         join: P.Join = select.from_
         if isinstance(join.left, P.Join):

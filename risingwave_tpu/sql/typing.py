@@ -61,6 +61,13 @@ def infer_output_fields(stmt, catalog) -> Dict[str, Field]:
             continue
         if isinstance(expr, P.FuncCall):
             name = item.alias or f"{expr.name}_{i}"
+            from risingwave_tpu.expr.functions import udf_signature
+
+            sig = udf_signature(expr.name)
+            if sig is not None:
+                rf = sig[0]
+                out[name] = Field(name, rf.dtype, scale=rf.scale)
+                continue
             if expr.name in ("count",):
                 out[name] = Field(name, DataType.INT64)
             elif expr.name in ("sum", "min", "max", "avg") and expr.args:

@@ -1,0 +1,148 @@
+"""Python UDFs (pure_callback under jit) + temporal joins
+(FOR SYSTEM_TIME AS OF PROCTIME) + CREATE TABLE PRIMARY KEY.
+
+Reference: src/expr/impl/src/udf/python.rs, executor/temporal_join.rs:44.
+"""
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.frontend.session import SqlSession
+from risingwave_tpu.sql import Catalog
+
+
+@pytest.fixture
+def session():
+    return SqlSession(Catalog({}), capacity=1 << 10)
+
+
+def test_python_udf_in_select_and_mv(session):
+    session.execute("CREATE TABLE t (k BIGINT, x BIGINT)")
+    session.execute("INSERT INTO t VALUES (1, 3), (2, 10), (3, 0)")
+    session.execute(
+        "CREATE FUNCTION triple(x BIGINT) RETURNS BIGINT LANGUAGE python "
+        "AS $$\ndef triple(x):\n    return x * 3\n$$"
+    )
+    out, _ = session.execute("SELECT k, triple(x) AS t3 FROM t ORDER BY k")
+    assert list(out["t3"]) == [9, 30, 0]
+
+    # UDF inside a streaming MV: the pure_callback traces into the
+    # jitted project program and keeps working on later inserts
+    session.execute(
+        "CREATE MATERIALIZED VIEW m AS SELECT k, triple(x) AS t3 FROM t"
+    )
+    session.execute("INSERT INTO t VALUES (4, 7)")
+    out, _ = session.execute("SELECT k, t3 FROM m ORDER BY k")
+    assert list(out["t3"]) == [9, 30, 0, 21]
+
+
+def test_python_udf_row_error_becomes_null(session):
+    session.execute("CREATE TABLE t (k BIGINT, x BIGINT)")
+    session.execute("INSERT INTO t VALUES (1, 2), (2, 0)")
+    session.execute(
+        "CREATE FUNCTION inv100(x BIGINT) RETURNS BIGINT LANGUAGE python "
+        "AS $$\ndef inv100(x):\n    return 100 // x\n$$"
+    )
+    out, _ = session.execute("SELECT k, inv100(x) AS v FROM t ORDER BY k")
+    assert out["v"][0] == 50
+    assert out["v"][1] is None  # div-by-zero row -> SQL NULL
+
+    with pytest.raises(KeyError):
+        session.execute("DROP FUNCTION nosuch")
+    session.execute("DROP FUNCTION inv100")
+    with pytest.raises(ValueError, match="unknown function"):
+        session.execute("SELECT inv100(x) AS v FROM t")
+
+
+def test_temporal_join_enriches_stream(session):
+    """Orders stream probes a currencies dimension table at proctime:
+    updates to the table affect FUTURE rows only (temporal_join.rs)."""
+    session.execute(
+        "CREATE TABLE rates (cur BIGINT PRIMARY KEY, rate BIGINT)"
+    )
+    session.execute("INSERT INTO rates VALUES (1, 100), (2, 200)")
+    session.execute("CREATE TABLE orders (oid BIGINT, cur2 BIGINT, amt BIGINT)")
+    session.execute(
+        "CREATE MATERIALIZED VIEW enriched AS "
+        "SELECT oid, amt, rate FROM orders "
+        "JOIN rates FOR SYSTEM_TIME AS OF PROCTIME() "
+        "ON orders.cur2 = rates.cur"
+    )
+    session.execute("INSERT INTO orders VALUES (10, 1, 5), (11, 2, 6)")
+    out, _ = session.execute("SELECT oid, amt, rate FROM enriched ORDER BY oid")
+    assert list(out["oid"]) == [10, 11]
+    assert list(out["rate"]) == [100, 200]
+
+    # rate update: already-joined rows keep the OLD rate; new rows see
+    # the new one (processing-time semantics)
+    session.execute("INSERT INTO rates VALUES (1, 150)")
+    session.execute("INSERT INTO orders VALUES (12, 1, 7)")
+    out, _ = session.execute("SELECT oid, rate FROM enriched ORDER BY oid")
+    assert list(out["rate"]) == [100, 200, 150]
+
+
+def test_temporal_inner_drops_misses_left_pads(session):
+    session.execute("CREATE TABLE dim (k BIGINT PRIMARY KEY, v BIGINT)")
+    session.execute("INSERT INTO dim VALUES (1, 11)")
+    session.execute("CREATE TABLE s (sk BIGINT, n BIGINT)")
+    session.execute(
+        "CREATE MATERIALIZED VIEW inner_j AS "
+        "SELECT sk, n, v FROM s JOIN dim FOR SYSTEM_TIME AS OF PROCTIME() "
+        "ON s.sk = dim.k"
+    )
+    session.execute(
+        "CREATE MATERIALIZED VIEW left_j AS "
+        "SELECT sk, n, v FROM s LEFT JOIN dim "
+        "FOR SYSTEM_TIME AS OF PROCTIME() ON s.sk = dim.k"
+    )
+    session.execute("INSERT INTO s VALUES (1, 100), (9, 900)")
+    out, _ = session.execute("SELECT sk, v FROM inner_j")
+    assert list(out["sk"]) == [1]  # miss dropped
+    out, _ = session.execute("SELECT sk, v FROM left_j ORDER BY sk")
+    assert list(out["sk"]) == [1, 9]
+    assert out["v"][0] == 11 and out["v"][1] is None  # miss NULL-padded
+
+
+def test_pk_table_upserts(session):
+    session.execute("CREATE TABLE kv (k BIGINT PRIMARY KEY, v BIGINT)")
+    session.execute("INSERT INTO kv VALUES (1, 10), (2, 20)")
+    session.execute("INSERT INTO kv VALUES (1, 99)")  # overwrite
+    out, _ = session.execute("SELECT k, v FROM kv ORDER BY k")
+    assert list(out["k"]) == [1, 2]
+    assert list(out["v"]) == [99, 20]
+
+
+def test_varchar_udf_args_and_return(session):
+    session.execute("CREATE TABLE ev (name VARCHAR, n BIGINT)")
+    session.execute("INSERT INTO ev VALUES ('click', 2), ('view', 3)")
+    session.execute(
+        "CREATE FUNCTION shout(s VARCHAR, n BIGINT) RETURNS VARCHAR "
+        "LANGUAGE python AS $$\ndef shout(s, n):\n"
+        "    return s.upper() + '!' * n\n$$"
+    )
+    out, _ = session.execute(
+        "SELECT n, shout(name, n) AS s FROM ev ORDER BY n"
+    )
+    assert list(out["s"]) == ["CLICK!!", "VIEW!!!"]
+
+
+def test_temporal_join_right_qualifier_with_left_alias(session):
+    session.execute("CREATE TABLE dim (id BIGINT PRIMARY KEY, price BIGINT)")
+    session.execute("INSERT INTO dim VALUES (1, 11)")
+    session.execute("CREATE TABLE src (k BIGINT, q BIGINT)")
+    session.execute(
+        "CREATE MATERIALIZED VIEW m AS "
+        "SELECT s.k, d.price FROM src AS s "
+        "JOIN dim FOR SYSTEM_TIME AS OF PROCTIME() AS d ON s.k = d.id"
+    )
+    session.execute("INSERT INTO src VALUES (1, 0)")
+    out, _ = session.execute("SELECT k, price FROM m")
+    assert list(out["price"]) == [11]
+
+
+def test_zero_arg_udf_rejected(session):
+    with pytest.raises(NotImplementedError, match="zero-argument"):
+        session.execute(
+            "CREATE FUNCTION one() RETURNS BIGINT LANGUAGE python "
+            "AS $$\ndef one():\n    return 1\n$$"
+        )
