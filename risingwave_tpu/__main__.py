@@ -52,6 +52,7 @@ def serve(args) -> None:
     def clock():
         while not stop.is_set():
             try:
+                session.pump_sources()
                 runtime.tick()
             except Exception as e:  # noqa: BLE001 — keep serving
                 print(f"barrier error: {e}")

@@ -29,8 +29,11 @@ from risingwave_tpu.ops.hash_table import lookup
 
 
 @partial(jax.jit, static_argnames=("out_cols", "jt"))
-def _probe_step(table, values, vnulls, chunk, key_lanes, out_cols, jt):
-    slots, found = lookup(table, key_lanes, chunk.valid)
+def _probe_step(table, values, vnulls, chunk, key_lanes, key_ok, out_cols, jt):
+    # SQL: NULL = anything is unknown — NULL-keyed rows never match
+    # (their lane value 0 would otherwise hit a real pk=0 row)
+    slots, found = lookup(table, key_lanes, chunk.valid & key_ok)
+    found = found & key_ok
     cap = table.capacity
     idx = jnp.where(found, slots, cap - 1)  # safe gather lane
     cols = dict(chunk.columns)
@@ -78,6 +81,9 @@ class TemporalJoinExecutor(Executor):
                 chunk.col(k).astype(tk.dtype)
                 for k, tk in zip(self.left_keys, self.right.table.keys)
             )
+            key_ok = jnp.ones(chunk.capacity, jnp.bool_)
+            for k in self.left_keys:
+                key_ok = key_ok & ~chunk.null_of(k)
             return [
                 _probe_step(
                     self.right.table,
@@ -85,6 +91,7 @@ class TemporalJoinExecutor(Executor):
                     self.right.state.vnulls,
                     chunk,
                     key_lanes,
+                    key_ok,
                     self.output_cols,
                     self.join_type,
                 )
@@ -102,6 +109,12 @@ class TemporalJoinExecutor(Executor):
         }
         live = np.flatnonzero(np.asarray(chunk.valid))
         for j, i in enumerate(live[:n]):
+            if any(
+                data.get(k + "__null") is not None
+                and data[k + "__null"][j]
+                for k in self.left_keys
+            ):
+                continue  # NULL key never matches (SQL unknown)
             key = tuple(data[k][j].item() for k in self.left_keys)
             row = snap.get(key)
             if row is not None:

@@ -367,7 +367,12 @@ def register_py_udf(
     Fields: VARCHAR/JSONB args decode dictionary codes to python
     strings/objects before the call and the return value encodes back;
     DECIMAL crosses as Decimal. Vectorization happens in the callback;
-    error rows yield SQL NULL."""
+    error rows yield SQL NULL.
+
+    The registry is process-global (the reference keeps functions in a
+    cluster catalog): a UDF binds the dictionary of the session that
+    created it, so VARCHAR/JSONB UDFs are only meaningful in that
+    session — a second in-process session must CREATE its own."""
     import json as _json
     from decimal import Decimal as _Dec
 
@@ -376,6 +381,11 @@ def register_py_udf(
     if not arg_fields:
         raise NotImplementedError(
             "zero-argument UDFs are not supported (use a literal)"
+        )
+    lname = name.lower()
+    if lname in _REGISTRY and lname not in _UDF_SIGS:
+        raise ValueError(
+            f"{lname!r} is a builtin function and cannot be replaced"
         )
     dict_types = (_DT.VARCHAR, _DT.JSONB)
     if strings is None and (
@@ -450,6 +460,10 @@ def register_py_udf(
 
 
 def drop_function(name: str) -> bool:
+    """Drop a UDF; builtins are not droppable (only names registered
+    through register_py_udf qualify)."""
+    if name.lower() not in _UDF_SIGS:
+        return False
     _UDF_SIGS.pop(name.lower(), None)
     return _REGISTRY.pop(name.lower(), None) is not None
 

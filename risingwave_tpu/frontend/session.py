@@ -80,6 +80,8 @@ class SqlSession:
         # temporal joins probe a relation's materialize state directly
         self.planner.mviews = self.batch.tables
         self.dml = DmlManager(self.runtime, catalog, strings=self.strings)
+        # CREATE SOURCE registry: name -> GenericSourceExecutor
+        self.sources: Dict[str, object] = {}
 
     def execute(self, sql: str) -> Tuple[Dict[str, np.ndarray], str]:
         """Returns (result columns, command tag). Non-queries return an
@@ -89,6 +91,8 @@ class SqlSession:
 
     def _execute_locked(self, sql: str) -> Tuple[Dict[str, np.ndarray], str]:
         stripped = sql.lstrip()
+        if stripped[:13].lower().startswith("create source"):
+            return self._create_source(stripped)
         if stripped[:15].lower().startswith("create function"):
             return self._create_function(stripped)
         if stripped[:13].lower().startswith("drop function"):
@@ -225,6 +229,92 @@ class SqlSession:
         out = self._decode_output(stmt, out)
         n = len(next(iter(out.values()))) if out else 0
         return out, f"SELECT {n}"
+
+    def _create_source(self, sql: str):
+        """CREATE SOURCE name (cols) WITH (connector='filelog'|'datagen',
+        ... , format='json'|'csv') — external ingestion through the
+        connector framework (reference: handler/create_source.rs +
+        src/connector/). MVs FROM the source get its polled chunks via
+        ``pump_sources`` (the CLI clock calls it every tick)."""
+        import re
+
+        from risingwave_tpu.connectors.framework import (
+            CsvParser,
+            DatagenSource,
+            FileLogSource,
+            GenericSourceExecutor,
+            JsonParser,
+        )
+
+        m = re.match(
+            r"(?is)^create\s+source\s+(\w+)\s*\((.*?)\)\s*"
+            r"with\s*\((.*?)\)\s*;?\s*$",
+            sql,
+        )
+        if not m:
+            raise SyntaxError(
+                "CREATE SOURCE name (col TYPE, ...) WITH (connector=..., "
+                "format=...)"
+            )
+        name, cols, props_raw = m.groups()
+        if name in self.catalog.tables:
+            raise ValueError(f"relation {name!r} already exists")
+        props = {}
+        for kv in re.findall(r"(\w+)\s*=\s*'([^']*)'", props_raw):
+            props[kv[0].lower()] = kv[1]
+        fields = []
+        # split on commas OUTSIDE parens: DECIMAL(10,2) is one type
+        for c in re.split(r",(?![^(]*\))", cols):
+            c = c.strip()
+            if not c:
+                continue
+            parts = c.split(None, 1)
+            if len(parts) != 2:
+                raise SyntaxError(f"column {c!r}: expected 'name TYPE'")
+            fields.append(
+                _parse_type_word(parts[0], parts[1].replace(" ", ""))
+            )
+        schema = Schema(fields)
+        kind = props.get("connector")
+        if kind == "filelog":
+            conn = FileLogSource(props["path"])
+        elif kind == "datagen":
+            conn = DatagenSource(
+                schema, split_num=int(props.get("split_num", "1"))
+            )
+        else:
+            raise ValueError(f"unknown connector {kind!r}")
+        fmt = props.get("format", "json")
+        parser = (
+            JsonParser(schema) if fmt == "json" else CsvParser(schema)
+        )
+        src = GenericSourceExecutor(
+            conn, parser, table_id=f"{name}.source", strings=self.strings
+        )
+        self.sources[name] = src
+        self.catalog.tables[name] = schema
+        self.runtime.register_state(src)
+        return {}, "CREATE_SOURCE"
+
+    def pump_sources(
+        self, max_rows_per_split: int = 4096, capacity: int = 1 << 12
+    ) -> int:
+        """Poll every source once and route chunks into the consuming
+        fragments (the source executor's stream loop, driven by the
+        host clock). Returns rows ingested."""
+        total = 0
+        with self.runtime.lock:
+            for name, src in self.sources.items():
+                if not self.dml._targets.get(name):
+                    # no consumer yet: polling would advance offsets and
+                    # permanently drop rows read before the first MV
+                    continue
+                src.discover()
+                for chunk in src.poll(max_rows_per_split, capacity):
+                    total += int(np.asarray(chunk.valid).sum())
+                    for frag, side in self.dml._targets.get(name, ()):
+                        self.runtime.push(frag, chunk, side)
+        return total
 
     def _create_function(self, sql: str):
         """CREATE FUNCTION name(args) RETURNS type LANGUAGE python AS

@@ -225,6 +225,8 @@ class Parser:
                     if self._accept_word("primary"):
                         if not self._accept_word("key"):
                             raise SyntaxError("expected KEY after PRIMARY")
+                        if pk:
+                            raise SyntaxError("multiple primary keys")
                         self.expect("op", "(")
                         pkc = [self.expect("ident").value]
                         while self.accept("op", ","):
@@ -250,6 +252,8 @@ class Parser:
                     if self._accept_word("primary"):
                         if not self._accept_word("key"):
                             raise SyntaxError("expected KEY after PRIMARY")
+                        if pk:
+                            raise SyntaxError("multiple primary keys")
                         pk = (cname,)
                     cols.append((cname, tword))
                     if not self.accept("op", ","):

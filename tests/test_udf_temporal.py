@@ -146,3 +146,20 @@ def test_zero_arg_udf_rejected(session):
             "CREATE FUNCTION one() RETURNS BIGINT LANGUAGE python "
             "AS $$\ndef one():\n    return 1\n$$"
         )
+
+
+def test_temporal_join_null_key_never_matches(session):
+    """SQL: NULL = anything is unknown — a NULL stream key must not
+    match a real pk=0 row (lane padding value)."""
+    session.execute("CREATE TABLE dim0 (k BIGINT PRIMARY KEY, v BIGINT)")
+    session.execute("INSERT INTO dim0 VALUES (0, 7)")
+    session.execute("CREATE TABLE s0 (sk BIGINT, n BIGINT)")
+    session.execute(
+        "CREATE MATERIALIZED VIEW j0 AS "
+        "SELECT n, v FROM s0 JOIN dim0 FOR SYSTEM_TIME AS OF PROCTIME() "
+        "ON s0.sk = dim0.k"
+    )
+    session.execute("INSERT INTO s0 VALUES (NULL, 1), (0, 2)")
+    out, _ = session.execute("SELECT n, v FROM j0")
+    assert list(out["n"]) == [2]  # NULL-keyed row dropped, real 0 matches
+    assert list(out["v"]) == [7]
