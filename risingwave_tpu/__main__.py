@@ -38,7 +38,14 @@ def serve(args) -> None:
         if cfg is not None
         else StreamingRuntime(store)
     )
-    session = SqlSession(Catalog({}), runtime)
+    from risingwave_tpu.storage.meta_backup import DDL_PATH
+
+    if store is not None and store.exists(DDL_PATH):
+        # warm restart: replay the DDL log, recover state (meta_backup)
+        session = SqlSession.restore(runtime)
+        print(f"restored {len(session.meta.ddl())} DDL statements")
+    else:
+        session = SqlSession(Catalog({}), runtime)
     pg = PgServer(session, port=args.port).start()
     mport = REGISTRY.serve(args.metrics_port)
     print(
@@ -68,9 +75,40 @@ def serve(args) -> None:
         pg.shutdown()
 
 
+def ctl(args) -> None:
+    """risectl analogue: backup management over a state dir."""
+    from risingwave_tpu.storage.meta_backup import (
+        create_backup,
+        list_backups,
+        restore_backup,
+    )
+    from risingwave_tpu.storage.object_store import LocalFsObjectStore
+
+    store = LocalFsObjectStore(args.state_dir)
+    if args.ctl_cmd == "backup-create":
+        print(create_backup(store, args.backup_id))
+    elif args.ctl_cmd == "backup-list":
+        for b in list_backups(store):
+            print(b)
+    elif args.ctl_cmd == "backup-restore":
+        dst = LocalFsObjectStore(args.dest)
+        n = restore_backup(store, args.backup_id, dst)
+        print(f"restored {n} blobs into {args.dest}")
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(prog="risingwave_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
+    c = sub.add_parser("ctl", help="ops commands (risectl analogue)")
+    csub = c.add_subparsers(dest="ctl_cmd", required=True)
+    for name in ("backup-create", "backup-list", "backup-restore"):
+        cc = csub.add_parser(name)
+        cc.add_argument("--state-dir", required=True)
+        if name != "backup-list":
+            cc.add_argument("--backup-id", required=True)
+        if name == "backup-restore":
+            cc.add_argument("--dest", required=True)
+    c.set_defaults(fn=ctl)
     s = sub.add_parser("serve", help="start a single-node cluster")
     s.add_argument("--port", type=int, default=4566)
     s.add_argument("--metrics-port", type=int, default=0)

@@ -112,22 +112,25 @@ class CsvParser(Parser):
         text = raw.decode() if isinstance(raw, bytes) else raw
         try:
             row = next(csv.reader(io.StringIO(text), delimiter=self.delimiter))
-        except StopIteration:
+            out = []
+            for f, cell in zip(self.schema.fields, row):
+                if cell == "":
+                    out.append(None)
+                elif f.dtype.value in ("varchar", "jsonb"):
+                    out.append(cell)
+                elif f.dtype.value in ("float32", "float64"):
+                    out.append(float(cell))
+                elif f.dtype.value == "boolean":
+                    out.append(cell.lower() in ("t", "true", "1"))
+                elif f.dtype.value == "decimal":
+                    out.append(cell)  # Decimal-exact via composite encode
+                else:
+                    out.append(int(cell))
+        except (StopIteration, ValueError):
+            # bad cell/empty message -> dead-letter drop, same as the
+            # JSON parser: one malformed line must never poison the
+            # batch (offsets have already advanced past it)
             return None
-        out = []
-        for f, cell in zip(self.schema.fields, row):
-            if cell == "":
-                out.append(None)
-            elif f.dtype.value in ("varchar", "jsonb"):
-                out.append(cell)
-            elif f.dtype.value in ("float32", "float64"):
-                out.append(float(cell))
-            elif f.dtype.value == "boolean":
-                out.append(cell.lower() in ("t", "true", "1"))
-            elif f.dtype.value == "decimal":
-                out.append(cell)  # Decimal-exact via composite encode
-            else:
-                out.append(int(cell))
         out.extend([None] * (len(self.schema.fields) - len(out)))
         return tuple(out)
 
@@ -173,8 +176,11 @@ class DatagenSource(SplitEnumerator, SplitReader):
                 if spec.get("kind") == "random":
                     lo = int(spec.get("start", 0))
                     hi = int(spec.get("end", 1 << 20))
+                    # field identity in the seed: same-range fields must
+                    # draw INDEPENDENT streams, not identical ones
+                    fseed = hash((self.seed, f.name)) & 0x7FFFFFFF
                     rng = np.random.default_rng(
-                        self.seed * 1_000_003 + int(gids[j])
+                        fseed * 1_000_003 + int(gids[j])
                     )
                     row[f.name] = int(rng.integers(lo, hi))
                 else:
@@ -201,21 +207,27 @@ class FileLogSource(SplitEnumerator, SplitReader):
         return out
 
     def read(self, split: SplitMeta, offset: int, max_rows: int):
+        """``offset`` is a BYTE position: each poll seeks directly to
+        the frontier (a line index would re-scan the whole file every
+        poll — quadratic over the source lifetime). Lines missing their
+        trailing newline are in-flight producer writes and wait."""
         path = os.path.join(
             self.directory, f"partition-{split.split_id}.log"
         )
         rows: List[str] = []
+        pos = offset
         if os.path.exists(path):
-            with open(path, "r") as f:
-                for i, line in enumerate(f):
-                    if i < offset:
-                        continue
-                    if len(rows) >= max_rows:
+            with open(path, "rb") as f:
+                f.seek(offset)
+                while len(rows) < max_rows:
+                    line = f.readline()
+                    if not line or not line.endswith(b"\n"):
                         break
-                    line = line.rstrip("\n")
-                    if line:
-                        rows.append(line)
-        return rows, offset + len(rows)
+                    pos = f.tell()
+                    text = line[:-1].decode()
+                    if text:
+                        rows.append(text)
+        return rows, pos
 
     @staticmethod
     def append(directory: str, partition: int, messages: Iterable[str]):
@@ -311,7 +323,6 @@ class GenericSourceExecutor(Executor, Checkpointable):
         self._committed = dict(self.offsets)
         ids = sorted(self.offsets)
         codes = np.asarray([_split_code(i) for i in ids], np.int64)
-        self._id_by_code = {int(c): i for c, i in zip(codes, ids)}
         return [
             StateDelta(
                 self.table_id,

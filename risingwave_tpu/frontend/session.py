@@ -82,6 +82,46 @@ class SqlSession:
         self.dml = DmlManager(self.runtime, catalog, strings=self.strings)
         # CREATE SOURCE registry: name -> GenericSourceExecutor
         self.sources: Dict[str, object] = {}
+        self._replaying = False
+        self.meta = None
+        if getattr(self.runtime, "mgr", None) is not None:
+            # durable meta: DDL log + dictionary snapshots ride the
+            # same object store as Hummock state (storage/meta_backup)
+            from risingwave_tpu.storage.meta_backup import (
+                DictionaryPersistor,
+                MetaStore,
+            )
+
+            self.meta = MetaStore(self.runtime.mgr.store)
+            dump = self.meta.load_strings()
+            if dump:
+                for t in dump:
+                    self.strings.encode_one(t)
+            self.runtime.register_state(
+                DictionaryPersistor(self.strings, self.meta)
+            )
+
+    @classmethod
+    def restore(cls, runtime: StreamingRuntime, capacity: int = 1 << 14):
+        """Bootstrap a session from a durable store: replay the DDL log
+        (structure only — no barriers, no backfill), then recover every
+        executor's state from the last committed epoch (the reference's
+        cluster bootstrap: catalog load + recovery.rs:353)."""
+        session = cls(Catalog({}), runtime, capacity=capacity)
+        if session.meta is None:
+            raise ValueError("restore needs a runtime with an object store")
+        session._replaying = True
+        try:
+            for sql in session.meta.ddl():
+                session.execute(sql)
+        finally:
+            session._replaying = False
+        runtime.recover()
+        return session
+
+    def _log_ddl(self, sql: str) -> None:
+        if self.meta is not None and not self._replaying:
+            self.meta.append_ddl(sql)
 
     def execute(self, sql: str) -> Tuple[Dict[str, np.ndarray], str]:
         """Returns (result columns, command tag). Non-queries return an
@@ -105,6 +145,7 @@ class SqlSession:
                 raise SyntaxError("DROP FUNCTION <name>")
             if not F.drop_function(m.group(1)):
                 raise KeyError(f"unknown function {m.group(1)!r}")
+            self._log_ddl(stripped)
             return {}, "DROP_FUNCTION"
         if stripped[:8].lower() == "explain ":
             from risingwave_tpu.sql.optimizer import explain_sql
@@ -172,6 +213,7 @@ class SqlSession:
             self.runtime.register(stmt.name, Pipeline(chain))
             self.batch.register(stmt.name, mview)
             self.dml.add_target(stmt.name, stmt.name, "single")
+            self._log_ddl(sql)
             return {}, "CREATE_TABLE"
         if isinstance(stmt, P.CreateMaterializedView):
             planned = self.planner.plan(sql)
@@ -191,7 +233,14 @@ class SqlSession:
             self.runtime.register(planned.name, planned.pipeline)
             try:
                 for s, side in frag_inputs.items():
-                    self.runtime.subscribe(s, planned.name, side=side)
+                    # replay restores state from checkpoints afterwards:
+                    # backfilling from empty uprights would double rows
+                    self.runtime.subscribe(
+                        s,
+                        planned.name,
+                        side=side,
+                        backfill=not self._replaying,
+                    )
             except BaseException:
                 # keep the graph consistent on backfill failure: a
                 # half-registered fragment would crash later barriers
@@ -211,9 +260,11 @@ class SqlSession:
             if len(frag_inputs) < len(planned.inputs):
                 self.dml.attach(planned, skip=frag_inputs.keys())
             self.batch.register(planned.name, planned.mview)
-            # CREATE returns once the backfill snapshot is visible
-            # (the reference blocks DDL on backfill completion)
-            self.runtime.barrier()
+            self._log_ddl(sql)
+            if not self._replaying:
+                # CREATE returns once the backfill snapshot is visible
+                # (the reference blocks DDL on backfill completion)
+                self.runtime.barrier()
             return {}, "CREATE_MATERIALIZED_VIEW"
         if isinstance(stmt, P.InsertValues):
             n = self.dml.execute(sql)
@@ -294,6 +345,7 @@ class SqlSession:
         self.sources[name] = src
         self.catalog.tables[name] = schema
         self.runtime.register_state(src)
+        self._log_ddl(sql)
         return {}, "CREATE_SOURCE"
 
     def pump_sources(
@@ -357,6 +409,7 @@ class SqlSession:
         F.register_py_udf(
             name, fn, ret_field, arg_fields, strings=self.strings
         )
+        self._log_ddl(sql)
         return {}, "CREATE_FUNCTION"
 
     def _decode_output(self, stmt, out):

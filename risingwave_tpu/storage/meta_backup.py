@@ -1,0 +1,158 @@
+"""Meta store (catalog/DDL persistence) + cluster backup/restore.
+
+Reference roles:
+- meta store / catalog persistence (src/meta/src/storage/, sea-orm
+  model_v2/): DDL survives restarts. Here the meta store is a DDL log
+  + the session string dictionary, persisted as JSON blobs in the same
+  object store as Hummock state (the reference uses etcd/SQL; ours
+  rides the durability boundary that already exists);
+- backup/restore (src/storage/backup/, backup_reader.rs): a backup is
+  a SELF-CONTAINED prefix holding the meta snapshot, the version
+  manifest, and every SST the manifest references — restorable into an
+  empty store.
+
+Restart flow (the reference's cluster bootstrap): replay the DDL log
+with backfill/barriers suppressed (structure only), then
+``runtime.recover()`` restores every executor's state from the last
+committed epoch — tables, MVs, source offsets, dictionary.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+import numpy as np
+
+from risingwave_tpu.storage.object_store import ObjectStore
+
+DDL_PATH = "meta/ddl.json"
+STRINGS_PATH = "meta/strings.json"
+BACKUP_PREFIX = "backup"
+
+
+class MetaStore:
+    """Durable DDL log + dictionary snapshot."""
+
+    def __init__(self, store: ObjectStore):
+        self.store = store
+        self._ddl: List[str] = []
+        if store.exists(DDL_PATH):
+            self._ddl = json.loads(store.read(DDL_PATH))
+
+    def append_ddl(self, sql: str) -> None:
+        self._ddl.append(sql)
+        self.store.put(DDL_PATH, json.dumps(self._ddl).encode())
+
+    def ddl(self) -> List[str]:
+        return list(self._ddl)
+
+    def save_strings(self, dump: List[str]) -> None:
+        self.store.put(STRINGS_PATH, json.dumps(dump).encode())
+
+    def load_strings(self) -> Optional[List[str]]:
+        if not self.store.exists(STRINGS_PATH):
+            return None
+        return json.loads(self.store.read(STRINGS_PATH))
+
+
+from risingwave_tpu.storage.state_table import Checkpointable
+
+
+class DictionaryPersistor(Checkpointable):
+    """Aux state object: persists the session dictionary at checkpoint
+    STAGE time — strictly BEFORE the manifest that references its codes
+    becomes durable (persisting after the commit left a crash window
+    where committed state held codes the persisted dictionary lacked).
+    A dictionary persisted ahead of a failed commit is harmless: extra
+    codes decode nothing."""
+
+    def __init__(self, strings, meta: MetaStore):
+        self.strings = strings
+        self.meta = meta
+        self._persisted_len = 0
+
+    def checkpoint_table_ids(self):
+        return ()
+
+    def checkpoint_delta(self):
+        if len(self.strings) != self._persisted_len:
+            self.meta.save_strings(self.strings.dump())
+            self._persisted_len = len(self.strings)
+        return []
+
+    def restore_state(self, table_id, key_cols, value_cols):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# backup / restore
+# ---------------------------------------------------------------------------
+
+
+def create_backup(store: ObjectStore, backup_id: str) -> dict:
+    """Copy the meta snapshot + current manifest + every referenced SST
+    into ``backup/<id>/`` (self-contained; reference: meta snapshot
+    backup, src/storage/backup/)."""
+    from risingwave_tpu.storage.state_table import MANIFEST
+
+    manifest_paths = [
+        p
+        for p in store.list("")
+        if p.endswith(MANIFEST)
+        and not p.startswith(BACKUP_PREFIX + "/")
+        # a backup must not recursively swallow older backups (their
+        # manifests reference SSTs the live GC may have deleted)
+    ]
+    copied = []
+    ssts = 0
+    for mp in manifest_paths:
+        manifest = json.loads(store.read(mp))
+        dst = f"{BACKUP_PREFIX}/{backup_id}/{mp}"
+        store.put(dst, store.read(mp))
+        copied.append(mp)
+        # version["tables"]: table_id -> [{"path", "epoch"}, ...]
+        for entries in manifest.get("tables", {}).values():
+            for e in entries:
+                store.put(
+                    f"{BACKUP_PREFIX}/{backup_id}/{e['path']}",
+                    store.read(e["path"]),
+                )
+                ssts += 1
+    for p in (DDL_PATH, STRINGS_PATH):
+        if store.exists(p):
+            store.put(f"{BACKUP_PREFIX}/{backup_id}/{p}", store.read(p))
+            copied.append(p)
+    summary = {
+        "backup_id": backup_id,
+        "manifests": len(manifest_paths),
+        "ssts": ssts,
+        "meta": [p for p in copied if p.startswith("meta/")],
+    }
+    store.put(
+        f"{BACKUP_PREFIX}/{backup_id}/BACKUP_META",
+        json.dumps(summary).encode(),
+    )
+    return summary
+
+
+def list_backups(store: ObjectStore) -> List[str]:
+    out = []
+    for p in store.list(BACKUP_PREFIX + "/"):
+        if p.endswith("/BACKUP_META"):
+            out.append(p.split("/")[1])
+    return sorted(set(out))
+
+
+def restore_backup(
+    src: ObjectStore, backup_id: str, dst: ObjectStore
+) -> int:
+    """Materialize a backup into ``dst`` (typically an empty store for
+    a fresh cluster). Returns blobs restored."""
+    prefix = f"{BACKUP_PREFIX}/{backup_id}/"
+    blobs = [p for p in src.list(prefix) if not p.endswith("BACKUP_META")]
+    if not blobs:
+        raise KeyError(f"unknown backup {backup_id!r}")
+    for p in blobs:
+        dst.put(p[len(prefix):], src.read(p))
+    return len(blobs)
