@@ -126,7 +126,7 @@ class CsvParser(Parser):
                     out.append(cell)  # Decimal-exact via composite encode
                 else:
                     out.append(int(cell))
-        except (StopIteration, ValueError):
+        except (StopIteration, ValueError, csv.Error):
             # bad cell/empty message -> dead-letter drop, same as the
             # JSON parser: one malformed line must never poison the
             # batch (offsets have already advanced past it)
@@ -176,9 +176,15 @@ class DatagenSource(SplitEnumerator, SplitReader):
                 if spec.get("kind") == "random":
                     lo = int(spec.get("start", 0))
                     hi = int(spec.get("end", 1 << 20))
-                    # field identity in the seed: same-range fields must
-                    # draw INDEPENDENT streams, not identical ones
-                    fseed = hash((self.seed, f.name)) & 0x7FFFFFFF
+                    # field identity in the seed: same-range fields
+                    # must draw INDEPENDENT streams. crc32, not hash():
+                    # recovery re-reads committed offsets and must
+                    # regenerate IDENTICAL rows across process restarts
+                    import zlib
+
+                    fseed = (
+                        zlib.crc32(f.name.encode()) ^ self.seed
+                    ) & 0x7FFFFFFF
                     rng = np.random.default_rng(
                         fseed * 1_000_003 + int(gids[j])
                     )
@@ -192,9 +198,10 @@ class DatagenSource(SplitEnumerator, SplitReader):
 class FileLogSource(SplitEnumerator, SplitReader):
     """Partitioned append-only log directory — the kafka-shaped source
     (source/kafka/ without brokers): ``<dir>/partition-<i>.log`` holds
-    one message per line; the line index IS the offset, so committed
-    offsets resume exactly after recovery, and independent producers
-    append concurrently."""
+    one message per line; the BYTE position after the last consumed
+    line is the offset, so committed offsets resume exactly after
+    recovery and each poll seeks straight to the frontier. Independent
+    producers append concurrently."""
 
     def __init__(self, directory: str):
         self.directory = directory

@@ -335,6 +335,14 @@ class ShardedHashAgg(Executor, Checkpointable):
         )
 
 
+def _sharded_agg_shard_occupancy(self):
+    """Per-shard claimed-slot counts (autoscale policy input,
+    parallel/scale.py). One packed device read."""
+    return np.asarray(
+        jnp.sum((self.table.fp1 != jnp.uint32(0)).astype(jnp.int32), axis=1)
+    )
+
+
 def _sharded_agg_checkpoint_delta(self) -> List[StateDelta]:
     """Stage ALL shards' changed rows as ONE table (keys are globally
     unique across shards); same lane naming as the single-chip agg so
@@ -415,6 +423,7 @@ def _sharded_agg_restore_state(self, table_id, key_cols, value_cols) -> None:
 
 
 ShardedHashAgg.checkpoint_delta = _sharded_agg_checkpoint_delta
+ShardedHashAgg.shard_occupancy = _sharded_agg_shard_occupancy
 ShardedHashAgg.restore_state = _sharded_agg_restore_state
 
 
