@@ -24,6 +24,9 @@ class BatchQueryEngine:
 
     def __init__(self, tables: Dict[str, MaterializeExecutor]):
         self.tables = dict(tables)
+        # distributed-mode task count, 0/1 = local mode; flipped like
+        # the reference's QUERY_MODE session variable
+        self.distributed_tasks = 0
 
     def register(self, name: str, mview: MaterializeExecutor) -> None:
         self.tables[name] = mview
@@ -33,6 +36,18 @@ class BatchQueryEngine:
             stmt = P.parse(sql)
         if not isinstance(stmt, P.Select):
             raise ValueError("batch engine runs SELECT only")
+        if self.distributed_tasks > 1:
+            # distributed mode first; non-partitionable shapes fall
+            # back to local (scheduler/local.rs:60 mode split)
+            from risingwave_tpu.batch.distributed import (
+                DistributedBatchRunner,
+            )
+
+            out = DistributedBatchRunner(
+                self, self.distributed_tasks
+            ).query(stmt)
+            if out is not None:
+                return out
         if isinstance(stmt.from_, P.Join):
             cols, alias = self._join_scan(stmt.from_), None
         elif isinstance(stmt.from_, P.TableRef):
@@ -40,6 +55,15 @@ class BatchQueryEngine:
             cols, alias = mv.to_numpy(), stmt.from_.alias
         else:
             raise ValueError("batch FROM must be an MV name or join")
+        out = self._run_select_over(stmt, cols, alias)
+
+        # OrderBy + Limit (src/batch/src/executor/{order_by,limit}.rs)
+        out = self._order_limit(stmt, out)
+        return out
+
+    def _run_select_over(self, stmt, cols, alias=None):
+        """Filter -> agg/projection over one scan's columns (the task
+        body shared by local mode and distributed partition tasks)."""
         n = len(next(iter(cols.values()))) if cols else 0
 
         # RowSeqScan -> chunk -> Filter via the shared expr framework
@@ -74,8 +98,9 @@ class BatchQueryEngine:
                     out[name] = vals
                     if nl is not None and nl.any():
                         out[name + "__null"] = nl
+        return out
 
-        # OrderBy + Limit (src/batch/src/executor/{order_by,limit}.rs)
+    def _order_limit(self, stmt, out):
         if stmt.order_by:
             lanes = []
             for ident, desc in reversed(stmt.order_by):
