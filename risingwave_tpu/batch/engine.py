@@ -61,6 +61,24 @@ class BatchQueryEngine:
         out = self._order_limit(stmt, out)
         return out
 
+    @staticmethod
+    def _chunk_from_cols(cols, cap):
+        """Snapshot columns -> DataChunk; object-dtype lanes (python-
+        backend MVs embed None for SQL NULL) split into a numeric lane
+        + null lane so expression eval stays NULL-strict."""
+        data, nulls = {}, {}
+        for k, v in cols.items():
+            a = np.asarray(v)
+            if a.dtype == object:
+                vals = a.tolist()
+                nl = np.asarray([x is None for x in vals], bool)
+                data[k] = np.asarray([0 if x is None else x for x in vals])
+                if nl.any():
+                    nulls[k] = nl
+            else:
+                data[k] = a
+        return DataChunk.from_numpy(data, cap, nulls=nulls or None)
+
     def _run_select_over(self, stmt, cols, alias=None):
         """Filter -> agg/projection over one scan's columns (the task
         body shared by local mode and distributed partition tasks)."""
@@ -71,7 +89,7 @@ class BatchQueryEngine:
         binder = Binder(schema, alias)
         if n and stmt.where is not None:
             cap = max(1, 1 << (n - 1).bit_length())
-            chunk = DataChunk.from_numpy(cols, cap)
+            chunk = self._chunk_from_cols(cols, cap)
             keep_v, keep_n = compile_scalar(stmt.where, binder).eval(chunk)
             keep = np.asarray(keep_v).astype(bool)
             if keep_n is not None:
@@ -91,9 +109,16 @@ class BatchQueryEngine:
                     name = item.alias or f"{item.expr.name}_{i}"
                     out[name] = self._scalar_agg(item.expr, cols, n, binder)
                 else:
-                    name = item.alias or (
-                        item.expr.name if isinstance(item.expr, P.Ident) else f"col{i}"
-                    )
+                    # unaliased names must match sql/typing's inference
+                    # (the result edge keys decode on them)
+                    if item.alias:
+                        name = item.alias
+                    elif isinstance(item.expr, P.Ident):
+                        name = item.expr.name
+                    elif isinstance(item.expr, P.FuncCall):
+                        name = f"{item.expr.name}_{i}"
+                    else:
+                        name = f"col{i}"
                     vals, nl = self._eval_item(item.expr, cols, n, binder)
                     out[name] = vals
                     if nl is not None and nl.any():
@@ -201,7 +226,7 @@ class BatchQueryEngine:
         if isinstance(ast, P.Ident):
             return cols[binder.resolve(ast)], None
         cap = max(1, 1 << max(0, (n - 1)).bit_length()) if n else 1
-        chunk = DataChunk.from_numpy(cols, cap)
+        chunk = self._chunk_from_cols(cols, cap)
         v, nl = compile_scalar(ast, binder).eval(chunk)
         return np.asarray(v)[:n], (
             np.asarray(nl)[:n] if nl is not None else None

@@ -32,6 +32,9 @@ from risingwave_tpu.expr.expr import EvalResult, Expr, _null_or
 _REGISTRY: Dict[str, Tuple[int, int, Callable]] = {}
 # UDF name -> (out Field, arg Fields) for type inference at the edges
 _UDF_SIGS: Dict[str, Tuple[object, Tuple[object, ...]]] = {}
+# session-registered STRING BUILTINS: typed like UDFs but protected —
+# CREATE FUNCTION cannot shadow them and DROP FUNCTION refuses
+_PROTECTED: set = set()
 
 
 def register(name, min_arity, max_arity=None):
@@ -359,6 +362,7 @@ def register_py_udf(
     out_field,
     arg_fields,
     strings=None,
+    protected: bool = False,
 ) -> None:
     """Register a scalar python UDF callable under ``name`` (lowercased
     — SQL identifiers fold to lower case in the lexer).
@@ -383,7 +387,11 @@ def register_py_udf(
             "zero-argument UDFs are not supported (use a literal)"
         )
     lname = name.lower()
-    if lname in _REGISTRY and lname not in _UDF_SIGS:
+    if lname in _REGISTRY and lname not in _UDF_SIGS and not protected:
+        raise ValueError(
+            f"{lname!r} is a builtin function and cannot be replaced"
+        )
+    if lname in _PROTECTED and not protected:
         raise ValueError(
             f"{lname!r} is a builtin function and cannot be replaced"
         )
@@ -457,12 +465,14 @@ def register_py_udf(
     arity = len(arg_fields)
     _REGISTRY[name.lower()] = (arity, arity, impl)
     _UDF_SIGS[name.lower()] = (out_field, tuple(arg_fields))
+    if protected:
+        _PROTECTED.add(name.lower())
 
 
 def drop_function(name: str) -> bool:
-    """Drop a UDF; builtins are not droppable (only names registered
-    through register_py_udf qualify)."""
-    if name.lower() not in _UDF_SIGS:
+    """Drop a UDF; builtins (kernel or protected string builtins) are
+    not droppable."""
+    if name.lower() not in _UDF_SIGS or name.lower() in _PROTECTED:
         return False
     _UDF_SIGS.pop(name.lower(), None)
     return _REGISTRY.pop(name.lower(), None) is not None

@@ -82,6 +82,7 @@ class SqlSession:
         self.dml = DmlManager(self.runtime, catalog, strings=self.strings)
         # CREATE SOURCE registry: name -> GenericSourceExecutor
         self.sources: Dict[str, object] = {}
+        self._register_string_builtins()
         self._replaying = False
         self.meta = None
         if getattr(self.runtime, "mgr", None) is not None:
@@ -280,6 +281,53 @@ class SqlSession:
         out = self._decode_output(stmt, out)
         n = len(next(iter(out.values()))) if out else 0
         return out, f"SELECT {n}"
+
+    def _register_string_builtins(self) -> None:
+        """Dictionary-backed string functions (reference: the string
+        half of src/expr/impl/src/scalar/). VARCHAR lanes carry codes,
+        so these run host-side through the same typed-callback path as
+        python UDFs, decode -> op -> encode against THIS session's
+        dictionary — always-fresh against dictionary growth (a baked
+        code->code gather table would go stale inside jitted programs;
+        expr.functions.StringFunc offers that faster form for
+        fixed-dictionary Python-API pipelines). Registered PROTECTED:
+        CREATE/DROP FUNCTION cannot shadow or remove them. The
+        registry is process-global, so the LATEST session's dictionary
+        wins — one live SQL session per process is the contract (the
+        reference scopes functions per cluster the same way)."""
+        from risingwave_tpu.expr import functions as F
+
+        def _substr(s, start, n):
+            # PostgreSQL substr: positions are 1-based; a non-positive
+            # start consumes length; negative length is an error
+            if n < 0:
+                raise ValueError("negative substring length")
+            a, b = max(start, 1), max(start + n, 1)
+            return s[a - 1 : b - 1]
+
+        V = Field("s", DataType.VARCHAR)
+        I = Field("n", DataType.INT64)
+        sigs = {
+            "length": (I, (V,), lambda s: len(s)),
+            "upper": (V, (V,), lambda s: s.upper()),
+            "lower": (V, (V,), lambda s: s.lower()),
+            "trim": (V, (V,), lambda s: s.strip()),
+            "reverse": (V, (V,), lambda s: s[::-1]),
+            "concat": (V, (V, V), lambda a, b: a + b),
+            "substr": (V, (V, I, I), _substr),
+            "replace": (V, (V, V, V), lambda s, a, b: s.replace(a, b)),
+            "starts_with": (
+                Field("b", DataType.BOOLEAN),
+                (V, V),
+                lambda s, p: s.startswith(p),
+            ),
+            "char_length": (I, (V,), lambda s: len(s)),
+        }
+        for name, (out, args, fn) in sigs.items():
+            F.register_py_udf(
+                name, fn, out, list(args),
+                strings=self.strings, protected=True,
+            )
 
     def _create_source(self, sql: str):
         """CREATE SOURCE name (cols) WITH (connector='filelog'|'datagen',

@@ -156,6 +156,19 @@ def _rewrite_pred(pred, env, strings=None):
             a if isinstance(a, str) else _rewrite_pred(a, env, strings)
             for a in pred.args
         ]
+        from risingwave_tpu.expr.functions import udf_signature
+
+        sig = udf_signature(pred.name)
+        if sig is not None:
+            # typed-signature functions (UDFs + string builtins):
+            # literal args coerce into each parameter's lane domain
+            _out_f, arg_fs = sig
+            args = [
+                _lane_lit(a, f, strings)
+                if isinstance(a, P.Literal) and f is not None
+                else a
+                for a, f in zip(args, list(arg_fs) + [None] * len(args))
+            ]
         if pred.name in ("between", "in") and args:
             f = _field_of(env, args[0]) if isinstance(args[0], P.Ident) else None
             if f is not None:

@@ -163,3 +163,41 @@ def test_temporal_join_null_key_never_matches(session):
     out, _ = session.execute("SELECT n, v FROM j0")
     assert list(out["n"]) == [2]  # NULL-keyed row dropped, real 0 matches
     assert list(out["v"]) == [7]
+
+
+def test_string_builtin_functions(session):
+    session.execute("CREATE TABLE ev (name VARCHAR, n BIGINT)")
+    session.execute("INSERT INTO ev VALUES ('Alice', 1), ('bob jr', 2)")
+    out, _ = session.execute(
+        "SELECT n, length(name) AS l, upper(name) AS u, "
+        "substr(name, 1, 3) AS s3, replace(name, ' ', '_') AS r "
+        "FROM ev ORDER BY n"
+    )
+    assert list(out["l"]) == [5, 6]
+    assert list(out["u"]) == ["ALICE", "BOB JR"]
+    assert list(out["s3"]) == ["Ali", "bob"]
+    assert list(out["r"]) == ["Alice", "bob_jr"]
+    # usable in streaming MVs too (pure_callback under jit)
+    session.execute(
+        "CREATE MATERIALIZED VIEW m AS "
+        "SELECT n, concat(name, name) AS dd FROM ev"
+    )
+    out, _ = session.execute("SELECT n, dd FROM m ORDER BY n")
+    assert list(out["dd"]) == ["AliceAlice", "bob jrbob jr"]
+
+
+def test_unaliased_string_builtin_decodes(session):
+    session.execute("CREATE TABLE ev (name VARCHAR, n BIGINT)")
+    session.execute("INSERT INTO ev VALUES ('abc', 1)")
+    out, _ = session.execute("SELECT upper(name) FROM ev")
+    assert list(out["upper_0"]) == ["ABC"]  # decoded, not raw codes
+
+
+def test_string_builtins_protected(session):
+    with pytest.raises(ValueError, match="builtin"):
+        session.execute(
+            "CREATE FUNCTION upper(s VARCHAR) RETURNS VARCHAR "
+            "LANGUAGE python AS $$\ndef upper(s):\n    return s\n$$"
+        )
+    with pytest.raises(KeyError):
+        session.execute("DROP FUNCTION upper")
