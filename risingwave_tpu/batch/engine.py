@@ -104,6 +104,7 @@ class BatchQueryEngine:
             out = self._group_agg(stmt, cols, keys, binder)
         else:
             out = {}
+            chunk_cache = [None]
             for i, item in enumerate(stmt.items):
                 if isinstance(item.expr, P.FuncCall) and item.expr.name in AGG_FUNCS:
                     name = item.alias or f"{item.expr.name}_{i}"
@@ -119,7 +120,9 @@ class BatchQueryEngine:
                         name = f"{item.expr.name}_{i}"
                     else:
                         name = f"col{i}"
-                    vals, nl = self._eval_item(item.expr, cols, n, binder)
+                    vals, nl = self._eval_item(
+                        item.expr, cols, n, binder, chunk_cache
+                    )
                     out[name] = vals
                     if nl is not None and nl.any():
                         out[name + "__null"] = nl
@@ -220,13 +223,20 @@ class BatchQueryEngine:
             raise ValueError(f"unknown join type {jt!r}")
         return {c: m[c].to_numpy() for c in m.columns if c != "_merge"}
 
-    def _eval_item(self, ast, cols, n, binder):
+    def _eval_item(self, ast, cols, n, binder, chunk_cache=None):
         """-> (values, null_lane | None): computed items keep their SQL
-        NULLs (a UDF error row, NULL-strict arithmetic)."""
+        NULLs (a UDF error row, NULL-strict arithmetic). ``chunk_cache``
+        (a one-slot list) shares the converted DataChunk across a
+        select's items — the object-lane None-scan is O(rows*cols)."""
         if isinstance(ast, P.Ident):
             return cols[binder.resolve(ast)], None
         cap = max(1, 1 << max(0, (n - 1)).bit_length()) if n else 1
-        chunk = self._chunk_from_cols(cols, cap)
+        if chunk_cache is not None and chunk_cache[0] is not None:
+            chunk = chunk_cache[0]
+        else:
+            chunk = self._chunk_from_cols(cols, cap)
+            if chunk_cache is not None:
+                chunk_cache[0] = chunk
         v, nl = compile_scalar(ast, binder).eval(chunk)
         return np.asarray(v)[:n], (
             np.asarray(nl)[:n] if nl is not None else None

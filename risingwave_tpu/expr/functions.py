@@ -478,6 +478,10 @@ def drop_function(name: str) -> bool:
     return _REGISTRY.pop(name.lower(), None) is not None
 
 
+def is_protected(name: str) -> bool:
+    return name.lower() in _PROTECTED
+
+
 def udf_signature(name: str):
     """(out_field, arg_fields) | None — lets the result edge decode
     UDF outputs (dictionary codes / scaled decimals) by logical type."""

@@ -144,6 +144,11 @@ class SqlSession:
             m = re.match(r"(?is)^drop\s+function\s+(\w+)\s*;?\s*$", stripped)
             if not m:
                 raise SyntaxError("DROP FUNCTION <name>")
+            if F.is_protected(m.group(1)):
+                raise ValueError(
+                    f"{m.group(1)!r} is a builtin function and cannot "
+                    "be dropped"
+                )
             if not F.drop_function(m.group(1)):
                 raise KeyError(f"unknown function {m.group(1)!r}")
             self._log_ddl(stripped)
@@ -311,7 +316,7 @@ class SqlSession:
             "length": (I, (V,), lambda s: len(s)),
             "upper": (V, (V,), lambda s: s.upper()),
             "lower": (V, (V,), lambda s: s.lower()),
-            "trim": (V, (V,), lambda s: s.strip()),
+            "trim": (V, (V,), lambda s: s.strip(" ")),  # PG trim: spaces only
             "reverse": (V, (V,), lambda s: s[::-1]),
             "concat": (V, (V, V), lambda a, b: a + b),
             "substr": (V, (V, I, I), _substr),

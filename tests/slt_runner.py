@@ -1,0 +1,138 @@
+"""Minimal sqllogictest runner (the reference's e2e tier format).
+
+Reference: e2e_test/ *.slt files run by sqllogictest-rs against a
+risedev cluster (SURVEY.md §4). Directives supported:
+
+    statement ok
+    <sql>
+
+    statement error [substring]
+    <sql>
+
+    query <typestring> [rowsort]
+    <sql>
+    ----
+    <expected rows, one per line, columns tab-or-space separated>
+
+Blank lines separate records; ``#`` starts a comment. Values are
+compared as rendered text (NULL for SQL NULL).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import List, Optional
+
+
+@dataclass
+class Record:
+    kind: str  # "ok" | "error" | "query"
+    sql: str
+    expected: Optional[List[str]] = None
+    error_substr: str = ""
+    rowsort: bool = False
+    line: int = 0
+
+
+def parse_slt(text: str) -> List[Record]:
+    lines = text.splitlines()
+    out: List[Record] = []
+    i = 0
+    while i < len(lines):
+        line = lines[i].strip()
+        if not line or line.startswith("#"):
+            i += 1
+            continue
+        head = line.split()
+        start = i + 1
+        if head[0] == "statement":
+            sql_lines = []
+            i += 1
+            while i < len(lines) and lines[i].strip() and not lines[i].startswith("#"):
+                sql_lines.append(lines[i])
+                i += 1
+            rec = Record(
+                kind="ok" if head[1] == "ok" else "error",
+                sql="\n".join(sql_lines),
+                error_substr=" ".join(head[2:]) if head[1] == "error" else "",
+                line=start,
+            )
+            out.append(rec)
+        elif head[0] == "query":
+            rowsort = "rowsort" in head[2:]
+            sql_lines = []
+            i += 1
+            while i < len(lines) and lines[i].strip() != "----":
+                sql_lines.append(lines[i])
+                i += 1
+            i += 1  # skip ----
+            expected = []
+            while i < len(lines) and lines[i].strip():
+                expected.append(lines[i].rstrip())
+                i += 1
+            out.append(
+                Record(
+                    kind="query",
+                    sql="\n".join(sql_lines),
+                    expected=expected,
+                    rowsort=rowsort,
+                    line=start,
+                )
+            )
+        else:
+            raise SyntaxError(f"slt line {i + 1}: unknown directive {line!r}")
+        i += 1
+    return out
+
+
+def _render(v) -> str:
+    import numpy as np
+
+    if v is None:
+        return "NULL"
+    if isinstance(v, (bool, np.bool_)):
+        return "t" if bool(v) else "f"
+    if isinstance(v, float):
+        return f"{v:g}"
+    return str(v)
+
+
+def run_slt(session, text: str, path: str = "<slt>") -> int:
+    """Execute every record against a SqlSession; raises AssertionError
+    with file:line context on the first mismatch. Returns #records."""
+    records = parse_slt(text)
+    for rec in records:
+        where = f"{path}:{rec.line}"
+        if rec.kind == "ok":
+            session.execute(rec.sql)
+            continue
+        if rec.kind == "error":
+            try:
+                session.execute(rec.sql)
+            except Exception as e:  # noqa: BLE001 — any SQL error counts
+                if rec.error_substr and rec.error_substr.lower() not in str(
+                    e
+                ).lower():
+                    raise AssertionError(
+                        f"{where}: error {e!r} does not contain "
+                        f"{rec.error_substr!r}"
+                    ) from e
+                continue
+            raise AssertionError(f"{where}: expected an error, got success")
+        out, _tag = session.execute(rec.sql)
+        names = [n for n in out if not n.endswith("__null")]
+        n = len(out[names[0]]) if names else 0
+        got = []
+        for r in range(n):
+            got.append(
+                "\t".join(_render(out[c][r]) for c in names)
+            )
+        want = [re.sub(r"\s+", "\t", e.strip()) for e in rec.expected or []]
+        norm = lambda rows: sorted(rows) if rec.rowsort else rows
+        if norm(got) != norm(want):
+            raise AssertionError(
+                f"{where}: query mismatch\n  got:  {norm(got)}\n"
+                f"  want: {norm(want)}\n  sql: {rec.sql}"
+            )
+    return len(records)

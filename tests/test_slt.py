@@ -1,0 +1,23 @@
+"""sqllogictest-style e2e tier: tests/slt/*.slt executed against a
+fresh SqlSession each (reference: e2e_test/ + sqllogictest-rs,
+SURVEY.md §4)."""
+
+import glob
+import os
+
+import pytest
+
+from risingwave_tpu.frontend.session import SqlSession
+from risingwave_tpu.sql import Catalog
+from tests.slt_runner import run_slt
+
+SLT_DIR = os.path.join(os.path.dirname(__file__), "slt")
+FILES = sorted(glob.glob(os.path.join(SLT_DIR, "*.slt")))
+
+
+@pytest.mark.parametrize("path", FILES, ids=[os.path.basename(f) for f in FILES])
+def test_slt_file(path):
+    session = SqlSession(Catalog({}), capacity=1 << 10)
+    with open(path) as f:
+        n = run_slt(session, f.read(), path=path)
+    assert n > 0

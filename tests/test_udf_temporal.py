@@ -199,5 +199,5 @@ def test_string_builtins_protected(session):
             "CREATE FUNCTION upper(s VARCHAR) RETURNS VARCHAR "
             "LANGUAGE python AS $$\ndef upper(s):\n    return s\n$$"
         )
-    with pytest.raises(KeyError):
+    with pytest.raises(ValueError, match="builtin"):
         session.execute("DROP FUNCTION upper")
