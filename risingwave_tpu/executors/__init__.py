@@ -22,6 +22,7 @@ from risingwave_tpu.executors.materialize import MaterializeExecutor
 from risingwave_tpu.executors.generators import NowExecutor, ValuesExecutor
 from risingwave_tpu.executors.row_id_gen import RowIdGenExecutor
 from risingwave_tpu.executors.simple_agg import SimpleAggExecutor
+from risingwave_tpu.executors.sort import SortExecutor
 from risingwave_tpu.executors.top_n import GroupTopNExecutor
 from risingwave_tpu.executors.top_n_plain import TopNExecutor
 from risingwave_tpu.executors.watermark_filter import WatermarkFilterExecutor
@@ -30,6 +31,7 @@ __all__ = [
     "NowExecutor",
     "ValuesExecutor",
     "SimpleAggExecutor",
+    "SortExecutor",
     "TopNExecutor",
     "WatermarkFilterExecutor",
     "Barrier",

@@ -49,8 +49,17 @@ def parse_slt(text: str) -> List[Record]:
         if head[0] == "statement":
             sql_lines = []
             i += 1
-            while i < len(lines) and lines[i].strip() and not lines[i].startswith("#"):
-                sql_lines.append(lines[i])
+            in_dollar = False
+            while i < len(lines):
+                ln = lines[i]
+                if not in_dollar and (
+                    not ln.strip() or ln.startswith("#")
+                ):
+                    break
+                # $$-quoted bodies (python UDFs) may hold blank lines
+                if ln.count("$$") % 2 == 1:
+                    in_dollar = not in_dollar
+                sql_lines.append(ln)
                 i += 1
             rec = Record(
                 kind="ok" if head[1] == "ok" else "error",
@@ -125,9 +134,16 @@ def run_slt(session, text: str, path: str = "<slt>") -> int:
         n = len(out[names[0]]) if names else 0
         got = []
         for r in range(n):
-            got.append(
-                "\t".join(_render(out[c][r]) for c in names)
-            )
+            cells = []
+            for c in names:
+                nl = out.get(c + "__null")
+                cells.append(
+                    "NULL" if nl is not None and nl[r] else _render(out[c][r])
+                )
+            got.append("\t".join(cells))
+        # identical normalization on BOTH sides so spaced VARCHAR
+        # values compare consistently
+        got = [re.sub(r"\s+", "\t", g.strip()) for g in got]
         want = [re.sub(r"\s+", "\t", e.strip()) for e in rec.expected or []]
         norm = lambda rows: sorted(rows) if rec.rowsort else rows
         if norm(got) != norm(want):
