@@ -1,0 +1,130 @@
+"""ProjectSet executor — table-function row expansion.
+
+Reference: src/stream/src/executor/project_set.rs — each input row
+expands into the rows its table function yields (unnest, generate_
+series), tagged with a ``projected_row_id`` ordinal; scalar select
+items repeat per produced row.
+
+TPU re-design (the hop-window recipe): the expansion factor is STATIC
+— ``list_cap`` for unnest over a LIST column, ``max_steps`` for
+generate_series — so a chunk of capacity C becomes one chunk of
+capacity C*K with copy k forming a contiguous block (preserves the
+U-/U+ adjacency invariant exactly like hop_window.py); copies past
+each row's actual yield count are masked invalid. No loops, no dynamic
+shapes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.array.composite import LIST_LEN_SUFFIX
+from risingwave_tpu.executors.base import Executor
+
+
+@partial(jax.jit, static_argnames=("col", "out", "k", "ordinal"))
+def _unnest_step(chunk: StreamChunk, col: str, out: str, k: int, ordinal):
+    """Expand a LIST column's element lanes (array/composite layout:
+    ``col.0`` .. ``col.<k-1>`` + ``col.#`` length)."""
+    cap = chunk.capacity
+    tile = lambda a: jnp.tile(a, k)
+    idx = jnp.repeat(jnp.arange(k), cap)  # element index per copy
+    lens = chunk.col(col + LIST_LEN_SUFFIX)
+    elem = jnp.concatenate([chunk.col(f"{col}.{i}") for i in range(k)])
+    in_list = idx < tile(lens).astype(idx.dtype)
+    cols = {
+        n: tile(a)
+        for n, a in chunk.columns.items()
+        if not n.startswith(col + ".") and n != col + LIST_LEN_SUFFIX
+    }
+    cols[out] = elem
+    if ordinal:
+        cols["projected_row_id"] = idx.astype(jnp.int64)
+    nulls = {n: tile(a) for n, a in chunk.nulls.items() if n in cols}
+    valid = tile(chunk.valid) & in_list
+    return StreamChunk(cols, valid, nulls, tile(chunk.ops))
+
+
+@partial(jax.jit, static_argnames=("start_col", "stop_col", "out", "k", "ordinal"))
+def _series_step(chunk, start_col: str, stop_col: str, out: str, k: int, ordinal):
+    """generate_series(start, stop) inclusive, step 1, capped at k."""
+    cap = chunk.capacity
+    tile = lambda a: jnp.tile(a, k)
+    idx = jnp.repeat(jnp.arange(k, dtype=jnp.int64), cap)
+    start = tile(chunk.col(start_col).astype(jnp.int64))
+    stop = tile(chunk.col(stop_col).astype(jnp.int64))
+    val = start + idx
+    in_series = val <= stop
+    cols = {n: tile(a) for n, a in chunk.columns.items()}
+    cols[out] = val
+    if ordinal:
+        cols["projected_row_id"] = idx
+    nulls = {n: tile(a) for n, a in chunk.nulls.items() if n != out}
+    valid = tile(chunk.valid) & in_series
+    return StreamChunk(cols, valid, nulls, tile(chunk.ops))
+
+
+class ProjectSetExecutor(Executor):
+    """Table-function expansion. ``fn`` is "unnest" (over a LIST column
+    laid out by array/composite) or "generate_series" (int bounds,
+    step 1, ``max_steps`` static cap — rows needing more raise via the
+    overflow latch at the barrier)."""
+
+    def __init__(
+        self,
+        fn: str,
+        out: str = "value",
+        list_col: Optional[str] = None,
+        list_cap: Optional[int] = None,
+        start_col: Optional[str] = None,
+        stop_col: Optional[str] = None,
+        max_steps: int = 64,
+        ordinal: bool = True,
+    ):
+        if fn not in ("unnest", "generate_series"):
+            raise ValueError(f"unknown table function {fn!r}")
+        self.fn = fn
+        self.out = out
+        self.list_col = list_col
+        self.list_cap = list_cap
+        self.start_col = start_col
+        self.stop_col = stop_col
+        self.max_steps = max_steps
+        self.ordinal = ordinal
+        self._truncated = jnp.zeros((), jnp.bool_)
+
+    def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
+        if self.fn == "unnest":
+            return [
+                _unnest_step(
+                    chunk, self.list_col, self.out, self.list_cap,
+                    self.ordinal,
+                )
+            ]
+        # series longer than max_steps would silently truncate: latch
+        span = (
+            chunk.col(self.stop_col).astype(jnp.int64)
+            - chunk.col(self.start_col).astype(jnp.int64)
+            + 1
+        )
+        self._truncated = self._truncated | jnp.any(
+            chunk.valid & (span > self.max_steps)
+        )
+        return [
+            _series_step(
+                chunk, self.start_col, self.stop_col, self.out,
+                self.max_steps, self.ordinal,
+            )
+        ]
+
+    def on_barrier(self, barrier) -> List[StreamChunk]:
+        if self.fn == "generate_series" and bool(self._truncated):
+            raise RuntimeError(
+                "generate_series exceeded max_steps; raise the cap"
+            )
+        return []

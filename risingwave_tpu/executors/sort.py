@@ -165,10 +165,16 @@ class SortExecutor(Executor, Checkpointable):
         if watermark.column != self.ts_col:
             return watermark, []
         cutoff = jnp.asarray(watermark.value, jnp.int64)
-        out_cols, out_nulls, out_valid, self.valid, _n = _sort_emit(
+        out_cols, out_nulls, out_valid, self.valid, n_closed = _sort_emit(
             self.buf, self.bnulls, self.valid, self.seq, cutoff,
             self.names, self.ts_col,
         )
+        # one scalar read per watermark: an all-invalid capacity-wide
+        # chunk would cost O(capacity) device work in EVERY downstream
+        # stage, and EOWC emissions are empty most barriers — the
+        # small sync is the cheaper side of the trade
+        if int(n_closed) == 0:
+            return watermark, []
         chunk = StreamChunk(
             columns=out_cols,
             valid=out_valid,
@@ -179,38 +185,60 @@ class SortExecutor(Executor, Checkpointable):
 
     # -- checkpoint/restore ----------------------------------------------
     def checkpoint_delta(self) -> List[StateDelta]:
-        """Full-buffer snapshot keyed by seq (the buffer is small and
-        transient — rows leave at the next watermark; the reference
-        keeps a sort-buffer state table the same way)."""
-        sel = np.flatnonzero(np.asarray(self.valid))
+        """Incremental staging keyed by seq: upsert only rows APPENDED
+        since the last checkpoint, tombstone only rows that left (the
+        Checkpointable O(changed) contract). The seq lane of live rows
+        is pulled to diff against the previously-stored set — a freed
+        slot may already hold a new row, so slot marks alone cannot
+        name the departed seqs."""
+        valid_np = np.asarray(self.valid)
+        sel_all = np.flatnonzero(valid_np)
+        seq_rows = pull_rows({"k0": self.seq}, sel_all)
+        cur = (
+            np.asarray(seq_rows["k0"], np.int64)
+            if len(sel_all)
+            else np.zeros(0, np.int64)
+        )
+        prev = getattr(self, "_stored_seqs", np.zeros(0, np.int64))
+        new_mask = ~np.isin(cur, prev)
+        sel_new = sel_all[new_mask]
+        gone = np.setdiff1d(prev, cur)
+        self._stored_seqs = cur
+        n_up, n_del = len(sel_new), len(gone)
+        if n_up + n_del == 0:
+            return []
         lanes = {"k0": self.seq}
         lanes.update({f"v_{n}": self.buf[n] for n in self.names})
         lanes.update({f"n_{n}": l for n, l in self.bnulls.items()})
-        rows = pull_rows(lanes, sel)
-        # tombstone everything previously stored, then upsert current
-        # rows: emit-on-close deletes need the previous snapshot gone
-        prev = getattr(self, "_stored_seqs", np.zeros(0, np.int64))
-        cur = rows["k0"] if len(sel) else np.zeros(0, np.int64)
-        gone = np.setdiff1d(prev, cur)
-        self._stored_seqs = cur
-        key_cols = {"k0": np.concatenate([cur, gone])}
-        n_up, n_del = len(cur), len(gone)
+        rows = pull_rows(lanes, sel_new)
+        key_cols = {
+            "k0": np.concatenate(
+                [np.asarray(rows["k0"], np.int64), gone]
+            )
+        }
         value_cols = {}
         for n in self.names:
-            pad = np.zeros(n_del, np.asarray(rows[f"v_{n}"]).dtype)
-            value_cols[f"v_{n}"] = np.concatenate([rows[f"v_{n}"], pad])
+            vals = np.asarray(rows[f"v_{n}"])
+            value_cols[f"v_{n}"] = np.concatenate(
+                [vals, np.zeros(n_del, vals.dtype)]
+            )
         for n in self.bnulls:
             value_cols[f"n_{n}"] = np.concatenate(
-                [rows[f"n_{n}"].astype(np.uint8), np.zeros(n_del, np.uint8)]
+                [
+                    np.asarray(rows[f"n_{n}"]).astype(np.uint8),
+                    np.zeros(n_del, np.uint8),
+                ]
             )
-        if n_up + n_del == 0:
-            return []
         tomb = np.zeros(n_up + n_del, bool)
         tomb[n_up:] = True
         return [StateDelta(self.table_id, key_cols, value_cols, tomb, ("k0",))]
 
     def restore_state(self, table_id, key_cols, value_cols) -> None:
         n = len(next(iter(key_cols.values()))) if key_cols else 0
+        # recovery clears the error latches: the restored state is
+        # valid even when a latched overflow/delete caused the recovery
+        self._overflow = jnp.zeros((), jnp.bool_)
+        self._saw_delete = jnp.zeros((), jnp.bool_)
         if n > self.capacity:
             # silent scatter-drop would lose buffered rows forever:
             # grow the arena to hold the checkpoint

@@ -216,6 +216,12 @@ class CheckpointManager:
                 (delta.table_id, {"path": path, "epoch": epoch})
             )
             n += 1
+        from risingwave_tpu import utils_sync_point as sync_point
+
+        # SSTs are uploaded but the manifest is NOT yet written: the
+        # classic crash window (recovery must land on the previous
+        # epoch); tests inject crashes here (utils_sync_point)
+        sync_point.hit("before_manifest_commit")
         with self._lock:
             # re-validate under the lock: a concurrent commit may have
             # advanced the epoch while our SSTs uploaded; publishing
@@ -231,6 +237,7 @@ class CheckpointManager:
                 self.version["tables"].setdefault(table_id, []).append(entry)
             self.version["max_committed_epoch"] = epoch
             self._persist_version()
+        sync_point.hit("after_manifest_commit")
         return n
 
     def commit_epoch(self, epoch: int, executors: Sequence[object]) -> int:
@@ -292,6 +299,9 @@ class CheckpointManager:
                 {"path": path, "epoch": epoch}
             ] + cur[len(entries):]
             self._persist_version()
+        from risingwave_tpu import utils_sync_point as sync_point
+
+        sync_point.hit("before_compaction_gc")
         for e in entries:  # GC after the new version is durable
             self.store.delete(e["path"])
             self._sst_cache.pop(e["path"], None)

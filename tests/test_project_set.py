@@ -1,0 +1,46 @@
+"""ProjectSet: unnest over LIST lanes + generate_series expansion.
+Reference: src/stream/src/executor/project_set.rs."""
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.array.composite import encode_column
+from risingwave_tpu.executors.project_set import ProjectSetExecutor
+from risingwave_tpu.types import DataType, Field
+
+
+def test_unnest_expands_list_rows():
+    f = Field("xs", DataType.LIST, elem=DataType.INT64, list_cap=4)
+    lanes, nulls = encode_column(f, [[10, 11], [], None, [7]])
+    lanes["k"] = np.asarray([1, 2, 3, 4])
+    chunk = StreamChunk.from_numpy(lanes, 4, nulls=nulls)
+    ex = ProjectSetExecutor("unnest", out="x", list_col="xs", list_cap=4)
+    (out,) = ex.apply(chunk)
+    d = out.to_numpy()
+    rows = sorted(zip(d["k"].tolist(), d["x"].tolist(), d["projected_row_id"].tolist()))
+    assert rows == [(1, 10, 0), (1, 11, 1), (4, 7, 0)]
+    assert "xs.0" not in d  # element lanes consumed
+
+
+def test_generate_series_expansion_and_cap():
+    chunk = StreamChunk.from_numpy(
+        {"k": np.asarray([1, 2]), "lo": np.asarray([5, 0]),
+         "hi": np.asarray([7, -1])}, 2,
+    )
+    ex = ProjectSetExecutor(
+        "generate_series", out="s", start_col="lo", stop_col="hi",
+        max_steps=8,
+    )
+    (out,) = ex.apply(chunk)
+    d = out.to_numpy()
+    rows = sorted(zip(d["k"].tolist(), d["s"].tolist()))
+    assert rows == [(1, 5), (1, 6), (1, 7)]  # empty series for k=2
+    ex.on_barrier(None)  # no truncation
+
+    big = StreamChunk.from_numpy(
+        {"k": np.asarray([9]), "lo": np.asarray([0]), "hi": np.asarray([100])}, 2,
+    )
+    ex.apply(big)
+    with pytest.raises(RuntimeError, match="max_steps"):
+        ex.on_barrier(None)
