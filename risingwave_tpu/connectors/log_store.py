@@ -1,0 +1,170 @@
+"""KV log store — durable sink decoupling.
+
+Reference: src/stream/src/common/log_store_impl/kv_log_store/ (the
+sink-decoupling log: sink output persists in state, a LogSinker
+consumes it at its own pace; sink/log_store.rs traits).
+
+Closes the held-batch window documented in connectors/sink.py: with
+deliver_on_durable, a crash after the manifest persisted but before
+the held batch was written LOST the batch (at-most-once). Here the
+batch itself is durable — appended to a per-sink log in the object
+store at the barrier — and a decoupled ``LogSinker`` delivers pending
+epochs to the real sink, committing its consume offset afterwards:
+
+- no batch is ever lost (the log IS state; recovery rolls the
+  consumer offset back past discarded epochs so regenerated output is
+  redelivered);
+- delivery is at-least-once across crashes (offset commits after the
+  sink write; the reference needs coordinated 2PC sinks for
+  exactly-once external delivery, manager/sink_coordination/);
+- drive ``LogSinker.run_once(up_to=<durable frontier>)`` to also
+  guarantee rolled-back epochs are never delivered; without ``up_to``
+  the sinker may run ahead of durability and deliver output of an
+  epoch that later rolls back (still at-least-once, never lost).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Sequence, Tuple
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.connectors.sink import Sink, compact_rows
+from risingwave_tpu.executors.base import Barrier, Executor
+from risingwave_tpu.storage.object_store import ObjectStore
+
+
+class KvLogStore:
+    """Per-sink epoch log in the object store: one blob per epoch plus
+    a consumer-offset blob. Appends are idempotent per epoch (replay
+    of a deterministic epoch overwrites with identical content)."""
+
+    def __init__(self, store: ObjectStore, sink_id: str):
+        self.store = store
+        self.prefix = f"sinklog/{sink_id}"
+
+    def _epoch_path(self, epoch: int) -> str:
+        return f"{self.prefix}/log/{epoch:020d}.json"
+
+    def _offset_path(self) -> str:
+        return f"{self.prefix}/OFFSET"
+
+    def append(self, epoch: int, batch) -> None:
+        rows = [
+            {"pk": list(pk), "row": list(row) if row is not None else None,
+             "op": int(op)}
+            for pk, row, op in batch
+        ]
+        self.store.put(
+            self._epoch_path(epoch), json.dumps(rows).encode()
+        )
+
+    def committed_offset(self) -> int:
+        p = self._offset_path()
+        if not self.store.exists(p):
+            return 0
+        return int(json.loads(self.store.read(p))["epoch"])
+
+    def pending_epochs(self) -> List[int]:
+        off = self.committed_offset()
+        out = []
+        for p in self.store.list(self.prefix + "/log/"):
+            epoch = int(p.rsplit("/", 1)[1].split(".")[0])
+            if epoch > off:
+                out.append(epoch)
+        return sorted(out)
+
+    def read(self, epoch: int):
+        rows = json.loads(self.store.read(self._epoch_path(epoch)))
+        return [
+            (tuple(r["pk"]),
+             tuple(r["row"]) if r["row"] is not None else None,
+             r["op"])
+            for r in rows
+        ]
+
+    def commit_through(self, epoch: int) -> None:
+        self.store.put(
+            self._offset_path(), json.dumps({"epoch": epoch}).encode()
+        )
+
+    def truncate(self) -> None:
+        """GC delivered epochs (kv log store truncation)."""
+        off = self.committed_offset()
+        for p in list(self.store.list(self.prefix + "/log/")):
+            epoch = int(p.rsplit("/", 1)[1].split(".")[0])
+            if epoch <= off:
+                self.store.delete(p)
+
+    def discard_above(self, epoch: int) -> None:
+        """Recovery: epochs past the committed manifest rolled back;
+        their logged output is discarded AND the consumer offset rolls
+        back with them — replay regenerates those epochs (possibly with
+        different batch boundaries), and an offset ahead of the rolled-
+        back frontier would make pending_epochs() skip the regenerated
+        output forever (batch loss)."""
+        for p in list(self.store.list(self.prefix + "/log/")):
+            e = int(p.rsplit("/", 1)[1].split(".")[0])
+            if e > epoch:
+                self.store.delete(p)
+        if self.committed_offset() > epoch:
+            self.commit_through(epoch)
+
+
+class LogStoreSinkExecutor(Executor):
+    """Chain-tail sink writing through a KvLogStore (executor/sink.rs
+    with sink decoupling ON): the barrier appends the epoch's compacted
+    batch to the durable log; the decoupled LogSinker delivers."""
+
+    def __init__(
+        self, log_store: KvLogStore, pk: Sequence[str], columns: Sequence[str]
+    ):
+        self.log_store = log_store
+        self.pk = tuple(pk)
+        self.columns = tuple(columns)
+        self._buffer: List[Tuple[Tuple, Tuple, int]] = []
+
+    def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
+        from risingwave_tpu.connectors.sink import rows_from_chunk
+
+        self._buffer.extend(rows_from_chunk(chunk, self.pk, self.columns))
+        return [chunk]
+
+    def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
+        batch = compact_rows(self._buffer)
+        self._buffer = []
+        if barrier is not None and (batch or barrier.checkpoint):
+            self.log_store.append(barrier.epoch.curr, batch)
+        return []
+
+    def discard_pending(self) -> None:
+        self._buffer = []
+
+    def on_recover(self, epoch: int) -> None:
+        """Runtime recovery hook: drop logged output of rolled-back
+        epochs (they will be regenerated by replay)."""
+        self.log_store.discard_above(epoch)
+
+
+class LogSinker:
+    """The decoupled consumer (sink/log_store.rs LogSinker role):
+    drains pending epochs into the real sink at its own pace — the
+    stream never blocks on a slow external system."""
+
+    def __init__(self, log_store: KvLogStore, sink: Sink):
+        self.log_store = log_store
+        self.sink = sink
+
+    def run_once(self, up_to: Optional[int] = None) -> int:
+        """Deliver pending epochs (optionally only those <= up_to,
+        i.e. the durable frontier). Returns epochs delivered."""
+        n = 0
+        for epoch in self.log_store.pending_epochs():
+            if up_to is not None and epoch > up_to:
+                break
+            self.sink.write_batch(self.log_store.read(epoch), epoch)
+            self.sink.commit(epoch)
+            self.log_store.commit_through(epoch)
+            n += 1
+        self.log_store.truncate()
+        return n

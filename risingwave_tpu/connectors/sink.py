@@ -54,6 +54,23 @@ def compact_rows(rows: List[Tuple[Tuple, Tuple, int]]) -> List[Tuple[Tuple, Tupl
     return out
 
 
+def rows_from_chunk(chunk: StreamChunk, pk, columns):
+    """Chunk -> [(pk_tuple, row_tuple, op)] — the single host-side row
+    extraction shared by every sink executor."""
+    d = chunk.to_numpy(with_ops=True)
+    ops = d["__op__"]
+    out = []
+    for i in range(len(ops)):
+        out.append(
+            (
+                tuple(d[n][i].item() for n in pk),
+                tuple(d[n][i].item() for n in columns),
+                int(ops[i]),
+            )
+        )
+    return out
+
+
 class Sink:
     """Reference ``Sink`` trait narrowed to the epoch-batched path."""
 
@@ -153,12 +170,7 @@ class SinkExecutor(Executor):
         self._held: List[Tuple[int, List[Tuple[Tuple, Tuple, int]]]] = []
 
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
-        d = chunk.to_numpy(with_ops=True)
-        ops = d["__op__"]
-        for i in range(len(ops)):
-            pk = tuple(d[n][i].item() for n in self.pk)
-            row = tuple(d[n][i].item() for n in self.columns)
-            self._buffer.append((pk, row, int(ops[i])))
+        self._buffer.extend(rows_from_chunk(chunk, self.pk, self.columns))
         return [chunk]
 
     def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:

@@ -52,14 +52,17 @@ def _unnest_step(chunk: StreamChunk, col: str, out: str, k: int, ordinal):
 
 @partial(jax.jit, static_argnames=("start_col", "stop_col", "out", "k", "ordinal"))
 def _series_step(chunk, start_col: str, stop_col: str, out: str, k: int, ordinal):
-    """generate_series(start, stop) inclusive, step 1, capped at k."""
+    """generate_series(start, stop) inclusive, step 1, capped at k.
+    A NULL bound yields an EMPTY series (reference table-function NULL
+    semantics), never a sentinel-derived one."""
     cap = chunk.capacity
     tile = lambda a: jnp.tile(a, k)
     idx = jnp.repeat(jnp.arange(k, dtype=jnp.int64), cap)
+    bounds_ok = ~chunk.null_of(start_col) & ~chunk.null_of(stop_col)
     start = tile(chunk.col(start_col).astype(jnp.int64))
     stop = tile(chunk.col(stop_col).astype(jnp.int64))
     val = start + idx
-    in_series = val <= stop
+    in_series = (val <= stop) & tile(bounds_ok)
     cols = {n: tile(a) for n, a in chunk.columns.items()}
     cols[out] = val
     if ordinal:
@@ -100,6 +103,12 @@ class ProjectSetExecutor(Executor):
 
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         if self.fn == "unnest":
+            # lists longer than the configured expansion silently drop
+            # elements: latch like the series cap does
+            lens = chunk.col(self.list_col + LIST_LEN_SUFFIX)
+            self._truncated = self._truncated | jnp.any(
+                chunk.valid & (lens > self.list_cap)
+            )
             return [
                 _unnest_step(
                     chunk, self.list_col, self.out, self.list_cap,
@@ -107,13 +116,17 @@ class ProjectSetExecutor(Executor):
                 )
             ]
         # series longer than max_steps would silently truncate: latch
+        # (NULL bounds yield empty series and never count)
+        bounds_ok = ~chunk.null_of(self.start_col) & ~chunk.null_of(
+            self.stop_col
+        )
         span = (
             chunk.col(self.stop_col).astype(jnp.int64)
             - chunk.col(self.start_col).astype(jnp.int64)
             + 1
         )
         self._truncated = self._truncated | jnp.any(
-            chunk.valid & (span > self.max_steps)
+            chunk.valid & bounds_ok & (span > self.max_steps)
         )
         return [
             _series_step(
@@ -123,8 +136,11 @@ class ProjectSetExecutor(Executor):
         ]
 
     def on_barrier(self, barrier) -> List[StreamChunk]:
-        if self.fn == "generate_series" and bool(self._truncated):
-            raise RuntimeError(
-                "generate_series exceeded max_steps; raise the cap"
+        if bool(self._truncated):
+            what = (
+                "generate_series exceeded max_steps"
+                if self.fn == "generate_series"
+                else "unnest list exceeded list_cap"
             )
+            raise RuntimeError(f"{what}; raise the cap")
         return []

@@ -514,3 +514,9 @@ class StreamingRuntime:
         self._epoch = self.mgr.max_committed_epoch
         for p in self.fragments.values():
             p._epoch = self._epoch
+        # executors with recovery hooks (e.g. sink log stores dropping
+        # rolled-back epochs) learn the recovered frontier
+        for ex in self.executors():
+            fn = getattr(ex, "on_recover", None)
+            if fn is not None:
+                fn(self._epoch)
