@@ -168,6 +168,7 @@ class SinkExecutor(Executor):
         # drained by on_epoch_durable (async checkpoint worker)
         self._held_lock = _threading.Lock()
         self._held: List[Tuple[int, List[Tuple[Tuple, Tuple, int]]]] = []
+        self._finish_queue: List[Tuple[int, list, bool]] = []
 
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         self._buffer.extend(rows_from_chunk(chunk, self.pk, self.columns))
@@ -181,10 +182,21 @@ class SinkExecutor(Executor):
             with self._held_lock:
                 self._held.append((epoch, batch))
             return []
-        self.sink.write_batch(batch, epoch)
-        if barrier is None or barrier.checkpoint:
-            self.sink.commit(epoch)
+        # standalone delivery happens in finish_barrier, which the
+        # pipeline runs in executor order AFTER the walk: an upstream
+        # latch (overflow/inconsistency) raises from ITS finish before
+        # this sink externally commits the corrupt epoch
+        self._finish_queue.append(
+            (epoch, batch, barrier is None or barrier.checkpoint)
+        )
         return []
+
+    def finish_barrier(self) -> None:
+        due, self._finish_queue = self._finish_queue, []
+        for epoch, batch, commit in due:
+            self.sink.write_batch(batch, epoch)
+            if commit:
+                self.sink.commit(epoch)
 
     def discard_pending(self) -> None:
         """Recovery hook: drop batches held for epochs that rolled back
@@ -192,6 +204,7 @@ class SinkExecutor(Executor):
         with self._held_lock:
             self._held = []
         self._buffer = []
+        self._finish_queue = []
 
     def on_epoch_durable(self, epoch: int) -> None:
         """Runtime callback after the manifest persisted for ``epoch``:

@@ -82,3 +82,21 @@ class Executor:
         barrier (WatermarkFilterExecutor overrides; reference:
         watermark_filter.rs emits into its output stream)."""
         return None
+
+    # -- overlapped barrier scalar reads ---------------------------------
+    # Executors that must read device scalars at the barrier (overflow
+    # latches, occupancy counters) ENQUEUE the packed read inside
+    # ``on_barrier`` (sampling at their own position in the walk, i.e.
+    # after absorbing upstream flushes) via ``stage_scalars`` and defer
+    # the blocking host materialization to ``finish_barrier``, which
+    # the pipeline calls for every executor AFTER the walk. The N
+    # transfers are all in flight concurrently, so a chain pays ~one
+    # tunneled-TPU round-trip per barrier instead of N — with the
+    # values and raise points semantically identical to synchronous
+    # reads (checks still run before the runtime commits the epoch).
+
+    _staged_scalars = None
+
+    def finish_barrier(self) -> None:
+        """Materialize + act on scalars staged by on_barrier."""
+        return None

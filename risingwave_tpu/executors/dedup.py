@@ -28,6 +28,8 @@ from risingwave_tpu.ops.hash_table import (
     lookup_or_insert,
     plan_rehash,
     read_scalars,
+    stage_scalars,
+    finish_scalars,
     set_live,
 )
 from risingwave_tpu.storage.state_table import (
@@ -139,17 +141,22 @@ class AppendOnlyDedupExecutor(Executor, Checkpointable):
         self._bound = claimed
 
     def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
-        # ONE packed read for both latches + occupancy (refreshes the
-        # growth bound for free, same discipline as HashAgg.on_barrier)
-        saw_delete, dropped, claimed = read_scalars(
+        # staged read; finish_barrier materializes after the walk
+        self._staged_scalars = stage_scalars(
             self._saw_delete, self._dropped, self.table.occupancy()
         )
+        return []
+
+    def finish_barrier(self) -> None:
+        if self._staged_scalars is None:
+            return
+        saw_delete, dropped, claimed = finish_scalars(self._staged_scalars)
+        self._staged_scalars = None
         self._bound = int(claimed)
         if saw_delete:
             raise RuntimeError("append-only dedup received a DELETE")
         if dropped:
             raise RuntimeError("dedup table overflowed MAX_PROBE; grow capacity")
-        return []
 
     def on_watermark(self, watermark: Watermark):
         if self.window_key is None or watermark.column != self.window_key[0]:

@@ -48,7 +48,9 @@ from risingwave_tpu.ops.hash_table import (
     lookup,
     lookup_or_insert,
     plan_rehash,
+    finish_scalars,
     read_scalars,
+    stage_scalars,
     set_live,
 )
 
@@ -601,17 +603,36 @@ class HashAggExecutor(Executor, Checkpointable):
 
     # -- control ---------------------------------------------------------
     def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
-        # ONE packed device read for all three latches (each bool() on a
-        # device scalar is a full round-trip on a tunneled TPU). The
-        # true occupancy piggybacks on the same transfer, refreshing
-        # _insert_bound so the NEXT epoch's _maybe_grow usually decides
-        # from this cached value without its own round-trip.
-        dropped, mret, mi_bad, claimed = read_scalars(
+        # STAGE the packed latch+occupancy read (async D2H) and defer
+        # the blocking materialization to finish_barrier — every
+        # executor's transfer is then in flight concurrently, so a
+        # chain pays ~one tunneled-TPU round-trip per barrier, with
+        # values sampled at this exact point of the walk.
+        # NOTE: with a tripped latch the flush below still emits and
+        # pollutes downstream IN-PROCESS state before finish_barrier
+        # raises — covered by the existing contract that any barrier
+        # error requires recover() (runtime.py module docstring); the
+        # epoch is never checkpointed and sinks never deliver it
+        # (SinkExecutor delivery also lives in finish_barrier).
+        self._staged_scalars = stage_scalars(
             self.dropped,
             self.state.minmax_retracted,
             self.mi_bad,
             self.table.occupancy(),
         )
+        if self.cold_reader is not None:
+            self._merge_cold()
+        return self._flush_all()
+
+    def finish_barrier(self) -> None:
+        if self._staged_scalars is None:
+            return
+        dropped, mret, mi_bad, claimed = finish_scalars(
+            self._staged_scalars
+        )
+        self._staged_scalars = None
+        # occupancy refreshes _insert_bound so the NEXT epoch's
+        # _maybe_grow usually decides without its own round-trip
         self._insert_bound = int(claimed)
         if dropped:
             raise RuntimeError(
@@ -633,9 +654,6 @@ class HashAggExecutor(Executor, Checkpointable):
                 "values per group, or a value was retracted that was never "
                 "inserted"
             )
-        if self.cold_reader is not None:
-            self._merge_cold()
-        return self._flush_all()
 
     # -- cold tier (state >> HBM) -----------------------------------------
     def state_nbytes(self) -> int:

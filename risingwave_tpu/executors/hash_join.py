@@ -38,7 +38,12 @@ import jax.numpy as jnp
 
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Barrier, Executor, Watermark
-from risingwave_tpu.ops.hash_table import plan_rehash, read_scalars
+from risingwave_tpu.ops.hash_table import (
+    finish_scalars,
+    plan_rehash,
+    read_scalars,
+    stage_scalars,
+)
 from risingwave_tpu.ops.hash_table import lookup_or_insert, set_live
 from risingwave_tpu.storage.state_table import (
     Checkpointable,
@@ -414,12 +419,7 @@ class HashJoinExecutor(Executor, Checkpointable):
 
     # -- control ---------------------------------------------------------
     def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
-        import numpy as np
-
-        # ONE packed device read for all five latches (tunneled-TPU
-        # round-trips dominate small barriers); both sides' occupancy
-        # piggybacks to refresh the growth bounds for free
-        em, lo, li, ro, ri, cl, cr = read_scalars(
+        self._staged_scalars = stage_scalars(
             self._em_overflow,
             self.left.overflow,
             self.left.inconsistent,
@@ -428,6 +428,13 @@ class HashJoinExecutor(Executor, Checkpointable):
             self.left.table.occupancy(),
             self.right.table.occupancy(),
         )
+        return []
+
+    def finish_barrier(self) -> None:
+        if self._staged_scalars is None:
+            return
+        em, lo, li, ro, ri, cl, cr = finish_scalars(self._staged_scalars)
+        self._staged_scalars = None
         self._bound["l"] = int(cl)
         self._bound["r"] = int(cr)
         if em:
@@ -446,7 +453,6 @@ class HashJoinExecutor(Executor, Checkpointable):
                     f"{name} join side saw a DELETE matching no stored row "
                     "(inconsistent input stream)"
                 )
-        return []
 
     def on_watermark(self, watermark: Watermark):
         """Expire the matching side's closed windows; emit a downstream

@@ -352,6 +352,8 @@ from risingwave_tpu.ops.hash_table import (
     lookup_or_insert,
     plan_rehash,
     read_scalars,
+    stage_scalars,
+    finish_scalars,
 )
 from risingwave_tpu.storage.state_table import (
     grow_pow2,
@@ -524,19 +526,23 @@ class DeviceMaterializeExecutor(Executor, Checkpointable):
 
     # -- control ----------------------------------------------------------
     def on_barrier(self, barrier) -> list:
-        # ONE packed read: overflow latch + occupancy (the occupancy
-        # refreshes the growth bound so steady state has no mid-epoch
-        # refresh syncs — the bound heuristic assumes every incoming
-        # row is a new key; the true claimed count corrects it for free)
-        dropped, claimed = read_scalars(
+        self._staged_scalars = stage_scalars(
             self.state.dropped, self.table.occupancy()
         )
+        return []
+
+    def finish_barrier(self) -> None:
+        if self._staged_scalars is None:
+            return
+        dropped, claimed = finish_scalars(self._staged_scalars)
+        self._staged_scalars = None
+        # occupancy refreshes the growth bound so steady state has no
+        # mid-epoch refresh syncs
         self._bound = int(claimed)
         if dropped:
             raise RuntimeError(
                 "device MV hash table overflowed MAX_PROBE; grow capacity"
             )
-        return []
 
     def state_nbytes(self) -> int:
         return sum(

@@ -24,7 +24,7 @@ import numpy as np
 
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Barrier, Executor, Watermark
-from risingwave_tpu.ops.hash_table import read_scalars
+from risingwave_tpu.ops.hash_table import finish_scalars, stage_scalars
 from risingwave_tpu.storage.state_table import (
     Checkpointable,
     StateDelta,
@@ -149,9 +149,16 @@ class SortExecutor(Executor, Checkpointable):
         return []  # rows surface only when their time closes
 
     def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
-        saw_delete, overflow = read_scalars(
+        self._staged_scalars = stage_scalars(
             self._saw_delete, self._overflow
         )
+        return []
+
+    def finish_barrier(self) -> None:
+        if self._staged_scalars is None:
+            return
+        saw_delete, overflow = finish_scalars(self._staged_scalars)
+        self._staged_scalars = None
         if saw_delete:
             raise RuntimeError("EOWC sort requires append-only input")
         if overflow:
@@ -159,7 +166,6 @@ class SortExecutor(Executor, Checkpointable):
                 "sort buffer overflowed; grow capacity or advance "
                 "watermarks faster"
             )
-        return []
 
     def on_watermark(self, watermark: Watermark):
         if watermark.column != self.ts_col:

@@ -278,16 +278,29 @@ def set_live(table: HashTable, slots: jnp.ndarray, live_value: jnp.ndarray) -> H
     return HashTable(table.fp1, table.fp2, table.keys, new_live)
 
 
-def read_scalars(*xs) -> list:
-    """ONE packed device->host read of several scalars (latches,
-    occupancy counters). On a tunneled TPU every sync is a full
-    round-trip (~100ms), so every barrier/growth check packs its
-    scalars into a single transfer through this helper."""
+def stage_scalars(*xs):
+    """Pack scalars into one device array and START its async D2H copy
+    (finish with ``finish_scalars``). Lets every executor's barrier
+    read overlap in flight instead of paying a round-trip each."""
+    arr = jnp.stack([jnp.asarray(x).astype(jnp.int64) for x in xs])
+    try:
+        arr.copy_to_host_async()
+    except AttributeError:  # backend without async copies
+        pass
+    return arr
+
+
+def finish_scalars(arr) -> list:
+    """Blocking counterpart: materialize a staged pack."""
     import numpy as np
 
-    return np.asarray(
-        jnp.stack([jnp.asarray(x).astype(jnp.int64) for x in xs])
-    ).tolist()
+    return np.asarray(arr).tolist()
+
+
+def read_scalars(*xs) -> list:
+    """ONE packed, blocking device->host read of several scalars
+    (latches, occupancy counters) — stage + finish in one call."""
+    return finish_scalars(stage_scalars(*xs))
 
 
 def plan_rehash(

@@ -317,6 +317,8 @@ class FragmentActor(threading.Thread):
             self._emit(outs + gen)
         for wm in wms:
             self._send_watermark_downstream(wm)
+        for ex in self.executors:
+            ex.finish_barrier()
         self.dispatcher.control(BARRIER, b)
         self.mgr._collect(self.actor_name, b)
 

@@ -81,6 +81,11 @@ class Pipeline:
             if wm is not None:
                 _, outs = _walk_watermark(self.executors[i + 1 :], wm)
                 pending.extend(outs)
+        # materialize every executor's staged barrier scalars AFTER the
+        # walk: the async transfers overlapped, so the chain pays ~one
+        # round-trip; raises still precede the runtime's epoch commit
+        for ex in self.executors:
+            ex.finish_barrier()
         return pending
 
     def watermark(self, column: str, value: int) -> List[StreamChunk]:
@@ -166,6 +171,8 @@ class TwoInputPipeline:
         joined.extend(self.join.on_barrier(b))
         outs = self._through(self.tail, joined, barrier=b)
         outs.extend(self._generated_watermarks())
+        for ex in self.executors:
+            ex.finish_barrier()
         return outs
 
     def _generated_watermarks(self) -> List[StreamChunk]:

@@ -66,6 +66,7 @@ def _run(mode, seed, epochs=3, n_chunks=4, cap=128):
         chunks = _mk_chunks(rng, n_chunks, cap)
         ex.apply_stacked(stack_chunks(chunks), mode=mode)
         ex.on_barrier(None)
+        ex.finish_barrier()
     return _state_snapshot(ex)
 
 
@@ -84,6 +85,7 @@ def test_reduce_matches_oracle_append_only():
         chunks = _mk_chunks(rng, 3, 64)
         ex.apply_stacked(stack_chunks(chunks), mode="reduce")
         ex.on_barrier(None)
+        ex.finish_barrier()
         for c in _mk_chunks(rng2, 3, 64):
             d = c.to_numpy(with_ops=True)
             valid_n = len(d["k"])
@@ -140,6 +142,7 @@ def test_reduce_minmax_retraction_latches():
     ex.apply_stacked(stack_chunks([c]), mode="reduce")
     with pytest.raises(RuntimeError, match="materialized-input"):
         ex.on_barrier(None)
+        ex.finish_barrier()
 
 
 def test_fingerprint_collision_keys_not_merged(monkeypatch):

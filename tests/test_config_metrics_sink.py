@@ -92,6 +92,7 @@ def test_sink_executor_file_and_blackhole(tmp_path):
     )
     ex.apply(chunk)
     ex.on_barrier(Barrier(Epoch(0, 1)))
+    ex.finish_barrier()
     # pk 1: insert then update-delete -> vanished within epoch; pk 2 stays
     assert bh.rows_written == 1 and bh.commits == 1
 
@@ -104,6 +105,7 @@ def test_sink_executor_file_and_blackhole(tmp_path):
         )
     )
     ex2.on_barrier(Barrier(Epoch(1, 2)))
+    ex2.finish_barrier()
     lines = [json.loads(l) for l in open(path)]
     assert lines[0] == {"op": "insert", "pk": [9], "row": [9, 90]}
     assert lines[1]["op"] == "commit"

@@ -153,6 +153,7 @@ def test_minput_overflow_and_inconsistency_latch():
     ex.apply(_chunk([(1, v, Op.INSERT) for v in range(5)]))
     with pytest.raises(RuntimeError, match="minput_k|retracted"):
         ex.on_barrier(None)
+        ex.finish_barrier()
 
     ex2 = HashAggExecutor(
         group_keys=("g",),
@@ -164,6 +165,7 @@ def test_minput_overflow_and_inconsistency_latch():
     ex2.apply(_chunk([(1, 7, Op.DELETE)]))  # never inserted
     with pytest.raises(RuntimeError):
         ex2.on_barrier(None)
+        ex2.finish_barrier()
 
 
 def test_minput_survives_rehash():

@@ -51,6 +51,7 @@ def test_sort_overflow_and_delete_raise():
     s.apply(_chunk([4, 5, 6], [0, 0, 0]))  # exceeds capacity
     with pytest.raises(RuntimeError, match="overflow"):
         s.on_barrier(None)
+        s.finish_barrier()
 
     s2 = SortExecutor("ts", DT, capacity=8)
     c = StreamChunk.from_numpy(
@@ -60,6 +61,7 @@ def test_sort_overflow_and_delete_raise():
     s2.apply(c)
     with pytest.raises(RuntimeError, match="append-only"):
         s2.on_barrier(None)
+        s2.finish_barrier()
 
 
 def test_sort_checkpoint_restore_roundtrip():
