@@ -123,6 +123,7 @@ class LogStoreSinkExecutor(Executor):
         self.pk = tuple(pk)
         self.columns = tuple(columns)
         self._buffer: List[Tuple[Tuple, Tuple, int]] = []
+        self._finish_queue: List[Tuple[int, list]] = []
 
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         from risingwave_tpu.connectors.sink import rows_from_chunk
@@ -134,11 +135,19 @@ class LogStoreSinkExecutor(Executor):
         batch = compact_rows(self._buffer)
         self._buffer = []
         if barrier is not None and (batch or barrier.checkpoint):
-            self.log_store.append(barrier.epoch.curr, batch)
+            # persist in finish_barrier: an upstream latch (corrupt
+            # epoch) raises from ITS finish before this blob is written
+            self._finish_queue.append((barrier.epoch.curr, batch))
         return []
+
+    def finish_barrier(self) -> None:
+        due, self._finish_queue = self._finish_queue, []
+        for epoch, batch in due:
+            self.log_store.append(epoch, batch)
 
     def discard_pending(self) -> None:
         self._buffer = []
+        self._finish_queue = []
 
     def on_recover(self, epoch: int) -> None:
         """Runtime recovery hook: drop logged output of rolled-back

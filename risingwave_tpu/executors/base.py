@@ -98,5 +98,19 @@ class Executor:
     _staged_scalars = None
 
     def finish_barrier(self) -> None:
-        """Materialize + act on scalars staged by on_barrier."""
+        """Materialize scalars staged by on_barrier and run the
+        executor's checks (one implementation; executors override
+        ``_on_barrier_scalars`` only). Executors driven DIRECTLY with
+        ``on_barrier(None)`` (tests/tools, no pipeline) finish inline
+        so their latch checks still fire per epoch."""
+        if self._staged_scalars is None:
+            return
+        from risingwave_tpu.ops.hash_table import finish_scalars
+
+        vals = finish_scalars(self._staged_scalars)
+        self._staged_scalars = None
+        self._on_barrier_scalars(vals)
+
+    def _on_barrier_scalars(self, vals) -> None:
+        """Unpack + check the scalars this executor staged."""
         return None

@@ -145,13 +145,12 @@ class AppendOnlyDedupExecutor(Executor, Checkpointable):
         self._staged_scalars = stage_scalars(
             self._saw_delete, self._dropped, self.table.occupancy()
         )
+        if barrier is None:  # direct drive: checks fire inline
+            self.finish_barrier()
         return []
 
-    def finish_barrier(self) -> None:
-        if self._staged_scalars is None:
-            return
-        saw_delete, dropped, claimed = finish_scalars(self._staged_scalars)
-        self._staged_scalars = None
+    def _on_barrier_scalars(self, vals) -> None:
+        saw_delete, dropped, claimed = vals
         self._bound = int(claimed)
         if saw_delete:
             raise RuntimeError("append-only dedup received a DELETE")

@@ -1,0 +1,79 @@
+"""Expand executor — row duplication for GROUPING SETS.
+
+Reference: src/stream/src/executor/expand.rs — each input row is
+emitted once per column subset with the columns OUTSIDE the subset
+replaced by NULL and a ``flag`` column identifying the subset; a
+downstream HashAgg grouping on (keys..., flag) then computes every
+grouping set in one pass.
+
+TPU re-design (the hop-window recipe): K = len(subsets) is static, so
+a chunk of capacity C becomes one chunk of capacity C*K — copy k forms
+a contiguous block (U-/U+ adjacency preserved), with copy k's
+out-of-subset columns carrying an all-True null lane. Pure tiling +
+masks; no loops, no dynamic shapes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.executors.base import Executor
+
+
+@partial(jax.jit, static_argnames=("subsets", "names", "flag_col"))
+def _expand_step(chunk: StreamChunk, subsets, names, flag_col: str):
+    cap = chunk.capacity
+    k = len(subsets)
+    tile = lambda a: jnp.tile(a, k)
+    cols = {n: tile(a) for n, a in chunk.columns.items()}
+    cols[flag_col] = jnp.repeat(jnp.arange(k, dtype=jnp.int64), cap)
+    nulls = {}
+    for n in names:
+        base = chunk.nulls.get(n)
+        lanes = []
+        for subset in subsets:
+            if n in subset:
+                lanes.append(
+                    base
+                    if base is not None
+                    else jnp.zeros(cap, jnp.bool_)
+                )
+            else:  # outside the subset: NULL in this copy
+                lanes.append(jnp.ones(cap, jnp.bool_))
+        nulls[n] = jnp.concatenate(lanes)
+    # columns not mentioned in any subset keep their own null lanes
+    for n, lane in chunk.nulls.items():
+        if n not in nulls:
+            nulls[n] = tile(lane)
+    return StreamChunk(cols, tile(chunk.valid), nulls, tile(chunk.ops))
+
+
+class ExpandExecutor(Executor):
+    """GROUPING SETS expansion: ``subsets`` lists, per output copy, the
+    columns that KEEP their values (the grouping set); all other listed
+    columns become NULL in that copy; ``flag_col`` carries the subset
+    ordinal (group on (cols..., flag) downstream)."""
+
+    def __init__(
+        self,
+        subsets: Sequence[Sequence[str]],
+        flag_col: str = "flag",
+    ):
+        if not subsets:
+            raise ValueError("expand needs at least one subset")
+        self.subsets = tuple(tuple(s) for s in subsets)
+        # the union of all subset columns is what expansion touches
+        self.names = tuple(
+            sorted({c for s in self.subsets for c in s})
+        )
+        self.flag_col = flag_col
+
+    def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
+        return [
+            _expand_step(chunk, self.subsets, self.names, self.flag_col)
+        ]

@@ -622,15 +622,13 @@ class HashAggExecutor(Executor, Checkpointable):
         )
         if self.cold_reader is not None:
             self._merge_cold()
-        return self._flush_all()
+        outs = self._flush_all()
+        if barrier is None:  # direct drive: checks fire inline
+            self.finish_barrier()
+        return outs
 
-    def finish_barrier(self) -> None:
-        if self._staged_scalars is None:
-            return
-        dropped, mret, mi_bad, claimed = finish_scalars(
-            self._staged_scalars
-        )
-        self._staged_scalars = None
+    def _on_barrier_scalars(self, vals) -> None:
+        dropped, mret, mi_bad, claimed = vals
         # occupancy refreshes _insert_bound so the NEXT epoch's
         # _maybe_grow usually decides without its own round-trip
         self._insert_bound = int(claimed)

@@ -428,13 +428,12 @@ class HashJoinExecutor(Executor, Checkpointable):
             self.left.table.occupancy(),
             self.right.table.occupancy(),
         )
+        if barrier is None:  # direct drive: checks fire inline
+            self.finish_barrier()
         return []
 
-    def finish_barrier(self) -> None:
-        if self._staged_scalars is None:
-            return
-        em, lo, li, ro, ri, cl, cr = finish_scalars(self._staged_scalars)
-        self._staged_scalars = None
+    def _on_barrier_scalars(self, vals) -> None:
+        em, lo, li, ro, ri, cl, cr = vals
         self._bound["l"] = int(cl)
         self._bound["r"] = int(cr)
         if em:

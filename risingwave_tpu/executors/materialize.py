@@ -529,13 +529,12 @@ class DeviceMaterializeExecutor(Executor, Checkpointable):
         self._staged_scalars = stage_scalars(
             self.state.dropped, self.table.occupancy()
         )
+        if barrier is None:  # direct drive: checks fire inline
+            self.finish_barrier()
         return []
 
-    def finish_barrier(self) -> None:
-        if self._staged_scalars is None:
-            return
-        dropped, claimed = finish_scalars(self._staged_scalars)
-        self._staged_scalars = None
+    def _on_barrier_scalars(self, vals) -> None:
+        dropped, claimed = vals
         # occupancy refreshes the growth bound so steady state has no
         # mid-epoch refresh syncs
         self._bound = int(claimed)

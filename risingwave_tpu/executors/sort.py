@@ -152,13 +152,12 @@ class SortExecutor(Executor, Checkpointable):
         self._staged_scalars = stage_scalars(
             self._saw_delete, self._overflow
         )
+        if barrier is None:  # direct drive: checks fire inline
+            self.finish_barrier()
         return []
 
-    def finish_barrier(self) -> None:
-        if self._staged_scalars is None:
-            return
-        saw_delete, overflow = finish_scalars(self._staged_scalars)
-        self._staged_scalars = None
+    def _on_barrier_scalars(self, vals) -> None:
+        saw_delete, overflow = vals
         if saw_delete:
             raise RuntimeError("EOWC sort requires append-only input")
         if overflow:

@@ -39,8 +39,10 @@ def test_log_store_appends_and_delivers_in_order():
     ex = LogStoreSinkExecutor(log, pk=("k",), columns=("v",))
     ex.apply(_chunk([1, 2], [10, 20]))
     ex.on_barrier(Barrier(Epoch(0, 1)))
+    ex.finish_barrier()
     ex.apply(_chunk([1], [11]))
     ex.on_barrier(Barrier(Epoch(1, 2)))
+    ex.finish_barrier()
 
     sink = RecordingSink()
     delivered = LogSinker(log, sink).run_once()
@@ -60,6 +62,7 @@ def test_crash_between_delivery_and_offset_redelivers():
     ex = LogStoreSinkExecutor(log, pk=("k",), columns=("v",))
     ex.apply(_chunk([5], [50]))
     ex.on_barrier(Barrier(Epoch(0, 1)))
+    ex.finish_barrier()
 
     sink = RecordingSink()
     # simulate the crash window: write happened, offset did not commit
@@ -75,8 +78,10 @@ def test_rolled_back_epochs_discarded_on_recovery():
     ex = LogStoreSinkExecutor(log, pk=("k",), columns=("v",))
     ex.apply(_chunk([1], [10]))
     ex.on_barrier(Barrier(Epoch(0, 1)))
+    ex.finish_barrier()
     ex.apply(_chunk([2], [20]))
     ex.on_barrier(Barrier(Epoch(1, 2)))  # this epoch will roll back
+    ex.finish_barrier()
 
     ex.on_recover(1)  # recovery landed on epoch 1
     sink = RecordingSink()
