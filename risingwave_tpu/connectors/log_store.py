@@ -132,6 +132,9 @@ class LogStoreSinkExecutor(Executor):
         return [chunk]
 
     def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
+        # leftovers mean the previous finish walk ABORTED (an upstream
+        # latch raised): those epochs rolled back — never log them
+        self._finish_queue = []
         batch = compact_rows(self._buffer)
         self._buffer = []
         if barrier is not None and (batch or barrier.checkpoint):

@@ -74,6 +74,14 @@ class ExpandExecutor(Executor):
         self.flag_col = flag_col
 
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
+        missing = [n for n in self.names if n not in chunk.columns]
+        if missing:
+            raise KeyError(f"expand subset columns not in chunk: {missing}")
+        if self.flag_col in chunk.columns:
+            raise ValueError(
+                f"flag column {self.flag_col!r} collides with an input "
+                "column; pass a different flag_col"
+            )
         return [
             _expand_step(chunk, self.subsets, self.names, self.flag_col)
         ]

@@ -35,7 +35,7 @@ from risingwave_tpu.ops.hash_table import (
 
 GROW_AT = 0.5
 
-KINDS = ("row_number", "count", "sum")
+KINDS = ("row_number", "count", "sum", "min", "max", "lag")
 
 
 @dataclass(frozen=True)
@@ -49,6 +49,25 @@ class WindowCall:
             raise ValueError(f"unsupported window kind {self.kind!r}")
         if (self.input is None) != (self.kind in ("row_number", "count")):
             raise ValueError(f"{self.kind} input mismatch")
+
+
+def _accum_names(call: "WindowCall"):
+    """Accumulator lanes per call (lag keeps last-value + flags;
+    min/max keep a presence flag so sentinel-valued inputs are not
+    misread as NULL)."""
+    if call.kind == "lag":
+        return (call.output, call.output + "#has", call.output + "#null")
+    if call.kind in ("min", "max"):
+        return (call.output, call.output + "#has")
+    return (call.output,)
+
+
+def _accum_init(call: "WindowCall") -> int:
+    if call.kind == "min":
+        return jnp.iinfo(jnp.int64).max
+    if call.kind == "max":
+        return jnp.iinfo(jnp.int64).min
+    return 0
 
 
 @partial(jax.jit, static_argnames=("calls", "part_keys"), donate_argnums=(0, 1))
@@ -106,18 +125,39 @@ def _over_step(
     s_active = s_slot < table.capacity
     gslot = jnp.where(s_active, s_slot, 0)
 
+    # segment end == next segment's start (derive from boundary)
+    is_last = jnp.concatenate([boundary[1:], jnp.ones(1, jnp.bool_)])
+    MAXI = jnp.iinfo(jnp.int64).max
+    MINI = jnp.iinfo(jnp.int64).min
+
+    def seg_prefix_extreme(v, kind):
+        """Inclusive segmented prefix min/max via an associative scan
+        with a boundary-reset flag (the classic segmented-scan
+        combine)."""
+        comb = jnp.minimum if kind == "min" else jnp.maximum
+
+        def op(a, b):
+            fa, va = a
+            fb, vb = b
+            return fa | fb, jnp.where(fb, vb, comb(va, vb))
+
+        _, out = jax.lax.associative_scan(op, (boundary, v))
+        return out
+
     out_sorted: Dict[str, jnp.ndarray] = {}
+    out_nulls_sorted: Dict[str, jnp.ndarray] = {}
     new_accums = dict(accums)
     for c in calls:
         acc = new_accums[c.output]
         base = acc[gslot]
-        if c.kind == "row_number":
+        upd = jnp.where(s_active & is_last, gslot, table.capacity)
+        if c.kind in ("row_number", "count"):
             o = base + rank + 1
             contrib = jnp.where(s_active, jnp.int64(1), jnp.int64(0))
-        elif c.kind == "count":
-            o = base + rank + 1
-            contrib = jnp.where(s_active, jnp.int64(1), jnp.int64(0))
-        else:  # running sum (NULL inputs contribute 0, SQL skips them)
+            totals = jax.ops.segment_sum(contrib, gid, num_segments=n)[gid]
+            new_accums[c.output] = acc.at[upd].add(totals, mode="drop")
+        elif c.kind == "sum":
+            # running sum (NULL inputs contribute 0, SQL skips them)
             v = s_vals[c.input]
             nn = ~s_nulls.get(c.input, jnp.zeros(n, jnp.bool_))
             v = jnp.where(s_active & nn, v, 0)
@@ -125,28 +165,106 @@ def _over_step(
             # boundary's exclusive prefix may be negative)
             csum = jnp.cumsum(v)
             seg_base = jax.ops.segment_max(
-                jnp.where(boundary, csum - v, jnp.iinfo(jnp.int64).min),
+                jnp.where(boundary, csum - v, MINI),
                 gid,
                 num_segments=n,
             )[gid]
             o = base + (csum - seg_base)
-            contrib = v
+            totals = jax.ops.segment_sum(v, gid, num_segments=n)[gid]
+            new_accums[c.output] = acc.at[upd].add(totals, mode="drop")
+        elif c.kind in ("min", "max"):
+            sent = MAXI if c.kind == "min" else MINI
+            comb = jnp.minimum if c.kind == "min" else jnp.maximum
+            v = s_vals[c.input]
+            nn = ~s_nulls.get(c.input, jnp.zeros(n, jnp.bool_))
+            real = s_active & nn
+            v = jnp.where(real, v, sent)
+            pref = seg_prefix_extreme(v, c.kind)
+            o = comb(base, pref)
+            # presence via a companion lane, NOT sentinel equality: a
+            # legitimate input equal to the int64 extreme must not be
+            # misclassified as NULL (its value still combines right —
+            # min(x, +inf) = x)
+            has = new_accums[c.output + "#has"]
+            pref_has = (
+                jnp.cumsum(real.astype(jnp.int64))
+                - jax.ops.segment_max(
+                    jnp.where(
+                        boundary,
+                        jnp.cumsum(real.astype(jnp.int64))
+                        - real.astype(jnp.int64),
+                        MINI,
+                    ),
+                    gid,
+                    num_segments=n,
+                )[gid]
+            ) > 0
+            out_nulls_sorted[c.output] = ~((has[gslot] != 0) | pref_has)
+            seg_fn = (
+                jax.ops.segment_min if c.kind == "min" else jax.ops.segment_max
+            )
+            seg_ext = seg_fn(v, gid, num_segments=n)[gid]
+            if c.kind == "min":
+                new_accums[c.output] = acc.at[upd].min(seg_ext, mode="drop")
+            else:
+                new_accums[c.output] = acc.at[upd].max(seg_ext, mode="drop")
+            seg_any = (
+                jax.ops.segment_sum(
+                    real.astype(jnp.int64), gid, num_segments=n
+                )[gid]
+                > 0
+            )
+            new_accums[c.output + "#has"] = (
+                has.at[upd].max(seg_any.astype(jnp.int64), mode="drop")
+            )
+        else:  # lag(1): previous row's value within the partition
+            v = s_vals[c.input]
+            vnull = s_nulls.get(c.input, jnp.zeros(n, jnp.bool_))
+            prev_v = jnp.concatenate([jnp.zeros(1, v.dtype), v[:-1]])
+            prev_null = jnp.concatenate(
+                [jnp.zeros(1, jnp.bool_), vnull[:-1]]
+            )
+            first = rank == 0
+            # pre-update state: the partition's stored last value
+            prev_has = new_accums[c.output + "#has"][gslot] != 0
+            prev_stored_null = new_accums[c.output + "#null"][gslot] != 0
+            o = jnp.where(first, base, prev_v)
+            out_nulls_sorted[c.output] = jnp.where(
+                first, ~prev_has | prev_stored_null, prev_null
+            )
+            # store the segment's LAST value (+ its nullness) per slot
+            lastv = jax.ops.segment_max(
+                jnp.where(is_last, v, MINI), gid, num_segments=n
+            )[gid]
+            lastn = jax.ops.segment_max(
+                jnp.where(is_last, vnull.astype(jnp.int64), 0),
+                gid,
+                num_segments=n,
+            )[gid]
+            new_accums[c.output] = acc.at[upd].set(lastv, mode="drop")
+            new_accums[c.output + "#null"] = (
+                new_accums[c.output + "#null"]
+                .at[upd]
+                .set(lastn, mode="drop")
+            )
+            new_accums[c.output + "#has"] = (
+                new_accums[c.output + "#has"]
+                .at[upd]
+                .set(jnp.int64(1), mode="drop")
+            )
         out_sorted[c.output] = o
-        # per-partition totals land on the segment's last row
-        totals = jax.ops.segment_sum(contrib, gid, num_segments=n)[gid]
-        is_last = jnp.concatenate(
-            [s_slot[1:] != s_slot[:-1], jnp.ones(1, jnp.bool_)]
-        )
-        upd = jnp.where(s_active & is_last, gslot, table.capacity)
-        new_accums[c.output] = acc.at[upd].add(totals, mode="drop")
 
     # unsort back to arrival positions
     cols = dict(chunk.columns)
+    out_nulls = dict(chunk.nulls)
     for name, o in out_sorted.items():
         buf = jnp.zeros(n, jnp.int64)
         cols[name] = buf.at[s_pos].set(o)
+    for name, lane in out_nulls_sorted.items():
+        nbuf = jnp.zeros(n, jnp.bool_)
+        out_nulls[name] = nbuf.at[s_pos].set(lane)
     out = StreamChunk(
-        columns=cols, valid=chunk.valid & active, nulls=dict(chunk.nulls),
+        columns=cols, valid=chunk.valid & active, nulls=out_nulls,
         ops=chunk.ops,
     )
     return table, new_accums, out, saw_delete, dropped
@@ -169,9 +287,13 @@ class OverWindowExecutor(Executor):
             capacity,
             tuple(jnp.dtype(schema_dtypes[k]) for k in self.part_keys),
         )
-        self.accums = {
-            c.output: jnp.zeros(capacity, jnp.int64) for c in self.calls
-        }
+        self.accums = {}
+        self._accum_inits = {}
+        for c in self.calls:
+            for name in _accum_names(c):
+                init = _accum_init(c) if name == c.output else 0
+                self._accum_inits[name] = init
+                self.accums[name] = jnp.full(capacity, init, jnp.int64)
         self._bound = 0
         self._saw_delete = jnp.zeros((), jnp.bool_)
         self._dropped = jnp.zeros((), jnp.bool_)
@@ -201,7 +323,10 @@ class OverWindowExecutor(Executor):
             new = set_live(new, jnp.where(keep, slots, -1), self.table.live)
             idx = jnp.where(keep, slots, new_cap)
             self.accums = {
-                name: jnp.zeros(new_cap, jnp.int64)
+                # unclaimed slots must keep each lane's INIT value (a
+                # zero base would corrupt running min/max for new
+                # partitions landing there)
+                name: jnp.full(new_cap, self._accum_inits[name], jnp.int64)
                 .at[idx]
                 .set(a, mode="drop")
                 for name, a in self.accums.items()
@@ -211,11 +336,17 @@ class OverWindowExecutor(Executor):
         self._bound = claimed
 
     def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
-        import numpy as np
+        from risingwave_tpu.ops.hash_table import stage_scalars
 
-        sd, dr = np.asarray(
-            jnp.stack([self._saw_delete, self._dropped])
-        ).tolist()
+        self._staged_scalars = stage_scalars(
+            self._saw_delete, self._dropped
+        )
+        if barrier is None:  # direct drive: checks fire inline
+            self.finish_barrier()
+        return []
+
+    def _on_barrier_scalars(self, vals) -> None:
+        sd, dr = vals
         if sd:
             raise RuntimeError(
                 "append-only OverWindow received a DELETE (the general "
@@ -223,4 +354,3 @@ class OverWindowExecutor(Executor):
             )
         if dr:
             raise RuntimeError("OverWindow partition table overflowed")
-        return []

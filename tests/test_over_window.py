@@ -1,0 +1,54 @@
+
+
+def test_running_min_max_and_lag():
+    """min/max/lag window kinds vs a pandas-style oracle across chunks
+    (state crosses chunk boundaries)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from risingwave_tpu.array.chunk import StreamChunk
+    from risingwave_tpu.executors.over_window import (
+        OverWindowExecutor,
+        WindowCall,
+    )
+
+    ex = OverWindowExecutor(
+        partition_by=("p",),
+        calls=(
+            WindowCall("min", "x", "rmin"),
+            WindowCall("max", "x", "rmax"),
+            WindowCall("lag", "x", "prev"),
+        ),
+        schema_dtypes={"p": jnp.int64, "x": jnp.int64},
+        capacity=1 << 8,
+    )
+    rng = np.random.default_rng(7)
+    hist = {}
+    got = []
+    for _ in range(6):
+        n = int(rng.integers(3, 30))
+        ps = rng.integers(0, 4, n)
+        xs = rng.integers(-50, 50, n)
+        chunk = StreamChunk.from_numpy({"p": ps, "x": xs}, 32)
+        (out,) = ex.apply(chunk)
+        d = out.to_numpy()
+        pn = d.get("prev__null", np.zeros(len(d["p"]), bool))
+        for i in range(len(d["p"])):
+            got.append(
+                (int(d["p"][i]), int(d["rmin"][i]), int(d["rmax"][i]),
+                 None if pn[i] else int(d["prev"][i]))
+            )
+    want = []
+    hist = {}
+    # rebuild the oracle from the SAME arrival order
+    rng = np.random.default_rng(7)
+    for _ in range(6):
+        n = int(rng.integers(3, 30))
+        ps = rng.integers(0, 4, n)
+        xs = rng.integers(-50, 50, n)
+        for p, x in zip(ps.tolist(), xs.tolist()):
+            seen = hist.setdefault(p, [])
+            prev = seen[-1] if seen else None
+            seen.append(x)
+            want.append((p, min(seen), max(seen), prev))
+    assert got == want
