@@ -108,6 +108,7 @@ class DistributedBatchRunner:
                 from_=stmt.from_,
                 where=stmt.where,
                 group_by=stmt.group_by,
+                grouping_sets=stmt.grouping_sets,
             )
 
         partials: List[Dict[str, np.ndarray]] = []

@@ -68,6 +68,7 @@ class LAggProject:
     alias: Optional[str] = None
     order_by: Tuple = ()
     limit: Optional[int] = None
+    grouping_sets: Tuple = ()
 
 
 @dataclass
@@ -96,6 +97,7 @@ def build(
         alias=alias,
         order_by=select.order_by,
         limit=select.limit,
+        grouping_sets=select.grouping_sets,
     )
 
 
@@ -494,6 +496,7 @@ def emit(node: LAggProject) -> P.Select:
         group_by=node.group_by,
         order_by=node.order_by,
         limit=node.limit,
+        grouping_sets=node.grouping_sets,
     )
 
 

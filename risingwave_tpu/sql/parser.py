@@ -97,6 +97,8 @@ class Select:
     group_by: Tuple[Ident, ...]
     order_by: Tuple[Tuple[Ident, bool], ...] = ()  # (col, desc)
     limit: Optional[int] = None
+    # GROUP BY GROUPING SETS ((a, b), (a), ()) — empty means plain
+    grouping_sets: Tuple[Tuple[Ident, ...], ...] = ()
 
 
 @dataclass(frozen=True)
@@ -401,12 +403,40 @@ class Parser:
             rel = Join(rel, right, self.expr(), jt)
         where = self.expr() if self.accept("kw", "where") else None
         group: Tuple[Ident, ...] = ()
+        gsets: Tuple[Tuple[Ident, ...], ...] = ()
         if self.accept("kw", "group"):
             self.expect("kw", "by")
-            cols = [self.qualified_ident()]
-            while self.accept("op", ","):
-                cols.append(self.qualified_ident())
-            group = tuple(cols)
+            if self._accept_word("grouping"):
+                if not self._accept_word("sets"):
+                    raise SyntaxError("expected SETS after GROUPING")
+                self.expect("op", "(")
+                sets = []
+                while True:
+                    self.expect("op", "(")
+                    cols = []
+                    if not self.accept("op", ")"):
+                        cols.append(self.qualified_ident())
+                        while self.accept("op", ","):
+                            cols.append(self.qualified_ident())
+                        self.expect("op", ")")
+                    sets.append(tuple(cols))
+                    if not self.accept("op", ","):
+                        break
+                self.expect("op", ")")
+                gsets = tuple(sets)
+                # union of all set columns is the working key set
+                seen, union = set(), []
+                for st in gsets:
+                    for c in st:
+                        if c.name not in seen:
+                            seen.add(c.name)
+                            union.append(c)
+                group = tuple(union)
+            else:
+                cols = [self.qualified_ident()]
+                while self.accept("op", ","):
+                    cols.append(self.qualified_ident())
+                group = tuple(cols)
         order: Tuple[Tuple[Ident, bool], ...] = ()
         if self.accept("kw", "order"):
             self.expect("kw", "by")
@@ -423,7 +453,9 @@ class Parser:
         limit = None
         if self.accept("kw", "limit"):
             limit = int(self.expect("num").value)
-        return Select(tuple(items), rel, where, group, order, limit)
+        return Select(
+            tuple(items), rel, where, group, order, limit, gsets
+        )
 
     def select_item(self) -> SelectItem:
         e = self.expr()

@@ -242,6 +242,7 @@ def typecheck_select(select: P.Select, catalog, strings=None) -> P.Select:
         group_by=select.group_by,
         order_by=select.order_by,
         limit=select.limit,
+        grouping_sets=select.grouping_sets,
     )
     _check_collation(out, env, infer_output_fields(out, catalog))
     return out
