@@ -97,7 +97,40 @@ class JsonParser(Parser):
             obj = raw
         if not isinstance(obj, dict):
             return None
-        return tuple(obj.get(f.name) for f in self.schema.fields)
+        return tuple(
+            self._coerce(f, obj.get(f.name)) for f in self.schema.fields
+        )
+
+    @staticmethod
+    def _coerce(f, v):
+        """Type-check a cell into the field's lane domain; bad cells
+        become NULL (CsvParser convention) instead of blowing up
+        encode_column AFTER source offsets have advanced."""
+        if v is None:
+            return None
+        kind = f.dtype.value
+        try:
+            if kind in ("varchar", "jsonb"):
+                return v if isinstance(v, str) else json.dumps(v)
+            if kind in ("float32", "float64"):
+                return float(v)
+            if kind == "boolean":
+                if isinstance(v, bool):
+                    return v
+                if isinstance(v, (int, float)) and v in (0, 1):
+                    return bool(v)
+                if isinstance(v, str):
+                    low = v.lower()
+                    if low in ("t", "true", "1"):
+                        return True
+                    if low in ("f", "false", "0"):
+                        return False
+                return None  # bool("false") is True — never truthiness
+            if kind == "decimal":
+                return v if isinstance(v, str) else repr(v)
+            return int(v)  # int lanes: reject non-numeric strings too
+        except (TypeError, ValueError):
+            return None
 
 
 class CsvParser(Parser):
@@ -295,28 +328,33 @@ class GenericSourceExecutor(Executor, Checkpointable):
     ) -> List[StreamChunk]:
         """Read every split once; returns at most one chunk per split."""
         out: List[StreamChunk] = []
+        staged: Dict[str, int] = {}
         for s in self.splits:
             raw, new_off = self.connector.read(
                 s, self.offsets[s.split_id], max_rows_per_split
             )
-            self.offsets[s.split_id] = new_off
             rows = [r for r in map(self.parser.parse, raw) if r is not None]
-            if not rows:
-                continue
-            lanes: Dict[str, np.ndarray] = {}
-            nulls: Dict[str, np.ndarray] = {}
-            for j, f in enumerate(self.schema.fields):
-                cl, cn = encode_column(
-                    f, [r[j] for r in rows], self.strings
+            if rows:
+                lanes: Dict[str, np.ndarray] = {}
+                nulls: Dict[str, np.ndarray] = {}
+                for j, f in enumerate(self.schema.fields):
+                    cl, cn = encode_column(
+                        f, [r[j] for r in rows], self.strings
+                    )
+                    lanes.update(cl)
+                    if cn:
+                        nulls.update(cn)
+                out.append(
+                    StreamChunk.from_numpy(
+                        lanes, capacity, nulls=nulls or None
+                    )
                 )
-                lanes.update(cl)
-                if cn:
-                    nulls.update(cn)
-            out.append(
-                StreamChunk.from_numpy(
-                    lanes, capacity, nulls=nulls or None
-                )
-            )
+            staged[s.split_id] = new_off
+        # offsets advance only after EVERY split encoded: a failure on
+        # split k must not strand splits < k (their chunks were never
+        # returned) past offsets the next checkpoint would commit — the
+        # whole failed poll re-reads instead (exact-resume contract)
+        self.offsets.update(staged)
         return out
 
     @property

@@ -132,12 +132,19 @@ class LogStoreSinkExecutor(Executor):
         return [chunk]
 
     def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
+        if barrier is None:
+            # the log is keyed by epoch; a direct drive has none, and
+            # silently dropping the batch would be data loss — fail loud
+            raise ValueError(
+                "LogStoreSinkExecutor requires a real epoch: drive it "
+                "through a runtime barrier, not on_barrier(None)"
+            )
         # leftovers mean the previous finish walk ABORTED (an upstream
         # latch raised): those epochs rolled back — never log them
         self._finish_queue = []
         batch = compact_rows(self._buffer)
         self._buffer = []
-        if barrier is not None and (batch or barrier.checkpoint):
+        if batch or barrier.checkpoint:
             # persist in finish_barrier: an upstream latch (corrupt
             # epoch) raises from ITS finish before this blob is written
             self._finish_queue.append((barrier.epoch.curr, batch))

@@ -264,6 +264,7 @@ class FragmentActor(threading.Thread):
         # per-(channel,column) watermark frontier for min-alignment
         self._wm_seen: Dict[Tuple[int, str], int] = {}
         self._wm_sent: Dict[str, int] = {}
+        self._stopped: List[bool] = [False] * len(self.inputs)
 
     # -- chain plumbing ---------------------------------------------------
     def _through(self, chain, chunks, barrier=None):
@@ -367,18 +368,31 @@ class FragmentActor(threading.Thread):
         aligns per-input watermarks on merge, executor/merge.rs), then
         walk the chain with the aligned value."""
         self._wm_seen[(chan_idx, wm.column)] = wm.value
+        self._try_align(wm.column)
+
+    def _realign_after_stop(self) -> None:
+        """A channel just stopped: columns waiting on it may now align
+        across the remaining live inputs."""
+        for col in {c for (_ci, c) in self._wm_seen}:
+            self._try_align(col)
+
+    def _try_align(self, column: str) -> None:
+        # align against LIVE channels only: a stopped upstream never
+        # sends another watermark, so counting it would stall EOWC /
+        # window operators downstream forever
+        live = [i for i in range(len(self.inputs)) if not self._stopped[i]]
         vals = [
             v
             for (ci, col), v in self._wm_seen.items()
-            if col == wm.column
+            if col == column and not self._stopped[ci]
         ]
-        if len(vals) < len(self.inputs):
-            return  # some input has not reached any watermark yet
+        if not vals or len(vals) < len(live):
+            return  # some live input has not reached any watermark yet
         aligned = min(vals)
-        if aligned <= self._wm_sent.get(wm.column, -(1 << 62)):
+        if aligned <= self._wm_sent.get(column, -(1 << 62)):
             return
-        self._wm_sent[wm.column] = aligned
-        awm = Watermark(wm.column, aligned)
+        self._wm_sent[column] = aligned
+        awm = Watermark(column, aligned)
         if self.join_exec is None:
             down, outs = _walk_watermark(self.chain, awm)
             self._emit(outs)
@@ -420,7 +434,7 @@ class FragmentActor(threading.Thread):
     def _run_loop(self) -> None:
         n = len(self.inputs)
         parked: List[Optional[Barrier]] = [None] * n
-        stopped = [False] * n
+        stopped = self._stopped
         while True:
             progressed = False
             for i, (port, ch) in enumerate(self.inputs):
@@ -439,6 +453,7 @@ class FragmentActor(threading.Thread):
                     parked[i] = payload
                 elif kind == STOP:
                     stopped[i] = True
+                    self._realign_after_stop()
             live = [i for i in range(n) if not stopped[i]]
             if not live:
                 self.dispatcher.control(STOP)

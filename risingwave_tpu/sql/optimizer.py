@@ -234,14 +234,36 @@ def _fold_pred(pred):
     return pred
 
 
+_STRICT_ARITH = ("+", "-", "*", "/", "%")
+
+
+def _null_strict(expr) -> bool:
+    """True only when a NULL in ANY input ident forces the expression
+    itself to NULL. CASE/COALESCE-like constructs can map NULL inputs
+    to non-NULL outputs, so any appearance makes the tree non-strict."""
+    if isinstance(expr, (P.Ident, P.Literal)):
+        return True
+    if isinstance(expr, P.BinaryOp) and expr.op in _STRICT_ARITH:
+        return _null_strict(expr.left) and _null_strict(expr.right)
+    if isinstance(expr, P.UnaryOp) and expr.op == "-":
+        return _null_strict(expr.operand)
+    return False
+
+
 def _null_rejecting_side(pred, join: LJoin) -> Optional[str]:
     """Which side of the join this predicate null-rejects ("left" /
     "right" / None). Conservative: comparisons and IS NOT NULL reject
-    NULL inputs; anything else is assumed not to."""
-    rejecting = isinstance(pred, P.BinaryOp) and pred.op in (
+    NULL inputs only when their operands are NULL-strict — a CASE over
+    the padded side can turn a NULL row into a satisfying value, so it
+    must NOT trigger outer-join reduction."""
+    if isinstance(pred, P.BinaryOp) and pred.op in (
         "=", "<>", "<", "<=", ">", ">=",
-    )
-    rejecting |= isinstance(pred, P.UnaryOp) and pred.op == "is not null"
+    ):
+        rejecting = _null_strict(pred.left) and _null_strict(pred.right)
+    elif isinstance(pred, P.UnaryOp) and pred.op == "is not null":
+        rejecting = _null_strict(pred.operand)
+    else:
+        rejecting = False
     if not rejecting:
         return None
     if _owned_by(pred, join.left):

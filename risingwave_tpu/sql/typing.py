@@ -144,6 +144,24 @@ def _rewrite_pred(pred, env, strings=None):
         if pred.op in _CMP_OPS:
             lf = _field_of(env, left) if isinstance(left, P.Ident) else None
             rf = _field_of(env, right) if isinstance(right, P.Ident) else None
+            dict_side = next(
+                (
+                    f
+                    for f in (lf, rf)
+                    if f is not None
+                    and f.dtype in (DataType.VARCHAR, DataType.JSONB)
+                ),
+                None,
+            )
+            if dict_side is not None and pred.op not in ("=", "<>", "!="):
+                # dictionary codes are insertion-ordered, not
+                # collation-ordered: ordered operators over them would
+                # silently return wrong rows (mirrors _check_collation)
+                raise NotImplementedError(
+                    f"operator '{pred.op}' on {dict_side.dtype.name}: "
+                    "dictionary codes are equality-only, not "
+                    "collation-ordered"
+                )
             if lf is not None and isinstance(right, P.Literal):
                 right = _lane_lit(right, lf, strings)
             elif rf is not None and isinstance(left, P.Literal):
@@ -172,6 +190,14 @@ def _rewrite_pred(pred, env, strings=None):
         if pred.name in ("between", "in") and args:
             f = _field_of(env, args[0]) if isinstance(args[0], P.Ident) else None
             if f is not None:
+                if pred.name == "between" and f.dtype in (
+                    DataType.VARCHAR,
+                    DataType.JSONB,
+                ):
+                    raise NotImplementedError(
+                        f"{f.dtype.name} BETWEEN: dictionary codes are "
+                        "not collation-ordered"
+                    )
                 args = [args[0]] + [
                     _lane_lit(a, f, strings) if isinstance(a, P.Literal) else a
                     for a in args[1:]

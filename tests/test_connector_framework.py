@@ -66,6 +66,41 @@ def test_file_log_source_with_json_parser(tmp_path):
     assert int(chunks[0].to_numpy()["k"][0]) == 5
 
 
+def test_json_parser_type_mismatch_becomes_null(tmp_path):
+    """A wrong-typed cell ({"k": "oops"} for BIGINT) must become NULL
+    at parse time — not blow up encode_column after offsets advanced,
+    which would permanently lose the whole poll batch (advisor r3)."""
+    d = str(tmp_path)
+    FileLogSource.append(
+        d,
+        0,
+        [
+            '{"k": 1, "v": 10}',
+            '{"k": "oops", "v": [1, 2]}',
+            '{"k": 3, "v": "30"}',
+        ],
+    )
+    schema = Schema([("k", DataType.INT64), ("v", DataType.INT64)])
+    src = GenericSourceExecutor(
+        FileLogSource(d), JsonParser(schema), table_id="fl"
+    )
+    chunks = src.poll(10, 16)
+    data = chunks[0].to_numpy()
+    knull = data.get("k__null")
+    got = [
+        None if knull is not None and knull[i] else int(data["k"][i])
+        for i in range(len(data["k"]))
+    ]
+    assert got == [1, None, 3]
+    # offsets advanced past ALL three rows: the poll consumed them
+    assert src.offsets["0"] > 0
+    assert not src.poll(10, 16)  # nothing re-read
+    # numeric strings coerce ("30" -> 30)
+    vnull = data.get("v__null")
+    assert int(data["v"][2]) == 30
+    assert vnull is None or not vnull[2]
+
+
 def test_offsets_checkpoint_and_restore(tmp_path):
     d = str(tmp_path)
     FileLogSource.append(d, 0, [f'{{"k": {i}}}' for i in range(6)])

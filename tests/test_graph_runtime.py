@@ -220,6 +220,46 @@ def test_watermark_min_alignment_across_sources():
     g.stop()
 
 
+def test_watermark_aligns_after_source_stop():
+    """A stopped upstream must drop out of min-alignment: the live
+    input's watermarks keep flowing instead of stalling EOWC forever
+    (advisor r3, graph.py watermark alignment)."""
+
+    class RecordWM(Executor):
+        def __init__(self):
+            self.seen = []
+
+        def on_watermark(self, wm):
+            self.seen.append((wm.column, wm.value))
+            return wm, []
+
+    rec = RecordWM()
+    g = GraphRuntime(
+        [
+            FragmentSpec("s1", lambda i: []),
+            FragmentSpec("s2", lambda i: []),
+            FragmentSpec(
+                "m", lambda i: [rec], inputs=[("s1", 0), ("s2", 0)]
+            ),
+        ]
+    ).start()
+    g.inject_watermark("ts", 100, source="s1")
+    g.inject_barrier()
+    assert rec.seen == []  # s2 has no frontier: aligned on nothing
+    for ch in g._source_channels["s2"]:
+        ch.send_control("stop")
+    deadline = time.time() + 5.0
+    while time.time() < deadline and rec.seen != [("ts", 100)]:
+        time.sleep(0.01)
+    assert rec.seen == [("ts", 100)]  # realigned across live inputs
+    g.inject_watermark("ts", 200, source="s1")
+    deadline = time.time() + 5.0
+    while time.time() < deadline and len(rec.seen) < 2:
+        time.sleep(0.01)
+    assert rec.seen == [("ts", 100), ("ts", 200)]
+    g.stop()
+
+
 def test_permit_channel_backpressure():
     ch = PermitChannel(record_permits=8)
     c = StreamChunk.from_numpy({"x": np.arange(8)}, 8)

@@ -86,6 +86,25 @@ def test_outer_join_kept_without_null_rejection():
     assert node.join_type == "left"
 
 
+def test_outer_join_kept_under_non_strict_predicate():
+    """CASE WHEN r.ys IS NULL THEN 1 ELSE r.ys END = 1 is satisfied by
+    NULL-padded rows, so it must NOT reduce the LEFT join to INNER
+    (advisor r3: null-rejection requires NULL-strict operands)."""
+    ir = _ir(
+        _JOIN.format(
+            jt="LEFT OUTER",
+            where=(
+                "WHERE CASE WHEN r.ys IS NULL THEN 1 ELSE r.ys END = 1"
+            ),
+        )
+    )
+    node = ir.input
+    while isinstance(node, LFilter):
+        node = node.input
+    assert isinstance(node, LJoin)
+    assert node.join_type == "left"
+
+
 def test_constant_folding_drops_true_conjuncts():
     ir = _ir("SELECT k FROM t WHERE 1 = 1")
     assert not isinstance(ir.input, LFilter)  # folded away entirely
@@ -183,3 +202,9 @@ def test_varchar_collation_operations_rejected():
     # equality-complete operations still work
     out, _ = s.execute("SELECT name FROM ev WHERE name = 'apple'")
     assert list(out["name"]) == ["apple"]
+    # range comparisons on dictionary codes would compare insertion
+    # order, not collation: rejected loudly (advisor r3)
+    with pytest.raises(NotImplementedError, match="collation"):
+        s.execute("SELECT n FROM ev WHERE name > 'a'")
+    with pytest.raises(NotImplementedError, match="collation"):
+        s.execute("SELECT n FROM ev WHERE name BETWEEN 'a' AND 'c'")
