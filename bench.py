@@ -435,43 +435,82 @@ def bench_q5(args_epochs, events_per_epoch, chunk_events, smoke, agg_mode):
 
 
 # ---------------------------------------------------------------------------
-# Orchestration: each query benches in an isolated SUBPROCESS with a
-# timeout and tiered ESCALATION, so one kernel fault / hang cannot zero
-# out the whole benchmark (VERDICT r2 #1). Tiers run smallest-first:
-# each success is banked before risking a bigger shape, because a
-# killed/timed-out TPU process can wedge the single-client tunnel and
-# starve every later attempt. The parent always prints ONE JSON line.
+# Orchestration: each query benches in an isolated SUBPROCESS, so one
+# kernel fault / hang cannot zero out the whole benchmark (VERDICT r2
+# #1). r4 discipline (VERDICT r3 #1):
+#   - BREADTH-FIRST tiers: every query lands a smoke_dev number before
+#     anything escalates — the first few minutes bank a full result set.
+#   - Every success is written to BENCH_partial.json IMMEDIATELY; the
+#     driver's artifact can never be empty because the run was cut off.
+#   - Children time THEMSELVES out via signal.alarm and exit through
+#     normal teardown; the parent NEVER SIGKILLs a TPU client (a killed
+#     client wedges the single-client tunnel for a long time).
+#   - A global wall-clock budget (BENCH_BUDGET_S, default 2100s) gates
+#     every child launch; remaining-time is always enough for the child
+#     plus finalize, so the ONE JSON line always prints.
 # ---------------------------------------------------------------------------
 
 TIERS = {
     # (epochs, events_per_epoch, chunk_events, timeout_s)
-    "full": (10, 200_000, 8_192, 900),
-    "mid": (5, 50_000, 4_096, 420),
-    "smoke_dev": (2, 10_000, 2_048, 300),
+    "full": (10, 200_000, 8_192, 600),
+    "mid": (5, 50_000, 4_096, 360),
+    "smoke_dev": (2, 10_000, 2_048, 240),
 }
-TIER_ORDER = ["smoke_dev", "mid", "full"]  # escalate, banking each success
+TIER_ORDER = ["smoke_dev", "mid", "full"]  # breadth-first escalation
+PARTIAL_PATH = "BENCH_partial.json"
+_FINALIZE_RESERVE_S = 20  # budget held back for merge+print
 
 
-def _device_alive(timeout_s: int = 90) -> bool:
+def _device_alive(timeout_s: int = 60) -> bool:
     """Fresh-process probe: can a client still acquire the device? A
-    SIGKILLed bench child can wedge the single-client TPU tunnel; when
+    killed bench child can wedge the single-client TPU tunnel; when
     that happens every later jax.devices() hangs, so detect it cheaply
-    instead of burning each tier's full timeout."""
+    instead of burning each tier's full timeout. The probe itself
+    self-terminates via signal.alarm (never leaves a hung client)."""
     import subprocess
 
+    code = (
+        "import signal, os\n"
+        "signal.signal(signal.SIGALRM, lambda *a: os._exit(9))\n"
+        f"signal.alarm({timeout_s})\n"
+        "import jax; jax.devices()\n"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
     try:
-        proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            capture_output=True,
-            timeout=timeout_s,
-        )
-        return proc.returncode == 0
+        return proc.wait(timeout=timeout_s + 15) == 0
     except subprocess.TimeoutExpired:
+        # alarm never fired (blocked inside a C call): SIGTERM and move
+        # on — never SIGKILL a process that may hold the tunnel
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            pass
         return False
 
 
+def _bank_partial(merged: dict) -> None:
+    """Persist the merged results NOW — a cut-off run must still leave
+    the numbers on disk (r3 lost everything to an rc=124)."""
+    import os
+
+    tmp = PARTIAL_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(merged, f)
+    os.replace(tmp, PARTIAL_PATH)
+
+
 def _run_child(query: str, tier: str, smoke: bool, agg_mode: str):
+    """Run one (query, tier) in a subprocess. The child installs
+    signal.alarm(timeout) and exits through normal JAX teardown on
+    expiry; the parent waits timeout+grace and then SIGTERMs (still
+    catchable) — it never SIGKILLs."""
     import subprocess
+
     import os
 
     epochs, events, chunk, timeout_s = TIERS[tier]
@@ -488,19 +527,32 @@ def _run_child(query: str, tier: str, smoke: bool, agg_mode: str):
         str(chunk),
         "--agg-mode",
         agg_mode,
+        "--alarm-s",
+        str(timeout_s),
     ]
     if smoke:
         cmd.append("--smoke")
+    proc = subprocess.Popen(
+        cmd,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
     try:
-        proc = subprocess.run(
-            cmd, capture_output=True, timeout=timeout_s, text=True
-        )
+        out, err = proc.communicate(timeout=timeout_s + 45)
     except subprocess.TimeoutExpired:
+        proc.terminate()  # SIGTERM: python unwinds, client detaches
+        try:
+            out, err = proc.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            # do NOT escalate to SIGKILL: a murdered client wedges the
+            # tunnel; an orphan that eventually exits does less damage
+            return None, f"{query}/{tier}: unresponsive after SIGTERM"
         return None, f"{query}/{tier}: timeout after {timeout_s}s"
     if proc.returncode != 0:
-        tail = (proc.stderr or "")[-400:]
+        tail = (err or "")[-400:]
         return None, f"{query}/{tier}: rc={proc.returncode}: {tail}"
-    for line in reversed(proc.stdout.strip().splitlines()):
+    for line in reversed((out or "").strip().splitlines()):
         try:
             return json.loads(line), None
         except json.JSONDecodeError:
@@ -537,7 +589,25 @@ def main():
         action="store_true",
         help="run all queries in-process (debug aid)",
     )
+    ap.add_argument(
+        "--alarm-s",
+        type=int,
+        default=None,
+        help="child self-timeout: exit via normal teardown (never "
+        "leaves a wedged TPU client behind)",
+    )
     args = ap.parse_args()
+
+    if args.alarm_s:
+        import signal
+
+        def _expire(signum, frame):
+            # raise in the main thread: python unwinds, JAX client
+            # detaches cleanly, parent reads rc != 0
+            raise TimeoutError(f"self-timeout after {args.alarm_s}s")
+
+        signal.signal(signal.SIGALRM, _expire)
+        signal.alarm(args.alarm_s)
 
     if args.smoke:
         import os
@@ -580,56 +650,74 @@ def main():
         print(json.dumps(result))
         return
 
-    # orchestrator: subprocess per query, escalating tiers smallest-first
+    # orchestrator: breadth-first tiers, banked incrementally, budgeted
+    import os
+
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "2100"))
+    t_start = time.perf_counter()
+
+    def remaining() -> float:
+        return budget_s - (time.perf_counter() - t_start)
+
     tiers = ["smoke_dev"] if args.smoke else TIER_ORDER
     merged = {}
     errors = []
     dead = False
     if not args.smoke:
         # the tunnel admits one client and a previously killed process
-        # can wedge it for a long time; wait for recovery (bounded)
-        # instead of burning every tier's timeout against a dead device
-        for attempt in range(10):
-            if _device_alive(90):
+        # can wedge it for a long time; wait briefly for recovery — but
+        # cap at ~5 min total (r3 burned 33 min here and still lost)
+        for attempt in range(3):
+            if _device_alive(60):
                 break
             print(
-                f"device unavailable (attempt {attempt + 1}/10); waiting",
+                f"device unavailable (attempt {attempt + 1}/3); waiting",
                 file=sys.stderr,
             )
-            if attempt < 9:
-                time.sleep(120)
+            if attempt < 2:
+                time.sleep(60)
         else:
-            print(
-                json.dumps(
-                    {
-                        "metric": "nexmark_q5_lite_throughput",
-                        "value": 0,
-                        "unit": "bids/sec",
-                        "vs_baseline": 0,
-                        "errors": ["TPU tunnel unavailable for ~33 min"],
-                    }
-                )
-            )
+            merged = {
+                "metric": "nexmark_q5_lite_throughput",
+                "value": 0,
+                "unit": "bids/sec",
+                "vs_baseline": 0,
+                "errors": ["TPU tunnel unavailable (~5 min of probes)"],
+            }
+            _bank_partial(merged)
+            print(json.dumps(merged))
             return
-    for query in ("q5", "q8", "q7"):
-        got = None
-        for tier in tiers:
-            if dead:
-                break
+    failed: set = set()  # (query) that failed — don't escalate those
+    for tier in tiers:  # BREADTH-first: every query lands small numbers
+        for query in ("q5", "q8", "q7"):
+            if dead or query in failed:
+                continue
+            # worst case this child costs: its timeout + 45s communicate
+            # grace + 30s SIGTERM drain + a 75s post-failure device
+            # probe — all of it must fit before the finalize reserve
+            child_budget = TIERS[tier][3] + 45 + 30 + 75 + _FINALIZE_RESERVE_S
+            if remaining() < child_budget:
+                errors.append(
+                    f"{query}/{tier}: skipped (budget: {remaining():.0f}s "
+                    f"left, need {child_budget}s)"
+                )
+                continue
             sub, err = _run_child(query, tier, args.smoke, args.agg_mode)
             if sub is not None:
                 sub[f"{query}_tier" if query != "q5" else "tier"] = tier
-                got = sub  # bank the largest successful tier
-                continue
-            errors.append(err)
-            if not args.smoke and not _device_alive():
+                merged.update(sub)  # larger tier overwrites smaller
+            else:
+                errors.append(err)
+                failed.add(query)
+            snapshot = dict(merged)
+            if errors:
+                snapshot["errors"] = list(errors)
+            _bank_partial(snapshot)  # success AND failure: bank now
+            if sub is None and not args.smoke and not _device_alive():
                 # the failed child wedged the tunnel: stop risking the
                 # banked results; report what we have
                 errors.append(f"{query}/{tier}: device wedged; stopping")
                 dead = True
-            break  # don't escalate past a failure
-        if got is not None:
-            merged.update(got)
     if "metric" not in merged:
         # q5 (the headline) failed even if q8/q7 landed: keep the
         # one-JSON-line contract parseable for the driver
@@ -643,6 +731,8 @@ def main():
         )
     if errors:
         merged["errors"] = errors
+    merged["bench_wall_s"] = round(time.perf_counter() - t_start, 1)
+    _bank_partial(merged)
     print(json.dumps(merged))
 
 

@@ -110,7 +110,12 @@ class JsonParser(Parser):
             return None
         kind = f.dtype.value
         try:
-            if kind in ("varchar", "jsonb"):
+            if kind in ("jsonb", "struct", "list", "interval"):
+                # composite lanes: encode_column canonicalizes the RAW
+                # value (key-order-insensitive jsonb codes, child lane
+                # extraction) — stringifying here would double-encode
+                return v
+            if kind == "varchar":
                 return v if isinstance(v, str) else json.dumps(v)
             if kind in ("float32", "float64"):
                 return float(v)
@@ -127,6 +132,12 @@ class JsonParser(Parser):
                         return False
                 return None  # bool("false") is True — never truthiness
             if kind == "decimal":
+                from decimal import Decimal, InvalidOperation
+
+                try:
+                    Decimal(v if isinstance(v, str) else repr(v))
+                except (TypeError, ValueError, InvalidOperation):
+                    return None
                 return v if isinstance(v, str) else repr(v)
             return int(v)  # int lanes: reject non-numeric strings too
         except (TypeError, ValueError):
