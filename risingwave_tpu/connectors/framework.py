@@ -110,11 +110,23 @@ class JsonParser(Parser):
             return None
         kind = f.dtype.value
         try:
-            if kind in ("jsonb", "struct", "list", "interval"):
-                # composite lanes: encode_column canonicalizes the RAW
-                # value (key-order-insensitive jsonb codes, child lane
-                # extraction) — stringifying here would double-encode
+            if kind == "jsonb":
+                # encode_column canonicalizes the RAW value (key-order-
+                # insensitive codes) — stringifying would double-encode
                 return v
+            if kind == "struct":
+                return v if isinstance(v, dict) else None
+            if kind == "list":
+                cap = getattr(f, "list_cap", None)
+                if not isinstance(v, (list, tuple)):
+                    return None
+                return v if cap is None or len(v) <= cap else None
+            if kind == "interval":
+                # JSON has no interval literal; only an already-built
+                # Interval survives (encode_column requires one)
+                from risingwave_tpu.types import Interval
+
+                return v if isinstance(v, Interval) else None
             if kind == "varchar":
                 return v if isinstance(v, str) else json.dumps(v)
             if kind in ("float32", "float64"):
@@ -134,11 +146,13 @@ class JsonParser(Parser):
             if kind == "decimal":
                 from decimal import Decimal, InvalidOperation
 
+                text = v if isinstance(v, str) else repr(v)
                 try:
-                    Decimal(v if isinstance(v, str) else repr(v))
+                    if not Decimal(text).is_finite():
+                        return None  # NaN/Infinity would blow scaling
                 except (TypeError, ValueError, InvalidOperation):
                     return None
-                return v if isinstance(v, str) else repr(v)
+                return text
             return int(v)  # int lanes: reject non-numeric strings too
         except (TypeError, ValueError):
             return None

@@ -65,11 +65,22 @@ class SqlSession:
         catalog: Catalog,
         runtime: Optional[StreamingRuntime] = None,
         capacity: int = 1 << 14,
+        exec_mode: str = "serial",
+        parallelism: int = 1,
     ):
         from risingwave_tpu.array.dictionary import StringDictionary
 
+        if exec_mode not in ("serial", "graph"):
+            raise ValueError(f"unknown exec_mode {exec_mode!r}")
         self.catalog = catalog
         self.runtime = runtime or StreamingRuntime(store=None)
+        self.capacity = capacity
+        # "serial": host-driven executor chains; "graph": the unified
+        # actor path — fragment graph with dispatchers/permit channels,
+        # hash-partitioned across ``parallelism`` actors where the plan
+        # shape allows (runtime/fragmenter.py)
+        self.exec_mode = exec_mode
+        self.parallelism = parallelism
         self.planner = StreamPlanner(catalog, capacity=capacity)
         self.batch = BatchQueryEngine({})
         # one session dictionary backs every VARCHAR/JSONB column: codes
@@ -103,12 +114,24 @@ class SqlSession:
             )
 
     @classmethod
-    def restore(cls, runtime: StreamingRuntime, capacity: int = 1 << 14):
+    def restore(
+        cls,
+        runtime: StreamingRuntime,
+        capacity: int = 1 << 14,
+        exec_mode: str = "serial",
+        parallelism: int = 1,
+    ):
         """Bootstrap a session from a durable store: replay the DDL log
         (structure only — no barriers, no backfill), then recover every
         executor's state from the last committed epoch (the reference's
         cluster bootstrap: catalog load + recovery.rs:353)."""
-        session = cls(Catalog({}), runtime, capacity=capacity)
+        session = cls(
+            Catalog({}),
+            runtime,
+            capacity=capacity,
+            exec_mode=exec_mode,
+            parallelism=parallelism,
+        )
         if session.meta is None:
             raise ValueError("restore needs a runtime with an object store")
         session._replaying = True
@@ -123,6 +146,15 @@ class SqlSession:
     def _log_ddl(self, sql: str) -> None:
         if self.meta is not None and not self._replaying:
             self.meta.append_ddl(sql)
+
+    def _fresh_planner(self) -> StreamPlanner:
+        """A fresh planner per graph-mode instance: deterministic
+        table_ids (instances are vnode partitions of the SAME logical
+        tables) with this session's dictionary/temporal bindings."""
+        p = StreamPlanner(self.catalog, capacity=self.capacity)
+        p.strings = self.strings
+        p.mviews = self.batch.tables
+        return p
 
     def execute(self, sql: str) -> Tuple[Dict[str, np.ndarray], str]:
         """Returns (result columns, command tag). Non-queries return an
@@ -222,7 +254,14 @@ class SqlSession:
             self._log_ddl(sql)
             return {}, "CREATE_TABLE"
         if isinstance(stmt, P.CreateMaterializedView):
-            planned = self.planner.plan(sql)
+            if self.exec_mode == "graph":
+                from risingwave_tpu.runtime.fragmenter import graph_planned_mv
+
+                planned = graph_planned_mv(
+                    self._fresh_planner, sql, parallelism=self.parallelism
+                )
+            else:
+                planned = self.planner.plan(sql)
             if planned.name in self.runtime.fragments:
                 raise ValueError(
                     f"relation {planned.name!r} already exists"

@@ -1,0 +1,551 @@
+"""Planner output -> actor-graph execution: the unified runtime path.
+
+The reference has ONE path from SQL to running operators: the frontend
+fragments the stream plan at exchange edges
+(src/frontend/src/stream_fragmenter/mod.rs:26-60), meta expands
+fragments x parallelism into actors with dispatchers and vnode mappings
+(src/meta/src/stream/stream_graph/actor.rs:648,
+stream_graph/schedule.rs:131), and compute nodes run them over permit
+channels (src/stream/src/executor/dispatch.rs:683). This module is that
+path for the TPU build: it takes the StreamPlanner's executor chains
+and re-expresses them as a ``GraphRuntime`` fragment graph —
+
+  source frag --hash(dist cols)--> parallel frag x N --simple--> mat frag
+
+- Each parallel instance is an independently planned, fresh executor
+  chain (the actor build step, stream_manager.rs:89 create_nodes).
+- Keyed state is hash-partitioned by a dispatch-key subset of the
+  stateful executor's keys that traces back to source columns; one
+  logical state table spans all instances with disjoint vnode ownership
+  (consistent_hash/vnode.rs:34) via ``PartitionedStateView``.
+- The facade ``GraphPipeline`` exposes the serial Pipeline surface
+  (push/barrier/watermark/executors), so the SAME StreamingRuntime
+  checkpoint/recovery/barrier machinery drives both execution modes.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.executors.base import Executor
+from risingwave_tpu.executors.dedup import AppendOnlyDedupExecutor
+from risingwave_tpu.executors.filter import FilterExecutor
+from risingwave_tpu.executors.hash_agg import HashAggExecutor
+from risingwave_tpu.executors.hop_window import HopWindowExecutor
+from risingwave_tpu.executors.project import ProjectExecutor
+from risingwave_tpu.expr import expr as E
+from risingwave_tpu.ops.hashing import VNODE_COUNT, hash_columns
+from risingwave_tpu.runtime.graph import FragmentSpec, GraphRuntime
+from risingwave_tpu.runtime.pipeline import Pipeline, TwoInputPipeline
+from risingwave_tpu.storage.state_table import Checkpointable, StateDelta
+
+# stateless executors a hash exchange may commute past (rows travel
+# independently; no cross-row state): anything else ends the parallel
+# prefix and runs in the singleton tail fragment
+_PARALLEL_STATELESS = (FilterExecutor, ProjectExecutor, HopWindowExecutor)
+# keyed stateful executors whose state partitions cleanly by a subset
+# of their key tuple (HashAgg dirty-group state, append-only dedup)
+_KEYED = (HashAggExecutor, AppendOnlyDedupExecutor)
+
+
+def _keys_of(ex) -> Tuple[str, ...]:
+    return tuple(getattr(ex, "group_keys", None) or getattr(ex, "keys", ()))
+
+
+def _trace_source_col(chain: Sequence[Executor], name: str) -> Optional[str]:
+    """Walk ``name`` backwards through a chain prefix to the source
+    column it is an UNMODIFIED copy of (None if computed/renamed-over/
+    untraceable). Conservative: only executors whose column flow we
+    fully understand participate."""
+    cur = name
+    for ex in reversed(list(chain)):
+        if isinstance(ex, ProjectExecutor):
+            expr = dict(ex.outputs).get(cur)
+            if not isinstance(expr, E.Col):
+                return None
+            cur = expr.name
+        elif isinstance(ex, HopWindowExecutor):
+            if cur == ex.out_start:
+                return None  # computed column
+        elif isinstance(ex, FilterExecutor):
+            pass
+        elif isinstance(ex, _KEYED):
+            if cur not in _keys_of(ex):
+                return None  # agg/dedup emit only their key columns
+        else:
+            return None
+    return cur
+
+
+def _view_positions(
+    chain_before: Sequence[Executor],
+    key_tuple: Sequence[str],
+    dispatch_srcs: Sequence[str],
+) -> Optional[Tuple[int, ...]]:
+    """For a keyed executor whose input has passed ``chain_before``:
+    the position in its key tuple of each dispatch source column, in
+    dispatch order (restore routing must hash the same values in the
+    same order as the upstream HashDispatcher). None if any dispatch
+    column is not one of the executor's keys."""
+    out = []
+    for s in dispatch_srcs:
+        q = next(
+            (
+                qi
+                for qi, k in enumerate(key_tuple)
+                if _trace_source_col(chain_before, k) == s
+            ),
+            None,
+        )
+        if q is None:
+            return None
+        out.append(q)
+    return tuple(out)
+
+
+class PartitionedStateView(Checkpointable):
+    """One LOGICAL state table physically partitioned across N actor
+    instances by vnode of the dispatch columns (the reference's 'same
+    table_id, disjoint vnodes per actor' model). Presents the
+    Checkpointable surface: deltas concatenate (key spaces are
+    disjoint), restores route rows to the owning instance with the
+    exact hash the upstream HashDispatcher used."""
+
+    def __init__(self, instances: Sequence[object], positions: Dict[str, Tuple[int, ...]]):
+        self._instances = list(instances)
+        self._positions = dict(positions)  # table_id -> key-lane positions
+
+    # -- Checkpointable ---------------------------------------------------
+    @property
+    def table_id(self) -> str:
+        return self._instances[0].table_id
+
+    def checkpoint_table_ids(self) -> List[str]:
+        return self._instances[0].checkpoint_table_ids()
+
+    def checkpoint_delta(self) -> List[StateDelta]:
+        by_tid: Dict[str, List[StateDelta]] = {}
+        order: List[str] = []
+        for inst in self._instances:
+            for d in inst.checkpoint_delta():
+                if d.table_id not in by_tid:
+                    order.append(d.table_id)
+                by_tid.setdefault(d.table_id, []).append(d)
+        out = []
+        for tid in order:
+            ds = by_tid[tid]
+            if len(ds) == 1:
+                out.append(ds[0])
+                continue
+            keys = {
+                k: np.concatenate([d.key_cols[k] for d in ds])
+                for k in ds[0].key_cols
+            }
+            vals = {
+                k: np.concatenate([d.value_cols[k] for d in ds])
+                for k in ds[0].value_cols
+            }
+            tomb = np.concatenate([d.tombstone for d in ds])
+            out.append(StateDelta(tid, keys, vals, tomb, ds[0].key_order))
+        return out
+
+    def restore_state(self, table_id, key_cols, value_cols) -> None:
+        n = len(self._instances)
+        if not key_cols or n == 1:
+            for inst in self._instances:
+                inst.restore_state(table_id, key_cols, value_cols)
+            return
+        pos = self._positions[table_id]
+        lanes = [jnp.asarray(key_cols[f"k{p}"]) for p in pos]
+        # EXACTLY the dispatcher's routing (graph.py _vnode_slice_mask):
+        # a row restored to the wrong instance would be unreachable
+        vnode = np.asarray(
+            hash_columns(lanes, seed=0xC0FFEE) % VNODE_COUNT
+        ).astype(np.int64)
+        dest = vnode % n
+        for i, inst in enumerate(self._instances):
+            m = dest == i
+            inst.restore_state(
+                table_id,
+                {k: v[m] for k, v in key_cols.items()},
+                {k: v[m] for k, v in value_cols.items()},
+            )
+
+    # -- runtime hook fan-out ---------------------------------------------
+    def state_nbytes(self) -> int:
+        return sum(
+            getattr(i, "state_nbytes", lambda: 0)() for i in self._instances
+        )
+
+    def evict_cold(self) -> int:
+        total = 0
+        for i in self._instances:
+            fn = getattr(i, "evict_cold", None)
+            if fn is not None and getattr(i, "cold_reader", None) is not None:
+                total += fn()
+        return total
+
+    def on_epoch_durable(self, epoch: int) -> None:
+        for i in self._instances:
+            fn = getattr(i, "on_epoch_durable", None)
+            if fn is not None:
+                fn(epoch)
+
+    def discard_pending(self) -> None:
+        for i in self._instances:
+            fn = getattr(i, "discard_pending", None)
+            if fn is not None:
+                fn()
+
+    def on_recover(self, epoch: int) -> None:
+        for i in self._instances:
+            fn = getattr(i, "on_recover", None)
+            if fn is not None:
+                fn(epoch)
+
+    @property
+    def minput(self):
+        for i in self._instances:
+            m = getattr(i, "minput", None)
+            if m:
+                return m
+        return {}
+
+    @property
+    def checkpoint_enabled(self):
+        return getattr(self._instances[0], "checkpoint_enabled", False)
+
+    @checkpoint_enabled.setter
+    def checkpoint_enabled(self, v):
+        for i in self._instances:
+            if hasattr(i, "checkpoint_enabled"):
+                i.checkpoint_enabled = v
+
+    @property
+    def cold_reader(self):
+        return getattr(self._instances[0], "cold_reader", None)
+
+    @cold_reader.setter
+    def cold_reader(self, fn):
+        for i in self._instances:
+            if hasattr(i, "cold_reader"):
+                i.cold_reader = fn
+
+
+class GraphPipeline:
+    """Pipeline-compatible facade over a ``GraphRuntime`` actor graph:
+    the object a StreamingRuntime registers, barriers, checkpoints, and
+    recovers — while pushes flow through dispatchers, permit channels,
+    and (possibly parallel) FragmentActor threads.
+
+    Contract differences vs the serial Pipeline are epoch-granular:
+    ``push``/``watermark`` return [] (processing is async inside the
+    actors) and ``barrier`` returns everything the terminal fragment
+    emitted during the epoch — the StreamingRuntime routes barrier
+    output to subscribers before their own barrier runs, so MV-on-MV
+    edges see identical per-epoch content in both modes."""
+
+    def __init__(
+        self,
+        specs: Sequence[FragmentSpec],
+        source_map: Dict[str, str],  # side ("single"/"left"/"right") -> frag
+        out_fragment: str,
+        ckpt_executors: Sequence[object],
+    ):
+        self.graph = GraphRuntime(specs).start()
+        self._sources = dict(source_map)
+        self._out = out_fragment
+        self._executors = list(ckpt_executors)
+        self.__dict__["_epoch_val"] = 0
+
+    # the runtime assigns p._epoch on registration/recovery; keep the
+    # actor graph's barrier clock in lockstep so injected epochs stay
+    # monotonic relative to whatever the runtime restored
+    @property
+    def _epoch(self) -> int:
+        return self.__dict__["_epoch_val"]
+
+    @_epoch.setter
+    def _epoch(self, v: int) -> None:
+        self.__dict__["_epoch_val"] = v
+        self.graph._epoch = v
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    @property
+    def executors(self) -> List[object]:
+        return self._executors
+
+    # -- message surface --------------------------------------------------
+    def push(self, chunk: StreamChunk, start: int = 0) -> List[StreamChunk]:
+        self.graph.inject_chunk(self._sources["single"], chunk)
+        return []
+
+    def push_left(self, chunk: StreamChunk) -> List[StreamChunk]:
+        self.graph.inject_chunk(self._sources["left"], chunk)
+        return []
+
+    def push_right(self, chunk: StreamChunk) -> List[StreamChunk]:
+        self.graph.inject_chunk(self._sources["right"], chunk)
+        return []
+
+    def watermark(self, column: str, value: int) -> List[StreamChunk]:
+        self.graph.inject_watermark(column, value)
+        return []  # flushed output surfaces at the next barrier drain
+
+    def barrier(
+        self, checkpoint: bool = True, epoch: Optional[int] = None
+    ) -> List[StreamChunk]:
+        prev = self._epoch
+        target = (
+            epoch
+            if epoch is not None
+            else max(int(time.time() * 1000) << 16, prev + 1)
+        )
+        self._epoch = prev  # keep graph clock aligned before inject
+        self.graph.inject_barrier(checkpoint=checkpoint, epoch=target)
+        self.__dict__["_epoch_val"] = target
+        return self.graph.drain(self._out)
+
+    def close(self) -> None:
+        self.graph.stop()
+
+
+# ---------------------------------------------------------------------------
+# planner output -> fragment graph
+# ---------------------------------------------------------------------------
+
+
+def graph_planned_mv(planner_factory, sql: str, parallelism: int = 1):
+    """Plan ``sql`` once per instance with FRESH planners (identical,
+    deterministic table_ids across instances — they are partitions of
+    the same logical tables) and return a PlannedMV whose pipeline is a
+    GraphPipeline. Shapes that cannot partition fall back to a
+    single-actor graph — same SQL, same results, still actors."""
+    n = max(1, parallelism)
+    proto = planner_factory().plan(sql)
+    # decide partitionability on the prototype BEFORE paying for N-1
+    # more planner passes — a non-partitionable shape falls back to a
+    # single-actor graph using only the prototype
+    if isinstance(proto.pipeline, TwoInputPipeline):
+        sides = _split_join(proto.pipeline) if n > 1 else None
+        plans = (
+            [proto] + [planner_factory().plan(sql) for _ in range(n - 1)]
+            if sides is not None
+            else [proto]
+        )
+        gp = _two_input_graph(plans, sides)
+    else:
+        split = (
+            _split_single(list(proto.pipeline.executors)) if n > 1 else None
+        )
+        plans = (
+            [proto] + [planner_factory().plan(sql) for _ in range(n - 1)]
+            if split is not None
+            else [proto]
+        )
+        gp = _single_graph(plans, split)
+    from risingwave_tpu.sql.planner import PlannedMV
+
+    return PlannedMV(
+        proto.name, gp, proto.mview, proto.inputs, schema=proto.schema
+    )
+
+
+def _singleton_graph(chain, source_map_side="single"):
+    name = "mv"
+    specs = [FragmentSpec(name, lambda i, ch=tuple(chain): list(ch))]
+    return GraphPipeline(specs, {source_map_side: name}, name, list(chain))
+
+
+def _single_graph(plans, split) -> GraphPipeline:
+    chains = [list(p.pipeline.executors) for p in plans]
+    chain0 = chains[0]
+    n = len(plans)
+
+    if split is None or n == 1:
+        return _singleton_graph(chain0)
+    prefix_len, dispatch_cols, positions_by_idx = split
+
+    specs = [
+        FragmentSpec(
+            "src", lambda i: [], dispatch=("hash", list(dispatch_cols))
+        ),
+        FragmentSpec(
+            "par",
+            lambda i: list(chains[i][:prefix_len]),
+            inputs=[("src", 0)],
+            parallelism=n,
+        ),
+        FragmentSpec(
+            "mat",
+            lambda i: list(chain0[prefix_len:]),
+            inputs=[("par", 0)],
+        ),
+    ]
+    ckpt: List[object] = []
+    for j in range(prefix_len):
+        ex0 = chain0[j]
+        if isinstance(ex0, Checkpointable):
+            ckpt.append(
+                PartitionedStateView(
+                    [chains[i][j] for i in range(n)], positions_by_idx[j]
+                )
+            )
+    ckpt.extend(chain0[prefix_len:])
+    return GraphPipeline(specs, {"single": "src"}, "mat", ckpt)
+
+
+def _split_single(chain):
+    """Find the parallel prefix of a single-input chain: stateless ops
+    up to and including the FIRST keyed stateful executor. Returns
+    (prefix_len, dispatch source cols, {chain idx -> {table_id ->
+    positions}}) or None when the shape cannot partition."""
+    keyed_idx = None
+    for j, ex in enumerate(chain):
+        if isinstance(ex, _KEYED):
+            keyed_idx = j
+            break
+        if not isinstance(ex, _PARALLEL_STATELESS):
+            return None
+    if keyed_idx is None:
+        return None
+    keyed = chain[keyed_idx]
+    keys = _keys_of(keyed)
+    before = chain[:keyed_idx]
+    dispatch, kpos = [], []
+    for pos, k in enumerate(keys):
+        src = _trace_source_col(before, k)
+        if src is not None:
+            dispatch.append(src)
+            kpos.append(pos)
+    if not dispatch:
+        return None
+    positions = {
+        keyed_idx: {
+            tid: tuple(kpos) for tid in keyed.checkpoint_table_ids()
+        }
+    }
+    return keyed_idx + 1, dispatch, positions
+
+
+def _two_input_graph(plans, sides) -> GraphPipeline:
+    tp0 = plans[0].pipeline
+    n = len(plans)
+    if sides is None or n == 1:
+        build = {
+            "left": tp0.left,
+            "right": tp0.right,
+            "join": tp0.join,
+            "tail": tp0.tail,
+        }
+        specs = [
+            FragmentSpec("left_src", lambda i: []),
+            FragmentSpec("right_src", lambda i: []),
+            FragmentSpec(
+                "join",
+                lambda i, b=build: dict(b),
+                inputs=[("left_src", 0), ("right_src", 1)],
+            ),
+        ]
+        return GraphPipeline(
+            specs,
+            {"left": "left_src", "right": "right_src"},
+            "join",
+            tp0.executors,
+        )
+    ldisp, rdisp, join_positions, side_positions = sides
+
+    def build_join(i):
+        tp = plans[i].pipeline
+        return {
+            "left": tp.left,
+            "right": tp.right,
+            "join": tp.join,
+            "tail": [],
+        }
+
+    specs = [
+        FragmentSpec(
+            "left_src", lambda i: [], dispatch=("hash", list(ldisp))
+        ),
+        FragmentSpec(
+            "right_src", lambda i: [], dispatch=("hash", list(rdisp))
+        ),
+        FragmentSpec(
+            "join",
+            build_join,
+            inputs=[("left_src", 0), ("right_src", 1)],
+            parallelism=n,
+        ),
+        FragmentSpec("mat", lambda i: list(tp0.tail), inputs=[("join", 0)]),
+    ]
+    ckpt: List[object] = []
+    for side_name in ("left", "right"):
+        chain0 = getattr(tp0, side_name)
+        for j, ex0 in enumerate(chain0):
+            if isinstance(ex0, Checkpointable):
+                ckpt.append(
+                    PartitionedStateView(
+                        [getattr(plans[i].pipeline, side_name)[j] for i in range(n)],
+                        side_positions[(side_name, j)],
+                    )
+                )
+    ckpt.append(
+        PartitionedStateView(
+            [plans[i].pipeline.join for i in range(n)], join_positions
+        )
+    )
+    ckpt.extend(tp0.tail)
+    return GraphPipeline(
+        specs, {"left": "left_src", "right": "right_src"}, "mat", ckpt
+    )
+
+
+def _split_join(tp):
+    """Partitionability of a two-input join fragment. Returns
+    (left dispatch cols, right dispatch cols, join table positions,
+    {(side, idx) -> table positions}) or None."""
+    join = tp.join
+    lkeys = tuple(join.left_keys)
+    rkeys = tuple(join.right_keys)
+    ldisp, rdisp, jpos = [], [], []
+    for p in range(len(lkeys)):
+        ls = _trace_source_col(tp.left, lkeys[p])
+        rs = _trace_source_col(tp.right, rkeys[p])
+        if ls is not None and rs is not None:
+            ldisp.append(ls)
+            rdisp.append(rs)
+            jpos.append(p)
+    if not jpos:
+        return None
+    # every side executor must be either parallel-safe stateless or a
+    # keyed stateful whose key tuple covers the side's dispatch columns
+    side_positions: Dict[Tuple[str, int], Dict[str, Tuple[int, ...]]] = {}
+    for side_name, disp in (("left", ldisp), ("right", rdisp)):
+        chain = getattr(tp, side_name)
+        for j, ex in enumerate(chain):
+            if isinstance(ex, _PARALLEL_STATELESS):
+                continue
+            if isinstance(ex, _KEYED):
+                pos = _view_positions(chain[:j], _keys_of(ex), disp)
+                if pos is None:
+                    return None
+                side_positions[(side_name, j)] = {
+                    tid: pos for tid in ex.checkpoint_table_ids()
+                }
+                continue
+            return None
+    tid = join.table_id
+    join_positions = {
+        f"{tid}.left": tuple(jpos),
+        f"{tid}.right": tuple(jpos),
+    }
+    return ldisp, rdisp, join_positions, side_positions

@@ -545,9 +545,14 @@ class GraphRuntime:
         in_channels: Dict[str, List[List[Tuple[int, PermitChannel]]]] = {
             s.name: [[] for _ in range(s.parallelism)] for s in specs
         }
-        out_edges: Dict[str, List[Tuple[FragmentSpec, List[PermitChannel]]]] = {
-            s.name: [] for s in specs
-        }
+        # out_edges[up_name][up_instance] — each UPSTREAM INSTANCE gets
+        # its own channel into every downstream instance (merge.rs:32
+        # selects over per-upstream-ACTOR inputs): M parallel senders
+        # sharing one channel would deliver M barriers down a single
+        # input and double-flush the consumer
+        out_edges: Dict[
+            str, List[List[Tuple[FragmentSpec, List[PermitChannel]]]]
+        ] = {s.name: [[] for _ in range(s.parallelism)] for s in specs}
         # one Condition per actor instance, shared by ALL its input
         # channels — enables select/wait-on-any in the input loop
         cvs = {
@@ -557,16 +562,18 @@ class GraphRuntime:
         }
         for s in specs:
             for up_name, port in s.inputs:
-                chans = []
-                for di in range(s.parallelism):
-                    ch = PermitChannel(
-                        self._channel_permits,
-                        cv=cvs[(s.name, di)],
-                        abort=self._abort,
-                    )
-                    in_channels[s.name][di].append((port, ch))
-                    chans.append(ch)
-                out_edges[up_name].append((s, chans))
+                up = self.specs[up_name]
+                for ui in range(up.parallelism):
+                    chans = []
+                    for di in range(s.parallelism):
+                        ch = PermitChannel(
+                            self._channel_permits,
+                            cv=cvs[(s.name, di)],
+                            abort=self._abort,
+                        )
+                        in_channels[s.name][di].append((port, ch))
+                        chans.append(ch)
+                    out_edges[up_name][ui].append((s, chans))
 
         # source fragments: the manager is their upstream — channels
         # must exist BEFORE actors copy their input lists
@@ -584,9 +591,9 @@ class GraphRuntime:
                 self._source_channels[s.name] = srcs
 
         for s in specs:
-            downstream = out_edges[s.name]
             for inst in range(s.parallelism):
                 built = s.build(inst)
+                downstream = out_edges[s.name][inst]
                 if downstream:
                     # one dispatcher fanning to every downstream edge:
                     # wrap per-edge dispatchers in a multiplexer
@@ -646,12 +653,20 @@ class GraphRuntime:
                 ch.send_control(WATERMARK, Watermark(column, value))
 
     def inject_barrier(
-        self, checkpoint: bool = True, timeout: float = 120.0
+        self,
+        checkpoint: bool = True,
+        timeout: float = 120.0,
+        epoch: Optional[int] = None,
     ) -> Barrier:
         """Send a barrier into every source and block until every actor
-        collected it (barrier_manager.rs:857 collect)."""
+        collected it (barrier_manager.rs:857 collect). ``epoch`` pins
+        the barrier's curr epoch (a runtime passes its own clock so the
+        graph's epochs line up with checkpoint manifests)."""
         prev = self._epoch
-        self._epoch = prev + 1
+        target = epoch if epoch is not None else prev + 1
+        if target <= prev:
+            raise ValueError(f"epoch {target} <= previous {prev}")
+        self._epoch = target
         b = Barrier(Epoch(prev, self._epoch), checkpoint)
         with self._collect_lock:
             self._collected[self._epoch] = set()
