@@ -540,6 +540,12 @@ def _side_restore(side: JoinSide, key_cols, value_cols) -> JoinSide:
 
     n = len(next(iter(key_cols.values()))) if key_cols else 0
     fanout = side.fanout
+    if n and "rv" in value_cols and value_cols["rv"].shape[1] != fanout:
+        raise ValueError(
+            f"checkpoint bucket fanout {value_cols['rv'].shape[1]} != "
+            f"executor fanout {fanout}: restore lands rows at their "
+            "stored in-bucket positions — configure the same fanout"
+        )
     cap = grow_pow2(n, side.capacity, GROW_AT)
     fresh = JoinSide.create(
         cap,

@@ -18,19 +18,34 @@ flatten to the host materializer).
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Barrier, Executor
 from risingwave_tpu.executors.dedup import dedup_step_fn
-from risingwave_tpu.executors.hash_join import JOIN_TYPES, join_step_fn
-from risingwave_tpu.ops.hash_table import HashTable
+from risingwave_tpu.executors.hash_join import (
+    JOIN_TYPES,
+    _side_restore,
+    join_step_fn,
+)
+from risingwave_tpu.ops.hash_table import HashTable, lookup_or_insert, set_live
 from risingwave_tpu.ops.join import JoinSide
-from risingwave_tpu.parallel.exchange import exchange_chunk
+from risingwave_tpu.parallel.exchange import dest_shard, exchange_chunk
+from risingwave_tpu.storage.state_table import (
+    Checkpointable,
+    StateDelta,
+    grow_pow2,
+    pull_rows,
+    stage_marks,
+)
+
+GROW_AT = 0.5
 
 
 def stack_for_mesh(tree, mesh: Mesh, axis: str):
@@ -52,7 +67,7 @@ def flatten_stacked(chunk: StreamChunk) -> StreamChunk:
     return jax.tree.map(lambda a: a.reshape((-1,) + a.shape[2:]), chunk)
 
 
-class ShardedDedup(Executor):
+class ShardedDedup(Executor, Checkpointable):
     """Mesh-parallel DISTINCT: exchange by dedup key, local seen-set.
 
     ``apply`` takes a stacked (n_shards, cap) chunk and returns ONE
@@ -67,17 +82,22 @@ class ShardedDedup(Executor):
         schema_dtypes: Dict[str, object],
         capacity: int = 1 << 16,
         bucket_cap: Optional[int] = None,
+        table_id: str = "sharded_dedup",
     ):
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
         self.n_shards = mesh.devices.size
         self.keys = tuple(keys)
         self.bucket_cap = bucket_cap
+        self.table_id = table_id
         table1 = HashTable.create(
             capacity, tuple(jnp.dtype(schema_dtypes[k]) for k in self.keys)
         )
         self.table = stack_for_mesh(table1, mesh, self.axis)
         self.sdirty = stack_for_mesh(
+            jnp.zeros(capacity, jnp.bool_), mesh, self.axis
+        )
+        self.stored = stack_for_mesh(
             jnp.zeros(capacity, jnp.bool_), mesh, self.axis
         )
         self.flags = stack_for_mesh(
@@ -133,8 +153,77 @@ class ShardedDedup(Executor):
             )
         return []
 
+    # -- checkpoint/restore (one logical table across shards) ------------
+    def checkpoint_delta(self) -> List[StateDelta]:
+        """Same lane naming as the single-chip dedup (k{i}), keys
+        globally unique across shards — either executor can restore the
+        other's checkpoint."""
+        sdirty = np.asarray(self.sdirty).reshape(-1)
+        if not sdirty.any():
+            return []
+        shape = self.sdirty.shape
+        upsert, tomb, sel = stage_marks(
+            sdirty,
+            np.asarray(self.table.live).reshape(-1),
+            np.asarray(self.stored).reshape(-1),
+        )
+        flat = lambda a: a.reshape((-1,) + a.shape[2:])
+        lanes = {f"k{i}": flat(l) for i, l in enumerate(self.table.keys)}
+        key_names = tuple(lanes)
+        keys = pull_rows(lanes, sel)
+        self.stored = (
+            self.stored | jnp.asarray(upsert.reshape(shape))
+        ) & ~jnp.asarray(tomb.reshape(shape))
+        self.sdirty = jnp.zeros_like(self.sdirty)
+        return [StateDelta(self.table_id, keys, {}, tomb[sel], key_names)]
 
-class ShardedHashJoin(Executor):
+    def restore_state(self, table_id, key_cols, value_cols) -> None:
+        """Re-partition recovered keys by vnode and rebuild every shard
+        (works across mesh sizes: a key's shard is vnode % n_shards)."""
+        n_rows = len(next(iter(key_cols.values()))) if key_cols else 0
+        key_dtypes = tuple(k.dtype for k in self.table.keys)
+        cap = self.table.keys[0].shape[-1]
+        lanes = dest = None
+        if n_rows:
+            lanes = tuple(
+                jnp.asarray(np.asarray(key_cols[f"k{i}"], dtype=d))
+                for i, d in enumerate(key_dtypes)
+            )
+            dest = np.asarray(dest_shard(lanes, self.n_shards))
+            cap = grow_pow2(
+                int(np.bincount(dest, minlength=self.n_shards).max()),
+                cap,
+                GROW_AT,
+            )
+        tables, stores = [], []
+        for k in range(self.n_shards):
+            t = HashTable.create(cap, key_dtypes)
+            stored = jnp.zeros(cap, jnp.bool_)
+            if n_rows:
+                sel = np.flatnonzero(dest == k)
+                if len(sel):
+                    sub = tuple(l[jnp.asarray(sel)] for l in lanes)
+                    t, slots, _, _ = lookup_or_insert(
+                        t, sub, jnp.ones(len(sel), jnp.bool_)
+                    )
+                    t = set_live(t, slots, True)
+                    stored = stored.at[slots].set(True)
+            tables.append(t)
+            stores.append(stored)
+        sharding = NamedSharding(self.mesh, P(self.axis))
+        stack = lambda *xs: jnp.stack(xs)
+        self.table = jax.device_put(jax.tree.map(stack, *tables), sharding)
+        self.stored = jax.device_put(jnp.stack(stores), sharding)
+        self.sdirty = jax.device_put(
+            jnp.zeros_like(self.stored), sharding
+        )
+        self.flags = stack_for_mesh(
+            jnp.zeros(2, jnp.bool_), self.mesh, self.axis
+        )
+        self._step = None  # capacity may have changed: recompile
+
+
+class ShardedHashJoin(Executor, Checkpointable):
     """Mesh-parallel streaming equi-join, all join types.
 
     Both sides' state is stacked over the mesh; each arrival runs one
@@ -159,9 +248,11 @@ class ShardedHashJoin(Executor):
         left_nullable: Sequence[str] = (),
         right_nullable: Sequence[str] = (),
         join_type: str = "inner",
+        table_id: str = "sharded_join",
     ):
         if join_type not in JOIN_TYPES:
             raise ValueError(f"unknown join type {join_type!r}")
+        self.table_id = table_id
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
         self.n_shards = mesh.devices.size
@@ -301,3 +392,108 @@ class ShardedHashJoin(Executor):
                     "stored row"
                 )
         return []
+
+    # -- checkpoint/restore (two logical tables across shards) -----------
+    def checkpoint_table_ids(self) -> List[str]:
+        return [f"{self.table_id}.left", f"{self.table_id}.right"]
+
+    def checkpoint_delta(self) -> List[StateDelta]:
+        """Same lane naming as the single-chip join (_side_delta):
+        k{i} key lanes + rv/deg/r_*/n_* 2D bucket lanes, each side ONE
+        logical table; keys are globally unique across shards."""
+        out = []
+        for name in ("left", "right"):
+            d = self._sharded_side_delta(name)
+            if d is not None:
+                out.append(d)
+        return out
+
+    def _sharded_side_delta(self, name: str) -> Optional[StateDelta]:
+        side = getattr(self, name)
+        sdirty = np.asarray(side.sdirty).reshape(-1)
+        if not sdirty.any():
+            return None
+        shape = side.sdirty.shape
+        upsert, tomb, sel = stage_marks(
+            sdirty,
+            np.asarray(side.table.live).reshape(-1),
+            np.asarray(side.stored).reshape(-1),
+        )
+        flat = lambda a: a.reshape((-1,) + a.shape[2:])
+        lanes = {f"k{i}": flat(l) for i, l in enumerate(side.table.keys)}
+        key_names = tuple(lanes)
+        lanes["rv"] = flat(side.row_valid)
+        lanes["deg"] = flat(side.degree)
+        for nm, a in side.rows.items():
+            lanes[f"r_{nm}"] = flat(a)
+        for nm, a in side.row_nulls.items():
+            lanes[f"n_{nm}"] = flat(a)
+        pulled = pull_rows(lanes, sel)
+        keys = {k: pulled[k] for k in key_names}
+        vals = {k: v for k, v in pulled.items() if k not in key_names}
+        setattr(
+            self,
+            name,
+            dataclasses.replace(
+                side,
+                sdirty=jnp.zeros_like(side.sdirty),
+                stored=(
+                    side.stored | jnp.asarray(upsert.reshape(shape))
+                ) & ~jnp.asarray(tomb.reshape(shape)),
+            ),
+        )
+        return StateDelta(
+            f"{self.table_id}.{name}", keys, vals, tomb[sel], key_names
+        )
+
+    def restore_state(self, table_id, key_cols, value_cols) -> None:
+        """Re-partition one side's recovered rows by vnode (the same
+        positional-key hash the exchange uses) and rebuild every shard
+        with the single-chip _side_restore — works across mesh sizes."""
+        name = "left" if table_id.endswith(".left") else "right"
+        side = getattr(self, name)
+        proto = jax.tree.map(lambda a: a[0], side)
+        n_rows = len(next(iter(key_cols.values()))) if key_cols else 0
+        dest = None
+        if n_rows:
+            lanes = tuple(
+                jnp.asarray(np.asarray(key_cols[f"k{i}"], dtype=k.dtype))
+                for i, k in enumerate(proto.table.keys)
+            )
+            dest = np.asarray(dest_shard(lanes, self.n_shards))
+            # uniform per-shard capacity: _side_restore grows from the
+            # template's capacity, so pre-grow the template to the
+            # hottest shard's need and every shard lands on one shape
+            cap = grow_pow2(
+                int(np.bincount(dest, minlength=self.n_shards).max()),
+                proto.capacity,
+                GROW_AT,
+            )
+        else:
+            cap = proto.capacity
+        template = JoinSide.create(
+            cap,
+            proto.fanout,
+            tuple(k.dtype for k in proto.table.keys),
+            {nm: a.dtype for nm, a in proto.rows.items()},
+            nullable=tuple(proto.row_nulls),
+        )
+        sides = []
+        for k in range(self.n_shards):
+            if n_rows:
+                m = dest == k
+                sub_k = {kk: v[m] for kk, v in key_cols.items()}
+                sub_v = {kk: v[m] for kk, v in value_cols.items()}
+            else:
+                sub_k, sub_v = {}, {}
+            sides.append(_side_restore(template, sub_k, sub_v))
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *sides)
+        setattr(
+            self,
+            name,
+            jax.device_put(stacked, NamedSharding(self.mesh, P(self.axis))),
+        )
+        self._em_overflow = stack_for_mesh(
+            jnp.zeros((), jnp.bool_), self.mesh, self.axis
+        )
+        self._steps = {}  # capacities may have changed: recompile

@@ -28,6 +28,7 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -82,16 +83,31 @@ def _trace_source_col(chain: Sequence[Executor], name: str) -> Optional[str]:
     return cur
 
 
+def _key_lane_index(ex, pos: int) -> Optional[int]:
+    """Checkpoint key-lane index (k{i}) of key POSITION ``pos``: HashAgg
+    interleaves a bool null-indicator lane after each NULLABLE group
+    key, so lane != position when nullable keys precede. A nullable
+    dispatch key itself is disqualified (the dispatcher hashes the raw
+    value lane; NULL rows would route by fill garbage)."""
+    nb = getattr(ex, "nullable", None)
+    if nb is None:
+        return pos
+    if nb[pos]:
+        return None
+    return pos + sum(1 for q in range(pos) if nb[q])
+
+
 def _view_positions(
     chain_before: Sequence[Executor],
-    key_tuple: Sequence[str],
+    ex,
     dispatch_srcs: Sequence[str],
 ) -> Optional[Tuple[int, ...]]:
     """For a keyed executor whose input has passed ``chain_before``:
-    the position in its key tuple of each dispatch source column, in
+    the checkpoint key-LANE index of each dispatch source column, in
     dispatch order (restore routing must hash the same values in the
     same order as the upstream HashDispatcher). None if any dispatch
-    column is not one of the executor's keys."""
+    column is not one of the executor's (non-nullable) keys."""
+    key_tuple = _keys_of(ex)
     out = []
     for s in dispatch_srcs:
         q = next(
@@ -104,7 +120,10 @@ def _view_positions(
         )
         if q is None:
             return None
-        out.append(q)
+        lane = _key_lane_index(ex, q)
+        if lane is None:
+            return None
+        out.append(lane)
     return tuple(out)
 
 
@@ -319,6 +338,215 @@ class GraphPipeline:
 
 
 # ---------------------------------------------------------------------------
+# sharded (multi-chip) fragment mode: one actor per fragment, the
+# parallelism INSIDE it — stacked state over a jax Mesh, vnode exchange
+# via all_to_all under shard_map (parallel/sharded_*.py). Unlike the
+# actor-parallel mode, no dispatch-column tracing is needed: every
+# sharded op re-exchanges its input by its OWN keys on device.
+# ---------------------------------------------------------------------------
+
+
+class StackSplitExecutor(Executor):
+    """Flat (cap,) chunk -> stacked (n, cap) chunk, shard i seeing rows
+    i, i+n, i+2n... (round-robin source split). The downstream sharded
+    op's on-device exchange re-routes rows by key vnode, so the split
+    here only balances load."""
+
+    def __init__(self, n_shards: int):
+        self.n = n_shards
+
+    def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
+        n = self.n
+        idx = jnp.arange(chunk.valid.shape[-1], dtype=jnp.int32)
+        valid = jnp.stack([chunk.valid & (idx % n == i) for i in range(n)])
+        bcast = lambda a: jnp.broadcast_to(a[None], (n,) + a.shape)
+        return [
+            StreamChunk(
+                columns={k: bcast(v) for k, v in chunk.columns.items()},
+                valid=valid,
+                nulls={k: bcast(v) for k, v in chunk.nulls.items()},
+                ops=bcast(chunk.ops),
+            )
+        ]
+
+
+class FlattenExecutor(Executor):
+    """Stacked (n, cap) chunk -> flat (n*cap,) chunk (host boundary)."""
+
+    def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
+        from risingwave_tpu.parallel.sharded_join import flatten_stacked
+
+        if chunk.valid.ndim == 1:
+            return [chunk]  # already flat (e.g. a sharded agg flush)
+        return [flatten_stacked(chunk)]
+
+
+def _sharded_equiv(ex, mesh):
+    """Sharded replacement for a keyed single-chip executor, carrying
+    the SAME table_id (the checkpoint is one logical table either
+    way). None when the executor's features aren't sharded yet."""
+    from risingwave_tpu.parallel.sharded_agg import ShardedHashAgg
+    from risingwave_tpu.parallel.sharded_join import ShardedDedup
+
+    if isinstance(ex, HashAggExecutor):
+        if ex.window_key is not None or any(
+            c.materialized for c in ex.calls
+        ):
+            return None
+        return ShardedHashAgg(
+            mesh,
+            ex.group_keys,
+            ex.calls,
+            ex._dtypes,
+            capacity=ex.table.capacity,
+            out_cap=ex.out_cap,
+            nullable_keys=tuple(
+                k for k, nb in zip(ex.group_keys, ex.nullable) if nb
+            ),
+            table_id=ex.table_id,
+        )
+    if isinstance(ex, AppendOnlyDedupExecutor):
+        if ex.window_key is not None:
+            return None
+        return ShardedDedup(
+            mesh,
+            ex.keys,
+            {k: lane.dtype for k, lane in zip(ex.keys, ex.table.keys)},
+            capacity=ex.table.capacity,
+            table_id=ex.table_id,
+        )
+    return None
+
+
+def _shard_single_chain(chain, mesh):
+    """chain -> sharded chain, or None when the shape can't shard:
+    stateless* + ONE keyed (replaced by its sharded twin between
+    StackSplit/Flatten) + anything (fed flat chunks as before)."""
+    from risingwave_tpu.parallel.sharded_agg import ShardedHashAgg
+
+    keyed_idx = None
+    for j, ex in enumerate(chain):
+        if isinstance(ex, _KEYED):
+            keyed_idx = j
+            break
+        if not isinstance(ex, _PARALLEL_STATELESS):
+            return None
+    if keyed_idx is None:
+        return None
+    sharded = _sharded_equiv(chain[keyed_idx], mesh)
+    if sharded is None:
+        return None
+    n = mesh.devices.size
+    mid = [StackSplitExecutor(n), sharded]
+    if not isinstance(sharded, ShardedHashAgg):
+        mid.append(FlattenExecutor())  # dedup emits stacked chunks
+    return list(chain[:keyed_idx]) + mid + list(chain[keyed_idx + 1 :])
+
+
+def sharded_planned_mv(planner_factory, sql: str, n_shards: int):
+    """Plan ``sql`` and run it as SHARDED fragments over an n-device
+    jax Mesh: keyed state stacked across devices, exchanges on ICI via
+    all_to_all under shard_map — the multi-chip execution mode. Falls
+    back to a single-actor graph when the shape can't shard."""
+    from risingwave_tpu.parallel.sharded_agg import make_mesh
+    from risingwave_tpu.parallel.sharded_join import ShardedHashJoin
+
+    mesh = make_mesh(n_shards)
+    proto = planner_factory().plan(sql)
+    from risingwave_tpu.sql.planner import PlannedMV
+
+    if isinstance(proto.pipeline, TwoInputPipeline):
+        tp = proto.pipeline
+        left = _shard_side_chain(tp.left, mesh)
+        right = _shard_side_chain(tp.right, mesh)
+        if left is None or right is None:
+            gp = _two_input_graph([proto], None)
+        else:
+            join = tp.join
+            sj = ShardedHashJoin(
+                mesh,
+                join.left_keys,
+                join.right_keys,
+                {n_: a.dtype for n_, a in join.left.rows.items()},
+                {n_: a.dtype for n_, a in join.right.rows.items()},
+                capacity=join.left.capacity,
+                fanout=join.left.fanout,
+                out_cap=join.out_cap,
+                left_nullable=tuple(join.left.row_nulls),
+                right_nullable=tuple(join.right.row_nulls),
+                join_type=join.join_type,
+                table_id=join.table_id,
+            )
+            build = {
+                "left": left,
+                "right": right,
+                "join": sj,
+                "tail": [FlattenExecutor()] + list(tp.tail),
+            }
+            specs = [
+                FragmentSpec("left_src", lambda i: []),
+                FragmentSpec("right_src", lambda i: []),
+                FragmentSpec(
+                    "join",
+                    lambda i, b=build: dict(b),
+                    inputs=[("left_src", 0), ("right_src", 1)],
+                ),
+            ]
+            gp = GraphPipeline(
+                specs,
+                {"left": "left_src", "right": "right_src"},
+                "join",
+                left + right + [sj] + build["tail"],
+            )
+    else:
+        chain = _shard_single_chain(list(proto.pipeline.executors), mesh)
+        if chain is None:
+            gp = _singleton_graph(list(proto.pipeline.executors))
+        else:
+            specs = [FragmentSpec("mv", lambda i, c=tuple(chain): list(c))]
+            gp = GraphPipeline(specs, {"single": "mv"}, "mv", chain)
+    return PlannedMV(
+        proto.name, gp, proto.mview, proto.inputs, schema=proto.schema
+    )
+
+
+def _shard_side_chain(chain, mesh):
+    """A join side shards when it is stateless* + optional ONE dedup +
+    rename-only projects (which operate element-wise on stacked
+    chunks). Returns the sharded chain or None."""
+    from risingwave_tpu.parallel.sharded_join import ShardedDedup
+
+    out = []
+    seen_keyed = False
+    for ex in chain:
+        if isinstance(ex, _KEYED):
+            if seen_keyed:
+                return None
+            sharded = _sharded_equiv(ex, mesh)
+            if not isinstance(sharded, ShardedDedup):
+                return None  # agg flushes flat: can't feed a stacked join
+            seen_keyed = True
+            out.append(StackSplitExecutor(mesh.devices.size))
+            out.append(sharded)
+        elif isinstance(ex, ProjectExecutor):
+            if seen_keyed and not all(
+                isinstance(e, E.Col) for _n, e in ex.outputs
+            ):
+                return None  # only renames are stacked-safe
+            out.append(ex)
+        elif isinstance(ex, (FilterExecutor, HopWindowExecutor)):
+            if seen_keyed:
+                return None  # pre-exchange ops only before the dedup
+            out.append(ex)
+        else:
+            return None
+    if not seen_keyed:
+        # stateless side: split right before the join's own exchange
+        out.append(StackSplitExecutor(mesh.devices.size))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # planner output -> fragment graph
 # ---------------------------------------------------------------------------
 
@@ -420,17 +648,18 @@ def _split_single(chain):
     keyed = chain[keyed_idx]
     keys = _keys_of(keyed)
     before = chain[:keyed_idx]
-    dispatch, kpos = [], []
+    dispatch, lanes = [], []
     for pos, k in enumerate(keys):
         src = _trace_source_col(before, k)
-        if src is not None:
+        lane = _key_lane_index(keyed, pos)
+        if src is not None and lane is not None:
             dispatch.append(src)
-            kpos.append(pos)
+            lanes.append(lane)
     if not dispatch:
         return None
     positions = {
         keyed_idx: {
-            tid: tuple(kpos) for tid in keyed.checkpoint_table_ids()
+            tid: tuple(lanes) for tid in keyed.checkpoint_table_ids()
         }
     }
     return keyed_idx + 1, dispatch, positions
@@ -535,7 +764,7 @@ def _split_join(tp):
             if isinstance(ex, _PARALLEL_STATELESS):
                 continue
             if isinstance(ex, _KEYED):
-                pos = _view_positions(chain[:j], _keys_of(ex), disp)
+                pos = _view_positions(chain[:j], ex, disp)
                 if pos is None:
                     return None
                 side_positions[(side_name, j)] = {

@@ -188,6 +188,57 @@ def test_graph_restore_across_parallelism(catalog):
         graph2.pipeline.close()
 
 
+def test_sharded_mode_single_input_matches_serial(catalog):
+    """The SAME q5 SQL on the sharded (multi-chip) fragment mode: one
+    actor, state stacked over an 8-device mesh, on-device vnode
+    exchange — identical MV to the serial plan."""
+    from risingwave_tpu.runtime.fragmenter import sharded_planned_mv
+
+    serial = StreamPlanner(catalog, capacity=1 << 12).plan(Q5_SQL)
+    sharded = sharded_planned_mv(_factory(catalog), Q5_SQL, n_shards=8)
+    try:
+        for c in _bid_chunks():
+            serial.pipeline.push(c)
+            sharded.pipeline.push(c)
+            serial.pipeline.barrier()
+            sharded.pipeline.barrier()
+        want = serial.mview.snapshot()
+        assert want
+        assert sharded.mview.snapshot() == want
+    finally:
+        sharded.pipeline.close()
+
+
+def test_sharded_mode_join_matches_serial(catalog):
+    """q8 SQL in sharded mode: sharded dedups feed a sharded join
+    on-device (stacked chunks end to end), flattened only at the MV."""
+    from risingwave_tpu.parallel.sharded_join import ShardedHashJoin
+    from risingwave_tpu.runtime.fragmenter import sharded_planned_mv
+
+    serial = StreamPlanner(catalog, capacity=1 << 12).plan(Q8_SQL)
+    sharded = sharded_planned_mv(_factory(catalog), Q8_SQL, n_shards=8)
+    assert any(
+        isinstance(ex, ShardedHashJoin) for ex in sharded.pipeline.executors
+    ), "q8 shape must actually shard"
+    gen = NexmarkGenerator(NexmarkConfig())
+    try:
+        for _ in range(5):
+            chunks = gen.next_chunks(2000, 2048)
+            if chunks["person"] is not None:
+                serial.pipeline.push_left(chunks["person"])
+                sharded.pipeline.push_left(chunks["person"])
+            if chunks["auction"] is not None:
+                serial.pipeline.push_right(chunks["auction"])
+                sharded.pipeline.push_right(chunks["auction"])
+            serial.pipeline.barrier()
+            sharded.pipeline.barrier()
+        want = serial.mview.snapshot()
+        assert want
+        assert sharded.mview.snapshot() == want
+    finally:
+        sharded.pipeline.close()
+
+
 def test_session_graph_mode_end_to_end():
     """SqlSession(exec_mode='graph'): CREATE TABLE + INSERT + MV with
     GROUP BY runs on the actor graph; SELECT over the MV matches the
