@@ -24,6 +24,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+import numpy as np
+
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Barrier, Executor
 from risingwave_tpu.ops.hash_table import (
@@ -32,33 +34,82 @@ from risingwave_tpu.ops.hash_table import (
     plan_rehash,
     set_live,
 )
+from risingwave_tpu.executors.sort import ArenaBufferedExecutor
+from risingwave_tpu.storage.state_table import (
+    Checkpointable,
+    StateDelta,
+    grow_pow2,
+    pull_rows,
+    stage_marks,
+)
 
 GROW_AT = 0.5
 
-KINDS = ("row_number", "count", "sum", "min", "max", "lag")
+KINDS = (
+    "row_number",
+    "count",
+    "sum",
+    "min",
+    "max",
+    "lag",
+    "lead",
+    "rank",
+    "dense_rank",
+)
 
 
 @dataclass(frozen=True)
 class WindowCall:
+    """One window function call.
+
+    ``frame``: optional static ROWS frame (lo, hi) offsets relative to
+    the current row (e.g. (-2, 0) = 2 PRECEDING..CURRENT ROW) for
+    sum/min/max/count in the EOWC executor; None = UNBOUNDED PRECEDING
+    ..CURRENT ROW (running). ``offset``: lead/lag distance."""
+
     kind: str
     input: Optional[str]  # None for row_number / count(*)
     output: str
+    frame: Optional[Tuple[int, int]] = None
+    offset: int = 1
 
     def __post_init__(self):
         if self.kind not in KINDS:
             raise ValueError(f"unsupported window kind {self.kind!r}")
         if (self.input is None) != (self.kind in ("row_number", "count")):
             raise ValueError(f"{self.kind} input mismatch")
+        if self.frame is not None:
+            lo, hi = self.frame
+            if lo > hi:
+                raise ValueError(f"frame {self.frame}: lo > hi")
+            if hi - lo + 1 > 64:
+                raise ValueError(
+                    "ROWS frames wider than 64 are not supported (the "
+                    "fused kernel combines one shift per frame row)"
+                )
+            if self.kind not in ("sum", "min", "max", "count"):
+                raise ValueError(f"{self.kind} does not take a frame")
+        if self.offset < 1:
+            raise ValueError("lead/lag offset must be >= 1")
 
 
 def _accum_names(call: "WindowCall"):
     """Accumulator lanes per call (lag keeps last-value + flags;
     min/max keep a presence flag so sentinel-valued inputs are not
-    misread as NULL)."""
+    misread as NULL; rank/dense_rank keep (last rank, row count, dense
+    count, last order value, presence))."""
     if call.kind == "lag":
         return (call.output, call.output + "#has", call.output + "#null")
     if call.kind in ("min", "max"):
         return (call.output, call.output + "#has")
+    if call.kind in ("rank", "dense_rank"):
+        return (
+            call.output,
+            call.output + "#cnt",
+            call.output + "#dense",
+            call.output + "#last",
+            call.output + "#has",
+        )
     return (call.output,)
 
 
@@ -70,10 +121,13 @@ def _accum_init(call: "WindowCall") -> int:
     return 0
 
 
-@partial(jax.jit, static_argnames=("calls", "part_keys"), donate_argnums=(0, 1))
+@partial(
+    jax.jit, static_argnames=("calls", "part_keys"), donate_argnums=(0, 1, 2)
+)
 def _over_step(
     table: HashTable,
     accums: Dict[str, jnp.ndarray],
+    sdirty: jnp.ndarray,
     chunk: StreamChunk,
     calls: Tuple[WindowCall, ...],
     part_keys: Tuple[str, ...],
@@ -86,6 +140,8 @@ def _over_step(
     table, slots, _, _ = lookup_or_insert(table, keys, active)
     dropped = jnp.any(active & (slots < 0))
     table = set_live(table, jnp.where(active, slots, -1), True)
+    sdirty = sdirty.at[jnp.where(active, slots, -1)].set(True, mode="drop")
+    ooo = jnp.zeros((), jnp.bool_)  # out-of-order arrival (rank kinds)
 
     # rank rows of one partition within the chunk (arrival order)
     skey = jnp.where(active, slots, table.capacity).astype(jnp.int32)
@@ -217,6 +273,69 @@ def _over_step(
             new_accums[c.output + "#has"] = (
                 has.at[upd].max(seg_any.astype(jnp.int64), mode="drop")
             )
+        elif c.kind in ("rank", "dense_rank"):
+            # arrival order must be the ORDER BY order (the append-only
+            # specialization's contract): order values non-decreasing
+            # per partition — enforced by the ooo latch
+            v = s_vals[c.input]
+            prev_v = jnp.concatenate([jnp.zeros(1, v.dtype), v[:-1]])
+            vb = boundary | (v != prev_v)  # value-group starts
+            # 1-based count of value groups within the segment
+            cum_vb_all = jnp.cumsum(vb.astype(jnp.int64))
+            seg_vb_base = jax.ops.segment_max(
+                jnp.where(boundary, cum_vb_all - 1, MINI),
+                gid,
+                num_segments=n,
+            )[gid]
+            cum_vb = cum_vb_all - seg_vb_base
+            # arrival index (0-based, in-segment) of each value group's
+            # first row — the rank numerator for its whole group
+            grp_start = seg_prefix_extreme(
+                jnp.where(vb, rank, MINI), "max"
+            )
+            has = new_accums[c.output + "#has"][gslot] != 0
+            lastv = new_accums[c.output + "#last"][gslot]
+            cnt0 = new_accums[c.output + "#cnt"][gslot]
+            dense0 = new_accums[c.output + "#dense"][gslot]
+            rank0 = new_accums[c.output][gslot]
+            first_group = cum_vb == 1
+            eq_carry = has & (v == lastv) & first_group
+            ooo = ooo | jnp.any(
+                (s_active & ~boundary & (v < prev_v))
+                | (s_active & boundary & has & (v < lastv))
+            )
+            ranked = jnp.where(eq_carry, rank0, cnt0 + grp_start + 1)
+            first_eq = (
+                jax.ops.segment_max(
+                    jnp.where(boundary, eq_carry.astype(jnp.int64), 0),
+                    gid,
+                    num_segments=n,
+                )[gid]
+                > 0
+            )
+            dense_row = dense0 + cum_vb - jnp.where(first_eq, 1, 0)
+            o = ranked if c.kind == "rank" else dense_row
+            contrib = jnp.where(s_active, jnp.int64(1), jnp.int64(0))
+            totals = jax.ops.segment_sum(contrib, gid, num_segments=n)[gid]
+            new_accums[c.output] = acc.at[upd].set(ranked, mode="drop")
+            new_accums[c.output + "#cnt"] = (
+                new_accums[c.output + "#cnt"]
+                .at[upd]
+                .add(totals, mode="drop")
+            )
+            new_accums[c.output + "#dense"] = (
+                new_accums[c.output + "#dense"]
+                .at[upd]
+                .set(dense_row, mode="drop")
+            )
+            new_accums[c.output + "#last"] = (
+                new_accums[c.output + "#last"].at[upd].set(v, mode="drop")
+            )
+            new_accums[c.output + "#has"] = (
+                new_accums[c.output + "#has"]
+                .at[upd]
+                .set(jnp.int64(1), mode="drop")
+            )
         else:  # lag(1): previous row's value within the partition
             v = s_vals[c.input]
             vnull = s_nulls.get(c.input, jnp.zeros(n, jnp.bool_))
@@ -267,12 +386,280 @@ def _over_step(
         columns=cols, valid=chunk.valid & active, nulls=out_nulls,
         ops=chunk.ops,
     )
-    return table, new_accums, out, saw_delete, dropped
+    return table, new_accums, sdirty, out, saw_delete, dropped, ooo
 
 
-class OverWindowExecutor(Executor):
-    """Append-only window functions: ROW_NUMBER / running COUNT / SUM
-    per partition in arrival order."""
+# ---------------------------------------------------------------------------
+# EOWC over-window: complete-partition batch compute at window close
+# ---------------------------------------------------------------------------
+
+
+@partial(
+    jax.jit,
+    static_argnames=("names", "calls", "part_keys", "order_col", "win_col"),
+)
+def _eowc_over_emit(
+    buf,
+    bnulls,
+    valid,
+    seq,
+    cutoff,
+    names: Tuple[str, ...],
+    calls: Tuple[WindowCall, ...],
+    part_keys: Tuple[str, ...],
+    order_col: str,
+    win_col: str,
+):
+    """Sort closed rows by (partition, order, seq) and compute EVERY
+    window call on the complete partitions in one program. Closed
+    partitions are final (watermark contract), so lead/FOLLOWING frames
+    need no hold-back: beyond-partition-end is NULL / clipped, exactly
+    SQL's frame semantics on a finished window."""
+    cap = valid.shape[0]
+    closed = valid & (buf[win_col] < cutoff)
+    open_flag = (~closed).astype(jnp.int32)
+    sort_in = (
+        (open_flag,)
+        + tuple(buf[k] for k in part_keys)
+        + (buf[order_col], seq)
+        + (jnp.arange(cap, dtype=jnp.int32),)
+    )
+    nk = 3 + len(part_keys)
+    sorted_all = jax.lax.sort(sort_in, num_keys=nk)
+    order_idx = sorted_all[-1]  # original slot of each sorted position
+    closed_s = closed[order_idx]
+    s = lambda a: a[order_idx]
+    pk_s = [s(buf[k]) for k in part_keys]
+    v_order = s(buf[order_col])
+
+    idx = jnp.arange(cap, dtype=jnp.int64)
+    prev_ne = jnp.zeros(cap, jnp.bool_)
+    for lane in pk_s:
+        prev_ne = prev_ne | jnp.concatenate(
+            [jnp.ones(1, jnp.bool_), lane[1:] != lane[:-1]]
+        )
+    trans = jnp.concatenate(
+        [jnp.ones(1, jnp.bool_), closed_s[1:] != closed_s[:-1]]
+    )
+    boundary = prev_ne | trans
+    boundary = boundary.at[0].set(True)
+    gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    seg_start = jax.ops.segment_max(
+        jnp.where(boundary, idx, 0), gid, num_segments=cap
+    )[gid]
+    in_seg = idx - seg_start  # 0-based index within the partition
+
+    def shifted(vals, nullm, d):
+        """(value, isnull) of the row d positions away within the SAME
+        closed partition; beyond it -> (0, NULL)."""
+        j = idx + d
+        jc = jnp.clip(j, 0, cap - 1)
+        ok = (
+            (j >= 0)
+            & (j < cap)
+            & (gid[jc] == gid)
+            & closed_s[jc]
+            & closed_s
+        )
+        return (
+            jnp.where(ok, vals[jc], 0),
+            jnp.where(ok, nullm[jc], True),
+        )
+
+    MAXI = jnp.iinfo(jnp.int64).max
+    MINI = jnp.iinfo(jnp.int64).min
+    out_sorted: Dict[str, jnp.ndarray] = {}
+    out_nulls_sorted: Dict[str, jnp.ndarray] = {}
+    zero_nulls = jnp.zeros(cap, jnp.bool_)
+    for c in calls:
+        if c.input is not None:
+            v = s(buf[c.input]).astype(jnp.int64)
+            vnull = s(bnulls[c.input]) if c.input in bnulls else zero_nulls
+        if c.kind == "row_number":
+            o, onull = in_seg + 1, zero_nulls
+        elif c.kind in ("rank", "dense_rank"):
+            pv = jnp.concatenate([jnp.zeros(1, v_order.dtype), v_order[:-1]])
+            vb = boundary | (v_order != pv)
+            cum_vb_all = jnp.cumsum(vb.astype(jnp.int64))
+            seg_vb = jax.ops.segment_max(
+                jnp.where(boundary, cum_vb_all - 1, MINI),
+                gid,
+                num_segments=cap,
+            )[gid]
+            if c.kind == "dense_rank":
+                o = cum_vb_all - seg_vb
+            else:
+                # segmented prefix max with boundary reset: a plain max
+                # scan would leak a previous partition's group starts
+                def reset_max(a, b):
+                    fa, va = a
+                    fb, vb_ = b
+                    return fa | fb, jnp.where(fb, vb_, jnp.maximum(va, vb_))
+
+                _, grp_start = jax.lax.associative_scan(
+                    reset_max, (boundary, jnp.where(vb, in_seg, MINI))
+                )
+                o = grp_start + 1
+            onull = zero_nulls
+        elif c.kind in ("lead", "lag"):
+            d = c.offset if c.kind == "lead" else -c.offset
+            o, onull = shifted(v, vnull, d)
+        elif c.frame is not None:
+            lo, hi = c.frame
+            if c.kind == "count":
+                v, vnull = jnp.ones(cap, jnp.int64), zero_nulls
+            ident = (
+                MAXI if c.kind == "min" else MINI if c.kind == "max" else 0
+            )
+            comb = (
+                jnp.minimum
+                if c.kind == "min"
+                else jnp.maximum
+                if c.kind == "max"
+                else (lambda a, b: a + b)
+            )
+            acc = jnp.full(cap, ident, jnp.int64)
+            any_real = zero_nulls
+            for d in range(lo, hi + 1):
+                sv, sn = shifted(v, vnull, d)
+                real = ~sn
+                acc = comb(acc, jnp.where(real, sv, ident))
+                any_real = any_real | real
+            if c.kind == "count":
+                o, onull = acc, zero_nulls
+            else:
+                o, onull = acc, ~any_real
+        else:
+            # running UNBOUNDED PRECEDING .. CURRENT ROW
+            if c.kind == "count":
+                real = closed_s
+                vv = jnp.ones(cap, jnp.int64)
+            else:
+                real = closed_s & ~vnull
+                vv = v
+            if c.kind == "sum" or c.kind == "count":
+                vv = jnp.where(real, vv, 0)
+                csum = jnp.cumsum(vv)
+                base = jax.ops.segment_max(
+                    jnp.where(boundary, csum - vv, MINI),
+                    gid,
+                    num_segments=cap,
+                )[gid]
+                o, onull = csum - base, zero_nulls
+            else:
+                sent = MAXI if c.kind == "min" else MINI
+                vv = jnp.where(real, vv, sent)
+
+                def op(a, b):
+                    fa, va, ra = a
+                    fb, vb_, rb = b
+                    cmb = (
+                        jnp.minimum if c.kind == "min" else jnp.maximum
+                    )
+                    return (
+                        fa | fb,
+                        jnp.where(fb, vb_, cmb(va, vb_)),
+                        jnp.where(fb, rb, ra | rb),
+                    )
+
+                _, o, has = jax.lax.associative_scan(
+                    op, (boundary, vv, real)
+                )
+                onull = ~has
+        out_sorted[c.output] = o
+        out_nulls_sorted[c.output] = onull
+
+    out_cols = {n: s(buf[n]) for n in names}
+    out_cols.update(out_sorted)
+    out_nulls = {n: s(bnulls[n]) for n in bnulls}
+    out_nulls.update(out_nulls_sorted)
+    new_valid = valid & ~closed
+    return (
+        out_cols,
+        out_nulls,
+        closed_s,
+        new_valid,
+        jnp.sum(closed.astype(jnp.int32)),
+    )
+
+
+class EowcOverWindowExecutor(ArenaBufferedExecutor):
+    """Emit-on-window-close window functions (over_window/eowc.rs:88):
+    rows buffer in a device arena until the watermark closes their
+    window column; complete partitions then compute EVERY call — incl.
+    lead/lag and static ROWS frames — in one fused sorted-segment
+    program. The partition key must include the window column (the EOWC
+    contract: a closed partition receives no further rows)."""
+
+    def __init__(
+        self,
+        partition_by: Sequence[str],
+        order_col: str,
+        calls: Sequence[WindowCall],
+        schema_dtypes: Dict[str, object],
+        win_col: Optional[str] = None,
+        capacity: int = 1 << 14,
+        nullable: Sequence[str] = (),
+        table_id: str = "eowc_over",
+    ):
+        self.part_keys = tuple(partition_by)
+        self.order_col = order_col
+        self.win_col = win_col or self.part_keys[0]
+        if self.win_col not in self.part_keys:
+            raise ValueError(
+                "the window column must be one of the partition keys "
+                "(a closed partition may receive no further rows)"
+            )
+        self.calls = tuple(calls)
+        for c in self.calls:
+            if (
+                c.kind in ("rank", "dense_rank")
+                and c.input != self.order_col
+            ):
+                raise ValueError(
+                    f"{c.kind} ranks by the executor's order column "
+                    f"{self.order_col!r}; got input {c.input!r}"
+                )
+        super().__init__(schema_dtypes, capacity, nullable, table_id)
+
+    def on_watermark(self, watermark):
+        if watermark.column != self.win_col:
+            return watermark, []
+        cutoff = jnp.asarray(watermark.value, jnp.int64)
+        out_cols, out_nulls, out_valid, self.valid, n_closed = (
+            _eowc_over_emit(
+                self.buf,
+                self.bnulls,
+                self.valid,
+                self.seq,
+                cutoff,
+                self.names,
+                self.calls,
+                self.part_keys,
+                self.order_col,
+                self.win_col,
+            )
+        )
+        if int(n_closed) == 0:
+            return watermark, []
+        chunk = StreamChunk(
+            columns=out_cols,
+            valid=out_valid,
+            nulls=out_nulls,
+            ops=jnp.zeros(self.capacity, jnp.int32),
+        )
+        return watermark, [chunk]
+
+    _arena_name = "EOWC over-window arena"
+
+
+class OverWindowExecutor(Executor, Checkpointable):
+    """Append-only window functions: ROW_NUMBER / running COUNT / SUM /
+    MIN / MAX / LAG / RANK / DENSE_RANK per partition in arrival order
+    (rank kinds require arrival order == ORDER BY order; violations
+    latch and raise at the barrier). Checkpointable: partition keys +
+    every accumulator lane persist as one state table, so a window MV
+    survives recovery bit-exactly."""
 
     def __init__(
         self,
@@ -280,9 +667,22 @@ class OverWindowExecutor(Executor):
         calls: Sequence[WindowCall],
         schema_dtypes: Dict[str, object],
         capacity: int = 1 << 14,
+        table_id: str = "over_window",
     ):
         self.part_keys = tuple(partition_by)
         self.calls = tuple(calls)
+        for c in self.calls:
+            if c.kind == "lead" or c.frame is not None:
+                raise ValueError(
+                    f"{c.kind}/frames need future rows: use "
+                    "EowcOverWindowExecutor (emit on window close)"
+                )
+            if c.kind == "lag" and c.offset != 1:
+                raise ValueError(
+                    "streaming lag supports offset=1 only; use "
+                    "EowcOverWindowExecutor for lag(k)"
+                )
+        self.table_id = table_id
         self.table = HashTable.create(
             capacity,
             tuple(jnp.dtype(schema_dtypes[k]) for k in self.part_keys),
@@ -294,18 +694,29 @@ class OverWindowExecutor(Executor):
                 init = _accum_init(c) if name == c.output else 0
                 self._accum_inits[name] = init
                 self.accums[name] = jnp.full(capacity, init, jnp.int64)
+        self.sdirty = jnp.zeros(capacity, jnp.bool_)
+        self.stored = jnp.zeros(capacity, jnp.bool_)
         self._bound = 0
         self._saw_delete = jnp.zeros((), jnp.bool_)
         self._dropped = jnp.zeros((), jnp.bool_)
+        self._ooo = jnp.zeros((), jnp.bool_)
 
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
+        for c in self.calls:
+            if c.kind in ("rank", "dense_rank") and c.input in chunk.nulls:
+                raise ValueError(
+                    f"rank order column {c.input!r} carries a null lane "
+                    "(NULL ordering unsupported)"
+                )
         self._maybe_grow(chunk.capacity)
         self._bound += chunk.capacity
-        self.table, self.accums, out, sd, dr = _over_step(
-            self.table, self.accums, chunk, self.calls, self.part_keys
+        self.table, self.accums, self.sdirty, out, sd, dr, ooo = _over_step(
+            self.table, self.accums, self.sdirty, chunk, self.calls,
+            self.part_keys,
         )
         self._saw_delete = self._saw_delete | sd
         self._dropped = self._dropped | dr
+        self._ooo = self._ooo | ooo
         return [out]
 
     def _maybe_grow(self, incoming: int):
@@ -331,6 +742,16 @@ class OverWindowExecutor(Executor):
                 .set(a, mode="drop")
                 for name, a in self.accums.items()
             }
+            self.sdirty = (
+                jnp.zeros(new_cap, jnp.bool_)
+                .at[idx]
+                .set(self.sdirty, mode="drop")
+            )
+            self.stored = (
+                jnp.zeros(new_cap, jnp.bool_)
+                .at[idx]
+                .set(self.stored, mode="drop")
+            )
             self.table = new
             claimed = int(self.table.occupancy())
         self._bound = claimed
@@ -339,14 +760,14 @@ class OverWindowExecutor(Executor):
         from risingwave_tpu.ops.hash_table import stage_scalars
 
         self._staged_scalars = stage_scalars(
-            self._saw_delete, self._dropped
+            self._saw_delete, self._dropped, self._ooo
         )
         if barrier is None:  # direct drive: checks fire inline
             self.finish_barrier()
         return []
 
     def _on_barrier_scalars(self, vals) -> None:
-        sd, dr = vals
+        sd, dr, ooo = vals
         if sd:
             raise RuntimeError(
                 "append-only OverWindow received a DELETE (the general "
@@ -354,3 +775,66 @@ class OverWindowExecutor(Executor):
             )
         if dr:
             raise RuntimeError("OverWindow partition table overflowed")
+        if ooo:
+            raise RuntimeError(
+                "rank/dense_rank saw out-of-order arrivals: the "
+                "append-only OverWindow requires arrival order to match "
+                "ORDER BY (sort upstream, e.g. with the EOWC sort)"
+            )
+
+    # -- checkpoint/restore ----------------------------------------------
+    def checkpoint_delta(self) -> List[StateDelta]:
+        sdirty = np.asarray(self.sdirty)
+        if not sdirty.any():
+            return []
+        # partitions never die in the append-only executor: alive =
+        # every claimed slot, so there are no tombstones
+        alive = np.asarray(self.table.fp1) != 0
+        upsert, tomb, sel = stage_marks(
+            sdirty, alive, np.asarray(self.stored)
+        )
+        lanes = {f"k{i}": l for i, l in enumerate(self.table.keys)}
+        key_names = tuple(lanes)
+        for name, a in self.accums.items():
+            lanes[f"acc_{name}"] = a
+        pulled = pull_rows(lanes, sel)
+        keys = {k: pulled[k] for k in key_names}
+        vals = {k: v for k, v in pulled.items() if k not in key_names}
+        self.stored = (self.stored | jnp.asarray(upsert)) & ~jnp.asarray(
+            tomb
+        )
+        self.sdirty = jnp.zeros_like(self.sdirty)
+        return [StateDelta(self.table_id, keys, vals, tomb[sel], key_names)]
+
+    def restore_state(self, table_id, key_cols, value_cols) -> None:
+        n = len(next(iter(key_cols.values()))) if key_cols else 0
+        key_dtypes = tuple(k.dtype for k in self.table.keys)
+        cap = grow_pow2(n, self.table.capacity, GROW_AT)
+        table = HashTable.create(cap, key_dtypes)
+        self.accums = {
+            name: jnp.full(cap, self._accum_inits[name], jnp.int64)
+            for name in self.accums
+        }
+        self.sdirty = jnp.zeros(cap, jnp.bool_)
+        self.stored = jnp.zeros(cap, jnp.bool_)
+        if n:
+            lanes = tuple(
+                jnp.asarray(np.asarray(key_cols[f"k{i}"], dtype=d))
+                for i, d in enumerate(key_dtypes)
+            )
+            table, slots, _, _ = lookup_or_insert(
+                table, lanes, jnp.ones(n, jnp.bool_)
+            )
+            table = set_live(table, slots, True)
+            self.stored = self.stored.at[slots].set(True)
+            for name in self.accums:
+                self.accums[name] = (
+                    self.accums[name]
+                    .at[slots]
+                    .set(jnp.asarray(value_cols[f"acc_{name}"]))
+                )
+        self.table = table
+        self._bound = int(n)
+        self._saw_delete = jnp.zeros((), jnp.bool_)
+        self._dropped = jnp.zeros((), jnp.bool_)
+        self._ooo = jnp.zeros((), jnp.bool_)

@@ -94,19 +94,23 @@ def _sort_emit(buf, bnulls, valid, seq, cutoff, names, ts_col):
     )
 
 
-class SortExecutor(Executor, Checkpointable):
-    """EOWC sort: buffer until the ``ts_col`` watermark closes rows,
-    then emit in (ts, arrival) order. Append-only input."""
+class ArenaBufferedExecutor(Executor, Checkpointable):
+    """Shared EOWC arena: a fixed-capacity slot buffer in HBM holding
+    open (not-yet-closed) rows keyed by arrival seq. Subclasses decide
+    WHEN rows close and WHAT to emit (SortExecutor: ordered rows;
+    EowcOverWindowExecutor: window-function outputs over complete
+    partitions). One arena lifecycle — append, overflow/append-only
+    latches, seq-keyed incremental checkpoints — lives here."""
+
+    _arena_name = "EOWC arena"
 
     def __init__(
         self,
-        ts_col: str,
         schema_dtypes: Dict[str, object],
         capacity: int = 1 << 14,
         nullable: Sequence[str] = (),
-        table_id: str = "sort",
+        table_id: str = "arena",
     ):
-        self.ts_col = ts_col
         self.table_id = table_id
         self.names = tuple(schema_dtypes)
         self.capacity = capacity
@@ -159,34 +163,15 @@ class SortExecutor(Executor, Checkpointable):
     def _on_barrier_scalars(self, vals) -> None:
         saw_delete, overflow = vals
         if saw_delete:
-            raise RuntimeError("EOWC sort requires append-only input")
+            raise RuntimeError(
+                f"{self._arena_name} requires append-only input"
+            )
         if overflow:
             raise RuntimeError(
-                "sort buffer overflowed; grow capacity or advance "
-                "watermarks faster"
+                f"{self._arena_name} overflowed; grow capacity or "
+                "advance watermarks faster"
             )
 
-    def on_watermark(self, watermark: Watermark):
-        if watermark.column != self.ts_col:
-            return watermark, []
-        cutoff = jnp.asarray(watermark.value, jnp.int64)
-        out_cols, out_nulls, out_valid, self.valid, n_closed = _sort_emit(
-            self.buf, self.bnulls, self.valid, self.seq, cutoff,
-            self.names, self.ts_col,
-        )
-        # one scalar read per watermark: an all-invalid capacity-wide
-        # chunk would cost O(capacity) device work in EVERY downstream
-        # stage, and EOWC emissions are empty most barriers — the
-        # small sync is the cheaper side of the trade
-        if int(n_closed) == 0:
-            return watermark, []
-        chunk = StreamChunk(
-            columns=out_cols,
-            valid=out_valid,
-            nulls=out_nulls,
-            ops=jnp.zeros(self.capacity, jnp.int32),
-        )
-        return watermark, [chunk]
 
     # -- checkpoint/restore ----------------------------------------------
     def checkpoint_delta(self) -> List[StateDelta]:
@@ -286,3 +271,43 @@ class SortExecutor(Executor, Checkpointable):
         self.valid = self.valid.at[idx].set(True)
         self.next_seq = jnp.asarray(int(seqs.max()) + 1, jnp.int64)
         self._stored_seqs = seqs
+
+
+class SortExecutor(ArenaBufferedExecutor):
+    """EOWC sort: buffer until the ``ts_col`` watermark closes rows,
+    then emit in (ts, arrival) order. Append-only input."""
+
+    _arena_name = "EOWC sort buffer"
+
+    def __init__(
+        self,
+        ts_col: str,
+        schema_dtypes: Dict[str, object],
+        capacity: int = 1 << 14,
+        nullable: Sequence[str] = (),
+        table_id: str = "sort",
+    ):
+        super().__init__(schema_dtypes, capacity, nullable, table_id)
+        self.ts_col = ts_col
+
+    def on_watermark(self, watermark: Watermark):
+        if watermark.column != self.ts_col:
+            return watermark, []
+        cutoff = jnp.asarray(watermark.value, jnp.int64)
+        out_cols, out_nulls, out_valid, self.valid, n_closed = _sort_emit(
+            self.buf, self.bnulls, self.valid, self.seq, cutoff,
+            self.names, self.ts_col,
+        )
+        # one scalar read per watermark: an all-invalid capacity-wide
+        # chunk would cost O(capacity) device work in EVERY downstream
+        # stage, and EOWC emissions are empty most barriers — the
+        # small sync is the cheaper side of the trade
+        if int(n_closed) == 0:
+            return watermark, []
+        chunk = StreamChunk(
+            columns=out_cols,
+            valid=out_valid,
+            nulls=out_nulls,
+            ops=jnp.zeros(self.capacity, jnp.int32),
+        )
+        return watermark, [chunk]

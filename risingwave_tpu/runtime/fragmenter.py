@@ -522,9 +522,17 @@ def _shard_side_chain(chain, mesh):
         if isinstance(ex, _KEYED):
             if seen_keyed:
                 return None
+            # type/feature-check BEFORE building: _sharded_equiv
+            # allocates mesh-stacked device state (a sharded agg would
+            # be constructed only to be discarded — agg flushes flat
+            # chunks, which can't feed a stacked join)
+            if (
+                not isinstance(ex, AppendOnlyDedupExecutor)
+                or ex.window_key is not None
+            ):
+                return None
             sharded = _sharded_equiv(ex, mesh)
-            if not isinstance(sharded, ShardedDedup):
-                return None  # agg flushes flat: can't feed a stacked join
+            assert isinstance(sharded, ShardedDedup)
             seen_keyed = True
             out.append(StackSplitExecutor(mesh.devices.size))
             out.append(sharded)

@@ -1,3 +1,8 @@
+"""OverWindow executor: running window kinds vs pandas-style oracles,
+rank/dense_rank over ordered arrivals, checkpoint/restore.
+
+Reference: src/stream/src/executor/over_window/general.rs:49 (the
+append-only arrival-ordered specialization)."""
 
 
 def test_running_min_max_and_lag():
@@ -52,3 +57,320 @@ def test_running_min_max_and_lag():
             seen.append(x)
             want.append((p, min(seen), max(seen), prev))
     assert got == want
+
+
+def test_rank_dense_rank_ordered_arrivals():
+    """rank/dense_rank over per-partition non-decreasing order values,
+    with ties, crossing chunk boundaries."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from risingwave_tpu.array.chunk import StreamChunk
+    from risingwave_tpu.executors.over_window import (
+        OverWindowExecutor,
+        WindowCall,
+    )
+
+    ex = OverWindowExecutor(
+        partition_by=("p",),
+        calls=(
+            WindowCall("rank", "x", "rk"),
+            WindowCall("dense_rank", "x", "drk"),
+            WindowCall("row_number", None, "rn"),
+        ),
+        schema_dtypes={"p": jnp.int64, "x": jnp.int64},
+        capacity=1 << 8,
+    )
+    rng = np.random.default_rng(3)
+    # per-partition monotone order values WITH ties: random increments
+    # of 0/0/1/2 so ties occur both inside a chunk and across chunks
+    cur = {p: 0 for p in range(4)}
+    arrivals = []
+    for _ in range(5):
+        n = int(rng.integers(4, 24))
+        ps = rng.integers(0, 4, n)
+        xs = []
+        for p in ps.tolist():
+            cur[p] += int(rng.choice([0, 0, 1, 2]))
+            xs.append(cur[p])
+        arrivals.append((ps, np.asarray(xs, np.int64)))
+
+    got = []
+    for ps, xs in arrivals:
+        chunk = StreamChunk.from_numpy({"p": ps, "x": xs}, 32)
+        (out,) = ex.apply(chunk)
+        d = out.to_numpy()
+        for i in range(len(d["p"])):
+            got.append(
+                (int(d["p"][i]), int(d["rk"][i]), int(d["drk"][i]),
+                 int(d["rn"][i]))
+            )
+    ex.on_barrier(None)  # ooo latch must NOT fire
+
+    # oracle: SQL rank()/dense_rank() over (partition by p order by x)
+    hist = {}
+    want = []
+    for ps, xs in arrivals:
+        for p, x in zip(ps.tolist(), xs.tolist()):
+            seen = hist.setdefault(p, [])
+            seen.append(x)
+            rank = 1 + sum(1 for v in seen if v < x)
+            dense = len({v for v in seen if v < x}) + 1
+            want.append((p, rank, dense, len(seen)))
+    assert got == want
+
+
+def test_rank_out_of_order_raises():
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+
+    from risingwave_tpu.array.chunk import StreamChunk
+    from risingwave_tpu.executors.over_window import (
+        OverWindowExecutor,
+        WindowCall,
+    )
+
+    ex = OverWindowExecutor(
+        partition_by=("p",),
+        calls=(WindowCall("rank", "x", "rk"),),
+        schema_dtypes={"p": jnp.int64, "x": jnp.int64},
+        capacity=1 << 6,
+    )
+    ex.apply(
+        StreamChunk.from_numpy(
+            {"p": np.zeros(2, np.int64), "x": np.asarray([5, 3], np.int64)},
+            8,
+        )
+    )
+    with pytest.raises(RuntimeError, match="out-of-order"):
+        ex.on_barrier(None)
+
+
+def test_over_window_checkpoint_restore():
+    """A window MV's state survives kill+recover bit-exactly: outputs
+    after restore equal an uninterrupted run (VERDICT r3 #5 — before
+    this, recovery silently produced wrong results)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from risingwave_tpu.array.chunk import StreamChunk
+    from risingwave_tpu.executors.over_window import (
+        OverWindowExecutor,
+        WindowCall,
+    )
+    from risingwave_tpu.storage.object_store import MemObjectStore
+    from risingwave_tpu.storage.state_table import CheckpointManager
+
+    CALLS = (
+        WindowCall("row_number", None, "rn"),
+        WindowCall("sum", "x", "rs"),
+        WindowCall("min", "x", "rmin"),
+        WindowCall("lag", "x", "prev"),
+        WindowCall("rank", "o", "rk"),
+    )
+    DT = {"p": jnp.int64, "x": jnp.int64, "o": jnp.int64}
+
+    def chunks():
+        rng = np.random.default_rng(9)
+        cur = {p: 0 for p in range(5)}
+        out = []
+        for _ in range(6):
+            n = int(rng.integers(4, 20))
+            ps = rng.integers(0, 5, n)
+            xs = rng.integers(-40, 40, n).astype(np.int64)
+            os_ = []
+            for p in ps.tolist():
+                cur[p] += int(rng.choice([0, 1, 3]))
+                os_.append(cur[p])
+            out.append(
+                StreamChunk.from_numpy(
+                    {"p": ps, "x": xs, "o": np.asarray(os_, np.int64)}, 32
+                )
+            )
+        return out
+
+    def outputs(ex, cs):
+        rows = []
+        for c in cs:
+            (out,) = ex.apply(c)
+            d = out.to_numpy()
+            pn = d.get("prev__null", np.zeros(len(d["p"]), bool))
+            for i in range(len(d["p"])):
+                rows.append(
+                    (int(d["p"][i]), int(d["rn"][i]), int(d["rs"][i]),
+                     int(d["rmin"][i]),
+                     None if pn[i] else int(d["prev"][i]),
+                     int(d["rk"][i]))
+                )
+        return rows
+
+    cs = chunks()
+    oracle = OverWindowExecutor(("p",), CALLS, DT, capacity=1 << 7,
+                                table_id="ow")
+    uninterrupted = outputs(oracle, cs)
+
+    mgr = CheckpointManager(MemObjectStore())
+    ex1 = OverWindowExecutor(("p",), CALLS, DT, capacity=1 << 7,
+                             table_id="ow")
+    first = outputs(ex1, cs[:3])
+    staged = mgr.stage([ex1])
+    assert staged and staged[0].table_id == "ow"
+    mgr.commit_staged(1, staged)
+    del ex1  # the kill
+
+    ex2 = OverWindowExecutor(("p",), CALLS, DT, capacity=1 << 7,
+                             table_id="ow")
+    mgr.recover([ex2])
+    rest = outputs(ex2, cs[3:])
+    ex2.on_barrier(None)
+    assert first + rest == uninterrupted
+
+
+def _eowc_oracle(rows, calls_spec):
+    """Oracle: SQL window functions over complete (p, w) partitions
+    ordered by (o, arrival)."""
+    from collections import defaultdict
+
+    parts = defaultdict(list)
+    for i, r in enumerate(rows):
+        parts[(r["p"], r["w"])].append((r["o"], i, r))
+    out = []
+    for key in parts:
+        seq = sorted(parts[key], key=lambda t: (t[0], t[1]))
+        vals = [r["x"] for _o, _i, r in seq]
+        orders = [o for o, _i, _r in seq]
+        n = len(seq)
+        for i, (_o, _idx, r) in enumerate(seq):
+            row = dict(r)
+            row["rn"] = i + 1
+            row["rk"] = 1 + sum(1 for o2 in orders if o2 < orders[i])
+            row["drk"] = len({o2 for o2 in orders if o2 < orders[i]}) + 1
+            row["ld"] = vals[i + 1] if i + 1 < n else None
+            row["lg"] = vals[i - 1] if i >= 1 else None
+            lo, hi = max(0, i - 2), min(n - 1, i + 1)
+            w = vals[lo : hi + 1]
+            row["fsum"] = sum(w)
+            row["fmin"] = min(w)
+            out.append(row)
+    return out
+
+
+def test_eowc_over_window_lead_and_frames():
+    """Lead, lag, rank and a ROWS BETWEEN 2 PRECEDING AND 1 FOLLOWING
+    frame, computed when the watermark closes each window partition —
+    vs a complete-partition SQL oracle. Checkpoint/restore mid-stream."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from risingwave_tpu.array.chunk import StreamChunk
+    from risingwave_tpu.executors.over_window import (
+        EowcOverWindowExecutor,
+        WindowCall,
+    )
+    from risingwave_tpu.storage.object_store import MemObjectStore
+    from risingwave_tpu.storage.state_table import CheckpointManager
+
+    CALLS = (
+        WindowCall("row_number", None, "rn"),
+        WindowCall("rank", "o", "rk"),
+        WindowCall("dense_rank", "o", "drk"),
+        WindowCall("lead", "x", "ld"),
+        WindowCall("lag", "x", "lg"),
+        WindowCall("sum", "x", "fsum", frame=(-2, 1)),
+        WindowCall("min", "x", "fmin", frame=(-2, 1)),
+    )
+    DT = {
+        "p": jnp.int64, "w": jnp.int64, "o": jnp.int64, "x": jnp.int64
+    }
+
+    def mk(capacity=1 << 9, table_id="eow"):
+        return EowcOverWindowExecutor(
+            partition_by=("w", "p"),
+            order_col="o",
+            calls=CALLS,
+            schema_dtypes=DT,
+            win_col="w",
+            capacity=capacity,
+            table_id=table_id,
+        )
+
+    rng = np.random.default_rng(21)
+    all_rows = []
+    epochs = []
+    for e in range(4):
+        n = int(rng.integers(6, 28))
+        rows = [
+            {
+                "p": int(rng.integers(0, 3)),
+                "w": int(e // 2),  # two epochs per window
+                "o": int(rng.integers(0, 6)),
+                "x": int(rng.integers(-20, 20)),
+            }
+            for _ in range(n)
+        ]
+        all_rows.extend(rows)
+        epochs.append(
+            StreamChunk.from_numpy(
+                {
+                    k: np.asarray([r[k] for r in rows], np.int64)
+                    for k in ("p", "w", "o", "x")
+                },
+                32,
+            )
+        )
+
+    def run(ex, chunks, wms):
+        """Apply chunks, then each watermark; collect emitted rows."""
+        got = []
+        for c in chunks:
+            ex.apply(c)
+        for wm_v in wms:
+            from risingwave_tpu.executors.base import Watermark
+
+            _, outs = ex.on_watermark(Watermark("w", wm_v))
+            for out in outs:
+                d = out.to_numpy()
+                nl = {
+                    k: d.get(k + "__null")
+                    for k in ("ld", "lg", "fmin")
+                }
+                for i in range(len(d["p"])):
+                    got.append(
+                        {
+                            "p": int(d["p"][i]), "w": int(d["w"][i]),
+                            "o": int(d["o"][i]), "x": int(d["x"][i]),
+                            "rn": int(d["rn"][i]), "rk": int(d["rk"][i]),
+                            "drk": int(d["drk"][i]),
+                            "ld": None
+                            if nl["ld"] is not None and nl["ld"][i]
+                            else int(d["ld"][i]),
+                            "lg": None
+                            if nl["lg"] is not None and nl["lg"][i]
+                            else int(d["lg"][i]),
+                            "fsum": int(d["fsum"][i]),
+                            "fmin": int(d["fmin"][i]),
+                        }
+                    )
+        return got
+
+    # uninterrupted run: close window 0, then window 1
+    ex = mk()
+    got = run(ex, epochs, [1, 2])
+    ex.on_barrier(None)
+
+    want = _eowc_oracle(all_rows, CALLS)
+    key = lambda r: (r["w"], r["p"], r["o"], r["rn"])
+    assert sorted(got, key=key) == sorted(want, key=key)
+
+    # kill+recover between the two windows: same final output set
+    mgr = CheckpointManager(MemObjectStore())
+    ex1 = mk()
+    got1 = run(ex1, epochs[:2], [1])  # window 0 closed
+    mgr.commit_staged(1, mgr.stage([ex1]))
+    del ex1
+
+    ex2 = mk()
+    mgr.recover([ex2])
+    got2 = run(ex2, epochs[2:], [2])
+    assert sorted(got1 + got2, key=key) == sorted(want, key=key)
