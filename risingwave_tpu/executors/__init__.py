@@ -24,7 +24,10 @@ from risingwave_tpu.executors.row_id_gen import RowIdGenExecutor
 from risingwave_tpu.executors.simple_agg import SimpleAggExecutor
 from risingwave_tpu.executors.sort import SortExecutor
 from risingwave_tpu.executors.top_n import GroupTopNExecutor
-from risingwave_tpu.executors.top_n_plain import TopNExecutor
+from risingwave_tpu.executors.top_n_plain import (
+    RetractableGroupTopNExecutor,
+    TopNExecutor,
+)
 from risingwave_tpu.executors.watermark_filter import WatermarkFilterExecutor
 
 __all__ = [
@@ -33,6 +36,7 @@ __all__ = [
     "SimpleAggExecutor",
     "SortExecutor",
     "TopNExecutor",
+    "RetractableGroupTopNExecutor",
     "WatermarkFilterExecutor",
     "Barrier",
     "Watermark",

@@ -245,3 +245,329 @@ class DynamicMaxFilterExecutor(Executor, Checkpointable):
         self._bound = int(n)
         self._saw_delete = jnp.zeros((), jnp.bool_)
         self._dropped = jnp.zeros((), jnp.bool_)
+
+
+# ---------------------------------------------------------------------------
+# General dynamic filter (comparator, both directions)
+# ---------------------------------------------------------------------------
+
+_CMP = {
+    ">": lambda v, rv: v > rv,
+    ">=": lambda v, rv: v >= rv,
+    "<": lambda v, rv: v < rv,
+    "<=": lambda v, rv: v <= rv,
+}
+
+
+@partial(
+    jax.jit,
+    static_argnames=("op", "pk", "names", "value_col"),
+    donate_argnums=(0, 1, 2, 3),
+)
+def _dyn_left_step(
+    table, rows, passing, sdirty, chunk, rv, rv_valid, op, pk, names,
+    value_col,
+):
+    """Store the left chunk's rows and pass through the comparator
+    against the CURRENT right value (right moves apply at the barrier,
+    dynamic_filter.rs semantics, so cmp(value, rv) == the row's emitted
+    status for every stored row)."""
+    keys = tuple(chunk.col(k) for k in pk)
+    signs = chunk.effective_signs()
+    active = chunk.valid & (signs != 0)
+    table, slots, _, _ = lookup_or_insert(table, keys, active)
+    dropped = jnp.any(active & (slots < 0))
+    idx = jnp.where(active, slots, table.capacity)
+    rows = {
+        n: rows[n].at[idx].set(chunk.col(n), mode="drop") for n in names
+    }
+    table = set_live(table, jnp.where(active, slots, -1), signs > 0)
+    sdirty = sdirty.at[idx].set(True, mode="drop")
+    ok = chunk.valid & rv_valid & _CMP[op](chunk.col(value_col), rv)
+    passing = passing.at[idx].set(ok & (signs > 0), mode="drop")
+    return table, rows, passing, sdirty, chunk.mask(ok), dropped
+
+
+@partial(jax.jit, static_argnames=("op", "value_col"), donate_argnums=(2,))
+def _dyn_rv_diff(table, rows, passing, rv, rv_valid, op, value_col):
+    """The right value moved: recompute the pass set; rows whose status
+    flipped are the emission delta (promotions AND retractions — both
+    directions of movement)."""
+    mask_new = table.live & rv_valid & _CMP[op](rows[value_col], rv)
+    changed = mask_new != passing
+    return mask_new, changed
+
+
+class DynamicFilterExecutor(Executor, Checkpointable):
+    """General dynamic filter (dynamic_filter.rs:40): emits left rows
+    satisfying ``value_col <op> right_value`` where the right side is a
+    1-row change stream (e.g. a SimpleAgg MAX). Right moves apply at
+    the barrier and re-emit/retract previously filtered/passed rows
+    from the device row store — BOTH directions, full retraction."""
+
+    def __init__(
+        self,
+        value_col: str,
+        op: str,
+        pk: Sequence[str],
+        schema_dtypes: Dict[str, object],
+        capacity: int = 1 << 14,
+        table_id: str = "dynfilter_general",
+    ):
+        if op not in _CMP:
+            raise ValueError(f"unsupported comparator {op!r}")
+        self.op = op
+        self.value_col = value_col
+        self.pk = tuple(pk)
+        self.names = tuple(sorted(schema_dtypes))
+        self._dtypes = {n: jnp.dtype(schema_dtypes[n]) for n in self.names}
+        self.table = HashTable.create(
+            capacity, tuple(self._dtypes[k] for k in self.pk)
+        )
+        self.rows = {
+            n: jnp.zeros(capacity, self._dtypes[n]) for n in self.names
+        }
+        self.passing = jnp.zeros(capacity, jnp.bool_)
+        self.sdirty = jnp.zeros(capacity, jnp.bool_)
+        self.stored = jnp.zeros(capacity, jnp.bool_)
+        vd = self._dtypes[self.value_col]
+        self.rv = jnp.zeros((), vd)
+        self.rv_valid = jnp.zeros((), jnp.bool_)
+        self._staged_rv = None  # (device value, device valid) pending
+        self._rv_dirty = True  # first checkpoint must persist the rv
+        self.table_id = table_id
+        self._bound = 0
+        self._dropped = jnp.zeros((), jnp.bool_)
+
+    # -- left input -------------------------------------------------------
+    def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
+        return self.apply_left(chunk)
+
+    def apply_left(self, chunk: StreamChunk) -> List[StreamChunk]:
+        for c in self.pk + (self.value_col,):
+            if c in chunk.nulls:
+                raise ValueError(
+                    f"dynamic filter column {c!r} cannot be NULL"
+                )
+        self._maybe_grow(chunk.capacity)
+        self._bound += chunk.capacity
+        (
+            self.table,
+            self.rows,
+            self.passing,
+            self.sdirty,
+            out,
+            dropped,
+        ) = _dyn_left_step(
+            self.table,
+            self.rows,
+            self.passing,
+            self.sdirty,
+            chunk,
+            self.rv,
+            self.rv_valid,
+            self.op,
+            self.pk,
+            self.names,
+            self.value_col,
+        )
+        self._dropped = self._dropped | dropped
+        return [out]
+
+    # -- right input (1-row change stream) --------------------------------
+    def apply_right(self, chunk: StreamChunk) -> List[StreamChunk]:
+        signs = chunk.effective_signs()
+        ins = chunk.valid & (signs > 0)
+        dels = chunk.valid & (signs < 0)
+        pos = jnp.arange(chunk.capacity, dtype=jnp.int32)
+        last_ins = jnp.max(jnp.where(ins, pos, -1))
+        has_ins = last_ins >= 0
+        v = chunk.col(self.value_col)[jnp.maximum(last_ins, 0)]
+        if self._staged_rv is None:
+            prev_v, prev_valid = self.rv, self.rv_valid
+        else:
+            prev_v, prev_valid = self._staged_rv
+        # an insert replaces the value; a delete-only chunk clears it
+        # (the aggregate retracted its single row)
+        new_v = jnp.where(has_ins, v.astype(self.rv.dtype), prev_v)
+        new_valid = jnp.where(
+            has_ins, True, prev_valid & ~jnp.any(dels)
+        )
+        self._staged_rv = (new_v, new_valid)
+        return []
+
+    def _maybe_grow(self, incoming: int):
+        cap = self.table.capacity
+        if self._bound + incoming <= cap * GROW_AT:
+            return
+        claimed, survivors = read_scalars(
+            self.table.occupancy(),
+            jnp.sum((self.table.live | self.sdirty).astype(jnp.int32)),
+        )
+        new_cap = plan_rehash(cap, incoming, claimed, survivors, GROW_AT)
+        if new_cap is not None:
+            keep = self.table.live | self.sdirty
+            new = HashTable.create(
+                new_cap, tuple(k.dtype for k in self.table.keys)
+            )
+            new, slots, _, _ = lookup_or_insert(new, self.table.keys, keep)
+            new = set_live(
+                new, jnp.where(keep, slots, -1), self.table.live
+            )
+            idx = jnp.where(keep, slots, new_cap)
+
+            def move(a):
+                return (
+                    jnp.zeros(new_cap, a.dtype).at[idx].set(a, mode="drop")
+                )
+
+            self.rows = {n: move(a) for n, a in self.rows.items()}
+            self.passing = move(self.passing)
+            self.sdirty = move(self.sdirty)
+            self.stored = move(self.stored)
+            self.table = new
+            claimed = int(self.table.occupancy())
+        self._bound = claimed
+
+    def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
+        if bool(self._dropped):
+            raise RuntimeError(
+                "dynamic filter row store overflowed; grow capacity"
+            )
+        if self._staged_rv is None:
+            return []
+        self.rv, self.rv_valid = self._staged_rv
+        self._staged_rv = None
+        self._rv_dirty = True
+        mask_new, changed = _dyn_rv_diff(
+            self.table,
+            self.rows,
+            self.passing,
+            self.rv,
+            self.rv_valid,
+            self.op,
+            self.value_col,
+        )
+        self.passing = mask_new
+        # flipped rows must re-stage: a checkpoint persisting the new
+        # rv with the OLD pass flags would double-retract (or lose)
+        # rows after recovery when the rv moves again
+        self.sdirty = self.sdirty | changed
+        sel = np.flatnonzero(np.asarray(changed))
+        if not len(sel):
+            return []
+        lanes = {n: self.rows[n] for n in self.names}
+        lanes["__now__"] = mask_new
+        pulled = pull_rows(lanes, sel)
+        from risingwave_tpu.types import Op
+
+        now = np.asarray(pulled["__now__"])
+        outs = []
+        for promote in (False, True):
+            m = now == promote
+            if not m.any():
+                continue
+            cols = {
+                n: np.asarray(pulled[n])[m].astype(self._dtypes[n])
+                for n in self.names
+            }
+            outs.append(
+                StreamChunk.from_numpy(
+                    cols,
+                    max(2, int(m.sum())),
+                    ops=np.full(
+                        int(m.sum()),
+                        int(Op.INSERT if promote else Op.DELETE),
+                        np.int32,
+                    ),
+                )
+            )
+        return outs
+
+    # -- checkpoint/restore ----------------------------------------------
+    def checkpoint_table_ids(self):
+        return [f"{self.table_id}.rows", f"{self.table_id}.rv"]
+
+    def checkpoint_delta(self):
+        out = []
+        sdirty = np.asarray(self.sdirty)
+        if sdirty.any():
+            upsert, tomb, sel = stage_marks(
+                sdirty, np.asarray(self.table.live), np.asarray(self.stored)
+            )
+            lanes = {
+                f"k{i}": lane for i, lane in enumerate(self.table.keys)
+            }
+            key_names = tuple(lanes)
+            for n in self.names:
+                lanes[f"r_{n}"] = self.rows[n]
+            lanes["pass"] = self.passing
+            pulled = pull_rows(lanes, sel)
+            keys = {k: pulled[k] for k in key_names}
+            vals = {k: v for k, v in pulled.items() if k not in key_names}
+            self.stored = (
+                self.stored | jnp.asarray(upsert)
+            ) & ~jnp.asarray(tomb)
+            self.sdirty = jnp.zeros_like(self.sdirty)
+            out.append(
+                StateDelta(
+                    f"{self.table_id}.rows", keys, vals, tomb[sel], key_names
+                )
+            )
+        if self._rv_dirty:
+            # the right value: a 1-row table
+            rv, rvv = np.asarray(self.rv), bool(self.rv_valid)
+            out.append(
+                StateDelta(
+                    f"{self.table_id}.rv",
+                    {"k0": np.zeros(1, np.int64)},
+                    {"rv": rv[None], "rv_valid": np.asarray([rvv])},
+                    np.zeros(1, bool),
+                    ("k0",),
+                )
+            )
+            self._rv_dirty = False
+        return out
+
+    def restore_state(self, table_id, key_cols, value_cols):
+        if table_id.endswith(".rv"):
+            if key_cols:
+                self.rv = jnp.asarray(
+                    value_cols["rv"][0].astype(self.rv.dtype)
+                )
+                self.rv_valid = jnp.asarray(bool(value_cols["rv_valid"][0]))
+            return
+        n = len(next(iter(key_cols.values()))) if key_cols else 0
+        cap = grow_pow2(n, self.table.capacity, GROW_AT)
+        key_dtypes = tuple(k.dtype for k in self.table.keys)
+        table = HashTable.create(cap, key_dtypes)
+        rows = {nm: jnp.zeros(cap, self._dtypes[nm]) for nm in self.names}
+        self.passing = jnp.zeros(cap, jnp.bool_)
+        self.sdirty = jnp.zeros(cap, jnp.bool_)
+        self.stored = jnp.zeros(cap, jnp.bool_)
+        if n:
+            lanes = tuple(
+                jnp.asarray(np.asarray(key_cols[f"k{i}"], dtype=d))
+                for i, d in enumerate(key_dtypes)
+            )
+            table, slots, _, _ = lookup_or_insert(
+                table, lanes, jnp.ones(n, jnp.bool_)
+            )
+            table = set_live(table, slots, True)
+            rows = {
+                nm: a.at[slots].set(
+                    jnp.asarray(
+                        np.asarray(value_cols[f"r_{nm}"]).astype(a.dtype)
+                    )
+                )
+                for nm, a in rows.items()
+            }
+            self.passing = self.passing.at[slots].set(
+                jnp.asarray(value_cols["pass"].astype(bool))
+            )
+            self.stored = self.stored.at[slots].set(True)
+        self.table = table
+        self.rows = rows
+        self._bound = int(n)
+        self._dropped = jnp.zeros((), jnp.bool_)
+        self._staged_rv = None

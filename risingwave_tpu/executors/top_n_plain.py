@@ -57,13 +57,10 @@ def _upsert_step(table, rows, sdirty, chunk: StreamChunk, pk, names):
     return table, rows, sdirty, dropped
 
 
-@partial(jax.jit, static_argnames=("n", "desc"))
-def _rank_top(table: HashTable, order_lane, n: int, desc: bool):
-    """Indices of the top-n live rows by (order, pk-lanes) total order.
-    The order lane maps to an unsigned memcomparable key (the same
+def _order_key_u64(v, desc: bool):
+    """Map an order lane to an unsigned memcomparable key (the same
     transform the SST sort uses) so int/float/asc/desc all reduce to
     one uint64 comparison."""
-    v = order_lane
     if jnp.issubdtype(v.dtype, jnp.floating):
         from risingwave_tpu.ops.agg import _float_to_order_key
 
@@ -74,14 +71,21 @@ def _rank_top(table: HashTable, order_lane, n: int, desc: bool):
         key = jax.lax.bitcast_convert_type(
             v.astype(jnp.int64), jnp.uint64
         ) ^ (jnp.uint64(1) << jnp.uint64(63))
-    if desc:
-        key = ~key
-    # dead rows rank last; pk lanes tiebreak for determinism
-    key = jnp.where(table.live, key, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+    return ~key if desc else key
+
+
+@partial(jax.jit, static_argnames=("n", "desc"))
+def _rank_top(table: HashTable, order_lane, n: int, desc: bool):
+    """Indices of the top-n live rows by (order, pk-lanes) total order.
+    Liveness is its own LEADING sort key: a dead-row sentinel value
+    would collide with a legitimate INT64 extreme order value and let
+    dead slots displace live rows."""
+    live_last = (~table.live).astype(jnp.int32)
+    key = _order_key_u64(order_lane, desc)
     sort_ops = jax.lax.sort(
-        (key,) + tuple(k for k in table.keys)
+        (live_last, key) + tuple(k for k in table.keys)
         + (jnp.arange(table.capacity, dtype=jnp.int32),),
-        num_keys=1 + len(table.keys),
+        num_keys=2 + len(table.keys),
     )
     idx = sort_ops[-1][:n]
     alive = table.live[idx]
@@ -265,3 +269,356 @@ class TopNExecutor(Executor, Checkpointable):
             self._emitted[pkv] = tuple(
                 pulled[nm][i].item() for nm in self.names
             )
+
+
+# ---------------------------------------------------------------------------
+# Retractable GroupTopN
+# ---------------------------------------------------------------------------
+
+
+@partial(
+    jax.jit, static_argnames=("pk", "names"), donate_argnums=(0, 1, 2, 3)
+)
+def _upsert_step_ed(table, rows, sdirty, epoch_dirty, chunk, pk, names):
+    """_upsert_step that also marks epoch_dirty (cleared per barrier)
+    in the same scatter — one probe, two mark lanes."""
+    keys = tuple(chunk.col(k) for k in pk)
+    signs = chunk.effective_signs()
+    active = chunk.valid & (signs != 0)
+    table, slots, _, _ = lookup_or_insert(table, keys, active)
+    dropped = jnp.any(active & (slots < 0))
+    idx = jnp.where(active, slots, table.capacity)
+    rows = {
+        n: rows[n].at[idx].set(chunk.col(n), mode="drop") for n in names
+    }
+    table = set_live(table, jnp.where(active, slots, -1), signs > 0)
+    sdirty = sdirty.at[idx].set(True, mode="drop")
+    epoch_dirty = epoch_dirty.at[idx].set(True, mode="drop")
+    return table, rows, sdirty, epoch_dirty, dropped
+
+
+@partial(
+    jax.jit,
+    static_argnames=("k", "desc", "group_names", "order_col"),
+    donate_argnums=(),
+)
+def _group_topk_mask(
+    table: HashTable,
+    rows: Dict[str, jnp.ndarray],
+    epoch_dirty: jnp.ndarray,
+    k: int,
+    desc: bool,
+    group_names: Tuple[str, ...],
+    order_col: str,
+):
+    """Per-slot masks: is the row in its group's current top-k, and
+    does its group contain an epoch-dirty row (so its top-k must be
+    re-pulled)? One device sort over (group lanes, order key, pk)."""
+    cap = table.capacity
+    # liveness as its own sort key within the group (a dead-row
+    # sentinel would collide with INT64-extreme order values)
+    live_last = (~table.live).astype(jnp.int32)
+    okey = _order_key_u64(rows[order_col], desc)
+    glanes = tuple(rows[g] for g in group_names)
+    sort_in = glanes + (live_last, okey) + tuple(table.keys) + (
+        jnp.arange(cap, dtype=jnp.int32),
+    )
+    sorted_all = jax.lax.sort(
+        sort_in, num_keys=len(glanes) + 2 + len(table.keys)
+    )
+    slot_s = sorted_all[-1]
+    live_s = table.live[slot_s]
+    dirty_s = epoch_dirty[slot_s]
+    boundary = jnp.zeros(cap, jnp.bool_).at[0].set(True)
+    for lane in sorted_all[: len(glanes)]:
+        boundary = boundary | jnp.concatenate(
+            [jnp.ones(1, jnp.bool_), lane[1:] != lane[:-1]]
+        )
+    gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    seg_start = jax.ops.segment_max(
+        jnp.where(boundary, idx, 0), gid, num_segments=cap
+    )[gid]
+    in_topk_s = live_s & ((idx - seg_start) < k)
+    gdirty_s = (
+        jax.ops.segment_max(
+            dirty_s.astype(jnp.int32), gid, num_segments=cap
+        )[gid]
+        > 0
+    )
+    in_topk = jnp.zeros(cap, jnp.bool_).at[slot_s].set(in_topk_s)
+    gdirty = jnp.zeros(cap, jnp.bool_).at[slot_s].set(gdirty_s)
+    return in_topk, gdirty
+
+
+class RetractableGroupTopNExecutor(Executor, Checkpointable):
+    """GROUP BY g ORDER BY o LIMIT k with full retraction support
+    (group_top_n.rs:63): deletes/updates crossing a group's top-k
+    boundary re-emit the displaced/promoted rows exactly.
+
+    TPU re-design: ONE pk-keyed row store holds every input row; the
+    barrier ranks rows within groups on device (one fused sort +
+    segmented scan), pulls only the top-k rows of groups TOUCHED this
+    epoch, and diffs them against a per-group host mirror of what was
+    emitted — per-barrier host traffic is O(changed groups x k), never
+    O(state)."""
+
+    def __init__(
+        self,
+        group_by: Sequence[str],
+        order_col: str,
+        limit: int,
+        pk: Sequence[str],
+        schema_dtypes: Dict[str, object],
+        desc: bool = False,
+        capacity: int = 1 << 14,
+        window_key: Optional[Tuple[str, int]] = None,
+        table_id: str = "group_top_n",
+    ):
+        self.group_by = tuple(group_by)
+        self.order_col = order_col
+        self.limit = int(limit)
+        self.desc = desc
+        self.pk = tuple(pk)
+        # row identity INCLUDES the group (group_top_n.rs keys state by
+        # group key + pk): a row "moving" groups is two distinct rows,
+        # so the old group's retraction is never lost
+        self.store_keys = self.group_by + tuple(
+            c for c in self.pk if c not in self.group_by
+        )
+        self.names = tuple(sorted(schema_dtypes))
+        self._dtypes = {n: jnp.dtype(schema_dtypes[n]) for n in self.names}
+        self.table = HashTable.create(
+            capacity, tuple(self._dtypes[c] for c in self.store_keys)
+        )
+        self.rows = {
+            n: jnp.zeros(capacity, self._dtypes[n]) for n in self.names
+        }
+        self.sdirty = jnp.zeros(capacity, jnp.bool_)
+        self.stored = jnp.zeros(capacity, jnp.bool_)
+        self.epoch_dirty = jnp.zeros(capacity, jnp.bool_)
+        if window_key is not None and window_key[0] not in self.group_by:
+            raise ValueError(
+                "window_key must be one of the group columns (a closed "
+                "window bounds its groups)"
+            )
+        self.window_key = window_key
+        self.table_id = table_id
+        self._bound = 0
+        self._dropped = jnp.zeros((), jnp.bool_)
+        # group tuple -> {pk tuple -> full row tuple} of EMITTED rows
+        self._emitted: Dict[Tuple, Dict[Tuple, Tuple]] = {}
+
+    def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
+        for c in self.pk + self.group_by + (self.order_col,):
+            if c in chunk.nulls:
+                raise ValueError(f"GroupTopN key column {c!r} cannot be NULL")
+        self._maybe_grow(chunk.capacity)
+        self._bound += chunk.capacity
+        (
+            self.table,
+            self.rows,
+            self.sdirty,
+            self.epoch_dirty,
+            dropped,
+        ) = _upsert_step_ed(
+            self.table,
+            self.rows,
+            self.sdirty,
+            self.epoch_dirty,
+            chunk,
+            self.store_keys,
+            self.names,
+        )
+        self._dropped = self._dropped | dropped
+        return []
+
+    def _maybe_grow(self, incoming: int):
+        cap = self.table.capacity
+        if self._bound + incoming <= cap * GROW_AT:
+            return
+        from risingwave_tpu.ops.hash_table import read_scalars
+
+        claimed, survivors = read_scalars(
+            self.table.occupancy(),
+            jnp.sum((self.table.live | self.sdirty).astype(jnp.int32)),
+        )
+        new_cap = plan_rehash(cap, incoming, claimed, survivors, GROW_AT)
+        if new_cap is not None:
+            keep = self.table.live | self.sdirty
+            new = HashTable.create(
+                new_cap, tuple(x.dtype for x in self.table.keys)
+            )
+            new, slots, _, _ = lookup_or_insert(new, self.table.keys, keep)
+            new = set_live(new, jnp.where(keep, slots, -1), self.table.live)
+            idx = jnp.where(keep, slots, new_cap)
+
+            def move(a):
+                return (
+                    jnp.zeros(new_cap, a.dtype).at[idx].set(a, mode="drop")
+                )
+
+            self.rows = {n: move(a) for n, a in self.rows.items()}
+            self.sdirty = move(self.sdirty)
+            self.stored = move(self.stored)
+            self.epoch_dirty = move(self.epoch_dirty)
+            self.table = new
+            claimed = int(self.table.occupancy())
+        self._bound = claimed
+
+    def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
+        if bool(self._dropped):
+            raise RuntimeError("GroupTopN row store overflowed; grow capacity")
+        if not bool(jnp.any(self.epoch_dirty)):
+            return []
+        in_topk, gdirty = _group_topk_mask(
+            self.table,
+            self.rows,
+            self.epoch_dirty,
+            self.limit,
+            self.desc,
+            self.group_by,
+            self.order_col,
+        )
+        # pull the top-k of touched groups PLUS the epoch-dirty rows
+        # themselves (deleted rows name fully-emptied groups)
+        mask = np.asarray((gdirty & in_topk) | self.epoch_dirty)
+        sel = np.flatnonzero(mask)
+        lanes = {n: self.rows[n] for n in self.names}
+        lanes["__topk__"] = in_topk
+        lanes["__live__"] = self.table.live
+        pulled = pull_rows(lanes, sel)
+        n_sel = len(sel)
+        new_top: Dict[Tuple, Dict[Tuple, Tuple]] = {}
+        changed: set = set()
+        for i in range(n_sel):
+            g = tuple(pulled[c][i].item() for c in self.group_by)
+            changed.add(g)
+            if pulled["__topk__"][i] and pulled["__live__"][i]:
+                pkv = tuple(pulled[c][i].item() for c in self.pk)
+                new_top.setdefault(g, {})[pkv] = tuple(
+                    pulled[n][i].item() for n in self.names
+                )
+        dels, ins = [], []
+        for g in changed:
+            old = self._emitted.get(g, {})
+            new = new_top.get(g, {})
+            dels.extend(v for p, v in old.items() if new.get(p) != v)
+            ins.extend(v for p, v in new.items() if old.get(p) != v)
+            if new:
+                self._emitted[g] = new
+            else:
+                self._emitted.pop(g, None)
+        self.epoch_dirty = jnp.zeros_like(self.epoch_dirty)
+        outs = []
+        for vals, op in ((dels, Op.DELETE), (ins, Op.INSERT)):
+            if not vals:
+                continue
+            cols = {
+                n: np.asarray([r[j] for r in vals], self._dtypes[n])
+                for j, n in enumerate(self.names)
+            }
+            outs.append(
+                StreamChunk.from_numpy(
+                    cols,
+                    max(2, len(vals)),
+                    ops=np.full(len(vals), int(op), np.int32),
+                )
+            )
+        return outs
+
+    def on_watermark(self, watermark):
+        """Window-bounded groups expire silently below the watermark
+        (EOWC-final: the MV keeps the closed window's final top-k)."""
+        if self.window_key is None or watermark.column != self.window_key[0]:
+            return watermark, []
+        cutoff = jnp.asarray(
+            watermark.value - self.window_key[1], jnp.int64
+        )
+        lane = self.rows[self.window_key[0]]
+        expired = self.table.live & (lane < cutoff)
+        slots = jnp.where(
+            expired, jnp.arange(self.table.capacity, dtype=jnp.int32), -1
+        )
+        self.table = set_live(self.table, slots, False)
+        self.sdirty = self.sdirty | expired
+        # closed groups leave the mirror without emitting retractions
+        gi = self.group_by.index(self.window_key[0])
+        cut = int(watermark.value - self.window_key[1])
+        for g in [g for g in self._emitted if g[gi] < cut]:
+            del self._emitted[g]
+        return watermark, []
+
+    # -- checkpoint/restore (pk-keyed row store, plain-TopN layout) -------
+    def checkpoint_delta(self) -> List[StateDelta]:
+        sdirty = np.asarray(self.sdirty)
+        if not sdirty.any():
+            return []
+        upsert, tomb, sel = stage_marks(
+            sdirty, np.asarray(self.table.live), np.asarray(self.stored)
+        )
+        lanes = {f"k{i}": lane for i, lane in enumerate(self.table.keys)}
+        key_names = tuple(lanes)
+        for n in self.names:
+            lanes[f"r_{n}"] = self.rows[n]
+        pulled = pull_rows(lanes, sel)
+        keys = {x: pulled[x] for x in key_names}
+        vals = {x: v for x, v in pulled.items() if x not in key_names}
+        self.stored = (self.stored | jnp.asarray(upsert)) & ~jnp.asarray(tomb)
+        self.sdirty = jnp.zeros_like(self.sdirty)
+        return [StateDelta(self.table_id, keys, vals, tomb[sel], key_names)]
+
+    def restore_state(self, table_id, key_cols, value_cols) -> None:
+        n = len(next(iter(key_cols.values()))) if key_cols else 0
+        cap = grow_pow2(n, self.table.capacity, GROW_AT)
+        key_dtypes = tuple(x.dtype for x in self.table.keys)
+        table = HashTable.create(cap, key_dtypes)
+        rows = {nm: jnp.zeros(cap, self._dtypes[nm]) for nm in self.names}
+        self.sdirty = jnp.zeros(cap, jnp.bool_)
+        self.stored = jnp.zeros(cap, jnp.bool_)
+        self.epoch_dirty = jnp.zeros(cap, jnp.bool_)
+        if n:
+            lanes = tuple(
+                jnp.asarray(np.asarray(key_cols[f"k{i}"], dtype=d))
+                for i, d in enumerate(key_dtypes)
+            )
+            table, slots, _, _ = lookup_or_insert(
+                table, lanes, jnp.ones(n, jnp.bool_)
+            )
+            table = set_live(table, slots, True)
+            rows = {
+                nm: a.at[slots].set(
+                    jnp.asarray(
+                        np.asarray(value_cols[f"r_{nm}"]).astype(a.dtype)
+                    )
+                )
+                for nm, a in rows.items()
+            }
+            self.stored = self.stored.at[slots].set(True)
+        self.table = table
+        self.rows = rows
+        self._bound = int(n)
+        self._dropped = jnp.zeros((), jnp.bool_)
+        # rebuild the emitted mirror: every group's current top-k (the
+        # downstream MV restored to exactly this view)
+        self._emitted = {}
+        if n:
+            in_topk, _ = _group_topk_mask(
+                self.table,
+                self.rows,
+                jnp.ones(cap, jnp.bool_),
+                self.limit,
+                self.desc,
+                self.group_by,
+                self.order_col,
+            )
+            sel = np.flatnonzero(np.asarray(in_topk))
+            pulled = pull_rows(
+                {nm: self.rows[nm] for nm in self.names}, sel
+            )
+            for i in range(len(sel)):
+                g = tuple(pulled[c][i].item() for c in self.group_by)
+                pkv = tuple(pulled[c][i].item() for c in self.pk)
+                self._emitted.setdefault(g, {})[pkv] = tuple(
+                    pulled[nm][i].item() for nm in self.names
+                )
