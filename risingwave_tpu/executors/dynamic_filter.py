@@ -381,17 +381,21 @@ class DynamicFilterExecutor(Executor, Checkpointable):
         dels = chunk.valid & (signs < 0)
         pos = jnp.arange(chunk.capacity, dtype=jnp.int32)
         last_ins = jnp.max(jnp.where(ins, pos, -1))
+        last_del = jnp.max(jnp.where(dels, pos, -1))
         has_ins = last_ins >= 0
         v = chunk.col(self.value_col)[jnp.maximum(last_ins, 0)]
         if self._staged_rv is None:
             prev_v, prev_valid = self.rv, self.rv_valid
         else:
             prev_v, prev_valid = self._staged_rv
-        # an insert replaces the value; a delete-only chunk clears it
-        # (the aggregate retracted its single row)
+        # rows apply IN ORDER (dynamic_filter.rs): the LAST op decides
+        # validity — an insert followed by its own retraction nets out
+        # to no right value
         new_v = jnp.where(has_ins, v.astype(self.rv.dtype), prev_v)
         new_valid = jnp.where(
-            has_ins, True, prev_valid & ~jnp.any(dels)
+            last_ins > last_del,
+            True,
+            jnp.where(last_del > last_ins, False, prev_valid),
         )
         self._staged_rv = (new_v, new_valid)
         return []

@@ -467,9 +467,16 @@ class RetractableGroupTopNExecutor(Executor, Checkpointable):
         self._bound = claimed
 
     def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
-        if bool(self._dropped):
+        from risingwave_tpu.ops.hash_table import read_scalars
+
+        # ONE packed read for the latch + the dirty short-circuit
+        # (tunneled-TPU round-trips dominate)
+        dropped, any_dirty = read_scalars(
+            self._dropped, jnp.any(self.epoch_dirty)
+        )
+        if dropped:
             raise RuntimeError("GroupTopN row store overflowed; grow capacity")
-        if not bool(jnp.any(self.epoch_dirty)):
+        if not any_dirty:
             return []
         in_topk, gdirty = _group_topk_mask(
             self.table,

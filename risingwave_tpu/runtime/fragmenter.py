@@ -276,11 +276,24 @@ class GraphPipeline:
         out_fragment: str,
         ckpt_executors: Sequence[object],
     ):
-        self.graph = GraphRuntime(specs).start()
+        self._specs = list(specs)
+        self.graph = GraphRuntime(self._specs).start()
         self._sources = dict(source_map)
         self._out = out_fragment
         self._executors = list(ckpt_executors)
         self.__dict__["_epoch_val"] = 0
+
+    def rebuild(self) -> None:
+        """Replace dead actors: fresh threads + channels around the
+        SAME executor instances (their state is restored separately by
+        the runtime's recovery). The watchdog calls this before
+        recover() when a graph-backed fragment fails."""
+        try:
+            self.graph.stop(timeout=1.0)
+        except BaseException:
+            pass  # a wedged/failed graph cannot block the rebuild
+        self.graph = GraphRuntime(self._specs).start()
+        self.graph._epoch = self._epoch
 
     # the runtime assigns p._epoch on registration/recovery; keep the
     # actor graph's barrier clock in lockstep so injected epochs stay

@@ -73,7 +73,15 @@ class StreamingRuntime:
         async_checkpoint: bool = True,
         compact_at: int = 8,
         memory_budget_bytes: Optional[int] = None,
+        auto_recover: bool = False,
     ):
+        # failure detection + self-healing (barrier/mod.rs:676-710 +
+        # recovery.rs:353): a poisoned epoch or dead actor surfacing at
+        # the barrier triggers recovery WITHOUT caller intervention —
+        # rebuild actor graphs, restore state from the last committed
+        # epoch, roll source offsets back so the pump replays
+        self.auto_recover = auto_recover
+        self.auto_recoveries = 0
         # state >> HBM control (the reference's LRU memory controller,
         # src/compute/src/memory/controller.rs role): when accounted
         # device state exceeds the budget, fully-durable groups are
@@ -251,9 +259,48 @@ class StreamingRuntime:
     def barrier(self) -> Dict[str, List[StreamChunk]]:
         """Inject one barrier into every fragment; commit a checkpoint
         every ``checkpoint_frequency``-th barrier. Returns each
-        fragment's emitted chunks."""
+        fragment's emitted chunks.
+
+        With ``auto_recover``, a failure here (poisoned epoch, dead
+        actor, commit-lane error) recovers in place and returns {} —
+        the failed epoch is abandoned, offsets roll back, and the
+        caller's next pump replays it (no manual recover())."""
         with self.lock:
-            return self._barrier_locked()
+            try:
+                outs = self._barrier_locked()
+                self._consecutive_recoveries = 0
+                return outs
+            except (KeyboardInterrupt, SystemExit):
+                raise  # never convert an operator stop into a recovery
+            except Exception as e:
+                if not self.auto_recover or self.mgr is None:
+                    raise
+                self._auto_recover(e)
+                return {}
+
+    def _auto_recover(self, cause: Exception) -> None:
+        # a DETERMINISTIC failure (e.g. a capacity overflow) would
+        # recover-replay-fail forever: after a few consecutive failed
+        # epochs, surface the cause instead
+        self._consecutive_recoveries = (
+            getattr(self, "_consecutive_recoveries", 0) + 1
+        )
+        self.last_failure = cause
+        REGISTRY.counter("auto_recoveries_total").inc()
+        self.auto_recoveries += 1
+        if self._consecutive_recoveries > 3:
+            raise RuntimeError(
+                "auto-recovery failed 3 consecutive epochs — the fault "
+                "is deterministic, not transient"
+            ) from cause
+        # dead actor threads never come back: rebuild graph-backed
+        # fragments (fresh actors/channels around the same executors)
+        # BEFORE restoring executor state
+        for p in self.fragments.values():
+            fn = getattr(p, "rebuild", None)
+            if fn is not None:
+                fn()
+        self.recover()
 
     def _barrier_locked(self) -> Dict[str, List[StreamChunk]]:
         t0 = time.perf_counter()

@@ -29,6 +29,14 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "smoke: fast CI-signal subset — `pytest -m smoke` runs <2 min "
+        "(VERDICT r3 #10)",
+    )
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

@@ -1,0 +1,168 @@
+"""Failure detection + self-healing (VERDICT r3 #9): a poisoned epoch
+or dead actor thread triggers recovery INSIDE the runtime — no caller
+ever calls recover().
+
+Reference: meta failure detection + global recovery,
+src/meta/src/barrier/mod.rs:676-710 + barrier/recovery.rs:353.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.connectors.framework import (
+    FileLogSource,
+    GenericSourceExecutor,
+    JsonParser,
+)
+from risingwave_tpu.executors.base import Executor
+from risingwave_tpu.executors.hash_agg import HashAggExecutor
+from risingwave_tpu.executors.materialize import MaterializeExecutor
+from risingwave_tpu.ops.agg import AggCall
+from risingwave_tpu.runtime import Pipeline
+from risingwave_tpu.runtime.fragmenter import GraphPipeline
+from risingwave_tpu.runtime.graph import FragmentSpec
+from risingwave_tpu.runtime.runtime import StreamingRuntime
+from risingwave_tpu.storage.object_store import MemObjectStore
+from risingwave_tpu.types import DataType, Schema
+
+
+import pytest as _pytest
+
+pytestmark = _pytest.mark.smoke
+
+
+class PoisonOnce(Executor):
+    """Raises at the first armed barrier, then behaves forever after
+    (the transient-fault model of the recovery suites)."""
+
+    def __init__(self):
+        self.armed = False
+        self.fired = 0
+
+    def apply(self, chunk):
+        return [chunk]
+
+    def on_barrier(self, b):
+        if self.armed:
+            self.armed = False
+            self.fired += 1
+            raise RuntimeError("poisoned epoch (injected)")
+        return []
+
+
+def _agg_chain(poison, table_id):
+    agg = HashAggExecutor(
+        group_keys=("k",),
+        calls=(AggCall("sum", "v", "s"), AggCall("count_star", None, "c")),
+        schema_dtypes={"k": jnp.int64, "v": jnp.int64},
+        capacity=1 << 8,
+        table_id=f"{table_id}.agg",
+    )
+    mview = MaterializeExecutor(
+        pk=("k",), columns=("s", "c"), table_id=f"{table_id}.mview"
+    )
+    return [poison, agg, mview], mview
+
+
+def test_poisoned_epoch_self_heals_with_source_replay(tmp_path):
+    """Source-backed MV: the poisoned epoch's rows are NOT lost — the
+    watchdog recovers, offsets roll back, and the pump's re-read
+    replays them. No recover() call anywhere in this test."""
+    d = str(tmp_path)
+    schema = Schema([("k", DataType.INT64), ("v", DataType.INT64)])
+    src = GenericSourceExecutor(
+        FileLogSource(d), JsonParser(schema), table_id="src"
+    )
+    rt = StreamingRuntime(
+        MemObjectStore(), async_checkpoint=False, auto_recover=True
+    )
+    poison = PoisonOnce()
+    chain, mview = _agg_chain(poison, "mv")
+    rt.register("mv", Pipeline(chain))
+    rt.register_state(src)
+
+    rng = np.random.default_rng(31)
+    all_rows = []
+    for epoch in range(6):
+        rows = [
+            {"k": int(rng.integers(0, 4)), "v": int(rng.integers(0, 50))}
+            for _ in range(int(rng.integers(3, 10)))
+        ]
+        all_rows.extend(rows)
+        FileLogSource.append(
+            d, 0, [f'{{"k": {r["k"]}, "v": {r["v"]}}}' for r in rows]
+        )
+        if epoch == 3:
+            poison.armed = True
+        # the pump: poll + push + barrier until the epoch commits (a
+        # recovered epoch rolls offsets back, so re-polling replays it)
+        src.discover()  # partition-0 appears on the first append
+        for _attempt in range(4):
+            for c in src.poll(64, 16):
+                rt.push("mv", c)
+            before = rt.mgr.max_committed_epoch
+            rt.barrier()
+            if rt.mgr.max_committed_epoch > before:
+                break
+        else:
+            raise AssertionError("epoch never committed")
+
+    assert rt.auto_recoveries == 1 and poison.fired == 1
+    want = {}
+    for r in all_rows:
+        s, c = want.get(r["k"], (0, 0))
+        want[r["k"]] = (s + r["v"], c + 1)
+    got = {k[0]: v for k, v in mview.snapshot().items()}
+    assert got == want
+
+
+def test_dead_actor_graph_self_heals(tmp_path):
+    """Graph-backed fragment: the poisoned barrier kills the actor
+    thread; the watchdog rebuilds the actor graph and restores state —
+    the stream continues with exact results."""
+    poison = PoisonOnce()
+    chain, mview = _agg_chain(poison, "gmv")
+    agg = chain[1]
+    gp = GraphPipeline(
+        [FragmentSpec("gmv", lambda i, ch=tuple(chain): list(ch))],
+        {"single": "gmv"},
+        "gmv",
+        [agg, mview],
+    )
+    rt = StreamingRuntime(
+        MemObjectStore(), async_checkpoint=False, auto_recover=True
+    )
+    rt.register("gmv", gp)
+
+    rng = np.random.default_rng(7)
+    want = {}
+
+    def mk_chunk():
+        n = int(rng.integers(3, 10))
+        ks = rng.integers(0, 4, n).astype(np.int64)
+        vs = rng.integers(0, 50, n).astype(np.int64)
+        return ks, vs, StreamChunk.from_numpy({"k": ks, "v": vs}, 16)
+
+    first_actor_graph = gp.graph
+    for epoch in range(6):
+        ks, vs, chunk = mk_chunk()
+        if epoch == 3:
+            poison.armed = True
+        for _attempt in range(4):
+            rt.push("gmv", chunk)
+            before = rt.mgr.max_committed_epoch
+            rt.barrier()
+            if rt.mgr.max_committed_epoch > before:
+                break
+        else:
+            raise AssertionError("epoch never committed")
+        for k, v in zip(ks.tolist(), vs.tolist()):
+            s, c = want.get(k, (0, 0))
+            want[k] = (s + v, c + 1)
+
+    assert rt.auto_recoveries == 1 and poison.fired == 1
+    assert gp.graph is not first_actor_graph  # actors were rebuilt
+    got = {k[0]: v for k, v in mview.snapshot().items()}
+    assert got == want
+    gp.close()

@@ -8,6 +8,11 @@ from risingwave_tpu.array.chunk import DataChunk, StreamChunk
 from risingwave_tpu.types import DataType, Op, Schema
 
 
+import pytest as _pytest
+
+pytestmark = _pytest.mark.smoke
+
+
 def test_roundtrip_padding():
     c = DataChunk.from_numpy({"a": np.arange(5), "b": np.ones(5) * 0.5}, capacity=8)
     assert c.capacity == 8

@@ -21,6 +21,11 @@ from risingwave_tpu.connectors.framework import (
 from risingwave_tpu.types import DataType, Schema
 
 
+import pytest as _pytest
+
+pytestmark = _pytest.mark.smoke
+
+
 def test_datagen_splits_partition_sequence_space():
     schema = Schema([("id", DataType.INT64), ("v", DataType.INT64)])
     src = GenericSourceExecutor(

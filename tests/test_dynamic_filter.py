@@ -18,6 +18,11 @@ from risingwave_tpu.types import Op
 DT = {"id": jnp.int64, "v": jnp.int64}
 
 
+import pytest as _pytest
+
+pytestmark = _pytest.mark.smoke
+
+
 def _replay(state, chunks):
     for c in chunks:
         d = c.to_numpy(with_ops=True)
@@ -151,3 +156,37 @@ def test_dynamic_filter_checkpoint_restore():
     _right(ex2, 70)
     _replay(state, ex2.on_barrier(None))
     assert state == {(5, 80)}
+
+
+def test_right_chunk_insert_then_delete_nets_to_invalid():
+    """Rows apply in order: an INSERT followed by its own DELETE in one
+    right chunk leaves NO right value — everything retracts."""
+    ex = DynamicFilterExecutor(
+        "v", ">", ("id",), DT, capacity=1 << 6, table_id="dford"
+    )
+    state = set()
+    _replay(
+        state,
+        ex.apply_left(
+            StreamChunk.from_numpy(
+                {
+                    "id": np.asarray([1, 2], np.int64),
+                    "v": np.asarray([60, 80], np.int64),
+                },
+                4,
+            )
+        ),
+    )
+    _right(ex, 50)
+    _replay(state, ex.on_barrier(None))
+    assert state == {(1, 60), (2, 80)}
+    # one chunk: INSERT 10 then DELETE 10 -> net empty right side
+    ex.apply_right(
+        StreamChunk.from_numpy(
+            {"v": np.asarray([10, 10], np.int64)},
+            4,
+            ops=np.asarray([int(Op.INSERT), int(Op.DELETE)], np.int32),
+        )
+    )
+    _replay(state, ex.on_barrier(None))
+    assert state == set()

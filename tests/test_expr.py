@@ -10,6 +10,11 @@ from risingwave_tpu.expr import Case, IsNull, TumbleStart, col, lit
 from risingwave_tpu.types import Op
 
 
+import pytest as _pytest
+
+pytestmark = _pytest.mark.smoke
+
+
 def make_chunk(**kw):
     nulls = kw.pop("nulls", None)
     n = len(next(iter(kw.values())))

@@ -10,6 +10,11 @@ import numpy as np
 from risingwave_tpu.ops import hash_table as ht
 
 
+import pytest as _pytest
+
+pytestmark = _pytest.mark.smoke
+
+
 def _mk(capacity=256, dtypes=(jnp.int32,)):
     return ht.HashTable.create(capacity, dtypes)
 

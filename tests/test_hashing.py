@@ -6,6 +6,11 @@ import numpy as np
 from risingwave_tpu.ops.hashing import VNODE_COUNT, hash128, hash_columns, vnode_of
 
 
+import pytest as _pytest
+
+pytestmark = _pytest.mark.smoke
+
+
 def test_vnode_range_and_determinism(rng):
     keys = jnp.asarray(rng.integers(0, 1 << 30, size=1000, dtype=np.int32))
     v1 = np.asarray(vnode_of([keys]))

@@ -5,6 +5,11 @@ Reference: src/stream/src/executor/over_window/general.rs:49 (the
 append-only arrival-ordered specialization)."""
 
 
+import pytest as _pytest
+
+pytestmark = _pytest.mark.smoke
+
+
 def test_running_min_max_and_lag():
     """min/max/lag window kinds vs a pandas-style oracle across chunks
     (state crosses chunk boundaries)."""

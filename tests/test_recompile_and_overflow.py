@@ -150,3 +150,47 @@ def test_int64_fingerprints_distinguish_high_bits():
     h1, h2 = hash128((jnp.asarray(keys),))
     pairs = set(zip(np.asarray(h1).tolist(), np.asarray(h2).tolist()))
     assert len(pairs) == len(keys)  # no collisions among 32 variants
+
+
+def test_bench_shape_stacked_scan_at_bench_capacity():
+    """The BENCH's exact device shapes in the suite (VERDICT r2/r3: the
+    r02 kernel-fault class only ever fired at bench scale): capacity
+    2^16 agg state fed by stacked per-epoch scans in both agg modes."""
+    import functools
+
+    from risingwave_tpu.executors.hop_window import hop_step_fn
+    from risingwave_tpu.parallel.sharded_agg import stack_chunks
+
+    q5 = build_q5_lite(capacity=1 << 16, state_cleaning=False)
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=10_000))
+    pre = functools.partial(
+        hop_step_fn,
+        ts_col="date_time",
+        size_ms=10_000,
+        slide_ms=2_000,
+        out_start="window_start",
+    )
+    total = 0
+    for mode in ("reduce", "scan"):  # both bench agg modes
+        for _ in range(2):
+            chunks = []
+            done = 0
+            while done < 6_000:
+                ev = gen.next_events(2048)
+                done += 2048
+                bid = ev["bid"]
+                if bid and len(bid["auction"]):
+                    chunks.append(
+                        StreamChunk.from_numpy(
+                            {
+                                "auction": bid["auction"],
+                                "date_time": bid["date_time"],
+                            },
+                            2048,
+                        )
+                    )
+                    total += len(bid["auction"])
+            q5.agg.apply_stacked(stack_chunks(chunks), pre=pre, mode=mode)
+            q5.pipeline.barrier()
+    assert total > 5_000
+    assert q5.mview.snapshot()

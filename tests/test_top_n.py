@@ -11,6 +11,11 @@ from risingwave_tpu.types import Op
 import jax.numpy as jnp
 
 
+import pytest as _pytest
+
+pytestmark = _pytest.mark.smoke
+
+
 def _replay(outs, snap, names=("g", "v", "p")):
     for out in outs:
         d = out.to_numpy(with_ops=True)
