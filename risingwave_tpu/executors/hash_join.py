@@ -35,6 +35,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Barrier, Executor, Watermark
@@ -358,6 +359,12 @@ class HashJoinExecutor(Executor, Checkpointable):
         self._bound = {"l": 0, "r": 0}
         self._em_overflow = jnp.zeros((), jnp.bool_)
         self._wm = {"l": None, "r": None, "out": None}
+        # cold tier (state >> HBM): the runtime wires cold_get_rows to
+        # CheckpointManager.get_rows; evicted durable keys are recorded
+        # host-side per side and fault back in when touched
+        self.cold_get_rows = None
+        self._evicted = {"left": set(), "right": set()}
+        self._cold_tombstones: Dict[str, list] = {}
 
     # -- data ------------------------------------------------------------
     def apply_left(self, chunk: StreamChunk) -> List[StreamChunk]:
@@ -370,6 +377,11 @@ class HashJoinExecutor(Executor, Checkpointable):
         raise TypeError("HashJoin is two-input: use apply_left/apply_right")
 
     def _apply(self, side: str, chunk: StreamChunk) -> List[StreamChunk]:
+        if self._evicted["left"] or self._evicted["right"]:
+            # merge-on-return BEFORE the step: an arriving chunk probes
+            # the other side and appends to its own — both sides' cold
+            # buckets for its keys must be resident or matches are lost
+            self._fault_in(side, chunk)
         own = self.left if side == "l" else self.right
         own = self._maybe_grow(side, own, chunk.capacity)
         other = self.right if side == "l" else self.left
@@ -417,6 +429,193 @@ class HashJoinExecutor(Executor, Checkpointable):
         self._bound[side] = claimed
         return own
 
+    # -- cold tier (state >> HBM; join/hash_join.rs:157 LRU-over-
+    # Hummock analogue: durable buckets leave HBM, fault back on touch)
+    def state_nbytes(self) -> int:
+        return sum(
+            leaf.nbytes
+            for leaf in jax.tree.leaves((self.left, self.right))
+        )
+
+    def evict_cold(self) -> int:
+        """Free every fully-durable key's bucket from HBM, shrinking
+        each side to its hot set. Returns keys evicted."""
+        if self.cold_get_rows is None:
+            raise RuntimeError("evict_cold needs cold_get_rows (runtime)")
+        for side in (self.left, self.right):
+            for lane in side.table.keys:
+                if not jnp.issubdtype(lane.dtype, jnp.integer):
+                    # the host-side evicted-key set round-trips values
+                    # through python ints; a float key would corrupt
+                    return 0
+        return self._evict_side("left") + self._evict_side("right")
+
+    def _evict_side(self, name: str) -> int:
+        import dataclasses
+
+        side = getattr(self, name)
+        claimed = side.table.fp1 != jnp.uint32(0)
+        durable = claimed & side.stored & ~side.sdirty
+        n_evict = int(jnp.sum(durable.astype(jnp.int32)))
+        if n_evict == 0:
+            return 0
+        # record evicted keys host-side: the membership check is what
+        # lets the hot path skip cold lookups for genuinely-new keys
+        sel = np.flatnonzero(np.asarray(durable))
+        keys = pull_rows(
+            {f"k{i}": l for i, l in enumerate(side.table.keys)}, sel
+        )
+        lanes = [
+            np.asarray(keys[f"k{i}"])
+            for i in range(len(side.table.keys))
+        ]
+        ev = self._evicted[name]
+        for j in range(len(sel)):
+            ev.add(tuple(int(a[j]) for a in lanes))
+        # rebuild the side holding only the hot keys (eviction must
+        # actually free HBM, not just slots)
+        hot = claimed & ~durable
+        hsel = np.flatnonzero(np.asarray(hot))
+        n_hot = len(hsel)
+        new_cap = grow_pow2(n_hot, 1 << 10, GROW_AT)
+        fresh = JoinSide.create(
+            new_cap,
+            side.fanout,
+            tuple(k.dtype for k in side.table.keys),
+            {nm: a.dtype for nm, a in side.rows.items()},
+            nullable=tuple(side.row_nulls),
+        )
+        if n_hot:
+            pull = {f"k{i}": l for i, l in enumerate(side.table.keys)}
+            pull["rv"] = side.row_valid
+            pull["deg"] = side.degree
+            pull["live"] = side.table.live
+            pull["sd"] = side.sdirty
+            pull["st"] = side.stored
+            for nm, a in side.rows.items():
+                pull[f"r_{nm}"] = a
+            for nm, a in side.row_nulls.items():
+                pull[f"n_{nm}"] = a
+            rows = pull_rows(pull, hsel)
+            jl = tuple(
+                jnp.asarray(rows[f"k{i}"])
+                for i in range(len(side.table.keys))
+            )
+            table, slots, _, _ = lookup_or_insert(
+                fresh.table, jl, jnp.ones(n_hot, jnp.bool_)
+            )
+            table = set_live(table, slots, jnp.asarray(rows["live"]))
+            fresh = dataclasses.replace(
+                fresh,
+                table=table,
+                rows={
+                    nm: a.at[slots].set(jnp.asarray(rows[f"r_{nm}"]))
+                    for nm, a in fresh.rows.items()
+                },
+                row_nulls={
+                    nm: a.at[slots].set(jnp.asarray(rows[f"n_{nm}"]))
+                    for nm, a in fresh.row_nulls.items()
+                },
+                row_valid=fresh.row_valid.at[slots].set(
+                    jnp.asarray(rows["rv"])
+                ),
+                degree=fresh.degree.at[slots].set(
+                    jnp.asarray(rows["deg"])
+                ),
+                sdirty=fresh.sdirty.at[slots].set(jnp.asarray(rows["sd"])),
+                stored=fresh.stored.at[slots].set(jnp.asarray(rows["st"])),
+                overflow=side.overflow,
+                inconsistent=side.inconsistent,
+            )
+        setattr(self, name, fresh)
+        self._bound["l" if name == "left" else "r"] = int(
+            fresh.table.occupancy()
+        )
+        return n_evict
+
+    def _expire_evicted(self, name: str, pos: int, cutoff: int) -> None:
+        """Watermark closes EVICTED keys too: they leave the evicted
+        set (never fault back) and their store rows tombstone at the
+        next checkpoint — recovery must not resurrect closed windows
+        (expire_keys only reaches resident slots)."""
+        ev = self._evicted[name]
+        closed = {t for t in ev if t[pos] < cutoff}
+        if closed:
+            ev.difference_update(closed)
+            self._cold_tombstones.setdefault(name, []).extend(closed)
+
+    def _fault_in(self, side: str, chunk: StreamChunk) -> None:
+        own_keys = self.left_keys if side == "l" else self.right_keys
+        cols = [np.asarray(chunk.col(k)) for k in own_keys]
+        valid = np.asarray(chunk.valid)
+        touched = {
+            tuple(int(c[i]) for c in cols) for i in np.flatnonzero(valid)
+        }
+        for name in ("left", "right"):
+            hits = touched & self._evicted[name]
+            if hits:
+                self._restore_cold_keys(name, sorted(hits))
+
+    def _restore_cold_keys(self, name: str, key_tuples) -> None:
+        import dataclasses
+
+        letter = "l" if name == "left" else "r"
+        side = getattr(self, name)
+        n = len(key_tuples)
+        side = self._maybe_grow(letter, side, n)
+        lanes_np = {
+            f"k{i}": np.asarray(
+                [t[i] for t in key_tuples],
+                dtype=side.table.keys[i].dtype,
+            )
+            for i in range(len(side.table.keys))
+        }
+        found, vals = self.cold_get_rows(
+            f"{self.table_id}.{name}", dict(lanes_np)
+        )
+        nt = int(found.sum())
+        if nt:
+            jl = tuple(
+                jnp.asarray(lanes_np[f"k{i}"][found])
+                for i in range(len(side.table.keys))
+            )
+            table, slots, _, _ = lookup_or_insert(
+                side.table, jl, jnp.ones(nt, jnp.bool_)
+            )
+            table = set_live(table, slots, True)
+            side = dataclasses.replace(
+                side,
+                table=table,
+                rows={
+                    nm: a.at[slots].set(
+                        jnp.asarray(
+                            vals[f"r_{nm}"][found].astype(a.dtype)
+                        )
+                    )
+                    for nm, a in side.rows.items()
+                },
+                row_nulls={
+                    nm: a.at[slots].set(
+                        jnp.asarray(vals[f"n_{nm}"][found].astype(bool))
+                    )
+                    for nm, a in side.row_nulls.items()
+                },
+                row_valid=side.row_valid.at[slots].set(
+                    jnp.asarray(vals["rv"][found].astype(bool))
+                ),
+                degree=(
+                    side.degree.at[slots].set(
+                        jnp.asarray(vals["deg"][found].astype(np.int32))
+                    )
+                    if "deg" in vals  # legacy pre-degree checkpoints
+                    else side.degree
+                ),
+                stored=side.stored.at[slots].set(True),
+            )
+        setattr(self, name, side)
+        self._bound[letter] += nt
+        self._evicted[name].difference_update(key_tuples)
+
     # -- control ---------------------------------------------------------
     def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
         self._staged_scalars = stage_scalars(
@@ -462,14 +661,14 @@ class HashJoinExecutor(Executor, Checkpointable):
             return watermark, []
         cutoff = jnp.asarray(watermark.value, jnp.int64)
         if watermark.column == self.window_cols[0]:
-            self.left = expire_keys(
-                self.left, self._key_index("l", self.window_cols[0]), cutoff
-            )
+            pos = self._key_index("l", self.window_cols[0])
+            self.left = expire_keys(self.left, pos, cutoff)
+            self._expire_evicted("left", pos, int(watermark.value))
             self._wm["l"] = watermark.value
         else:
-            self.right = expire_keys(
-                self.right, self._key_index("r", self.window_cols[1]), cutoff
-            )
+            pos = self._key_index("r", self.window_cols[1])
+            self.right = expire_keys(self.right, pos, cutoff)
+            self._expire_evicted("right", pos, int(watermark.value))
             self._wm["r"] = watermark.value
         if self._wm["l"] is None or self._wm["r"] is None:
             return None, []
@@ -611,6 +810,59 @@ def _join_checkpoint_delta(self):
     if got is not None:
         out.append(got[0])
         self.right = got[1]
+    # watermark-closed EVICTED keys: their buckets live only in the
+    # store — stage explicit tombstones so recovery cannot resurrect
+    # closed windows (resident expiry tombstones ride _side_delta)
+    pending = getattr(self, "_cold_tombstones", None)
+    if pending:
+        by_tid = {d.table_id: d for d in out}
+        for name, tuples in pending.items():
+            if not tuples:
+                continue
+            side = getattr(self, name)
+            tid = f"{self.table_id}.{name}"
+            keys = {
+                f"k{i}": np.asarray(
+                    [t[i] for t in tuples],
+                    dtype=side.table.keys[i].dtype,
+                )
+                for i in range(len(side.table.keys))
+            }
+            nvals = {}
+            nrows = len(tuples)
+            nvals["rv"] = np.zeros(
+                (nrows, side.fanout), side.row_valid.dtype
+            )
+            nvals["deg"] = np.zeros((nrows, side.fanout), np.int32)
+            for nm, a in side.rows.items():
+                nvals[f"r_{nm}"] = np.zeros((nrows,) + a.shape[1:], a.dtype)
+            for nm, a in side.row_nulls.items():
+                nvals[f"n_{nm}"] = np.zeros((nrows,) + a.shape[1:], a.dtype)
+            tomb = np.ones(nrows, bool)
+            prev = by_tid.get(tid)
+            if prev is None:
+                out.append(
+                    StateDelta(
+                        tid, keys, nvals, tomb, tuple(keys)
+                    )
+                )
+            else:
+                merged_keys = {
+                    k: np.concatenate([prev.key_cols[k], keys[k]])
+                    for k in prev.key_cols
+                }
+                merged_vals = {
+                    k: np.concatenate([prev.value_cols[k], nvals[k]])
+                    for k in prev.value_cols
+                }
+                out[out.index(prev)] = StateDelta(
+                    tid,
+                    merged_keys,
+                    merged_vals,
+                    np.concatenate([prev.tombstone, tomb]),
+                    prev.key_order,
+                )
+        self._cold_tombstones = {}
     return out
 
 
@@ -621,6 +873,9 @@ def _join_restore_state(self, table_id, key_cols, value_cols):
     else:
         self.right = _side_restore(self.right, key_cols, value_cols)
         self._bound["r"] = int(self.right.table.occupancy())
+    # a full restore materializes EVERYTHING the store holds — no key
+    # is cold anymore
+    self._evicted = {"left": set(), "right": set()}
 
 
 HashJoinExecutor.checkpoint_table_ids = _join_checkpoint_table_ids

@@ -162,6 +162,10 @@ class StreamingRuntime:
                             _tid, keys
                         )
                     )
+                # multi-table executors (join sides) pick their own
+                # table per read
+                if hasattr(ex, "cold_get_rows"):
+                    ex.cold_get_rows = self.mgr.get_rows
         if upstream is not None:
             self.subscribe(upstream, name, backfill=backfill)
 
@@ -353,7 +357,11 @@ class StreamingRuntime:
         evicted = 0
         for ex in self.executors():
             fn = getattr(ex, "evict_cold", None)
-            if fn is not None and getattr(ex, "cold_reader", None) is not None:
+            has_reader = (
+                getattr(ex, "cold_reader", None) is not None
+                or getattr(ex, "cold_get_rows", None) is not None
+            )
+            if fn is not None and has_reader:
                 if getattr(ex, "minput", None):
                     continue  # multiset cold-merge unsupported
                 evicted += fn()

@@ -317,25 +317,42 @@ class CheckpointManager:
     def read_table(
         self, table_id: str
     ) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
-        with self._lock:
-            entries = list(self.version["tables"].get(table_id, []))
-        ssts = [read_sst(self.store.read(e["path"])) for e in entries]
+        # full-table restores bypass the SST cache: pinning every
+        # restored SST would hold the whole committed store in host RAM
+        # (the cache exists for the point-read working set)
+        ssts = list(reversed(self._ssts_newest_first(table_id, cache=False)))
         if not ssts:
             return {}, {}
         return merge_ssts(ssts, ssts[-1].meta.key_names)
 
-    def _ssts_newest_first(self, table_id: str):
-        with self._lock:
-            entries = list(self.version["tables"].get(table_id, []))
-        out = []
-        for e in reversed(entries):
-            sst = self._sst_cache.get(e["path"])
-            if sst is None:
-                sst = self._sst_cache[e["path"]] = read_sst(
-                    self.store.read(e["path"])
-                )
-            out.append(sst)
-        return out
+    def _ssts_newest_first(self, table_id: str, cache: bool = True):
+        # blob reads run OUTSIDE the lock; a compactor — this manager's
+        # off-path thread, or another node still draining after a
+        # "kill" — may GC an SST between the version snapshot and the
+        # read. Retry after RELOADING the manifest: the durable version
+        # never references GC'd files (GC runs only after the new
+        # manifest persists, compact_once).
+        for attempt in range(8):
+            with self._lock:
+                if attempt:
+                    self._load()
+                entries = list(self.version["tables"].get(table_id, []))
+            out = []
+            try:
+                for e in reversed(entries):
+                    sst = self._sst_cache.get(e["path"])
+                    if sst is None:
+                        sst = read_sst(self.store.read(e["path"]))
+                        if cache:
+                            self._sst_cache[e["path"]] = sst
+                    out.append(sst)
+                return out
+            except (KeyError, FileNotFoundError, OSError):
+                continue
+        raise RuntimeError(
+            f"SST run for {table_id!r} kept vanishing mid-read "
+            "(compaction livelock?)"
+        )
 
     def get_rows(
         self, table_id: str, key_cols: Dict[str, np.ndarray]
@@ -366,7 +383,10 @@ class CheckpointManager:
             live = hit & ~sst.tombstone[np.where(hit, rows, 0)]
             for name, col in sst.values.items():
                 if name not in values:
-                    values[name] = np.zeros(n, col.dtype)
+                    # 2D bucket lanes (join rv/deg/r_*) read back whole
+                    values[name] = np.zeros(
+                        (n,) + col.shape[1:], col.dtype
+                    )
                 values[name][live] = col[rows[live]]
             found |= live
             unresolved &= ~hit  # tombstone = resolved absent
