@@ -320,6 +320,96 @@ def bench_q7(gen_cfg, epochs, events_per_epoch, chunk_events):
     }
 
 
+Q5_SQL = (
+    "CREATE MATERIALIZED VIEW q5 AS "
+    "SELECT auction, window_start, count(*) AS num "
+    "FROM HOP(bid, date_time, INTERVAL '2' SECOND, INTERVAL '10' SECOND) "
+    "GROUP BY auction, window_start"
+)
+
+
+def bench_q5_unified(epochs, events_per_epoch, chunk_events, smoke):
+    """The SAME q5 as SQL through the UNIFIED path: planner -> actor
+    graph (dispatchers, permit channels, FragmentActor threads) — the
+    one-path-from-SQL-to-execution evidence, measured."""
+    import jax
+
+    if smoke:
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from risingwave_tpu.array.chunk import StreamChunk
+    from risingwave_tpu.connectors.nexmark import (
+        BID_SCHEMA,
+        NexmarkConfig,
+        NexmarkGenerator,
+    )
+    from risingwave_tpu.queries.nexmark_q import Q5_SLIDE_MS, Q5_WINDOW_MS
+    from risingwave_tpu.runtime.fragmenter import graph_planned_mv
+    from risingwave_tpu.sql import Catalog, StreamPlanner
+
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=10_000))
+    host_chunks = []
+    for _ in range(epochs):
+        per_epoch, done = [], 0
+        while done < events_per_epoch:
+            n = min(chunk_events, events_per_epoch - done)
+            done += n
+            bid = gen.next_events(n)["bid"]
+            if bid and len(bid["auction"]):
+                per_epoch.append(
+                    {"auction": bid["auction"], "date_time": bid["date_time"]}
+                )
+        host_chunks.append(per_epoch)
+    flat = [c for ep in host_chunks for c in ep]
+    total_bids = sum(len(c["auction"]) for c in flat)
+    cpu_rows_s, cpu_counts = cpu_actor_baseline(
+        flat, Q5_WINDOW_MS, Q5_SLIDE_MS
+    )
+
+    c5 = _state_cap(2 * events_per_epoch, 1 << 16)
+    catalog = Catalog({"bid": BID_SCHEMA})
+    factory = lambda: StreamPlanner(catalog, capacity=c5)
+    mv = graph_planned_mv(factory, Q5_SQL, parallelism=1)
+    cap = chunk_events
+    mk = lambda: [
+        [StreamChunk.from_numpy(c, cap) for c in ep] for ep in host_chunks
+    ]
+    # warmup epoch compiles, then a fresh graph + warm caches
+    for c in (StreamChunk.from_numpy(x, cap) for x in host_chunks[0]):
+        mv.pipeline.push(c)
+    mv.pipeline.barrier()
+    mv.pipeline.close()
+    mv = graph_planned_mv(factory, Q5_SQL, parallelism=1)
+
+    barrier_times = []
+    t0 = time.perf_counter()
+    for ep in mk():
+        for c in ep:
+            mv.pipeline.push(c)
+        tb = time.perf_counter()
+        mv.pipeline.barrier()
+        barrier_times.append(time.perf_counter() - tb)
+    dt = time.perf_counter() - t0
+    snap = mv.mview.snapshot()  # {(auction, window_start): (num,)}
+    ok = snap == {k: (v,) for k, v in cpu_counts.items()}
+    mv.pipeline.close()
+    if not ok:
+        print(
+            f"Q5U MISMATCH: {len(snap)} groups vs {len(cpu_counts)}",
+            file=sys.stderr,
+        )
+    return {
+        "q5u_throughput": round(total_bids / dt, 1),
+        "q5u_unit": "bids/sec",
+        "q5u_vs_baseline": round((total_bids / dt) / cpu_rows_s, 3),
+        "q5u_p99_barrier_ms": round(
+            float(np.percentile(np.asarray(barrier_times) * 1e3, 99)), 2
+        ),
+        "q5u_correct": ok,
+    }
+
+
 def bench_q5(args_epochs, events_per_epoch, chunk_events, smoke, agg_mode):
     import jax
 
@@ -564,6 +654,8 @@ def _bench_one(query: str, epochs, events, chunk, smoke, agg_mode):
     gen_cfg = {"first_event_rate": 10_000}
     if query == "q5":
         return bench_q5(epochs, events, chunk, smoke, agg_mode)
+    if query == "q5u":
+        return bench_q5_unified(epochs, events, chunk, smoke)
     if query == "q8":
         return bench_q8(gen_cfg, epochs, events, chunk)
     if query == "q7":
@@ -577,7 +669,9 @@ def main():
     ap.add_argument("--epochs", type=int, default=None)
     ap.add_argument("--events-per-epoch", type=int, default=None)
     ap.add_argument("--chunk-events", type=int, default=None)
-    ap.add_argument("--only", choices=["q5", "q7", "q8"], default=None)
+    ap.add_argument(
+        "--only", choices=["q5", "q7", "q8", "q5u"], default=None
+    )
     ap.add_argument(
         "--agg-mode",
         choices=["reduce", "scan"],
@@ -689,9 +783,11 @@ def main():
             return
     failed: set = set()  # (query) that failed — don't escalate those
     for tier in tiers:  # BREADTH-first: every query lands small numbers
-        for query in ("q5", "q8", "q7"):
+        for query in ("q5", "q8", "q7", "q5u"):
             if dead or query in failed:
                 continue
+            if query == "q5u" and tier != "smoke_dev":
+                continue  # unified-path evidence: smoke tier only
             # worst case this child costs: its timeout + 45s communicate
             # grace + 30s SIGTERM drain + a 75s post-failure device
             # probe — all of it must fit before the finalize reserve

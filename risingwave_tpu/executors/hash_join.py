@@ -815,11 +815,33 @@ def _join_checkpoint_delta(self):
     # closed windows (resident expiry tombstones ride _side_delta)
     pending = getattr(self, "_cold_tombstones", None)
     if pending:
+        from risingwave_tpu.ops.hash_table import lookup as _ht_lookup
+
         by_tid = {d.table_id: d for d in out}
         for name, tuples in pending.items():
             if not tuples:
                 continue
             side = getattr(self, name)
+            # a key re-created AFTER its window closed (late arrival) is
+            # RESIDENT again: its upsert (or its own tombstone) stages
+            # via _side_delta — a cold tombstone in the same delta would
+            # make point reads and merge reads disagree on the key
+            lanes_j = tuple(
+                jnp.asarray(
+                    np.asarray(
+                        [t[i] for t in tuples],
+                        dtype=side.table.keys[i].dtype,
+                    )
+                )
+                for i in range(len(side.table.keys))
+            )
+            slots, _found = _ht_lookup(
+                side.table, lanes_j, jnp.ones(len(tuples), jnp.bool_)
+            )
+            resident = np.asarray(slots) >= 0
+            tuples = [t for t, r in zip(tuples, resident) if not r]
+            if not tuples:
+                continue
             tid = f"{self.table_id}.{name}"
             keys = {
                 f"k{i}": np.asarray(

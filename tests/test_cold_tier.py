@@ -316,3 +316,63 @@ def test_join_evicted_keys_expire_under_watermark():
     d = outs[0].to_numpy(with_ops=True)
     rows = {(int(d["lw"][i]), int(d["lv"][i])) for i in range(len(d["lw"]))}
     assert rows == {(20, 2)}  # window 10 gone, window 20 restored
+
+
+def test_cold_tombstone_dropped_when_key_recreated_late():
+    """A late arrival re-creates a key AFTER its window closed while
+    evicted: the staged cold tombstone must yield to the resident
+    upsert — point reads and merge reads must agree post-recovery."""
+    from risingwave_tpu.executors.base import Watermark
+    from risingwave_tpu.executors.hash_join import HashJoinExecutor
+
+    L = {"lw": jnp.int64, "lv": jnp.int64}
+    R = {"rw": jnp.int64, "rv": jnp.int64}
+
+    def mk():
+        return HashJoinExecutor(
+            ("lw",), ("rw",), L, R,
+            capacity=1 << 8, fanout=4, out_cap=1 << 9,
+            window_cols=("lw", "rw"), table_id="lj",
+        )
+
+    mgr = CheckpointManager(MemObjectStore())
+    j = mk()
+    j.cold_get_rows = mgr.get_rows
+    j.apply_left(
+        StreamChunk.from_numpy(
+            {"lw": np.asarray([10], np.int64),
+             "lv": np.asarray([1], np.int64)}, 8,
+        )
+    )
+    j.on_barrier(None)
+    mgr.commit_staged(1, mgr.stage([j]))
+    assert j.evict_cold() == 1
+    j.on_watermark(Watermark("lw", 15))  # closes window 10 (evicted)
+    j.on_watermark(Watermark("rw", 15))
+    # LATE left row for window 10 arrives BEFORE the next checkpoint:
+    # the key is resident again
+    j.apply_left(
+        StreamChunk.from_numpy(
+            {"lw": np.asarray([10], np.int64),
+             "lv": np.asarray([5], np.int64)}, 8,
+        )
+    )
+    j.on_barrier(None)
+    mgr.commit_staged(2, mgr.stage([j]))
+
+    # point read and full recovery must BOTH see exactly the late row
+    found, vals = mgr.get_rows(
+        "lj.left", {"k0": np.asarray([10], np.int64)}
+    )
+    assert found[0]
+    j2 = mk()
+    mgr.recover([j2])
+    outs = j2.apply_right(
+        StreamChunk.from_numpy(
+            {"rw": np.asarray([10], np.int64),
+             "rv": np.asarray([9], np.int64)}, 8,
+        )
+    )
+    d = outs[0].to_numpy(with_ops=True)
+    rows = [(int(d["lw"][i]), int(d["lv"][i])) for i in range(len(d["lw"]))]
+    assert rows == [(10, 5)]  # the late row, not the pre-expiry one
