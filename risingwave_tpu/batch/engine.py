@@ -47,6 +47,11 @@ class BatchQueryEngine:
                 self, self.distributed_tasks
             ).query(stmt)
             if out is not None:
+                if getattr(stmt, "distinct", False) and out:
+                    import pandas as pd
+
+                    df = pd.DataFrame(out).drop_duplicates()
+                    out = {k: df[k].to_numpy() for k in out}
                 return out
         if isinstance(stmt.from_, P.Join):
             cols, alias = self._join_scan(stmt.from_), None
@@ -56,6 +61,12 @@ class BatchQueryEngine:
         else:
             raise ValueError("batch FROM must be an MV name or join")
         out = self._run_select_over(stmt, cols, alias)
+
+        if getattr(stmt, "distinct", False) and out:
+            import pandas as pd
+
+            df = pd.DataFrame(out).drop_duplicates()
+            out = {k: df[k].to_numpy() for k in out}
 
         # OrderBy + Limit (src/batch/src/executor/{order_by,limit}.rs)
         out = self._order_limit(stmt, out)
@@ -102,6 +113,9 @@ class BatchQueryEngine:
         if stmt.group_by:
             keys = [binder.resolve(g) for g in stmt.group_by]
             out = self._group_agg(stmt, cols, keys, binder)
+            having = getattr(stmt, "having", None)
+            if having is not None:
+                out = self._having_filter(having, out)
         else:
             out = {}
             chunk_cache = [None]
@@ -126,7 +140,33 @@ class BatchQueryEngine:
                     out[name] = vals
                     if nl is not None and nl.any():
                         out[name + "__null"] = nl
+            having = getattr(stmt, "having", None)
+            if having is not None:
+                # HAVING over a GLOBAL aggregate filters its single row
+                out = self._having_filter(having, {
+                    k: np.asarray(v) for k, v in out.items()
+                })
         return out
+
+    def _having_filter(self, having, out):
+        """HAVING over the grouped OUTPUT columns (keys + agg aliases),
+        evaluated through the shared expression framework."""
+        value_cols = {
+            k: v for k, v in out.items() if not k.endswith("__null")
+        }
+        n = len(next(iter(value_cols.values()))) if value_cols else 0
+        if not n:
+            return out
+        hb = Binder(
+            {k: np.asarray(v).dtype for k, v in value_cols.items()}, None
+        )
+        cap = max(1, 1 << (n - 1).bit_length())
+        chunk = self._chunk_from_cols(value_cols, cap)
+        kv, kn = compile_scalar(having, hb).eval(chunk)
+        keep = np.asarray(kv).astype(bool)[:n]
+        if kn is not None:
+            keep &= ~np.asarray(kn)[:n]
+        return {k: np.asarray(v)[keep] for k, v in out.items()}
 
     def _order_limit(self, stmt, out):
         if stmt.order_by:

@@ -113,6 +113,12 @@ def _build_rel(rel, catalog=None):
             rel.slide_ms, rel.alias,
         )
     if isinstance(rel, P.SubQuery):
+        if rel.select.having is not None or rel.select.distinct:
+            # the IR has no slot for these yet: emit() would silently
+            # drop a derived table's HAVING/DISTINCT
+            raise NotImplementedError(
+                "HAVING/DISTINCT inside a derived table is not supported"
+            )
         return build(rel.select, alias=rel.alias, catalog=catalog)
     if isinstance(rel, P.Join):
         return LJoin(
@@ -552,8 +558,17 @@ def _emit_rel(node):
 
 
 def optimize_select(select: P.Select, catalog=None) -> P.Select:
-    """AST -> IR -> rules -> AST. The public entry the planner uses."""
-    return emit(optimize(build(select, catalog=catalog)))
+    """AST -> IR -> rules -> AST. The public entry the planner uses.
+    HAVING/DISTINCT ride AROUND the IR (no rule touches them: HAVING
+    filters agg OUTPUT, which pushdown must never move below the agg)."""
+    import dataclasses
+
+    out = emit(optimize(build(select, catalog=catalog)))
+    if select.having is not None or select.distinct:
+        out = dataclasses.replace(
+            out, having=select.having, distinct=select.distinct
+        )
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -99,6 +99,9 @@ class Select:
     limit: Optional[int] = None
     # GROUP BY GROUPING SETS ((a, b), (a), ()) — empty means plain
     grouping_sets: Tuple[Tuple[Ident, ...], ...] = ()
+    # HAVING references OUTPUT names (group keys / agg aliases)
+    having: Optional[object] = None
+    distinct: bool = False  # SELECT DISTINCT a, b == GROUP BY a, b
 
 
 @dataclass(frozen=True)
@@ -142,7 +145,7 @@ _TOKEN_RE = re.compile(
 )
 
 _KEYWORDS = {
-    "select", "from", "where", "group", "by", "as", "join", "inner", "on",
+    "select", "from", "where", "group", "by", "having", "as", "join", "inner", "on",
     "and", "or", "not", "create", "materialized", "view", "tumble", "hop",
     "interval", "second", "seconds", "millisecond", "milliseconds",
     "minute", "minutes", "case", "when", "then", "else", "end", "null", "order", "limit", "asc", "desc",
@@ -366,6 +369,7 @@ class Parser:
     # -- select ----------------------------------------------------------
     def select(self) -> Select:
         self.expect("kw", "select")
+        distinct = bool(self.accept("kw", "distinct"))
         items = [self.select_item()]
         while self.accept("op", ","):
             items.append(self.select_item())
@@ -437,6 +441,7 @@ class Parser:
                 while self.accept("op", ","):
                     cols.append(self.qualified_ident())
                 group = tuple(cols)
+        having = self.expr() if self.accept("kw", "having") else None
         order: Tuple[Tuple[Ident, bool], ...] = ()
         if self.accept("kw", "order"):
             self.expect("kw", "by")
@@ -454,7 +459,8 @@ class Parser:
         if self.accept("kw", "limit"):
             limit = int(self.expect("num").value)
         return Select(
-            tuple(items), rel, where, group, order, limit, gsets
+            tuple(items), rel, where, group, order, limit, gsets,
+            having=having, distinct=distinct,
         )
 
     def select_item(self) -> SelectItem:

@@ -267,6 +267,27 @@ class StreamPlanner:
             select, self.catalog, getattr(self, "strings", None)
         )
         select = optimize_select(select, catalog=self.catalog)
+        if select.distinct:
+            # SELECT DISTINCT a, b == GROUP BY a, b with no aggregates
+            # (the reference planner's rewrite)
+            import dataclasses
+
+            if select.group_by or any(_is_agg(it.expr) for it in select.items):
+                raise NotImplementedError(
+                    "DISTINCT with GROUP BY/aggregates is not supported"
+                )
+            for it in select.items:
+                if not isinstance(it.expr, P.Ident):
+                    raise NotImplementedError(
+                        "SELECT DISTINCT items must be bare columns"
+                    )
+            select = dataclasses.replace(
+                select,
+                group_by=tuple(it.expr for it in select.items),
+                distinct=False,
+            )
+        if select.having is not None and not select.group_by:
+            raise ValueError("HAVING requires GROUP BY")
         if isinstance(select.from_, P.Join):
             if select.from_.join_type.startswith("temporal"):
                 return self._plan_temporal(name, select)
@@ -342,6 +363,16 @@ class StreamPlanner:
                 name, select, binder, schema, retractable=False
             )
             chain.extend(chain2)
+            if select.having is not None:
+                # HAVING filters the agg's OUTPUT stream (group keys +
+                # agg aliases) — never pushed below the agg
+                chain.append(
+                    FilterExecutor(
+                        compile_scalar(
+                            select.having, Binder(out_schema, None)
+                        )
+                    )
+                )
             return self._maybe_topn(
                 name, select, binder,
                 BoundRel(chain, out_schema, pk, source, alias),
@@ -736,6 +767,12 @@ class StreamPlanner:
                 retractable=True, nullable_cols=padded,
             )
             tail.extend(gchain)
+            if select.having is not None:
+                tail.append(
+                    FilterExecutor(
+                        compile_scalar(select.having, Binder(gout, None))
+                    )
+                )
             mview = MaterializeExecutor(
                 pk=gpk,
                 columns=tuple(c for c in gout if c not in gpk),

@@ -269,8 +269,20 @@ def typecheck_select(select: P.Select, catalog, strings=None) -> P.Select:
         order_by=select.order_by,
         limit=select.limit,
         grouping_sets=select.grouping_sets,
+        distinct=select.distinct,
     )
-    _check_collation(out, env, infer_output_fields(out, catalog))
+    out_fields = infer_output_fields(out, catalog)
+    if select.having is not None:
+        # HAVING references OUTPUT names; group KEYS keep their source
+        # lane domains (DECIMAL scaling, dictionary codes), so literals
+        # rewrite against the inferred output fields
+        import dataclasses
+
+        out = dataclasses.replace(
+            out,
+            having=_rewrite_pred(select.having, out_fields, strings),
+        )
+    _check_collation(out, env, out_fields)
     return out
 
 
