@@ -38,6 +38,12 @@ def serve(args) -> None:
         if cfg is not None
         else StreamingRuntime(store)
     )
+    # a served cluster self-heals (barrier/mod.rs:676 failure recovery):
+    # a poisoned epoch or dead actor recovers in place and the source
+    # pump replays the lost epoch from committed offsets. Gate on the
+    # runtime's ACTUAL persistence (from_config builds its own store)
+    if runtime.mgr is not None:
+        runtime.auto_recover = True
     from risingwave_tpu.storage.meta_backup import DDL_PATH
 
     if store is not None and store.exists(DDL_PATH):

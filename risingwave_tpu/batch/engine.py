@@ -47,11 +47,15 @@ class BatchQueryEngine:
                 self, self.distributed_tasks
             ).query(stmt)
             if out is not None:
-                if getattr(stmt, "distinct", False) and out:
-                    import pandas as pd
-
-                    df = pd.DataFrame(out).drop_duplicates()
-                    out = {k: df[k].to_numpy() for k in out}
+                having = getattr(stmt, "having", None)
+                if having is not None:
+                    # merged rows are COMPLETE (two-phase agg finished):
+                    # filtering here is correct for global aggregates
+                    # and idempotent for grouped ones
+                    out = self._having_filter(
+                        having, {k: np.asarray(v) for k, v in out.items()}
+                    )
+                out = self._distinct(stmt, out)
                 return out
         if isinstance(stmt.from_, P.Join):
             cols, alias = self._join_scan(stmt.from_), None
@@ -61,12 +65,7 @@ class BatchQueryEngine:
         else:
             raise ValueError("batch FROM must be an MV name or join")
         out = self._run_select_over(stmt, cols, alias)
-
-        if getattr(stmt, "distinct", False) and out:
-            import pandas as pd
-
-            df = pd.DataFrame(out).drop_duplicates()
-            out = {k: df[k].to_numpy() for k in out}
+        out = self._distinct(stmt, out)
 
         # OrderBy + Limit (src/batch/src/executor/{order_by,limit}.rs)
         out = self._order_limit(stmt, out)
@@ -147,6 +146,15 @@ class BatchQueryEngine:
                     k: np.asarray(v) for k, v in out.items()
                 })
         return out
+
+    @staticmethod
+    def _distinct(stmt, out):
+        if not getattr(stmt, "distinct", False) or not out:
+            return out
+        import pandas as pd
+
+        df = pd.DataFrame(out).drop_duplicates()
+        return {k: df[k].to_numpy() for k in out}
 
     def _having_filter(self, having, out):
         """HAVING over the grouped OUTPUT columns (keys + agg aliases),
