@@ -155,7 +155,22 @@ class DistributedBatchRunner:
             if not isinstance(e, P.FuncCall):
                 return None  # mixed scalar select: fall back
             name = item.alias or f"{e.name}_{i}"
-            vals = np.concatenate([p[name] for p in live])
+            # a partial flagged NULL (sum/min/max over zero surviving
+            # rows) contributes nothing — merging its 0 fill value
+            # would corrupt min/max/sum
+            vals_list = [
+                np.asarray(p[name])
+                for p in live
+                if not (
+                    name + "__null" in p
+                    and bool(np.asarray(p[name + "__null"])[0])
+                )
+            ]
+            if not vals_list:
+                out[name] = np.asarray([0])
+                out[name + "__null"] = np.asarray([True])
+                continue
+            vals = np.concatenate(vals_list)
             if e.name in ("count", "sum"):
                 out[name] = np.asarray([vals.sum()])
             elif e.name == "min":

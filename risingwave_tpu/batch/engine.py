@@ -72,11 +72,13 @@ class BatchQueryEngine:
         return out
 
     @staticmethod
-    def _chunk_from_cols(cols, cap):
+    def _chunk_from_cols(cols, cap, nulls=None):
         """Snapshot columns -> DataChunk; object-dtype lanes (python-
         backend MVs embed None for SQL NULL) split into a numeric lane
-        + null lane so expression eval stays NULL-strict."""
-        data, nulls = {}, {}
+        + null lane so expression eval stays NULL-strict. Callers with
+        explicit null masks (e.g. agg ``__null`` companions) pass them
+        via ``nulls`` and they merge with the derived ones."""
+        data, nl_map = {}, {k: np.asarray(v, bool) for k, v in (nulls or {}).items()}
         for k, v in cols.items():
             a = np.asarray(v)
             if a.dtype == object:
@@ -84,10 +86,10 @@ class BatchQueryEngine:
                 nl = np.asarray([x is None for x in vals], bool)
                 data[k] = np.asarray([0 if x is None else x for x in vals])
                 if nl.any():
-                    nulls[k] = nl
+                    nl_map[k] = nl_map.get(k, False) | nl
             else:
                 data[k] = a
-        return DataChunk.from_numpy(data, cap, nulls=nulls or None)
+        return DataChunk.from_numpy(data, cap, nulls=nl_map or None)
 
     def _run_select_over(self, stmt, cols, alias=None):
         """Filter -> agg/projection over one scan's columns (the task
@@ -121,7 +123,10 @@ class BatchQueryEngine:
             for i, item in enumerate(stmt.items):
                 if isinstance(item.expr, P.FuncCall) and item.expr.name in AGG_FUNCS:
                     name = item.alias or f"{item.expr.name}_{i}"
-                    out[name] = self._scalar_agg(item.expr, cols, n, binder)
+                    vals, isnull = self._scalar_agg(item.expr, cols, n, binder)
+                    out[name] = vals
+                    if isnull:
+                        out[name + "__null"] = np.array([True])
                 else:
                     # unaliased names must match sql/typing's inference
                     # (the result edge keys decode on them)
@@ -162,6 +167,14 @@ class BatchQueryEngine:
         value_cols = {
             k: v for k, v in out.items() if not k.endswith("__null")
         }
+        # a NULL aggregate (min/sum over zero surviving rows) must make
+        # the HAVING predicate NULL -> row dropped, not compare its
+        # numeric fill value; carry the __null companions as masks
+        null_masks = {
+            k[: -len("__null")]: np.asarray(v, bool)
+            for k, v in out.items()
+            if k.endswith("__null") and k[: -len("__null")] in value_cols
+        }
         n = len(next(iter(value_cols.values()))) if value_cols else 0
         if not n:
             return out
@@ -169,7 +182,7 @@ class BatchQueryEngine:
             {k: np.asarray(v).dtype for k, v in value_cols.items()}, None
         )
         cap = max(1, 1 << (n - 1).bit_length())
-        chunk = self._chunk_from_cols(value_cols, cap)
+        chunk = self._chunk_from_cols(value_cols, cap, nulls=null_masks or None)
         kv, kn = compile_scalar(having, hb).eval(chunk)
         keep = np.asarray(kv).astype(bool)[:n]
         if kn is not None:
@@ -182,6 +195,14 @@ class BatchQueryEngine:
             for ident, desc in reversed(stmt.order_by):
                 lane = out[ident.name]
                 lanes.append(-lane if desc else lane)
+                nl = out.get(ident.name + "__null")
+                if nl is not None:
+                    # Postgres: NULL sorts as larger than every value —
+                    # last under ASC, first under DESC; the null lane
+                    # must dominate the fill value, so append it AFTER
+                    # (lexsort: later keys are more significant)
+                    nl = np.asarray(nl, bool)
+                    lanes.append(~nl if desc else nl)
             order = np.lexsort(tuple(lanes))
             out = {k: v[order] for k, v in out.items()}
         if stmt.limit is not None:
@@ -291,13 +312,25 @@ class BatchQueryEngine:
         )
 
     def _scalar_agg(self, fc, cols, n, binder):
+        """NULL-aware global aggregate: NULL cells (None in object
+        lanes) are skipped; sum/min/max over zero surviving rows is SQL
+        NULL — returned as (values, is_null) so the caller emits the
+        ``__null`` companion; count(*) / count(col) never is."""
         if fc.args == ("*",):
-            return np.array([n])
-        x = cols[binder.resolve(fc.args[0])]
-        fn = {"count": len, "sum": np.sum, "min": np.min, "max": np.max}[
-            fc.name
-        ]
-        return np.array([fn(x) if len(x) else 0])
+            return np.array([n]), False
+        x = np.asarray(cols[binder.resolve(fc.args[0])])
+        if x.dtype == object:
+            live = np.asarray([v for v in x.tolist() if v is not None])
+        elif np.issubdtype(x.dtype, np.floating):
+            live = x[~np.isnan(x)]  # outer joins surface NULL as NaN
+        else:
+            live = x
+        if fc.name == "count":
+            return np.array([len(live)]), False
+        if len(live) == 0:
+            return np.array([0]), True
+        fn = {"sum": np.sum, "min": np.min, "max": np.max}[fc.name]
+        return np.array([fn(live)]), False
 
     def _group_agg(self, stmt, cols, keys, binder):
         import pandas as pd
@@ -306,6 +339,7 @@ class BatchQueryEngine:
         gb = df.groupby(keys, sort=False)
         out: Dict[str, np.ndarray] = {}
         frames = {}
+        src_cols: Dict[str, str] = {}
         for i, item in enumerate(stmt.items):
             if isinstance(item.expr, P.Ident):
                 name = binder.resolve(item.expr)
@@ -318,10 +352,17 @@ class BatchQueryEngine:
             name = item.alias or f"{fc.name}_{i}"
             if fc.args == ("*",):
                 frames[name] = gb.size()
+            elif fc.name == "sum":
+                # min_count=1: sum over an all-NULL group is SQL NULL
+                # (pandas' default min_count=0 would fabricate a 0)
+                col = binder.resolve(fc.args[0])
+                src_cols[name] = col
+                frames[name] = gb[col].sum(min_count=1)
             else:
                 col = binder.resolve(fc.args[0])
+                src_cols[name] = col
                 frames[name] = getattr(gb[col], {
-                    "count": "count", "sum": "sum", "min": "min", "max": "max"
+                    "count": "count", "min": "min", "max": "max"
                 }[fc.name])()
         if frames:
             res = pd.DataFrame(frames).reset_index()
@@ -332,5 +373,31 @@ class BatchQueryEngine:
                 nm = binder.resolve(item.expr)
                 out[item.alias or nm] = res[nm].to_numpy()
         for name in frames:
-            out[name] = res[name].to_numpy()
+            lane = res[name]
+            nl = lane.isna().to_numpy()
+            if nl.any():
+                # NULL agg outputs (all-NULL group): numeric fill + the
+                # __null companion the result edge / HAVING understand
+                vals = lane.to_numpy()
+                arr = np.asarray(
+                    [0 if m else x for x, m in zip(vals.tolist(), nl.tolist())]
+                )
+                # pandas widens int sums to float64 once any group is
+                # NaN — restore the integer domain unless the SOURCE
+                # column is genuinely floating
+                src = df[src_cols[name]] if name in src_cols else None
+                int_like = src is not None and (
+                    src.dtype == object
+                    and all(
+                        isinstance(v, (int, np.integer))
+                        for v in src.dropna().tolist()
+                    )
+                    or np.issubdtype(src.dtype, np.integer)
+                )
+                if int_like and np.issubdtype(arr.dtype, np.floating):
+                    arr = arr.astype(np.int64)
+                out[name] = arr
+                out[name + "__null"] = nl
+            else:
+                out[name] = lane.to_numpy()
         return out

@@ -153,7 +153,12 @@ class JsonParser(Parser):
                 except (TypeError, ValueError, InvalidOperation):
                     return None
                 return text
-            return int(v)  # int lanes: reject non-numeric strings too
+            # int lanes: reject non-numeric strings; a non-integral
+            # float becomes NULL (bad-cell convention) — never silently
+            # truncate 3.7 -> 3
+            if isinstance(v, float) and not v.is_integer():
+                return None
+            return int(v)
         except (TypeError, ValueError):
             return None
 

@@ -292,7 +292,7 @@ class StreamingRuntime:
         self.last_failure = cause
         REGISTRY.counter("auto_recoveries_total").inc()
         self.auto_recoveries += 1
-        if self._consecutive_recoveries > 3:
+        if self._consecutive_recoveries >= 3:
             raise RuntimeError(
                 "auto-recovery failed 3 consecutive epochs — the fault "
                 "is deterministic, not transient"

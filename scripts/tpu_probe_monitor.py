@@ -1,0 +1,112 @@
+"""Round-long TPU tunnel health monitor.
+
+The single-client tunneled TPU (axon relay) can wedge for hours if any
+client is SIGKILLed; four rounds have ended with zero driver-captured TPU
+artifacts because the tunnel was dead whenever bench ran.  This monitor
+probes the tunnel all round on a gentle cadence and leaves a forensic
+trail either way:
+
+  - TPU_PROBE_r05.log   — timestamped probe results for the whole round
+  - .tpu_healthy        — marker file (touched when the last probe passed,
+                          removed when it failed) so the builder can react
+
+Probe discipline (see bench.py:_device_alive): the child installs
+signal.alarm and exits through normal teardown; the parent only ever
+SIGTERMs — never SIGKILL, a murdered client wedges the tunnel for hours.
+
+Usage: python scripts/tpu_probe_monitor.py [--interval 900] [--once]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "TPU_PROBE_r05.log")
+MARKER = os.path.join(REPO, ".tpu_healthy")
+BUSY = os.path.join(REPO, ".bench_running")
+
+
+def probe_once(timeout_s: int = 90) -> tuple[bool, float, str]:
+    """Fresh-process device acquisition probe; returns (ok, secs, detail)."""
+    code = (
+        "import signal, os\n"
+        "signal.signal(signal.SIGALRM, lambda *a: os._exit(9))\n"
+        f"signal.alarm({timeout_s})\n"
+        "import jax\n"
+        "d = jax.devices()\n"
+        "print(len(d), d[0].platform)\n"
+    )
+    t0 = time.monotonic()
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        cwd=REPO,
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout_s + 15)
+        dt = time.monotonic() - t0
+        if proc.returncode == 0:
+            return True, dt, (out or "").strip()
+        return False, dt, f"rc={proc.returncode}"
+    except subprocess.TimeoutExpired:
+        proc.terminate()  # SIGTERM only — never SIGKILL a tunnel client
+        try:
+            proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            pass
+        return False, time.monotonic() - t0, "hang (SIGTERMed)"
+
+
+def log_line(ok: bool, dt: float, detail: str) -> None:
+    stamp = datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds"
+    )
+    line = f"{stamp} {'OK' if ok else 'DEAD'} {dt:.1f}s {detail}\n"
+    with open(LOG, "a") as f:
+        f.write(line)
+    if ok:
+        with open(MARKER, "w") as f:
+            f.write(stamp + "\n")
+    elif os.path.exists(MARKER):
+        os.remove(MARKER)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=int, default=900)
+    ap.add_argument("--once", action="store_true")
+    ap.add_argument("--timeout", type=int, default=90)
+    args = ap.parse_args()
+    while True:
+        if os.path.exists(BUSY):
+            # bench (or another legitimate client) holds the single-
+            # client tunnel: probing now would both hang AND add a
+            # competing client — skip, and don't touch the marker
+            stamp = datetime.datetime.now(datetime.timezone.utc).isoformat(
+                timespec="seconds"
+            )
+            with open(LOG, "a") as f:
+                f.write(f"{stamp} BUSY skipped (bench running)\n")
+            print("probe: BUSY (bench running)", flush=True)
+        else:
+            ok, dt, detail = probe_once(args.timeout)
+            log_line(ok, dt, detail)
+            print(
+                f"probe: {'OK' if ok else 'DEAD'} ({dt:.1f}s) {detail}",
+                flush=True,
+            )
+        if args.once:
+            break
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    main()

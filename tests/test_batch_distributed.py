@@ -73,3 +73,28 @@ def test_order_by_falls_back_to_local(session):
     out, _ = session.execute("SELECT k, x FROM t ORDER BY x DESC LIMIT 3")
     session.batch.distributed_tasks = 0
     assert list(out["x"]) == [499, 498, 497]
+
+
+def test_distributed_scalar_agg_skips_null_partials(session):
+    """A partition whose surviving rows are all NULL emits a NULL
+    partial (value fill 0 + __null companion); the merge must skip it,
+    not fold the 0 into min/sum (review r5: silent corruption)."""
+    session.execute("CREATE TABLE nv (k BIGINT, v BIGINT)")
+    session.execute(
+        "INSERT INTO nv VALUES (1, NULL), (2, NULL), (3, 5), (4, 7)"
+    )
+    session.batch.distributed_tasks = 4
+    try:
+        out, _ = session.execute(
+            "SELECT min(v) AS m, sum(v) AS s, count(v) AS c FROM nv"
+        )
+    finally:
+        session.batch.distributed_tasks = 0
+    assert out["m"][0] == 5 and out["s"][0] == 12 and out["c"][0] == 2
+    # all partitions NULL -> SQL NULL result
+    session.batch.distributed_tasks = 4
+    try:
+        out, _ = session.execute("SELECT max(v) AS m FROM nv WHERE k <= 2")
+    finally:
+        session.batch.distributed_tasks = 0
+    assert out["m"][0] is None

@@ -218,3 +218,14 @@ def test_create_source_datagen_sql(tmp_path):
     s.runtime.barrier()
     out, _ = s.execute("SELECT n FROM c")
     assert list(out["n"]) == [16]
+
+
+def test_json_parser_fractional_int_cell_becomes_null():
+    """A non-integral JSON number landing in an int lane follows the
+    bad-cell-becomes-NULL convention — never silent truncation
+    (advisor r4: int(3.7) -> 3 altered producer data)."""
+    schema = Schema([("id", DataType.INT64), ("v", DataType.INT64)])
+    p = JsonParser(schema)
+    assert p.parse('{"id": 1, "v": 3.7}') == (1, None)
+    assert p.parse('{"id": 2, "v": 4.0}') == (2, 4)  # integral float ok
+    assert p.parse('{"id": 3, "v": 5}') == (3, 5)

@@ -369,3 +369,47 @@ def test_having_decimal_group_key_scales_literal():
     )
     # unscaled comparison (raw 0.50-lane=50 > 1.5) would keep ALL groups
     assert len(out["c"]) == 2 and sorted(out["c"].tolist()) == [1, 1]
+
+
+def test_having_null_aggregate_follows_sql_null_semantics():
+    """A NULL aggregate output (sum over an all-NULL group) must make
+    the HAVING predicate NULL -> group dropped, not compare the lane's
+    numeric fill value (advisor r4: batch _having_filter stripped the
+    __null companions before evaluation)."""
+    from risingwave_tpu.frontend.session import SqlSession
+
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute("CREATE TABLE t (k BIGINT, v BIGINT)")
+    s.execute("INSERT INTO t VALUES (1, NULL), (1, NULL), (2, 5)")
+    # group 1's sum is SQL NULL: HAVING s >= 0 must drop it, and
+    # HAVING s = 0 must NOT resurrect it via the zero fill value
+    out, _ = s.execute(
+        "SELECT k, sum(v) AS s FROM t GROUP BY k HAVING s >= 0 ORDER BY k"
+    )
+    assert list(out["k"]) == [2]
+    out, _ = s.execute(
+        "SELECT k, sum(v) AS s FROM t GROUP BY k HAVING s = 0 ORDER BY k"
+    )
+    assert list(out["k"]) == []
+
+
+def test_order_by_null_aggregate_sorts_last():
+    """NULL aggregate outputs follow Postgres placement under ORDER BY:
+    larger than every value — last under ASC, first under DESC — and a
+    LIMIT must not let the numeric fill value beat a real group."""
+    from risingwave_tpu.frontend.session import SqlSession
+
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute("CREATE TABLE t (k BIGINT, v BIGINT)")
+    s.execute(
+        "INSERT INTO t VALUES (1, NULL), (1, NULL), (2, 5), (3, -2)"
+    )
+    out, _ = s.execute(
+        "SELECT k, sum(v) AS s FROM t GROUP BY k ORDER BY s LIMIT 2"
+    )
+    assert list(out["k"]) == [3, 2] and list(out["s"]) == [-2, 5]
+    out, _ = s.execute(
+        "SELECT k, sum(v) AS s FROM t GROUP BY k ORDER BY s DESC"
+    )
+    assert list(out["k"]) == [1, 2, 3]
+    assert out["s"][0] is None
