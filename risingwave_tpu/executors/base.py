@@ -83,6 +83,22 @@ class Executor:
         watermark_filter.rs emits into its output stream)."""
         return None
 
+    def pure_step(self):
+        """A pure device function chunk -> chunk equivalent to this
+        executor's ``apply`` (exactly one output chunk, no state), or
+        None. Stateless executors expose it so an epoch-batching
+        wrapper can trace them INTO a downstream stateful op's fused
+        per-epoch program (one device dispatch per epoch instead of one
+        per chunk — the XLA answer to the reference's per-chunk actor
+        loop, hash_agg.rs:326).
+
+        Contract: return a ``functools.partial`` of a MODULE-LEVEL
+        function whose bound arguments are hashable — the composition
+        is a static jit argument and must compare equal across executor
+        instances of the same plan shape, or every graph rebuild
+        recompiles the fused program."""
+        return None
+
     # -- overlapped barrier scalar reads ---------------------------------
     # Executors that must read device scalars at the barrier (overflow
     # latches, occupancy counters) ENQUEUE the packed read inside

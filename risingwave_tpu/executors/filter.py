@@ -51,3 +51,6 @@ class FilterExecutor(Executor):
 
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         return [_filter_step(chunk, self.pred)]
+
+    def pure_step(self):
+        return partial(_filter_step, pred=self.pred)

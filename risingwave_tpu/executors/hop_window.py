@@ -80,6 +80,15 @@ class HopWindowExecutor(Executor):
             _hop_step(chunk, self.ts_col, self.size_ms, self.slide_ms, self.out_start)
         ]
 
+    def pure_step(self):
+        return partial(
+            hop_step_fn,
+            ts_col=self.ts_col,
+            size_ms=self.size_ms,
+            slide_ms=self.slide_ms,
+            out_start=self.out_start,
+        )
+
     def on_watermark(self, watermark):
         """Translate an event-time watermark into a window_start
         watermark: a future row (ts >= wm) lands only in windows with

@@ -494,19 +494,25 @@ class DeviceMaterializeExecutor(Executor, Checkpointable):
 
     # -- data -------------------------------------------------------------
     def apply(self, chunk: StreamChunk):
-        self._maybe_grow(chunk.capacity)
+        self._maybe_grow(chunk)  # also advances the insert bound
         self.table, self.state = _mv_step(
             self.table, self.state, chunk, self.pk, self.columns
         )
-        self._bound += chunk.capacity
         return [chunk]
 
-    def _maybe_grow(self, incoming: int) -> None:
+    def _maybe_grow(self, chunk: StreamChunk) -> None:
         cap = self.table.capacity
-        if self._bound + incoming <= cap * GROW_AT:
+        if self._bound + chunk.capacity <= cap * GROW_AT:
+            self._bound += chunk.capacity
             return
-        # ONE packed transfer for both counters (tunnel RTT dominates)
-        claimed, survivors = read_scalars(
+        # agg flush chunks arrive at the agg's FULL state capacity with
+        # few live rows; taking capacity at face value would rebuild
+        # (= recompile, ~30-40s on a tunneled TPU) long before real
+        # load demands it. The cheap host-side bound uses capacity; at
+        # the trip point, ONE packed transfer (tunnel RTT dominates)
+        # refreshes true occupancy AND the chunk's true live count —
+        # the honest insert upper bound for the growth decision.
+        claimed, survivors, live = read_scalars(
             self.table.occupancy(),
             jnp.sum(
                 (
@@ -515,14 +521,15 @@ class DeviceMaterializeExecutor(Executor, Checkpointable):
                     | self.state.stored
                 ).astype(jnp.int32)
             ),
+            jnp.sum(chunk.valid.astype(jnp.int32)),
         )
-        new_cap = plan_rehash(cap, incoming, claimed, survivors, GROW_AT)
+        new_cap = plan_rehash(cap, int(live), claimed, survivors, GROW_AT)
         if new_cap is not None:
             self.table, self.state = _mv_rebuild(
                 self.table, self.state, new_cap
             )
             claimed = survivors
-        self._bound = claimed
+        self._bound = claimed + int(live)
 
     # -- control ----------------------------------------------------------
     def on_barrier(self, barrier) -> list:

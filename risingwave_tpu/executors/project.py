@@ -38,3 +38,6 @@ class ProjectExecutor(Executor):
 
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         return [_project_step(chunk, self.outputs)]
+
+    def pure_step(self):
+        return partial(_project_step, outputs=self.outputs)

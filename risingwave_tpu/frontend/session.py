@@ -253,43 +253,82 @@ class SqlSession:
             self.dml.add_target(stmt.name, stmt.name, "single")
             self._log_ddl(sql)
             return {}, "CREATE_TABLE"
+        return self._execute_create_mv_or_rest(stmt, sql)
+
+    def _register_planned(self, planned) -> None:
+        """Runtime-register one planned MV: subscribe fragment inputs
+        (tables / MVs) with the correct join side + backfill; attach
+        DML targets for raw base streams; expose to batch reads.
+        Shared by top-level MVs and lowered-join aux MVs."""
+        frag_inputs = {
+            s: side
+            for s, side in planned.inputs.items()
+            if s in self.runtime.fragments
+        }
+        self.runtime.register(planned.name, planned.pipeline)
+        try:
+            for s, side in frag_inputs.items():
+                # replay restores state from checkpoints afterwards:
+                # backfilling from empty uprights would double rows
+                self.runtime.subscribe(
+                    s,
+                    planned.name,
+                    side=side,
+                    backfill=not self._replaying,
+                )
+        except BaseException:
+            # keep the graph consistent on backfill failure: a
+            # half-registered fragment would crash later barriers
+            self.runtime.unregister(planned.name)
+            raise
+        if len(frag_inputs) < len(planned.inputs):
+            self.dml.attach(planned, skip=frag_inputs.keys())
+        self.batch.register(planned.name, planned.mview)
+
+    def _unregister_planned(self, planned) -> None:
+        """Undo EVERYTHING _register_planned did — stale DML targets
+        or batch registrations pointing at an unregistered fragment
+        would crash later INSERTs / serve half-built MVs."""
+        self.runtime.unregister(planned.name)
+        self.dml.detach_fragment(planned.name)
+        self.batch.tables.pop(planned.name, None)
+
+    def _execute_create_mv_or_rest(self, stmt, sql):
         if isinstance(stmt, P.CreateMaterializedView):
-            if self.exec_mode == "graph":
+            nested_join = isinstance(stmt.select.from_, P.Join) and (
+                isinstance(stmt.select.from_.left, P.Join)
+                or isinstance(stmt.select.from_.right, P.Join)
+            )
+            if self.exec_mode == "graph" and not nested_join:
                 from risingwave_tpu.runtime.fragmenter import graph_planned_mv
 
                 planned = graph_planned_mv(
                     self._fresh_planner, sql, parallelism=self.parallelism
                 )
             else:
+                # multi-way joins lower into a tree of hidden MVs
+                # (planner aux) — serial registration path
                 planned = self.planner.plan(sql)
             if planned.name in self.runtime.fragments:
                 raise ValueError(
                     f"relation {planned.name!r} already exists"
                 )
-            # each input is either an existing fragment (table / MV):
-            # subscribe its delta edge with the correct join side and
-            # backfill from its snapshot — or a raw base stream: attach
-            # a DML target so INSERTs land in this MV directly
-            frag_inputs = {
-                s: side
-                for s, side in planned.inputs.items()
-                if s in self.runtime.fragments
-            }
-            self.runtime.register(planned.name, planned.pipeline)
+            # register the lowered-join aux MVs first (deepest first):
+            # the outer join subscribes to their change streams
+            registered_aux = []
             try:
-                for s, side in frag_inputs.items():
-                    # replay restores state from checkpoints afterwards:
-                    # backfilling from empty uprights would double rows
-                    self.runtime.subscribe(
-                        s,
-                        planned.name,
-                        side=side,
-                        backfill=not self._replaying,
-                    )
+                for sub in planned.aux:
+                    self._register_planned(sub)
+                    registered_aux.append(sub)
+                self._register_planned(planned)
             except BaseException:
-                # keep the graph consistent on backfill failure: a
-                # half-registered fragment would crash later barriers
-                self.runtime.unregister(planned.name)
+                for sub in reversed(registered_aux):
+                    self._unregister_planned(sub)
+                # the planner added hidden aux entries to the catalog
+                # during lowering — a failed CREATE must not leak them
+                for sub in planned.aux:
+                    self.catalog.mvs.pop(sub.name, None)
+                    self.catalog.tables.pop(sub.name, None)
                 raise
             self.catalog.add_mv(planned)
             # overlay inferred LOGICAL types (decimal scale, varchar,
@@ -302,9 +341,6 @@ class SqlSession:
             self.catalog.tables[planned.name] = Schema(
                 tuple(inferred.get(f.name, f) for f in sch.fields)
             )
-            if len(frag_inputs) < len(planned.inputs):
-                self.dml.attach(planned, skip=frag_inputs.keys())
-            self.batch.register(planned.name, planned.mview)
             self._log_ddl(sql)
             if not self._replaying:
                 # CREATE returns once the backfill snapshot is visible

@@ -34,8 +34,11 @@ def snapshot_chunks(
     """
     snap = mview.snapshot()
     names = list(mview.pk) + list(mview.columns)
+    # host-map executors carry ``_dtypes``; the device-resident MV
+    # exposes ``dtypes`` — both map column -> numpy/jnp dtype
+    dt_map = getattr(mview, "_dtypes", None) or getattr(mview, "dtypes", {})
     dtypes = {
-        name: mview._dtypes.get(name, np.dtype(np.int64)) for name in names
+        name: np.dtype(dt_map.get(name, np.int64)) for name in names
     }
     rows = [list(k) + list(v) for k, v in snap.items()]
     out: List[StreamChunk] = []

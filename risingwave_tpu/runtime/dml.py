@@ -60,6 +60,19 @@ class DmlManager:
         own materializing fragment; MVs over it ride subscriptions)."""
         self._targets.setdefault(stream, []).append((fragment, side))
 
+    def detach_fragment(self, fragment: str) -> None:
+        """Drop every target routing into ``fragment`` — the rollback
+        path when a multi-MV registration fails halfway (a stale target
+        would crash later INSERTs on an unregistered fragment)."""
+        for stream in list(self._targets):
+            kept = [
+                (f, s) for f, s in self._targets[stream] if f != fragment
+            ]
+            if kept:
+                self._targets[stream] = kept
+            else:
+                del self._targets[stream]
+
     def execute(self, sql: str) -> int:
         stmt = P.parse(sql)
         if not isinstance(stmt, P.InsertValues):

@@ -151,7 +151,10 @@ class PartitionedStateView(Checkpointable):
         by_tid: Dict[str, List[StateDelta]] = {}
         order: List[str] = []
         for inst in self._instances:
-            for d in inst.checkpoint_delta():
+            # instances capture per-epoch deltas in their actor threads
+            # under pipelined barriers; consume those (epoch order) or
+            # fall back to a live pull in synchronous mode
+            for d in inst.staged_or_live_delta():
                 if d.table_id not in by_tid:
                     order.append(d.table_id)
                 by_tid.setdefault(d.table_id, []).append(d)
@@ -221,6 +224,10 @@ class PartitionedStateView(Checkpointable):
             if fn is not None:
                 fn()
 
+    def discard_captured(self) -> None:
+        for i in self._instances:
+            i.discard_captured()
+
     def on_recover(self, epoch: int) -> None:
         for i in self._instances:
             fn = getattr(i, "on_recover", None)
@@ -275,9 +282,13 @@ class GraphPipeline:
         source_map: Dict[str, str],  # side ("single"/"left"/"right") -> frag
         out_fragment: str,
         ckpt_executors: Sequence[object],
+        epoch_batch: bool = True,
     ):
         self._specs = list(specs)
-        self.graph = GraphRuntime(self._specs).start()
+        self._epoch_batch = epoch_batch
+        self.graph = GraphRuntime(
+            self._specs, epoch_batch=epoch_batch
+        ).start()
         self._sources = dict(source_map)
         self._out = out_fragment
         self._executors = list(ckpt_executors)
@@ -292,8 +303,11 @@ class GraphPipeline:
             self.graph.stop(timeout=1.0)
         except BaseException:
             pass  # a wedged/failed graph cannot block the rebuild
-        self.graph = GraphRuntime(self._specs).start()
+        self.graph = GraphRuntime(
+            self._specs, epoch_batch=self._epoch_batch
+        ).start()
         self.graph._epoch = self._epoch
+        self.graph.capture_deltas = getattr(self, "_capture", False)
 
     # the runtime assigns p._epoch on registration/recovery; keep the
     # actor graph's barrier clock in lockstep so injected epochs stay
@@ -335,6 +349,16 @@ class GraphPipeline:
     def barrier(
         self, checkpoint: bool = True, epoch: Optional[int] = None
     ) -> List[StreamChunk]:
+        target = self.barrier_nowait(checkpoint=checkpoint, epoch=epoch)
+        return self.wait_barrier(target)
+
+    # -- pipelined barriers (in-flight epochs, barrier/mod.rs:538) -------
+    def barrier_nowait(
+        self, checkpoint: bool = True, epoch: Optional[int] = None
+    ) -> int:
+        """Inject the barrier and return its epoch WITHOUT draining:
+        pushes made after this belong to the next epoch while the
+        actors are still flushing this one."""
         prev = self._epoch
         target = (
             epoch
@@ -342,9 +366,21 @@ class GraphPipeline:
             else max(int(time.time() * 1000) << 16, prev + 1)
         )
         self._epoch = prev  # keep graph clock aligned before inject
-        self.graph.inject_barrier(checkpoint=checkpoint, epoch=target)
+        self.graph.inject_barrier_nowait(checkpoint=checkpoint, epoch=target)
         self.__dict__["_epoch_val"] = target
+        return target
+
+    def wait_barrier(self, epoch: int) -> List[StreamChunk]:
+        """Block until every actor collected ``epoch``; drain what the
+        terminal fragment emitted."""
+        self.graph.wait_barrier(epoch)
         return self.graph.drain(self._out)
+
+    def set_capture(self, enabled: bool) -> None:
+        """Actors seal checkpoint deltas at the barrier (pipelined
+        checkpointing); survives ``rebuild``."""
+        self._capture = enabled
+        self.graph.capture_deltas = enabled
 
     def close(self) -> None:
         self.graph.stop()
@@ -572,7 +608,9 @@ def _shard_side_chain(chain, mesh):
 # ---------------------------------------------------------------------------
 
 
-def graph_planned_mv(planner_factory, sql: str, parallelism: int = 1):
+def graph_planned_mv(
+    planner_factory, sql: str, parallelism: int = 1, epoch_batch: bool = True
+):
     """Plan ``sql`` once per instance with FRESH planners (identical,
     deterministic table_ids across instances — they are partitions of
     the same logical tables) and return a PlannedMV whose pipeline is a
@@ -580,6 +618,11 @@ def graph_planned_mv(planner_factory, sql: str, parallelism: int = 1):
     single-actor graph — same SQL, same results, still actors."""
     n = max(1, parallelism)
     proto = planner_factory().plan(sql)
+    if getattr(proto, "aux", ()):
+        # lowered multi-MV plans (nested joins / decorrelated scalar
+        # subqueries) are wired through runtime subscription edges; the
+        # actor-graph wrapper would drop the aux list — run them serial
+        return proto
     # decide partitionability on the prototype BEFORE paying for N-1
     # more planner passes — a non-partitionable shape falls back to a
     # single-actor graph using only the prototype
@@ -590,7 +633,7 @@ def graph_planned_mv(planner_factory, sql: str, parallelism: int = 1):
             if sides is not None
             else [proto]
         )
-        gp = _two_input_graph(plans, sides)
+        gp = _two_input_graph(plans, sides, epoch_batch=epoch_batch)
     else:
         split = (
             _split_single(list(proto.pipeline.executors)) if n > 1 else None
@@ -600,7 +643,7 @@ def graph_planned_mv(planner_factory, sql: str, parallelism: int = 1):
             if split is not None
             else [proto]
         )
-        gp = _single_graph(plans, split)
+        gp = _single_graph(plans, split, epoch_batch=epoch_batch)
     from risingwave_tpu.sql.planner import PlannedMV
 
     return PlannedMV(
@@ -608,19 +651,22 @@ def graph_planned_mv(planner_factory, sql: str, parallelism: int = 1):
     )
 
 
-def _singleton_graph(chain, source_map_side="single"):
+def _singleton_graph(chain, source_map_side="single", epoch_batch=True):
     name = "mv"
     specs = [FragmentSpec(name, lambda i, ch=tuple(chain): list(ch))]
-    return GraphPipeline(specs, {source_map_side: name}, name, list(chain))
+    return GraphPipeline(
+        specs, {source_map_side: name}, name, list(chain),
+        epoch_batch=epoch_batch,
+    )
 
 
-def _single_graph(plans, split) -> GraphPipeline:
+def _single_graph(plans, split, epoch_batch=True) -> GraphPipeline:
     chains = [list(p.pipeline.executors) for p in plans]
     chain0 = chains[0]
     n = len(plans)
 
     if split is None or n == 1:
-        return _singleton_graph(chain0)
+        return _singleton_graph(chain0, epoch_batch=epoch_batch)
     prefix_len, dispatch_cols, positions_by_idx = split
 
     specs = [
@@ -649,7 +695,9 @@ def _single_graph(plans, split) -> GraphPipeline:
                 )
             )
     ckpt.extend(chain0[prefix_len:])
-    return GraphPipeline(specs, {"single": "src"}, "mat", ckpt)
+    return GraphPipeline(
+        specs, {"single": "src"}, "mat", ckpt, epoch_batch=epoch_batch
+    )
 
 
 def _split_single(chain):
@@ -686,7 +734,7 @@ def _split_single(chain):
     return keyed_idx + 1, dispatch, positions
 
 
-def _two_input_graph(plans, sides) -> GraphPipeline:
+def _two_input_graph(plans, sides, epoch_batch=True) -> GraphPipeline:
     tp0 = plans[0].pipeline
     n = len(plans)
     if sides is None or n == 1:
@@ -710,6 +758,7 @@ def _two_input_graph(plans, sides) -> GraphPipeline:
             {"left": "left_src", "right": "right_src"},
             "join",
             tp0.executors,
+            epoch_batch=epoch_batch,
         )
     ldisp, rdisp, join_positions, side_positions = sides
 
@@ -755,7 +804,11 @@ def _two_input_graph(plans, sides) -> GraphPipeline:
     )
     ckpt.extend(tp0.tail)
     return GraphPipeline(
-        specs, {"left": "left_src", "right": "right_src"}, "mat", ckpt
+        specs,
+        {"left": "left_src", "right": "right_src"},
+        "mat",
+        ckpt,
+        epoch_batch=epoch_batch,
     )
 
 

@@ -320,6 +320,14 @@ class FragmentActor(threading.Thread):
             self._send_watermark_downstream(wm)
         for ex in self.executors:
             ex.finish_barrier()
+        if b.checkpoint and self.mgr.capture_deltas:
+            # pipelined barriers: seal this epoch's delta NOW, before
+            # any next-epoch chunk in the input queue mutates state
+            # (shared-buffer seal; uploader.rs:548 overlap analogue)
+            for ex in self.executors:
+                cap = getattr(ex, "capture_checkpoint", None)
+                if cap is not None:
+                    cap()
         self.dispatcher.control(BARRIER, b)
         self.mgr._collect(self.actor_name, b)
 
@@ -524,10 +532,17 @@ class GraphRuntime:
     barriers at sources, waits for whole-graph collection."""
 
     def __init__(
-        self, specs: Sequence[FragmentSpec], channel_permits: int = 1 << 16
+        self,
+        specs: Sequence[FragmentSpec],
+        channel_permits: int = 1 << 16,
+        epoch_batch: bool = True,
     ):
         self.specs = {s.name: s for s in specs}
         self._channel_permits = channel_permits
+        self._epoch_batch = epoch_batch
+        # pipelined barriers: actors seal checkpoint deltas at the
+        # barrier instead of the runtime staging after a full drain
+        self.capture_deltas = False
         self.actors: List[FragmentActor] = []
         self.collectors: Dict[str, _Collector] = {}
         self._source_channels: Dict[str, List[PermitChannel]] = {}
@@ -593,6 +608,24 @@ class GraphRuntime:
         for s in specs:
             for inst in range(s.parallelism):
                 built = s.build(inst)
+                if self._epoch_batch:
+                    # fuse [stateless*, HashAgg] runs into per-epoch
+                    # batched ops — the actor's data path only; the
+                    # pipeline's checkpoint registry keeps holding the
+                    # original executor objects
+                    from risingwave_tpu.executors.epoch_batch import (
+                        fuse_epoch_batch,
+                    )
+
+                    if isinstance(built, dict):
+                        built = dict(
+                            built,
+                            left=fuse_epoch_batch(built.get("left", [])),
+                            right=fuse_epoch_batch(built.get("right", [])),
+                            tail=fuse_epoch_batch(built.get("tail", [])),
+                        )
+                    else:
+                        built = fuse_epoch_batch(built)
                 downstream = out_edges[s.name][inst]
                 if downstream:
                     # one dispatcher fanning to every downstream edge:
@@ -652,16 +685,13 @@ class GraphRuntime:
             for ch in chans:
                 ch.send_control(WATERMARK, Watermark(column, value))
 
-    def inject_barrier(
-        self,
-        checkpoint: bool = True,
-        timeout: float = 120.0,
-        epoch: Optional[int] = None,
+    def inject_barrier_nowait(
+        self, checkpoint: bool = True, epoch: Optional[int] = None
     ) -> Barrier:
-        """Send a barrier into every source and block until every actor
-        collected it (barrier_manager.rs:857 collect). ``epoch`` pins
-        the barrier's curr epoch (a runtime passes its own clock so the
-        graph's epochs line up with checkpoint manifests)."""
+        """Send a barrier into every source WITHOUT waiting for
+        collection — channels are FIFO, so pushes enqueued after this
+        belong to the next epoch while actors still process this one
+        (the reference's in-flight barriers, barrier/mod.rs:538)."""
         prev = self._epoch
         target = epoch if epoch is not None else prev + 1
         if target <= prev:
@@ -669,15 +699,20 @@ class GraphRuntime:
         self._epoch = target
         b = Barrier(Epoch(prev, self._epoch), checkpoint)
         with self._collect_lock:
-            self._collected[self._epoch] = set()
+            self._collected[target] = set()
         for chans in self._source_channels.values():
             for ch in chans:
                 ch.send_control(BARRIER, b)
+        return b
+
+    def wait_barrier(self, epoch: int, timeout: float = 120.0) -> None:
+        """Block until every actor collected ``epoch``
+        (barrier_manager.rs:857 collect)."""
         with self._collect_lock:
             try:
                 ok = self._collect_lock.wait_for(
                     lambda: self._failure is not None
-                    or len(self._collected.get(self._epoch, ()))
+                    or len(self._collected.get(epoch, ()))
                     == len(self.actors),
                     timeout=timeout,
                 )
@@ -685,12 +720,25 @@ class GraphRuntime:
                     raise RuntimeError("actor failed") from self._failure
                 if not ok:
                     raise TimeoutError(
-                        f"barrier {self._epoch} not collected: "
-                        f"{len(self._collected.get(self._epoch, ()))}"
+                        f"barrier {epoch} not collected: "
+                        f"{len(self._collected.get(epoch, ()))}"
                         f"/{len(self.actors)} actors"
                     )
             finally:
-                self._collected.pop(self._epoch, None)
+                self._collected.pop(epoch, None)
+
+    def inject_barrier(
+        self,
+        checkpoint: bool = True,
+        timeout: float = 120.0,
+        epoch: Optional[int] = None,
+    ) -> Barrier:
+        """Send a barrier into every source and block until every actor
+        collected it. ``epoch`` pins the barrier's curr epoch (a
+        runtime passes its own clock so the graph's epochs line up with
+        checkpoint manifests)."""
+        b = self.inject_barrier_nowait(checkpoint=checkpoint, epoch=epoch)
+        self.wait_barrier(b.epoch.curr, timeout=timeout)
         return b
 
     def stop(self, timeout: float = 30.0) -> None:
@@ -705,6 +753,12 @@ class GraphRuntime:
             self._abort.set()
             for a in self.actors:
                 a.join(timeout=5.0)
+        # wake anyone blocked in wait_barrier on an epoch this graph
+        # will never collect (a pipelined closer during recovery)
+        with self._collect_lock:
+            if self._failure is None and self._collected:
+                self._failure = RuntimeError("graph stopped")
+            self._collect_lock.notify_all()
 
     def drain(self, name: str) -> List[StreamChunk]:
         return self.collectors[name].drain()

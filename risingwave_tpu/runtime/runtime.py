@@ -74,6 +74,7 @@ class StreamingRuntime:
         compact_at: int = 8,
         memory_budget_bytes: Optional[int] = None,
         auto_recover: bool = False,
+        in_flight_barriers: int = 1,
     ):
         # failure detection + self-healing (barrier/mod.rs:676-710 +
         # recovery.rs:353): a poisoned epoch or dead actor surfacing at
@@ -118,6 +119,19 @@ class StreamingRuntime:
         self._compact_idle.set()
         self.compaction_errors: List[BaseException] = []
         self._work_abort = threading.Event()
+        # pipelined barriers (barrier/mod.rs:538 in_flight_barrier_nums):
+        # barrier() returns at ADMISSION (inject only); a closer thread
+        # waits for collection, stages the actor-sealed deltas, and
+        # feeds the async commit lane — up to ``in_flight_barriers``
+        # epochs overlap. Requires graph-backed fragments and no
+        # subscription edges (validated at the first pipelined barrier).
+        self.in_flight_barriers = max(1, in_flight_barriers)
+        self._closer_q: deque = deque()
+        self._closer_cv = threading.Condition()
+        self._closer: Optional[threading.Thread] = None
+        self._closer_err: List[BaseException] = []
+        self._closer_abort = threading.Event()
+        self.epoch_close_ms: List[float] = []  # admission -> closed
         # serializes barrier/DDL/DML against a background barrier clock
         # (the CLI's tick thread vs pgwire sessions — the reference
         # serializes via the meta barrier scheduler's command queue)
@@ -209,10 +223,15 @@ class StreamingRuntime:
                 del self._subs[up]
 
     def _fragment_mview(self, name: str):
-        from risingwave_tpu.executors.materialize import MaterializeExecutor
+        from risingwave_tpu.executors.materialize import (
+            DeviceMaterializeExecutor,
+            MaterializeExecutor,
+        )
 
         for ex in reversed(self.fragments[name].executors):
-            if isinstance(ex, MaterializeExecutor):
+            if isinstance(
+                ex, (MaterializeExecutor, DeviceMaterializeExecutor)
+            ):
                 return ex
         raise ValueError(f"fragment {name!r} has no materialize stage")
 
@@ -222,6 +241,14 @@ class StreamingRuntime:
             return p.push_left(chunk)
         if side == "right":
             return p.push_right(chunk)
+        if side == "both":
+            # self-join: ONE base stream feeds both join inputs (the
+            # Nexmark q7 shape — bid joined against its own per-window
+            # max); the reference realizes this as two upstream edges
+            # from the same fragment
+            outs = p.push_left(chunk)
+            outs.extend(p.push_right(chunk))
+            return outs
         return p.push(chunk)
 
     def push(self, name: str, chunk: StreamChunk, side: str = "single"):
@@ -306,7 +333,119 @@ class StreamingRuntime:
                 fn()
         self.recover()
 
+    # -- pipelined barrier path (in_flight_barriers > 1) -----------------
+    def _validate_pipelined(self) -> None:
+        if self._subs:
+            raise ValueError(
+                "pipelined barriers do not support subscription edges "
+                "(MV-on-MV needs synchronous epoch routing) — use "
+                "in_flight_barriers=1"
+            )
+        for name, p in self.fragments.items():
+            if not hasattr(p, "barrier_nowait"):
+                raise ValueError(
+                    f"fragment {name!r} is not graph-backed; pipelined "
+                    "barriers need GraphPipeline fragments"
+                )
+            if self.mgr is not None:
+                p.set_capture(True)
+
+    def _barrier_pipelined(self) -> Dict[str, List[StreamChunk]]:
+        t0 = time.perf_counter()
+        self._raise_closer_error()
+        self._raise_worker_error()
+        self._validate_pipelined()
+        prev, self._epoch = self._epoch, self.next_epoch()
+        self._barrier_seq += 1
+        is_ckpt = (
+            self.mgr is not None
+            and self._barrier_seq % self.checkpoint_frequency == 0
+        )
+        for _name, p in self.fragments.items():
+            p._epoch = prev
+            p.barrier_nowait(checkpoint=is_ckpt, epoch=self._epoch)
+        with self._closer_cv:
+            self._closer_q.append((self._epoch, is_ckpt, t0))
+            self._ensure_closer()
+            self._closer_cv.notify_all()
+            # admission control: bounded in-flight epochs
+            self._closer_cv.wait_for(
+                lambda: len(self._closer_q) < self.in_flight_barriers
+                or bool(self._closer_err)
+            )
+        self._raise_closer_error()
+        ms = (time.perf_counter() - t0) * 1e3
+        self.barrier_latencies_ms.append(ms)  # ADMISSION latency
+        REGISTRY.histogram("barrier_latency_ms").observe(ms)
+        REGISTRY.counter("barriers_total").inc()
+        return {}
+
+    def _ensure_closer(self) -> None:
+        if self._closer is None or not self._closer.is_alive():
+            self._closer = threading.Thread(
+                target=self._closer_loop, daemon=True
+            )
+            self._closer.start()
+
+    def _closer_loop(self) -> None:
+        while True:
+            with self._closer_cv:
+                if not self._closer_q:
+                    self._closer_cv.wait(timeout=0.5)
+                    if not self._closer_q:
+                        continue
+                epoch, is_ckpt, t_adm = self._closer_q[0]
+            try:
+                if not self._closer_err and not self._closer_abort.is_set():
+                    for name, p in self.fragments.items():
+                        with span("barrier.close", fragment=name):
+                            p.wait_barrier(epoch)
+                    if is_ckpt:
+                        # deltas were SEALED by the actors at the
+                        # barrier (capture_checkpoint): stage consumes
+                        # host buffers, never racing next-epoch compute
+                        t_staged = time.perf_counter()
+                        with span("checkpoint.stage", epoch=epoch):
+                            staged = self.mgr.stage(self.executors())
+                        REGISTRY.counter("checkpoints_total").inc()
+                        with self._inflight_lock:
+                            self._inflight += 1
+                        self._work_q.append((epoch, staged, t_staged))
+                        self._ensure_worker()
+                        self._work_event.set()
+                    self.epoch_close_ms.append(
+                        (time.perf_counter() - t_adm) * 1e3
+                    )
+            except BaseException as e:  # surfaced at the next barrier
+                self._closer_err.append(e)
+            finally:
+                with self._closer_cv:
+                    if self._closer_q and self._closer_q[0][0] == epoch:
+                        self._closer_q.popleft()
+                    self._closer_cv.notify_all()
+
+    def _raise_closer_error(self) -> None:
+        if self._closer_err:
+            raise RuntimeError(
+                "pipelined barrier close failed"
+            ) from self._closer_err[0]
+
+    def wait_epochs(self) -> None:
+        """Join the closer lane: every admitted barrier fully closed
+        (collection + staging done; commits may still be in the async
+        lane — ``wait_checkpoints`` joins those too)."""
+        with self._closer_cv:
+            self._closer_cv.wait_for(lambda: not self._closer_q)
+        self._raise_closer_error()
+
+    def p99_epoch_close_ms(self) -> float:
+        if not self.epoch_close_ms:
+            return 0.0
+        return float(np.percentile(self.epoch_close_ms, 99))
+
     def _barrier_locked(self) -> Dict[str, List[StreamChunk]]:
+        if self.in_flight_barriers > 1:
+            return self._barrier_pipelined()
         t0 = time.perf_counter()
         prev, self._epoch = self._epoch, self.next_epoch()
         self._barrier_seq += 1
@@ -515,6 +654,8 @@ class StreamingRuntime:
         """Join the async lane (the FLUSH / sync-epoch analogue).
         Compaction intentionally does NOT block this (it runs on its
         own worker — ADVICE r2: inline compaction stalled FLUSH)."""
+        if self.in_flight_barriers > 1:
+            self.wait_epochs()  # staging happens in the closer lane
         while True:
             with self._inflight_lock:
                 if self._inflight == 0:
@@ -546,6 +687,10 @@ class StreamingRuntime:
         # abort the async lane FIRST: staged epochs still queued refer
         # to pre-recovery state; committing one after the restore would
         # advance the manifest past the epoch we just recovered to
+        self._closer_abort.set()
+        with self._closer_cv:
+            self._closer_cv.notify_all()
+            self._closer_cv.wait_for(lambda: not self._closer_q, timeout=150)
         self._work_abort.set()
         while True:
             with self._inflight_lock:
@@ -565,7 +710,13 @@ class StreamingRuntime:
             fn = getattr(ex, "discard_pending", None)
             if fn is not None:
                 fn()
+            # captured deltas of rolled-back epochs are stale
+            fn = getattr(ex, "discard_captured", None)
+            if fn is not None:
+                fn()
         self._work_err.clear()
+        self._closer_err.clear()
+        self._closer_abort.clear()
         self._epoch = self.mgr.max_committed_epoch
         for p in self.fragments.values():
             p._epoch = self._epoch

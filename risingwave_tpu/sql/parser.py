@@ -82,6 +82,16 @@ class SubQuery:
 
 
 @dataclass(frozen=True)
+class ScalarSubQuery:
+    """(SELECT <scalar agg expr> FROM t [WHERE corr]) used as an
+    expression (reference: binder/expr/subquery.rs:22). The planner
+    decorrelates the supported shapes into joins against grouped-agg
+    MVs."""
+
+    select: "Select"
+
+
+@dataclass(frozen=True)
 class Join:
     left: object  # relation
     right: object
@@ -627,6 +637,12 @@ class Parser:
             self.expect("kw", "end")
             return CaseExpr(tuple(branches), default)
         if self.accept("op", "("):
+            if self.peek().kind == "kw" and self.peek().value == "select":
+                # scalar subquery: (SELECT <agg expr> FROM ... [WHERE ...])
+                # (reference: binder/expr/subquery.rs:22)
+                sub = self.select()
+                self.expect("op", ")")
+                return ScalarSubQuery(sub)
             e = self.expr()
             self.expect("op", ")")
             return e

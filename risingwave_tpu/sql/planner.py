@@ -37,6 +37,7 @@ from risingwave_tpu.executors import (
     MaterializeExecutor,
     ProjectExecutor,
 )
+from risingwave_tpu.executors.materialize import DeviceMaterializeExecutor
 from risingwave_tpu.executors.row_id_gen import RowIdGenExecutor
 from risingwave_tpu.expr import expr as E
 from risingwave_tpu.ops.agg import AggCall
@@ -58,13 +59,27 @@ class BoundRel:
     alias: Optional[str]
 
 
+def _join_inputs(lsrc: str, rsrc: str) -> Dict[str, str]:
+    """Join input map; a SELF-join (both sides read one base stream,
+    the Nexmark q7 shape) collapses to side "both" so the runtime
+    feeds each source chunk to both inputs."""
+    if lsrc == rsrc:
+        return {lsrc: "both"}
+    return {lsrc: "left", rsrc: "right"}
+
+
 @dataclass
 class PlannedMV:
     name: str
     pipeline: Union[Pipeline, TwoInputPipeline]
     mview: MaterializeExecutor
-    inputs: Dict[str, str]  # base stream name -> "single"|"left"|"right"
+    inputs: Dict[str, str]  # base stream name -> "single"|"left"|"right"|"both"
     schema: Optional[Dict[str, object]] = None  # output col -> dtype
+    # hidden MVs a multi-way join lowered into (registered by the
+    # session BEFORE this one, in list order — deepest first; the
+    # reference fragments an n-way join into a tree of 2-way
+    # StreamHashJoins the same way)
+    aux: Tuple["PlannedMV", ...] = ()
 
 
 class Catalog:
@@ -210,6 +225,23 @@ def _is_agg(ast) -> bool:
     return isinstance(ast, P.FuncCall) and ast.name in AGG_FUNCS
 
 
+def _contains_agg(ast) -> bool:
+    if _is_agg(ast):
+        return True
+    if isinstance(ast, P.BinaryOp):
+        return _contains_agg(ast.left) or _contains_agg(ast.right)
+    if isinstance(ast, P.UnaryOp):
+        return _contains_agg(ast.operand)
+    return False
+
+
+def _split_and(e) -> List[object]:
+    """Flatten AND-ed conjuncts."""
+    if isinstance(e, P.BinaryOp) and e.op == "and":
+        return _split_and(e.left) + _split_and(e.right)
+    return [e]
+
+
 def _idents_in_select(select: P.Select):
     """Column references in select items + GROUP BY (not WHERE)."""
     for item in select.items:
@@ -263,6 +295,7 @@ class StreamPlanner:
         from risingwave_tpu.sql.optimizer import optimize_select
         from risingwave_tpu.sql.typing import typecheck_select
 
+        select = self._decorrelate(select)
         select = typecheck_select(
             select, self.catalog, getattr(self, "strings", None)
         )
@@ -297,15 +330,57 @@ class StreamPlanner:
     # -- single-input ----------------------------------------------------
     def _plan_single(self, name: str, select: P.Select) -> PlannedMV:
         rel = self._plan_rel(name, select)
-        mview = MaterializeExecutor(
-            pk=rel.pk,
-            columns=tuple(c for c in rel.schema if c not in rel.pk),
-            table_id=f"{name}.mview",
-        )
+        mview = self._make_mview(name, rel)
         pipeline = Pipeline(rel.chain + [mview])
         return PlannedMV(
             name, pipeline, mview, {rel.source: "single"}, schema=rel.schema
         )
+
+    def _make_mview(self, name: str, rel):
+        """Pick the MV backend: the DEVICE-resident executor when the
+        plan provably never delivers a NULL lane to it — the host-map
+        executor pulls every flush chunk to the host (~100ms/chunk on
+        a tunneled TPU, memory: DeviceMaterializeExecutor docstring),
+        so agg MVs like Nexmark q5 must stay in HBM end to end.
+
+        Provably NULL-free today: terminal HashAgg with non-nullable
+        group keys and count-only outputs, reached only through
+        column-move projects / filters. Everything else keeps the
+        host-map executor (its object rows embed None natively)."""
+        cols = tuple(c for c in rel.schema if c not in rel.pk)
+        if rel.pk and self._device_mv_safe(rel.chain):
+            return DeviceMaterializeExecutor(
+                pk=rel.pk,
+                columns=cols,
+                schema_dtypes=rel.schema,
+                table_id=f"{name}.mview",
+                capacity=self.capacity,
+            )
+        return MaterializeExecutor(
+            pk=rel.pk, columns=cols, table_id=f"{name}.mview"
+        )
+
+    @staticmethod
+    def _device_mv_safe(chain) -> bool:
+        from risingwave_tpu.expr import expr as E
+
+        for ex in reversed(list(chain)):
+            if isinstance(ex, FilterExecutor):
+                continue  # drops/retracts rows, never adds NULLs
+            if isinstance(ex, ProjectExecutor):
+                # column moves only — computed expressions could
+                # introduce NULL lanes the device MV didn't declare
+                if all(
+                    isinstance(expr, E.Col) for _, expr in ex.outputs
+                ):
+                    continue
+                return False
+            if isinstance(ex, HashAggExecutor):
+                return not any(ex.nullable) and all(
+                    c.kind in ("count_star", "count") for c in ex.calls
+                )
+            return False
+        return False
 
     def _from_bound(self, name: str, src) -> BoundRel:
         """FROM clause -> BoundRel (source chain + schema, no select
@@ -703,11 +778,91 @@ class StreamPlanner:
         )
 
     def _plan_join(self, name: str, select: P.Select) -> PlannedMV:
+        import dataclasses as _dc
+
+        aux: List[PlannedMV] = []
+        planned = self._plan_join_core(name, select, aux)
+        if aux:
+            planned = _dc.replace(planned, aux=tuple(aux))
+        return planned
+
+    def _lower_nested_join(
+        self, name: str, jast: P.Join, aux: List[PlannedMV]
+    ) -> BoundRel:
+        """Left-deep multi-way joins: plan a NESTED join as a hidden
+        MV (``{name}__jK``) and treat its change stream as one input
+        of the outer 2-way join — MV-on-MV lowering. The reference
+        fragments an n-way join into a tree of 2-way StreamHashJoins
+        (optimizer on e2e_test/tpch q3); here the tree edges are the
+        runtime's subscription edges."""
+        if jast.join_type != "inner":
+            raise ValueError(
+                "only INNER nested joins lower to MV trees (outer/"
+                "semi nesting unsupported)"
+            )
+        inner_name = f"{name}__j{len(aux)}"
+        # discover the inner result's visible columns + qualifiers with
+        # a THROWAWAY binder pass (self._tid stays untouched)
+        sides: List[object] = []
+
+        def flat(j):
+            if isinstance(j, P.Join):
+                flat(j.left)
+                flat(j.right)
+            else:
+                sides.append(j)
+
+        flat(jast)
+        tmp = StreamPlanner(self.catalog, capacity=self.capacity)
+        cols: List[str] = []
+        quals: set = set()
+        for srel in sides:
+            r = tmp._rel_of(inner_name, srel)
+            cols.extend(c for c in r.schema if not c.startswith("_"))
+            if r.alias:
+                quals.add(r.alias)
+        inner_sel = P.Select(
+            items=tuple(P.SelectItem(P.Ident(c), None) for c in cols),
+            from_=jast,
+            where=None,
+            group_by=(),
+        )
+        inner = self._plan_join_core(inner_name, inner_sel, aux)
+        aux.append(inner)
+        self.catalog.add_mv(inner)
+        # hidden pk lanes (_row_id) must not collide with the outer
+        # side's own hidden lanes: rename them behind a projector
+        return self._rename_hidden(
+            BoundRel(
+                [],
+                dict(inner.schema),
+                tuple(inner.mview.pk),
+                inner_name,
+                frozenset(quals | {inner_name}),
+            ),
+            inner_name,
+        )
+
+    def _plan_join_core(
+        self, name: str, select: P.Select, aux: List[PlannedMV]
+    ) -> PlannedMV:
         join: P.Join = select.from_
         if isinstance(join.left, P.Join):
-            raise ValueError("multi-way joins not supported yet")
-        left = self._rel_of(name, join.left)
-        right = self._rel_of(name, join.right)
+            left = self._lower_nested_join(name, join.left, aux)
+        else:
+            left = self._rel_of(name, join.left)
+        if isinstance(join.right, P.Join):
+            right = self._lower_nested_join(name, join.right, aux)
+        else:
+            right = self._rel_of(name, join.right)
+        # hidden planner lanes (_row_id) may exist on BOTH sides (two
+        # non-aggregating derived tables); rename them apart — user
+        # columns still must be disjoint, enforced below
+        if {c for c in left.schema if c.startswith("_")} & {
+            c for c in right.schema if c.startswith("_")
+        }:
+            left = self._rename_hidden(left, "l")
+            right = self._rename_hidden(right, "r")
         if set(left.schema) & set(right.schema):
             raise ValueError(
                 f"join sides share column names: "
@@ -784,9 +939,103 @@ class StreamPlanner:
                 name,
                 pipeline,
                 mview,
-                {left.source: "left", right.source: "right"},
+                _join_inputs(left.source, right.source),
                 schema=gout,
             )
+        if not semi_anti and any(
+            _contains_agg(it.expr) for it in select.items
+        ):
+            # GLOBAL aggregate over a joined stream (TPC-H q17's outer
+            # ``sum(l_extendedprice) / 7``): SimpleAgg (retraction-safe
+            # signed updates) + a post-projection computing arbitrary
+            # scalar expressions over the lifted agg outputs
+            from risingwave_tpu.executors.simple_agg import (
+                SimpleAggExecutor,
+            )
+
+            merged = {**left.schema, **right.schema}
+            calls: List[AggCall] = []
+            agg_schema: Dict[str, object] = {}
+            tmp = [0]
+
+            def lift(ast):
+                if _is_agg(ast):
+                    out = f"__a{tmp[0]}"
+                    tmp[0] += 1
+                    if ast.args == ("*",):
+                        if ast.name != "count":
+                            raise ValueError(f"{ast.name}(*) unsupported")
+                        calls.append(AggCall("count_star", None, out))
+                        agg_schema[out] = jnp.dtype(jnp.int64)
+                    else:
+                        arg = ast.args[0]
+                        if not isinstance(arg, P.Ident):
+                            raise ValueError(
+                                "aggregate args must be bare columns "
+                                "(project first)"
+                            )
+                        n = self._join_resolve(arg, left, right)
+                        calls.append(AggCall(AGG_FUNCS[ast.name], n, out))
+                        agg_schema[out] = merged[n]
+                    return P.Ident(out)
+                if isinstance(ast, P.BinaryOp):
+                    return P.BinaryOp(ast.op, lift(ast.left), lift(ast.right))
+                if isinstance(ast, P.UnaryOp):
+                    return P.UnaryOp(ast.op, lift(ast.operand))
+                if isinstance(ast, P.Literal):
+                    return ast
+                raise ValueError(
+                    "ungrouped join aggregates: items must be aggregate "
+                    "expressions"
+                )
+
+            lifted = []
+            for i, item in enumerate(select.items):
+                outn = item.alias or f"col{i}"
+                lifted.append((outn, lift(item.expr), item.expr))
+            tail.append(
+                SimpleAggExecutor(
+                    tuple(calls), merged, table_id=self._tid(name, "sagg")
+                )
+            )
+            outputs: Dict[str, E.Expr] = {}
+            gout: Dict[str, object] = {}
+
+            def _has_float_lit(a):
+                if isinstance(a, P.Literal):
+                    return isinstance(a.value, float)
+                if isinstance(a, P.BinaryOp):
+                    return _has_float_lit(a.left) or _has_float_lit(a.right)
+                if isinstance(a, P.UnaryOp):
+                    return _has_float_lit(a.operand)
+                return False
+
+            for outn, lexpr, orig in lifted:
+                outputs[outn] = compile_scalar(
+                    lexpr, Binder(agg_schema, None)
+                )
+                if isinstance(lexpr, P.Ident):
+                    gout[outn] = agg_schema[lexpr.name]
+                else:
+                    gout[outn] = jnp.dtype(
+                        jnp.float64 if _has_float_lit(orig) else jnp.int64
+                    )
+            tail.append(ProjectExecutor(outputs))
+            mview = MaterializeExecutor(
+                pk=(),
+                columns=tuple(gout),
+                table_id=f"{name}.mview",
+            )
+            tail.append(mview)
+            pipeline = TwoInputPipeline(left.chain, right.chain, hj, tail)
+            return PlannedMV(
+                name,
+                pipeline,
+                mview,
+                _join_inputs(left.source, right.source),
+                schema=gout,
+            )
+
         out_names = []
         for i, item in enumerate(select.items):
             if not isinstance(item.expr, P.Ident):
@@ -824,7 +1073,7 @@ class StreamPlanner:
             name,
             pipeline,
             mview,
-            {left.source: "left", right.source: "right"},
+            _join_inputs(left.source, right.source),
             schema=out_schema,
         )
 
@@ -838,10 +1087,201 @@ class StreamPlanner:
             f"(got {type(rel).__name__})"
         )
 
+    # -- scalar-subquery decorrelation (binder/expr/subquery.rs:22) ------
+    def _decorrelate(self, select: P.Select) -> P.Select:
+        """Rewrite WHERE conjuncts of the form
+
+            <col> <cmp> (SELECT [k *] agg(c) FROM t WHERE t.key = <outer col>)
+
+        into an INNER join against a hidden grouped-agg derived table
+        plus an algebraic predicate (the reference's correlated-apply →
+        join rewrite, narrowed to equality correlation + one aggregate).
+        ``avg`` splits into sum/count and the comparison is multiplied
+        through by the (positive) count and the coefficient denominator
+        — exact in the integer lane domain, no division (TPC-H q17's
+        ``l_quantity < (SELECT 0.2 * avg(l_quantity) ...)``)."""
+        if select.where is None:
+            return select
+        import dataclasses as _dc
+
+        conjs = _split_and(select.where)
+        out_conjs: List[object] = []
+        new_from = select.from_
+        sq_i = 0
+        changed = False
+        flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "<>": "<>"}
+        for c in conjs:
+            sub = None
+            if isinstance(c, P.BinaryOp) and c.op in flip:
+                if isinstance(c.right, P.ScalarSubQuery) and isinstance(
+                    c.left, P.Ident
+                ):
+                    outer_e, sub, op = c.left, c.right.select, c.op
+                elif isinstance(c.left, P.ScalarSubQuery) and isinstance(
+                    c.right, P.Ident
+                ):
+                    outer_e, sub, op = c.right, c.left.select, flip[c.op]
+            if sub is None:
+                out_conjs.append(c)
+                continue
+            new_from, pred = self._decorrelate_one(
+                new_from, outer_e, op, sub, sq_i
+            )
+            out_conjs.append(pred)
+            sq_i += 1
+            changed = True
+        if not changed:
+            return select
+        where = out_conjs[0]
+        for c in out_conjs[1:]:
+            where = P.BinaryOp("and", where, c)
+        return _dc.replace(select, from_=new_from, where=where)
+
+    def _decorrelate_one(self, from_, outer_e, op, sub: P.Select, i: int):
+        from fractions import Fraction
+
+        if not isinstance(sub.from_, P.TableRef):
+            raise ValueError(
+                "scalar subquery FROM must be a plain table / MV name"
+            )
+        tname = sub.from_.name
+        talias = sub.from_.alias or tname
+        tcols = set(self.catalog.schema_dtypes(tname))
+        if sub.group_by or len(sub.items) != 1:
+            raise ValueError(
+                "scalar subquery must select exactly one aggregate"
+            )
+        # item: agg(c) or <lit> * agg(c) / agg(c) * <lit>
+        e = sub.items[0].expr
+        coeff = Fraction(1)
+        if isinstance(e, P.BinaryOp) and e.op == "*":
+            lit, agg = e.left, e.right
+            if isinstance(agg, P.Literal):
+                lit, agg = agg, lit
+            if not isinstance(lit, P.Literal):
+                raise ValueError("scalar subquery item must be lit * agg")
+            coeff = Fraction(str(lit.value))
+            e = agg
+        if not (
+            isinstance(e, P.FuncCall)
+            and e.name in ("avg", "sum", "min", "max")
+            and len(e.args) == 1
+            and isinstance(e.args[0], P.Ident)
+        ):
+            raise ValueError(
+                "scalar subquery supports [k *] avg/sum/min/max(col)"
+            )
+        if coeff <= 0:
+            raise ValueError(
+                "scalar subquery coefficient must be positive (the "
+                "comparison is multiplied through by it)"
+            )
+        kind, aggcol = e.name, e.args[0].name
+        # correlation: exactly one t.key = outer_col equality; remaining
+        # conjuncts stay as the subquery's own WHERE
+        corr = None
+        rest: List[object] = []
+        for cj in _split_and(sub.where) if sub.where is not None else []:
+            if (
+                corr is None
+                and isinstance(cj, P.BinaryOp)
+                and cj.op == "="
+                and isinstance(cj.left, P.Ident)
+                and isinstance(cj.right, P.Ident)
+            ):
+                a, b = cj.left, cj.right
+                a_inner = a.name in tcols and a.qualifier in (None, talias)
+                b_inner = b.name in tcols and b.qualifier in (None, talias)
+                if a_inner and not b_inner:
+                    corr = (a.name, b)
+                    continue
+                if b_inner and not a_inner:
+                    corr = (b.name, a)
+                    continue
+            rest.append(cj)
+        if corr is None:
+            raise ValueError(
+                "scalar subquery must correlate on one t.key = outer "
+                "column equality"
+            )
+        inner_key, outer_corr = corr
+        kname, sname, nname = f"__k{i}", f"__s{i}", f"__n{i}"
+        alias = f"__sq{i}"
+        items = [P.SelectItem(P.Ident(inner_key), kname)]
+        if kind == "avg":
+            items.append(
+                P.SelectItem(P.FuncCall("sum", (P.Ident(aggcol),)), sname)
+            )
+            items.append(
+                P.SelectItem(P.FuncCall("count", (P.Ident(aggcol),)), nname)
+            )
+        else:
+            items.append(
+                P.SelectItem(P.FuncCall(kind, (P.Ident(aggcol),)), sname)
+            )
+        sq_where = None
+        for cj in rest:
+            sq_where = (
+                cj if sq_where is None else P.BinaryOp("and", sq_where, cj)
+            )
+        sq_sel = P.Select(
+            items=tuple(items),
+            from_=sub.from_,
+            where=sq_where,
+            group_by=(P.Ident(inner_key),),
+        )
+        new_from = P.Join(
+            left=from_,
+            right=P.SubQuery(sq_sel, alias),
+            on=P.BinaryOp("=", P.Ident(kname, alias), outer_corr),
+            join_type="inner",
+        )
+        p, q = coeff.numerator, coeff.denominator
+        lhs: object = outer_e
+        if kind == "avg":
+            lhs = P.BinaryOp("*", lhs, P.Ident(nname, alias))
+        if q != 1:
+            lhs = P.BinaryOp("*", lhs, P.Literal(q))
+        rhs: object = P.Ident(sname, alias)
+        if p != 1:
+            rhs = P.BinaryOp("*", P.Literal(p), rhs)
+        return new_from, P.BinaryOp(op, lhs, rhs)
+
+    @staticmethod
+    def _rename_hidden(rel: BoundRel, tag: str) -> BoundRel:
+        hidden = [c for c in rel.schema if c.startswith("_")]
+        if not hidden:
+            return rel
+        ren = {
+            c: (f"_{tag}{c}" if c in hidden else c) for c in rel.schema
+        }
+        proj = ProjectExecutor({ren[c]: E.col(c) for c in rel.schema})
+        return BoundRel(
+            rel.chain + [proj],
+            {ren[c]: d for c, d in rel.schema.items()},
+            tuple(ren.get(p, p) for p in rel.pk),
+            rel.source,
+            rel.alias,
+        )
+
+    @staticmethod
+    def _alias_match(qual, alias) -> bool:
+        """A lowered join side is addressable through ANY of its
+        original sides' qualifiers (alias is then a frozenset)."""
+        if isinstance(alias, (set, frozenset)):
+            return qual in alias
+        return qual == alias
+
     def _join_resolve(self, ident: P.Ident, left: BoundRel, right: BoundRel):
-        if ident.qualifier == left.alias and ident.name in left.schema:
+        if (
+            self._alias_match(ident.qualifier, left.alias)
+            and ident.name in left.schema
+        ):
             return ident.name
-        if ident.qualifier == right.alias and ident.name in right.schema:
+        if (
+            self._alias_match(ident.qualifier, right.alias)
+            and ident.name in right.schema
+        ):
             return ident.name
         if ident.qualifier is None:
             if (ident.name in left.schema) != (ident.name in right.schema):

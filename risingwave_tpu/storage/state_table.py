@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import json
 import threading
+from collections import deque as _deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -115,6 +116,33 @@ class Checkpointable:
         device-side sdirty marks (update stored marks)."""
         raise NotImplementedError
 
+    # -- pipelined barriers: capture-at-barrier (the memtable seal) ----
+    # With more than one barrier in flight, the delta for epoch N must
+    # be pulled BEFORE any epoch-N+1 row mutates this executor's state.
+    # Actor threads call ``capture_checkpoint`` while processing the
+    # checkpoint barrier (FIFO channels guarantee nothing from N+1 has
+    # been applied yet — the shared-buffer seal point,
+    # /root/reference/src/storage/src/hummock/shared_buffer/); the
+    # checkpoint manager later consumes captures in epoch order.
+    _captured_deltas = None
+
+    def capture_checkpoint(self) -> None:
+        if self._captured_deltas is None:
+            self._captured_deltas = _deque()
+        self._captured_deltas.append(self.checkpoint_delta())
+
+    def staged_or_live_delta(self) -> List[StateDelta]:
+        """Oldest captured delta if any (pipelined mode), else a live
+        pull (synchronous mode)."""
+        if self._captured_deltas:
+            return self._captured_deltas.popleft()
+        return self.checkpoint_delta()
+
+    def discard_captured(self) -> None:
+        """Recovery: captured deltas of rolled-back epochs are stale."""
+        if self._captured_deltas is not None:
+            self._captured_deltas.clear()
+
     def restore_state(
         self, table_id: str, key_cols: Dict[str, np.ndarray],
         value_cols: Dict[str, np.ndarray],
@@ -177,7 +205,7 @@ class CheckpointManager:
         for ex in executors:
             if not isinstance(ex, Checkpointable):
                 continue
-            for delta in ex.checkpoint_delta():
+            for delta in ex.staged_or_live_delta():
                 if delta.table_id in seen_ids:
                     raise ValueError(
                         f"duplicate table_id {delta.table_id!r} in one "

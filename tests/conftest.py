@@ -35,6 +35,11 @@ def pytest_configure(config):
         "smoke: fast CI-signal subset — `pytest -m smoke` runs <2 min "
         "(VERDICT r3 #10)",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-process / long-haul tests (subprocess spawns pay "
+        "a cold jax import each)",
+    )
 
 
 @pytest.fixture

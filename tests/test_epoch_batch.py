@@ -1,0 +1,147 @@
+"""Per-epoch chunk batching in the actor graph (VERDICT r4 weak #1:
+make the benched path the built path).
+
+A fragment whose chain ends [stateless*, HashAgg] accumulates the
+epoch's chunks and applies them in ONE fused device program
+(HashAggExecutor.apply_stacked with the stateless prefix traced in via
+``pre``) — emission stays barrier-granular, so results are
+byte-identical to the per-chunk walk.
+
+Reference: the reference benches its production executor directly
+(src/stream/src/executor/hash_agg.rs:62, src/stream/benches/).
+"""
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.connectors.nexmark import (
+    BID_SCHEMA,
+    NexmarkConfig,
+    NexmarkGenerator,
+)
+from risingwave_tpu.executors.epoch_batch import (
+    EpochBatchedAggExecutor,
+    fuse_epoch_batch,
+)
+from risingwave_tpu.runtime.fragmenter import graph_planned_mv
+from risingwave_tpu.sql import Catalog, StreamPlanner
+
+pytestmark = pytest.mark.smoke
+
+Q5_SQL = (
+    "CREATE MATERIALIZED VIEW q5 AS "
+    "SELECT auction, window_start, count(*) AS num "
+    "FROM HOP(bid, date_time, INTERVAL '2' SECOND, INTERVAL '10' SECOND) "
+    "GROUP BY auction, window_start"
+)
+
+
+@pytest.fixture
+def catalog():
+    return Catalog({"bid": BID_SCHEMA})
+
+
+def _factory(catalog):
+    return lambda: StreamPlanner(catalog, capacity=1 << 12)
+
+
+def _bid_chunks(n, events=800, cap=1 << 10):
+    gen = NexmarkGenerator(NexmarkConfig())
+    out = []
+    while len(out) < n:
+        c = gen.next_chunks(events, cap)["bid"]
+        if c is not None:
+            out.append(c)
+    return out
+
+
+def test_fuse_rewrites_stateless_agg_runs(catalog):
+    chain = list(
+        StreamPlanner(catalog, capacity=1 << 10)
+        .plan(Q5_SQL)
+        .pipeline.executors
+    )
+    fused = fuse_epoch_batch(chain)
+    wrappers = [
+        e for e in fused if isinstance(e, EpochBatchedAggExecutor)
+    ]
+    assert len(wrappers) == 1
+    # the wrapper holds the ORIGINAL agg object (checkpoint registry
+    # keeps referencing it) and the stateless prefix was absorbed
+    from risingwave_tpu.executors.hash_agg import HashAggExecutor
+
+    orig_aggs = [e for e in chain if type(e) is HashAggExecutor]
+    assert wrappers[0].agg is orig_aggs[0]
+    assert len(fused) < len(chain)
+    # everything downstream of the agg is untouched, in order
+    tail = chain[chain.index(orig_aggs[0]) + 1 :]
+    assert fused[fused.index(wrappers[0]) + 1 :] == tail
+
+
+def test_actor_chain_is_batched(catalog):
+    mv = graph_planned_mv(_factory(catalog), Q5_SQL, parallelism=1)
+    try:
+        chains = [a.chain for a in mv.pipeline.graph.actors]
+        assert any(
+            isinstance(e, EpochBatchedAggExecutor)
+            for ch in chains
+            for e in ch
+        )
+    finally:
+        mv.pipeline.close()
+
+
+@pytest.mark.parametrize("parallelism", [1, 2])
+def test_batched_graph_matches_serial_varying_epoch_sizes(
+    catalog, parallelism
+):
+    """Epochs of 1, 3, 5 and 2 chunks (pow2 padding exercises 1/4/8/2
+    stack shapes) produce the exact serial-pipeline MV."""
+    chunks = _bid_chunks(11)
+    epochs = [chunks[0:1], chunks[1:4], chunks[4:9], chunks[9:11]]
+
+    serial = StreamPlanner(catalog, capacity=1 << 12).plan(Q5_SQL)
+    graph = graph_planned_mv(
+        _factory(catalog), Q5_SQL, parallelism=parallelism
+    )
+    try:
+        for ep in epochs:
+            for c in ep:
+                serial.pipeline.push(c)
+                graph.pipeline.push(c)
+            serial.pipeline.barrier()
+            graph.pipeline.barrier()
+        want = serial.mview.snapshot()
+        assert want
+        assert graph.mview.snapshot() == want
+    finally:
+        graph.pipeline.close()
+
+
+def test_batched_graph_off_switch_matches(catalog):
+    """epoch_batch=False is the per-chunk walk; both graph modes agree
+    (the differential guard for the fused path)."""
+    from risingwave_tpu.executors.epoch_batch import (
+        EpochBatchedAggExecutor as EB,
+    )
+
+    chunks = _bid_chunks(6)
+    on = graph_planned_mv(_factory(catalog), Q5_SQL, parallelism=1)
+    off = graph_planned_mv(
+        _factory(catalog), Q5_SQL, parallelism=1, epoch_batch=False
+    )
+    try:
+        off_chains = [e for a in off.pipeline.graph.actors for e in a.chain]
+        assert not any(isinstance(e, EB) for e in off_chains)
+        for i in range(0, 6, 3):
+            for c in chunks[i : i + 3]:
+                on.pipeline.push(c)
+                off.pipeline.push(c)
+            on.pipeline.barrier()
+            off.pipeline.barrier()
+        want = off.mview.snapshot()
+        assert want
+        assert on.mview.snapshot() == want
+    finally:
+        on.pipeline.close()
+        off.pipeline.close()

@@ -1,0 +1,110 @@
+"""Nexmark q7 from SQL, end to end (VERDICT r4 missing #3).
+
+The q7 shape — bids joined against their own per-window MAX — plans
+from SQL as a SELF-join of two derived tables over one base stream.
+The planner collapses the duplicate source to input side "both" and
+the runtime feeds every source chunk to both join inputs.
+
+Reference: e2e_test/nexmark/ q7 (join formulation), retracting agg
+side through the join's delete/insert path.
+"""
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.connectors.nexmark import (
+    BID_SCHEMA,
+    NexmarkConfig,
+    NexmarkGenerator,
+)
+from risingwave_tpu.queries.nexmark_q import build_q7
+from risingwave_tpu.sql import Catalog, StreamPlanner
+
+pytestmark = pytest.mark.smoke
+
+Q7_SQL = (
+    "CREATE MATERIALIZED VIEW q7 AS "
+    "SELECT b.auction, b.bidder, b.price, b.wstart FROM "
+    "(SELECT auction, bidder, price, window_start AS wstart "
+    " FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND)) AS b "
+    "JOIN "
+    "(SELECT max(price) AS maxprice, window_start AS mwstart "
+    " FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND) "
+    " GROUP BY window_start) AS m "
+    "ON b.wstart = m.mwstart AND b.price = m.maxprice"
+)
+
+
+def _bid_chunks(n, events=1500, cap=2048, rate=1000):
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=rate))
+    out = []
+    while len(out) < n:
+        c = gen.next_chunks(events, cap)["bid"]
+        if c is not None:
+            out.append(c)
+    return out
+
+
+def _rows(mview):
+    cols = mview.to_numpy()
+    names = ("wstart", "auction", "bidder")
+    price = cols.get("price", cols.get("maxprice"))
+    return sorted(
+        zip(*(np.asarray(cols[n]).tolist() for n in names), price.tolist())
+    )
+
+
+def test_q7_sql_matches_hand_built():
+    """Several windows' worth of bids; each new window max retracts the
+    previous max's join matches — SQL plan must land on exactly the
+    hand-built pipeline's MV."""
+    planner = StreamPlanner(Catalog({"bid": BID_SCHEMA}), capacity=1 << 14)
+    mv = planner.plan(Q7_SQL)
+    assert mv.inputs == {"bid": "both"}
+    hand = build_q7(capacity=1 << 14, state_cleaning=False)
+    for c in _bid_chunks(8):
+        mv.pipeline.push_left(c)
+        mv.pipeline.push_right(c)
+        hand.pipeline.push_left(c)
+        hand.pipeline.push_right(c)
+        mv.pipeline.barrier()
+        hand.pipeline.barrier()
+    got, want = _rows(mv.mview), _rows(hand.mview)
+    assert want  # multiple windows, non-trivial
+    assert got == want
+
+
+def test_q7_via_session_insert_routing():
+    """Session-level: one INSERT into the base table reaches BOTH join
+    sides (side='both' routing through the DML targets)."""
+    from risingwave_tpu.frontend.session import SqlSession
+
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute("CREATE TABLE bid (auction BIGINT, bidder BIGINT, "
+              "price BIGINT, date_time BIGINT)")
+    s.execute(
+        "CREATE MATERIALIZED VIEW q7 AS "
+        "SELECT b.auction, b.bidder, b.price, b.wstart FROM "
+        "(SELECT auction, bidder, price, window_start AS wstart "
+        " FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND)) AS b "
+        "JOIN "
+        "(SELECT max(price) AS maxprice, window_start AS mwstart "
+        " FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND) "
+        " GROUP BY window_start) AS m "
+        "ON b.wstart = m.mwstart AND b.price = m.maxprice"
+    )
+    s.execute(
+        "INSERT INTO bid VALUES (1, 10, 100, 1000), (2, 11, 250, 2000), "
+        "(3, 12, 250, 11000)"
+    )
+    out, _ = s.execute(
+        "SELECT auction, price FROM q7 ORDER BY auction"
+    )
+    # window [0,10s): max 250 -> auction 2; window [10s,20s): auction 3
+    assert list(out["auction"]) == [2, 3]
+    assert list(out["price"]) == [250, 250]
+    # a new max in window 0 RETRACTS auction 2's row
+    s.execute("INSERT INTO bid VALUES (4, 13, 300, 3000)")
+    out, _ = s.execute("SELECT auction, price FROM q7 ORDER BY auction")
+    assert list(out["auction"]) == [3, 4]
+    assert list(out["price"]) == [250, 300]

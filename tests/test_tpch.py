@@ -1,0 +1,177 @@
+"""TPC-H q3 / q17 streaming MVs (BASELINE.md config 5, VERDICT r4
+missing #3: multi-way joins + scalar subqueries).
+
+- q3: 3-way join + grouped agg. The planner lowers the nested join
+  into a tree of hidden 2-way-join MVs connected by subscription edges
+  (the reference fragments an n-way join into a tree of 2-way
+  StreamHashJoins, optimizer over e2e_test/tpch).
+- q17: correlated scalar subquery (``l_quantity < (SELECT 0.2 *
+  avg(l_quantity) ... WHERE l_partkey = p_partkey)``) decorrelated
+  into a join against a grouped sum/count MV with the comparison
+  multiplied through — exact integer algebra, no division
+  (binder/expr/subquery.rs:22 apply→join rewrite, narrowed).
+
+Monetary values are integer cents; dates are yyyymmdd ints.
+"""
+
+import pytest
+
+from risingwave_tpu.frontend.session import SqlSession
+from risingwave_tpu.sql import Catalog
+
+pytestmark = pytest.mark.smoke
+
+Q3_SQL = (
+    "CREATE MATERIALIZED VIEW q3 AS "
+    "SELECT l.l_orderkey, sum(l.rev) AS revenue, o.o_orderdate, "
+    "o.o_shippriority "
+    "FROM (SELECT o_orderkey, o_custkey, o_orderdate, o_shippriority "
+    "      FROM orders WHERE o_orderdate < 19950315) AS o "
+    "JOIN (SELECT c_custkey FROM customer WHERE c_mktsegment = 1) AS c "
+    "  ON c.c_custkey = o.o_custkey "
+    "JOIN (SELECT l_orderkey, l_extendedprice * (100 - l_discount) AS rev, "
+    "             l_shipdate "
+    "      FROM lineitem WHERE l_shipdate > 19950315) AS l "
+    "  ON l.l_orderkey = o.o_orderkey "
+    "GROUP BY l.l_orderkey, o.o_orderdate, o.o_shippriority"
+)
+
+Q17_SQL = (
+    "CREATE MATERIALIZED VIEW q17 AS "
+    "SELECT sum(l.l_extendedprice) / 7 AS avg_yearly "
+    "FROM (SELECT l_partkey, l_quantity, l_extendedprice FROM lineitem) AS l "
+    "JOIN (SELECT p_partkey FROM part "
+    "      WHERE p_brand = 23 AND p_container = 5) AS p "
+    "  ON p.p_partkey = l.l_partkey "
+    "WHERE l.l_quantity < (SELECT 0.2 * avg(l_quantity) FROM lineitem "
+    "                      WHERE l_partkey = p.p_partkey)"
+)
+
+
+def _session():
+    return SqlSession(Catalog({}), capacity=1 << 10)
+
+
+def test_tpch_q3_three_way_join_agg():
+    s = _session()
+    s.execute(
+        "CREATE TABLE customer (c_custkey BIGINT PRIMARY KEY, "
+        "c_mktsegment BIGINT)"
+    )
+    s.execute(
+        "CREATE TABLE orders (o_orderkey BIGINT PRIMARY KEY, "
+        "o_custkey BIGINT, o_orderdate BIGINT, o_shippriority BIGINT)"
+    )
+    s.execute(
+        "CREATE TABLE lineitem (l_orderkey BIGINT, l_extendedprice BIGINT, "
+        "l_discount BIGINT, l_shipdate BIGINT)"
+    )
+    s.execute(Q3_SQL)
+    s.execute("INSERT INTO customer VALUES (1, 1), (2, 2), (3, 1)")
+    s.execute(
+        "INSERT INTO orders VALUES (10, 1, 19950101, 0), "
+        "(11, 2, 19950101, 0), (12, 3, 19950110, 1), (13, 1, 19960101, 0)"
+    )
+    s.execute(
+        "INSERT INTO lineitem VALUES (10, 1000, 10, 19950401), "
+        "(10, 500, 0, 19950501), (11, 700, 0, 19950401), "
+        "(12, 200, 50, 19960101), (13, 900, 0, 19970101), "
+        "(10, 100, 0, 19940101)"
+    )
+    out, _ = s.execute(
+        "SELECT l_orderkey, revenue, o_orderdate, o_shippriority "
+        "FROM q3 ORDER BY l_orderkey"
+    )
+    # order 10 (cust 1 / seg 1 / date ok): 1000*90 + 500*100 = 140000
+    # (the 19940101 shipment is too early); order 11: wrong segment;
+    # order 12: 200*50; order 13: order date too late
+    assert list(out["l_orderkey"]) == [10, 12]
+    assert list(out["revenue"]) == [140000, 10000]
+    assert list(out["o_shippriority"]) == [0, 1]
+    # incremental: a new qualifying shipment updates order 10's revenue
+    s.execute("INSERT INTO lineitem VALUES (10, 10, 0, 19950601)")
+    out, _ = s.execute(
+        "SELECT l_orderkey, revenue FROM q3 ORDER BY l_orderkey"
+    )
+    assert list(out["revenue"]) == [141000, 10000]
+
+
+def test_tpch_q17_correlated_scalar_subquery():
+    s = _session()
+    s.execute(
+        "CREATE TABLE lineitem (l_partkey BIGINT, l_quantity BIGINT, "
+        "l_extendedprice BIGINT)"
+    )
+    s.execute(
+        "CREATE TABLE part (p_partkey BIGINT PRIMARY KEY, p_brand BIGINT, "
+        "p_container BIGINT)"
+    )
+    s.execute(Q17_SQL)
+    s.execute("INSERT INTO part VALUES (1, 23, 5), (2, 23, 5), (3, 9, 9)")
+    # part 1: qty 10,100,100 -> 0.2*avg = 14 -> qty 10 counts (111)
+    # part 2: qty 50,50 -> threshold 10 -> none; part 3: wrong brand
+    s.execute(
+        "INSERT INTO lineitem VALUES (1, 10, 111), (1, 100, 222), "
+        "(1, 100, 333), (2, 50, 444), (2, 50, 555), (3, 1, 666)"
+    )
+    out, _ = s.execute("SELECT avg_yearly FROM q17")
+    assert list(out["avg_yearly"]) == [111 // 7]
+    # new cheap lineitem drags part 1's avg to 53.5 -> threshold 10.7:
+    # qty 10 stays, qty 4 joins -> (111 + 777) / 7
+    s.execute("INSERT INTO lineitem VALUES (1, 4, 777)")
+    out, _ = s.execute("SELECT avg_yearly FROM q17")
+    assert list(out["avg_yearly"]) == [(111 + 777) // 7]
+
+
+def test_four_way_join_lowers_to_mv_tree():
+    """Left-deep 4-way join: two levels of hidden aux MVs."""
+    s = _session()
+    s.execute("CREATE TABLE a (ak BIGINT, av BIGINT)")
+    s.execute("CREATE TABLE b (bk BIGINT, bv BIGINT)")
+    s.execute("CREATE TABLE c (ck BIGINT, cv BIGINT)")
+    s.execute("CREATE TABLE d (dk BIGINT, dv BIGINT)")
+    s.execute(
+        "CREATE MATERIALIZED VIEW j4 AS "
+        "SELECT a.av, b.bv, c.cv, d.dv FROM "
+        "(SELECT ak, av FROM a) AS a "
+        "JOIN (SELECT bk, bv FROM b) AS b ON a.ak = b.bk "
+        "JOIN (SELECT ck, cv FROM c) AS c ON c.ck = a.ak "
+        "JOIN (SELECT dk, dv FROM d) AS d ON d.dk = a.ak"
+    )
+    aux = [f for f in s.runtime.fragments if f.startswith("j4__j")]
+    assert len(aux) == 2  # ((a JOIN b) JOIN c) and its inner join
+    s.execute("INSERT INTO a VALUES (1, 10), (2, 20)")
+    s.execute("INSERT INTO b VALUES (1, 11), (3, 31)")
+    s.execute("INSERT INTO c VALUES (1, 12), (2, 22)")
+    s.execute("INSERT INTO d VALUES (1, 13)")
+    out, _ = s.execute("SELECT av, bv, cv, dv FROM j4")
+    assert list(out["av"]) == [10]
+    assert (
+        list(out["bv"]),
+        list(out["cv"]),
+        list(out["dv"]),
+    ) == ([11], [12], [13])
+
+
+def test_tpch_q17_graph_mode_matches_serial():
+    """exec_mode='graph': the fragmenter must NOT drop the planner's
+    aux MVs (review r5: decorrelated plans silently returned NULL in
+    graph mode — the flat 2-way FROM dodges the session's syntactic
+    nested-join gate, so the fragmenter itself falls back)."""
+    s = SqlSession(Catalog({}), capacity=1 << 10, exec_mode="graph")
+    s.execute(
+        "CREATE TABLE lineitem (l_partkey BIGINT, l_quantity BIGINT, "
+        "l_extendedprice BIGINT)"
+    )
+    s.execute(
+        "CREATE TABLE part (p_partkey BIGINT PRIMARY KEY, p_brand BIGINT, "
+        "p_container BIGINT)"
+    )
+    s.execute(Q17_SQL)
+    s.execute("INSERT INTO part VALUES (1, 23, 5)")
+    s.execute(
+        "INSERT INTO lineitem VALUES (1, 10, 111), (1, 100, 222), "
+        "(1, 100, 333)"
+    )
+    out, _ = s.execute("SELECT avg_yearly FROM q17")
+    assert list(out["avg_yearly"]) == [111 // 7]
