@@ -128,8 +128,23 @@ def main() -> None:
         "the host backend",
     )
     s.set_defaults(fn=serve)
+    cn = sub.add_parser(
+        "compute-node",
+        help="start a compute-node role behind a TCP wire "
+        "(cluster/compute_node.py; compute_node_serve analogue)",
+    )
+    cn.add_argument("--port", type=int, default=0)
+    cn.add_argument("--state-dir", required=True)
+    cn.add_argument("--device", choices=["cpu", "tpu"], default="cpu")
+    cn.set_defaults(fn=_compute_node)
     args = ap.parse_args()
     args.fn(args)
+
+
+def _compute_node(args) -> None:
+    from risingwave_tpu.cluster.compute_node import run
+
+    run(args.port, args.state_dir, args.device)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,16 @@
+"""Multi-process cluster roles (the reference's 4-role split,
+docs/architecture-design.md:9-21, narrowed to two roles for v0):
+
+- ``compute_node``: a process hosting streaming fragments behind a TCP
+  control + exchange stream (src/compute/src/server.rs:85;
+  exchange over gRPC in the reference,
+  src/compute/src/rpc/service/exchange_service.rs:78-146 — here
+  length-prefixed frames with Arrow IPC chunk payloads and permit flow
+  control, proto/stream_service.proto:116-122 control stream).
+- ``ComputeClient`` (meta/frontend side): drives DDL, the data stream,
+  and the barrier clock over the wire; detects compute death and runs
+  recovery against the SHARED object store (kill -9 the compute
+  process, respawn, recover from the last committed epoch).
+"""
+
+from risingwave_tpu.cluster.client import ComputeClient  # noqa: F401

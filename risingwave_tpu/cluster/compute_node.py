@@ -1,0 +1,188 @@
+"""Compute-node role: streaming fragments behind a TCP wire.
+
+Reference: ``compute_node_serve`` (src/compute/src/server.rs:85) hosts
+gRPC Task/Exchange/Stream services; barriers arrive over the meta
+control stream (proto/stream_service.proto:116-122
+StreamingControlStream) and data over ExchangeService.GetStream with
+permit flow control (exchange_service.rs:78-146, permit.rs:35-90).
+
+TPU build v0: ONE duplex TCP connection carries both streams as framed
+messages (cluster/wire.py). DDL ships as SQL text (the reference ships
+fragment-graph protos; SQL + deterministic planning reaches the same
+actors — documented simplification). State persists to the SHARED
+object store (``--state-dir``): a kill -9'd node restarts, replays the
+DDL log, recovers from the last committed epoch, and the driver-side
+client replays uncommitted chunks — the reference's recovery contract
+(barrier/recovery.rs:353) across a real process boundary.
+
+Run: ``python -m risingwave_tpu compute-node --port 0 --state-dir DIR``
+(prints ``LISTENING <port>`` on stdout so a parent can connect).
+"""
+
+from __future__ import annotations
+
+import socket
+import sys
+
+
+def _build_session(state_dir: str):
+    from risingwave_tpu.frontend.session import SqlSession
+    from risingwave_tpu.runtime.runtime import StreamingRuntime
+    from risingwave_tpu.sql import Catalog
+    from risingwave_tpu.storage.meta_backup import DDL_PATH
+    from risingwave_tpu.storage.object_store import LocalFsObjectStore
+
+    store = LocalFsObjectStore(state_dir)
+    runtime = StreamingRuntime(store)
+    runtime.auto_recover = True
+    if store.exists(DDL_PATH):
+        session = SqlSession.restore(runtime)
+    else:
+        session = SqlSession(Catalog({}), runtime)
+    return session
+
+
+def serve(port: int, state_dir: str) -> None:
+    from risingwave_tpu.cluster import wire
+
+    session = _build_session(state_dir)
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", port))
+    srv.listen(1)
+    print(f"LISTENING {srv.getsockname()[1]}", flush=True)
+    while True:
+        conn, _addr = srv.accept()
+        try:
+            _serve_conn(conn, session)
+        except ConnectionError:
+            pass  # driver went away; await a reconnect
+        finally:
+            conn.close()
+
+
+def _serve_conn(conn: socket.socket, session) -> None:
+    from risingwave_tpu.cluster import wire
+
+    dicts = getattr(session, "strings", None)
+    while True:
+        header, payload = wire.recv_frame(conn)
+        kind = header.get("type")
+        try:
+            if kind == "ddl":
+                _out, tag = session.execute(header["sql"])
+                wire.send_frame(conn, {"type": "ok", "tag": tag})
+            elif kind == "chunk":
+                chunk = wire.payload_chunk(
+                    payload,
+                    capacity=header.get("capacity"),
+                    dictionaries=dicts,
+                )
+                table = header["table"]
+                n = 0
+                targets = session.dml._targets.get(table, ())
+                if not targets:
+                    raise KeyError(f"no consumers for stream {table!r}")
+                for frag, side in targets:
+                    session.runtime.push(frag, chunk, side)
+                    n += 1
+                # permit grant: rows are returned to the sender's
+                # budget only after the node ABSORBED them (permit.rs)
+                wire.send_frame(
+                    conn,
+                    {"type": "ack", "permits": int(header.get("rows", 0))},
+                )
+            elif kind == "barrier":
+                # the watchdog may roll a poisoned epoch back in place
+                # (auto_recover); the node's chunks come from the WIRE,
+                # so it cannot replay them itself — report the rollback
+                # honestly and let the driver replay (silently replying
+                # barrier_complete would drop the epoch's rows)
+                before = session.runtime.auto_recoveries
+                session.runtime.barrier()
+                session.runtime.wait_checkpoints()
+                committed = (
+                    session.runtime.mgr.max_committed_epoch
+                    if session.runtime.mgr
+                    else 0
+                )
+                if session.runtime.auto_recoveries > before:
+                    wire.send_frame(
+                        conn,
+                        {"type": "barrier_failed", "committed": committed},
+                    )
+                else:
+                    wire.send_frame(
+                        conn,
+                        {
+                            "type": "barrier_complete",
+                            "epoch": session.runtime.epoch,
+                            "committed": committed,
+                        },
+                    )
+            elif kind == "query":
+                out, tag = session.execute(header["sql"])
+                # results are already decoded (strings, decimals, NULL
+                # as None) by the session's result edge — small enough
+                # for JSON; the DATA plane stays Arrow
+                rows = {
+                    k: [
+                        None
+                        if x is None
+                        else (x.item() if hasattr(x, "item") else x)
+                        for x in v
+                    ]
+                    for k, v in out.items()
+                }
+                wire.send_frame(
+                    conn, {"type": "rows", "tag": tag, "data": rows}
+                )
+            elif kind == "status":
+                wire.send_frame(
+                    conn,
+                    {
+                        "type": "status",
+                        "committed": (
+                            session.runtime.mgr.max_committed_epoch
+                            if session.runtime.mgr
+                            else 0
+                        ),
+                    },
+                )
+            elif kind == "shutdown":
+                wire.send_frame(conn, {"type": "ok", "tag": "BYE"})
+                sys.exit(0)
+            else:
+                raise ValueError(f"unknown frame type {kind!r}")
+        except ConnectionError:
+            raise
+        except Exception as e:  # surfaced to the driver, keep serving
+            wire.send_frame(conn, {"type": "error", "message": repr(e)})
+
+
+def run(port: int, state_dir: str, device: str = "cpu") -> None:
+    """Shared entry for ``python -m risingwave_tpu compute-node`` and
+    direct module execution — ONE place defines the role's setup."""
+    import os
+
+    if device == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    serve(port, state_dir)
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--state-dir", required=True)
+    ap.add_argument("--device", choices=["cpu", "tpu"], default="cpu")
+    args = ap.parse_args(argv)
+    run(args.port, args.state_dir, args.device)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""Framed wire protocol for the two-process cluster.
+
+Frame layout (all little-endian):
+
+    4 bytes  header length H
+    4 bytes  payload length P
+    H bytes  JSON header (utf-8)
+    P bytes  payload (Arrow IPC stream for chunk frames, else empty)
+
+Flow control v0 is the synchronous absorb-ack: the sender keeps ONE
+chunk in flight and the receiver's ack (which echoes the row count as
+``permits``) releases the next — a degenerate form of the reference's
+permit channels (src/stream/src/executor/exchange/permit.rs:35-90,
+which generalize to a row budget with piggybacked AddPermits). A slow
+compute node therefore back-pressures the frontend instead of growing
+an unbounded socket buffer.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Optional, Tuple
+
+_HDR = struct.Struct("<II")
+
+
+def send_frame(sock: socket.socket, header: dict, payload: bytes = b"") -> None:
+    h = json.dumps(header).encode()
+    sock.sendall(_HDR.pack(len(h), len(payload)) + h + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        part = sock.recv(n - len(buf))
+        if not part:
+            raise ConnectionError("peer closed")
+        buf.extend(part)
+    return bytes(buf)
+
+
+def recv_frame(sock: socket.socket) -> Tuple[dict, bytes]:
+    hlen, plen = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    header = json.loads(_recv_exact(sock, hlen))
+    payload = _recv_exact(sock, plen) if plen else b""
+    return header, payload
+
+
+def chunk_payload(chunk, dictionaries=None) -> bytes:
+    """StreamChunk -> Arrow IPC stream bytes (ops lane included)."""
+    import io
+
+    import pyarrow as pa
+
+    from risingwave_tpu.array.arrow import chunk_to_arrow
+
+    batch = chunk_to_arrow(chunk, dictionaries=dictionaries, with_ops=True)
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, batch.schema) as w:
+        w.write_batch(batch)
+    return sink.getvalue()
+
+
+def payload_chunk(data: bytes, capacity: Optional[int] = None,
+                  dictionaries=None):
+    """Arrow IPC stream bytes -> StreamChunk."""
+    import io
+
+    import pyarrow as pa
+
+    from risingwave_tpu.array.arrow import chunk_from_arrow
+
+    with pa.ipc.open_stream(io.BytesIO(data)) as r:
+        batches = list(r)
+    assert len(batches) == 1, "one batch per chunk frame"
+    return chunk_from_arrow(
+        batches[0], capacity=capacity, dictionaries=dictionaries
+    )

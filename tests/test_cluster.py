@@ -1,0 +1,149 @@
+"""Two-process cluster: a compute-node role behind a real TCP wire
+(VERDICT r4 missing #2 / next #5).
+
+The driver (this test = the meta + frontend roles) ships DDL as SQL,
+streams Nexmark bid chunks as Arrow IPC frames with permit acks, ticks
+the barrier clock over the wire, and — after a kill -9 mid-stream —
+respawns the node, which restores DDL + state from the SHARED object
+store; the driver replays exactly the chunks beyond the restored
+commit frontier. Final MV must equal an uninterrupted in-process run.
+
+Reference: compute_node_serve (src/compute/src/server.rs:85), control
+stream (proto/stream_service.proto:116-122), exchange permits
+(exchange/permit.rs:35-90), recovery (barrier/recovery.rs:353).
+"""
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.cluster import ComputeClient
+from risingwave_tpu.connectors.nexmark import (
+    BID_SCHEMA,
+    NexmarkConfig,
+    NexmarkGenerator,
+)
+
+DDL = [
+    "CREATE TABLE bid (auction BIGINT, bidder BIGINT, price BIGINT, "
+    "date_time BIGINT)",
+    "CREATE MATERIALIZED VIEW q5 AS "
+    "SELECT auction, window_start, count(*) AS num "
+    "FROM HOP(bid, date_time, INTERVAL '2' SECOND, INTERVAL '10' SECOND) "
+    "GROUP BY auction, window_start",
+]
+
+
+def _bid_cols(n_chunks, events=600, cap=1 << 10):
+    gen = NexmarkGenerator(NexmarkConfig())
+    out = []
+    while len(out) < n_chunks:
+        c = gen.next_chunks(events, cap)["bid"]
+        if c is not None:
+            cols = c.to_numpy()
+            out.append(
+                {
+                    k: v
+                    for k, v in cols.items()
+                    if k in ("auction", "bidder", "price", "date_time")
+                }
+            )
+    return out
+
+
+def _oracle(chunks_cols, cap=1 << 10):
+    """Uninterrupted in-process run of the same chunks."""
+    from risingwave_tpu.array.chunk import StreamChunk
+    from risingwave_tpu.frontend.session import SqlSession
+    from risingwave_tpu.sql import Catalog
+
+    s = SqlSession(Catalog({}), capacity=1 << 12)
+    for sql in DDL:
+        s.execute(sql)
+    for cols in chunks_cols:
+        chunk = StreamChunk.from_numpy(cols, cap)
+        for frag, side in s.dml._targets.get("bid", ()):
+            s.runtime.push(frag, chunk, side)
+        s.runtime.barrier()
+    out, _ = s.execute(
+        "SELECT auction, window_start, num FROM q5 ORDER BY auction"
+    )
+    return out
+
+
+def _rows(out):
+    return sorted(
+        zip(
+            [int(x) for x in out["auction"]],
+            [int(x) for x in out["window_start"]],
+            [int(x) for x in out["num"]],
+        )
+    )
+
+
+@pytest.mark.slow
+def test_two_process_q5_parity_and_kill9_recovery(tmp_path):
+    chunks = _bid_cols(6)
+    want = _rows(_oracle(chunks))
+    assert want
+
+    cn = ComputeClient.spawn(str(tmp_path / "state"))
+    try:
+        for sql in DDL:
+            cn.ddl(sql)
+        # stream the first half, one barrier per chunk
+        for cols in chunks[:3]:
+            cn.push_chunk("bid", cols, 1 << 10)
+            cn.barrier()
+        # chunk 4 lands but its epoch is NOT sealed when the node dies
+        cn.push_chunk("bid", chunks[3], 1 << 10)
+        cn.kill9()
+        # meta-side recovery: respawn, node restores from the store,
+        # driver replays past the restored frontier
+        cn.recover()
+        cn.barrier()
+        for cols in chunks[4:]:
+            cn.push_chunk("bid", cols, 1 << 10)
+            cn.barrier()
+        got = _rows(cn.query(
+            "SELECT auction, window_start, num FROM q5 ORDER BY auction"
+        ))
+        assert got == want
+    finally:
+        cn.close()
+
+
+@pytest.mark.slow
+def test_kill_between_commit_and_reply_does_not_double_apply(tmp_path):
+    """kill -9 landing AFTER the node committed epoch E but BEFORE the
+    barrier_complete reply reaches the driver: the driver still holds
+    E's chunks as unsealed, but the restored frontier proves the
+    in-flight barrier committed — replaying them would double-apply.
+    (White-box: the commit happens normally; the client's view is then
+    rewound to 'reply lost'.)"""
+    chunks = _bid_cols(4)
+    want = _rows(_oracle(chunks))
+
+    cn = ComputeClient.spawn(str(tmp_path / "state"))
+    try:
+        for sql in DDL:
+            cn.ddl(sql)
+        for cols in chunks[:3]:
+            cn.push_chunk("bid", cols, 1 << 10)
+            cn.barrier()
+        prev_committed = cn._last_committed
+        pending_before = [(None, "bid", c, 1 << 10) for c in [chunks[3]]]
+        cn.push_chunk("bid", chunks[3], 1 << 10)
+        cn.barrier()  # the node commits AND replies...
+        # ...but pretend the reply was lost: rewind the client's view
+        cn._pending = list(pending_before)
+        cn._barrier_inflight = True
+        cn._last_committed = prev_committed
+        cn.kill9()
+        cn.recover()  # frontier advanced past prev_committed -> no replay
+        cn.barrier()
+        got = _rows(cn.query(
+            "SELECT auction, window_start, num FROM q5 ORDER BY auction"
+        ))
+        assert got == want
+    finally:
+        cn.close()
