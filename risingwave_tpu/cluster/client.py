@@ -27,11 +27,18 @@ class ComputeError(RuntimeError):
 
 class ComputeClient:
     def __init__(self, port: int, proc: Optional[subprocess.Popen] = None,
-                 state_dir: Optional[str] = None):
+                 state_dir: Optional[str] = None,
+                 env: Optional[dict] = None):
         self.port = port
         self.proc = proc
         self.state_dir = state_dir
+        self.env = dict(env or {})  # reproduced on recovery respawns
         self.sock: Optional[socket.socket] = None
+        # client-side varchar lanes encode through ONE dictionary (the
+        # session-side mirror); the wire itself carries strings
+        from risingwave_tpu.array.dictionary import StringDictionary
+
+        self._strings = StringDictionary()
         # replay buffer: [(sealing_epoch | None, table, cols, cap)] —
         # entries get their sealing epoch at the next barrier; entries
         # whose epoch is <= the node's committed frontier are durable
@@ -45,7 +52,9 @@ class ComputeClient:
 
     # -- lifecycle -------------------------------------------------------
     @classmethod
-    def spawn(cls, state_dir: str, port: int = 0) -> "ComputeClient":
+    def spawn(
+        cls, state_dir: str, port: int = 0, env: Optional[dict] = None
+    ) -> "ComputeClient":
         proc = subprocess.Popen(
             [
                 sys.executable,
@@ -61,12 +70,12 @@ class ComputeClient:
             stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL,
             text=True,
-            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            env={**os.environ, "JAX_PLATFORMS": "cpu", **(env or {})},
         )
         line = proc.stdout.readline().strip()
         if not line.startswith("LISTENING"):
             raise RuntimeError(f"compute node failed to start: {line!r}")
-        client = cls(int(line.split()[1]), proc, state_dir)
+        client = cls(int(line.split()[1]), proc, state_dir, env=env)
         client.connect()
         return client
 
@@ -122,24 +131,73 @@ class ComputeClient:
         return reply["tag"]
 
     def push_chunk(self, table: str, cols: dict, capacity: int) -> None:
-        """Send one chunk (numpy column dict). Flow control is the
+        """Send one chunk (numpy column dict; str/object lanes are
+        VARCHAR and ship as Arrow strings). Flow control is the
         synchronous absorb-ack — a window of one chunk in flight (the
         reference's permit channels generalize this to a row budget)."""
+        import numpy as np
+
         from risingwave_tpu.array.chunk import StreamChunk
 
         rows = len(next(iter(cols.values())))
-        chunk = StreamChunk.from_numpy(cols, capacity)
+        enc, dicts, nulls = {}, {}, {}
+        for k, v in cols.items():
+            a = np.asarray(v)
+            if a.dtype.kind in ("U", "O"):
+                vals = a.tolist()
+                isnull = np.array([x is None for x in vals], bool)
+                if isnull.any():
+                    nulls[k] = isnull  # SQL NULL, not the string "None"
+                enc[k] = self._strings.encode(
+                    ["" if x is None else str(x) for x in vals]
+                )
+                dicts[k] = self._strings
+            else:
+                enc[k] = a
+        chunk = StreamChunk.from_numpy(enc, capacity, nulls=nulls or None)
         reply, _ = self._rpc(
             {"type": "chunk", "table": table, "capacity": capacity,
              "rows": rows},
-            wire.chunk_payload(chunk),
+            wire.chunk_payload(chunk, dictionaries=dicts or None),
         )
         assert reply["type"] == "ack"
         self._pending.append((None, table, cols, capacity))
 
+    def _replay(self, entries) -> None:
+        """Re-push entries one at a time; each leaves the pending
+        buffer only when its replacement is acked (``push_chunk``
+        re-appends on ack) — a death mid-replay keeps the tail for the
+        next ``recover()`` instead of silently discarding it."""
+        for i, (_e, table, cols, capacity) in enumerate(entries):
+            try:
+                self.push_chunk(table, cols, capacity)
+            except BaseException:
+                self._pending.extend(entries[i:])
+                raise
+
     def barrier(self, _retried: bool = False) -> int:
         self._barrier_inflight = True
-        reply, _ = self._rpc({"type": "barrier"})
+        try:
+            reply, _ = self._rpc({"type": "barrier"})
+        except ComputeError:
+            # the node REPLIED (it is alive) but the barrier errored —
+            # the commit may or may not have landed. Reconcile against
+            # the live frontier (the same disambiguation recover()
+            # uses) so epoch-None entries a landed commit covered are
+            # never replayed; if even status() fails, keep the
+            # in-flight ambiguity for recover().
+            try:
+                committed = self.status()
+            except (ComputeError, ConnectionError, OSError):
+                committed = None
+            if committed is not None:
+                if committed > self._last_committed:
+                    self._pending = [
+                        p for p in self._pending if p[0] is not None
+                    ]
+                self._last_committed = committed
+                self._barrier_inflight = False
+            raise
         self._barrier_inflight = False
         committed = int(reply["committed"])
         if reply["type"] == "barrier_failed":
@@ -153,8 +211,7 @@ class ComputeClient:
                 if p[0] is None or p[0] > committed
             ]
             self._pending = []
-            for _e, table, cols, capacity in replay:
-                self.push_chunk(table, cols, capacity)
+            self._replay(replay)
             if _retried:
                 raise ComputeError("barrier rolled back twice")
             return self.barrier(_retried=True)
@@ -185,7 +242,7 @@ class ComputeClient:
         its reply must not double-apply rows)."""
         if self.state_dir is None:
             raise RuntimeError("no state_dir to recover from")
-        fresh = ComputeClient.spawn(self.state_dir)
+        fresh = ComputeClient.spawn(self.state_dir, env=self.env)
         self.port, self.proc, self.sock = fresh.port, fresh.proc, fresh.sock
         frontier = self.status()
         if self._barrier_inflight and frontier > self._last_committed:
@@ -199,5 +256,4 @@ class ComputeClient:
             p for p in self._pending if p[0] is None or p[0] > frontier
         ]
         self._pending = []
-        for _e, table, cols, capacity in replay:
-            self.push_chunk(table, cols, capacity)
+        self._replay(replay)

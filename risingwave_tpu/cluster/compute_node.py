@@ -21,6 +21,7 @@ Run: ``python -m risingwave_tpu compute-node --port 0 --state-dir DIR``
 
 from __future__ import annotations
 
+import os
 import socket
 import sys
 
@@ -62,9 +63,11 @@ def serve(port: int, state_dir: str) -> None:
 
 
 def _serve_conn(conn: socket.socket, session) -> None:
+    from risingwave_tpu import utils_sync_point as sync_point
     from risingwave_tpu.cluster import wire
 
-    dicts = getattr(session, "strings", None)
+    shared = getattr(session, "strings", None)
+    dicts = wire.SharedDictionaries(shared) if shared is not None else None
     while True:
         header, payload = wire.recv_frame(conn)
         kind = header.get("type")
@@ -79,13 +82,38 @@ def _serve_conn(conn: socket.socket, session) -> None:
                     dictionaries=dicts,
                 )
                 table = header["table"]
-                n = 0
                 targets = session.dml._targets.get(table, ())
                 if not targets:
                     raise KeyError(f"no consumers for stream {table!r}")
-                for frag, side in targets:
-                    session.runtime.push(frag, chunk, side)
-                    n += 1
+                try:
+                    for frag, side in targets:
+                        sync_point.hit("compute_push")
+                        session.runtime.push(frag, chunk, side)
+                except Exception as push_err:
+                    # a failure after the first target absorbed rows
+                    # would leave the epoch half-applied; roll the WHOLE
+                    # epoch back in place (the watchdog's recovery:
+                    # rebuild dead actors + restore from last commit) so
+                    # state is as-if this chunk never arrived, then
+                    # surface the error — the client has not buffered it
+                    # yet, and the next barrier reports barrier_failed
+                    # so the client replays the epoch's EARLIER chunks.
+                    # The flag is session-level (NOT connection-local,
+                    # a reconnect must still see barrier_failed) and set
+                    # BEFORE the rollback so no window commits the
+                    # half-applied state.
+                    session._push_rolled_back = True
+                    try:
+                        session.runtime._auto_recover(push_err)
+                    except BaseException:
+                        # the rollback itself failed (or escalated after
+                        # repeated deterministic faults): in-place state
+                        # is unrecoverable — die, so the driver's
+                        # respawn + restore + replay path takes over
+                        # from the last DURABLE epoch instead of ever
+                        # committing the half-applied one
+                        os._exit(11)
+                    raise push_err
                 # permit grant: rows are returned to the sender's
                 # budget only after the node ABSORBED them (permit.rs)
                 wire.send_frame(
@@ -106,7 +134,10 @@ def _serve_conn(conn: socket.socket, session) -> None:
                     if session.runtime.mgr
                     else 0
                 )
-                if session.runtime.auto_recoveries > before:
+                if session.runtime.auto_recoveries > before or getattr(
+                    session, "_push_rolled_back", False
+                ):
+                    session._push_rolled_back = False
                     wire.send_frame(
                         conn,
                         {"type": "barrier_failed", "committed": committed},
@@ -121,14 +152,19 @@ def _serve_conn(conn: socket.socket, session) -> None:
                         },
                     )
             elif kind == "query":
+                from decimal import Decimal
+
                 out, tag = session.execute(header["sql"])
-                # results are already decoded (strings, decimals, NULL
-                # as None) by the session's result edge — small enough
-                # for JSON; the DATA plane stays Arrow
+                # results are already decoded (strings, NULL as None)
+                # by the session's result edge — small enough for JSON;
+                # the DATA plane stays Arrow. DECIMALs cross as their
+                # exact string form (JSON has no decimal type).
                 rows = {
                     k: [
                         None
                         if x is None
+                        else str(x)
+                        if isinstance(x, Decimal)
                         else (x.item() if hasattr(x, "item") else x)
                         for x in v
                     ]
@@ -170,6 +206,26 @@ def run(port: int, state_dir: str, device: str = "cpu") -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    # cross-process failpoint (the reference's fail::fail_point! over
+    # its sync-point sites): RW_TPU_FAULT="<sync_point>:<nth>" arms the
+    # named sync point to raise on its nth hit — tests drive exact
+    # crash windows in the spawned node without reaching into it
+    fault = os.environ.get("RW_TPU_FAULT")
+    if fault:
+        from risingwave_tpu import utils_sync_point as sync_point
+
+        name, sep, nth_s = fault.rpartition(":")
+        if not sep:
+            name, nth_s = fault, "1"
+        nth = int(nth_s)
+        counter = {"n": 0}
+
+        def _trip() -> None:
+            counter["n"] += 1
+            if counter["n"] == nth:
+                raise RuntimeError(f"injected fault at {name} #{nth}")
+
+        sync_point.activate(name, _trip)
     serve(port, state_dir)
 
 

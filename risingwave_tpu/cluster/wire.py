@@ -48,6 +48,20 @@ def recv_frame(sock: socket.socket) -> Tuple[dict, bytes]:
     return header, payload
 
 
+class SharedDictionaries(dict):
+    """``chunk_from_arrow`` expects ``{column -> StringDictionary}``;
+    a session keeps ONE global dictionary for every varchar lane. This
+    mapping hands that shared instance to whichever string column asks
+    (only string-typed Arrow columns call ``setdefault``)."""
+
+    def __init__(self, shared):
+        super().__init__()
+        self._shared = shared
+
+    def setdefault(self, key, default=None):
+        return self._shared
+
+
 def chunk_payload(chunk, dictionaries=None) -> bytes:
     """StreamChunk -> Arrow IPC stream bytes (ops lane included)."""
     import io

@@ -394,11 +394,13 @@ class MvDeviceState:
         return cls(values, vnulls, sdirty, stored, dropped)
 
 
-@partial(jax.jit, static_argnames=("pk", "cols"), donate_argnums=(0, 1))
-def _mv_step(table, state, chunk, pk, cols):
+def mv_step_fn(table, state, chunk, pk, cols):
     """One chunk applied to the device MV: find-or-insert pk, last row
     per pk wins (Overwrite conflict behavior), deletes flip live off.
-    Entirely on device — zero host syncs (the tunneled-TPU contract)."""
+    Entirely on device — zero host syncs (the tunneled-TPU contract).
+    Un-jitted so sharded wrappers can call it inside shard_map
+    (parallel/sharded_mv.py); the single-chip executor uses the jitted
+    ``_mv_step`` below."""
     keys = tuple(chunk.col(k) for k in pk)
     table, slots, found, inserted = lookup_or_insert(table, keys, chunk.valid)
     dropped = state.dropped | jnp.any(chunk.valid & (slots < 0))
@@ -423,6 +425,11 @@ def _mv_step(table, state, chunk, pk, cols):
     return table, MvDeviceState(values, vnulls, sdirty, state.stored, dropped)
 
 
+_mv_step = partial(jax.jit, static_argnames=("pk", "cols"), donate_argnums=(0, 1))(
+    mv_step_fn
+)
+
+
 @partial(jax.jit, static_argnames=("new_cap",), donate_argnums=())
 def _mv_rebuild(table, state, new_cap):
     """Re-insert surviving slots into a fresh table (host-decided
@@ -443,7 +450,42 @@ def _mv_rebuild(table, state, new_cap):
     )
 
 
-class DeviceMaterializeExecutor(Executor, Checkpointable):
+class MvDeviceReadMixin:
+    """Read surface over a ``_host_rows()`` provider — shared by the
+    single-chip device MV and the mesh-sharded one
+    (parallel/sharded_mv.py) so the k{j}/v{j}/n_{c} lane naming and
+    NULL decoding live in exactly one place."""
+
+    def snapshot(self):
+        """pk tuple -> value tuple (NULL -> None), matching the host-map
+        executors' interface. One bulk transfer, on demand."""
+        _, rows = self._host_rows()
+        n = len(rows["k0"]) if self.pk else 0
+        out = {}
+        for i in range(n):
+            k = tuple(rows[f"k{j}"][i].item() for j in range(len(self.pk)))
+            v = tuple(
+                None
+                if (f"n_{c}" in rows and rows[f"n_{c}"][i])
+                else rows[f"v{j}"][i].item()
+                for j, c in enumerate(self.columns)
+            )
+            out[k] = v
+        return out
+
+    def to_numpy(self):
+        _, rows = self._host_rows()
+        out = {}
+        for j, name in enumerate(self.pk):
+            out[name] = rows[f"k{j}"]
+        for j, name in enumerate(self.columns):
+            out[name] = rows[f"v{j}"]
+            if f"n_{name}" in rows:
+                out[name + "__null"] = rows[f"n_{name}"]
+        return out
+
+
+class DeviceMaterializeExecutor(MvDeviceReadMixin, Executor, Checkpointable):
     """Device-resident MV: pk-keyed hash table + value lanes in HBM.
 
     Reference: src/stream/src/executor/mview/materialize.rs:44 with
@@ -569,33 +611,7 @@ class DeviceMaterializeExecutor(Executor, Checkpointable):
         )
         return sel, pull_rows(lanes, sel)
 
-    def snapshot(self):
-        """pk tuple -> value tuple (NULL -> None), matching the host-map
-        executors' interface. One bulk transfer, on demand."""
-        _, rows = self._host_rows()
-        n = len(rows["k0"]) if self.pk else 0
-        out = {}
-        for i in range(n):
-            k = tuple(rows[f"k{j}"][i].item() for j in range(len(self.pk)))
-            v = tuple(
-                None
-                if (f"n_{c}" in rows and rows[f"n_{c}"][i])
-                else rows[f"v{j}"][i].item()
-                for j, c in enumerate(self.columns)
-            )
-            out[k] = v
-        return out
-
-    def to_numpy(self):
-        _, rows = self._host_rows()
-        out = {}
-        for j, name in enumerate(self.pk):
-            out[name] = rows[f"k{j}"]
-        for j, name in enumerate(self.columns):
-            out[name] = rows[f"v{j}"]
-            if f"n_{name}" in rows:
-                out[name + "__null"] = rows[f"n_{name}"]
-        return out
+    # snapshot()/to_numpy() come from MvDeviceReadMixin
 
     # -- checkpoint/restore -----------------------------------------------
     def checkpoint_delta(self):

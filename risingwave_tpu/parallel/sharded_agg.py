@@ -99,8 +99,14 @@ class ShardedHashAgg(Executor, Checkpointable):
         chunk_cap: Optional[int] = None,
         nullable_keys: Sequence[str] = (),
         table_id: str = "sharded_agg",
+        stacked_out: bool = False,
     ):
         self.table_id = table_id
+        # stacked_out keeps barrier-flush deltas as STACKED device
+        # chunks — required when the flush feeds another sharded op
+        # (e.g. a join side: q7's per-window MAX change stream) instead
+        # of crossing the host boundary
+        self.stacked_out = stacked_out
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
         self.n_shards = mesh.devices.size
@@ -312,8 +318,13 @@ class ShardedHashAgg(Executor, Checkpointable):
         )
 
     def _delta_to_chunk(self, delta) -> StreamChunk:
-        """Stacked (n_shards, 2*out_cap) delta -> one flat StreamChunk."""
-        flat = lambda a: np.asarray(a).reshape(-1)
+        """Stacked (n_shards, 2*out_cap) delta -> one flat StreamChunk
+        (or, with ``stacked_out``, a stacked device chunk that flows
+        straight into the next sharded op with no host round-trip)."""
+        if self.stacked_out:
+            flat = lambda a: a  # keep the shard axis + device residency
+        else:
+            flat = lambda a: np.asarray(a).reshape(-1)
         cols, nulls = {}, {}
         i = 0
         for name, nb in zip(self.group_keys, self.nullable):

@@ -430,7 +430,7 @@ class FlattenExecutor(Executor):
         return [flatten_stacked(chunk)]
 
 
-def _sharded_equiv(ex, mesh):
+def _sharded_equiv(ex, mesh, stacked_out: bool = False):
     """Sharded replacement for a keyed single-chip executor, carrying
     the SAME table_id (the checkpoint is one logical table either
     way). None when the executor's features aren't sharded yet."""
@@ -453,6 +453,7 @@ def _sharded_equiv(ex, mesh):
                 k for k, nb in zip(ex.group_keys, ex.nullable) if nb
             ),
             table_id=ex.table_id,
+            stacked_out=stacked_out,
         )
     if isinstance(ex, AppendOnlyDedupExecutor):
         if ex.window_key is not None:
@@ -492,6 +493,69 @@ def _shard_single_chain(chain, mesh):
     return list(chain[:keyed_idx]) + mid + list(chain[keyed_idx + 1 :])
 
 
+def _shard_tail(tail, mesh, value_dtypes, value_nulls, capacity=None):
+    """Replace a fixed-width materializer tail with a vnode-partitioned
+    ``ShardedMaterialize`` (VERDICT r4 #6): Col-only projects stay
+    stacked, the MV partitions by pk over the mesh, and a final Flatten
+    keeps drained output flat for subscribers. ``value_dtypes`` /
+    ``value_nulls`` describe the lanes arriving at the tail (from the
+    upstream join or agg). Returns (tail_chain, sharded_mview) or None
+    when the shape can't swap (nullable/unknown pk lane, non-Col
+    projects, non-materializer tail)."""
+    from risingwave_tpu.executors.materialize import (
+        DeviceMaterializeExecutor,
+        MaterializeExecutor,
+    )
+    from risingwave_tpu.parallel.sharded_mv import ShardedMaterialize
+
+    if not tail:
+        return None
+    *pre, mat = tail
+    for ex in pre:
+        if not isinstance(ex, ProjectExecutor) or not all(
+            isinstance(e, E.Col) for _n, e in ex.outputs
+        ):
+            return None
+    renames: Dict[str, str] = {}  # output name -> source lane name
+    for ex in pre:
+        new = {n: renames.get(e.name, e.name) for n, e in ex.outputs}
+        renames = new
+    src_of = lambda n_: renames.get(n_, n_) if renames else n_
+    if isinstance(mat, DeviceMaterializeExecutor):
+        pk, columns = mat.pk, mat.columns
+        dtypes = dict(mat.dtypes)
+        nullable = tuple(mat.state.vnulls)
+        capacity = mat.table.capacity
+    elif isinstance(mat, MaterializeExecutor):
+        pk, columns = mat.pk, mat.columns
+        dtypes, nullable = {}, []
+        for n_ in pk + columns:
+            d = value_dtypes.get(src_of(n_))
+            if d is None:
+                return None
+            dtypes[n_] = jnp.dtype(d)
+            if src_of(n_) in value_nulls:
+                if n_ in pk:
+                    return None  # nullable pk: host-map executor only
+                nullable.append(n_)
+        nullable = tuple(nullable)
+        # per-shard capacity follows the plan's sizing (the upstream
+        # join/agg capacity), like every other sharded op
+        capacity = capacity or (1 << 14)
+    else:
+        return None
+    smv = ShardedMaterialize(
+        mesh,
+        pk,
+        columns,
+        dtypes,
+        table_id=mat.table_id,
+        capacity=capacity,
+        nullable=nullable,
+    )
+    return list(pre) + [smv, FlattenExecutor()], smv
+
+
 def sharded_planned_mv(planner_factory, sql: str, n_shards: int):
     """Plan ``sql`` and run it as SHARDED fragments over an n-device
     jax Mesh: keyed state stacked across devices, exchanges on ICI via
@@ -504,6 +568,7 @@ def sharded_planned_mv(planner_factory, sql: str, n_shards: int):
     proto = planner_factory().plan(sql)
     from risingwave_tpu.sql.planner import PlannedMV
 
+    mview = proto.mview
     if isinstance(proto.pipeline, TwoInputPipeline):
         tp = proto.pipeline
         left = _shard_side_chain(tp.left, mesh)
@@ -526,11 +591,36 @@ def sharded_planned_mv(planner_factory, sql: str, n_shards: int):
                 join_type=join.join_type,
                 table_id=join.table_id,
             )
+            tail = None
+            if join.join_type == "inner":
+                # outer joins append computed null lanes per emission
+                # side — only inner emissions carry exactly the declared
+                # nullable sets, so only those swap to the sharded MV
+                out_dtypes = {
+                    n_: a.dtype for n_, a in join.left.rows.items()
+                }
+                out_dtypes.update(
+                    {n_: a.dtype for n_, a in join.right.rows.items()}
+                )
+                out_nulls = set(join.left.row_nulls) | set(
+                    join.right.row_nulls
+                )
+                tail = _shard_tail(
+                    tp.tail,
+                    mesh,
+                    out_dtypes,
+                    out_nulls,
+                    capacity=join.left.capacity,
+                )
+            if tail is None:
+                tail_chain = [FlattenExecutor()] + list(tp.tail)
+            else:
+                tail_chain, mview = tail
             build = {
                 "left": left,
                 "right": right,
                 "join": sj,
-                "tail": [FlattenExecutor()] + list(tp.tail),
+                "tail": tail_chain,
             }
             specs = [
                 FragmentSpec("left_src", lambda i: []),
@@ -552,18 +642,53 @@ def sharded_planned_mv(planner_factory, sql: str, n_shards: int):
         if chain is None:
             gp = _singleton_graph(list(proto.pipeline.executors))
         else:
+            swapped = _shard_single_tail(chain, mesh)
+            if swapped is not None:
+                chain, mview = swapped
             specs = [FragmentSpec("mv", lambda i, c=tuple(chain): list(c))]
             gp = GraphPipeline(specs, {"single": "mv"}, "mv", chain)
     return PlannedMV(
-        proto.name, gp, proto.mview, proto.inputs, schema=proto.schema
+        proto.name, gp, mview, proto.inputs, schema=proto.schema
     )
 
 
+def _shard_single_tail(chain, mesh):
+    """After ``_shard_single_chain``, try to keep the MV sharded too:
+    [..., ShardedHashAgg, (Flatten?), projects..., DeviceMaterialize]
+    becomes [..., agg(stacked flush), projects..., ShardedMaterialize,
+    Flatten]. Only the device materializer swaps here (its dtypes and
+    null lanes are declared; the host-map executor's are inferred only
+    on the join path). Returns (chain, mview) or None."""
+    from risingwave_tpu.parallel.sharded_agg import ShardedHashAgg
+
+    agg_idx = next(
+        (
+            j
+            for j, ex in enumerate(chain)
+            if isinstance(ex, ShardedHashAgg)
+        ),
+        None,
+    )
+    if agg_idx is None:
+        return None
+    rest = chain[agg_idx + 1 :]
+    swapped = _shard_tail(rest, mesh, {}, set())
+    if swapped is None:
+        return None
+    tail_chain, smv = swapped
+    agg = chain[agg_idx]
+    agg.stacked_out = True
+    return list(chain[: agg_idx + 1]) + tail_chain, smv
+
+
 def _shard_side_chain(chain, mesh):
-    """A join side shards when it is stateless* + optional ONE dedup +
-    rename-only projects (which operate element-wise on stacked
-    chunks). Returns the sharded chain or None."""
-    from risingwave_tpu.parallel.sharded_join import ShardedDedup
+    """A join side shards when it is stateless* + optional ONE keyed op
+    (append-only dedup -> ShardedDedup; windowless non-materialized
+    HashAgg -> ShardedHashAgg whose barrier flush stays STACKED and
+    feeds the join directly — the q7 per-window-MAX side) + rename-only
+    projects (element-wise on stacked chunks). Returns the sharded
+    chain or None."""
+    from risingwave_tpu.executors.row_id_gen import RowIdGenExecutor
 
     out = []
     seen_keyed = False
@@ -571,17 +696,14 @@ def _shard_side_chain(chain, mesh):
         if isinstance(ex, _KEYED):
             if seen_keyed:
                 return None
-            # type/feature-check BEFORE building: _sharded_equiv
-            # allocates mesh-stacked device state (a sharded agg would
-            # be constructed only to be discarded — agg flushes flat
-            # chunks, which can't feed a stacked join)
-            if (
-                not isinstance(ex, AppendOnlyDedupExecutor)
-                or ex.window_key is not None
-            ):
+            # feature-check BEFORE building: _sharded_equiv allocates
+            # mesh-stacked device state
+            if isinstance(ex, HashAggExecutor):
+                sharded = _sharded_equiv(ex, mesh, stacked_out=True)
+            else:
+                sharded = _sharded_equiv(ex, mesh)
+            if sharded is None:
                 return None
-            sharded = _sharded_equiv(ex, mesh)
-            assert isinstance(sharded, ShardedDedup)
             seen_keyed = True
             out.append(StackSplitExecutor(mesh.devices.size))
             out.append(sharded)
@@ -593,7 +715,11 @@ def _shard_side_chain(chain, mesh):
             out.append(ex)
         elif isinstance(ex, (FilterExecutor, HopWindowExecutor)):
             if seen_keyed:
-                return None  # pre-exchange ops only before the dedup
+                return None  # pre-exchange ops only before the keyed op
+            out.append(ex)
+        elif isinstance(ex, RowIdGenExecutor):
+            if seen_keyed:
+                return None  # runs on flat host-side chunks only
             out.append(ex)
         else:
             return None

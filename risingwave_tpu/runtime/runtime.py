@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from risingwave_tpu import utils_sync_point as sync_point
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.metrics import REGISTRY
 from risingwave_tpu.trace import span
@@ -236,6 +237,10 @@ class StreamingRuntime:
         raise ValueError(f"fragment {name!r} has no materialize stage")
 
     def _push_into(self, name: str, chunk: StreamChunk, side: str):
+        # failpoint for crash tests: a push that dies mid-fan-out (one
+        # subscriber absorbed the chunk, a later one did not) is the
+        # half-applied-epoch window the compute node must roll back
+        sync_point.hit(f"push_into:{name}:{side}")
         p = self.fragments[name]
         if side == "left":
             return p.push_left(chunk)

@@ -113,6 +113,111 @@ def test_two_process_q5_parity_and_kill9_recovery(tmp_path):
 
 
 @pytest.mark.slow
+def test_failed_multi_target_push_rolls_back_whole_epoch(tmp_path):
+    """A table feeding q5 AND q7 fans every chunk out through the
+    subscription edges; if a later subscriber's push fails after an
+    earlier one absorbed the rows, the node must roll the whole epoch
+    back (not keep it half-applied) and report barrier_failed so the
+    driver replays the epoch's earlier chunks. Fault injection: the
+    RW_TPU_FAULT failpoint raises at the 2nd push into q7 — chunk 1
+    lands everywhere, chunk 2 dies after bid + q5 absorbed it."""
+    chunks = _bid_cols(2)
+    q7_sql = (
+        "CREATE MATERIALIZED VIEW q7 AS "
+        "SELECT b.auction, b.bidder, b.price, b.wstart FROM "
+        "(SELECT auction, bidder, price, window_start AS wstart "
+        " FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND)) AS b "
+        "JOIN "
+        "(SELECT max(price) AS maxprice, window_start AS mwstart "
+        " FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND) "
+        " GROUP BY window_start) AS m "
+        "ON b.wstart = m.mwstart AND b.price = m.maxprice"
+    )
+    ddl2 = DDL + [q7_sql]
+
+    def _query_both(run):
+        q5 = run("SELECT auction, window_start, num FROM q5")
+        q7 = run("SELECT auction, price FROM q7")
+        return _rows(q5), sorted(
+            zip([int(x) for x in q7["auction"]], [int(x) for x in q7["price"]])
+        )
+
+    def _oracle2():
+        from risingwave_tpu.array.chunk import StreamChunk
+        from risingwave_tpu.frontend.session import SqlSession
+        from risingwave_tpu.sql import Catalog
+
+        s = SqlSession(Catalog({}), capacity=1 << 12)
+        for sql in ddl2:
+            s.execute(sql)
+        for cols in chunks:
+            chunk = StreamChunk.from_numpy(cols, 1 << 10)
+            for frag, side in s.dml._targets.get("bid", ()):
+                s.runtime.push(frag, chunk, side)
+            s.runtime.barrier()
+        return _query_both(lambda q: s.execute(q)[0])
+
+    want_q5, want_q7 = _oracle2()
+    assert want_q5 and want_q7
+
+    cn = ComputeClient.spawn(
+        str(tmp_path / "state"),
+        env={"RW_TPU_FAULT": "push_into:q7:both:2"},
+    )
+    try:
+        for sql in ddl2:
+            cn.ddl(sql)
+        cn.push_chunk("bid", chunks[0], 1 << 10)  # q7 hit 1: absorbed
+        from risingwave_tpu.cluster.client import ComputeError
+
+        with pytest.raises(ComputeError, match="injected fault"):
+            # dies at q7 hit 2 — AFTER bid's table fragment and q5
+            # already absorbed the rows (the half-applied window)
+            cn.push_chunk("bid", chunks[1], 1 << 10)
+        # the rollback erased chunk 0 too; the failed barrier makes the
+        # client replay it, then the retried barrier seals the epoch
+        cn.barrier()
+        cn.push_chunk("bid", chunks[1], 1 << 10)  # clean retry (hit 3)
+        cn.barrier()
+        got_q5, got_q7 = _query_both(cn.query)
+        assert got_q5 == want_q5
+        assert got_q7 == want_q7
+    finally:
+        cn.close()
+
+
+@pytest.mark.slow
+def test_varchar_over_the_wire(tmp_path):
+    """String lanes cross the wire as Arrow strings: the client encodes
+    its numpy str/object columns through a client-side dictionary, the
+    payload decodes them back to strings, and the node re-encodes into
+    the session's ONE shared dictionary (wire.SharedDictionaries)."""
+    cn = ComputeClient.spawn(str(tmp_path / "state"))
+    try:
+        cn.ddl(
+            "CREATE TABLE ev (name VARCHAR, v BIGINT, date_time BIGINT)"
+        )
+        cn.ddl(
+            "CREATE MATERIALIZED VIEW byname AS "
+            "SELECT name, count(*) AS num FROM "
+            "TUMBLE(ev, date_time, INTERVAL '10' SECOND) "
+            "GROUP BY name, window_start"
+        )
+        cols = {
+            "name": np.array(["a", "b", "a", "c"], dtype=object),
+            "v": np.arange(4, dtype=np.int64),
+            "date_time": np.array([1000, 2000, 3000, 4000], np.int64),
+        }
+        cn.push_chunk("ev", cols, 8)
+        cn.barrier()
+        out = cn.query("SELECT name, num FROM byname")
+        got = sorted(zip(out["name"], [int(x) for x in out["num"]]))
+        assert got == [("a", 2), ("b", 1), ("c", 1)]
+    finally:
+        cn.close()
+
+
+@pytest.mark.slow
 def test_kill_between_commit_and_reply_does_not_double_apply(tmp_path):
     """kill -9 landing AFTER the node committed epoch E but BEFORE the
     barrier_complete reply reaches the driver: the driver still holds
