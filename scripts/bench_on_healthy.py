@@ -1,0 +1,63 @@
+"""Fire bench.py the moment the TPU tunnel probe reports healthy.
+
+The tunnel wedges for hours and revives unpredictably (r05 log: two OK
+probes at 01:03/01:18 between dead stretches); a human-paced check
+misses those windows. This watcher polls the probe monitor's
+``.tpu_healthy`` marker every 45s and launches ``python bench.py``
+(which banks every success to BENCH_partial.json immediately and
+maintains ``.bench_running`` so the prober stands down) as soon as the
+marker appears. Results are left on disk for the builder to commit;
+BENCH_WATCH.log records every attempt either way.
+
+Usage: python scripts/bench_on_healthy.py  (backgrounded, SIGTERM-safe)
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MARKER = os.path.join(REPO, ".tpu_healthy")
+BUSY = os.path.join(REPO, ".bench_running")
+LOG = os.path.join(REPO, "BENCH_WATCH.log")
+COOLDOWN_S = 1800  # after a bench attempt, let the prober re-establish
+
+
+def log(msg: str) -> None:
+    stamp = datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds"
+    )
+    with open(LOG, "a") as f:
+        f.write(f"{stamp} {msg}\n")
+    print(msg, flush=True)
+
+
+def main() -> None:
+    log("watcher up")
+    while True:
+        if os.path.exists(MARKER) and not os.path.exists(BUSY):
+            log("tunnel healthy -> launching bench.py")
+            t0 = time.monotonic()
+            try:
+                rc = subprocess.call(
+                    [sys.executable, "bench.py"], cwd=REPO, timeout=5400
+                )
+            except subprocess.TimeoutExpired:
+                # bench.py budgets itself; this is a backstop. SIGTERM
+                # only (a SIGKILLed tunnel client wedges the relay).
+                log("bench.py exceeded 90min backstop (SIGTERMed)")
+                rc = -15
+            log(
+                f"bench.py exited rc={rc} after "
+                f"{time.monotonic() - t0:.0f}s — check BENCH_partial.json"
+            )
+            time.sleep(COOLDOWN_S)
+        time.sleep(45)
+
+
+if __name__ == "__main__":
+    main()
