@@ -646,11 +646,17 @@ def _run_child(query: str, tier: str, smoke: bool, agg_mode: str):
     ]
     if smoke:
         cmd.append("--smoke")
+    env = dict(os.environ)
+    # the barrier deadman must outlast first-epoch XLA compiles over the
+    # TPU tunnel (minutes); the child's own signal.alarm stays the real
+    # backstop, so give the deadman everything up to 30s before it
+    env.setdefault("RW_BARRIER_TIMEOUT_S", str(max(timeout_s - 30, 120)))
     proc = subprocess.Popen(
         cmd,
         stdout=subprocess.PIPE,
         stderr=subprocess.PIPE,
         text=True,
+        env=env,
     )
     try:
         out, err = proc.communicate(timeout=timeout_s + 45)

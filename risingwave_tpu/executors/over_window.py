@@ -30,6 +30,8 @@ from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Barrier, Executor
 from risingwave_tpu.ops.hash_table import (
     HashTable,
+    first_occurrence_mask,
+    last_occurrence_mask,
     lookup_or_insert,
     plan_rehash,
     set_live,
@@ -838,3 +840,701 @@ class OverWindowExecutor(Executor, Checkpointable):
         self._saw_delete = jnp.zeros((), jnp.bool_)
         self._dropped = jnp.zeros((), jnp.bool_)
         self._ooo = jnp.zeros((), jnp.bool_)
+
+
+# ---------------------------------------------------------------------------
+# General (retractable) over-window
+# ---------------------------------------------------------------------------
+
+
+@partial(
+    jax.jit,
+    static_argnames=("calls", "part_keys", "order_col", "pk", "lane_names"),
+    donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8),
+)
+def _general_over_step(
+    table: HashTable,
+    buf: Dict[str, jnp.ndarray],
+    bnulls: Dict[str, jnp.ndarray],
+    present: jnp.ndarray,
+    seq: jnp.ndarray,
+    em: Dict[str, jnp.ndarray],
+    emnulls: Dict[str, jnp.ndarray],
+    em_valid: jnp.ndarray,
+    sdirty: jnp.ndarray,
+    seq_base: jnp.ndarray,
+    chunk: StreamChunk,
+    calls: Tuple[WindowCall, ...],
+    part_keys: Tuple[str, ...],
+    order_col: str,
+    pk: Tuple[str, ...],
+    lane_names: Tuple[str, ...],
+):
+    """One fused retractable over-window step (general.rs:49 the TPU
+    way): apply the chunk's inserts/deletes to the pk-keyed row arena,
+    mark every touched partition dirty, re-sort the arena and recompute
+    EVERY window call over the dirty partitions, then diff against the
+    previously-emitted lanes and emit retract/re-emit pairs. The
+    reference walks per-row affected frame ranges (frame_finder.rs); on
+    TPU whole-partition recomputation in one sorted-segment program is
+    the idiomatic equivalent — segment scans are near-free on the VPU
+    and the emitted diff is identical."""
+    cap = present.shape[0]
+    n = chunk.capacity
+    total = cap + n  # sort domain: arena + ghost entries (one per row)
+    rows_active = chunk.valid
+    signs = chunk.effective_signs()
+    is_ins = signs > 0
+    is_del = rows_active & (signs < 0)
+
+    keys = tuple(chunk.col(k) for k in pk)
+    table, slots, found, _ = lookup_or_insert(table, keys, rows_active)
+    gslots = jnp.clip(slots, 0, cap - 1)
+    dropped = jnp.any(rows_active & (slots < 0))
+    pre_present = present[gslots]
+    dup = _chunk_dup(slots, rows_active)
+    # a DELETE must target a currently-present pk (or one produced
+    # earlier in this very chunk); anything else is upstream
+    # inconsistency (the reference's consistency check)
+    bad_delete = jnp.any(
+        is_del & ~dup & ~(slots < 0) & ~(found & pre_present)
+    )
+
+    # last occurrence per pk wins (within-chunk -old/+new updates);
+    # the table's live lane tracks the final presence so dead slots are
+    # reclaimed at the next rehash
+    writer = last_occurrence_mask(slots, rows_active)
+    table = set_live(table, jnp.where(writer, slots, -1), is_ins)
+
+    # ghost entries: a same-chunk partition-key move leaves the OLD
+    # partition with no touched member (the slot now sorts under its
+    # new partition), so its remaining rows would keep stale window
+    # values. Emit one non-live ghost per moved row under the OLD
+    # (emitted) partition keys purely to carry the dirty mark there.
+    moved = jnp.zeros(n, jnp.bool_)
+    for k in part_keys:
+        moved = moved | (
+            em[k][gslots] != chunk.col(k).astype(jnp.int64)
+        )
+    ghost = writer & is_ins & em_valid[gslots] & moved
+
+    target = jnp.where(writer, slots, cap)
+    present = present.at[target].set(is_ins, mode="drop")
+    for name in lane_names:
+        buf[name] = (
+            buf[name]
+            .at[target]
+            .set(chunk.col(name).astype(buf[name].dtype), mode="drop")
+        )
+        if name in bnulls:
+            lane = chunk.nulls.get(name, jnp.zeros(n, jnp.bool_))
+            bnulls[name] = bnulls[name].at[target].set(lane, mode="drop")
+    pos = jnp.arange(n, dtype=jnp.int64)
+    seq = seq.at[target].set(seq_base + pos, mode="drop")
+    touched = (
+        jnp.zeros(cap, jnp.bool_)
+        .at[jnp.where(rows_active, slots, cap)]
+        .set(True, mode="drop")
+    )
+    sdirty = sdirty | touched
+
+    # ---- sort the arena: members = rows needing compute or retraction
+    member = present | em_valid
+    member_e = jnp.concatenate([member, ghost])
+    present_e = jnp.concatenate([present, jnp.zeros(n, jnp.bool_)])
+    plane_e = tuple(
+        jnp.concatenate(
+            [
+                jnp.where(present, buf[k], em[k]).astype(jnp.int64),
+                em[k][gslots],
+            ]
+        )
+        for k in part_keys
+    )
+    order_e = jnp.concatenate(
+        [
+            jnp.where(present, buf[order_col], em[order_col]).astype(
+                jnp.int64
+            ),
+            em[order_col][gslots],
+        ]
+    )
+    seq_e = jnp.concatenate([seq, seq[gslots]])
+    touched_e = jnp.concatenate([touched, ghost])
+    idx = jnp.arange(total, dtype=jnp.int32)  # >= cap identifies ghosts
+    sort_in = (
+        (~member_e).astype(jnp.int32),
+        *plane_e,
+        (~present_e).astype(jnp.int32),  # live rows first per partition
+        order_e,
+        seq_e,
+        idx,
+    )
+    nk = len(sort_in) - 1
+    sorted_all = jax.lax.sort(sort_in, num_keys=nk)
+    s_idx = sorted_all[-1]
+
+    def s(a, fill=0):
+        """Gather an arena lane into the sorted domain (ghost entries
+        read the fill value — they are never live)."""
+        return jnp.concatenate(
+            [a, jnp.full(n, fill, a.dtype)]
+        )[s_idx]
+
+    member_s = member_e[s_idx]
+    live_s = present_e[s_idx]
+    plane_s = [p[s_idx] for p in plane_e]
+    v_order = order_e[s_idx]
+
+    arange = jnp.arange(total, dtype=jnp.int64)
+    boundary = jnp.zeros(total, jnp.bool_)
+    for lane in plane_s:
+        boundary = boundary | jnp.concatenate(
+            [jnp.ones(1, jnp.bool_), lane[1:] != lane[:-1]]
+        )
+    boundary = boundary | jnp.concatenate(
+        [jnp.ones(1, jnp.bool_), member_s[1:] != member_s[:-1]]
+    )
+    boundary = boundary.at[0].set(True)
+    gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    seg_start = jax.ops.segment_max(
+        jnp.where(boundary, arange, 0), gid, num_segments=total
+    )[gid]
+    in_seg = arange - seg_start
+    dirty_s = (
+        jax.ops.segment_max(
+            touched_e[s_idx].astype(jnp.int32), gid, num_segments=total
+        )[gid]
+        > 0
+    ) & member_s
+
+    MAXI = jnp.iinfo(jnp.int64).max
+    MINI = jnp.iinfo(jnp.int64).min
+    zero_nulls = jnp.zeros(total, jnp.bool_)
+
+    def shifted(vals, nullm, d):
+        j = jnp.arange(total, dtype=jnp.int32) + d
+        jc = jnp.clip(j, 0, total - 1)
+        ok = (
+            (j >= 0)
+            & (j < total)
+            & (gid[jc] == gid)
+            & live_s[jc]
+            & live_s
+        )
+        return jnp.where(ok, vals[jc], 0), jnp.where(ok, nullm[jc], True)
+
+    out_sorted: Dict[str, jnp.ndarray] = {}
+    out_nulls_sorted: Dict[str, jnp.ndarray] = {}
+    for c in calls:
+        if c.input is not None:
+            v = s(buf[c.input]).astype(jnp.int64)
+            vnull = (
+                s(bnulls[c.input], True)
+                if c.input in bnulls
+                else zero_nulls
+            )
+        if c.kind == "row_number":
+            o, onull = in_seg + 1, zero_nulls
+        elif c.kind in ("rank", "dense_rank"):
+            pv = jnp.concatenate(
+                [jnp.zeros(1, v_order.dtype), v_order[:-1]]
+            )
+            vb = boundary | (v_order != pv)
+            cum_vb_all = jnp.cumsum(vb.astype(jnp.int64))
+            seg_vb = jax.ops.segment_max(
+                jnp.where(boundary, cum_vb_all - 1, MINI),
+                gid,
+                num_segments=total,
+            )[gid]
+            if c.kind == "dense_rank":
+                o = cum_vb_all - seg_vb
+            else:
+
+                def reset_max(a, b):
+                    fa, va = a
+                    fb, vb_ = b
+                    return fa | fb, jnp.where(
+                        fb, vb_, jnp.maximum(va, vb_)
+                    )
+
+                _, grp_start = jax.lax.associative_scan(
+                    reset_max, (boundary, jnp.where(vb, in_seg, MINI))
+                )
+                o = grp_start + 1
+            onull = zero_nulls
+        elif c.kind in ("lead", "lag"):
+            d = c.offset if c.kind == "lead" else -c.offset
+            o, onull = shifted(v, vnull, d)
+        elif c.frame is not None:
+            lo, hi = c.frame
+            if c.kind == "count":
+                v, vnull = jnp.ones(total, jnp.int64), zero_nulls
+            ident = (
+                MAXI if c.kind == "min" else MINI if c.kind == "max" else 0
+            )
+            comb = (
+                jnp.minimum
+                if c.kind == "min"
+                else jnp.maximum
+                if c.kind == "max"
+                else (lambda a, b: a + b)
+            )
+            acc = jnp.full(total, ident, jnp.int64)
+            any_real = zero_nulls
+            for d in range(lo, hi + 1):
+                sv, sn = shifted(v, vnull, d)
+                real = ~sn
+                acc = comb(acc, jnp.where(real, sv, ident))
+                any_real = any_real | real
+            if c.kind == "count":
+                o, onull = acc, zero_nulls
+            else:
+                o, onull = acc, ~any_real
+        else:
+            # running UNBOUNDED PRECEDING .. CURRENT ROW
+            if c.kind == "count":
+                real = live_s
+                vv = jnp.ones(total, jnp.int64)
+            else:
+                real = live_s & ~vnull
+                vv = v
+            if c.kind in ("sum", "count"):
+                vv = jnp.where(real, vv, 0)
+                csum = jnp.cumsum(vv)
+                base = jax.ops.segment_max(
+                    jnp.where(boundary, csum - vv, MINI),
+                    gid,
+                    num_segments=total,
+                )[gid]
+                o, onull = csum - base, zero_nulls
+            else:
+                sent = MAXI if c.kind == "min" else MINI
+                vv = jnp.where(real, vv, sent)
+
+                def op(a, b):
+                    fa, va, ra = a
+                    fb, vb_, rb = b
+                    cmb = jnp.minimum if c.kind == "min" else jnp.maximum
+                    return (
+                        fa | fb,
+                        jnp.where(fb, vb_, cmb(va, vb_)),
+                        jnp.where(fb, rb, ra | rb),
+                    )
+
+                _, o, has = jax.lax.associative_scan(
+                    op, (boundary, vv, real)
+                )
+                onull = ~has
+        out_sorted[c.output] = o
+        out_nulls_sorted[c.output] = onull
+
+    # ---- unsort to slots (ghost entries, s_idx >= cap, are dropped);
+    # diff against the emitted lanes
+    dirty_slot = (
+        jnp.zeros(cap, jnp.bool_).at[s_idx].set(dirty_s, mode="drop")
+    )
+    new_out = {
+        name: jnp.zeros(cap, jnp.int64).at[s_idx].set(o, mode="drop")
+        for name, o in out_sorted.items()
+    }
+    new_out_nulls = {
+        name: jnp.zeros(cap, jnp.bool_).at[s_idx].set(o, mode="drop")
+        for name, o in out_nulls_sorted.items()
+    }
+    both = present & em_valid
+    changed = jnp.zeros(cap, jnp.bool_)
+    for name in lane_names:
+        cn = bnulls.get(name, jnp.zeros(cap, jnp.bool_))
+        en = emnulls.get(name, jnp.zeros(cap, jnp.bool_))
+        # compare values only where both sides are non-NULL — the cell
+        # under a NULL flag is an arbitrary fill
+        changed = changed | (
+            ~cn & ~en & (buf[name].astype(jnp.int64) != em[name])
+        )
+        changed = changed | (cn != en)
+    for c in calls:
+        nn = new_out_nulls[c.output]
+        en = emnulls.get(c.output, jnp.zeros(cap, jnp.bool_))
+        changed = changed | (
+            jnp.where(~nn, new_out[c.output], 0)
+            != jnp.where(~en, em[c.output], 0)
+        )
+        changed = changed | (nn != en)
+    changed = changed & both
+    retract = em_valid & dirty_slot & (~present | changed)
+    insert = present & dirty_slot & (~em_valid | changed)
+    sdirty = sdirty | retract | insert
+
+    ops_del = jnp.full(cap, 1, jnp.int32)  # Op.DELETE
+    ops_ins = jnp.zeros(cap, jnp.int32)  # Op.INSERT
+    out_names = tuple(c.output for c in calls)
+    # compact each diff to a dense prefix: a scattered-valid chunk
+    # defeats downstream _live_slice and host conversion fast paths
+    rorder = jnp.argsort(~retract, stable=True)
+    iorder = jnp.argsort(~insert, stable=True)
+    ret_cols = {
+        name: em[name][rorder] for name in lane_names + out_names
+    }
+    ret_nulls = {name: a[rorder] for name, a in emnulls.items()}
+    ret_chunk = StreamChunk(
+        columns=ret_cols,
+        valid=retract[rorder],
+        nulls=ret_nulls,
+        ops=ops_del,
+    )
+    ins_cols = {
+        name: buf[name].astype(jnp.int64)[iorder] for name in lane_names
+    }
+    ins_cols.update({name: new_out[name][iorder] for name in out_names})
+    ins_nulls = {name: a[iorder] for name, a in bnulls.items()}
+    ins_nulls.update(
+        {name: a[iorder] for name, a in new_out_nulls.items()}
+    )
+    ins_chunk = StreamChunk(
+        columns=ins_cols,
+        valid=insert[iorder],
+        nulls=ins_nulls,
+        ops=ops_ins,
+    )
+
+    # emitted state := what downstream now holds
+    upd = jnp.where(insert, jnp.arange(cap, dtype=jnp.int32), cap)
+    for name in lane_names:
+        em[name] = (
+            em[name].at[upd].set(buf[name].astype(jnp.int64), mode="drop")
+        )
+        cn = bnulls.get(name, jnp.zeros(cap, jnp.bool_))
+        emnulls[name] = (
+            emnulls.get(name, jnp.zeros(cap, jnp.bool_))
+            .at[upd]
+            .set(cn, mode="drop")
+        )
+    for name in out_names:
+        em[name] = em[name].at[upd].set(new_out[name], mode="drop")
+        emnulls[name] = (
+            emnulls.get(name, jnp.zeros(cap, jnp.bool_))
+            .at[upd]
+            .set(new_out_nulls[name], mode="drop")
+        )
+    em_valid = (em_valid & ~retract) | insert
+
+    return (
+        table,
+        buf,
+        bnulls,
+        present,
+        seq,
+        em,
+        emnulls,
+        em_valid,
+        sdirty,
+        ret_chunk,
+        ins_chunk,
+        dropped,
+        bad_delete,
+    )
+
+
+def _chunk_dup(slots: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """Rows whose slot already appeared earlier in the chunk (a delete
+    may legitimately target a row inserted earlier in the same chunk,
+    which lookup_or_insert reports as freshly inserted)."""
+    return valid & ~first_occurrence_mask(slots, valid)
+
+
+class GeneralOverWindowExecutor(Executor, Checkpointable):
+    """General (retractable) window functions over partitions.
+
+    Reference: src/stream/src/executor/over_window/general.rs:49 —
+    handles inserts, deletes and updates ANYWHERE in the ORDER BY
+    order, retracting and re-emitting every row whose window value
+    changes. The reference computes per-row affected frame ranges
+    (frame_finder.rs); the TPU re-design keeps all rows in a pk-keyed
+    device arena and recomputes complete dirty partitions in one fused
+    sorted-segment program per chunk — recompute is near-free on the
+    VPU, and the diff against the previously-emitted lanes yields the
+    exact minimal retract/re-emit set.
+
+    Supports every WindowCall kind including lead/lag(k) and static
+    ROWS frames (deletes may reopen any frame, so the general executor
+    has no hold-back constraint — it simply recomputes).
+    Checkpointable: current rows + emitted rows persist; recovery is
+    bit-exact."""
+
+    def __init__(
+        self,
+        partition_by: Sequence[str],
+        order_col: str,
+        pk: Sequence[str],
+        calls: Sequence[WindowCall],
+        schema_dtypes: Dict[str, object],
+        capacity: int = 1 << 12,
+        nullable: Sequence[str] = (),
+        table_id: str = "general_over",
+    ):
+        self.part_keys = tuple(partition_by)
+        self.order_col = order_col
+        self.pk = tuple(pk)
+        self.calls = tuple(calls)
+        for c in self.calls:
+            if c.kind in ("rank", "dense_rank") and c.input != order_col:
+                raise ValueError(
+                    f"{c.kind} ranks by the executor's order column "
+                    f"{order_col!r}; got input {c.input!r}"
+                )
+        for nm, d in schema_dtypes.items():
+            if not jnp.issubdtype(jnp.dtype(d), jnp.integer):
+                raise ValueError(
+                    f"general OverWindow lane {nm!r} has non-integer "
+                    f"dtype {d}: emitted/diffed lanes are carried as "
+                    "int64 (dictionary- or scale-encode upstream)"
+                )
+        self.lane_names = tuple(schema_dtypes)
+        self.out_names = tuple(c.output for c in self.calls)
+        self.schema_dtypes = dict(schema_dtypes)
+        self.nullable = tuple(nullable)
+        self.table_id = table_id
+        self._alloc(capacity)
+        self._seq_base = 0
+        self._dropped = jnp.zeros((), jnp.bool_)
+        self._bad_delete = jnp.zeros((), jnp.bool_)
+        self._bound = 0
+
+    def _alloc(self, cap: int):
+        self.table = HashTable.create(
+            cap, tuple(jnp.dtype(self.schema_dtypes[k]) for k in self.pk)
+        )
+        self.buf = {
+            n: jnp.zeros(cap, jnp.dtype(d))
+            for n, d in self.schema_dtypes.items()
+        }
+        self.bnulls = {n: jnp.zeros(cap, jnp.bool_) for n in self.nullable}
+        self.present = jnp.zeros(cap, jnp.bool_)
+        self.seq = jnp.zeros(cap, jnp.int64)
+        self.em = {
+            n: jnp.zeros(cap, jnp.int64)
+            for n in self.lane_names + self.out_names
+        }
+        self.emnulls = {}
+        self.em_valid = jnp.zeros(cap, jnp.bool_)
+        self.sdirty = jnp.zeros(cap, jnp.bool_)
+        self.stored = jnp.zeros(cap, jnp.bool_)
+
+    @property
+    def capacity(self) -> int:
+        return self.present.shape[0]
+
+    def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
+        for c in self.calls:
+            if c.kind in ("rank", "dense_rank") and c.input in chunk.nulls:
+                raise ValueError(
+                    f"rank order column {c.input!r} carries a null lane "
+                    "(NULL ordering unsupported)"
+                )
+        self._maybe_grow(chunk.capacity)
+        (
+            self.table,
+            self.buf,
+            self.bnulls,
+            self.present,
+            self.seq,
+            self.em,
+            self.emnulls,
+            self.em_valid,
+            self.sdirty,
+            ret,
+            ins,
+            dr,
+            bd,
+        ) = _general_over_step(
+            self.table,
+            self.buf,
+            self.bnulls,
+            self.present,
+            self.seq,
+            self.em,
+            self.emnulls,
+            self.em_valid,
+            self.sdirty,
+            jnp.int64(self._seq_base),
+            chunk,
+            self.calls,
+            self.part_keys,
+            self.order_col,
+            self.pk,
+            self.lane_names,
+        )
+        self._seq_base += chunk.capacity
+        self._bound += chunk.capacity
+        self._dropped = self._dropped | dr
+        self._bad_delete = self._bad_delete | bd
+        return [ret, ins]
+
+    def _maybe_grow(self, incoming: int):
+        cap = self.capacity
+        if self._bound + incoming <= cap * GROW_AT:
+            return
+        claimed = int(self.table.occupancy())
+        survivors = int(
+            jnp.sum(self.table.live | self.sdirty | self.stored)
+        )
+        new_cap = plan_rehash(cap, incoming, claimed, survivors, GROW_AT)
+        if new_cap is not None:
+            self._rehash(new_cap)
+            claimed = int(self.table.occupancy())
+        self._bound = claimed
+
+    def _rehash(self, new_cap: int):
+        # a slot survives iff someone still cares: live row, unflushed
+        # emission-state change (sdirty), or a durable row whose
+        # tombstone has not been staged yet (stored) — delete/insert
+        # churn with fresh pks compacts instead of growing forever
+        keep = (self.table.live | self.sdirty | self.stored) & (
+            self.table.fp1 != jnp.uint32(0)
+        )
+        new = HashTable.create(
+            new_cap, tuple(k.dtype for k in self.table.keys)
+        )
+        new, slots, _, _ = lookup_or_insert(new, self.table.keys, keep)
+        new = set_live(new, jnp.where(keep, slots, -1), self.table.live)
+        idx = jnp.where(keep, slots, new_cap)
+
+        def mv(a, fill=0):
+            return (
+                jnp.full(new_cap, fill, a.dtype).at[idx].set(a, mode="drop")
+            )
+
+        self.buf = {n: mv(a) for n, a in self.buf.items()}
+        self.bnulls = {n: mv(a) for n, a in self.bnulls.items()}
+        self.present = mv(self.present)
+        self.seq = mv(self.seq)
+        self.em = {n: mv(a) for n, a in self.em.items()}
+        self.emnulls = {n: mv(a) for n, a in self.emnulls.items()}
+        self.em_valid = mv(self.em_valid)
+        self.sdirty = mv(self.sdirty)
+        self.stored = mv(self.stored)
+        self.table = new
+
+    def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
+        from risingwave_tpu.ops.hash_table import stage_scalars
+
+        self._staged_scalars = stage_scalars(
+            self._dropped, self._bad_delete
+        )
+        if barrier is None:
+            self.finish_barrier()
+        return []
+
+    def _on_barrier_scalars(self, vals) -> None:
+        dr, bd = vals
+        if dr:
+            raise RuntimeError("general OverWindow row arena overflowed")
+        if bd:
+            raise RuntimeError(
+                "general OverWindow received a DELETE for an unknown pk "
+                "(inconsistent upstream)"
+            )
+
+    # -- checkpoint/restore ----------------------------------------------
+    def checkpoint_delta(self) -> List[StateDelta]:
+        sdirty = np.asarray(self.sdirty)
+        if not sdirty.any():
+            return []
+        alive = np.asarray(self.present | self.em_valid)
+        upsert, tomb, sel = stage_marks(
+            sdirty, alive, np.asarray(self.stored)
+        )
+        lanes = {f"k{i}": l for i, l in enumerate(self.table.keys)}
+        key_names = tuple(lanes)
+        for n in self.lane_names:
+            lanes[f"c_{n}"] = self.buf[n]
+        for n, a in self.bnulls.items():
+            lanes[f"cn_{n}"] = a
+        for n, a in self.em.items():
+            lanes[f"e_{n}"] = a
+        for n, a in self.emnulls.items():
+            lanes[f"en_{n}"] = a
+        lanes["seq"] = self.seq
+        lanes["present"] = self.present
+        pulled = pull_rows(lanes, sel)
+        keys = {k: pulled[k] for k in key_names}
+        vals = {k: v for k, v in pulled.items() if k not in key_names}
+        self.stored = (self.stored | jnp.asarray(upsert)) & ~jnp.asarray(
+            tomb
+        )
+        self.sdirty = jnp.zeros_like(self.sdirty)
+        return [StateDelta(self.table_id, keys, vals, tomb[sel], key_names)]
+
+    def restore_state(self, table_id, key_cols, value_cols) -> None:
+        n = len(next(iter(key_cols.values()))) if key_cols else 0
+        cap = grow_pow2(max(n, 1), self.capacity, GROW_AT)
+        self._alloc(cap)
+        if n:
+            key_dtypes = tuple(k.dtype for k in self.table.keys)
+            lanes = tuple(
+                jnp.asarray(np.asarray(key_cols[f"k{i}"], dtype=d))
+                for i, d in enumerate(key_dtypes)
+            )
+            self.table, slots, _, _ = lookup_or_insert(
+                self.table, lanes, jnp.ones(n, jnp.bool_)
+            )
+            self.table = set_live(self.table, slots, True)
+            self.stored = self.stored.at[slots].set(True)
+            pres = jnp.asarray(
+                np.asarray(value_cols["present"], dtype=bool)
+            )
+            self.present = self.present.at[slots].set(pres)
+            self.em_valid = self.em_valid.at[slots].set(pres)
+            self.seq = self.seq.at[slots].set(
+                jnp.asarray(np.asarray(value_cols["seq"], np.int64))
+            )
+            self._seq_base = int(np.asarray(value_cols["seq"]).max()) + 1
+            for nme in self.lane_names:
+                self.buf[nme] = (
+                    self.buf[nme]
+                    .at[slots]
+                    .set(
+                        jnp.asarray(
+                            np.asarray(
+                                value_cols[f"c_{nme}"],
+                                self.buf[nme].dtype,
+                            )
+                        )
+                    )
+                )
+            for nme in self.bnulls:
+                if f"cn_{nme}" in value_cols:
+                    self.bnulls[nme] = (
+                        self.bnulls[nme]
+                        .at[slots]
+                        .set(
+                            jnp.asarray(
+                                np.asarray(value_cols[f"cn_{nme}"], bool)
+                            )
+                        )
+                    )
+            for nme in self.em:
+                if f"e_{nme}" in value_cols:
+                    self.em[nme] = (
+                        self.em[nme]
+                        .at[slots]
+                        .set(
+                            jnp.asarray(
+                                np.asarray(
+                                    value_cols[f"e_{nme}"], np.int64
+                                )
+                            )
+                        )
+                    )
+            for key, v in value_cols.items():
+                if key.startswith("en_"):
+                    nme = key[3:]
+                    self.emnulls[nme] = (
+                        jnp.zeros(cap, jnp.bool_)
+                        .at[slots]
+                        .set(jnp.asarray(np.asarray(v, bool)))
+                    )
+        self._bound = int(n)
+        self._dropped = jnp.zeros((), jnp.bool_)
+        self._bad_delete = jnp.zeros((), jnp.bool_)

@@ -44,6 +44,15 @@ from risingwave_tpu.executors.base import Barrier, Epoch, Executor, Watermark
 from risingwave_tpu.ops.hashing import VNODE_COUNT, hash_columns
 from risingwave_tpu.runtime.pipeline import _walk_watermark, walk_chain
 
+
+def _default_barrier_timeout() -> float:
+    import os
+
+    try:
+        return float(os.environ.get("RW_BARRIER_TIMEOUT_S", "120"))
+    except ValueError:
+        return 120.0
+
 # message kinds flowing through channels
 CHUNK, BARRIER, WATERMARK, STOP = "chunk", "barrier", "watermark", "stop"
 
@@ -705,9 +714,17 @@ class GraphRuntime:
                 ch.send_control(BARRIER, b)
         return b
 
-    def wait_barrier(self, epoch: int, timeout: float = 120.0) -> None:
+    def wait_barrier(self, epoch: int, timeout: Optional[float] = None) -> None:
         """Block until every actor collected ``epoch``
-        (barrier_manager.rs:857 collect)."""
+        (barrier_manager.rs:857 collect).
+
+        ``timeout`` is a deadman for a silently-stuck actor, not the
+        failure path (a raising actor sets ``_failure`` and wakes us
+        immediately). Default comes from ``RW_BARRIER_TIMEOUT_S`` (else
+        120s): the first epoch on a tunneled TPU spends minutes inside
+        XLA compiles, so device benches raise it via the env var."""
+        if timeout is None:
+            timeout = _default_barrier_timeout()
         with self._collect_lock:
             try:
                 ok = self._collect_lock.wait_for(
@@ -730,7 +747,7 @@ class GraphRuntime:
     def inject_barrier(
         self,
         checkpoint: bool = True,
-        timeout: float = 120.0,
+        timeout: Optional[float] = None,
         epoch: Optional[int] = None,
     ) -> Barrier:
         """Send a barrier into every source and block until every actor
