@@ -628,6 +628,11 @@ def _run_child(query: str, tier: str, smoke: bool, agg_mode: str):
     import os
 
     epochs, events, chunk, timeout_s = TIERS[tier]
+    if query == "q7":
+        # q7's compile stack (grouped-max DynamicFilter + retracting
+        # join) is the deepest; its r05 mid-tier run blew the shared
+        # tier alarm and wedged the tunnel — give it 1.5x headroom
+        timeout_s = int(timeout_s * 1.5)
     cmd = [
         sys.executable,
         os.path.abspath(__file__),
