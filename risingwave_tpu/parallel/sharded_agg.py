@@ -49,6 +49,10 @@ from risingwave_tpu.executors.hash_agg import (
 from risingwave_tpu.ops import agg as agg_ops
 from risingwave_tpu.ops.agg import AggCall, AggState
 from risingwave_tpu.ops.hash_table import HashTable, lookup_or_insert, set_live
+from risingwave_tpu.parallel.sharded_join import (
+    double_bucket_cap,
+    track_bucket_cap,
+)
 from risingwave_tpu.parallel.exchange import (
     dest_shard as _dest_shard,
     exchange_chunk,
@@ -146,11 +150,13 @@ class ShardedHashAgg(Executor, Checkpointable):
         )
         self._step = None  # built lazily (needs bucket_cap from chunk)
         self._insert_bound = 0  # per-shard upper bound of claimed slots
+        self._built_bucket_cap: Optional[int] = None
 
     # -- the sharded step -------------------------------------------------
     def _build_step(self, chunk_cap: int):
         n_shards = self.n_shards
         bucket_cap = self.bucket_cap or max(64, (2 * chunk_cap) // n_shards)
+        track_bucket_cap(self, bucket_cap)
         calls, group_keys, nullable = self.calls, self.group_keys, self.nullable
         axis = self.axis
 
@@ -272,6 +278,38 @@ class ShardedHashAgg(Executor, Checkpointable):
                 (self.table.fp1 != jnp.uint32(0)).astype(jnp.int32), axis=1
             )))
         self._insert_bound = claimed
+
+    # -- capacity escape (watchdog replay, scale.rs:453 analogue) ---------
+    def capacity_overflow_latched(self) -> bool:
+        return bool(jnp.any(self.dropped))
+
+    def grow_for_replay(self) -> None:
+        """Double the skew-sensitive capacities (exchange bucket,
+        emission cap, probe table) and reset device state; recover()
+        restores the durable rows before the poisoned epoch replays."""
+        double_bucket_cap(self)
+        self.out_cap *= 2
+        self.capacity *= 2
+        table1 = HashTable.create(self.capacity, self._key_dtypes)
+        state1 = agg_ops.create_state(
+            self.capacity, self.calls, self._dtypes
+        )
+        stack = lambda a: jnp.broadcast_to(
+            a[None], (self.n_shards,) + a.shape
+        )
+        self.table = jax.device_put(
+            jax.tree.map(stack, table1), self._shard0
+        )
+        self.state = jax.device_put(
+            jax.tree.map(stack, state1), self._shard0
+        )
+        self.dropped = jax.device_put(
+            jnp.zeros(self.n_shards, jnp.bool_), self._shard0
+        )
+        self._insert_bound = 0
+        self._step = None
+        if hasattr(self, "_flush"):
+            del self._flush
 
     # -- barrier flush ----------------------------------------------------
     def _build_flush(self):

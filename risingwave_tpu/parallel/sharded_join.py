@@ -67,6 +67,27 @@ def flatten_stacked(chunk: StreamChunk) -> StreamChunk:
     return jax.tree.map(lambda a: a.reshape((-1,) + a.shape[2:]), chunk)
 
 
+def track_bucket_cap(ex, bucket_cap: int) -> None:
+    """Record the LARGEST exchange bucket any built step implied — the
+    growth escape must never rebuild smaller than what overflowed."""
+    ex._built_bucket_cap = max(
+        getattr(ex, "_built_bucket_cap", None) or 0, bucket_cap
+    )
+
+
+def double_bucket_cap(ex) -> None:
+    """The shared capacity-escape idiom: pin bucket_cap to 2x the
+    largest bucket in effect (explicit setting wins over the implied
+    per-chunk default)."""
+    cur = (
+        ex.bucket_cap
+        if ex.bucket_cap is not None
+        else getattr(ex, "_built_bucket_cap", None)
+    )
+    if cur is not None:
+        ex.bucket_cap = 2 * cur
+
+
 class ShardedDedup(Executor, Checkpointable):
     """Mesh-parallel DISTINCT: exchange by dedup key, local seen-set.
 
@@ -104,10 +125,12 @@ class ShardedDedup(Executor, Checkpointable):
             jnp.zeros(2, jnp.bool_), mesh, self.axis
         )  # [saw_delete, dropped|overflow]
         self._step = None
+        self._built_bucket_cap: Optional[int] = None
 
     def _build_step(self, chunk_cap: int):
         n, axis, keys = self.n_shards, self.axis, self.keys
         bucket_cap = self.bucket_cap or max(64, (2 * chunk_cap) // n)
+        track_bucket_cap(self, bucket_cap)
 
         def local(table, sdirty, flags, chunk):
             table, sdirty, flags, chunk = jax.tree.map(
@@ -152,6 +175,28 @@ class ShardedDedup(Executor, Checkpointable):
                 "grow capacity/bucket_cap"
             )
         return []
+
+    # -- capacity escape (watchdog replay, scale.rs:453 analogue) ---------
+    def capacity_overflow_latched(self) -> bool:
+        return bool(jnp.any(self.flags, axis=0)[1])
+
+    def grow_for_replay(self) -> None:
+        """Double probe capacity + exchange bucket and reset device
+        state at the new shapes; the watchdog's recover() restores
+        durable rows into them before the poisoned epoch replays."""
+        cap = 2 * self.table.keys[0].shape[-1]
+        double_bucket_cap(self)
+        key_dtypes = tuple(k.dtype for k in self.table.keys)
+        self.table = stack_for_mesh(
+            HashTable.create(cap, key_dtypes), self.mesh, self.axis
+        )
+        z = jnp.zeros(cap, jnp.bool_)
+        self.sdirty = stack_for_mesh(z, self.mesh, self.axis)
+        self.stored = stack_for_mesh(z, self.mesh, self.axis)
+        self.flags = stack_for_mesh(
+            jnp.zeros(2, jnp.bool_), self.mesh, self.axis
+        )
+        self._step = None
 
     # -- checkpoint/restore (one logical table across shards) ------------
     def checkpoint_delta(self) -> List[StateDelta]:
@@ -296,10 +341,12 @@ class ShardedHashJoin(Executor, Checkpointable):
             jnp.zeros((), jnp.bool_), mesh, self.axis
         )
         self._steps: Dict[Tuple[str, int], object] = {}
+        self._built_bucket_cap: Optional[int] = None
 
     def _build_step(self, arrival: str, chunk_cap: int):
         n, axis = self.n_shards, self.axis
         bucket_cap = self.bucket_cap or max(64, (2 * chunk_cap) // n)
+        track_bucket_cap(self, bucket_cap)
         own_keys = self.left_keys if arrival == "l" else self.right_keys
         other_keys = self.right_keys if arrival == "l" else self.left_keys
         own_names = self.left_names if arrival == "l" else self.right_names
@@ -392,6 +439,46 @@ class ShardedHashJoin(Executor, Checkpointable):
                     "stored row"
                 )
         return []
+
+    # -- capacity escape (watchdog replay, scale.rs:453 analogue) ---------
+    def capacity_overflow_latched(self) -> bool:
+        if bool(jnp.any(self._em_overflow)):
+            return True
+        return any(
+            bool(jnp.any(getattr(self, s).overflow))
+            for s in ("left", "right")
+        )
+
+    def grow_for_replay(self) -> None:
+        """Double the overflowed dimension (emission/bucket caps on the
+        exchange latch; capacity+fanout on a side latch) and reset both
+        sides empty at the new shapes — the mid-epoch state is poisoned
+        either way, and recover() restores the durable rows before the
+        epoch replays."""
+        if bool(jnp.any(self._em_overflow)):
+            self.out_cap *= 2
+            double_bucket_cap(self)
+        side_ovf = any(
+            bool(jnp.any(getattr(self, s).overflow))
+            for s in ("left", "right")
+        )
+        f = 2 if side_ovf else 1  # key lanes pair: grow both sides
+        for name in ("left", "right"):
+            proto = jax.tree.map(lambda a: a[0], getattr(self, name))
+            side1 = JoinSide.create(
+                proto.capacity * f,
+                proto.fanout * f,
+                tuple(k.dtype for k in proto.table.keys),
+                {nm: a.dtype for nm, a in proto.rows.items()},
+                nullable=tuple(proto.row_nulls),
+            )
+            setattr(
+                self, name, stack_for_mesh(side1, self.mesh, self.axis)
+            )
+        self._em_overflow = stack_for_mesh(
+            jnp.zeros((), jnp.bool_), self.mesh, self.axis
+        )
+        self._steps = {}
 
     # -- checkpoint/restore (two logical tables across shards) -----------
     def checkpoint_table_ids(self) -> List[str]:

@@ -36,7 +36,11 @@ from risingwave_tpu.executors.materialize import (
 )
 from risingwave_tpu.ops.hash_table import HashTable, lookup, lookup_or_insert
 from risingwave_tpu.parallel.exchange import dest_shard, exchange_chunk
-from risingwave_tpu.parallel.sharded_join import stack_for_mesh
+from risingwave_tpu.parallel.sharded_join import (
+    double_bucket_cap,
+    stack_for_mesh,
+    track_bucket_cap,
+)
 from risingwave_tpu.storage.state_table import (
     Checkpointable,
     StateDelta,
@@ -110,6 +114,7 @@ class ShardedMaterialize(MvDeviceReadMixin, Executor, Checkpointable):
     def _build_step(self, chunk_cap: int):
         n, axis, pk, cols = self.n_shards, self.axis, self.pk, self.columns
         bucket_cap = self.bucket_cap or max(64, (2 * chunk_cap) // n)
+        track_bucket_cap(self, bucket_cap)
 
         def local(table, state, chunk):
             table, state, chunk = jax.tree.map(
@@ -155,6 +160,36 @@ class ShardedMaterialize(MvDeviceReadMixin, Executor, Checkpointable):
                 "grow capacity/bucket_cap"
             )
         return []
+
+    # -- capacity escape (watchdog replay, scale.rs:453 analogue) ---------
+    def capacity_overflow_latched(self) -> bool:
+        return bool(jnp.any(self.state.dropped))
+
+    def grow_for_replay(self) -> None:
+        """Double pk-table capacity + exchange bucket and reset device
+        state at the new shapes; recover() restores the durable rows
+        before the poisoned epoch replays."""
+        self.capacity *= 2
+        double_bucket_cap(self)
+        nullable = tuple(self.state.vnulls)
+        table1 = HashTable.create(
+            self.capacity, tuple(self.dtypes[k] for k in self.pk)
+        )
+        state1 = MvDeviceState(
+            values={
+                c: jnp.zeros(self.capacity, self.dtypes[c])
+                for c in self.columns
+            },
+            vnulls={
+                c: jnp.zeros(self.capacity, jnp.bool_) for c in nullable
+            },
+            sdirty=jnp.zeros(self.capacity, jnp.bool_),
+            stored=jnp.zeros(self.capacity, jnp.bool_),
+            dropped=jnp.zeros((), jnp.bool_),
+        )
+        self.table = stack_for_mesh(table1, self.mesh, self.axis)
+        self.state = stack_for_mesh(state1, self.mesh, self.axis)
+        self._steps = {}
 
     def state_nbytes(self) -> int:
         return sum(

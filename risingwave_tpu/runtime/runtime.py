@@ -305,6 +305,14 @@ class StreamingRuntime:
             try:
                 outs = self._barrier_locked()
                 self._consecutive_recoveries = 0
+                if getattr(self, "_grew_last_recovery", False):
+                    # the grown replay committed: the growths were
+                    # legitimate cures, not a runaway — refund the
+                    # per-executor give-up budget
+                    self._grew_last_recovery = False
+                    for ex in self.executors():
+                        if getattr(ex, "_growth_rounds", 0):
+                            ex._growth_rounds = 0
                 return outs
             except (KeyboardInterrupt, SystemExit):
                 raise  # never convert an operator stop into a recovery
@@ -324,6 +332,35 @@ class StreamingRuntime:
         self.last_failure = cause
         REGISTRY.counter("auto_recoveries_total").inc()
         self.auto_recoveries += 1
+        # a latched sharded-capacity overflow is DETERMINISTIC at the
+        # old shape but curable: grow the overflowed op 2x before the
+        # replay (the reference reschedules with more parallelism,
+        # scale.rs:453 — here capacity is the per-shard analogue) and
+        # refund the deterministic-fault budget so the grown replay
+        # gets its attempt. Quiesce FIRST: an in-flight worker step or
+        # queued closer commit could otherwise write an old-shape
+        # table back over the grown one.
+        self._quiesce()
+        grew = 0
+        for ex in self.executors():
+            latched = getattr(ex, "capacity_overflow_latched", None)
+            if latched is None or not latched():
+                continue
+            rounds = getattr(ex, "_growth_rounds", 0)
+            if rounds >= 5:
+                raise RuntimeError(
+                    f"{type(ex).__name__} still overflows after "
+                    f"{rounds} capacity doublings — giving up"
+                ) from cause
+            ex.grow_for_replay()
+            ex._growth_rounds = rounds + 1
+            REGISTRY.counter("overflow_growths_total").inc()
+            grew += 1
+        if grew:
+            self._grew_last_recovery = True
+            self._consecutive_recoveries = min(
+                self._consecutive_recoveries, 1
+            )
         if self._consecutive_recoveries >= 3:
             raise RuntimeError(
                 "auto-recovery failed 3 consecutive epochs — the fault "
@@ -683,12 +720,11 @@ class StreamingRuntime:
         return float(np.percentile(self.checkpoint_sync_ms, 99))
 
     # -- recovery --------------------------------------------------------
-    def recover(self) -> None:
-        """Rebuild all fragment state from the last committed epoch."""
-        if not self.mgr:
-            raise RuntimeError("no object store configured")
-        # quiesce compaction: its GC deletes SSTs that recovery's
-        # read_table may be about to read
+    def _quiesce(self) -> None:
+        """Drain the async commit lane and in-flight worker steps.
+        Leaves the abort flags SET — recover() clears them after the
+        restore. Idempotent (auto-recovery quiesces before growing
+        capacities; recover() quiesces again trivially)."""
         # abort the async lane FIRST: staged epochs still queued refer
         # to pre-recovery state; committing one after the restore would
         # advance the manifest past the epoch we just recovered to
@@ -702,6 +738,14 @@ class StreamingRuntime:
                 if self._inflight == 0:
                     break
             time.sleep(0.002)
+
+    def recover(self) -> None:
+        """Rebuild all fragment state from the last committed epoch."""
+        if not self.mgr:
+            raise RuntimeError("no object store configured")
+        self._quiesce()
+        # quiesce compaction: its GC deletes SSTs that recovery's
+        # read_table may be about to read
         self._compact_pause.set()
         try:
             self._compact_idle.wait()
