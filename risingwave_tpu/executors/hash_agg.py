@@ -37,6 +37,8 @@ from risingwave_tpu.storage.state_table import (
     Checkpointable,
     StateDelta,
     grow_pow2,
+    host_key_view,
+    lanes_from_host_keys,
     pull_rows,
     stage_marks,
 )
@@ -466,6 +468,10 @@ class HashAggExecutor(Executor, Checkpointable):
         # cold tier: set by the runtime to CheckpointManager.get_rows so
         # evicted (durable) groups fold back in on their next touch
         self.cold_reader = None
+        # with minput, merge-at-barrier cannot fold multisets back in
+        # (a delete pre-merge would falsely latch inconsistent), so
+        # evicted keys fault in ON TOUCH via this host-side set
+        self._evicted: set = set()
 
     # -- data ------------------------------------------------------------
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
@@ -475,6 +481,8 @@ class HashAggExecutor(Executor, Checkpointable):
                     f"group key {k!r} carries a null lane but was not "
                     "declared in nullable_keys"
                 )
+        if self._evicted:
+            self._fault_in(chunk)
         self._maybe_grow(chunk.capacity)
         self._insert_bound += chunk.capacity
         if self.minput:
@@ -525,6 +533,11 @@ class HashAggExecutor(Executor, Checkpointable):
             kept for differential testing and for plans that need
             strict intra-epoch chunk ordering.
         """
+        if self._evicted:
+            # the epoch-batched path cannot see per-chunk keys before
+            # the fused program runs (pre is traced in): restore every
+            # evicted group up front — correct, if conservative
+            self._fault_in_all()
         n_chunks, cap = stacked.valid.shape[:2]
         probe = jax.eval_shape(
             pre if pre is not None else (lambda c: c),
@@ -668,10 +681,31 @@ class HashAggExecutor(Executor, Checkpointable):
         if self.cold_reader is None:
             raise RuntimeError("evict_cold needs a cold_reader (runtime)")
         if self.minput:
-            raise NotImplementedError(
-                "cold tiering with materialized MIN/MAX multisets is not "
-                "supported (multiset merge)"
+            # multisets cannot cold-MERGE (a pre-merge delete would
+            # falsely latch inconsistent): record the evicted keys so
+            # they fault back in ON TOUCH, state-exact, before any
+            # post-eviction row lands on the group
+            claimed = np.asarray(self.table.fp1) != 0
+            durable = np.asarray(
+                self.state.stored & ~self.state.sdirty & ~self.state.dirty
             )
+            sel = np.flatnonzero(claimed & durable)
+            if len(sel):
+                pulled = pull_rows(
+                    {
+                        f"k{i}": l
+                        for i, l in enumerate(self.table.keys)
+                    },
+                    sel,
+                )
+                views = [
+                    host_key_view(np.asarray(pulled[f"k{i}"]))
+                    for i in range(len(self.table.keys))
+                ]
+                for j in range(len(sel)):
+                    self._evicted.add(
+                        tuple(int(a[j]) for a in views)
+                    )
         # shrink to fit the surviving hot set — eviction must actually
         # free HBM, not just slots
         hot = (
@@ -692,6 +726,61 @@ class HashAggExecutor(Executor, Checkpointable):
         n = int(n)
         self._insert_bound = int(self.table.occupancy())
         return n
+
+    # -- fault-in on touch (the minput-compatible cold path) -------------
+    def _chunk_key_tuples(self, chunk: StreamChunk) -> set:
+        """Canonical host tuples of the chunk's group keys, in the
+        table's key-lane layout (value [+ null flag] per key)."""
+        valid = np.asarray(chunk.valid)
+        sel = np.flatnonzero(valid)
+        views = []
+        for k, nb in zip(self.group_keys, self.nullable):
+            a = np.asarray(chunk.col(k))
+            if nb:
+                nl = (
+                    np.asarray(chunk.nulls[k])
+                    if k in chunk.nulls
+                    else np.zeros(len(a), bool)
+                )
+                a = np.where(nl, np.zeros((), a.dtype), a)
+                views.append(host_key_view(a))
+                views.append(nl.astype(np.int64))
+            else:
+                views.append(host_key_view(a))
+        return {tuple(int(v[i]) for v in views) for i in sel}
+
+    def _fault_in(self, chunk: StreamChunk) -> None:
+        hits = self._chunk_key_tuples(chunk) & self._evicted
+        if hits:
+            self._restore_cold_groups(sorted(hits))
+
+    def _fault_in_all(self) -> None:
+        if self._evicted:
+            self._restore_cold_groups(sorted(self._evicted))
+
+    def _restore_cold_groups(self, key_tuples) -> None:
+        """State-exact restore of evicted groups BEFORE any new row
+        lands on them (merge-at-barrier cannot fold minput multisets:
+        a pre-merge delete would falsely latch inconsistent)."""
+        dtypes = [k.dtype for k in self.table.keys]
+        lanes_np = lanes_from_host_keys(key_tuples, dtypes)
+        found, vals = self.cold_reader(lanes_np)
+        self._evicted.difference_update(key_tuples)
+        nt = int(found.sum())
+        if not nt:
+            return
+        self._maybe_grow(nt)
+        self._insert_bound += nt
+        key_lanes = tuple(
+            jnp.asarray(lanes_np[f"k{i}"][found])
+            for i in range(len(dtypes))
+        )
+        cold = {k: jnp.asarray(np.asarray(v)[found]) for k, v in vals.items()}
+        self.table, self.state, self.minput, ovf = _fault_in_scatter(
+            self.table, self.state, self.minput, key_lanes, cold,
+            self.calls,
+        )
+        self.dropped = self.dropped | ovf
 
     def _merge_cold(self) -> int:
         """Fold durable state into groups (re)created since the last
@@ -808,6 +897,61 @@ class HashAggExecutor(Executor, Checkpointable):
             columns=cols, valid=sl(delta["valid"]), nulls=nulls,
             ops=sl(delta["ops"]),
         )
+
+
+@partial(jax.jit, static_argnames=("calls",), donate_argnums=(0, 1, 2))
+def _fault_in_scatter(table, state, minput, key_lanes, cold, calls):
+    """Insert evicted keys back and scatter their FULL durable state
+    (accums + emitted snapshots + minput multisets) — byte-identical to
+    the pre-eviction slot, before any post-eviction row touches it."""
+    n = key_lanes[0].shape[0]
+    table, slots, _, _ = lookup_or_insert(
+        table, key_lanes, jnp.ones(n, jnp.bool_)
+    )
+    overflow = jnp.any(slots < 0)
+    idx = jnp.where(slots >= 0, slots, table.capacity)
+    rc = cold["row_count"].astype(state.row_count.dtype)
+
+    def put(a, lane, cast=True):
+        v = cold[lane]
+        return a.at[idx].set(
+            v.astype(a.dtype) if cast else v, mode="drop"
+        )
+
+    new_state = AggState(
+        row_count=state.row_count.at[idx].set(rc, mode="drop"),
+        accums={
+            nm: put(a, f"acc_{nm}") for nm, a in state.accums.items()
+        },
+        nonnull={
+            nm: put(a, f"nn_{nm}") for nm, a in state.nonnull.items()
+        },
+        emitted={
+            nm: put(a, f"em_{nm}") for nm, a in state.emitted.items()
+        },
+        emitted_isnull={
+            nm: put(a, f"ei_{nm}")
+            for nm, a in state.emitted_isnull.items()
+        },
+        emitted_valid=put(state.emitted_valid, "ev"),
+        dirty=state.dirty,  # restored groups carry no unflushed change
+        minmax_retracted=state.minmax_retracted,
+        sdirty=state.sdirty,
+        stored=state.stored.at[idx].set(True, mode="drop"),
+    )
+    table = set_live(table, jnp.where(slots >= 0, slots, -1), rc > 0)
+    new_minput = {
+        name: (
+            v.at[idx].set(
+                cold[f"miv_{name}"].astype(v.dtype), mode="drop"
+            ),
+            c.at[idx].set(
+                cold[f"mic_{name}"].astype(c.dtype), mode="drop"
+            ),
+        )
+        for name, (v, c) in minput.items()
+    }
+    return table, new_state, new_minput, overflow
 
 
 @partial(jax.jit, static_argnames=("calls",), donate_argnums=(0,))
@@ -1020,6 +1164,8 @@ def _agg_restore_state(self, table_id, key_cols, value_cols) -> None:
     self.dropped = jnp.zeros((), jnp.bool_)
     self.mi_bad = jnp.zeros((), jnp.bool_)
     self._insert_bound = int(n)
+    # recovery restored every durable group as RESIDENT state
+    self._evicted = set()
 
 
 HashAggExecutor.checkpoint_delta = _agg_checkpoint_delta

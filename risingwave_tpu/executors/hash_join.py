@@ -47,6 +47,8 @@ from risingwave_tpu.ops.hash_table import (
 )
 from risingwave_tpu.ops.hash_table import lookup_or_insert, set_live
 from risingwave_tpu.storage.state_table import (
+    host_key_view,
+    lanes_from_host_keys,
     Checkpointable,
     StateDelta,
     grow_pow2,
@@ -442,12 +444,9 @@ class HashJoinExecutor(Executor, Checkpointable):
         each side to its hot set. Returns keys evicted."""
         if self.cold_get_rows is None:
             raise RuntimeError("evict_cold needs cold_get_rows (runtime)")
-        for side in (self.left, self.right):
-            for lane in side.table.keys:
-                if not jnp.issubdtype(lane.dtype, jnp.integer):
-                    # the host-side evicted-key set round-trips values
-                    # through python ints; a float key would corrupt
-                    return 0
+        # non-integer key lanes ride the host-side evicted set as exact
+        # bit patterns (host_key_view) — VARCHAR keys are dictionary
+        # codes (integers) and float keys bit-cast losslessly
         return self._evict_side("left") + self._evict_side("right")
 
     def _evict_side(self, name: str) -> int:
@@ -466,7 +465,7 @@ class HashJoinExecutor(Executor, Checkpointable):
             {f"k{i}": l for i, l in enumerate(side.table.keys)}, sel
         )
         lanes = [
-            np.asarray(keys[f"k{i}"])
+            host_key_view(np.asarray(keys[f"k{i}"]))
             for i in range(len(side.table.keys))
         ]
         ev = self._evicted[name]
@@ -538,15 +537,26 @@ class HashJoinExecutor(Executor, Checkpointable):
         set (never fault back) and their store rows tombstone at the
         next checkpoint — recovery must not resurrect closed windows
         (expire_keys only reaches resident slots)."""
+        side = getattr(self, name)
+        dt = np.dtype(side.table.keys[pos].dtype)
+        if dt.kind == "f":
+            # evicted tuples hold bit patterns (host_key_view): convert
+            # back to the numeric domain for the watermark comparison
+            itype = np.int32 if dt.itemsize == 4 else np.int64
+            conv = lambda x: float(np.array(x, itype).view(dt))
+        else:
+            conv = lambda x: x
         ev = self._evicted[name]
-        closed = {t for t in ev if t[pos] < cutoff}
+        closed = {t for t in ev if conv(t[pos]) < cutoff}
         if closed:
             ev.difference_update(closed)
             self._cold_tombstones.setdefault(name, []).extend(closed)
 
     def _fault_in(self, side: str, chunk: StreamChunk) -> None:
         own_keys = self.left_keys if side == "l" else self.right_keys
-        cols = [np.asarray(chunk.col(k)) for k in own_keys]
+        cols = [
+            host_key_view(np.asarray(chunk.col(k))) for k in own_keys
+        ]
         valid = np.asarray(chunk.valid)
         touched = {
             tuple(int(c[i]) for c in cols) for i in np.flatnonzero(valid)
@@ -563,13 +573,9 @@ class HashJoinExecutor(Executor, Checkpointable):
         side = getattr(self, name)
         n = len(key_tuples)
         side = self._maybe_grow(letter, side, n)
-        lanes_np = {
-            f"k{i}": np.asarray(
-                [t[i] for t in key_tuples],
-                dtype=side.table.keys[i].dtype,
-            )
-            for i in range(len(side.table.keys))
-        }
+        lanes_np = lanes_from_host_keys(
+            key_tuples, [k.dtype for k in side.table.keys]
+        )
         found, vals = self.cold_get_rows(
             f"{self.table_id}.{name}", dict(lanes_np)
         )
@@ -826,13 +832,11 @@ def _join_checkpoint_delta(self):
             # RESIDENT again: its upsert (or its own tombstone) stages
             # via _side_delta — a cold tombstone in the same delta would
             # make point reads and merge reads disagree on the key
+            lanes_np = lanes_from_host_keys(
+                tuples, [k.dtype for k in side.table.keys]
+            )
             lanes_j = tuple(
-                jnp.asarray(
-                    np.asarray(
-                        [t[i] for t in tuples],
-                        dtype=side.table.keys[i].dtype,
-                    )
-                )
+                jnp.asarray(lanes_np[f"k{i}"])
                 for i in range(len(side.table.keys))
             )
             slots, _found = _ht_lookup(
@@ -843,13 +847,9 @@ def _join_checkpoint_delta(self):
             if not tuples:
                 continue
             tid = f"{self.table_id}.{name}"
-            keys = {
-                f"k{i}": np.asarray(
-                    [t[i] for t in tuples],
-                    dtype=side.table.keys[i].dtype,
-                )
-                for i in range(len(side.table.keys))
-            }
+            keys = lanes_from_host_keys(
+                tuples, [k.dtype for k in side.table.keys]
+            )
             nvals = {}
             nrows = len(tuples)
             nvals["rv"] = np.zeros(

@@ -543,8 +543,6 @@ class StreamingRuntime:
                 or getattr(ex, "cold_get_rows", None) is not None
             )
             if fn is not None and has_reader:
-                if getattr(ex, "minput", None):
-                    continue  # multiset cold-merge unsupported
                 evicted += fn()
         REGISTRY.counter("cold_evictions_total").inc(evicted)
         REGISTRY.gauge("state_bytes").set(float(self.state_nbytes()))

@@ -82,6 +82,36 @@ def grow_pow2(n: int, cap: int, grow_at: float = 0.5) -> int:
     return cap
 
 
+def host_key_view(a: np.ndarray) -> np.ndarray:
+    """Canonical integer view of a key lane for host-side cold-tier
+    set membership. Float lanes become their exact bit patterns (the
+    cold set needs identity, not numeric comparison), so float-keyed
+    state can evict/fault-in without round-tripping through lossy
+    python floats."""
+    a = np.asarray(a)
+    if a.dtype.kind == "f":
+        return a.view(np.int32 if a.itemsize == 4 else np.int64)
+    if a.dtype.kind == "b":
+        return a.astype(np.int64)
+    return a
+
+
+def lanes_from_host_keys(key_tuples, dtypes) -> Dict[str, np.ndarray]:
+    """Inverse of host_key_view over a set of canonical key tuples:
+    rebuild k{i} lanes in their native dtypes (bit-casting back into
+    float lanes)."""
+    out = {}
+    for i, dt in enumerate(dtypes):
+        dt = np.dtype(dt)
+        arr = np.asarray([t[i] for t in key_tuples], dtype=np.int64)
+        if dt.kind == "f":
+            w = arr.astype(np.int32 if dt.itemsize == 4 else np.int64)
+            out[f"k{i}"] = w.view(dt)
+        else:
+            out[f"k{i}"] = arr.astype(dt)
+    return out
+
+
 def pull_rows(device_lanes: Dict[str, object], sel: np.ndarray) -> Dict[str, np.ndarray]:
     """Device->host transfer of SELECTED rows only (checkpoint staging
     must be O(changed rows), not O(capacity)). ``sel`` is padded to a

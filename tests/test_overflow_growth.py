@@ -25,9 +25,7 @@ from risingwave_tpu.runtime import Pipeline
 from risingwave_tpu.runtime.runtime import StreamingRuntime
 from risingwave_tpu.storage.object_store import MemObjectStore
 
-import pytest as _pytest
-
-pytestmark = _pytest.mark.smoke
+pytestmark = pytest.mark.smoke
 
 N = 8
 
