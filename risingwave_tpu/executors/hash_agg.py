@@ -831,6 +831,16 @@ class HashAggExecutor(Executor, Checkpointable):
         if self.window_key is None or watermark.column != self.window_key[0]:
             return watermark, []
         colname, retention, emit_deletes = self.window_key
+        if self._evicted:
+            # a cold-evicted group past the cutoff must still close —
+            # fault expiring groups back in so the normal expiry path
+            # retracts/tombstones them (the join's _expire_evicted
+            # analogue; expiry is rare, the fault-in cost is fine)
+            ki = self._key_lane_index(colname)
+            cut = int(watermark.value) - retention
+            expiring = [t for t in self._evicted if t[ki] < cut]
+            if expiring:
+                self._restore_cold_groups(sorted(expiring))
         outs: List[StreamChunk] = []
         if not emit_deletes:
             # EOWC finalization silently frees state — any dirty (not yet

@@ -295,13 +295,42 @@ def _can_push(core: "LAggProject", c) -> bool:
     amap, group_names = _alias_map(core)
     for ident in _pred_sites(c):
         target = amap.get(ident.name)
-        if target is None or _contains_agg(target):
+        if target is None or _contains_agg(target) or _contains_window(
+            target
+        ):
+            # a window-computed output (e.g. row_number()) is defined
+            # only ABOVE the over-window stage: filtering before it
+            # would rank a different row set
             return False
         if core.group_by and not (
             isinstance(target, P.Ident) and target.name in group_names
         ):
             return False
     return True
+
+
+def _contains_window(ast) -> bool:
+    if isinstance(ast, P.WindowFuncCall):
+        return True
+    if isinstance(ast, P.FuncCall):
+        return any(
+            _contains_window(a)
+            for a in ast.args
+            if not isinstance(a, str)
+        )
+    if isinstance(ast, P.BinaryOp):
+        return _contains_window(ast.left) or _contains_window(ast.right)
+    if isinstance(ast, P.UnaryOp):
+        return _contains_window(ast.operand)
+    if isinstance(ast, P.CaseExpr):
+        return any(
+            _contains_window(x)
+            for b in ast.branches
+            for x in b
+        ) or (
+            ast.default is not None and _contains_window(ast.default)
+        )
+    return False
 
 
 def _absorbable(arm, c) -> bool:

@@ -54,6 +54,17 @@ class CaseExpr:
 
 
 @dataclass(frozen=True)
+class WindowFuncCall:
+    """<func>(args) OVER (PARTITION BY ... ORDER BY ... [ROWS frame])
+    (reference: binder window_function.rs; planner over_window)."""
+
+    func: "FuncCall"
+    partition_by: Tuple["Ident", ...]
+    order_by: Tuple[Tuple["Ident", bool], ...]  # (col, desc)
+    frame: Optional[Tuple[int, int]] = None  # ROWS (lo, hi) rel offsets
+
+
+@dataclass(frozen=True)
 class SelectItem:
     expr: object
     alias: Optional[str]
@@ -545,6 +556,62 @@ class Parser:
             return Ident(self.expect("ident").value, qualifier=a)
         return Ident(a)
 
+    def _window_spec(self, call: FuncCall) -> WindowFuncCall:
+        """OVER ( [PARTITION BY c,...] [ORDER BY c [ASC|DESC],...]
+        [ROWS BETWEEN <n> PRECEDING AND CURRENT ROW] )."""
+        self.expect("op", "(")
+        part: List[Ident] = []
+        order: List[Tuple[Ident, bool]] = []
+        frame = None
+        if self._accept_word("partition"):
+            self.expect("kw", "by")
+            part.append(self.qualified_ident())
+            while self.accept("op", ","):
+                part.append(self.qualified_ident())
+        if self.accept("kw", "order"):
+            self.expect("kw", "by")
+            while True:
+                ident = self.qualified_ident()
+                desc = bool(self.accept("kw", "desc"))
+                if not desc:
+                    self.accept("kw", "asc")
+                order.append((ident, desc))
+                if not self.accept("op", ","):
+                    break
+        if self._accept_word("rows"):
+            self.expect("kw", "between")
+            if self._accept_word("unbounded"):
+                if not self._accept_word("preceding"):
+                    raise SyntaxError("expected PRECEDING after UNBOUNDED")
+                lo = None
+            else:
+                lo = -int(self.expect("num").value)
+                if not self._accept_word("preceding"):
+                    raise SyntaxError("expected PRECEDING")
+            self.expect("kw", "and")
+            if self._accept_word("current"):
+                if not self._accept_word("row"):
+                    raise SyntaxError("expected ROW after CURRENT")
+                hi = 0
+            elif self._accept_word("unbounded"):
+                raise SyntaxError("UNBOUNDED FOLLOWING is not supported")
+            else:
+                hi = int(self.expect("num").value)
+                if not self._accept_word("following"):
+                    raise SyntaxError("expected FOLLOWING")
+            # lo None = UNBOUNDED PRECEDING (running; frame stays None only
+            # when hi == 0, the executor's running default)
+            if lo is None:
+                if hi != 0:
+                    raise SyntaxError(
+                        "UNBOUNDED PRECEDING .. n FOLLOWING is unsupported"
+                    )
+                frame = None
+            else:
+                frame = (lo, hi)
+        self.expect("op", ")")
+        return WindowFuncCall(call, tuple(part), tuple(order), frame)
+
     # -- expressions (precedence climbing) -------------------------------
     def expr(self):
         return self.or_expr()
@@ -660,14 +727,20 @@ class Parser:
                     return FuncCall("extract", (Literal(f.value), inner))
                 if self.accept("op", "*"):
                     self.expect("op", ")")
-                    return FuncCall(t.value, ("*",))
+                    call = FuncCall(t.value, ("*",))
+                    if self._accept_word("over"):
+                        return self._window_spec(call)
+                    return call
                 args = []
                 if not self.accept("op", ")"):
                     args.append(self.expr())
                     while self.accept("op", ","):
                         args.append(self.expr())
                     self.expect("op", ")")
-                return FuncCall(t.value, tuple(args))
+                call = FuncCall(t.value, tuple(args))
+                if self._accept_word("over"):
+                    return self._window_spec(call)
+                return call
             if self.accept("op", "."):
                 return Ident(self.expect("ident").value, qualifier=t.value)
             return Ident(t.value)

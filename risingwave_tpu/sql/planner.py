@@ -433,6 +433,21 @@ class StreamPlanner:
         if select.where is not None:
             chain.append(FilterExecutor(compile_scalar(select.where, binder)))
 
+        if any(isinstance(it.expr, P.WindowFuncCall) for it in select.items):
+            if select.group_by or select.having is not None:
+                raise NotImplementedError(
+                    "window functions cannot mix with GROUP BY/HAVING "
+                    "in one SELECT (plan as MV-on-MV)"
+                )
+            chain2, out_schema, pk = self._plan_over_window(
+                name, select, binder, schema, pk
+            )
+            chain.extend(chain2)
+            return self._maybe_topn(
+                name, select, binder,
+                BoundRel(chain, out_schema, pk, source, alias),
+            )
+
         if select.group_by:
             chain2, out_schema, pk = self._plan_groupby(
                 name, select, binder, schema, retractable=False
@@ -518,6 +533,176 @@ class StreamPlanner:
             name, select, binder,
             BoundRel(chain, out_schema2, pk, source, alias),
         )
+
+    def _plan_over_window(
+        self, name: str, select: P.Select, binder: Binder,
+        schema: Dict[str, object], pk: Tuple[str, ...],
+    ):
+        """SELECT cols..., fn() OVER (PARTITION BY p ORDER BY o) ... ->
+        [RowIdGen] -> Project(needed lanes [+ negated order for DESC])
+        -> GeneralOverWindowExecutor -> Project(user columns + pk).
+
+        Reference: binder window_function.rs + the OverWindow plan node
+        (general.rs executor). Every call in one SELECT must share one
+        window (one PARTITION BY + ORDER BY); frames may differ."""
+        from risingwave_tpu.executors.over_window import (
+            GeneralOverWindowExecutor,
+            WindowCall,
+        )
+
+        chain: List[Executor] = []
+
+        # hidden pk for append-only sources (rows need identity so the
+        # executor can retract precisely)
+        if not pk:
+            chain.append(
+                RowIdGenExecutor(
+                    out_col="_row_id", table_id=self._tid(name, "rowid")
+                )
+            )
+            schema = dict(schema)
+            schema["_row_id"] = jnp.dtype(jnp.int64)
+            pk = ("_row_id",)
+
+        # group calls by their window spec — one chained executor per
+        # distinct (PARTITION BY, ORDER BY), like the reference's
+        # multiple OverWindow plan nodes; later executors see earlier
+        # outputs as pass-through lanes
+        groups: Dict[tuple, dict] = {}
+        passthrough: List[Tuple[str, str]] = []  # (out name, in col)
+        out_names: List[str] = []
+        for i, item in enumerate(select.items):
+            ast = item.expr
+            if isinstance(ast, P.Ident):
+                incol = binder.resolve(ast)
+                passthrough.append((item.alias or ast.name, incol))
+                continue
+            if not isinstance(ast, P.WindowFuncCall):
+                raise NotImplementedError(
+                    "window SELECTs support bare columns + window "
+                    "calls only (wrap computed expressions in a "
+                    "derived table)"
+                )
+            if len(ast.order_by) != 1:
+                raise NotImplementedError(
+                    "OVER (... ORDER BY) supports exactly one order "
+                    "column"
+                )
+            part_cols = tuple(
+                binder.resolve(c) for c in ast.partition_by
+            )
+            oident, desc = ast.order_by[0]
+            ocol = binder.resolve(oident)
+            key = (part_cols, ocol, desc)
+            g = groups.setdefault(
+                key,
+                {
+                    "part": part_cols,
+                    "ocol": ocol,
+                    "desc": desc,
+                    "eff_ord": (
+                        f"_word{len(groups)}" if desc else ocol
+                    ),
+                    "calls": [],
+                },
+            )
+            out = item.alias or f"{ast.func.name}_{i}"
+            out_names.append(out)
+            fn, args = ast.func.name, ast.func.args
+            if fn == "row_number":
+                g["calls"].append(WindowCall("row_number", None, out))
+            elif fn in ("rank", "dense_rank"):
+                g["calls"].append(WindowCall(fn, g["eff_ord"], out))
+            elif fn == "count" and args == ("*",):
+                g["calls"].append(
+                    WindowCall("count", None, out, frame=ast.frame)
+                )
+            elif fn in ("sum", "min", "max"):
+                incol = binder.resolve(args[0])
+                g["calls"].append(
+                    WindowCall(fn, incol, out, frame=ast.frame)
+                )
+            elif fn in ("lag", "lead"):
+                incol = binder.resolve(args[0])
+                k = 1
+                if len(args) > 1:
+                    if not isinstance(args[1], P.Literal):
+                        raise ValueError(
+                            "lag/lead offset must be a literal"
+                        )
+                    k = int(args[1].value)
+                g["calls"].append(WindowCall(fn, incol, out, offset=k))
+            else:
+                raise NotImplementedError(
+                    f"window function {fn!r} unsupported"
+                )
+
+        glist = list(groups.values())
+        needed = dict.fromkeys(
+            [c for _, c in passthrough]
+            + [c for g in glist for c in g["part"]]
+            + [g["ocol"] for g in glist]
+            + [
+                c.input
+                for g in glist
+                for c in g["calls"]
+                if c.input is not None
+                and not c.input.startswith("_word")
+            ]
+            + list(pk)
+        )
+        pre_outputs: Dict[str, E.Expr] = {c: E.col(c) for c in needed}
+        win_schema = {c: schema[c] for c in needed}
+        for g in glist:
+            if g["desc"]:
+                # executors sort ascending: order by the negated lane
+                # (ties and rank values are unchanged under negation).
+                # Keep the SOURCE dtype: int64 here would truncate a
+                # float order column before the executor's own
+                # integer-only guard could reject it loudly
+                pre_outputs[g["eff_ord"]] = E.lit(0) - E.col(g["ocol"])
+                win_schema[g["eff_ord"]] = win_schema[g["ocol"]]
+        chain.append(ProjectExecutor(pre_outputs))
+
+        for gi, g in enumerate(glist):
+            nullable = tuple(
+                c
+                for c in win_schema
+                if c not in pk
+                and c not in g["part"]
+                and c != g["eff_ord"]
+            )
+            chain.append(
+                GeneralOverWindowExecutor(
+                    partition_by=g["part"],
+                    order_col=g["eff_ord"],
+                    pk=pk,
+                    calls=tuple(g["calls"]),
+                    schema_dtypes=dict(win_schema),
+                    capacity=self.capacity,
+                    nullable=nullable,
+                    table_id=self._tid(name, "over"),
+                )
+            )
+            # this group's outputs pass through later executors
+            for c in g["calls"]:
+                win_schema[c.output] = jnp.dtype(jnp.int64)
+
+        # project down to the user's columns (+ pk identity)
+        post: Dict[str, E.Expr] = {}
+        out_schema: Dict[str, object] = {}
+        for out, incol in passthrough:
+            post[out] = E.col(incol)
+            out_schema[out] = win_schema[incol]
+        for out in out_names:
+            post[out] = E.col(out)  # window outputs are int64 lanes
+            out_schema[out] = jnp.dtype(jnp.int64)
+        for pcol in pk:
+            if pcol not in post:
+                post[pcol] = E.col(pcol)
+                out_schema[pcol] = win_schema[pcol]
+        chain.append(ProjectExecutor(post))
+        return chain, out_schema, pk
 
     def _maybe_topn(
         self, name: str, select: P.Select, binder: Binder, rel: BoundRel

@@ -527,10 +527,41 @@ def test_float_keyed_join_cold_tier():
 
     # watermark expiry of evicted float keys compares in the NUMERIC
     # domain (bit patterns are identity only): cutoff 1.0 closes 0.5
-    assert j._evicted["left"] == {
-        t for t in j._evicted["left"]
-    }  # two keys remain (1.25 faulted back in)
-    before = set(j._evicted["left"])
+    assert len(j._evicted["left"]) == 2  # 1.25 faulted back in
     j._expire_evicted("left", 0, 1.0)
-    remaining = j._evicted["left"]
-    assert len(before) - len(remaining) == 1  # only 0.5 closed
+    assert len(j._evicted["left"]) == 1  # only 0.5 closed
+
+
+def test_evicted_minput_groups_expire_under_watermark():
+    """A cold-evicted group past the watermark cutoff still closes:
+    it faults back in and the normal expiry path retracts it (the
+    join's _expire_evicted analogue for aggs)."""
+    from risingwave_tpu.executors.base import Watermark
+
+    store = MemObjectStore()
+    mgr = CheckpointManager(store)
+    ex = HashAggExecutor(
+        group_keys=("k",),
+        calls=(AggCall("min", "v", "mn", materialized=True),),
+        schema_dtypes=DT,
+        capacity=1 << 8,
+        table_id="coldexp",
+        window_key=("k", 0, True),  # k doubles as the window column
+    )
+    ex.cold_reader = lambda keys: mgr.get_rows("coldexp", keys)
+    snap = {}
+    _replay_cols(
+        snap,
+        ex.apply(_chunk([(1000, 5, Op.INSERT), (2000, 7, Op.INSERT)])),
+        ("mn",),
+    )
+    _replay_cols(snap, ex.on_barrier(None), ("mn",))
+    mgr.commit_epoch(1 << 16, [ex])
+    assert ex.evict_cold() == 2 and len(ex._evicted) == 2
+
+    wm, outs = ex.on_watermark(Watermark("k", 1500))
+    _replay_cols(snap, outs, ("mn",))
+    _replay_cols(snap, ex.on_barrier(None), ("mn",))
+    assert (1000,) not in snap, "closed window row was not retracted"
+    assert snap[(2000,)] == (7,)
+    assert all(t[0] >= 1500 for t in ex._evicted)

@@ -1,0 +1,117 @@
+"""OVER() window functions from SQL (VERDICT r4 missing #3): the
+parser's window grammar lowers to GeneralOverWindowExecutor — incl.
+DESC ordering (hidden negated lane), frames, and retracting inputs
+(MV-on-MV: upstream agg updates shift ranks downstream).
+
+Reference: binder window_function.rs; e2e nexmark q9 shape."""
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.frontend.session import SqlSession
+from risingwave_tpu.sql import Catalog
+
+pytestmark = pytest.mark.smoke
+
+
+def _session():
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute(
+        "CREATE TABLE bid (auction BIGINT, bidder BIGINT, price BIGINT, "
+        "date_time BIGINT)"
+    )
+    return s
+
+
+def test_row_number_rank_sum_over_partition():
+    s = _session()
+    s.execute(
+        "CREATE MATERIALIZED VIEW w AS SELECT auction, price, "
+        "row_number() OVER (PARTITION BY auction ORDER BY price) AS rn, "
+        "rank() OVER (PARTITION BY auction ORDER BY price) AS rk, "
+        "sum(price) OVER (PARTITION BY auction ORDER BY price) AS rs "
+        "FROM bid"
+    )
+    s.execute(
+        "INSERT INTO bid VALUES (1, 0, 30, 0), (1, 0, 10, 0), "
+        "(1, 0, 20, 0), (2, 0, 5, 0), (1, 0, 20, 0)"
+    )
+    out, _ = s.execute("SELECT auction, price, rn, rk, rs FROM w ORDER BY auction")
+    rows = sorted(zip(*(list(out[c]) for c in ("auction", "price", "rn", "rk", "rs"))))
+    assert rows == [
+        (1, 10, 1, 1, 10),
+        (1, 20, 2, 2, 30),
+        (1, 20, 3, 2, 50),
+        (1, 30, 4, 4, 80),
+        (2, 5, 1, 1, 5),
+    ]
+
+
+def test_desc_order_and_frame():
+    s = _session()
+    s.execute(
+        "CREATE MATERIALIZED VIEW w2 AS SELECT auction, price, "
+        "row_number() OVER (PARTITION BY auction ORDER BY price DESC) AS rn, "
+        "sum(price) OVER (PARTITION BY auction ORDER BY price "
+        "ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) AS fs "
+        "FROM bid"
+    )
+    s.execute(
+        "INSERT INTO bid VALUES (1, 0, 10, 0), (1, 0, 30, 0), (1, 0, 20, 0)"
+    )
+    out, _ = s.execute("SELECT price, rn, fs FROM w2")
+    rows = sorted(zip(*(list(out[c]) for c in ("price", "rn", "fs"))))
+    # DESC row_number: 30->1, 20->2, 10->3; ASC frame sums: 10, 10+20, 20+30
+    assert rows == [(10, 3, 10), (20, 2, 30), (30, 1, 50)]
+
+
+def test_retracting_input_shifts_ranks():
+    """Window over an MV: upstream count changes retract through the
+    window executor and re-rank downstream rows."""
+    s = _session()
+    s.execute(
+        "CREATE MATERIALIZED VIEW cnts AS SELECT auction, count(*) AS c "
+        "FROM bid GROUP BY auction"
+    )
+    s.execute(
+        "CREATE MATERIALIZED VIEW ranked AS SELECT auction, c, "
+        "rank() OVER (ORDER BY c) AS rk FROM cnts"
+    )
+    s.execute("INSERT INTO bid VALUES (1, 0, 0, 0), (2, 0, 0, 0), (2, 0, 0, 0)")
+    out, _ = s.execute("SELECT auction, c, rk FROM ranked ORDER BY auction")
+    assert sorted(zip(list(out["auction"]), list(out["c"]), list(out["rk"]))) == [
+        (1, 1, 1),
+        (2, 2, 2),
+    ]
+    # auction 1 overtakes: 1 -> 3 bids; ranks flip via retract/re-emit
+    s.execute("INSERT INTO bid VALUES (1, 0, 0, 0), (1, 0, 0, 0)")
+    out, _ = s.execute("SELECT auction, c, rk FROM ranked ORDER BY auction")
+    assert sorted(zip(list(out["auction"]), list(out["c"]), list(out["rk"]))) == [
+        (1, 3, 2),
+        (2, 2, 1),
+    ]
+
+
+def test_q9_shape_top1_per_partition():
+    """The Nexmark q9 shape: highest bid per auction via row_number()
+    OVER (... ORDER BY price DESC) filtered to 1 in an outer select."""
+    s = _session()
+    s.execute(
+        "CREATE MATERIALIZED VIEW q9 AS SELECT auction, price, bidder FROM "
+        "(SELECT auction, price, bidder, row_number() OVER "
+        "(PARTITION BY auction ORDER BY price DESC) AS rn FROM bid) AS t "
+        "WHERE rn = 1"
+    )
+    s.execute(
+        "INSERT INTO bid VALUES (1, 7, 100, 0), (1, 8, 300, 0), "
+        "(2, 9, 50, 0), (1, 10, 200, 0)"
+    )
+    out, _ = s.execute("SELECT auction, price, bidder FROM q9 ORDER BY auction")
+    assert list(out["auction"]) == [1, 2]
+    assert list(out["price"]) == [300, 50]
+    assert list(out["bidder"]) == [8, 9]
+    # a new global max for auction 2 replaces its top row
+    s.execute("INSERT INTO bid VALUES (2, 11, 500, 0)")
+    out, _ = s.execute("SELECT auction, price, bidder FROM q9 ORDER BY auction")
+    assert list(out["price"]) == [300, 500]
+    assert list(out["bidder"]) == [8, 11]
