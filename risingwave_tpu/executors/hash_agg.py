@@ -838,7 +838,18 @@ class HashAggExecutor(Executor, Checkpointable):
             # analogue; expiry is rare, the fault-in cost is fine)
             ki = self._key_lane_index(colname)
             cut = int(watermark.value) - retention
-            expiring = [t for t in self._evicted if t[ki] < cut]
+            dt = np.dtype(self.table.keys[ki].dtype)
+            if dt.kind == "f":
+                # evicted tuples hold host_key_view bit patterns:
+                # compare in the numeric domain (hash_join does the
+                # same in _expire_evicted)
+                itype = np.int32 if dt.itemsize == 4 else np.int64
+                conv = lambda x: float(np.array(x, itype).view(dt))
+            else:
+                conv = lambda x: x
+            expiring = [
+                t for t in self._evicted if conv(t[ki]) < cut
+            ]
             if expiring:
                 self._restore_cold_groups(sorted(expiring))
         outs: List[StreamChunk] = []

@@ -293,6 +293,17 @@ def _can_push(core: "LAggProject", c) -> bool:
     if core.limit is not None or core.order_by:
         return False
     amap, group_names = _alias_map(core)
+    # a select containing ANY window call ranks over its full input
+    # row set: a predicate may sink below it only if it references
+    # nothing but columns present in EVERY window's PARTITION BY
+    # (filtering whole partitions cannot change in-partition values)
+    win_parts = None
+    for target in amap.values():
+        if isinstance(target, P.WindowFuncCall):
+            names = {i.name for i in target.partition_by}
+            win_parts = (
+                names if win_parts is None else win_parts & names
+            )
     for ident in _pred_sites(c):
         target = amap.get(ident.name)
         if target is None or _contains_agg(target) or _contains_window(
@@ -301,6 +312,10 @@ def _can_push(core: "LAggProject", c) -> bool:
             # a window-computed output (e.g. row_number()) is defined
             # only ABOVE the over-window stage: filtering before it
             # would rank a different row set
+            return False
+        if win_parts is not None and not (
+            isinstance(target, P.Ident) and target.name in win_parts
+        ):
             return False
         if core.group_by and not (
             isinstance(target, P.Ident) and target.name in group_names

@@ -26,6 +26,13 @@ class ObjectStore:
     def read(self, path: str) -> bytes:
         raise NotImplementedError
 
+    def read_range(self, path: str, off: int, length: int) -> bytes:
+        """Partial object read (reference: ObjectStore::read with a
+        block range, object/s3.rs ranged GET) — what block-granular
+        SST reads ride on. Default: slice a full read (stores with a
+        native ranged read override)."""
+        return self.read(path)[off : off + length]
+
     def exists(self, path: str) -> bool:
         raise NotImplementedError
 
@@ -42,6 +49,7 @@ class MemObjectStore(ObjectStore):
     def __init__(self):
         self._blobs: Dict[str, bytes] = {}
         self._lock = threading.Lock()
+        self.bytes_read = 0  # test observability: IO actually paid
 
     def put(self, path: str, data: bytes) -> None:
         with self._lock:
@@ -49,7 +57,19 @@ class MemObjectStore(ObjectStore):
 
     def read(self, path: str) -> bytes:
         with self._lock:
-            return self._blobs[path]
+            if path not in self._blobs:
+                raise FileNotFoundError(path)
+            b = self._blobs[path]
+            self.bytes_read += len(b)
+            return b
+
+    def read_range(self, path: str, off: int, length: int) -> bytes:
+        with self._lock:
+            if path not in self._blobs:
+                raise FileNotFoundError(path)
+            b = self._blobs[path][off : off + length]
+            self.bytes_read += len(b)
+            return b
 
     def exists(self, path: str) -> bool:
         with self._lock:
@@ -95,6 +115,11 @@ class LocalFsObjectStore(ObjectStore):
     def read(self, path: str) -> bytes:
         with open(self._abs(path), "rb") as f:
             return f.read()
+
+    def read_range(self, path: str, off: int, length: int) -> bytes:
+        with open(self._abs(path), "rb") as f:
+            f.seek(off)
+            return f.read(length)
 
     def exists(self, path: str) -> bool:
         return os.path.exists(self._abs(path))

@@ -35,7 +35,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from risingwave_tpu.storage.object_store import ObjectStore
+from risingwave_tpu.storage.block_sst import (
+    BlockSst,
+    build_block_sst,
+    order_tuple,
+)
 from risingwave_tpu.storage.sstable import (
+    _order_key,
     build_sst,
     merge_ssts,
     newest_wins,
@@ -43,7 +49,8 @@ from risingwave_tpu.storage.sstable import (
 )
 
 MANIFEST = "MANIFEST"
-COMPACT_AT = 8  # SSTs per table before a full-merge compaction
+COMPACT_AT = 8  # L0 SSTs per table before a leveled compaction
+L1_FILE_ROWS = 1 << 16  # target rows per non-overlapping L1 file
 
 
 @dataclass
@@ -319,48 +326,121 @@ class CheckpointManager:
             return [
                 t
                 for t, entries in self.version["tables"].items()
-                if len(entries) >= self.compact_at
+                if sum(1 for e in entries if e.get("level", 0) == 0)
+                >= self.compact_at
             ]
 
     def compact_once(self, table_id: str, epoch: int) -> bool:
-        """Full-merge one table's SST run into a single SST
-        (fast_compactor_runner analogue), OFF the commit path: the
-        merge runs without the lock; the version swap is CAS-style —
-        if a concurrent commit appended new SSTs meanwhile, they are
-        preserved as the new run's suffix. Returns True if compacted."""
+        """Leveled compaction (two-level picker, the write-amplification
+        bound of compaction/picker/): merge the table's L0 epoch deltas
+        with ONLY the L1 files whose key ranges overlap the L0 span,
+        and rewrite that span as non-overlapping block-format L1 files.
+        L1 files outside the span are untouched — repeated compactions
+        rewrite each key's neighborhood, not the whole table.
+
+        OFF the commit path: the merge runs without the lock; the
+        version swap is CAS-style — concurrent commits append L0
+        entries which are preserved as the new run's suffix."""
         with self._lock:
             entries = list(self.version["tables"].get(table_id, []))
-        if len(entries) < self.compact_at:
+        l0 = [e for e in entries if e.get("level", 0) == 0]
+        l1 = [e for e in entries if e.get("level", 0) == 1]
+        if len(l0) < self.compact_at:
             return False
-        ssts = [read_sst(self.store.read(e["path"])) for e in entries]
-        key_order = ssts[-1].meta.key_names
+        l0_ssts = [self._materialized(e, cache=False) for e in l0]
+        key_order = l0_ssts[-1].meta.key_names
+
+        # the L0 span in the order-key domain — SSTs are key-sorted, so
+        # each file's span is exactly its first and last row
+        span_lo = span_hi = None
+        for s in l0_ssts:
+            if s.meta.n_rows == 0:
+                continue
+            ok = [
+                _order_key(np.asarray(s.keys[k])).astype(np.uint64)
+                for k in key_order
+            ]
+            lo = tuple(int(a[0]) for a in ok)
+            hi = tuple(int(a[-1]) for a in ok)
+            span_lo = lo if span_lo is None else min(span_lo, lo)
+            span_hi = hi if span_hi is None else max(span_hi, hi)
+        overlapping = [
+            e
+            for e in l1
+            if span_lo is not None
+            and not (
+                tuple(e["last"]) < span_lo or tuple(e["first"]) > span_hi
+            )
+        ]
+        src = l0 + overlapping
+        ssts = l0_ssts + [
+            self._materialized(e, cache=False) for e in overlapping
+        ]
         keys, values = merge_ssts(ssts, key_order)
         n_rows = len(next(iter(keys.values()))) if keys else 0
-        blob = build_sst(
-            table_id,
-            epoch,
-            keys,
-            values,
-            np.zeros(n_rows, bool),
-            key_order,
+        # L1 file epoch = newest SOURCE epoch: stays below any
+        # concurrently-committed L0 so newest-wins ordering holds
+        src_epoch = max(e["epoch"] for e in src)
+        new_entries: List[dict] = []
+        new_paths: List[str] = []
+        if n_rows:
+            from risingwave_tpu.storage.sstable import sort_order
+
+            order = sort_order([keys[k] for k in key_order])
+            keys = {k: np.asarray(a)[order] for k, a in keys.items()}
+            values = {v: np.asarray(a)[order] for v, a in values.items()}
+            okeys = [
+                _order_key(keys[k]).astype(np.uint64) for k in key_order
+            ]
+            for part, at in enumerate(range(0, n_rows, L1_FILE_ROWS)):
+                hi_i = min(at + L1_FILE_ROWS, n_rows)
+                sl = slice(at, hi_i)
+                blob = build_block_sst(
+                    table_id,
+                    src_epoch,
+                    {k: a[sl] for k, a in keys.items()},
+                    {v: a[sl] for v, a in values.items()},
+                    np.zeros(hi_i - at, bool),
+                    key_order,
+                )
+                path = (
+                    f"{self.prefix}/sst/{table_id}/"
+                    f"{epoch:020d}.l1.{part:04d}.sst"
+                )
+                self.store.put(path, blob)
+                new_paths.append(path)
+                new_entries.append(
+                    {
+                        "path": path,
+                        "epoch": src_epoch,
+                        "level": 1,
+                        "format": "block",
+                        "first": [int(a[at]) for a in okeys],
+                        "last": [int(a[hi_i - 1]) for a in okeys],
+                    }
+                )
+        untouched = [e for e in l1 if e not in overlapping]
+        merged_l1 = sorted(
+            untouched + new_entries, key=lambda e: tuple(e["first"])
         )
-        path = f"{self.prefix}/sst/{table_id}/{epoch:020d}.compact.sst"
-        self.store.put(path, blob)
         with self._lock:
             cur = self.version["tables"].get(table_id, [])
             if cur[: len(entries)] != entries:
                 # someone else rewrote the run (another compactor);
-                # abandon ours — the orphan SST is unreferenced
-                self.store.delete(path)
+                # abandon ours — the orphan SSTs are unreferenced
+                for p in new_paths:
+                    self.store.delete(p)
                 return False
-            self.version["tables"][table_id] = [
-                {"path": path, "epoch": epoch}
-            ] + cur[len(entries):]
+            # L1 files lead (oldest layer; newest-first reads walk the
+            # list reversed), surviving + concurrent L0s follow
+            self.version["tables"][table_id] = merged_l1 + cur[
+                len(entries):
+            ]
             self._persist_version()
         from risingwave_tpu import utils_sync_point as sync_point
 
         sync_point.hit("before_compaction_gc")
-        for e in entries:  # GC after the new version is durable
+        for e in src:  # GC after the new version is durable
             self.store.delete(e["path"])
             self._sst_cache.pop(e["path"], None)
         return True
@@ -375,15 +455,59 @@ class CheckpointManager:
     def read_table(
         self, table_id: str
     ) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
+        return self._read_retry(lambda: self._read_table_once(table_id))
+
+    def _read_table_once(self, table_id: str):
         # full-table restores bypass the SST cache: pinning every
         # restored SST would hold the whole committed store in host RAM
         # (the cache exists for the point-read working set)
-        ssts = list(reversed(self._ssts_newest_first(table_id, cache=False)))
-        if not ssts:
+        readers = list(
+            reversed(self._readers_newest_first(table_id, cache=False))
+        )
+        if not readers:
             return {}, {}
+        ssts = [
+            r.materialize() if isinstance(r, BlockSst) else r
+            for r in readers
+        ]
         return merge_ssts(ssts, ssts[-1].meta.key_names)
 
-    def _ssts_newest_first(self, table_id: str, cache: bool = True):
+    def _read_retry(self, fn):
+        """Run a read closure that may lazily touch SST bytes (block
+        reads happen AFTER the entry snapshot); a concurrent
+        compaction's GC can delete a file mid-read, so retry the WHOLE
+        closure against a reloaded manifest — the durable version never
+        references GC'd files."""
+        for attempt in range(8):
+            if attempt:
+                with self._lock:
+                    self._load()
+            try:
+                return fn()
+            except (FileNotFoundError, OSError, ValueError):
+                # NOT KeyError: that is how user errors (bad prefix /
+                # range column) surface from the read closures
+                continue
+        raise RuntimeError(
+            "SST files kept vanishing mid-read (compaction livelock?)"
+        )
+
+    def _open_entry(self, e: dict, cache: bool):
+        r = self._sst_cache.get(e["path"])
+        if r is None:
+            if e.get("format") == "block":
+                r = BlockSst(self.store, e["path"])
+            else:
+                r = read_sst(self.store.read(e["path"]))
+            if cache:
+                self._sst_cache[e["path"]] = r
+        return r
+
+    def _materialized(self, e: dict, cache: bool = True):
+        r = self._open_entry(e, cache)
+        return r.materialize() if isinstance(r, BlockSst) else r
+
+    def _readers_newest_first(self, table_id: str, cache: bool = True):
         # blob reads run OUTSIDE the lock; a compactor — this manager's
         # off-path thread, or another node still draining after a
         # "kill" — may GC an SST between the version snapshot and the
@@ -398,14 +522,9 @@ class CheckpointManager:
             out = []
             try:
                 for e in reversed(entries):
-                    sst = self._sst_cache.get(e["path"])
-                    if sst is None:
-                        sst = read_sst(self.store.read(e["path"]))
-                        if cache:
-                            self._sst_cache[e["path"]] = sst
-                    out.append(sst)
+                    out.append(self._open_entry(e, cache))
                 return out
-            except (KeyError, FileNotFoundError, OSError):
+            except (KeyError, FileNotFoundError, OSError, ValueError):
                 continue
         raise RuntimeError(
             f"SST run for {table_id!r} kept vanishing mid-read "
@@ -422,15 +541,54 @@ class CheckpointManager:
 
         Returns ``(found_mask, value_cols)``; value lanes are only
         meaningful where ``found_mask``."""
-        ssts = self._ssts_newest_first(table_id)
+        return self._read_retry(
+            lambda: self._get_rows_once(table_id, key_cols)
+        )
+
+    def _get_rows_once(self, table_id, key_cols):
+        readers = self._readers_newest_first(table_id)
         n = len(next(iter(key_cols.values()))) if key_cols else 0
         found = np.zeros(n, bool)
         unresolved = np.ones(n, bool)
         values: Dict[str, np.ndarray] = {}
-        for sst in ssts:
+        for sst in readers:
             if not unresolved.any():
                 break
             lanes = [np.asarray(key_cols[k]) for k in sst.meta.key_names]
+            if isinstance(sst, BlockSst):
+                # block-granular: prune by the header's key range (no
+                # IO — already resident), then at most one ~block read
+                # per query. The bloom is skipped on purpose: for a
+                # non-overlapping leveled file its bits outweigh a
+                # single block, so range + in-block binary search is
+                # strictly cheaper.
+                fr, la = sst.key_range()
+                if not fr:
+                    continue
+                qts = [
+                    _order_key(np.asarray(l)).astype(np.uint64)
+                    for l in lanes
+                ]
+                in_rng = np.ones(n, bool)
+                for qi in range(n):
+                    t = tuple(int(a[qi]) for a in qts)
+                    in_rng[qi] = fr <= t <= la
+                cand = unresolved & in_rng
+                if not cand.any():
+                    continue
+                hit, tombs, vals = sst.point_read(lanes, cand)
+                if not hit.any():
+                    continue
+                live = hit & ~tombs
+                for name, col in vals.items():
+                    if name not in values:
+                        values[name] = np.zeros(
+                            (n,) + col.shape[1:], col.dtype
+                        )
+                    values[name][live] = col[live]
+                found |= live
+                unresolved &= ~hit
+                continue
             cand = unresolved & sst.may_contain(lanes)
             if not cand.any():
                 continue
@@ -455,37 +613,144 @@ class CheckpointManager:
     ) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
         """Prefix range scan at the committed version (StateStore::iter,
         store.rs:298): touches only rows matching the key-lane prefix in
-        each SST, then resolves newest-wins — the read path backfill and
-        lookup joins build on."""
-        ssts = self._ssts_newest_first(table_id)
-        if not ssts:
+        each SST — and only the overlapping BLOCKS of leveled files —
+        then resolves newest-wins; the read path backfill and lookup
+        joins build on."""
+        return self.scan_range(table_id, prefix_cols=prefix_cols)
+
+    def scan_range(
+        self,
+        table_id: str,
+        prefix_cols: Optional[Dict[str, object]] = None,
+        range_col: Optional[str] = None,
+        lo: Optional[object] = None,
+        hi: Optional[object] = None,
+        reverse: bool = False,
+    ) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
+        """Ordered range scan at the committed version (the forward /
+        backward UserIterator, src/storage/src/hummock/iterator/):
+        equality over a key-lane prefix, optional [lo, hi] bounds
+        (inclusive) on the NEXT key lane, rows returned in key order
+        (``reverse`` = backward). Leveled (block-format) files read
+        only their overlapping blocks; L0 epoch deltas mask in place;
+        newest epoch wins per key and tombstones drop."""
+        return self._read_retry(
+            lambda: self._scan_range_once(
+                table_id, prefix_cols, range_col, lo, hi, reverse
+            )
+        )
+
+    def _scan_range_once(
+        self, table_id, prefix_cols, range_col, lo, hi, reverse
+    ):
+        readers = self._readers_newest_first(table_id)
+        if not readers:
             return {}, {}
-        key_names = ssts[0].meta.key_names
-        value_names = ssts[0].meta.value_names
+        key_names = readers[0].meta.key_names
+        value_names = readers[0].meta.value_names
+        prefix_cols = dict(prefix_cols or {})
+        plen = len(prefix_cols)
+        if tuple(key_names[:plen]) != tuple(prefix_cols):
+            # allow any dict order as long as the SET is the key prefix
+            if set(key_names[:plen]) != set(prefix_cols):
+                raise KeyError(
+                    f"prefix {tuple(prefix_cols)} is not a prefix of "
+                    f"key order {key_names}"
+                )
+        if range_col is not None and (
+            plen >= len(key_names) or key_names[plen] != range_col
+        ):
+            raise KeyError(
+                f"range column {range_col!r} must be key lane {plen}"
+            )
+
         k_parts: Dict[str, list] = {k: [] for k in key_names}
         v_parts: Dict[str, list] = {v: [] for v in value_names}
         t_parts, e_parts = [], []
-        for sst in ssts:
-            m = sst.prefix_mask(prefix_cols)
+
+        def collect(blk_keys, blk_vals, blk_tomb, epoch):
+            m = np.ones(len(blk_tomb), bool)
+            for name, v in prefix_cols.items():
+                m &= blk_keys[name] == v
+            if range_col is not None:
+                lane = blk_keys[range_col]
+                if lo is not None:
+                    m &= lane >= lo
+                if hi is not None:
+                    m &= lane <= hi
             if not m.any():
-                continue
+                return
             for k in key_names:
-                k_parts[k].append(np.asarray(sst.keys[k])[m])
+                k_parts[k].append(np.asarray(blk_keys[k])[m])
             for v in value_names:
-                v_parts[v].append(np.asarray(sst.values[v])[m])
-            t_parts.append(sst.tombstone[m])
-            e_parts.append(np.full(int(m.sum()), sst.meta.epoch, np.int64))
+                v_parts[v].append(np.asarray(blk_vals[v])[m])
+            t_parts.append(np.asarray(blk_tomb)[m])
+            e_parts.append(np.full(int(m.sum()), epoch, np.int64))
+
+        # order-key bounds for block pruning in leveled files
+        def bound(extreme) -> Optional[tuple]:
+            vals = []
+            for kn in key_names:
+                if kn in prefix_cols:
+                    vals.append(prefix_cols[kn])
+                elif kn == range_col and extreme is not None:
+                    vals.append(extreme)
+                else:
+                    break
+            return tuple(vals) if vals else None
+
+        for sst in readers:
+            if isinstance(sst, BlockSst):
+                blo = bhi = None
+                if (
+                    prefix_cols or lo is not None or hi is not None
+                ) and sst.key_dtypes:
+                    # lane dtypes ride the header: whole-file pruning
+                    # costs no data IO
+                    lane_dt = dict(zip(key_names, sst.key_dtypes))
+                    lov = bound(lo)
+                    hiv = bound(hi)
+                    if lov is not None:
+                        blo = order_tuple(
+                            lov, [lane_dt[k] for k in key_names[: len(lov)]]
+                        )
+                    if hiv is not None:
+                        bhi = order_tuple(
+                            hiv, [lane_dt[k] for k in key_names[: len(hiv)]]
+                        )
+                    elif prefix_cols:
+                        pv = tuple(
+                            prefix_cols[k] for k in key_names[:plen]
+                        )
+                        bhi = order_tuple(
+                            pv, [lane_dt[k] for k in key_names[:plen]]
+                        )
+                for blk in sst.scan_blocks(blo, bhi):
+                    collect(
+                        {k: blk[f"k_{k}"] for k in key_names},
+                        {v: blk[f"v_{v}"] for v in value_names},
+                        blk["tombstone"],
+                        sst.meta.epoch,
+                    )
+            else:
+                collect(
+                    sst.keys, sst.values, sst.tombstone, sst.meta.epoch
+                )
         if not t_parts:
             return {k: np.zeros(0) for k in key_names}, {}
         keys = {k: np.concatenate(p) for k, p in k_parts.items()}
         vals = {v: np.concatenate(p) for v, p in v_parts.items()}
-        return newest_wins(
+        keys, vals = newest_wins(
             keys,
             vals,
             np.concatenate(t_parts),
             np.concatenate(e_parts),
             key_names,
         )
+        if reverse:
+            keys = {k: a[::-1] for k, a in keys.items()}
+            vals = {v: a[::-1] for v, a in vals.items()}
+        return keys, vals
 
     def recover(self, executors: Sequence[object]) -> None:
         """Rebuild every Checkpointable executor's device state from

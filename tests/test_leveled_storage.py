@@ -1,0 +1,183 @@
+"""Storage depth (VERDICT r4 next #10): block-granular SSTs, ordered
+range/backward iteration, and the two-level compaction picker.
+
+Reference: src/storage/src/hummock/sstable/builder.rs:95 (block
+layout), iterator/ (forward/backward merge iterators),
+compaction/picker/ (leveled picker bounding write amplification)."""
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.storage.block_sst import (
+    BlockSst,
+    build_block_sst,
+    order_tuple,
+)
+from risingwave_tpu.storage.object_store import MemObjectStore
+from risingwave_tpu.storage.state_table import (
+    CheckpointManager,
+    StateDelta,
+)
+
+pytestmark = pytest.mark.smoke
+
+
+def _commit(mgr, epoch, tid, ks, vs, tomb=None):
+    n = len(ks)
+    mgr.commit_staged(
+        epoch,
+        [
+            StateDelta(
+                tid,
+                {"k": np.asarray(ks, np.int64)},
+                {"v": np.asarray(vs, np.int64)},
+                np.zeros(n, bool) if tomb is None else np.asarray(tomb),
+                ("k",),
+            )
+        ],
+    )
+
+
+def test_block_sst_point_and_range_reads():
+    store = MemObjectStore()
+    n = 20_000
+    ks = np.arange(n, dtype=np.int64)
+    blob = build_block_sst(
+        "t", 1, {"k": ks}, {"v": ks * 7}, np.zeros(n, bool), ("k",),
+        block_rows=1024,
+    )
+    store.put("t.sst", blob)
+    r = BlockSst(store, "t.sst")
+    assert r.meta.n_rows == n and len(r.blocks) == (n + 1023) // 1024
+
+    # point read touches header + one block, not the whole file
+    store.bytes_read = 0
+    hit, tomb, vals = r.point_read(
+        [np.asarray([5000, 19999, 123456], np.int64)],
+        np.ones(3, bool),
+    )
+    assert list(hit) == [True, True, False]
+    assert vals["v"][0] == 35000 and vals["v"][1] == 19999 * 7
+    assert store.bytes_read < len(blob) // 4
+
+    # range scan loads only overlapping blocks
+    store.bytes_read = 0
+    got = []
+    blo = order_tuple((7000,), [np.dtype(np.int64)])
+    bhi = order_tuple((7100,), [np.dtype(np.int64)])
+    for blk in r.scan_blocks(blo, bhi):
+        m = (blk["k_k"] >= 7000) & (blk["k_k"] <= 7100)
+        got.extend(blk["k_k"][m].tolist())
+    assert got == list(range(7000, 7101))
+    assert store.bytes_read < len(blob) // 8
+
+    # backward iteration yields blocks in reverse key order
+    firsts = [blk["k_k"][0] for blk in r.scan_blocks(reverse=True)]
+    assert firsts == sorted(firsts, reverse=True)
+
+
+def test_leveled_compaction_bounds_rewrites_and_stays_exact():
+    store = MemObjectStore()
+    mgr = CheckpointManager(store, compact_at=4)
+    rng = np.random.default_rng(3)
+    oracle = {}
+    epoch = 0
+    # many epochs over a WIDE key space: compactions must go leveled
+    for round_ in range(16):
+        epoch += 1 << 16
+        ks = rng.integers(0, 200_000, 500)
+        vs = rng.integers(0, 1 << 30, 500)
+        for k, v in zip(ks.tolist(), vs.tolist()):
+            oracle[k] = v
+        _commit(mgr, epoch, "lt", ks, vs)
+        mgr._maybe_compact(epoch)
+    entries = mgr.version["tables"]["lt"]
+    l1 = [e for e in entries if e.get("level", 0) == 1]
+    assert l1, "no leveled files were ever produced"
+    # L1 files are non-overlapping and sorted
+    spans = sorted((tuple(e["first"]), tuple(e["last"])) for e in l1)
+    for (f1, l1_), (f2, _) in zip(spans, spans[1:]):
+        assert l1_ < f2, "L1 files overlap"
+
+    # point reads agree with the oracle
+    probe = rng.choice(list(oracle), 300, replace=False)
+    found, vals = mgr.get_rows(
+        "lt", {"k": np.asarray(probe, np.int64)}
+    )
+    assert found.all()
+    assert [oracle[k] for k in probe.tolist()] == vals["v"][found].tolist()
+
+    # full recovery read agrees
+    keys, vals = mgr.read_table("lt")
+    assert dict(zip(keys["k"].tolist(), vals["v"].tolist())) == oracle
+
+
+def test_leveled_point_reads_are_sublinear():
+    """A narrow probe over a big leveled store must read a small
+    fraction of the stored bytes (block index + bloom + few blocks)."""
+    store = MemObjectStore()
+    mgr = CheckpointManager(store, compact_at=2)
+    epoch = 0
+    n_per = 30_000
+    for r in range(4):
+        epoch += 1 << 16
+        ks = np.arange(r * n_per, (r + 1) * n_per, dtype=np.int64)
+        _commit(mgr, epoch, "big", ks, ks * 3)
+        mgr._maybe_compact(epoch)
+    total = sum(len(b) for p, b in store._blobs.items() if "/sst/" in p)
+    # fresh manager: cold cache, every byte accounted
+    mgr2 = CheckpointManager(store, compact_at=2)
+    store.bytes_read = 0
+    found, vals = mgr2.get_rows(
+        "big", {"k": np.asarray([7, 50_000, 119_999], np.int64)}
+    )
+    assert found.all() and vals["v"].tolist() == [21, 150_000, 359_997]
+    assert store.bytes_read < total // 5, (store.bytes_read, total)
+
+
+def test_scan_range_ordered_and_backward():
+    store = MemObjectStore()
+    mgr = CheckpointManager(store, compact_at=2)
+    # two-lane key: (g, k); updates + tombstones across epochs
+    def commit2(epoch, gs, ks, vs, tomb=None):
+        n = len(gs)
+        mgr.commit_staged(
+            epoch,
+            [
+                StateDelta(
+                    "r2",
+                    {
+                        "g": np.asarray(gs, np.int64),
+                        "k": np.asarray(ks, np.int64),
+                    },
+                    {"v": np.asarray(vs, np.int64)},
+                    np.zeros(n, bool)
+                    if tomb is None
+                    else np.asarray(tomb),
+                    ("g", "k"),
+                )
+            ],
+        )
+
+    commit2(1 << 16, [1] * 5 + [2] * 5, list(range(5)) * 2,
+            [10, 11, 12, 13, 14, 20, 21, 22, 23, 24])
+    commit2(2 << 16, [1, 1], [2, 4], [99, 0], tomb=[False, True])
+    mgr._maybe_compact(2 << 16)
+    commit2(3 << 16, [1], [9], [77])
+
+    keys, vals = mgr.scan_range(
+        "r2", prefix_cols={"g": 1}, range_col="k", lo=1, hi=9
+    )
+    assert keys["k"].tolist() == [1, 2, 3, 9]  # k=4 tombstoned
+    assert vals["v"].tolist() == [11, 99, 13, 77]  # k=2 updated
+
+    keys, vals = mgr.scan_range(
+        "r2", prefix_cols={"g": 1}, range_col="k", lo=1, hi=9,
+        reverse=True,
+    )
+    assert keys["k"].tolist() == [9, 3, 2, 1]
+
+    # full prefix scan of g=2 untouched by g=1 churn
+    keys, vals = mgr.scan_prefix("r2", {"g": 2})
+    assert keys["k"].tolist() == [0, 1, 2, 3, 4]
+    assert vals["v"].tolist() == [20, 21, 22, 23, 24]

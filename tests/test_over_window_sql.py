@@ -92,6 +92,27 @@ def test_retracting_input_shifts_ranks():
     ]
 
 
+def test_non_partition_predicate_stays_above_window():
+    """WHERE on a non-PARTITION column must NOT push below the window:
+    rn ranks the FULL row set, then the filter applies."""
+    s = _session()
+    s.execute(
+        "CREATE MATERIALIZED VIEW g AS SELECT auction, price FROM "
+        "(SELECT auction, price, row_number() OVER "
+        "(ORDER BY price DESC) AS rn FROM bid) AS t "
+        "WHERE rn = 1 AND auction = 2"
+    )
+    # global top row is auction 1: the MV must be EMPTY (pushing
+    # auction = 2 below the window would wrongly return (2, 80))
+    s.execute("INSERT INTO bid VALUES (1, 0, 100, 0), (2, 0, 80, 0)")
+    out, _ = s.execute("SELECT auction, price FROM g")
+    assert len(out["auction"]) == 0
+    # auction 2 takes the global top: exactly one row appears
+    s.execute("INSERT INTO bid VALUES (2, 0, 150, 0)")
+    out, _ = s.execute("SELECT auction, price FROM g")
+    assert list(out["auction"]) == [2] and list(out["price"]) == [150]
+
+
 def test_q9_shape_top1_per_partition():
     """The Nexmark q9 shape: highest bid per auction via row_number()
     OVER (... ORDER BY price DESC) filtered to 1 in an outer select."""
