@@ -185,6 +185,26 @@ class SqlSession:
                 raise KeyError(f"unknown function {m.group(1)!r}")
             self._log_ddl(stripped)
             return {}, "DROP_FUNCTION"
+        if stripped[:12].lower().startswith("create index"):
+            return self._create_index(stripped)
+        import re as _re
+
+        m = _re.match(
+            r"(?is)^set\s+(\w+)\s*=\s*'?(\w+)'?\s*;?\s*$", stripped
+        )
+        if m:
+            # session variables (the reference's SET handler; the one
+            # consumed today gates delta-join planning like
+            # rw_streaming_enable_delta_join)
+            var, val = m.group(1).lower(), m.group(2).lower()
+            truthy = val in ("true", "on", "1", "yes")
+            if var in ("enable_delta_join", "rw_streaming_enable_delta_join"):
+                self.catalog.enable_delta_join = truthy
+            else:
+                self.session_vars = getattr(self, "session_vars", {})
+                self.session_vars[var] = val
+            self._log_ddl(stripped)
+            return {}, "SET"
         if stripped[:8].lower() == "explain ":
             from risingwave_tpu.sql.optimizer import explain_sql
 
@@ -265,6 +285,11 @@ class SqlSession:
             for s, side in planned.inputs.items()
             if s in self.runtime.fragments
         }
+        # a delta join's arrangements are PRE-POPULATED (shared with
+        # CREATE INDEX): replaying both base snapshots through the join
+        # would join existing data twice — seed from one arrangement
+        # instead (see _seed_delta_join)
+        delta = getattr(planned, "delta_join", False)
         self.runtime.register(planned.name, planned.pipeline)
         try:
             for s, side in frag_inputs.items():
@@ -274,7 +299,7 @@ class SqlSession:
                     s,
                     planned.name,
                     side=side,
-                    backfill=not self._replaying,
+                    backfill=not self._replaying and not delta,
                 )
         except BaseException:
             # keep the graph consistent on backfill failure: a
@@ -284,6 +309,47 @@ class SqlSession:
         if len(frag_inputs) < len(planned.inputs):
             self.dml.attach(planned, skip=frag_inputs.keys())
         self.batch.register(planned.name, planned.mview)
+        if delta and not self._replaying:
+            self._seed_delta_join(planned)
+
+    def _seed_delta_join(self, planned) -> None:
+        """Initial snapshot for a delta-join MV: replay the LEFT
+        arrangement's current rows through apply_left (the right
+        arrangement already holds all existing right rows, so this
+        yields exactly A ⋈ B once)."""
+        import numpy as np
+
+        from risingwave_tpu.array.chunk import StreamChunk
+
+        join = planned.pipeline.join
+        arr = join.left_arr
+        rows = list(arr.rows.items())
+        names = arr.pk + arr.columns
+        for at in range(0, len(rows), 512):
+            part = rows[at : at + 512]
+            cols: Dict[str, list] = {n: [] for n in names}
+            for k, v in part:
+                for n, val in zip(arr.pk, k):
+                    cols[n].append(val)
+                for n, val in zip(arr.columns, v):
+                    cols[n].append(val)
+            nulls = {
+                n: np.asarray([v is None for v in vs], bool)
+                for n, vs in cols.items()
+                if any(v is None for v in vs)
+            }
+            npcols = {
+                n: np.asarray(
+                    [0 if v is None else v for v in vs], np.int64
+                )
+                for n, vs in cols.items()
+            }
+            cap = 1 << max(1, int(np.ceil(np.log2(max(2, len(part))))))
+            self.runtime.push(
+                planned.name,
+                StreamChunk.from_numpy(npcols, cap, nulls=nulls),
+                side="left",
+            )
 
     def _unregister_planned(self, planned) -> None:
         """Undo EVERYTHING _register_planned did — stale DML targets
@@ -408,6 +474,66 @@ class SqlSession:
                 name, fn, out, list(args),
                 strings=self.strings, protected=True,
             )
+
+    def _create_index(self, sql: str):
+        """CREATE INDEX name ON table (col [, ...]) — an index IS a
+        special MV (the reference plans it the same way,
+        handler/create_index.rs): an IndexArrangement keyed by the
+        index columns ‖ base pk, maintained from the base change
+        stream, backfilled from the base snapshot, and shared by
+        delta-join plans."""
+        import re
+
+        from risingwave_tpu.executors.lookup import IndexArrangement
+        from risingwave_tpu.runtime import Pipeline
+
+        m = re.match(
+            r"(?is)^create\s+index\s+(\w+)\s+on\s+(\w+)\s*"
+            r"\(([^)]+)\)\s*;?\s*$",
+            sql,
+        )
+        if not m:
+            raise SyntaxError("CREATE INDEX <name> ON <table> (cols...)")
+        name, base, colraw = m.group(1), m.group(2), m.group(3)
+        cols = tuple(c.strip() for c in colraw.split(","))
+        if name in self.catalog.indexes or name in self.runtime.fragments:
+            raise ValueError(f"relation {name!r} already exists")
+        if base not in self.runtime.fragments:
+            raise KeyError(f"unknown base relation {base!r}")
+        base_mv = self.batch.tables.get(base)
+        if base_mv is None:
+            raise KeyError(f"base relation {base!r} is not materialized")
+        base_pk = tuple(base_mv.pk)
+        base_cols = tuple(base_mv.pk) + tuple(base_mv.columns)
+        for c in cols:
+            if c not in base_cols:
+                raise KeyError(f"column {c!r} not in {base!r}")
+        rest = tuple(
+            c for c in base_cols if c not in cols and c not in base_pk
+        )
+        arr = IndexArrangement(
+            index_cols=cols,
+            base_pk=base_pk,
+            columns=rest,
+            table_id=f"{name}.index",
+        )
+        self.runtime.register(name, Pipeline([arr]))
+        try:
+            self.runtime.subscribe(
+                base, name, backfill=not self._replaying
+            )
+        except BaseException:
+            self.runtime.unregister(name)
+            raise
+        self.catalog.indexes[name] = {
+            "base": base,
+            "cols": cols,
+            "base_pk": base_pk,
+            "arrangement": arr,
+        }
+        self.batch.register(name, arr)
+        self._log_ddl(sql)
+        return {}, "CREATE_INDEX"
 
     def _create_source(self, sql: str):
         """CREATE SOURCE name (cols) WITH (connector='filelog'|'datagen',

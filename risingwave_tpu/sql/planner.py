@@ -92,6 +92,11 @@ class Catalog:
     def __init__(self, tables: Dict[str, Schema]):
         self.tables = dict(tables)
         self.mvs: Dict[str, "PlannedMV"] = {}
+        # CREATE INDEX registry: name -> {"base", "cols", "base_pk",
+        # "arrangement"} (shared IndexArrangement instances; delta
+        # joins plan against these, lookup.rs)
+        self.indexes: Dict[str, dict] = {}
+        self.enable_delta_join = False  # SET enable_delta_join = true
 
     def schema_dtypes(self, name: str) -> Dict[str, object]:
         sch = self.tables[name]
@@ -324,6 +329,9 @@ class StreamPlanner:
         if isinstance(select.from_, P.Join):
             if select.from_.join_type.startswith("temporal"):
                 return self._plan_temporal(name, select)
+            dj = self._try_delta_join(name, select)
+            if dj is not None:
+                return dj
             return self._plan_join(name, select)
         return self._plan_single(name, select)
 
@@ -851,6 +859,165 @@ class StreamPlanner:
         return chain, out_schema, pk
 
     # -- joins -----------------------------------------------------------
+    def _try_delta_join(
+        self, name: str, select: P.Select
+    ) -> Optional[PlannedMV]:
+        """Plan an INNER 2-way join as a DELTA JOIN over two shared
+        CREATE INDEX arrangements (lookup.rs; frontend delta_join
+        rule, gated on a session variable like the reference's
+        rw_streaming_enable_delta_join). Returns None when the shape
+        or the indexes don't fit — the hash join path takes over."""
+        if not self.catalog.enable_delta_join:
+            return None
+        f = select.from_
+        if not (
+            isinstance(f, P.Join)
+            and f.join_type == "inner"
+            and isinstance(f.left, P.TableRef)
+            and isinstance(f.right, P.TableRef)
+        ):
+            return None
+        if select.where is not None or select.group_by or select.limit:
+            return None
+        lt, rt = f.left, f.right
+        if self.catalog.is_mv(lt.name) or self.catalog.is_mv(rt.name):
+            return None
+        if lt.name == rt.name:
+            # a self-join would collapse the inputs dict to one side;
+            # feeding a SHARED arrangement as 'both' would double-count
+            return None
+        lsch = self.catalog.schema_dtypes(lt.name)
+        rsch = self.catalog.schema_dtypes(rt.name)
+        lal = {lt.alias or lt.name}
+        ral = {rt.alias or rt.name}
+
+        def side_of(ident: P.Ident) -> Optional[str]:
+            if ident.qualifier:
+                if ident.qualifier in lal:
+                    return "l" if ident.name in lsch else None
+                if ident.qualifier in ral:
+                    return "r" if ident.name in rsch else None
+                return None
+            inl, inr = ident.name in lsch, ident.name in rsch
+            if inl == inr:
+                return None  # ambiguous or unknown
+            return "l" if inl else "r"
+
+        lkeys, rkeys = [], []
+        for c in _split_and(f.on):
+            if not (
+                isinstance(c, P.BinaryOp)
+                and c.op == "="
+                and isinstance(c.left, P.Ident)
+                and isinstance(c.right, P.Ident)
+            ):
+                return None
+            s1, s2 = side_of(c.left), side_of(c.right)
+            if (s1, s2) == ("l", "r"):
+                lkeys.append(c.left.name)
+                rkeys.append(c.right.name)
+            elif (s1, s2) == ("r", "l"):
+                lkeys.append(c.right.name)
+                rkeys.append(c.left.name)
+            else:
+                return None
+        if not lkeys:
+            return None
+        if len(set(lkeys)) != len(lkeys) or len(set(rkeys)) != len(
+            rkeys
+        ):
+            # duplicate key columns would collapse under set matching
+            # and silently drop a join condition
+            return None
+
+        def find_index(table: str, keys: Sequence[str]):
+            # EXACT column-set match: lookup() keys its prefix map by
+            # the full index-column tuple, so a superset index cannot
+            # serve a shorter join key
+            for d in self.catalog.indexes.values():
+                if d["base"] == table and len(d["cols"]) == len(
+                    keys
+                ) and set(d["cols"]) == set(keys):
+                    return d
+            return None
+
+        lidx = find_index(lt.name, lkeys)
+        if lidx is None:
+            return None
+        # permute the key pairs into the LEFT index's column order,
+        # then demand a right index with exactly that order
+        perm = [lkeys.index(c) for c in lidx["cols"]]
+        lkeys = [lkeys[i] for i in perm]
+        rkeys = [rkeys[i] for i in perm]
+        ridx = next(
+            (
+                d
+                for d in self.catalog.indexes.values()
+                if d["base"] == rt.name
+                and tuple(d["cols"]) == tuple(rkeys)
+            ),
+            None,
+        )
+        if ridx is None:
+            return None
+
+        from risingwave_tpu.executors.lookup import DeltaJoinExecutor
+        from risingwave_tpu.runtime.pipeline import TwoInputPipeline
+
+        left_out: List[Tuple[str, str]] = []
+        right_out: List[Tuple[str, str]] = []
+        out_schema: Dict[str, object] = {}
+        for i, item in enumerate(select.items):
+            ast = item.expr
+            if not isinstance(ast, P.Ident):
+                return None
+            side = side_of(ast)
+            if side is None:
+                return None
+            out = item.alias or ast.name
+            (left_out if side == "l" else right_out).append(
+                (out, ast.name)
+            )
+            dt = (lsch if side == "l" else rsch)[ast.name]
+            if not jnp.issubdtype(jnp.dtype(dt), jnp.integer):
+                # the host delta-join emission path carries int64
+                # lanes; a float column would truncate silently —
+                # decline, the hash path handles it
+                return None
+            out_schema[out] = dt
+        pk = []
+        for i, c in enumerate(lidx["base_pk"]):
+            left_out.append((f"_dlpk{i}", c))
+            out_schema[f"_dlpk{i}"] = jnp.dtype(jnp.int64)
+            pk.append(f"_dlpk{i}")
+        for i, c in enumerate(ridx["base_pk"]):
+            right_out.append((f"_drpk{i}", c))
+            out_schema[f"_drpk{i}"] = jnp.dtype(jnp.int64)
+            pk.append(f"_drpk{i}")
+
+        join = DeltaJoinExecutor(
+            lidx["arrangement"],
+            ridx["arrangement"],
+            lkeys,
+            rkeys,
+            left_out,
+            right_out,
+        )
+        mview = MaterializeExecutor(
+            pk=tuple(pk),
+            columns=tuple(n for n in out_schema if n not in pk),
+            table_id=f"{name}.mview",
+        )
+        planned = PlannedMV(
+            name,
+            TwoInputPipeline([], [], join, [mview]),
+            mview,
+            {lt.name: "left", rt.name: "right"},
+            schema=out_schema,
+        )
+        planned.delta_join = True  # session: seed instead of backfill
+        return planned
+
     def _plan_temporal(self, name: str, select: P.Select) -> PlannedMV:
         """stream JOIN table FOR SYSTEM_TIME AS OF PROCTIME() ON ... —
         the stream side probes the table's materialize state at apply
