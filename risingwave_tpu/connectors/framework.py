@@ -31,6 +31,7 @@ from risingwave_tpu.array.composite import encode_column
 from risingwave_tpu.array.dictionary import StringDictionary
 from risingwave_tpu.executors.base import Executor
 from risingwave_tpu.storage.state_table import Checkpointable, StateDelta
+from risingwave_tpu.types import Op
 from risingwave_tpu.types import Schema
 
 
@@ -161,6 +162,71 @@ class JsonParser(Parser):
             return int(v)
         except (TypeError, ValueError):
             return None
+
+
+class ChangeParser(Parser):
+    """Parser emitting CHANGE events rather than plain rows:
+    ``parse_changes(raw) -> [(op, row), ...]`` (parser/unified/ in the
+    reference — one upstream record may yield several ops)."""
+
+    def parse(self, raw):  # pragma: no cover - changes path only
+        raise TypeError("ChangeParser: use parse_changes")
+
+    def parse_changes(self, raw):
+        raise NotImplementedError
+
+
+class DebeziumJsonParser(ChangeParser):
+    """Debezium CDC envelope (reference: parser/debezium/ +
+    source/cdc/): ``{"before": .., "after": .., "op": "c|r|u|d"}``.
+
+    - ``c`` (create) and ``r`` (read) -> INSERT of ``after``. ``r`` is
+      the CDC BACKFILL lane: the connector snapshots the upstream
+      table as reads before streaming changes (cdc backfill contract,
+      src/stream/src/executor/backfill/cdc/), so a fresh MV converges
+      to the source table and then follows its changes;
+    - ``u`` -> UPDATE_DELETE of ``before`` + UPDATE_INSERT of
+      ``after``;
+    - ``d`` -> DELETE of ``before``.
+
+    Tolerates the schema-ful envelope (``{"schema":.., "payload":..}``)
+    and drops undecodable records (non-strict mode)."""
+
+    def __init__(self, schema: Schema):
+        super().__init__(schema)
+        self._rows = JsonParser(schema)
+
+    def parse_changes(self, raw):
+        if isinstance(raw, (bytes, str)):
+            try:
+                obj = json.loads(raw)
+            except json.JSONDecodeError:
+                return []
+        else:
+            obj = raw
+        if not isinstance(obj, dict):
+            return []
+        payload = obj.get("payload", obj)
+        if not isinstance(payload, dict):
+            return []
+        op = payload.get("op")
+        before = payload.get("before")
+        after = payload.get("after")
+        out = []
+        if op in ("c", "r") and isinstance(after, dict):
+            out.append((int(Op.INSERT), self._rows.parse(after)))
+        elif op == "d" and isinstance(before, dict):
+            out.append((int(Op.DELETE), self._rows.parse(before)))
+        elif op == "u" and isinstance(before, dict) and isinstance(
+            after, dict
+        ):
+            out.append((int(Op.UPDATE_DELETE), self._rows.parse(before)))
+            out.append((int(Op.UPDATE_INSERT), self._rows.parse(after)))
+        if any(r is None for _, r in out):
+            # drop the WHOLE change: emitting one half of an update
+            # pair would strand a stale row downstream
+            return []
+        return out
 
 
 class CsvParser(Parser):
@@ -363,20 +429,41 @@ class GenericSourceExecutor(Executor, Checkpointable):
             raw, new_off = self.connector.read(
                 s, self.offsets[s.split_id], max_rows_per_split
             )
-            rows = [r for r in map(self.parser.parse, raw) if r is not None]
-            if rows:
+            if isinstance(self.parser, ChangeParser):
+                pairs = [
+                    p
+                    for r in raw
+                    for p in self.parser.parse_changes(r)
+                ]
+                rows = [r for _, r in pairs]
+                all_ops = [o for o, _ in pairs]
+            else:
+                rows = [
+                    r for r in map(self.parser.parse, raw) if r is not None
+                ]
+                all_ops = None
+            # an update envelope doubles its row count: slice into
+            # capacity-bounded chunks so a full poll window of updates
+            # cannot overflow DataChunk.from_numpy
+            for at in range(0, len(rows), capacity):
+                part = rows[at : at + capacity]
                 lanes: Dict[str, np.ndarray] = {}
                 nulls: Dict[str, np.ndarray] = {}
                 for j, f in enumerate(self.schema.fields):
                     cl, cn = encode_column(
-                        f, [r[j] for r in rows], self.strings
+                        f, [r[j] for r in part], self.strings
                     )
                     lanes.update(cl)
                     if cn:
                         nulls.update(cn)
+                ops_arr = (
+                    np.asarray(all_ops[at : at + capacity], np.int32)
+                    if all_ops is not None
+                    else None
+                )
                 out.append(
                     StreamChunk.from_numpy(
-                        lanes, capacity, nulls=nulls or None
+                        lanes, capacity, ops=ops_arr, nulls=nulls or None
                     )
                 )
             staged[s.split_id] = new_off

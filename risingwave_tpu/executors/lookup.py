@@ -50,7 +50,10 @@ class IndexArrangement(MaterializeExecutor):
             table_id=table_id,
         )
         self.by_prefix: Dict[Tuple, set] = {}
-        # the prefix map needs row-level hooks: pin the dict backend
+        # the prefix map + lookup() read self.rows: pin the dict
+        # backend for apply AND restore (the native map never
+        # populates .rows)
+        self._force_python = True
         self._backend = "python"
 
     # -- maintenance -----------------------------------------------------
@@ -162,22 +165,27 @@ class DeltaJoinExecutor(Executor):
         names = [n for n, _ in self.left_out] + [
             n for n, _ in self.right_out
         ]
-        cols = {}
-        nulls = {}
-        for j, name in enumerate(names):
-            vals = [r[j] for r in out_rows]
-            nl = np.asarray([v is None for v in vals], bool)
-            cols[name] = np.asarray(
-                [0 if v is None else v for v in vals], np.int64
+        out: List[StreamChunk] = []
+        for at in range(0, len(out_rows), self.out_cap):
+            rows = out_rows[at : at + self.out_cap]
+            ops = out_ops[at : at + self.out_cap]
+            cols = {}
+            nulls = {}
+            for j, name in enumerate(names):
+                vals = [r[j] for r in rows]
+                nl = np.asarray([v is None for v in vals], bool)
+                cols[name] = np.asarray(
+                    [0 if v is None else v for v in vals], np.int64
+                )
+                if nl.any():
+                    nulls[name] = nl
+            cap = 1 << max(1, int(np.ceil(np.log2(max(2, len(rows))))))
+            out.append(
+                StreamChunk.from_numpy(
+                    cols, cap, ops=np.asarray(ops, np.int32), nulls=nulls
+                )
             )
-            if nl.any():
-                nulls[name] = nl
-        cap = 1 << max(1, int(np.ceil(np.log2(max(2, len(out_rows))))))
-        return [
-            StreamChunk.from_numpy(
-                cols, cap, ops=np.asarray(out_ops, np.int32), nulls=nulls
-            )
-        ]
+        return out
 
     def _delta(self, chunk, side_keys, own_out, other_arr, other_out, flip):
         stream_cols = [c for _, c in own_out]

@@ -64,7 +64,12 @@ class MaterializeExecutor(Executor, Checkpointable):
         self.checkpoint_enabled = False
 
     # -- backend selection ----------------------------------------------
+    _force_python = False  # subclasses needing row hooks pin the dict
+
     def _pick_backend(self, chunk: StreamChunk, data) -> None:
+        if self._force_python:
+            self._backend = "python"
+            return
         names = self.pk + self.columns
         eligible = all(
             np.issubdtype(data[name].dtype, np.integer)
@@ -288,7 +293,7 @@ class MaterializeExecutor(Executor, Checkpointable):
         if not key_cols:
             return
         n = len(next(iter(key_cols.values())))
-        ints = all(
+        ints = not self._force_python and all(
             np.issubdtype(np.asarray(a).dtype, np.integer)
             for a in list(key_cols.values()) + list(value_cols.values())
         )

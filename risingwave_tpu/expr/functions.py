@@ -123,6 +123,141 @@ def _log10(v):
     return jnp.log10(jnp.where(bad, 1.0, f)), bad
 
 
+@register("trunc", 1)
+def _trunc(v):
+    # preserve the input dtype (PG trunc(double) -> double); ints pass
+    return (jnp.trunc(v) if jnp.issubdtype(v.dtype, jnp.floating) else v), None
+
+
+@register("cbrt", 1)
+def _cbrt(v):
+    return jnp.cbrt(v.astype(jnp.float64)), None
+
+
+@register("log2", 1)
+def _log2(v):
+    f = v.astype(jnp.float64)
+    return jnp.log2(f), f <= 0
+
+
+@register("log", 2)
+def _log(b, x):
+    fb, fx = b.astype(jnp.float64), x.astype(jnp.float64)
+    bad = (fx <= 0) | (fb <= 0) | (fb == 1)
+    return jnp.log(fx) / jnp.log(fb), bad
+
+
+@register("sin", 1)
+def _sin(v):
+    return jnp.sin(v.astype(jnp.float64)), None
+
+
+@register("cos", 1)
+def _cos(v):
+    return jnp.cos(v.astype(jnp.float64)), None
+
+
+@register("tan", 1)
+def _tan(v):
+    return jnp.tan(v.astype(jnp.float64)), None
+
+
+@register("cot", 1)
+def _cot(v):
+    f = v.astype(jnp.float64)
+    return jnp.cos(f) / jnp.sin(f), None
+
+
+@register("asin", 1)
+def _asin(v):
+    f = v.astype(jnp.float64)
+    return jnp.arcsin(f), jnp.abs(f) > 1
+
+
+@register("acos", 1)
+def _acos(v):
+    f = v.astype(jnp.float64)
+    return jnp.arccos(f), jnp.abs(f) > 1
+
+
+@register("atan", 1)
+def _atan(v):
+    return jnp.arctan(v.astype(jnp.float64)), None
+
+
+@register("atan2", 2)
+def _atan2(y, x):
+    return (
+        jnp.arctan2(y.astype(jnp.float64), x.astype(jnp.float64)),
+        None,
+    )
+
+
+@register("sinh", 1)
+def _sinh(v):
+    return jnp.sinh(v.astype(jnp.float64)), None
+
+
+@register("cosh", 1)
+def _cosh(v):
+    return jnp.cosh(v.astype(jnp.float64)), None
+
+
+@register("tanh", 1)
+def _tanh(v):
+    return jnp.tanh(v.astype(jnp.float64)), None
+
+
+@register("degrees", 1)
+def _degrees(v):
+    return jnp.degrees(v.astype(jnp.float64)), None
+
+
+@register("radians", 1)
+def _radians(v):
+    return jnp.radians(v.astype(jnp.float64)), None
+
+
+@register("gcd", 2)
+def _gcd(a, b):
+    return jnp.gcd(a.astype(jnp.int64), b.astype(jnp.int64)), None
+
+
+@register("lcm", 2)
+def _lcm(a, b):
+    return jnp.lcm(a.astype(jnp.int64), b.astype(jnp.int64)), None
+
+
+@register("bit_and", 2)
+def _bit_and(a, b):
+    return a.astype(jnp.int64) & b.astype(jnp.int64), None
+
+
+@register("bit_or", 2)
+def _bit_or(a, b):
+    return a.astype(jnp.int64) | b.astype(jnp.int64), None
+
+
+@register("bit_xor", 2)
+def _bit_xor(a, b):
+    return a.astype(jnp.int64) ^ b.astype(jnp.int64), None
+
+
+@register("bit_not", 1)
+def _bit_not(v):
+    return ~v.astype(jnp.int64), None
+
+
+@register("bit_shift_left", 2)
+def _bshl(v, n):
+    return v.astype(jnp.int64) << n.astype(jnp.int64), None
+
+
+@register("bit_shift_right", 2)
+def _bshr(v, n):
+    return v.astype(jnp.int64) >> n.astype(jnp.int64), None
+
+
 @register("greatest", 2, 8)
 def _greatest(*vs):
     out = vs[0]

@@ -451,6 +451,23 @@ class SqlSession:
             a, b = max(start, 1), max(start + n, 1)
             return s[a - 1 : b - 1]
 
+        def _split_part(s, d, n):
+            if n == 0:
+                raise ValueError("split_part field position must not be 0")
+            parts = s.split(d) if d else [s]
+            i = n - 1 if n > 0 else len(parts) + n
+            return parts[i] if 0 <= i < len(parts) else ""
+
+        def _overlay(s, repl, start, n):
+            a = max(start - 1, 0)
+            return s[:a] + repl + s[a + n :]
+
+        def _md5(s):
+            import hashlib
+
+            return hashlib.md5(s.encode()).hexdigest()
+
+        B = Field("b", DataType.BOOLEAN)
         V = Field("s", DataType.VARCHAR)
         I = Field("n", DataType.INT64)
         sigs = {
@@ -458,16 +475,39 @@ class SqlSession:
             "upper": (V, (V,), lambda s: s.upper()),
             "lower": (V, (V,), lambda s: s.lower()),
             "trim": (V, (V,), lambda s: s.strip(" ")),  # PG trim: spaces only
+            "ltrim": (V, (V,), lambda s: s.lstrip(" ")),
+            "rtrim": (V, (V,), lambda s: s.rstrip(" ")),
+            "btrim": (V, (V, V), lambda s, cs: s.strip(cs)),
             "reverse": (V, (V,), lambda s: s[::-1]),
             "concat": (V, (V, V), lambda a, b: a + b),
+            "concat_ws": (
+                V, (V, V, V), lambda sep, a, b: sep.join((a, b)),
+            ),
             "substr": (V, (V, I, I), _substr),
             "replace": (V, (V, V, V), lambda s, a, b: s.replace(a, b)),
-            "starts_with": (
-                Field("b", DataType.BOOLEAN),
-                (V, V),
-                lambda s, p: s.startswith(p),
-            ),
+            "starts_with": (B, (V, V), lambda s, p: s.startswith(p)),
+            "ends_with": (B, (V, V), lambda s, p: s.endswith(p)),
             "char_length": (I, (V,), lambda s: len(s)),
+            "position": (I, (V, V), lambda sub, s: s.find(sub) + 1),
+            "strpos": (I, (V, V), lambda s, sub: s.find(sub) + 1),
+            "repeat": (V, (V, I), lambda s, n: s * max(n, 0)),
+            "initcap": (V, (V,), lambda s: s.title()),
+            "left": (V, (V, I), lambda s, n: s[:n] if n >= 0 else s[: len(s) + n]),
+            "right": (V, (V, I), lambda s, n: s[-n:] if n > 0 else s[-n if n else len(s):]),
+            "lpad": (V, (V, I, V), lambda s, n, p: s[:n] if len(s) >= n else (p * n)[: n - len(s)] + s),
+            "rpad": (V, (V, I, V), lambda s, n, p: s[:n] if len(s) >= n else s + (p * n)[: n - len(s)]),
+            "split_part": (V, (V, V, I), _split_part),
+            "translate": (
+                V, (V, V, V),
+                lambda s, frm, to: s.translate(
+                    {ord(c): (to[i] if i < len(to) else None)
+                     for i, c in enumerate(frm)}
+                ),
+            ),
+            "overlay": (V, (V, V, I, I), _overlay),
+            "md5": (V, (V,), _md5),
+            "ascii": (I, (V,), lambda s: ord(s[0]) if s else 0),
+            "chr": (V, (I,), lambda n: chr(n)),
         }
         for name, (out, args, fn) in sigs.items():
             F.register_py_udf(

@@ -960,6 +960,14 @@ class StreamPlanner:
         )
         if ridx is None:
             return None
+        # the seeding/emission paths carry int64 lanes: a float join
+        # key or base pk would truncate — decline to the hash path
+        for col, sch in [(c, lsch) for c in lkeys + list(
+            lidx["base_pk"]
+        )] + [(c, rsch) for c in rkeys + list(ridx["base_pk"])]:
+            dt = sch.get(col, jnp.dtype(jnp.int64))  # hidden _row_id
+            if not jnp.issubdtype(jnp.dtype(dt), jnp.integer):
+                return None
 
         from risingwave_tpu.executors.lookup import DeltaJoinExecutor
         from risingwave_tpu.runtime.pipeline import TwoInputPipeline

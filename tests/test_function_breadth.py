@@ -1,0 +1,116 @@
+"""Scalar function breadth (VERDICT r4 missing #9) + Debezium CDC
+parsing (missing #6): the new math/bit/string functions evaluate with
+SQL NULL conventions, and a Debezium-envelope source drives a
+retracting MV end to end (op r = the CDC backfill lane)."""
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.frontend.session import SqlSession
+from risingwave_tpu.sql import Catalog
+
+pytestmark = pytest.mark.smoke
+
+
+def test_math_and_bit_functions_from_sql():
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute("CREATE TABLE t (a BIGINT, b BIGINT)")
+    s.execute("INSERT INTO t VALUES (12, 8), (7, 3)")
+    out, _ = s.execute(
+        "SELECT gcd(a, b) AS g, lcm(a, b) AS l, bit_and(a, b) AS ba, "
+        "bit_or(a, b) AS bo, bit_xor(a, b) AS bx, "
+        "bit_shift_left(a, 2) AS sl, trunc(a / 2) AS tr FROM t "
+        "ORDER BY g"
+    )
+    assert list(out["g"]) == [1, 4]
+    assert list(out["l"]) == [21, 24]
+    assert list(out["ba"]) == [3, 8]
+    assert list(out["bo"]) == [7, 12]
+    assert list(out["bx"]) == [4, 4]
+    assert list(out["sl"]) == [28, 48]
+
+
+def test_trig_log_null_domains():
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute("CREATE TABLE t (a BIGINT)")
+    s.execute("INSERT INTO t VALUES (1), (0)")
+    out, _ = s.execute(
+        "SELECT a, log2(a) AS l2, asin(a) AS asn FROM t ORDER BY a"
+    )
+    # log2(0) -> NULL (domain), asin in [-1,1] both fine
+    l2 = list(out["l2"])
+    assert l2[0] is None or (
+        isinstance(l2[0], float) and np.isnan(l2[0])
+    ), l2
+    assert float(l2[1]) == 0.0
+
+
+def test_string_function_breadth():
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute("CREATE TABLE t (w VARCHAR)")
+    s.execute("INSERT INTO t VALUES ('hello world')")
+    out, _ = s.execute(
+        "SELECT split_part(w, ' ', 2) AS p, initcap(w) AS ic, "
+        "lpad(w, 13, '*') AS lp, strpos(w, 'world') AS sp, "
+        "repeat('ab', 2) AS rp, md5(w) AS h FROM t"
+    )
+    assert list(out["p"]) == ["world"]
+    assert list(out["ic"]) == ["Hello World"]
+    assert list(out["lp"]) == ["**hello world"]
+    assert list(out["sp"]) == [7]
+    assert list(out["rp"]) == ["abab"]
+    import hashlib
+
+    assert list(out["h"]) == [hashlib.md5(b"hello world").hexdigest()]
+
+
+def test_debezium_cdc_source_to_retracting_mv(tmp_path):
+    """Debezium envelope lines (snapshot reads + create/update/delete)
+    through the connector framework: the downstream agg MV converges to
+    the upstream table's state — the CDC backfill contract."""
+    import jax.numpy as jnp
+
+    from risingwave_tpu.connectors.framework import (
+        DebeziumJsonParser,
+        FileLogSource,
+        GenericSourceExecutor,
+    )
+    from risingwave_tpu.executors.hash_agg import HashAggExecutor
+    from risingwave_tpu.executors.materialize import MaterializeExecutor
+    from risingwave_tpu.ops.agg import AggCall
+    from risingwave_tpu.runtime.pipeline import Pipeline
+    from risingwave_tpu.types import DataType, Field, Schema
+
+    d = str(tmp_path)
+    schema = Schema([Field("id", DataType.INT64), Field("v", DataType.INT64)])
+    src = GenericSourceExecutor(
+        FileLogSource(d), DebeziumJsonParser(schema), table_id="cdc"
+    )
+    agg = HashAggExecutor(
+        ("id",),
+        (AggCall("sum", "v", "s"), AggCall("count_star", None, "c")),
+        {"id": jnp.int64, "v": jnp.int64},
+        capacity=1 << 8,
+        table_id="cdc.agg",
+    )
+    mv = MaterializeExecutor(pk=("id",), columns=("s", "c"), table_id="cdc.mv")
+    pipe = Pipeline([agg, mv])
+
+    lines = [
+        # snapshot (backfill) reads
+        '{"op": "r", "after": {"id": 1, "v": 10}}',
+        '{"op": "r", "after": {"id": 2, "v": 20}}',
+        # streaming changes
+        '{"op": "c", "after": {"id": 3, "v": 30}}',
+        '{"op": "u", "before": {"id": 1, "v": 10}, "after": {"id": 1, "v": 15}}',
+        '{"op": "d", "before": {"id": 2, "v": 20}}',
+        '{"schema": {}, "payload": {"op": "c", "after": {"id": 4, "v": 40}}}',
+        'garbage not json',
+    ]
+    FileLogSource.append(d, 0, lines)
+    src.discover()
+    for c in src.poll(64, 16):
+        pipe.push(c)
+    pipe.barrier()
+    snap = {k[0]: v for k, v in mv.snapshot().items()}
+    assert snap == {1: (15, 1), 3: (30, 1), 4: (40, 1)}
