@@ -22,6 +22,9 @@ from risingwave_tpu.sql.planner import AGG_FUNCS, Binder, compile_scalar
 class BatchQueryEngine:
     """``tables`` maps name -> MaterializeExecutor (the MV catalog)."""
 
+    spill_threshold_rows: "int | None" = None  # SET batch_spill_threshold
+    last_spill_partitions = 0
+
     def __init__(self, tables: Dict[str, MaterializeExecutor]):
         self.tables = dict(tables)
         # distributed-mode task count, 0/1 = local mode; flipped like
@@ -333,6 +336,76 @@ class BatchQueryEngine:
         return np.array([fn(live)]), False
 
     def _group_agg(self, stmt, cols, keys, binder):
+        n = len(next(iter(cols.values()))) if cols else 0
+        if (
+            self.spill_threshold_rows is not None
+            and n > self.spill_threshold_rows
+        ):
+            return self._group_agg_spilled(stmt, cols, keys, binder)
+        return self._group_agg_mem(stmt, cols, keys, binder)
+
+    def _group_agg_spilled(self, stmt, cols, keys, binder):
+        """Spill-to-disk aggregation (reference: src/batch/src/spill/):
+        hash-partition the input rows by group key into on-disk runs,
+        aggregate one partition at a time (memory bounded by the
+        largest partition, not the input), and concatenate — each key
+        lives in exactly one partition, so results are exact."""
+        import shutil
+        import tempfile
+
+        import pandas as pd
+
+        P_PARTS = 8
+        key_cols = list(keys)  # already resolved column names
+        n = len(next(iter(cols.values())))
+        # vectorized partition hash — this branch exists FOR large n
+        part = (
+            pd.util.hash_pandas_object(
+                pd.DataFrame({c: cols[c] for c in key_cols}), index=False
+            ).to_numpy()
+            % P_PARTS
+        )
+        # one object-boxing pass per column, not one per partition
+        obj_cols = {k: np.asarray(v, dtype=object) for k, v in cols.items()}
+        tmpdir = tempfile.mkdtemp(prefix="rw_batch_spill_")
+        self.last_spill_partitions = 0
+        try:
+            paths = []
+            for p in range(P_PARTS):
+                m = part == p
+                if not m.any():
+                    continue
+                path = f"{tmpdir}/part{p}.npz"
+                np.savez(path, **{k: v[m] for k, v in obj_cols.items()})
+                paths.append(path)
+            self.last_spill_partitions = len(paths)
+            outs = []
+            for path in paths:
+                z = np.load(path, allow_pickle=True)
+                pcols = {k: z[k] for k in z.files}
+                outs.append(
+                    self._group_agg_mem(stmt, pcols, keys, binder)
+                )
+        finally:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+        # concatenate partition results; a __null companion present in
+        # ANY partition must exist for all (False-filled elsewhere)
+        names = {nm for o in outs for nm in o}
+        merged: Dict[str, np.ndarray] = {}
+        for nm in names:
+            parts = []
+            for o in outs:
+                if nm in o:
+                    parts.append(np.asarray(o[nm]))
+                elif nm.endswith("__null"):
+                    base = nm[: -len("__null")]
+                    parts.append(
+                        np.zeros(len(o[base]), bool)
+                    )
+            merged[nm] = np.concatenate(parts)
+        return merged
+
+    def _group_agg_mem(self, stmt, cols, keys, binder):
         import pandas as pd
 
         df = pd.DataFrame(cols)

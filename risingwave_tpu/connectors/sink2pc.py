@@ -1,0 +1,158 @@
+"""Coordinated two-phase-commit sinks — exactly-once external delivery.
+
+Reference: src/meta/src/manager/sink_coordination/ (the coordinator
+collects per-writer pre-commit metadata for an epoch and issues ONE
+atomic commit) + the iceberg/file 2PC sinks it drives. Upgrades the
+at-least-once LogSinker contract (connectors/log_store.py) to
+exactly-once for sinks that can stage-then-publish atomically.
+
+Protocol per epoch (each step idempotent, so every crash window
+replays safely):
+
+1. ``prepare(rows, epoch)`` — stage the batch durably but INVISIBLY
+   (e.g. a staging file). Re-preparing an epoch overwrites the stage.
+2. ``commit_prepared(epoch)`` — atomically publish (rename). A second
+   commit of the same epoch is a no-op; committed epochs are immune
+   to re-prepare.
+3. The coordinator advances the log-store consumer offset only AFTER
+   the external commit, so:
+   - crash after prepare:   offset behind -> replay re-prepares
+     (overwrite) and commits once;
+   - crash after commit:    offset behind -> replay's commit is a
+     no-op (already published);
+   - rolled-back epochs:    never prepared past the durable frontier
+     (``up_to``), and recovery aborts any staged leftovers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Tuple
+
+from risingwave_tpu.connectors.log_store import KvLogStore
+from risingwave_tpu.connectors.sink import Sink
+
+
+class TwoPhaseSink(Sink):
+    """A sink that can stage an epoch invisibly and publish atomically
+    (the reference's coordinated sink trait)."""
+
+    def prepare(self, rows, epoch: int) -> None:
+        raise NotImplementedError
+
+    def commit_prepared(self, epoch: int) -> None:
+        raise NotImplementedError
+
+    def abort_prepared(self, epoch: int) -> None:
+        raise NotImplementedError
+
+    def committed_epochs(self) -> List[int]:
+        raise NotImplementedError
+
+    # the plain Sink surface maps to prepare+commit in one step
+    def write_batch(self, rows, epoch: int) -> None:
+        self.prepare(rows, epoch)
+
+    def commit(self, epoch: int) -> None:
+        self.commit_prepared(epoch)
+
+
+class FileTwoPhaseSink(TwoPhaseSink):
+    """Stage to ``<dir>/staging/<epoch>``, publish by atomic rename to
+    ``<dir>/committed/<epoch>`` (the file/iceberg 2PC shape)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(os.path.join(root, "staging"), exist_ok=True)
+        os.makedirs(os.path.join(root, "committed"), exist_ok=True)
+
+    def _staging(self, epoch: int) -> str:
+        return os.path.join(self.root, "staging", f"{epoch:020d}.json")
+
+    def _committed(self, epoch: int) -> str:
+        return os.path.join(self.root, "committed", f"{epoch:020d}.json")
+
+    def prepare(self, rows, epoch: int) -> None:
+        if os.path.exists(self._committed(epoch)):
+            return  # already published: replayed prepare is a no-op
+        tmp = self._staging(epoch) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                [
+                    {
+                        "pk": list(pk),
+                        "row": list(row) if row is not None else None,
+                        "op": int(op),
+                    }
+                    for pk, row, op in rows
+                ],
+                f,
+            )
+        os.replace(tmp, self._staging(epoch))
+
+    def commit_prepared(self, epoch: int) -> None:
+        if os.path.exists(self._committed(epoch)):
+            return  # idempotent publish
+        if not os.path.exists(self._staging(epoch)):
+            raise RuntimeError(f"epoch {epoch} was never prepared")
+        os.replace(self._staging(epoch), self._committed(epoch))
+
+    def abort_prepared(self, epoch: int) -> None:
+        try:
+            os.unlink(self._staging(epoch))
+        except FileNotFoundError:
+            pass
+
+    def committed_epochs(self) -> List[int]:
+        return sorted(
+            int(f.split(".")[0])
+            for f in os.listdir(os.path.join(self.root, "committed"))
+            if f.endswith(".json")
+        )
+
+    def read_committed(self, epoch: int):
+        with open(self._committed(epoch)) as f:
+            return [
+                (
+                    tuple(r["pk"]),
+                    tuple(r["row"]) if r["row"] is not None else None,
+                    r["op"],
+                )
+                for r in json.load(f)
+            ]
+
+
+class SinkCoordinator:
+    """The meta-side coordinator (sink_coordination/coordinator
+    analogue, single-writer form): drains the durable log into a
+    TwoPhaseSink with exactly-once publish semantics. The drain loop
+    IS LogSinker's (TwoPhaseSink adapts write_batch/commit to
+    prepare/commit_prepared) — one loop, no drift."""
+
+    def __init__(self, log_store: KvLogStore, sink: TwoPhaseSink):
+        from risingwave_tpu.connectors.log_store import LogSinker
+
+        self.log_store = log_store
+        self.sink = sink
+        self._sinker = LogSinker(log_store, sink)
+
+    def recover(self) -> None:
+        """Abort staged-but-unpublished epochs: replay will re-prepare
+        them (possibly with different batch boundaries)."""
+        for epoch in self.log_store.pending_epochs():
+            self.sink.abort_prepared(epoch)
+
+    def run_once(self, up_to: int) -> int:
+        """Deliver pending epochs <= ``up_to`` (the DURABLE frontier —
+        REQUIRED: publishing a not-yet-durable epoch that later rolls
+        back would permanently strand its pre-rollback rows externally,
+        since committed epochs are immune to re-prepare). Safe to crash
+        anywhere and rerun; the offset advances after the external
+        commit, and both phases are idempotent. Returns epochs
+        published."""
+        if up_to is None:
+            raise ValueError(
+                "SinkCoordinator.run_once requires the durable frontier"
+            )
+        return self._sinker.run_once(up_to=up_to)

@@ -200,6 +200,16 @@ class SqlSession:
             truthy = val in ("true", "on", "1", "yes")
             if var in ("enable_delta_join", "rw_streaming_enable_delta_join"):
                 self.catalog.enable_delta_join = truthy
+            elif var in ("batch_spill_threshold", "rw_batch_spill_threshold"):
+                if val in ("off", "none", "0"):
+                    self.batch.spill_threshold_rows = None
+                elif val.isdigit():
+                    self.batch.spill_threshold_rows = int(val)
+                else:
+                    raise ValueError(
+                        f"batch_spill_threshold needs an integer or "
+                        f"'off', got {val!r}"
+                    )
             else:
                 self.session_vars = getattr(self, "session_vars", {})
                 self.session_vars[var] = val

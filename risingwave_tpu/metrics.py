@@ -134,7 +134,20 @@ class MetricsRegistry:
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib API name)
-                if self.path.rstrip("/") not in ("", "/metrics"):
+                path = self.path.rstrip("/")
+                if path == "/heap":
+                    # heap profile: device-state accounting + host
+                    # tracemalloc top (utils_heap; jeprof analogue)
+                    from risingwave_tpu import utils_heap
+
+                    body = utils_heap.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path not in ("", "/metrics"):
                     self.send_response(404)
                     self.end_headers()
                     return

@@ -89,6 +89,11 @@ class StreamingRuntime:
         # device state exceeds the budget, fully-durable groups are
         # evicted to the object store and fold back on next touch
         self.memory_budget_bytes = memory_budget_bytes
+        # heap profiling (/heap): this runtime's executors feed the
+        # device-state half of the report (utils_heap, jeprof analogue)
+        from risingwave_tpu import utils_heap
+
+        utils_heap.attach_runtime(self)
         self.fragments: Dict[str, object] = {}
         # upstream -> [(downstream, side)]; side targets one input of a
         # two-input fragment ("left"/"right") or "single"
@@ -201,6 +206,30 @@ class StreamingRuntime:
             raise KeyError(f"unknown upstream fragment {upstream!r}")
         if name not in self.fragments:
             raise KeyError(f"unknown fragment {name!r}")
+        # UNION schema check (union.rs asserts input schemas match):
+        # a second upstream feeding the same (fragment, side) must
+        # expose the same lane set, or the mismatch would surface deep
+        # inside a kernel long after DDL time
+        try:
+            new_mv = self._fragment_mview(upstream)
+        except ValueError:
+            new_mv = None  # no materialize stage: nothing to compare
+        if new_mv is not None:
+            new_sig = set(new_mv.pk) | set(new_mv.columns)
+            for prev_up, edges in self._subs.items():
+                if prev_up == upstream or (name, side) not in edges:
+                    continue
+                try:
+                    prev_mv = self._fragment_mview(prev_up)
+                except ValueError:
+                    continue
+                prev_sig = set(prev_mv.pk) | set(prev_mv.columns)
+                if prev_sig != new_sig:
+                    raise ValueError(
+                        f"UNION inputs disagree on schema: {upstream!r} "
+                        f"exposes {sorted(new_sig)} but {prev_up!r} "
+                        f"exposes {sorted(prev_sig)}"
+                    )
         self._subs.setdefault(upstream, []).append((name, side))
         if backfill:
             from risingwave_tpu.runtime.backfill import snapshot_chunks

@@ -1,0 +1,103 @@
+"""Heap profiling — host allocation tracking + device-state accounting.
+
+Reference: the compute node's jemalloc heap profiling + memory
+dashboard (src/compute/src/memory/, risedev heap-profile tooling).
+TPU re-design: host-side Python allocations are tracked with
+``tracemalloc`` (grouped by source line, like jeprof's collapsed
+stacks); DEVICE state — the dominant memory here — is accounted
+exactly from each executor's ``state_nbytes()`` (slot arrays in HBM),
+so one report covers both tiers.
+
+Surface: ``start()`` / ``stop()`` + ``render()`` for programmatic use,
+and the metrics server's ``/heap`` endpoint (set a runtime with
+``attach_runtime`` — ``StreamingRuntime`` does this on construction).
+"""
+
+from __future__ import annotations
+
+import tracemalloc
+import weakref
+from typing import List, Optional
+
+_runtime_ref: Optional["weakref.ref"] = None
+
+
+def attach_runtime(runtime) -> None:
+    """Register the runtime whose executors the /heap report walks."""
+    global _runtime_ref
+    _runtime_ref = weakref.ref(runtime)
+
+
+def start(nframes: int = 8) -> None:
+    if not tracemalloc.is_tracing():
+        tracemalloc.start(nframes)
+
+
+def stop() -> None:
+    if tracemalloc.is_tracing():
+        tracemalloc.stop()
+
+
+def is_running() -> bool:
+    return tracemalloc.is_tracing()
+
+
+def host_top(limit: int = 25) -> List[dict]:
+    """Top host allocation sites since start(), by retained bytes."""
+    if not tracemalloc.is_tracing():
+        return []
+    snap = tracemalloc.take_snapshot()
+    stats = snap.statistics("lineno")
+    return [
+        {
+            "site": str(s.traceback[0]) if s.traceback else "?",
+            "bytes": int(s.size),
+            "count": int(s.count),
+        }
+        for s in stats[:limit]
+    ]
+
+
+def device_state() -> List[dict]:
+    """Per-executor device-state bytes (exact — the arrays ARE the
+    state), newest runtime attached via attach_runtime."""
+    rt = _runtime_ref() if _runtime_ref is not None else None
+    if rt is None:
+        return []
+    out = []
+    for ex in rt.executors():
+        fn = getattr(ex, "state_nbytes", None)
+        if fn is None:
+            continue
+        out.append(
+            {
+                "executor": type(ex).__name__,
+                "table_id": getattr(ex, "table_id", "?"),
+                "bytes": int(fn()),
+            }
+        )
+    out.sort(key=lambda d: -d["bytes"])
+    return out
+
+
+def render(limit: int = 25) -> str:
+    lines = ["# device state (exact, per executor)"]
+    total = 0
+    for d in device_state():
+        total += d["bytes"]
+        lines.append(
+            f"{d['bytes']:>14,}  {d['executor']:<28} {d['table_id']}"
+        )
+    lines.append(f"{total:>14,}  TOTAL device state")
+    lines.append("")
+    if tracemalloc.is_tracing():
+        lines.append(f"# host allocations (tracemalloc, top {limit})")
+        for d in host_top(limit):
+            lines.append(
+                f"{d['bytes']:>14,}  n={d['count']:<8} {d['site']}"
+            )
+    else:
+        lines.append(
+            "# host tracking off — utils_heap.start() enables tracemalloc"
+        )
+    return "\n".join(lines) + "\n"

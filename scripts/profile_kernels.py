@@ -78,7 +78,7 @@ def main():
     print(f"{'lookup_or_insert (fresh table)':40s} {(time.perf_counter()-t0)/reps*1e3:10.3f} ms")
 
     # agg apply at shape
-    calls = (AggCall(kind="count", input=None, output="cnt"),)
+    calls = (AggCall(kind="count_star", input=None, output="cnt"),)
     dtypes = {"auction": jnp.int64, "window_start": jnp.int64}
     state = agg_ops.create_state(cap, calls, dtypes)
     signs = jnp.ones(n, jnp.int64)

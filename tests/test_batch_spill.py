@@ -1,0 +1,45 @@
+"""Batch spill-to-disk aggregation (VERDICT r4 weak #8 depth item;
+reference: src/batch/src/spill/): over-threshold GROUP BY inputs
+hash-partition to disk and aggregate partition-by-partition, exactly."""
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.frontend.session import SqlSession
+from risingwave_tpu.sql import Catalog
+
+pytestmark = pytest.mark.smoke
+
+
+def test_spilled_group_by_matches_in_memory():
+    s = SqlSession(Catalog({}), capacity=1 << 12)
+    s.execute("CREATE TABLE t (k BIGINT, v BIGINT)")
+    rng = np.random.default_rng(2)
+    ks = rng.integers(0, 300, 6000).tolist()
+    vs = rng.integers(-50, 50, 6000).tolist()
+    for at in range(0, 6000, 500):
+        vals = ", ".join(
+            f"({k}, {v})"
+            for k, v in zip(ks[at : at + 500], vs[at : at + 500])
+        )
+        s.execute(f"INSERT INTO t VALUES {vals}")
+
+    sql = (
+        "SELECT k, count(*) AS c, sum(v) AS sv, min(v) AS mn "
+        "FROM t GROUP BY k ORDER BY k"
+    )
+    want, _ = s.execute(sql)
+    s.execute("SET batch_spill_threshold = 1000")
+    got, _ = s.execute(sql)
+    assert s.batch.last_spill_partitions > 1, "never spilled"
+    for nm in ("k", "c", "sv", "mn"):
+        assert list(got[nm]) == list(want[nm]), nm
+    # NULL agg outputs survive the spill path (all-NULL group)
+    s.execute("CREATE TABLE t2 (k BIGINT, v BIGINT)")
+    s.execute("INSERT INTO t2 VALUES (1, NULL), (1, NULL), (2, 5)")
+    s.execute("SET batch_spill_threshold = 1")
+    got, _ = s.execute(
+        "SELECT k, sum(v) AS sv FROM t2 GROUP BY k ORDER BY k"
+    )
+    assert list(got["k"]) == [1, 2]
+    assert list(got["sv"]) == [None, 5]

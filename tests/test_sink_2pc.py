@@ -1,0 +1,71 @@
+"""Coordinated 2PC sinks: exactly-once external delivery across every
+crash window (VERDICT r4 missing #10; reference:
+src/meta/src/manager/sink_coordination/)."""
+
+import pytest
+
+from risingwave_tpu.connectors.log_store import KvLogStore
+from risingwave_tpu.connectors.sink2pc import (
+    FileTwoPhaseSink,
+    SinkCoordinator,
+)
+from risingwave_tpu.storage.object_store import MemObjectStore
+
+pytestmark = pytest.mark.smoke
+
+
+def _mk(tmp_path):
+    log = KvLogStore(MemObjectStore(), "s1")
+    sink = FileTwoPhaseSink(str(tmp_path))
+    return log, sink, SinkCoordinator(log, sink)
+
+
+def _batch(epoch):
+    return [((epoch,), (epoch * 10,), 0)]
+
+
+def test_exactly_once_across_crash_windows(tmp_path):
+    log, sink, coord = _mk(tmp_path)
+    for e in (1, 2, 3):
+        log.append(e << 16, _batch(e))
+
+    # window A: crash AFTER prepare, BEFORE commit (epoch 1)
+    rows = log.read(1 << 16)
+    sink.prepare(rows, 1 << 16)
+    # "crash" -> recovery aborts staged epochs, replay re-runs
+    coord.recover()
+    coord.run_once(up_to=3 << 16)
+    assert sink.committed_epochs() == [1 << 16, 2 << 16, 3 << 16]
+    assert sink.read_committed(1 << 16) == [((1,), (10,), 0)]
+
+    # window B: crash AFTER commit, BEFORE offset advance (epoch 4)
+    log.append(4 << 16, _batch(4))
+    rows = log.read(4 << 16)
+    sink.prepare(rows, 4 << 16)
+    sink.commit_prepared(4 << 16)
+    # offset NOT advanced: a rerun must not duplicate the publish
+    coord.recover()
+    n = coord.run_once(up_to=4 << 16)
+    assert n == 1  # the replayed epoch delivers once
+    assert sink.committed_epochs().count(4 << 16) == 1
+    assert log.committed_offset() == 4 << 16
+
+    # idempotent rerun: nothing pending, nothing re-published
+    assert coord.run_once(up_to=4 << 16) == 0
+    assert sink.committed_epochs() == [
+        1 << 16, 2 << 16, 3 << 16, 4 << 16,
+    ]
+
+
+def test_rolled_back_epoch_never_published(tmp_path):
+    log, sink, coord = _mk(tmp_path)
+    log.append(1 << 16, _batch(1))
+    log.append(2 << 16, _batch(2))  # NOT durable yet
+    coord.run_once(up_to=1 << 16)  # durable frontier = epoch 1
+    assert sink.committed_epochs() == [1 << 16]
+    # epoch 2 rolls back; replay regenerates it with different content
+    log.discard_above(1 << 16)
+    log.append(2 << 16, [((9,), (99,), 0)])
+    coord.run_once(up_to=2 << 16)
+    assert sink.read_committed(2 << 16) == [((9,), (99,), 0)]
+    assert sink.committed_epochs() == [1 << 16, 2 << 16]

@@ -1,0 +1,69 @@
+"""UNION schema check at DDL time (VERDICT r4 weak #7) + heap
+profiling surface (missing component: heap profiling)."""
+
+import urllib.request
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.frontend.session import SqlSession
+from risingwave_tpu.sql import Catalog
+
+pytestmark = pytest.mark.smoke
+
+
+def test_union_schema_mismatch_raises_at_ddl():
+    from risingwave_tpu.executors.materialize import MaterializeExecutor
+    from risingwave_tpu.runtime import Pipeline, StreamingRuntime
+
+    rt = StreamingRuntime()
+    rt.register(
+        "u1", Pipeline([MaterializeExecutor(pk=("a",), columns=("b",),
+                                            table_id="u1.mv")])
+    )
+    rt.register(
+        "u2", Pipeline([MaterializeExecutor(pk=("a",), columns=("c",),
+                                            table_id="u2.mv")])
+    )
+    rt.register(
+        "sink", Pipeline([MaterializeExecutor(pk=("a",), columns=("b",),
+                                              table_id="sink.mv")])
+    )
+    rt.subscribe("u1", "sink", backfill=False)
+    with pytest.raises(ValueError, match="UNION inputs disagree"):
+        rt.subscribe("u2", "sink", backfill=False)
+    # same-schema second input is fine
+    rt.register(
+        "u3", Pipeline([MaterializeExecutor(pk=("a",), columns=("b",),
+                                            table_id="u3.mv")])
+    )
+    rt.subscribe("u3", "sink", backfill=False)
+
+
+def test_heap_endpoint_reports_device_state():
+    from risingwave_tpu import utils_heap
+    from risingwave_tpu.metrics import REGISTRY
+
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute("CREATE TABLE t (k BIGINT, v BIGINT)")
+    s.execute(
+        "CREATE MATERIALIZED VIEW hm AS SELECT k, count(*) AS c FROM t "
+        "GROUP BY k"
+    )
+    s.execute("INSERT INTO t VALUES (1, 2), (3, 4)")
+    utils_heap.start()
+    try:
+        blob = utils_heap.render()
+        assert "TOTAL device state" in blob
+        assert "HashAggExecutor" in blob
+        assert "host allocations" in blob
+        port = REGISTRY.serve(0)
+        try:
+            got = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/heap", timeout=10
+            ).read().decode()
+            assert "TOTAL device state" in got
+        finally:
+            REGISTRY.shutdown()
+    finally:
+        utils_heap.stop()
