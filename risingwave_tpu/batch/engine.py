@@ -357,7 +357,6 @@ class BatchQueryEngine:
 
         P_PARTS = 8
         key_cols = list(keys)  # already resolved column names
-        n = len(next(iter(cols.values())))
         # vectorized partition hash — this branch exists FOR large n
         part = (
             pd.util.hash_pandas_object(
@@ -365,8 +364,9 @@ class BatchQueryEngine:
             ).to_numpy()
             % P_PARTS
         )
-        # one object-boxing pass per column, not one per partition
-        obj_cols = {k: np.asarray(v, dtype=object) for k, v in cols.items()}
+        # native numeric lanes save/load as-is (dtype-stable results);
+        # only genuinely object lanes (None cells) stay boxed
+        obj_cols = {k: np.asarray(v) for k, v in cols.items()}
         tmpdir = tempfile.mkdtemp(prefix="rw_batch_spill_")
         self.last_spill_partitions = 0
         try:

@@ -1,0 +1,140 @@
+"""Header-dict <-> protobuf codec for the cluster wire.
+
+The in-process API stays the header dict (``{"type": ..., ...}``) so
+client/compute_node logic is codec-agnostic; this module maps those
+dicts onto the IDL in ``proto/stream_service.proto`` (the committed
+gencode is ``stream_service_pb2.py``). The JSON codec remains
+selectable for debugging (``RW_WIRE_CODEC=json``)."""
+
+from __future__ import annotations
+
+import json
+from typing import Dict
+
+from risingwave_tpu.cluster import stream_service_pb2 as pb
+
+_REQ_KINDS = ("ddl", "chunk", "barrier", "query", "status", "shutdown")
+
+# the EXACT header keys each frame type may carry: a key outside this
+# set would silently vanish on the proto wire while round-tripping
+# fine under the json debug codec — fail loudly instead
+_KNOWN_KEYS = {
+    "ddl": {"sql"},
+    "chunk": {"table", "capacity", "rows"},
+    "barrier": set(),
+    "query": {"sql"},
+    "status": {"committed"},
+    "shutdown": set(),
+    "ok": {"tag"},
+    "ack": {"permits"},
+    "barrier_complete": {"epoch", "committed"},
+    "barrier_failed": {"committed"},
+    "rows": {"tag", "data"},
+    "error": {"message"},
+}
+
+
+def encode_header(header: Dict) -> bytes:
+    kind = header["type"]
+    extra = set(header) - {"type"} - _KNOWN_KEYS.get(kind, set())
+    if extra:
+        raise ValueError(
+            f"frame {kind!r} carries keys {sorted(extra)} the wire IDL "
+            "does not map — extend proto/stream_service.proto first"
+        )
+    if kind == "status" and "committed" in header:
+        # the NAME collides between the status REQUEST (empty probe)
+        # and the node's status REPLY; the reply always carries its
+        # durable frontier
+        m = pb.Response()
+        m.node_status.committed = int(header["committed"])
+        return m.SerializeToString()
+    if kind in _REQ_KINDS:
+        m = pb.Request()
+        if kind == "ddl":
+            m.ddl.sql = header["sql"]
+        elif kind == "chunk":
+            m.chunk.table = header["table"]
+            m.chunk.capacity = int(header.get("capacity") or 0)
+            m.chunk.rows = int(header.get("rows") or 0)
+        elif kind == "barrier":
+            m.barrier.SetInParent()
+        elif kind == "query":
+            m.query.sql = header["sql"]
+        elif kind == "status":
+            m.status.SetInParent()
+        else:
+            m.shutdown.SetInParent()
+        return m.SerializeToString()
+    m = pb.Response()
+    if kind == "ok":
+        m.ok.tag = header.get("tag", "")
+    elif kind == "ack":
+        m.ack.permits = int(header.get("permits", 0))
+    elif kind == "barrier_complete":
+        m.barrier_complete.epoch = int(header.get("epoch", 0))
+        m.barrier_complete.committed = int(header.get("committed", 0))
+    elif kind == "barrier_failed":
+        m.barrier_failed.committed = int(header.get("committed", 0))
+    elif kind == "rows":
+        m.rows.tag = header.get("tag", "")
+        m.rows.json_rows = json.dumps(header.get("data", {}))
+    elif kind == "status":
+        m.node_status.committed = int(header.get("committed", 0))
+    elif kind == "error":
+        m.error.message = header.get("message", "")
+    else:
+        raise ValueError(f"unknown frame type {kind!r}")
+    return m.SerializeToString()
+
+
+def decode_header(raw: bytes) -> Dict:
+    # Requests and Responses share the wire; their oneof field numbers
+    # are DISJOINT (1-6 vs 11-17, see the .proto), so whichever parses
+    # with a populated oneof is the frame's true type — decoding needs
+    # no out-of-band direction
+    req = pb.Request()
+    req.ParseFromString(raw)
+    which = req.WhichOneof("req")
+    if which is not None:
+        if which == "ddl":
+            return {"type": "ddl", "sql": req.ddl.sql}
+        if which == "chunk":
+            return {
+                "type": "chunk",
+                "table": req.chunk.table,
+                "capacity": req.chunk.capacity or None,
+                "rows": req.chunk.rows,
+            }
+        if which == "query":
+            return {"type": "query", "sql": req.query.sql}
+        return {"type": which}
+    resp = pb.Response()
+    resp.ParseFromString(raw)
+    which = resp.WhichOneof("resp")
+    if which == "ok":
+        return {"type": "ok", "tag": resp.ok.tag}
+    if which == "ack":
+        return {"type": "ack", "permits": resp.ack.permits}
+    if which == "barrier_complete":
+        return {
+            "type": "barrier_complete",
+            "epoch": resp.barrier_complete.epoch,
+            "committed": resp.barrier_complete.committed,
+        }
+    if which == "barrier_failed":
+        return {
+            "type": "barrier_failed",
+            "committed": resp.barrier_failed.committed,
+        }
+    if which == "rows":
+        return {
+            "type": "rows",
+            "tag": resp.rows.tag,
+            "data": json.loads(resp.rows.json_rows),
+        }
+    if which == "node_status":
+        return {"type": "status", "committed": resp.node_status.committed}
+    if which == "error":
+        return {"type": "error", "message": resp.error.message}
+    raise ValueError("frame decodes to neither Request nor Response")

@@ -19,16 +19,35 @@ an unbounded socket buffer.
 from __future__ import annotations
 
 import json
+import os
 import socket
 import struct
 from typing import Optional, Tuple
 
-_HDR = struct.Struct("<II")
+_HDR = struct.Struct("<BII")  # codec byte + header len + payload len
+_CODEC_JSON = 0
+_CODEC_PROTO = 1
+
+
+def _default_codec() -> int:
+    # the IDL (proto/stream_service.proto) is the wire contract;
+    # RW_WIRE_CODEC=json keeps the debug-readable header form
+    return (
+        _CODEC_JSON
+        if os.environ.get("RW_WIRE_CODEC", "proto") == "json"
+        else _CODEC_PROTO
+    )
 
 
 def send_frame(sock: socket.socket, header: dict, payload: bytes = b"") -> None:
-    h = json.dumps(header).encode()
-    sock.sendall(_HDR.pack(len(h), len(payload)) + h + payload)
+    codec = _default_codec()
+    if codec == _CODEC_PROTO:
+        from risingwave_tpu.cluster.proto_codec import encode_header
+
+        h = encode_header(header)
+    else:
+        h = json.dumps(header).encode()
+    sock.sendall(_HDR.pack(codec, len(h), len(payload)) + h + payload)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -42,8 +61,14 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def recv_frame(sock: socket.socket) -> Tuple[dict, bytes]:
-    hlen, plen = _HDR.unpack(_recv_exact(sock, _HDR.size))
-    header = json.loads(_recv_exact(sock, hlen))
+    codec, hlen, plen = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    raw = _recv_exact(sock, hlen)
+    if codec == _CODEC_PROTO:
+        from risingwave_tpu.cluster.proto_codec import decode_header
+
+        header = decode_header(raw)
+    else:
+        header = json.loads(raw)
     payload = _recv_exact(sock, plen) if plen else b""
     return header, payload
 

@@ -210,25 +210,43 @@ class StreamingRuntime:
         # a second upstream feeding the same (fragment, side) must
         # expose the same lane set, or the mismatch would surface deep
         # inside a kernel long after DDL time
-        try:
-            new_mv = self._fragment_mview(upstream)
-        except ValueError:
-            new_mv = None  # no materialize stage: nothing to compare
-        if new_mv is not None:
-            new_sig = set(new_mv.pk) | set(new_mv.columns)
+        def _mv_sig(frag):
+            try:
+                mv = self._fragment_mview(frag)
+            except ValueError:
+                return None  # no materialize stage: nothing to compare
+            dts = (
+                getattr(mv, "schema_dtypes", None)
+                or getattr(mv, "dtypes", None)
+                or getattr(mv, "_dtypes", {})
+                or {}
+            )
+            return {
+                n: (str(dts[n]) if n in dts else None)
+                for n in tuple(mv.pk) + tuple(mv.columns)
+            }
+
+        new_sig = _mv_sig(upstream)
+        if new_sig is not None:
             for prev_up, edges in self._subs.items():
                 if prev_up == upstream or (name, side) not in edges:
                     continue
-                try:
-                    prev_mv = self._fragment_mview(prev_up)
-                except ValueError:
+                prev_sig = _mv_sig(prev_up)
+                if prev_sig is None:
                     continue
-                prev_sig = set(prev_mv.pk) | set(prev_mv.columns)
-                if prev_sig != new_sig:
+                mismatch = set(prev_sig) != set(new_sig) or any(
+                    # dtypes compare only where BOTH sides know them
+                    # (host MVs learn dtypes from their first chunk)
+                    a is not None and b is not None and a != b
+                    for a, b in (
+                        (new_sig[n], prev_sig[n]) for n in new_sig
+                    )
+                )
+                if mismatch:
                     raise ValueError(
                         f"UNION inputs disagree on schema: {upstream!r} "
-                        f"exposes {sorted(new_sig)} but {prev_up!r} "
-                        f"exposes {sorted(prev_sig)}"
+                        f"exposes {sorted(new_sig.items())} but "
+                        f"{prev_up!r} exposes {sorted(prev_sig.items())}"
                     )
         self._subs.setdefault(upstream, []).append((name, side))
         if backfill:
