@@ -79,8 +79,6 @@ def encode_header(header: Dict) -> bytes:
     elif kind == "rows":
         m.rows.tag = header.get("tag", "")
         m.rows.json_rows = json.dumps(header.get("data", {}))
-    elif kind == "status":
-        m.node_status.committed = int(header.get("committed", 0))
     elif kind == "error":
         m.error.message = header.get("message", "")
     else:

@@ -215,12 +215,11 @@ class StreamingRuntime:
                 mv = self._fragment_mview(frag)
             except ValueError:
                 return None  # no materialize stage: nothing to compare
-            dts = (
-                getattr(mv, "schema_dtypes", None)
-                or getattr(mv, "dtypes", None)
-                or getattr(mv, "_dtypes", {})
-                or {}
-            )
+            dts = getattr(mv, "dtypes", None)  # device MVs
+            if not isinstance(dts, dict):
+                dts = getattr(mv, "_dtypes", None)  # host MVs (lazy)
+            if not isinstance(dts, dict):
+                dts = {}
             return {
                 n: (str(dts[n]) if n in dts else None)
                 for n in tuple(mv.pk) + tuple(mv.columns)

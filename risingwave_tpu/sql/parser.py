@@ -93,6 +93,26 @@ class SubQuery:
 
 
 @dataclass(frozen=True)
+class Exists:
+    """EXISTS (SELECT ... [WHERE corr]) — decorrelated into a left-semi
+    (NOT EXISTS: left-anti) join (binder/expr/subquery.rs Exists)."""
+
+    select: "Select"
+
+
+@dataclass(frozen=True)
+class InSubquery:
+    """<expr> [NOT] IN (SELECT col FROM ...) — decorrelated into a
+    left-semi/anti join on expr = col. NOT IN assumes the subquery
+    column is non-NULL (three-valued NOT IN semantics with NULLs are
+    not modeled — the reference warns the same way)."""
+
+    expr: object
+    select: "Select"
+    negated: bool = False
+
+
+@dataclass(frozen=True)
 class ScalarSubQuery:
     """(SELECT <scalar agg expr> FROM t [WHERE corr]) used as an
     expression (reference: binder/expr/subquery.rs:22). The planner
@@ -648,13 +668,27 @@ class Parser:
             self.expect("kw", "and")
             hi = self.add_expr()
             return FuncCall("between", (e, lo, hi))
+        negated = False
+        if (
+            self.peek().kind == "kw"
+            and self.peek().value == "not"
+            and self.toks[self.i + 1].kind == "kw"
+            and self.toks[self.i + 1].value == "in"
+        ):
+            self.next()  # NOT (only as a prefix of IN here)
+            negated = True
         if self.accept("kw", "in"):
             self.expect("op", "(")
+            if self.peek().kind == "kw" and self.peek().value == "select":
+                sub = self.select()
+                self.expect("op", ")")
+                return InSubquery(e, sub, negated)
             vals = [self.expr()]
             while self.accept("op", ","):
                 vals.append(self.expr())
             self.expect("op", ")")
-            return FuncCall("in", (e, *vals))
+            inlist = FuncCall("in", (e, *vals))
+            return UnaryOp("not", inlist) if negated else inlist
         return e
 
     def add_expr(self):
@@ -715,6 +749,18 @@ class Parser:
             return e
         if t.kind == "ident":
             self.next()
+            if t.value == "exists" and (
+                self.peek().kind == "op" and self.peek().value == "("
+            ):
+                # EXISTS (SELECT ...) — only the subquery form; a
+                # function named exists() would shadow it, none exists
+                save = self.i
+                self.next()  # (
+                if self.peek().kind == "kw" and self.peek().value == "select":
+                    sub = self.select()
+                    self.expect("op", ")")
+                    return Exists(sub)
+                self.i = save
             if self.accept("op", "("):
                 if t.value == "extract":
                     # EXTRACT(FIELD FROM expr) — pg special form

@@ -223,6 +223,12 @@ def compile_scalar(ast, binder: Binder) -> E.Expr:
                 ast.name, tuple(compile_scalar(a, binder) for a in ast.args)
             )
         raise ValueError(f"unknown function {ast.name!r}")
+    if isinstance(ast, (P.Exists, P.InSubquery)):
+        raise NotImplementedError(
+            "EXISTS/IN subqueries are decorrelated only in the TOP-"
+            "level WHERE — lift the enclosing derived table into its "
+            "own MV (MV-on-MV) to use one inside"
+        )
     raise TypeError(f"cannot compile {ast!r}")
 
 
@@ -1155,10 +1161,10 @@ class StreamPlanner:
         fragments an n-way join into a tree of 2-way StreamHashJoins
         (optimizer on e2e_test/tpch q3); here the tree edges are the
         runtime's subscription edges."""
-        if jast.join_type != "inner":
+        if jast.join_type not in ("inner", "left_semi", "left_anti"):
             raise ValueError(
-                "only INNER nested joins lower to MV trees (outer/"
-                "semi nesting unsupported)"
+                "only INNER/SEMI/ANTI nested joins lower to MV trees "
+                "(outer nesting unsupported)"
             )
         inner_name = f"{name}__j{len(aux)}"
         # discover the inner result's visible columns + qualifiers with
@@ -1172,7 +1178,10 @@ class StreamPlanner:
             else:
                 sides.append(j)
 
-        flat(jast)
+        if jast.join_type in ("left_semi", "left_anti"):
+            flat(jast.left)  # semi/anti joins emit LEFT columns only
+        else:
+            flat(jast)
         tmp = StreamPlanner(self.catalog, capacity=self.capacity)
         cols: List[str] = []
         quals: set = set()
@@ -1471,6 +1480,43 @@ class StreamPlanner:
         changed = False
         flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "<>": "<>"}
         for c in conjs:
+            # EXISTS / NOT EXISTS / IN / NOT IN -> left-semi/anti join
+            # (binder/expr/subquery.rs Exists + InSubquery rewrites)
+            exists = c if isinstance(c, P.Exists) else None
+            anti = False
+            if (
+                isinstance(c, P.UnaryOp)
+                and c.op == "not"
+                and isinstance(c.operand, P.Exists)
+            ):
+                exists, anti = c.operand, True
+            if exists is not None:
+                new_from = self._semi_anti_join(
+                    new_from, exists.select, sq_i, anti, in_expr=None
+                )
+                sq_i += 1
+                changed = True
+                continue
+            insub, neg = (
+                (c, False)
+                if isinstance(c, P.InSubquery)
+                else (c.operand, True)
+                if isinstance(c, P.UnaryOp)
+                and c.op == "not"
+                and isinstance(c.operand, P.InSubquery)
+                else (None, False)
+            )
+            if insub is not None:
+                new_from = self._semi_anti_join(
+                    new_from,
+                    insub.select,
+                    sq_i,
+                    insub.negated ^ neg,
+                    in_expr=insub.expr,
+                )
+                sq_i += 1
+                changed = True
+                continue
             sub = None
             if isinstance(c, P.BinaryOp) and c.op in flip:
                 if isinstance(c.right, P.ScalarSubQuery) and isinstance(
@@ -1492,10 +1538,112 @@ class StreamPlanner:
             changed = True
         if not changed:
             return select
-        where = out_conjs[0]
-        for c in out_conjs[1:]:
-            where = P.BinaryOp("and", where, c)
+        where = None
+        for c in out_conjs:
+            where = c if where is None else P.BinaryOp("and", where, c)
         return _dc.replace(select, from_=new_from, where=where)
+
+    def _as_subquery_rel(self, rel):
+        """Bare-table outer FROM -> SELECT * derived table (the join
+        planner requires subquery sides with explicit columns)."""
+        if isinstance(rel, P.TableRef) and rel.name in self.catalog.tables:
+            cols = tuple(
+                P.SelectItem(P.Ident(c), None)
+                for c in self.catalog.schema_dtypes(rel.name)
+            )
+            return P.SubQuery(
+                P.Select(
+                    items=cols, from_=rel, where=None, group_by=()
+                ),
+                rel.alias or rel.name,
+            )
+        return rel
+
+    def _semi_anti_join(
+        self, from_, sub: P.Select, i: int, anti: bool, in_expr
+    ):
+        """EXISTS/IN subquery -> a left_semi (negated: left_anti) join
+        against a hidden derived table projecting the matching key.
+
+        - EXISTS: the subquery's WHERE must carry one ``t.key = outer``
+          equality (the correlation); residual conjuncts stay inside.
+        - IN: the subquery's single item is the matching column;
+          correlation equalities are also honored when present.
+        """
+        if not isinstance(sub.from_, P.TableRef):
+            raise ValueError(
+                "EXISTS/IN subquery FROM must be a plain table / MV name"
+            )
+        if sub.group_by:
+            raise ValueError("EXISTS/IN subquery cannot GROUP BY")
+        tname = sub.from_.name
+        talias = sub.from_.alias or tname
+        tcols = set(self.catalog.schema_dtypes(tname))
+        # split correlation equalities out of the subquery's WHERE
+        corr: List[Tuple[str, P.Ident]] = []
+        rest: List[object] = []
+        for cj in _split_and(sub.where) if sub.where is not None else []:
+            picked = False
+            if (
+                isinstance(cj, P.BinaryOp)
+                and cj.op == "="
+                and isinstance(cj.left, P.Ident)
+                and isinstance(cj.right, P.Ident)
+            ):
+                a, b = cj.left, cj.right
+                a_in = a.name in tcols and a.qualifier in (None, talias)
+                b_in = b.name in tcols and b.qualifier in (None, talias)
+                if a_in and not b_in:
+                    corr.append((a.name, b))
+                    picked = True
+                elif b_in and not a_in:
+                    corr.append((b.name, a))
+                    picked = True
+            if not picked:
+                rest.append(cj)
+        alias = f"__sq{i}"
+        items: List[P.SelectItem] = []
+        on = None
+        if in_expr is not None:
+            if len(sub.items) != 1:
+                raise ValueError("IN subquery must select one column")
+            it = sub.items[0].expr
+            if not isinstance(it, P.Ident):
+                raise ValueError("IN subquery item must be a bare column")
+            if not isinstance(in_expr, P.Ident):
+                raise ValueError(
+                    "IN lhs must be a bare column (project first)"
+                )
+            items.append(P.SelectItem(it, f"sq{i}ink"))
+            on = P.BinaryOp(
+                "=", P.Ident(f"sq{i}ink", alias), in_expr
+            )
+        elif not corr:
+            raise ValueError(
+                "EXISTS subquery must correlate on at least one "
+                "t.key = outer column equality"
+            )
+        for j, (inner_key, outer_ident) in enumerate(corr):
+            out = f"sq{i}ck{j}"
+            items.append(P.SelectItem(P.Ident(inner_key), out))
+            eq = P.BinaryOp("=", P.Ident(out, alias), outer_ident)
+            on = eq if on is None else P.BinaryOp("and", on, eq)
+        where = None
+        for cj in rest:
+            where = cj if where is None else P.BinaryOp("and", where, cj)
+        sq = P.SubQuery(
+            P.Select(
+                items=tuple(items), from_=sub.from_, where=where,
+                group_by=(),
+            ),
+            alias,
+        )
+        return P.Join(
+            left=self._as_subquery_rel(from_),
+            right=sq,
+            on=on,
+            join_type="left_anti" if anti else "left_semi",
+        )
 
     def _decorrelate_one(self, from_, outer_e, op, sub: P.Select, i: int):
         from fractions import Fraction

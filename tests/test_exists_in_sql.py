@@ -1,0 +1,126 @@
+"""EXISTS / NOT EXISTS / IN / NOT IN subqueries from SQL (VERDICT r4
+missing #3 remainder): decorrelated into left-semi/anti joins
+(binder/expr/subquery.rs), maintained with retractions."""
+
+import pytest
+
+from risingwave_tpu.frontend.session import SqlSession
+from risingwave_tpu.sql import Catalog
+
+pytestmark = pytest.mark.smoke
+
+
+def _s():
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute("CREATE TABLE orders (oid BIGINT, cust BIGINT, amt BIGINT)")
+    s.execute("CREATE TABLE vips (vid BIGINT)")
+    return s
+
+
+def test_exists_semi_join():
+    s = _s()
+    s.execute(
+        "CREATE MATERIALIZED VIEW vo AS SELECT oid, amt FROM orders "
+        "WHERE EXISTS (SELECT vid FROM vips WHERE vips.vid = orders.cust)"
+    )
+    s.execute("INSERT INTO orders VALUES (1, 10, 100), (2, 11, 200)")
+    s.execute("INSERT INTO vips VALUES (10)")
+    out, _ = s.execute("SELECT oid, amt FROM vo ORDER BY oid")
+    assert list(out["oid"]) == [1]
+    # a NEW vip retroactively admits order 2 (semi-join maintenance)
+    s.execute("INSERT INTO vips VALUES (11)")
+    out, _ = s.execute("SELECT oid, amt FROM vo ORDER BY oid")
+    assert list(out["oid"]) == [1, 2]
+
+
+def test_not_exists_anti_join():
+    s = _s()
+    s.execute(
+        "CREATE MATERIALIZED VIEW nv AS SELECT oid FROM orders "
+        "WHERE NOT EXISTS (SELECT vid FROM vips WHERE vips.vid = orders.cust)"
+    )
+    s.execute("INSERT INTO orders VALUES (1, 10, 100), (2, 11, 200)")
+    s.execute("INSERT INTO vips VALUES (10)")
+    out, _ = s.execute("SELECT oid FROM nv ORDER BY oid")
+    assert list(out["oid"]) == [2]
+    # order 2's cust becomes a vip -> RETRACTED from the anti join
+    s.execute("INSERT INTO vips VALUES (11)")
+    out, _ = s.execute("SELECT oid FROM nv ORDER BY oid")
+    assert list(out["oid"]) == []
+
+
+def test_in_and_not_in_subquery():
+    s = _s()
+    s.execute(
+        "CREATE MATERIALIZED VIEW iv AS SELECT oid FROM orders "
+        "WHERE cust IN (SELECT vid FROM vips)"
+    )
+    s.execute(
+        "CREATE MATERIALIZED VIEW niv AS SELECT oid FROM orders "
+        "WHERE cust NOT IN (SELECT vid FROM vips)"
+    )
+    s.execute("INSERT INTO orders VALUES (1, 10, 100), (2, 11, 200)")
+    s.execute("INSERT INTO vips VALUES (10)")
+    out, _ = s.execute("SELECT oid FROM iv ORDER BY oid")
+    assert list(out["oid"]) == [1]
+    out, _ = s.execute("SELECT oid FROM niv ORDER BY oid")
+    assert list(out["oid"]) == [2]
+
+
+def test_exists_with_residual_predicate():
+    s = _s()
+    s.execute(
+        "CREATE MATERIALIZED VIEW big AS SELECT oid FROM orders "
+        "WHERE amt > 150 AND EXISTS "
+        "(SELECT vid FROM vips WHERE vips.vid = orders.cust AND vid > 5)"
+    )
+    s.execute(
+        "INSERT INTO orders VALUES (1, 10, 100), (2, 10, 900), (3, 3, 900)"
+    )
+    s.execute("INSERT INTO vips VALUES (10), (3)")
+    out, _ = s.execute("SELECT oid FROM big ORDER BY oid")
+    # oid 1 fails amt, oid 3's vip fails vid > 5
+    assert list(out["oid"]) == [2]
+
+
+def test_not_in_value_list_still_works():
+    s = _s()
+    s.execute("INSERT INTO orders VALUES (1, 10, 100), (2, 11, 200)")
+    out, _ = s.execute(
+        "SELECT oid FROM orders WHERE cust NOT IN (11, 12) ORDER BY oid"
+    )
+    assert list(out["oid"]) == [1]
+
+
+def test_prefix_not_in_subquery():
+    s = _s()
+    s.execute(
+        "CREATE MATERIALIZED VIEW pni AS SELECT oid FROM orders "
+        "WHERE NOT cust IN (SELECT vid FROM vips)"
+    )
+    s.execute("INSERT INTO orders VALUES (1, 10, 100), (2, 11, 200)")
+    s.execute("INSERT INTO vips VALUES (10)")
+    out, _ = s.execute("SELECT oid FROM pni ORDER BY oid")
+    assert list(out["oid"]) == [2]
+
+
+def test_two_exists_conjuncts_chain_semi_joins():
+    """TPC-H q21 shape: multiple EXISTS predicates chain as nested
+    semi joins lowered through hidden MVs."""
+    s = _s()
+    s.execute("CREATE TABLE bans (bid BIGINT)")
+    s.execute(
+        "CREATE MATERIALIZED VIEW both2 AS SELECT oid FROM orders "
+        "WHERE EXISTS (SELECT vid FROM vips WHERE vips.vid = orders.cust) "
+        "AND EXISTS (SELECT bid FROM bans WHERE bans.bid = orders.cust)"
+    )
+    s.execute(
+        "INSERT INTO orders VALUES (1, 10, 100), (2, 11, 200), (3, 12, 300)"
+    )
+    s.execute("INSERT INTO vips VALUES (10), (11)")
+    s.execute("INSERT INTO bans VALUES (11), (12)")
+    out, _ = s.execute("SELECT oid FROM both2 ORDER BY oid")
+    assert list(out["oid"]) == [2]  # cust 11 is both vip and banned
+    s.execute("INSERT INTO bans VALUES (10)")
+    out, _ = s.execute("SELECT oid FROM both2 ORDER BY oid")
+    assert list(out["oid"]) == [1, 2]
