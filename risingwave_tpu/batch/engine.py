@@ -212,23 +212,45 @@ class BatchQueryEngine:
             out = {k: v[: stmt.limit] for k, v in out.items()}
         return out
 
+    @staticmethod
+    def _join_quals(rel) -> set:
+        """Every alias addressable inside a (possibly nested) join."""
+        if isinstance(rel, P.Join):
+            return BatchQueryEngine._join_quals(
+                rel.left
+            ) | BatchQueryEngine._join_quals(rel.right)
+        return {rel.alias or rel.name}
+
     def _join_scan(self, join: P.Join) -> Dict[str, np.ndarray]:
-        """Two-way batch join over MV scans (reference: the batch
-        HashJoinExecutor, src/batch/src/executor/join/). Column names
-        must be disjoint across sides (alias/rename upstream); outer
-        joins surface missing ints as NaN-capable float lanes."""
+        """Batch join over MV scans (reference: the batch
+        HashJoinExecutor, src/batch/src/executor/join/), LEFT-DEEP
+        multi-way: a nested left join evaluates recursively and its
+        result becomes the probe side (the same tree shape the
+        streaming planner lowers to). Column names must be disjoint
+        across sides (alias/rename upstream); outer joins surface
+        missing ints as NaN-capable float lanes."""
         import pandas as pd
 
-        if isinstance(join.left, P.Join):
-            raise ValueError("multi-way batch joins not supported yet")
+        if isinstance(join.right, P.Join):
+            raise ValueError(
+                "batch joins are left-deep: nest on the left side"
+            )
 
         def side(rel):
             if not isinstance(rel, P.TableRef):
                 raise ValueError("batch join sides must be MV names")
-            return rel.alias or rel.name, pd.DataFrame(
-                self.tables[rel.name].to_numpy()
-            )
-        lname, ldf = side(join.left)
+            df = pd.DataFrame(self.tables[rel.name].to_numpy())
+            # hidden planner lanes (_row_id) are not addressable in
+            # batch SQL and would collide across sides
+            df = df[[c for c in df.columns if not c.startswith("_")]]
+            return rel.alias or rel.name, df
+
+        if isinstance(join.left, P.Join):
+            ldf = pd.DataFrame(self._join_scan(join.left))
+            lquals = self._join_quals(join.left)
+        else:
+            lname, ldf = side(join.left)
+            lquals = {lname}
         rname, rdf = side(join.right)
         overlap = set(ldf.columns) & set(rdf.columns)
         if overlap:
@@ -239,7 +261,7 @@ class BatchQueryEngine:
         pairs = []
 
         def resolve(ident: P.Ident) -> str:
-            if ident.qualifier == lname and ident.name in ldf.columns:
+            if ident.qualifier in lquals and ident.name in ldf.columns:
                 return ident.name
             if ident.qualifier == rname and ident.name in rdf.columns:
                 return ident.name

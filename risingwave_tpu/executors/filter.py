@@ -17,12 +17,15 @@ import jax.numpy as jnp
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Executor
 from risingwave_tpu.expr import Expr
+from risingwave_tpu.expr.expr import StaticTree
 from risingwave_tpu.types import Op
 
 
 @partial(jax.jit, static_argnames=("pred",))
-def _filter_step(chunk: StreamChunk, pred: Expr) -> StreamChunk:
-    keep_v, keep_n = pred.eval(chunk)
+def _filter_step(chunk: StreamChunk, pred: "StaticTree") -> StreamChunk:
+    # pred rides as a STRUCTURALLY-keyed static: a bare Expr static
+    # collides in the jit cache (Expr.__eq__ builds a truthy BinOp)
+    keep_v, keep_n = pred.value.eval(chunk)
     keep = keep_v.astype(jnp.bool_)
     if keep_n is not None:
         keep = keep & ~keep_n  # NULL predicate drops the row (SQL WHERE)
@@ -47,10 +50,11 @@ def _filter_step(chunk: StreamChunk, pred: Expr) -> StreamChunk:
 
 class FilterExecutor(Executor):
     def __init__(self, pred: Expr):
+        self._spred = StaticTree(pred)
         self.pred = pred
 
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
-        return [_filter_step(chunk, self.pred)]
+        return [_filter_step(chunk, self._spred)]
 
     def pure_step(self):
-        return partial(_filter_step, pred=self.pred)
+        return partial(_filter_step, pred=self._spred)

@@ -15,14 +15,17 @@ import jax
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Executor
 from risingwave_tpu.expr import Expr
+from risingwave_tpu.expr.expr import StaticTree
 
 
 @partial(jax.jit, static_argnames=("outputs",))
 def _project_step(
-    chunk: StreamChunk, outputs: Tuple[Tuple[str, Expr], ...]
+    chunk: StreamChunk, outputs: "StaticTree"
 ) -> StreamChunk:
+    # outputs ride as a STRUCTURALLY-keyed static: bare Expr tuples
+    # collide in the jit cache (Expr.__eq__ builds a truthy BinOp)
     cols, nulls = {}, {}
-    for name, expr in outputs:
+    for name, expr in outputs.value:
         v, n = expr.eval(chunk)
         cols[name] = v
         if n is not None:
@@ -35,9 +38,10 @@ class ProjectExecutor(Executor):
 
     def __init__(self, outputs: Dict[str, Expr]):
         self.outputs = tuple(outputs.items())
+        self._souts = StaticTree(self.outputs)
 
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
-        return [_project_step(chunk, self.outputs)]
+        return [_project_step(chunk, self._souts)]
 
     def pure_step(self):
-        return partial(_project_step, outputs=self.outputs)
+        return partial(_project_step, outputs=self._souts)

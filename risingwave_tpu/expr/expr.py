@@ -86,6 +86,54 @@ class Expr:
     __hash__ = object.__hash__  # __eq__ override would otherwise kill it
 
 
+def structural_key(v) -> tuple:
+    """Hashable STRUCTURAL identity of an expression tree.
+
+    ``Expr.__eq__`` is operator sugar — ``a == b`` BUILDS ``BinOp``
+    (always truthy) — so Exprs must never be compared with ``==`` for
+    caching. In particular, passing a bare Expr (or a container of
+    them) as a jit static argument silently collides different
+    predicates in the compilation cache: the fastpath confirms a probe
+    with ``==``, the truthy BinOp reads as "equal", and a second
+    filter reuses the first predicate's kernel (observed: two MVs with
+    different WHERE clauses returning identical rows). Wrap statics in
+    ``StaticTree`` instead."""
+    import dataclasses as _dc
+
+    if isinstance(v, Expr):
+        return (type(v).__name__,) + tuple(
+            structural_key(getattr(v, f.name)) for f in _dc.fields(v)
+        )
+    if isinstance(v, (tuple, list)):
+        return ("#seq",) + tuple(structural_key(x) for x in v)
+    if isinstance(v, dict):
+        return ("#map",) + tuple(
+            (structural_key(k), structural_key(x))
+            for k, x in sorted(v.items(), key=lambda kv: repr(kv[0]))
+        )
+    return ("#leaf", type(v).__name__, v)
+
+
+class StaticTree:
+    """jit-static wrapper giving an Expr-bearing value structural
+    eq/hash (see structural_key)."""
+
+    __slots__ = ("value", "_key")
+
+    def __init__(self, value):
+        self.value = value
+        self._key = structural_key(value)
+
+    def __hash__(self):
+        return hash(self._key)
+
+    def __eq__(self, other):
+        return isinstance(other, StaticTree) and self._key == other._key
+
+    def __ne__(self, other):
+        return not self.__eq__(other)
+
+
 def _wrap(v) -> "Expr":
     return v if isinstance(v, Expr) else Lit(v)
 

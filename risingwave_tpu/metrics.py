@@ -125,6 +125,61 @@ class MetricsRegistry:
                 lines.append(f"{base}_sum {sum(obs)}")
         return "\n".join(lines) + "\n"
 
+    def render_dashboard(self) -> str:
+        """One self-contained HTML ops page (the reference ships a
+        React dashboard from the meta node; this collapses the same
+        surfaces — fragments, state sizes, barrier health, recovery
+        counters — into a static render per request)."""
+        from html import escape
+
+        from risingwave_tpu import utils_heap
+
+        rows = []
+        rt = utils_heap._runtime_ref() if utils_heap._runtime_ref else None
+        frag_rows = ""
+        if rt is not None:
+            for name in sorted(getattr(rt, "fragments", {})):
+                subs = [
+                    f"{d}({s})"
+                    for d, s in getattr(rt, "_subs", {}).get(name, ())
+                ]
+                frag_rows += (
+                    f"<tr><td>{escape(name)}</td>"
+                    f"<td>{escape(', '.join(subs) or '-')}</td></tr>"
+                )
+            stats = [
+                ("epoch", getattr(rt, "_epoch", 0)),
+                (
+                    "committed epoch",
+                    rt.mgr.max_committed_epoch if rt.mgr else 0,
+                ),
+                ("auto recoveries", getattr(rt, "auto_recoveries", 0)),
+                ("p99 barrier ms", round(rt.p99_barrier_ms(), 2)),
+                (
+                    "p99 checkpoint sync ms",
+                    round(rt.p99_checkpoint_sync_ms(), 2),
+                ),
+            ]
+            rows += [
+                f"<tr><td>{escape(str(k))}</td><td>{v}</td></tr>"
+                for k, v in stats
+            ]
+        state_rows = "".join(
+            f"<tr><td>{escape(d['executor'])}</td>"
+            f"<td>{escape(str(d['table_id']))}</td>"
+            f"<td style='text-align:right'>{d['bytes']:,}</td></tr>"
+            for d in utils_heap.device_state()[:40]
+        )
+        return f"""<!doctype html><html><head><title>risingwave_tpu</title>
+<style>body{{font-family:monospace;margin:2em}}table{{border-collapse:collapse;margin:1em 0}}
+td,th{{border:1px solid #999;padding:2px 8px}}h2{{margin-top:1.5em}}</style></head><body>
+<h1>risingwave_tpu dashboard</h1>
+<h2>runtime</h2><table>{''.join(rows) or '<tr><td>no runtime attached</td></tr>'}</table>
+<h2>fragments &rarr; subscribers</h2><table>{frag_rows or '<tr><td>none</td></tr>'}</table>
+<h2>device state (top 40)</h2><table><tr><th>executor</th><th>table</th><th>bytes</th></tr>{state_rows}</table>
+<p><a href="/metrics">/metrics</a> &middot; <a href="/heap">/heap</a></p>
+</body></html>"""
+
     def serve(self, port: int = 0) -> int:
         """Expose ``/metrics`` over HTTP (the prometheus scrape surface
         the reference serves from each node). Returns the bound port."""
@@ -147,7 +202,19 @@ class MetricsRegistry:
                     self.end_headers()
                     self.wfile.write(body)
                     return
-                if path not in ("", "/metrics"):
+                if path in ("", "/dashboard"):
+                    # the ops dashboard (reference: the meta dashboard
+                    # UI, collapsed to one self-contained page)
+                    body = registry.render_dashboard().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/html; charset=utf-8"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path != "/metrics":
                     self.send_response(404)
                     self.end_headers()
                     return

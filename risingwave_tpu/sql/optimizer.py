@@ -69,6 +69,8 @@ class LAggProject:
     order_by: Tuple = ()
     limit: Optional[int] = None
     grouping_sets: Tuple = ()
+    having: Optional[object] = None
+    distinct: bool = False
 
 
 @dataclass
@@ -98,6 +100,8 @@ def build(
         order_by=select.order_by,
         limit=select.limit,
         grouping_sets=select.grouping_sets,
+        having=select.having,
+        distinct=select.distinct,
     )
 
 
@@ -113,12 +117,6 @@ def _build_rel(rel, catalog=None):
             rel.slide_ms, rel.alias,
         )
     if isinstance(rel, P.SubQuery):
-        if rel.select.having is not None or rel.select.distinct:
-            # the IR has no slot for these yet: emit() would silently
-            # drop a derived table's HAVING/DISTINCT
-            raise NotImplementedError(
-                "HAVING/DISTINCT inside a derived table is not supported"
-            )
         return build(rel.select, alias=rel.alias, catalog=catalog)
     if isinstance(rel, P.Join):
         return LJoin(
@@ -569,6 +567,8 @@ def emit(node: LAggProject) -> P.Select:
         order_by=node.order_by,
         limit=node.limit,
         grouping_sets=node.grouping_sets,
+        having=node.having,
+        distinct=node.distinct,
     )
 
 

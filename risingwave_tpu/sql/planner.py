@@ -246,6 +246,13 @@ def _contains_agg(ast) -> bool:
     return False
 
 
+def _and_all(conjuncts):
+    out = None
+    for c in conjuncts:
+        out = c if out is None else P.BinaryOp("and", out, c)
+    return out
+
+
 def _split_and(e) -> List[object]:
     """Flatten AND-ed conjuncts."""
     if isinstance(e, P.BinaryOp) and e.op == "and":
@@ -311,25 +318,7 @@ class StreamPlanner:
             select, self.catalog, getattr(self, "strings", None)
         )
         select = optimize_select(select, catalog=self.catalog)
-        if select.distinct:
-            # SELECT DISTINCT a, b == GROUP BY a, b with no aggregates
-            # (the reference planner's rewrite)
-            import dataclasses
-
-            if select.group_by or any(_is_agg(it.expr) for it in select.items):
-                raise NotImplementedError(
-                    "DISTINCT with GROUP BY/aggregates is not supported"
-                )
-            for it in select.items:
-                if not isinstance(it.expr, P.Ident):
-                    raise NotImplementedError(
-                        "SELECT DISTINCT items must be bare columns"
-                    )
-            select = dataclasses.replace(
-                select,
-                group_by=tuple(it.expr for it in select.items),
-                distinct=False,
-            )
+        select = self._rewrite_distinct(select)
         if select.having is not None and not select.group_by:
             raise ValueError("HAVING requires GROUP BY")
         if isinstance(select.from_, P.Join):
@@ -340,6 +329,30 @@ class StreamPlanner:
                 return dj
             return self._plan_join(name, select)
         return self._plan_single(name, select)
+
+    @staticmethod
+    def _rewrite_distinct(select: P.Select) -> P.Select:
+        """SELECT DISTINCT a, b == GROUP BY a, b with no aggregates
+        (the reference planner's rewrite) — applied at every nesting
+        level (derived tables included)."""
+        if not select.distinct:
+            return select
+        import dataclasses
+
+        if select.group_by or any(_is_agg(it.expr) for it in select.items):
+            raise NotImplementedError(
+                "DISTINCT with GROUP BY/aggregates is not supported"
+            )
+        for it in select.items:
+            if not isinstance(it.expr, P.Ident):
+                raise NotImplementedError(
+                    "SELECT DISTINCT items must be bare columns"
+                )
+        return dataclasses.replace(
+            select,
+            group_by=tuple(it.expr for it in select.items),
+            distinct=False,
+        )
 
     # -- single-input ----------------------------------------------------
     def _plan_single(self, name: str, select: P.Select) -> PlannedMV:
@@ -436,6 +449,9 @@ class StreamPlanner:
         """Plan one select over a single (possibly windowed) input.
         ``pre`` overrides FROM processing with an already-bound input
         (the temporal-join path enriches the stream first)."""
+        select = self._rewrite_distinct(select)
+        if select.having is not None and not select.group_by:
+            raise ValueError("HAVING requires GROUP BY")
         bound = pre if pre is not None else self._from_bound(name, select.from_)
         chain = bound.chain
         schema = bound.schema
@@ -1538,10 +1554,9 @@ class StreamPlanner:
             changed = True
         if not changed:
             return select
-        where = None
-        for c in out_conjs:
-            where = c if where is None else P.BinaryOp("and", where, c)
-        return _dc.replace(select, from_=new_from, where=where)
+        return _dc.replace(
+            select, from_=new_from, where=_and_all(out_conjs)
+        )
 
     def _as_subquery_rel(self, rel):
         """Bare-table outer FROM -> SELECT * derived table (the join
@@ -1628,9 +1643,7 @@ class StreamPlanner:
             items.append(P.SelectItem(P.Ident(inner_key), out))
             eq = P.BinaryOp("=", P.Ident(out, alias), outer_ident)
             on = eq if on is None else P.BinaryOp("and", on, eq)
-        where = None
-        for cj in rest:
-            where = cj if where is None else P.BinaryOp("and", where, cj)
+        where = _and_all(rest)
         sq = P.SubQuery(
             P.Select(
                 items=tuple(items), from_=sub.from_, where=where,
@@ -1727,11 +1740,7 @@ class StreamPlanner:
             items.append(
                 P.SelectItem(P.FuncCall(kind, (P.Ident(aggcol),)), sname)
             )
-        sq_where = None
-        for cj in rest:
-            sq_where = (
-                cj if sq_where is None else P.BinaryOp("and", sq_where, cj)
-            )
+        sq_where = _and_all(rest)
         sq_sel = P.Select(
             items=tuple(items),
             from_=sub.from_,

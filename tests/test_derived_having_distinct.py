@@ -1,0 +1,56 @@
+"""HAVING / DISTINCT inside derived tables + left-deep multi-way BATCH
+joins (VERDICT r4 weak #9 + layer-7 depth)."""
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.frontend.session import SqlSession
+from risingwave_tpu.sql import Catalog
+
+pytestmark = pytest.mark.smoke
+
+
+def test_having_inside_derived_table():
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute("CREATE TABLE t (k BIGINT, v BIGINT)")
+    s.execute(
+        "CREATE MATERIALIZED VIEW hot AS SELECT k2, c FROM "
+        "(SELECT k AS k2, count(*) AS c FROM t GROUP BY k HAVING "
+        "c > 1) AS g"
+    )
+    s.execute("INSERT INTO t VALUES (1, 0), (1, 0), (2, 0)")
+    out, _ = s.execute("SELECT k2, c FROM hot ORDER BY k2")
+    assert list(out["k2"]) == [1] and list(out["c"]) == [2]
+    s.execute("INSERT INTO t VALUES (2, 0)")
+    out, _ = s.execute("SELECT k2, c FROM hot ORDER BY k2")
+    assert list(out["k2"]) == [1, 2]
+
+
+def test_distinct_inside_derived_table():
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute("CREATE TABLE t (k BIGINT, v BIGINT)")
+    s.execute(
+        "CREATE MATERIALIZED VIEW dk AS SELECT k2, count(*) AS n FROM "
+        "(SELECT DISTINCT k AS k2 FROM t) AS d GROUP BY k2"
+    )
+    s.execute("INSERT INTO t VALUES (5, 1), (5, 2), (6, 3)")
+    out, _ = s.execute("SELECT k2, n FROM dk ORDER BY k2")
+    assert list(out["k2"]) == [5, 6]
+    assert list(out["n"]) == [1, 1]  # dedup before the count
+
+
+def test_batch_three_way_join():
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute("CREATE TABLE a (ak BIGINT, av BIGINT)")
+    s.execute("CREATE TABLE b (bk BIGINT, bv BIGINT)")
+    s.execute("CREATE TABLE c (ck BIGINT, cv BIGINT)")
+    s.execute("INSERT INTO a VALUES (1, 10), (2, 20)")
+    s.execute("INSERT INTO b VALUES (1, 100), (2, 200)")
+    s.execute("INSERT INTO c VALUES (1, 1000), (3, 3000)")
+    out, _ = s.execute(
+        "SELECT av, bv, cv FROM a JOIN b ON a.ak = b.bk "
+        "JOIN c ON b.bk = c.ck ORDER BY av"
+    )
+    assert list(out["av"]) == [10]
+    assert list(out["bv"]) == [100]
+    assert list(out["cv"]) == [1000]

@@ -67,3 +67,30 @@ def test_heap_endpoint_reports_device_state():
             REGISTRY.shutdown()
     finally:
         utils_heap.stop()
+
+
+def test_dashboard_page_renders():
+    from risingwave_tpu.metrics import REGISTRY
+
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute("CREATE TABLE d (k BIGINT)")
+    s.execute(
+        "CREATE MATERIALIZED VIEW dm AS SELECT k, count(*) AS c FROM d "
+        "GROUP BY k"
+    )
+    s.execute("INSERT INTO d VALUES (1), (2)")
+    port = REGISTRY.serve(0)
+    try:
+        page = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/", timeout=10
+        ).read().decode()
+        assert "risingwave_tpu dashboard" in page
+        assert "dm" in page  # the fragment appears
+        assert "committed epoch" in page
+        # /metrics still serves prometheus text
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ).read().decode()
+        assert "# TYPE" in body
+    finally:
+        REGISTRY.shutdown()
