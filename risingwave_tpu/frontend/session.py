@@ -210,6 +210,13 @@ class SqlSession:
                         f"batch_spill_threshold needs an integer or "
                         f"'off', got {val!r}"
                     )
+            elif var in ("barrier_interval_ms", "checkpoint_frequency"):
+                # cluster-mutable system params (the reference's ALTER
+                # SYSTEM SET surface, system_param/mod.rs:78): take
+                # effect at the next tick/barrier
+                if not val.isdigit() or int(val) <= 0:
+                    raise ValueError(f"{var} needs a positive integer")
+                setattr(self.runtime, var, int(val))
             else:
                 self.session_vars = getattr(self, "session_vars", {})
                 self.session_vars[var] = val

@@ -94,3 +94,15 @@ def test_dashboard_page_renders():
         assert "# TYPE" in body
     finally:
         REGISTRY.shutdown()
+
+
+def test_set_system_params_mutates_runtime():
+    """SET barrier_interval_ms / checkpoint_frequency are the cluster-
+    mutable system params (ALTER SYSTEM surface, system_param/mod.rs)."""
+    s = SqlSession(Catalog({}), capacity=1 << 8)
+    s.execute("SET barrier_interval_ms = 250")
+    s.execute("SET checkpoint_frequency = 4")
+    assert s.runtime.barrier_interval_ms == 250
+    assert s.runtime.checkpoint_frequency == 4
+    with pytest.raises(ValueError):
+        s.execute("SET barrier_interval_ms = nope")
