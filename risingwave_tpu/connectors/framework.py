@@ -175,6 +175,16 @@ class ChangeParser(Parser):
     def parse_changes(self, raw):
         raise NotImplementedError
 
+    @staticmethod
+    def _decode_obj(raw):
+        """bytes/str -> dict, None when undecodable (non-strict)."""
+        if isinstance(raw, (bytes, str)):
+            try:
+                raw = json.loads(raw)
+            except json.JSONDecodeError:
+                return None
+        return raw if isinstance(raw, dict) else None
+
 
 class DebeziumJsonParser(ChangeParser):
     """Debezium CDC envelope (reference: parser/debezium/ +
@@ -197,14 +207,8 @@ class DebeziumJsonParser(ChangeParser):
         self._rows = JsonParser(schema)
 
     def parse_changes(self, raw):
-        if isinstance(raw, (bytes, str)):
-            try:
-                obj = json.loads(raw)
-            except json.JSONDecodeError:
-                return []
-        else:
-            obj = raw
-        if not isinstance(obj, dict):
+        obj = self._decode_obj(raw)
+        if obj is None:
             return []
         payload = obj.get("payload", obj)
         if not isinstance(payload, dict):
@@ -227,6 +231,92 @@ class DebeziumJsonParser(ChangeParser):
             # pair would strand a stale row downstream
             return []
         return out
+
+
+class UpsertJsonParser(ChangeParser):
+    """Upsert-keyed JSON (reference: parser/ upsert_json + the Kafka
+    upsert model): each record is ``{"key": {...}, "value": {...}}``;
+    a NULL/absent value is a DELETE of the key (the key fields fill
+    the row, value fields NULL). Plain objects (no key envelope) fall
+    back to inserts.
+
+    CONTRACT (same as the reference's upsert sources, which REQUIRE a
+    PRIMARY KEY): the first consumer must be a pk-keyed materialize —
+    an upsert emits a plain INSERT with NO retraction of the prior
+    value (overwrite-by-pk resolves it), and a tombstone's value
+    columns are NULL. Feeding an aggregation directly would
+    double-count; ``requires_pk`` marks the contract for wiring."""
+
+    requires_pk = True
+
+    def __init__(self, schema: Schema):
+        super().__init__(schema)
+        self._rows = JsonParser(schema)
+
+    def parse_changes(self, raw):
+        obj = self._decode_obj(raw)
+        if obj is None:
+            return []
+        if "key" not in obj:
+            row = self._rows.parse(obj)
+            return [(int(Op.INSERT), row)] if row is not None else []
+        key = obj.get("key")
+        val = obj.get("value")
+        if not isinstance(key, dict):
+            return []
+        if val is None:
+            row = self._rows.parse(key)
+            return [(int(Op.DELETE), row)] if row is not None else []
+        if not isinstance(val, dict):
+            return []
+        row = self._rows.parse({**key, **val})
+        return [(int(Op.INSERT), row)] if row is not None else []
+
+
+class ProtobufParser(Parser):
+    """Protobuf-encoded messages (reference: parser/protobuf/): decode
+    with a compiled message class (the descriptor the reference loads
+    from a schema registry maps to gencode here), then coerce fields
+    by name through the same lane rules as JSON."""
+
+    def __init__(self, schema: Schema, message_cls):
+        super().__init__(schema)
+        self.message_cls = message_cls
+
+    def parse(self, raw) -> Optional[Tuple]:
+        msg = self.message_cls()
+        try:
+            if isinstance(raw, str):
+                raw = bytes.fromhex(raw)  # file-log sources carry text
+            msg.ParseFromString(raw)
+        except Exception:
+            return None  # dead-letter drop (non-strict mode)
+        out = []
+        for f in self.schema.fields:
+            try:
+                # proto3 semantics: a scalar field always HAS a value
+                # (0/empty is the default, not NULL) — NULL only when
+                # the message type lacks the field entirely
+                v = getattr(msg, f.name)
+            except AttributeError:
+                v = None
+            out.append(JsonParser._coerce(f, self._pythonize(v)))
+        return tuple(out)
+
+    @staticmethod
+    def _pythonize(v):
+        """Protobuf containers -> plain python so the shared lane rules
+        apply: repeated fields become lists, nested messages dicts."""
+        if v is None or isinstance(v, (int, float, str, bytes, bool)):
+            return v
+        if hasattr(v, "DESCRIPTOR"):  # nested message
+            from google.protobuf.json_format import MessageToDict
+
+            return MessageToDict(v, preserving_proto_field_name=True)
+        try:  # repeated / map containers
+            return [ProtobufParser._pythonize(x) for x in v]
+        except TypeError:
+            return v
 
 
 class CsvParser(Parser):
