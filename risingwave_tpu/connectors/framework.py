@@ -245,9 +245,7 @@ class UpsertJsonParser(ChangeParser):
     an upsert emits a plain INSERT with NO retraction of the prior
     value (overwrite-by-pk resolves it), and a tombstone's value
     columns are NULL. Feeding an aggregation directly would
-    double-count; ``requires_pk`` marks the contract for wiring."""
-
-    requires_pk = True
+    double-count."""
 
     def __init__(self, schema: Schema):
         super().__init__(schema)
@@ -257,13 +255,14 @@ class UpsertJsonParser(ChangeParser):
         obj = self._decode_obj(raw)
         if obj is None:
             return []
-        if "key" not in obj:
+        key = obj.get("key")
+        # an ENVELOPE has a dict key + a value member; anything else is
+        # a plain record (a schema may legitimately have a column named
+        # "key")
+        if not (isinstance(key, dict) and "value" in obj):
             row = self._rows.parse(obj)
             return [(int(Op.INSERT), row)] if row is not None else []
-        key = obj.get("key")
         val = obj.get("value")
-        if not isinstance(key, dict):
-            return []
         if val is None:
             row = self._rows.parse(key)
             return [(int(Op.DELETE), row)] if row is not None else []
@@ -306,14 +305,21 @@ class ProtobufParser(Parser):
     @staticmethod
     def _pythonize(v):
         """Protobuf containers -> plain python so the shared lane rules
-        apply: repeated fields become lists, nested messages dicts."""
+        apply: map fields become dicts, repeated fields lists, nested
+        messages dicts (manual field walk — MessageToDict's proto3-JSON
+        mapping would stringify int64 and base64 bytes)."""
         if v is None or isinstance(v, (int, float, str, bytes, bool)):
             return v
+        if hasattr(v, "items"):  # map<k,v> containers are dict-like
+            return {
+                k: ProtobufParser._pythonize(x) for k, x in v.items()
+            }
         if hasattr(v, "DESCRIPTOR"):  # nested message
-            from google.protobuf.json_format import MessageToDict
-
-            return MessageToDict(v, preserving_proto_field_name=True)
-        try:  # repeated / map containers
+            return {
+                fd.name: ProtobufParser._pythonize(getattr(v, fd.name))
+                for fd in v.DESCRIPTOR.fields
+            }
+        try:  # repeated containers
             return [ProtobufParser._pythonize(x) for x in v]
         except TypeError:
             return v

@@ -1,0 +1,97 @@
+"""Avro binary parsing (VERDICT missing #6 remainder): dependency-free
+decoder for records of the engine's lane types, verified against a
+hand-encoded corpus (zigzag varints, unions-with-null, arrays, enum,
+Confluent wire framing)."""
+
+import struct
+
+import pytest
+
+from risingwave_tpu.connectors.avro import AvroParser, decode_record
+from risingwave_tpu.types import DataType, Field, Schema
+
+pytestmark = pytest.mark.smoke
+
+
+def zz(n: int) -> bytes:
+    """Encode an Avro zigzag varint (test-side oracle encoder)."""
+    u = (n << 1) ^ (n >> 63) if n >= 0 else ((-n) << 1) - 1
+    out = bytearray()
+    while True:
+        b = u & 0x7F
+        u >>= 7
+        if u:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def avro_str(s: str) -> bytes:
+    b = s.encode()
+    return zz(len(b)) + b
+
+
+SCHEMA = {
+    "type": "record",
+    "name": "ev",
+    "fields": [
+        {"name": "id", "type": "long"},
+        {"name": "name", "type": "string"},
+        {"name": "score", "type": "double"},
+        {"name": "note", "type": ["null", "string"]},
+        {"name": "tags", "type": {"type": "array", "items": "long"}},
+        {"name": "kind", "type": {"type": "enum", "name": "k",
+                                  "symbols": ["A", "B"]}},
+    ],
+}
+
+
+def _record(id_, name, score, note, tags, kind_idx):
+    b = zz(id_) + avro_str(name) + struct.pack("<d", score)
+    if note is None:
+        b += zz(0)  # union branch 0 = null
+    else:
+        b += zz(1) + avro_str(note)
+    if tags:
+        b += zz(len(tags)) + b"".join(zz(t) for t in tags)
+    b += zz(0)  # array end
+    b += zz(kind_idx)
+    return b
+
+
+def test_decode_record_round_trip():
+    blob = _record(-42, "hi", 1.5, "n", [3, -7], 1)
+    rec = decode_record(blob, SCHEMA)
+    assert rec == {
+        "id": -42, "name": "hi", "score": 1.5, "note": "n",
+        "tags": [3, -7], "kind": "B",
+    }
+    # null union branch
+    rec = decode_record(_record(7, "x", 0.0, None, [], 0), SCHEMA)
+    assert rec["note"] is None and rec["tags"] == []
+    # truncated input -> None (non-strict drop)
+    assert decode_record(blob[:3], SCHEMA) is None
+
+
+def test_confluent_wire_framing():
+    blob = _record(1, "y", 2.0, None, [], 0)
+    framed = b"\x00" + (1234).to_bytes(4, "big") + blob
+    rec = decode_record(framed, SCHEMA)
+    assert rec is not None and rec["id"] == 1 and rec["name"] == "y"
+
+
+def test_avro_parser_lane_coercion():
+    schema = Schema([
+        Field("id", DataType.INT64),
+        Field("name", DataType.VARCHAR),
+        Field("score", DataType.FLOAT64),
+        Field("note", DataType.VARCHAR),
+    ])
+    p = AvroParser(schema, SCHEMA)
+    row = p.parse(_record(9, "bob", 2.25, None, [1], 0))
+    assert row == (9, "bob", 2.25, None)
+    assert p.parse(b"\xff") is None
+    # hex text form (file-log carried)
+    row = p.parse(_record(3, "z", 0.5, "q", [], 1).hex())
+    assert row == (3, "z", 0.5, "q")
