@@ -1,0 +1,140 @@
+"""Multi-compute-node cluster: vnode-sharded fragments across N
+node processes.
+
+Reference: the multi-CN deployment — HashDataDispatcher crossing node
+boundaries over the exchange service (src/stream/src/executor/
+dispatch.rs:683 + src/compute/src/rpc/service/exchange_service.rs) with
+the meta barrier manager driving every node's control stream
+(proto/stream_service.proto InjectBarrier broadcast).
+
+Engine mapping: each compute node runs the SAME DDL and owns the rows
+whose DISTRIBUTION-column hash lands on it (``node = hash(dist) %
+n``) — the cross-host half of the hash exchange happens at the
+meta/frontend role, which splits every pushed chunk by the same
+stable hash the storage layer uses, pushes each slice down its node's
+wire, and injects barriers on ALL nodes per epoch. With the
+distribution column equal to the MV's group/pk key (the reference's
+distribution-key contract), per-node MVs hold DISJOINT keys and a
+batch query is the concatenation of the nodes' results.
+
+Each node keeps its own state dir (object store); kill -9 of any node
+recovers independently through the single-node replay protocol
+(cluster/client.py) while the other nodes keep their state.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from risingwave_tpu.cluster.client import ComputeClient
+from risingwave_tpu.storage.sstable import key_hashes
+
+
+class ShardedClusterClient:
+    """The meta/frontend role over N compute nodes."""
+
+    def __init__(self, clients: Sequence[ComputeClient]):
+        if not clients:
+            raise ValueError("need at least one compute node")
+        self.nodes: List[ComputeClient] = list(clients)
+        self.dist: Dict[str, str] = {}  # table -> distribution column
+
+    @classmethod
+    def spawn(cls, n_nodes: int, state_dirs: Sequence[str]):
+        if len(state_dirs) != n_nodes:
+            raise ValueError("one state dir per node")
+        return cls([ComputeClient.spawn(state_dir=d) for d in state_dirs])
+
+    # -- DDL (broadcast) -------------------------------------------------
+    def ddl(self, sql: str, distributed_by: Optional[str] = None) -> str:
+        """Run DDL on EVERY node. ``distributed_by`` names the routing
+        column for a CREATE TABLE (the reference's distribution key);
+        MVs grouping/keying by that column then shard exactly."""
+        tags = {self.nodes[i].ddl(sql) for i in range(len(self.nodes))}
+        if len(tags) != 1:
+            raise RuntimeError(f"nodes disagree on DDL: {tags}")
+        tag = next(iter(tags))
+        if distributed_by is not None:
+            import re
+
+            m = re.match(r"(?is)^\s*create\s+table\s+(\w+)", sql)
+            if not m:
+                raise ValueError("distributed_by applies to CREATE TABLE")
+            self.dist[m.group(1)] = distributed_by
+        return tag
+
+    # -- data (hash-routed) ----------------------------------------------
+    def push_chunk(
+        self, table: str, cols: Dict[str, np.ndarray], capacity: int
+    ) -> None:
+        dcol = self.dist.get(table)
+        if dcol is None:
+            raise KeyError(
+                f"table {table!r} has no distribution column (pass "
+                "distributed_by= at CREATE TABLE)"
+            )
+        n = len(next(iter(cols.values())))
+        if n == 0:
+            return
+        dest = (
+            key_hashes([np.asarray(cols[dcol])])
+            % np.uint64(len(self.nodes))
+        ).astype(np.int64)
+        for i, node in enumerate(self.nodes):
+            m = dest == i
+            if not m.any():
+                continue
+            part = {k: np.asarray(v)[m] for k, v in cols.items()}
+            node.push_chunk(table, part, capacity)
+
+    def barrier(self) -> List[int]:
+        """One epoch across the cluster: every node collects + commits
+        its barrier (the meta barrier manager's broadcast). A DEAD node
+        recovers in place — respawn from its durable state, replay its
+        un-durable chunks (client.recover) — while the other nodes'
+        state is untouched; the barrier then retries on that node."""
+        epochs = []
+        for node in self.nodes:
+            try:
+                if node.sock is None:  # killed: socket torn down
+                    raise ConnectionError("node down")
+                epochs.append(node.barrier())
+            except (ConnectionError, OSError):
+                node.recover()
+                epochs.append(node.barrier())
+        return epochs
+
+    # -- reads (scatter-gather) -------------------------------------------
+    def query(
+        self, sql: str, order_by: Optional[str] = None, desc: bool = False
+    ) -> Dict[str, list]:
+        """Run the SELECT on every node and concatenate — exact when
+        the MV's key is the distribution column (disjoint shards).
+        ``order_by`` re-establishes a global order at the merge (the
+        per-node ORDER BY only orders within a shard)."""
+        merged: Dict[str, list] = {}
+        for node in self.nodes:
+            out = node.query(sql)
+            for k, v in out.items():
+                merged.setdefault(k, []).extend(v)
+        if order_by is not None and merged:
+            order = np.argsort(
+                np.asarray(merged[order_by]), kind="stable"
+            )
+            if desc:
+                order = order[::-1]
+            merged = {k: [v[i] for i in order] for k, v in merged.items()}
+        return merged
+
+    # -- failure injection / lifecycle ------------------------------------
+    def kill9(self, i: int) -> None:
+        self.nodes[i].kill9()
+
+    def close(self) -> None:
+        for node in self.nodes:
+            try:
+                node.close()
+            except Exception:
+                pass

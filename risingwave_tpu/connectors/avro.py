@@ -5,8 +5,9 @@ resolution). This is a dependency-free decoder for the subset the
 engine's lane types need: records of null/boolean/int/long/float/
 double/string/bytes/enum + unions-with-null (nullable fields) +
 arrays of those. Schemas are plain Avro JSON schema documents; the
-registry's wire framing (magic 0 + 4-byte schema id) is recognized
-and skipped when present.
+registry's wire framing (magic 0 + 4-byte schema id) is a DECLARED
+source property (``registry_framed=True``), never sniffed — an
+unframed record whose first field encodes as byte 0 would misdecode.
 
 Zigzag varints, IEEE floats and length-prefixed bytes follow the Avro
 1.11 binary spec.
@@ -103,43 +104,56 @@ def _decode_value(r: _Reader, sch) -> object:
     raise ValueError(f"unsupported avro type {sch!r}")
 
 
-def decode_record(blob: bytes, schema: dict) -> Optional[dict]:
+def decode_record(
+    blob: bytes, schema: dict, framed: bool = False
+) -> Optional[dict]:
     """One binary-encoded record -> field dict; None when undecodable.
-    Confluent wire framing (0x00 + schema id) is skipped if present."""
+
+    ``framed`` declares Confluent wire framing (0x00 magic + 4-byte
+    registry schema id) — an EXPLICIT source property, never sniffed:
+    a legitimate unframed record whose first field encodes as byte 0
+    (long 0, false, empty string, union branch 0) would otherwise
+    misdecode silently. The record must consume the whole buffer
+    (single-record message contract)."""
     try:
-        r = _Reader(blob)
-        if len(blob) > 5 and blob[0] == 0:
-            r.pos = 5  # magic byte + 4-byte registry schema id
-            try:
-                return _decode_value(_Reader(blob, 5), schema)
-            except (EOFError, ValueError):
-                r = _Reader(blob)  # not framed after all
+        r = _Reader(blob, 5 if framed else 0)
+        if framed and (len(blob) < 5 or blob[0] != 0):
+            return None
         v = _decode_value(r, schema)
+        if r.pos != len(blob):
+            return None  # trailing garbage: not a clean record
         return v if isinstance(v, dict) else None
-    except (EOFError, ValueError, struct.error):
+    except (EOFError, ValueError, struct.error, TypeError, KeyError,
+            IndexError):
+        # the documented contract is None-when-undecodable: a non-bytes
+        # input or a malformed nested schema must drop the record, not
+        # poison the split (offsets never advance past an exception)
         return None
 
 
 class AvroParser(Parser):
     """Avro-encoded source messages: decode the record against its
     writer schema (an Avro JSON schema document), then coerce fields
-    by name through the shared JSON lane rules."""
+    by name through the shared JSON lane rules. ``registry_framed``
+    declares the Confluent wire envelope (a source property in the
+    reference's WITH(...) options — never sniffed from the bytes)."""
 
-    def __init__(self, schema: Schema, avro_schema):
+    def __init__(self, schema: Schema, avro_schema, registry_framed=False):
         super().__init__(schema)
         if isinstance(avro_schema, str):
             avro_schema = json.loads(avro_schema)
         if avro_schema.get("type") != "record":
             raise ValueError("AvroParser needs a record schema")
         self.avro_schema = avro_schema
+        self.registry_framed = bool(registry_framed)
 
     def parse(self, raw) -> Optional[Tuple]:
-        if isinstance(raw, str):
-            try:
-                raw = bytes.fromhex(raw)  # file-log sources carry text
-            except ValueError:
-                return None
-        rec = decode_record(raw, self.avro_schema)
+        raw = self.binary_raw(raw)
+        if raw is None:
+            return None
+        rec = decode_record(
+            raw, self.avro_schema, framed=self.registry_framed
+        )
         if rec is None:
             return None
         return tuple(

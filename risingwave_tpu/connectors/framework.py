@@ -78,6 +78,19 @@ class Parser:
         """One message -> row tuple (schema order), or None to drop."""
         raise NotImplementedError
 
+    @staticmethod
+    def binary_raw(raw) -> Optional[bytes]:
+        """Normalize a raw message for BINARY parsers: text-carried
+        sources (file logs) deliver hex strings; None = undecodable."""
+        if isinstance(raw, bytes):
+            return raw
+        if isinstance(raw, str):
+            try:
+                return bytes.fromhex(raw)
+            except ValueError:
+                return None
+        return None
+
 
 # ---------------------------------------------------------------------------
 # parsers
@@ -256,10 +269,11 @@ class UpsertJsonParser(ChangeParser):
         if obj is None:
             return []
         key = obj.get("key")
-        # an ENVELOPE has a dict key + a value member; anything else is
-        # a plain record (a schema may legitimately have a column named
-        # "key")
-        if not (isinstance(key, dict) and "value" in obj):
+        # a DICT-valued "key" member marks the envelope (value may be
+        # absent/null — producers with null-omitting serializers emit
+        # tombstones as bare {"key": ...}); a non-dict/absent key is a
+        # plain record (a schema may have a scalar column named "key")
+        if not isinstance(key, dict):
             row = self._rows.parse(obj)
             return [(int(Op.INSERT), row)] if row is not None else []
         val = obj.get("value")
@@ -283,10 +297,11 @@ class ProtobufParser(Parser):
         self.message_cls = message_cls
 
     def parse(self, raw) -> Optional[Tuple]:
+        raw = self.binary_raw(raw)
+        if raw is None:
+            return None
         msg = self.message_cls()
         try:
-            if isinstance(raw, str):
-                raw = bytes.fromhex(raw)  # file-log sources carry text
             msg.ParseFromString(raw)
         except Exception:
             return None  # dead-letter drop (non-strict mode)
@@ -305,19 +320,23 @@ class ProtobufParser(Parser):
     @staticmethod
     def _pythonize(v):
         """Protobuf containers -> plain python so the shared lane rules
-        apply: map fields become dicts, repeated fields lists, nested
-        messages dicts (manual field walk — MessageToDict's proto3-JSON
-        mapping would stringify int64 and base64 bytes)."""
+        apply: nested messages become dicts (SET fields only — walking
+        every descriptor field would recurse forever on
+        self-referential types), map fields dicts, repeated fields
+        lists. Manual walk, not MessageToDict: the proto3-JSON mapping
+        would stringify int64 and base64 bytes."""
         if v is None or isinstance(v, (int, float, str, bytes, bool)):
             return v
+        # message check FIRST: a message with a field literally named
+        # "items" would otherwise duck-type as a map container
+        if hasattr(v, "DESCRIPTOR"):
+            return {
+                fd.name: ProtobufParser._pythonize(val)
+                for fd, val in v.ListFields()
+            }
         if hasattr(v, "items"):  # map<k,v> containers are dict-like
             return {
                 k: ProtobufParser._pythonize(x) for k, x in v.items()
-            }
-        if hasattr(v, "DESCRIPTOR"):  # nested message
-            return {
-                fd.name: ProtobufParser._pythonize(getattr(v, fd.name))
-                for fd in v.DESCRIPTOR.fields
             }
         try:  # repeated containers
             return [ProtobufParser._pythonize(x) for x in v]

@@ -74,11 +74,21 @@ def test_decode_record_round_trip():
     assert decode_record(blob[:3], SCHEMA) is None
 
 
-def test_confluent_wire_framing():
+def test_confluent_wire_framing_is_explicit():
     blob = _record(1, "y", 2.0, None, [], 0)
     framed = b"\x00" + (1234).to_bytes(4, "big") + blob
-    rec = decode_record(framed, SCHEMA)
+    rec = decode_record(framed, SCHEMA, framed=True)
     assert rec is not None and rec["id"] == 1 and rec["name"] == "y"
+    # framing is DECLARED, never sniffed: an unframed record whose
+    # first field encodes as byte 0 (id=0) must decode as itself
+    tricky = _record(0, "ABC", 2.0, None, [], 0)
+    assert tricky[0] == 0
+    rec = decode_record(tricky, SCHEMA)
+    assert rec is not None and rec["id"] == 0 and rec["name"] == "ABC"
+    # declared-framed input missing the magic byte is rejected
+    assert decode_record(blob, SCHEMA, framed=True) is None
+    # trailing garbage is rejected (single-record contract)
+    assert decode_record(blob + b"x", SCHEMA) is None
 
 
 def test_avro_parser_lane_coercion():

@@ -140,6 +140,8 @@ def test_upsert_json_parser(tmp_path):
         '{"key": {"id": 2}, "value": {"v": 9}}',
         '{"key": {"id": 1}, "value": {"v": 7}}',   # upsert
         '{"key": {"id": 2}, "value": null}',        # delete
+        '{"key": {"id": 3}, "value": {"v": 1}}',
+        '{"key": {"id": 3}}',                       # null-omitting tombstone
     ])
     src.discover()
     for c in src.poll(64, 16):
