@@ -53,6 +53,12 @@ COMPACT_AT = 8  # L0 SSTs per table before a leveled compaction
 L1_FILE_ROWS = 1 << 16  # target rows per non-overlapping L1 file
 
 
+class EpochFloorError(RuntimeError):
+    """An MVCC pin below the table's compaction floor: that history
+    has been folded away. Deliberately NOT a ValueError — the read
+    retry loop treats ValueError as a transient decode race."""
+
+
 @dataclass
 class StateDelta:
     """One table's staged epoch delta (host-side, compacted).
@@ -436,6 +442,11 @@ class CheckpointManager:
             self.version["tables"][table_id] = merged_l1 + cur[
                 len(entries):
             ]
+            # epoch-pinned reads below this floor would silently see a
+            # partial table (the folded layer is excluded): record the
+            # newest epoch this compaction folded so readers can raise
+            floors = self.version.setdefault("history_floor", {})
+            floors[table_id] = max(floors.get(table_id, 0), src_epoch)
             self._persist_version()
         from risingwave_tpu import utils_sync_point as sync_point
 
@@ -507,7 +518,10 @@ class CheckpointManager:
         r = self._open_entry(e, cache)
         return r.materialize() if isinstance(r, BlockSst) else r
 
-    def _readers_newest_first(self, table_id: str, cache: bool = True):
+    def _readers_newest_first(
+        self, table_id: str, cache: bool = True,
+        at_epoch: "Optional[int]" = None,
+    ):
         # blob reads run OUTSIDE the lock; a compactor — this manager's
         # off-path thread, or another node still draining after a
         # "kill" — may GC an SST between the version snapshot and the
@@ -519,6 +533,25 @@ class CheckpointManager:
                 if attempt:
                     self._load()
                 entries = list(self.version["tables"].get(table_id, []))
+            if at_epoch is not None:
+                # MVCC snapshot pin (StateStore epoch-pinned reads,
+                # store.rs read options): ignore SSTs committed after
+                # the pinned epoch — L1 files carry their newest SOURCE
+                # epoch, so a compaction never hides history newer than
+                # its inputs. Below the compaction floor the folded
+                # layer would be EXCLUDED and the read silently
+                # partial: refuse (the reference pins epochs against
+                # compaction via hummock version pinning).
+                floor = self.version.get("history_floor", {}).get(
+                    table_id, 0
+                )
+                if at_epoch < floor:
+                    raise EpochFloorError(
+                        f"epoch {at_epoch} is below {table_id!r}'s "
+                        f"compaction floor {floor}: that history has "
+                        "been folded"
+                    )
+                entries = [e for e in entries if e["epoch"] <= at_epoch]
             out = []
             try:
                 for e in reversed(entries):
@@ -532,7 +565,8 @@ class CheckpointManager:
         )
 
     def get_rows(
-        self, table_id: str, key_cols: Dict[str, np.ndarray]
+        self, table_id: str, key_cols: Dict[str, np.ndarray],
+        at_epoch: Optional[int] = None,
     ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
         """MVCC-style point reads at the committed version
         (StateStore::get, store.rs:218): per queried key, newest SST
@@ -540,13 +574,16 @@ class CheckpointManager:
         whole SSTs per query batch — no full-table materialization.
 
         Returns ``(found_mask, value_cols)``; value lanes are only
-        meaningful where ``found_mask``."""
+        meaningful where ``found_mask``. ``at_epoch`` pins an MVCC
+        snapshot: the read sees exactly the state committed at that
+        epoch (epoch-pinned batch reads, store.rs read options) —
+        subject to compaction having not yet folded those epochs."""
         return self._read_retry(
-            lambda: self._get_rows_once(table_id, key_cols)
+            lambda: self._get_rows_once(table_id, key_cols, at_epoch)
         )
 
-    def _get_rows_once(self, table_id, key_cols):
-        readers = self._readers_newest_first(table_id)
+    def _get_rows_once(self, table_id, key_cols, at_epoch=None):
+        readers = self._readers_newest_first(table_id, at_epoch=at_epoch)
         n = len(next(iter(key_cols.values()))) if key_cols else 0
         found = np.zeros(n, bool)
         unresolved = np.ones(n, bool)
@@ -609,14 +646,18 @@ class CheckpointManager:
         return found, values
 
     def scan_prefix(
-        self, table_id: str, prefix_cols: Dict[str, object]
+        self, table_id: str, prefix_cols: Dict[str, object],
+        at_epoch: Optional[int] = None,
     ) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
         """Prefix range scan at the committed version (StateStore::iter,
         store.rs:298): touches only rows matching the key-lane prefix in
         each SST — and only the overlapping BLOCKS of leveled files —
         then resolves newest-wins; the read path backfill and lookup
-        joins build on."""
-        return self.scan_range(table_id, prefix_cols=prefix_cols)
+        joins build on. ``at_epoch`` pins the same MVCC snapshot the
+        other read paths honor."""
+        return self.scan_range(
+            table_id, prefix_cols=prefix_cols, at_epoch=at_epoch
+        )
 
     def scan_range(
         self,
@@ -626,6 +667,7 @@ class CheckpointManager:
         lo: Optional[object] = None,
         hi: Optional[object] = None,
         reverse: bool = False,
+        at_epoch: Optional[int] = None,
     ) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
         """Ordered range scan at the committed version (the forward /
         backward UserIterator, src/storage/src/hummock/iterator/):
@@ -636,14 +678,16 @@ class CheckpointManager:
         newest epoch wins per key and tombstones drop."""
         return self._read_retry(
             lambda: self._scan_range_once(
-                table_id, prefix_cols, range_col, lo, hi, reverse
+                table_id, prefix_cols, range_col, lo, hi, reverse,
+                at_epoch,
             )
         )
 
     def _scan_range_once(
-        self, table_id, prefix_cols, range_col, lo, hi, reverse
+        self, table_id, prefix_cols, range_col, lo, hi, reverse,
+        at_epoch=None,
     ):
-        readers = self._readers_newest_first(table_id)
+        readers = self._readers_newest_first(table_id, at_epoch=at_epoch)
         if not readers:
             return {}, {}
         key_names = readers[0].meta.key_names
