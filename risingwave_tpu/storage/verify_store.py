@@ -1,0 +1,121 @@
+"""Differential read verification — the VerifyStateStore analogue.
+
+Reference: src/storage/src/store_impl.rs VerifyStateStore (debug-mode
+dispatch wrapper running every operation against two stores and
+asserting agreement). Here the two independent implementations are the
+OPTIMIZED read paths (bloom/block-pruned point reads, block-pruned
+range scans) vs the ORACLE path (full materialization + newest-wins
+merge): wrap a CheckpointManager and every get_rows/scan_range runs
+both, raising on any divergence. Used by the chaos/e2e tiers to catch
+pruning bugs (a wrong bloom bit or block bound silently drops rows —
+exactly the class of bug assertions in the hot path can't see).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from risingwave_tpu.storage.sstable import merge_ssts
+from risingwave_tpu.storage.block_sst import BlockSst
+
+
+class VerifyReadStore:
+    """Wraps a CheckpointManager; reads run BOTH paths and must agree."""
+
+    def __init__(self, mgr):
+        self.mgr = mgr
+        self.verified_reads = 0
+
+    def __getattr__(self, name):  # everything else passes through
+        return getattr(self.mgr, name)
+
+    # -- oracle path -----------------------------------------------------
+    def _oracle_rows(self, table_id: str, at_epoch: Optional[int] = None):
+        readers = list(
+            reversed(
+                self.mgr._readers_newest_first(
+                    table_id, cache=False, at_epoch=at_epoch
+                )
+            )
+        )
+        if not readers:
+            return {}, {}, ()
+        ssts = [
+            r.materialize() if isinstance(r, BlockSst) else r
+            for r in readers
+        ]
+        keys, vals = merge_ssts(ssts, ssts[-1].meta.key_names)
+        return keys, vals, ssts[-1].meta.key_names
+
+    # -- verified reads --------------------------------------------------
+    def get_rows(self, table_id, key_cols, at_epoch=None):
+        found, vals = self.mgr.get_rows(
+            table_id, key_cols, at_epoch=at_epoch
+        )
+        okeys, ovals, key_names = self._oracle_rows(table_id, at_epoch)
+        n = len(next(iter(key_cols.values()))) if key_cols else 0
+        table = {}
+        if okeys:
+            rows = list(
+                zip(*(np.asarray(okeys[k]).tolist() for k in key_names))
+            )
+            for i, kt in enumerate(rows):
+                table[kt] = i
+        for i in range(n):
+            kt = tuple(
+                np.asarray(key_cols[k])[i].item() for k in key_names
+            )
+            want = kt in table
+            if bool(found[i]) != want:
+                raise AssertionError(
+                    f"differential store: key {kt} found={bool(found[i])}"
+                    f" but oracle says {want} ({table_id})"
+                )
+            if want:
+                j = table[kt]
+                for vn, lane in vals.items():
+                    ov = np.asarray(ovals[vn])[j]
+                    if not np.array_equal(np.asarray(lane[i]), ov):
+                        raise AssertionError(
+                            f"differential store: {table_id} key {kt} "
+                            f"lane {vn}: fast={lane[i]} oracle={ov}"
+                        )
+        self.verified_reads += 1
+        return found, vals
+
+    def scan_range(
+        self, table_id, prefix_cols=None, range_col=None, lo=None,
+        hi=None, reverse=False, at_epoch=None,
+    ):
+        keys, vals = self.mgr.scan_range(
+            table_id, prefix_cols, range_col, lo, hi, reverse, at_epoch
+        )
+        okeys, ovals, key_names = self._oracle_rows(table_id, at_epoch)
+        if okeys:
+            mask = np.ones(len(next(iter(okeys.values()))), bool)
+            for kn, v in (prefix_cols or {}).items():
+                mask &= np.asarray(okeys[kn]) == v
+            if range_col is not None:
+                lane = np.asarray(okeys[range_col])
+                if lo is not None:
+                    mask &= lane >= lo
+                if hi is not None:
+                    mask &= lane <= hi
+            want_n = int(mask.sum())
+        else:
+            want_n = 0
+        got_n = len(next(iter(keys.values()))) if keys else 0
+        if got_n != want_n:
+            raise AssertionError(
+                f"differential store: scan of {table_id} returned "
+                f"{got_n} rows, oracle {want_n}"
+            )
+        self.verified_reads += 1
+        return keys, vals
+
+    def scan_prefix(self, table_id, prefix_cols, at_epoch=None):
+        return self.scan_range(
+            table_id, prefix_cols=prefix_cols, at_epoch=at_epoch
+        )
