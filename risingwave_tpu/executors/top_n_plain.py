@@ -351,6 +351,62 @@ def _group_topk_mask(
     return in_topk, gdirty
 
 
+def _diff_touched_groups(
+    table, rows, in_topk, epoch_dirty, group_by, pk, names, gdirty,
+    emitted,
+):
+    """Pull touched groups' top-k (+ the epoch-dirty rows naming
+    fully-emptied groups) and diff against the host mirror of what was
+    emitted; updates ``emitted`` in place. Shared by the single-chip
+    and the sharded executor (one shard = one call over its slices)."""
+    mask = np.asarray((gdirty & in_topk) | epoch_dirty)
+    sel = np.flatnonzero(mask)
+    lanes = {n: rows[n] for n in names}
+    lanes["__topk__"] = in_topk
+    lanes["__live__"] = table.live
+    pulled = pull_rows(lanes, sel)
+    new_top: Dict[Tuple, Dict[Tuple, Tuple]] = {}
+    changed: set = set()
+    for i in range(len(sel)):
+        g = tuple(pulled[c][i].item() for c in group_by)
+        changed.add(g)
+        if pulled["__topk__"][i] and pulled["__live__"][i]:
+            pkv = tuple(pulled[c][i].item() for c in pk)
+            new_top.setdefault(g, {})[pkv] = tuple(
+                pulled[n][i].item() for n in names
+            )
+    dels, ins = [], []
+    for g in changed:
+        old = emitted.get(g, {})
+        new = new_top.get(g, {})
+        dels.extend(v for p, v in old.items() if new.get(p) != v)
+        ins.extend(v for p, v in new.items() if old.get(p) != v)
+        if new:
+            emitted[g] = new
+        else:
+            emitted.pop(g, None)
+    return dels, ins
+
+
+def _emit_diffs(dels, ins, names, dtypes) -> List[StreamChunk]:
+    outs = []
+    for vals, op in ((dels, Op.DELETE), (ins, Op.INSERT)):
+        if not vals:
+            continue
+        cols = {
+            n: np.asarray([r[j] for r in vals], dtypes[n])
+            for j, n in enumerate(names)
+        }
+        outs.append(
+            StreamChunk.from_numpy(
+                cols,
+                max(2, len(vals)),
+                ops=np.full(len(vals), int(op), np.int32),
+            )
+        )
+    return outs
+
+
 class RetractableGroupTopNExecutor(Executor, Checkpointable):
     """GROUP BY g ORDER BY o LIMIT k with full retraction support
     (group_top_n.rs:63): deletes/updates crossing a group's top-k
@@ -489,50 +545,12 @@ class RetractableGroupTopNExecutor(Executor, Checkpointable):
         )
         # pull the top-k of touched groups PLUS the epoch-dirty rows
         # themselves (deleted rows name fully-emptied groups)
-        mask = np.asarray((gdirty & in_topk) | self.epoch_dirty)
-        sel = np.flatnonzero(mask)
-        lanes = {n: self.rows[n] for n in self.names}
-        lanes["__topk__"] = in_topk
-        lanes["__live__"] = self.table.live
-        pulled = pull_rows(lanes, sel)
-        n_sel = len(sel)
-        new_top: Dict[Tuple, Dict[Tuple, Tuple]] = {}
-        changed: set = set()
-        for i in range(n_sel):
-            g = tuple(pulled[c][i].item() for c in self.group_by)
-            changed.add(g)
-            if pulled["__topk__"][i] and pulled["__live__"][i]:
-                pkv = tuple(pulled[c][i].item() for c in self.pk)
-                new_top.setdefault(g, {})[pkv] = tuple(
-                    pulled[n][i].item() for n in self.names
-                )
-        dels, ins = [], []
-        for g in changed:
-            old = self._emitted.get(g, {})
-            new = new_top.get(g, {})
-            dels.extend(v for p, v in old.items() if new.get(p) != v)
-            ins.extend(v for p, v in new.items() if old.get(p) != v)
-            if new:
-                self._emitted[g] = new
-            else:
-                self._emitted.pop(g, None)
+        dels, ins = _diff_touched_groups(
+            self.table, self.rows, in_topk, self.epoch_dirty,
+            self.group_by, self.pk, self.names, gdirty, self._emitted,
+        )
         self.epoch_dirty = jnp.zeros_like(self.epoch_dirty)
-        outs = []
-        for vals, op in ((dels, Op.DELETE), (ins, Op.INSERT)):
-            if not vals:
-                continue
-            cols = {
-                n: np.asarray([r[j] for r in vals], self._dtypes[n])
-                for j, n in enumerate(self.names)
-            }
-            outs.append(
-                StreamChunk.from_numpy(
-                    cols,
-                    max(2, len(vals)),
-                    ops=np.full(len(vals), int(op), np.int32),
-                )
-            )
-        return outs
+        return _emit_diffs(dels, ins, self.names, self._dtypes)
 
     def on_watermark(self, watermark):
         """Window-bounded groups expire silently below the watermark

@@ -14,6 +14,7 @@ keeps gRPC between compute nodes.
 """
 
 from risingwave_tpu.parallel.sharded_agg import ShardedHashAgg, make_mesh
+from risingwave_tpu.parallel.sharded_top_n import ShardedGroupTopN
 from risingwave_tpu.parallel.sharded_join import (
     ShardedDedup,
     ShardedHashJoin,
@@ -23,6 +24,7 @@ from risingwave_tpu.parallel.sharded_join import (
 
 __all__ = [
     "ShardedDedup",
+    "ShardedGroupTopN",
     "ShardedHashAgg",
     "ShardedHashJoin",
     "flatten_stacked",

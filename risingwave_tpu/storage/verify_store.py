@@ -27,12 +27,25 @@ class VerifyReadStore:
     def __init__(self, mgr):
         self.mgr = mgr
         self.verified_reads = 0
+        # oracle results cached per (table, version paths, pin): the
+        # differential tier must not re-download the whole table per
+        # read; a version change produces a different key
+        self._oracle_cache: Dict[tuple, tuple] = {}
 
     def __getattr__(self, name):  # everything else passes through
         return getattr(self.mgr, name)
 
     # -- oracle path -----------------------------------------------------
     def _oracle_rows(self, table_id: str, at_epoch: Optional[int] = None):
+        with self.mgr._lock:
+            paths = tuple(
+                e["path"]
+                for e in self.mgr.version["tables"].get(table_id, ())
+            )
+        ck = (table_id, paths, at_epoch)
+        hit = self._oracle_cache.get(ck)
+        if hit is not None:
+            return hit
         readers = list(
             reversed(
                 self.mgr._readers_newest_first(
@@ -41,13 +54,18 @@ class VerifyReadStore:
             )
         )
         if not readers:
-            return {}, {}, ()
-        ssts = [
-            r.materialize() if isinstance(r, BlockSst) else r
-            for r in readers
-        ]
-        keys, vals = merge_ssts(ssts, ssts[-1].meta.key_names)
-        return keys, vals, ssts[-1].meta.key_names
+            out = ({}, {}, ())
+        else:
+            ssts = [
+                r.materialize() if isinstance(r, BlockSst) else r
+                for r in readers
+            ]
+            keys, vals = merge_ssts(ssts, ssts[-1].meta.key_names)
+            out = (keys, vals, ssts[-1].meta.key_names)
+        if len(self._oracle_cache) > 8:
+            self._oracle_cache.pop(next(iter(self._oracle_cache)))
+        self._oracle_cache[ck] = out
+        return out
 
     # -- verified reads --------------------------------------------------
     def get_rows(self, table_id, key_cols, at_epoch=None):
@@ -93,6 +111,23 @@ class VerifyReadStore:
             table_id, prefix_cols, range_col, lo, hi, reverse, at_epoch
         )
         okeys, ovals, key_names = self._oracle_rows(table_id, at_epoch)
+
+        def rowset(ks, vs):
+            if not ks:
+                return {}
+            n = len(next(iter(ks.values())))
+            vns = sorted(vs)
+            return {
+                tuple(np.asarray(ks[k])[i].item() for k in key_names): tuple(
+                    np.asarray(np.asarray(vs[v])[i]).tolist()
+                    if np.asarray(vs[v])[i].ndim
+                    else np.asarray(vs[v])[i].item()
+                    for v in vns
+                )
+                for i in range(n)
+            }
+
+        want = {}
         if okeys:
             mask = np.ones(len(next(iter(okeys.values()))), bool)
             for kn, v in (prefix_cols or {}).items():
@@ -103,14 +138,16 @@ class VerifyReadStore:
                     mask &= lane >= lo
                 if hi is not None:
                     mask &= lane <= hi
-            want_n = int(mask.sum())
-        else:
-            want_n = 0
-        got_n = len(next(iter(keys.values()))) if keys else 0
-        if got_n != want_n:
+            sel = np.flatnonzero(mask)
+            fk = {k: np.asarray(a)[sel] for k, a in okeys.items()}
+            fv = {k: np.asarray(a)[sel] for k, a in ovals.items()}
+            want = rowset(fk, fv)
+        got = rowset(keys, vals)
+        if got != want:
             raise AssertionError(
-                f"differential store: scan of {table_id} returned "
-                f"{got_n} rows, oracle {want_n}"
+                f"differential store: scan of {table_id} diverges — "
+                f"{len(got)} rows vs oracle {len(want)} (or values "
+                "differ)"
             )
         self.verified_reads += 1
         return keys, vals
