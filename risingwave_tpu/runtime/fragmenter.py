@@ -465,6 +465,25 @@ def _sharded_equiv(ex, mesh, stacked_out: bool = False):
             capacity=ex.table.capacity,
             table_id=ex.table_id,
         )
+    from risingwave_tpu.executors.top_n_plain import (
+        RetractableGroupTopNExecutor,
+    )
+    from risingwave_tpu.parallel.sharded_top_n import ShardedGroupTopN
+
+    if isinstance(ex, RetractableGroupTopNExecutor):
+        if ex.window_key is not None:
+            return None
+        return ShardedGroupTopN(
+            mesh,
+            ex.group_by,
+            ex.order_col,
+            ex.limit,
+            ex.pk,
+            {n: ex._dtypes[n] for n in ex.names},
+            desc=ex.desc,
+            capacity=ex.table.capacity,
+            table_id=ex.table_id,
+        )
     return None
 
 
@@ -474,9 +493,14 @@ def _shard_single_chain(chain, mesh):
     StackSplit/Flatten) + anything (fed flat chunks as before)."""
     from risingwave_tpu.parallel.sharded_agg import ShardedHashAgg
 
+    from risingwave_tpu.executors.top_n_plain import (
+        RetractableGroupTopNExecutor,
+    )
+    from risingwave_tpu.parallel.sharded_top_n import ShardedGroupTopN
+
     keyed_idx = None
     for j, ex in enumerate(chain):
-        if isinstance(ex, _KEYED):
+        if isinstance(ex, _KEYED + (RetractableGroupTopNExecutor,)):
             keyed_idx = j
             break
         if not isinstance(ex, _PARALLEL_STATELESS):
@@ -488,8 +512,10 @@ def _shard_single_chain(chain, mesh):
         return None
     n = mesh.devices.size
     mid = [StackSplitExecutor(n), sharded]
-    if not isinstance(sharded, ShardedHashAgg):
-        mid.append(FlattenExecutor())  # dedup emits stacked chunks
+    if not isinstance(sharded, (ShardedHashAgg, ShardedGroupTopN)):
+        # dedup emits STACKED chunks from apply; GroupTopN/agg emit
+        # host chunks at the barrier — only the former needs a flatten
+        mid.append(FlattenExecutor())
     return list(chain[:keyed_idx]) + mid + list(chain[keyed_idx + 1 :])
 
 

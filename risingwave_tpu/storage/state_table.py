@@ -693,20 +693,19 @@ class CheckpointManager:
         key_names = readers[0].meta.key_names
         value_names = readers[0].meta.value_names
         prefix_cols = dict(prefix_cols or {})
-        plen = len(prefix_cols)
-        if tuple(key_names[:plen]) != tuple(prefix_cols):
-            # allow any dict order as long as the SET is the key prefix
-            if set(key_names[:plen]) != set(prefix_cols):
-                raise KeyError(
-                    f"prefix {tuple(prefix_cols)} is not a prefix of "
-                    f"key order {key_names}"
-                )
-        if range_col is not None and (
-            plen >= len(key_names) or key_names[plen] != range_col
-        ):
+        for kn in prefix_cols:
+            if kn not in key_names:
+                raise KeyError(f"{kn!r} is not a key lane of {key_names}")
+        if range_col is not None and range_col not in key_names:
             raise KeyError(
-                f"range column {range_col!r} must be key lane {plen}"
+                f"range column {range_col!r} is not a key lane"
             )
+        # equality filters apply to ANY key-lane subset (the historical
+        # scan_prefix contract); BLOCK pruning only uses the longest
+        # LEADING run of equality lanes (+ a range on the next lane)
+        plen = 0
+        while plen < len(key_names) and key_names[plen] in prefix_cols:
+            plen += 1
 
         k_parts: Dict[str, list] = {k: [] for k in key_names}
         v_parts: Dict[str, list] = {v: [] for v in value_names}
