@@ -493,6 +493,7 @@ def _shard_single_chain(chain, mesh):
     StackSplit/Flatten) + anything (fed flat chunks as before)."""
     from risingwave_tpu.parallel.sharded_agg import ShardedHashAgg
 
+    from risingwave_tpu.executors.row_id_gen import RowIdGenExecutor
     from risingwave_tpu.executors.top_n_plain import (
         RetractableGroupTopNExecutor,
     )
@@ -503,7 +504,13 @@ def _shard_single_chain(chain, mesh):
         if isinstance(ex, _KEYED + (RetractableGroupTopNExecutor,)):
             keyed_idx = j
             break
-        if not isinstance(ex, _PARALLEL_STATELESS):
+        # RowIdGen is safe here: the prefix runs FLAT, single-threaded,
+        # BEFORE the StackSplit (ids stay globally unique) — unlike the
+        # actor-parallel mode where per-instance generators would
+        # collide
+        if not isinstance(
+            ex, _PARALLEL_STATELESS + (RowIdGenExecutor,)
+        ):
             return None
     if keyed_idx is None:
         return None

@@ -321,6 +321,9 @@ class StreamPlanner:
         select = self._rewrite_distinct(select)
         if select.having is not None and not select.group_by:
             raise ValueError("HAVING requires GROUP BY")
+        topn = self._try_over_window_to_topn(name, select)
+        if topn is not None:
+            return topn
         if isinstance(select.from_, P.Join):
             if select.from_.join_type.startswith("temporal"):
                 return self._plan_temporal(name, select)
@@ -562,6 +565,156 @@ class StreamPlanner:
         return self._maybe_topn(
             name, select, binder,
             BoundRel(chain, out_schema2, pk, source, alias),
+        )
+
+    def _try_over_window_to_topn(
+        self, name: str, select: P.Select
+    ) -> Optional[PlannedMV]:
+        """The reference's over_window_to_topn_rule.rs: rewrite
+
+            SELECT cols FROM (SELECT cols, row_number() OVER
+              (PARTITION BY g ORDER BY o [DESC]) AS rn FROM t) AS x
+            WHERE rn <= k      (also rn < k, rn = 1)
+
+        onto the retractable GroupTopN executor — per-group top-k
+        maintenance is O(changed groups x k) per barrier where the
+        general over-window recomputes whole partitions. Returns None
+        when the shape doesn't match (the window path handles it)."""
+        f = select.from_
+        if not (
+            isinstance(f, P.SubQuery)
+            and isinstance(f.select.from_, (P.TableRef, P.WindowTVF))
+            and select.where is not None
+            and not select.group_by
+            and not select.having
+            and select.limit is None
+        ):
+            return None
+        inner = f.select
+        if inner.where is not None or inner.group_by or inner.limit:
+            return None
+        wins = [
+            (i, it)
+            for i, it in enumerate(inner.items)
+            if isinstance(it.expr, P.WindowFuncCall)
+        ]
+        if len(wins) != 1:
+            return None
+        wi, witem = wins[0]
+        w = witem.expr
+        if (
+            w.func.name != "row_number"
+            or w.frame is not None
+            or len(w.order_by) != 1
+            or not w.partition_by
+        ):
+            return None
+        rn_name = witem.alias or f"row_number_{wi}"
+        # the outer WHERE must be exactly a bound on rn; rn must not be
+        # selected (GroupTopN emits rows without a rank column)
+        conjs = _split_and(select.where)
+        k = None
+        for c in conjs:
+            if not (
+                isinstance(c, P.BinaryOp)
+                and isinstance(c.left, P.Ident)
+                and c.left.name == rn_name
+                and c.left.qualifier in (None, f.alias)
+                and isinstance(c.right, P.Literal)
+            ):
+                return None
+            v = c.right.value
+            if not isinstance(v, int) or isinstance(v, bool):
+                return None  # float/str bounds: the window path filters
+            if c.op == "<=":
+                bound = v
+            elif c.op == "<":
+                bound = v - 1
+            elif c.op == "=" and v == 1:
+                bound = 1
+            else:
+                return None
+            k = bound if k is None else min(k, bound)
+        if k is None or k < 1:
+            return None
+        for it in select.items:
+            if not isinstance(it.expr, P.Ident) or it.expr.name == rn_name:
+                return None
+
+        bound_rel = self._from_bound(name, inner.from_)
+        schema = dict(bound_rel.schema)
+        binder = Binder(schema, bound_rel.alias)
+        part_cols = tuple(binder.resolve(c) for c in w.partition_by)
+        oident, desc = w.order_by[0]
+        ocol = binder.resolve(oident)
+        chain = list(bound_rel.chain)
+        pk = bound_rel.pk
+        if not pk:
+            chain.append(
+                RowIdGenExecutor(
+                    out_col="_row_id", table_id=self._tid(name, "rowid")
+                )
+            )
+            schema["_row_id"] = jnp.dtype(jnp.int64)
+            pk = ("_row_id",)
+        # resolve inner pass-through aliases for the outer projection
+        amap = {
+            (it.alias or (it.expr.name if isinstance(it.expr, P.Ident) else None)):
+                it.expr
+            for it in inner.items
+        }
+        from risingwave_tpu.executors.top_n_plain import (
+            RetractableGroupTopNExecutor,
+        )
+
+        gt = RetractableGroupTopNExecutor(
+            group_by=part_cols,
+            order_col=ocol,
+            limit=k,
+            pk=pk,
+            schema_dtypes=schema,
+            desc=desc,
+            capacity=self.capacity,
+            table_id=self._tid(name, "gtopn"),
+        )
+        chain.append(gt)
+        post: Dict[str, E.Expr] = {}
+        out_schema: Dict[str, object] = {}
+        for it in select.items:
+            src = amap.get(it.expr.name)
+            if not isinstance(src, P.Ident):
+                return None  # inner item is computed: window path
+            incol = binder.resolve(src)
+            out = it.alias or it.expr.name
+            post[out] = E.col(incol)
+            out_schema[out] = schema[incol]
+        out_pk = []
+        for pcol in pk:
+            target = pcol
+            existing = post.get(pcol)
+            if existing is not None and not (
+                isinstance(existing, E.Col) and existing.name == pcol
+            ):
+                # an outer alias SHADOWS the pk name: keying the MV on
+                # the aliased values would collide rows — carry the
+                # real pk under a hidden name instead
+                target = f"_pk_{pcol}"
+            post[target] = E.col(pcol)
+            out_schema[target] = schema[pcol]
+            out_pk.append(target)
+        chain.append(ProjectExecutor(post))
+        rel = BoundRel(
+            chain, out_schema, tuple(out_pk), bound_rel.source,
+            bound_rel.alias,
+        )
+        mview = self._make_mview(name, rel)
+        chain.append(mview)
+        return PlannedMV(
+            name,
+            Pipeline(chain),
+            mview,
+            {bound_rel.source: "single"},
+            schema=out_schema,
         )
 
     def _plan_over_window(

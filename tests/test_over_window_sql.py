@@ -136,3 +136,39 @@ def test_q9_shape_top1_per_partition():
     out, _ = s.execute("SELECT auction, price, bidder FROM q9 ORDER BY auction")
     assert list(out["price"]) == [300, 500]
     assert list(out["bidder"]) == [8, 11]
+
+
+def test_over_window_to_topn_rule():
+    """row_number() ... WHERE rn <= k plans onto GroupTopN (the
+    reference's over_window_to_topn_rule), not the general window
+    executor — per-group maintenance instead of partition recompute."""
+    from risingwave_tpu.executors.top_n_plain import (
+        RetractableGroupTopNExecutor,
+    )
+
+    s = _session()
+    s.execute(
+        "CREATE MATERIALIZED VIEW t2 AS SELECT auction, price FROM "
+        "(SELECT auction, price, row_number() OVER "
+        "(PARTITION BY auction ORDER BY price DESC) AS rn FROM bid) AS x "
+        "WHERE rn <= 2"
+    )
+    planned = s.catalog.mvs["t2"]
+    assert any(
+        isinstance(e, RetractableGroupTopNExecutor)
+        for e in planned.pipeline.executors
+    ), [type(e).__name__ for e in planned.pipeline.executors]
+    s.execute(
+        "INSERT INTO bid VALUES (1, 0, 10, 0), (1, 0, 30, 0), "
+        "(1, 0, 20, 0), (2, 0, 5, 0)"
+    )
+    out, _ = s.execute("SELECT auction, price FROM t2 ORDER BY price")
+    assert sorted(zip(out["auction"], out["price"])) == [
+        (1, 20), (1, 30), (2, 5),
+    ]
+    # a new maximum displaces the group's k-th row
+    s.execute("INSERT INTO bid VALUES (1, 0, 40, 0)")
+    out, _ = s.execute("SELECT auction, price FROM t2 ORDER BY price")
+    assert sorted(zip(out["auction"], out["price"])) == [
+        (1, 30), (1, 40), (2, 5),
+    ]
