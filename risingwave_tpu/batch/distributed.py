@@ -83,6 +83,16 @@ class DistributedBatchRunner:
             and i.expr.name in ("count", "sum", "min", "max")
             for i in stmt.items
         )
+        # extended aggregates (avg/var/stddev/bool_*) have no partial-
+        # merge rule here; grouped ones are exact anyway (hash-disjoint
+        # groups, concatenation merges) but GLOBAL ones must run local
+        from risingwave_tpu.sql.planner import EXTENDED_AGGS
+
+        if not stmt.group_by and any(
+            isinstance(i.expr, P.FuncCall) and i.expr.name in EXTENDED_AGGS
+            for i in stmt.items
+        ):
+            return None
 
         # -- partition (leaf scan tasks over vnode ranges) --------------
         if stmt.group_by:

@@ -16,7 +16,12 @@ import numpy as np
 from risingwave_tpu.array.chunk import DataChunk
 from risingwave_tpu.executors.materialize import MaterializeExecutor
 from risingwave_tpu.sql import parser as P
-from risingwave_tpu.sql.planner import AGG_FUNCS, Binder, compile_scalar
+from risingwave_tpu.sql.planner import (
+    AGG_FUNCS,
+    EXTENDED_AGGS,
+    Binder,
+    compile_scalar,
+)
 
 
 class BatchQueryEngine:
@@ -124,7 +129,10 @@ class BatchQueryEngine:
             out = {}
             chunk_cache = [None]
             for i, item in enumerate(stmt.items):
-                if isinstance(item.expr, P.FuncCall) and item.expr.name in AGG_FUNCS:
+                if isinstance(item.expr, P.FuncCall) and (
+                    item.expr.name in AGG_FUNCS
+                    or item.expr.name in EXTENDED_AGGS
+                ):
                     name = item.alias or f"{item.expr.name}_{i}"
                     vals, isnull = self._scalar_agg(item.expr, cols, n, binder)
                     out[name] = vals
@@ -342,6 +350,8 @@ class BatchQueryEngine:
         NULL — returned as (values, is_null) so the caller emits the
         ``__null`` companion; count(*) / count(col) never is."""
         if fc.args == ("*",):
+            if fc.name != "count":
+                raise ValueError(f"{fc.name}(*) unsupported")
             return np.array([n]), False
         x = np.asarray(cols[binder.resolve(fc.args[0])])
         if x.dtype == object:
@@ -354,6 +364,20 @@ class BatchQueryEngine:
             return np.array([len(live)]), False
         if len(live) == 0:
             return np.array([0]), True
+        if fc.name in EXTENDED_AGGS:
+            if fc.name in ("bool_and", "bool_or"):
+                b = live.astype(bool)
+                return np.array([b.all() if fc.name == "bool_and" else b.any()]), False
+            f = live.astype(np.float64)
+            if fc.name == "avg":
+                return np.array([f.mean()]), False
+            ddof = 0 if fc.name.endswith("_pop") else 1
+            if len(f) <= ddof:
+                return np.array([0.0]), True  # var_samp of 1 row = NULL
+            var = f.var(ddof=ddof)
+            if fc.name.startswith("stddev"):
+                return np.array([np.sqrt(var)]), False
+            return np.array([var]), False
         fn = {"sum": np.sum, "min": np.min, "max": np.max}[fc.name]
         return np.array([fn(live)]), False
 
@@ -431,10 +455,26 @@ class BatchQueryEngine:
         import pandas as pd
 
         df = pd.DataFrame(cols)
+        # coerced-numeric companions for extended aggregates (object
+        # lanes carry None cells; to_numeric makes them NaN, which every
+        # pandas reducer skips — PG NULL-skipping semantics)
+        for item in stmt.items:
+            fc = item.expr
+            if (
+                isinstance(fc, P.FuncCall)
+                and fc.name in EXTENDED_AGGS
+                and fc.args != ("*",)
+            ):
+                col = binder.resolve(fc.args[0])
+                if f"__num_{col}" not in df:
+                    df[f"__num_{col}"] = pd.to_numeric(
+                        df[col], errors="coerce"
+                    )
         gb = df.groupby(keys, sort=False)
         out: Dict[str, np.ndarray] = {}
         frames = {}
         src_cols: Dict[str, str] = {}
+        ext_kinds: Dict[str, str] = {}
         for i, item in enumerate(stmt.items):
             if isinstance(item.expr, P.Ident):
                 name = binder.resolve(item.expr)
@@ -442,11 +482,31 @@ class BatchQueryEngine:
                     raise ValueError(f"{name!r} not in GROUP BY")
                 continue
             fc = item.expr
-            if not (isinstance(fc, P.FuncCall) and fc.name in AGG_FUNCS):
+            if not (
+                isinstance(fc, P.FuncCall)
+                and (fc.name in AGG_FUNCS or fc.name in EXTENDED_AGGS)
+            ):
                 raise ValueError("items must be keys or aggregates")
             name = item.alias or f"{fc.name}_{i}"
             if fc.args == ("*",):
+                if fc.name != "count":
+                    raise ValueError(f"{fc.name}(*) unsupported")
                 frames[name] = gb.size()
+            elif fc.name in EXTENDED_AGGS:
+                col = f"__num_{binder.resolve(fc.args[0])}"
+                ext_kinds[name] = fc.name
+                if fc.name == "avg":
+                    frames[name] = gb[col].mean()
+                elif fc.name == "bool_and":
+                    frames[name] = gb[col].min()  # finished to bool below
+                elif fc.name == "bool_or":
+                    frames[name] = gb[col].max()
+                else:  # var/stddev: NaN when n <= ddof (samp of 1 row)
+                    ddof = 0 if fc.name.endswith("_pop") else 1
+                    v = gb[col].var(ddof=ddof)
+                    frames[name] = (
+                        np.sqrt(v) if fc.name.startswith("stddev") else v
+                    )
             elif fc.name == "sum":
                 # min_count=1: sum over an all-NULL group is SQL NULL
                 # (pandas' default min_count=0 would fabricate a 0)
@@ -495,4 +555,10 @@ class BatchQueryEngine:
                 out[name + "__null"] = nl
             else:
                 out[name] = lane.to_numpy()
+        # finish bool aggregates: min/max over the 0/1 numeric lane
+        for name, kind in ext_kinds.items():
+            if kind in ("bool_and", "bool_or"):
+                out[name] = (
+                    np.asarray(out[name], dtype=np.float64) != 0
+                )
         return out

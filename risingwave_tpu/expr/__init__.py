@@ -16,6 +16,7 @@ from risingwave_tpu.expr.expr import (
     Between,
     BinOp,
     Case,
+    Cast,
     Col,
     Expr,
     InList,
@@ -33,6 +34,7 @@ __all__ = [
     "Col",
     "Lit",
     "BinOp",
+    "Cast",
     "And",
     "Or",
     "Not",

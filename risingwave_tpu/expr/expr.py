@@ -165,11 +165,26 @@ class Lit(Expr):
         return jnp.full(chunk.capacity, self.value), None
 
 
+@dataclass(frozen=True, eq=False)
+class Cast(Expr):
+    """Device dtype cast (CAST(x AS t) on fixed-width lanes; logical-
+    type casts — dictionary/decimal rescale — happen at the host
+    edges, sql/typing.py)."""
+
+    inner: Expr
+    dtype: object  # numpy/jnp dtype
+
+    def eval(self, chunk: DataChunk) -> EvalResult:
+        v, n = self.inner.eval(chunk)
+        return v.astype(self.dtype), n
+
+
 _BIN_FNS: dict[str, Callable] = {
     "+": jnp.add,
     "-": jnp.subtract,
     "*": jnp.multiply,
     "//": jnp.floor_divide,
+    "/": jnp.true_divide,  # float division (agg finishing: avg/var)
     "%": jnp.remainder,
     "==": jnp.equal,
     "!=": jnp.not_equal,
@@ -190,7 +205,7 @@ class BinOp(Expr):
         lv, ln = self.left.eval(chunk)
         rv, rn = self.right.eval(chunk)
         nulls = _null_or(ln, rn)
-        if self.op in ("//", "%"):
+        if self.op in ("//", "%", "/"):
             # guard div-by-zero on padding/NULL lanes; SQL raises on a
             # *visible* non-null zero divisor — the host checks that via
             # Filter/Project error lanes later; here we make it NULL so

@@ -647,9 +647,40 @@ class SqlSession:
         else:
             raise ValueError(f"unknown connector {kind!r}")
         fmt = props.get("format", "json")
-        parser = (
-            JsonParser(schema) if fmt == "json" else CsvParser(schema)
-        )
+        if fmt == "json":
+            parser = JsonParser(schema)
+        elif fmt == "csv":
+            parser = CsvParser(schema)
+        elif fmt == "debezium":
+            # Debezium CDC envelopes: op r/c -> insert, u -> retract +
+            # reinsert, d -> delete (reference FORMAT DEBEZIUM,
+            # src/connector/src/parser/debezium/)
+            from risingwave_tpu.connectors.framework import (
+                DebeziumJsonParser,
+            )
+
+            parser = DebeziumJsonParser(schema)
+        elif fmt == "upsert_json":
+            from risingwave_tpu.connectors.framework import (
+                UpsertJsonParser,
+            )
+
+            parser = UpsertJsonParser(schema)
+        elif fmt == "avro":
+            from risingwave_tpu.connectors.avro import AvroParser
+
+            if "avro_schema" not in props:
+                raise ValueError(
+                    "format='avro' needs avro_schema='...' in WITH (...)"
+                )
+            parser = AvroParser(
+                schema,
+                props["avro_schema"],
+                registry_framed=props.get("registry_framed", "")
+                .lower() in ("true", "t", "1"),
+            )
+        else:
+            raise ValueError(f"unknown source format {fmt!r}")
         src = GenericSourceExecutor(
             conn, parser, table_id=f"{name}.source", strings=self.strings
         )

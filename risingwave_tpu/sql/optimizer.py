@@ -420,10 +420,10 @@ def _push_into(node, conjuncts: List[object]):
 
 
 def _contains_agg(ast) -> bool:
-    from risingwave_tpu.sql.planner import AGG_FUNCS
+    from risingwave_tpu.sql.planner import AGG_FUNCS, EXTENDED_AGGS
 
     if isinstance(ast, P.FuncCall):
-        if ast.name in AGG_FUNCS:
+        if ast.name in AGG_FUNCS or ast.name in EXTENDED_AGGS:
             return True
         return any(
             _contains_agg(a) for a in ast.args if not isinstance(a, str)

@@ -47,6 +47,83 @@ from risingwave_tpu.types import Schema
 
 AGG_FUNCS = {"count": "count", "sum": "sum", "min": "min", "max": "max"}
 
+# Composite aggregates lowered onto the base kinds + a finishing
+# projection (the reference ships these as first-class agg kernels,
+# src/expr/impl/src/aggregate/general.rs + stddev via sum/count
+# decomposition in the frontend; here the decomposition IS the plan:
+# hidden sum/count/sum-of-squares calls feed one post-agg Project, so
+# retraction, checkpointing, sharding, and two-phase splits all come
+# for free from the base machinery).
+EXTENDED_AGGS = (
+    "avg",
+    "var_pop",
+    "var_samp",
+    "stddev_pop",
+    "stddev_samp",
+    "bool_and",
+    "bool_or",
+)
+
+
+def _ext_agg_acc():
+    """Shared-state accumulator for extended-agg lowering: hidden base
+    calls are DEDUPED by (kind, input) so ``avg(v), stddev_samp(v)``
+    carries one sum(v) + one count(v), not two of each."""
+    return {"calls": [], "pre": {}, "hidden": {}}
+
+
+def _lower_extended_agg(kind: str, incol: str, acc: dict):
+    """Lower one extended aggregate over ``incol`` into a finishing
+    Expr + output dtype, appending its (deduped) base AggCalls and
+    pre-projected inputs (x*x for variance, int cast for bool_and/or)
+    into ``acc``.
+
+    NULL semantics follow PG: avg/var/stddev over zero non-null rows
+    is NULL (0/0 division -> NULL via the non-strict ``/`` guard);
+    var_samp/stddev_samp of a single row is NULL (n-1 = 0).
+    """
+
+    def base(k: str, col: str):
+        key = (k, col)
+        if key not in acc["hidden"]:
+            out = f"__x{len(acc['hidden'])}"
+            acc["hidden"][key] = out
+            acc["calls"].append(AggCall(k, col, out))
+        return E.col(acc["hidden"][key])
+
+    if kind == "avg":
+        fin = E.BinOp("/", base("sum", incol), base("count", incol))
+        return fin, jnp.dtype(jnp.float64)
+    if kind in ("bool_and", "bool_or"):
+        bcol = f"__xb_{incol}"
+        acc["pre"][bcol] = (
+            E.Cast(E.col(incol), jnp.int64),
+            jnp.dtype(jnp.int64),
+        )
+        m = base("min" if kind == "bool_and" else "max", bcol)
+        return E.BinOp("!=", m, E.lit(0)), jnp.dtype(jnp.bool_)
+    # variance family: E[x^2] - E[x]^2 (pop) / (q - s*mean)/(n-1) (samp)
+    qcol = f"__xq_{incol}"
+    fx = E.Cast(E.col(incol), jnp.float64)
+    acc["pre"][qcol] = (E.BinOp("*", fx, fx), jnp.dtype(jnp.float64))
+    n = base("count", incol)
+    s = E.Cast(base("sum", incol), jnp.float64)
+    q = base("sum", qcol)
+    mean = E.BinOp("/", s, n)
+    if kind in ("var_pop", "stddev_pop"):
+        var = E.BinOp("-", E.BinOp("/", q, n), E.BinOp("*", mean, mean))
+    else:
+        var = E.BinOp(
+            "/",
+            E.BinOp("-", q, E.BinOp("*", mean, s)),
+            E.BinOp("-", n, E.lit(1)),
+        )
+    from risingwave_tpu.expr import functions as _F
+
+    var = _F.Func("greatest", (var, E.lit(0.0)))  # clamp fp cancellation
+    fin = _F.Func("sqrt", (var,)) if kind.startswith("stddev") else var
+    return fin, jnp.dtype(jnp.float64)
+
 
 @dataclass
 class BoundRel:
@@ -195,7 +272,7 @@ def compile_scalar(ast, binder: Binder) -> E.Expr:
                 a.value for a in ast.args[1:] if isinstance(a, P.Literal)
             )
             return E.InList(e, vals)
-        if ast.name in AGG_FUNCS:
+        if ast.name in AGG_FUNCS or ast.name in EXTENDED_AGGS:
             raise ValueError(f"aggregate {ast.name}() outside GROUP BY select")
         if ast.name == "coalesce":
             return F.Coalesce(
@@ -233,7 +310,9 @@ def compile_scalar(ast, binder: Binder) -> E.Expr:
 
 
 def _is_agg(ast) -> bool:
-    return isinstance(ast, P.FuncCall) and ast.name in AGG_FUNCS
+    return isinstance(ast, P.FuncCall) and (
+        ast.name in AGG_FUNCS or ast.name in EXTENDED_AGGS
+    )
 
 
 def _contains_agg(ast) -> bool:
@@ -507,6 +586,8 @@ class StreamPlanner:
 
             calls: List[AggCall] = []
             out_schema = {}
+            ext_acc = _ext_agg_acc()
+            finishing: Dict[str, object] = {}
             for i, item in enumerate(select.items):
                 ast = item.expr
                 if not _is_agg(ast):
@@ -524,13 +605,47 @@ class StreamPlanner:
                     if not isinstance(arg, P.Ident):
                         raise ValueError("aggregate args must be bare columns")
                     incol = binder.resolve(arg)
+                    if ast.name in EXTENDED_AGGS:
+                        finishing[out], out_schema[out] = (
+                            _lower_extended_agg(ast.name, incol, ext_acc)
+                        )
+                        continue
                     calls.append(AggCall(AGG_FUNCS[ast.name], incol, out))
                     out_schema[out] = schema[incol]
+            calls.extend(ext_acc["calls"])
+            pre_cols = ext_acc["pre"]
+            agg_schema = schema
+            if pre_cols:
+                agg_schema = {
+                    **schema,
+                    **{n: dt for n, (_, dt) in pre_cols.items()},
+                }
+                chain.append(
+                    ProjectExecutor(
+                        {
+                            **{c: E.col(c) for c in schema},
+                            **{n: ex for n, (ex, _) in pre_cols.items()},
+                        }
+                    )
+                )
             chain.append(
                 SimpleAggExecutor(
-                    tuple(calls), schema, table_id=self._tid(name, "sagg")
+                    tuple(calls), agg_schema, table_id=self._tid(name, "sagg")
                 )
             )
+            if finishing:
+                chain.append(
+                    ProjectExecutor(
+                        {
+                            **{
+                                c.output: E.col(c.output)
+                                for c in calls
+                                if not c.output.startswith("__x")
+                            },
+                            **finishing,
+                        }
+                    )
+                )
             return BoundRel(chain, out_schema, (), source, alias)
 
         # no GROUP BY: projection (+ hidden row id when no pk exists)
@@ -941,6 +1056,8 @@ class StreamPlanner:
         aggs: List[AggCall] = []
         out_schema: Dict[str, object] = {}
         chain: List[Executor] = []
+        ext_acc = _ext_agg_acc()  # deduped hidden calls + pre inputs
+        finishing: Dict[str, object] = {}  # visible out -> Expr over hidden
         for i, item in enumerate(select.items):
             ast = item.expr
             if _is_agg(ast):
@@ -958,6 +1075,13 @@ class StreamPlanner:
                             "(project first)"
                         )
                     incol = binder.resolve(arg)
+                    if ast.name in EXTENDED_AGGS:
+                        fin, odt = _lower_extended_agg(
+                            ast.name, incol, ext_acc
+                        )
+                        finishing[out] = fin
+                        out_schema[out] = odt
+                        continue
                     kind = AGG_FUNCS[ast.name]
                     aggs.append(
                         AggCall(
@@ -985,12 +1109,37 @@ class StreamPlanner:
             for it in select.items
             if isinstance(it.expr, P.Ident) and it.alias
         }
+        for c in ext_acc["calls"]:
+            aggs.append(
+                AggCall(
+                    c.kind,
+                    c.input,
+                    c.output,
+                    materialized=retractable and c.kind in ("min", "max"),
+                )
+            )
+        pre_cols = ext_acc["pre"]
         if aggs:
+            agg_schema = schema
+            if pre_cols:
+                # hidden agg inputs (x*x, bool->int) projected in front
+                agg_schema = {
+                    **schema,
+                    **{n: dt for n, (_, dt) in pre_cols.items()},
+                }
+                chain.append(
+                    ProjectExecutor(
+                        {
+                            **{c: E.col(c) for c in schema},
+                            **{n: ex for n, (ex, _) in pre_cols.items()},
+                        }
+                    )
+                )
             chain.append(
                 HashAggExecutor(
                     group_keys=keys,
                     calls=tuple(aggs),
-                    schema_dtypes=schema,
+                    schema_dtypes=agg_schema,
                     capacity=self.capacity,
                     nullable_keys=tuple(k for k in keys if k in nullable_cols),
                     table_id=self._tid(name, "agg"),
@@ -1014,12 +1163,30 @@ class StreamPlanner:
                     table_id=self._tid(name, "dedup"),
                 )
             )
+        visible = [
+            a.output for a in aggs if not a.output.startswith("__x")
+        ] + list(finishing)
+        if finishing:
+            # finishing projection: hidden sums/counts -> user values
+            chain.append(
+                ProjectExecutor(
+                    {
+                        **{k: E.col(k) for k in keys},
+                        **{
+                            a.output: E.col(a.output)
+                            for a in aggs
+                            if not a.output.startswith("__x")
+                        },
+                        **finishing,
+                    }
+                )
+            )
         if renames:
             chain.append(
                 ProjectExecutor(
                     {
                         renames.get(c, c): E.col(c)
-                        for c in (list(keys) + [a.output for a in aggs])
+                        for c in (list(keys) + visible)
                     }
                 )
             )
@@ -1513,6 +1680,12 @@ class StreamPlanner:
                                 "(project first)"
                             )
                         n = self._join_resolve(arg, left, right)
+                        if ast.name in EXTENDED_AGGS:
+                            raise NotImplementedError(
+                                f"{ast.name}() over a joined global "
+                                "aggregate: wrap the join in a derived-"
+                                "table MV first"
+                            )
                         calls.append(AggCall(AGG_FUNCS[ast.name], n, out))
                         agg_schema[out] = merged[n]
                     return P.Ident(out)

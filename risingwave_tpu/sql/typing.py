@@ -70,6 +70,12 @@ def infer_output_fields(stmt, catalog) -> Dict[str, Field]:
                 continue
             if expr.name in ("count",):
                 out[name] = Field(name, DataType.INT64)
+            elif expr.name in (
+                "var_pop", "var_samp", "stddev_pop", "stddev_samp",
+            ):
+                out[name] = Field(name, DataType.FLOAT64)
+            elif expr.name in ("bool_and", "bool_or"):
+                out[name] = Field(name, DataType.BOOLEAN)
             elif expr.name in ("sum", "min", "max", "avg") and expr.args:
                 arg = expr.args[0]
                 if isinstance(arg, P.Ident):
