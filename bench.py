@@ -632,6 +632,18 @@ def _bank_partial(merged: dict) -> None:
     os.replace(tmp, PARTIAL_PATH)
 
 
+def _child_timeout(query: str, tier: str) -> int:
+    """Per-(query, tier) child alarm. q7's compile stack (grouped-max
+    DynamicFilter + retracting join) is the deepest; it has blown tier
+    alarms at smoke_dev AND mid and wedged the tunnel each time — it
+    runs DEAD-last now, so generous headroom costs only its own tier.
+    q5u compiles one program per executor (vs q5's single fused
+    program) and measures the run TWICE (sync + pipelined)."""
+    base = TIERS[tier][3]
+    mult = {"q7": 2.5, "q5u": 2.0}.get(query, 1.0)
+    return int(base * mult)
+
+
 def _run_child(query: str, tier: str, smoke: bool, agg_mode: str):
     """Run one (query, tier) in a subprocess. The child installs
     signal.alarm(timeout) and exits through normal JAX teardown on
@@ -641,18 +653,8 @@ def _run_child(query: str, tier: str, smoke: bool, agg_mode: str):
 
     import os
 
-    epochs, events, chunk, timeout_s = TIERS[tier]
-    if query == "q7":
-        # q7's compile stack (grouped-max DynamicFilter + retracting
-        # join) is the deepest; its r05 mid-tier run blew the shared
-        # tier alarm and wedged the tunnel — give it 1.5x headroom
-        timeout_s = int(timeout_s * 1.5)
-    elif query == "q5u":
-        # the unified actor path compiles one program per executor
-        # (vs q5's single fused program) and measures the run TWICE
-        # (sync + pipelined); its r05 smoke run blew the 210s barrier
-        # deadman while still inside warmup compiles — 2x headroom
-        timeout_s = int(timeout_s * 2)
+    epochs, events, chunk, _ = TIERS[tier]
+    timeout_s = _child_timeout(query, tier)
     cmd = [
         sys.executable,
         os.path.abspath(__file__),
@@ -706,6 +708,7 @@ def _run_child(query: str, tier: str, smoke: bool, agg_mode: str):
 
 
 def _bench_one(query: str, epochs, events, chunk, smoke, agg_mode):
+    _enable_compile_cache()
     gen_cfg = {"first_event_rate": 10_000}
     if query == "q5":
         return bench_q5(epochs, events, chunk, smoke, agg_mode)
@@ -716,6 +719,17 @@ def _bench_one(query: str, epochs, events, chunk, smoke, agg_mode):
     if query == "q7":
         return bench_q7(gen_cfg, epochs, events, chunk)
     raise ValueError(query)
+
+
+def _enable_compile_cache():
+    """Persistent XLA compilation cache shared across bench children
+    and watcher re-runs: first-epoch compiles dominate every TPU tier
+    (q7's stack alone has blown multiple tier alarms and wedged the
+    tunnel), and identical HLO recompiles from scratch in each fresh
+    subprocess without this. Safe no-op if the backend refuses."""
+    from risingwave_tpu.config import enable_compile_cache
+
+    enable_compile_cache()
 
 
 def main():
@@ -852,37 +866,44 @@ def main():
     failed: set = set()  # (query) that failed — don't escalate those
     # q5u FIRST: the unified SQL->actor path is the headline system
     # (VERDICT r4 weak #1 — the benched system must be the built
-    # system); q5 (apply_stacked direct) stays as the fusion oracle
-    for tier in tiers:  # BREADTH-first: every query lands small numbers
-        for query in ("q5u", "q5", "q8", "q7"):
-            if dead or query in failed:
-                continue
-            # worst case this child costs: its timeout + 45s communicate
-            # grace + 30s SIGTERM drain + a 75s post-failure device
-            # probe — all of it must fit before the finalize reserve
-            child_budget = TIERS[tier][3] + 45 + 30 + 75 + _FINALIZE_RESERVE_S
-            if remaining() < child_budget:
-                errors.append(
-                    f"{query}/{tier}: skipped (budget: {remaining():.0f}s "
-                    f"left, need {child_budget}s)"
-                )
-                continue
-            sub, err = _run_child(query, tier, args.smoke, args.agg_mode)
-            if sub is not None:
-                sub[f"{query}_tier" if query != "q5" else "tier"] = tier
-                merged.update(sub)  # larger tier overwrites smaller
-            else:
-                errors.append(err)
-                failed.add(query)
-            snapshot = dict(merged)
-            if errors:
-                snapshot["errors"] = list(errors)
-            _bank_partial(snapshot)  # success AND failure: bank now
-            if sub is None and not args.smoke and not _device_alive():
-                # the failed child wedged the tunnel: stop risking the
-                # banked results; report what we have
-                errors.append(f"{query}/{tier}: device wedged; stopping")
-                dead = True
+    # system); q5 (apply_stacked direct) stays as the fusion oracle.
+    # q7 runs DEAD-LAST across all tiers: it has wedged the tunnel on
+    # every r05 attempt (smoke_dev AND mid), and a wedge stops the
+    # whole run — it must never cost the other queries their
+    # escalation to mid/full.
+    schedule = [(t, q) for t in tiers for q in ("q5u", "q5", "q8")]
+    schedule += [(t, "q7") for t in tiers]
+    for tier, query in schedule:
+        if dead or query in failed:
+            continue
+        # worst case this child costs: its (per-query multiplied)
+        # timeout + 45s communicate grace + 30s SIGTERM drain + a 75s
+        # post-failure device probe — all before the finalize reserve
+        child_budget = (
+            _child_timeout(query, tier) + 45 + 30 + 75 + _FINALIZE_RESERVE_S
+        )
+        if remaining() < child_budget:
+            errors.append(
+                f"{query}/{tier}: skipped (budget: {remaining():.0f}s "
+                f"left, need {child_budget}s)"
+            )
+            continue
+        sub, err = _run_child(query, tier, args.smoke, args.agg_mode)
+        if sub is not None:
+            sub[f"{query}_tier" if query != "q5" else "tier"] = tier
+            merged.update(sub)  # larger tier overwrites smaller
+        else:
+            errors.append(err)
+            failed.add(query)
+        snapshot = dict(merged)
+        if errors:
+            snapshot["errors"] = list(errors)
+        _bank_partial(snapshot)  # success AND failure: bank now
+        if sub is None and not args.smoke and not _device_alive():
+            # the failed child wedged the tunnel: stop risking the
+            # banked results; report what we have
+            errors.append(f"{query}/{tier}: device wedged; stopping")
+            dead = True
     if "value" in merged:
         # keep the apply_stacked (fusion-oracle) number visible next to
         # the headline before q5u overwrites the driver fields

@@ -86,3 +86,30 @@ def load_config(
         else:
             setattr(obj, key, v)
     return cfg
+
+
+def enable_compile_cache(cache_dir: str = None) -> str:
+    """Point JAX's persistent XLA compilation cache at ``cache_dir``
+    (default: ``<repo>/.jax_cache``) so identical compiles re-load
+    across processes — bench children, watcher re-runs, and test runs
+    all share it. Best-effort: returns the dir, or "" on refusal."""
+    import os
+
+    import jax
+
+    d = (
+        cache_dir
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_cache",
+        )
+    )
+    try:
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", d)
+    except Exception:
+        return ""
+    return d
