@@ -49,11 +49,18 @@ class MaterializeExecutor(Executor, Checkpointable):
         pk: Sequence[str],
         columns: Sequence[str],
         table_id: str = "mview",
+        conflict_resolve: bool = False,
     ):
         self.pk = tuple(pk)
         self.columns = tuple(columns)
         self.rows: Dict[Tuple, Tuple] = {}
         self.table_id = table_id
+        # ConflictBehavior::Overwrite with DOWNSTREAM-CORRECT emission
+        # (materialize.rs:192-230): an insert on an existing pk emits
+        # UpdateDelete(stored) + UpdateInsert(new); a delete emits the
+        # STORED row; a delete of an absent pk is dropped. User-pk
+        # tables set this so MVs over them see real retractions.
+        self.conflict_resolve = bool(conflict_resolve)
         self._changed: set = set()  # python path: pks since checkpoint
         self._dtypes: Dict[str, np.dtype] = {}
         self._native = None  # NativeMvMap once eligible
@@ -67,7 +74,9 @@ class MaterializeExecutor(Executor, Checkpointable):
     _force_python = False  # subclasses needing row hooks pin the dict
 
     def _pick_backend(self, chunk: StreamChunk, data) -> None:
-        if self._force_python:
+        if self._force_python or self.conflict_resolve:
+            # conflict resolution reads stored rows per key — the
+            # python dict is the value store
             self._backend = "python"
             return
         names = self.pk + self.columns
@@ -99,6 +108,14 @@ class MaterializeExecutor(Executor, Checkpointable):
                 self._dtypes[name] = data[name].dtype
         if self._backend is None:
             self._pick_backend(chunk, data)
+        if self._backend == "native" and any(
+            nm in chunk.nulls for nm in self.pk + self.columns
+        ):
+            # the int matrix cannot represent NULL cells (a later
+            # UPDATE ... SET c = NULL on an all-int table): migrate to
+            # the python dict, folding un-drained pending deltas into
+            # the changed-key set so checkpointing stays exact
+            self._demote_to_python()
         is_del = (ops == Op.DELETE) | (ops == Op.UPDATE_DELETE)
         if self._backend == "native":
             keys = (
@@ -116,8 +133,87 @@ class MaterializeExecutor(Executor, Checkpointable):
             self._native.apply(keys, vals, is_del)
             self._pending.append((keys, vals, is_del.astype(np.uint8)))
             return [chunk]
+        if self.conflict_resolve:
+            return self._apply_resolve(data, ops, n)
         self._apply_python(data, ops, is_del, n)
         return [chunk]
+
+    def _demote_to_python(self) -> None:
+        keys, vals = self._native.dump()
+        self.rows = {
+            tuple(k): tuple(v) for k, v in zip(keys.tolist(), vals.tolist())
+        }
+        for pk_arr, _, _ in self._pending:
+            for kt in map(tuple, pk_arr.tolist()):
+                self._changed.add(kt)
+        self._pending = []
+        self._native = None
+        self._backend = "python"
+
+    def _apply_resolve(self, data, ops, n) -> List[StreamChunk]:
+        """Row-ordered conflict resolution against the stored map; the
+        returned chunk is what downstream operators must see to stay
+        consistent with this table (retractions included)."""
+        names = self.pk + self.columns
+        cols_l = {}
+        for name in names:
+            col = data[name].tolist()
+            nl = data.get(name + "__null")
+            if nl is not None:
+                col = [None if b else v for v, b in zip(col, nl)]
+            cols_l[name] = col
+        out_rows: List[Tuple[int, Tuple, Tuple]] = []
+        for i in range(n):
+            k = tuple(cols_l[nm][i] for nm in self.pk)
+            self._changed.add(k)
+            if ops[i] in (Op.INSERT, Op.UPDATE_INSERT):
+                v = tuple(cols_l[nm][i] for nm in self.columns)
+                old = self.rows.get(k)
+                if old is not None:
+                    out_rows.append((int(Op.UPDATE_DELETE), k, old))
+                    out_rows.append((int(Op.UPDATE_INSERT), k, v))
+                else:
+                    op = (
+                        int(Op.UPDATE_INSERT)
+                        if ops[i] == Op.UPDATE_INSERT
+                        else int(Op.INSERT)
+                    )
+                    out_rows.append((op, k, v))
+                self.rows[k] = v
+            else:
+                old = self.rows.pop(k, None)
+                if old is None:
+                    continue  # delete of an absent pk: dropped
+                op = (
+                    int(Op.UPDATE_DELETE)
+                    if ops[i] == Op.UPDATE_DELETE
+                    else int(Op.DELETE)
+                )
+                out_rows.append((op, k, old))
+        if not out_rows:
+            return []
+        m = len(out_rows)
+        cap = max(2, 1 << (m - 1).bit_length())
+        cols: Dict[str, np.ndarray] = {}
+        nulls: Dict[str, np.ndarray] = {}
+        for j, nm in enumerate(names):
+            pk_n = len(self.pk)
+            vals = [
+                (r[1][j] if j < pk_n else r[2][j - pk_n]) for r in out_rows
+            ]
+            mask = np.asarray([v is None for v in vals], bool)
+            dt = self._dtypes.get(nm, np.dtype(np.int64))
+            cols[nm] = np.asarray(
+                [0 if v is None else v for v in vals], dt
+            )
+            if mask.any():
+                nulls[nm] = mask
+        out_ops = np.asarray([r[0] for r in out_rows], np.int32)
+        return [
+            StreamChunk.from_numpy(
+                cols, cap, ops=out_ops, nulls=nulls or None
+            )
+        ]
 
     def _apply_python(self, data, ops, is_del, n):
         # NULL pk components fold into the key tuple as None (SQL NULL
@@ -251,8 +347,6 @@ class MaterializeExecutor(Executor, Checkpointable):
             row = self.rows.get(k)
             if row is None:
                 tombs.append(k)
-            elif any(v is None for v in row):
-                raise ValueError("NULL value persistence not supported yet")
             else:
                 ups.append((k, row))
         n = len(ups) + len(tombs)
@@ -265,12 +359,22 @@ class MaterializeExecutor(Executor, Checkpointable):
         value_cols = {}
         for j, name in enumerate(self.columns):
             pad = np.zeros(len(tombs), dtype=self._dtypes[name])
+            vals = [r[j] for _, r in ups]
             value_cols[f"v{j}"] = np.concatenate(
                 [
-                    np.array([r[j] for _, r in ups], dtype=self._dtypes[name]),
+                    np.array(
+                        [0 if v is None else v for v in vals],
+                        dtype=self._dtypes[name],
+                    ),
                     pad,
                 ]
             ) if ups else pad
+            # NULL cells persist as a bool companion lane (restore
+            # reads it back). Emitted UNCONDITIONALLY: SST merges for
+            # one table_id need every delta to carry the same lane set
+            value_cols[f"vn{j}"] = np.array(
+                [v is None for v in vals] + [False] * len(tombs), bool
+            )
         tombstone = np.zeros(n, bool)
         tombstone[len(ups):] = True
         self._changed.clear()
@@ -293,9 +397,13 @@ class MaterializeExecutor(Executor, Checkpointable):
         if not key_cols:
             return
         n = len(next(iter(key_cols.values())))
-        ints = not self._force_python and all(
-            np.issubdtype(np.asarray(a).dtype, np.integer)
-            for a in list(key_cols.values()) + list(value_cols.values())
+        ints = (
+            not self._force_python
+            and not self.conflict_resolve  # resolve reads the dict
+            and all(
+                np.issubdtype(np.asarray(a).dtype, np.integer)
+                for a in list(key_cols.values()) + list(value_cols.values())
+            )  # vn{j} NULL companions are bool -> python path
         )
         if ints:
             try:
@@ -331,12 +439,17 @@ class MaterializeExecutor(Executor, Checkpointable):
             except (RuntimeError, OSError):
                 self._backend = None
         self._backend = "python"
+        nls = [
+            value_cols.get(f"vn{j}") for j in range(len(self.columns))
+        ]
         for i in range(n):
             k = tuple(
                 key_cols[f"k{j}"][i].item() for j in range(len(self.pk))
             )
             v = tuple(
-                value_cols[f"v{j}"][i].item()
+                None
+                if nls[j] is not None and bool(nls[j][i])
+                else value_cols[f"v{j}"][i].item()
                 for j in range(len(self.columns))
             )
             self.rows[k] = v

@@ -28,6 +28,11 @@ class RowIdGenExecutor(Executor, Checkpointable):
         self._committed = -1
 
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
+        if self.out_col in chunk.columns:
+            # DML deletes/updates address existing rows BY id — never
+            # reassign (reference row_id_gen.rs only fills fresh
+            # inserts; deletes carry the stored row)
+            return [chunk]
         ids = self._base + jnp.arange(chunk.capacity, dtype=jnp.int64)
         self._base += chunk.capacity
         return [chunk.with_columns(**{self.out_col: ids})]

@@ -263,13 +263,18 @@ class SqlSession:
             )
             if stmt.pk:
                 # user pk: upsert table (create_table.rs pk handling) —
-                # probe-able by temporal joins; no hidden row id
+                # probe-able by temporal joins; no hidden row id.
+                # conflict_resolve: a pk-conflicting INSERT emits
+                # UpdateDelete(stored) + UpdateInsert(new) downstream,
+                # so MVs over this table see real retractions
+                # (materialize.rs:192-230 Overwrite)
                 mview = MaterializeExecutor(
                     pk=stmt.pk,
                     columns=tuple(
                         ln for ln in lane_names if ln not in stmt.pk
                     ),
                     table_id=f"{stmt.name}.table",
+                    conflict_resolve=True,
                 )
                 chain = [mview]
             else:
@@ -437,6 +442,11 @@ class SqlSession:
             # writes, so advance the barrier clock here
             self.runtime.barrier()
             return {}, f"INSERT 0 {n}"
+        if isinstance(stmt, (P.DeleteFrom, P.UpdateSet)):
+            n = self._execute_delete_update(stmt)
+            self.runtime.barrier()
+            verb = "DELETE" if isinstance(stmt, P.DeleteFrom) else "UPDATE"
+            return {}, f"{verb} {n}"
         from risingwave_tpu.sql.typing import typecheck_select
 
         stmt = typecheck_select(stmt, self.catalog, self.strings)
@@ -444,6 +454,166 @@ class SqlSession:
         out = self._decode_output(stmt, out)
         n = len(next(iter(out.values()))) if out else 0
         return out, f"SELECT {n}"
+
+    def _execute_delete_update(self, stmt) -> int:
+        """DELETE FROM / UPDATE ... SET over a base table (reference:
+        handler/dml.rs -> batch delete/update executors feeding the
+        table's DML channel). The matching stored rows become a
+        retraction chunk pushed through the table's own fragment, so
+        the table state AND every subscribed MV converge together."""
+        from risingwave_tpu.array.chunk import StreamChunk
+        from risingwave_tpu.sql.planner import Binder, compile_scalar
+        from risingwave_tpu.types import Op
+
+        name = stmt.table
+        if (
+            name not in self.catalog.tables
+            or self.catalog.is_mv(name)
+            or name in self.sources
+        ):
+            raise ValueError(f"{name!r} is not a DML-writable table")
+        mview = self.batch.tables.get(name)
+        if mview is None or name not in self.runtime.fragments:
+            raise KeyError(f"unknown table {name!r}")
+        cols = mview.to_numpy()
+        nrows = len(next(iter(cols.values()))) if cols else 0
+        if nrows == 0:
+            return 0
+        schema = self.catalog.tables[name]
+        sets = getattr(stmt, "sets", ())
+        for c, _ in sets:
+            if c not in schema.names:
+                raise KeyError(f"unknown column {c!r}")
+            if c in getattr(mview, "pk", ()):
+                raise ValueError(
+                    f"UPDATE of primary-key column {c!r} unsupported "
+                    "(DELETE + INSERT instead)"
+                )
+        # type-directed literal rewriting (decimal scales, varchar
+        # codes) through the SAME path SELECT uses: a synthetic select
+        # carrying the WHERE + SET expressions
+        items = [
+            P.SelectItem(P.Ident(f.name), None) for f in schema.fields
+        ] + [
+            P.SelectItem(ex, f"__set{j}") for j, (_, ex) in enumerate(sets)
+        ]
+        sel = P.Select(
+            items=tuple(items),
+            from_=P.TableRef(name, None),
+            where=stmt.where,
+            group_by=(),
+        )
+        from risingwave_tpu.sql.typing import typecheck_select
+
+        sel = typecheck_select(sel, self.catalog, self.strings)
+        where = sel.where
+        set_exprs = [
+            (sets[j][0], sel.items[len(schema.fields) + j].expr)
+            for j in range(len(sets))
+        ]
+        # stored lanes -> numpy (+ null masks out of object lanes)
+        lanes: Dict[str, np.ndarray] = {}
+        nulls_in: Dict[str, np.ndarray] = {}
+        for k, v in cols.items():
+            arr = np.asarray(v)
+            if arr.dtype == object:
+                vals = arr.tolist()
+                nl = np.asarray([x is None for x in vals], bool)
+                arr = np.asarray(
+                    [0 if m else x for x, m in zip(vals, nl.tolist())]
+                )
+                if nl.any():
+                    nulls_in[k] = nl
+            lanes[k] = arr
+        cap = max(2, 1 << (nrows - 1).bit_length())
+        chunk = StreamChunk.from_numpy(lanes, cap, nulls=nulls_in or None)
+        binder = Binder({k: v.dtype for k, v in lanes.items()}, None)
+        if where is not None:
+            kv, kn = compile_scalar(where, binder).eval(chunk)
+            keep = np.asarray(kv).astype(bool)[:nrows]
+            if kn is not None:
+                keep &= ~np.asarray(kn)[:nrows]
+        else:
+            keep = np.ones(nrows, bool)
+        m = int(keep.sum())
+        if m == 0:
+            return 0
+        old_cols = {k: v[:nrows][keep] for k, v in lanes.items()}
+        old_nulls = {
+            k: v[:nrows][keep] for k, v in nulls_in.items()
+        }
+        if not sets:  # DELETE
+            out = StreamChunk.from_numpy(
+                old_cols,
+                max(2, 1 << (m - 1).bit_length()),
+                ops=np.full(m, int(Op.DELETE), np.int32),
+                nulls=old_nulls or None,
+            )
+            self.runtime.push(name, out)
+            return m
+        # UPDATE: evaluate SET expressions over the full chunk, take
+        # the kept rows, and interleave UpdateDelete(old)/
+        # UpdateInsert(new) pairs
+        new_cols = {k: v.copy() for k, v in old_cols.items()}
+        new_nulls = {k: v.copy() for k, v in old_nulls.items()}
+        for cname, ex in set_exprs:
+            nv, nn = compile_scalar(ex, binder).eval(chunk)
+            nv = np.asarray(nv)[:nrows][keep]
+            tgt = lanes[cname].dtype
+            # the INSERT path's overflow guard (chunk.py from_numpy)
+            # must hold here too: never silently wrap/truncate
+            if np.issubdtype(tgt, np.integer) and nv.size:
+                if np.issubdtype(nv.dtype, np.floating):
+                    if not np.all(np.mod(nv, 1) == 0):
+                        raise ValueError(
+                            f"UPDATE value for {cname!r} is not integral"
+                        )
+                info = np.iinfo(tgt)
+                live = (
+                    ~np.asarray(nn)[:nrows][keep]
+                    if nn is not None
+                    else np.ones(m, bool)
+                )
+                if np.any((nv[live] < info.min) | (nv[live] > info.max)):
+                    raise ValueError(
+                        f"UPDATE value overflows column {cname!r} "
+                        f"dtype {tgt}"
+                    )
+            new_cols[cname] = nv.astype(tgt, copy=False)
+            nn_host = (
+                np.asarray(nn)[:nrows][keep]
+                if nn is not None
+                else np.zeros(m, bool)
+            )
+            if nn_host.any():
+                new_nulls[cname] = nn_host
+            else:
+                new_nulls.pop(cname, None)
+        inter_cols = {}
+        inter_nulls = {}
+        for k in old_cols:
+            merged = np.empty(2 * m, old_cols[k].dtype)
+            merged[0::2] = old_cols[k]
+            merged[1::2] = new_cols[k]
+            inter_cols[k] = merged
+            onl = old_nulls.get(k)
+            nnl = new_nulls.get(k)
+            if onl is not None or nnl is not None:
+                mn = np.zeros(2 * m, bool)
+                if onl is not None:
+                    mn[0::2] = onl
+                if nnl is not None:
+                    mn[1::2] = nnl
+                inter_nulls[k] = mn
+        ops = np.empty(2 * m, np.int32)
+        ops[0::2] = int(Op.UPDATE_DELETE)
+        ops[1::2] = int(Op.UPDATE_INSERT)
+        out_cap = max(2, 1 << (2 * m - 1).bit_length())
+        out = StreamChunk.from_numpy(
+            inter_cols, out_cap, ops=ops, nulls=inter_nulls or None
+        )
+        self.runtime.push(name, out)
+        return m
 
     def _register_string_builtins(self) -> None:
         """Dictionary-backed string functions (reference: the string

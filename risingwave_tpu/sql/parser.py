@@ -171,7 +171,28 @@ class InsertValues:
     columns: Optional[Tuple[str, ...]] = None
 
 
-Statement = Union[CreateMaterializedView, CreateTable, Select, InsertValues]
+@dataclass(frozen=True)
+class DeleteFrom:
+    """DELETE FROM t [WHERE pred] (reference: handler/dml.rs ->
+    batch delete executor feeding the table's DML channel)."""
+
+    table: str
+    where: Optional[object] = None
+
+
+@dataclass(frozen=True)
+class UpdateSet:
+    """UPDATE t SET c = expr [, ...] [WHERE pred]."""
+
+    table: str
+    sets: Tuple[Tuple[str, object], ...]  # (column, value expr)
+    where: Optional[object] = None
+
+
+Statement = Union[
+    CreateMaterializedView, CreateTable, Select, InsertValues,
+    DeleteFrom, UpdateSet,
+]
 
 # -------------------------------------------------------------- lexer --
 
@@ -341,6 +362,26 @@ class Parser:
             return InsertValues(
                 table, tuple(rows), tuple(cols) if cols else None
             )
+        if self._accept_word("delete"):
+            self.expect("kw", "from")
+            table = self.expect("ident").value
+            where = self.expr() if self.accept("kw", "where") else None
+            self.expect("eof")
+            return DeleteFrom(table, where)
+        if self._accept_word("update"):
+            table = self.expect("ident").value
+            if not self._accept_word("set"):
+                raise SyntaxError("expected SET after UPDATE <table>")
+            sets = []
+            while True:
+                col = self.expect("ident").value
+                self.expect("op", "=")
+                sets.append((col, self.expr()))
+                if not self.accept("op", ","):
+                    break
+            where = self.expr() if self.accept("kw", "where") else None
+            self.expect("eof")
+            return UpdateSet(table, tuple(sets), where)
         sel = self.select()
         self.expect("eof")
         return sel

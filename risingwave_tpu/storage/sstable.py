@@ -200,7 +200,22 @@ def merge_ssts(
     value_names = list(ssts[-1].meta.value_names)
 
     keys = {n: np.concatenate([s.keys[n] for s in ssts]) for n in ssts[-1].keys}
-    vals = {n: np.concatenate([s.values[n] for s in ssts]) for n in value_names}
+
+    def _val_lane(s, n):
+        # lane-set evolution: a lane absent from an OLDER sst reads as
+        # zeros (bool lanes: False). Concretely: a table's NULL
+        # companion lanes (materialize vn{j}) appear only once its
+        # backend demotes to the nullable python path — rows written
+        # before that are by construction non-NULL.
+        if n in s.values:
+            return s.values[n]
+        ref = ssts[-1].values[n]
+        return np.zeros(s.meta.n_rows, ref.dtype)
+
+    vals = {
+        n: np.concatenate([_val_lane(s, n) for s in ssts])
+        for n in value_names
+    }
     tomb = np.concatenate([s.tombstone for s in ssts])
     epochs = np.concatenate(
         [np.full(s.meta.n_rows, s.meta.epoch, np.int64) for s in ssts]

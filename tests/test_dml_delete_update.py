@@ -1,0 +1,193 @@
+"""DML breadth: DELETE FROM / UPDATE ... SET + pk-upsert retraction.
+
+Reference: handler/dml.rs (batch insert/delete/update executors feed the
+table's DML channel) and mview/materialize.rs:192-230 (Overwrite
+conflict behavior emits UpdateDelete(stored) + UpdateInsert(new), so
+downstream MVs stay consistent with the table).
+"""
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.frontend.session import SqlSession
+from risingwave_tpu.sql import Catalog
+
+pytestmark = pytest.mark.smoke
+
+
+def _sess():
+    return SqlSession(Catalog({}), capacity=1 << 10)
+
+
+def test_delete_from_rowid_table_updates_mv():
+    s = _sess()
+    s.execute("CREATE TABLE t (k BIGINT, v BIGINT)")
+    s.execute(
+        "CREATE MATERIALIZED VIEW m AS "
+        "SELECT k, sum(v) AS sv, count(*) AS n FROM t GROUP BY k"
+    )
+    s.execute("INSERT INTO t VALUES (1, 10), (1, 20), (2, 5)")
+    out, _ = s.execute("SELECT k, sv FROM m ORDER BY k")
+    assert list(out["sv"]) == [30, 5]
+    _, tag = s.execute("DELETE FROM t WHERE v = 20")
+    assert tag == "DELETE 1"
+    out, _ = s.execute("SELECT k, sv, n FROM m ORDER BY k")
+    assert list(out["sv"]) == [10, 5]
+    assert list(out["n"]) == [1, 1]
+    # the table itself shrank too
+    out, _ = s.execute("SELECT k, v FROM t ORDER BY v")
+    assert list(out["v"]) == [5, 10]
+
+
+def test_delete_whole_group_removes_mv_row():
+    s = _sess()
+    s.execute("CREATE TABLE t (k BIGINT, v BIGINT)")
+    s.execute(
+        "CREATE MATERIALIZED VIEW m AS "
+        "SELECT k, count(*) AS n FROM t GROUP BY k"
+    )
+    s.execute("INSERT INTO t VALUES (1, 1), (2, 2)")
+    s.execute("DELETE FROM t WHERE k = 2")
+    out, _ = s.execute("SELECT k, n FROM m ORDER BY k")
+    assert list(out["k"]) == [1]
+
+
+def test_delete_without_where_empties_table():
+    s = _sess()
+    s.execute("CREATE TABLE t (v BIGINT)")
+    s.execute("INSERT INTO t VALUES (1), (2), (3)")
+    _, tag = s.execute("DELETE FROM t")
+    assert tag == "DELETE 3"
+    out, _ = s.execute("SELECT v FROM t")
+    assert len(out.get("v", [])) == 0
+
+
+def test_update_set_updates_table_and_mv():
+    s = _sess()
+    s.execute("CREATE TABLE t (k BIGINT, v BIGINT)")
+    s.execute(
+        "CREATE MATERIALIZED VIEW m AS "
+        "SELECT k, sum(v) AS sv, avg(v) AS a FROM t GROUP BY k"
+    )
+    s.execute("INSERT INTO t VALUES (1, 10), (1, 20), (2, 5)")
+    _, tag = s.execute("UPDATE t SET v = v + 100 WHERE k = 1")
+    assert tag == "UPDATE 2"
+    out, _ = s.execute("SELECT k, sv, a FROM m ORDER BY k")
+    assert list(out["sv"]) == [230, 5]
+    assert list(out["a"]) == pytest.approx([115.0, 5.0])
+    out, _ = s.execute("SELECT v FROM t ORDER BY v")
+    assert list(out["v"]) == [5, 110, 120]
+
+
+def test_pk_upsert_emits_retraction_to_mv():
+    """INSERT on an existing pk = Overwrite: downstream aggregates see
+    UpdateDelete(old) + UpdateInsert(new), not a phantom extra row."""
+    s = _sess()
+    s.execute("CREATE TABLE t (k BIGINT PRIMARY KEY, g BIGINT, v BIGINT)")
+    s.execute(
+        "CREATE MATERIALIZED VIEW m AS "
+        "SELECT g, sum(v) AS sv, count(*) AS n, avg(v) AS a "
+        "FROM t GROUP BY g"
+    )
+    s.execute("INSERT INTO t VALUES (0, 0, 10), (2, 0, 30), (1, 1, 100)")
+    out, _ = s.execute("SELECT g, a FROM m ORDER BY g")
+    assert list(out["a"]) == pytest.approx([20.0, 100.0])
+    s.execute("INSERT INTO t VALUES (0, 0, 50)")  # pk upsert: 10 -> 50
+    out, _ = s.execute("SELECT g, sv, n, a FROM m ORDER BY g")
+    assert list(out["n"]) == [2, 1]  # still two rows in group 0
+    assert list(out["sv"]) == [80, 100]
+    assert list(out["a"]) == pytest.approx([40.0, 100.0])
+
+
+def test_pk_table_delete_and_update():
+    s = _sess()
+    s.execute("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+    s.execute(
+        "CREATE MATERIALIZED VIEW m AS SELECT sum(v) AS sv FROM t"
+    )
+    s.execute("INSERT INTO t VALUES (1, 10), (2, 20), (3, 30)")
+    s.execute("DELETE FROM t WHERE k = 2")
+    out, _ = s.execute("SELECT sv FROM m")
+    assert out["sv"][0] == 40
+    s.execute("UPDATE t SET v = 99 WHERE k = 3")
+    out, _ = s.execute("SELECT sv FROM m")
+    assert out["sv"][0] == 109
+    out, _ = s.execute("SELECT k, v FROM t ORDER BY k")
+    assert list(out["k"]) == [1, 3]
+    assert list(out["v"]) == [10, 99]
+
+
+def test_update_pk_column_rejected():
+    s = _sess()
+    s.execute("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+    s.execute("INSERT INTO t VALUES (1, 10)")
+    with pytest.raises(ValueError, match="primary-key"):
+        s.execute("UPDATE t SET k = 2 WHERE v = 10")
+
+
+def test_delete_on_mv_rejected():
+    s = _sess()
+    s.execute("CREATE TABLE t (v BIGINT)")
+    s.execute("CREATE MATERIALIZED VIEW m AS SELECT count(*) AS n FROM t")
+    with pytest.raises(ValueError, match="not a DML-writable"):
+        s.execute("DELETE FROM m")
+
+
+def test_delete_varchar_predicate():
+    s = _sess()
+    s.execute("CREATE TABLE t (name VARCHAR, v BIGINT)")
+    s.execute("INSERT INTO t VALUES ('a', 1), ('b', 2), ('a', 3)")
+    _, tag = s.execute("DELETE FROM t WHERE name = 'a'")
+    assert tag == "DELETE 2"
+    out, _ = s.execute("SELECT name, v FROM t")
+    assert list(out["name"]) == ["b"]
+
+
+def test_pk_conflict_resolution_survives_recovery():
+    """After a cold restart the restored pk table must KEEP resolving
+    conflicts (restore_state may not flip it onto the int-matrix
+    backend, which cannot emit UpdateDelete(stored))."""
+    from risingwave_tpu.runtime import StreamingRuntime
+    from risingwave_tpu.storage.object_store import MemObjectStore
+
+    store = MemObjectStore()
+    rt = StreamingRuntime(store)
+    s = SqlSession(Catalog({}), rt)
+    s.execute("CREATE TABLE t (k BIGINT PRIMARY KEY, g BIGINT, v BIGINT)")
+    s.execute(
+        "CREATE MATERIALIZED VIEW m AS "
+        "SELECT g, sum(v) AS sv, count(*) AS n FROM t GROUP BY g"
+    )
+    s.execute("INSERT INTO t VALUES (1, 0, 10), (2, 0, 30)")
+    rt.wait_checkpoints()
+
+    rt2 = StreamingRuntime(store)
+    s2 = SqlSession.restore(rt2)
+    s2.execute("INSERT INTO t VALUES (1, 0, 99)")  # upsert post-restore
+    out, _ = s2.execute("SELECT g, sv, n FROM m")
+    assert list(out["n"]) == [2]  # NOT 3: the upsert retracted
+    assert list(out["sv"]) == [129]
+
+
+def test_update_set_null_demotes_native_backend():
+    """UPDATE ... SET c = NULL on an all-int (native-mapped) table:
+    the table must store a real NULL (not 0) and survive checkpoint."""
+    from risingwave_tpu.runtime import StreamingRuntime
+    from risingwave_tpu.storage.object_store import MemObjectStore
+
+    store = MemObjectStore()
+    rt = StreamingRuntime(store)
+    s = SqlSession(Catalog({}), rt)
+    s.execute("CREATE TABLE t (k BIGINT, v BIGINT)")
+    s.execute("INSERT INTO t VALUES (1, 10), (2, 20)")
+    s.execute("UPDATE t SET v = NULL WHERE k = 2")
+    out, _ = s.execute("SELECT k, v FROM t ORDER BY k")
+    assert out["v"][1] is None or (
+        not isinstance(out["v"][1], str) and np.isnan(float(out["v"][1]))
+    )
+    rt.wait_checkpoints()  # NULL value persistence (vn lanes)
+    rt2 = StreamingRuntime(store)
+    s2 = SqlSession.restore(rt2)
+    out, _ = s2.execute("SELECT k, v FROM t ORDER BY k")
+    v1 = out["v"][1]
+    assert v1 is None or (not isinstance(v1, str) and np.isnan(float(v1)))
