@@ -525,6 +525,35 @@ class GenericSourceExecutor(Executor, Checkpointable):
         self.splits = connector.list_splits()
         self.offsets: Dict[str, int] = {s.split_id: 0 for s in self.splits}
         self._committed = dict(self.offsets)
+        # source throttling (the reference's Mutation::Throttle /
+        # ALTER ... SET rate_limit, common/rate_limit.rs): a token
+        # bucket in source RECORDS/sec, refilled on wall time, burst
+        # capped at one second's worth. None = unthrottled.
+        self.rate_limit: Optional[int] = None
+        self._bucket = 0.0
+        self._bucket_t: Optional[float] = None
+        self._poll_rr = 0  # fair-start rotation under throttling
+
+    def set_rate_limit(self, rows_per_s: Optional[int]) -> None:
+        """Throttle change (applies from the next poll — the barrier-
+        mutation analogue in the host-pumped model)."""
+        self.rate_limit = rows_per_s
+        self._bucket = float(rows_per_s) if rows_per_s else 0.0
+        self._bucket_t = None
+
+    def _throttle_allowance(self) -> Optional[int]:
+        if self.rate_limit is None:
+            return None
+        import time as _time
+
+        now = _time.monotonic()
+        if self._bucket_t is not None:
+            self._bucket = min(
+                float(self.rate_limit),
+                self._bucket + (now - self._bucket_t) * self.rate_limit,
+            )
+        self._bucket_t = now
+        return int(self._bucket)
 
     def discover(self) -> List[SplitMeta]:
         """Re-enumerate splits (SourceManager periodic discovery): new
@@ -535,15 +564,39 @@ class GenericSourceExecutor(Executor, Checkpointable):
         return self.splits
 
     def poll(
-        self, max_rows_per_split: int, capacity: int
+        self,
+        max_rows_per_split: int,
+        capacity: int,
+        only: Optional[set] = None,
     ) -> List[StreamChunk]:
-        """Read every split once; returns at most one chunk per split."""
+        """Read every split once (or the ``only`` subset — a parallel
+        source worker reads just its ASSIGNED splits, SourceManager
+        contract); returns at most one chunk per split."""
         out: List[StreamChunk] = []
         staged: Dict[str, int] = {}
-        for s in self.splits:
+        allowance = self._throttle_allowance()
+        splits = self.splits
+        if allowance is not None and splits:
+            # fairness under throttling: rotate the starting split per
+            # poll, or a busy early split starves every later one (the
+            # reference's per-reader rate limit has no such coupling)
+            r = self._poll_rr % len(splits)
+            splits = splits[r:] + splits[:r]
+            self._poll_rr += 1
+        for s in splits:
+            if only is not None and s.split_id not in only:
+                continue
+            limit = max_rows_per_split
+            if allowance is not None:
+                if allowance <= 0:
+                    break  # bucket dry: later splits wait for refill
+                limit = min(limit, allowance)
             raw, new_off = self.connector.read(
-                s, self.offsets[s.split_id], max_rows_per_split
+                s, self.offsets[s.split_id], limit
             )
+            if allowance is not None:
+                allowance -= len(raw)
+                self._bucket -= len(raw)
             if isinstance(self.parser, ChangeParser):
                 pairs = [
                     p

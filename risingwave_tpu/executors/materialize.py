@@ -155,13 +155,7 @@ class MaterializeExecutor(Executor, Checkpointable):
         returned chunk is what downstream operators must see to stay
         consistent with this table (retractions included)."""
         names = self.pk + self.columns
-        cols_l = {}
-        for name in names:
-            col = data[name].tolist()
-            nl = data.get(name + "__null")
-            if nl is not None:
-                col = [None if b else v for v, b in zip(col, nl)]
-            cols_l[name] = col
+        cols_l = self._null_folded(data, names)
         out_rows: List[Tuple[int, Tuple, Tuple]] = []
         for i in range(n):
             k = tuple(cols_l[nm][i] for nm in self.pk)
@@ -215,6 +209,19 @@ class MaterializeExecutor(Executor, Checkpointable):
             )
         ]
 
+    @staticmethod
+    def _null_folded(data, names):
+        """{name: python list with __null-masked cells folded to None}
+        — the one place the NULL-lane representation is interpreted."""
+        out = {}
+        for name in names:
+            col = data[name].tolist()
+            nl = data.get(name + "__null")
+            if nl is not None:
+                col = [None if isnull else v for v, isnull in zip(col, nl)]
+            out[name] = col
+        return out
+
     def _apply_python(self, data, ops, is_del, n):
         # NULL pk components fold into the key tuple as None (SQL NULL
         # group keys are distinct; reference pk serde writes a null tag
@@ -223,14 +230,8 @@ class MaterializeExecutor(Executor, Checkpointable):
         def tuples(names):
             if not names:
                 return [()] * n
-            lanes = []
-            for name in names:
-                col = data[name].tolist()
-                nl = data.get(name + "__null")
-                if nl is not None:
-                    col = [None if isnull else v for v, isnull in zip(col, nl)]
-                lanes.append(col)
-            return list(zip(*lanes))
+            folded = self._null_folded(data, names)
+            return list(zip(*(folded[name] for name in names)))
 
         keys = tuples(self.pk)
         vals = tuples(self.columns)

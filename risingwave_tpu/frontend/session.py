@@ -93,6 +93,12 @@ class SqlSession:
         self.dml = DmlManager(self.runtime, catalog, strings=self.strings)
         # CREATE SOURCE registry: name -> GenericSourceExecutor
         self.sources: Dict[str, object] = {}
+        # split-to-worker assignment authority (SourceManager,
+        # source_manager.rs): discovery + rebalancing + per-worker
+        # disjoint polling (the SourceChangeSplit analogue)
+        from risingwave_tpu.runtime import SourceManager
+
+        self.source_mgr = SourceManager()
         self._register_string_builtins()
         self._replaying = False
         self.meta = None
@@ -166,6 +172,32 @@ class SqlSession:
         stripped = sql.lstrip()
         if stripped[:13].lower().startswith("create source"):
             return self._create_source(stripped)
+        if stripped[:12].lower().startswith("alter source"):
+            # ALTER SOURCE name SET rate_limit = N | DEFAULT — the
+            # reference's throttle mutation (Mutation::Throttle,
+            # handler/alter_streaming_rate_limit.rs); applies from the
+            # next poll in the host-pumped model
+            import re
+
+            m = re.match(
+                r"(?is)^alter\s+source\s+(\w+)\s+set\s+rate_limit\s*=\s*"
+                r"(\d+|default)\s*;?\s*$",
+                stripped,
+            )
+            if not m:
+                raise SyntaxError(
+                    "ALTER SOURCE <name> SET rate_limit = <rows/s|DEFAULT>"
+                )
+            name, val = m.group(1), m.group(2).lower()
+            if name not in self.sources:
+                raise KeyError(f"unknown source {name!r}")
+            self.sources[name].set_rate_limit(
+                None if val == "default" else int(val)
+            )
+            # the throttle is operator-visible config: it must survive
+            # a restore (the DDL log replays this statement)
+            self._log_ddl(stripped)
+            return {}, "ALTER_SOURCE"
         if stripped[:15].lower().startswith("create function"):
             return self._create_function(stripped)
         if stripped[:13].lower().startswith("drop function"):
@@ -855,6 +887,7 @@ class SqlSession:
             conn, parser, table_id=f"{name}.source", strings=self.strings
         )
         self.sources[name] = src
+        self.source_mgr.register(name, src, parallelism=self.parallelism)
         self.catalog.tables[name] = schema
         self.runtime.register_state(src)
         self._log_ddl(sql)
@@ -873,11 +906,17 @@ class SqlSession:
                     # no consumer yet: polling would advance offsets and
                     # permanently drop rows read before the first MV
                     continue
-                src.discover()
-                for chunk in src.poll(max_rows_per_split, capacity):
-                    total += int(np.asarray(chunk.valid).sum())
-                    for frag, side in self.dml._targets.get(name, ()):
-                        self.runtime.push(frag, chunk, side)
+                # periodic discovery + least-loaded assignment of new
+                # splits (source_manager.rs discovery loop); polling
+                # walks each worker slot's DISJOINT split subset
+                self.source_mgr.discover(name)
+                for w in range(self.source_mgr.parallelism(name)):
+                    for chunk in self.source_mgr.poll(
+                        name, w, max_rows_per_split, capacity
+                    ):
+                        total += int(np.asarray(chunk.valid).sum())
+                        for frag, side in self.dml._targets.get(name, ()):
+                            self.runtime.push(frag, chunk, side)
         return total
 
     def _create_function(self, sql: str):

@@ -3,5 +3,12 @@
 from risingwave_tpu.runtime.pipeline import Pipeline, TwoInputPipeline
 from risingwave_tpu.runtime.dml import DmlManager
 from risingwave_tpu.runtime.runtime import StreamingRuntime
+from risingwave_tpu.runtime.source_manager import SourceManager
 
-__all__ = ["DmlManager", "Pipeline", "TwoInputPipeline", "StreamingRuntime"]
+__all__ = [
+    "DmlManager",
+    "Pipeline",
+    "TwoInputPipeline",
+    "StreamingRuntime",
+    "SourceManager",
+]

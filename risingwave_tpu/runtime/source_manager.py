@@ -1,0 +1,151 @@
+"""SourceManager — split-to-worker assignment with periodic discovery
+and rebalancing.
+
+Reference: src/meta/src/stream/source_manager.rs (54+): meta owns the
+split set per source, discovers new partitions on a tick, assigns each
+split to exactly one source actor, and ships assignment changes to the
+actors as ``SourceChangeSplit`` barrier mutations; offsets travel with
+the split so a reassigned split resumes exactly.
+
+TPU re-design: the source executor is a host-side object (device work
+starts after parsing), so "actors" here are WORKER SLOTS — disjoint
+split subsets polled independently (a graph-mode session polls one
+slot per parallel source instance; serial mode uses one slot). The
+manager owns only the assignment; offsets stay in the executor's
+checkpointable state, so rebalancing is metadata-only and exactly-once
+survives any reassignment.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+
+class SourceManager:
+    """Assignment authority for every registered source.
+
+    Invariants:
+    - every discovered split is owned by exactly one worker slot;
+    - rebalancing moves the MINIMUM number of splits (new splits fill
+      the least-loaded slots first; a parallelism change reflows only
+      the splits that must move);
+    - offsets are never touched here (they live with the executor).
+    """
+
+    def __init__(self):
+        # name -> (executor, parallelism, {split_id: worker})
+        self._sources: Dict[str, Tuple[object, int, Dict[str, int]]] = {}
+        self.changes_log: List[Tuple[str, str, int]] = []  # (src, split, worker)
+
+    def register(self, name: str, executor, parallelism: int = 1) -> None:
+        if parallelism < 1:
+            raise ValueError("parallelism must be >= 1")
+        assign: Dict[str, int] = {}
+        self._sources[name] = (executor, parallelism, assign)
+        self._assign_new(name, [s.split_id for s in executor.splits])
+
+    def unregister(self, name: str) -> None:
+        self._sources.pop(name, None)
+
+    def parallelism(self, name: str) -> int:
+        return self._sources[name][1]
+
+    def assignment(self, name: str) -> Dict[str, int]:
+        """split_id -> worker slot (a copy)."""
+        return dict(self._sources[name][2])
+
+    def worker_splits(self, name: str, worker: int) -> set:
+        _, _, assign = self._sources[name]
+        return {sid for sid, w in assign.items() if w == worker}
+
+    # -- discovery / rebalancing -----------------------------------------
+    def _loads(self, name: str) -> List[int]:
+        _, par, assign = self._sources[name]
+        loads = [0] * par
+        for w in assign.values():
+            loads[w] += 1
+        return loads
+
+    def _assign_new(self, name: str, split_ids) -> List[str]:
+        """Place unowned splits on the least-loaded slots (the
+        reference's diff-assignment on discovery)."""
+        _, par, assign = self._sources[name]
+        fresh = [sid for sid in split_ids if sid not in assign]
+        loads = self._loads(name)
+        for sid in fresh:
+            w = loads.index(min(loads))
+            assign[sid] = w
+            loads[w] += 1
+            self.changes_log.append((name, sid, w))
+        return fresh
+
+    def discover(self, name: str) -> List[str]:
+        """Re-enumerate the connector's splits (the periodic tick,
+        source_manager.rs:54 discovery loop). Returns newly-assigned
+        split ids; dropped splits leave the assignment."""
+        executor, _, assign = self._sources[name]
+        executor.discover()
+        live = {s.split_id for s in executor.splits}
+        for sid in [s for s in assign if s not in live]:
+            del assign[sid]
+        return self._assign_new(name, sorted(live))
+
+    def set_parallelism(self, name: str, parallelism: int) -> Dict[str, int]:
+        """Change the worker-slot count, reflowing ONLY the splits that
+        must move (reference: scale on source fragments re-splits the
+        assignment, preserving offsets). Returns the moves
+        {split_id: new_worker}."""
+        if parallelism < 1:
+            raise ValueError("parallelism must be >= 1")
+        executor, _, assign = self._sources[name]
+        moves: Dict[str, int] = {}
+        # drop slots >= parallelism: their splits must move
+        homeless = sorted(
+            sid for sid, w in assign.items() if w >= parallelism
+        )
+        for sid in homeless:
+            del assign[sid]
+        self._sources[name] = (executor, parallelism, assign)
+        loads = self._loads(name)
+        # rebalance: every slot should hold ceil/floor(n/par)
+        n = len(assign)
+        hi = -(-n // parallelism)
+        for sid in homeless:
+            w = loads.index(min(loads))
+            assign[sid] = w
+            loads[w] += 1
+            moves[sid] = w
+            self.changes_log.append((name, sid, w))
+        # optional smoothing: pull from overloaded slots into idle ones
+        for sid in sorted(assign):
+            w = assign[sid]
+            if loads[w] > hi:
+                tgt = loads.index(min(loads))
+                if loads[tgt] < hi and tgt != w:
+                    loads[w] -= 1
+                    loads[tgt] += 1
+                    assign[sid] = tgt
+                    moves[sid] = tgt
+                    self.changes_log.append((name, sid, tgt))
+        return moves
+
+    # -- polling -----------------------------------------------------------
+    def poll(
+        self,
+        name: str,
+        worker: Optional[int] = None,
+        max_rows_per_split: int = 4096,
+        capacity: int = 1 << 12,
+    ):
+        """Poll one worker slot's splits (or every split when worker is
+        None). Disjoint slots never double-read: the assignment
+        partitions the split set."""
+        executor, par, _ = self._sources[name]
+        if worker is None:
+            return executor.poll(max_rows_per_split, capacity)
+        if not 0 <= worker < par:
+            raise IndexError(f"worker {worker} out of range 0..{par - 1}")
+        return executor.poll(
+            max_rows_per_split, capacity,
+            only=self.worker_splits(name, worker),
+        )
