@@ -491,6 +491,68 @@ class StringFunc(Expr):
 # error->NULL policy) via the extra-null lane.
 
 
+def _udf_lane_in(field, v, strings):
+    """Device-lane cell -> the python value a UDF body receives."""
+    import json as _json
+    from decimal import Decimal as _Dec
+
+    from risingwave_tpu.types import DataType as _DT
+
+    if field.dtype is _DT.VARCHAR:
+        return strings.decode_one(int(v))
+    if field.dtype is _DT.JSONB:
+        return _json.loads(strings.decode_one(int(v)))
+    if field.dtype is _DT.DECIMAL:
+        return _Dec(int(v)).scaleb(-field.scale)
+    return v
+
+
+def _udf_lane_out(field, v, strings):
+    """UDF return value -> the device-lane cell encoding."""
+    import json as _json
+    from decimal import Decimal as _Dec
+
+    from risingwave_tpu.types import DataType as _DT
+
+    if field.dtype is _DT.VARCHAR:
+        return strings.encode_one(str(v))
+    if field.dtype is _DT.JSONB:
+        return strings.encode_one(
+            _json.dumps(v, sort_keys=True, separators=(",", ":"))
+        )
+    if field.dtype is _DT.DECIMAL:
+        # str(v) handles str returns (external UDFs cross DECIMAL as
+        # str) and floats alike; repr(str) would produce "'1.23'"
+        d = v if isinstance(v, _Dec) else _Dec(str(v))
+        return int(d.scaleb(field.scale).to_integral_value())
+    return v
+
+
+def _check_udf_registrable(
+    lname: str, out_field, arg_fields, strings, allow_builtin=False
+):
+    """Shared registration guards: builtins are not replaceable
+    (unless the session itself registers protected string builtins)
+    and dictionary-typed signatures need the session dictionary."""
+    from risingwave_tpu.types import DataType as _DT
+
+    if not allow_builtin and (
+        (lname in _REGISTRY and lname not in _UDF_SIGS)
+        or lname in _PROTECTED
+    ):
+        raise ValueError(
+            f"{lname!r} is a builtin function and cannot be replaced"
+        )
+    dict_types = (_DT.VARCHAR, _DT.JSONB)
+    if strings is None and (
+        out_field.dtype in dict_types
+        or any(f.dtype in dict_types for f in arg_fields)
+    ):
+        raise ValueError(
+            "VARCHAR/JSONB UDF signatures need the session dictionary"
+        )
+
+
 def register_py_udf(
     name: str,
     fn: Callable,
@@ -522,47 +584,16 @@ def register_py_udf(
             "zero-argument UDFs are not supported (use a literal)"
         )
     lname = name.lower()
-    if lname in _REGISTRY and lname not in _UDF_SIGS and not protected:
-        raise ValueError(
-            f"{lname!r} is a builtin function and cannot be replaced"
-        )
-    if lname in _PROTECTED and not protected:
-        raise ValueError(
-            f"{lname!r} is a builtin function and cannot be replaced"
-        )
-    dict_types = (_DT.VARCHAR, _DT.JSONB)
-    if strings is None and (
-        out_field.dtype in dict_types
-        or any(f.dtype in dict_types for f in arg_fields)
-    ):
-        raise ValueError(
-            "VARCHAR/JSONB UDF signatures need the session dictionary"
-        )
+    _check_udf_registrable(
+        lname, out_field, arg_fields, strings, allow_builtin=protected
+    )
     out_np = np.dtype(out_field.dtype.device_dtype)
 
     def _in(field, v):
-        if field.dtype is _DT.VARCHAR:
-            return strings.decode_one(int(v))
-        if field.dtype is _DT.JSONB:
-            return _json.loads(strings.decode_one(int(v)))
-        if field.dtype is _DT.DECIMAL:
-            return _Dec(int(v)).scaleb(-field.scale)
-        return v
+        return _udf_lane_in(field, v, strings)
 
     def _out(v):
-        if out_field.dtype is _DT.VARCHAR:
-            return strings.encode_one(str(v))
-        if out_field.dtype is _DT.JSONB:
-            return strings.encode_one(
-                _json.dumps(v, sort_keys=True, separators=(",", ":"))
-            )
-        if out_field.dtype is _DT.DECIMAL:
-            return int(
-                _Dec(repr(v) if not isinstance(v, _Dec) else v)
-                .scaleb(out_field.scale)
-                .to_integral_value()
-            )
-        return v
+        return _udf_lane_out(out_field, v, strings)
 
     def impl(*values):
         import jax
@@ -602,6 +633,84 @@ def register_py_udf(
     _UDF_SIGS[name.lower()] = (out_field, tuple(arg_fields))
     if protected:
         _PROTECTED.add(name.lower())
+
+
+def register_external_udf(
+    name: str,
+    address: str,
+    out_field,
+    arg_fields,
+    strings=None,
+    timeout: float = 5.0,
+    retries: int = 2,
+) -> None:
+    """Register a scalar UDF served by an OUT-OF-PROCESS UDF server
+    (risingwave_tpu/udf_server.py; reference: udf/external.rs — the
+    flight-service client). One batched RPC per chunk through
+    jax.pure_callback; lane coercions match the embedded runtime
+    (VARCHAR/JSONB decode to python values, DECIMAL crosses as str).
+    Row errors and NULL args yield SQL NULL; an unreachable service
+    raises (a missing UDF service is a query error, not silent NULLs).
+    """
+    from risingwave_tpu.types import DataType as _DT
+    from risingwave_tpu.udf_server import call_external
+
+    if not arg_fields:
+        raise NotImplementedError("zero-argument UDFs are not supported")
+    lname = name.lower()
+    _check_udf_registrable(lname, out_field, arg_fields, strings)
+    out_np = np.dtype(out_field.dtype.device_dtype)
+
+    def _wire_in(field, v):
+        # JSON-safe request cell; an undecodable cell (e.g. the empty-
+        # string fill of a NULL JSONB lane) crosses as None -> the
+        # server returns row NULL, matching the embedded runtime's
+        # bad-cell-becomes-NULL policy
+        try:
+            x = _udf_lane_in(field, v, strings)
+        except Exception:
+            return None
+        if field.dtype is _DT.DECIMAL:
+            return str(x)
+        return x
+
+    def impl(*values):
+        import jax
+
+        n = values[0].shape[0]
+
+        def host(*arrs):
+            cols = [
+                [_wire_in(f, c) for c in np.asarray(a).tolist()]
+                for f, a in zip(arg_fields, arrs)
+            ]
+            vals, nls = call_external(
+                address, lname, cols, timeout=timeout, retries=retries
+            )
+            out = np.zeros(n, out_np)
+            err = np.zeros(n, np.bool_)
+            for i in range(n):
+                if nls[i] or vals[i] is None:
+                    err[i] = True
+                    continue
+                try:
+                    out[i] = _udf_lane_out(out_field, vals[i], strings)
+                except Exception:
+                    err[i] = True
+            return out, err
+
+        return jax.pure_callback(
+            host,
+            (
+                jax.ShapeDtypeStruct((n,), out_np),
+                jax.ShapeDtypeStruct((n,), np.bool_),
+            ),
+            *values,
+        )
+
+    arity = len(arg_fields)
+    _REGISTRY[lname] = (arity, arity, impl)
+    _UDF_SIGS[lname] = (out_field, tuple(arg_fields))
 
 
 def drop_function(name: str) -> bool:

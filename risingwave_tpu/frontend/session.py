@@ -908,9 +908,17 @@ class SqlSession:
                     continue
                 # periodic discovery + least-loaded assignment of new
                 # splits (source_manager.rs discovery loop); polling
-                # walks each worker slot's DISJOINT split subset
+                # walks each worker slot's DISJOINT split subset.
+                # Worker order ROTATES per pump: under a rate limit the
+                # slots share one token bucket, and a fixed order would
+                # let slot 0 drain it every time (starving slot 1+ just
+                # like an unrotated split order would)
                 self.source_mgr.discover(name)
-                for w in range(self.source_mgr.parallelism(name)):
+                par = self.source_mgr.parallelism(name)
+                self._pump_rr = getattr(self, "_pump_rr", 0) + 1
+                for w in (
+                    (i + self._pump_rr) % par for i in range(par)
+                ):
                     for chunk in self.source_mgr.poll(
                         name, w, max_rows_per_split, capacity
                     ):
@@ -918,6 +926,24 @@ class SqlSession:
                         for frag, side in self.dml._targets.get(name, ()):
                             self.runtime.push(frag, chunk, side)
         return total
+
+    @staticmethod
+    def _parse_udf_args(args: str):
+        import re
+
+        fields = []
+        # split on commas OUTSIDE parens: DECIMAL(10,2) is one type
+        for a in re.split(r",(?![^(]*\))", args):
+            a = a.strip()
+            if not a:
+                continue
+            parts = a.split(None, 1)
+            if len(parts) != 2:
+                raise SyntaxError(f"argument {a!r}: expected 'name TYPE'")
+            fields.append(
+                _parse_type_word(parts[0], parts[1].replace(" ", ""))
+            )
+        return fields
 
     def _create_function(self, sql: str):
         """CREATE FUNCTION name(args) RETURNS type LANGUAGE python AS
@@ -929,26 +955,40 @@ class SqlSession:
 
         from risingwave_tpu.expr import functions as F
 
+        ext = re.match(
+            r"(?is)^create\s+function\s+(\w+)\s*\((.*?)\)\s*"
+            r"returns\s+(\w+(?:\([\d\s,]*\))?)\s*"
+            r"language\s+external\s+as\s+'([^']+)'\s*;?\s*$",
+            sql,
+        )
+        if ext:
+            # out-of-process UDF service (udf/external.rs analogue):
+            # the body lives in a separate process at this address
+            name, args, ret, address = ext.groups()
+            arg_fields = self._parse_udf_args(args)
+            F.register_external_udf(
+                name,
+                address,
+                _parse_type_word("__ret__", ret),
+                arg_fields,
+                strings=self.strings,
+            )
+            self._log_ddl(sql)
+            return {}, "CREATE_FUNCTION"
         m = re.match(
-            r"(?is)^create\s+function\s+(\w+)\s*\(([^)]*)\)\s*"
-            r"returns\s+(\w+)\s*language\s+python\s+as\s+\$\$(.*)\$\$\s*;?\s*$",
+            r"(?is)^create\s+function\s+(\w+)\s*\((.*?)\)\s*"
+            r"returns\s+(\w+(?:\([\d\s,]*\))?)\s*"
+            r"language\s+python\s+as\s+\$\$(.*)\$\$\s*;?\s*$",
             sql,
         )
         if not m:
             raise SyntaxError(
                 "CREATE FUNCTION name(arg TYPE, ...) RETURNS TYPE "
-                "LANGUAGE python AS $$ def name(...): ... $$"
+                "LANGUAGE python AS $$ def name(...): ... $$ | "
+                "LANGUAGE external AS '<host:port>'"
             )
         name, args, ret, body = m.groups()
-        arg_fields = []
-        for a in args.split(","):
-            a = a.strip()
-            if not a:
-                continue
-            parts = a.split()
-            if len(parts) != 2:
-                raise SyntaxError(f"argument {a!r}: expected 'name TYPE'")
-            arg_fields.append(_parse_type_word(parts[0], parts[1]))
+        arg_fields = self._parse_udf_args(args)
         ret_field = _parse_type_word("__ret__", ret)
         ns: Dict[str, object] = {}
         exec(body, ns)  # noqa: S102 — embedded UDFs run user code by design

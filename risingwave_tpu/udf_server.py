@@ -1,0 +1,175 @@
+"""Out-of-process UDF server.
+
+Reference: src/expr/impl/src/udf/external.rs — an external UDF service
+the cluster calls per batch (arrow-flight there). TPU re-design: UDF
+bodies never belong on the device path anyway (they are host python),
+so the wire is a plain length-prefixed JSON frame over TCP — dependency
+-free, batch-at-a-time, with per-row error->NULL semantics matching the
+embedded runtime.
+
+Frame: 4-byte big-endian length + UTF-8 JSON.
+  request : {"fn": name, "cols": [[...], ...]}    (column-major batch)
+  response: {"values": [...], "nulls": [...]}     or {"error": "..."}
+
+Serve functions from a python file:
+  python -m risingwave_tpu.udf_server --port 8816 --file my_fns.py
+Every top-level callable in the file (not starting with "_") is served
+under its name. NULL cells arrive as None; a row raising becomes NULL.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+from typing import Callable, Dict, Optional
+
+
+def read_frame(sock) -> Optional[bytes]:
+    hdr = b""
+    while len(hdr) < 4:
+        part = sock.recv(4 - len(hdr))
+        if not part:
+            return None
+        hdr += part
+    (n,) = struct.unpack(">I", hdr)
+    buf = b""
+    while len(buf) < n:
+        part = sock.recv(min(1 << 16, n - len(buf)))
+        if not part:
+            return None
+        buf += part
+    return buf
+
+
+def write_frame(sock, payload: bytes) -> None:
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+class UdfServer:
+    """Threaded TCP server hosting a {name: callable} registry."""
+
+    def __init__(self, fns: Dict[str, Callable], host="127.0.0.1", port=0):
+        self.fns = dict(fns)
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    raw = read_frame(self.request)
+                    if raw is None:
+                        return
+                    try:
+                        resp = outer._dispatch(json.loads(raw))
+                    except Exception as e:  # malformed frame
+                        resp = {"error": f"{type(e).__name__}: {e}"}
+                    write_frame(
+                        self.request, json.dumps(resp).encode("utf-8")
+                    )
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.address = "{}:{}".format(*self._server.server_address)
+        self._thread: Optional[threading.Thread] = None
+
+    def _dispatch(self, req: dict) -> dict:
+        fn = self.fns.get(req.get("fn"))
+        if fn is None:
+            return {"error": f"unknown function {req.get('fn')!r}"}
+        cols = req.get("cols", [])
+        n = len(cols[0]) if cols else 0
+        values, nulls = [], []
+        for i in range(n):
+            args = [c[i] for c in cols]
+            if any(a is None for a in args):
+                values.append(None)  # NULL-strict, like the kernels
+                nulls.append(True)
+                continue
+            try:
+                values.append(fn(*args))
+                nulls.append(False)
+            except Exception:  # row error -> NULL (non_strict.rs)
+                values.append(None)
+                nulls.append(True)
+        return {"values": values, "nulls": nulls}
+
+    def start(self) -> "UdfServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def call_external(
+    address: str,
+    fn: str,
+    cols,
+    timeout: float = 5.0,
+    retries: int = 2,
+):
+    """One batched UDF call with retry-on-fresh-connection (the
+    reference client retries flight RPCs). Raises RuntimeError when
+    the server stays unreachable or reports an error — a missing UDF
+    service is a query error, not silent NULLs."""
+    host, _, port = address.rpartition(":")
+    last: Optional[Exception] = None
+    for _ in range(retries + 1):
+        try:
+            with socket.create_connection(
+                (host or "127.0.0.1", int(port)), timeout=timeout
+            ) as sock:
+                sock.settimeout(timeout)
+                write_frame(
+                    sock,
+                    json.dumps({"fn": fn, "cols": cols}).encode("utf-8"),
+                )
+                raw = read_frame(sock)
+                if raw is None:
+                    raise ConnectionError("server closed mid-response")
+                resp = json.loads(raw)
+                if "error" in resp:
+                    raise RuntimeError(
+                        f"external UDF {fn!r}: {resp['error']}"
+                    )
+                return resp["values"], resp["nulls"]
+        except (OSError, ConnectionError, json.JSONDecodeError) as e:
+            last = e
+    raise RuntimeError(
+        f"external UDF service {address} unreachable: {last}"
+    ) from last
+
+
+def _main() -> None:
+    import argparse
+    import runpy
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8816)
+    ap.add_argument(
+        "--file", required=True, help="python file defining the UDFs"
+    )
+    args = ap.parse_args()
+    ns = runpy.run_path(args.file)
+    fns = {
+        k: v
+        for k, v in ns.items()
+        if callable(v) and not k.startswith("_")
+    }
+    srv = UdfServer(fns, args.host, args.port)
+    print(f"udf server on {srv.address} serving {sorted(fns)}", flush=True)
+    srv._server.serve_forever()
+
+
+if __name__ == "__main__":
+    _main()

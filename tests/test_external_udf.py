@@ -1,0 +1,173 @@
+"""Out-of-process UDF server + client (reference: udf/external.rs —
+the external UDF flight service; here a dependency-free framed-JSON
+TCP protocol with the same batch + row-error->NULL semantics)."""
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.frontend.session import SqlSession
+from risingwave_tpu.sql import Catalog
+from risingwave_tpu.udf_server import UdfServer, call_external
+
+pytestmark = pytest.mark.smoke
+
+
+@pytest.fixture
+def server():
+    def double(x):
+        return x * 2
+
+    def risky(x):
+        if x == 13:
+            raise ValueError("unlucky")
+        return x + 1
+
+    def shout(s):
+        return s.upper() + "!"
+
+    srv = UdfServer(
+        {"double": double, "risky": risky, "shout": shout}
+    ).start()
+    yield srv
+    srv.stop()
+
+
+def test_protocol_batch_and_row_errors(server):
+    vals, nulls = call_external(server.address, "double", [[1, 2, 3]])
+    assert vals == [2, 4, 6] and nulls == [False] * 3
+    vals, nulls = call_external(server.address, "risky", [[12, 13, 14]])
+    assert vals == [13, None, 15]
+    assert nulls == [False, True, False]  # row error -> NULL
+    with pytest.raises(RuntimeError, match="unknown function"):
+        call_external(server.address, "nope", [[1]])
+
+
+def test_unreachable_server_raises():
+    with pytest.raises(RuntimeError, match="unreachable"):
+        call_external("127.0.0.1:1", "f", [[1]], timeout=0.3, retries=1)
+
+
+def test_sql_external_udf_end_to_end(server):
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute(
+        f"CREATE FUNCTION double(x BIGINT) RETURNS BIGINT "
+        f"LANGUAGE external AS '{server.address}'"
+    )
+    s.execute("CREATE TABLE t (v BIGINT)")
+    s.execute(
+        "CREATE MATERIALIZED VIEW m AS "
+        "SELECT v, double(v) AS d FROM t"
+    )
+    s.execute("INSERT INTO t VALUES (3), (5)")
+    out, _ = s.execute("SELECT v, d FROM m ORDER BY v")
+    assert list(out["d"]) == [6, 10]
+    # batch SELECT path too
+    out, _ = s.execute("SELECT double(v) AS d2 FROM t ORDER BY d2")
+    assert list(out["d2"]) == [6, 10]
+
+
+def test_sql_external_varchar_udf(server):
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute(
+        f"CREATE FUNCTION shout(s VARCHAR) RETURNS VARCHAR "
+        f"LANGUAGE external AS '{server.address}'"
+    )
+    s.execute("CREATE TABLE t (name VARCHAR)")
+    s.execute("INSERT INTO t VALUES ('hi'), ('yo')")
+    out, _ = s.execute("SELECT shout(name) AS x FROM t")
+    assert sorted(out["x"]) == ["HI!", "YO!"]
+
+
+def test_subprocess_server_cli(tmp_path):
+    """The shipped __main__ entry hosts functions from a user file."""
+    import socket
+    import subprocess
+    import sys
+    import time
+
+    fns = tmp_path / "fns.py"
+    fns.write_text("def triple(x):\n    return x * 3\n")
+    # pick a free port
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "risingwave_tpu.udf_server",
+            "--port",
+            str(port),
+            "--file",
+            str(fns),
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            try:
+                vals, _ = call_external(
+                    f"127.0.0.1:{port}", "triple", [[7]],
+                    timeout=1.0, retries=0,
+                )
+                assert vals == [21]
+                break
+            except RuntimeError:
+                time.sleep(0.2)
+        else:
+            raise AssertionError("server never came up")
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_external_decimal_udf(server):
+    """DECIMAL crosses the wire as str both ways (review finding r5:
+    repr(str) used to corrupt the Decimal parse into all-NULL rows)."""
+    srv = __import__(
+        "risingwave_tpu.udf_server", fromlist=["UdfServer"]
+    ).UdfServer({"with_tax": lambda amt: str(round(float(amt) * 1.1, 2))})
+    srv.start()
+    try:
+        s = SqlSession(Catalog({}), capacity=1 << 10)
+        s.execute(
+            f"CREATE FUNCTION with_tax(a DECIMAL(10,2)) RETURNS "
+            f"DECIMAL(10,2) LANGUAGE external AS '{srv.address}'"
+        )
+        s.execute("CREATE TABLE t (amt DECIMAL(10, 2))")
+        s.execute("INSERT INTO t VALUES (100.00), (250.50)")
+        out, _ = s.execute("SELECT with_tax(amt) AS x FROM t")
+        vals = sorted(float(v) for v in out["x"])
+        assert vals == pytest.approx([110.0, 275.55])
+    finally:
+        srv.stop()
+
+
+def test_pump_rotates_workers_under_throttle(tmp_path):
+    """parallelism=2 + rate limit: both workers' splits make progress
+    across pumps (review finding r5: fixed worker order starved w1)."""
+    from risingwave_tpu.connectors.framework import FileLogSource
+
+    d = str(tmp_path)
+    FileLogSource.append(d, 0, [f'{{"v": {i}}}' for i in range(500)])
+    FileLogSource.append(d, 1, [f'{{"v": {1000 + i}}}' for i in range(5)])
+    s = SqlSession(Catalog({}), capacity=1 << 10, parallelism=2)
+    s.execute(
+        f"CREATE SOURCE g (v BIGINT) "
+        f"WITH (connector='filelog', path='{d}', format='json')"
+    )
+    s.execute(
+        "CREATE MATERIALIZED VIEW mx AS SELECT max(v) AS m FROM g"
+    )
+    s.execute("ALTER SOURCE g SET rate_limit = 5")
+    src = s.sources["g"]
+    for _ in range(8):
+        s.pump_sources()
+        s.runtime.barrier()
+        if src._bucket_t is not None:
+            src._bucket_t -= 1.0  # deterministic refill
+    out, _ = s.execute("SELECT m FROM mx")
+    assert out["m"][0] >= 1000, "worker 1's split starved"
