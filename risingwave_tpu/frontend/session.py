@@ -67,6 +67,7 @@ class SqlSession:
         capacity: int = 1 << 14,
         exec_mode: str = "serial",
         parallelism: int = 1,
+        hub=None,
     ):
         from risingwave_tpu.array.dictionary import StringDictionary
 
@@ -99,6 +100,13 @@ class SqlSession:
         from risingwave_tpu.runtime import SourceManager
 
         self.source_mgr = SourceManager()
+        # NotificationHub (manager/notification.rs + the frontend
+        # ObserverManager): sessions sharing one runtime observe each
+        # other's catalog mutations with versioned catch-up
+        self.hub = hub
+        self._hub_oid = None
+        if hub is not None:
+            self._hub_oid = hub.subscribe(self._apply_notification)
         self._register_string_builtins()
         self._replaying = False
         self.meta = None
@@ -153,6 +161,59 @@ class SqlSession:
         if self.meta is not None and not self._replaying:
             self.meta.append_ddl(sql)
 
+    # -- notifications (observer manager) --------------------------------
+    def _notify(self, op: str, kind: str, name: str, **payload) -> None:
+        if self.hub is not None:
+            payload["origin"] = id(self)
+            self.hub.publish(op, kind, name, payload)
+
+    def _apply_notification(self, n) -> None:
+        """Apply a peer session's catalog mutation (the frontend
+        observer role, observer_manager.rs:40): this session gains
+        READ/WRITE access to the relation without owning its fragment
+        registration (the shared runtime already runs it)."""
+        if n.payload.get("origin") == id(self):
+            return  # self-echo
+        if n.op == "drop":
+            self.catalog.mvs.pop(n.name, None)
+            self.catalog.tables.pop(n.name, None)
+            self.batch.tables.pop(n.name, None)
+            self.sources.pop(n.name, None)
+            self.source_mgr.unregister(n.name)
+            self.dml.detach_fragment(n.name)
+            return
+        if "schema" not in n.payload:
+            # payload freed by a later drop (the hub compacts dropped
+            # relations): the following drop in the backlog cancels it
+            return
+        if n.kind in ("table", "mv"):
+            self.catalog.tables[n.name] = n.payload["schema"]
+            if n.payload.get("mview") is not None:
+                self.batch.register(n.name, n.payload["mview"])
+            if n.kind == "mv" and n.payload.get("planned") is not None:
+                self.catalog.mvs[n.name] = n.payload["planned"]
+            elif n.kind == "table" and n.payload.get("writable", True):
+                # peer INSERTs route into the SHARED runtime fragment
+                self.dml.add_target(n.name, n.name, "single")
+        elif n.kind == "source":
+            self.catalog.tables[n.name] = n.payload["schema"]
+            # the SAME executor object (shared offsets: whoever pumps
+            # first wins each record exactly once); registering it in
+            # this session's manager makes MVs created HERE pumpable
+            self.sources.setdefault(n.name, n.payload["src"])
+            if n.name not in self.source_mgr:
+                self.source_mgr.register(
+                    n.name, n.payload["src"], parallelism=1
+                )
+
+    def close(self) -> None:
+        """Detach from the hub: a discarded session must not keep
+        receiving (and acting on) peers' DDL, nor be kept alive by the
+        hub's observer table."""
+        if self.hub is not None and self._hub_oid is not None:
+            self.hub.unsubscribe(self._hub_oid)
+            self._hub_oid = None
+
     def _fresh_planner(self) -> StreamPlanner:
         """A fresh planner per graph-mode instance: deterministic
         table_ids (instances are vnode partitions of the SAME logical
@@ -200,6 +261,9 @@ class SqlSession:
             return {}, "ALTER_SOURCE"
         if stripped[:15].lower().startswith("create function"):
             return self._create_function(stripped)
+        low = stripped.lower()
+        if low.startswith(("drop materialized view", "drop table", "drop source")):
+            return self._execute_drop(stripped)
         if stripped[:13].lower().startswith("drop function"):
             import re
 
@@ -326,6 +390,7 @@ class SqlSession:
             self.batch.register(stmt.name, mview)
             self.dml.add_target(stmt.name, stmt.name, "single")
             self._log_ddl(sql)
+            self._notify("add", "table", stmt.name, schema=schema, mview=mview)
             return {}, "CREATE_TABLE"
         return self._execute_create_mv_or_rest(stmt, sql)
 
@@ -462,6 +527,11 @@ class SqlSession:
                 tuple(inferred.get(f.name, f) for f in sch.fields)
             )
             self._log_ddl(sql)
+            self._notify(
+                "add", "mv", planned.name,
+                schema=self.catalog.tables[planned.name],
+                mview=planned.mview, planned=planned,
+            )
             if not self._replaying:
                 # CREATE returns once the backfill snapshot is visible
                 # (the reference blocks DDL on backfill completion)
@@ -891,6 +961,7 @@ class SqlSession:
         self.catalog.tables[name] = schema
         self.runtime.register_state(src)
         self._log_ddl(sql)
+        self._notify("add", "source", name, schema=schema, src=src)
         return {}, "CREATE_SOURCE"
 
     def pump_sources(
@@ -926,6 +997,78 @@ class SqlSession:
                         for frag, side in self.dml._targets.get(name, ()):
                             self.runtime.push(frag, chunk, side)
         return total
+
+    def _execute_drop(self, sql: str):
+        """DROP MATERIALIZED VIEW / TABLE / SOURCE <name> (reference:
+        handler/drop_mv.rs etc. -> DdlController::drop_streaming_job).
+        Dependency-guarded: a relation with downstream subscribers or
+        DML-fed MVs refuses to drop (the reference requires CASCADE)."""
+        import re
+
+        m = re.match(
+            r"(?is)^drop\s+(materialized\s+view|table|source)\s+"
+            r"(\w+)\s*;?\s*$",
+            sql,
+        )
+        if not m:
+            raise SyntaxError("DROP MATERIALIZED VIEW|TABLE|SOURCE <name>")
+        kword, name = m.group(1).lower(), m.group(2)
+        kind = {"materialized view": "mv"}.get(
+            " ".join(kword.split()), kword
+        )
+        if kind == "mv":
+            if not self.catalog.is_mv(name):
+                raise KeyError(f"unknown materialized view {name!r}")
+        elif kind == "table":
+            if name not in self.catalog.tables or self.catalog.is_mv(
+                name
+            ) or name in self.sources:
+                raise KeyError(f"unknown table {name!r}")
+        else:
+            if name not in self.sources:
+                raise KeyError(f"unknown source {name!r}")
+        # dependency guard: subscribers (MV-on-MV / MVs over the table)
+        # or DML-attached MVs reading a source
+        if self.runtime._subs.get(name):
+            deps = [d for d, _ in self.runtime._subs[name]]
+            raise ValueError(
+                f"cannot drop {name!r}: {deps} depend on it"
+            )
+        if kind == "source" and self.dml._targets.get(name):
+            deps = [f for f, _ in self.dml._targets[name]]
+            raise ValueError(
+                f"cannot drop {name!r}: {deps} depend on it"
+            )
+        if kind == "mv":
+            planned = self.catalog.mvs.pop(name)
+            self.runtime.unregister(name)
+            self.dml.detach_fragment(name)
+            self.batch.tables.pop(name, None)
+            self.catalog.tables.pop(name, None)
+            # hidden aux MVs (lowered joins) die with their top MV
+            # unless another MV still subscribes to them
+            for sub in reversed(getattr(planned, "aux", ())):
+                if self.runtime._subs.get(sub.name):
+                    continue
+                self.runtime.unregister(sub.name)
+                self.dml.detach_fragment(sub.name)
+                self.batch.tables.pop(sub.name, None)
+                self.catalog.tables.pop(sub.name, None)
+                self.catalog.mvs.pop(sub.name, None)
+        elif kind == "table":
+            self.runtime.unregister(name)
+            self.dml.detach_fragment(name)
+            self.batch.tables.pop(name, None)
+            self.catalog.tables.pop(name, None)
+        else:  # source
+            src = self.sources.pop(name, None)
+            self.source_mgr.unregister(name)
+            self.catalog.tables.pop(name, None)
+            if src is not None:
+                self.runtime.unregister_state(src)
+        self._log_ddl(sql)
+        self._notify("drop", kind, name)
+        return {}, f"DROP_{kind.upper()}"
 
     @staticmethod
     def _parse_udf_args(args: str):

@@ -3,6 +3,7 @@
 from risingwave_tpu.runtime.pipeline import Pipeline, TwoInputPipeline
 from risingwave_tpu.runtime.dml import DmlManager
 from risingwave_tpu.runtime.runtime import StreamingRuntime
+from risingwave_tpu.runtime.notification import NotificationHub
 from risingwave_tpu.runtime.source_manager import SourceManager
 
 __all__ = [
@@ -11,4 +12,5 @@ __all__ = [
     "TwoInputPipeline",
     "StreamingRuntime",
     "SourceManager",
+    "NotificationHub",
 ]

@@ -1,0 +1,121 @@
+"""NotificationHub — versioned catalog-change pub/sub.
+
+Reference: src/meta/src/manager/notification.rs + the frontend's
+ObserverManager (src/frontend/src/observer/observer_manager.rs:40):
+meta assigns every catalog mutation a monotonically increasing notify
+version and pushes it to subscribed frontends/compute nodes; a late
+subscriber first receives a SNAPSHOT at some version and then only
+deltas > that version, so no mutation is ever missed or applied twice.
+
+TPU re-design: sessions are in-process frontends sharing one runtime;
+the hub carries (version, op, kind, name, payload) tuples where the
+payload holds direct object references (schema, mview handle, source
+executor) instead of protobuf — the process boundary version of this
+rides the cluster wire's DDL broadcast (cluster/multi_node.py).
+
+Ordering: versions are contiguous; each observer holds a reorder
+buffer and applies notifications strictly in version order, so a
+publish racing a subscription's backlog replay can never deliver v3
+before v2 (each mutation applies exactly once, in order).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Tuple
+
+
+class Notification:
+    __slots__ = ("version", "op", "kind", "name", "payload")
+
+    def __init__(self, version, op, kind, name, payload):
+        self.version = version  # monotonically increasing, contiguous
+        self.op = op  # "add" | "drop"
+        self.kind = kind  # "table" | "mv" | "source" | "function"
+        self.name = name
+        self.payload = payload  # dict of object refs (schema, mview, ...)
+
+
+class _Observer:
+    """Per-observer in-order exactly-once delivery: a reorder buffer
+    keyed by version drains contiguously from ``seen``."""
+
+    def __init__(self, cb: Callable[[Notification], None], seen: int):
+        self.cb = cb
+        self.seen = seen
+        self._pending: Dict[int, Notification] = {}
+        # RLock: an observer callback may itself publish (re-entrant)
+        self._lock = threading.RLock()
+
+    def deliver(self, n: Notification) -> None:
+        with self._lock:
+            if n.version <= self.seen:
+                return  # duplicate
+            self._pending[n.version] = n
+            while self.seen + 1 in self._pending:
+                m = self._pending.pop(self.seen + 1)
+                self.seen += 1
+                self.cb(m)
+
+
+class NotificationHub:
+    """The meta-side notifier. Thread-safe; callbacks run outside the
+    hub lock (an observer may publish), in version order per observer."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._version = 0
+        self._log: List[Notification] = []
+        self._observers: Dict[int, _Observer] = {}
+        self._next_obs = 0
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def publish(self, op, kind, name, payload=None) -> int:
+        with self._lock:
+            self._version += 1
+            n = Notification(self._version, op, kind, name, payload or {})
+            self._log.append(n)
+            if op == "drop":
+                # free the dropped relation's object refs held by the
+                # log (late subscribers see an empty-payload add that
+                # the following drop cancels; observers skip it)
+                for old in self._log:
+                    if old.name == name and old.kind == kind and old.op == "add":
+                        old.payload = {}
+            observers = list(self._observers.values())
+        for obs in observers:
+            obs.deliver(n)
+        return n.version
+
+    def subscribe(
+        self,
+        callback: Callable[[Notification], None],
+        from_version: int = 0,
+    ) -> int:
+        """Register an observer; mutations with version > from_version
+        replay IMMEDIATELY (the snapshot-then-deltas contract), then
+        live pushes follow — in version order even against concurrent
+        publishes. Returns an observer id for unsubscribe."""
+        obs = _Observer(callback, from_version)
+        with self._lock:
+            backlog = [n for n in self._log if n.version > from_version]
+            oid = self._next_obs
+            self._next_obs += 1
+            self._observers[oid] = obs
+        for n in backlog:
+            obs.deliver(n)
+        return oid
+
+    def unsubscribe(self, oid: int) -> None:
+        with self._lock:
+            self._observers.pop(oid, None)
+
+    def snapshot(self) -> Tuple[int, List[Notification]]:
+        """(current version, full mutation log) — net state is the
+        log folded add/drop per (kind, name)."""
+        with self._lock:
+            return self._version, list(self._log)

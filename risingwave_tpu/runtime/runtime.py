@@ -323,6 +323,11 @@ class StreamingRuntime:
         split offsets) into the checkpoint/recovery cycle."""
         self._aux_state.append(obj)
 
+    def unregister_state(self, obj) -> None:
+        """Drop a Checkpointable (DROP SOURCE): a dead executor must
+        not keep persisting its state every checkpoint."""
+        self._aux_state = [o for o in self._aux_state if o is not obj]
+
     def executors(self) -> List[object]:
         out = []
         for p in self.fragments.values():

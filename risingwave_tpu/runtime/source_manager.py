@@ -47,6 +47,9 @@ class SourceManager:
     def unregister(self, name: str) -> None:
         self._sources.pop(name, None)
 
+    def __contains__(self, name: str) -> bool:
+        return name in self._sources
+
     def parallelism(self, name: str) -> int:
         return self._sources[name][1]
 

@@ -27,6 +27,15 @@ import threading
 from typing import Callable, Dict, Optional
 
 
+def _json_cell(o):
+    """JSON fallback for common UDF return types: numpy scalars carry
+    .item(); Decimal and friends cross as str (the client's DECIMAL
+    lane parses text)."""
+    if hasattr(o, "item"):
+        return o.item()
+    return str(o)
+
+
 def read_frame(sock) -> Optional[bytes]:
     hdr = b""
     while len(hdr) < 4:
@@ -63,11 +72,16 @@ class UdfServer:
                         return
                     try:
                         resp = outer._dispatch(json.loads(raw))
-                    except Exception as e:  # malformed frame
-                        resp = {"error": f"{type(e).__name__}: {e}"}
-                    write_frame(
-                        self.request, json.dumps(resp).encode("utf-8")
-                    )
+                        # numpy scalars etc. serialize via .item();
+                        # anything else unserializable must become an
+                        # ERROR FRAME, never a dead socket (the client
+                        # would misreport 'service unreachable')
+                        payload = json.dumps(resp, default=_json_cell)
+                    except Exception as e:  # malformed frame / result
+                        payload = json.dumps(
+                            {"error": f"{type(e).__name__}: {e}"}
+                        )
+                    write_frame(self.request, payload.encode("utf-8"))
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True

@@ -171,3 +171,27 @@ def test_pump_rotates_workers_under_throttle(tmp_path):
             src._bucket_t -= 1.0  # deterministic refill
     out, _ = s.execute("SELECT m FROM mx")
     assert out["m"][0] >= 1000, "worker 1's split starved"
+
+
+def test_numpy_and_unserializable_results():
+    """A numpy-scalar result serializes via .item(); a genuinely
+    unserializable one becomes an error FRAME, not a dead socket
+    (review finding r5)."""
+    import numpy as _np
+
+    from risingwave_tpu.udf_server import UdfServer
+
+    srv = UdfServer({
+        "npy": lambda x: _np.int64(x) * 2,
+        "bad": lambda x: object(),
+    }).start()
+    try:
+        vals, nulls = call_external(srv.address, "npy", [[4]])
+        assert vals == [8] and nulls == [False]
+        vals, nulls = call_external(srv.address, "bad", [[1]])
+        # object() stringifies via the fallback: delivered as str, or
+        # an error frame — either way the CONNECTION survives
+        vals2, _ = call_external(srv.address, "npy", [[5]])
+        assert vals2 == [10]
+    finally:
+        srv.stop()
