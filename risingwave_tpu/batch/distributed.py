@@ -93,6 +93,13 @@ class DistributedBatchRunner:
             for i in stmt.items
         ):
             return None
+        # window functions need the WHOLE partition in one task (row_
+        # number over round-robin slices would restart per task):
+        # local mode handles them
+        if any(
+            isinstance(i.expr, P.WindowFuncCall) for i in stmt.items
+        ):
+            return None
 
         # -- partition (leaf scan tasks over vnode ranges) --------------
         if stmt.group_by:

@@ -24,6 +24,39 @@ from risingwave_tpu.sql.planner import (
 )
 
 
+def _and_join(conjuncts):
+    out = None
+    for c in conjuncts:
+        out = c if out is None else P.BinaryOp("and", out, c)
+    return out
+
+
+def _strip_quals(ast, cols: set):
+    """Rewrite qualified idents (a.x) to bare names for evaluation
+    over a joined frame whose columns are disjoint across sides."""
+    if isinstance(ast, P.Ident):
+        if ast.name not in cols:
+            raise KeyError(f"cannot resolve join column {ast}")
+        return P.Ident(ast.name)
+    if isinstance(ast, P.BinaryOp):
+        return P.BinaryOp(
+            ast.op, _strip_quals(ast.left, cols), _strip_quals(ast.right, cols)
+        )
+    if isinstance(ast, P.UnaryOp):
+        return P.UnaryOp(ast.op, _strip_quals(ast.operand, cols))
+    if isinstance(ast, P.FuncCall):
+        return P.FuncCall(
+            ast.name,
+            tuple(
+                _strip_quals(a, cols)
+                if isinstance(a, (P.Ident, P.BinaryOp, P.UnaryOp, P.FuncCall))
+                else a
+                for a in ast.args
+            ),
+        )
+    return ast  # literals etc. pass through
+
+
 class BatchQueryEngine:
     """``tables`` maps name -> MaterializeExecutor (the MV catalog)."""
 
@@ -117,6 +150,18 @@ class BatchQueryEngine:
             keep = keep[:n] & np.asarray(chunk.valid)[:n]
             cols = {k: v[keep] for k, v in cols.items()}
             n = int(keep.sum())
+
+        # window functions (src/batch/src/executor/over_window.rs):
+        # pandas per-partition transforms over the filtered scan
+        if any(
+            isinstance(it.expr, P.WindowFuncCall) for it in stmt.items
+        ):
+            if stmt.group_by:
+                raise NotImplementedError(
+                    "window functions over GROUP BY: aggregate in a "
+                    "derived table first"
+                )
+            return self._over_window(stmt, cols, n, binder)
 
         # aggregation / projection
         if stmt.group_by:
@@ -220,6 +265,155 @@ class BatchQueryEngine:
             out = {k: v[: stmt.limit] for k, v in out.items()}
         return out
 
+    def _over_window(self, stmt, cols, n, binder):
+        """Batch OVER() (reference: src/batch/src/executor/
+        over_window.rs): row_number/rank/dense_rank/lag/lead +
+        sum/min/max/count over full partitions, plus trailing ROWS
+        frames for the reducers. Output preserves scan row order."""
+        import pandas as pd
+
+        df = pd.DataFrame(cols)
+        out: Dict[str, np.ndarray] = {}
+        for i, item in enumerate(stmt.items):
+            ast = item.expr
+            if isinstance(ast, P.Ident):
+                name = binder.resolve(ast)
+                out[item.alias or name] = np.asarray(cols[name])
+                continue
+            if not isinstance(ast, P.WindowFuncCall):
+                raise NotImplementedError(
+                    "window SELECTs mix bare columns and OVER() calls "
+                    "only (wrap expressions in a derived table)"
+                )
+            part = [binder.resolve(c) for c in ast.partition_by]
+            if len(ast.order_by) > 1:
+                raise NotImplementedError(
+                    "OVER (... ORDER BY) supports one order column"
+                )
+            ocol = odesc = None
+            if ast.order_by:
+                oident, odesc = ast.order_by[0]
+                ocol = binder.resolve(oident)
+            order = df.sort_values(
+                part + ([ocol] if ocol else []),
+                ascending=[True] * len(part) + ([not odesc] if ocol else []),
+                kind="stable",
+            ) if (part or ocol) else df
+            # count(*) and unpartitioned reducers work on a constant
+            # lane: rows count as rows, never skipping NULL proxies
+            order = order.assign(__one=1)
+            # dropna=False: SQL puts NULL partition keys in their own
+            # partition — pandas' default silently DROPS those rows
+            gb = (
+                order.groupby(part, sort=False, dropna=False)
+                if part
+                else None
+            )
+            fn, args = ast.func.name, ast.func.args
+            name = item.alias or f"{fn}_{i}"
+            nl = None
+            if fn == "row_number":
+                s = (gb.cumcount() if gb is not None else
+                     pd.Series(np.arange(len(order)), index=order.index)) + 1
+            elif fn in ("rank", "dense_rank"):
+                if ocol is None:
+                    raise ValueError(f"{fn}() needs ORDER BY")
+                method = "min" if fn == "rank" else "dense"
+                src = gb[ocol] if gb is not None else order[ocol]
+                s = src.rank(method=method, ascending=not odesc)
+            elif fn in ("lag", "lead"):
+                col = binder.resolve(args[0])
+                k = int(args[1].value) if len(args) > 1 else 1
+                k = k if fn == "lag" else -k
+                s = (gb[col].shift(k) if gb is not None
+                     else order[col].shift(k))
+                if len(args) > 2:
+                    if not isinstance(args[2], P.Literal):
+                        raise ValueError(
+                            "lag/lead default must be a literal"
+                        )
+                    s = s.fillna(args[2].value)
+                else:
+                    nl = s.isna()
+            elif fn in ("sum", "min", "max", "count"):
+                if args == ("*",):
+                    if fn != "count":
+                        raise ValueError(f"{fn}(*) unsupported")
+                    col = "__one"  # count ROWS, not non-NULL proxies
+                    fn_eff = "sum"
+                else:
+                    col = binder.resolve(args[0])
+                    fn_eff = fn
+                if ast.frame is not None:
+                    lo, hi = ast.frame
+                    if hi != 0 or lo > 0:
+                        raise NotImplementedError(
+                            "batch ROWS frames support trailing "
+                            "windows (N PRECEDING .. CURRENT ROW)"
+                        )
+                    window = -lo + 1
+                    roll = (
+                        gb[col] if gb is not None else order[col]
+                    ).rolling(window, min_periods=1)
+                    agg = {"count": "count"}.get(fn_eff, fn_eff)
+                    s = getattr(roll, agg)()
+                    if gb is not None:
+                        s = s.reset_index(level=list(range(len(part))),
+                                          drop=True)
+                elif ocol is not None:
+                    # SQL default frame with ORDER BY: RUNNING
+                    # aggregate (RANGE UNBOUNDED PRECEDING .. CURRENT
+                    # ROW) — computed as ROWS-cumulative, then ORDER-
+                    # BY peers share the frame end (transform 'last')
+                    src = gb[col] if gb is not None else order[col]
+                    if fn_eff == "count":
+                        s = src.transform(
+                            lambda x: x.notna().cumsum()
+                        ) if gb is not None else order[col].notna().cumsum()
+                    else:
+                        cum = {"sum": "cumsum", "min": "cummin",
+                               "max": "cummax"}[fn_eff]
+                        s = getattr(src, cum)()
+                    peer_keys = [order[c] for c in part] + [order[ocol]]
+                    s = s.groupby(peer_keys, dropna=False).transform(
+                        "last"
+                    )
+                else:
+                    s = (
+                        gb[col].transform(fn_eff)
+                        if gb is not None
+                        else pd.Series(
+                            getattr(order[col], fn_eff)(),
+                            index=order.index,
+                        )
+                    )
+            else:
+                raise NotImplementedError(
+                    f"window function {fn!r} unsupported in batch"
+                )
+            s = s.reindex(df.index).sort_index()
+            vals = s.to_numpy()
+            if nl is None and pd.isna(vals).any():
+                nl = pd.Series(vals).isna()
+            if nl is not None:
+                nlv = np.asarray(nl.reindex(df.index).sort_index()
+                                 if hasattr(nl, "reindex") else nl, bool)
+                if nlv.any():
+                    out[name + "__null"] = nlv
+                    vals = np.asarray(
+                        [0 if m else v for v, m in zip(vals.tolist(),
+                                                       nlv.tolist())]
+                    )
+            if fn in (
+                "row_number", "rank", "dense_rank", "count"
+            ) and np.issubdtype(np.asarray(vals).dtype, np.floating):
+                # pandas rank/rolling-count return float; these are
+                # integral by definition
+                a = np.asarray(vals, np.float64)
+                vals = np.where(np.isnan(a), 0, a).astype(np.int64)
+            out[name] = np.asarray(vals)
+        return out
+
     @staticmethod
     def _join_quals(rel) -> set:
         """Every alias addressable inside a (possibly nested) join."""
@@ -267,6 +461,7 @@ class BatchQueryEngine:
             )
 
         pairs = []
+        residual = []  # non-equi conjuncts -> NL/post-filter path
 
         def resolve(ident: P.Ident) -> str:
             if ident.qualifier in lquals and ident.name in ldf.columns:
@@ -290,22 +485,38 @@ class BatchQueryEngine:
                 and isinstance(e.left, P.Ident)
                 and isinstance(e.right, P.Ident)
             ):
-                a, b = resolve(e.left), resolve(e.right)
+                try:
+                    a, b = resolve(e.left), resolve(e.right)
+                except KeyError:
+                    residual.append(e)
+                    return
                 if a in ldf.columns and b in rdf.columns:
                     pairs.append((a, b))
-                elif b in ldf.columns and a in rdf.columns:
+                    return
+                if b in ldf.columns and a in rdf.columns:
                     pairs.append((b, a))
-                else:
-                    raise ValueError("join condition must cross sides")
-                return
-            raise ValueError("batch ON must be AND-ed equalities")
+                    return
+                # same-side equality: an ordinary predicate
+            residual.append(e)  # theta predicate: NL / post-filter
 
         walk(join.on)
+        jt = join.join_type
         if not pairs:
-            raise ValueError("no equi-join keys found")
+            # NO equi keys: NESTED-LOOP join (reference: src/batch/src/
+            # executor/join/nested_loop_join.rs) — cross product
+            # filtered by the full ON predicate
+            if jt not in ("inner", "left"):
+                raise ValueError(
+                    "non-equi batch joins support INNER/LEFT only"
+                )
+            return self._nl_join(ldf, rdf, join.on, jt)
+        if residual and jt != "inner":
+            raise ValueError(
+                "equi + residual ON predicates support INNER joins "
+                "only (outer-join padding happens before the residual)"
+            )
         lk = [p[0] for p in pairs]
         rk = [p[1] for p in pairs]
-        jt = join.join_type
         if jt in ("inner", "left", "right", "full"):
             how = {"full": "outer"}.get(jt, jt)
             m = ldf.merge(rdf, left_on=lk, right_on=rk, how=how)
@@ -323,7 +534,71 @@ class BatchQueryEngine:
             m = rdf[hit.values] if jt == "right_semi" else rdf[~hit.values]
         else:
             raise ValueError(f"unknown join type {jt!r}")
-        return {c: m[c].to_numpy() for c in m.columns if c != "_merge"}
+        out = {c: m[c].to_numpy() for c in m.columns if c != "_merge"}
+        if residual:
+            keep = self._eval_on(out, _and_join(residual))
+            out = {k: v[keep] for k, v in out.items()}
+        return out
+
+    def _nl_join(self, ldf, rdf, on, jt):
+        """Cross product + predicate filter; LEFT pads unmatched probe
+        rows with NULLs (nested_loop_join.rs semantics). O(|L|*|R|) by
+        nature — the optimizer should have picked equi keys if any."""
+        import pandas as pd
+
+        lx = ldf.assign(__x=1, __lid=np.arange(len(ldf)))
+        rx = rdf.assign(__x=1)
+        cross = lx.merge(rx, on="__x").drop(columns="__x")
+        cols = {c: cross[c].to_numpy() for c in cross.columns}
+        keep = (
+            self._eval_on(cols, on)
+            if len(cross)
+            else np.zeros(0, bool)
+        )
+        inner = cross[keep]
+        if jt == "left":
+            matched = set(inner["__lid"].tolist())
+            miss = lx[~lx["__lid"].isin(matched)].drop(columns="__x")
+            pad = pd.DataFrame(
+                {c: [None] * len(miss) for c in rdf.columns}
+            )
+            pad.index = miss.index
+            inner = pd.concat(
+                [inner, pd.concat([miss, pad], axis=1)],
+                ignore_index=True,
+            )
+        return {
+            c: inner[c].to_numpy()
+            for c in inner.columns
+            if c != "__lid"
+        }
+
+    def _eval_on(self, cols, on) -> np.ndarray:
+        """Evaluate an ON predicate over joined columns: qualifiers
+        strip to bare names (sides are disjoint by construction);
+        NULL comparisons drop the row (SQL join semantics)."""
+        n = len(next(iter(cols.values()))) if cols else 0
+        if n == 0:
+            return np.zeros(0, bool)
+        stripped = _strip_quals(on, set(cols))
+        cap = max(1, 1 << (n - 1).bit_length())
+        # float NaN is this engine's outer-join NULL encoding: a NaN
+        # cell must make the predicate NULL (drop), not compare as a
+        # value (NaN != x is True in IEEE, NULL != x is NULL in SQL)
+        nan_nulls = {}
+        for k, v in cols.items():
+            a = np.asarray(v)
+            if np.issubdtype(a.dtype, np.floating) and np.isnan(a).any():
+                nan_nulls[k] = np.isnan(a)
+        chunk = self._chunk_from_cols(cols, cap, nulls=nan_nulls or None)
+        binder = Binder(
+            {k: np.asarray(v).dtype for k, v in cols.items()}, None
+        )
+        kv, kn = compile_scalar(stripped, binder).eval(chunk)
+        keep = np.asarray(kv).astype(bool)[:n]
+        if kn is not None:
+            keep &= ~np.asarray(kn)[:n]
+        return keep
 
     def _eval_item(self, ast, cols, n, binder, chunk_cache=None):
         """-> (values, null_lane | None): computed items keep their SQL

@@ -59,6 +59,16 @@ def infer_output_fields(stmt, catalog) -> Dict[str, Field]:
                 name = item.alias or expr.name
                 out[name] = Field(name, f.dtype, scale=f.scale)
             continue
+        if isinstance(expr, P.WindowFuncCall):
+            name = item.alias or f"{expr.func.name}_{i}"
+            fn = expr.func.name
+            if fn in ("row_number", "rank", "dense_rank", "count"):
+                out[name] = Field(name, DataType.INT64)
+            elif expr.func.args and isinstance(expr.func.args[0], P.Ident):
+                f = _from_env(env, expr.func.args[0].name)
+                if f is not None:  # lag/lead/sum/min/max keep arg type
+                    out[name] = Field(name, f.dtype, scale=f.scale)
+            continue
         if isinstance(expr, P.FuncCall):
             name = item.alias or f"{expr.name}_{i}"
             from risingwave_tpu.expr.functions import udf_signature
