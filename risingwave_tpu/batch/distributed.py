@@ -89,7 +89,13 @@ class DistributedBatchRunner:
         from risingwave_tpu.sql.planner import EXTENDED_AGGS
 
         if not stmt.group_by and any(
-            isinstance(i.expr, P.FuncCall) and i.expr.name in EXTENDED_AGGS
+            isinstance(i.expr, P.FuncCall)
+            and (
+                i.expr.name in EXTENDED_AGGS
+                or i.expr.name
+                in ("approx_count_distinct", "string_agg", "array_agg")
+                or getattr(i.expr, "distinct", False)
+            )
             for i in stmt.items
         ):
             return None

@@ -24,6 +24,22 @@ from risingwave_tpu.sql.planner import (
 )
 
 
+# aggregates the batch engine evaluates beyond the planner's kinds:
+# DISTINCT counts (pandas nunique), string_agg / array_agg (the
+# reference's ordered-set aggregates, impl/src/aggregate/string_agg.rs)
+DISTINCT_AGG_NAMES = ("approx_count_distinct",)
+COLLECT_AGGS = ("string_agg", "array_agg")
+
+
+def _is_batch_agg(fc) -> bool:
+    return isinstance(fc, P.FuncCall) and (
+        fc.name in AGG_FUNCS
+        or fc.name in EXTENDED_AGGS
+        or fc.name in DISTINCT_AGG_NAMES
+        or fc.name in COLLECT_AGGS
+    )
+
+
 def _and_join(conjuncts):
     out = None
     for c in conjuncts:
@@ -68,6 +84,9 @@ class BatchQueryEngine:
         # distributed-mode task count, 0/1 = local mode; flipped like
         # the reference's QUERY_MODE session variable
         self.distributed_tasks = 0
+        # session dictionary (set by SqlSession): string_agg decodes
+        # VARCHAR codes, joins text, and encodes the result back
+        self.strings = None
 
     def register(self, name: str, mview: MaterializeExecutor) -> None:
         self.tables[name] = mview
@@ -174,10 +193,7 @@ class BatchQueryEngine:
             out = {}
             chunk_cache = [None]
             for i, item in enumerate(stmt.items):
-                if isinstance(item.expr, P.FuncCall) and (
-                    item.expr.name in AGG_FUNCS
-                    or item.expr.name in EXTENDED_AGGS
-                ):
+                if _is_batch_agg(item.expr):
                     name = item.alias or f"{item.expr.name}_{i}"
                     vals, isnull = self._scalar_agg(item.expr, cols, n, binder)
                     out[name] = vals
@@ -310,6 +326,10 @@ class BatchQueryEngine:
                 else None
             )
             fn, args = ast.func.name, ast.func.args
+            if getattr(ast.func, "distinct", False):
+                raise NotImplementedError(
+                    f"{fn}(DISTINCT ...) OVER (...) unsupported"
+                )
             name = item.alias or f"{fn}_{i}"
             nl = None
             if fn == "row_number":
@@ -635,6 +655,38 @@ class BatchQueryEngine:
             live = x[~np.isnan(x)]  # outer joins surface NULL as NaN
         else:
             live = x
+        if fc.name in DISTINCT_AGG_NAMES or getattr(fc, "distinct", False):
+            if fc.name not in ("count",) + DISTINCT_AGG_NAMES:
+                raise NotImplementedError(
+                    f"{fc.name}(DISTINCT ...) unsupported"
+                )
+            return np.array([len(set(live.tolist()))]), False
+        if fc.name in COLLECT_AGGS:
+            if fc.name == "array_agg":
+                if len(x) == 0:
+                    return np.array([0]), True  # zero rows -> NULL
+                # PG array_agg PRESERVES NULL elements
+                arr = np.empty(1, object)
+                arr[0] = [
+                    None
+                    if v is None or (isinstance(v, float) and np.isnan(v))
+                    else v
+                    for v in x.tolist()
+                ]
+                return arr, False
+            if self.strings is None:
+                raise ValueError("string_agg needs the session dictionary")
+            if len(fc.args) < 2 or not isinstance(fc.args[1], P.Literal):
+                raise ValueError(
+                    "string_agg(col, 'sep') needs a literal separator"
+                )
+            if len(live) == 0:
+                return np.array([0]), True  # all-NULL/empty -> NULL
+            sep = str(fc.args[1].value)
+            code = self.strings.encode_one(
+                sep.join(self.strings.decode_one(int(c)) for c in live)
+            )
+            return np.array([code]), False
         if fc.name == "count":
             return np.array([len(live)]), False
         if len(live) == 0:
@@ -757,16 +809,55 @@ class BatchQueryEngine:
                     raise ValueError(f"{name!r} not in GROUP BY")
                 continue
             fc = item.expr
-            if not (
-                isinstance(fc, P.FuncCall)
-                and (fc.name in AGG_FUNCS or fc.name in EXTENDED_AGGS)
-            ):
+            if not _is_batch_agg(fc):
                 raise ValueError("items must be keys or aggregates")
             name = item.alias or f"{fc.name}_{i}"
             if fc.args == ("*",):
                 if fc.name != "count":
                     raise ValueError(f"{fc.name}(*) unsupported")
                 frames[name] = gb.size()
+            elif fc.name in DISTINCT_AGG_NAMES or getattr(
+                fc, "distinct", False
+            ):
+                if fc.name not in ("count",) + DISTINCT_AGG_NAMES:
+                    raise NotImplementedError(
+                        f"{fc.name}(DISTINCT ...) unsupported"
+                    )
+                col = binder.resolve(fc.args[0])
+                frames[name] = gb[col].nunique()  # NULLs excluded
+            elif fc.name in COLLECT_AGGS:
+                col = binder.resolve(fc.args[0])
+                if fc.name == "array_agg":
+                    # PG array_agg PRESERVES NULL elements
+                    import pandas as pd
+
+                    frames[name] = gb[col].agg(
+                        lambda x: [
+                            None if pd.isna(v) else v for v in x
+                        ]
+                    )
+                else:  # string_agg(col, sep); all-NULL group -> NULL
+                    if self.strings is None:
+                        raise ValueError(
+                            "string_agg needs the session dictionary"
+                        )
+                    if len(fc.args) < 2 or not isinstance(
+                        fc.args[1], P.Literal
+                    ):
+                        raise ValueError(
+                            "string_agg(col, 'sep') needs a literal "
+                            "separator"
+                        )
+                    sep = str(fc.args[1].value)
+                    dec = self.strings.decode_one
+                    enc = self.strings.encode_one
+                    frames[name] = gb[col].agg(
+                        lambda x: enc(
+                            sep.join(dec(int(c)) for c in x.dropna())
+                        )
+                        if len(x.dropna())
+                        else np.nan
+                    )
             elif fc.name in EXTENDED_AGGS:
                 col = f"__num_{binder.resolve(fc.args[0])}"
                 ext_kinds[name] = fc.name

@@ -97,7 +97,7 @@ def enable_compile_cache(cache_dir: str = None) -> str:
 
     import jax
 
-    d = (
+    base = (
         cache_dir
         or os.environ.get("JAX_COMPILATION_CACHE_DIR")
         or os.path.join(
@@ -105,11 +105,23 @@ def enable_compile_cache(cache_dir: str = None) -> str:
             ".jax_cache",
         )
     )
+    # partition by platform context: XLA:CPU AOT results embed target-
+    # machine features that vary with XLA_FLAGS/platform — loading a
+    # bench-context artifact under pytest warns about feature
+    # mismatches and risks SIGILL
+    import hashlib
+
+    ctx = "{}|{}".format(
+        os.environ.get("JAX_PLATFORMS", ""),
+        os.environ.get("XLA_FLAGS", ""),
+    )
+    d = os.path.join(base, hashlib.sha1(ctx.encode()).hexdigest()[:8])
     try:
         os.makedirs(d, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", d)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", d)
+        # children inherit the BASE dir and derive their own context
+        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", base)
     except Exception:
         return ""
     return d

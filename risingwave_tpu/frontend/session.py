@@ -89,6 +89,7 @@ class SqlSession:
         # strings compare codes (array/dictionary.py)
         self.strings = StringDictionary()
         self.planner.strings = self.strings  # literal -> code rewriting
+        self.batch.strings = self.strings  # string_agg joins decoded text
         # temporal joins probe a relation's materialize state directly
         self.planner.mviews = self.batch.tables
         self.dml = DmlManager(self.runtime, catalog, strings=self.strings)

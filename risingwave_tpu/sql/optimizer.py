@@ -453,6 +453,7 @@ def _substitute(pred, amap: Dict[str, object]):
                 a if isinstance(a, str) else _substitute(a, amap)
                 for a in pred.args
             ),
+            distinct=pred.distinct,
         )
     if isinstance(pred, P.CaseExpr):
         return P.CaseExpr(

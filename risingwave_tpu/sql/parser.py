@@ -32,6 +32,7 @@ class Literal:
 class FuncCall:
     name: str  # lowercased
     args: Tuple[object, ...]  # exprs; ("*",) for COUNT(*)
+    distinct: bool = False  # count(DISTINCT x) / string_agg(DISTINCT x)
 
 
 @dataclass(frozen=True)
@@ -819,12 +820,13 @@ class Parser:
                         return self._window_spec(call)
                     return call
                 args = []
+                dis = bool(self.accept("kw", "distinct"))
                 if not self.accept("op", ")"):
                     args.append(self.expr())
                     while self.accept("op", ","):
                         args.append(self.expr())
                     self.expect("op", ")")
-                call = FuncCall(t.value, tuple(args))
+                call = FuncCall(t.value, tuple(args), distinct=dis)
                 if self._accept_word("over"):
                     return self._window_spec(call)
                 return call

@@ -64,6 +64,22 @@ EXTENDED_AGGS = (
     "bool_or",
 )
 
+# DISTINCT aggregates lower onto an AppendOnlyDedupExecutor keyed
+# (group keys, distinct column) feeding a plain count — the reference
+# keeps per-agg distinct dedup tables (executor/aggregation/
+# distinct.rs); here the dedup IS an executor stage, so checkpointing
+# and sharding reuse its machinery. approx_count_distinct shares the
+# lowering (an exact answer is a valid approximation; the reference's
+# HLL trades exactness for bounded state).
+DISTINCT_AGGS = ("approx_count_distinct",)
+
+
+def _is_distinct_agg(ast) -> bool:
+    return isinstance(ast, P.FuncCall) and (
+        ast.name in DISTINCT_AGGS
+        or (ast.name in AGG_FUNCS and getattr(ast, "distinct", False))
+    )
+
 
 def _ext_agg_acc():
     """Shared-state accumulator for extended-agg lowering: hidden base
@@ -311,7 +327,9 @@ def compile_scalar(ast, binder: Binder) -> E.Expr:
 
 def _is_agg(ast) -> bool:
     return isinstance(ast, P.FuncCall) and (
-        ast.name in AGG_FUNCS or ast.name in EXTENDED_AGGS
+        ast.name in AGG_FUNCS
+        or ast.name in EXTENDED_AGGS
+        or ast.name in DISTINCT_AGGS
     )
 
 
@@ -588,6 +606,36 @@ class StreamPlanner:
             out_schema = {}
             ext_acc = _ext_agg_acc()
             finishing: Dict[str, object] = {}
+            gdcols = [
+                binder.resolve(it.expr.args[0])
+                for it in select.items
+                if _is_distinct_agg(it.expr)
+                and it.expr.args != ("*",)
+                and isinstance(it.expr.args[0], P.Ident)
+            ]
+            if any(_is_distinct_agg(it.expr) for it in select.items):
+                if len(set(gdcols)) != 1 or len(gdcols) != sum(
+                    1 for it in select.items if _is_distinct_agg(it.expr)
+                ):
+                    raise NotImplementedError(
+                        "DISTINCT aggregates take one shared bare column"
+                    )
+                if any(
+                    _is_agg(it.expr) and not _is_distinct_agg(it.expr)
+                    for it in select.items
+                ):
+                    raise NotImplementedError(
+                        "mixing DISTINCT and plain aggregates: split "
+                        "into two MVs"
+                    )
+                chain.append(
+                    AppendOnlyDedupExecutor(
+                        keys=(gdcols[0],),
+                        schema_dtypes=schema,
+                        capacity=self.capacity,
+                        table_id=self._tid(name, "distinct"),
+                    )
+                )
             for i, item in enumerate(select.items):
                 ast = item.expr
                 if not _is_agg(ast):
@@ -605,6 +653,23 @@ class StreamPlanner:
                     if not isinstance(arg, P.Ident):
                         raise ValueError("aggregate args must be bare columns")
                     incol = binder.resolve(arg)
+                    if getattr(ast, "distinct", False) and not _is_distinct_agg(ast):
+                        raise NotImplementedError(
+                            f"{ast.name}(DISTINCT ...) unsupported"
+                        )
+                    if _is_distinct_agg(ast):
+                        kind = (
+                            "count"
+                            if ast.name in DISTINCT_AGGS
+                            else AGG_FUNCS[ast.name]
+                        )
+                        calls.append(AggCall(kind, incol, out))
+                        out_schema[out] = (
+                            jnp.dtype(jnp.int64)
+                            if kind == "count"
+                            else schema[incol]
+                        )
+                        continue
                     if ast.name in EXTENDED_AGGS:
                         finishing[out], out_schema[out] = (
                             _lower_extended_agg(ast.name, incol, ext_acc)
@@ -907,6 +972,10 @@ class StreamPlanner:
             out = item.alias or f"{ast.func.name}_{i}"
             out_names.append(out)
             fn, args = ast.func.name, ast.func.args
+            if getattr(ast.func, "distinct", False):
+                raise NotImplementedError(
+                    f"{fn}(DISTINCT ...) OVER (...) unsupported"
+                )
             if fn == "row_number":
                 g["calls"].append(WindowCall("row_number", None, out))
             elif fn in ("rank", "dense_rank"):
@@ -1056,6 +1125,46 @@ class StreamPlanner:
         aggs: List[AggCall] = []
         out_schema: Dict[str, object] = {}
         chain: List[Executor] = []
+        # DISTINCT aggregates: dedup on (keys, distinct col) FIRST
+        dcols = [
+            binder.resolve(it.expr.args[0])
+            for it in select.items
+            if _is_distinct_agg(it.expr)
+            and it.expr.args != ("*",)
+            and isinstance(it.expr.args[0], P.Ident)
+        ]
+        if any(_is_distinct_agg(it.expr) for it in select.items):
+            if len(dcols) != sum(
+                1 for it in select.items if _is_distinct_agg(it.expr)
+            ):
+                raise ValueError(
+                    "DISTINCT aggregates take one bare column"
+                )
+            if retractable:
+                raise NotImplementedError(
+                    "DISTINCT aggregates need an append-only input"
+                )
+            if len(set(dcols)) != 1:
+                raise NotImplementedError(
+                    "all DISTINCT aggregates in one select must share "
+                    "a column"
+                )
+            if any(
+                _is_agg(it.expr) and not _is_distinct_agg(it.expr)
+                for it in select.items
+            ):
+                raise NotImplementedError(
+                    "mixing DISTINCT and plain aggregates: split into "
+                    "two MVs"
+                )
+            chain.append(
+                AppendOnlyDedupExecutor(
+                    keys=keys + (dcols[0],),
+                    schema_dtypes=schema,
+                    capacity=self.capacity,
+                    table_id=self._tid(name, "distinct"),
+                )
+            )
         ext_acc = _ext_agg_acc()  # deduped hidden calls + pre inputs
         finishing: Dict[str, object] = {}  # visible out -> Expr over hidden
         for i, item in enumerate(select.items):
@@ -1075,6 +1184,26 @@ class StreamPlanner:
                             "(project first)"
                         )
                     incol = binder.resolve(arg)
+                    if getattr(ast, "distinct", False) and not _is_distinct_agg(ast):
+                        raise NotImplementedError(
+                            f"{ast.name}(DISTINCT ...) unsupported"
+                        )
+                    if _is_distinct_agg(ast):
+                        # deduped upstream: the plain kind over unique
+                        # rows IS the distinct aggregate (count ->
+                        # distinct count, sum -> distinct sum, ...)
+                        kind = (
+                            "count"
+                            if ast.name in DISTINCT_AGGS
+                            else AGG_FUNCS[ast.name]
+                        )
+                        aggs.append(AggCall(kind, incol, out))
+                        out_schema[out] = (
+                            jnp.dtype(jnp.int64)
+                            if kind == "count"
+                            else schema[incol]
+                        )
+                        continue
                     if ast.name in EXTENDED_AGGS:
                         fin, odt = _lower_extended_agg(
                             ast.name, incol, ext_acc
@@ -2017,6 +2146,11 @@ class StreamPlanner:
         ):
             raise ValueError(
                 "scalar subquery supports [k *] avg/sum/min/max(col)"
+            )
+        if getattr(e, "distinct", False):
+            raise NotImplementedError(
+                f"{e.name}(DISTINCT ...) in a scalar subquery is "
+                "unsupported (the decorrelation would drop DISTINCT)"
             )
         if coeff <= 0:
             raise ValueError(

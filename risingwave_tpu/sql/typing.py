@@ -78,8 +78,10 @@ def infer_output_fields(stmt, catalog) -> Dict[str, Field]:
                 rf = sig[0]
                 out[name] = Field(name, rf.dtype, scale=rf.scale)
                 continue
-            if expr.name in ("count",):
+            if expr.name in ("count", "approx_count_distinct"):
                 out[name] = Field(name, DataType.INT64)
+            elif expr.name == "string_agg":
+                out[name] = Field(name, DataType.VARCHAR)
             elif expr.name in (
                 "var_pop", "var_samp", "stddev_pop", "stddev_samp",
             ):
@@ -218,7 +220,7 @@ def _rewrite_pred(pred, env, strings=None):
                     _lane_lit(a, f, strings) if isinstance(a, P.Literal) else a
                     for a in args[1:]
                 ]
-        return P.FuncCall(pred.name, tuple(args))
+        return P.FuncCall(pred.name, tuple(args), distinct=pred.distinct)
     if isinstance(pred, P.CaseExpr):
         return P.CaseExpr(
             tuple(
