@@ -88,12 +88,17 @@ class DistributedBatchRunner:
         # groups, concatenation merges) but GLOBAL ones must run local
         from risingwave_tpu.sql.planner import EXTENDED_AGGS
 
+        from risingwave_tpu.batch.engine import (
+            COLLECT_AGGS,
+            DISTINCT_AGG_NAMES,
+        )
+
         if not stmt.group_by and any(
             isinstance(i.expr, P.FuncCall)
             and (
                 i.expr.name in EXTENDED_AGGS
-                or i.expr.name
-                in ("approx_count_distinct", "string_agg", "array_agg")
+                or i.expr.name in DISTINCT_AGG_NAMES
+                or i.expr.name in COLLECT_AGGS
                 or getattr(i.expr, "distinct", False)
             )
             for i in stmt.items

@@ -87,6 +87,29 @@ class BatchQueryEngine:
         # session dictionary (set by SqlSession): string_agg decodes
         # VARCHAR codes, joins text, and encodes the result back
         self.strings = None
+        # session catalog (set by SqlSession): array_agg decodes its
+        # ELEMENTS by the arg column's logical type — the result edge
+        # only decodes whole lanes, never values inside lists
+        self.catalog = None
+
+    def _elem_decoder(self, stmt, arg):
+        """Per-element decode fn for collect aggregates."""
+        if self.catalog is None or not isinstance(arg, P.Ident):
+            return lambda v: v
+        from risingwave_tpu.sql.typing import _env_of_rel
+        from risingwave_tpu.types import DataType
+
+        f = _env_of_rel(stmt.from_, self.catalog).get(arg.name)
+        if f is None:
+            return lambda v: v
+        if f.dtype is DataType.VARCHAR and self.strings is not None:
+            return lambda v: self.strings.decode_one(int(v))
+        if f.dtype is DataType.DECIMAL:
+            from decimal import Decimal
+
+            sc = f.scale or 0
+            return lambda v: Decimal(int(v)).scaleb(-sc)
+        return lambda v: v
 
     def register(self, name: str, mview: MaterializeExecutor) -> None:
         self.tables[name] = mview
@@ -195,7 +218,9 @@ class BatchQueryEngine:
             for i, item in enumerate(stmt.items):
                 if _is_batch_agg(item.expr):
                     name = item.alias or f"{item.expr.name}_{i}"
-                    vals, isnull = self._scalar_agg(item.expr, cols, n, binder)
+                    vals, isnull = self._scalar_agg(
+                        item.expr, cols, n, binder, stmt=stmt
+                    )
                     out[name] = vals
                     if isnull:
                         out[name + "__null"] = np.array([True])
@@ -639,7 +664,7 @@ class BatchQueryEngine:
             np.asarray(nl)[:n] if nl is not None else None
         )
 
-    def _scalar_agg(self, fc, cols, n, binder):
+    def _scalar_agg(self, fc, cols, n, binder, stmt=None):
         """NULL-aware global aggregate: NULL cells (None in object
         lanes) are skipped; sum/min/max over zero surviving rows is SQL
         NULL — returned as (values, is_null) so the caller emits the
@@ -666,11 +691,16 @@ class BatchQueryEngine:
                 if len(x) == 0:
                     return np.array([0]), True  # zero rows -> NULL
                 # PG array_agg PRESERVES NULL elements
+                edec = (
+                    self._elem_decoder(stmt, fc.args[0])
+                    if stmt is not None
+                    else (lambda v: v)
+                )
                 arr = np.empty(1, object)
                 arr[0] = [
                     None
                     if v is None or (isinstance(v, float) and np.isnan(v))
-                    else v
+                    else edec(v)
                     for v in x.tolist()
                 ]
                 return arr, False
@@ -828,12 +858,15 @@ class BatchQueryEngine:
             elif fc.name in COLLECT_AGGS:
                 col = binder.resolve(fc.args[0])
                 if fc.name == "array_agg":
-                    # PG array_agg PRESERVES NULL elements
+                    # PG array_agg PRESERVES NULL elements; VARCHAR/
+                    # DECIMAL elements decode to SQL values (the edge
+                    # never decodes inside lists)
                     import pandas as pd
 
+                    edec = self._elem_decoder(stmt, fc.args[0])
                     frames[name] = gb[col].agg(
                         lambda x: [
-                            None if pd.isna(v) else v for v in x
+                            None if pd.isna(v) else edec(v) for v in x
                         ]
                     )
                 else:  # string_agg(col, sep); all-NULL group -> NULL
@@ -851,13 +884,14 @@ class BatchQueryEngine:
                     sep = str(fc.args[1].value)
                     dec = self.strings.decode_one
                     enc = self.strings.encode_one
-                    frames[name] = gb[col].agg(
-                        lambda x: enc(
-                            sep.join(dec(int(c)) for c in x.dropna())
-                        )
-                        if len(x.dropna())
-                        else np.nan
-                    )
+
+                    def _sagg(x, _sep=sep, _dec=dec, _enc=enc):
+                        d = x.dropna()
+                        if not len(d):
+                            return np.nan
+                        return _enc(_sep.join(_dec(int(c)) for c in d))
+
+                    frames[name] = gb[col].agg(_sagg)
             elif fc.name in EXTENDED_AGGS:
                 col = f"__num_{binder.resolve(fc.args[0])}"
                 ext_kinds[name] = fc.name

@@ -827,6 +827,12 @@ class HashAggExecutor(Executor, Checkpointable):
                 break
         return outs
 
+    def cleaning_watermarks(self):
+        """[(table_id, storage key name, cutoff)] — consumed by the
+        runtime at checkpoint (skip-watermark compaction)."""
+        wm = getattr(self, "_cleaning_watermark", None)
+        return [(self.table_id, wm[0], wm[1])] if wm else []
+
     def on_watermark(self, watermark: Watermark):
         if self.window_key is None or watermark.column != self.window_key[0]:
             return watermark, []
@@ -860,6 +866,15 @@ class HashAggExecutor(Executor, Checkpointable):
             outs = self._flush_all()
         cutoff = jnp.asarray(watermark.value - retention, dtype=jnp.int64)
         key_index = self._key_lane_index(colname)
+        # storage-side skip-watermark cleaning (state_table.rs:1133):
+        # the runtime forwards this to the checkpoint manager so
+        # compaction drops expired keys from durable SSTs — the EOWC
+        # path (emit_deletes=False) frees device state WITHOUT
+        # tombstones, and only this watermark reclaims its storage
+        self._cleaning_watermark = (
+            f"k{key_index}",
+            int(watermark.value) - retention,
+        )
         if self.minput:
             lane = self.table.keys[key_index]
             expired = self.table.live & (lane < cutoff)

@@ -166,6 +166,20 @@ class Lit(Expr):
 
 
 @dataclass(frozen=True, eq=False)
+class AssumeNotNull(Expr):
+    """Drop the NULL lane. The planner inserts this only AFTER a
+    NULL-filter on the column: the surviving rows are provably
+    non-null, so stripping the lane is semantics-preserving (null-
+    lane-free consumers like the dedup keys accept the column)."""
+
+    inner: Expr
+
+    def eval(self, chunk: DataChunk) -> EvalResult:
+        v, _ = self.inner.eval(chunk)
+        return v, None
+
+
+@dataclass(frozen=True, eq=False)
 class Cast(Expr):
     """Device dtype cast (CAST(x AS t) on fixed-width lanes; logical-
     type casts — dictionary/decimal rescale — happen at the host

@@ -90,6 +90,7 @@ class SqlSession:
         self.strings = StringDictionary()
         self.planner.strings = self.strings  # literal -> code rewriting
         self.batch.strings = self.strings  # string_agg joins decoded text
+        self.batch.catalog = catalog  # collect-agg element decoding
         # temporal joins probe a relation's materialize state directly
         self.planner.mviews = self.batch.tables
         self.dml = DmlManager(self.runtime, catalog, strings=self.strings)

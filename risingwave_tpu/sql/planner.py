@@ -81,6 +81,62 @@ def _is_distinct_agg(ast) -> bool:
     )
 
 
+def _distinct_dedup_stage(select, binder, keys, schema, capacity, table_id):
+    """Validate a select's DISTINCT aggregates and build their shared
+    dedup prefix: [NULL filter on the distinct column (PG ignores NULL
+    inputs), AppendOnlyDedupExecutor keyed (group keys, column)].
+    Returns [] when the select has no DISTINCT aggregates.
+
+    Known divergence: a group whose rows ALL have a NULL distinct
+    column is dropped entirely (PG keeps it with count 0) — the NULL
+    filter removes its rows before grouping."""
+    items = select.items
+    if not any(_is_distinct_agg(it.expr) for it in items):
+        return [], None
+    dcols = [
+        binder.resolve(it.expr.args[0])
+        for it in items
+        if _is_distinct_agg(it.expr)
+        and it.expr.args != ("*",)
+        and isinstance(it.expr.args[0], P.Ident)
+    ]
+    n_distinct = sum(1 for it in items if _is_distinct_agg(it.expr))
+    if len(dcols) != n_distinct:
+        raise ValueError("DISTINCT aggregates take one bare column")
+    if len(set(dcols)) != 1:
+        raise NotImplementedError(
+            "all DISTINCT aggregates in one select must share a column"
+        )
+    if any(
+        _is_agg(it.expr) and not _is_distinct_agg(it.expr)
+        for it in items
+    ):
+        raise NotImplementedError(
+            "mixing DISTINCT and plain aggregates: split into two MVs"
+        )
+    dcol = dcols[0]
+    stage = [
+        FilterExecutor(E.IsNull(E.col(dcol), negate=True)),
+        # the filter removed NULL rows but not the column's NULL LANE;
+        # strip it so the dedup's null-free key contract holds
+        ProjectExecutor(
+            {
+                c: (
+                    E.AssumeNotNull(E.col(c)) if c == dcol else E.col(c)
+                )
+                for c in schema
+            }
+        ),
+        AppendOnlyDedupExecutor(
+            keys=tuple(keys) + (dcol,),
+            schema_dtypes=schema,
+            capacity=capacity,
+            table_id=table_id,
+        ),
+    ]
+    return stage, dcol
+
+
 def _ext_agg_acc():
     """Shared-state accumulator for extended-agg lowering: hidden base
     calls are DEDUPED by (kind, input) so ``avg(v), stddev_samp(v)``
@@ -290,6 +346,11 @@ def compile_scalar(ast, binder: Binder) -> E.Expr:
             return E.InList(e, vals)
         if ast.name in AGG_FUNCS or ast.name in EXTENDED_AGGS:
             raise ValueError(f"aggregate {ast.name}() outside GROUP BY select")
+        if getattr(ast, "distinct", False):
+            raise ValueError(
+                f"DISTINCT specified, but {ast.name} is not an "
+                "aggregate function"
+            )
         if ast.name == "coalesce":
             return F.Coalesce(
                 tuple(compile_scalar(a, binder) for a in ast.args)
@@ -606,36 +667,11 @@ class StreamPlanner:
             out_schema = {}
             ext_acc = _ext_agg_acc()
             finishing: Dict[str, object] = {}
-            gdcols = [
-                binder.resolve(it.expr.args[0])
-                for it in select.items
-                if _is_distinct_agg(it.expr)
-                and it.expr.args != ("*",)
-                and isinstance(it.expr.args[0], P.Ident)
-            ]
-            if any(_is_distinct_agg(it.expr) for it in select.items):
-                if len(set(gdcols)) != 1 or len(gdcols) != sum(
-                    1 for it in select.items if _is_distinct_agg(it.expr)
-                ):
-                    raise NotImplementedError(
-                        "DISTINCT aggregates take one shared bare column"
-                    )
-                if any(
-                    _is_agg(it.expr) and not _is_distinct_agg(it.expr)
-                    for it in select.items
-                ):
-                    raise NotImplementedError(
-                        "mixing DISTINCT and plain aggregates: split "
-                        "into two MVs"
-                    )
-                chain.append(
-                    AppendOnlyDedupExecutor(
-                        keys=(gdcols[0],),
-                        schema_dtypes=schema,
-                        capacity=self.capacity,
-                        table_id=self._tid(name, "distinct"),
-                    )
-                )
+            dstage, _ = _distinct_dedup_stage(
+                select, binder, (), schema, self.capacity,
+                self._tid(name, "distinct"),
+            )
+            chain.extend(dstage)
             for i, item in enumerate(select.items):
                 ast = item.expr
                 if not _is_agg(ast):
@@ -1125,46 +1161,18 @@ class StreamPlanner:
         aggs: List[AggCall] = []
         out_schema: Dict[str, object] = {}
         chain: List[Executor] = []
-        # DISTINCT aggregates: dedup on (keys, distinct col) FIRST
-        dcols = [
-            binder.resolve(it.expr.args[0])
-            for it in select.items
-            if _is_distinct_agg(it.expr)
-            and it.expr.args != ("*",)
-            and isinstance(it.expr.args[0], P.Ident)
-        ]
-        if any(_is_distinct_agg(it.expr) for it in select.items):
-            if len(dcols) != sum(
-                1 for it in select.items if _is_distinct_agg(it.expr)
-            ):
-                raise ValueError(
-                    "DISTINCT aggregates take one bare column"
-                )
-            if retractable:
-                raise NotImplementedError(
-                    "DISTINCT aggregates need an append-only input"
-                )
-            if len(set(dcols)) != 1:
-                raise NotImplementedError(
-                    "all DISTINCT aggregates in one select must share "
-                    "a column"
-                )
-            if any(
-                _is_agg(it.expr) and not _is_distinct_agg(it.expr)
-                for it in select.items
-            ):
-                raise NotImplementedError(
-                    "mixing DISTINCT and plain aggregates: split into "
-                    "two MVs"
-                )
-            chain.append(
-                AppendOnlyDedupExecutor(
-                    keys=keys + (dcols[0],),
-                    schema_dtypes=schema,
-                    capacity=self.capacity,
-                    table_id=self._tid(name, "distinct"),
-                )
+        # DISTINCT aggregates: NULL-filter + dedup on (keys, col) FIRST
+        if retractable and any(
+            _is_distinct_agg(it.expr) for it in select.items
+        ):
+            raise NotImplementedError(
+                "DISTINCT aggregates need an append-only input"
             )
+        dstage, _ = _distinct_dedup_stage(
+            select, binder, keys, schema, self.capacity,
+            self._tid(name, "distinct"),
+        )
+        chain.extend(dstage)
         ext_acc = _ext_agg_acc()  # deduped hidden calls + pre inputs
         finishing: Dict[str, object] = {}  # visible out -> Expr over hidden
         for i, item in enumerate(select.items):

@@ -219,6 +219,29 @@ class CheckpointManager:
         self._sst_cache: Dict[str, object] = {}  # path -> parsed Sst
         self._load()
 
+    # -- table watermarks (state cleaning) --------------------------------
+    def update_table_watermark(
+        self, table_id: str, key_name: str, value: int
+    ) -> None:
+        """Advance a table's cleaning watermark: rows whose ``key_name``
+        falls BELOW it are expired and may be dropped by compaction
+        (reference: StateTable::update_watermark -> Hummock table
+        watermarks -> iterator/skip_watermark.rs dropping expired keys
+        during compaction). Monotonic; persisted with the manifest so
+        a restart keeps cleaning."""
+        with self._lock:
+            wms = self.version.setdefault("watermarks", {})
+            cur = wms.get(table_id)
+            if cur is not None and cur[0] == key_name and cur[1] >= value:
+                return
+            wms[table_id] = [key_name, int(value)]
+            self._persist_version()
+
+    def table_watermark(self, table_id: str):
+        with self._lock:
+            wm = self.version.get("watermarks", {}).get(table_id)
+            return tuple(wm) if wm else None
+
     # -- version ---------------------------------------------------------
     def _manifest_path(self) -> str:
         return f"{self.prefix}/{MANIFEST}"
@@ -248,6 +271,12 @@ class CheckpointManager:
         for ex in executors:
             if not isinstance(ex, Checkpointable):
                 continue
+            # executors with watermark-driven cleaning advance their
+            # table's skip-watermark here, riding the checkpoint cycle
+            wm_fn = getattr(ex, "cleaning_watermarks", None)
+            if wm_fn is not None:
+                for tid, key, val in wm_fn():
+                    self.update_table_watermark(tid, key, val)
             for delta in ex.staged_or_live_delta():
                 if delta.table_id in seen_ids:
                     raise ValueError(
@@ -384,6 +413,19 @@ class CheckpointManager:
         ]
         keys, values = merge_ssts(ssts, key_order)
         n_rows = len(next(iter(keys.values()))) if keys else 0
+        # skip-watermark cleaning: expired keys drop during the merge
+        # (iterator/skip_watermark.rs) — tombstone-free state cleaning
+        wm = self.table_watermark(table_id)
+        if wm is not None and n_rows:
+            kname, wval = wm
+            if kname in keys:
+                keep = np.asarray(keys[kname]) >= wval
+                if not keep.all():
+                    keys = {k: np.asarray(a)[keep] for k, a in keys.items()}
+                    values = {
+                        v: np.asarray(a)[keep] for v, a in values.items()
+                    }
+                    n_rows = int(keep.sum())
         # L1 file epoch = newest SOURCE epoch: stays below any
         # concurrently-committed L0 so newest-wins ordering holds
         src_epoch = max(e["epoch"] for e in src)

@@ -149,3 +149,38 @@ def test_collect_aggs_null_semantics():
     # array_agg preserves NULL elements
     out, _ = s.execute("SELECT array_agg(v) AS vs FROM t")
     assert sorted(out["vs"][0], key=lambda x: (x is None, x)) == [1, None]
+
+
+def test_streaming_count_distinct_ignores_nulls():
+    """NULL distinct-column rows filter out before the dedup stage
+    (PG: count(DISTINCT u) ignores NULLs; review finding r5: they used
+    to crash the dedup executor)."""
+    s = _sess()
+    s.execute("CREATE TABLE t (k BIGINT, u BIGINT)")
+    s.execute(
+        "CREATE MATERIALIZED VIEW m AS "
+        "SELECT k, count(DISTINCT u) AS d FROM t GROUP BY k"
+    )
+    s.execute("INSERT INTO t VALUES (1, 7), (1, NULL), (1, 7)")
+    out, _ = s.execute("SELECT k, d FROM m")
+    assert list(out["d"]) == [1]
+
+
+def test_array_agg_decodes_varchar_elements():
+    s = _sess()
+    s.execute("CREATE TABLE t (k BIGINT, name VARCHAR)")
+    s.execute("INSERT INTO t VALUES (1, 'alpha'), (1, 'beta')")
+    out, _ = s.execute("SELECT array_agg(name) AS ns FROM t")
+    assert sorted(out["ns"][0]) == ["alpha", "beta"]
+    out, _ = s.execute(
+        "SELECT k, array_agg(name) AS ns FROM t GROUP BY k"
+    )
+    assert sorted(out["ns"][0]) == ["alpha", "beta"]
+
+
+def test_distinct_on_scalar_function_rejected():
+    s = _sess()
+    s.execute("CREATE TABLE t (name VARCHAR)")
+    s.execute("INSERT INTO t VALUES ('a')")
+    with pytest.raises(Exception, match="DISTINCT"):
+        s.execute("SELECT upper(DISTINCT name) AS u FROM t")

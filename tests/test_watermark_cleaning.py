@@ -1,0 +1,120 @@
+"""Skip-watermark state cleaning in storage compaction.
+
+Reference: StateTable::update_watermark (state_table.rs:1133) ->
+Hummock table watermarks -> compaction dropping expired keys
+(iterator/skip_watermark.rs). Closed-window state that was never
+tombstoned (the EOWC path frees device state silently) reclaims its
+DURABLE footprint here.
+"""
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.storage.object_store import MemObjectStore
+from risingwave_tpu.storage.state_table import (
+    CheckpointManager,
+    StateDelta,
+)
+
+pytestmark = pytest.mark.smoke
+
+
+def _commit(mgr, epoch, tid, ks, vs, tomb=None):
+    n = len(ks)
+    mgr.commit_staged(
+        epoch,
+        [
+            StateDelta(
+                tid,
+                {"k0": np.asarray(ks, np.int64)},
+                {"v": np.asarray(vs, np.int64)},
+                np.zeros(n, bool) if tomb is None else np.asarray(tomb),
+                ("k0",),
+            )
+        ],
+    )
+
+
+def test_compaction_drops_expired_keys():
+    store = MemObjectStore()
+    mgr = CheckpointManager(store, compact_at=2)
+    for e in range(1, 5):
+        _commit(mgr, e, "t", [e * 10, e * 10 + 1], [e, e])
+    mgr.update_table_watermark("t", "k0", 30)
+    assert mgr.compact_once("t", 10)
+    keys, _ = mgr.read_table("t")
+    ks = sorted(np.asarray(keys["k0"]).tolist())
+    assert ks == [30, 31, 40, 41]  # 10/11/20/21 expired
+    # watermark is monotonic: an older value cannot regress it
+    mgr.update_table_watermark("t", "k0", 5)
+    assert mgr.table_watermark("t") == ("k0", 30)
+
+
+def test_watermark_survives_manifest_reload():
+    store = MemObjectStore()
+    mgr = CheckpointManager(store, compact_at=2)
+    _commit(mgr, 1, "t", [1, 100], [0, 0])
+    mgr.update_table_watermark("t", "k0", 50)
+    mgr2 = CheckpointManager(store, compact_at=2)
+    assert mgr2.table_watermark("t") == ("k0", 50)
+    _commit(mgr2, 2, "t", [2, 200], [0, 0])
+    assert mgr2.compact_once("t", 10)
+    keys, _ = mgr2.read_table("t")
+    assert sorted(np.asarray(keys["k0"]).tolist()) == [100, 200]
+
+
+def test_eowc_agg_forwards_cleaning_watermark():
+    """An EOWC-style HashAgg (window_key, emit_deletes=False) frees
+    device state silently; its cleaning watermark must reach the
+    manager at stage() so compaction reclaims the durable rows."""
+    import jax.numpy as jnp
+
+    from risingwave_tpu.executors.base import Watermark
+    from risingwave_tpu.executors.hash_agg import HashAggExecutor
+    from risingwave_tpu.ops.agg import AggCall
+
+    store = MemObjectStore()
+    mgr = CheckpointManager(store, compact_at=2)
+    agg = HashAggExecutor(
+        ("ws",),
+        (AggCall("count_star", None, "n"),),
+        {"ws": jnp.int64, "v": jnp.int64},
+        capacity=1 << 8,
+        table_id="q.agg",
+        window_key=("ws", 0, False),  # EOWC: no delete emission
+    )
+    from risingwave_tpu.array.chunk import StreamChunk
+
+    for e, ws in enumerate(((1000, 2000), (2000, 3000)), start=1):
+        agg.apply(
+            StreamChunk.from_numpy(
+                {
+                    "ws": np.asarray(ws, np.int64),
+                    "v": np.asarray([1, 1], np.int64),
+                },
+                4,
+            )
+        )
+        mgr.commit_epoch(e, [agg])
+    # watermark closes windows < 2500
+    agg.on_watermark(Watermark("ws", 2500))
+    assert agg.cleaning_watermarks() == [("q.agg", "k0", 2500)]
+    mgr.commit_epoch(3, [agg])  # stage() forwards the watermark
+    assert mgr.table_watermark("q.agg") == ("k0", 2500)
+    # two fresh L0 deltas re-arm the compaction threshold
+    agg.apply(
+        StreamChunk.from_numpy(
+            {
+                "ws": np.asarray([3000, 4000], np.int64),
+                "v": np.asarray([1, 1], np.int64),
+            },
+            4,
+        )
+    )
+    mgr.commit_epoch(4, [agg])
+    # threshold compaction (inline or manual) applies the watermark
+    mgr.compact_once("q.agg", 10)
+    keys, _ = mgr.read_table("q.agg")
+    ks = sorted(np.asarray(keys["k0"]).tolist())
+    assert all(k >= 2500 for k in ks), ks
+    assert 3000 in ks
