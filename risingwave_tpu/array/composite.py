@@ -48,9 +48,40 @@ def _child_field(parent: Field, child: Field) -> Field:
     )
 
 
+_I256_BITS = 256
+_I256_LIMBS = 4
+_U64_MASK = (1 << 64) - 1
+
+
+def _int256_to_limbs(v: int) -> Tuple[int, ...]:
+    """Signed 256-bit int -> 4 little-endian 64-bit limbs, each stored
+    two's-complement in an int64 lane (reference: types/int256 — a
+    4-limb wide integer; limb lanes keep device storage fixed-width)."""
+    if not -(1 << 255) <= v < (1 << 255):
+        raise OverflowError(f"{v} overflows INT256")
+    u = v & ((1 << _I256_BITS) - 1)  # two's complement
+    out = []
+    for i in range(_I256_LIMBS):
+        limb = (u >> (64 * i)) & _U64_MASK
+        out.append(limb - (1 << 64) if limb >= (1 << 63) else limb)
+    return tuple(out)
+
+
+def _limbs_to_int256(limbs: Sequence[int]) -> int:
+    u = 0
+    for i, limb in enumerate(limbs):
+        u |= (int(limb) & _U64_MASK) << (64 * i)
+    return u - (1 << _I256_BITS) if u >= (1 << 255) else u
+
+
 def expand_field(field: Field) -> List[Tuple[str, np.dtype]]:
     """Leaf device lanes (name, dtype) for one logical column."""
     dt = field.dtype
+    if dt is DataType.INT256:
+        return [
+            (f"{field.name}.l{i}", np.dtype(np.int64))
+            for i in range(_I256_LIMBS)
+        ]
     if dt is DataType.INTERVAL:
         return [
             (f"{field.name}.months", np.dtype(np.int32)),
@@ -100,6 +131,8 @@ def encode_column(
         anchor = f"{field.name}.usecs"
     elif dt is DataType.LIST:
         anchor = field.name + LIST_LEN_SUFFIX
+    elif dt is DataType.INT256:
+        anchor = f"{field.name}.l0"
     nulls = {anchor: isnull} if isnull.any() else None
 
     if dt is DataType.VARCHAR or dt is DataType.JSONB:
@@ -125,6 +158,17 @@ def encode_column(
             np.int64,
         )
         return {field.name: arr}, nulls
+    if dt is DataType.INT256:
+        limb_arrs = [np.zeros(n, np.int64) for _ in range(_I256_LIMBS)]
+        for i, v in enumerate(values):
+            if v is None:
+                continue
+            for j, limb in enumerate(_int256_to_limbs(int(v))):
+                limb_arrs[j][i] = limb
+        return {
+            f"{field.name}.l{j}": limb_arrs[j]
+            for j in range(_I256_LIMBS)
+        }, nulls
     if dt is DataType.INTERVAL:
         months = np.zeros(n, np.int32)
         usecs = np.zeros(n, np.int64)
@@ -196,6 +240,8 @@ def decode_column(
         isnull = null_of(field.name + LIST_LEN_SUFFIX)
     elif dt is DataType.STRUCT:
         isnull = None  # NULL struct == all children NULL
+    elif dt is DataType.INT256:
+        isnull = null_of(f"{field.name}.l0")
     else:
         isnull = null_of(field.name)
 
@@ -219,6 +265,16 @@ def decode_column(
             [
                 Decimal(int(v)).scaleb(-field.scale)
                 for v in lanes[field.name]
+            ]
+        )
+    if dt is DataType.INT256:
+        limb_arrs = [
+            lanes[f"{field.name}.l{j}"] for j in range(_I256_LIMBS)
+        ]
+        return _masked(
+            [
+                _limbs_to_int256([a[i] for a in limb_arrs])
+                for i in range(len(limb_arrs[0]))
             ]
         )
     if dt is DataType.INTERVAL:

@@ -34,6 +34,7 @@ _TYPE_WORDS = {
     "decimal": DataType.DECIMAL, "numeric": DataType.DECIMAL,
     "interval": DataType.INTERVAL,
     "jsonb": DataType.JSONB, "json": DataType.JSONB,
+    "int256": DataType.INT256, "rw_int256": DataType.INT256,
 }
 
 

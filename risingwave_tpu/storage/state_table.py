@@ -217,6 +217,9 @@ class CheckpointManager:
         self._lock = threading.RLock()
         self.version = {"max_committed_epoch": 0, "tables": {}}
         self._sst_cache: Dict[str, object] = {}  # path -> parsed Sst
+        # stage()-buffered cleaning watermarks: durable only WITH the
+        # epoch that staged them (commit_staged applies + persists)
+        self._pending_watermarks: Dict[str, Tuple[str, int]] = {}
         self._load()
 
     # -- table watermarks (state cleaning) --------------------------------
@@ -272,11 +275,16 @@ class CheckpointManager:
             if not isinstance(ex, Checkpointable):
                 continue
             # executors with watermark-driven cleaning advance their
-            # table's skip-watermark here, riding the checkpoint cycle
+            # table's skip-watermark here — BUFFERED: it becomes
+            # durable with this epoch's manifest commit, never before
+            # (compaction acting on an early watermark could drop
+            # state whose downstream emissions were not yet durable)
             wm_fn = getattr(ex, "cleaning_watermarks", None)
             if wm_fn is not None:
                 for tid, key, val in wm_fn():
-                    self.update_table_watermark(tid, key, val)
+                    cur = self._pending_watermarks.get(tid)
+                    if cur is None or cur[0] != key or cur[1] < val:
+                        self._pending_watermarks[tid] = (key, int(val))
             for delta in ex.staged_or_live_delta():
                 if delta.table_id in seen_ids:
                     raise ValueError(
@@ -336,6 +344,16 @@ class CheckpointManager:
             for table_id, entry in new_entries:
                 self.version["tables"].setdefault(table_id, []).append(entry)
             self.version["max_committed_epoch"] = epoch
+            # cleaning watermarks become durable WITH this epoch: the
+            # emissions they license compaction to destroy are durable
+            # in the same manifest write
+            if self._pending_watermarks:
+                wms = self.version.setdefault("watermarks", {})
+                for tid, (key, val) in self._pending_watermarks.items():
+                    cur = wms.get(tid)
+                    if cur is None or cur[0] != key or cur[1] < val:
+                        wms[tid] = [key, val]
+                self._pending_watermarks = {}
             self._persist_version()
         sync_point.hit("after_manifest_commit")
         return n

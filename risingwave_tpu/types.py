@@ -84,6 +84,7 @@ class DataType(enum.Enum):
     JSONB = "jsonb"  # dictionary-encoded canonical JSON, int32
     STRUCT = "struct"  # composite: child lanes (Field.children)
     LIST = "list"  # composite: padded element lanes (Field.elem/cap)
+    INT256 = "int256"  # composite: 4 little-endian int64 limbs
 
     @property
     def device_dtype(self) -> np.dtype:
@@ -106,7 +107,12 @@ class DataType(enum.Enum):
 
     @property
     def is_composite(self) -> bool:
-        return self in (DataType.INTERVAL, DataType.STRUCT, DataType.LIST)
+        return self in (
+            DataType.INTERVAL,
+            DataType.STRUCT,
+            DataType.LIST,
+            DataType.INT256,
+        )
 
     @property
     def null_value(self):

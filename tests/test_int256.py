@@ -1,0 +1,70 @@
+"""INT256 composite type: 4 little-endian int64 limb lanes.
+
+Reference: src/common/src/types/ int256 (a 4-limb wide integer used
+where int64 sums would overflow). TPU re-design: fixed-width limb
+lanes keep the device layout static; arithmetic happens at the host
+edges (the reference's int256 is host-side too — no SIMD kernels).
+"""
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.array.composite import (
+    _int256_to_limbs,
+    _limbs_to_int256,
+    decode_column,
+    encode_column,
+    expand_field,
+)
+from risingwave_tpu.types import DataType, Field
+
+pytestmark = pytest.mark.smoke
+
+
+def test_limb_round_trip_extremes():
+    cases = [
+        0, 1, -1, (1 << 255) - 1, -(1 << 255), 1 << 200, -(1 << 200),
+        123456789, -987654321, (1 << 64), (1 << 128) + 7,
+    ]
+    for v in cases:
+        assert _limbs_to_int256(_int256_to_limbs(v)) == v
+    with pytest.raises(OverflowError):
+        _int256_to_limbs(1 << 255)
+    with pytest.raises(OverflowError):
+        _int256_to_limbs(-(1 << 255) - 1)
+
+
+def test_expand_encode_decode_with_nulls():
+    f = Field("x", DataType.INT256)
+    lanes_spec = expand_field(f)
+    assert [n for n, _ in lanes_spec] == ["x.l0", "x.l1", "x.l2", "x.l3"]
+    assert all(d == np.dtype(np.int64) for _, d in lanes_spec)
+    vals = [1 << 100, None, -(1 << 200), 42]
+    lanes, nulls = encode_column(f, vals)
+    assert set(lanes) == {"x.l0", "x.l1", "x.l2", "x.l3"}
+    assert nulls is not None and list(nulls["x.l0"]) == [
+        False, True, False, False,
+    ]
+    got = decode_column(
+        f, lanes, lambda n: nulls.get(n) if nulls else None
+    )
+    assert got == [1 << 100, None, -(1 << 200), 42]
+
+
+def test_int256_sum_via_host():
+    """The int64-overflow use case: limb decode -> python bigint sum."""
+    f = Field("x", DataType.INT256)
+    big = (1 << 80) + 5
+    vals = [big, big, big]
+    lanes, nulls = encode_column(f, vals)
+    decoded = decode_column(f, lanes, lambda n: None)
+    assert sum(decoded) == 3 * big
+
+
+def test_ddl_gated_like_other_composites():
+    from risingwave_tpu.frontend.session import SqlSession
+    from risingwave_tpu.sql import Catalog
+
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    with pytest.raises(NotImplementedError, match="INT256"):
+        s.execute("CREATE TABLE t (x INT256)")
