@@ -36,6 +36,12 @@ class FuncCall:
 
 
 @dataclass(frozen=True)
+class Star:
+    """SELECT * — expanded to the relation's columns before planning
+    (the reference's binder star expansion, binder/select.rs)."""
+
+
+@dataclass(frozen=True)
 class UnaryOp:
     op: str
     operand: object
@@ -453,9 +459,17 @@ class Parser:
     def select(self) -> Select:
         self.expect("kw", "select")
         distinct = bool(self.accept("kw", "distinct"))
-        items = [self.select_item()]
-        while self.accept("op", ","):
-            items.append(self.select_item())
+        if self.accept("op", "*"):
+            # SELECT * [, more]: expanded against the catalog by the
+            # typing layer / session before planning (binder star
+            # expansion)
+            items = [SelectItem(Star(), None)]
+            while self.accept("op", ","):
+                items.append(self.select_item())
+        else:
+            items = [self.select_item()]
+            while self.accept("op", ","):
+                items.append(self.select_item())
         self.expect("kw", "from")
         rel = self.relation()
         while True:

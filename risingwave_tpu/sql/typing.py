@@ -49,6 +49,7 @@ def infer_output_fields(stmt, catalog) -> Dict[str, Field]:
     """Best-effort output column name -> logical Field for a Select."""
     if not isinstance(stmt, P.Select):
         return {}
+    stmt = expand_star(stmt, catalog, strict=False)
     env = _env_of_rel(stmt.from_, catalog) if stmt.from_ is not None else {}
     out: Dict[str, Field] = {}
     for i, item in enumerate(stmt.items):
@@ -263,11 +264,40 @@ def _check_collation(select: P.Select, env, out_fields) -> None:
             )
 
 
+def expand_star(select: P.Select, catalog, strict: bool = True) -> P.Select:
+    """SELECT * -> explicit Ident items in relation column order
+    (binder star expansion, binder/select.rs). Hidden planner columns
+    (leading underscore) stay hidden. ``strict=False`` returns the
+    select unchanged when the relation's columns are unknown (inner
+    derived tables during best-effort inference)."""
+    if not any(isinstance(it.expr, P.Star) for it in select.items):
+        return select
+    env = _env_of_rel(select.from_, catalog)
+    if not env:
+        if not strict:
+            return select
+        raise ValueError("SELECT *: unknown relation columns")
+    items = []
+    for it in select.items:
+        if isinstance(it.expr, P.Star):
+            items.extend(
+                P.SelectItem(P.Ident(n), None)
+                for n in env
+                if not n.startswith("_")
+            )
+        else:
+            items.append(it)
+    import dataclasses
+
+    return dataclasses.replace(select, items=tuple(items))
+
+
 def typecheck_select(select: P.Select, catalog, strings=None) -> P.Select:
     """Type-directed pass run before planning/execution: rewrites
     DECIMAL/VARCHAR/JSONB literals into the lane domain and rejects
     unordered-dictionary min/max/ORDER BY. Recurses into derived
     tables."""
+    select = expand_star(select, catalog)
     new_from = _typecheck_rel(select.from_, catalog, strings)
     env = _env_of_rel(new_from, catalog)
     where = (

@@ -1,0 +1,189 @@
+"""SqlSmith-lite: seeded random query generation + DIFFERENTIAL
+checking of the streaming plan against the batch engine.
+
+Reference: src/tests/sqlsmith/ — generated queries where the property
+under test is agreement between two independent execution paths, not
+hand-written expectations. Here every generated query runs twice:
+
+  1. CREATE MATERIALIZED VIEW m AS <query>  (streaming executors,
+     incremental over multiple INSERT epochs)
+  2. <query> directly                        (batch engine over the
+     base table snapshot)
+
+and the row multisets must agree. Failures reproduce from the seed.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.frontend.session import SqlSession
+from risingwave_tpu.sql import Catalog
+
+AGGS = ["count", "sum", "min", "max", "avg"]
+CMPS = ["<", "<=", ">", ">=", "=", "<>"]
+
+
+def _gen_query(rng: random.Random, i: int):
+    """One random supported SELECT over t(k BIGINT, v BIGINT, w BIGINT)."""
+    where = ""
+    if rng.random() < 0.7:
+        col = rng.choice(["k", "v", "w"])
+        lit = rng.randint(-5, 15)
+        op = rng.choice(CMPS)
+        where = f" WHERE {col} {op} {lit}"
+        if rng.random() < 0.3:
+            col2 = rng.choice(["v", "w"])
+            where += f" AND {col2} {rng.choice(CMPS)} {rng.randint(-5, 15)}"
+    if rng.random() < 0.6:
+        # grouped aggregates
+        n_aggs = rng.randint(1, 3)
+        items = ["k"]
+        for j in range(n_aggs):
+            fn = rng.choice(AGGS)
+            arg = "*" if fn == "count" and rng.random() < 0.4 else rng.choice(["v", "w"])
+            items.append(f"{fn}({arg}) AS a{j}")
+        return f"SELECT {', '.join(items)} FROM t{where} GROUP BY k"
+    # plain projection
+    cols = rng.sample(["k", "v", "w"], rng.randint(1, 3))
+    return f"SELECT {', '.join(cols)} FROM t{where}"
+
+
+def _rows(out):
+    """Column dict -> sorted list of normalized row tuples."""
+    if not out:
+        return []
+    names = sorted(k for k in out if not k.endswith("__null"))
+    cols = []
+    for n in names:
+        nl = out.get(n + "__null")
+        vals = []
+        for i, v in enumerate(np.asarray(out[n]).tolist()):
+            if nl is not None and bool(np.asarray(nl)[i]):
+                vals.append(None)
+            elif isinstance(v, float):
+                vals.append(None if np.isnan(v) else round(v, 9))
+            else:
+                vals.append(v)
+        cols.append(vals)
+    rows = list(zip(*cols))
+    return sorted(rows, key=lambda r: tuple((x is None, x) for x in r))
+
+
+@pytest.mark.parametrize("seed", [11, 23, 47])
+def test_streaming_batch_differential(seed):
+    rng = random.Random(seed)
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute("CREATE TABLE t (k BIGINT, v BIGINT, w BIGINT)")
+    # data in TWO epochs so streaming exercises incremental updates
+    for _ in range(2):
+        rows = ", ".join(
+            f"({rng.randint(0, 4)}, {rng.randint(-5, 15)}, "
+            f"{rng.randint(-5, 15)})"
+            for _ in range(rng.randint(5, 20))
+        )
+        s.execute(f"INSERT INTO t VALUES {rows}")
+    n_q = 8
+    for i in range(n_q):
+        q = _gen_query(rng, i)
+        mv = f"fz{seed}_{i}"
+        try:
+            s.execute(f"CREATE MATERIALIZED VIEW {mv} AS {q}")
+        except (NotImplementedError, ValueError):
+            continue  # outside the supported streaming surface: fine
+        got_stream, _ = s.execute(f"SELECT * FROM {mv}")
+        got_batch, _ = s.execute(q)
+        # streaming MV may expose hidden pk cols; compare the batch
+        # query's column set
+        keep = {
+            k
+            for k in got_batch
+            if not k.endswith("__null") and not k.startswith("_")
+        }
+        gs = {
+            k: v
+            for k, v in got_stream.items()
+            if k.split("__null")[0] in keep
+        }
+        gb = {
+            k: v
+            for k, v in got_batch.items()
+            if k.split("__null")[0] in keep
+        }
+        assert _rows(gs) == _rows(gb), (
+            f"seed={seed} query #{i}: {q}\n"
+            f"stream={_rows(gs)}\nbatch={_rows(gb)}"
+        )
+
+
+def test_differential_with_updates_and_deletes():
+    """The same property under RETRACTION: DML mutates the table and
+    both paths must still agree."""
+    rng = random.Random(7)
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute("CREATE TABLE t (k BIGINT, v BIGINT, w BIGINT)")
+    rows = ", ".join(
+        f"({rng.randint(0, 3)}, {rng.randint(-5, 15)}, {rng.randint(-5, 15)})"
+        for _ in range(15)
+    )
+    s.execute(f"INSERT INTO t VALUES {rows}")
+    q = "SELECT k, sum(v) AS sv, count(*) AS n, avg(w) AS aw FROM t GROUP BY k"
+    s.execute(f"CREATE MATERIALIZED VIEW dm AS {q}")
+    s.execute("UPDATE t SET v = v + 7 WHERE w > 5")
+    s.execute("DELETE FROM t WHERE v < 0")
+    got_stream, _ = s.execute("SELECT * FROM dm")
+    got_batch, _ = s.execute(q)
+    ks = {"k", "sv", "n", "aw"}
+    gs = {k: v for k, v in got_stream.items() if k.split("__null")[0] in ks}
+    gb = {k: v for k, v in got_batch.items() if k.split("__null")[0] in ks}
+    assert _rows(gs) == _rows(gb)
+
+
+def test_select_star():
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute("CREATE TABLE t (a BIGINT, b BIGINT)")
+    s.execute("INSERT INTO t VALUES (1, 2), (3, 4)")
+    out, _ = s.execute("SELECT * FROM t ORDER BY a")
+    assert list(out["a"]) == [1, 3] and list(out["b"]) == [2, 4]
+    s.execute("CREATE MATERIALIZED VIEW m AS SELECT * FROM t")
+    out, _ = s.execute("SELECT * FROM m ORDER BY a")
+    assert list(out["b"]) == [2, 4]
+    # hidden planner columns stay hidden
+    assert all(not c.startswith("_") for c in out)
+
+
+def test_select_star_preserves_logical_types():
+    """SELECT * MVs keep VARCHAR/DECIMAL logical types (review
+    finding r5: the overlay used to skip Star items and serve codes)."""
+    from decimal import Decimal
+
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute("CREATE TABLE t (x VARCHAR, d DECIMAL(10, 2))")
+    s.execute("INSERT INTO t VALUES ('hi', 1.25)")
+    s.execute("CREATE MATERIALIZED VIEW m AS SELECT * FROM t")
+    out, _ = s.execute("SELECT x, d FROM m")
+    assert list(out["x"]) == ["hi"]
+    assert out["d"][0] == Decimal("1.25")
+
+
+def test_nested_select_star():
+    """Star over a star-subquery expands level by level (streaming
+    planner path; batch FROM-subqueries are a separate limitation)."""
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute("CREATE TABLE t (a BIGINT, b BIGINT)")
+    s.execute(
+        "CREATE MATERIALIZED VIEW m AS "
+        "SELECT * FROM (SELECT * FROM t) AS s2"
+    )
+    s.execute("INSERT INTO t VALUES (1, 2)")
+    out, _ = s.execute("SELECT * FROM m")
+    assert list(out["a"]) == [1] and list(out["b"]) == [2]
+
+
+def test_select_star_with_extra_items():
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute("CREATE TABLE t (a BIGINT)")
+    s.execute("INSERT INTO t VALUES (5)")
+    out, _ = s.execute("SELECT *, a + 1 AS a1 FROM t")
+    assert list(out["a"]) == [5] and list(out["a1"]) == [6]
