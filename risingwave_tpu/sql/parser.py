@@ -459,17 +459,16 @@ class Parser:
     def select(self) -> Select:
         self.expect("kw", "select")
         distinct = bool(self.accept("kw", "distinct"))
-        if self.accept("op", "*"):
-            # SELECT * [, more]: expanded against the catalog by the
-            # typing layer / session before planning (binder star
-            # expansion)
-            items = [SelectItem(Star(), None)]
-            while self.accept("op", ","):
+        # `*` is valid in ANY item position (expanded against the
+        # catalog by the typing layer before planning)
+        items = []
+        while True:
+            if self.accept("op", "*"):
+                items.append(SelectItem(Star(), None))
+            else:
                 items.append(self.select_item())
-        else:
-            items = [self.select_item()]
-            while self.accept("op", ","):
-                items.append(self.select_item())
+            if not self.accept("op", ","):
+                break
         self.expect("kw", "from")
         rel = self.relation()
         while True:

@@ -264,27 +264,73 @@ def _check_collation(select: P.Select, env, out_fields) -> None:
             )
 
 
+def _names_of_rel(rel, catalog, strict: bool) -> list:
+    """Output column NAMES of a FROM clause. Name-complete even where
+    TYPES are uninferrable (star expansion needs names only — the
+    best-effort type env would silently drop expression columns)."""
+    if isinstance(rel, P.TableRef):
+        sch = catalog.tables.get(rel.name)
+        if sch is None:
+            return []
+        if getattr(catalog, "is_mv", lambda n: False)(rel.name):
+            # MV schemas carry PLANNER-hidden lanes (_row_id, hidden
+            # join keys) — those stay hidden; base-table underscore
+            # columns are user-created and expand normally
+            return [n for n in sch.names if not n.startswith("_")]
+        return list(sch.names)
+    if isinstance(rel, P.Join):
+        return _names_of_rel(rel.left, catalog, strict) + _names_of_rel(
+            rel.right, catalog, strict
+        )
+    if isinstance(rel, P.SubQuery):
+        inner = expand_star(rel.select, catalog, strict=False)
+        out = []
+        for i, it in enumerate(inner.items):
+            if isinstance(it.expr, P.Star):
+                return []  # inner couldn't expand: names unknown
+            if it.alias:
+                out.append(it.alias)
+            elif isinstance(it.expr, P.Ident):
+                out.append(it.expr.name)
+            elif isinstance(it.expr, P.FuncCall):
+                out.append(f"{it.expr.name}_{i}")
+            elif isinstance(it.expr, P.WindowFuncCall):
+                out.append(f"{it.expr.func.name}_{i}")
+            elif strict:
+                raise ValueError(
+                    "SELECT * over a derived table with unnamed "
+                    "expression columns: alias them"
+                )
+            else:
+                return []
+        return out
+    if isinstance(rel, P.WindowTVF):
+        return _names_of_rel(rel.table, catalog, strict) + [
+            "window_start",
+            "window_end",
+        ]
+    return []
+
+
 def expand_star(select: P.Select, catalog, strict: bool = True) -> P.Select:
     """SELECT * -> explicit Ident items in relation column order
-    (binder star expansion, binder/select.rs). Hidden planner columns
-    (leading underscore) stay hidden. ``strict=False`` returns the
-    select unchanged when the relation's columns are unknown (inner
-    derived tables during best-effort inference)."""
+    (binder star expansion, binder/select.rs). ``strict=False``
+    returns the select unchanged when the relation's columns are
+    unknown (inner derived tables during best-effort inference).
+    Catalog schemas list user-visible columns only, so hidden planner
+    lanes never expand — including user columns that happen to start
+    with an underscore."""
     if not any(isinstance(it.expr, P.Star) for it in select.items):
         return select
-    env = _env_of_rel(select.from_, catalog)
-    if not env:
+    names = _names_of_rel(select.from_, catalog, strict)
+    if not names:
         if not strict:
             return select
         raise ValueError("SELECT *: unknown relation columns")
     items = []
     for it in select.items:
         if isinstance(it.expr, P.Star):
-            items.extend(
-                P.SelectItem(P.Ident(n), None)
-                for n in env
-                if not n.startswith("_")
-            )
+            items.extend(P.SelectItem(P.Ident(n), None) for n in names)
         else:
             items.append(it)
     import dataclasses
