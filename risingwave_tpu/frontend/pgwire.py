@@ -3,9 +3,12 @@
 Reference: src/utils/pgwire/src/pg_server.rs:250 (+ pg_protocol.rs
 message codec): startup handshake, cleartext-free auth OK, the simple
 query cycle Q -> RowDescription/DataRow*/CommandComplete ->
-ReadyForQuery, ErrorResponse on failure, SSLRequest politely refused.
-Enough protocol for psql / psycopg simple queries to work against the
-SqlSession.
+ReadyForQuery, plus the EXTENDED protocol (Parse/Bind/Describe/
+Execute/Close/Sync with text-format parameters — prepared statements
+bind $n placeholders as SQL literals; Describe infers the row shape
+from the typing layer without executing). ErrorResponse on failure,
+SSLRequest politely refused. Enough protocol for psql / psycopg
+simple AND extended queries to work against the SqlSession.
 
 This is a host control-plane surface — no device work happens here, so
 a plain threaded TCP server (one thread per connection, like the
@@ -72,6 +75,64 @@ class _Conn(socketserver.BaseRequestHandler):
             # normal StartupMessage (protocol 3.0) — params ignored
             return True
 
+    @staticmethod
+    def _row_description(cols) -> bytes:
+        names = list(cols)
+        fields = b""
+        for name in names:
+            fields += (
+                name.encode() + b"\0"
+                + struct.pack(
+                    "!IhIhih",
+                    0, 0, _oid_of(np.asarray(cols[name]).dtype), -1, -1, 0,
+                )
+            )
+        return _msg(b"T", struct.pack("!h", len(names)) + fields)
+
+    @staticmethod
+    def _data_rows(cols) -> bytes:
+        names = list(cols)
+        out = b""
+        n = len(cols[names[0]]) if names else 0
+        for i in range(n):
+            row = b""
+            for name in names:
+                v = cols[name][i]
+                if v is None or (isinstance(v, float) and np.isnan(v)):
+                    row += struct.pack("!i", -1)
+                else:
+                    s = str(
+                        v.item() if hasattr(v, "item") else v
+                    ).encode()
+                    row += struct.pack("!i", len(s)) + s
+            out += _msg(b"D", struct.pack("!h", len(names)) + row)
+        return out
+
+    @staticmethod
+    def _bind_params(sql: str, params) -> str:
+        """Substitute $n placeholders as SQL literals (text-format
+        extended protocol; the in-process prepared-statement form).
+        SINGLE-PASS regex substitution: replacements are never
+        rescanned, so a parameter whose VALUE contains '$k' text can
+        never have another parameter spliced into it."""
+        import re as _re
+
+        def lit(m):
+            i = int(m.group(1))
+            if not 1 <= i <= len(params):
+                raise KeyError(f"no parameter ${i}")
+            p = params[i - 1]
+            if p is None:
+                return "NULL"
+            s = p.decode()
+            if _re.fullmatch(
+                r"[+-]?(\d+(\.\d*)?|\.\d+)([eE][+-]?\d+)?", s
+            ):
+                return s
+            return "'" + s.replace("'", "''") + "'"
+
+        return _re.sub(r"\$(\d+)", lit, sql)
+
     def handle(self):
         if not self._startup():
             return
@@ -86,6 +147,9 @@ class _Conn(socketserver.BaseRequestHandler):
         out(_msg(b"Z", b"I"))
 
         session: SqlSession = self.server.session  # type: ignore[attr-defined]
+        stmts: dict = {}  # prepared name -> sql
+        portals: dict = {}  # portal name -> (bound sql, T already sent)
+        skip_to_sync = False  # error in a pipeline: discard until Sync
         while True:
             head = self._recv_exact(5)
             if head is None:
@@ -96,57 +160,175 @@ class _Conn(socketserver.BaseRequestHandler):
                 return
             if tag == b"X":  # Terminate
                 return
-            if tag != b"Q":  # only the simple query protocol
-                out(
-                    _err(f"unsupported message {tag!r}")
-                    + _msg(b"Z", b"I")
-                )
+            if skip_to_sync:
+                # protocol: after an extended-protocol error, queued
+                # messages are DISCARDED until the client's Sync
+                if tag == b"S":
+                    skip_to_sync = False
+                    out(_msg(b"Z", b"I"))
                 continue
-            sql = body.rstrip(b"\0").decode()
             try:
-                with self.server.lock:  # type: ignore[attr-defined]
-                    cols, tag_str = session.execute(sql)
-                if cols:
-                    names = list(cols)
-                    fields = b""
-                    for name in names:
-                        fields += (
-                            name.encode() + b"\0"
-                            + struct.pack(
-                                "!IhIhih",
-                                0, 0, _oid_of(cols[name].dtype), -1, -1, 0,
+                if tag == b"Q":
+                    sql = body.rstrip(b"\0").decode()
+                    with self.server.lock:  # type: ignore[attr-defined]
+                        cols, tag_str = session.execute(sql)
+                    if cols:
+                        out(self._row_description(cols))
+                        out(self._data_rows(cols))
+                    out(_msg(b"C", tag_str.encode() + b"\0"))
+                    out(_msg(b"Z", b"I"))
+                elif tag == b"P":  # Parse
+                    name, rest = body.split(b"\0", 1)
+                    sql, _rest = rest.split(b"\0", 1)
+                    stmts[name] = sql.decode()
+                    out(_msg(b"1"))  # ParseComplete
+                elif tag == b"B":  # Bind
+                    portal, rest = body.split(b"\0", 1)
+                    stmt, rest = rest.split(b"\0", 1)
+                    off = 0
+                    (nfmt,) = struct.unpack_from("!h", rest, off)
+                    off += 2
+                    fmts = struct.unpack_from(f"!{nfmt}h", rest, off)
+                    off += 2 * nfmt
+                    if any(f == 1 for f in fmts):
+                        raise ValueError(
+                            "binary parameter format unsupported "
+                            "(bind text-format parameters)"
+                        )
+                    (nparams,) = struct.unpack_from("!h", rest, off)
+                    off += 2
+                    params = []
+                    for _ in range(nparams):
+                        (plen,) = struct.unpack_from("!i", rest, off)
+                        off += 4
+                        if plen < 0:
+                            params.append(None)
+                        else:
+                            params.append(rest[off : off + plen])
+                            off += plen
+                    if stmt not in stmts:
+                        raise KeyError(
+                            f"unknown prepared statement {stmt!r}"
+                        )
+                    portals[portal] = [
+                        self._bind_params(stmts[stmt], params),
+                        False,
+                    ]
+                    out(_msg(b"2"))  # BindComplete
+                elif tag == b"D":  # Describe
+                    kind, name = body[:1], body[1:].split(b"\0", 1)[0]
+                    sql = (
+                        portals.get(name, [None])[0]
+                        if kind == b"P"
+                        else stmts.get(name)
+                    )
+                    if kind == b"S":
+                        # ParameterDescription is MANDATORY before the
+                        # row shape when describing a statement
+                        import re as _re
+
+                        nps = (
+                            max(
+                                (
+                                    int(m)
+                                    for m in _re.findall(
+                                        r"\$(\d+)", sql or ""
+                                    )
+                                ),
+                                default=0,
                             )
                         )
-                    out(
-                        _msg(
-                            b"T",
-                            struct.pack("!h", len(names)) + fields,
-                        )
-                    )
-                    n = len(cols[names[0]])
-                    for i in range(n):
-                        row = b""
-                        for name in names:
-                            v = cols[name][i]
-                            if v is None or (
-                                isinstance(v, float) and np.isnan(v)
-                            ):
-                                row += struct.pack("!i", -1)
-                            else:
-                                s = str(
-                                    v.item() if hasattr(v, "item") else v
-                                ).encode()
-                                row += struct.pack("!i", len(s)) + s
                         out(
                             _msg(
-                                b"D",
-                                struct.pack("!h", len(names)) + row,
+                                b"t",
+                                struct.pack("!h", nps)
+                                + struct.pack("!I", 0) * nps,  # unknown
                             )
                         )
-                out(_msg(b"C", tag_str.encode() + b"\0"))
+                    desc = None
+                    if sql is not None and sql.lstrip()[:6].lower() == "select":
+                        # infer the row shape WITHOUT executing
+                        desc = self._describe_select(session, sql)
+                    if desc is None:
+                        out(_msg(b"n"))  # NoData
+                    else:
+                        out(desc)
+                        if kind == b"P" and name in portals:
+                            portals[name][1] = True
+                elif tag == b"E":  # Execute
+                    name = body.split(b"\0", 1)[0]
+                    if name not in portals:
+                        raise KeyError(f"unknown portal {name!r}")
+                    sql, t_sent = portals[name]
+                    with self.server.lock:  # type: ignore[attr-defined]
+                        cols, tag_str = session.execute(sql)
+                    if cols:
+                        if not t_sent:
+                            out(self._row_description(cols))
+                        out(self._data_rows(cols))
+                    out(_msg(b"C", tag_str.encode() + b"\0"))
+                elif tag == b"C":  # Close
+                    kind, name = body[:1], body[1:].split(b"\0", 1)[0]
+                    (portals if kind == b"P" else stmts).pop(name, None)
+                    out(_msg(b"3"))  # CloseComplete
+                elif tag == b"S":  # Sync
+                    out(_msg(b"Z", b"I"))
+                elif tag == b"H":  # Flush
+                    pass
+                else:
+                    out(_err(f"unsupported message {tag!r}"))
+                    out(_msg(b"Z", b"I"))
             except Exception as e:  # noqa: BLE001 — surface as pg error
                 out(_err(str(e)))
-            out(_msg(b"Z", b"I"))
+                if tag == b"Q":
+                    out(_msg(b"Z", b"I"))
+                else:
+                    # extended protocol: discard the rest of the
+                    # pipeline; the client's Sync elicits ReadyForQuery
+                    skip_to_sync = True
+
+    @staticmethod
+    def _describe_select(session: SqlSession, sql: str):
+        """RowDescription for a SELECT from the typing layer (names +
+        logical types; no execution, no side effects)."""
+        try:
+            import re as _re
+
+            from risingwave_tpu.sql import parser as P
+            from risingwave_tpu.sql.typing import (
+                expand_star,
+                infer_output_fields,
+                output_name,
+            )
+            from risingwave_tpu.types import DataType
+
+            # unbound parameters parse as NULL for shape inference
+            stmt = P.parse(_re.sub(r"\$\d+", "NULL", sql))
+            if not isinstance(stmt, P.Select):
+                return None
+            stmt = expand_star(stmt, session.catalog, strict=False)
+            inferred = infer_output_fields(stmt, session.catalog)
+            fields = b""
+            names = [
+                output_name(it, i) for i, it in enumerate(stmt.items)
+            ]
+            oid_map = {
+                DataType.BOOLEAN: _OID_BOOL,
+                DataType.FLOAT32: _OID_FLOAT8,
+                DataType.FLOAT64: _OID_FLOAT8,
+                DataType.VARCHAR: _OID_TEXT,
+                DataType.JSONB: _OID_TEXT,
+                DataType.DECIMAL: _OID_TEXT,
+            }
+            for nm in names:
+                f = inferred.get(nm)
+                oid = oid_map.get(f.dtype, _OID_INT8) if f else _OID_INT8
+                fields += nm.encode() + b"\0" + struct.pack(
+                    "!IhIhih", 0, 0, oid, -1, -1, 0
+                )
+            return _msg(b"T", struct.pack("!h", len(names)) + fields)
+        except Exception:  # noqa: BLE001 — Describe is best-effort
+            return None
 
 
 def _err(message: str) -> bytes:

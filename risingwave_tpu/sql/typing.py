@@ -45,6 +45,22 @@ def _env_of_rel(rel, catalog) -> Dict[str, Field]:
     return {}
 
 
+def output_name(item: P.SelectItem, i: int) -> str:
+    """The output column name of one select item — shared by the
+    inference pass, the batch engine, and pgwire Describe so names
+    never drift between layers."""
+    if item.alias:
+        return item.alias
+    expr = item.expr
+    if isinstance(expr, P.Ident):
+        return expr.name
+    if isinstance(expr, P.WindowFuncCall):
+        return f"{expr.func.name}_{i}"
+    if isinstance(expr, P.FuncCall):
+        return f"{expr.name}_{i}"
+    return f"col{i}"
+
+
 def infer_output_fields(stmt, catalog) -> Dict[str, Field]:
     """Best-effort output column name -> logical Field for a Select."""
     if not isinstance(stmt, P.Select):

@@ -154,3 +154,135 @@ def test_pgwire_concurrent_clients(server):
     assert err is None and rows == [("7", "1")]
     a.close()
     b.close()
+
+
+class ExtendedClient(PgClient):
+    """Extended-protocol helper: Parse/Bind/Describe/Execute/Sync."""
+
+    def _send(self, tag, body=b""):
+        self.sock.sendall(tag + struct.pack("!I", len(body) + 4) + body)
+
+    def prepare(self, name, sql):
+        self._send(
+            b"P", name.encode() + b"\0" + sql.encode() + b"\0"
+            + struct.pack("!h", 0)
+        )
+
+    def bind(self, portal, stmt, params):
+        body = portal.encode() + b"\0" + stmt.encode() + b"\0"
+        body += struct.pack("!h", 0)  # all-text param formats
+        body += struct.pack("!h", len(params))
+        for p in params:
+            if p is None:
+                body += struct.pack("!i", -1)
+            else:
+                b = str(p).encode()
+                body += struct.pack("!i", len(b)) + b
+        body += struct.pack("!h", 0)  # result formats
+        self._send(b"B", body)
+
+    def run(self, portal=""):
+        self._send(b"D", b"P" + portal.encode() + b"\0")
+        self._send(b"E", portal.encode() + b"\0" + struct.pack("!i", 0))
+        self._send(b"S")
+        rows, names, tagline = [], [], None
+        for tag, body in self._drain_until_ready():
+            if tag == b"T":
+                (ncols,) = struct.unpack("!h", body[:2])
+                at = 2
+                for _ in range(ncols):
+                    end = body.index(b"\0", at)
+                    names.append(body[at:end].decode())
+                    at = end + 1 + 18
+            elif tag == b"D":
+                (ncols,) = struct.unpack("!h", body[:2])
+                at = 2
+                row = []
+                for _ in range(ncols):
+                    (ln,) = struct.unpack("!i", body[at : at + 4])
+                    at += 4
+                    if ln < 0:
+                        row.append(None)
+                    else:
+                        row.append(body[at : at + ln].decode())
+                        at += ln
+                rows.append(tuple(row))
+            elif tag == b"C":
+                tagline = body.rstrip(b"\0").decode()
+        return names, rows, tagline
+
+
+def test_pgwire_extended_protocol(server):
+    c = ExtendedClient(server.port)
+    c.query("CREATE TABLE e (k BIGINT, v BIGINT)")
+    c.query("INSERT INTO e VALUES (1, 10), (2, 20), (3, 30)")
+    # prepared statement with parameters, executed twice
+    c.prepare("s1", "SELECT k, v FROM e WHERE v > $1 ORDER BY k")
+    c.bind("p1", "s1", [15])
+    names, rows, tagline = c.run("p1")
+    assert names == ["k", "v"]
+    assert rows == [("2", "20"), ("3", "30")]
+    assert tagline.startswith("SELECT")
+    c.bind("p2", "s1", [25])
+    _, rows2, _ = c.run("p2")
+    assert rows2 == [("3", "30")]
+    # parameterized INSERT through the extended path
+    c.prepare("ins", "INSERT INTO e VALUES ($1, $2)")
+    c.bind("p3", "ins", [9, 90])
+    _, _, tag3 = c.run("p3")
+    assert tag3.startswith("INSERT")
+    _, rows3, _, _ = c.query("SELECT v FROM e WHERE k = 9")
+    assert rows3 == [("90",)]
+    # NULL parameter
+    c.prepare("s2", "SELECT count(*) AS n FROM e WHERE v > $1")
+    c.bind("p4", "s2", [None])
+    _, rows4, _ = c.run("p4")
+    assert rows4 == [("0",)]  # NULL comparison filters everything
+    c.close()
+
+
+def test_pgwire_extended_string_param(server):
+    c = ExtendedClient(server.port)
+    c.query("CREATE TABLE s (name VARCHAR, v BIGINT)")
+    c.query("INSERT INTO s VALUES ('ann', 1), ('bob', 2)")
+    c.prepare("q", "SELECT v FROM s WHERE name = $1")
+    c.bind("", "q", ["ann"])
+    _, rows, _ = c.run("")
+    assert rows == [("1",)]
+    # quoting: a value with an embedded quote must not break out
+    c.bind("", "q", ["o'brien"])
+    _, rows2, _ = c.run("")
+    assert rows2 == []
+    c.close()
+
+
+def test_pgwire_extended_error_skips_to_sync(server):
+    """An error mid-pipeline discards queued messages until Sync
+    (review finding r5: the server used to keep processing)."""
+    c = ExtendedClient(server.port)
+    # Bind against an unknown statement, then pipeline D+E+S: exactly
+    # ONE ErrorResponse must arrive before ReadyForQuery
+    c.bind("px", "nope", [1])
+    c._send(b"D", b"Ppx\0")
+    c._send(b"E", b"px\0" + struct.pack("!i", 0))
+    c._send(b"S")
+    errs = sum(
+        1 for tag, _ in c._drain_until_ready() if tag == b"E"
+    )
+    assert errs == 1
+    # the connection is healthy again
+    _, rows, _, err = c.query("SELECT 1 AS one FROM (SELECT 1 AS o) AS d")
+    c.close()
+
+
+def test_pgwire_param_value_with_dollar(server):
+    """A parameter VALUE containing '$1' must never have another
+    parameter substituted inside it (review finding r5)."""
+    c = ExtendedClient(server.port)
+    c.query("CREATE TABLE dz (a VARCHAR, b VARCHAR)")
+    c.query("INSERT INTO dz VALUES ('x', 'keep$1keep')")
+    c.prepare("q", "SELECT b FROM dz WHERE a = $1 AND b = $2")
+    c.bind("", "q", ["x", "keep$1keep"])
+    _, rows, _ = c.run("")
+    assert rows == [("keep$1keep",)]
+    c.close()
