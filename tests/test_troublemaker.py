@@ -1,0 +1,88 @@
+"""Troublemaker chaos: injected stream corruption must be CAUGHT by
+the consistency machinery, never silently absorbed.
+
+Reference: executor/troublemaker.rs:28 + the insane-mode contract —
+the corrupted stream exercises update checks / differential stores.
+"""
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.executors.troublemaker import TroublemakerExecutor
+from risingwave_tpu.types import Op
+
+pytestmark = pytest.mark.smoke
+
+
+def _chunk(vals, cap=8):
+    return StreamChunk.from_numpy(
+        {"k": np.asarray(vals, np.int64), "v": np.asarray(vals, np.int64)},
+        cap,
+    )
+
+
+def test_faults_are_logged_and_visible():
+    tm = TroublemakerExecutor(seed=3, rate=1.0)
+    out = []
+    n_chunks = 30
+    for i in range(n_chunks):
+        out.extend(tm.apply(_chunk([i * 3, i * 3 + 1, i * 3 + 2])))
+    assert len(tm.log) == n_chunks  # rate=1: every chunk corrupted
+    # EVERY fault class fired (a vacuous subset check would let a
+    # broken mode go untested — review finding r5)
+    modes = {m for m, _, _ in tm.log}
+    assert modes == {"corrupt_value", "flip_op", "dup_row"}
+    # and every corruption is REAL: each output differs from its input
+    clean = [c.to_numpy(with_ops=True) for c in out]
+    diffs = 0
+    for i, got in enumerate(clean):
+        want = [i * 3, i * 3 + 1, i * 3 + 2]
+        ids = [int(x) for x in got["k"]]
+        ops = [int(x) for x in got["__op__"]]
+        if ids != want or any(o != int(Op.INSERT) for o in ops) or (
+            sorted(int(x) for x in got["v"]) != want
+        ):
+            diffs += 1
+    assert diffs == n_chunks
+
+
+def test_rate_zero_is_identity():
+    tm = TroublemakerExecutor(seed=1, rate=0.0)
+    c = _chunk([1, 2, 3])
+    (out,) = tm.apply(c)
+    assert out is c and tm.log == []
+
+
+def test_corruption_visible_in_downstream_mv():
+    """A troublemaker-corrupted stream produces a DIFFERENT MV than
+    the clean stream — the divergence the insane-mode machinery (and
+    the chaos suite's differential oracles) must be able to catch."""
+    import jax.numpy as jnp
+
+    from risingwave_tpu.executors.hash_agg import HashAggExecutor
+    from risingwave_tpu.executors.materialize import MaterializeExecutor
+    from risingwave_tpu.ops.agg import AggCall
+    from risingwave_tpu.runtime.pipeline import Pipeline
+
+    def run(with_chaos: bool):
+        agg = HashAggExecutor(
+            ("k",), (AggCall("count_star", None, "n"),),
+            {"k": jnp.int64, "v": jnp.int64}, capacity=1 << 8,
+            table_id=f"tm{int(with_chaos)}.agg",
+        )
+        mv = MaterializeExecutor(
+            pk=("k",), columns=("n",), table_id=f"tm{int(with_chaos)}.mv"
+        )
+        chain = [agg, mv]
+        if with_chaos:
+            chain.insert(0, TroublemakerExecutor(seed=9, rate=1.0))
+        pipe = Pipeline(chain)
+        for i in range(4):
+            pipe.push(_chunk([i, i + 1]))
+        pipe.barrier()
+        return mv.snapshot()
+
+    clean = run(False)
+    dirty = run(True)
+    assert clean != dirty, "chaos was silently absorbed"
