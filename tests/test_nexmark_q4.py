@@ -1,0 +1,95 @@
+"""Nexmark q4 from SQL: average closing price per category.
+
+Reference: e2e_test/nexmark/ q4 — AVG over each auction's max bid,
+grouped by category. The shape composes pieces this round completed:
+a grouped MAX over a join (auction x bid) lowered to an MV, and an
+avg() MV over it (MV-on-MV + extended aggregates).
+"""
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.frontend.session import SqlSession
+from risingwave_tpu.sql import Catalog
+
+pytestmark = pytest.mark.smoke
+
+
+def test_q4_avg_of_per_auction_max():
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute("CREATE TABLE auction (aid BIGINT, category BIGINT)")
+    s.execute("CREATE TABLE bid (auction BIGINT, price BIGINT)")
+    # per-auction winning bid, carrying the category through the join
+    s.execute(
+        "CREATE MATERIALIZED VIEW winning AS "
+        "SELECT aid, category, max(price) AS final_p "
+        "FROM (SELECT aid, category FROM auction) AS a "
+        "JOIN (SELECT auction, price FROM bid) AS b "
+        "ON a.aid = b.auction "
+        "GROUP BY aid, category"
+    )
+    # q4: category-level average of the winning bids (MV-on-MV)
+    s.execute(
+        "CREATE MATERIALIZED VIEW q4 AS "
+        "SELECT category, avg(final_p) AS avg_final "
+        "FROM winning GROUP BY category"
+    )
+    s.execute(
+        "INSERT INTO auction VALUES (1, 10), (2, 10), (3, 20)"
+    )
+    s.execute(
+        "INSERT INTO bid VALUES (1, 100), (1, 300), (2, 50), "
+        "(3, 700), (3, 900)"
+    )
+    out, _ = s.execute("SELECT category, avg_final FROM q4 ORDER BY category")
+    # cat 10: max(1)=300, max(2)=50 -> avg 175; cat 20: max(3)=900
+    assert list(out["category"]) == [10, 20]
+    assert list(out["avg_final"]) == pytest.approx([175.0, 900.0])
+    # a higher bid arrives for auction 2: the winning bid RISES and
+    # the category average follows incrementally
+    s.execute("INSERT INTO bid VALUES (2, 250)")
+    out, _ = s.execute(
+        "SELECT category, avg_final FROM q4 ORDER BY category"
+    )
+    assert list(out["avg_final"]) == pytest.approx([275.0, 900.0])
+
+
+def test_q4_differential_vs_batch():
+    """The same q4 aggregate computed by the batch engine over the
+    winning MV agrees with the streaming q4 MV."""
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute("CREATE TABLE auction (aid BIGINT, category BIGINT)")
+    s.execute("CREATE TABLE bid (auction BIGINT, price BIGINT)")
+    s.execute(
+        "CREATE MATERIALIZED VIEW winning AS "
+        "SELECT aid, category, max(price) AS final_p "
+        "FROM (SELECT aid, category FROM auction) AS a "
+        "JOIN (SELECT auction, price FROM bid) AS b "
+        "ON a.aid = b.auction "
+        "GROUP BY aid, category"
+    )
+    s.execute(
+        "CREATE MATERIALIZED VIEW q4 AS "
+        "SELECT category, avg(final_p) AS avg_final "
+        "FROM winning GROUP BY category"
+    )
+    rng = np.random.default_rng(5)
+    aucs = ", ".join(
+        f"({i}, {int(rng.integers(0, 4))})" for i in range(1, 21)
+    )
+    s.execute(f"INSERT INTO auction VALUES {aucs}")
+    bids = ", ".join(
+        f"({int(rng.integers(1, 21))}, {int(rng.integers(1, 1000))})"
+        for _ in range(120)
+    )
+    s.execute(f"INSERT INTO bid VALUES {bids}")
+    stream, _ = s.execute("SELECT category, avg_final FROM q4")
+    batch, _ = s.execute(
+        "SELECT category, avg(final_p) AS avg_final FROM winning "
+        "GROUP BY category"
+    )
+    sm = dict(zip(stream["category"], stream["avg_final"]))
+    bm = dict(zip(batch["category"], batch["avg_final"]))
+    assert set(sm) == set(bm)
+    for c in sm:
+        assert sm[c] == pytest.approx(bm[c])
