@@ -145,14 +145,47 @@ class BatchQueryEngine:
         elif isinstance(stmt.from_, P.TableRef):
             mv = self.tables[stmt.from_.name]
             cols, alias = mv.to_numpy(), stmt.from_.alias
+        elif isinstance(stmt.from_, P.SubQuery):
+            # derived table: run the inner select (its own WHERE/GROUP
+            # BY/ORDER BY/LIMIT apply) and scan its result — NULL
+            # companions fold into object lanes, the engine's nullable
+            # column convention
+            inner = self.query("", stmt=stmt.from_.select)
+            cols = self._fold_null_lanes(inner)
+            alias = stmt.from_.alias
         else:
-            raise ValueError("batch FROM must be an MV name or join")
+            raise ValueError(
+                "batch FROM must be an MV name, join, or subquery"
+            )
         out = self._run_select_over(stmt, cols, alias)
         out = self._distinct(stmt, out)
 
         # OrderBy + Limit (src/batch/src/executor/{order_by,limit}.rs)
         out = self._order_limit(stmt, out)
         return out
+
+    @staticmethod
+    def _fold_null_lanes(out):
+        """{v, v__null} pairs -> object lanes with None cells (the
+        engine's nullable-column convention for scan inputs)."""
+        cols = {}
+        for k, v in out.items():
+            if k.endswith("__null"):
+                continue
+            nl = out.get(k + "__null")
+            arr = np.asarray(v)
+            if nl is not None and np.asarray(nl).any():
+                vals = arr.tolist()
+                cols[k] = np.asarray(
+                    [
+                        None if m else x
+                        for x, m in zip(vals, np.asarray(nl, bool))
+                    ],
+                    object,
+                )
+            else:
+                cols[k] = arr
+        return cols
 
     @staticmethod
     def _chunk_from_cols(cols, cap, nulls=None):
@@ -290,9 +323,18 @@ class BatchQueryEngine:
         if stmt.order_by:
             lanes = []
             for ident, desc in reversed(stmt.order_by):
-                lane = out[ident.name]
-                lanes.append(-lane if desc else lane)
+                lane = np.asarray(out[ident.name])
                 nl = out.get(ident.name + "__null")
+                if lane.dtype == object:
+                    # None-embedded object lane (a folded subquery
+                    # output): split into fill values + a null mask
+                    vals = lane.tolist()
+                    onl = np.asarray([x is None for x in vals], bool)
+                    lane = np.asarray(
+                        [0 if m else x for x, m in zip(vals, onl)]
+                    )
+                    nl = onl if nl is None else (np.asarray(nl, bool) | onl)
+                lanes.append(-lane if desc else lane)
                 if nl is not None:
                     # Postgres: NULL sorts as larger than every value —
                     # last under ASC, first under DESC; the null lane
@@ -827,7 +869,9 @@ class BatchQueryEngine:
                     df[f"__num_{col}"] = pd.to_numeric(
                         df[col], errors="coerce"
                     )
-        gb = df.groupby(keys, sort=False)
+        # dropna=False: SQL groups NULL keys (the _over_window path
+        # passes the same flag for the same reason)
+        gb = df.groupby(keys, sort=False, dropna=False)
         out: Dict[str, np.ndarray] = {}
         frames = {}
         src_cols: Dict[str, str] = {}
@@ -926,7 +970,21 @@ class BatchQueryEngine:
         for item in stmt.items:
             if isinstance(item.expr, P.Ident):
                 nm = binder.resolve(item.expr)
-                out[item.alias or nm] = res[nm].to_numpy()
+                import pandas as pd
+
+                lane = res[nm]
+                knl = pd.isna(lane).to_numpy()
+                if knl.any():
+                    # the NULL group's key surfaces as SQL NULL
+                    out[item.alias or nm] = np.asarray(
+                        [
+                            0 if m else x
+                            for x, m in zip(lane.tolist(), knl.tolist())
+                        ]
+                    )
+                    out[(item.alias or nm) + "__null"] = knl
+                else:
+                    out[item.alias or nm] = lane.to_numpy()
         for name in frames:
             lane = res[name]
             nl = lane.isna().to_numpy()

@@ -1,0 +1,99 @@
+"""Batch FROM-subqueries (derived tables in batch SELECT).
+
+Reference: the batch planner's derived-table scans — inner select
+runs fully (WHERE/GROUP BY/ORDER BY/LIMIT), the outer scans its
+result; NULL aggregate outputs stay SQL NULL through the nesting.
+"""
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.frontend.session import SqlSession
+from risingwave_tpu.sql import Catalog
+
+pytestmark = pytest.mark.smoke
+
+
+def _sess():
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute("CREATE TABLE t (k BIGINT, v BIGINT)")
+    s.execute("INSERT INTO t VALUES (1, 10), (1, 20), (2, 5), (3, 7)")
+    return s
+
+
+def test_agg_over_derived_filter():
+    s = _sess()
+    out, _ = s.execute(
+        "SELECT k, sum(v) AS sv FROM "
+        "(SELECT k, v FROM t WHERE v > 6) AS d GROUP BY k ORDER BY k"
+    )
+    assert list(out["k"]) == [1, 3]
+    assert list(out["sv"]) == [30, 7]
+
+
+def test_derived_agg_then_outer_filter():
+    s = _sess()
+    out, _ = s.execute(
+        "SELECT k2, sv FROM (SELECT k AS k2, sum(v) AS sv FROM t "
+        "GROUP BY k) AS g WHERE sv > 6 ORDER BY k2"
+    )
+    assert list(out["k2"]) == [1, 3]
+    assert list(out["sv"]) == [30, 7]
+
+
+def test_nested_star_over_subquery_batch():
+    s = _sess()
+    out, _ = s.execute(
+        "SELECT * FROM (SELECT * FROM t) AS s2 ORDER BY v"
+    )
+    assert list(out["v"]) == [5, 7, 10, 20]
+
+
+def test_null_agg_output_through_nesting():
+    s = _sess()
+    out, _ = s.execute(
+        "SELECT mn FROM (SELECT min(v) AS mn FROM t WHERE v > 99) AS e"
+    )
+    v = out["mn"][0]
+    assert v is None or (not isinstance(v, str) and np.isnan(float(v)))
+
+
+def test_inner_limit_applies_before_outer_agg():
+    s = _sess()
+    out, _ = s.execute(
+        "SELECT count(*) AS n FROM "
+        "(SELECT v FROM t ORDER BY v LIMIT 2) AS small"
+    )
+    assert out["n"][0] == 2
+
+
+def test_order_by_nullable_subquery_lane():
+    """Outer ORDER BY on a NULL-carrying subquery lane sorts NULLS
+    LAST (review finding r5: it used to TypeError on None < int)."""
+    s = _sess()
+    out, _ = s.execute(
+        "SELECT k, pv FROM (SELECT k, lag(v, 1) "
+        "OVER (PARTITION BY k ORDER BY v) AS pv FROM t) AS d "
+        "ORDER BY pv"
+    )
+    vals = list(out["pv"])
+    nls = list(out.get("pv__null", [False] * len(vals)))
+    non_null = [v for v, m in zip(vals, nls) if not m and v is not None]
+    assert non_null == sorted(non_null)
+    # NULLs sorted last
+    tail_nulls = [m or v is None for v, m in zip(vals, nls)]
+    assert tail_nulls == sorted(tail_nulls)
+
+
+def test_group_by_null_key_from_subquery():
+    """GROUP BY over a nullable subquery column keeps the NULL group
+    (review finding r5: pandas' dropna default silently dropped it)."""
+    s = _sess()
+    out, _ = s.execute(
+        "SELECT mn, count(*) AS c FROM "
+        "(SELECT min(v) AS mn FROM t WHERE v > 99) AS e GROUP BY mn"
+    )
+    assert len(out["c"]) == 1 and out["c"][0] == 1
+    assert out["mn"][0] is None or bool(
+        np.asarray(out.get("mn__null", [False]))[0]
+    ) or np.isnan(float(out["mn"][0]))
