@@ -123,10 +123,15 @@ def _log10(v):
     return jnp.log10(jnp.where(bad, 1.0, f)), bad
 
 
-@register("trunc", 1)
-def _trunc(v):
+@register("trunc", 1, 2)
+def _trunc(v, digits=None):
     # preserve the input dtype (PG trunc(double) -> double); ints pass
-    return (jnp.trunc(v) if jnp.issubdtype(v.dtype, jnp.floating) else v), None
+    if not jnp.issubdtype(v.dtype, jnp.floating):
+        return v, None
+    if digits is None:
+        return jnp.trunc(v), None
+    scale = 10.0 ** digits
+    return jnp.trunc(v * scale) / scale, None
 
 
 @register("cbrt", 1)
@@ -206,6 +211,47 @@ def _cosh(v):
 @register("tanh", 1)
 def _tanh(v):
     return jnp.tanh(v.astype(jnp.float64)), None
+
+
+@register("asinh", 1)
+def _asinh(v):
+    return jnp.arcsinh(v.astype(jnp.float64)), None
+
+
+@register("acosh", 1)
+def _acosh(v):
+    f = v.astype(jnp.float64)
+    return jnp.arccosh(jnp.where(f < 1, 1.0, f)), f < 1
+
+
+@register("atanh", 1)
+def _atanh(v):
+    f = v.astype(jnp.float64)
+    bad = jnp.abs(f) >= 1
+    return jnp.arctanh(jnp.where(bad, 0.0, f)), bad
+
+
+@register("factorial", 1)
+def _factorial(v):
+    # n! for n in [0, 20] fits int64; larger / negative -> NULL
+    n = v.astype(jnp.int64)
+    bad = (n < 0) | (n > 20)
+    safe = jnp.clip(n, 0, 20)
+    # cumulative product over a static table (device-friendly)
+    table = jnp.cumprod(
+        jnp.concatenate(
+            [jnp.ones(1, jnp.int64), jnp.arange(1, 21, dtype=jnp.int64)]
+        )
+    )
+    return table[safe], bad
+
+
+@register("hypot", 2)
+def _hypot(a, b):
+    return (
+        jnp.hypot(a.astype(jnp.float64), b.astype(jnp.float64)),
+        None,
+    )
 
 
 @register("degrees", 1)

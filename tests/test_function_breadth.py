@@ -190,3 +190,27 @@ def test_protobuf_parser(tmp_path):
     # proto3: zero-valued scalars are VALUES, not NULL
     assert p.parse(ev_pb2.Ev(id=0, v=0).SerializeToString()) == (0, 0)
     assert p.parse(b"\xff\xff garbage") is None
+
+
+def test_round5_math_additions():
+    import math
+
+    from risingwave_tpu.frontend.session import SqlSession
+    from risingwave_tpu.sql import Catalog
+
+    s = SqlSession(Catalog({}), capacity=1 << 8)
+    s.execute("CREATE TABLE t (v BIGINT)")
+    s.execute("INSERT INTO t VALUES (5)")
+    out, _ = s.execute(
+        "SELECT factorial(v) AS f, asinh(v) AS a, hypot(v, v) AS h "
+        "FROM t"
+    )
+    assert out["f"][0] == 120
+    assert out["a"][0] == pytest.approx(math.asinh(5))
+    assert out["h"][0] == pytest.approx(math.hypot(5, 5))
+    # domain errors -> NULL, never a trap
+    s.execute("INSERT INTO t VALUES (-3)")
+    out, _ = s.execute("SELECT v, factorial(v) AS f FROM t ORDER BY v")
+    assert out["f"][0] is None or bool(
+        __import__("numpy").asarray(out.get("f__null", [0, 0]))[0]
+    )
