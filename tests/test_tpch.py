@@ -175,3 +175,65 @@ def test_tpch_q17_graph_mode_matches_serial():
     )
     out, _ = s.execute("SELECT avg_yearly FROM q17")
     assert list(out["avg_yearly"]) == [111 // 7]
+
+
+def test_tpch_q1_pricing_summary():
+    """TPC-H q1 (pricing summary report): grouped sums, averages, and
+    counts with extended aggregates — the canonical wide-agg shape
+    (reference e2e_test/tpch q1; avg decomposes onto sum/count)."""
+    from risingwave_tpu.frontend.session import SqlSession
+
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute(
+        "CREATE TABLE lineitem (l_returnflag BIGINT, l_linestatus BIGINT, "
+        "l_quantity BIGINT, l_extendedprice BIGINT, l_discount BIGINT)"
+    )
+    s.execute(
+        "CREATE MATERIALIZED VIEW q1 AS SELECT "
+        "l_returnflag, l_linestatus, "
+        "sum(l_quantity) AS sum_qty, "
+        "sum(l_extendedprice) AS sum_base_price, "
+        "avg(l_quantity) AS avg_qty, "
+        "avg(l_extendedprice) AS avg_price, "
+        "avg(l_discount) AS avg_disc, "
+        "count(*) AS count_order "
+        "FROM lineitem GROUP BY l_returnflag, l_linestatus"
+    )
+    import numpy as np
+
+    rng = np.random.default_rng(17)
+    rows = []
+    for _ in range(200):
+        rows.append((
+            int(rng.integers(0, 2)), int(rng.integers(0, 2)),
+            int(rng.integers(1, 50)), int(rng.integers(100, 10000)),
+            int(rng.integers(0, 10)),
+        ))
+    vals = ", ".join(str(r) for r in rows)
+    s.execute(f"INSERT INTO lineitem VALUES {vals}")
+    out, _ = s.execute(
+        "SELECT l_returnflag, l_linestatus, sum_qty, avg_qty, "
+        "avg_price, avg_disc, count_order FROM q1 "
+        "ORDER BY l_returnflag, l_linestatus"
+    )
+    # numpy oracle
+    import collections
+
+    groups = collections.defaultdict(list)
+    for r in rows:
+        groups[(r[0], r[1])].append(r)
+    for i in range(len(out["count_order"])):
+        key = (int(out["l_returnflag"][i]), int(out["l_linestatus"][i]))
+        g = groups[key]
+        assert out["count_order"][i] == len(g)
+        assert out["sum_qty"][i] == sum(r[2] for r in g)
+        assert out["avg_qty"][i] == pytest.approx(
+            sum(r[2] for r in g) / len(g)
+        )
+        assert out["avg_price"][i] == pytest.approx(
+            sum(r[3] for r in g) / len(g)
+        )
+        assert out["avg_disc"][i] == pytest.approx(
+            sum(r[4] for r in g) / len(g)
+        )
+    assert len(out["count_order"]) == len(groups)
