@@ -24,6 +24,7 @@ import jax.numpy as jnp
 
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Barrier, Executor, Watermark
+from risingwave_tpu.types import Op
 
 
 @partial(jax.jit, static_argnames=("col",), donate_argnums=(1,))
@@ -36,10 +37,27 @@ def _wm_step(chunk: StreamChunk, running_max, col: str, wm_floor):
         active = active & ~null
     cmax = jnp.max(jnp.where(active, ts, jnp.iinfo(jnp.int64).min))
     running_max = jnp.maximum(running_max, cmax)
-    # rows strictly below the CURRENT watermark are late -> dropped
-    # (watermark_filter.rs filters with `ts >= watermark`)
-    keep = chunk.valid & (ts >= wm_floor)
-    return chunk.mask(keep & chunk.valid), running_max
+    # INSERT rows strictly below the CURRENT watermark are late ->
+    # dropped (watermark_filter.rs filters with `ts >= watermark`).
+    # RETRACTIONS pass regardless: a DELETE/UPDATE_DELETE for a row
+    # below the watermark must still reach downstream state — dropping
+    # it would desync MVs from a DML-mutated table (its target may
+    # already be cleaned, in which case it no-ops downstream).
+    retract = (chunk.ops == Op.DELETE) | (chunk.ops == Op.UPDATE_DELETE)
+    keep = chunk.valid & ((ts >= wm_floor) | retract)
+    out = chunk.mask(keep)
+    # a surviving U- whose U+ partner was dropped (update moving a row
+    # BELOW the watermark) downgrades to a plain DELETE
+    is_ud = out.ops == Op.UPDATE_DELETE
+    partner_alive = jnp.roll(out.valid, -1) & jnp.roll(
+        out.ops == Op.UPDATE_INSERT, -1
+    )
+    fix = is_ud & out.valid & ~partner_alive
+    new_ops = jnp.where(fix, jnp.int32(Op.DELETE), out.ops)
+    return (
+        StreamChunk(out.columns, out.valid, out.nulls, new_ops),
+        running_max,
+    )
 
 
 class WatermarkFilterExecutor(Executor):

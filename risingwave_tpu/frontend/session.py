@@ -180,6 +180,7 @@ class SqlSession:
         if n.op == "drop":
             self.catalog.mvs.pop(n.name, None)
             self.catalog.tables.pop(n.name, None)
+            self.catalog.watermarks.pop(n.name, None)
             self.batch.tables.pop(n.name, None)
             self.sources.pop(n.name, None)
             self.source_mgr.unregister(n.name)
@@ -343,6 +344,10 @@ class SqlSession:
             ]
             schema = Schema(fields)
             self.catalog.tables[stmt.name] = schema
+            if stmt.watermark is not None:
+                # WATERMARK FOR: MVs over this table get a self-driving
+                # watermark filter at the scan (planner inserts it)
+                self.catalog.watermarks[stmt.name] = stmt.watermark
             # a table IS a materialized relation (create_table.rs makes
             # the same plan: dml -> row-id gen -> materialize): give it
             # a fragment so INSERTs land somewhere queryable and
@@ -900,10 +905,34 @@ class SqlSession:
         for kv in re.findall(r"(\w+)\s*=\s*'([^']*)'", props_raw):
             props[kv[0].lower()] = kv[1]
         fields = []
+        watermark = None
         # split on commas OUTSIDE parens: DECIMAL(10,2) is one type
         for c in re.split(r",(?![^(]*\))", cols):
             c = c.strip()
             if not c:
+                continue
+            wm = re.match(
+                r"(?is)^watermark\s+for\s+(\w+)\s+as\s+(\w+)\s*-\s*"
+                r"interval\s+'(\d+)(?:\s+(\w+))?'\s*(\w+)?\s*$",
+                c,
+            )
+            if wm:
+                from risingwave_tpu.sql.parser import INTERVAL_SCALES
+
+                # SQL identifiers fold case-insensitively (the Parser
+                # path lowercases in the lexer)
+                if wm.group(1).lower() != wm.group(2).lower():
+                    raise SyntaxError(
+                        "WATERMARK expression must be <col> - INTERVAL"
+                    )
+                unit = (wm.group(5) or wm.group(4) or "second").lower()
+                scale = INTERVAL_SCALES.get(unit)
+                if scale is None:
+                    raise SyntaxError(f"bad interval unit {unit!r}")
+                watermark = (
+                    wm.group(1).lower(),
+                    int(wm.group(3)) * scale,
+                )
                 continue
             parts = c.split(None, 1)
             if len(parts) != 2:
@@ -912,6 +941,12 @@ class SqlSession:
                 _parse_type_word(parts[0], parts[1].replace(" ", ""))
             )
         schema = Schema(fields)
+        if watermark is not None and watermark[0] not in {
+            f.name for f in fields
+        }:
+            raise SyntaxError(
+                f"WATERMARK over unknown column {watermark[0]!r}"
+            )
         kind = props.get("connector")
         if kind == "filelog":
             conn = FileLogSource(props["path"])
@@ -962,6 +997,8 @@ class SqlSession:
         self.sources[name] = src
         self.source_mgr.register(name, src, parallelism=self.parallelism)
         self.catalog.tables[name] = schema
+        if watermark is not None:
+            self.catalog.watermarks[name] = watermark
         self.runtime.register_state(src)
         self._log_ddl(sql)
         self._notify("add", "source", name, schema=schema, src=src)
@@ -1063,10 +1100,12 @@ class SqlSession:
             self.dml.detach_fragment(name)
             self.batch.tables.pop(name, None)
             self.catalog.tables.pop(name, None)
+            self.catalog.watermarks.pop(name, None)
         else:  # source
             src = self.sources.pop(name, None)
             self.source_mgr.unregister(name)
             self.catalog.tables.pop(name, None)
+            self.catalog.watermarks.pop(name, None)
             if src is not None:
                 self.runtime.unregister_state(src)
         self._log_ddl(sql)

@@ -156,6 +156,10 @@ class Select:
 class CreateMaterializedView:
     name: str
     select: Select
+    # EMIT ON WINDOW CLOSE (reference: EmitOnWindowClose plans): closed
+    # windows finalize (state freed) and final rows are exact; this
+    # build still emits intermediate updates before the close
+    emit_on_window_close: bool = False
 
 
 @dataclass(frozen=True)
@@ -166,6 +170,8 @@ class CreateTable:
     name: str
     columns: Tuple[Tuple[str, str], ...]  # (name, type word)
     pk: Tuple[str, ...] = ()  # PRIMARY KEY (cols); empty -> hidden row id
+    # WATERMARK FOR col AS col - INTERVAL '...': (column, lag_ms)
+    watermark: Optional[Tuple[str, int]] = None
 
 
 @dataclass(frozen=True)
@@ -226,6 +232,14 @@ _KEYWORDS = {
 # value only in join-type position, like the reference sqlparser's
 # non-reserved keywords after LEFT/RIGHT):
 _JOIN_WORDS = {"left", "right", "full", "outer", "semi", "anti"}
+
+# INTERVAL unit -> milliseconds — shared with the session's CREATE
+# SOURCE clause parsing so the two grammars cannot drift
+INTERVAL_SCALES = {
+    "millisecond": 1, "milliseconds": 1,
+    "second": 1000, "seconds": 1000,
+    "minute": 60_000, "minutes": 60_000,
+}
 
 
 @dataclass
@@ -295,7 +309,32 @@ class Parser:
                 self.expect("op", "(")
                 cols = []
                 pk: Tuple[str, ...] = ()
+                watermark: Optional[Tuple[str, int]] = None
                 while True:
+                    if self._accept_word("watermark"):
+                        # WATERMARK FOR col AS col - INTERVAL '...'
+                        # (reference: CREATE ... WATERMARK FOR, the
+                        # watermark-definition DDL)
+                        if not self._accept_word("for"):
+                            raise SyntaxError(
+                                "expected FOR after WATERMARK"
+                            )
+                        wcol = self.expect("ident").value
+                        self.expect("kw", "as")
+                        wcol2 = self.expect("ident").value
+                        if wcol2 != wcol:
+                            raise SyntaxError(
+                                "WATERMARK expression must be "
+                                f"{wcol} - INTERVAL '...'"
+                            )
+                        self.expect("op", "-")
+                        lag = self.interval_ms()
+                        if watermark is not None:
+                            raise SyntaxError("multiple WATERMARK clauses")
+                        watermark = (wcol, lag)
+                        if not self.accept("op", ","):
+                            break
+                        continue
                     if self._accept_word("primary"):
                         if not self._accept_word("key"):
                             raise SyntaxError("expected KEY after PRIMARY")
@@ -337,14 +376,29 @@ class Parser:
                 unknown = set(pk) - {c for c, _ in cols}
                 if unknown:
                     raise SyntaxError(f"PRIMARY KEY over unknown {unknown}")
-                return CreateTable(name, tuple(cols), pk)
+                if watermark is not None and watermark[0] not in {
+                    c for c, _ in cols
+                }:
+                    raise SyntaxError(
+                        f"WATERMARK over unknown column {watermark[0]!r}"
+                    )
+                return CreateTable(name, tuple(cols), pk, watermark)
             self.expect("kw", "materialized")
             self.expect("kw", "view")
             name = self.expect("ident").value
             self.expect("kw", "as")
             sel = self.select()
+            eowc = False
+            if self._accept_word("emit"):
+                if not (
+                    self._accept_word("on")
+                    and self._accept_word("window")
+                    and self._accept_word("close")
+                ):
+                    raise SyntaxError("expected EMIT ON WINDOW CLOSE")
+                eowc = True
             self.expect("eof")
-            return CreateMaterializedView(name, sel)
+            return CreateMaterializedView(name, sel, eowc)
         if self.accept("kw", "insert"):
             self.expect("kw", "into")
             table = self.expect("ident").value
@@ -616,11 +670,7 @@ class Parser:
             raise SyntaxError(f"bad interval {raw!r}")
         n = int(m.group(1))
         unit = (unit_tok.value if unit_tok else (m.group(2) or "second")).lower()
-        scale = {
-            "millisecond": 1, "milliseconds": 1,
-            "second": 1000, "seconds": 1000,
-            "minute": 60_000, "minutes": 60_000,
-        }.get(unit)
+        scale = INTERVAL_SCALES.get(unit)
         if scale is None:
             raise SyntaxError(f"bad interval unit {unit!r}")
         return n * scale

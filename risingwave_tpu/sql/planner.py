@@ -206,6 +206,10 @@ class BoundRel:
     pk: Tuple[str, ...]
     source: str  # base stream name the driver pushes into
     alias: Optional[str]
+    # set when the input is a window TVF over a watermark-declared
+    # relation: downstream grouped aggs keyed on it clean closed
+    # windows (window_key state cleaning)
+    window_col: Optional[str] = None
 
 
 def _join_inputs(lsrc: str, rsrc: str) -> Dict[str, str]:
@@ -246,6 +250,9 @@ class Catalog:
         # joins plan against these, lookup.rs)
         self.indexes: Dict[str, dict] = {}
         self.enable_delta_join = False  # SET enable_delta_join = true
+        # WATERMARK FOR declarations: relation -> (column, lag_ms)
+        # (reference: watermark definitions on sources/tables)
+        self.watermarks: Dict[str, Tuple[str, int]] = {}
 
     def schema_dtypes(self, name: str) -> Dict[str, object]:
         sch = self.tables[name]
@@ -460,8 +467,10 @@ class StreamPlanner:
     # -- entry -----------------------------------------------------------
     def plan(self, sql: str) -> PlannedMV:
         stmt = P.parse(sql)
+        eowc = False
         if isinstance(stmt, P.CreateMaterializedView):
             name, select = stmt.name, stmt.select
+            eowc = stmt.emit_on_window_close
         else:
             name, select = "anon_mv", stmt
         # type-directed pass first (decimal literal scaling, dictionary
@@ -479,17 +488,32 @@ class StreamPlanner:
         select = self._rewrite_distinct(select)
         if select.having is not None and not select.group_by:
             raise ValueError("HAVING requires GROUP BY")
-        topn = self._try_over_window_to_topn(name, select)
-        if topn is not None:
-            return topn
-        if isinstance(select.from_, P.Join):
+        planned = self._try_over_window_to_topn(name, select)
+        if planned is None and isinstance(select.from_, P.Join):
             if select.from_.join_type.startswith("temporal"):
-                return self._plan_temporal(name, select)
-            dj = self._try_delta_join(name, select)
-            if dj is not None:
-                return dj
-            return self._plan_join(name, select)
-        return self._plan_single(name, select)
+                planned = self._plan_temporal(name, select)
+            else:
+                planned = self._try_delta_join(name, select)
+                if planned is None:
+                    planned = self._plan_join(name, select)
+        elif planned is None:
+            planned = self._plan_single(name, select)
+        if eowc:
+            # EMIT ON WINDOW CLOSE needs a watermark-cleaned windowed
+            # plan — silently accepting it on ANY plan shape with no
+            # window cleaning would promise a close that never happens
+            from risingwave_tpu.executors.hash_agg import HashAggExecutor
+
+            if not any(
+                isinstance(ex, HashAggExecutor)
+                and ex.window_key is not None
+                for ex in planned.pipeline.executors
+            ):
+                raise ValueError(
+                    "EMIT ON WINDOW CLOSE requires a windowed GROUP BY "
+                    "over a WATERMARK-declared relation"
+                )
+        return planned
 
     @staticmethod
     def _rewrite_distinct(select: P.Select) -> P.Select:
@@ -583,6 +607,7 @@ class StreamPlanner:
         if isinstance(src, P.WindowTVF):
             source = src.table.name
             schema = dict(self.catalog.schema_dtypes(source))
+            self._maybe_watermark_filter(chain, source, schema)
             chain.append(
                 HopWindowExecutor(
                     src.ts_col, src.size_ms, src.slide_ms,
@@ -590,10 +615,23 @@ class StreamPlanner:
                 )
             )
             schema["window_start"] = jnp.dtype(jnp.int64)
-            return BoundRel(chain, schema, (), source, src.alias)
+            # the hop translates the event-time watermark into a
+            # window_start watermark (hop_window.py on_watermark), so
+            # downstream windowed aggs can clean closed windows
+            wm = self.catalog.watermarks.get(source)
+            window_col = (
+                "window_start"
+                if wm is not None and wm[0] == src.ts_col
+                else None
+            )
+            return BoundRel(
+                chain, schema, (), source, src.alias,
+                window_col=window_col,
+            )
         if isinstance(src, P.TableRef):
             source = src.name
             schema = dict(self.catalog.schema_dtypes(source))
+            self._maybe_watermark_filter(chain, source, schema)
             # scanning an MV: its change stream carries retractions keyed
             # by the MV pk — downstream state must key the same way
             pk = (
@@ -603,6 +641,19 @@ class StreamPlanner:
             )
             return BoundRel(chain, schema, pk, source, src.alias)
         raise TypeError(f"unsupported FROM {src!r}")
+
+    def _maybe_watermark_filter(
+        self, chain: List[Executor], source: str, schema
+    ) -> None:
+        """WATERMARK FOR declarations insert a self-driving
+        WatermarkFilterExecutor at the scan (watermark_filter.rs:39):
+        late rows drop and the generated watermark walks downstream
+        every barrier, cleaning windowed state without driver calls."""
+        wm = self.catalog.watermarks.get(source)
+        if wm is not None and wm[0] in schema:
+            from risingwave_tpu.executors import WatermarkFilterExecutor
+
+            chain.append(WatermarkFilterExecutor(wm[0], lag_ms=wm[1]))
 
     def _plan_rel(
         self, name: str, select: P.Select, pre: Optional[BoundRel] = None
@@ -640,8 +691,15 @@ class StreamPlanner:
             )
 
         if select.group_by:
+            # a windowed input over a watermark-declared relation:
+            # grouped aggs keyed on the window column clean closed
+            # windows (state_table watermark state cleaning; EMIT ON
+            # WINDOW CLOSE finalizes them silently either way — this
+            # build also emits intermediate updates before the close)
+            wcol = bound.window_col
             chain2, out_schema, pk = self._plan_groupby(
-                name, select, binder, schema, retractable=False
+                name, select, binder, schema, retractable=False,
+                window_col=wcol,
             )
             chain.extend(chain2)
             if select.having is not None:
@@ -1146,9 +1204,13 @@ class StreamPlanner:
         schema: Dict[str, object],
         retractable: bool,
         nullable_cols: frozenset = frozenset(),
+        window_col: Optional[str] = None,
     ):
         """GROUP BY + aggregates (or DISTINCT) over an already-planned
         input with ``schema``. Returns (executors, out_schema, pk).
+        ``window_col``: when set AND among the group keys, the agg
+        gets window_key state cleaning (closed windows finalize
+        silently on the upstream watermark; the MV keeps final rows).
 
         ``retractable``: the input stream can carry row-level deletes
         (e.g. downstream of a non-append-only join); MIN/MAX calls then
@@ -1284,6 +1346,14 @@ class StreamPlanner:
                     # group; SQL plans can't bound that statically, so
                     # size generously (the overflow latch still guards)
                     minput_k=256,
+                    # watermark-driven state cleaning for windowed
+                    # group keys (retention 0, finalize silently: the
+                    # MV keeps the closed windows' final rows)
+                    window_key=(
+                        (window_col, 0, False)
+                        if window_col is not None and window_col in keys
+                        else None
+                    ),
                 )
             )
         elif retractable:

@@ -1,0 +1,157 @@
+"""WATERMARK FOR DDL + EMIT ON WINDOW CLOSE: the EOWC SQL surface.
+
+Reference: watermark definitions on sources/tables + EmitOnWindowClose
+plans. The planner inserts a self-driving WatermarkFilterExecutor at
+every scan of a watermark-declared relation (late rows drop, the
+generated watermark walks downstream each barrier) and windowed
+grouped aggs keyed on the TVF window column get window_key state
+cleaning — closed windows finalize (state freed) while the MV keeps
+their final rows. Divergence (documented): intermediate updates are
+visible before the close.
+"""
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.frontend.session import SqlSession
+from risingwave_tpu.sql import Catalog
+
+pytestmark = pytest.mark.smoke
+
+W = 10_000  # tumble-ish window: size == slide
+
+
+def _windowed_mv(s, eowc: bool):
+    suffix = " EMIT ON WINDOW CLOSE" if eowc else ""
+    s.execute(
+        "CREATE MATERIALIZED VIEW w AS SELECT window_start, "
+        "count(*) AS n FROM HOP(bids, ts, INTERVAL '10' SECONDS, "
+        f"INTERVAL '10' SECONDS) GROUP BY window_start{suffix}"
+    )
+
+
+def test_watermark_cleans_closed_windows():
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute(
+        "CREATE TABLE bids (ts TIMESTAMP, v BIGINT, "
+        "WATERMARK FOR ts AS ts - INTERVAL '0' SECONDS)"
+    )
+    _windowed_mv(s, eowc=False)
+    from risingwave_tpu.executors.hash_agg import HashAggExecutor
+
+    agg = next(
+        ex
+        for ex in s.runtime.fragments["w"].executors
+        if isinstance(ex, HashAggExecutor)
+    )
+    assert agg.window_key == ("window_start", 0, False)
+    # epoch 1: two windows; epoch 2 advances event time far ahead —
+    # earlier windows CLOSE (state frees) but the MV keeps finals
+    s.execute(f"INSERT INTO bids VALUES (1000, 1), ({W + 1000}, 1)")
+    s.execute(f"INSERT INTO bids VALUES ({5 * W + 1}, 1)")
+    out, _ = s.execute("SELECT window_start, n FROM w ORDER BY window_start")
+    assert list(out["n"]) == [1, 1, 1]
+    live = int(np.asarray(agg.table.live).sum())
+    assert live <= 1, f"closed windows still hold state ({live} groups)"
+    # LATE row for a closed window: dropped by the watermark filter
+    s.execute("INSERT INTO bids VALUES (1001, 1)")
+    out, _ = s.execute("SELECT window_start, n FROM w ORDER BY window_start")
+    assert list(out["n"]) == [1, 1, 1]  # unchanged
+
+
+def test_emit_on_window_close_suffix():
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute(
+        "CREATE TABLE bids (ts TIMESTAMP, v BIGINT, "
+        "WATERMARK FOR ts AS ts - INTERVAL '2' SECONDS)"
+    )
+    _windowed_mv(s, eowc=True)
+    s.execute(f"INSERT INTO bids VALUES (1000, 1), (2000, 1)")
+    s.execute(f"INSERT INTO bids VALUES ({9 * W}, 1)")
+    out, _ = s.execute("SELECT window_start, n FROM w ORDER BY window_start")
+    assert list(out["n"]) == [2, 1]
+
+
+def test_eowc_without_watermark_rejected():
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute("CREATE TABLE bids (ts TIMESTAMP, v BIGINT)")
+    with pytest.raises(ValueError, match="WATERMARK"):
+        _windowed_mv(s, eowc=True)
+
+
+def test_source_watermark_ddl(tmp_path):
+    from risingwave_tpu.connectors.framework import FileLogSource
+
+    d = str(tmp_path)
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute(
+        f"CREATE SOURCE ev (ts TIMESTAMP, v BIGINT, "
+        f"WATERMARK FOR ts AS ts - INTERVAL '1' SECOND) "
+        f"WITH (connector='filelog', path='{d}', format='json')"
+    )
+    assert s.catalog.watermarks["ev"] == ("ts", 1000)
+    _ = s.execute(
+        "CREATE MATERIALIZED VIEW c AS SELECT window_start, count(*) "
+        "AS n FROM HOP(ev, ts, INTERVAL '10' SECONDS, "
+        "INTERVAL '10' SECONDS) GROUP BY window_start"
+    )
+    FileLogSource.append(d, 0, [
+        '{"ts": 1000, "v": 1}', '{"ts": 50000, "v": 1}',
+    ])
+    s.pump_sources()
+    s.runtime.barrier()
+    out, _ = s.execute(
+        "SELECT window_start, n FROM c ORDER BY window_start"
+    )
+    assert list(out["n"]) == [1, 1]
+    s.execute("DROP MATERIALIZED VIEW c")
+    s.execute("DROP SOURCE ev")
+    assert "ev" not in s.catalog.watermarks
+
+
+def test_watermark_survives_ddl_replay():
+    from risingwave_tpu.runtime import StreamingRuntime
+    from risingwave_tpu.storage.object_store import MemObjectStore
+
+    store = MemObjectStore()
+    rt = StreamingRuntime(store)
+    s = SqlSession(Catalog({}), rt)
+    s.execute(
+        "CREATE TABLE bids (ts TIMESTAMP, v BIGINT, "
+        "WATERMARK FOR ts AS ts - INTERVAL '3' SECONDS)"
+    )
+    rt.wait_checkpoints()
+    s2 = SqlSession.restore(StreamingRuntime(store))
+    assert s2.catalog.watermarks["bids"] == ("ts", 3000)
+
+
+def test_retractions_pass_the_watermark_filter():
+    """DELETE/UPDATE below the watermark must still reach downstream
+    state (review finding r5: dropping them desynced MVs from DML'd
+    tables); its no-op against already-cleaned state is fine."""
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute(
+        "CREATE TABLE t (ts TIMESTAMP, v BIGINT, "
+        "WATERMARK FOR ts AS ts - INTERVAL '0' SECONDS)"
+    )
+    s.execute("CREATE MATERIALIZED VIEW m AS SELECT count(*) AS n FROM t")
+    s.execute("INSERT INTO t VALUES (1000, 1)")
+    s.execute("INSERT INTO t VALUES (100000, 2)")  # wm -> 100000
+    out, _ = s.execute("SELECT n FROM m")
+    assert out["n"][0] == 2
+    s.execute("DELETE FROM t WHERE ts = 1000")  # below the watermark
+    out, _ = s.execute("SELECT n FROM m")
+    assert out["n"][0] == 1  # the retraction arrived
+
+
+def test_source_watermark_unit_inside_quotes(tmp_path):
+    from risingwave_tpu.connectors.framework import FileLogSource
+
+    d = str(tmp_path)
+    s = SqlSession(Catalog({}), capacity=1 << 10)
+    s.execute(
+        f"CREATE SOURCE ev (ts TIMESTAMP, "
+        f"WATERMARK FOR ts AS TS - INTERVAL '1 second') "
+        f"WITH (connector='filelog', path='{d}', format='json')"
+    )
+    assert s.catalog.watermarks["ev"] == ("ts", 1000)
