@@ -488,11 +488,14 @@ class SqlSession:
 
     def _execute_create_mv_or_rest(self, stmt, sql):
         if isinstance(stmt, P.CreateMaterializedView):
-            nested_join = isinstance(stmt.select.from_, P.Join) and (
+            is_union = isinstance(stmt.select, P.UnionAll)
+            nested_join = not is_union and isinstance(
+                stmt.select.from_, P.Join
+            ) and (
                 isinstance(stmt.select.from_.left, P.Join)
                 or isinstance(stmt.select.from_.right, P.Join)
             )
-            if self.exec_mode == "graph" and not nested_join:
+            if self.exec_mode == "graph" and not nested_join and not is_union:
                 from risingwave_tpu.runtime.fragmenter import graph_planned_mv
 
                 planned = graph_planned_mv(
@@ -557,6 +560,11 @@ class SqlSession:
             self.runtime.barrier()
             verb = "DELETE" if isinstance(stmt, P.DeleteFrom) else "UPDATE"
             return {}, f"{verb} {n}"
+        if isinstance(stmt, P.UnionAll):
+            raise NotImplementedError(
+                "ad-hoc UNION ALL queries are unsupported: CREATE a "
+                "MATERIALIZED VIEW over the union and SELECT from it"
+            )
         from risingwave_tpu.sql.typing import typecheck_select
 
         stmt = typecheck_select(stmt, self.catalog, self.strings)

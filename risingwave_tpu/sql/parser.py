@@ -42,6 +42,14 @@ class Star:
 
 
 @dataclass(frozen=True)
+class UnionAll:
+    """<select> UNION ALL <select> [...] (reference: the frontend's
+    set-operation binder + stream UnionExecutor, union.rs)."""
+
+    selects: Tuple["Select", ...]
+
+
+@dataclass(frozen=True)
 class UnaryOp:
     op: str
     operand: object
@@ -387,7 +395,7 @@ class Parser:
             self.expect("kw", "view")
             name = self.expect("ident").value
             self.expect("kw", "as")
-            sel = self.select()
+            sel = self._select_maybe_union()
             eowc = False
             if self._accept_word("emit"):
                 if not (
@@ -443,9 +451,24 @@ class Parser:
             where = self.expr() if self.accept("kw", "where") else None
             self.expect("eof")
             return UpdateSet(table, tuple(sets), where)
-        sel = self.select()
+        sel = self._select_maybe_union()
         self.expect("eof")
         return sel
+
+    def _select_maybe_union(self):
+        """select [UNION ALL select ...] — chained branches flatten
+        into one UnionAll node."""
+        branches = [self.select()]
+        while self._accept_word("union"):
+            if not self._accept_word("all"):
+                raise SyntaxError(
+                    "only UNION ALL is supported (UNION implies "
+                    "distinct, which needs a dedup over the merge)"
+                )
+            branches.append(self.select())
+        if len(branches) == 1:
+            return branches[0]
+        return UnionAll(tuple(branches))
 
     def _literal_value(self):
         """A literal (optionally negated) inside VALUES."""
@@ -656,7 +679,11 @@ class Parser:
         if self.accept("kw", "as"):
             return self.expect("ident").value
         t = self.peek()
-        if t.kind == "ident" and t.value not in ("left", "right", "full", "for"):
+        if t.kind == "ident" and t.value not in (
+            "left", "right", "full", "for",
+            "union",  # a set-op continuation, not an alias
+            "emit",  # EMIT ON WINDOW CLOSE suffix
+        ):
             return self.next().value
         return None
 

@@ -473,6 +473,12 @@ class StreamPlanner:
             eowc = stmt.emit_on_window_close
         else:
             name, select = "anon_mv", stmt
+        if isinstance(select, P.UnionAll):
+            if eowc:
+                raise NotImplementedError(
+                    "EMIT ON WINDOW CLOSE over UNION ALL unsupported"
+                )
+            return self._plan_union(name, select)
         # type-directed pass first (decimal literal scaling, dictionary
         # collation guards), then logical optimization (predicate
         # pushdown into derived tables, outer-join simplification,
@@ -514,6 +520,104 @@ class StreamPlanner:
                     "over a WATERMARK-declared relation"
                 )
         return planned
+
+    def _plan_union(self, name: str, union: P.UnionAll) -> PlannedMV:
+        """UNION ALL: each branch lowers to a hidden MV; the top MV's
+        fragment subscribes to ALL of them (the runtime's multi-
+        subscription IS the UnionExecutor, union.rs — chunks from
+        every upstream merge into one stream) and keys rows by a fresh
+        union-level row id so branch ids can never collide.
+
+        v1 scope: branches must be APPEND-ONLY projections with
+        identical output schemas — a retracting branch (aggregates,
+        TopN) would delete against the fresh row ids and miss."""
+        import dataclasses as _dc
+
+        aux: List[PlannedMV] = []
+        out_schema: Optional[Dict[str, object]] = None
+        added: List[str] = []
+        try:
+            for i, sel in enumerate(union.selects):
+                # a per-branch tag column: the top MV keys rows by
+                # (_ubranch, _row_id), so a branch's RETRACTIONS hit
+                # exactly the rows that branch inserted (a fresh
+                # union-level row id could never be re-derived for a
+                # delete)
+                sel = _dc.replace(
+                    sel,
+                    items=tuple(sel.items)
+                    + (P.SelectItem(P.Literal(i), "_ubranch"),),
+                )
+                sub = self._plan_branch(f"__u{i}_{name}", sel)
+                if "_row_id" not in sub.schema or sub.mview.pk != (
+                    "_row_id",
+                ):
+                    raise NotImplementedError(
+                        "UNION ALL branches must be append-only "
+                        "projections (no aggregates/TopN) in this build"
+                    )
+                sch = tuple(
+                    (c, d)
+                    for c, d in sub.schema.items()
+                    if c not in ("_row_id", "_ubranch")
+                )
+                if out_schema is None:
+                    out_schema = sch
+                elif out_schema != sch:
+                    # ORDER matters too: name-based merging of swapped
+                    # columns would silently diverge from SQL's
+                    # positional semantics
+                    raise ValueError(
+                        "UNION ALL branches must have identical "
+                        f"schemas (names, types, AND order): "
+                        f"{[c for c, _ in out_schema]} vs "
+                        f"{[c for c, _ in sch]}"
+                    )
+                self.catalog.add_mv(sub)
+                added.append(sub.name)
+                aux.append(sub)
+        except BaseException:
+            # a failed later branch must not leak earlier hidden MVs
+            # into the catalog (they have no runtime fragment yet)
+            for n in added:
+                self.catalog.mvs.pop(n, None)
+                self.catalog.tables.pop(n, None)
+            raise
+        cols = tuple(c for c, _ in out_schema)
+        mview = MaterializeExecutor(
+            pk=("_ubranch", "_row_id"),
+            columns=cols,
+            table_id=f"{name}.mview",
+        )
+        pipeline = Pipeline([mview])
+        return PlannedMV(
+            name,
+            pipeline,
+            mview,
+            {a.name: "single" for a in aux},
+            schema={
+                **dict(out_schema),
+                "_ubranch": jnp.dtype(jnp.int64),
+                "_row_id": jnp.dtype(jnp.int64),
+            },
+            aux=tuple(aux),
+        )
+
+    def _plan_branch(self, name: str, select: P.Select) -> PlannedMV:
+        """One union branch through the full single-select pipeline
+        (typecheck, optimize, lowering)."""
+        from risingwave_tpu.sql.optimizer import optimize_select
+        from risingwave_tpu.sql.typing import typecheck_select
+
+        select = self._decorrelate(select)
+        select = typecheck_select(
+            select, self.catalog, getattr(self, "strings", None)
+        )
+        select = optimize_select(select, catalog=self.catalog)
+        select = self._rewrite_distinct(select)
+        if isinstance(select.from_, P.Join):
+            return self._plan_join(name, select)
+        return self._plan_single(name, select)
 
     @staticmethod
     def _rewrite_distinct(select: P.Select) -> P.Select:

@@ -63,6 +63,10 @@ def output_name(item: P.SelectItem, i: int) -> str:
 
 def infer_output_fields(stmt, catalog) -> Dict[str, Field]:
     """Best-effort output column name -> logical Field for a Select."""
+    if isinstance(stmt, P.UnionAll):
+        # branches share one schema (the planner enforces it): the
+        # first branch types the union's output
+        stmt = stmt.selects[0]
     if not isinstance(stmt, P.Select):
         return {}
     stmt = expand_star(stmt, catalog, strict=False)
