@@ -323,6 +323,12 @@ class BatchQueryEngine:
         if stmt.order_by:
             lanes = []
             for ident, desc in reversed(stmt.order_by):
+                if ident.name not in out:
+                    raise ValueError(
+                        f"ORDER BY column {ident.name!r} must appear "
+                        "in the SELECT list (this engine sorts the "
+                        "projected output)"
+                    )
                 lane = np.asarray(out[ident.name])
                 nl = out.get(ident.name + "__null")
                 if lane.dtype == object:
