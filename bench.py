@@ -381,6 +381,11 @@ def bench_q5_unified(epochs, events_per_epoch, chunk_events, smoke):
     mv.pipeline.barrier()
     mv.pipeline.close()
     mv = graph_planned_mv(factory, Q5_SQL, parallelism=1)
+    # drop warmup-epoch observations (first-epoch compile would
+    # dominate the reported per-stage p99 and defeat the breakdown)
+    from risingwave_tpu.metrics import REGISTRY
+
+    REGISTRY.histograms.pop("barrier_stage_ms", None)
 
     dev_epochs = mk()  # host->device conversion OUTSIDE the timer
     barrier_times = []
@@ -392,6 +397,22 @@ def bench_q5_unified(epochs, events_per_epoch, chunk_events, smoke):
         mv.pipeline.barrier()
         barrier_times.append(time.perf_counter() - tb)
     dt = time.perf_counter() - t0
+    # measured roofline (PROFILE.md "measured vs modeled"): HBM bytes
+    # actually moved this run = chunks pushed + live executor state
+    from risingwave_tpu.epoch_trace import chunk_nbytes, roofline
+
+    moved = sum(chunk_nbytes(c) for ep in dev_epochs for c in ep) + sum(
+        ex.state_nbytes()
+        for ex in mv.pipeline.executors
+        if hasattr(ex, "state_nbytes")
+    )
+    rf = roofline(moved, dt)
+    # snapshot the per-stage breakdown NOW: it must describe the sync
+    # run next to whose p99 it is reported, not blend in the pipelined
+    # phase's admission-mode observations below
+    from risingwave_tpu.epoch_trace import stage_breakdown
+
+    stages_sync = stage_breakdown()
     snap = mv.mview.snapshot()  # {(auction, window_start): (num,)}
     ok = snap == {k: (v,) for k, v in cpu_counts.items()}
     mv.pipeline.close()
@@ -445,6 +466,13 @@ def bench_q5_unified(epochs, events_per_epoch, chunk_events, smoke):
         "q5u_correct": ok,
         "q5u_cpu_actor_rows_per_sec": round(cpu_rows_s, 1),
         "q5u_total_bids": total_bids,
+        # barrier-lifecycle observability: where each barrier's time
+        # went (per stage, sync run only) + the measured roofline
+        "barrier_stage_ms": stages_sync,
+        "achieved_bw_frac": rf["achieved_bw_frac"],
+        "achieved_bw_gbps": rf["achieved_bw_gbps"],
+        "hbm_peak_gbps": rf["hbm_peak_gbps"],
+        "hbm_bytes_touched": rf["hbm_bytes_touched"],
     }
 
 
@@ -533,10 +561,25 @@ def bench_q5(args_epochs, events_per_epoch, chunk_events, smoke, agg_mode):
         ]
 
     run_q5(mk_stacked()[:1])  # warmup: compile epoch step + flush
-    q5, dt, barrier_times = run_q5(mk_stacked())
+    from risingwave_tpu.metrics import REGISTRY
+
+    REGISTRY.histograms.pop("barrier_stage_ms", None)  # drop warmup obs
+    stacked = mk_stacked()
+    q5, dt, barrier_times = run_q5(stacked)
 
     rows_s = total_bids / dt
     p99_barrier_ms = float(np.percentile(np.asarray(barrier_times) * 1e3, 99))
+
+    # measured roofline: bytes this run moved through HBM (epoch-stacked
+    # input chunks + the live agg/MV state) over the measured wall time
+    from risingwave_tpu.epoch_trace import chunk_nbytes, roofline, stage_breakdown
+
+    moved = sum(chunk_nbytes(s) for s in stacked) + sum(
+        ex.state_nbytes()
+        for ex in q5.pipeline.executors
+        if hasattr(ex, "state_nbytes")
+    )
+    rf = roofline(moved, dt)
 
     # -- correctness cross-check vs the CPU actor ------------------------
     mv = {k: v[0] for k, v in q5.mview.snapshot().items()}
@@ -559,6 +602,10 @@ def bench_q5(args_epochs, events_per_epoch, chunk_events, smoke, agg_mode):
         "epochs": epochs,
         "agg_mode": agg_mode,
         "correct": ok,
+        "q5_achieved_bw_frac": rf["achieved_bw_frac"],
+        "q5_achieved_bw_gbps": rf["achieved_bw_gbps"],
+        "q5_hbm_peak_gbps": rf["hbm_peak_gbps"],
+        "q5_barrier_stage_ms": stage_breakdown(),
     }
 
 
@@ -619,6 +666,35 @@ def _device_alive(timeout_s: int = 60) -> bool:
         except subprocess.TimeoutExpired:
             pass
         return False
+
+
+def _dump_bench_stall(query: str, tier: str, err) -> str:
+    """A child wedged the device: leave a parent-side stall artifact
+    naming the query (the child's own runtime-side STALL_DUMP_*.json —
+    graph.wait_barrier timeout — complements this with per-actor
+    detail). Never raises."""
+    import os
+
+    path = f"BENCH_STALL_{query}_{tier}.json"
+    try:
+        with open(path, "w") as f:
+            json.dump(
+                {
+                    "query": query,
+                    "tier": tier,
+                    "error": str(err),
+                    "ts": time.time(),
+                    "child_stall_dumps": sorted(
+                        p for p in os.listdir(".")
+                        if p.startswith("STALL_DUMP_")
+                    ),
+                },
+                f,
+                indent=1,
+            )
+    except OSError:
+        return ""
+    return path
 
 
 def _bank_partial(merged: dict) -> None:
@@ -810,6 +886,12 @@ def main():
             result.update(
                 _bench_one(q, epochs, events, chunk, args.smoke, args.agg_mode)
             )
+        result.setdefault(
+            "achieved_bw_frac", result.get("q5_achieved_bw_frac", 0.0)
+        )
+        result.setdefault(
+            "barrier_stage_ms", result.get("q5_barrier_stage_ms", {})
+        )
         print(json.dumps(result))
         return
 
@@ -899,11 +981,29 @@ def main():
         if errors:
             snapshot["errors"] = list(errors)
         _bank_partial(snapshot)  # success AND failure: bank now
-        if sub is None and not args.smoke and not _device_alive():
-            # the failed child wedged the tunnel: stop risking the
-            # banked results; report what we have
-            errors.append(f"{query}/{tier}: device wedged; stopping")
-            dead = True
+        if sub is None and not args.smoke:
+            # per-query device health re-probe (VERDICT r6 #2): one
+            # wedged query must not cost the remaining queries their
+            # runs. Record the forensic artifact, then give the tunnel
+            # a bounded chance to recover before the next child.
+            healthy = _device_alive()
+            if not healthy:
+                _dump_bench_stall(query, tier, err)
+                for _attempt in range(2):
+                    if remaining() < 120 + _FINALIZE_RESERVE_S:
+                        break
+                    time.sleep(60)
+                    if _device_alive():
+                        healthy = True
+                        errors.append(
+                            f"{query}/{tier}: tunnel recovered after wedge"
+                        )
+                        break
+            if not healthy:
+                # still wedged after the grace window: stop risking the
+                # banked results; report what we have
+                errors.append(f"{query}/{tier}: device wedged; stopping")
+                dead = True
     if "value" in merged:
         # keep the apply_stacked (fusion-oracle) number visible next to
         # the headline before q5u overwrites the driver fields
@@ -915,6 +1015,13 @@ def main():
         merged["value"] = merged["q5u_throughput"]
         merged["unit"] = "bids/sec"
         merged["vs_baseline"] = merged["q5u_vs_baseline"]
+    if "achieved_bw_frac" not in merged and "q5_achieved_bw_frac" in merged:
+        # q5u failed but the stacked oracle landed: its measured
+        # roofline keeps the headline fields populated
+        merged["achieved_bw_frac"] = merged["q5_achieved_bw_frac"]
+        merged.setdefault(
+            "barrier_stage_ms", merged.get("q5_barrier_stage_ms", {})
+        )
     if "metric" not in merged:
         # every headline candidate failed even if q8/q7 landed: keep
         # the one-JSON-line contract parseable for the driver

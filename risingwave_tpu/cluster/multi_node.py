@@ -24,11 +24,15 @@ recovers independently through the single-node replay protocol
 
 from __future__ import annotations
 
+import re
+import time
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 from risingwave_tpu.cluster.client import ComputeClient
+from risingwave_tpu.epoch_trace import record_stage
+from risingwave_tpu.event_log import EVENT_LOG
 from risingwave_tpu.storage.sstable import key_hashes
 
 
@@ -39,7 +43,12 @@ class ShardedClusterClient:
         if not clients:
             raise ValueError("need at least one compute node")
         self.nodes: List[ComputeClient] = list(clients)
-        self.dist: Dict[str, str] = {}  # table -> distribution column
+        self.dist: Dict[str, str] = {}  # table/MV -> distribution column
+        # MVs whose key does NOT contain their base's distribution
+        # column: each node holds a PARTIAL group, so concatenating
+        # per-node results duplicates groups — query() must refuse
+        # instead of silently returning wrong rows
+        self._unsafe_mv: Dict[str, str] = {}  # mv -> reason
 
     @classmethod
     def spawn(cls, n_nodes: int, state_dirs: Sequence[str]):
@@ -57,13 +66,58 @@ class ShardedClusterClient:
             raise RuntimeError(f"nodes disagree on DDL: {tags}")
         tag = next(iter(tags))
         if distributed_by is not None:
-            import re
-
             m = re.match(r"(?is)^\s*create\s+table\s+(\w+)", sql)
             if not m:
                 raise ValueError("distributed_by applies to CREATE TABLE")
             self.dist[m.group(1)] = distributed_by
+        self._classify_mv(sql)
+        EVENT_LOG.record("ddl", tag=tag, sql=sql.strip()[:200], scope="cluster")
         return tag
+
+    def _classify_mv(self, sql: str) -> None:
+        """Track whether a CREATE MATERIALIZED VIEW's key preserves its
+        base's distribution column. Groups sharded by a column in their
+        GROUP BY stay node-local (the reference's distribution-key
+        contract); an MV grouping by anything else holds PARTIAL groups
+        per node, and scatter-gather reads would duplicate them."""
+        m = re.match(
+            r"(?is)^\s*create\s+materialized\s+view\s+(\w+)\s+as\s+(.*)$",
+            sql,
+        )
+        if not m:
+            return
+        mv, select = m.group(1), m.group(2)
+        # re-creating an MV re-classifies it from scratch — a stale
+        # unsafe/dist entry from a dropped namesake must not stick
+        self._unsafe_mv.pop(mv, None)
+        self.dist.pop(mv, None)
+        fm = re.search(r"(?is)\bfrom\s+(?:hop\s*\(\s*(\w+)|(\w+))", select)
+        if not fm:
+            return
+        base = fm.group(1) or fm.group(2)
+        base_dist = self.dist.get(base)
+        if base_dist is None:
+            if base in self._unsafe_mv:
+                # MV over an already-unsafe MV inherits the problem
+                self._unsafe_mv[mv] = f"builds on unsafe MV {base!r}"
+            return
+        gm = re.search(
+            r"(?is)\bgroup\s+by\s+(.+?)(?:\bhaving\b|\border\s+by\b|;|$)",
+            select,
+        )
+        if gm is None:
+            # row-preserving MV: rows stay on the node their base row
+            # hashed to — concatenation is exact, contract carries over
+            self.dist[mv] = base_dist
+            return
+        group_cols = {c.strip().lower() for c in gm.group(1).split(",")}
+        if base_dist.lower() in group_cols:
+            self.dist[mv] = base_dist
+        else:
+            self._unsafe_mv[mv] = (
+                f"key ({', '.join(sorted(group_cols))}) does not contain "
+                f"{base!r}'s distribution column {base_dist!r}"
+            )
 
     # -- data (hash-routed) ----------------------------------------------
     def push_chunk(
@@ -96,14 +150,23 @@ class ShardedClusterClient:
         un-durable chunks (client.recover) — while the other nodes'
         state is untouched; the barrier then retries on that node."""
         epochs = []
-        for node in self.nodes:
+        for i, node in enumerate(self.nodes):
+            t0 = time.perf_counter()
             try:
                 if node.sock is None:  # killed: socket torn down
                     raise ConnectionError("node down")
                 epochs.append(node.barrier())
-            except (ConnectionError, OSError):
+            except (ConnectionError, OSError) as e:
+                EVENT_LOG.record("recovery", mode="node", node=i, cause=repr(e))
                 node.recover()
                 epochs.append(node.barrier())
+            # per-node barrier RTT: the cross-node half of the epoch's
+            # stage attribution (wire + that node's full commit)
+            record_stage(
+                "node_commit",
+                (time.perf_counter() - t0) * 1e3,
+                fragment=f"node{i}",
+            )
         return epochs
 
     # -- reads (scatter-gather) -------------------------------------------
@@ -114,6 +177,16 @@ class ShardedClusterClient:
         the MV's key is the distribution column (disjoint shards).
         ``order_by`` re-establishes a global order at the merge (the
         per-node ORDER BY only orders within a shard)."""
+        fm = re.search(r"(?is)\bfrom\s+(\w+)", sql)
+        if fm and fm.group(1) in self._unsafe_mv:
+            # concatenating partial groups would silently return
+            # duplicated-group results — refuse loudly instead
+            raise ValueError(
+                f"cannot scatter-gather query MV {fm.group(1)!r}: "
+                f"{self._unsafe_mv[fm.group(1)]}. Re-create the MV "
+                "grouping by the distribution column, or query the "
+                "nodes individually and merge groups yourself."
+            )
         merged: Dict[str, list] = {}
         for node in self.nodes:
             out = node.query(sql)

@@ -14,7 +14,10 @@ unrecognized-capture pattern.
 
 from __future__ import annotations
 
-import tomllib
+try:
+    import tomllib
+except ModuleNotFoundError:  # python < 3.11
+    import tomli as tomllib
 from dataclasses import dataclass, field, fields
 from typing import Any, Dict, Optional
 

@@ -674,3 +674,10 @@ class GenericSourceExecutor(Executor, Checkpointable):
             if sid is not None:
                 self.offsets[sid] = int(offset)
         self._committed = dict(self.offsets)
+        from risingwave_tpu.event_log import EVENT_LOG
+
+        EVENT_LOG.record(
+            "offset_resume",
+            table_id=str(self.table_id),
+            splits=len(self.offsets),
+        )

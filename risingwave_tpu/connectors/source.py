@@ -90,3 +90,11 @@ class NexmarkSourceExecutor(Executor, Checkpointable):
         ):
             self.splits[int(split)].seek(int(offset))
         self._committed = [g.offset for g in self.splits]
+        from risingwave_tpu.event_log import EVENT_LOG
+
+        EVENT_LOG.record(
+            "offset_resume",
+            table_id=str(self.table_id),
+            splits=len(self.splits),
+            offsets=self._committed[:8],
+        )

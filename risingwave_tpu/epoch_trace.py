@@ -1,0 +1,248 @@
+"""Barrier-lifecycle observability: per-epoch stage attribution, device
+telemetry (measured roofline fraction), and await-tree-style stall
+dumps.
+
+Reference: the reference threads ``tracing`` spans through every actor,
+dumps await trees on stall (src/utils/runtime/), and attributes barrier
+latency per stage in its grafana dashboards. Here every barrier gets an
+``EpochTrace``: the runtime stamps each lifecycle stage (chunk ingest,
+dispatch/flush, device step, checkpoint staging, SST upload, manifest
+commit) into it, mirrors the stage durations into the
+``barrier_stage_ms{stage=...}`` histogram (prometheus + chrome-trace via
+trace.span), and derives per-barrier HBM telemetry: bytes touched =
+device-state delta (utils_heap accounting) + chunk bytes moved, reported
+as achieved bandwidth vs the configured chip peak so every bench JSON
+carries a MEASURED roofline fraction (PROFILE.md "measured vs modeled").
+
+``dump_stalls()`` is the q7-wedge forensic path: when a barrier exceeds
+its deadline, snapshot every thread's open span stack, each actor's
+input-channel depths and last-collected epoch, and the pending epochs,
+to a JSON artifact BEFORE recovery tears the evidence down.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from risingwave_tpu.metrics import REGISTRY
+
+# HBM peak per platform (GB/s): TPU v4 ≈ 1228, a generic GPU ≈ 2000,
+# host DRAM ≈ 50. Override with RW_HBM_PEAK_GBPS for the actual chip —
+# the roofline fraction is only as honest as this denominator.
+_HBM_PEAK_GBPS = {"tpu": 1228.0, "gpu": 2000.0, "cpu": 50.0}
+
+
+def hbm_peak_gbps(platform: Optional[str] = None) -> float:
+    env = os.environ.get("RW_HBM_PEAK_GBPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    if platform is None:
+        try:
+            import jax
+
+            platform = jax.default_backend()
+        except Exception:
+            platform = "cpu"
+    return _HBM_PEAK_GBPS.get(platform, _HBM_PEAK_GBPS["cpu"])
+
+
+def roofline(bytes_touched: int, seconds: float, platform=None) -> Dict:
+    """Measured achieved-bandwidth vs chip peak. ``bytes_touched`` is
+    the accounted HBM traffic (state delta + chunks moved); ``seconds``
+    the wall time it moved in. Model ceiling lives in PROFILE.md; this
+    is the measured half."""
+    peak = hbm_peak_gbps(platform)
+    bw = (bytes_touched / seconds / 1e9) if seconds > 0 else 0.0
+    return {
+        "hbm_bytes_touched": int(bytes_touched),
+        "achieved_bw_gbps": round(bw, 4),
+        "hbm_peak_gbps": peak,
+        "achieved_bw_frac": round(bw / peak, 6) if peak else 0.0,
+    }
+
+
+def chunk_nbytes(chunk) -> int:
+    """Device bytes one StreamChunk occupies (column lanes + null lanes
+    + valid + ops) — the per-push half of 'HBM bytes touched'."""
+    total = 0
+    for attr in ("columns", "nulls"):
+        for arr in getattr(chunk, attr, {}).values():
+            total += int(getattr(arr, "nbytes", 0))
+    for attr in ("valid", "ops"):
+        arr = getattr(chunk, attr, None)
+        if arr is not None:
+            total += int(getattr(arr, "nbytes", 0))
+    return total
+
+
+def record_stage(stage: str, ms: float, fragment: str = "-") -> None:
+    """One stage observation -> the prometheus surface. Every label set
+    keeps the same keys (stage, fragment) so exposition stays uniform."""
+    REGISTRY.histogram("barrier_stage_ms").observe(
+        ms, stage=stage, fragment=fragment
+    )
+
+
+@dataclass
+class EpochTrace:
+    """Everything one barrier did, attributed by lifecycle stage.
+
+    ``stages_ms`` keys (the barrier lifecycle):
+      ingest          — host time in push() since the previous barrier
+      dispatch        — per-fragment barrier walk (flush + routing)
+      device_step     — barrier-fence device wait (block_until_ready +
+                        staged-scalar materialization; the ONLY forced
+                        sync, at the barrier)
+      checkpoint_stage— delta pull + mark flips (mgr.stage)
+      upload          — SST build + object-store puts
+      manifest_commit — version write (the durability point)
+    """
+
+    epoch: int
+    seq: int
+    checkpoint: bool
+    t_start: float = field(default_factory=time.perf_counter)
+    wall_ms: float = 0.0
+    stages_ms: Dict[str, float] = field(default_factory=dict)
+    chunk_bytes: int = 0
+    state_bytes: int = 0
+    state_delta_bytes: int = 0
+    hbm_bytes_touched: int = 0
+    achieved_bw_gbps: float = 0.0
+    achieved_bw_frac: float = 0.0
+    committed_at: Optional[float] = None
+
+    def add_stage(self, stage: str, ms: float, fragment: str = "-") -> None:
+        self.stages_ms[stage] = self.stages_ms.get(stage, 0.0) + ms
+        record_stage(stage, ms, fragment)
+
+    def finalize(
+        self,
+        state_bytes: int,
+        prev_state_bytes: int,
+        platform: Optional[str] = None,
+    ) -> None:
+        """Close the trace: wall time + device telemetry. Called once
+        the barrier's synchronous part is done (async commit stages may
+        still land afterwards — they mutate stages_ms in place)."""
+        self.wall_ms = (time.perf_counter() - self.t_start) * 1e3
+        self.state_bytes = int(state_bytes)
+        self.state_delta_bytes = abs(int(state_bytes) - int(prev_state_bytes))
+        self.hbm_bytes_touched = self.state_delta_bytes + self.chunk_bytes
+        rf = roofline(self.hbm_bytes_touched, self.wall_ms / 1e3, platform)
+        self.achieved_bw_gbps = rf["achieved_bw_gbps"]
+        self.achieved_bw_frac = rf["achieved_bw_frac"]
+        REGISTRY.gauge("achieved_bw_frac").set(self.achieved_bw_frac)
+        REGISTRY.gauge("hbm_bytes_touched").set(float(self.hbm_bytes_touched))
+
+    def to_dict(self) -> Dict:
+        return {
+            "epoch": self.epoch,
+            "seq": self.seq,
+            "checkpoint": self.checkpoint,
+            "wall_ms": round(self.wall_ms, 3),
+            "stages_ms": {k: round(v, 3) for k, v in self.stages_ms.items()},
+            "chunk_bytes": self.chunk_bytes,
+            "state_bytes": self.state_bytes,
+            "state_delta_bytes": self.state_delta_bytes,
+            "hbm_bytes_touched": self.hbm_bytes_touched,
+            "achieved_bw_gbps": self.achieved_bw_gbps,
+            "achieved_bw_frac": self.achieved_bw_frac,
+        }
+
+
+def stage_breakdown() -> Dict[str, Dict[str, float]]:
+    """The registry's barrier_stage_ms summary — what bench.py embeds
+    in every BENCH_*.json as ``barrier_stage_ms``."""
+    h = REGISTRY.histograms.get("barrier_stage_ms")
+    return h.summary() if h is not None else {}
+
+
+# ---------------------------------------------------------------------------
+# Stall dumps (await-tree analogue)
+# ---------------------------------------------------------------------------
+
+_DUMP_LOCK = threading.Lock()
+
+
+def dump_stalls(
+    reason: str,
+    runtime=None,
+    graph=None,
+    extra: Optional[Dict] = None,
+    path: Optional[str] = None,
+) -> str:
+    """Snapshot what every thread/actor is doing into a JSON artifact.
+
+    Captures: each thread's open span stack (trace.active_spans), each
+    actor's liveness + input-channel depths + last-collected epoch,
+    pending (uncollected) epochs with the stuck actors named, per-
+    fragment epochs, and the recent event-log tail. Returns the artifact
+    path. Never raises — a forensic dump must not worsen the stall."""
+    from risingwave_tpu.trace import active_spans
+
+    doc: Dict = {
+        "reason": reason,
+        "ts": time.time(),
+        "pid": os.getpid(),
+        "spans": active_spans(),
+    }
+    try:
+        if graph is not None:
+            doc["graph"] = graph.stall_snapshot()
+        if runtime is not None:
+            doc["runtime"] = _runtime_snapshot(runtime)
+        from risingwave_tpu.event_log import EVENT_LOG
+
+        doc["recent_events"] = EVENT_LOG.events(limit=20)
+        if extra:
+            doc["extra"] = extra
+    except Exception as e:  # partial dump beats no dump
+        doc["snapshot_error"] = repr(e)
+    if path is None:
+        d = os.environ.get("RW_STALL_DIR", ".")
+        path = os.path.join(d, f"STALL_DUMP_{int(time.time())}.json")
+    with _DUMP_LOCK:
+        try:
+            with open(path, "w") as f:
+                json.dump(doc, f, indent=1, default=str)
+        except OSError:
+            return ""
+    try:
+        from risingwave_tpu.event_log import EVENT_LOG
+
+        EVENT_LOG.record("stall_dump", reason=reason, path=path)
+    except Exception:
+        pass
+    REGISTRY.counter("stall_dumps_total").inc()
+    return path
+
+
+def _runtime_snapshot(rt) -> Dict:
+    """StreamingRuntime-side stall state: per-fragment epochs, the
+    async-lane depth, and graph-backed fragments' actor snapshots."""
+    snap: Dict = {
+        "epoch": getattr(rt, "_epoch", None),
+        "committed_epoch": rt.mgr.max_committed_epoch if rt.mgr else None,
+        "inflight_commits": getattr(rt, "_inflight", 0),
+        "closer_queue": len(getattr(rt, "_closer_q", ())),
+        "fragments": {},
+    }
+    for name, p in getattr(rt, "fragments", {}).items():
+        frag = {"epoch": getattr(p, "_epoch", None)}
+        g = getattr(p, "graph", None)
+        if g is not None:  # GraphPipeline: per-actor detail
+            try:
+                frag["actors"] = g.stall_snapshot()
+            except Exception as e:
+                frag["actors"] = repr(e)
+        snap["fragments"][name] = frag
+    return snap

@@ -1,0 +1,91 @@
+"""Meta event log — ring-buffered cluster history + JSONL spill.
+
+Reference: the meta node's event log (src/meta/src/manager/event_log.rs
++ ``risectl meta event-log``) recording DDL, barrier commits,
+recoveries, scale events, and connector offset resumes so an operator
+can reconstruct *what the cluster did* after the fact. Here: one
+process-wide ring (bounded deque — the hot path never grows memory)
+plus an optional JSONL spill file for durability across the process,
+served at ``/events`` on the metrics HTTP server and rendered on the
+dashboard.
+
+Recording sites (grow as subsystems need them):
+- ``ddl``            — frontend/session.py, every DDL statement
+- ``barrier_commit`` — runtime, each durable checkpoint epoch
+- ``recovery``       — runtime auto/manual recovery (with cause)
+- ``scale``          — parallel/scale.py reschedules
+- ``offset_resume``  — source executors resuming connector offsets
+- ``stall_dump``     — epoch_trace.dump_stalls artifacts
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from risingwave_tpu.metrics import REGISTRY
+
+_DEFAULT_CAPACITY = 4096
+
+
+class EventLog:
+    def __init__(
+        self,
+        capacity: int = _DEFAULT_CAPACITY,
+        spill_path: Optional[str] = None,
+    ):
+        self._events: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+        # JSONL spill: the ring forgets, the file does not (best-effort)
+        self.spill_path = spill_path or os.environ.get("RW_EVENT_LOG_PATH")
+
+    def set_spill(self, path: Optional[str]) -> None:
+        with self._lock:
+            self.spill_path = path
+
+    def record(self, kind: str, **fields) -> Dict:
+        """Append one event. ``fields`` must be JSON-serializable (the
+        spill and the /events endpoint both emit JSON)."""
+        with self._lock:
+            self._seq += 1
+            ev = {"seq": self._seq, "ts": time.time(), "kind": kind}
+            ev.update(fields)
+            self._events.append(ev)
+            spill = self.spill_path
+        REGISTRY.counter("events_total").inc(kind=kind)
+        if spill:
+            try:
+                with open(spill, "a") as f:
+                    f.write(json.dumps(ev, default=str) + "\n")
+            except OSError:
+                pass  # spill is forensic, never load-bearing
+        return ev
+
+    def events(
+        self, kind: Optional[str] = None, limit: Optional[int] = None
+    ) -> List[Dict]:
+        """Newest-last snapshot, optionally filtered by kind."""
+        with self._lock:
+            out = list(self._events)
+        if kind is not None:
+            out = [e for e in out if e["kind"] == kind]
+        if limit is not None:
+            out = out[-limit:]
+        return out
+
+    def to_json(self, limit: Optional[int] = None) -> str:
+        return json.dumps({"events": self.events(limit=limit)}, default=str)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+
+# the process-default log (reference: the meta node's single event log)
+EVENT_LOG = EventLog()
+record = EVENT_LOG.record

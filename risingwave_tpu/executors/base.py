@@ -122,8 +122,13 @@ class Executor:
         if self._staged_scalars is None:
             return
         from risingwave_tpu.ops.hash_table import finish_scalars
+        from risingwave_tpu.trace import span
 
-        vals = finish_scalars(self._staged_scalars)
+        # the materialization below is the barrier's device fence: the
+        # span attributes per-executor device wait to the epoch trace
+        # (and leaves a frame on the live stack for stall dumps)
+        with span("executor.device_step", executor=type(self).__name__):
+            vals = finish_scalars(self._staged_scalars)
         self._staged_scalars = None
         self._on_barrier_scalars(vals)
 

@@ -231,7 +231,13 @@ class SqlSession:
         """Returns (result columns, command tag). Non-queries return an
         empty column dict."""
         with self.runtime.lock:
-            return self._execute_locked(sql)
+            out, tag = self._execute_locked(sql)
+        if tag.startswith(("CREATE_", "DROP_", "ALTER_")):
+            # meta event log: every DDL lands in cluster history
+            from risingwave_tpu.event_log import EVENT_LOG
+
+            EVENT_LOG.record("ddl", tag=tag, sql=sql.strip()[:200])
+        return out, tag
 
     def _execute_locked(self, sql: str) -> Tuple[Dict[str, np.ndarray], str]:
         stripped = sql.lstrip()

@@ -13,7 +13,7 @@ record shapes/capacities and host-side timings only.
 from __future__ import annotations
 
 import threading
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Dict, List, Tuple
 
 import numpy as np
@@ -40,21 +40,57 @@ class Counter:
 
 
 class Histogram:
-    def __init__(self, registry, name: str):
+    """Windowed histogram: quantiles come from a bounded per-label-set
+    reservoir (deque of the most recent ``window`` observations) while
+    ``_count``/``_sum`` stay exact monotonic totals — a long-running
+    node's memory no longer grows with every observation (previously an
+    unbounded list per label set)."""
+
+    DEFAULT_WINDOW = 4096
+
+    def __init__(self, registry, name: str, window: int = None):
         self.name = name
-        self._obs: Dict[_Labels, List[float]] = defaultdict(list)
+        self.window = window or self.DEFAULT_WINDOW
+        self._obs: Dict[_Labels, deque] = {}
+        self._count: Dict[_Labels, int] = defaultdict(int)
+        self._sum: Dict[_Labels, float] = defaultdict(float)
         self._lock = registry._lock
 
     def observe(self, value: float, **labels: str) -> None:
+        key = _labels(labels)
         with self._lock:
-            self._obs[_labels(labels)].append(value)
+            dq = self._obs.get(key)
+            if dq is None:
+                dq = self._obs[key] = deque(maxlen=self.window)
+            dq.append(value)
+            self._count[key] += 1
+            self._sum[key] += value
 
     def percentile(self, q: float, **labels: str) -> float:
         obs = self._obs.get(_labels(labels))
         return float(np.percentile(obs, q)) if obs else 0.0
 
     def count(self, **labels: str) -> int:
-        return len(self._obs.get(_labels(labels), ()))
+        return self._count.get(_labels(labels), 0)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """{label-string: {p50, p99, count, sum}} across every label
+        set — the bench's per-stage breakdown surface."""
+        with self._lock:
+            keys = list(self._obs)
+        out = {}
+        for key in keys:
+            obs = list(self._obs.get(key, ()))
+            if not obs:
+                continue
+            lbl = ",".join(f"{k}={v}" for k, v in key) or "-"
+            out[lbl] = {
+                "p50": round(float(np.percentile(obs, 50)), 3),
+                "p99": round(float(np.percentile(obs, 99)), 3),
+                "count": self._count.get(key, len(obs)),
+                "sum": round(self._sum.get(key, 0.0), 3),
+            }
+        return out
 
 
 class Gauge:
@@ -112,6 +148,7 @@ class MetricsRegistry:
             for labels, obs in sorted(h._obs.items()):
                 lbl = ",".join(f'{k}="{val}"' for k, val in labels)
                 base = f"{name}{{{lbl}}}" if lbl else name
+                win = list(obs)  # quantiles over the bounded window
                 for q in (0.5, 0.9, 0.99):
                     ql = (
                         f'{{{lbl},quantile="{q}"}}'
@@ -119,10 +156,11 @@ class MetricsRegistry:
                         else f'{{quantile="{q}"}}'
                     )
                     lines.append(
-                        f"{name}{ql} {float(np.percentile(obs, q * 100))}"
+                        f"{name}{ql} {float(np.percentile(win, q * 100))}"
                     )
-                lines.append(f"{base}_count {len(obs)}")
-                lines.append(f"{base}_sum {sum(obs)}")
+                # count/sum are exact totals (monotonic), not windowed
+                lines.append(f"{base}_count {h._count.get(labels, len(win))}")
+                lines.append(f"{base}_sum {h._sum.get(labels, sum(win))}")
         return "\n".join(lines) + "\n"
 
     def render_dashboard(self) -> str:
@@ -170,6 +208,23 @@ class MetricsRegistry:
             f"<td style='text-align:right'>{d['bytes']:,}</td></tr>"
             for d in utils_heap.device_state()[:40]
         )
+        # meta event log tail (reference: the dashboard's event log view)
+        from risingwave_tpu.event_log import EVENT_LOG
+
+        event_rows = "".join(
+            f"<tr><td>{e['seq']}</td><td>{escape(e['kind'])}</td>"
+            f"<td>{escape(', '.join(f'{k}={v}' for k, v in e.items() if k not in ('seq', 'ts', 'kind')))}</td></tr>"
+            for e in EVENT_LOG.events(limit=25)
+        )
+        # per-stage barrier attribution (EpochTrace -> barrier_stage_ms)
+        stage_rows = ""
+        h = self.histograms.get("barrier_stage_ms")
+        if h is not None:
+            stage_rows = "".join(
+                f"<tr><td>{escape(lbl)}</td><td>{s['p50']}</td>"
+                f"<td>{s['p99']}</td><td>{s['count']}</td></tr>"
+                for lbl, s in sorted(h.summary().items())
+            )
         return f"""<!doctype html><html><head><title>risingwave_tpu</title>
 <style>body{{font-family:monospace;margin:2em}}table{{border-collapse:collapse;margin:1em 0}}
 td,th{{border:1px solid #999;padding:2px 8px}}h2{{margin-top:1.5em}}</style></head><body>
@@ -177,7 +232,9 @@ td,th{{border:1px solid #999;padding:2px 8px}}h2{{margin-top:1.5em}}</style></he
 <h2>runtime</h2><table>{''.join(rows) or '<tr><td>no runtime attached</td></tr>'}</table>
 <h2>fragments &rarr; subscribers</h2><table>{frag_rows or '<tr><td>none</td></tr>'}</table>
 <h2>device state (top 40)</h2><table><tr><th>executor</th><th>table</th><th>bytes</th></tr>{state_rows}</table>
-<p><a href="/metrics">/metrics</a> &middot; <a href="/heap">/heap</a></p>
+<h2>barrier stages (ms)</h2><table><tr><th>stage</th><th>p50</th><th>p99</th><th>n</th></tr>{stage_rows or '<tr><td>no barriers traced</td></tr>'}</table>
+<h2>events (last 25)</h2><table><tr><th>#</th><th>kind</th><th>detail</th></tr>{event_rows or '<tr><td>none</td></tr>'}</table>
+<p><a href="/metrics">/metrics</a> &middot; <a href="/heap">/heap</a> &middot; <a href="/events">/events</a></p>
 </body></html>"""
 
     def serve(self, port: int = 0) -> int:
@@ -198,6 +255,18 @@ td,th{{border:1px solid #999;padding:2px 8px}}h2{{margin-top:1.5em}}</style></he
                     body = utils_heap.render().encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path == "/events":
+                    # meta event log (reference: risectl meta event-log
+                    # / the dashboard's event view) as JSON
+                    from risingwave_tpu.event_log import EVENT_LOG
+
+                    body = EVENT_LOG.to_json().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)

@@ -61,6 +61,11 @@ class ScaleController:
             new._epoch = old._epoch
             rt.fragments[fragment] = new
             self.reschedules += 1
+            from risingwave_tpu.event_log import EVENT_LOG
+
+            EVENT_LOG.record(
+                "scale", fragment=fragment, reschedules=self.reschedules
+            )
             return new
 
     def autoscale(

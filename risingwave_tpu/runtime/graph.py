@@ -39,7 +39,9 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from risingwave_tpu import utils_sync_point as sync_point
 from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.epoch_trace import record_stage
 from risingwave_tpu.executors.base import Barrier, Epoch, Executor, Watermark
 from risingwave_tpu.ops.hashing import VNODE_COUNT, hash_columns
 from risingwave_tpu.runtime.pipeline import _walk_watermark, walk_chain
@@ -298,6 +300,34 @@ class FragmentActor(threading.Thread):
         self._emit(self._through(self.tail, outs))
 
     def _process_barrier(self, b: Barrier) -> None:
+        # stall-injection site for tests (and the q7-wedge forensic
+        # path): a delay here holds THIS actor's collection back while
+        # the rest of the graph reaches the barrier
+        sync_point.hit(f"actor_barrier:{self.actor_name}")
+        import time as _time
+
+        t0 = _time.perf_counter()
+        self._process_barrier_inner(b)
+        t1 = _time.perf_counter()
+        # flush + emit happened above; finish_barrier below is the
+        # barrier-only device fence (staged-scalar materialization)
+        for ex in self.executors:
+            ex.finish_barrier()
+        t2 = _time.perf_counter()
+        record_stage("dispatch", (t1 - t0) * 1e3, fragment=self.actor_name)
+        record_stage("device_step", (t2 - t1) * 1e3, fragment=self.actor_name)
+        if b.checkpoint and self.mgr.capture_deltas:
+            # pipelined barriers: seal this epoch's delta NOW, before
+            # any next-epoch chunk in the input queue mutates state
+            # (shared-buffer seal; uploader.rs:548 overlap analogue)
+            for ex in self.executors:
+                cap = getattr(ex, "capture_checkpoint", None)
+                if cap is not None:
+                    cap()
+        self.dispatcher.control(BARRIER, b)
+        self.mgr._collect(self.actor_name, b)
+
+    def _process_barrier_inner(self, b: Barrier) -> None:
         # watermarks generated behind the barrier are sent AFTER the
         # flushed data chunks: channels are FIFO, so sending the
         # watermark first would let it overtake the very rows it covers
@@ -327,18 +357,6 @@ class FragmentActor(threading.Thread):
             self._emit(outs + gen)
         for wm in wms:
             self._send_watermark_downstream(wm)
-        for ex in self.executors:
-            ex.finish_barrier()
-        if b.checkpoint and self.mgr.capture_deltas:
-            # pipelined barriers: seal this epoch's delta NOW, before
-            # any next-epoch chunk in the input queue mutates state
-            # (shared-buffer seal; uploader.rs:548 overlap analogue)
-            for ex in self.executors:
-                cap = getattr(ex, "capture_checkpoint", None)
-                if cap is not None:
-                    cap()
-        self.dispatcher.control(BARRIER, b)
-        self.mgr._collect(self.actor_name, b)
 
     def _generated_watermarks_join(self):
         """Poll emit_watermark across a two-input fragment's chains
@@ -557,6 +575,9 @@ class GraphRuntime:
         self._source_channels: Dict[str, List[PermitChannel]] = {}
         self._collect_lock = threading.Condition()
         self._collected: Dict[int, set] = {}
+        # last epoch each actor fully collected (stall-dump attribution:
+        # the actor whose last epoch lags is the stuck one)
+        self._last_collected: Dict[str, int] = {}
         self._failure: Optional[BaseException] = None
         self._epoch = 0
         self._source_rr: Dict[str, int] = {}
@@ -736,10 +757,25 @@ class GraphRuntime:
                 if self._failure is not None:
                     raise RuntimeError("actor failed") from self._failure
                 if not ok:
+                    got = self._collected.get(epoch, set())
+                    stuck = sorted(
+                        a.actor_name
+                        for a in self.actors
+                        if a.actor_name not in got
+                    )
+                    # forensic artifact BEFORE the epoch is abandoned
+                    # (the q7 wedge left zero diagnostics without this)
+                    from risingwave_tpu.epoch_trace import dump_stalls
+
+                    dump_stalls(
+                        f"barrier {epoch} timed out after {timeout}s; "
+                        f"stuck actors: {stuck}",
+                        graph=self,
+                    )
                     raise TimeoutError(
                         f"barrier {epoch} not collected: "
-                        f"{len(self._collected.get(epoch, ()))}"
-                        f"/{len(self.actors)} actors"
+                        f"{len(got)}/{len(self.actors)} actors "
+                        f"(stuck: {', '.join(stuck)})"
                     )
             finally:
                 self._collected.pop(epoch, None)
@@ -780,6 +816,40 @@ class GraphRuntime:
     def drain(self, name: str) -> List[StreamChunk]:
         return self.collectors[name].drain()
 
+    def stall_snapshot(self) -> Dict[str, object]:
+        """Forensic view for dump_stalls: per-actor liveness, input
+        permit-channel depths, last-collected epoch, and which actors
+        every pending epoch is still waiting on (the await-tree dump's
+        actor table). Cheap and lock-safe — called while wedged."""
+        with self._collect_lock:
+            pending = {e: set(s) for e, s in self._collected.items()}
+            last = dict(self._last_collected)
+            failure = repr(self._failure) if self._failure else None
+        actors = []
+        for a in self.actors:
+            actors.append(
+                {
+                    "actor": a.actor_name,
+                    "alive": a.is_alive(),
+                    "last_collected_epoch": last.get(a.actor_name, 0),
+                    "input_depths": [len(ch) for _p, ch in a.inputs],
+                    "error": repr(a.error) if a.error else None,
+                }
+            )
+        names = [a.actor_name for a in self.actors]
+        return {
+            "epoch": self._epoch,
+            "failure": failure,
+            "actors": actors,
+            "epochs_pending": {
+                str(e): {
+                    "collected": sorted(got),
+                    "stuck": sorted(n for n in names if n not in got),
+                }
+                for e, got in pending.items()
+            },
+        }
+
     @property
     def executors(self) -> List[Executor]:
         out = []
@@ -790,6 +860,9 @@ class GraphRuntime:
     # -- actor callbacks --------------------------------------------------
     def _collect(self, actor_name: str, b: Barrier) -> None:
         with self._collect_lock:
+            self._last_collected[actor_name] = max(
+                self._last_collected.get(actor_name, 0), b.epoch.curr
+            )
             # stragglers from an abandoned (timed-out) epoch are dropped,
             # not re-registered — only live epochs have an entry
             if b.epoch.curr in self._collected:

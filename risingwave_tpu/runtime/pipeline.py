@@ -67,6 +67,7 @@ class Pipeline:
             else max(int(time.time() * 1000) << 16, prev + 1)
         )
         b = Barrier(Epoch(prev, self._epoch), checkpoint)
+        t0 = time.perf_counter()
         pending: List[StreamChunk] = []
         for i, ex in enumerate(self.executors):
             nxt: List[StreamChunk] = []
@@ -81,11 +82,19 @@ class Pipeline:
             if wm is not None:
                 _, outs = _walk_watermark(self.executors[i + 1 :], wm)
                 pending.extend(outs)
+        t1 = time.perf_counter()
         # materialize every executor's staged barrier scalars AFTER the
         # walk: the async transfers overlapped, so the chain pays ~one
         # round-trip; raises still precede the runtime's epoch commit
         for ex in self.executors:
             ex.finish_barrier()
+        # stage attribution (EpochTrace lifecycle): the walk is host
+        # dispatch; the scalar materialization is the barrier-only
+        # device fence
+        from risingwave_tpu.epoch_trace import record_stage
+
+        record_stage("dispatch", (t1 - t0) * 1e3)
+        record_stage("device_step", (time.perf_counter() - t1) * 1e3)
         return pending
 
     def watermark(self, column: str, value: int) -> List[StreamChunk]:
@@ -163,6 +172,7 @@ class TwoInputPipeline:
             else max(int(time.time() * 1000) << 16, prev + 1)
         )
         b = Barrier(Epoch(prev, self._epoch), checkpoint)
+        t0 = time.perf_counter()
         joined: List[StreamChunk] = []
         for c in self._through(self.left, [], barrier=b):
             joined.extend(self.join.apply_left(c))
@@ -171,8 +181,13 @@ class TwoInputPipeline:
         joined.extend(self.join.on_barrier(b))
         outs = self._through(self.tail, joined, barrier=b)
         outs.extend(self._generated_watermarks())
+        t1 = time.perf_counter()
         for ex in self.executors:
             ex.finish_barrier()
+        from risingwave_tpu.epoch_trace import record_stage
+
+        record_stage("dispatch", (t1 - t0) * 1e3)
+        record_stage("device_step", (time.perf_counter() - t1) * 1e3)
         return outs
 
     def _generated_watermarks(self) -> List[StreamChunk]:

@@ -31,6 +31,8 @@ import numpy as np
 
 from risingwave_tpu import utils_sync_point as sync_point
 from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.epoch_trace import EpochTrace, chunk_nbytes, dump_stalls
+from risingwave_tpu.event_log import EVENT_LOG
 from risingwave_tpu.metrics import REGISTRY
 from risingwave_tpu.trace import span
 from risingwave_tpu.storage.object_store import ObjectStore
@@ -142,6 +144,35 @@ class StreamingRuntime:
         # (the CLI's tick thread vs pgwire sessions — the reference
         # serializes via the meta barrier scheduler's command queue)
         self.lock = threading.RLock()
+        # -- barrier-lifecycle observability (EpochTrace) ---------------
+        # every barrier gets a stage-attributed trace; the ring keeps
+        # the recent history for /events-style inspection and bench
+        self.epoch_traces: deque = deque(maxlen=256)
+        self.last_epoch_trace: Optional[EpochTrace] = None
+        self._traces_by_epoch: Dict[int, EpochTrace] = {}
+        self._ingest_s = 0.0  # host time in push() since last barrier
+        self._ingest_bytes = 0  # chunk bytes moved since last barrier
+        self._prev_state_bytes = 0
+        # stall watchdog: if a barrier exceeds this deadline, dump every
+        # actor's span stack + channel depths BEFORE recovery destroys
+        # the evidence (the q7 wedge forensic path). None disables.
+        # Default rides just under the barrier deadman
+        # (RW_BARRIER_TIMEOUT_S, which device benches raise to cover
+        # first-epoch XLA compiles) so a legitimately-compiling barrier
+        # never writes a false stall artifact.
+        import os
+
+        from risingwave_tpu.runtime.graph import _default_barrier_timeout
+
+        try:
+            self.stall_dump_after_s: Optional[float] = float(
+                os.environ.get(
+                    "RW_STALL_DUMP_S",
+                    max(60.0, 0.9 * _default_barrier_timeout()),
+                )
+            )
+        except ValueError:
+            self.stall_dump_after_s = 0.9 * _default_barrier_timeout()
 
     # -- fragments -------------------------------------------------------
     def register(
@@ -306,9 +337,14 @@ class StreamingRuntime:
         """Feed one chunk into a fragment and route its emitted deltas
         into every subscribed downstream fragment (the exchange edge an
         MV-on-MV chain rides)."""
+        t0 = time.perf_counter()
         outs = self._push_into(name, chunk, side)
         REGISTRY.counter("chunks_pushed_total").inc(fragment=name)
         self._route(name, outs)
+        # ingest attribution: the next barrier's EpochTrace charges this
+        # host time + chunk bytes to its "ingest" stage
+        self._ingest_s += time.perf_counter() - t0
+        self._ingest_bytes += chunk_nbytes(chunk)
         return outs
 
     def _route(self, upstream: str, chunks) -> None:
@@ -353,6 +389,7 @@ class StreamingRuntime:
         the failed epoch is abandoned, offsets roll back, and the
         caller's next pump replays it (no manual recover())."""
         with self.lock:
+            watchdog = self._arm_stall_watchdog()
             try:
                 outs = self._barrier_locked()
                 self._consecutive_recoveries = 0
@@ -372,6 +409,31 @@ class StreamingRuntime:
                     raise
                 self._auto_recover(e)
                 return {}
+            finally:
+                if watchdog is not None:
+                    watchdog.cancel()
+
+    def _arm_stall_watchdog(self) -> Optional[threading.Timer]:
+        """Fire a stall dump if the barrier outlives its deadline — the
+        artifact lands while the barrier is STILL stuck, before any
+        recovery/abandonment destroys the evidence (q7 wedge case)."""
+        if self.stall_dump_after_s is None or self.stall_dump_after_s <= 0:
+            return None
+        epoch_at_arm = self._epoch
+
+        def _fire() -> None:
+            dump_stalls(
+                f"barrier after epoch {epoch_at_arm} exceeded "
+                f"{self.stall_dump_after_s}s deadline",
+                runtime=self,
+            )
+
+        # one Timer thread per barrier: ~100µs against a >=100ms barrier
+        # cadence (barrier_interval_ms); canceled timers exit promptly
+        t = threading.Timer(self.stall_dump_after_s, _fire)
+        t.daemon = True
+        t.start()
+        return t
 
     def _auto_recover(self, cause: Exception) -> None:
         # a DETERMINISTIC failure (e.g. a capacity overflow) would
@@ -383,6 +445,12 @@ class StreamingRuntime:
         self.last_failure = cause
         REGISTRY.counter("auto_recoveries_total").inc()
         self.auto_recoveries += 1
+        EVENT_LOG.record(
+            "recovery",
+            mode="auto",
+            cause=repr(cause),
+            consecutive=self._consecutive_recoveries,
+        )
         # a latched sharded-capacity overflow is DETERMINISTIC at the
         # old shape but curable: grow the overflowed op 2x before the
         # replay (the reference reschedules with more parallelism,
@@ -454,6 +522,7 @@ class StreamingRuntime:
             self.mgr is not None
             and self._barrier_seq % self.checkpoint_frequency == 0
         )
+        tr = self._begin_trace(is_ckpt)
         for _name, p in self.fragments.items():
             p._epoch = prev
             p.barrier_nowait(checkpoint=is_ckpt, epoch=self._epoch)
@@ -467,6 +536,9 @@ class StreamingRuntime:
                 or bool(self._closer_err)
             )
         self._raise_closer_error()
+        # the trace is NOT finalized here: admission wall time would
+        # inflate achieved_bw to nonsense — the closer lane finalizes
+        # it once the epoch actually closed (commit stages land later)
         ms = (time.perf_counter() - t0) * 1e3
         self.barrier_latencies_ms.append(ms)  # ADMISSION latency
         REGISTRY.histogram("barrier_latency_ms").observe(ms)
@@ -490,9 +562,15 @@ class StreamingRuntime:
                 epoch, is_ckpt, t_adm = self._closer_q[0]
             try:
                 if not self._closer_err and not self._closer_abort.is_set():
+                    tr = self._traces_by_epoch.get(epoch)
+                    t_close = time.perf_counter()
                     for name, p in self.fragments.items():
                         with span("barrier.close", fragment=name):
                             p.wait_barrier(epoch)
+                    if tr is not None:
+                        tr.add_stage(
+                            "close", (time.perf_counter() - t_close) * 1e3
+                        )
                     if is_ckpt:
                         # deltas were SEALED by the actors at the
                         # barrier (capture_checkpoint): stage consumes
@@ -500,12 +578,21 @@ class StreamingRuntime:
                         t_staged = time.perf_counter()
                         with span("checkpoint.stage", epoch=epoch):
                             staged = self.mgr.stage(self.executors())
+                        if tr is not None:
+                            tr.add_stage(
+                                "checkpoint_stage",
+                                (time.perf_counter() - t_staged) * 1e3,
+                            )
                         REGISTRY.counter("checkpoints_total").inc()
                         with self._inflight_lock:
                             self._inflight += 1
-                        self._work_q.append((epoch, staged, t_staged))
+                        self._work_q.append((epoch, staged, t_staged, tr))
                         self._ensure_worker()
                         self._work_event.set()
+                    if tr is not None:
+                        # finalize over admission->closed (the epoch's
+                        # real span), not admission-only wall time
+                        self._end_trace(tr)
                     self.epoch_close_ms.append(
                         (time.perf_counter() - t_adm) * 1e3
                     )
@@ -546,6 +633,7 @@ class StreamingRuntime:
             self.mgr is not None
             and self._barrier_seq % self.checkpoint_frequency == 0
         )
+        tr = self._begin_trace(is_ckpt)
         outs = {}
         # registration order is topological (downstreams register after
         # their upstream), so an upstream's barrier-flush deltas reach a
@@ -556,18 +644,50 @@ class StreamingRuntime:
             # once: sink commits may never run ahead of durability);
             # the runtime's epoch is passed down so held sink batches
             # key by the exact epoch _commit/_on_epoch_durable will use
+            tf = time.perf_counter()
             with span("barrier.fragment", fragment=name):
                 outs[name] = p.barrier(checkpoint=is_ckpt, epoch=self._epoch)
             self._route(name, outs[name])
+            tr.add_stage(
+                "dispatch", (time.perf_counter() - tf) * 1e3, fragment=name
+            )
         if is_ckpt:
-            self._commit(self._epoch)
+            self._commit(self._epoch, tr)
         if self.memory_budget_bytes is not None:
             self._enforce_memory_budget()
+        self._end_trace(tr)
         ms = (time.perf_counter() - t0) * 1e3
         self.barrier_latencies_ms.append(ms)
         REGISTRY.histogram("barrier_latency_ms").observe(ms)
         REGISTRY.counter("barriers_total").inc()
         return outs
+
+    # -- EpochTrace plumbing ---------------------------------------------
+    def _begin_trace(self, is_ckpt: bool) -> EpochTrace:
+        tr = EpochTrace(self._epoch, self._barrier_seq, is_ckpt)
+        # charge accumulated push() time/bytes to this epoch's ingest
+        tr.add_stage("ingest", self._ingest_s * 1e3)
+        tr.chunk_bytes = self._ingest_bytes
+        self._ingest_s, self._ingest_bytes = 0.0, 0
+        self._traces_by_epoch[tr.epoch] = tr
+        # bound the pending map (async commits resolve FIFO)
+        while len(self._traces_by_epoch) > 512:
+            self._traces_by_epoch.pop(next(iter(self._traces_by_epoch)))
+        return tr
+
+    def _end_trace(self, tr: EpochTrace) -> None:
+        state_bytes = self.state_nbytes()
+        tr.finalize(state_bytes, self._prev_state_bytes)
+        self._prev_state_bytes = state_bytes
+        self.epoch_traces.append(tr)
+        self.last_epoch_trace = tr
+        if tr.checkpoint:
+            EVENT_LOG.record(
+                "barrier_commit",
+                epoch=tr.epoch,
+                wall_ms=round(tr.wall_ms, 2),
+                achieved_bw_frac=tr.achieved_bw_frac,
+            )
 
     def state_nbytes(self) -> int:
         """Accounted device state across all fragments (host estimate)."""
@@ -618,7 +738,7 @@ class StreamingRuntime:
         return float(np.percentile(self.barrier_latencies_ms, 99))
 
     # -- checkpoint lane -------------------------------------------------
-    def _commit(self, epoch: int) -> None:
+    def _commit(self, epoch: int, tr: Optional[EpochTrace] = None) -> None:
         self._raise_worker_error()
         # stage on the main thread (device pull + eager mark flips, with
         # the duplicate-table_id check) — ONE code path with the sync
@@ -626,10 +746,14 @@ class StreamingRuntime:
         t_staged = time.perf_counter()
         with span("checkpoint.stage"):
             staged = self.mgr.stage(self.executors())
+        if tr is not None:
+            tr.add_stage(
+                "checkpoint_stage", (time.perf_counter() - t_staged) * 1e3
+            )
         REGISTRY.counter("checkpoints_total").inc()
         REGISTRY.gauge("checkpoint_staged_tables").set(len(staged))
         if not self.async_checkpoint:
-            self.mgr.commit_staged(epoch, staged)
+            self.mgr.commit_staged(epoch, staged, trace=tr)
             self.checkpoint_sync_ms.append(
                 (time.perf_counter() - t_staged) * 1e3
             )
@@ -638,7 +762,7 @@ class StreamingRuntime:
             return
         with self._inflight_lock:
             self._inflight += 1
-        self._work_q.append((epoch, staged, t_staged))
+        self._work_q.append((epoch, staged, t_staged, tr))
         self._ensure_worker()
         self._work_event.set()
 
@@ -654,7 +778,7 @@ class StreamingRuntime:
             self._work_event.wait(timeout=0.5)
             self._work_event.clear()
             while self._work_q:
-                epoch, staged, t_staged = self._work_q.popleft()
+                epoch, staged, t_staged, tr = self._work_q.popleft()
                 try:
                     if self._work_err or self._work_abort.is_set():
                         # a prior epoch failed to commit (or recovery is
@@ -666,7 +790,7 @@ class StreamingRuntime:
                         continue
                     # single-worker FIFO queue -> epoch order holds
                     with span("checkpoint.commit", epoch=epoch):
-                        self.mgr.commit_staged(epoch, staged)
+                        self.mgr.commit_staged(epoch, staged, trace=tr)
                     self.checkpoint_sync_ms.append(
                         (time.perf_counter() - t_staged) * 1e3
                     )
@@ -824,3 +948,4 @@ class StreamingRuntime:
             fn = getattr(ex, "on_recover", None)
             if fn is not None:
                 fn(self._epoch)
+        EVENT_LOG.record("recovery", mode="restore", epoch=self._epoch)

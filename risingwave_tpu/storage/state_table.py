@@ -295,16 +295,27 @@ class CheckpointManager:
                 staged.append(delta)
         return staged
 
-    def commit_staged(self, epoch: int, staged: Sequence[StateDelta]) -> int:
+    def commit_staged(
+        self,
+        epoch: int,
+        staged: Sequence[StateDelta],
+        trace=None,
+    ) -> int:
         """Build + upload SSTs for a staged epoch, then commit the
         manifest. The single commit implementation behind both the sync
-        path and the runtime's async worker. Returns SSTs written."""
+        path and the runtime's async worker. Returns SSTs written.
+        ``trace`` (an EpochTrace) receives the upload / manifest_commit
+        stage attribution; without one the stages still land in the
+        ``barrier_stage_ms`` histogram."""
+        import time as _time
+
         with self._lock:
             if epoch <= int(self.version["max_committed_epoch"]):
                 raise ValueError(
                     f"epoch {epoch} <= committed "
                     f"{self.version['max_committed_epoch']}"
                 )
+        t_upload = _time.perf_counter()
         n = 0
         new_entries = []  # (table_id, entry) — registered under lock below
         for delta in staged:
@@ -326,10 +337,12 @@ class CheckpointManager:
             n += 1
         from risingwave_tpu import utils_sync_point as sync_point
 
+        upload_ms = (_time.perf_counter() - t_upload) * 1e3
         # SSTs are uploaded but the manifest is NOT yet written: the
         # classic crash window (recovery must land on the previous
         # epoch); tests inject crashes here (utils_sync_point)
         sync_point.hit("before_manifest_commit")
+        t_manifest = _time.perf_counter()
         with self._lock:
             # re-validate under the lock: a concurrent commit may have
             # advanced the epoch while our SSTs uploaded; publishing
@@ -356,6 +369,15 @@ class CheckpointManager:
                 self._pending_watermarks = {}
             self._persist_version()
         sync_point.hit("after_manifest_commit")
+        manifest_ms = (_time.perf_counter() - t_manifest) * 1e3
+        if trace is not None:
+            trace.add_stage("upload", upload_ms)
+            trace.add_stage("manifest_commit", manifest_ms)
+        else:
+            from risingwave_tpu.epoch_trace import record_stage
+
+            record_stage("upload", upload_ms)
+            record_stage("manifest_commit", manifest_ms)
         return n
 
     def commit_epoch(self, epoch: int, executors: Sequence[object]) -> int:

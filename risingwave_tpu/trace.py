@@ -21,6 +21,31 @@ from risingwave_tpu.metrics import REGISTRY
 
 _MAX_EVENTS = 65_536
 
+# live span stacks per thread (the await-tree analogue: the reference
+# dumps every actor's pending await tree on stall; here every thread's
+# currently-open span stack is snapshotable via active_spans())
+_ACTIVE_LOCK = threading.Lock()
+_ACTIVE: dict = {}  # tid -> (thread_name, [ {span, t0, args}, ... ])
+
+
+def active_spans() -> dict:
+    """Snapshot every thread's currently-open span stack — what each
+    actor/worker is doing RIGHT NOW (outermost first), with elapsed
+    seconds. The stall-dump surface (reference: await-tree dumps)."""
+    now = time.perf_counter()
+    out = {}
+    with _ACTIVE_LOCK:
+        for tid, (tname, stack) in _ACTIVE.items():
+            out[f"{tname}({tid})"] = [
+                {
+                    "span": fr["span"],
+                    "elapsed_s": round(now - fr["t0"], 4),
+                    **({"args": fr["args"]} if fr["args"] else {}),
+                }
+                for fr in stack
+            ]
+    return out
+
 
 class Tracer:
     def __init__(self, max_events: int = _MAX_EVENTS):
@@ -34,15 +59,29 @@ class Tracer:
             yield
             return
         t0 = time.perf_counter()
+        tid = threading.get_ident()
+        frame = {"span": name, "t0": t0, "args": args or None}
+        with _ACTIVE_LOCK:
+            if tid not in _ACTIVE:
+                _ACTIVE[tid] = (threading.current_thread().name, [])
+            _ACTIVE[tid][1].append(frame)
         try:
             yield
         finally:
             dur = time.perf_counter() - t0
+            with _ACTIVE_LOCK:
+                entry = _ACTIVE.get(tid)
+                if entry is not None:
+                    stack = entry[1]
+                    if frame in stack:
+                        stack.remove(frame)
+                    if not stack:
+                        del _ACTIVE[tid]
             with self._lock:
                 self._events.append(
                     (
                         name,
-                        threading.get_ident(),
+                        tid,
                         t0,
                         dur,
                         args or None,

@@ -65,6 +65,51 @@ def probe_once(timeout_s: int = 90) -> tuple[bool, float, str]:
         return False, time.monotonic() - t0, "hang (SIGTERMed)"
 
 
+def dump_stalls(dt: float, detail: str) -> str:
+    """Probe found the tunnel dead: leave a forensic JSON artifact
+    (the monitor-side half of the stall-dump story — the in-process
+    half is risingwave_tpu.epoch_trace.dump_stalls). Captures the probe
+    result, the recent probe history, and whatever is known about the
+    client that may be wedging the single-client tunnel."""
+    import json
+
+    doc = {
+        "reason": f"device probe failed after {dt:.1f}s: {detail}",
+        "ts": time.time(),
+        "marker_present": os.path.exists(MARKER),
+        "bench_running": None,
+        "probe_log_tail": [],
+    }
+    if os.path.exists(BUSY):
+        try:
+            with open(BUSY) as f:
+                pid = int(f.read().strip() or "0")
+            info = {"pid": pid}
+            try:  # is the bench client alive, and what is it running?
+                with open(f"/proc/{pid}/cmdline", "rb") as f:
+                    info["cmdline"] = (
+                        f.read().replace(b"\0", b" ").decode().strip()
+                    )
+                info["alive"] = True
+            except OSError:
+                info["alive"] = False  # stale marker: client died
+            doc["bench_running"] = info
+        except (OSError, ValueError):
+            pass
+    try:
+        with open(LOG) as f:
+            doc["probe_log_tail"] = f.readlines()[-20:]
+    except OSError:
+        pass
+    path = os.path.join(REPO, f"STALL_DUMP_probe_{int(time.time())}.json")
+    try:
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1)
+    except OSError:
+        return ""
+    return path
+
+
 def log_line(ok: bool, dt: float, detail: str) -> None:
     stamp = datetime.datetime.now(datetime.timezone.utc).isoformat(
         timespec="seconds"
@@ -99,6 +144,10 @@ def main() -> None:
         else:
             ok, dt, detail = probe_once(args.timeout)
             log_line(ok, dt, detail)
+            if not ok:
+                path = dump_stalls(dt, detail)
+                if path:
+                    print(f"probe: stall dump -> {path}", flush=True)
             print(
                 f"probe: {'OK' if ok else 'DEAD'} ({dt:.1f}s) {detail}",
                 flush=True,

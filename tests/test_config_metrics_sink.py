@@ -137,6 +137,77 @@ def test_metrics_gauge_and_http_exposition():
         reg.shutdown()
 
 
+def test_events_endpoint_and_bounded_histogram():
+    import urllib.request
+
+    from risingwave_tpu.event_log import EVENT_LOG
+    from risingwave_tpu.metrics import REGISTRY
+
+    EVENT_LOG.clear()
+    EVENT_LOG.record("ddl", tag="CREATE_TABLE", sql="CREATE TABLE t (...)")
+    EVENT_LOG.record("recovery", mode="auto", cause="Boom()")
+    port = REGISTRY.serve(0)
+    try:
+        doc = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/events", timeout=5
+            ).read().decode()
+        )
+        kinds = [e["kind"] for e in doc["events"]]
+        assert kinds[-2:] == ["ddl", "recovery"]
+        assert doc["events"][-2]["tag"] == "CREATE_TABLE"
+        # the dashboard renders the same history
+        html = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/dashboard", timeout=5
+        ).read().decode()
+        assert "/events" in html and "recovery" in html
+    finally:
+        REGISTRY.shutdown()
+
+    # Histogram memory is bounded: quantiles window, totals stay exact
+    reg = MetricsRegistry()
+    h = reg.histogram("long_run_ms")
+    for i in range(3 * h.window):
+        h.observe(float(i), stage="upload")
+    key = (("stage", "upload"),)
+    assert len(h._obs[key]) == h.window
+    assert h.count(stage="upload") == 3 * h.window
+    assert f'long_run_ms{{stage="upload"}}_count {3 * h.window}' in reg.render()
+    # the window sees only the newest observations
+    assert h.percentile(0, stage="upload") >= float(2 * h.window)
+
+
+def test_roofline_fields_and_stage_breakdown():
+    """The bench JSON contract: achieved_bw_frac is a measured
+    fraction of a configured chip peak, and barrier_stage_ms carries a
+    per-stage breakdown once barriers ran."""
+    import os
+
+    from risingwave_tpu.epoch_trace import (
+        hbm_peak_gbps,
+        record_stage,
+        roofline,
+        stage_breakdown,
+    )
+
+    rf = roofline(10 * 10**9, 1.0, platform="cpu")
+    assert rf["achieved_bw_gbps"] == 10.0
+    assert 0.0 < rf["achieved_bw_frac"] <= 1.0
+    assert rf["achieved_bw_frac"] == round(10.0 / rf["hbm_peak_gbps"], 6)
+    assert roofline(0, 0.0)["achieved_bw_frac"] == 0.0
+    os.environ["RW_HBM_PEAK_GBPS"] = "123.0"
+    try:
+        assert hbm_peak_gbps("tpu") == 123.0
+    finally:
+        del os.environ["RW_HBM_PEAK_GBPS"]
+
+    record_stage("manifest_commit", 2.0)
+    bd = stage_breakdown()
+    assert any("stage=manifest_commit" in k for k in bd)
+    row = next(v for k, v in bd.items() if "stage=manifest_commit" in k)
+    assert {"p50", "p99", "count", "sum"} <= set(row)
+
+
 def test_tracer_spans_and_chrome_export(tmp_path):
     import json
 

@@ -51,6 +51,7 @@ def _hot_chunks(rng, n_rows, hot_key=7):
     return per_shard
 
 
+@pytest.mark.slow
 def test_hot_key_overflow_heals_via_growth(mesh):
     """bucket_cap=8 cannot absorb a 64-row single-key epoch; the
     watchdog must double capacities until the replay commits, with the
@@ -107,6 +108,7 @@ def test_hot_key_overflow_heals_via_growth(mesh):
     assert got == {7: (want_sum2, 96)}
 
 
+@pytest.mark.slow
 def test_dedup_overflow_heals_and_keeps_exactness(mesh):
     """ShardedDedup with a tiny exchange bucket: the hot epoch heals by
     growth and the first-seen semantics stay exact across the replay

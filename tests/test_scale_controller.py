@@ -56,6 +56,7 @@ def _gens(n):
     ]
 
 
+@pytest.mark.slow
 def test_reschedule_4_to_8_shards_exact():
     """Epochs at 4 shards -> online reschedule to 8 -> more epochs:
     output matches an unrescheduled single-chip twin throughout."""

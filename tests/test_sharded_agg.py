@@ -191,6 +191,7 @@ def test_sharded_agg_nullable_group_key(mesh):
     assert got == want
 
 
+@pytest.mark.slow
 def test_sharded_agg_checkpoint_restore_across_mesh_sizes(mesh):
     """Kill-recover the sharded agg, restoring onto a DIFFERENT mesh
     size (vnode remap; VERDICT r2 #6) — continued output matches an
@@ -270,6 +271,7 @@ def test_sharded_agg_checkpoint_restore_across_mesh_sizes(mesh):
     assert snap_sharded == snap_single
 
 
+@pytest.mark.slow
 def test_sharded_agg_grows(mesh):
     """Per-shard rehash: tiny initial capacity must grow instead of
     latching dropped."""

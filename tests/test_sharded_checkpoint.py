@@ -97,6 +97,7 @@ def _oracle(epochs):
 
 
 @pytest.mark.parametrize("recover_shards", [N, 4])
+@pytest.mark.slow
 def test_sharded_q8_kill_and_recover_midstream(recover_shards):
     """Run 2 epochs sharded, checkpoint, KILL, rebuild (possibly on a
     smaller mesh), recover, run 2 more epochs — final MV must equal an
@@ -129,6 +130,7 @@ def test_sharded_q8_kill_and_recover_midstream(recover_shards):
     assert mview2.snapshot() == want
 
 
+@pytest.mark.slow
 def test_sharded_join_checkpoint_restores_into_single_chip():
     """Lane-naming compatibility: a sharded join's checkpoint restores
     into a single-chip HashJoinExecutor (and the stream continues with

@@ -85,6 +85,7 @@ P_DT = {"id": jnp.int64, "name": jnp.int32, "starttime": jnp.int64}
 A_DT = {"seller": jnp.int64, "astarttime": jnp.int64}
 
 
+@pytest.mark.slow
 def test_sharded_q8_matches_single_chip():
     mesh = make_mesh(N)
     sd_p = ShardedDedup(

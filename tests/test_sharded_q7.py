@@ -15,6 +15,11 @@ e2e_test/nexmark/.
 
 import pytest
 
+# ~2 min of virtual-mesh compile+replay: deeper-tier only (the tier-1
+# budget keeps the cheap sharded parity tests; q7's coverage here is
+# the kill/recover + parity pair, still run by plain `pytest`)
+pytestmark = pytest.mark.slow
+
 from risingwave_tpu.connectors.nexmark import (
     BID_SCHEMA,
     NexmarkConfig,

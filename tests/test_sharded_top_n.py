@@ -107,6 +107,7 @@ def test_sharded_group_top_n_matches_single_chip(mesh):
     assert len(s_snap) > 5
 
 
+@pytest.mark.slow
 def test_sharded_group_top_n_checkpoint_cross_layout(mesh):
     store = MemObjectStore()
     mgr = CheckpointManager(store)

@@ -188,6 +188,7 @@ def test_graph_restore_across_parallelism(catalog):
         graph2.pipeline.close()
 
 
+@pytest.mark.slow
 def test_sharded_mode_single_input_matches_serial(catalog):
     """The SAME q5 SQL on the sharded (multi-chip) fragment mode: one
     actor, state stacked over an 8-device mesh, on-device vnode
@@ -209,6 +210,7 @@ def test_sharded_mode_single_input_matches_serial(catalog):
         sharded.pipeline.close()
 
 
+@pytest.mark.slow
 def test_sharded_mode_join_matches_serial(catalog):
     """q8 SQL in sharded mode: sharded dedups feed a sharded join
     on-device (stacked chunks end to end), flattened only at the MV."""
