@@ -15,14 +15,26 @@ import os
 import socket
 import subprocess
 import sys
-import time
 from typing import Dict, List, Optional, Tuple
 
 from risingwave_tpu.cluster import wire
+from risingwave_tpu.resilience import RetryPolicy
 
 
 class ComputeError(RuntimeError):
     """The node rejected a request (application error, NOT a death)."""
+
+
+#: connect retries: every OSError is transient here (the node is
+#: booting; refusal/reset/timeout all mean "not up YET") — bounded by
+#: the policy's deadline, the former fixed 50x100ms spin generalized
+_CONNECT_POLICY = RetryPolicy(
+    max_attempts=60,
+    base_backoff_s=0.05,
+    max_backoff_s=0.5,
+    deadline_s=15.0,
+    classify=lambda e: isinstance(e, OSError),
+)
 
 
 class ComputeClient:
@@ -79,19 +91,23 @@ class ComputeClient:
         client.connect()
         return client
 
-    def connect(self, attempts: int = 50) -> None:
-        for _ in range(attempts):
-            try:
-                s = socket.create_connection(("127.0.0.1", self.port), 5)
-                # RPC replies can lag behind jit compiles on the node
-                # (~tens of seconds cold): generous per-op timeout, not
-                # the connect timeout
-                s.settimeout(300)
-                self.sock = s
-                return
-            except OSError:
-                time.sleep(0.1)
-        raise ConnectionError(f"cannot reach compute node :{self.port}")
+    def connect(self, policy: Optional[RetryPolicy] = None) -> None:
+        from risingwave_tpu.resilience import RetryBudgetExceeded
+
+        def attempt():
+            s = socket.create_connection(("127.0.0.1", self.port), 5)
+            # RPC replies can lag behind jit compiles on the node
+            # (~tens of seconds cold): generous per-op timeout, not
+            # the connect timeout
+            s.settimeout(300)
+            self.sock = s
+
+        try:
+            (policy or _CONNECT_POLICY).run(attempt, op="node.connect")
+        except RetryBudgetExceeded as e:
+            raise ConnectionError(
+                f"cannot reach compute node :{self.port}"
+            ) from e
 
     def kill9(self) -> None:
         """SIGKILL the node (chaos path; CPU process — never a TPU

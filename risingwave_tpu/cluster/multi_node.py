@@ -33,16 +33,47 @@ import numpy as np
 from risingwave_tpu.cluster.client import ComputeClient
 from risingwave_tpu.epoch_trace import record_stage
 from risingwave_tpu.event_log import EVENT_LOG
+from risingwave_tpu.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryPolicy,
+)
 from risingwave_tpu.storage.sstable import key_hashes
+
+#: a node death during push/barrier is transient at the CLUSTER level:
+#: recovery respawns it. ConnectionError/OSError = the wire died.
+_NODE_TRANSIENT = (ConnectionError, OSError)
 
 
 class ShardedClusterClient:
     """The meta/frontend role over N compute nodes."""
 
-    def __init__(self, clients: Sequence[ComputeClient]):
+    def __init__(
+        self,
+        clients: Sequence[ComputeClient],
+        recover_retry: Optional[RetryPolicy] = None,
+    ):
         if not clients:
             raise ValueError("need at least one compute node")
         self.nodes: List[ComputeClient] = list(clients)
+        # recover-and-retry budget per node death: a node that cannot
+        # come back inside the deadline surfaces instead of wedging the
+        # barrier forever (respawn itself can transiently fail)
+        self.recover_retry = recover_retry or RetryPolicy.from_env(
+            max_attempts=3,
+            base_backoff_s=0.2,
+            max_backoff_s=2.0,
+            deadline_s=60.0,
+            classify=lambda e: isinstance(e, _NODE_TRANSIENT),
+        )
+        # per-node breaker: a node that dies-and-fails-recovery
+        # repeatedly opens its breaker, and the cluster fails fast on
+        # the next barrier instead of burning a full recover budget
+        # per epoch against a husk
+        self.node_breakers: List[CircuitBreaker] = [
+            CircuitBreaker.from_env(f"node{i}")
+            for i in range(len(self.nodes))
+        ]
         self.dist: Dict[str, str] = {}  # table/MV -> distribution column
         # MVs whose key does NOT contain their base's distribution
         # column: each node holds a PARTIAL group, so concatenating
@@ -141,14 +172,54 @@ class ShardedClusterClient:
             if not m.any():
                 continue
             part = {k: np.asarray(v)[m] for k, v in cols.items()}
-            node.push_chunk(table, part, capacity)
+            try:
+                if node.sock is None:  # killed: socket torn down
+                    raise ConnectionError("node down")
+                node.push_chunk(table, part, capacity)
+            except _NODE_TRANSIENT as e:
+                # the chunk was never acked, so it is NOT in the
+                # replay buffer: recover the node (which replays its
+                # pending chunks), then re-push this one
+                self._recover_node(
+                    i, node, e,
+                    lambda: node.push_chunk(table, part, capacity),
+                )
+
+    def _recover_node(self, i: int, node: ComputeClient, cause, fn):
+        """Shared death handling for push/barrier: ONE ``recovery``
+        event per death, then recover+retry bounded by the policy's
+        deadline, gated by the node's breaker."""
+        br = self.node_breakers[i]
+        if not br.allow():
+            raise CircuitOpenError(
+                f"node{i} breaker is open (repeated failed recoveries); "
+                f"last cause: {cause!r}"
+            )
+        EVENT_LOG.record("recovery", mode="node", node=i, cause=repr(cause))
+
+        def attempt():
+            node.recover()
+            return fn()
+
+        def on_retry(exc, n):
+            # counts every TRANSIENT failure (incl. the giveup's last
+            # attempt) — semantic errors (ComputeError) bypass on_retry
+            # and must never poison the breaker: the node is alive
+            br.record_failure()
+
+        out = self.recover_retry.run(
+            attempt, op="node.recover", on_retry=on_retry
+        )
+        br.record_success()
+        return out
 
     def barrier(self) -> List[int]:
         """One epoch across the cluster: every node collects + commits
         its barrier (the meta barrier manager's broadcast). A DEAD node
         recovers in place — respawn from its durable state, replay its
         un-durable chunks (client.recover) — while the other nodes'
-        state is untouched; the barrier then retries on that node."""
+        state is untouched; the barrier then retries on that node,
+        bounded by the recover policy's deadline and the node breaker."""
         epochs = []
         for i, node in enumerate(self.nodes):
             t0 = time.perf_counter()
@@ -156,10 +227,10 @@ class ShardedClusterClient:
                 if node.sock is None:  # killed: socket torn down
                     raise ConnectionError("node down")
                 epochs.append(node.barrier())
-            except (ConnectionError, OSError) as e:
-                EVENT_LOG.record("recovery", mode="node", node=i, cause=repr(e))
-                node.recover()
-                epochs.append(node.barrier())
+            except _NODE_TRANSIENT as e:
+                epochs.append(
+                    self._recover_node(i, node, e, node.barrier)
+                )
             # per-node barrier RTT: the cross-node half of the epoch's
             # stage attribution (wire + that node's full commit)
             record_stage(

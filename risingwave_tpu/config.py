@@ -48,10 +48,28 @@ class SystemParams:
 
 
 @dataclass
+class ResilienceConfig:
+    """Transient-fault knobs at the durability boundary (reference:
+    ObjectStoreConfig's retry/timeout block, src/object_store/). These
+    feed ``resilience.RetryPolicy`` / ``CircuitBreaker`` as the
+    baseline; a SET ``RW_RETRY_*`` / ``RW_BREAKER_*`` env knob wins
+    over the config (the operator's no-restart/no-file escape hatch).
+    Defaults mirror the env defaults."""
+
+    retry_max_attempts: int = 8
+    retry_base_backoff_ms: int = 50
+    retry_max_backoff_ms: int = 2000
+    retry_deadline_s: float = 30.0
+    breaker_threshold: int = 5
+    breaker_cooldown_s: float = 5.0
+
+
+@dataclass
 class RwConfig:
     streaming: StreamingConfig = field(default_factory=StreamingConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
     system: SystemParams = field(default_factory=SystemParams)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     unrecognized: Dict[str, Any] = field(default_factory=dict)
 
 
@@ -73,7 +91,7 @@ def load_config(
     if path is not None:
         with open(path, "rb") as f:
             data = tomllib.load(f)
-        for section in ("streaming", "storage", "system"):
+        for section in ("streaming", "storage", "system", "resilience"):
             if section in data:
                 _apply(
                     getattr(cfg, section), data.pop(section),

@@ -32,6 +32,7 @@ from typing import List, Optional, Tuple
 
 from risingwave_tpu.connectors.log_store import KvLogStore
 from risingwave_tpu.connectors.sink import Sink
+from risingwave_tpu.resilience import RetryPolicy
 
 
 class TwoPhaseSink(Sink):
@@ -130,12 +131,24 @@ class SinkCoordinator:
     IS LogSinker's (TwoPhaseSink adapts write_batch/commit to
     prepare/commit_prepared) — one loop, no drift."""
 
-    def __init__(self, log_store: KvLogStore, sink: TwoPhaseSink):
+    def __init__(
+        self,
+        log_store: KvLogStore,
+        sink: TwoPhaseSink,
+        retry_policy: Optional[RetryPolicy] = None,
+    ):
         from risingwave_tpu.connectors.log_store import LogSinker
 
         self.log_store = log_store
         self.sink = sink
         self._sinker = LogSinker(log_store, sink)
+        # transient prepare/commit failures (a flaky external
+        # coordinator) retry the drain: both phases are idempotent
+        # (re-prepare overwrites the stage; re-commit of a published
+        # epoch is a no-op) and the consume offset advances only after
+        # the external commit, so a retried drain continues exactly
+        # where the failed attempt stopped — exactly-once holds
+        self._retry = retry_policy or RetryPolicy.from_env()
 
     def recover(self) -> None:
         """Abort staged-but-unpublished epochs: replay will re-prepare
@@ -149,10 +162,25 @@ class SinkCoordinator:
         back would permanently strand its pre-rollback rows externally,
         since committed epochs are immune to re-prepare). Safe to crash
         anywhere and rerun; the offset advances after the external
-        commit, and both phases are idempotent. Returns epochs
-        published."""
+        commit, and both phases are idempotent — so transient failures
+        mid-drain simply retry (bounded by the policy's deadline).
+        Returns epochs published across all attempts."""
         if up_to is None:
             raise ValueError(
                 "SinkCoordinator.run_once requires the durable frontier"
             )
-        return self._sinker.run_once(up_to=up_to)
+        # count delivered epochs from the offset frontier, not from the
+        # attempts' return values: an attempt that delivers some epochs
+        # and then flakes advanced the offset for those epochs — the
+        # retried attempt resumes at the pending frontier, and the
+        # frontier delta is the exact total across all attempts
+        pending0 = [
+            e for e in self.log_store.pending_epochs() if e <= up_to
+        ]
+        self._retry.run(
+            lambda: self._sinker.run_once(up_to=up_to), op="sink2pc.drain"
+        )
+        still = [
+            e for e in self.log_store.pending_epochs() if e <= up_to
+        ]
+        return len(pending0) - len(still)

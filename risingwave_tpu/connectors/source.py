@@ -20,6 +20,7 @@ import numpy as np
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.connectors.nexmark import NexmarkConfig, NexmarkGenerator
 from risingwave_tpu.executors.base import Executor
+from risingwave_tpu.resilience import RetryPolicy
 from risingwave_tpu.storage.state_table import Checkpointable, StateDelta
 
 
@@ -36,6 +37,7 @@ class NexmarkSourceExecutor(Executor, Checkpointable):
         split_num: int = 1,
         seed: int = 42,
         table_id: str = "source.nexmark",
+        retry_policy: Optional[RetryPolicy] = None,
     ):
         self.table_id = table_id
         dicts = NexmarkGenerator.make_dictionaries()
@@ -50,6 +52,22 @@ class NexmarkSourceExecutor(Executor, Checkpointable):
             for i in range(split_num)
         ]
         self._committed = [0] * split_num
+        # transient read faults (a flaky external connector) retry
+        # anchored at the split's offset: every attempt seeks back to
+        # where the poll started, so a mid-read failure can never skip
+        # or double-count events (the offset IS the read cursor — the
+        # same property exactly-once recovery rides)
+        self._retry = retry_policy or RetryPolicy.from_env()
+
+    def _poll_split(self, g: NexmarkGenerator, n: int, capacity: int):
+        start = g.offset
+
+        def attempt():
+            if g.offset != start:
+                g.seek(start)
+            return g.next_chunks(n, capacity)
+
+        return self._retry.run(attempt, op="source.poll")
 
     def poll(
         self, events_per_split: int, capacity: int
@@ -60,7 +78,7 @@ class NexmarkSourceExecutor(Executor, Checkpointable):
             "bid": [],
         }
         for g in self.splits:
-            chunks = g.next_chunks(events_per_split, capacity)
+            chunks = self._poll_split(g, events_per_split, capacity)
             for stream, c in chunks.items():
                 if c is not None:
                     out[stream].append(c)

@@ -16,6 +16,14 @@ Recording sites (grow as subsystems need them):
 - ``scale``          — parallel/scale.py reschedules
 - ``offset_resume``  — source executors resuming connector offsets
 - ``stall_dump``     — epoch_trace.dump_stalls artifacts
+- ``breaker``        — resilience.CircuitBreaker state transitions
+                       (closed/open/half_open, with the breaker name)
+- ``degraded``       — runtime entered degraded mode: store breaker
+                       open mid-epoch, checkpoint deltas spilling
+                       locally, compaction paused
+- ``restored``       — degraded spill fully replayed, store healthy
+- ``degraded_discard`` — recovery discarded a stale degraded spill
+                       (sources replay those epochs instead)
 """
 
 from __future__ import annotations

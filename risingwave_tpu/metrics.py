@@ -225,6 +225,38 @@ class MetricsRegistry:
                 f"<td>{s['p99']}</td><td>{s['count']}</td></tr>"
                 for lbl, s in sorted(h.summary().items())
             )
+        # resilience health: retry pressure + breaker states + degraded
+        # mode (resilience.py) — the operator's first look when the
+        # store flakes
+        res_rows = ""
+        for cname in (
+            "retries_total",
+            "retry_giveups_total",
+            "retry_success_after_retry_total",
+            "store_fast_fails_total",
+            "breaker_transitions_total",
+            "degraded_entries_total",
+            "degraded_epochs_spilled_total",
+            "degraded_epochs_replayed_total",
+        ):
+            c = self.counters.get(cname)
+            if c is None:
+                continue
+            for labels, v in sorted(c._values.items()):
+                lbl = ",".join(f"{k}={val}" for k, val in labels) or "-"
+                res_rows += (
+                    f"<tr><td>{escape(cname)}</td>"
+                    f"<td>{escape(lbl)}</td><td>{v:g}</td></tr>"
+                )
+        br = self.gauges.get("breaker_state")
+        if br is not None:
+            names = {0.0: "closed", 1.0: "half_open", 2.0: "open"}
+            for labels, v in sorted(br._values.items()):
+                lbl = ",".join(f"{k}={val}" for k, val in labels) or "-"
+                res_rows += (
+                    f"<tr><td>breaker_state</td><td>{escape(lbl)}</td>"
+                    f"<td>{escape(names.get(v, str(v)))}</td></tr>"
+                )
         return f"""<!doctype html><html><head><title>risingwave_tpu</title>
 <style>body{{font-family:monospace;margin:2em}}table{{border-collapse:collapse;margin:1em 0}}
 td,th{{border:1px solid #999;padding:2px 8px}}h2{{margin-top:1.5em}}</style></head><body>
@@ -233,6 +265,7 @@ td,th{{border:1px solid #999;padding:2px 8px}}h2{{margin-top:1.5em}}</style></he
 <h2>fragments &rarr; subscribers</h2><table>{frag_rows or '<tr><td>none</td></tr>'}</table>
 <h2>device state (top 40)</h2><table><tr><th>executor</th><th>table</th><th>bytes</th></tr>{state_rows}</table>
 <h2>barrier stages (ms)</h2><table><tr><th>stage</th><th>p50</th><th>p99</th><th>n</th></tr>{stage_rows or '<tr><td>no barriers traced</td></tr>'}</table>
+<h2>resilience</h2><table><tr><th>metric</th><th>labels</th><th>value</th></tr>{res_rows or '<tr><td>no retries / breakers yet</td></tr>'}</table>
 <h2>events (last 25)</h2><table><tr><th>#</th><th>kind</th><th>detail</th></tr>{event_rows or '<tr><td>none</td></tr>'}</table>
 <p><a href="/metrics">/metrics</a> &middot; <a href="/heap">/heap</a> &middot; <a href="/events">/events</a></p>
 </body></html>"""

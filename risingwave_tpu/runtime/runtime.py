@@ -34,6 +34,13 @@ from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.epoch_trace import EpochTrace, chunk_nbytes, dump_stalls
 from risingwave_tpu.event_log import EVENT_LOG
 from risingwave_tpu.metrics import REGISTRY
+from risingwave_tpu.resilience import (
+    STORE_UNAVAILABLE,
+    CircuitBreaker,
+    DeltaSpill,
+    RetryingObjectStore,
+    RetryPolicy,
+)
 from risingwave_tpu.trace import span
 from risingwave_tpu.storage.object_store import ObjectStore
 from risingwave_tpu.storage.state_table import CheckpointManager
@@ -61,11 +68,27 @@ class StreamingRuntime:
 
         if store is None:
             store = LocalFsObjectStore(cfg.storage.object_store_root)
+        res = getattr(cfg, "resilience", None)
+        retry_policy = breaker = None
+        if res is not None:
+            retry_policy = RetryPolicy.from_env(
+                max_attempts=res.retry_max_attempts,
+                base_backoff_s=res.retry_base_backoff_ms / 1e3,
+                max_backoff_s=res.retry_max_backoff_ms / 1e3,
+                deadline_s=res.retry_deadline_s,
+            )
+            breaker = CircuitBreaker.from_env(
+                "object_store",
+                failure_threshold=res.breaker_threshold,
+                cooldown_s=res.breaker_cooldown_s,
+            )
         return cls(
             store,
             barrier_interval_ms=cfg.system.barrier_interval_ms,
             checkpoint_frequency=cfg.system.checkpoint_frequency,
             compact_at=cfg.storage.compact_at,
+            retry_policy=retry_policy,
+            breaker=breaker,
         )
 
     def __init__(
@@ -78,6 +101,9 @@ class StreamingRuntime:
         memory_budget_bytes: Optional[int] = None,
         auto_recover: bool = False,
         in_flight_barriers: int = 1,
+        retry_policy: Optional[RetryPolicy] = None,
+        breaker: Optional[CircuitBreaker] = None,
+        degraded_dir: Optional[str] = None,
     ):
         # failure detection + self-healing (barrier/mod.rs:676-710 +
         # recovery.rs:353): a poisoned epoch or dead actor surfacing at
@@ -103,11 +129,53 @@ class StreamingRuntime:
         self._aux_state: List[object] = []
         self.barrier_interval_ms = barrier_interval_ms
         self.checkpoint_frequency = checkpoint_frequency
+        # the durability boundary is retry-wrapped and breaker-gated
+        # (resilience.py): transient store faults are absorbed by
+        # backoff; a hard-down store opens the breaker and the runtime
+        # DEGRADES instead of dying — queries keep answering from
+        # live/HBM state, checkpoint deltas spill locally, compaction
+        # pauses, and the spill replays when the breaker half-opens.
+        if store is not None:
+            if isinstance(store, RetryingObjectStore):
+                if store.breaker is None:
+                    # a breaker-less pre-wrapped store (e.g. bare
+                    # store.resilient()) would make degraded-mode
+                    # restore probes unthrottled — every barrier would
+                    # pay the full retry deadline against a down store.
+                    # The runtime REQUIRES the cooldown gate: attach one.
+                    store.breaker = breaker or CircuitBreaker.from_env(
+                        "object_store"
+                    )
+                self.store_breaker = store.breaker
+            else:
+                self.store_breaker = breaker or CircuitBreaker.from_env(
+                    "object_store"
+                )
+                store = RetryingObjectStore(
+                    store,
+                    retry_policy or RetryPolicy.from_env(),
+                    self.store_breaker,
+                )
+        else:
+            self.store_breaker = None
         self.mgr = (
             CheckpointManager(store, compact_at=compact_at)
             if store is not None
             else None
         )
+        # degraded-mode checkpointing state (guarded by _degraded_lock:
+        # the async worker and the barrier thread both touch it)
+        self._degraded = False
+        self._degraded_lock = threading.Lock()
+        self._spill = DeltaSpill(degraded_dir)
+        # a persistent RW_DEGRADED_DIR can hold a PREVIOUS incarnation's
+        # spill: those epochs rolled back with that process (sources
+        # replay their data after recovery) — replaying them here would
+        # at best trip the manifest's epoch guard and at worst
+        # double-apply. Stale on arrival; discard.
+        stale = self._spill.discard_all()
+        if stale:
+            EVENT_LOG.record("degraded_discard", epochs=stale, at="boot")
         self.async_checkpoint = async_checkpoint
         self._epoch = self.mgr.max_committed_epoch if self.mgr else 0
         self._barrier_seq = 0
@@ -624,6 +692,10 @@ class StreamingRuntime:
         return float(np.percentile(self.epoch_close_ms, 99))
 
     def _barrier_locked(self) -> Dict[str, List[StreamChunk]]:
+        # degraded-mode probe rides the barrier clock: the breaker's
+        # cooldown gates actual store touches, so a down store costs
+        # nothing per barrier and a healed one replays the spill here
+        self._maybe_restore_degraded()
         if self.in_flight_barriers > 1:
             return self._barrier_pipelined()
         t0 = time.perf_counter()
@@ -737,6 +809,111 @@ class StreamingRuntime:
             return 0.0
         return float(np.percentile(self.barrier_latencies_ms, 99))
 
+    # -- degraded mode (store breaker open) ------------------------------
+    @property
+    def degraded(self) -> bool:
+        return self._degraded
+
+    def try_restore_degraded(self) -> bool:
+        """Operator/driver surface: force a restore probe NOW (the
+        barrier clock does this automatically). True = fully restored."""
+        with self.lock:
+            return self._maybe_restore_degraded()
+
+    def _enter_degraded(
+        self, epoch: int, staged, cause: BaseException
+    ) -> None:
+        """The store became unavailable mid-epoch (breaker open or
+        retry budget exhausted): spill the staged deltas locally, pause
+        compaction, keep serving queries from live/HBM state. The
+        spilled epochs replay — in order — once the breaker half-opens
+        (``_maybe_restore_degraded``)."""
+        with self._degraded_lock:
+            first = not self._degraded
+            self._degraded = True
+            self._spill.spill(epoch, staged)
+        if first:
+            self._compact_pause.set()
+            REGISTRY.counter("degraded_entries_total").inc()
+            REGISTRY.gauge("degraded_mode").set(1.0)
+            EVENT_LOG.record(
+                "degraded", epoch=epoch, cause=repr(cause)
+            )
+
+    def _commit_or_degrade(self, epoch: int, staged, tr=None) -> bool:
+        """The single durable-commit gate for the sync path and the
+        async worker: returns True iff the epoch is durable; a store-
+        unavailable failure degrades instead of raising (any OTHER
+        failure propagates — the failed-barrier recovery contract)."""
+        with self._degraded_lock:
+            if self._degraded:
+                self._spill.spill(epoch, staged)
+                return False
+        try:
+            self.mgr.commit_staged(epoch, staged, trace=tr)
+            return True
+        except STORE_UNAVAILABLE as e:
+            self._enter_degraded(epoch, staged, e)
+            return False
+
+    def _maybe_restore_degraded(self) -> bool:
+        """Probe the healed store: replay spilled epochs in order
+        through the normal commit path. Called at every barrier (the
+        breaker's cooldown gates how often the store is actually
+        touched). Returns True when the runtime left degraded mode."""
+        if not self._degraded:
+            return False
+        br = self.store_breaker
+        if br is not None and not br.allow():
+            return False  # still cooling down: no store touch at all
+        replayed = []
+        restored = False
+        with self._degraded_lock:
+            if not self._degraded:
+                return False
+            try:
+                for epoch in self._spill.epochs():
+                    if epoch <= self.mgr.max_committed_epoch:
+                        # already covered by the manifest (e.g. a
+                        # replay attempt that committed but failed
+                        # later): the spill entry is redundant
+                        self._spill.remove(epoch)
+                        continue
+                    staged = self._spill.load(epoch)
+                    # replay is idempotent: a previous half-committed
+                    # attempt left orphan SSTs at the same paths which
+                    # this put simply overwrites; the manifest is the
+                    # only durability authority
+                    self.mgr.commit_staged(epoch, staged)
+                    self._spill.remove(epoch)
+                    replayed.append(epoch)
+            except STORE_UNAVAILABLE:
+                # breaker re-opened mid-replay; already-replayed epochs
+                # ARE durable — only the tail stays spilled
+                pass
+            else:
+                self._degraded = False
+                restored = True
+        # durable hooks (sink release — arbitrary external work) run
+        # OUTSIDE the lock so the async worker never stalls behind them
+        if replayed:
+            REGISTRY.counter("degraded_epochs_replayed_total").inc(
+                len(replayed)
+            )
+        for epoch in replayed:
+            self._on_epoch_durable(epoch)
+        if not restored:
+            return False
+        REGISTRY.gauge("degraded_mode").set(0.0)
+        EVENT_LOG.record(
+            "restored",
+            epochs_replayed=len(replayed),
+            epoch=self.mgr.max_committed_epoch,
+        )
+        self._compact_pause.clear()
+        self._kick_compactor()
+        return True
+
     # -- checkpoint lane -------------------------------------------------
     def _commit(self, epoch: int, tr: Optional[EpochTrace] = None) -> None:
         self._raise_worker_error()
@@ -753,12 +930,12 @@ class StreamingRuntime:
         REGISTRY.counter("checkpoints_total").inc()
         REGISTRY.gauge("checkpoint_staged_tables").set(len(staged))
         if not self.async_checkpoint:
-            self.mgr.commit_staged(epoch, staged, trace=tr)
-            self.checkpoint_sync_ms.append(
-                (time.perf_counter() - t_staged) * 1e3
-            )
-            self._on_epoch_durable(epoch)
-            self._kick_compactor()
+            if self._commit_or_degrade(epoch, staged, tr):
+                self.checkpoint_sync_ms.append(
+                    (time.perf_counter() - t_staged) * 1e3
+                )
+                self._on_epoch_durable(epoch)
+                self._kick_compactor()
             return
         with self._inflight_lock:
             self._inflight += 1
@@ -788,14 +965,19 @@ class StreamingRuntime:
                         # sink output for unpersisted state — drop
                         # everything until the caller recover()s
                         continue
-                    # single-worker FIFO queue -> epoch order holds
+                    # single-worker FIFO queue -> epoch order holds;
+                    # store-unavailable failures degrade (spill) rather
+                    # than poisoning the lane — the stream keeps going
                     with span("checkpoint.commit", epoch=epoch):
-                        self.mgr.commit_staged(epoch, staged, trace=tr)
-                    self.checkpoint_sync_ms.append(
-                        (time.perf_counter() - t_staged) * 1e3
-                    )
-                    self._on_epoch_durable(epoch)
-                    self._kick_compactor()
+                        durable = self._commit_or_degrade(
+                            epoch, staged, tr
+                        )
+                    if durable:
+                        self.checkpoint_sync_ms.append(
+                            (time.perf_counter() - t_staged) * 1e3
+                        )
+                        self._on_epoch_durable(epoch)
+                        self._kick_compactor()
                 except BaseException as e:  # surfaced on main thread
                     self._work_err.append(e)
                 finally:
@@ -916,6 +1098,10 @@ class StreamingRuntime:
         """Rebuild all fragment state from the last committed epoch."""
         if not self.mgr:
             raise RuntimeError("no object store configured")
+        # an explicit recovery is a manual store probe: let it through
+        # an open breaker (its reads settle the breaker either way)
+        if self.store_breaker is not None:
+            self.store_breaker.force_probe()
         self._quiesce()
         # quiesce compaction: its GC deletes SSTs that recovery's
         # read_table may be about to read
@@ -926,6 +1112,18 @@ class StreamingRuntime:
         finally:
             self._compact_pause.clear()
             self._work_abort.clear()
+        # degraded spill of rolled-back epochs is stale: recovery lands
+        # on the last DURABLE manifest; sources replay the spilled
+        # epochs' data, so replaying the spill too would double-apply
+        with self._degraded_lock:
+            if self._degraded or self._spill.epochs():
+                discarded = self._spill.discard_all()
+                if self._degraded:
+                    EVENT_LOG.record(
+                        "degraded_discard", epochs=discarded
+                    )
+                self._degraded = False
+        REGISTRY.gauge("degraded_mode").set(0.0)
         # rolled-back epochs must not leave stale sink batches behind:
         # replay would re-hold the same rows -> duplicate delivery
         for ex in self.executors():

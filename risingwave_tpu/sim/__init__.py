@@ -8,9 +8,24 @@ droppable in-process: abandon every live object mid-write, keep only
 the object store's committed bytes, rebuild executors, recover. The
 ``CrashingStore`` injects the crash at an exact put — including BETWEEN
 a checkpoint's SST uploads and its manifest commit, the torn-upload
-window the manifest protocol must tolerate.
+window the manifest protocol must tolerate. ``FlakyStore`` layers a
+seeded TRANSIENT fault storm (flaky blob store, injected latency) on
+top, the failure mode risingwave_tpu/resilience.py must absorb; the
+two compose so a crash can land mid-retry-loop.
 """
 
-from risingwave_tpu.sim.chaos import ChaosRunner, CrashPoint, CrashingStore
+from risingwave_tpu.sim.chaos import (
+    ChaosRunner,
+    CrashingStore,
+    CrashPoint,
+    FlakyStore,
+    chaos_seed,
+)
 
-__all__ = ["ChaosRunner", "CrashPoint", "CrashingStore"]
+__all__ = [
+    "ChaosRunner",
+    "CrashPoint",
+    "CrashingStore",
+    "FlakyStore",
+    "chaos_seed",
+]

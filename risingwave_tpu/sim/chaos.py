@@ -1,4 +1,4 @@
-"""Crash-injecting object store + kill/recover chaos runner.
+"""Crash/flaky-injecting object stores + kill/recover chaos runner.
 
 Reference model: the madsim simulation tier kills arbitrary nodes at
 arbitrary times and asserts the cluster converges to the same result
@@ -7,26 +7,59 @@ recovery/). Here the unit of failure is the process: a crash abandons
 all live state mid-operation; durability is exactly what the object
 store holds. Recovery = rebuild executors + ``CheckpointManager.
 recover`` + source offsets resume (exactly-once's two halves).
+
+Two injectors compose:
+- ``CrashingStore`` — FATAL faults: ``arm(n)`` kills the process at
+  the n-th subsequent write, and a dead process serves NOTHING (reads
+  included — a killed node cannot answer).
+- ``FlakyStore`` — TRANSIENT faults: a seeded schedule of
+  ``TransientStoreError`` + injected latency per op, the flaky-blob-
+  store / slow-upload / DEAD-then-ALIVE-probe failure mode the
+  resilience layer (risingwave_tpu/resilience.py) must absorb.
+  Stack ``FlakyStore(CrashingStore(disk))`` and a crash can land in
+  the MIDDLE of a retry loop (the retry re-enters the crash gate).
+
+Replay: every runner failure message carries the fault-schedule seed;
+``chaos_seed(default)`` lets tests accept ``RW_CHAOS_SEED`` to replay
+a failing schedule deterministically.
 """
 
 from __future__ import annotations
 
 import random
-from typing import Callable, Optional
+import time
+from typing import Callable, Optional, Sequence
 
+from risingwave_tpu.resilience import (
+    STORE_UNAVAILABLE,
+    RetryingObjectStore,
+    RetryPolicy,
+    TransientStoreError,
+)
 from risingwave_tpu.storage.object_store import MemObjectStore, ObjectStore
 from risingwave_tpu.storage.state_table import CheckpointManager
 
 
+def chaos_seed(default: int) -> int:
+    """The fault-schedule seed: ``RW_CHAOS_SEED`` (replay a failure
+    printed by a previous run) or the test's default. A malformed env
+    value falls back to the default rather than killing collection."""
+    from risingwave_tpu.resilience import _env_val
+
+    return _env_val("RW_CHAOS_SEED", int, default)
+
+
 class CrashPoint(BaseException):
     """The injected process death (BaseException: nothing may catch and
-    'handle' a crash on the way out)."""
+    'handle' a crash on the way out — retry loops included)."""
 
 
 class CrashingStore(ObjectStore):
     """Wraps the durable store; ``arm(n)`` makes the n-th subsequent
-    write raise CrashPoint and poisons every later write — the process
-    is dead; only ``inner``'s already-committed bytes survive."""
+    write raise CrashPoint and poisons EVERY later op — the process is
+    dead; only ``inner``'s already-committed bytes survive. Reads are
+    gated too: a dead process cannot serve reads (sim fidelity — a
+    killed node answering GETs would mask torn-recovery bugs)."""
 
     def __init__(self, inner: ObjectStore):
         self.inner = inner
@@ -36,9 +69,12 @@ class CrashingStore(ObjectStore):
     def arm(self, nth_write: int) -> None:
         self._countdown = nth_write
 
-    def _write_gate(self):
+    def _death_gate(self):
         if self.dead:
             raise CrashPoint("process already dead")
+
+    def _write_gate(self):
+        self._death_gate()
         if self._countdown is not None:
             self._countdown -= 1
             if self._countdown <= 0:
@@ -55,24 +91,102 @@ class CrashingStore(ObjectStore):
         self.inner.delete(path)
 
     def read(self, path: str) -> bytes:
+        self._death_gate()
         return self.inner.read(path)
 
+    def read_range(self, path: str, off: int, length: int) -> bytes:
+        self._death_gate()
+        return self.inner.read_range(path, off, length)
+
     def exists(self, path: str) -> bool:
+        self._death_gate()
         return self.inner.exists(path)
 
     def list(self, prefix: str):
+        self._death_gate()
         return self.inner.list(prefix)
+
+
+class FlakyStore(ObjectStore):
+    """Seeded schedule of transient errors + injected latency per op.
+
+    ``rate`` is the per-op probability of a ``TransientStoreError``;
+    ``latency_s`` adds up to that much seeded delay per op (a slow
+    upload, not just a failed one). Pass a shared ``rng`` so the
+    schedule continues across process respawns (the ChaosRunner does),
+    or a ``seed`` for a standalone deterministic schedule. ``ops``
+    restricts injection to named ops (e.g. only ``put``)."""
+
+    def __init__(
+        self,
+        inner: ObjectStore,
+        rate: float = 0.2,
+        seed: int = 0,
+        rng: Optional[random.Random] = None,
+        latency_s: float = 0.0,
+        ops: Optional[Sequence[str]] = None,
+    ):
+        self.inner = inner
+        self.rate = rate
+        self.rng = rng if rng is not None else random.Random(seed)
+        self.latency_s = latency_s
+        self.ops = frozenset(ops) if ops is not None else None
+        self.faults = 0
+
+    def _maybe_fault(self, op: str, path: str) -> None:
+        if self.ops is not None and op not in self.ops:
+            return
+        if self.latency_s:
+            time.sleep(self.rng.random() * self.latency_s)
+        if self.rng.random() < self.rate:
+            self.faults += 1
+            raise TransientStoreError(
+                f"injected transient fault #{self.faults} at {op} {path}"
+            )
+
+    def put(self, path: str, data: bytes) -> None:
+        self._maybe_fault("put", path)
+        self.inner.put(path, data)
+
+    def read(self, path: str) -> bytes:
+        self._maybe_fault("read", path)
+        return self.inner.read(path)
+
+    def read_range(self, path: str, off: int, length: int) -> bytes:
+        self._maybe_fault("read_range", path)
+        return self.inner.read_range(path, off, length)
+
+    def exists(self, path: str) -> bool:
+        self._maybe_fault("exists", path)
+        return self.inner.exists(path)
+
+    def list(self, prefix: str):
+        self._maybe_fault("list", prefix)
+        return self.inner.list(prefix)
+
+    def delete(self, path: str) -> None:
+        self._maybe_fault("delete", path)
+        self.inner.delete(path)
 
 
 class ChaosRunner:
     """Run a build+feed workload for ``n_epochs`` COMMITTED epochs with
-    seeded random crashes; compare against an undisturbed twin outside.
+    seeded random crashes AND (optionally) a transient-fault storm;
+    compare against an undisturbed twin outside.
 
     ``make()`` returns a fresh workload object exposing ``executors``
     (incl. its source, so offsets checkpoint+restore) and is driven by
     ``feed(obj)`` for one epoch's data+barrier (NO commit — the runner
     owns commits so it can crash them). Epoch numbers encode the
     committed count, so recovery knows where to resume.
+
+    With ``flaky_rate`` > 0, the store stack per process incarnation is
+    ``RetryingObjectStore(FlakyStore(CrashingStore(disk)))``: transient
+    faults are absorbed by the retry layer, fatal crashes kill the
+    incarnation — and a crash can land mid-retry. The flaky schedule's
+    rng is SHARED across incarnations, so one seed replays the whole
+    storm. A retry give-up (budget exceeded / breaker open) is treated
+    like a crash: the process abandons live state and recovers.
     """
 
     def __init__(
@@ -82,38 +196,97 @@ class ChaosRunner:
         seed: int = 0,
         crash_prob: float = 0.25,
         disk: Optional[ObjectStore] = None,
+        flaky_rate: float = 0.0,
+        retry_policy: Optional[RetryPolicy] = None,
     ):
         self.make = make
         self.feed = feed
+        self.seed = seed
         self.rng = random.Random(seed)
         self.crash_prob = crash_prob
         self.disk = disk if disk is not None else MemObjectStore()
+        self.flaky_rate = flaky_rate
+        # flaky schedule survives respawns: one rng for the whole storm
+        self._flaky_rng = random.Random(seed ^ 0x5EED)
+        self.retry_policy = retry_policy or RetryPolicy(
+            max_attempts=8,
+            base_backoff_s=0.001,
+            max_backoff_s=0.02,
+            deadline_s=10.0,
+            seed=seed,
+        )
         self.crashes = 0
+        self.giveups = 0
+        self.faults_injected = 0
+
+    def _spawn(self):
+        """One process incarnation: fresh workload + store stack + mgr."""
+        obj = self.make()
+        crashing = CrashingStore(self.disk)
+        store: ObjectStore = crashing
+        flaky = None
+        if self.flaky_rate > 0:
+            flaky = FlakyStore(
+                crashing, rate=self.flaky_rate, rng=self._flaky_rng
+            )
+            store = RetryingObjectStore(flaky, self.retry_policy)
+        mgr = CheckpointManager(store, read_retry=self.retry_policy)
+        mgr.recover(obj.executors)  # no-op on a fresh disk
+        return obj, crashing, flaky, mgr
+
+    def _not_converged(self) -> RuntimeError:
+        return RuntimeError(
+            f"chaos run did not converge (seed={self.seed}; "
+            f"rerun with RW_CHAOS_SEED={self.seed} to replay)"
+        )
+
+    def _spawn_bounded(self, budget: list):
+        """Respawn, absorbing retry give-ups DURING recovery reads too
+        (the storm does not pause for the recovering process): each
+        failed spawn burns one attempt and counts a giveup, so a
+        hard-down store still surfaces with the seed breadcrumb."""
+        while True:
+            budget[0] += 1
+            if budget[0] > budget[1]:
+                raise self._not_converged()
+            try:
+                return self._spawn()
+            except STORE_UNAVAILABLE:
+                self.giveups += 1
 
     def run(self, n_epochs: int, max_attempts: int = 200) -> object:
-        obj = self.make()
-        store = CrashingStore(self.disk)
-        mgr = CheckpointManager(store)
-        mgr.recover(obj.executors)  # no-op on a fresh disk
+        budget = [0, max_attempts]
+        obj, crashing, flaky, mgr = self._spawn_bounded(budget)
         done = mgr.max_committed_epoch >> 16
-        attempts = 0
         while done < n_epochs:
-            attempts += 1
-            if attempts > max_attempts:
-                raise RuntimeError("chaos run did not converge")
+            budget[0] += 1
+            if budget[0] > budget[1]:
+                raise self._not_converged()
             if self.rng.random() < self.crash_prob:
                 # land the crash anywhere in the commit's write window:
-                # SST put(s) or the manifest put itself (torn upload)
-                store.arm(self.rng.randint(1, 3))
+                # SST put(s) or the manifest put itself (torn upload) —
+                # with retries on, a flaky fault may burn extra writes
+                # first, so the crash lands MID retry loop
+                crashing.arm(self.rng.randint(1, 3))
             try:
                 self.feed(obj)
                 mgr.commit_epoch((done + 1) << 16, obj.executors)
                 done += 1
             except CrashPoint:
                 self.crashes += 1
-                obj = self.make()
-                store = CrashingStore(self.disk)
-                mgr = CheckpointManager(store)
-                mgr.recover(obj.executors)
+                if flaky is not None:
+                    self.faults_injected += flaky.faults
+                obj, crashing, flaky, mgr = self._spawn_bounded(budget)
                 done = mgr.max_committed_epoch >> 16
+            except STORE_UNAVAILABLE:
+                # the store stayed down past the retry budget: the
+                # process gives up the epoch exactly like a crash —
+                # live state is abandoned, recovery replays
+                self.giveups += 1
+                if flaky is not None:
+                    self.faults_injected += flaky.faults
+                obj, crashing, flaky, mgr = self._spawn_bounded(budget)
+                done = mgr.max_committed_epoch >> 16
+        if flaky is not None:
+            self.faults_injected += flaky.faults
         return obj

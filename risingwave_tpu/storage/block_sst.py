@@ -171,8 +171,14 @@ class BlockSst:
         head = store.read_range(path, 0, 16)
         if not is_block_sst(head):
             raise ValueError(f"{path} is not a block SST")
-        (hl,) = struct.unpack("<Q", head[8:16])
-        hdr = json.loads(store.read_range(path, 16, hl).decode())
+        try:
+            (hl,) = struct.unpack("<Q", head[8:16])
+            hdr = json.loads(store.read_range(path, 16, hl).decode())
+        except (struct.error, UnicodeDecodeError) as e:
+            # a torn/partial header read (flaky ranged GET) must surface
+            # in the ValueError domain the storage retry loops classify
+            # as a transient decode race — not escape as struct.error
+            raise ValueError(f"torn block-SST header at {path}") from e
         m = hdr["meta"]
         self.meta = SstMeta(
             table_id=m["table_id"],

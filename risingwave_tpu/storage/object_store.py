@@ -42,6 +42,17 @@ class ObjectStore:
     def delete(self, path: str) -> None:
         raise NotImplementedError
 
+    def resilient(self, policy=None, breaker=None) -> "ObjectStore":
+        """Wrap this store in the retrying, breaker-gated boundary
+        (resilience.RetryingObjectStore) — the production posture for
+        any store that can transiently fail. Idempotent: wrapping a
+        wrapper returns it unchanged."""
+        from risingwave_tpu.resilience import RetryingObjectStore
+
+        if isinstance(self, RetryingObjectStore):
+            return self
+        return RetryingObjectStore(self, policy, breaker)
+
 
 class MemObjectStore(ObjectStore):
     """In-memory store (reference: object/mem.rs) — tests & sim."""

@@ -34,6 +34,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from risingwave_tpu.resilience import (
+    CircuitBreaker,
+    RetryingObjectStore,
+    RetryPolicy,
+)
 from risingwave_tpu.storage.object_store import ObjectStore
 from risingwave_tpu.storage.block_sst import (
     BlockSst,
@@ -210,8 +215,28 @@ class CheckpointManager:
         store: ObjectStore,
         prefix: str = "hummock",
         compact_at: int = COMPACT_AT,
+        retry_policy: Optional[RetryPolicy] = None,
+        breaker: Optional[CircuitBreaker] = None,
+        read_retry: Optional[RetryPolicy] = None,
     ):
+        # the durability boundary: EVERY store touch (SST upload,
+        # manifest commit, compaction IO, block reads) goes through the
+        # retrying, monitored wrapper (reference: src/object_store/'s
+        # RetryCondition around each op). Transient classification is
+        # narrow (TransientStoreError/ConnectionError/Timeout), so
+        # in-mem and local-fs stores behave exactly as before; chaos
+        # CrashPoints are BaseExceptions and always propagate.
+        if not isinstance(store, RetryingObjectStore):
+            store = RetryingObjectStore(
+                store, retry_policy or RetryPolicy.from_env(), breaker
+            )
         self.store = store
+        # read-closure retries (GC race / torn decode) reload the
+        # manifest between attempts; deadline + backoff bound what was
+        # previously an ad-hoc fixed-count spin
+        self._read_policy = read_retry or RetryPolicy.from_env(
+            max_attempts=8, base_backoff_s=0.002, max_backoff_s=0.05
+        )
         self.prefix = prefix
         self.compact_at = compact_at
         self._lock = threading.RLock()
@@ -565,24 +590,34 @@ class CheckpointManager:
         ]
         return merge_ssts(ssts, ssts[-1].meta.key_names)
 
+    @staticmethod
+    def _read_transient(exc: Exception) -> bool:
+        # in READ context a missing file IS transient (a compaction's
+        # GC deleted it mid-read; the reloaded manifest never references
+        # GC'd files) and ValueError is a torn-decode race. NOT
+        # KeyError: that is how user errors (bad prefix / range column)
+        # surface from the read closures.
+        return isinstance(exc, (OSError, ValueError)) and not isinstance(
+            exc, EpochFloorError
+        )
+
     def _read_retry(self, fn):
         """Run a read closure that may lazily touch SST bytes (block
         reads happen AFTER the entry snapshot); a concurrent
         compaction's GC can delete a file mid-read, so retry the WHOLE
-        closure against a reloaded manifest — the durable version never
-        references GC'd files."""
-        for attempt in range(8):
-            if attempt:
-                with self._lock:
-                    self._load()
-            try:
-                return fn()
-            except (FileNotFoundError, OSError, ValueError):
-                # NOT KeyError: that is how user errors (bad prefix /
-                # range column) surface from the read closures
-                continue
-        raise RuntimeError(
-            "SST files kept vanishing mid-read (compaction livelock?)"
+        closure against a reloaded manifest — bounded by the read
+        policy's deadline + backoff (a wedged manifest race can no
+        longer spin), with attempts visible in the retry metrics."""
+
+        def _reload(exc, attempt):
+            with self._lock:
+                self._load()
+
+        return self._read_policy.run(
+            fn,
+            op="storage.read",
+            classify=self._read_transient,
+            on_retry=_reload,
         )
 
     def _open_entry(self, e: dict, cache: bool):
@@ -609,8 +644,9 @@ class CheckpointManager:
         # "kill" — may GC an SST between the version snapshot and the
         # read. Retry after RELOADING the manifest: the durable version
         # never references GC'd files (GC runs only after the new
-        # manifest persists, compact_once).
-        for attempt in range(8):
+        # manifest persists, compact_once). Bounded by the read
+        # policy's attempt budget (shared with _read_retry).
+        for attempt in range(self._read_policy.max_attempts):
             with self._lock:
                 if attempt:
                     self._load()

@@ -72,3 +72,64 @@ def test_two_node_sharded_mv_with_kill9(tmp_path):
         assert got == oracle
     finally:
         cc.close()
+
+
+def test_kill9_racing_barrier_single_recovery_event(tmp_path):
+    """Satellite: kill -9 racing the barrier broadcast must surface
+    EXACTLY ONE ``recovery`` event for the dead node (one death = one
+    event, however many bounded retry attempts recovery takes inside)
+    and converge to the undisturbed result."""
+    from risingwave_tpu.event_log import EVENT_LOG
+
+    cc = ShardedClusterClient.spawn(
+        2, [str(tmp_path / "n0"), str(tmp_path / "n1")]
+    )
+    try:
+        cc.ddl(
+            "CREATE TABLE bid (auction BIGINT, price BIGINT)",
+            distributed_by="auction",
+        )
+        cc.ddl(
+            "CREATE MATERIALIZED VIEW m AS SELECT auction, count(*) AS c, "
+            "sum(price) AS s FROM bid GROUP BY auction"
+        )
+        rng = np.random.default_rng(11)
+        oracle: dict = {}
+
+        def feed(n):
+            state = rng.bit_generator.state
+            _push_bids(cc, rng, n)
+            rng.bit_generator.state = state
+            a = rng.integers(0, 40, n).astype(np.int64)
+            p = rng.integers(1, 100, n).astype(np.int64)
+            for k, v in zip(a.tolist(), p.tolist()):
+                c, s = oracle.get(k, (0, 0))
+                oracle[k] = (c + 1, s + v)
+
+        feed(250)
+        cc.barrier()
+        # the kill lands between the data and the barrier broadcast:
+        # the barrier must recover the node in place and commit
+        feed(150)
+        before = len(EVENT_LOG.events(kind="recovery"))
+        cc.kill9(1)
+        cc.barrier()
+        recoveries = [
+            e
+            for e in EVENT_LOG.events(kind="recovery")[before:]
+            if e.get("mode") == "node"
+        ]
+        assert len(recoveries) == 1, recoveries
+        assert recoveries[0]["node"] == 1
+        assert cc.node_breakers[1].state == "closed"  # healthy again
+
+        feed(100)
+        cc.barrier()
+        out = cc.query("SELECT auction, c, s FROM m", order_by="auction")
+        got = {
+            int(a): (int(c), int(s))
+            for a, c, s in zip(out["auction"], out["c"], out["s"])
+        }
+        assert got == oracle  # converged to the undisturbed result
+    finally:
+        cc.close()

@@ -1,0 +1,455 @@
+"""Transient-fault resilience layer (reference: src/object_store/'s
+retrying monitored wrapper + the madsim fault-injection tier):
+RetryPolicy bounds, CircuitBreaker lifecycle, the RetryingObjectStore
+durability boundary, degraded-mode checkpointing in the runtime, and
+offset-anchored source-read retry."""
+
+import time
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.event_log import EVENT_LOG
+from risingwave_tpu.executors.materialize import MaterializeExecutor
+from risingwave_tpu.metrics import REGISTRY
+from risingwave_tpu.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    DeltaSpill,
+    RetryBudgetExceeded,
+    RetryingObjectStore,
+    RetryPolicy,
+    TransientStoreError,
+)
+from risingwave_tpu.runtime.pipeline import Pipeline
+from risingwave_tpu.runtime.runtime import StreamingRuntime
+from risingwave_tpu.sim import FlakyStore
+from risingwave_tpu.storage.object_store import MemObjectStore
+from risingwave_tpu.storage.state_table import CheckpointManager, StateDelta
+
+pytestmark = pytest.mark.smoke
+
+
+def _fast_policy(**kw):
+    d = dict(
+        max_attempts=4, base_backoff_s=1e-4, max_backoff_s=1e-3,
+        deadline_s=5.0,
+    )
+    d.update(kw)
+    return RetryPolicy(**d)
+
+
+# -- RetryPolicy -----------------------------------------------------------
+def test_retry_policy_retries_transient_then_succeeds():
+    p = _fast_policy()
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TransientStoreError("blip")
+        return "ok"
+
+    assert p.run(fn, op="t") == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_policy_fatal_errors_propagate_immediately():
+    p = _fast_policy()
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise FileNotFoundError("semantic miss, not transient")
+
+    with pytest.raises(FileNotFoundError):
+        p.run(fn, op="t")
+    assert len(calls) == 1  # no retry burned on a fatal error
+
+
+def test_retry_policy_attempt_budget_bounds():
+    p = _fast_policy(max_attempts=3)
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise TransientStoreError("down")
+
+    with pytest.raises(RetryBudgetExceeded) as ei:
+        p.run(fn, op="t")
+    assert len(calls) == 3
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.last_error, TransientStoreError)
+
+
+def test_retry_policy_deadline_bounds_with_fake_clock():
+    """Provably deadline-bounded: with a fake clock, the loop must stop
+    as soon as elapsed + next backoff crosses the deadline — no sleep
+    may ever run past it."""
+    p = RetryPolicy(
+        max_attempts=1000, base_backoff_s=1.0, max_backoff_s=1.0,
+        jitter_frac=0.0, deadline_s=3.5,
+    )
+    now = [0.0]
+    sleeps = []
+
+    def clock():
+        return now[0]
+
+    def sleep(s):
+        sleeps.append(s)
+        now[0] += s
+
+    def fn():
+        raise TransientStoreError("down forever")
+
+    with pytest.raises(RetryBudgetExceeded) as ei:
+        p.run(fn, op="t", clock=clock, sleep=sleep)
+    assert sum(sleeps) < 3.5  # never slept past the deadline
+    assert ei.value.attempts < 1000  # deadline, not attempts, stopped it
+
+
+def test_retry_backoff_deterministic_for_seed():
+    import random
+
+    a = RetryPolicy(seed=9)
+    b = RetryPolicy(seed=9)
+    ra, rb = random.Random(9), random.Random(9)
+    sched_a = [a.backoff_s(i, ra) for i in range(1, 6)]
+    sched_b = [b.backoff_s(i, rb) for i in range(1, 6)]
+    assert sched_a == sched_b  # seeded jitter replays exactly
+    assert all(s <= a.max_backoff_s for s in sched_a)
+
+
+def test_from_env_set_env_wins_over_caller_defaults(monkeypatch):
+    """RW_RETRY_* is the operator's no-restart escape hatch: a SET env
+    var must win even over a caller's pinned defaults; unset knobs fall
+    back to those defaults."""
+    monkeypatch.setenv("RW_RETRY_MAX_ATTEMPTS", "12")
+    p = RetryPolicy.from_env(max_attempts=3, deadline_s=4.0)
+    assert p.max_attempts == 12  # env wins
+    assert p.deadline_s == 4.0  # unset knob: caller default holds
+    monkeypatch.delenv("RW_RETRY_MAX_ATTEMPTS")
+    assert RetryPolicy.from_env(max_attempts=3).max_attempts == 3
+    monkeypatch.setenv("RW_BREAKER_THRESHOLD", "9")
+    br = CircuitBreaker.from_env("t_env", failure_threshold=2)
+    assert br.failure_threshold == 9
+
+
+# -- CircuitBreaker --------------------------------------------------------
+def test_breaker_lifecycle_and_events():
+    now = [0.0]
+    br = CircuitBreaker(
+        "t_lifecycle", failure_threshold=2, cooldown_s=1.0,
+        clock=lambda: now[0],
+    )
+    seq0 = len(EVENT_LOG.events(kind="breaker"))
+    assert br.allow() and br.state == "closed"
+    br.record_failure()
+    assert br.state == "closed"  # below threshold
+    br.record_failure()
+    assert br.state == "open" and not br.allow()
+    now[0] += 1.1  # cooldown elapses -> half-open probe allowed
+    assert br.allow() and br.state == "half_open"
+    br.record_failure()  # probe failed -> reopen
+    assert br.state == "open"
+    now[0] += 1.1
+    assert br.allow()
+    br.record_success()
+    assert br.state == "closed"
+    kinds = [
+        (e["frm"], e["to"])
+        for e in EVENT_LOG.events(kind="breaker")[seq0:]
+        if e["name"] == "t_lifecycle"
+    ]
+    assert kinds == [
+        ("closed", "open"),
+        ("open", "half_open"),
+        ("half_open", "open"),
+        ("open", "half_open"),
+        ("half_open", "closed"),
+    ]
+    assert (
+        REGISTRY.counter("breaker_transitions_total").get(
+            name="t_lifecycle", to="open"
+        )
+        >= 2
+    )
+
+
+# -- RetryingObjectStore ---------------------------------------------------
+def test_retrying_store_absorbs_flaky_faults():
+    disk = MemObjectStore()
+    rs = RetryingObjectStore(
+        FlakyStore(disk, rate=0.4, seed=11),
+        _fast_policy(max_attempts=10),
+    )
+    for i in range(30):
+        rs.put(f"k{i}", bytes([i]))
+    assert [rs.read(f"k{i}") for i in range(30)] == [
+        bytes([i]) for i in range(30)
+    ]
+    assert rs.inner.faults > 0  # the storm actually fired
+
+
+def test_retrying_store_breaker_opens_and_fast_fails():
+    class Down:
+        def put(self, path, data):
+            raise TransientStoreError("down")
+
+    br = CircuitBreaker("t_store", failure_threshold=3, cooldown_s=60.0)
+    rs = RetryingObjectStore(Down(), _fast_policy(max_attempts=3), br)
+    with pytest.raises(RetryBudgetExceeded):
+        rs.put("a", b"x")  # 3 attempts = 3 failures -> breaker opens
+    assert br.state == "open"
+    with pytest.raises(CircuitOpenError):
+        rs.put("b", b"y")  # fast-fail: no attempt reaches the store
+
+
+def test_retrying_store_never_catches_crashpoint():
+    from risingwave_tpu.sim import CrashingStore, CrashPoint
+
+    crashing = CrashingStore(MemObjectStore())
+    crashing.arm(1)
+    rs = RetryingObjectStore(crashing, _fast_policy())
+    with pytest.raises(CrashPoint):
+        rs.put("a", b"x")  # a process death is NOT retried
+
+
+# -- DeltaSpill ------------------------------------------------------------
+def test_delta_spill_roundtrip(tmp_path):
+    spill = DeltaSpill(str(tmp_path))
+    d = StateDelta(
+        "t1",
+        {"k": np.array([1, 2], np.int64)},
+        {"v": np.array([1.5, 2.5], np.float64)},
+        np.array([False, True]),
+        ("k",),
+    )
+    spill.spill(7 << 16, [d])
+    assert spill.epochs() == [7 << 16]
+    (back,) = spill.load(7 << 16)
+    assert back.table_id == "t1" and back.key_order == ("k",)
+    np.testing.assert_array_equal(back.key_cols["k"], d.key_cols["k"])
+    np.testing.assert_array_equal(back.value_cols["v"], d.value_cols["v"])
+    np.testing.assert_array_equal(back.tombstone, d.tombstone)
+    spill.remove(7 << 16)
+    assert spill.epochs() == []
+
+
+# -- degraded-mode runtime -------------------------------------------------
+class ToggleStore(MemObjectStore):
+    """MemObjectStore with a kill switch: while ``down``, every op is a
+    transient fault (the hard-down blob store)."""
+
+    def __init__(self):
+        super().__init__()
+        self.down = False
+
+    def _gate(self):
+        if self.down:
+            raise TransientStoreError("store is down")
+
+    def put(self, path, data):
+        self._gate()
+        super().put(path, data)
+
+    def read(self, path):
+        self._gate()
+        return super().read(path)
+
+    def read_range(self, path, off, length):
+        self._gate()
+        return super().read_range(path, off, length)
+
+    def exists(self, path):
+        self._gate()
+        return super().exists(path)
+
+    def list(self, prefix):
+        self._gate()
+        return super().list(prefix)
+
+    def delete(self, path):
+        self._gate()
+        super().delete(path)
+
+
+def _chunk(ids, vals, cap=8):
+    return StreamChunk.from_numpy(
+        {"id": np.asarray(ids, np.int64), "v": np.asarray(vals, np.int64)},
+        cap,
+    )
+
+
+def test_runtime_degrades_spills_and_restores(tmp_path):
+    """The acceptance path: breaker opens mid-epoch -> the runtime
+    keeps serving queries from live state, spills checkpoint deltas
+    locally, pauses compaction; when the store heals the spill replays
+    in order, sinks release, and the manifest catches up — with
+    degraded/restored/breaker transitions visible in the event log."""
+    toggle = ToggleStore()
+    breaker = CircuitBreaker(
+        "t_degraded", failure_threshold=2, cooldown_s=0.2
+    )
+    store = RetryingObjectStore(
+        toggle, _fast_policy(max_attempts=2, deadline_s=1.0), breaker
+    )
+    rt = StreamingRuntime(
+        store,
+        async_checkpoint=False,
+        checkpoint_frequency=1,
+        degraded_dir=str(tmp_path / "spill"),
+    )
+    assert rt.store_breaker is breaker  # pre-wrapped store adopts it
+    mv = MaterializeExecutor(pk=["id"], columns=["v"], table_id="mv_dg")
+    rt.register("f", Pipeline([mv]))
+
+    seq0 = len(EVENT_LOG.events())
+    rt.push("f", _chunk([1, 2], [10, 20]))
+    rt.barrier()  # epoch 1: durable while healthy
+    e1 = rt.mgr.max_committed_epoch
+    assert e1 > 0 and not rt.degraded
+
+    toggle.down = True
+    rt.push("f", _chunk([3], [30]))
+    rt.barrier()  # breaker opens mid-epoch -> degrade, no raise
+    assert rt.degraded and breaker.state == "open"
+    assert len(rt._spill.epochs()) == 1
+    assert rt._compact_pause.is_set()  # compaction paused
+    # queries still answer from live/HBM state (all three epochs' rows)
+    assert mv.snapshot()[(3,)] == (30,)
+    rt.push("f", _chunk([4], [40]))
+    rt.barrier()  # still down: spills directly, no store touch
+    assert len(rt._spill.epochs()) == 2
+    assert rt.mgr.max_committed_epoch == e1  # manifest frozen at e1
+
+    toggle.down = False
+    time.sleep(0.25)  # let the breaker cooldown elapse
+    rt.push("f", _chunk([5], [50]))
+    rt.barrier()  # probe half-opens, replays the spill, commits live
+    assert not rt.degraded and breaker.state == "closed"
+    assert rt._spill.epochs() == []
+    assert rt.mgr.max_committed_epoch > e1
+    assert not rt._compact_pause.is_set()
+
+    events = EVENT_LOG.events()[seq0:]
+    kinds = [e["kind"] for e in events]
+    assert "degraded" in kinds and "restored" in kinds
+    restored = [e for e in events if e["kind"] == "restored"][-1]
+    assert restored["epochs_replayed"] == 2
+    opens = [
+        e for e in events
+        if e["kind"] == "breaker" and e.get("name") == "t_degraded"
+    ]
+    assert ("closed", "open") in [(e["frm"], e["to"]) for e in opens]
+    assert ("half_open", "closed") in [(e["frm"], e["to"]) for e in opens]
+    assert REGISTRY.counter("degraded_entries_total").get() >= 1
+
+    # the replayed manifest is complete: a fresh recovery sees ALL rows
+    mv2 = MaterializeExecutor(pk=["id"], columns=["v"], table_id="mv_dg")
+    CheckpointManager(toggle).recover([mv2])
+    assert mv2.snapshot() == mv.snapshot()
+    assert sorted(mv2.snapshot()) == [(1,), (2,), (3,), (4,), (5,)]
+
+
+def test_runtime_recover_discards_stale_spill(tmp_path):
+    """recover() lands on the last DURABLE manifest; a degraded spill
+    of rolled-back epochs must be discarded (sources replay), never
+    replayed on top of the restored state."""
+    toggle = ToggleStore()
+    rt = StreamingRuntime(
+        RetryingObjectStore(
+            toggle,
+            _fast_policy(max_attempts=2, deadline_s=1.0),
+            CircuitBreaker("t_discard", failure_threshold=1, cooldown_s=99),
+        ),
+        async_checkpoint=False,
+        degraded_dir=str(tmp_path / "spill"),
+    )
+    mv = MaterializeExecutor(pk=["id"], columns=["v"], table_id="mv_dc")
+    rt.register("f", Pipeline([mv]))
+    rt.push("f", _chunk([1], [10]))
+    rt.barrier()
+    toggle.down = True
+    rt.push("f", _chunk([2], [20]))
+    rt.barrier()
+    assert rt.degraded and rt._spill.epochs()
+    toggle.down = False
+    rt.recover()
+    assert not rt.degraded and rt._spill.epochs() == []
+    assert mv.snapshot() == {(1,): (10,)}  # epoch 2 rolled back cleanly
+
+
+# -- source read retry -----------------------------------------------------
+def test_source_poll_retries_anchored_at_offset():
+    """A transient read fault mid-poll retries from the SAME offset:
+    output and committed offsets match an undisturbed twin exactly (no
+    skipped or double-counted events)."""
+    from risingwave_tpu.connectors.nexmark import NexmarkConfig
+    from risingwave_tpu.connectors.source import NexmarkSourceExecutor
+
+    calm = NexmarkSourceExecutor(NexmarkConfig(), split_num=2)
+    flaky = NexmarkSourceExecutor(
+        NexmarkConfig(), split_num=2, retry_policy=_fast_policy()
+    )
+    g = flaky.splits[0]
+    orig = g.next_chunks
+    fails = [2]
+
+    def flaky_next(n, cap):
+        if fails[0] > 0:
+            fails[0] -= 1
+            # fail AFTER consuming some events: the un-anchored retry
+            # would skip them
+            orig(max(1, n // 2), cap)
+            raise TransientStoreError("connector blip")
+        return orig(n, cap)
+
+    g.next_chunks = flaky_next
+    want = calm.poll(300, 512)
+    got = flaky.poll(300, 512)
+    assert fails[0] == 0  # the fault actually fired (twice)
+    assert [s.offset for s in calm.splits] == [
+        s.offset for s in flaky.splits
+    ]
+    for stream in ("person", "auction", "bid"):
+        assert len(want[stream]) == len(got[stream])
+        for cw, cg in zip(want[stream], got[stream]):
+            for k, v in cw.to_numpy().items():
+                np.testing.assert_array_equal(v, cg.to_numpy()[k])
+
+
+# -- bounded manager read retry (satellite) --------------------------------
+def test_manager_read_retry_is_deadline_bounded():
+    """_read_retry must give up within the policy budget instead of
+    spinning on a wedged manifest race, and expose attempts via the
+    retry metrics."""
+    mgr = CheckpointManager(
+        MemObjectStore(),
+        read_retry=RetryPolicy(
+            max_attempts=3, base_backoff_s=1e-4, max_backoff_s=1e-3,
+            deadline_s=2.0,
+        ),
+    )
+    d = StateDelta(
+        "t", {"k": np.array([1], np.int64)},
+        {"v": np.array([2], np.int64)}, np.array([False]), ("k",),
+    )
+    mgr.commit_staged(1 << 16, [d])
+    before = REGISTRY.counter("retries_total").get(op="storage.read")
+    calls = []
+
+    def wedged():
+        calls.append(1)
+        raise ValueError("decode race that never heals")
+
+    with pytest.raises(RetryBudgetExceeded):
+        mgr._read_retry(wedged)
+    assert len(calls) == 3  # bounded, not an unbounded spin
+    after = REGISTRY.counter("retries_total").get(op="storage.read")
+    assert after - before == 3
+    # and KeyError (user error) still surfaces immediately, unretried
+    with pytest.raises(KeyError):
+        mgr._read_retry(lambda: (_ for _ in ()).throw(KeyError("bad")))

@@ -69,3 +69,108 @@ def test_rolled_back_epoch_never_published(tmp_path):
     coord.run_once(up_to=2 << 16)
     assert sink.read_committed(2 << 16) == [((9,), (99,), 0)]
     assert sink.committed_epochs() == [1 << 16, 2 << 16]
+
+
+class FlakyTwoPhaseSink(FileTwoPhaseSink):
+    """A flaky external coordinator: the first ``fail_prepares`` /
+    ``fail_commits`` calls of each phase raise a transient fault."""
+
+    def __init__(self, root, fail_prepares=0, fail_commits=0):
+        super().__init__(root)
+        self.fail_prepares = fail_prepares
+        self.fail_commits = fail_commits
+        self.faults = 0
+
+    def prepare(self, rows, epoch):
+        if self.fail_prepares > 0:
+            self.fail_prepares -= 1
+            self.faults += 1
+            raise TransientStoreError("flaky coordinator: prepare")
+        super().prepare(rows, epoch)
+
+    def commit_prepared(self, epoch):
+        if self.fail_commits > 0:
+            self.fail_commits -= 1
+            self.faults += 1
+            raise TransientStoreError("flaky coordinator: commit")
+        super().commit_prepared(epoch)
+
+
+from risingwave_tpu.resilience import (  # noqa: E402
+    RetryBudgetExceeded,
+    RetryPolicy,
+    TransientStoreError,
+)
+
+_FAST = RetryPolicy(
+    max_attempts=6, base_backoff_s=1e-4, max_backoff_s=1e-3, deadline_s=5.0
+)
+
+
+def test_flaky_coordinator_exactly_once(tmp_path):
+    """Satellite: a flaky coordinator (transient prepare AND commit
+    failures mid-drain) must still yield exactly-once sink output after
+    retry — no duplicate, no lost commit."""
+    log = KvLogStore(MemObjectStore(), "s_flaky")
+    sink = FlakyTwoPhaseSink(
+        str(tmp_path), fail_prepares=2, fail_commits=2
+    )
+    coord = SinkCoordinator(log, sink, retry_policy=_FAST)
+    for e in (1, 2, 3):
+        log.append(e << 16, _batch(e))
+    n = coord.run_once(up_to=3 << 16)
+    assert sink.faults == 4  # both phases actually flaked
+    assert n == 3  # delivered across retries, counted once each
+    assert sink.committed_epochs() == [1 << 16, 2 << 16, 3 << 16]
+    for e in (1, 2, 3):
+        assert sink.read_committed(e << 16) == _batch(e)
+    assert log.committed_offset() == 3 << 16
+    # idempotent rerun: nothing pending, nothing re-published
+    assert coord.run_once(up_to=3 << 16) == 0
+
+
+def test_flaky_coordinator_bounded_giveup(tmp_path):
+    """A coordinator that stays down exhausts the retry budget and
+    surfaces — having delivered nothing externally visible."""
+    log = KvLogStore(MemObjectStore(), "s_down")
+    sink = FlakyTwoPhaseSink(str(tmp_path), fail_commits=10**6)
+    coord = SinkCoordinator(
+        log, sink,
+        retry_policy=RetryPolicy(
+            max_attempts=3, base_backoff_s=1e-4, deadline_s=1.0
+        ),
+    )
+    log.append(1 << 16, _batch(1))
+    with pytest.raises(RetryBudgetExceeded):
+        coord.run_once(up_to=1 << 16)
+    assert sink.committed_epochs() == []  # nothing published
+    assert log.committed_offset() == 0  # offset never ran ahead
+    # heal -> the SAME epoch delivers exactly once
+    sink.fail_commits = 0
+    assert coord.run_once(up_to=1 << 16) == 1
+    assert sink.committed_epochs() == [1 << 16]
+
+
+def test_crash_between_prepare_and_commit_with_flaky_replay(tmp_path):
+    """Satellite: crash lands BETWEEN prepare and commit; the replaying
+    coordinator is itself flaky — recovery aborts the stage, the
+    retried replay re-prepares and publishes exactly once."""
+    log = KvLogStore(MemObjectStore(), "s_crash")
+    sink = FlakyTwoPhaseSink(str(tmp_path))
+    coord = SinkCoordinator(log, sink, retry_policy=_FAST)
+    log.append(1 << 16, _batch(1))
+    sink.prepare(log.read(1 << 16), 1 << 16)
+    # -- crash here: staged, never committed, offset never advanced --
+    sink2 = FlakyTwoPhaseSink(str(tmp_path), fail_prepares=1, fail_commits=1)
+    coord2 = SinkCoordinator(log, sink2, retry_policy=_FAST)
+    coord2.recover()  # aborts the staged epoch
+    import os
+
+    assert not os.path.exists(sink2._staging(1 << 16))
+    assert coord2.run_once(up_to=1 << 16) == 1
+    assert sink2.committed_epochs() == [1 << 16]
+    assert sink2.read_committed(1 << 16) == _batch(1)
+    # a second replay after the publish is a no-op (no duplicates)
+    coord2.recover()
+    assert coord2.run_once(up_to=1 << 16) == 0
+    assert sink2.committed_epochs() == [1 << 16]
