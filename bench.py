@@ -78,6 +78,41 @@ def cpu_actor_q8(stream, window_ms):
     return n_rows / dt, out
 
 
+def _rwlint_gate(query: str) -> None:
+    """Static plan verification BEFORE the bench runs (strict): a
+    provably-broken plan fails the child with RW-E### diagnostics
+    instead of burning a tier on wrong numbers. Lints the same
+    small-capacity twin `lint --all-nexmark` verifies (the verifier is
+    static, so plan shape is all that matters — analysis/)."""
+    from risingwave_tpu.analysis.lint import (
+        NEXMARK_SOURCE_SCHEMAS,
+        build_nexmark_corpus,
+        lint_pipeline,
+    )
+
+    built = build_nexmark_corpus(only=query)
+    if query not in built:
+        return
+    lint_pipeline(
+        built[query].pipeline,
+        NEXMARK_SOURCE_SCHEMAS[query],
+        name=query,
+        strict=True,
+    )
+
+
+def _recompile_watch():
+    """Armed AFTER the warmup pass: steady-state kernel cache deltas
+    land in the BENCH JSON (``*_recompiles``) and in
+    ``recompiles_total{fn=...}`` — nonzero means the run was re-tracing
+    fused steps mid-measurement."""
+    from risingwave_tpu.analysis.jax_sanitizer import RecompileWatch
+
+    w = RecompileWatch()
+    w.snapshot()
+    return w
+
+
 def _state_cap(expected_rows: int, floor: int) -> int:
     """Table capacity whose growth margin covers the expected volume:
     growth REBUILDS tables at new capacities, and every new capacity
@@ -98,6 +133,7 @@ def bench_q8(gen_cfg, epochs, events_per_epoch, chunk_events):
     from risingwave_tpu.connectors.nexmark import NexmarkConfig, NexmarkGenerator
     from risingwave_tpu.queries.nexmark_q import Q8_WINDOW_MS, build_q8
 
+    _rwlint_gate("q8")  # static: fail BEFORE generating the event stream
     gen = NexmarkGenerator(NexmarkConfig(**gen_cfg))
     host_stream = []  # [(side, cols)] in arrival order, per epoch
     epochs_stream = []
@@ -173,6 +209,7 @@ def bench_q8(gen_cfg, epochs, events_per_epoch, chunk_events):
         (q8.pipeline.push_left if side == "p" else q8.pipeline.push_right)(c)
     q8.pipeline.barrier()
     q8 = build_q8(capacity=c8, fanout=8, out_cap=1 << 14)
+    recompiles = _recompile_watch()
 
     barrier_times = []
     t0 = time.perf_counter()
@@ -201,6 +238,7 @@ def bench_q8(gen_cfg, epochs, events_per_epoch, chunk_events):
             float(np.percentile(np.asarray(barrier_times) * 1e3, 99)), 2
         ),
         "q8_correct": ok,
+        "q8_recompiles": recompiles.deltas(),
     }
 
 
@@ -241,6 +279,7 @@ def bench_q7(gen_cfg, epochs, events_per_epoch, chunk_events):
     from risingwave_tpu.connectors.nexmark import NexmarkConfig, NexmarkGenerator
     from risingwave_tpu.queries.nexmark_q import build_q7
 
+    _rwlint_gate("q7")  # static: fail BEFORE generating the event stream
     window_ms = 10_000
     gen = NexmarkGenerator(NexmarkConfig(**gen_cfg))
     host_epochs = []
@@ -298,6 +337,7 @@ def bench_q7(gen_cfg, epochs, events_per_epoch, chunk_events):
     q7 = mk_q7()
     run(q7, mk()[:1])  # warmup epoch: compile everything
 
+    recompiles = _recompile_watch()
     q7 = mk_q7()
     dt, barrier_times = run(q7, mk())
 
@@ -317,6 +357,7 @@ def bench_q7(gen_cfg, epochs, events_per_epoch, chunk_events):
             float(np.percentile(np.asarray(barrier_times) * 1e3, 99)), 2
         ),
         "q7_correct": ok,
+        "q7_recompiles": recompiles.deltas(),
     }
 
 
@@ -482,6 +523,8 @@ def bench_q5(args_epochs, events_per_epoch, chunk_events, smoke, agg_mode):
     if smoke:
         jax.config.update("jax_platforms", "cpu")
 
+    _rwlint_gate("q5")  # static: fail BEFORE generating the event stream
+
     import numpy as np
 
     from risingwave_tpu.connectors.nexmark import NexmarkConfig, NexmarkGenerator
@@ -564,6 +607,7 @@ def bench_q5(args_epochs, events_per_epoch, chunk_events, smoke, agg_mode):
     from risingwave_tpu.metrics import REGISTRY
 
     REGISTRY.histograms.pop("barrier_stage_ms", None)  # drop warmup obs
+    recompiles = _recompile_watch()
     stacked = mk_stacked()
     q5, dt, barrier_times = run_q5(stacked)
 
@@ -606,6 +650,7 @@ def bench_q5(args_epochs, events_per_epoch, chunk_events, smoke, agg_mode):
         "q5_achieved_bw_gbps": rf["achieved_bw_gbps"],
         "q5_hbm_peak_gbps": rf["hbm_peak_gbps"],
         "q5_barrier_stage_ms": stage_breakdown(),
+        "q5_recompiles": recompiles.deltas(),
     }
 
 

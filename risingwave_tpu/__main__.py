@@ -10,14 +10,13 @@ metrics endpoint; `CREATE TABLE` / `CREATE MATERIALIZED VIEW` /
 from __future__ import annotations
 
 import argparse
+import os
 import threading
 import time
 
 
 def serve(args) -> None:
     if args.device == "cpu":
-        import os
-
         os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
 
@@ -46,12 +45,20 @@ def serve(args) -> None:
         runtime.auto_recover = True
     from risingwave_tpu.storage.meta_backup import DDL_PATH
 
+    # config sets the baseline; a SET RW_STRICT_LINT wins (the same
+    # no-restart escape-hatch precedence as the [resilience] knobs) —
+    # passing None lets SqlSession resolve the env default itself
+    strict = (
+        None
+        if "RW_STRICT_LINT" in os.environ
+        else (cfg.streaming.strict_lint if cfg is not None else None)
+    )
     if store is not None and store.exists(DDL_PATH):
         # warm restart: replay the DDL log, recover state (meta_backup)
-        session = SqlSession.restore(runtime)
+        session = SqlSession.restore(runtime, strict_lint=strict)
         print(f"restored {len(session.meta.ddl())} DDL statements")
     else:
-        session = SqlSession(Catalog({}), runtime)
+        session = SqlSession(Catalog({}), runtime, strict_lint=strict)
     pg = PgServer(session, port=args.port).start()
     mport = REGISTRY.serve(args.metrics_port)
     print(
@@ -128,6 +135,28 @@ def main() -> None:
         "the host backend",
     )
     s.set_defaults(fn=serve)
+    ln = sub.add_parser(
+        "lint",
+        help="rwlint: static plan verifier + JAX compilation sanitizer "
+        "over SQL files and/or the built-in Nexmark queries "
+        "(analysis/; exit 0 = no errors)",
+    )
+    ln.add_argument(
+        "paths", nargs="*", help="SQL files (DDL is executed in-memory)"
+    )
+    ln.add_argument(
+        "--all-nexmark",
+        action="store_true",
+        help="lint every built-in Nexmark query pipeline (q5/q7/q8)",
+    )
+    ln.add_argument(
+        "--deep",
+        action="store_true",
+        help="also trace jaxprs: dtype promotions, 64-bit hash "
+        "arithmetic (no XLA compiles)",
+    )
+    ln.add_argument("--json", action="store_true")
+    ln.set_defaults(fn=_lint)
     cn = sub.add_parser(
         "compute-node",
         help="start a compute-node role behind a TCP wire "
@@ -145,6 +174,21 @@ def _compute_node(args) -> None:
     from risingwave_tpu.cluster.compute_node import run
 
     run(args.port, args.state_dir, args.device)
+
+
+def _lint(args) -> None:
+    # lint never touches the TPU: forcing CPU keeps a CI lint run from
+    # grabbing the single-client tunnel (same dance as serve --device)
+    import os
+    import sys
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from risingwave_tpu.analysis.lint import run_cli
+
+    sys.exit(run_cli(args))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,65 @@
+"""rwlint — static plan-graph verifier + JAX compilation sanitizer.
+
+The invariants the planner -> fragmenter -> executor pipeline ASSUMES
+but (before this package) never verified become DDL-time checks:
+
+- ``plan_verifier``: walks the fragment DAG / executor chains and
+  checks per-channel schema + dtype agreement, distribution-key <->
+  downstream keyed-state alignment across every hash exchange,
+  state-table pk coverage, watermark-column reachability for
+  window-keyed state cleaning, channel wiring, and barrier-DAG
+  acyclicity — emitting ``RW-E###`` diagnostics with fragment/executor
+  provenance instead of runtime corruption (TiLT, arxiv 2301.12030:
+  typed-IR stream plans make these statically checkable; Shared
+  Arrangements, arxiv 1812.02639: key alignment IS the soundness
+  invariant of shared keyed state).
+- ``jax_sanitizer``: inspects the jaxprs of compiled step functions
+  (64-bit promotion / non-32-bit hash arithmetic / missing buffer
+  donation), guards the per-barrier device step against implicit
+  host<->device transfers, and fingerprints per-executor abstract
+  input signatures across epochs to catch recompile storms.
+- ``lint``: the entry points — ``lint_planned`` (the CREATE-MV hook),
+  ``lint_pipeline`` (hand-built pipelines: bench, tests), SQL-file
+  and all-Nexmark linting behind ``python -m risingwave_tpu lint``.
+
+The package ``__init__`` is LAZY: runtime modules (pipeline/graph)
+import ``analysis.jax_sanitizer`` on their hot paths, and an eager
+re-export here would cycle through plan_verifier -> executors ->
+pipeline.
+"""
+
+from __future__ import annotations
+
+_EXPORTS = {
+    "Diagnostic": "diagnostics",
+    "PlanLintError": "diagnostics",
+    "CODES": "diagnostics",
+    "verify_planned": "plan_verifier",
+    "verify_graph_specs": "plan_verifier",
+    "lint_planned": "lint",
+    "lint_pipeline": "lint",
+    "lint_sql_file": "lint",
+    "lint_all_nexmark": "lint",
+    "transfer_guard": "jax_sanitizer",
+    "RecompileWatch": "jax_sanitizer",
+    "SignatureWatch": "jax_sanitizer",
+    "SIGNATURES": "jax_sanitizer",
+    "check_promotions": "jax_sanitizer",
+    "check_hash_path_32bit": "jax_sanitizer",
+    "check_donation": "jax_sanitizer",
+}
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(f"{__name__}.{mod}"), name)
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -1,0 +1,114 @@
+"""Structured lint diagnostics — the ``RW-E###`` vocabulary.
+
+One code = one invariant. Codes are STABLE API (tests assert them, the
+README tables them); add new ones, never renumber. Families:
+
+- RW-E1xx  per-channel schema / dtype agreement
+- RW-E2xx  distribution-key / join-key alignment (exchange soundness)
+- RW-E3xx  dtype promotion & hash-path width (x64-portability)
+- RW-E4xx  compilation hygiene (donation, transfers, recompiles)
+- RW-E5xx  watermark propagation / state-cleaning reachability
+- RW-E6xx  fragment-graph wiring (channels, cycles, reachability)
+- RW-E7xx  state tables (pk coverage, table-id uniqueness)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Sequence
+
+CODES = {
+    # verifier self-diagnostics
+    "RW-E001": "executor lint_info() raised — treated as opaque, "
+    "verification degraded past this executor",
+    # schema / dtype agreement
+    "RW-E101": "executor reads a column its input channel does not carry",
+    "RW-E102": "column dtype disagrees with the executor's declared dtype",
+    # key alignment across exchanges / joins
+    "RW-E201": "hash-dispatch key missing from the upstream fragment's output",
+    "RW-E202": "dispatch keys do not cover the parallel fragment's state keys",
+    "RW-E203": "non-hash dispatch feeds a parallel fragment with keyed state",
+    "RW-E204": "join key dtypes disagree between the left and right sides",
+    # dtype promotion / hashing width
+    "RW-E301": "implicit 32->64-bit promotion inside a compiled step",
+    "RW-E302": "hash path performs 64-bit arithmetic (x64/platform dependent)",
+    # compilation hygiene
+    "RW-E401": "state-carrying kernel compiled without buffer donation",
+    "RW-E402": "implicit host<->device transfer inside the device step",
+    "RW-E403": "shape-unstable executor: abstract input signature changed "
+    "after warmup (recompile hazard)",
+    # watermark propagation
+    "RW-E501": "window-keyed state cleaning on a column no watermark can reach",
+    # fragment-graph wiring
+    "RW-E601": "channel references an unknown upstream fragment",
+    "RW-E602": "duplicate channel between the same fragment pair and port",
+    "RW-E603": "fragment graph contains a cycle (barriers can never align)",
+    "RW-E604": "fragment output is never consumed and is not the sink",
+    "RW-E605": "declared output/source fragment does not exist",
+    # state tables
+    "RW-E701": "state-table primary key not covered by the input schema",
+    "RW-E702": "duplicate state table_id within one plan",
+}
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One finding, with fragment/executor provenance."""
+
+    code: str
+    message: str
+    fragment: str = ""
+    executor: str = ""
+    severity: str = "error"  # "error" | "warning"
+
+    def __post_init__(self):
+        if self.code not in CODES:
+            raise ValueError(f"unknown diagnostic code {self.code!r}")
+
+    def render(self) -> str:
+        where = []
+        if self.fragment:
+            where.append(f"frag={self.fragment}")
+        if self.executor:
+            where.append(f"ex={self.executor}")
+        loc = f" [{' '.join(where)}]" if where else ""
+        return f"{self.code}{loc} {self.message}"
+
+
+@dataclass
+class LintReport:
+    """Collector threaded through the verifier passes."""
+
+    diagnostics: List[Diagnostic] = field(default_factory=list)
+
+    def add(
+        self,
+        code: str,
+        message: str,
+        fragment: str = "",
+        executor: str = "",
+        severity: str = "error",
+    ) -> None:
+        self.diagnostics.append(
+            Diagnostic(code, message, fragment, executor, severity)
+        )
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == "error"]
+
+    def render(self) -> str:
+        return "\n".join(d.render() for d in self.diagnostics)
+
+
+class PlanLintError(ValueError):
+    """strict_lint promotion: DDL is refused with every finding listed."""
+
+    def __init__(self, diagnostics: Sequence[Diagnostic], name: str = ""):
+        self.diagnostics = list(diagnostics)
+        what = f" for {name!r}" if name else ""
+        lines = "\n  ".join(d.render() for d in self.diagnostics)
+        super().__init__(
+            f"plan verification failed{what} "
+            f"({len(self.diagnostics)} finding(s)):\n  {lines}"
+        )

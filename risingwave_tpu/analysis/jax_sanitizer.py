@@ -1,0 +1,421 @@
+"""Part B — the JAX compilation sanitizer.
+
+Four independent hygiene checks over the compiled/compilable surface of
+a plan (none of them run XLA — tracing and lowering only):
+
+- ``check_promotions``: trace a step function and flag implicit
+  32->64-bit ``convert_element_type`` eqns (RW-E301). On TPU an
+  accidental f64/i64 lane doubles HBM traffic and can silently fall
+  off the fast paths.
+- ``check_hash_path_32bit``: the hash chain must be pure 32-bit
+  arithmetic — any 64-bit add/mul/shift/bitand inside it means the
+  result depends on ``jax_enable_x64`` / platform promotion rules
+  (RW-E302). 64-bit inputs may only enter via ``bitcast_convert_type``
+  into uint32 lanes.
+- ``check_donation``: a state-carrying kernel lowered WITHOUT buffer
+  donation holds two copies of its state alive per step (RW-E401).
+- ``transfer_guard``: context manager arming ``jax.transfer_guard``
+  around the per-barrier device step (RW_TRANSFER_GUARD env, default
+  off; tests arm it) so implicit host<->device transfers raise at the
+  exact step that issued them (RW-E402 is the lint-side code).
+
+Plus the recompile instrumentation:
+
+- ``RecompileWatch``: snapshots the jit-cache sizes of the registered
+  step kernels; a steady-state delta is a recompile storm in the
+  making. Deltas feed ``recompiles_total{fn=...}`` (metrics.py).
+- ``SignatureWatch`` / ``SIGNATURES``: fingerprints each executor's
+  abstract input signature (shapes+dtypes, the jit cache key's data
+  part) per chunk; a NEW fingerprint after ``mark_stable()`` is a
+  shape-unstable executor (RW-E403) — reported to metrics + event log.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+import jax
+
+from risingwave_tpu.analysis.diagnostics import Diagnostic
+
+_64BIT = ("int64", "uint64", "float64")
+_32BIT = ("int32", "uint32", "float32")
+# arithmetic primitives whose 64-bit output makes a hash value depend
+# on jax_enable_x64 / platform promotion. bitcast_convert_type — the
+# sanctioned way to split a 64-bit key into uint32 lanes — is not
+# arithmetic, so it is never flagged.
+_ARITH = {
+    "add", "sub", "mul", "xor", "or", "and", "shift_left",
+    "shift_right_logical", "shift_right_arithmetic", "rem", "div",
+}
+
+
+def _aval_dtype(v) -> Optional[str]:
+    aval = getattr(v, "aval", None)
+    dt = getattr(aval, "dtype", None)
+    return str(dt) if dt is not None else None
+
+
+def _scan_eqns(jaxpr, fn):
+    """Depth-first over a (closed) jaxpr including sub-jaxprs (scan /
+    while / cond bodies), calling ``fn(eqn)`` for every equation."""
+    core = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in core.eqns:
+        fn(eqn)
+        for p in eqn.params.values():
+            sub = getattr(p, "jaxpr", None)
+            if sub is not None:
+                _scan_eqns(p, fn)
+            elif isinstance(p, (tuple, list)):
+                for q in p:
+                    if hasattr(q, "jaxpr"):
+                        _scan_eqns(q, fn)
+
+
+def check_promotions(
+    fn: Callable, *example_args, name: str = "", **example_kwargs
+) -> List[Diagnostic]:
+    """RW-E301: implicit 32->64-bit widening inside a traced step."""
+    name = name or getattr(fn, "__name__", repr(fn))
+    jaxpr = jax.make_jaxpr(fn)(*example_args, **example_kwargs)
+    out: List[Diagnostic] = []
+
+    def visit(eqn):
+        if eqn.primitive.name != "convert_element_type":
+            return
+        new = str(eqn.params.get("new_dtype", ""))
+        if new not in _64BIT:
+            return
+        src = _aval_dtype(eqn.invars[0])
+        if src in _32BIT:
+            out.append(
+                Diagnostic(
+                    "RW-E301",
+                    f"{name}: {src} -> {new} promotion inside the "
+                    "compiled step (doubles lane width on device)",
+                    executor=name,
+                )
+            )
+
+    _scan_eqns(jaxpr, visit)
+    return out
+
+
+def check_hash_path_32bit(
+    fn: Callable, *example_args, name: str = "", **example_kwargs
+) -> List[Diagnostic]:
+    """RW-E302: 64-bit arithmetic anywhere in a hash function's jaxpr.
+
+    The contract (ops/hashing.py): 64-bit key columns are bit-split
+    into uint32 lanes up front via bitcast; every mix/combine after
+    that is uint32. Any 64-bit add/mul/shift/mask op means the hash
+    value depends on the x64 flag / platform promotion — the exact
+    class of bug where vnode routing diverges between hosts."""
+    name = name or getattr(fn, "__name__", repr(fn))
+    jaxpr = jax.make_jaxpr(fn)(*example_args, **example_kwargs)
+    seen: Set[str] = set()
+    out: List[Diagnostic] = []
+
+    def visit(eqn):
+        prim = eqn.primitive.name
+        if prim not in _ARITH or prim in seen:
+            return
+        for v in eqn.outvars:
+            if _aval_dtype(v) in _64BIT:
+                seen.add(prim)
+                out.append(
+                    Diagnostic(
+                        "RW-E302",
+                        f"{name}: 64-bit {prim} in the hash path — "
+                        "result depends on jax_enable_x64 / platform "
+                        "promotion (split keys into uint32 lanes via "
+                        "bitcast instead)",
+                        executor=name,
+                    )
+                )
+                return
+
+    _scan_eqns(jaxpr, visit)
+    return out
+
+
+def check_donation(
+    fn: Callable, *example_args, name: str = "", **example_kwargs
+) -> List[Diagnostic]:
+    """RW-E401: a jitted state kernel lowered without any donated
+    buffer. ``example_args`` may be ``jax.ShapeDtypeStruct``s — the
+    check lowers (no XLA compile, no allocation)."""
+    name = name or getattr(fn, "__name__", repr(fn))
+    lowered = fn.lower(*example_args, **example_kwargs)
+    txt = lowered.as_text()
+    if "jax.buffer_donor" in txt or "tf.aliasing_output" in txt:
+        return []
+    return [
+        Diagnostic(
+            "RW-E401",
+            f"{name}: no donated buffers — every step holds two live "
+            "copies of the carried state in HBM",
+            executor=name,
+        )
+    ]
+
+
+# ---------------------------------------------------------------------------
+# transfer guard (RW-E402 at runtime)
+# ---------------------------------------------------------------------------
+
+
+def transfer_guard():
+    """Context manager for the per-barrier device step: when
+    ``RW_TRANSFER_GUARD`` is armed (tests set it to 1; opt out with 0),
+    implicit host<->device transfers raise AT the offending step
+    instead of silently serializing the pipeline. Explicit transfers
+    (``jax.device_get`` — e.g. ops/hash_table.finish_scalars) stay
+    legal. Off (no-op) unless armed: production serving may stream
+    through host-map executors by design."""
+    mode = os.environ.get("RW_TRANSFER_GUARD", "0").strip().lower()
+    if mode in ("", "0", "off", "false", "allow"):
+        return contextlib.nullcontext()
+    if mode in ("1", "on", "true"):
+        mode = "disallow"
+    return jax.transfer_guard(mode)
+
+
+# ---------------------------------------------------------------------------
+# recompile instrumentation
+# ---------------------------------------------------------------------------
+
+
+def _default_kernels() -> List[Tuple[str, object]]:
+    """The fused step kernels whose jit caches define 'the pipeline
+    compiled once'. Missing attributes are skipped (refactor-proof)."""
+    out: List[Tuple[str, object]] = []
+
+    def grab(modname: str, attr: str) -> None:
+        import importlib
+
+        try:
+            mod = importlib.import_module(modname)
+        except ImportError:
+            return
+        fn = getattr(mod, attr, None)
+        if fn is not None and hasattr(fn, "_cache_size"):
+            out.append((attr.lstrip("_"), fn))
+
+    grab("risingwave_tpu.executors.hash_agg", "_agg_step")
+    grab("risingwave_tpu.executors.hash_agg", "_agg_step_mi")
+    grab("risingwave_tpu.executors.hop_window", "_hop_step")
+    grab("risingwave_tpu.executors.project", "_project_step")
+    grab("risingwave_tpu.executors.filter", "_filter_step")
+    grab("risingwave_tpu.executors.dedup", "_dedup_step")
+    grab("risingwave_tpu.executors.materialize", "_mv_step")
+    grab("risingwave_tpu.ops.hash_table", "lookup_or_insert")
+    grab("risingwave_tpu.ops.hash_table", "lookup")
+    return out
+
+
+class RecompileWatch:
+    """Per-kernel jit-cache miss tracking across a steady-state window.
+
+    ``snapshot()`` after warmup; ``deltas()`` at the end returns
+    {kernel: new-compile count} and feeds ``recompiles_total`` — the
+    regression gate for 'steady-state epochs trigger zero recompiles'.
+    """
+
+    def __init__(self, kernels: Optional[Sequence[Tuple[str, object]]] = None):
+        self.kernels = list(kernels) if kernels is not None else _default_kernels()
+        self._base: Dict[str, int] = {}
+
+    def snapshot(self) -> Dict[str, int]:
+        self._base = {n: f._cache_size() for n, f in self.kernels}
+        return dict(self._base)
+
+    def deltas(self, record: bool = True) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for n, f in self.kernels:
+            d = f._cache_size() - self._base.get(n, 0)
+            if d > 0:
+                out[n] = d
+        if record and out:
+            from risingwave_tpu.metrics import record_recompiles
+
+            record_recompiles(out)
+            # recording CONSUMES the window: advance the base so a
+            # second deltas()/total() never double-counts the same
+            # misses into recompiles_total
+            for n, d in out.items():
+                self._base[n] = self._base.get(n, 0) + d
+        return out
+
+    def total(self, record: bool = True) -> int:
+        return sum(self.deltas(record=record).values())
+
+
+class SignatureWatch:
+    """Abstract-input-signature fingerprinting per executor.
+
+    ``start()`` begins observation (runtime/pipeline.walk_chain feeds
+    every (executor, chunk) pair when enabled); ``mark_stable()`` ends
+    the warmup window; any NEW signature after that is a recompile
+    hazard: the executor's inputs are shape-unstable, so its fused step
+    re-traces. Hazards go to ``recompile_hazard_total{executor=...}``,
+    the meta event log, and ``report()`` as RW-E403."""
+
+    def __init__(self):
+        self.enabled = False
+        self._stable = False
+        self._sigs: Dict[int, Set[tuple]] = {}
+        self._names: Dict[int, str] = {}
+        self._hazards: Dict[str, List[tuple]] = {}
+
+    def start(self) -> "SignatureWatch":
+        self.enabled = True
+        self._stable = False
+        self._sigs.clear()
+        self._names.clear()
+        self._hazards.clear()
+        return self
+
+    def mark_stable(self) -> None:
+        self._stable = True
+
+    def stop(self) -> None:
+        self.enabled = False
+
+    @staticmethod
+    def _fingerprint(chunk) -> tuple:
+        cols = tuple(
+            (k, v.shape, str(v.dtype))
+            for k, v in sorted(chunk.columns.items())
+        )
+        nulls = tuple(sorted(chunk.nulls))
+        return (cols, nulls, chunk.valid.shape)
+
+    def observe(self, ex, chunk) -> None:
+        try:
+            sig = self._fingerprint(chunk)
+        except AttributeError:
+            return  # not a StreamChunk (defensive)
+        key = id(ex)
+        seen = self._sigs.setdefault(key, set())
+        if sig in seen:
+            return
+        seen.add(sig)
+        self._names[key] = type(ex).__name__
+        if self._stable:
+            name = self._names[key]
+            self._hazards.setdefault(name, []).append(sig)
+            from risingwave_tpu.event_log import EVENT_LOG
+            from risingwave_tpu.metrics import REGISTRY
+
+            REGISTRY.counter("recompile_hazard_total").inc(executor=name)
+            EVENT_LOG.record(
+                "recompile_hazard", executor=name, signature=repr(sig)[:200]
+            )
+
+    def report(self) -> List[Diagnostic]:
+        return [
+            Diagnostic(
+                "RW-E403",
+                f"executor saw {len(sigs)} new abstract input "
+                "signature(s) after warmup — every one re-traces its "
+                "fused step (recompile storm on TPU)",
+                executor=name,
+                severity="warning",
+            )
+            for name, sigs in sorted(self._hazards.items())
+        ]
+
+
+# the process singleton walk_chain consults (off unless start()ed)
+SIGNATURES = SignatureWatch()
+
+
+# ---------------------------------------------------------------------------
+# pipeline-level sanitize (deep lint)
+# ---------------------------------------------------------------------------
+
+
+def sanitize_executors(executors: Sequence[object]) -> List[Diagnostic]:
+    """Trace every executor's pure step (when it exposes one) with a
+    synthetic fixed-capacity chunk and scan for promotions. Cheap: no
+    XLA compiles, tracing only."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from risingwave_tpu.array.chunk import StreamChunk
+
+    out: List[Diagnostic] = []
+    for ex in executors:
+        step = getattr(ex, "pure_step", lambda: None)()
+        if step is None:
+            continue
+        info = getattr(ex, "lint_info", lambda: None)() or {}
+        dtypes = {
+            k: v
+            for k, v in (info.get("expects") or {}).items()
+            if v is not None
+        }
+        if not dtypes:
+            continue
+        cols = {
+            k: np.zeros(8, dtype=np.dtype(jnp.dtype(v)))
+            for k, v in dtypes.items()
+        }
+        chunk = StreamChunk.from_numpy(cols, 8)
+        try:
+            out.extend(
+                check_promotions(step, chunk, name=type(ex).__name__)
+            )
+        except Exception:  # noqa: BLE001 — sanitizer is best-effort
+            continue
+    return out
+
+
+def sanitize_state_kernels() -> List[Diagnostic]:
+    """RW-E401 over the shared state kernels: the hash-table
+    probe/insert step must donate its table buffers, or every barrier
+    holds two live copies of the state in HBM. Lower-only — no XLA
+    compile, no device allocation beyond the tiny example table."""
+    import jax.numpy as jnp
+
+    from risingwave_tpu.ops.hash_table import HashTable, lookup_or_insert
+
+    t = HashTable.create(64, (jnp.dtype(jnp.int64),))
+    keys = (jnp.zeros(8, jnp.int64),)
+    valid = jnp.ones(8, jnp.bool_)
+    return check_donation(
+        lookup_or_insert, t, keys, valid, name="lookup_or_insert"
+    )
+
+
+def sanitize_hash_kernels() -> List[Diagnostic]:
+    """The shared hash path itself (ops/hashing): must be pure 32-bit
+    for int64 compound keys — the dtype-audit regression gate."""
+    import jax.numpy as jnp
+
+    from risingwave_tpu.ops import hashing
+
+    keys = (
+        jnp.zeros(8, jnp.int64),
+        jnp.zeros(8, jnp.int32),
+        jnp.zeros(8, jnp.float64),
+    )
+    out = check_hash_path_32bit(
+        lambda ks: hashing.hash_columns(ks, seed=0xC0FFEE),
+        keys,
+        name="hash_columns",
+    )
+    out.extend(
+        check_hash_path_32bit(
+            lambda ks: hashing.hash128(ks), keys, name="hash128"
+        )
+    )
+    out.extend(
+        check_hash_path_32bit(
+            lambda ks: hashing.vnode_of(ks), keys, name="vnode_of"
+        )
+    )
+    return out

@@ -1,0 +1,299 @@
+"""rwlint entry points: the CREATE-MV hook, pipeline linting for
+hand-built plans (bench / tests), SQL-file linting, and the CLI driver
+behind ``python -m risingwave_tpu lint``.
+
+Cost contract: ``lint_planned`` is pure host-side metadata walking —
+no tracing, no XLA — so the DDL path stays O(plan size), well under
+the 50ms/query budget (PROFILE.md has measured numbers). The deep
+sanitizer (``--deep``) traces jaxprs and is CLI/test-only.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from risingwave_tpu.analysis.diagnostics import Diagnostic, PlanLintError
+from risingwave_tpu.analysis.plan_verifier import verify_planned
+
+
+def _record(name: str, diags: List[Diagnostic], elapsed_ms: float) -> None:
+    from risingwave_tpu.metrics import REGISTRY
+
+    REGISTRY.histogram("lint_ms").observe(elapsed_ms)
+    for d in diags:
+        REGISTRY.counter("lint_diagnostics_total").inc(code=d.code)
+    errors = [d for d in diags if d.severity == "error"]
+    if errors:
+        from risingwave_tpu.event_log import EVENT_LOG
+
+        EVENT_LOG.record(
+            "lint",
+            relation=name,
+            errors=len(errors),
+            codes=",".join(sorted({d.code for d in errors})),
+        )
+
+
+def lint_planned(
+    planned,
+    catalog=None,
+    source_schemas: Optional[Dict[str, dict]] = None,
+    strict: bool = True,
+) -> List[Diagnostic]:
+    """Verify one PlannedMV; with ``strict``, error findings refuse the
+    DDL via PlanLintError. Always records metrics + event log."""
+    t0 = time.perf_counter()
+    diags = verify_planned(planned, catalog=catalog, source_schemas=source_schemas)
+    name = getattr(planned, "name", "mv")
+    _record(name, diags, (time.perf_counter() - t0) * 1e3)
+    errors = [d for d in diags if d.severity == "error"]
+    if strict and errors:
+        raise PlanLintError(errors, name=name)
+    return diags
+
+
+def lint_pipeline(
+    pipeline,
+    source_schemas: Optional[Dict[str, dict]] = None,
+    name: str = "mv",
+    strict: bool = True,
+) -> List[Diagnostic]:
+    """Lint a hand-built Pipeline / TwoInputPipeline / GraphPipeline
+    (the bench and Python-API surface). ``source_schemas`` maps input
+    side ("single"/"left"/"right") -> {col: dtype}."""
+
+    class _Shim:
+        pass
+
+    shim = _Shim()
+    shim.name = name
+    shim.pipeline = pipeline
+    shim.inputs = {}
+    return lint_planned(
+        shim, source_schemas=source_schemas or {}, strict=strict
+    )
+
+
+# ---------------------------------------------------------------------------
+# built-in Nexmark query corpus
+# ---------------------------------------------------------------------------
+
+_I64 = "int64"
+_I32 = "int32"
+
+NEXMARK_SOURCE_SCHEMAS = {
+    "q5": {"single": {"auction": _I64, "date_time": _I64}},
+    "q7": {
+        side: {
+            "auction": _I64,
+            "bidder": _I64,
+            "price": _I64,
+            "date_time": _I64,
+        }
+        for side in ("left", "right")
+    },
+    "q8": {
+        "left": {"id": _I64, "name": _I32, "date_time": _I64},
+        "right": {"seller": _I64, "date_time": _I64},
+    },
+}
+
+
+def build_nexmark_corpus(capacity: int = 1 << 10, only: str = None):
+    """Small-capacity twins of the built-in Nexmark plans — the lint
+    corpus shared by ``lint --all-nexmark``, bench's pre-run gate, and
+    the test suite (the verifier is static: plan shape is all that
+    matters, so tiny capacities keep it fast). ``only`` selects one
+    query; unknown names yield {}."""
+    from risingwave_tpu.queries.nexmark_q import (
+        build_q5_lite,
+        build_q7,
+        build_q8,
+    )
+
+    from risingwave_tpu.analysis.plan_verifier import _host_device
+
+    builders = {
+        "q5": lambda: build_q5_lite(capacity=capacity),
+        "q7": lambda: build_q7(
+            capacity=capacity,
+            agg_capacity=capacity,
+            filter_capacity=capacity,
+            out_cap=capacity,
+        ),
+        "q8": lambda: build_q8(capacity=capacity, out_cap=capacity),
+    }
+    names = (only,) if only is not None else tuple(builders)
+    # lint-only twins: pin their state allocations to host CPU so a
+    # pre-bench gate on a TPU session never transiently touches HBM
+    with _host_device():
+        return {n: builders[n]() for n in names if n in builders}
+
+
+def lint_all_nexmark(
+    deep: bool = False, strict: bool = False
+) -> Dict[str, List[Diagnostic]]:
+    """Lint every built-in Nexmark query pipeline. With ``deep``, also
+    run the jaxpr sanitizer over each pipeline's executors and the
+    shared hash kernels."""
+    out: Dict[str, List[Diagnostic]] = {}
+    built = build_nexmark_corpus()
+    for qname, q in built.items():
+        out[qname] = lint_pipeline(
+            q.pipeline,
+            NEXMARK_SOURCE_SCHEMAS[qname],
+            name=qname,
+            strict=strict,
+        )
+    if deep:
+        from risingwave_tpu.analysis.jax_sanitizer import (
+            sanitize_executors,
+            sanitize_hash_kernels,
+            sanitize_state_kernels,
+        )
+
+        for qname, q in built.items():
+            out[qname] = out[qname] + sanitize_executors(
+                q.pipeline.executors
+            )
+        out["hash_kernels"] = sanitize_hash_kernels()
+        out["state_kernels"] = sanitize_state_kernels()
+    return out
+
+
+def lint_sql_file(path: str) -> Dict[str, List[Diagnostic]]:
+    """Execute a SQL file's DDL through an in-memory session (no object
+    store, serial mode) and collect the lint findings of every CREATE
+    MATERIALIZED VIEW. Statements split on ';' with `--` comment LINES
+    stripped — this is not a SQL lexer: dollar-quoted UDF bodies with
+    semicolons, and string literals spanning lines where a continuation
+    line starts with `--`, are not supported here."""
+    from risingwave_tpu.frontend.session import SqlSession
+    from risingwave_tpu.runtime import StreamingRuntime
+    from risingwave_tpu.sql import Catalog
+
+    session = SqlSession(
+        Catalog({}), StreamingRuntime(store=None), strict_lint=False
+    )
+    with open(path) as f:
+        text = f.read()
+    # strip whole `--` comment LINES before splitting on ';' (not
+    # trailing comments: `--` may legally appear inside a string
+    # literal): a comment must neither swallow the statement sharing
+    # its segment nor split one at a ';' inside the comment text
+    text = "\n".join(
+        ln
+        for ln in text.splitlines()
+        if not ln.lstrip().startswith("--")
+    )
+    findings: Dict[str, List[Diagnostic]] = {}
+    for raw in text.split(";"):
+        # re-strip per segment: a trailing same-line comment
+        # ("stmt; -- note") survives the pre-strip and becomes a
+        # comment-only residual segment after the split
+        stmt = "\n".join(
+            ln
+            for ln in raw.splitlines()
+            if not ln.lstrip().startswith("--")
+        ).strip()
+        if not stmt:
+            continue
+        # lint runs DDL only: catalog-shaping statements feed the
+        # verifier; DML/queries (bulk INSERT seeds, smoke SELECTs)
+        # would do real work and abort the lint on unrelated failures
+        if stmt.split(None, 1)[0].upper() not in (
+            "CREATE",
+            "DROP",
+            "ALTER",
+            "SET",
+        ):
+            continue
+        before = len(session.lint_findings)
+        session.execute(stmt)
+        for name, d in session.lint_findings[before:]:
+            findings.setdefault(name, []).append(d)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# CLI driver (python -m risingwave_tpu lint ...)
+# ---------------------------------------------------------------------------
+
+
+def run_cli(args) -> int:
+    """Returns the process exit code: 0 = no error findings."""
+    import json as _json
+
+    if not args.all_nexmark and not args.paths:
+        # exit-code contract: 2 = usage/input (CI tells this apart
+        # from 1 = lint errors), never an interpreter traceback — and
+        # --json consumers get JSON on EVERY exit path
+        msg = "nothing to lint: pass SQL files and/or --all-nexmark"
+        print(_json.dumps({"error": msg}) if args.json else f"rwlint: {msg}")
+        return 2
+
+    findings: Dict[str, List[Diagnostic]] = {}
+    usage_errors: List[str] = []
+    if args.all_nexmark:
+        for name, diags in lint_all_nexmark(deep=args.deep).items():
+            findings.setdefault(name, []).extend(diags)
+    for path in args.paths:
+        try:
+            per_file = lint_sql_file(path)
+        except OSError as e:
+            # keep going: findings already collected for other targets
+            # must still be reported, not dropped on a later bad path
+            usage_errors.append(f"cannot read {path}: {e}")
+            continue
+        except Exception as e:  # noqa: BLE001 — bad SQL in the file
+            usage_errors.append(f"{path}: {type(e).__name__}: {e}")
+            continue
+        for name, diags in per_file.items():
+            findings.setdefault(f"{path}:{name}", []).extend(diags)
+    n_err = 0
+    if args.json:
+        out = {
+            name: [
+                {
+                    "code": d.code,
+                    "severity": d.severity,
+                    "fragment": d.fragment,
+                    "executor": d.executor,
+                    "message": d.message,
+                }
+                for d in diags
+            ]
+            for name, diags in findings.items()
+        }
+        if usage_errors:
+            out["__errors__"] = usage_errors
+        print(_json.dumps(out))
+        n_err = sum(
+            1
+            for diags in findings.values()
+            for d in diags
+            if d.severity == "error"
+        )
+    else:
+        for name in sorted(findings):
+            diags = findings[name]
+            errs = [d for d in diags if d.severity == "error"]
+            n_err += len(errs)
+            status = "FAIL" if errs else ("warn" if diags else "ok")
+            print(f"{name}: {status}")
+            for d in diags:
+                print(f"  {d.render()}")
+        total = len(findings)
+        for msg in usage_errors:
+            print(f"rwlint: {msg}")
+        print(
+            f"rwlint: {total} target(s), {n_err} error(s), "
+            f"{sum(len(v) for v in findings.values()) - n_err} warning(s)"
+        )
+    # usage/input problems dominate lint findings in the exit code so
+    # CI never mistakes a half-linted run for a clean (or merely
+    # finding-bearing) one
+    if usage_errors:
+        return 2
+    return 1 if n_err else 0

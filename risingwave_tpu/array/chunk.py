@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from risingwave_tpu.types import DataType, Op, Schema, op_sign
+from risingwave_tpu.types import Schema, op_sign
 
 
 @jax.tree_util.register_pytree_node_class

@@ -9,7 +9,7 @@ projection run through the same expression framework as streaming.
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict
 
 import numpy as np
 

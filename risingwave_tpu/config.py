@@ -28,6 +28,11 @@ class StreamingConfig:
 
     chunk_capacity: int = 4096  # fixed chunk shape (stream_chunk size)
     in_flight_checkpoints: int = 8  # async upload lane depth
+    # rwlint at CREATE-MV time (analysis/): True turns error-severity
+    # diagnostics into DDL-time failures instead of runtime corruption.
+    # Env escape hatch: RW_STRICT_LINT=0 (SqlSession reads it when the
+    # session is built without an explicit setting).
+    strict_lint: bool = True
 
 
 @dataclass

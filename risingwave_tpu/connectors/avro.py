@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import json
 import struct
-from typing import Dict, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 from risingwave_tpu.connectors.framework import JsonParser, Parser
 from risingwave_tpu.types import Schema

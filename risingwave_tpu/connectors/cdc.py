@@ -26,14 +26,14 @@ is a host stream anyway — device work starts downstream).
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.array.composite import encode_column
 from risingwave_tpu.storage.state_table import Checkpointable, StateDelta
-from risingwave_tpu.types import Op, Schema
+from risingwave_tpu.types import Schema
 
 
 class ExternalTable:

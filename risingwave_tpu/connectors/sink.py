@@ -17,7 +17,6 @@ import os
 import threading as _threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
-import numpy as np
 
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.array.dictionary import StringDictionary

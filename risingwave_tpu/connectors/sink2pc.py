@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 from risingwave_tpu.connectors.log_store import KvLogStore
 from risingwave_tpu.connectors.sink import Sink

@@ -83,6 +83,36 @@ class Executor:
         watermark_filter.rs emits into its output stream)."""
         return None
 
+    def lint_info(self):
+        """Static metadata for the plan verifier (analysis/), or None.
+
+        None (the default) marks the executor OPAQUE: the verifier
+        stops schema/watermark tracking at it and skips value-level
+        checks downstream — it never guesses. Executors that know
+        their column flow return a dict with any of:
+
+        - ``requires``: columns read from the input channel
+        - ``expects``: {col: dtype} declared input dtypes (implies
+          requires)
+        - ``adds``: {col: dtype|None} columns appended to the schema
+        - ``emits``: {col: dtype|None} output schema REPLACING the
+          input (aggs, joins, projects)
+        - ``renames``: {out: in|None} for emits-executors — which
+          output is an unmodified copy of which input (None =
+          computed); drives dispatch-key tracing and watermark
+          capability
+        - ``keys``: state partition keys (exchange alignment, RW-E202)
+        - ``state_pk``: state-table primary key (coverage, RW-E701)
+        - ``table_ids``: state table ids (uniqueness, RW-E702)
+        - ``window_key``: state-cleaning column that must be
+          watermark-reachable (RW-E501)
+        - ``watermark_map``: {in_col: out_col} watermark translation
+          (hop window)
+        - ``watermark_src``: column this executor GENERATES watermarks
+          for (watermark filter)
+        """
+        return None
+
     def pure_step(self):
         """A pure device function chunk -> chunk equivalent to this
         executor's ``apply`` (exactly one output chunk, no state), or

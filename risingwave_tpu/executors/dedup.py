@@ -22,16 +22,7 @@ import numpy as np
 
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Barrier, Executor, Watermark
-from risingwave_tpu.ops.hash_table import (
-    HashTable,
-    first_occurrence_mask,
-    lookup_or_insert,
-    plan_rehash,
-    read_scalars,
-    stage_scalars,
-    finish_scalars,
-    set_live,
-)
+from risingwave_tpu.ops.hash_table import HashTable, first_occurrence_mask, lookup_or_insert, plan_rehash, read_scalars, stage_scalars, set_live
 from risingwave_tpu.storage.state_table import (
     Checkpointable,
     StateDelta,
@@ -107,6 +98,17 @@ class AppendOnlyDedupExecutor(Executor, Checkpointable):
         self._bound = 0
         self._saw_delete = jnp.zeros((), jnp.bool_)
         self._dropped = jnp.zeros((), jnp.bool_)
+
+    def lint_info(self):
+        expects = {
+            k: lane.dtype for k, lane in zip(self.keys, self.table.keys)
+        }
+        return {
+            "expects": expects,
+            "keys": self.keys,
+            "table_ids": (self.table_id,),
+            "window_key": self.window_key[0] if self.window_key else None,
+        }
 
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         for k in self.keys:

@@ -30,15 +30,7 @@ import numpy as np
 
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Barrier, Executor, Watermark
-from risingwave_tpu.ops.hash_table import (
-    HashTable,
-    lookup_or_insert,
-    plan_rehash,
-    read_scalars,
-    stage_scalars,
-    finish_scalars,
-    set_live,
-)
+from risingwave_tpu.ops.hash_table import HashTable, lookup_or_insert, plan_rehash, read_scalars, stage_scalars, set_live
 from risingwave_tpu.storage.state_table import (
     Checkpointable,
     StateDelta,
@@ -128,6 +120,17 @@ class DynamicMaxFilterExecutor(Executor, Checkpointable):
         self._bound = 0
         self._saw_delete = jnp.zeros((), jnp.bool_)
         self._dropped = jnp.zeros((), jnp.bool_)
+
+    def lint_info(self):
+        return {
+            "expects": {
+                self.group_col: self.table.keys[0].dtype,
+                self.value_col: self.maxes.dtype,
+            },
+            "keys": (self.group_col,),
+            "table_ids": (self.table_id,),
+            "window_key": self.window_key[0] if self.window_key else None,
+        }
 
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         if self.group_col in chunk.nulls or self.value_col in chunk.nulls:

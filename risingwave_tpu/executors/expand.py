@@ -16,7 +16,7 @@ masks; no loops, no dynamic shapes.
 from __future__ import annotations
 
 from functools import partial
-from typing import List, Sequence, Tuple
+from typing import List, Sequence
 
 import jax
 import jax.numpy as jnp

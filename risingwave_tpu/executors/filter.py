@@ -56,5 +56,10 @@ class FilterExecutor(Executor):
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         return [_filter_step(chunk, self._spred)]
 
+    def lint_info(self):
+        from risingwave_tpu.expr.expr import collect_columns
+
+        return {"requires": tuple(sorted(collect_columns(self.pred)))}
+
     def pure_step(self):
         return partial(_filter_step, pred=self._spred)

@@ -13,7 +13,7 @@ which is exactly how the host epoch loop drives executors here.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional
 
 import numpy as np
 

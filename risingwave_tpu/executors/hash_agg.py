@@ -45,16 +45,7 @@ from risingwave_tpu.storage.state_table import (
 from risingwave_tpu.ops import agg as agg_ops
 from risingwave_tpu.ops import minput as mi_ops
 from risingwave_tpu.ops.agg import AggCall, AggState
-from risingwave_tpu.ops.hash_table import (
-    HashTable,
-    lookup,
-    lookup_or_insert,
-    plan_rehash,
-    finish_scalars,
-    read_scalars,
-    stage_scalars,
-    set_live,
-)
+from risingwave_tpu.ops.hash_table import HashTable, lookup, lookup_or_insert, plan_rehash, read_scalars, stage_scalars, set_live
 
 GROW_AT = 0.5  # rehash when claimed slots may exceed this load factor
 
@@ -472,6 +463,35 @@ class HashAggExecutor(Executor, Checkpointable):
         # (a delete pre-merge would falsely latch inconsistent), so
         # evicted keys fault in ON TOUCH via this host-side set
         self._evicted: set = set()
+
+    def lint_info(self):
+        emits = {k: self._dtypes.get(k) for k in self.group_keys}
+        renames = {k: k for k in self.group_keys}
+        requires = set(self.group_keys)
+        for c in self.calls:
+            if c.input is not None:
+                requires.add(c.input)
+            if c.kind in ("count", "count_star"):
+                out_dt = jnp.int64
+            elif c.kind in ("min", "max") and c.input in self._dtypes:
+                out_dt = self._dtypes[c.input]
+            else:
+                out_dt = None  # sum/avg widen by kind-specific rules
+            emits[c.output] = out_dt
+            renames[c.output] = None
+        return {
+            "requires": tuple(sorted(requires)),
+            "expects": {
+                k: self._dtypes[k]
+                for k in sorted(requires)
+                if k in self._dtypes
+            },
+            "emits": emits,
+            "renames": renames,
+            "keys": self.group_keys,
+            "table_ids": (self.table_id,),
+            "window_key": self.window_key[0] if self.window_key else None,
+        }
 
     # -- data ------------------------------------------------------------
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:

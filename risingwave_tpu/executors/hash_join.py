@@ -39,12 +39,7 @@ import numpy as np
 
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Barrier, Executor, Watermark
-from risingwave_tpu.ops.hash_table import (
-    finish_scalars,
-    plan_rehash,
-    read_scalars,
-    stage_scalars,
-)
+from risingwave_tpu.ops.hash_table import plan_rehash, read_scalars, stage_scalars
 from risingwave_tpu.ops.hash_table import lookup_or_insert, set_live
 from risingwave_tpu.storage.state_table import (
     host_key_view,
@@ -343,6 +338,13 @@ class HashJoinExecutor(Executor, Checkpointable):
         rk_dtypes = tuple(jnp.dtype(right_dtypes[k]) for k in self.right_keys)
         if lk_dtypes != rk_dtypes:
             raise ValueError(f"join key dtype mismatch: {lk_dtypes} vs {rk_dtypes}")
+        # declared per-side input dtypes, kept for the plan verifier
+        self._lint_left_dtypes = {
+            n: jnp.dtype(d) for n, d in left_dtypes.items()
+        }
+        self._lint_right_dtypes = {
+            n: jnp.dtype(d) for n, d in right_dtypes.items()
+        }
 
         self.left = JoinSide.create(
             capacity,
@@ -367,6 +369,19 @@ class HashJoinExecutor(Executor, Checkpointable):
         self.cold_get_rows = None
         self._evicted = {"left": set(), "right": set()}
         self._cold_tombstones: Dict[str, list] = {}
+
+    def lint_info(self):
+        dtypes = dict(self._lint_left_dtypes)
+        dtypes.update(self._lint_right_dtypes)
+        return {
+            "left_keys": self.left_keys,
+            "right_keys": self.right_keys,
+            "expects_left": dict(self._lint_left_dtypes),
+            "expects_right": dict(self._lint_right_dtypes),
+            "emits": {n: dtypes.get(n) for n in self.out_names},
+            "table_ids": (self.table_id,),
+            "window_cols": self.window_cols,
+        }
 
     # -- data ------------------------------------------------------------
     def apply_left(self, chunk: StreamChunk) -> List[StreamChunk]:

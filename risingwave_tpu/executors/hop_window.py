@@ -80,6 +80,15 @@ class HopWindowExecutor(Executor):
             _hop_step(chunk, self.ts_col, self.size_ms, self.slide_ms, self.out_start)
         ]
 
+    def lint_info(self):
+        import jax.numpy as jnp
+
+        return {
+            "requires": (self.ts_col,),
+            "adds": {self.out_start: jnp.int64},
+            "watermark_map": {self.ts_col: self.out_start},
+        }
+
     def pure_step(self):
         return partial(
             hop_step_fn,

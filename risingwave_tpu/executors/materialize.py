@@ -21,7 +21,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from risingwave_tpu.array.chunk import StreamChunk
-from risingwave_tpu.executors.base import Barrier, Executor
+from risingwave_tpu.executors.base import Executor
 from risingwave_tpu.storage.state_table import Checkpointable, StateDelta
 from risingwave_tpu.types import Op
 
@@ -69,6 +69,15 @@ class MaterializeExecutor(Executor, Checkpointable):
         # set by StreamingRuntime.register when a checkpoint store will
         # drain _pending every checkpoint barrier
         self.checkpoint_enabled = False
+
+    def lint_info(self):
+        return {
+            "requires": tuple(self.columns),
+            "state_pk": tuple(
+                c for c in self.pk if c != "_row_id"
+            ),  # _row_id is generated upstream by RowIdGen
+            "table_ids": (self.table_id,),
+        }
 
     # -- backend selection ----------------------------------------------
     _force_python = False  # subclasses needing row hooks pin the dict
@@ -462,18 +471,10 @@ class MaterializeExecutor(Executor, Checkpointable):
 
 import jax
 import jax.numpy as jnp
-from dataclasses import dataclass, field as dc_field
+from dataclasses import dataclass
 from functools import partial
 
-from risingwave_tpu.ops.hash_table import (
-    HashTable,
-    last_occurrence_mask,
-    lookup_or_insert,
-    plan_rehash,
-    read_scalars,
-    stage_scalars,
-    finish_scalars,
-)
+from risingwave_tpu.ops.hash_table import HashTable, last_occurrence_mask, lookup_or_insert, plan_rehash, read_scalars, stage_scalars
 from risingwave_tpu.storage.state_table import (
     grow_pow2,
     pull_rows,
@@ -652,6 +653,13 @@ class DeviceMaterializeExecutor(MvDeviceReadMixin, Executor, Checkpointable):
         )
         self._bound = 0
         self.checkpoint_enabled = False
+
+    def lint_info(self):
+        return {
+            "expects": dict(self.dtypes),
+            "state_pk": tuple(self.pk),
+            "table_ids": (self.table_id,),
+        }
 
     # -- data -------------------------------------------------------------
     def apply(self, chunk: StreamChunk):

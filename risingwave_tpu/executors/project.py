@@ -8,7 +8,7 @@ column set; ops/visibility pass through untouched.
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, List, Tuple
+from typing import Dict, List
 
 import jax
 
@@ -42,6 +42,21 @@ class ProjectExecutor(Executor):
 
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         return [_project_step(chunk, self._souts)]
+
+    def lint_info(self):
+        from risingwave_tpu.expr.expr import Cast, Col, collect_columns
+
+        requires = set()
+        emits, renames = {}, {}
+        for name, e in self.outputs:
+            requires |= collect_columns(e)
+            renames[name] = e.name if isinstance(e, Col) else None
+            emits[name] = e.dtype if isinstance(e, Cast) else None
+        return {
+            "requires": tuple(sorted(requires)),
+            "emits": emits,
+            "renames": renames,
+        }
 
     def pure_step(self):
         return partial(_project_step, outputs=self._souts)

@@ -27,6 +27,14 @@ class RowIdGenExecutor(Executor, Checkpointable):
         self._base = 0
         self._committed = -1
 
+    def lint_info(self):
+        import jax.numpy as jnp
+
+        return {
+            "adds": {self.out_col: jnp.int64},
+            "table_ids": (self.table_id,),
+        }
+
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         if self.out_col in chunk.columns:
             # DML deletes/updates address existing rows BY id — never

@@ -16,7 +16,7 @@ once per barrier.
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -24,7 +24,7 @@ import numpy as np
 
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Barrier, Executor, Watermark
-from risingwave_tpu.ops.hash_table import finish_scalars, stage_scalars
+from risingwave_tpu.ops.hash_table import stage_scalars
 from risingwave_tpu.storage.state_table import (
     Checkpointable,
     StateDelta,

@@ -124,6 +124,15 @@ class TopNExecutor(Executor, Checkpointable):
         self._dropped = jnp.zeros((), jnp.bool_)
         self._emitted: Dict[Tuple, Tuple] = {}  # pk -> full row
 
+    def lint_info(self):
+        return {
+            "expects": dict(self._dtypes),
+            "emits": dict(self._dtypes),
+            "renames": {n: n for n in self.names},
+            "state_pk": tuple(self.pk),
+            "table_ids": (self.table_id,),
+        }
+
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         for k in self.pk + (self.order_col,):
             if k in chunk.nulls:
@@ -464,6 +473,17 @@ class RetractableGroupTopNExecutor(Executor, Checkpointable):
         self._dropped = jnp.zeros((), jnp.bool_)
         # group tuple -> {pk tuple -> full row tuple} of EMITTED rows
         self._emitted: Dict[Tuple, Dict[Tuple, Tuple]] = {}
+
+    def lint_info(self):
+        return {
+            "expects": dict(self._dtypes),
+            "emits": dict(self._dtypes),
+            "renames": {n: n for n in self.names},
+            "keys": self.group_by,
+            "state_pk": tuple(self.store_keys),
+            "table_ids": (self.table_id,),
+            "window_key": self.window_key[0] if self.window_key else None,
+        }
 
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         for c in self.pk + self.group_by + (self.order_col,):

@@ -77,6 +77,12 @@ class WatermarkFilterExecutor(Executor):
         self._running_max = jnp.asarray(jnp.iinfo(jnp.int64).min, jnp.int64)
         self._wm: Optional[int] = None  # host copy, refreshed per barrier
 
+    def lint_info(self):
+        return {
+            "requires": (self.column,),
+            "watermark_src": self.column,
+        }
+
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         floor = jnp.asarray(
             self._wm if self._wm is not None else jnp.iinfo(jnp.int64).min,

@@ -16,7 +16,7 @@ NULL semantics:
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Callable, Optional, Sequence, Tuple
+from typing import Callable, Optional, Tuple
 
 import jax.numpy as jnp
 
@@ -112,6 +112,32 @@ def structural_key(v) -> tuple:
             for k, x in sorted(v.items(), key=lambda kv: repr(kv[0]))
         )
     return ("#leaf", type(v).__name__, v)
+
+
+def collect_columns(node) -> frozenset:
+    """Every input column name an expression tree reads (the lint
+    surface behind ``Executor.lint_info`` requires-sets). Walks any
+    Expr dataclass plus tuple/list containers; never uses ``==`` on
+    Exprs (see structural_key)."""
+    import dataclasses as _dc
+
+    out = set()
+
+    def walk(x):
+        if isinstance(x, Col):
+            out.add(x.name)
+            return
+        if isinstance(x, Expr):
+            if _dc.is_dataclass(x):
+                for f in _dc.fields(x):
+                    walk(getattr(x, f.name))
+            return
+        if isinstance(x, (tuple, list)):
+            for v in x:
+                walk(v)
+
+    walk(node)
+    return frozenset(out)
 
 
 class StaticTree:

@@ -17,7 +17,6 @@ reference's per-session task) is the right shape.
 
 from __future__ import annotations
 
-import socket
 import socketserver
 import struct
 import threading

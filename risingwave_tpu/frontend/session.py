@@ -69,11 +69,27 @@ class SqlSession:
         exec_mode: str = "serial",
         parallelism: int = 1,
         hub=None,
+        strict_lint: Optional[bool] = None,
     ):
         from risingwave_tpu.array.dictionary import StringDictionary
 
         if exec_mode not in ("serial", "graph"):
             raise ValueError(f"unknown exec_mode {exec_mode!r}")
+        # rwlint at CREATE-MV time (analysis/): every planned MV is
+        # verified before actors spawn; with strict_lint, an
+        # error-severity diagnostic refuses the DDL (PlanLintError).
+        # Default comes from RW_STRICT_LINT (on unless set to 0) so the
+        # whole test suite self-applies the verifier.
+        if strict_lint is None:
+            import os
+
+            strict_lint = os.environ.get(
+                "RW_STRICT_LINT", "1"
+            ).strip().lower() not in ("0", "off", "false")
+        self.strict_lint = bool(strict_lint)
+        # (name, Diagnostic) per CREATE MV, in DDL order — the CLI's
+        # SQL-file lint surface reads this
+        self.lint_findings = []
         self.catalog = catalog
         self.runtime = runtime or StreamingRuntime(store=None)
         self.capacity = capacity
@@ -137,6 +153,7 @@ class SqlSession:
         capacity: int = 1 << 14,
         exec_mode: str = "serial",
         parallelism: int = 1,
+        strict_lint: Optional[bool] = None,
     ):
         """Bootstrap a session from a durable store: replay the DDL log
         (structure only — no barriers, no backfill), then recover every
@@ -148,6 +165,7 @@ class SqlSession:
             capacity=capacity,
             exec_mode=exec_mode,
             parallelism=parallelism,
+            strict_lint=strict_lint,
         )
         if session.meta is None:
             raise ValueError("restore needs a runtime with an object store")
@@ -408,6 +426,42 @@ class SqlSession:
             return {}, "CREATE_TABLE"
         return self._execute_create_mv_or_rest(stmt, sql)
 
+    def _lint_planned(self, planned) -> None:
+        """Static plan verification at CREATE-MV time (analysis/):
+        findings land in ``self.lint_findings`` + metrics/event-log;
+        with ``strict_lint``, errors raise PlanLintError and the DDL
+        is refused with nothing registered."""
+        from risingwave_tpu.analysis.lint import lint_planned
+
+        # DDL-log replay must never be refused by lint: every statement
+        # was accepted when first created, and a stricter rule added
+        # since must not brick state recovery — record findings instead
+        strict = self.strict_lint and not self._replaying
+        for p in tuple(getattr(planned, "aux", ())) + (planned,):
+            diags = lint_planned(p, catalog=self.catalog, strict=strict)
+            self.lint_findings.extend((p.name, d) for d in diags)
+
+    def _rollback_aux_catalog(self, planned) -> None:
+        """The planner adds hidden aux entries to the catalog during
+        lowering — a refused/failed CREATE must not leak them."""
+        for sub in planned.aux:
+            self.catalog.mvs.pop(sub.name, None)
+            self.catalog.tables.pop(sub.name, None)
+
+    def _discard_planned(self, planned) -> None:
+        """Tear down a planned MV that will never launch (duplicate
+        name, lint refusal, registration failure): roll back hidden aux
+        catalog entries and reap graph-mode actor threads, which spawn
+        at PLAN time. A wedged/dead graph must not mask the original
+        error (GraphPipeline.rebuild guards its stop() identically)."""
+        self._rollback_aux_catalog(planned)
+        close = getattr(planned.pipeline, "close", None)
+        if close is not None:
+            try:
+                close()
+            except BaseException:
+                pass
+
     def _register_planned(self, planned) -> None:
         """Runtime-register one planned MV: subscribe fragment inputs
         (tables / MVs) with the correct join side + backfill; attach
@@ -512,9 +566,18 @@ class SqlSession:
                 # (planner aux) — serial registration path
                 planned = self.planner.plan(sql)
             if planned.name in self.runtime.fragments:
+                self._discard_planned(planned)
                 raise ValueError(
                     f"relation {planned.name!r} already exists"
                 )
+            # rwlint: refuse a provably-broken dataflow BEFORE anything
+            # registers (aux MVs included — deepest first, like
+            # registration order)
+            try:
+                self._lint_planned(planned)
+            except BaseException:
+                self._discard_planned(planned)
+                raise
             # register the lowered-join aux MVs first (deepest first):
             # the outer join subscribes to their change streams
             registered_aux = []
@@ -526,11 +589,7 @@ class SqlSession:
             except BaseException:
                 for sub in reversed(registered_aux):
                     self._unregister_planned(sub)
-                # the planner added hidden aux entries to the catalog
-                # during lowering — a failed CREATE must not leak them
-                for sub in planned.aux:
-                    self.catalog.mvs.pop(sub.name, None)
-                    self.catalog.tables.pop(sub.name, None)
+                self._discard_planned(planned)
                 raise
             self.catalog.add_mv(planned)
             # overlay inferred LOGICAL types (decimal scale, varchar,

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import threading
 from collections import defaultdict, deque
-from typing import Dict, List, Tuple
+from typing import Dict, Tuple
 
 import numpy as np
 
@@ -347,3 +347,15 @@ td,th{{border:1px solid #999;padding:2px 8px}}h2{{margin-top:1.5em}}</style></he
 
 # the process-default registry (reference: GLOBAL_METRICS_REGISTRY)
 REGISTRY = MetricsRegistry()
+
+
+def record_recompiles(deltas: Dict[str, int]) -> None:
+    """Per-kernel compiled-fn cache misses (analysis.RecompileWatch
+    deltas) -> ``recompiles_total{fn=...}``. Steady-state epochs must
+    keep this flat: every increment is a re-trace of a fused step —
+    ~30s each on a tunneled TPU, the recompile-storm failure mode the
+    fixed-capacity chunk design exists to prevent."""
+    c = REGISTRY.counter("recompiles_total")
+    for fn, d in deltas.items():
+        if d:
+            c.inc(d, fn=fn)

@@ -168,14 +168,17 @@ def lookup_or_insert(
             # key lane uncontended. (Four independent scatters could pick
             # different winners per lane, leaving a torn chimera slot that
             # matches no key and leaks capacity — ADVICE.md r1, medium.)
+            # Index lanes are EXPLICIT int32 (rwlint RW-E30x dtype
+            # audit): weak python-int sentinels must never promote the
+            # probe arithmetic under a different default-int regime.
             want = unresolved & is_empty
-            idx = jnp.where(want, cand, cap)  # cap = drop lane
+            idx = jnp.where(want, cand, jnp.int32(cap))  # cap = drop lane
             row_ids = jnp.arange(n, dtype=jnp.int32)
             claim = claim.at[idx].set(row_ids, mode="drop")
             won = want & (claim[cand] == row_ids)
             # wipe this round's entries so the scratch stays all-sentinel
             claim = claim.at[idx].set(n, mode="drop")
-            widx = jnp.where(won, cand, cap)
+            widx = jnp.where(won, cand, jnp.int32(cap))
             new_fp1 = table.fp1.at[widx].set(fp1, mode="drop")
             new_fp2 = table.fp2.at[widx].set(fp2, mode="drop")
             new_keys = tuple(
@@ -273,7 +276,7 @@ def lookup(table: HashTable, key_cols, valid):
 def set_live(table: HashTable, slots: jnp.ndarray, live_value: jnp.ndarray) -> HashTable:
     """Mark slots live/dead (dead = logical delete, slot stays claimed)."""
     cap = table.capacity
-    idx = jnp.where(slots >= 0, slots, cap)
+    idx = jnp.where(slots >= 0, slots, jnp.int32(cap))
     new_live = table.live.at[idx].set(live_value, mode="drop")
     return HashTable(table.fp1, table.fp2, table.keys, new_live)
 
@@ -291,10 +294,14 @@ def stage_scalars(*xs):
 
 
 def finish_scalars(arr) -> list:
-    """Blocking counterpart: materialize a staged pack."""
-    import numpy as np
+    """Blocking counterpart: materialize a staged pack.
 
-    return np.asarray(arr).tolist()
+    Uses ``jax.device_get`` — an EXPLICIT transfer — because this runs
+    inside the per-barrier device step, which tests arm with
+    ``jax.transfer_guard("disallow")`` (RW_TRANSFER_GUARD): the one
+    sanctioned D2H read per barrier must not trip the guard that
+    exists to catch the unsanctioned ones."""
+    return jax.device_get(arr).tolist()
 
 
 def read_scalars(*xs) -> list:

@@ -37,6 +37,18 @@ def _mix32(h: jnp.ndarray) -> jnp.ndarray:
     return h
 
 
+def _split64(col: jnp.ndarray) -> list[jnp.ndarray]:
+    """64-bit column -> (lo, hi) uint32 lanes via ONE bitcast.
+
+    ``bitcast_convert_type`` to a narrower dtype appends a minor-most
+    dim whose index 0 is the least-significant word — bit-identical to
+    the old ``& 0xFFFFFFFF`` / ``>> 32`` split, but with ZERO 64-bit
+    arithmetic: the hash chain stays valid under any ``jax_enable_x64``
+    / platform promotion regime (rwlint RW-E302 guards this)."""
+    bits = jax.lax.bitcast_convert_type(col, jnp.uint32)
+    return [bits[..., 0], bits[..., 1]]
+
+
 def _to_u32_lanes(col: jnp.ndarray) -> list[jnp.ndarray]:
     """Bit-cast any supported column dtype to one or more uint32 lane sets.
 
@@ -44,7 +56,8 @@ def _to_u32_lanes(col: jnp.ndarray) -> list[jnp.ndarray]:
     full 64 bits of the key flow into every downstream mix — folding to a
     single u32 would make the "independent" fingerprints of ``hash128``
     collide together for int64 ids, the most common key type in Nexmark
-    (ADVICE.md r1 weak #6).
+    (ADVICE.md r1 weak #6). Everything downstream of this function is
+    EXPLICITLY uint32: no 64-bit op may appear in the mixing chain.
     """
     if col.dtype == jnp.bool_:
         return [col.astype(jnp.uint32)]
@@ -58,17 +71,9 @@ def _to_u32_lanes(col: jnp.ndarray) -> list[jnp.ndarray]:
     if col.dtype == jnp.float64:
         col = jnp.where(col == 0.0, jnp.float64(0.0), col)
         col = jnp.where(jnp.isnan(col), jnp.float64(jnp.nan), col)
-        bits = jax.lax.bitcast_convert_type(col, jnp.uint64)
-        return [
-            (bits & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32),
-            (bits >> jnp.uint64(32)).astype(jnp.uint32),
-        ]
+        return _split64(col)
     if col.dtype in (jnp.int64, jnp.uint64):
-        u = col.astype(jnp.uint64)
-        return [
-            (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32),
-            (u >> jnp.uint64(32)).astype(jnp.uint32),
-        ]
+        return _split64(col)
     return [col.astype(jnp.uint32)]
 
 

@@ -30,8 +30,7 @@ overflow, surfaced at the next barrier.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -47,7 +46,7 @@ from risingwave_tpu.executors.hash_agg import (
     build_restored_agg,
 )
 from risingwave_tpu.ops import agg as agg_ops
-from risingwave_tpu.ops.agg import AggCall, AggState
+from risingwave_tpu.ops.agg import AggCall
 from risingwave_tpu.ops.hash_table import HashTable, lookup_or_insert, set_live
 from risingwave_tpu.parallel.sharded_join import (
     double_bucket_cap,

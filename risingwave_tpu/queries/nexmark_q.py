@@ -18,14 +18,7 @@ from typing import Optional
 
 import jax.numpy as jnp
 
-from risingwave_tpu.executors import (
-    AppendOnlyDedupExecutor,
-    DynamicMaxFilterExecutor,
-    HashAggExecutor,
-    HashJoinExecutor,
-    HopWindowExecutor,
-    MaterializeExecutor,
-)
+from risingwave_tpu.executors import AppendOnlyDedupExecutor, DynamicMaxFilterExecutor, HashAggExecutor, HashJoinExecutor, HopWindowExecutor
 from risingwave_tpu.executors.materialize import DeviceMaterializeExecutor
 from risingwave_tpu.ops.agg import AggCall
 from risingwave_tpu.runtime import Pipeline, TwoInputPipeline

@@ -12,7 +12,6 @@ from __future__ import annotations
 
 from typing import Dict, List, Tuple
 
-import numpy as np
 
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.sql import parser as P

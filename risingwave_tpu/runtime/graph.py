@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 
 from risingwave_tpu import utils_sync_point as sync_point
+from risingwave_tpu.analysis.jax_sanitizer import transfer_guard
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.epoch_trace import record_stage
 from risingwave_tpu.executors.base import Barrier, Epoch, Executor, Watermark
@@ -310,9 +311,11 @@ class FragmentActor(threading.Thread):
         self._process_barrier_inner(b)
         t1 = _time.perf_counter()
         # flush + emit happened above; finish_barrier below is the
-        # barrier-only device fence (staged-scalar materialization)
-        for ex in self.executors:
-            ex.finish_barrier()
+        # barrier-only device fence (staged-scalar materialization);
+        # transfer_guard (when armed) rejects implicit transfers here
+        with transfer_guard():
+            for ex in self.executors:
+                ex.finish_barrier()
         t2 = _time.perf_counter()
         record_stage("dispatch", (t1 - t0) * 1e3, fragment=self.actor_name)
         record_stage("device_step", (t2 - t1) * 1e3, fragment=self.actor_name)

@@ -20,6 +20,7 @@ from __future__ import annotations
 import time
 from typing import List, Optional, Sequence
 
+from risingwave_tpu.analysis.jax_sanitizer import SIGNATURES, transfer_guard
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Barrier, Epoch, Executor, Watermark
 
@@ -30,9 +31,14 @@ def walk_chain(chain: Sequence[Executor], chunks, barrier=None):
     the executors below it. The single chain-walking loop shared by
     Pipeline, TwoInputPipeline and the graph runtime's FragmentActor."""
     pending = list(chunks)
+    # recompile-hazard fingerprinting (analysis/jax_sanitizer): one
+    # attribute check when disarmed — the hot path stays flat
+    watch = SIGNATURES if SIGNATURES.enabled else None
     for ex in chain:
         nxt: List[StreamChunk] = []
         for c in pending:
+            if watch is not None:
+                watch.observe(ex, c)
             nxt.extend(ex.apply(c))
         if barrier is not None:
             nxt.extend(ex.on_barrier(barrier))
@@ -85,9 +91,12 @@ class Pipeline:
         t1 = time.perf_counter()
         # materialize every executor's staged barrier scalars AFTER the
         # walk: the async transfers overlapped, so the chain pays ~one
-        # round-trip; raises still precede the runtime's epoch commit
-        for ex in self.executors:
-            ex.finish_barrier()
+        # round-trip; raises still precede the runtime's epoch commit.
+        # transfer_guard: when armed (RW_TRANSFER_GUARD, tests) any
+        # IMPLICIT host<->device transfer here raises at the offender
+        with transfer_guard():
+            for ex in self.executors:
+                ex.finish_barrier()
         # stage attribution (EpochTrace lifecycle): the walk is host
         # dispatch; the scalar materialization is the barrier-only
         # device fence
@@ -182,8 +191,9 @@ class TwoInputPipeline:
         outs = self._through(self.tail, joined, barrier=b)
         outs.extend(self._generated_watermarks())
         t1 = time.perf_counter()
-        for ex in self.executors:
-            ex.finish_barrier()
+        with transfer_guard():
+            for ex in self.executors:
+                ex.finish_barrier()
         from risingwave_tpu.epoch_trace import record_stage
 
         record_stage("dispatch", (t1 - t0) * 1e3)

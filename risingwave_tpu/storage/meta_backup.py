@@ -22,7 +22,6 @@ from __future__ import annotations
 import json
 from typing import List, Optional
 
-import numpy as np
 
 from risingwave_tpu.storage.object_store import ObjectStore
 

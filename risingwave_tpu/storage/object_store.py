@@ -16,7 +16,7 @@ from __future__ import annotations
 import os
 import tempfile
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 
 class ObjectStore:

@@ -27,7 +27,7 @@ from __future__ import annotations
 import json
 import threading
 from collections import deque as _deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax

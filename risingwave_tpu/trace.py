@@ -15,7 +15,6 @@ import threading
 import time
 from collections import deque
 from contextlib import contextmanager
-from typing import Optional
 
 from risingwave_tpu.metrics import REGISTRY
 

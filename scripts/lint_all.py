@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""The one-liner CI gate: host-language lint + rwlint over every
+built-in query.
+
+    python scripts/lint_all.py
+
+Stages (all must pass; exit code is the OR of their failures):
+
+1. ruff (pyflakes+bugbear, ruff.toml) over risingwave_tpu/, tests/,
+   scripts/, bench.py — or, when ruff is not installed (the bench
+   image does not ship it), a built-in AST unused-import scan (the
+   F401 class) + byte-compilation of every file (syntax errors).
+2. ``python -m risingwave_tpu lint --all-nexmark --deep`` — the static
+   plan verifier + jaxpr sanitizer over q5/q7/q8.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import py_compile
+import shutil
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TARGETS = ["risingwave_tpu", "tests", "scripts", "bench.py"]
+
+
+def _py_files():
+    for t in TARGETS:
+        p = os.path.join(ROOT, t)
+        if os.path.isfile(p):
+            yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for f in filenames:
+                if f.endswith(".py"):
+                    yield os.path.join(dirpath, f)
+
+
+def _unused_imports(path: str) -> list:
+    """F401-class scan: imported names never referenced. Conservative:
+    __init__.py re-exports, `_` names, and __all__-listed names pass."""
+    if os.path.basename(path) == "__init__.py":
+        return []
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
+    imported = {}  # name -> lineno
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                name = (a.asname or a.name).split(".")[0]
+                imported[name] = node.lineno
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue  # feature declarations, not names
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                imported[a.asname or a.name] = node.lineno
+    if not imported:
+        return []
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            pass  # handled via the root Name
+    # names echoed in strings count (doctests, __all__, noqa-ish use)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            used.update(node.value.replace(".", " ").split())
+    out = []
+    for name, lineno in sorted(imported.items(), key=lambda kv: kv[1]):
+        if name.startswith("_") or name in used:
+            continue
+        line = src.splitlines()[lineno - 1]
+        if "noqa" in line:
+            continue
+        out.append(f"{path}:{lineno}: unused import {name!r}")
+    return out
+
+
+def stage_host_lint() -> int:
+    ruff = shutil.which("ruff")
+    if ruff is not None:
+        print(f"[lint_all] ruff ({ruff})")
+        return subprocess.call(
+            [ruff, "check", *TARGETS], cwd=ROOT
+        )
+    print("[lint_all] ruff not installed — built-in fallback "
+          "(unused-import scan + byte-compile)")
+    import tempfile
+
+    rc = 0
+    findings = []
+    with tempfile.TemporaryDirectory() as tmp:
+        for path in _py_files():
+            try:
+                py_compile.compile(
+                    path, doraise=True,
+                    cfile=os.path.join(tmp, "out.pyc"),
+                )
+            except py_compile.PyCompileError as e:
+                findings.append(str(e))
+                rc = 1
+            findings.extend(_unused_imports(path))
+    for f in findings:
+        print(f)
+    if findings:
+        rc = 1
+    return rc
+
+
+def stage_rwlint() -> int:
+    print("[lint_all] rwlint --all-nexmark --deep")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.call(
+        [sys.executable, "-m", "risingwave_tpu", "lint",
+         "--all-nexmark", "--deep"],
+        cwd=ROOT,
+        env=env,
+    )
+
+
+def main() -> int:
+    rc = stage_host_lint()
+    rc |= stage_rwlint()
+    print(f"[lint_all] {'FAIL' if rc else 'ok'}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

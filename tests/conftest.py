@@ -25,6 +25,13 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# arm jax.transfer_guard("disallow") around the per-barrier device step
+# (runtime/pipeline.py + runtime/graph.py wrap it via
+# analysis.jax_sanitizer.transfer_guard): an implicit host<->device
+# transfer on the hot path raises AT the offending executor. Opt out
+# with RW_TRANSFER_GUARD=0.
+os.environ.setdefault("RW_TRANSFER_GUARD", "1")
+
 # persistent XLA compilation cache (VERDICT r4 weak #10): identical
 # test compiles re-load across runs instead of re-tracing XLA — pays
 # for itself on both dev and judge boxes. Safe no-op on refusal.

@@ -148,7 +148,6 @@ def test_reduce_minmax_retraction_latches():
 def test_fingerprint_collision_keys_not_merged(monkeypatch):
     """Two different keys forced onto the SAME fingerprint must stay
     separate groups (the raw key lanes split the sorted segment)."""
-    import risingwave_tpu.ops.agg as agg_mod
 
     real_hash128 = None
     from risingwave_tpu.ops import hashing

@@ -2,7 +2,6 @@
 create an MV over a live MV — snapshot + live deltas must equal a
 from-scratch computation, and both MVs must survive kill-recover."""
 
-import numpy as np
 import pandas as pd
 
 from risingwave_tpu.connectors.nexmark import (

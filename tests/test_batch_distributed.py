@@ -5,7 +5,6 @@ Reference: BatchPlanFragmenter stage DAG (plan_fragmenter.rs:137),
 BatchTaskExecution (task_execution.rs:300), hash-shuffle channels.
 """
 
-import numpy as np
 import pytest
 
 from risingwave_tpu.frontend.session import SqlSession

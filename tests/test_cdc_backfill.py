@@ -5,7 +5,6 @@ Reference: src/stream/src/executor/backfill/cdc/ — the merge rule
 and per-table progress state that survives recovery.
 """
 
-import numpy as np
 import pytest
 
 from risingwave_tpu.connectors.cdc import CdcBackfillExecutor, ExternalTable
@@ -13,9 +12,7 @@ from risingwave_tpu.connectors.framework import (
     DebeziumJsonParser,
     FileLogSource,
 )
-from risingwave_tpu.executors.hash_agg import HashAggExecutor
 from risingwave_tpu.executors.materialize import MaterializeExecutor
-from risingwave_tpu.ops.agg import AggCall
 from risingwave_tpu.runtime.pipeline import Pipeline
 from risingwave_tpu.types import DataType, Field, Schema
 
@@ -27,7 +24,6 @@ def _schema():
 
 
 def _mv_pipe():
-    import jax.numpy as jnp
 
     mv = MaterializeExecutor(pk=("id",), columns=("v",), table_id="c.mv")
     return Pipeline([mv]), mv

@@ -17,11 +17,7 @@ import numpy as np
 import pytest
 
 from risingwave_tpu.cluster import ComputeClient
-from risingwave_tpu.connectors.nexmark import (
-    BID_SCHEMA,
-    NexmarkConfig,
-    NexmarkGenerator,
-)
+from risingwave_tpu.connectors.nexmark import NexmarkConfig, NexmarkGenerator
 
 DDL = [
     "CREATE TABLE bid (auction BIGINT, bidder BIGINT, price BIGINT, "

@@ -227,7 +227,7 @@ def test_tracer_spans_and_chrome_export(tmp_path):
 
 def test_arrow_roundtrip():
     import numpy as np
-    import pyarrow as pa
+    import pyarrow as pa  # noqa: F401 — availability gate
 
     from risingwave_tpu.array.arrow import chunk_from_arrow, chunk_to_arrow
     from risingwave_tpu.array.chunk import StreamChunk

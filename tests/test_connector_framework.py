@@ -6,10 +6,8 @@ datagen + kafka connectors; exactly-once resume discipline of
 source_executor.rs offsets.
 """
 
-from decimal import Decimal
 
 import numpy as np
-import pytest
 
 from risingwave_tpu.connectors.framework import (
     CsvParser,

@@ -6,7 +6,6 @@ Reference: executor/aggregation/distinct.rs (distinct dedup tables),
 impl/src/aggregate/approx_count_distinct.rs, string_agg.rs.
 """
 
-import numpy as np
 import pytest
 
 from risingwave_tpu.frontend.session import SqlSession

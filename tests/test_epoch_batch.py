@@ -11,7 +11,6 @@ Reference: the reference benches its production executor directly
 (src/stream/src/executor/hash_agg.rs:62, src/stream/benches/).
 """
 
-import numpy as np
 import pytest
 
 from risingwave_tpu.connectors.nexmark import (

@@ -7,7 +7,6 @@ import glob
 import json
 import time
 
-import numpy as np
 import pytest
 
 from risingwave_tpu import utils_sync_point as sync_point

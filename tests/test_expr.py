@@ -1,7 +1,6 @@
 """Expression framework: SQL semantics vs hand-computed oracles
 (reference: src/expr/core vectorized eval + non-strict NULL handling)."""
 
-import jax.numpy as jnp
 import numpy as np
 
 from risingwave_tpu.array.chunk import StreamChunk

@@ -4,7 +4,6 @@ collided different predicates in the compilation cache — two MVs with
 different WHERE clauses returned identical rows. Statics now ride
 StaticTree (structural eq/hash)."""
 
-import numpy as np
 import pytest
 
 from risingwave_tpu.frontend.session import SqlSession

@@ -2,7 +2,6 @@
 the external UDF flight service; here a dependency-free framed-JSON
 TCP protocol with the same batch + row-error->NULL semantics)."""
 
-import numpy as np
 import pytest
 
 from risingwave_tpu.frontend.session import SqlSession

@@ -125,7 +125,6 @@ def test_string_funcs_over_dictionary():
 
 
 def test_sql_functions_end_to_end():
-    import jax.numpy as jnp
 
     from risingwave_tpu.sql import Catalog, StreamPlanner
     from risingwave_tpu.types import DataType, Schema

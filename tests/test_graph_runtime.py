@@ -15,7 +15,7 @@ import pytest
 
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.connectors.nexmark import NexmarkConfig, NexmarkGenerator
-from risingwave_tpu.executors.base import Executor, Watermark
+from risingwave_tpu.executors.base import Executor
 from risingwave_tpu.queries.nexmark_q import build_q5_lite, build_q8
 from risingwave_tpu.runtime.graph import (
     FragmentSpec,

@@ -7,7 +7,6 @@ import collections
 import jax.numpy as jnp
 import numpy as np
 import pandas as pd
-import pytest
 
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors import Barrier, HashJoinExecutor, Watermark

@@ -3,7 +3,6 @@ delivery, rolled-back epochs never delivered.
 Reference: common/log_store_impl/kv_log_store/."""
 
 import numpy as np
-import pytest
 
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.connectors.log_store import (

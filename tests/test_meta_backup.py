@@ -4,7 +4,6 @@ Reference: meta store (src/meta/src/storage/), cluster bootstrap
 (barrier/recovery.rs:353), backup (src/storage/backup/).
 """
 
-import numpy as np
 import pytest
 
 from risingwave_tpu.frontend.session import SqlSession

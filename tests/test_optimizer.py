@@ -6,8 +6,6 @@ Reference test model: planner tests comparing plan dumps
 (src/frontend/planner_test/) + e2e result checks.
 """
 
-import jax.numpy as jnp
-import numpy as np
 import pytest
 
 from risingwave_tpu.frontend.session import SqlSession

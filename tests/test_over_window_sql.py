@@ -5,7 +5,6 @@ DESC ordering (hidden negated lane), frames, and retracting inputs
 
 Reference: binder window_function.rs; e2e nexmark q9 shape."""
 
-import numpy as np
 import pytest
 
 from risingwave_tpu.frontend.session import SqlSession

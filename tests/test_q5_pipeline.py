@@ -4,9 +4,7 @@ executor chain tests vs expected chunks, src/stream/src/executor/
 test_utils.rs; e2e nexmark slt, e2e_test/nexmark/).
 """
 
-import numpy as np
 import pandas as pd
-import pytest
 
 from risingwave_tpu.connectors.nexmark import NexmarkConfig, NexmarkGenerator
 from risingwave_tpu.queries.nexmark_q import Q5_SLIDE_MS, Q5_WINDOW_MS, build_q5_lite

@@ -4,7 +4,6 @@ GlobalBarrierManager loop + CheckpointControl, barrier/mod.rs:532)."""
 
 import time
 
-import numpy as np
 
 from risingwave_tpu.connectors.nexmark import NexmarkConfig, NexmarkGenerator
 from risingwave_tpu.queries.nexmark_q import build_q5_lite, build_q8

@@ -5,9 +5,7 @@ Reference: src/meta/src/stream/scale.rs:453 (Reschedule), recovery-based
 rescale (barrier/recovery.rs:415), auto-parallelism policy.
 """
 
-import jax
 import jax.numpy as jnp
-import numpy as np
 import pytest
 
 from risingwave_tpu.connectors.nexmark import NexmarkConfig, NexmarkGenerator

@@ -6,7 +6,6 @@ splits on a tick, diff-assigns new ones, and ships SourceChangeSplit
 mutations; offsets travel with the split (exactly-once across moves).
 """
 
-import numpy as np
 import pytest
 
 from risingwave_tpu.connectors.framework import (

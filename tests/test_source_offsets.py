@@ -8,7 +8,7 @@ from risingwave_tpu.connectors import NexmarkConfig, NexmarkSourceExecutor
 from risingwave_tpu.connectors.nexmark import NexmarkGenerator
 from risingwave_tpu.queries.nexmark_q import build_q5_lite
 from risingwave_tpu.runtime import StreamingRuntime
-from risingwave_tpu.storage import CheckpointManager, MemObjectStore
+from risingwave_tpu.storage import MemObjectStore
 
 
 def test_generator_is_offset_deterministic():

@@ -2,7 +2,6 @@
 hand-built pipelines / pandas oracles (reference: planner tests +
 e2e sqllogictest, SURVEY §4)."""
 
-import numpy as np
 import pandas as pd
 import pytest
 

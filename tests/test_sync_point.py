@@ -1,7 +1,6 @@
 """Sync-point-driven deterministic crash tests (reference:
 src/utils/sync-point + storage failpoint tests)."""
 
-import numpy as np
 import pytest
 
 from risingwave_tpu import utils_sync_point as sync_point

@@ -4,7 +4,7 @@ group's top-k (reference: top_n executor tests, top_n_cache.rs)."""
 import numpy as np
 
 from risingwave_tpu.array.chunk import StreamChunk
-from risingwave_tpu.executors import Barrier, GroupTopNExecutor, Watermark
+from risingwave_tpu.executors import Barrier, GroupTopNExecutor
 from risingwave_tpu.executors.base import Epoch
 from risingwave_tpu.types import Op
 

@@ -7,7 +7,6 @@ in src/common/src/array/{struct_array,list_array,jsonb_array}.rs.
 
 from decimal import Decimal
 
-import numpy as np
 import pytest
 
 from risingwave_tpu.array.composite import (

@@ -4,7 +4,6 @@
 Reference: src/expr/impl/src/udf/python.rs, executor/temporal_join.rs:44.
 """
 
-import numpy as np
 import pytest
 
 from risingwave_tpu.frontend.session import SqlSession

@@ -3,7 +3,6 @@ profiling surface (missing component: heap profiling)."""
 
 import urllib.request
 
-import numpy as np
 import pytest
 
 from risingwave_tpu.frontend.session import SqlSession

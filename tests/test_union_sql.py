@@ -2,7 +2,6 @@
 UnionExecutor, union.rs — here the runtime's multi-subscription IS the
 union merge; branches lower to hidden MVs like the join tree does)."""
 
-import numpy as np
 import pytest
 
 from risingwave_tpu.frontend.session import SqlSession
