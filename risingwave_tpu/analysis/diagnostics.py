@@ -45,6 +45,9 @@ CODES = {
     "RW-E603": "fragment graph contains a cycle (barriers can never align)",
     "RW-E604": "fragment output is never consumed and is not the sink",
     "RW-E605": "declared output/source fragment does not exist",
+    "RW-E606": "stateful fragment has no rebuildable boundary (state not "
+    "covered by the pipeline's restorable checkpoint registry — partial "
+    "recovery cannot restore it)",
     # state tables
     "RW-E701": "state-table primary key not covered by the input schema",
     "RW-E702": "duplicate state table_id within one plan",

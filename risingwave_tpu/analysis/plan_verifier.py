@@ -379,9 +379,12 @@ def verify_graph_specs(
     source_fragments: Dict[str, str],  # side -> fragment name
     source_schemas: Dict[str, Schema],  # side -> schema
     rep: LintReport,
+    ckpt_executors: Optional[Sequence[object]] = None,
 ) -> None:
     """Fragment-DAG verification: wiring, acyclicity, exchange key
-    alignment, then per-fragment chain walks in topological order."""
+    alignment, then per-fragment chain walks in topological order.
+    With ``ckpt_executors`` (the pipeline's checkpoint registry), also
+    checks every fragment's rebuildable boundary (RW-E606)."""
     by_name: Dict[str, object] = {}
     for s in specs:
         if s.name in by_name:
@@ -630,6 +633,77 @@ def verify_graph_specs(
                         executor=prov,
                     )
 
+    # -- rebuildable boundary per fragment (RW-E606) ----------------------
+    if ckpt_executors is not None:
+        _check_rebuildable(topo, builds, ckpt_executors, rep)
+
+
+def _check_rebuildable(
+    topo: Sequence[str],
+    builds: Dict[str, object],
+    ckpt_executors: Sequence[object],
+    rep: LintReport,
+) -> None:
+    """RW-E606: every stateful executor a fragment builds must be
+    restorable through the pipeline's checkpoint registry (same
+    table_id, with a real ``restore_state``), or a partial recovery of
+    that fragment cannot rebuild its state — the plan would only ever
+    recover stop-the-world, silently. Flagged at DDL time."""
+    from risingwave_tpu.storage.state_table import Checkpointable
+
+    def _tids(ex) -> Tuple[str, ...]:
+        fn = getattr(ex, "checkpoint_table_ids", None)
+        if fn is None:
+            return ()
+        try:
+            return tuple(fn())
+        except Exception:  # noqa: BLE001 — lint must never crash DDL
+            return ()
+
+    restorable: Set[str] = set()
+    for ex in ckpt_executors:
+        if not isinstance(ex, Checkpointable):
+            continue
+        if type(ex).restore_state is Checkpointable.restore_state:
+            rep.add(
+                "RW-E606",
+                f"checkpoint registry entry {type(ex).__name__} "
+                f"(tables {list(_tids(ex))}) does not implement "
+                "restore_state — its state checkpoints but can never "
+                "be restored",
+                executor=type(ex).__name__,
+            )
+            continue
+        restorable |= set(_tids(ex))
+
+    for name in topo:
+        built = builds.get(name)
+        if built is None:
+            continue  # builder needs live inputs: nothing provable
+        if isinstance(built, dict):
+            chains = (
+                list(built.get("left", ()))
+                + list(built.get("right", ()))
+                + ([built["join"]] if built.get("join") is not None else [])
+                + list(built.get("tail", ()))
+            )
+        else:
+            chains = list(built)
+        for idx, ex in enumerate(chains):
+            if not isinstance(ex, Checkpointable):
+                continue
+            missing = [t for t in _tids(ex) if t not in restorable]
+            if missing:
+                rep.add(
+                    "RW-E606",
+                    f"stateful executor's tables {missing} are not "
+                    "covered by the pipeline's checkpoint registry — "
+                    f"fragment {name!r} has no rebuildable boundary "
+                    "(partial recovery cannot restore it)",
+                    fragment=name,
+                    executor=_prov(idx, ex),
+                )
+
 
 def verify_planned(
     planned,
@@ -661,6 +735,10 @@ def verify_planned(
                 for side in pipeline._sources
             },
             rep,
+            # the checkpoint registry, when the pipeline exposes one
+            # (GraphPipeline does; spec-level stubs don't) — drives the
+            # RW-E606 rebuildable-boundary check
+            ckpt_executors=getattr(pipeline, "_executors", None),
         )
     else:
         verify_serial_pipeline(pipeline, source_schemas, name, rep)

@@ -229,11 +229,19 @@ def dump_stalls(
 def _runtime_snapshot(rt) -> Dict:
     """StreamingRuntime-side stall state: per-fragment epochs, the
     async-lane depth, and graph-backed fragments' actor snapshots."""
+    pending = getattr(rt, "_pending_partial", None)
     snap: Dict = {
         "epoch": getattr(rt, "_epoch", None),
         "committed_epoch": rt.mgr.max_committed_epoch if rt.mgr else None,
         "inflight_commits": getattr(rt, "_inflight", 0),
         "closer_queue": len(getattr(rt, "_closer_q", ())),
+        # partial-recovery provenance: which fragments are fenced for a
+        # deferred scoped recovery, and how many partials have run —
+        # a wedge mid-partial-recovery is debuggable from this alone
+        "partial_recoveries": getattr(rt, "partial_recoveries", 0),
+        "pending_partial": (
+            sorted(pending["scope"]) if pending is not None else None
+        ),
         "fragments": {},
     }
     for name, p in getattr(rt, "fragments", {}).items():

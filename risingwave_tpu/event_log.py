@@ -12,7 +12,15 @@ dashboard.
 Recording sites (grow as subsystems need them):
 - ``ddl``            — frontend/session.py, every DDL statement
 - ``barrier_commit`` — runtime, each durable checkpoint epoch
-- ``recovery``       — runtime auto/manual recovery (with cause)
+- ``recovery``       — runtime recovery, with cause; ``mode`` is one of
+                       ``partial`` (fragment-scoped restore started),
+                       ``partial_done`` (subtree restored + replayed),
+                       ``partial_deferred`` (store unavailable — blast
+                       radius stays fenced until the breaker heals),
+                       ``auto`` (full stop-the-world recovery), or
+                       ``restore`` (explicit/manual full restore)
+- ``actor_failure``  — graph supervisor: actor death attributed to its
+                       fragment, with the computed blast radius
 - ``scale``          — parallel/scale.py reschedules
 - ``offset_resume``  — source executors resuming connector offsets
 - ``stall_dump``     — epoch_trace.dump_stalls artifacts

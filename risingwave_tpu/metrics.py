@@ -192,6 +192,10 @@ class MetricsRegistry:
                     rt.mgr.max_committed_epoch if rt.mgr else 0,
                 ),
                 ("auto recoveries", getattr(rt, "auto_recoveries", 0)),
+                (
+                    "partial recoveries",
+                    getattr(rt, "partial_recoveries", 0),
+                ),
                 ("p99 barrier ms", round(rt.p99_barrier_ms(), 2)),
                 (
                     "p99 checkpoint sync ms",
@@ -238,6 +242,10 @@ class MetricsRegistry:
             "degraded_entries_total",
             "degraded_epochs_spilled_total",
             "degraded_epochs_replayed_total",
+            "actor_failures_total",
+            "partial_recoveries_total",
+            "partial_recovery_deferrals_total",
+            "replay_buffer_overflows_total",
         ):
             c = self.counters.get(cname)
             if c is None:

@@ -283,6 +283,7 @@ class GraphPipeline:
         out_fragment: str,
         ckpt_executors: Sequence[object],
         epoch_batch: bool = True,
+        ckpt_fragments: Optional[Sequence[str]] = None,
     ):
         self._specs = list(specs)
         self._epoch_batch = epoch_batch
@@ -292,13 +293,36 @@ class GraphPipeline:
         self._sources = dict(source_map)
         self._out = out_fragment
         self._executors = list(ckpt_executors)
+        # graph-fragment provenance of each ckpt executor (parallel to
+        # ckpt_executors): lets partial recovery decide which fragments'
+        # state a scoped rebuild must restore. None = unknown — scoped
+        # intra-graph rebuild is then ineligible (full-graph rebuild,
+        # still scoped at the runtime/MV level).
+        if ckpt_fragments is not None and len(ckpt_fragments) != len(
+            self._executors
+        ):
+            raise ValueError(
+                "ckpt_fragments must parallel ckpt_executors "
+                f"({len(ckpt_fragments)} vs {len(self._executors)})"
+            )
+        self._ckpt_fragments = (
+            list(ckpt_fragments) if ckpt_fragments is not None else None
+        )
         self.__dict__["_epoch_val"] = 0
 
-    def rebuild(self) -> None:
+    def rebuild(self, fragments: Optional[Sequence[str]] = None) -> None:
         """Replace dead actors: fresh threads + channels around the
         SAME executor instances (their state is restored separately by
         the runtime's recovery). The watchdog calls this before
-        recover() when a graph-backed fragment fails."""
+        recover() when a graph-backed fragment fails.
+
+        With ``fragments`` (a downstream-closed, source-free set from
+        ``scoped_recovery_plan``), only that subtree is rebuilt: actors
+        outside the blast radius keep their threads, channels, and live
+        state — the fragment-scoped failover path."""
+        if fragments:
+            self.graph.rebuild_scoped(set(fragments))
+            return
         try:
             self.graph.stop(timeout=1.0)
         except BaseException:
@@ -308,6 +332,61 @@ class GraphPipeline:
         ).start()
         self.graph._epoch = self._epoch
         self.graph.capture_deltas = getattr(self, "_capture", False)
+
+    # -- partial-recovery surface (the runtime's supervisor reads these)
+    def failure_scope(self) -> Optional[Dict[str, object]]:
+        """Structured view of the graph supervisor's failure state, or
+        None while healthy: which fragments failed, the computed blast
+        radius, and the per-actor errors."""
+        g = self.graph
+        if not getattr(g, "actor_errors", None):
+            return None
+        return {
+            "failed_fragments": sorted(g.failed_fragments),
+            "blast_radius": sorted(g.fenced_fragments),
+            "errors": {a: repr(e) for a, e in g.actor_errors.items()},
+        }
+
+    def scoped_recovery_plan(self):
+        """Decide how much of THIS pipeline a partial recovery must
+        touch. Returns ``(graph_fragments, executors)``:
+
+        - ``(blast, exs)`` — a scoped intra-graph rebuild is sound: only
+          the blast radius's actors are rebuilt and only ``exs`` (its
+          state tables) restore; actors outside keep running. Sound iff
+          the blast excludes every source fragment, every STATEFUL
+          fragment is inside it (replaying source data back through a
+          live stateful fragment would double-apply), and every
+          terminal fragment is inside it (otherwise the replay's output
+          would be re-drained into subscribers).
+        - ``(None, all_executors)`` — fall back to a full-graph rebuild
+          (the MV as a whole still recovers scoped at the runtime
+          level)."""
+        full = (None, list(self._executors))
+        g = self.graph
+        blast = set(getattr(g, "fenced_fragments", ()) or ())
+        if not blast or self._ckpt_fragments is None:
+            return full
+        sources = {s.name for s in self._specs if not s.inputs}
+        consumed = {u for s in self._specs for (u, _p) in s.inputs}
+        terminals = {s.name for s in self._specs if s.name not in consumed}
+        stateful = {
+            f
+            for ex, f in zip(self._executors, self._ckpt_fragments)
+            if isinstance(ex, Checkpointable)
+        }
+        if (
+            (blast & sources)
+            or not stateful <= blast
+            or not terminals <= blast
+        ):
+            return full
+        exs = [
+            ex
+            for ex, f in zip(self._executors, self._ckpt_fragments)
+            if f in blast
+        ]
+        return set(blast), exs
 
     # the runtime assigns p._epoch on registration/recovery; keep the
     # actor graph's barrier clock in lockstep so injected epochs stay
@@ -664,11 +743,13 @@ def sharded_planned_mv(planner_factory, sql: str, n_shards: int):
                     inputs=[("left_src", 0), ("right_src", 1)],
                 ),
             ]
+            ckpt = left + right + [sj] + build["tail"]
             gp = GraphPipeline(
                 specs,
                 {"left": "left_src", "right": "right_src"},
                 "join",
-                left + right + [sj] + build["tail"],
+                ckpt,
+                ckpt_fragments=["join"] * len(ckpt),
             )
     else:
         chain = _shard_single_chain(list(proto.pipeline.executors), mesh)
@@ -679,7 +760,10 @@ def sharded_planned_mv(planner_factory, sql: str, n_shards: int):
             if swapped is not None:
                 chain, mview = swapped
             specs = [FragmentSpec("mv", lambda i, c=tuple(chain): list(c))]
-            gp = GraphPipeline(specs, {"single": "mv"}, "mv", chain)
+            gp = GraphPipeline(
+                specs, {"single": "mv"}, "mv", chain,
+                ckpt_fragments=["mv"] * len(chain),
+            )
     return PlannedMV(
         proto.name, gp, mview, proto.inputs, schema=proto.schema
     )
@@ -816,6 +900,7 @@ def _singleton_graph(chain, source_map_side="single", epoch_batch=True):
     return GraphPipeline(
         specs, {source_map_side: name}, name, list(chain),
         epoch_batch=epoch_batch,
+        ckpt_fragments=[name] * len(chain),
     )
 
 
@@ -845,6 +930,7 @@ def _single_graph(plans, split, epoch_batch=True) -> GraphPipeline:
         ),
     ]
     ckpt: List[object] = []
+    frags: List[str] = []
     for j in range(prefix_len):
         ex0 = chain0[j]
         if isinstance(ex0, Checkpointable):
@@ -853,9 +939,12 @@ def _single_graph(plans, split, epoch_batch=True) -> GraphPipeline:
                     [chains[i][j] for i in range(n)], positions_by_idx[j]
                 )
             )
+            frags.append("par")
     ckpt.extend(chain0[prefix_len:])
+    frags.extend(["mat"] * len(chain0[prefix_len:]))
     return GraphPipeline(
-        specs, {"single": "src"}, "mat", ckpt, epoch_batch=epoch_batch
+        specs, {"single": "src"}, "mat", ckpt, epoch_batch=epoch_batch,
+        ckpt_fragments=frags,
     )
 
 
@@ -918,6 +1007,7 @@ def _two_input_graph(plans, sides, epoch_batch=True) -> GraphPipeline:
             "join",
             tp0.executors,
             epoch_batch=epoch_batch,
+            ckpt_fragments=["join"] * len(tp0.executors),
         )
     ldisp, rdisp, join_positions, side_positions = sides
 
@@ -946,6 +1036,7 @@ def _two_input_graph(plans, sides, epoch_batch=True) -> GraphPipeline:
         FragmentSpec("mat", lambda i: list(tp0.tail), inputs=[("join", 0)]),
     ]
     ckpt: List[object] = []
+    frags: List[str] = []
     for side_name in ("left", "right"):
         chain0 = getattr(tp0, side_name)
         for j, ex0 in enumerate(chain0):
@@ -956,18 +1047,22 @@ def _two_input_graph(plans, sides, epoch_batch=True) -> GraphPipeline:
                         side_positions[(side_name, j)],
                     )
                 )
+                frags.append("join")
     ckpt.append(
         PartitionedStateView(
             [plans[i].pipeline.join for i in range(n)], join_positions
         )
     )
+    frags.append("join")
     ckpt.extend(tp0.tail)
+    frags.extend(["mat"] * len(tp0.tail))
     return GraphPipeline(
         specs,
         {"left": "left_src", "right": "right_src"},
         "mat",
         ckpt,
         epoch_batch=epoch_batch,
+        ckpt_fragments=frags,
     )
 
 

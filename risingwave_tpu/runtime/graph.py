@@ -31,10 +31,11 @@ sharded all_to_all exchange in parallel/exchange.py).
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from functools import partial
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -74,6 +75,7 @@ class PermitChannel:
         record_permits: int = 1 << 16,
         cv: Optional[threading.Condition] = None,
         abort: Optional[threading.Event] = None,
+        fence: Optional[threading.Event] = None,
     ):
         self._budget = record_permits
         self._avail = record_permits
@@ -86,6 +88,12 @@ class PermitChannel:
         # must wake and drop instead of wedging forever on a dead
         # consumer's permits
         self._abort = abort
+        # per-CONSUMER fence (partial recovery): while the consuming
+        # actor is fenced for a scoped rebuild, data sends drop instead
+        # of blocking or piling up — the runtime's replay buffer
+        # re-derives that data into the rebuilt subtree. Control
+        # messages still enqueue (the dead channel is discarded whole).
+        self._fence = fence
 
     def send_chunk(self, chunk: StreamChunk) -> None:
         cost = min(chunk.capacity, self._budget)
@@ -93,7 +101,11 @@ class PermitChannel:
             while self._avail < cost:
                 if self._abort is not None and self._abort.is_set():
                     return  # graph aborting: drop data, never wedge
+                if self._fence is not None and self._fence.is_set():
+                    return  # consumer fenced for rebuild: drop, replay re-derives
                 self._cv.wait(timeout=0.1)
+            if self._fence is not None and self._fence.is_set():
+                return
             self._avail -= cost
             self._q.append((CHUNK, chunk, cost))
             self._cv.notify_all()
@@ -262,6 +274,7 @@ class FragmentActor(threading.Thread):
         join=None,
         right_chain: Sequence[Executor] = (),
         tail: Sequence[Executor] = (),
+        halt: Optional[threading.Event] = None,
     ):
         super().__init__(name=f"actor-{name}", daemon=True)
         self.actor_name = name
@@ -272,6 +285,13 @@ class FragmentActor(threading.Thread):
         self.inputs = list(inputs)
         self.dispatcher = dispatcher
         self.mgr = mgr
+        # fence/halt for scoped rebuild (partial recovery): when set,
+        # the run loop exits WITHOUT forwarding STOP — the whole
+        # fenced subtree is discarded and rebuilt around fresh channels
+        self.halt = halt if halt is not None else threading.Event()
+        # True while processing a message / barrier (False only in the
+        # idle wait) — the scoped rebuild's drain-quiesce reads this
+        self.busy = True
         self.error: Optional[BaseException] = None
         # per-(channel,column) watermark frontier for min-alignment
         self._wm_seen: Dict[Tuple[int, str], int] = {}
@@ -468,12 +488,18 @@ class FragmentActor(threading.Thread):
         except BaseException as e:  # noqa: BLE001 - surfaced to driver
             self.error = e
             self.mgr._actor_failed(self.actor_name, e)
+        finally:
+            self.busy = False  # a dead actor must not wedge drain-quiesce
 
     def _run_loop(self) -> None:
         n = len(self.inputs)
         parked: List[Optional[Barrier]] = [None] * n
         stopped = self._stopped
         while True:
+            if self.halt.is_set():
+                # fenced for a scoped rebuild: exit quietly (no STOP —
+                # the downstream subtree is fenced and rebuilt with us)
+                return
             progressed = False
             for i, (port, ch) in enumerate(self.inputs):
                 if stopped[i] or parked[i] is not None:
@@ -518,11 +544,16 @@ class FragmentActor(threading.Thread):
                 ]
                 if waitable:
                     cv = waitable[0]._cv
-                    with cv:
-                        cv.wait_for(
-                            lambda: any(len(ch._q) for ch in waitable),
-                            timeout=1.0,
-                        )
+                    self.busy = False
+                    try:
+                        with cv:
+                            cv.wait_for(
+                                lambda: self.halt.is_set()
+                                or any(len(ch._q) for ch in waitable),
+                                timeout=1.0,
+                            )
+                    finally:
+                        self.busy = True
 
     @property
     def executors(self) -> List[Executor]:
@@ -559,7 +590,16 @@ class FragmentSpec:
 
 class GraphRuntime:
     """LocalStreamManager analogue: owns channels + actors, injects
-    barriers at sources, waits for whole-graph collection."""
+    barriers at sources, waits for whole-graph collection.
+
+    Actor supervision (partial recovery): an actor failure is
+    attributed to its FRAGMENT; the supervisor computes the
+    downstream-closure blast radius and fences ONLY that subtree
+    (threads exit, channels into it drop data) — fragments outside the
+    blast keep running so a scoped rebuild can splice a fresh subtree
+    back in (``rebuild_scoped``). When the blast radius reaches a
+    source fragment or covers the whole graph, the supervisor falls
+    back to the stop-the-world abort (today's contract)."""
 
     def __init__(
         self,
@@ -585,12 +625,27 @@ class GraphRuntime:
         self._epoch = 0
         self._source_rr: Dict[str, int] = {}
         self._abort = threading.Event()
+        # -- actor supervisor state (fragment-scoped failover) ----------
+        # actor name -> the exception that killed it
+        self.actor_errors: Dict[str, BaseException] = {}
+        # fragments whose actors died / are fenced (the blast radius)
+        self.failed_fragments: Set[str] = set()
+        self.fenced_fragments: Set[str] = set()
         self._build(specs)
 
     # -- graph build (ActorGraphBuilder analogue, actor.rs:648) ----------
     def _build(self, specs: Sequence[FragmentSpec]) -> None:
-        # channels[(up, down)][down_instance] per downstream fragment
-        in_channels: Dict[str, List[List[Tuple[int, PermitChannel]]]] = {
+        # wiring is RETAINED (not just consumed) so a scoped rebuild can
+        # replace one subtree's channels/actors and re-point the live
+        # upstream dispatchers at the fresh channels:
+        #   _in_ch[name][inst]         -> [(port, channel)]
+        #   _out_edges[name][inst]     -> [(down_name, [channels])]
+        #   _edge_disp[(up,ui,down,k)] -> the per-edge Dispatcher (k =
+        #                                 ordinal of the (up,down) pair,
+        #                                 for duplicate edges e.g. both
+        #                                 join ports fed by one source)
+        #   _cvs/_halts[(name, inst)]  -> per-actor Condition / fence
+        self._in_ch: Dict[str, List[List[Tuple[int, PermitChannel]]]] = {
             s.name: [[] for _ in range(s.parallelism)] for s in specs
         }
         # out_edges[up_name][up_instance] — each UPSTREAM INSTANCE gets
@@ -598,30 +653,24 @@ class GraphRuntime:
         # selects over per-upstream-ACTOR inputs): M parallel senders
         # sharing one channel would deliver M barriers down a single
         # input and double-flush the consumer
-        out_edges: Dict[
-            str, List[List[Tuple[FragmentSpec, List[PermitChannel]]]]
+        self._out_edges: Dict[
+            str, List[List[Tuple[str, List[PermitChannel]]]]
         ] = {s.name: [[] for _ in range(s.parallelism)] for s in specs}
+        self._edge_disp: Dict[Tuple[str, int, str, int], Dispatcher] = {}
         # one Condition per actor instance, shared by ALL its input
         # channels — enables select/wait-on-any in the input loop
-        cvs = {
+        self._cvs = {
             (s.name, i): threading.Condition()
             for s in specs
             for i in range(s.parallelism)
         }
+        self._halts = {
+            (s.name, i): threading.Event()
+            for s in specs
+            for i in range(s.parallelism)
+        }
         for s in specs:
-            for up_name, port in s.inputs:
-                up = self.specs[up_name]
-                for ui in range(up.parallelism):
-                    chans = []
-                    for di in range(s.parallelism):
-                        ch = PermitChannel(
-                            self._channel_permits,
-                            cv=cvs[(s.name, di)],
-                            abort=self._abort,
-                        )
-                        in_channels[s.name][di].append((port, ch))
-                        chans.append(ch)
-                    out_edges[up_name][ui].append((s, chans))
+            self._wire_inputs(s)
 
         # source fragments: the manager is their upstream — channels
         # must exist BEFORE actors copy their input lists
@@ -631,69 +680,278 @@ class GraphRuntime:
                 for inst in range(s.parallelism):
                     ch = PermitChannel(
                         self._channel_permits,
-                        cv=cvs[(s.name, inst)],
+                        cv=self._cvs[(s.name, inst)],
                         abort=self._abort,
+                        fence=self._halts[(s.name, inst)],
                     )
-                    in_channels[s.name][inst].append((0, ch))
+                    self._in_ch[s.name][inst].append((0, ch))
                     srcs.append(ch)
                 self._source_channels[s.name] = srcs
 
         for s in specs:
             for inst in range(s.parallelism):
-                built = s.build(inst)
-                if self._epoch_batch:
-                    # fuse [stateless*, HashAgg] runs into per-epoch
-                    # batched ops — the actor's data path only; the
-                    # pipeline's checkpoint registry keeps holding the
-                    # original executor objects
-                    from risingwave_tpu.executors.epoch_batch import (
-                        fuse_epoch_batch,
-                    )
+                self._spawn_actor(s, inst)
 
-                    if isinstance(built, dict):
-                        built = dict(
-                            built,
-                            left=fuse_epoch_batch(built.get("left", [])),
-                            right=fuse_epoch_batch(built.get("right", [])),
-                            tail=fuse_epoch_batch(built.get("tail", [])),
-                        )
-                    else:
-                        built = fuse_epoch_batch(built)
-                downstream = out_edges[s.name][inst]
-                if downstream:
-                    # one dispatcher fanning to every downstream edge:
-                    # wrap per-edge dispatchers in a multiplexer
-                    per_edge = []
-                    for dspec, chans in downstream:
-                        kind = s.dispatch
-                        keys = None
-                        if isinstance(kind, tuple):
-                            kind, keys = kind
-                        per_edge.append(_mk_dispatcher(kind, chans, keys))
-                    dispatcher = _MultiDispatcher(per_edge)
-                else:
-                    coll = self.collectors.setdefault(s.name, _Collector())
-                    dispatcher = coll
-                if isinstance(built, dict):
-                    actor = FragmentActor(
-                        f"{s.name}#{inst}",
-                        built.get("left", []),
-                        in_channels[s.name][inst],
-                        dispatcher,
-                        self,
-                        join=built["join"],
-                        right_chain=built.get("right", []),
-                        tail=built.get("tail", []),
+    def _wire_inputs(self, s: FragmentSpec) -> None:
+        """Create the channels feeding fragment ``s`` and register them
+        on the upstream edge lists (build + scoped-rebuild shared)."""
+        for up_name, port in s.inputs:
+            up = self.specs[up_name]
+            for ui in range(up.parallelism):
+                chans = []
+                for di in range(s.parallelism):
+                    ch = PermitChannel(
+                        self._channel_permits,
+                        cv=self._cvs[(s.name, di)],
+                        abort=self._abort,
+                        fence=self._halts[(s.name, di)],
                     )
-                else:
-                    actor = FragmentActor(
-                        f"{s.name}#{inst}",
-                        built,
-                        in_channels[s.name][inst],
-                        dispatcher,
-                        self,
+                    self._in_ch[s.name][di].append((port, ch))
+                    chans.append(ch)
+                self._out_edges[up_name][ui].append((s.name, chans))
+
+    def _spawn_actor(self, s: FragmentSpec, inst: int) -> FragmentActor:
+        built = s.build(inst)
+        if self._epoch_batch:
+            # fuse [stateless*, HashAgg] runs into per-epoch
+            # batched ops — the actor's data path only; the
+            # pipeline's checkpoint registry keeps holding the
+            # original executor objects
+            from risingwave_tpu.executors.epoch_batch import (
+                fuse_epoch_batch,
+            )
+
+            if isinstance(built, dict):
+                built = dict(
+                    built,
+                    left=fuse_epoch_batch(built.get("left", [])),
+                    right=fuse_epoch_batch(built.get("right", [])),
+                    tail=fuse_epoch_batch(built.get("tail", [])),
+                )
+            else:
+                built = fuse_epoch_batch(built)
+        downstream = self._out_edges[s.name][inst]
+        if downstream:
+            # one dispatcher fanning to every downstream edge:
+            # wrap per-edge dispatchers in a multiplexer
+            per_edge = []
+            seen: Dict[str, int] = {}
+            for down_name, chans in downstream:
+                kind = s.dispatch
+                keys = None
+                if isinstance(kind, tuple):
+                    kind, keys = kind
+                d = _mk_dispatcher(kind, chans, keys)
+                o = seen.get(down_name, 0)
+                seen[down_name] = o + 1
+                self._edge_disp[(s.name, inst, down_name, o)] = d
+                per_edge.append(d)
+            dispatcher = _MultiDispatcher(per_edge)
+        else:
+            coll = self.collectors.setdefault(s.name, _Collector())
+            dispatcher = coll
+        if isinstance(built, dict):
+            actor = FragmentActor(
+                f"{s.name}#{inst}",
+                built.get("left", []),
+                self._in_ch[s.name][inst],
+                dispatcher,
+                self,
+                join=built["join"],
+                right_chain=built.get("right", []),
+                tail=built.get("tail", []),
+                halt=self._halts[(s.name, inst)],
+            )
+        else:
+            actor = FragmentActor(
+                f"{s.name}#{inst}",
+                built,
+                self._in_ch[s.name][inst],
+                dispatcher,
+                self,
+                halt=self._halts[(s.name, inst)],
+            )
+        self.actors.append(actor)
+        return actor
+
+    # -- supervisor topology helpers -------------------------------------
+    @staticmethod
+    def fragment_of(actor_name: str) -> str:
+        """Actor names are ``{fragment}#{instance}``."""
+        return actor_name.rsplit("#", 1)[0]
+
+    def source_fragment_names(self) -> Set[str]:
+        return {s.name for s in self.specs.values() if not s.inputs}
+
+    def downstream_closure(self, fragment: str) -> Set[str]:
+        """Every fragment transitively consuming ``fragment``'s output."""
+        down: Dict[str, List[str]] = {n: [] for n in self.specs}
+        for s in self.specs.values():
+            for up, _port in s.inputs:
+                down.setdefault(up, []).append(s.name)
+        out: Set[str] = set()
+        stack = [fragment]
+        while stack:
+            for d in down.get(stack.pop(), ()):
+                if d not in out:
+                    out.add(d)
+                    stack.append(d)
+        return out
+
+    def blast_radius(self, fragment: str) -> Set[str]:
+        """The fragments a failure in ``fragment`` poisons: itself plus
+        its downstream closure (state derived from its output can no
+        longer be trusted past the last committed epoch)."""
+        return {fragment} | self.downstream_closure(fragment)
+
+    def _fence(self, fragments: Set[str]) -> None:
+        """Fence a subtree: its actor threads exit (halt events), and
+        channels into it start dropping data (the channel-level fence
+        is the same event). Callers hold no locks."""
+        for (name, _inst), h in self._halts.items():
+            if name in fragments:
+                h.set()
+        # wake every fenced actor's select wait AND any sender blocked
+        # on a fenced channel's permits (they share the consumer's cv)
+        for (name, inst), cv in self._cvs.items():
+            if name in fragments:
+                with cv:
+                    cv.notify_all()
+
+    def rebuild_scoped(self, fragments: Set[str]) -> None:
+        """Splice a fresh subtree in place of ``fragments`` (which must
+        be downstream-closed and source-free — the supervisor's blast
+        radius): halt + reap their actors, drain-quiesce the surviving
+        actors so nothing from the failed window leaks past the fence,
+        rebuild the subtree's channels/actors around the SAME executor
+        objects (their state is restored separately), and re-point the
+        live upstream dispatchers at the fresh channels."""
+        fragments = set(fragments)
+        unknown = fragments - set(self.specs)
+        if unknown:
+            raise KeyError(f"unknown fragments {sorted(unknown)}")
+        for n in fragments:
+            if not self.specs[n].inputs:
+                raise ValueError(
+                    f"cannot scope-rebuild source fragment {n!r} — the "
+                    "blast radius reached a source; use a full rebuild"
+                )
+            missing = self.downstream_closure(n) - fragments
+            if missing:
+                raise ValueError(
+                    f"scope {sorted(fragments)} is not downstream-closed: "
+                    f"{n!r} also feeds {sorted(missing)}"
+                )
+        # 1. fence + reap the subtree's actors
+        self._fence(fragments)
+        doomed = [
+            a for a in self.actors
+            if self.fragment_of(a.actor_name) in fragments
+        ]
+        for a in doomed:
+            a.join(timeout=10.0)
+        stuck = [a.actor_name for a in doomed if a.is_alive()]
+        if stuck:
+            raise RuntimeError(
+                f"scoped rebuild: fenced actors would not halt: {stuck}"
+            )
+        self.actors = [
+            a for a in self.actors
+            if self.fragment_of(a.actor_name) not in fragments
+        ]
+        # 2. drain-quiesce the survivors: any message still queued from
+        # the failed window must land in the OLD fenced channels (and
+        # drop there) BEFORE dispatchers are re-pointed at fresh ones —
+        # otherwise pre-fence data would leak into the rebuilt subtree
+        # and the replay would double-apply it
+        deadline = time.monotonic() + 15.0
+
+        def _quiet() -> bool:
+            # dead survivors (a concurrent failure in a DISJOINT subtree)
+            # are someone else's recovery; only live actors must idle
+            return all(
+                not a.busy and all(len(ch) == 0 for _p, ch in a.inputs)
+                for a in self.actors
+                if a.is_alive()
+            )
+        while True:
+            if _quiet():
+                time.sleep(0.02)  # grace: recv->process handoff window
+                if _quiet():
+                    break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    "scoped rebuild: surviving actors did not quiesce"
+                )
+            time.sleep(0.005)
+        # 3. fresh per-actor state + channels for the subtree
+        ordered = [s for s in self.specs.values() if s.name in fragments]
+        for s in ordered:
+            for inst in range(s.parallelism):
+                self._cvs[(s.name, inst)] = threading.Condition()
+                self._halts[(s.name, inst)] = threading.Event()
+            self._in_ch[s.name] = [[] for _ in range(s.parallelism)]
+            self._out_edges[s.name] = [[] for _ in range(s.parallelism)]
+            # stale drained output of the crashed epoch dies with the
+            # old collector; the replay refills a fresh one
+            self.collectors.pop(s.name, None)
+        for s in ordered:
+            self._wire_scoped_inputs(s, fragments)
+        # 4. reset supervisor + collection state FOR THIS SCOPE ONLY —
+        # a concurrent failure in a disjoint subtree (its actors died
+        # while we rebuilt this one) must stay recorded, or the next
+        # barrier would stall unattributably against its dead actors
+        with self._collect_lock:
+            for a in [
+                a
+                for a in self.actor_errors
+                if self.fragment_of(a) in fragments
+            ]:
+                del self.actor_errors[a]
+            self.failed_fragments -= fragments
+            self.fenced_fragments -= fragments
+            self._failure = next(iter(self.actor_errors.values()), None)
+            self._collected.clear()
+            self._collect_lock.notify_all()
+        fresh = []
+        for s in ordered:
+            for inst in range(s.parallelism):
+                fresh.append(self._spawn_actor(s, inst))
+        for a in fresh:
+            a.start()
+
+    def _wire_scoped_inputs(self, s: FragmentSpec, fragments: Set[str]) -> None:
+        """``_wire_inputs`` for a scoped rebuild: edges from upstreams
+        OUTSIDE the scope re-point the existing live dispatcher at the
+        fresh channels (matching duplicate edges by ordinal)."""
+        seen: Dict[Tuple[str, str], int] = {}
+        for up_name, port in s.inputs:
+            up = self.specs[up_name]
+            o = seen.get((up_name, s.name), 0)
+            seen[(up_name, s.name)] = o + 1
+            for ui in range(up.parallelism):
+                chans = []
+                for di in range(s.parallelism):
+                    ch = PermitChannel(
+                        self._channel_permits,
+                        cv=self._cvs[(s.name, di)],
+                        abort=self._abort,
+                        fence=self._halts[(s.name, di)],
                     )
-                self.actors.append(actor)
+                    self._in_ch[s.name][di].append((port, ch))
+                    chans.append(ch)
+                if up_name in fragments:
+                    self._out_edges[up_name][ui].append((s.name, chans))
+                else:
+                    edges = self._out_edges[up_name][ui]
+                    idx = [
+                        i for i, (dn, _c) in enumerate(edges)
+                        if dn == s.name
+                    ][o]
+                    edges[idx] = (s.name, chans)
+                    self._edge_disp[(up_name, ui, s.name, o)].outputs = (
+                        list(chans)
+                    )
 
     def start(self) -> "GraphRuntime":
         for a in self.actors:
@@ -828,11 +1086,20 @@ class GraphRuntime:
             pending = {e: set(s) for e, s in self._collected.items()}
             last = dict(self._last_collected)
             failure = repr(self._failure) if self._failure else None
+            failed = sorted(self.failed_fragments)
+            blast = sorted(self.fenced_fragments)
+            errors = {a: repr(e) for a, e in self.actor_errors.items()}
         actors = []
         for a in self.actors:
             actors.append(
                 {
                     "actor": a.actor_name,
+                    # fragment provenance: a partial-recovery wedge is
+                    # debuggable from the artifact alone (which subtree
+                    # was fenced, which fragment each actor belongs to)
+                    "fragment": self.fragment_of(a.actor_name),
+                    "fenced": self.fragment_of(a.actor_name)
+                    in self.fenced_fragments,
                     "alive": a.is_alive(),
                     "last_collected_epoch": last.get(a.actor_name, 0),
                     "input_depths": [len(ch) for _p, ch in a.inputs],
@@ -843,6 +1110,9 @@ class GraphRuntime:
         return {
             "epoch": self._epoch,
             "failure": failure,
+            "failed_fragments": failed,
+            "blast_radius": blast,
+            "actor_errors": errors,
             "actors": actors,
             "epochs_pending": {
                 str(e): {
@@ -873,10 +1143,45 @@ class GraphRuntime:
                 self._collect_lock.notify_all()
 
     def _actor_failed(self, actor_name: str, err: BaseException) -> None:
-        self._abort.set()  # wake senders blocked on the dead consumer
+        """Actor supervisor (replaces the old global-abort contract):
+        attribute the failure to the actor's fragment, compute the
+        blast radius, and fence ONLY that subtree — fragments outside
+        it keep running and a scoped rebuild splices a fresh subtree
+        back in. Stop-the-world abort remains the fallback when the
+        blast radius reaches a source or covers the whole graph."""
+        frag = self.fragment_of(actor_name)
+        blast = self.blast_radius(frag)
+        whole = bool(blast & self.source_fragment_names()) or blast >= set(
+            self.specs
+        )
         with self._collect_lock:
-            self._failure = err
+            self.actor_errors[actor_name] = err
+            self.failed_fragments.add(frag)
+            self.fenced_fragments |= blast
+            if self._failure is None:
+                self._failure = err
             self._collect_lock.notify_all()
+        if whole:
+            # no fragment can make progress: wake senders blocked on
+            # the dead consumer and drop (today's full-recovery path)
+            self._abort.set()
+        else:
+            self._fence(blast)
+        try:
+            from risingwave_tpu.event_log import EVENT_LOG
+            from risingwave_tpu.metrics import REGISTRY
+
+            REGISTRY.counter("actor_failures_total").inc(fragment=frag)
+            EVENT_LOG.record(
+                "actor_failure",
+                actor=actor_name,
+                fragment=frag,
+                blast_radius=sorted(blast),
+                whole_graph=whole,
+                cause=repr(err),
+            )
+        except Exception:  # pragma: no cover - telemetry must not mask err
+            pass
 
 
 class _MultiDispatcher:

@@ -18,6 +18,16 @@ manifest commit to a background worker that preserves epoch order. A
 worker failure is fatal for live state (marks are already flipped):
 the next barrier raises and the driver must recover() from the last
 durable manifest — the reference's failed-barrier recovery contract.
+
+Partial recovery departs from that contract where it can: an ACTOR
+death is attributed to its fragment by the graph supervisor
+(runtime/graph.py), and ``_auto_recover`` restores + replays ONLY the
+blast radius (failed fragments + transitive subscribers) from a
+per-fragment replay buffer of uncommitted inputs — healthy fragments
+keep their live state and keep answering queries. Stop-the-world
+recovery remains the floor: unattributable failures, whole-runtime
+blasts, lost replay windows, and three consecutive failed partials all
+fall back to it (and three consecutive fulls raise).
 """
 
 from __future__ import annotations
@@ -25,7 +35,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -177,6 +187,49 @@ class StreamingRuntime:
         if stale:
             EVENT_LOG.record("degraded_discard", epochs=stale, at="boot")
         self.async_checkpoint = async_checkpoint
+        # -- partial recovery (fragment-scoped failover) ----------------
+        # per-fragment replay buffer of UNCOMMITTED inputs: every chunk
+        # entering a fragment (driver push, MV-on-MV routed delta,
+        # backfill) plus per-fragment barrier markers. A scoped recovery
+        # restores only the blast radius's state tables from the last
+        # committed checkpoint and replays this log into the rebuilt
+        # subtree — healthy fragments never roll back. Pruned as epochs
+        # become durable; a fragment whose log overflows re-anchors at
+        # the next barrier (replay floor) and is full-recovery-only
+        # until the anchor epoch is durable.
+        self._replay: Dict[str, List[tuple]] = {}
+        # fragment -> lowest epoch the log can replay from (0 = any
+        # committed state; None = window lost, re-anchors at the next
+        # barrier marker)
+        self._replay_floor: Dict[str, Optional[int]] = {}
+        # fragment -> last durable epoch whose STAGING included this
+        # fragment. Usually the global committed epoch, but a fragment
+        # fenced for a deferred recovery is excluded from staging, so
+        # healthy-only commits advance the manifest WITHOUT covering it
+        # — pruning or replay-skipping by the global epoch would then
+        # silently drop its un-durable window
+        self._replay_covered: Dict[str, int] = {}
+        self._replay_lock = threading.Lock()
+        import os as _os
+
+        try:
+            self._replay_cap = int(
+                _os.environ.get("RW_REPLAY_BUFFER_EVENTS", "4096")
+            )
+        except ValueError:
+            self._replay_cap = 4096
+        # deferred partial recovery (store unavailable mid-recovery):
+        # the blast radius stays fenced — skipped by barriers, its
+        # inputs parked in the replay buffer — until the breaker lets a
+        # restore probe through (composes with degraded mode)
+        self._pending_partial: Optional[Dict[str, object]] = None
+        self._consecutive_partials = 0
+        self._consecutive_recoveries = 0
+        # "partial" | "full" | None — chaos pumps read this to decide
+        # whether the failed epoch's data was replayed (partial) or
+        # rolled back with everything else (full: re-feed / re-poll)
+        self.last_recovery_mode: Optional[str] = None
+        self.partial_recoveries = 0
         self._epoch = self.mgr.max_committed_epoch if self.mgr else 0
         self._barrier_seq = 0
         self._last_barrier_at = 0.0
@@ -361,6 +414,9 @@ class StreamingRuntime:
         ddl_controller.rs + barrier/recovery.rs 'clean dirty jobs')."""
         self.fragments.pop(name, None)
         self._subs.pop(name, None)
+        with self._replay_lock:
+            self._replay.pop(name, None)
+            self._replay_floor.pop(name, None)
         for up, edges in list(self._subs.items()):
             kept = [e for e in edges if e[0] != name]
             if kept:
@@ -381,11 +437,74 @@ class StreamingRuntime:
                 return ex
         raise ValueError(f"fragment {name!r} has no materialize stage")
 
+    # -- replay buffer (partial recovery's data source) -------------------
+    def _record_push(self, name: str, chunk: StreamChunk, side: str) -> None:
+        if self.mgr is None:
+            return  # no durability boundary -> no recovery -> no log
+        with self._replay_lock:
+            if self._replay_floor.get(name, 0) is None:
+                return  # window lost: re-anchors at the next barrier
+            log = self._replay.setdefault(name, [])
+            if len(log) >= self._replay_cap:
+                # bounded: drop the window rather than grow without
+                # limit — this fragment falls back to full recovery
+                # until the log re-anchors at a durable barrier
+                log.clear()
+                self._replay_floor[name] = None
+                REGISTRY.counter("replay_buffer_overflows_total").inc(
+                    fragment=name
+                )
+                return
+            log.append(("push", chunk, side))
+
+    def _record_barrier(self, name: str, epoch: int, checkpoint: bool) -> None:
+        if self.mgr is None:
+            return
+        with self._replay_lock:
+            if self._replay_floor.get(name, 0) is None:
+                # re-anchor: state as of THIS barrier is the new replay
+                # baseline; the log replays any committed epoch >= it
+                self._replay[name] = []
+                self._replay_floor[name] = epoch
+                return
+            self._replay.setdefault(name, []).append(
+                ("barrier", epoch, checkpoint)
+            )
+
+    def _prune_replay(self, epoch: int) -> None:
+        """Epoch is durable: events at or before its barrier marker can
+        never be replayed again (restores land at >= this epoch).
+        Fragments fenced for a deferred recovery were EXCLUDED from
+        this epoch's staging — their durable coverage did not advance,
+        so their logs must keep the whole window for the resume."""
+        pp = self._pending_partial
+        skip = pp["scope"] if pp is not None else ()
+        with self._replay_lock:
+            for name, log in self._replay.items():
+                if name in skip:
+                    continue
+                self._replay_covered[name] = max(
+                    self._replay_covered.get(name, 0), epoch
+                )
+                cut = 0
+                for i, ev in enumerate(log):
+                    if ev[0] == "barrier" and ev[1] <= epoch:
+                        cut = i + 1
+                if cut:
+                    del log[:cut]
+
     def _push_into(self, name: str, chunk: StreamChunk, side: str):
         # failpoint for crash tests: a push that dies mid-fan-out (one
         # subscriber absorbed the chunk, a later one did not) is the
         # half-applied-epoch window the compute node must roll back
         sync_point.hit(f"push_into:{name}:{side}")
+        self._record_push(name, chunk, side)
+        pp = self._pending_partial
+        if pp is not None and name in pp["scope"]:
+            # fenced for a deferred partial recovery: the input is
+            # parked in the replay buffer and applied when the store
+            # heals — healthy fragments keep flowing around it
+            return []
         p = self.fragments[name]
         if side == "left":
             return p.push_left(chunk)
@@ -461,6 +580,11 @@ class StreamingRuntime:
             try:
                 outs = self._barrier_locked()
                 self._consecutive_recoveries = 0
+                self._consecutive_partials = 0
+                # a clean barrier clears the pump contract flag: pumps
+                # consult it ONLY when a barrier recovered instead of
+                # committing, so it must never linger from a past one
+                self.last_recovery_mode = None
                 if getattr(self, "_grew_last_recovery", False):
                     # the grown replay committed: the growths were
                     # legitimate cures, not a runaway — refund the
@@ -497,22 +621,95 @@ class StreamingRuntime:
             )
 
         # one Timer thread per barrier: ~100µs against a >=100ms barrier
-        # cadence (barrier_interval_ms); canceled timers exit promptly
+        # cadence (barrier_interval_ms); canceled timers exit promptly.
+        # The name is load-bearing for the orphan-timer regression test:
+        # every exit path of barrier() (success, recovery, escalation
+        # raise) runs the finally-cancel, so no timer with this name may
+        # outlive its barrier.
         t = threading.Timer(self.stall_dump_after_s, _fire)
         t.daemon = True
+        t.name = "rw-stall-watchdog"
         t.start()
         return t
 
     def _auto_recover(self, cause: Exception) -> None:
-        # a DETERMINISTIC failure (e.g. a capacity overflow) would
-        # recover-replay-fail forever: after a few consecutive failed
-        # epochs, surface the cause instead
-        self._consecutive_recoveries = (
-            getattr(self, "_consecutive_recoveries", 0) + 1
-        )
+        """Failure routing with the partial→full→raise escalation
+        ladder:
+
+        1. If the failure is attributable to one (or a few) graph-backed
+           fragments and the blast radius is a strict subset of the
+           runtime, run FRAGMENT-SCOPED PARTIAL RECOVERY: restore only
+           the affected fragments' state tables, replay their buffered
+           inputs, and leave healthy fragments' live state untouched.
+        2. Three consecutive partial-recovery failures (the fault keeps
+           re-firing) escalate to today's FULL recovery.
+        3. Three consecutive full recoveries raise the deterministic-
+           fault error (the existing contract)."""
         self.last_failure = cause
         REGISTRY.counter("auto_recoveries_total").inc()
         self.auto_recoveries += 1
+        # a latched capacity overflow needs the full path's grow-and-
+        # replay cure; everything else may be partial-eligible
+        latched = any(
+            fn()
+            for fn in (
+                getattr(ex, "capacity_overflow_latched", None)
+                for ex in self.executors()
+            )
+            if fn is not None
+        )
+        scope = None if latched else self._partial_scope()
+        while scope is not None and self._consecutive_partials < 3:
+            self._consecutive_partials += 1
+            EVENT_LOG.record(
+                "recovery",
+                mode="partial",
+                fragments=sorted(scope),
+                scope=len(scope),
+                total=len(self.fragments),
+                consecutive=self._consecutive_partials,
+                cause=repr(cause),
+            )
+            try:
+                # store-free cleanup FIRST — even before draining the
+                # async lane, which can itself raise STORE_UNAVAILABLE:
+                # a fenced sink's stale held batch must be gone before
+                # ANY later epoch can become durable and release it
+                self._discard_scope(scope)
+                # drain — never abort — the async lane: healthy
+                # fragments' staged epochs must still commit; only the
+                # blast radius rolls back
+                self.wait_checkpoints()
+                self._partial_recover(scope, repr(cause))
+                self.last_recovery_mode = "partial"
+                return
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except STORE_UNAVAILABLE:
+                # degraded-mode composition: the store is down, so the
+                # restore DEFERS — the blast radius stays fenced (its
+                # inputs park in the replay buffer) and healthy
+                # fragments keep serving; the barrier clock retries the
+                # restore once the breaker lets a probe through
+                self._pending_partial = {
+                    "scope": set(scope), "cause": repr(cause)
+                }
+                REGISTRY.counter("partial_recovery_deferrals_total").inc()
+                EVENT_LOG.record(
+                    "recovery",
+                    mode="partial_deferred",
+                    fragments=sorted(scope),
+                )
+                self.last_recovery_mode = "partial"
+                return
+            except Exception as e:  # noqa: BLE001 — count + escalate
+                cause = e
+                scope = self._partial_scope() or scope
+        # -- full recovery (the stop-the-world floor) --------------------
+        # a DETERMINISTIC failure (e.g. a capacity overflow) would
+        # recover-replay-fail forever: after a few consecutive failed
+        # epochs, surface the cause instead
+        self._consecutive_recoveries += 1
         EVENT_LOG.record(
             "recovery",
             mode="auto",
@@ -530,8 +727,8 @@ class StreamingRuntime:
         self._quiesce()
         grew = 0
         for ex in self.executors():
-            latched = getattr(ex, "capacity_overflow_latched", None)
-            if latched is None or not latched():
+            latched_fn = getattr(ex, "capacity_overflow_latched", None)
+            if latched_fn is None or not latched_fn():
                 continue
             rounds = getattr(ex, "_growth_rounds", 0)
             if rounds >= 5:
@@ -553,6 +750,7 @@ class StreamingRuntime:
                 "auto-recovery failed 3 consecutive epochs — the fault "
                 "is deterministic, not transient"
             ) from cause
+        self.last_recovery_mode = "full"
         # dead actor threads never come back: rebuild graph-backed
         # fragments (fresh actors/channels around the same executors)
         # BEFORE restoring executor state
@@ -561,6 +759,241 @@ class StreamingRuntime:
             if fn is not None:
                 fn()
         self.recover()
+
+    # -- partial recovery (fragment-scoped failover) ---------------------
+    def _partial_scope(self) -> Optional[set]:
+        """The runtime-level blast radius of the current failure: the
+        fragments whose actor graphs recorded an actor death, plus
+        their transitive subscribers (MV-on-MV closure). None when the
+        failure is not scopeable — no graph attributed it, the scope
+        covers every fragment, the replay window was lost, or pipelined
+        barriers are on (their closer lane owns epoch bookkeeping)."""
+        if self.mgr is None or self.in_flight_barriers > 1:
+            return None
+        failed = set()
+        for name, p in self.fragments.items():
+            fn = getattr(p, "failure_scope", None)
+            if fn is not None and fn():
+                failed.add(name)
+        if not failed:
+            return None
+        scope = set(failed)
+        frontier = list(failed)
+        while frontier:
+            up = frontier.pop()
+            for sub, _side in self._subs.get(up, ()):
+                if sub not in scope:
+                    scope.add(sub)
+                    frontier.append(sub)
+        if scope >= set(self.fragments):
+            return None  # whole-runtime blast: full recovery is the floor
+        committed = self.mgr.max_committed_epoch
+        with self._replay_lock:
+            for name in scope:
+                floor = self._replay_floor.get(name, 0)
+                cov = min(committed, self._replay_covered.get(name, committed))
+                if floor is None or floor > cov:
+                    return None  # replay window lost for this fragment
+        return scope
+
+    def _scoped_plans(self, scope: set) -> Dict[str, tuple]:
+        """(graph_fragments_or_None, executors_to_restore) per scoped
+        fragment, in registration (topological) order."""
+        plans: Dict[str, tuple] = {}
+        for name, p in self.fragments.items():
+            if name not in scope:
+                continue
+            fn = getattr(p, "scoped_recovery_plan", None)
+            plans[name] = fn() if fn is not None else (None, list(p.executors))
+        return plans
+
+    def _discard_scope(self, scope: set) -> None:
+        """Store-free cleanup of a blast radius: drop held sink batches
+        and captured deltas of every scoped fragment, so no later
+        durable epoch can release output whose producing state is about
+        to roll back and replay (double delivery). Runs BEFORE any
+        store touch — a deferred restore must leave nothing stale."""
+        for name, p in self.fragments.items():
+            if name not in scope:
+                continue
+            for ex in p.executors:
+                for hook in ("discard_pending", "discard_captured"):
+                    fn = getattr(ex, hook, None)
+                    if fn is not None:
+                        fn()
+
+    def _partial_recover(self, scope: set, cause: str) -> None:
+        """Restore + replay ONLY ``scope``: rebuild each affected
+        pipeline's actors (scoped inside the graph when sound), restore
+        its state tables from the last committed checkpoint, replay its
+        buffered inputs, and rejoin at the next barrier boundary.
+        Healthy fragments are never touched — their MVs keep answering
+        ``query()`` throughout. Raises STORE_UNAVAILABLE (caller defers)
+        when the store cannot serve the restore reads."""
+        t0 = time.perf_counter()
+        committed = self.mgr.max_committed_epoch
+        plans = self._scoped_plans(scope)
+        self._discard_scope(scope)
+        br = self.store_breaker
+        if br is not None and not br.allow():
+            from risingwave_tpu.resilience import CircuitOpenError
+
+            raise CircuitOpenError(
+                "object store breaker open: partial recovery deferred"
+            )
+        self.partial_recoveries += 1
+        REGISTRY.counter("partial_recoveries_total").inc()
+        REGISTRY.gauge("recovery_scope_fragments").set(float(len(scope)))
+        # quiesce compaction: its GC deletes SSTs the restore reads
+        self._compact_pause.set()
+        try:
+            self._compact_idle.wait()
+            for name, (gfrags, exs) in plans.items():
+                tf = time.perf_counter()
+                p = self.fragments[name]
+                rb = getattr(p, "rebuild", None)
+                if rb is not None:
+                    try:
+                        rb(fragments=gfrags)
+                    except TypeError:  # a rebuild() without scoping
+                        rb()
+                self.mgr.recover(exs)
+                # this fragment's restore lands at ITS durable coverage
+                # — which lags the global committed epoch if healthy-
+                # only commits advanced the manifest while it was fenced
+                with self._replay_lock:
+                    cov = min(
+                        committed, self._replay_covered.get(name, committed)
+                    )
+                p._epoch = cov
+                for ex in exs:
+                    fn = getattr(ex, "on_recover", None)
+                    if fn is not None:
+                        fn(cov)
+                # test/operator hook: fires INSIDE the recovery window,
+                # after the subtree restored and before it rejoins —
+                # healthy MVs must answer query() right now
+                sync_point.hit(f"partial_recovery:{name}")
+                self._replay_fragment(name, p, cov)
+                REGISTRY.histogram("recovery_downtime_ms").observe(
+                    (time.perf_counter() - tf) * 1e3, fragment=name
+                )
+        finally:
+            self._compact_pause.clear()
+        self._work_abort.clear()
+        self._closer_abort.clear()
+        self._work_err.clear()
+        self._closer_err.clear()
+        EVENT_LOG.record(
+            "recovery",
+            mode="partial_done",
+            fragments=sorted(scope),
+            epoch=committed,
+            wall_ms=round((time.perf_counter() - t0) * 1e3, 2),
+        )
+
+    def _replay_fragment(self, name: str, p, covered: int) -> int:
+        """Replay a fragment's buffered inputs on top of its restored
+        state: skip everything the fragment's durable coverage already
+        holds, re-push the rest in order, re-running barrier boundaries
+        as NON-checkpoint barriers (the next real checkpoint stages the
+        whole replayed delta). Outputs are discarded — every subscriber
+        is inside the scope and replays its OWN recorded inputs, so
+        routing them again would double-apply."""
+        with self._replay_lock:
+            log = list(self._replay.get(name, ()))
+        start = 0
+        for i, ev in enumerate(log):
+            if ev[0] == "barrier" and ev[1] <= covered:
+                start = i + 1
+        replayed = 0
+        for ev in log[start:]:
+            if ev[0] == "push":
+                _k, chunk, side = ev
+                if side == "left":
+                    p.push_left(chunk)
+                elif side == "right":
+                    p.push_right(chunk)
+                elif side == "both":
+                    p.push_left(chunk)
+                    p.push_right(chunk)
+                else:
+                    p.push(chunk)
+                replayed += 1
+            else:
+                _k, epoch, _ck = ev
+                # mutation-style rejoin boundary: the rebuilt subtree
+                # re-aligns at the SAME epoch fence the healthy graph
+                # already passed
+                p.barrier(checkpoint=False, epoch=epoch)
+        if replayed or start < len(log):
+            REGISTRY.counter("replay_events_total").inc(
+                len(log) - start, fragment=name
+            )
+        return replayed
+
+    def _maybe_resume_partial(self) -> bool:
+        """Deferred partial recovery rides the barrier clock (like the
+        degraded-mode restore probe): retry the scoped restore once the
+        breaker lets a store touch through. If the replay window was
+        lost while deferred, escalate to full recovery instead of
+        silently dropping data."""
+        pp = self._pending_partial
+        if pp is None:
+            return False
+        br = self.store_breaker
+        if br is not None and not br.allow():
+            return False
+        scope = set(pp["scope"])
+        committed = self.mgr.max_committed_epoch if self.mgr else 0
+        with self._replay_lock:
+            lost = any(
+                self._replay_floor.get(n, 0) is None
+                or self._replay_floor.get(n, 0)
+                > min(committed, self._replay_covered.get(n, committed))
+                for n in scope
+            )
+        if lost:
+            self._pending_partial = None
+            EVENT_LOG.record(
+                "recovery",
+                mode="auto",
+                cause="deferred partial recovery lost its replay window",
+            )
+            for p in self.fragments.values():
+                fn = getattr(p, "rebuild", None)
+                if fn is not None:
+                    fn()
+            self.last_recovery_mode = "full"
+            self.recover()
+            return True
+        try:
+            self._partial_recover(scope, str(pp["cause"]))
+        except STORE_UNAVAILABLE:
+            return False  # still down: stay deferred, never wedge
+        except Exception:
+            self._pending_partial = None
+            raise  # surfaces through barrier() -> _auto_recover routing
+        self._pending_partial = None
+        self.last_recovery_mode = "partial"
+        return True
+
+    def _staging_executors(self) -> List[object]:
+        """Executors eligible for checkpoint staging: while a deferred
+        partial recovery has fragments fenced, their (unrestored) state
+        must not be staged into a manifest — healthy fragments and aux
+        state keep committing around them."""
+        pp = self._pending_partial
+        if pp is None:
+            return self.executors()
+        skip = pp["scope"]
+        out: List[object] = []
+        for name, p in self.fragments.items():
+            if name in skip:
+                continue
+            out.extend(p.executors)
+        out.extend(self._aux_state)
+        return out
 
     # -- pipelined barrier path (in_flight_barriers > 1) -----------------
     def _validate_pipelined(self) -> None:
@@ -594,6 +1027,9 @@ class StreamingRuntime:
         for _name, p in self.fragments.items():
             p._epoch = prev
             p.barrier_nowait(checkpoint=is_ckpt, epoch=self._epoch)
+            # pipelined mode never takes the partial path, but the
+            # marker keeps the replay buffer's pruning cursor moving
+            self._record_barrier(_name, self._epoch, is_ckpt)
         with self._closer_cv:
             self._closer_q.append((self._epoch, is_ckpt, t0))
             self._ensure_closer()
@@ -645,7 +1081,9 @@ class StreamingRuntime:
                         # host buffers, never racing next-epoch compute
                         t_staged = time.perf_counter()
                         with span("checkpoint.stage", epoch=epoch):
-                            staged = self.mgr.stage(self.executors())
+                            staged = self.mgr.stage(
+                                self._staging_executors()
+                            )
                         if tr is not None:
                             tr.add_stage(
                                 "checkpoint_stage",
@@ -696,6 +1134,8 @@ class StreamingRuntime:
         # cooldown gates actual store touches, so a down store costs
         # nothing per barrier and a healed one replays the spill here
         self._maybe_restore_degraded()
+        # deferred partial recovery probes on the same clock
+        self._maybe_resume_partial()
         if self.in_flight_barriers > 1:
             return self._barrier_pipelined()
         t0 = time.perf_counter()
@@ -707,10 +1147,13 @@ class StreamingRuntime:
         )
         tr = self._begin_trace(is_ckpt)
         outs = {}
+        pending = self._pending_partial
         # registration order is topological (downstreams register after
         # their upstream), so an upstream's barrier-flush deltas reach a
         # subscriber BEFORE the subscriber's own barrier runs
         for name, p in self.fragments.items():
+            if pending is not None and name in pending["scope"]:
+                continue  # fenced: the deferred recovery owns this subtree
             p._epoch = prev  # fragments share the runtime's clock
             # non-checkpoint barriers must NOT commit sinks (exactly-
             # once: sink commits may never run ahead of durability);
@@ -720,6 +1163,9 @@ class StreamingRuntime:
             with span("barrier.fragment", fragment=name):
                 outs[name] = p.barrier(checkpoint=is_ckpt, epoch=self._epoch)
             self._route(name, outs[name])
+            # replay-buffer epoch fence: everything recorded before this
+            # marker belongs to epochs <= self._epoch for this fragment
+            self._record_barrier(name, self._epoch, is_ckpt)
             tr.add_stage(
                 "dispatch", (time.perf_counter() - tf) * 1e3, fragment=name
             )
@@ -922,7 +1368,7 @@ class StreamingRuntime:
         # commit (CheckpointManager.stage / commit_staged)
         t_staged = time.perf_counter()
         with span("checkpoint.stage"):
-            staged = self.mgr.stage(self.executors())
+            staged = self.mgr.stage(self._staging_executors())
         if tr is not None:
             tr.add_stage(
                 "checkpoint_stage", (time.perf_counter() - t_staged) * 1e3
@@ -987,11 +1433,16 @@ class StreamingRuntime:
     def _on_epoch_durable(self, epoch: int) -> None:
         """The epoch's manifest is persisted: release deferred sink
         deliveries (exactly-once: sink output never precedes the
-        durability of the state that produced it)."""
-        for ex in self.executors():
+        durability of the state that produced it), and prune the
+        partial-recovery replay buffer past the durable frontier.
+        Fragments fenced for a deferred partial recovery are EXCLUDED:
+        their held output belongs to state that is about to roll back
+        and replay — releasing it would double-deliver."""
+        for ex in self._staging_executors():
             fn = getattr(ex, "on_epoch_durable", None)
             if fn is not None:
                 fn(epoch)
+        self._prune_replay(epoch)
 
     # -- compaction lane (off the commit path) ---------------------------
     def _kick_compactor(self):
@@ -1094,10 +1545,57 @@ class StreamingRuntime:
                     break
             time.sleep(0.002)
 
-    def recover(self) -> None:
-        """Rebuild all fragment state from the last committed epoch."""
+    def recover(self, fragments: Optional[Sequence[str]] = None) -> None:
+        """Rebuild fragment state from the last committed epoch.
+
+        With ``fragments``, the recovery is FRAGMENT-SCOPED: only the
+        named fragments' pipelines rebuild, restore their state tables,
+        and replay their buffered inputs — every other fragment's live
+        state (and the epoch clock) is untouched. Without it, the full
+        stop-the-world restore (today's contract)."""
         if not self.mgr:
             raise RuntimeError("no object store configured")
+        if fragments is not None:
+            scope = set(fragments)
+            unknown = scope - set(self.fragments)
+            if unknown:
+                raise KeyError(f"unknown fragments {sorted(unknown)}")
+            # close the scope over subscribers: _replay_fragment discards
+            # replay outputs on the assumption every subscriber replays
+            # its OWN log — a half-closed manual scope would starve them
+            frontier = list(scope)
+            while frontier:
+                for sub, _side in self._subs.get(frontier.pop(), ()):
+                    if sub not in scope:
+                        scope.add(sub)
+                        frontier.append(sub)
+            # same replay-window guard the auto path enforces: replaying
+            # a cleared/late-anchored log would silently drop the
+            # un-durable window — refuse and point at full recovery
+            committed = self.mgr.max_committed_epoch
+            with self._replay_lock:
+                lost = sorted(
+                    n
+                    for n in scope
+                    if self._replay_floor.get(n, 0) is None
+                    or self._replay_floor.get(n, 0)
+                    > min(committed, self._replay_covered.get(n, committed))
+                )
+            if lost:
+                raise RuntimeError(
+                    f"replay window lost for {lost} (buffer overflow or "
+                    "not yet re-anchored at a durable barrier) — a scoped "
+                    "recovery would silently drop their un-durable "
+                    "window; use a full recover()"
+                )
+            # an explicit scoped recovery is a manual store probe too
+            if self.store_breaker is not None:
+                self.store_breaker.force_probe()
+            self.wait_checkpoints()
+            self._partial_recover(scope, "manual recover(fragments=...)")
+            self._pending_partial = None
+            self.last_recovery_mode = "partial"
+            return
         # an explicit recovery is a manual store probe: let it through
         # an open breaker (its reads settle the breaker either way)
         if self.store_breaker is not None:
@@ -1137,6 +1635,15 @@ class StreamingRuntime:
         self._work_err.clear()
         self._closer_err.clear()
         self._closer_abort.clear()
+        # a full restore supersedes any deferred partial recovery and
+        # resets the replay window: everything rolls back to the
+        # committed epoch and sources replay from their offsets, so the
+        # buffered inputs are stale
+        self._pending_partial = None
+        with self._replay_lock:
+            self._replay.clear()
+            self._replay_floor.clear()
+            self._replay_covered.clear()
         self._epoch = self.mgr.max_committed_epoch
         for p in self.fragments.values():
             p._epoch = self._epoch

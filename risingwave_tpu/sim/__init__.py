@@ -15,7 +15,10 @@ two compose so a crash can land mid-retry-loop.
 """
 
 from risingwave_tpu.sim.chaos import (
+    ActorChaosRunner,
+    ActorCrash,
     ChaosRunner,
+    CrashingExecutor,
     CrashingStore,
     CrashPoint,
     FlakyStore,
@@ -23,8 +26,11 @@ from risingwave_tpu.sim.chaos import (
 )
 
 __all__ = [
+    "ActorChaosRunner",
+    "ActorCrash",
     "ChaosRunner",
     "CrashPoint",
+    "CrashingExecutor",
     "CrashingStore",
     "FlakyStore",
     "chaos_seed",

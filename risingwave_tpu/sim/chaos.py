@@ -8,7 +8,7 @@ all live state mid-operation; durability is exactly what the object
 store holds. Recovery = rebuild executors + ``CheckpointManager.
 recover`` + source offsets resume (exactly-once's two halves).
 
-Two injectors compose:
+Three injectors compose:
 - ``CrashingStore`` — FATAL faults: ``arm(n)`` kills the process at
   the n-th subsequent write, and a dead process serves NOTHING (reads
   included — a killed node cannot answer).
@@ -18,6 +18,11 @@ Two injectors compose:
   resilience layer (risingwave_tpu/resilience.py) must absorb.
   Stack ``FlakyStore(CrashingStore(disk))`` and a crash can land in
   the MIDDLE of a retry loop (the retry re-enters the crash gate).
+- ``CrashingExecutor`` — ACTOR deaths: a pass-through executor planted
+  in a fragment chain that kills its actor thread mid-epoch (apply) or
+  at the barrier fence; ``ActorChaosRunner`` drives it randomly so the
+  runtime's fragment-scoped partial recovery (graph supervisor +
+  replay buffer) is chaos-tested, not just the store boundary.
 
 Replay: every runner failure message carries the fault-schedule seed;
 ``chaos_seed(default)`` lets tests accept ``RW_CHAOS_SEED`` to replay
@@ -30,6 +35,7 @@ import random
 import time
 from typing import Callable, Optional, Sequence
 
+from risingwave_tpu.executors.base import Executor
 from risingwave_tpu.resilience import (
     STORE_UNAVAILABLE,
     RetryingObjectStore,
@@ -167,6 +173,135 @@ class FlakyStore(ObjectStore):
     def delete(self, path: str) -> None:
         self._maybe_fault("delete", path)
         self.inner.delete(path)
+
+
+class ActorCrash(RuntimeError):
+    """Injected ACTOR death. Deliberately a RuntimeError (not a
+    BaseException like CrashPoint): it must ride the normal executor-
+    failure path — FragmentActor.run catches it, reports to the graph
+    supervisor via ``_actor_failed``, and the runtime's partial
+    recovery attributes/fences/restores exactly as for a real poisoned
+    executor."""
+
+
+class CrashingExecutor(Executor):
+    """Pass-through executor that murders its actor thread on demand —
+    the actor-kill injector ChaosRunner's store injectors cannot
+    provide. ``arm("apply")`` kills mid-epoch while a chunk is being
+    processed; ``arm("barrier")`` kills at the barrier fence;
+    ``always=True`` kills at EVERY barrier (deterministic fault — the
+    escalation-ladder fixture). One-shot arms disarm after firing, so
+    the recovery replay passes."""
+
+    def __init__(self, name: str = "crash"):
+        self.name = name
+        self._arm: Optional[Tuple[str, int]] = None
+        self.always = False
+        self.kills = 0
+
+    def arm(self, on: str = "apply", after: int = 1) -> None:
+        if on not in ("apply", "barrier"):
+            raise ValueError(f"unknown kill site {on!r}")
+        self._arm = (on, max(1, int(after)))
+
+    def _maybe_die(self, site: str) -> None:
+        if self.always and site == "barrier":
+            self.kills += 1
+            raise ActorCrash(f"{self.name}: deterministic kill at {site}")
+        if self._arm is not None and self._arm[0] == site:
+            on, left = self._arm
+            left -= 1
+            if left <= 0:
+                self._arm = None
+                self.kills += 1
+                raise ActorCrash(f"{self.name}: injected kill at {site}")
+            self._arm = (on, left)
+
+    # Executor surface (base defaults for everything else, so the
+    # epoch-batch fuser treats it as an opaque run-breaker)
+    def apply(self, chunk):
+        self._maybe_die("apply")
+        return [chunk]
+
+    def on_barrier(self, b):
+        self._maybe_die("barrier")
+        return []
+
+
+class ActorChaosRunner:
+    """ChaosRunner's actor-kill mode: murder a random actor mid-epoch
+    (via the workload's ``CrashingExecutor``s) and let the runtime's
+    supervisor recover — partially when the blast radius allows, fully
+    otherwise — then assert convergence against a fault-free twin.
+
+    ``make()`` returns a workload exposing:
+      - ``runtime``  — a StreamingRuntime with ``auto_recover=True``;
+      - ``crash_points`` — the CrashingExecutors planted in its chains;
+      - ``feed(i)``  — push epoch ``i``'s data (DETERMINISTIC per index)
+        and call ``runtime.barrier()``.
+
+    Pump contract after a barrier that recovered instead of committing:
+    ``runtime.last_recovery_mode`` says whether the failed window's
+    data was replayed in place (``"partial"`` — just barrier again) or
+    rolled back with everything else (``"full"`` — re-feed the same
+    index; state rolled back to the last commit, so the re-feed is the
+    replay). Every failure message carries the seed (RW_CHAOS_SEED
+    replays the schedule)."""
+
+    def __init__(
+        self,
+        make: Callable[[], object],
+        seed: int = 0,
+        kill_prob: float = 0.3,
+        kill_site: str = "mixed",
+    ):
+        self.make = make
+        self.seed = seed
+        self.rng = random.Random(seed ^ 0xAC70)
+        self.kill_prob = kill_prob
+        self.kill_site = kill_site
+        self.kills_armed = 0
+
+    def _fail(self, why: str) -> RuntimeError:
+        return RuntimeError(
+            f"actor-kill chaos run {why} (seed={self.seed}; rerun with "
+            f"RW_CHAOS_SEED={self.seed} to replay)"
+        )
+
+    def run(self, n_epochs: int, max_attempts: int = 200) -> object:
+        obj = self.make()
+        rt = obj.runtime
+        done = 0
+        attempts = 0
+        fed = False  # has epoch `done`'s data been pushed (and survived)?
+        while done < n_epochs:
+            attempts += 1
+            if attempts > max_attempts:
+                raise self._fail("did not converge")
+            if self.rng.random() < self.kill_prob and obj.crash_points:
+                cp = self.rng.choice(list(obj.crash_points))
+                site = (
+                    self.rng.choice(("apply", "barrier"))
+                    if self.kill_site == "mixed"
+                    else self.kill_site
+                )
+                cp.arm(on=site, after=1)
+                self.kills_armed += 1
+            before = rt.mgr.max_committed_epoch
+            if not fed:
+                obj.feed(done)
+                fed = True
+            else:
+                rt.barrier()
+            if rt.mgr.max_committed_epoch > before:
+                done += 1
+                fed = False
+            elif rt.last_recovery_mode == "full":
+                # full recovery rolled this window back to the last
+                # commit — the pump owns the replay: re-feed the index
+                fed = False
+        rt.wait_checkpoints()
+        return obj
 
 
 class ChaosRunner:

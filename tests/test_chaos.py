@@ -165,6 +165,245 @@ def test_flaky_fault_storm_heavy():
     _assert_converged(runner, obj.q8.mview.snapshot(), want)
 
 
+# ---------------------------------------------------------------------------
+# actor-kill chaos (partial recovery's madsim analogue): murder random
+# ACTORS mid-epoch — not the store — and converge bit-identically
+# ---------------------------------------------------------------------------
+
+
+class _ActorKillWorkload:
+    """Two graph MVs over one deterministic chunk stream; CrashingExecutors
+    planted in mv_b's parallel fragment are the runner's kill targets.
+    A kill's blast radius is mv_b only — mv_a must stay hot."""
+
+    def __init__(self, seed=101, n_epochs=8):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from risingwave_tpu.array.chunk import StreamChunk
+        from risingwave_tpu.executors.hash_agg import HashAggExecutor
+        from risingwave_tpu.executors.materialize import MaterializeExecutor
+        from risingwave_tpu.ops.agg import AggCall
+        from risingwave_tpu.runtime.fragmenter import (
+            GraphPipeline,
+            PartitionedStateView,
+        )
+        from risingwave_tpu.runtime.graph import FragmentSpec
+        from risingwave_tpu.runtime.runtime import StreamingRuntime
+        from risingwave_tpu.sim import CrashingExecutor
+
+        def mk_agg(tid):
+            return HashAggExecutor(
+                group_keys=("k",),
+                calls=(AggCall("sum", "v", "s"), AggCall("count_star", None, "c")),
+                schema_dtypes={"k": jnp.int64, "v": jnp.int64},
+                capacity=1 << 8,
+                table_id=tid,
+            )
+
+        self.runtime = StreamingRuntime(
+            MemObjectStore(), async_checkpoint=False, auto_recover=True
+        )
+        agg_a, self.mva = mk_agg("ka.agg"), MaterializeExecutor(
+            pk=("k",), columns=("s", "c"), table_id="ka.mview"
+        )
+        chain_a = [agg_a, self.mva]
+        gpa = GraphPipeline(
+            [
+                FragmentSpec("src", lambda i: []),
+                FragmentSpec(
+                    "work", lambda i, c=tuple(chain_a): list(c),
+                    inputs=[("src", 0)],
+                ),
+            ],
+            {"single": "src"}, "work", chain_a,
+            ckpt_fragments=["work"] * len(chain_a),
+        )
+        self.crash_points = [CrashingExecutor("p0"), CrashingExecutor("p1")]
+        aggs_b = [mk_agg("kb.agg") for _ in range(2)]
+        self.mvb = MaterializeExecutor(
+            pk=("k",), columns=("s", "c"), table_id="kb.mview"
+        )
+        chains = [
+            [self.crash_points[0], aggs_b[0]],
+            [self.crash_points[1], aggs_b[1]],
+        ]
+        gpb = GraphPipeline(
+            [
+                FragmentSpec("src", lambda i: [], dispatch=("hash", ["k"])),
+                FragmentSpec(
+                    "par", lambda i: list(chains[i]), inputs=[("src", 0)],
+                    parallelism=2,
+                ),
+                FragmentSpec("mat", lambda i: [self.mvb], inputs=[("par", 0)]),
+            ],
+            {"single": "src"}, "mat",
+            [PartitionedStateView(aggs_b, {"kb.agg": (0,)}), self.mvb],
+            ckpt_fragments=["par", "mat"],
+        )
+        self.runtime.register("mv_a", gpa)
+        self.runtime.register("mv_b", gpb)
+        rng = np.random.default_rng(seed)
+        self.chunks = []
+        for _ in range(n_epochs):
+            n = int(rng.integers(4, 12))
+            self.chunks.append(
+                StreamChunk.from_numpy(
+                    {
+                        "k": rng.integers(0, 8, n).astype("int64"),
+                        "v": rng.integers(0, 50, n).astype("int64"),
+                    },
+                    16,
+                )
+            )
+
+    def feed(self, i):
+        c = self.chunks[i]
+        self.runtime.push("mv_a", c)
+        self.runtime.push("mv_b", c)
+        self.runtime.barrier()
+
+    def snapshots(self):
+        return dict(self.mva.snapshot()), dict(self.mvb.snapshot())
+
+
+def test_actor_kill_chaos_converges_to_undisturbed():
+    """ChaosRunner's actor-kill mode at a tier-1-friendly rate: random
+    actor murders mid-epoch (apply AND barrier sites), recovered by the
+    fragment-scoped supervisor — both MVs bit-identical to the
+    fault-free twin, with at least one PARTIAL recovery exercised."""
+    from risingwave_tpu.sim import ActorChaosRunner
+
+    seed = chaos_seed(21)
+    n_epochs = 6
+    twin = _ActorKillWorkload()
+    for i in range(n_epochs):
+        twin.feed(i)
+    want = twin.snapshots()
+
+    runner = ActorChaosRunner(
+        _ActorKillWorkload, seed=seed, kill_prob=0.45, kill_site="mixed"
+    )
+    obj = runner.run(n_epochs)
+    kills = sum(cp.kills for cp in obj.crash_points)
+    assert kills >= 1, (
+        f"no actor was ever killed — raise kill_prob (seed={seed})"
+    )
+    got = obj.snapshots()
+    assert got == want, (
+        f"actor-kill chaos diverged from the fault-free twin "
+        f"(seed={seed}; rerun with RW_CHAOS_SEED={seed}: "
+        f"kills={kills} armed={runner.kills_armed} "
+        f"recoveries={obj.runtime.auto_recoveries} "
+        f"partial={obj.runtime.partial_recoveries})"
+    )
+    assert obj.runtime.partial_recoveries >= 1  # the scoped path ran
+
+
+@pytest.mark.slow
+def test_actor_kill_storm_q8_heavy():
+    """Heavy-kill storm over the q8 join graph: crash points in both
+    join-side chains, high kill rate, mixed sites — the partial-recovery
+    replay must keep join state exactly-once and converge."""
+    from risingwave_tpu.connectors.nexmark import NexmarkGenerator
+    from risingwave_tpu.queries.nexmark_q import build_q5_lite
+    from risingwave_tpu.runtime.fragmenter import GraphPipeline
+    from risingwave_tpu.runtime.graph import FragmentSpec
+    from risingwave_tpu.runtime.runtime import StreamingRuntime
+    from risingwave_tpu.sim import ActorChaosRunner, CrashingExecutor
+
+    seed = chaos_seed(33)
+    n_epochs = 6
+
+    class _Q8Kill:
+        def __init__(self):
+            self.runtime = StreamingRuntime(
+                MemObjectStore(), async_checkpoint=False, auto_recover=True
+            )
+            self.q8 = build_q8(capacity=1 << 12, state_cleaning=False)
+            tp = self.q8.pipeline
+            self.crash_points = [
+                CrashingExecutor("q8l"), CrashingExecutor("q8r"),
+            ]
+            build = {
+                "left": [self.crash_points[0]] + tp.left,
+                "right": [self.crash_points[1]] + tp.right,
+                "join": tp.join,
+                "tail": tp.tail,
+            }
+            specs = [
+                FragmentSpec("p", lambda i: []),
+                FragmentSpec("a", lambda i: []),
+                FragmentSpec(
+                    "join", lambda i, b=build: dict(b),
+                    inputs=[("p", 0), ("a", 1)],
+                ),
+            ]
+            gp = GraphPipeline(
+                specs, {"left": "p", "right": "a"}, "join", tp.executors,
+                ckpt_fragments=["join"] * len(tp.executors),
+            )
+            # a second, independent MV keeps the runtime multi-fragment
+            # so q8's blast radius stays a strict subset (partial path)
+            self.q5 = build_q5_lite(capacity=1 << 12, state_cleaning=False)
+            c5 = list(self.q5.pipeline.executors)
+            gp5 = GraphPipeline(
+                [
+                    FragmentSpec("src", lambda i: []),
+                    FragmentSpec(
+                        "work", lambda i, c=tuple(c5): list(c),
+                        inputs=[("src", 0)],
+                    ),
+                ],
+                {"single": "src"}, "work", c5,
+                ckpt_fragments=["work"] * len(c5),
+            )
+            self.runtime.register("q8", gp)
+            self.runtime.register("q5", gp5)
+            gen = NexmarkGenerator(NexmarkConfig(first_event_rate=25_000))
+            self.feeds = []
+            while len(self.feeds) < n_epochs:
+                ch = gen.next_chunks(6_000, 1 << 13)
+                if ch["person"] is None or ch["auction"] is None or ch["bid"] is None:
+                    continue
+                self.feeds.append(ch)
+
+        def feed(self, i):
+            ch = self.feeds[i]
+            self.runtime.push("q8", ch["person"], side="left")
+            self.runtime.push("q8", ch["auction"], side="right")
+            self.runtime.push(
+                "q5", ch["bid"].select(["auction", "date_time"])
+            )
+            self.runtime.barrier()
+
+        def snapshots(self):
+            return (
+                dict(self.q8.mview.snapshot()),
+                dict(self.q5.mview.snapshot()),
+            )
+
+    twin = _Q8Kill()
+    for i in range(n_epochs):
+        twin.feed(i)
+    want = twin.snapshots()
+    assert len(want[0]) > 20
+
+    runner = ActorChaosRunner(
+        _Q8Kill, seed=seed, kill_prob=0.6, kill_site="mixed"
+    )
+    obj = runner.run(n_epochs, max_attempts=300)
+    kills = sum(cp.kills for cp in obj.crash_points)
+    assert kills >= 1
+    got = obj.snapshots()
+    assert got == want, (
+        f"q8 heavy-kill storm diverged (seed={seed}; rerun with "
+        f"RW_CHAOS_SEED={seed}: kills={kills} "
+        f"recoveries={obj.runtime.auto_recoveries} "
+        f"partial={obj.runtime.partial_recoveries})"
+    )
+
+
 def test_dead_store_serves_nothing():
     """CrashingStore sim fidelity: once dead, EVERY op raises — a
     killed process cannot answer reads/exists/list either."""

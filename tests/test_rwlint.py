@@ -315,6 +315,80 @@ def test_e605_missing_out_fragment():
     _ddl_reject(_FakeGraph(specs, out="ghost"), "RW-E605", fragment="ghost")
 
 
+def test_e606_stateful_fragment_without_rebuildable_boundary():
+    """A GraphPipeline whose checkpoint registry does not cover a
+    fragment's stateful executor can never be PARTIALLY recovered (its
+    state checkpoints nowhere restorable) — refused at DDL time."""
+    from risingwave_tpu.runtime.fragmenter import GraphPipeline
+
+    agg = _agg(keys=("a",), tid="orphan.agg")
+    specs = [
+        FragmentSpec("src", lambda i: []),
+        FragmentSpec(
+            "work", lambda i, a=agg: [a], inputs=[("src", 0)]
+        ),
+    ]
+    # registry deliberately omits the agg: nothing can restore it
+    gp = GraphPipeline(
+        specs, {"single": "src"}, "work", [], ckpt_fragments=[]
+    )
+    try:
+        msg = _ddl_reject(gp, "RW-E606", fragment="work")
+        assert "orphan.agg" in msg
+    finally:
+        gp.close()
+
+
+def test_e606_registry_entry_without_restore_state():
+    """A checkpoint-registry entry that checkpoints but never
+    implements restore_state is flagged too (its deltas persist into a
+    table no recovery path can read back)."""
+    from risingwave_tpu.runtime.fragmenter import GraphPipeline
+    from risingwave_tpu.storage.state_table import Checkpointable
+
+    class WriteOnlyState(Checkpointable):
+        table_id = "writeonly.t"
+
+        def checkpoint_delta(self):
+            return []
+
+        # restore_state deliberately NOT implemented
+
+    wo = WriteOnlyState()
+    specs = [
+        FragmentSpec("src", lambda i: []),
+        FragmentSpec("work", lambda i: [], inputs=[("src", 0)]),
+    ]
+    gp = GraphPipeline(
+        specs, {"single": "src"}, "work", [wo], ckpt_fragments=["work"]
+    )
+    try:
+        msg = _ddl_reject(gp, "RW-E606")
+        assert "restore_state" in msg and "WriteOnlyState" in msg
+    finally:
+        gp.close()
+
+
+def test_e606_negative_fragmenter_plans_are_rebuildable():
+    """The fragmenter's own graph plans always carry a complete
+    restorable registry — no E606 on the real CREATE-MV path."""
+    from risingwave_tpu.runtime.fragmenter import graph_planned_mv
+    from risingwave_tpu.sql.planner import StreamPlanner
+
+    catalog = _src_catalog(("a", "b"))
+    planned = graph_planned_mv(
+        lambda: StreamPlanner(catalog),
+        "CREATE MATERIALIZED VIEW g AS SELECT a, count(*) AS n "
+        "FROM src GROUP BY a",
+        parallelism=2,
+    )
+    try:
+        diags = lint_planned(planned, catalog=catalog, strict=True)
+        assert not [d for d in diags if d.code == "RW-E606"]
+    finally:
+        planned.pipeline.close()
+
+
 def test_e701_state_pk_not_covered():
     mv = DeviceMaterializeExecutor(
         pk=("missing",),
