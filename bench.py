@@ -113,6 +113,64 @@ def _recompile_watch():
     return w
 
 
+def _profile_begin():
+    """Arm the dispatch-wall profiler for the measured run: every BENCH
+    JSON carries the per-executor decomposition of the dispatch stage
+    (executor_ms + device-wait), dispatches-per-barrier/row, and
+    host<->device transfer counts — the ranked fusion worklist for
+    ROADMAP open item 1. Fencing (per-call block_until_ready — the
+    host/device split) is armed ONLY on CPU: on a real device it would
+    serialize the async overlap the pipeline engineered and make the
+    timed numbers incomparable with unfenced artifacts. Force it with
+    RW_BENCH_PROFILE_FENCE=1; opt out of profiling entirely with
+    RW_BENCH_PROFILE=0."""
+    import os
+
+    if os.environ.get("RW_BENCH_PROFILE", "1") == "0":
+        return None
+    import jax
+
+    from risingwave_tpu.profiler import PROFILER
+
+    fence_env = os.environ.get("RW_BENCH_PROFILE_FENCE")
+    fence = (
+        fence_env != "0"
+        if fence_env is not None
+        else jax.default_backend() == "cpu"
+    )
+    PROFILER.reset()
+    return PROFILER.enable(fence=fence)
+
+
+def _profile_fields(prefix, prof, n_barriers, rows):
+    """Collect the profiler's surfaces into BENCH-JSON fields, print
+    the operator-readable top-5 dispatch-cost executors, and disarm."""
+    if prof is None:
+        return {}
+    total = prof.total_dispatches()
+    top = prof.top_executors()
+    fields = {
+        f"{prefix}_executor_ms": prof.executor_summary(),
+        f"{prefix}_device_dispatches": prof.dispatch_counts(),
+        f"{prefix}_dispatches_per_barrier": round(
+            total / max(n_barriers, 1), 2
+        ),
+        f"{prefix}_dispatches_per_row": round(total / max(rows, 1), 6),
+        f"{prefix}_transfers": prof.transfer_counts(),
+        f"{prefix}_top_executors": top,
+    }
+    print(f"[{prefix}] top dispatch-cost executors:", file=sys.stderr)
+    for d in top:
+        print(
+            f"  {d['executor']:<28} host {d.get('host_ms', 0.0):>9.1f}ms  "
+            f"device-wait {d.get('device_wait_ms', 0.0):>7.1f}ms  "
+            f"dispatches {d.get('dispatches', 0.0):>6.0f}",
+            file=sys.stderr,
+        )
+    prof.disable()
+    return fields
+
+
 def _state_cap(expected_rows: int, floor: int) -> int:
     """Table capacity whose growth margin covers the expected volume:
     growth REBUILDS tables at new capacities, and every new capacity
@@ -210,6 +268,10 @@ def bench_q8(gen_cfg, epochs, events_per_epoch, chunk_events):
     q8.pipeline.barrier()
     q8 = build_q8(capacity=c8, fanout=8, out_cap=1 << 14)
     recompiles = _recompile_watch()
+    from risingwave_tpu.metrics import REGISTRY
+
+    REGISTRY.histograms.pop("barrier_stage_ms", None)  # drop warmup obs
+    prof = _profile_begin()
 
     barrier_times = []
     t0 = time.perf_counter()
@@ -229,6 +291,8 @@ def bench_q8(gen_cfg, epochs, events_per_epoch, chunk_events):
             f"Q8 MISMATCH: device {len(got)} rows vs cpu {len(cpu_out)}",
             file=sys.stderr,
         )
+    from risingwave_tpu.epoch_trace import stage_breakdown
+
     return {
         "q8_throughput": round(total_rows / dt, 1),
         "q8_unit": "persons+auctions/sec",
@@ -239,6 +303,8 @@ def bench_q8(gen_cfg, epochs, events_per_epoch, chunk_events):
         ),
         "q8_correct": ok,
         "q8_recompiles": recompiles.deltas(),
+        "q8_barrier_stage_ms": stage_breakdown(),
+        **_profile_fields("q8", prof, len(barrier_times), total_rows),
     }
 
 
@@ -338,8 +404,16 @@ def bench_q7(gen_cfg, epochs, events_per_epoch, chunk_events):
     run(q7, mk()[:1])  # warmup epoch: compile everything
 
     recompiles = _recompile_watch()
+    # build + host->device conversion BEFORE arming the profiler: the
+    # measured dispatch/transfer counts describe steady-state barriers,
+    # not one-time construction (same protocol as q5/q8)
     q7 = mk_q7()
-    dt, barrier_times = run(q7, mk())
+    chunks7 = mk()
+    from risingwave_tpu.metrics import REGISTRY
+
+    REGISTRY.histograms.pop("barrier_stage_ms", None)  # drop warmup obs
+    prof = _profile_begin()
+    dt, barrier_times = run(q7, chunks7)
 
     got = q7.mview.snapshot()
     ok = got == cpu_out
@@ -348,6 +422,8 @@ def bench_q7(gen_cfg, epochs, events_per_epoch, chunk_events):
             f"Q7 MISMATCH: device {len(got)} rows vs cpu {len(cpu_out)}",
             file=sys.stderr,
         )
+    from risingwave_tpu.epoch_trace import stage_breakdown
+
     return {
         "q7_throughput": round(total_bids / dt, 1),
         "q7_unit": "bids/sec",
@@ -358,6 +434,8 @@ def bench_q7(gen_cfg, epochs, events_per_epoch, chunk_events):
         ),
         "q7_correct": ok,
         "q7_recompiles": recompiles.deltas(),
+        "q7_barrier_stage_ms": stage_breakdown(),
+        **_profile_fields("q7", prof, len(barrier_times), total_bids),
     }
 
 
@@ -429,6 +507,7 @@ def bench_q5_unified(epochs, events_per_epoch, chunk_events, smoke):
     REGISTRY.histograms.pop("barrier_stage_ms", None)
 
     dev_epochs = mk()  # host->device conversion OUTSIDE the timer
+    prof = _profile_begin()  # armed after build+conversion (steady state)
     barrier_times = []
     t0 = time.perf_counter()
     for ep in dev_epochs:
@@ -454,6 +533,10 @@ def bench_q5_unified(epochs, events_per_epoch, chunk_events, smoke):
     from risingwave_tpu.epoch_trace import stage_breakdown
 
     stages_sync = stage_breakdown()
+    # per-executor decomposition of the sync run's dispatch stage (the
+    # pipelined phase below runs unprofiled — the breakdown must
+    # describe the same run as stages_sync)
+    prof_fields = _profile_fields("q5u", prof, len(barrier_times), total_bids)
     snap = mv.mview.snapshot()  # {(auction, window_start): (num,)}
     ok = snap == {k: (v,) for k, v in cpu_counts.items()}
     mv.pipeline.close()
@@ -514,6 +597,7 @@ def bench_q5_unified(epochs, events_per_epoch, chunk_events, smoke):
         "achieved_bw_gbps": rf["achieved_bw_gbps"],
         "hbm_peak_gbps": rf["hbm_peak_gbps"],
         "hbm_bytes_touched": rf["hbm_bytes_touched"],
+        **prof_fields,
     }
 
 
@@ -585,12 +669,23 @@ def bench_q5(args_epochs, events_per_epoch, chunk_events, smoke, agg_mode):
 
     c5 = _state_cap(2 * events_per_epoch, 1 << 18)
 
-    def run_q5(epochs_chunks):
-        q5 = build_q5_lite(capacity=c5, state_cleaning=False)
+    def run_q5(epochs_chunks, q5=None):
+        from risingwave_tpu.profiler import PROFILER
+
+        if q5 is None:
+            q5 = build_q5_lite(capacity=c5, state_cleaning=False)
         barrier_times = []
         t0 = time.perf_counter()
         for stacked in epochs_chunks:
-            q5.agg.apply_stacked(stacked, pre=pre, mode=agg_mode)
+            if PROFILER.enabled:
+                # apply_stacked bypasses the chain walk — attribute its
+                # host time to the agg executor explicitly
+                PROFILER.run(
+                    q5.agg, "apply", q5.agg.apply_stacked,
+                    stacked, pre=pre, mode=agg_mode,
+                )
+            else:
+                q5.agg.apply_stacked(stacked, pre=pre, mode=agg_mode)
             tb = time.perf_counter()
             q5.pipeline.barrier()
             barrier_times.append(time.perf_counter() - tb)
@@ -608,8 +703,12 @@ def bench_q5(args_epochs, events_per_epoch, chunk_events, smoke, agg_mode):
 
     REGISTRY.histograms.pop("barrier_stage_ms", None)  # drop warmup obs
     recompiles = _recompile_watch()
+    # build + conversion outside the profiled window (steady-state
+    # dispatch counts, not construction)
     stacked = mk_stacked()
-    q5, dt, barrier_times = run_q5(stacked)
+    q5_fresh = build_q5_lite(capacity=c5, state_cleaning=False)
+    prof = _profile_begin()
+    q5, dt, barrier_times = run_q5(stacked, q5_fresh)
 
     rows_s = total_bids / dt
     p99_barrier_ms = float(np.percentile(np.asarray(barrier_times) * 1e3, 99))
@@ -651,6 +750,7 @@ def bench_q5(args_epochs, events_per_epoch, chunk_events, smoke, agg_mode):
         "q5_hbm_peak_gbps": rf["hbm_peak_gbps"],
         "q5_barrier_stage_ms": stage_breakdown(),
         "q5_recompiles": recompiles.deltas(),
+        **_profile_fields("q5", prof, len(barrier_times), total_bids),
     }
 
 

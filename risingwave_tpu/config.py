@@ -70,11 +70,30 @@ class ResilienceConfig:
 
 
 @dataclass
+class ProfilerConfig:
+    """Dispatch-wall profiler knobs (profiler.py). ``enabled`` turns on
+    per-executor attribution + dispatch/transfer counting;
+    ``slow_barrier_capture_ms`` auto-emits a PROFILE_* artifact (and a
+    forensic stall dump) when a barrier exceeds it; ``jax_trace`` arms
+    a real ``jax.profiler.trace`` window inside captures (heavy — the
+    JSON artifact is always written regardless). Env knobs
+    (RW_PROFILE, RW_PROFILE_SLOW_MS, RW_PROFILE_DIR,
+    RW_PROFILE_JAX_TRACE, RW_PROFILE_FENCE) win over the file."""
+
+    enabled: bool = False
+    fence: bool = True
+    slow_barrier_capture_ms: float = 0.0  # 0 = no auto-capture
+    capture_dir: str = ""
+    jax_trace: bool = False
+
+
+@dataclass
 class RwConfig:
     streaming: StreamingConfig = field(default_factory=StreamingConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
     system: SystemParams = field(default_factory=SystemParams)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    profiler: ProfilerConfig = field(default_factory=ProfilerConfig)
     unrecognized: Dict[str, Any] = field(default_factory=dict)
 
 
@@ -96,7 +115,9 @@ def load_config(
     if path is not None:
         with open(path, "rb") as f:
             data = tomllib.load(f)
-        for section in ("streaming", "storage", "system", "resilience"):
+        for section in (
+            "streaming", "storage", "system", "resilience", "profiler"
+        ):
             if section in data:
                 _apply(
                     getattr(cfg, section), data.pop(section),

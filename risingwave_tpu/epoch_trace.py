@@ -171,6 +171,7 @@ def stage_breakdown() -> Dict[str, Dict[str, float]]:
 # ---------------------------------------------------------------------------
 
 _DUMP_LOCK = threading.Lock()
+_DUMP_SEQ = [0]  # same-second dumps must not overwrite each other
 
 
 def dump_stalls(
@@ -207,18 +208,50 @@ def dump_stalls(
             doc["extra"] = extra
     except Exception as e:  # partial dump beats no dump
         doc["snapshot_error"] = repr(e)
+    try:
+        # device-side evidence (q7 wedge forensics): HBM memory stats,
+        # live-array census, accounted state tables, in-flight dispatch
+        # counters — a wedged TPU leaves data, not just a dead tunnel
+        from risingwave_tpu.profiler import device_forensics
+
+        doc["device"] = device_forensics()
+    except Exception as e:
+        doc["device"] = repr(e)
+    fallback_err = None
     if path is None:
         d = os.environ.get("RW_STALL_DIR", ".")
-        path = os.path.join(d, f"STALL_DUMP_{int(time.time())}.json")
+        with _DUMP_LOCK:
+            _DUMP_SEQ[0] += 1
+            seq = _DUMP_SEQ[0]
+        path = os.path.join(
+            d, f"STALL_DUMP_{int(time.time())}_{seq}.json"
+        )
     with _DUMP_LOCK:
         try:
             with open(path, "w") as f:
                 json.dump(doc, f, indent=1, default=str)
-        except OSError:
-            return ""
+        except OSError as e:
+            # RW_STALL_DIR unwritable: the forensic artifact still must
+            # land somewhere — fall back to the system temp dir and say
+            # so in the event log (previously a silent "")
+            fallback_err = repr(e)
+            import tempfile
+
+            path = os.path.join(
+                tempfile.gettempdir(), os.path.basename(path)
+            )
+            try:
+                with open(path, "w") as f:
+                    json.dump(doc, f, indent=1, default=str)
+            except OSError:
+                return ""
     try:
         from risingwave_tpu.event_log import EVENT_LOG
 
+        if fallback_err is not None:
+            EVENT_LOG.record(
+                "stall_dump_fallback", error=fallback_err, path=path
+            )
         EVENT_LOG.record("stall_dump", reason=reason, path=path)
     except Exception:
         pass

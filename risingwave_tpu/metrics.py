@@ -229,6 +229,22 @@ class MetricsRegistry:
                 f"<td>{s['p99']}</td><td>{s['count']}</td></tr>"
                 for lbl, s in sorted(h.summary().items())
             )
+        # dispatch-wall profile (profiler.py): the ranked per-executor
+        # cost table, when the profiler has been armed this process
+        prof_rows = ""
+        if "executor_ms" in self.histograms:
+            try:
+                from risingwave_tpu.profiler import PROFILER
+
+                prof_rows = "".join(
+                    f"<tr><td>{escape(str(d.get('executor', '-')))}</td>"
+                    f"<td>{d.get('host_ms', 0.0)}</td>"
+                    f"<td>{d.get('device_wait_ms', 0.0)}</td>"
+                    f"<td>{d.get('dispatches', 0.0):g}</td></tr>"
+                    for d in PROFILER.top_executors(10)
+                )
+            except Exception:
+                prof_rows = ""
         # resilience health: retry pressure + breaker states + degraded
         # mode (resilience.py) — the operator's first look when the
         # store flakes
@@ -273,6 +289,7 @@ td,th{{border:1px solid #999;padding:2px 8px}}h2{{margin-top:1.5em}}</style></he
 <h2>fragments &rarr; subscribers</h2><table>{frag_rows or '<tr><td>none</td></tr>'}</table>
 <h2>device state (top 40)</h2><table><tr><th>executor</th><th>table</th><th>bytes</th></tr>{state_rows}</table>
 <h2>barrier stages (ms)</h2><table><tr><th>stage</th><th>p50</th><th>p99</th><th>n</th></tr>{stage_rows or '<tr><td>no barriers traced</td></tr>'}</table>
+<h2>dispatch profile (top executors)</h2><table><tr><th>executor</th><th>host ms</th><th>device-wait ms</th><th>dispatches</th></tr>{prof_rows or '<tr><td>profiler not armed (RW_PROFILE=1)</td></tr>'}</table>
 <h2>resilience</h2><table><tr><th>metric</th><th>labels</th><th>value</th></tr>{res_rows or '<tr><td>no retries / breakers yet</td></tr>'}</table>
 <h2>events (last 25)</h2><table><tr><th>#</th><th>kind</th><th>detail</th></tr>{event_rows or '<tr><td>none</td></tr>'}</table>
 <p><a href="/metrics">/metrics</a> &middot; <a href="/heap">/heap</a> &middot; <a href="/events">/events</a></p>

@@ -46,7 +46,9 @@ from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.epoch_trace import record_stage
 from risingwave_tpu.executors.base import Barrier, Epoch, Executor, Watermark
 from risingwave_tpu.ops.hashing import VNODE_COUNT, hash_columns
-from risingwave_tpu.runtime.pipeline import _walk_watermark, walk_chain
+from risingwave_tpu.profiler import PROFILER
+from risingwave_tpu.runtime.pipeline import _pcall, _walk_watermark, walk_chain
+from risingwave_tpu.trace import span
 
 
 def _default_barrier_timeout() -> float:
@@ -313,11 +315,15 @@ class FragmentActor(threading.Thread):
         if port == 0:
             outs = []
             for c in self._through(self.chain, [chunk]):
-                outs.extend(self.join_exec.apply_left(c))
+                outs.extend(
+                    _pcall(self.join_exec, "apply", self.join_exec.apply_left, c)
+                )
         else:
             outs = []
             for c in self._through(self.right_chain, [chunk]):
-                outs.extend(self.join_exec.apply_right(c))
+                outs.extend(
+                    _pcall(self.join_exec, "apply", self.join_exec.apply_right, c)
+                )
         self._emit(self._through(self.tail, outs))
 
     def _process_barrier(self, b: Barrier) -> None:
@@ -327,15 +333,25 @@ class FragmentActor(threading.Thread):
         sync_point.hit(f"actor_barrier:{self.actor_name}")
         import time as _time
 
+        # epoch-correlated span: every actor a barrier crosses emits a
+        # slice carrying (epoch, fragment, actor) — chrome_trace links
+        # them with flow events, so one barrier is one arrow chain
+        # across the actor threads in Perfetto
         t0 = _time.perf_counter()
-        self._process_barrier_inner(b)
-        t1 = _time.perf_counter()
-        # flush + emit happened above; finish_barrier below is the
-        # barrier-only device fence (staged-scalar materialization);
-        # transfer_guard (when armed) rejects implicit transfers here
-        with transfer_guard():
-            for ex in self.executors:
-                ex.finish_barrier()
+        with span(
+            "actor.barrier",
+            epoch=b.epoch.curr,
+            fragment=self.actor_name,
+            actor=self.actor_name,
+        ), PROFILER.barrier_window(fragment=self.actor_name):
+            self._process_barrier_inner(b)
+            t1 = _time.perf_counter()
+            # flush + emit happened above; finish_barrier below is the
+            # barrier-only device fence (staged-scalar materialization);
+            # transfer_guard (when armed) rejects implicit transfers here
+            with transfer_guard():
+                for ex in self.executors:
+                    ex.finish_barrier()
         t2 = _time.perf_counter()
         record_stage("dispatch", (t1 - t0) * 1e3, fragment=self.actor_name)
         record_stage("device_step", (t2 - t1) * 1e3, fragment=self.actor_name)
@@ -370,10 +386,16 @@ class FragmentActor(threading.Thread):
         else:
             joined: List[StreamChunk] = []
             for c in self._through(self.chain, [], barrier=b):
-                joined.extend(self.join_exec.apply_left(c))
+                joined.extend(
+                    _pcall(self.join_exec, "apply", self.join_exec.apply_left, c)
+                )
             for c in self._through(self.right_chain, [], barrier=b):
-                joined.extend(self.join_exec.apply_right(c))
-            joined.extend(self.join_exec.on_barrier(b))
+                joined.extend(
+                    _pcall(self.join_exec, "apply", self.join_exec.apply_right, c)
+                )
+            joined.extend(
+                _pcall(self.join_exec, "flush", self.join_exec.on_barrier, b)
+            )
             outs = self._through(self.tail, joined, barrier=b)
             gen, gwms = self._generated_watermarks_join()
             wms.extend(gwms)
@@ -1051,8 +1073,13 @@ class GraphRuntime:
         collected it. ``epoch`` pins the barrier's curr epoch (a
         runtime passes its own clock so the graph's epochs line up with
         checkpoint manifests)."""
+        t0 = time.perf_counter()
         b = self.inject_barrier_nowait(checkpoint=checkpoint, epoch=epoch)
         self.wait_barrier(b.epoch.curr, timeout=timeout)
+        if PROFILER.enabled:
+            # slow-barrier auto-capture for graph-only drivers (the
+            # StreamingRuntime hooks its own barrier clock separately)
+            PROFILER.observe_barrier((time.perf_counter() - t0) * 1e3)
         return b
 
     def stop(self, timeout: float = 30.0) -> None:

@@ -23,6 +23,7 @@ from typing import List, Optional, Sequence
 from risingwave_tpu.analysis.jax_sanitizer import SIGNATURES, transfer_guard
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Barrier, Epoch, Executor, Watermark
+from risingwave_tpu.profiler import PROFILER
 
 
 def walk_chain(chain: Sequence[Executor], chunks, barrier=None):
@@ -31,19 +32,35 @@ def walk_chain(chain: Sequence[Executor], chunks, barrier=None):
     the executors below it. The single chain-walking loop shared by
     Pipeline, TwoInputPipeline and the graph runtime's FragmentActor."""
     pending = list(chunks)
-    # recompile-hazard fingerprinting (analysis/jax_sanitizer): one
-    # attribute check when disarmed — the hot path stays flat
+    # recompile-hazard fingerprinting (analysis/jax_sanitizer) and the
+    # dispatch-wall profiler: one attribute check each when disarmed —
+    # the hot path stays flat
     watch = SIGNATURES if SIGNATURES.enabled else None
+    prof = PROFILER if PROFILER.enabled else None
     for ex in chain:
         nxt: List[StreamChunk] = []
         for c in pending:
             if watch is not None:
                 watch.observe(ex, c)
-            nxt.extend(ex.apply(c))
+            if prof is None:
+                nxt.extend(ex.apply(c))
+            else:
+                nxt.extend(prof.run(ex, "apply", ex.apply, c))
         if barrier is not None:
-            nxt.extend(ex.on_barrier(barrier))
+            if prof is None:
+                nxt.extend(ex.on_barrier(barrier))
+            else:
+                nxt.extend(prof.run(ex, "flush", ex.on_barrier, barrier))
         pending = nxt
     return pending
+
+
+def _pcall(ex, phase, fn, *args):
+    """Profiler-gated call for executor entry points OUTSIDE walk_chain
+    (join apply_left/right, on_barrier in two-input shapes)."""
+    if PROFILER.enabled:
+        return PROFILER.run(ex, phase, fn, *args)
+    return fn(*args)
 
 
 class Pipeline:
@@ -74,29 +91,25 @@ class Pipeline:
         )
         b = Barrier(Epoch(prev, self._epoch), checkpoint)
         t0 = time.perf_counter()
-        pending: List[StreamChunk] = []
-        for i, ex in enumerate(self.executors):
-            nxt: List[StreamChunk] = []
-            for c in pending:
-                nxt.extend(ex.apply(c))
-            nxt.extend(ex.on_barrier(b))
-            pending = nxt
-        # executor-GENERATED watermarks (watermark_filter.rs) walk the
-        # rest of the chain after the barrier flushes
-        for i, ex in enumerate(self.executors):
-            wm = ex.emit_watermark()
-            if wm is not None:
-                _, outs = _walk_watermark(self.executors[i + 1 :], wm)
-                pending.extend(outs)
-        t1 = time.perf_counter()
-        # materialize every executor's staged barrier scalars AFTER the
-        # walk: the async transfers overlapped, so the chain pays ~one
-        # round-trip; raises still precede the runtime's epoch commit.
-        # transfer_guard: when armed (RW_TRANSFER_GUARD, tests) any
-        # IMPLICIT host<->device transfer here raises at the offender
-        with transfer_guard():
-            for ex in self.executors:
-                ex.finish_barrier()
+        with PROFILER.barrier_window():
+            pending = walk_chain(self.executors, [], barrier=b)
+            # executor-GENERATED watermarks (watermark_filter.rs) walk
+            # the rest of the chain after the barrier flushes
+            for i, ex in enumerate(self.executors):
+                wm = ex.emit_watermark()
+                if wm is not None:
+                    _, outs = _walk_watermark(self.executors[i + 1 :], wm)
+                    pending.extend(outs)
+            t1 = time.perf_counter()
+            # materialize every executor's staged barrier scalars AFTER
+            # the walk: the async transfers overlapped, so the chain
+            # pays ~one round-trip; raises still precede the runtime's
+            # epoch commit. transfer_guard: when armed
+            # (RW_TRANSFER_GUARD, tests) any IMPLICIT host<->device
+            # transfer here raises at the offender
+            with transfer_guard():
+                for ex in self.executors:
+                    ex.finish_barrier()
         # stage attribution (EpochTrace lifecycle): the walk is host
         # dispatch; the scalar materialization is the barrier-only
         # device fence
@@ -162,13 +175,13 @@ class TwoInputPipeline:
     def push_left(self, chunk: StreamChunk) -> List[StreamChunk]:
         outs = []
         for c in self._through(self.left, [chunk]):
-            outs.extend(self.join.apply_left(c))
+            outs.extend(_pcall(self.join, "apply", self.join.apply_left, c))
         return self._through(self.tail, outs)
 
     def push_right(self, chunk: StreamChunk) -> List[StreamChunk]:
         outs = []
         for c in self._through(self.right, [chunk]):
-            outs.extend(self.join.apply_right(c))
+            outs.extend(_pcall(self.join, "apply", self.join.apply_right, c))
         return self._through(self.tail, outs)
 
     def barrier(
@@ -182,18 +195,23 @@ class TwoInputPipeline:
         )
         b = Barrier(Epoch(prev, self._epoch), checkpoint)
         t0 = time.perf_counter()
-        joined: List[StreamChunk] = []
-        for c in self._through(self.left, [], barrier=b):
-            joined.extend(self.join.apply_left(c))
-        for c in self._through(self.right, [], barrier=b):
-            joined.extend(self.join.apply_right(c))
-        joined.extend(self.join.on_barrier(b))
-        outs = self._through(self.tail, joined, barrier=b)
-        outs.extend(self._generated_watermarks())
-        t1 = time.perf_counter()
-        with transfer_guard():
-            for ex in self.executors:
-                ex.finish_barrier()
+        with PROFILER.barrier_window():
+            joined: List[StreamChunk] = []
+            for c in self._through(self.left, [], barrier=b):
+                joined.extend(
+                    _pcall(self.join, "apply", self.join.apply_left, c)
+                )
+            for c in self._through(self.right, [], barrier=b):
+                joined.extend(
+                    _pcall(self.join, "apply", self.join.apply_right, c)
+                )
+            joined.extend(_pcall(self.join, "flush", self.join.on_barrier, b))
+            outs = self._through(self.tail, joined, barrier=b)
+            outs.extend(self._generated_watermarks())
+            t1 = time.perf_counter()
+            with transfer_guard():
+                for ex in self.executors:
+                    ex.finish_barrier()
         from risingwave_tpu.epoch_trace import record_stage
 
         record_stage("dispatch", (t1 - t0) * 1e3)

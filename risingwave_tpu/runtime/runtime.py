@@ -51,6 +51,7 @@ from risingwave_tpu.resilience import (
     RetryingObjectStore,
     RetryPolicy,
 )
+from risingwave_tpu.profiler import PROFILER
 from risingwave_tpu.trace import span
 from risingwave_tpu.storage.object_store import ObjectStore
 from risingwave_tpu.storage.state_table import CheckpointManager
@@ -92,6 +93,11 @@ class StreamingRuntime:
                 failure_threshold=res.breaker_threshold,
                 cooldown_s=res.breaker_cooldown_s,
             )
+        prof = getattr(cfg, "profiler", None)
+        if prof is not None:
+            # [profiler] section arms the dispatch-wall profiler for
+            # the process (env RW_PROFILE_* wins inside configure)
+            PROFILER.configure(prof)
         return cls(
             store,
             barrier_interval_ms=cfg.system.barrier_interval_ms,
@@ -122,6 +128,10 @@ class StreamingRuntime:
         # epoch, roll source offsets back so the pump replays
         self.auto_recover = auto_recover
         self.auto_recoveries = 0
+        # RW_PROFILE env arming must work on EVERY construction path
+        # (serve without --config, compute_node, direct construction),
+        # not only from_config; a no-op when the env var is unset
+        PROFILER.from_env()
         # state >> HBM control (the reference's LRU memory controller,
         # src/compute/src/memory/controller.rs role): when accounted
         # device state exceeds the budget, fully-durable groups are
@@ -648,6 +658,10 @@ class StreamingRuntime:
         self.last_failure = cause
         REGISTRY.counter("auto_recoveries_total").inc()
         self.auto_recoveries += 1
+        # close any open profiler capture window FIRST: an orphaned
+        # jax.profiler session surviving a recovery would hold the
+        # device and poison the next capture (watchdog-orphan audit)
+        PROFILER.abort_captures()
         # a latched capacity overflow needs the full path's grow-and-
         # replay cure; everything else may be partial-eligible
         latched = any(
@@ -1160,7 +1174,9 @@ class StreamingRuntime:
             # the runtime's epoch is passed down so held sink batches
             # key by the exact epoch _commit/_on_epoch_durable will use
             tf = time.perf_counter()
-            with span("barrier.fragment", fragment=name):
+            with span(
+                "barrier.fragment", fragment=name, epoch=self._epoch
+            ), PROFILER.barrier_window(fragment=name):
                 outs[name] = p.barrier(checkpoint=is_ckpt, epoch=self._epoch)
             self._route(name, outs[name])
             # replay-buffer epoch fence: everything recorded before this
@@ -1178,6 +1194,10 @@ class StreamingRuntime:
         self.barrier_latencies_ms.append(ms)
         REGISTRY.histogram("barrier_latency_ms").observe(ms)
         REGISTRY.counter("barriers_total").inc()
+        if PROFILER.enabled:
+            # slow-barrier auto-capture: a barrier over the profile
+            # threshold leaves a PROFILE_* artifact + forensic dump
+            PROFILER.observe_barrier(ms, runtime=self)
         return outs
 
     # -- EpochTrace plumbing ---------------------------------------------
@@ -1555,6 +1575,8 @@ class StreamingRuntime:
         stop-the-world restore (today's contract)."""
         if not self.mgr:
             raise RuntimeError("no object store configured")
+        # manual recovery mirrors the auto path's capture hygiene
+        PROFILER.abort_captures()
         if fragments is not None:
             scope = set(fragments)
             unknown = scope - set(self.fragments)

@@ -1,4 +1,4 @@
-"""Tracing — lightweight spans with chrome-trace export.
+"""Tracing — lightweight spans with chrome-trace / Perfetto export.
 
 Reference: the reference threads `tracing` spans through every actor/
 executor and exports via opentelemetry (src/utils/runtime/src/, await
@@ -6,6 +6,16 @@ tree dumps). Here spans are host-side (device work is opaque inside
 XLA programs anyway): a context manager records (name, start, dur,
 args) per thread into a bounded ring, renders chrome://tracing JSON,
 and mirrors durations into the metrics registry.
+
+Perfetto niceties (dispatch-wall profiler):
+- stable per-thread tids (a small registry id, never ``tid % 1e6``
+  which can collide across threads) plus ``ph:"M"`` thread_name
+  metadata, so the flame view shows actor names;
+- per-fragment pid lanes: spans carrying a ``fragment`` arg render in
+  that fragment's own process track (named via process_name metadata);
+- epoch flow events: spans carrying an ``epoch`` arg are linked with
+  ``ph:"s"/"t"`` flow arrows, so one barrier is traceable across every
+  actor thread it crossed.
 """
 
 from __future__ import annotations
@@ -25,6 +35,36 @@ _MAX_EVENTS = 65_536
 # currently-open span stack is snapshotable via active_spans())
 _ACTIVE_LOCK = threading.Lock()
 _ACTIVE: dict = {}  # tid -> (thread_name, [ {span, t0, args}, ... ])
+
+# stable small tids: python thread idents are reused after thread death
+# and collide under ``% 1_000_000`` — assign each (ident, name) its own
+# monotonic id. Names live in a SEPARATE {small_tid: name} map that is
+# append-only: a recycled ident gets a fresh small tid, and the dead
+# thread's tid keeps its name (post-recovery traces still label the
+# pre-fault actor's lane correctly).
+_TID_LOCK = threading.Lock()
+_TIDS: dict = {}  # python ident -> (small_tid, thread_name)
+_TID_NAMES: dict = {}  # small_tid -> thread_name (never overwritten)
+_NEXT_TID = [1]
+
+
+def _stable_tid() -> int:
+    ident = threading.get_ident()
+    with _TID_LOCK:
+        entry = _TIDS.get(ident)
+        name = threading.current_thread().name
+        if entry is None or entry[1] != name:
+            # new thread, or the ident was recycled by a new thread
+            entry = (_NEXT_TID[0], name)
+            _NEXT_TID[0] += 1
+            _TIDS[ident] = entry
+            _TID_NAMES[entry[0]] = name
+        return entry[0]
+
+
+def _thread_names() -> dict:
+    with _TID_LOCK:
+        return dict(_TID_NAMES)
 
 
 def active_spans() -> dict:
@@ -64,6 +104,7 @@ class Tracer:
             if tid not in _ACTIVE:
                 _ACTIVE[tid] = (threading.current_thread().name, [])
             _ACTIVE[tid][1].append(frame)
+        stid = _stable_tid()
         try:
             yield
         finally:
@@ -80,7 +121,7 @@ class Tracer:
                 self._events.append(
                     (
                         name,
-                        tid,
+                        stid,
                         t0,
                         dur,
                         args or None,
@@ -89,23 +130,90 @@ class Tracer:
             REGISTRY.histogram("span_ms").observe(dur * 1e3, span=name)
 
     def chrome_trace(self) -> str:
-        """chrome://tracing / perfetto 'traceEvents' JSON."""
+        """chrome://tracing / Perfetto 'traceEvents' JSON: named threads
+        (ph:"M" thread_name), per-fragment pid lanes, and epoch flow
+        events (ph:"s"/"t") linking one barrier across actor threads."""
         with self._lock:
             events = list(self._events)
+        # the ring appends at span COMPLETION; flow binding needs start
+        # order so the "s" (first) event of an epoch precedes its "t"s
+        events.sort(key=lambda e: e[2])
         out = []
+        # pid lanes: 1 = host/unattributed; each fragment its own pid
+        frag_pids: dict = {}
+        pids_seen = {1}
+        tids_by_pid: dict = {}  # pid -> set(tid)
+        epochs_seen: dict = {}  # epoch -> first-event flag
         for name, tid, t0, dur, args in events:
+            pid = 1
+            if args and "fragment" in args:
+                frag = str(args["fragment"])
+                pid = frag_pids.setdefault(frag, 2 + len(frag_pids))
+                pids_seen.add(pid)
+            tids_by_pid.setdefault(pid, set()).add(tid)
             ev = {
                 "name": name,
                 "ph": "X",
-                "pid": 1,
-                "tid": tid % 1_000_000,
+                "pid": pid,
+                "tid": tid,
                 "ts": t0 * 1e6,
                 "dur": dur * 1e6,
             }
             if args:
                 ev["args"] = args
             out.append(ev)
-        return json.dumps({"traceEvents": out})
+            epoch = (args or {}).get("epoch")
+            if epoch is not None:
+                # flow arrows: first span of the epoch starts the flow,
+                # every later span binds to it (enclosing-slice binding)
+                first = epoch not in epochs_seen
+                epochs_seen[epoch] = True
+                out.append(
+                    {
+                        "name": f"epoch {epoch}",
+                        "cat": "epoch",
+                        # string id: epochs are ms<<16, so truncating
+                        # to 32 bits would alias barriers ~65s apart
+                        # into one bogus flow chain
+                        "ph": "s" if first else "t",
+                        "id": str(epoch),
+                        "pid": pid,
+                        "tid": tid,
+                        "ts": t0 * 1e6,
+                        "bp": "e",
+                    }
+                )
+        # metadata: process names (fragment lanes) + thread names
+        names = _thread_names()
+        meta = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": 1,
+                "args": {"name": "host"},
+            }
+        ]
+        for frag, pid in sorted(frag_pids.items(), key=lambda kv: kv[1]):
+            meta.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "args": {"name": f"fragment:{frag}"},
+                }
+            )
+        for pid in sorted(pids_seen):
+            for tid in sorted(tids_by_pid.get(pid, ())):
+                meta.append(
+                    {
+                        "name": "thread_name",
+                        "ph": "M",
+                        "pid": pid,
+                        "tid": tid,
+                        "args": {"name": names.get(tid, f"thread-{tid}")},
+                    }
+                )
+        return json.dumps({"traceEvents": meta + out})
 
     def dump(self, path: str) -> None:
         with open(path, "w") as f:

@@ -12,6 +12,10 @@ Stages (all must pass; exit code is the OR of their failures):
    F401 class) + byte-compilation of every file (syntax errors).
 2. ``python -m risingwave_tpu lint --all-nexmark --deep`` — the static
    plan verifier + jaxpr sanitizer over q5/q7/q8.
+3. ``python scripts/perf_gate.py --smoke`` — the dispatch-cost
+   regression gate: committed BENCH artifacts vs
+   scripts/perf_budgets.json, plus the CPU q5 steady-state microbench
+   (bounded device dispatches/barrier + host-python ms/row).
 """
 
 from __future__ import annotations
@@ -129,9 +133,21 @@ def stage_rwlint() -> int:
     )
 
 
+def stage_perf_gate() -> int:
+    print("[lint_all] perf_gate --smoke (dispatch-cost budgets)")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.call(
+        [sys.executable, os.path.join(ROOT, "scripts", "perf_gate.py"),
+         "--smoke"],
+        cwd=ROOT,
+        env=env,
+    )
+
+
 def main() -> int:
     rc = stage_host_lint()
     rc |= stage_rwlint()
+    rc |= stage_perf_gate()
     print(f"[lint_all] {'FAIL' if rc else 'ok'}")
     return rc
 

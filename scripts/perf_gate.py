@@ -1,0 +1,278 @@
+#!/usr/bin/env python
+"""perf_gate — the dispatch-cost regression gate.
+
+Two modes, both against the committed budgets
+(``scripts/perf_budgets.json``):
+
+1. ``--bench BENCH_*.json`` (default: BENCH_partial.json): pure-JSON
+   comparison of a bench artifact's stage/executor p99s,
+   dispatches-per-row and dispatches-per-barrier against the budgets.
+   No jax import — runs in ~100ms, safe anywhere. Fields a (seed)
+   artifact does not carry are SKIPPED with a note, never failed: the
+   gate tightens as artifacts grow richer, it does not brick old ones.
+
+2. ``--smoke``: a CPU-cheap q5 steady-state microbench run in-process
+   with the dispatch-wall profiler armed — asserts the steady-state
+   device-dispatch count per barrier and the host-python ms/row stay
+   under budget. This is the tier-1 CI smoke: the fragment-fusion work
+   (ROADMAP open item 1) drives dispatches-per-barrier toward 1; this
+   gate makes sure nothing silently drives it the other way.
+
+Exit code: 0 = within budget, 1 = regression, 2 = usage/IO error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BUDGETS = os.path.join(ROOT, "scripts", "perf_budgets.json")
+DEFAULT_BENCH = os.path.join(ROOT, "BENCH_partial.json")
+
+
+def _load(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# mode 1: bench-artifact comparison (pure JSON)
+# ---------------------------------------------------------------------------
+
+
+def _stage_p99(bench: dict, stage: str) -> float:
+    """Max p99 of one stage across every fragment label set, over all
+    ``*barrier_stage_ms`` blocks in the artifact."""
+    worst = 0.0
+    for key, block in bench.items():
+        if not key.endswith("barrier_stage_ms") or not isinstance(block, dict):
+            continue
+        for lbl, row in block.items():
+            if f"stage={stage}" in lbl and isinstance(row, dict):
+                worst = max(worst, float(row.get("p99", 0.0)))
+    return worst
+
+
+def check_bench(bench: dict, budgets: dict, verbose=True):
+    """Returns (violations, skipped) lists of strings."""
+    b = budgets.get("bench", {})
+    violations, skipped = [], []
+
+    def note(msg):
+        if verbose:
+            print(f"[perf_gate] {msg}")
+
+    for stage, mx in b.get("stage_p99_ms", {}).items():
+        got = _stage_p99(bench, stage)
+        if got == 0.0:
+            skipped.append(f"stage {stage}: no observations in artifact")
+            continue
+        if got > mx:
+            violations.append(
+                f"stage {stage} p99 {got:.2f}ms > budget {mx}ms"
+            )
+        else:
+            note(f"stage {stage} p99 {got:.2f}ms <= {mx}ms ok")
+    for key, mx in b.get("scalar_max", {}).items():
+        if key not in bench:
+            skipped.append(f"{key}: absent from artifact")
+            continue
+        got = float(bench[key])
+        if got > mx:
+            violations.append(f"{key} = {got} > budget {mx}")
+        else:
+            note(f"{key} = {got} <= {mx} ok")
+    for q, mx in b.get("dispatches_per_row_max", {}).items():
+        key = f"{q}_dispatches_per_row"
+        if key not in bench:
+            skipped.append(f"{key}: absent from artifact")
+            continue
+        got = float(bench[key])
+        if got > mx:
+            violations.append(
+                f"{q}: {got} device dispatches/row > budget {mx} "
+                "(per-op dispatch regression — see PROFILE.md worklist)"
+            )
+        else:
+            note(f"{q}: {got} dispatches/row <= {mx} ok")
+    for q, mx in b.get("dispatches_per_barrier_max", {}).items():
+        key = f"{q}_dispatches_per_barrier"
+        if key not in bench:
+            skipped.append(f"{key}: absent from artifact")
+            continue
+        got = float(bench[key])
+        if got > mx:
+            violations.append(
+                f"{q}: {got} device dispatches/barrier > budget {mx}"
+            )
+        else:
+            note(f"{q}: {got} dispatches/barrier <= {mx} ok")
+    # executor-attribution coverage: when the artifact carries the
+    # per-executor decomposition it must actually explain the dispatch
+    # stage (≥ coverage_min of the stage total), or the breakdown has
+    # rotted into decoration
+    cov_min = b.get("executor_coverage_min")
+    if cov_min:
+        for q in ("q5", "q5u", "q7", "q8"):
+            blk = bench.get(f"{q}_executor_ms")
+            if not isinstance(blk, dict):
+                skipped.append(f"{q}_executor_ms: absent from artifact")
+                continue
+            cov = executor_coverage(bench, q)
+            if cov is None:
+                skipped.append(f"{q}: no dispatch-stage data to cover")
+            elif cov < cov_min:
+                violations.append(
+                    f"{q}: executor attribution covers only "
+                    f"{cov:.0%} of the dispatch stage (< {cov_min:.0%})"
+                )
+            else:
+                note(f"{q}: executor attribution covers {cov:.0%} ok")
+    return violations, skipped
+
+
+def executor_coverage(bench: dict, q: str):
+    """Fraction of the query's dispatch-stage total explained by its
+    per-executor (flush + barrier_apply, host + device-wait) sums."""
+    stage_key = "barrier_stage_ms" if q == "q5u" else f"{q}_barrier_stage_ms"
+    stages = bench.get(stage_key) or {}
+    disp = sum(
+        float(row.get("sum", 0.0))
+        for lbl, row in stages.items()
+        if "stage=dispatch" in lbl and isinstance(row, dict)
+    )
+    if disp <= 0:
+        return None
+    blk = bench.get(f"{q}_executor_ms") or {}
+    covered = 0.0
+    for hist in ("executor_ms", "executor_device_wait_ms"):
+        for lbl, row in (blk.get(hist) or {}).items():
+            if ("phase=flush" in lbl or "phase=barrier_apply" in lbl) and (
+                isinstance(row, dict)
+            ):
+                covered += float(row.get("sum", 0.0))
+    return covered / disp
+
+
+# ---------------------------------------------------------------------------
+# mode 2: steady-state smoke microbench (CPU, in-process)
+# ---------------------------------------------------------------------------
+
+
+def run_smoke(budgets: dict, epochs: int = 4, events: int = 2_000):
+    """q5 steady state with the profiler armed: bounded device
+    dispatches per barrier + bounded host-python ms per row. Returns
+    (violations, report dict)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if ROOT not in sys.path:  # runnable as a script from anywhere
+        sys.path.insert(0, ROOT)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from risingwave_tpu.connectors.nexmark import (
+        NexmarkConfig,
+        NexmarkGenerator,
+    )
+    from risingwave_tpu.metrics import REGISTRY
+    from risingwave_tpu.profiler import PROFILER
+    from risingwave_tpu.queries.nexmark_q import build_q5_lite
+
+    sb = budgets.get("smoke", {})
+    q5 = build_q5_lite(capacity=1 << 12, state_cleaning=False)
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=50_000))
+    # STEADY state: the same chunk every epoch (fresh keys would grow
+    # the table — a legitimate recompile, not the regression here)
+    bid = gen.next_chunks(events, 1 << 11)["bid"].select(
+        ["auction", "date_time"]
+    )
+    rows = int(bid.valid.sum())
+
+    def epoch():
+        q5.pipeline.push(bid)
+        q5.pipeline.barrier()
+
+    epoch()
+    epoch()  # warm: compiles + first-flush paths
+    PROFILER.reset()
+    PROFILER.enable(fence=False)  # count + host-attribute, no fencing
+    try:
+        per_epoch = []
+        for _ in range(epochs):
+            base = PROFILER.total_dispatches()
+            epoch()
+            per_epoch.append(PROFILER.total_dispatches() - base)
+        h = REGISTRY.histograms.get("executor_ms")
+        host_ms = sum(h._sum.values()) if h is not None else 0.0
+    finally:
+        PROFILER.disable()
+        PROFILER.reset()
+    dpb = max(per_epoch) if per_epoch else 0.0
+    ms_per_row = host_ms / max(rows * epochs, 1)
+    report = {
+        "dispatches_per_barrier": per_epoch,
+        "python_ms_per_row": round(ms_per_row, 5),
+        "rows_per_epoch": rows,
+    }
+    violations = []
+    mx = sb.get("dispatches_per_barrier_max")
+    if mx is not None and dpb > mx:
+        violations.append(
+            f"smoke: {dpb} device dispatches/barrier > budget {mx}"
+        )
+    mx = sb.get("python_ms_per_row_max")
+    if mx is not None and ms_per_row > mx:
+        violations.append(
+            f"smoke: {ms_per_row:.5f} host-python ms/row > budget {mx}"
+        )
+    if len(set(per_epoch)) > 1:
+        violations.append(
+            f"smoke: steady-state dispatch count not stable: {per_epoch} "
+            "(shape-unstable epoch — recompile hazard)"
+        )
+    return violations, report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--bench", default=None, help="BENCH JSON artifact")
+    ap.add_argument("--budgets", default=DEFAULT_BUDGETS)
+    ap.add_argument(
+        "--smoke",
+        action="store_true",
+        help="run the CPU steady-state microbench gate",
+    )
+    args = ap.parse_args(argv)
+    try:
+        budgets = _load(args.budgets)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"[perf_gate] cannot read budgets: {e}", file=sys.stderr)
+        return 2
+    violations = []
+    if args.smoke:
+        v, report = run_smoke(budgets)
+        print(f"[perf_gate] smoke: {json.dumps(report)}")
+        violations += v
+    bench_path = args.bench or DEFAULT_BENCH
+    # --smoke without an explicit artifact still gates the committed
+    # baseline when one exists (CI runs both checks in one call)
+    if args.bench or not args.smoke or os.path.exists(bench_path):
+        try:
+            bench = _load(bench_path)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"[perf_gate] cannot read bench: {e}", file=sys.stderr)
+            return 2
+        v, skipped = check_bench(bench, budgets)
+        for s in skipped:
+            print(f"[perf_gate] skip: {s}")
+        violations += v
+    for v in violations:
+        print(f"[perf_gate] REGRESSION: {v}", file=sys.stderr)
+    print(f"[perf_gate] {'FAIL' if violations else 'ok'}")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

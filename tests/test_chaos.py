@@ -274,6 +274,8 @@ def test_actor_kill_chaos_converges_to_undisturbed():
     fault-free twin, with at least one PARTIAL recovery exercised."""
     from risingwave_tpu.sim import ActorChaosRunner
 
+    from risingwave_tpu.profiler import PROFILER
+
     seed = chaos_seed(21)
     n_epochs = 6
     twin = _ActorKillWorkload()
@@ -281,10 +283,21 @@ def test_actor_kill_chaos_converges_to_undisturbed():
         twin.feed(i)
     want = twin.snapshots()
 
-    runner = ActorChaosRunner(
-        _ActorKillWorkload, seed=seed, kill_prob=0.45, kill_site="mixed"
-    )
-    obj = runner.run(n_epochs)
+    # profiler armed with an open capture across the storm: partial
+    # recovery must close it (orphan-window audit, extends the PR-5
+    # watchdog audit to profiler capture sessions)
+    PROFILER.enable(fence=False)
+    PROFILER.start_capture(tag="chaos-audit")
+    try:
+        runner = ActorChaosRunner(
+            _ActorKillWorkload, seed=seed, kill_prob=0.45, kill_site="mixed"
+        )
+        obj = runner.run(n_epochs)
+        # no orphaned profiler capture windows survived the recoveries
+        assert PROFILER.active_captures == []
+    finally:
+        PROFILER.disable()
+        PROFILER.reset()
     kills = sum(cp.kills for cp in obj.crash_points)
     assert kills >= 1, (
         f"no actor was ever killed — raise kill_prob (seed={seed})"

@@ -633,38 +633,51 @@ def _watchdog_threads():
 def test_no_orphan_stall_watchdog_timers_across_recoveries():
     """Every barrier arms a stall-watchdog Timer; success, partial
     recovery, full recovery, AND the escalation raise must all cancel
-    it — repeated recoveries may not pile up live timers."""
+    it — repeated recoveries may not pile up live timers. Same audit
+    for profiler capture windows: a capture open when the fault fires
+    must be closed by recovery, never orphaned."""
+    from risingwave_tpu.profiler import PROFILER
+
     rt = StreamingRuntime(
         MemObjectStore(), async_checkpoint=False, auto_recover=True
     )
     rt.stall_dump_after_s = 30.0  # real timers, armed per barrier
+    PROFILER.enable(fence=False)
+    PROFILER.start_capture(tag="orphan-audit")  # open across the faults
     crash = CrashingExecutor("boom")
     gpa, _ = build_singleton_mv("mv_a")
     gpb, _ = build_singleton_mv("mv_b", crash=crash)
     rt.register("mv_a", gpa)
     rt.register("mv_b", gpb)
     rng = np.random.default_rng(9)
-    for i in range(6):
-        n = int(rng.integers(4, 10))
-        c = StreamChunk.from_numpy(
-            {"k": rng.integers(0, 4, n).astype(np.int64),
-             "v": rng.integers(0, 40, n).astype(np.int64)}, 16,
-        )
-        if i in (2, 4):
-            crash.arm("apply", after=1)
-        rt.push("mv_a", c)
-        rt.push("mv_b", c)
-        rt.barrier()
-    # drive the raise path too (its finally must also cancel)
-    crash.always = True
-    with pytest.raises(RuntimeError):
-        for _ in range(10):
+    try:
+        for i in range(6):
+            n = int(rng.integers(4, 10))
+            c = StreamChunk.from_numpy(
+                {"k": rng.integers(0, 4, n).astype(np.int64),
+                 "v": rng.integers(0, 40, n).astype(np.int64)}, 16,
+            )
+            if i in (2, 4):
+                crash.arm("apply", after=1)
+            rt.push("mv_a", c)
             rt.push("mv_b", c)
             rt.barrier()
-    assert rt.auto_recoveries >= 3
-    deadline = time.time() + 5
-    while time.time() < deadline and _watchdog_threads():
-        time.sleep(0.05)  # canceled Timers exit promptly, not at expiry
-    assert _watchdog_threads() == []
-    gpa.close()
-    gpb.close()
+        # drive the raise path too (its finally must also cancel)
+        crash.always = True
+        with pytest.raises(RuntimeError):
+            for _ in range(10):
+                rt.push("mv_b", c)
+                rt.barrier()
+        assert rt.auto_recoveries >= 3
+        deadline = time.time() + 5
+        while time.time() < deadline and _watchdog_threads():
+            time.sleep(0.05)  # canceled Timers exit, not at expiry
+        assert _watchdog_threads() == []
+        # no orphaned profiler capture windows either: the first
+        # recovery closed the pre-fault window, none re-opened
+        assert PROFILER.active_captures == []
+    finally:
+        PROFILER.disable()
+        PROFILER.reset()
+        gpa.close()
+        gpb.close()

@@ -1,0 +1,489 @@
+"""Dispatch-wall profiler: per-executor attribution, device-dispatch /
+transfer accounting, Perfetto export (named threads, epoch flows),
+slow-barrier auto-capture, stall-dump fallback, and the perf gate."""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from risingwave_tpu import utils_sync_point as sync_point
+from risingwave_tpu.connectors.nexmark import NexmarkConfig, NexmarkGenerator
+from risingwave_tpu.event_log import EVENT_LOG
+from risingwave_tpu.metrics import REGISTRY
+from risingwave_tpu.profiler import PROFILER, device_forensics
+from risingwave_tpu.queries.nexmark_q import build_q5_lite
+from risingwave_tpu.runtime import StreamingRuntime
+from risingwave_tpu.storage.object_store import MemObjectStore
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    PROFILER.disable()
+    PROFILER.reset()
+    PROFILER.slow_barrier_ms = None
+    PROFILER.capture_dir = None
+    PROFILER._auto_captures = 0
+    sync_point.reset()
+    EVENT_LOG.clear()
+
+
+def _rt_with_q5():
+    rt = StreamingRuntime(MemObjectStore(), async_checkpoint=False)
+    q5 = build_q5_lite(capacity=1 << 12, state_cleaning=False)
+    rt.register("q5", q5.pipeline)
+    return rt, q5
+
+
+def _steady_chunk(events=2_000):
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=50_000))
+    return gen.next_chunks(events, 1 << 11)["bid"].select(
+        ["auction", "date_time"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# attribution
+# ---------------------------------------------------------------------------
+
+
+def test_executor_attribution_covers_dispatch_stage():
+    """The dispatch stage decomposes into per-executor executor_ms
+    entries (flush + barrier_apply + device wait) summing to within ε
+    of the parent stage total — attribution, not decoration."""
+    rt, q5 = _rt_with_q5()
+    bid = _steady_chunk()
+    rt.push("q5", bid)
+    rt.barrier()  # warmup (compiles) stays unprofiled
+    REGISTRY.histograms.pop("barrier_stage_ms", None)
+    PROFILER.reset()
+    PROFILER.enable(fence=True)
+    for _ in range(3):
+        rt.push("q5", bid)
+        rt.barrier()
+    PROFILER.disable()
+    bd = REGISTRY.histograms["barrier_stage_ms"].summary()
+    disp = sum(
+        v["sum"]
+        for k, v in bd.items()
+        if "stage=dispatch" in k and "fragment=q5" in k
+    )
+    assert disp > 0
+    h = REGISTRY.histograms["executor_ms"]
+    covered = sum(
+        v
+        for k, v in h._sum.items()
+        if dict(k)["phase"] in ("flush", "barrier_apply")
+    )
+    dw = REGISTRY.histograms.get("executor_device_wait_ms")
+    if dw is not None:
+        covered += sum(
+            v
+            for k, v in dw._sum.items()
+            if dict(k)["phase"] in ("flush", "barrier_apply")
+        )
+    assert covered >= 0.85 * disp, (covered, disp, bd)
+    assert covered <= disp * 1.05 + 1.0  # cannot exceed its parent
+    # every label set carries the full (executor, fragment, phase) key
+    for labels in h._sum:
+        assert {k for k, _ in labels} == {"executor", "fragment", "phase"}
+
+
+def test_dispatch_and_transfer_counters():
+    """Kernel interposer: jitted-kernel calls land in
+    device_dispatches_total{executor} with per-kernel detail; the
+    barrier's staged-scalar materialization counts as a d2h transfer."""
+    rt, q5 = _rt_with_q5()
+    bid = _steady_chunk()
+    rt.push("q5", bid)
+    rt.barrier()
+    PROFILER.reset()
+    PROFILER.enable(fence=False)
+    rt.push("q5", bid)
+    rt.barrier()
+    PROFILER.disable()
+    counts = PROFILER.dispatch_counts()
+    assert counts.get("HashAggExecutor", 0) >= 1
+    kernels = PROFILER.kernel_counts()
+    assert any(k.startswith("_agg") for k in kernels), kernels
+    # finish_scalars runs jax.device_get at the barrier fence
+    assert PROFILER.transfer_counts()["d2h"] >= 1
+    # disable restores the patched kernels (no proxies left behind)
+    import risingwave_tpu.executors.hash_agg as hash_agg_mod
+    from risingwave_tpu.profiler import _KernelProxy
+
+    assert not isinstance(hash_agg_mod._agg_step, _KernelProxy)
+
+
+def test_dispatch_counts_deterministic_and_flat_in_steady_state():
+    """Same seeded workload, fresh pipeline: identical per-epoch
+    dispatch counts across runs, and flat across steady epochs (ties
+    into the zero-recompile steady-state contract)."""
+    bid = _steady_chunk()
+
+    def run_once():
+        q5 = build_q5_lite(capacity=1 << 12, state_cleaning=False)
+        q5.pipeline.push(bid)
+        q5.pipeline.barrier()  # warm: compiles + first flush
+        PROFILER.reset()
+        PROFILER.enable(fence=False)
+        per_epoch = []
+        for _ in range(3):
+            base = PROFILER.total_dispatches()
+            q5.pipeline.push(bid)
+            q5.pipeline.barrier()
+            per_epoch.append(PROFILER.total_dispatches() - base)
+        PROFILER.disable()
+        return per_epoch
+
+    a, b = run_once(), run_once()
+    assert a == b, (a, b)
+    assert len(set(a)) == 1, f"steady-state dispatch count drifted: {a}"
+
+
+def test_profile_mode_off_overhead_under_1pct():
+    """Profile-mode-off is one attribute check per call site: its
+    measured unit cost times a generous per-barrier call count must be
+    <1% of the steady-state barrier wall. And nothing may be recorded
+    while off."""
+    rt, q5 = _rt_with_q5()
+    bid = _steady_chunk()
+    rt.push("q5", bid)
+    rt.barrier()  # warm
+    REGISTRY.histograms.pop("executor_ms", None)
+    t0 = time.perf_counter()
+    n = 3
+    for _ in range(n):
+        rt.push("q5", bid)
+        rt.barrier()
+    steady_ms = (time.perf_counter() - t0) / n * 1e3
+    assert "executor_ms" not in REGISTRY.histograms  # off records nothing
+    # unit cost of the disabled hook (the _pcall branch)
+    from risingwave_tpu.runtime.pipeline import _pcall
+
+    ex = q5.pipeline.executors[0]
+    sink = []
+
+    def f(x=None):
+        sink.append(None)
+        sink.clear()
+        return ()
+
+    loops = 20_000
+    t0 = time.perf_counter()
+    for _ in range(loops):
+        f(None)
+    raw_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(loops):
+        _pcall(ex, "apply", f, None)
+    hook_s = time.perf_counter() - t0
+    per_call_ms = max(hook_s - raw_s, 0.0) / loops * 1e3
+    # ~4 hook sites per executor per barrier is well above reality
+    calls = 4 * len(q5.pipeline.executors)
+    assert per_call_ms * calls < 0.01 * steady_ms, (
+        per_call_ms,
+        calls,
+        steady_ms,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Perfetto export
+# ---------------------------------------------------------------------------
+
+
+def test_chrome_trace_thread_names_fragment_lanes_and_epoch_flows():
+    """Satellite: stable tids + thread_name metadata (actor names show
+    in Perfetto), fragments on distinct pid lanes, and flow events
+    linking one barrier's spans across actor threads."""
+    from risingwave_tpu.runtime.graph import FragmentSpec, GraphRuntime
+    from risingwave_tpu.trace import TRACER
+
+    TRACER.clear()
+    q5 = build_q5_lite(capacity=1 << 12, state_cleaning=False)
+    g = GraphRuntime(
+        [
+            FragmentSpec("src", lambda i: []),
+            FragmentSpec(
+                "agg",
+                lambda i: list(q5.pipeline.executors),
+                inputs=[("src", 0)],
+            ),
+        ]
+    ).start()
+    try:
+        c = _steady_chunk(1_000)
+        g.inject_chunk("src", c)
+        g.inject_barrier()
+        g.inject_barrier()
+    finally:
+        g.stop(timeout=5.0)
+    doc = json.loads(TRACER.chrome_trace())
+    evs = doc["traceEvents"]
+    # named actor threads via ph:"M" metadata
+    tnames = {
+        e["args"]["name"]
+        for e in evs
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert any(n.startswith("actor-") for n in tnames), tnames
+    # fragments get their own pid lanes, named via process_name
+    pnames = {
+        e["args"]["name"]: e["pid"]
+        for e in evs
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert "host" in pnames
+    frag_lanes = {k: v for k, v in pnames.items() if k.startswith("fragment:")}
+    assert len(frag_lanes) >= 2  # src#0 + agg#0 lanes
+    assert len(set(frag_lanes.values())) == len(frag_lanes)
+    # epoch flow events: one barrier = one flow id across >1 thread
+    flows = [e for e in evs if e["ph"] in ("s", "t") and e.get("cat") == "epoch"]
+    assert flows, "no epoch flow events"
+    by_id = {}
+    for e in flows:
+        by_id.setdefault(e["id"], []).append(e)
+    linked = [fl for fl in by_id.values() if len(fl) >= 2]
+    assert linked, by_id
+    assert any(
+        len({(e["pid"], e["tid"]) for e in fl}) >= 2 for fl in linked
+    ), "flow never crosses a thread"
+    # exactly one flow-start per epoch
+    for fl in by_id.values():
+        assert sum(1 for e in fl if e["ph"] == "s") == 1
+
+
+def test_stable_tids_no_collisions_across_threads():
+    from risingwave_tpu.trace import TRACER, span
+
+    TRACER.clear()
+
+    def work(name):
+        with span(f"unit.{name}"):
+            time.sleep(0.01)
+
+    ts = [
+        threading.Thread(target=work, args=(i,), name=f"unit-worker-{i}")
+        for i in range(3)
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    doc = json.loads(TRACER.chrome_trace())
+    spans = [
+        e for e in doc["traceEvents"]
+        if e["ph"] == "X" and e["name"].startswith("unit.")
+    ]
+    tids = {e["tid"] for e in spans}
+    assert len(tids) == 3  # one stable tid per thread, no collisions
+    named = {
+        e["tid"]: e["args"]["name"]
+        for e in doc["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    for tid in tids:
+        assert named.get(tid, "").startswith("unit-worker-")
+
+
+# ---------------------------------------------------------------------------
+# capture windows + forensics
+# ---------------------------------------------------------------------------
+
+
+def test_slow_barrier_auto_capture_and_forensic_dump(tmp_path, monkeypatch):
+    """A barrier over the profile threshold auto-emits a PROFILE_*
+    artifact (executor breakdown + device forensics) and a stall dump
+    carrying device memory stats — the q7-wedge evidence path."""
+    monkeypatch.setenv("RW_STALL_DIR", str(tmp_path))
+    rt, q5 = _rt_with_q5()
+    bid = _steady_chunk()
+    rt.push("q5", bid)
+    rt.barrier()
+    PROFILER.reset()
+    PROFILER.enable(
+        fence=True, slow_barrier_ms=10.0, capture_dir=str(tmp_path)
+    )
+    sync_point.activate(
+        "before_manifest_commit", lambda: time.sleep(0.05)
+    )
+    rt.push("q5", bid)
+    rt.barrier()  # slow: over the 10ms threshold
+    profs = glob.glob(str(tmp_path / "PROFILE_slow_barrier_*.json"))
+    assert profs, "no PROFILE_* artifact"
+    doc = json.loads(open(profs[-1]).read())
+    assert doc["barrier_wall_ms"] >= 10.0
+    assert "executor_ms" in doc and doc["device_dispatches_total"]
+    assert "memory_stats" in doc["device"]  # None on CPU, key present
+    assert doc["device"]["live_arrays"]["total_count"] > 0
+    dumps = glob.glob(str(tmp_path / "STALL_DUMP_*.json"))
+    assert dumps, "no forensic stall dump"
+    sdoc = json.loads(open(dumps[-1]).read())
+    assert "memory_stats" in sdoc["device"]
+    assert "profiler" in sdoc["device"]
+    # window bookkeeping: capture closed, event recorded
+    assert PROFILER.active_captures == []
+    assert EVENT_LOG.events(kind="profile_capture")
+    # bounded: a persistently slow run cannot flood the dir, and
+    # manual captures never consume the auto budget
+    assert PROFILER._auto_captures <= PROFILER.max_auto_captures
+    before = PROFILER._auto_captures
+    PROFILER.end_capture(PROFILER.start_capture(tag="manual"))
+    assert PROFILER._auto_captures == before
+
+
+def test_recovery_aborts_open_capture_windows():
+    """PR-5 orphan-audit extension: a recovery mid-capture must close
+    the profiler window (an orphaned jax.profiler session would hold
+    the device)."""
+    rt, q5 = _rt_with_q5()
+    rt.push("q5", _steady_chunk())
+    rt.barrier()
+    PROFILER.enable(fence=False)
+    PROFILER.start_capture(tag="unit")
+    assert len(PROFILER.active_captures) == 1
+    rt.recover()
+    assert PROFILER.active_captures == []
+
+
+def test_stall_dump_falls_back_to_tempdir(tmp_path, monkeypatch):
+    """Satellite: RW_STALL_DIR unwritable no longer returns "" silently
+    — the dump lands in the system temp dir and the failure is event-
+    logged; a writable dir still takes precedence."""
+    from risingwave_tpu.epoch_trace import dump_stalls
+
+    # a FILE as the stall dir: os.path.join(file, name) cannot open
+    blocker = tmp_path / "not-a-dir"
+    blocker.write_text("x")
+    monkeypatch.setenv("RW_STALL_DIR", str(blocker))
+    EVENT_LOG.clear()
+    path = dump_stalls("unit: unwritable dir")
+    try:
+        assert path, "fallback did not produce an artifact"
+        import tempfile
+
+        assert os.path.dirname(path) == tempfile.gettempdir()
+        assert json.loads(open(path).read())["reason"].startswith("unit")
+        fb = EVENT_LOG.events(kind="stall_dump_fallback")
+        assert fb and fb[-1]["path"] == path
+        assert EVENT_LOG.events(kind="stall_dump")[-1]["path"] == path
+    finally:
+        if path and os.path.exists(path):
+            os.remove(path)
+    # the writable path still lands where asked, no fallback event
+    monkeypatch.setenv("RW_STALL_DIR", str(tmp_path))
+    EVENT_LOG.clear()
+    path2 = dump_stalls("unit: writable dir")
+    assert os.path.dirname(path2) == str(tmp_path)
+    assert not EVENT_LOG.events(kind="stall_dump_fallback")
+
+
+def test_device_forensics_shape():
+    d = device_forensics()
+    assert d["platform"] == "cpu"
+    assert "memory_stats" in d and "live_arrays" in d
+    assert "state_tables" in d and "profiler" in d
+
+
+# ---------------------------------------------------------------------------
+# perf gate
+# ---------------------------------------------------------------------------
+
+
+def _gate(args):
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "perf_gate.py"),
+         *args],
+        capture_output=True,
+        text=True,
+        cwd=ROOT,
+    )
+
+
+def test_perf_gate_clean_on_committed_baseline():
+    """The committed BENCH artifact must pass the committed budgets —
+    the gate's green state is reproducible from the repo alone."""
+    r = _gate(["--bench", os.path.join(ROOT, "BENCH_partial.json")])
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_perf_gate_fails_on_injected_dispatch_regression(tmp_path):
+    bench = json.load(open(os.path.join(ROOT, "BENCH_partial.json")))
+    bench["q5u_dispatches_per_row"] = 99.0  # per-op dispatch storm
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(bench))
+    r = _gate(["--bench", str(bad)])
+    assert r.returncode == 1
+    assert "dispatches/row" in r.stderr
+    # and a blown stage p99 also trips it
+    bench = json.load(open(os.path.join(ROOT, "BENCH_partial.json")))
+    bench.setdefault("barrier_stage_ms", {})[
+        "fragment=mv#0,stage=dispatch"
+    ] = {"p50": 9000.0, "p99": 9000.0, "count": 2, "sum": 18000.0}
+    bad.write_text(json.dumps(bench))
+    r = _gate(["--bench", str(bad)])
+    assert r.returncode == 1
+
+
+def test_perf_gate_smoke_budgets_in_process():
+    """The CI smoke microbench (in-process here to skip a cold jax
+    import): steady-state dispatches/barrier and host-python ms/row
+    within committed budgets, dispatch count stable across epochs."""
+    sys.path.insert(0, os.path.join(ROOT, "scripts"))
+    try:
+        import perf_gate
+    finally:
+        sys.path.pop(0)
+    budgets = json.load(
+        open(os.path.join(ROOT, "scripts", "perf_budgets.json"))
+    )
+    violations, report = perf_gate.run_smoke(budgets, epochs=3)
+    assert violations == [], (violations, report)
+    assert report["dispatches_per_barrier"]
+    assert (
+        max(report["dispatches_per_barrier"])
+        <= budgets["smoke"]["dispatches_per_barrier_max"]
+    )
+
+
+def test_profiler_config_section():
+    """[profiler] TOML section parses into ProfilerConfig and unknown
+    keys stay non-fatal."""
+    from risingwave_tpu.config import load_config
+
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".toml", delete=False
+    ) as f:
+        f.write(
+            "[profiler]\nenabled = false\nslow_barrier_capture_ms = 250.0\n"
+            "jax_trace = false\nmystery = 1\n"
+        )
+        p = f.name
+    try:
+        cfg = load_config(p)
+        assert cfg.profiler.enabled is False
+        assert cfg.profiler.slow_barrier_capture_ms == 250.0
+        assert cfg.unrecognized.get("profiler.mystery") == 1
+    finally:
+        os.remove(p)
+
+
+def test_env_rw_profile_0_disables_config_enabled_profiler(monkeypatch):
+    """The env knob wins in BOTH directions: RW_PROFILE=0 disarms a
+    config-enabled profiler (the operator's no-restart escape hatch)."""
+    from risingwave_tpu.config import ProfilerConfig
+
+    monkeypatch.setenv("RW_PROFILE", "0")
+    PROFILER.configure(ProfilerConfig(enabled=True, fence=False))
+    assert PROFILER.enabled is False
