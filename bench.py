@@ -78,12 +78,18 @@ def cpu_actor_q8(stream, window_ms):
     return n_rows / dt, out
 
 
-def _rwlint_gate(query: str) -> None:
+def _rwlint_gate(query: str):
     """Static plan verification BEFORE the bench runs (strict): a
     provably-broken plan fails the child with RW-E### diagnostics
     instead of burning a tier on wrong numbers. Lints the same
     small-capacity twin `lint --all-nexmark` verifies (the verifier is
-    static, so plan shape is all that matters — analysis/)."""
+    static, so plan shape is all that matters — analysis/).
+
+    Also runs the fusion-feasibility analyzer over the same twin and
+    returns its summary, so every BENCH JSON carries static blocker
+    evidence (``{q}_fusion``) next to the dynamic profiler evidence —
+    a TPU round's artifact shows WHAT was measured and WHY the
+    dispatch wall is still there, in one file."""
     from risingwave_tpu.analysis.lint import (
         NEXMARK_SOURCE_SCHEMAS,
         build_nexmark_corpus,
@@ -92,13 +98,45 @@ def _rwlint_gate(query: str) -> None:
 
     built = build_nexmark_corpus(only=query)
     if query not in built:
-        return
+        return None
     lint_pipeline(
         built[query].pipeline,
         NEXMARK_SOURCE_SCHEMAS[query],
         name=query,
         strict=True,
     )
+    try:
+        from risingwave_tpu.analysis.fusion_analyzer import (
+            analyze_pipeline,
+            report_to_json,
+        )
+
+        rep = report_to_json(
+            analyze_pipeline(
+                built[query].pipeline,
+                NEXMARK_SOURCE_SCHEMAS[query],
+                query,
+                deep=True,
+            )
+        )
+    except Exception:  # noqa: BLE001 — evidence, not a gate
+        return None
+    return {
+        "summary": rep["summary"],
+        "fragments": [
+            {
+                "fragment": f["fragment"],
+                "fusible_prefix": f["fusible_prefix"],
+                "chain_len": f["chain_len"],
+                "whole_chain_fusible": f["whole_chain_fusible"],
+                "host_sync_points": f["host_sync_points"],
+                "blocker_codes": sorted(
+                    {b["code"] for b in f["blockers"]}
+                ),
+            }
+            for f in rep["fragments"]
+        ],
+    }
 
 
 def _recompile_watch():
@@ -191,7 +229,7 @@ def bench_q8(gen_cfg, epochs, events_per_epoch, chunk_events):
     from risingwave_tpu.connectors.nexmark import NexmarkConfig, NexmarkGenerator
     from risingwave_tpu.queries.nexmark_q import Q8_WINDOW_MS, build_q8
 
-    _rwlint_gate("q8")  # static: fail BEFORE generating the event stream
+    fusion = _rwlint_gate("q8")  # static: fail BEFORE the event stream
     gen = NexmarkGenerator(NexmarkConfig(**gen_cfg))
     host_stream = []  # [(side, cols)] in arrival order, per epoch
     epochs_stream = []
@@ -303,6 +341,7 @@ def bench_q8(gen_cfg, epochs, events_per_epoch, chunk_events):
         ),
         "q8_correct": ok,
         "q8_recompiles": recompiles.deltas(),
+        "q8_fusion": fusion,
         "q8_barrier_stage_ms": stage_breakdown(),
         **_profile_fields("q8", prof, len(barrier_times), total_rows),
     }
@@ -345,7 +384,7 @@ def bench_q7(gen_cfg, epochs, events_per_epoch, chunk_events):
     from risingwave_tpu.connectors.nexmark import NexmarkConfig, NexmarkGenerator
     from risingwave_tpu.queries.nexmark_q import build_q7
 
-    _rwlint_gate("q7")  # static: fail BEFORE generating the event stream
+    fusion = _rwlint_gate("q7")  # static: fail BEFORE the event stream
     window_ms = 10_000
     gen = NexmarkGenerator(NexmarkConfig(**gen_cfg))
     host_epochs = []
@@ -434,6 +473,7 @@ def bench_q7(gen_cfg, epochs, events_per_epoch, chunk_events):
         ),
         "q7_correct": ok,
         "q7_recompiles": recompiles.deltas(),
+        "q7_fusion": fusion,
         "q7_barrier_stage_ms": stage_breakdown(),
         **_profile_fields("q7", prof, len(barrier_times), total_bids),
     }
@@ -607,7 +647,7 @@ def bench_q5(args_epochs, events_per_epoch, chunk_events, smoke, agg_mode):
     if smoke:
         jax.config.update("jax_platforms", "cpu")
 
-    _rwlint_gate("q5")  # static: fail BEFORE generating the event stream
+    fusion = _rwlint_gate("q5")  # static: fail BEFORE the event stream
 
     import numpy as np
 
@@ -750,6 +790,7 @@ def bench_q5(args_epochs, events_per_epoch, chunk_events, smoke, agg_mode):
         "q5_hbm_peak_gbps": rf["hbm_peak_gbps"],
         "q5_barrier_stage_ms": stage_breakdown(),
         "q5_recompiles": recompiles.deltas(),
+        "q5_fusion": fusion,
         **_profile_fields("q5", prof, len(barrier_times), total_bids),
     }
 

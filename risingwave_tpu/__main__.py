@@ -155,6 +155,15 @@ def main() -> None:
         help="also trace jaxprs: dtype promotions, 64-bit hash "
         "arithmetic (no XLA compiles)",
     )
+    ln.add_argument(
+        "--fusion-report",
+        action="store_true",
+        dest="fusion_report",
+        help="fusion-feasibility analysis per fragment: longest "
+        "fusible executor prefix, RW-E8xx blockers with file:line "
+        "provenance, estimated dispatch savings (implies "
+        "--all-nexmark when no SQL files are given)",
+    )
     ln.add_argument("--json", action="store_true")
     ln.set_defaults(fn=_lint)
     cn = sub.add_parser(

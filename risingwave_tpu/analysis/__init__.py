@@ -47,6 +47,13 @@ _EXPORTS = {
     "check_promotions": "jax_sanitizer",
     "check_hash_path_32bit": "jax_sanitizer",
     "check_donation": "jax_sanitizer",
+    "analyze_pipeline": "fusion_analyzer",
+    "analyze_planned": "fusion_analyzer",
+    "analyze_nexmark": "fusion_analyzer",
+    "classify_executor": "fusion_analyzer",
+    "scan_host_syncs": "fusion_analyzer",
+    "ChunkSpec": "shape_domain",
+    "capacity_bucket": "shape_domain",
 }
 
 

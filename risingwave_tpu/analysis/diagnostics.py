@@ -10,6 +10,7 @@ README tables them); add new ones, never renumber. Families:
 - RW-E5xx  watermark propagation / state-cleaning reachability
 - RW-E6xx  fragment-graph wiring (channels, cycles, reachability)
 - RW-E7xx  state tables (pk coverage, table-id uniqueness)
+- RW-E8xx  fusion feasibility (host-sync blockers, shape stability)
 """
 
 from __future__ import annotations
@@ -51,6 +52,23 @@ CODES = {
     # state tables
     "RW-E701": "state-table primary key not covered by the input schema",
     "RW-E702": "duplicate state table_id within one plan",
+    # fusion feasibility (analysis/fusion_analyzer.py): what blocks
+    # fusing a fragment's executor chain into ONE jitted per-barrier
+    # device step (ROADMAP item 1), proven statically
+    "RW-E801": "host synchronization inside the hot path — a fused "
+    "per-barrier device step would stall on this blocking host<->device "
+    "round-trip",
+    "RW-E802": "dynamic / data-dependent output shape — every distinct "
+    "emission size compiles a fresh program downstream",
+    "RW-E803": "unbucketed shape-polymorphic window: the executor's "
+    "window-keyed shape domain has no declared bucket lattice, so "
+    "window churn re-traces its fused step without bound (the q7 wedge "
+    "class)",
+    "RW-E804": "state buffer not donation-safe for a fused step — the "
+    "fused program would hold two live copies of the carried state in "
+    "HBM",
+    "RW-E805": "fused-step jaxpr count exceeds the recompile budget "
+    "across the declared chunk-size buckets",
 }
 
 

@@ -306,13 +306,28 @@ class SignatureWatch:
         self._names[key] = type(ex).__name__
         if self._stable:
             name = self._names[key]
-            self._hazards.setdefault(name, []).append(sig)
+            from risingwave_tpu.analysis.shape_domain import (
+                capacity_bucket,
+            )
             from risingwave_tpu.event_log import EVENT_LOG
             from risingwave_tpu.metrics import REGISTRY
 
+            # the shape BUCKET that produced the hazard: the dynamic
+            # twin of the fusion analyzer's chunk-size bucket lattice —
+            # a runtime hazard whose executor also carries a static
+            # RW-E803 finding names the same bucket in both reports
+            bucket = capacity_bucket(int(chunk.valid.shape[-1]))
+            self._hazards.setdefault(name, []).append((bucket, sig))
             REGISTRY.counter("recompile_hazard_total").inc(executor=name)
+            REGISTRY.counter("recompile_hazard_bucket_total").inc(
+                executor=name, bucket=str(bucket)
+            )
             EVENT_LOG.record(
-                "recompile_hazard", executor=name, signature=repr(sig)[:200]
+                "recompile_hazard",
+                executor=name,
+                bucket=bucket,
+                code="RW-E803",
+                signature=repr(sig)[:200],
             )
 
     def report(self) -> List[Diagnostic]:
@@ -320,8 +335,11 @@ class SignatureWatch:
             Diagnostic(
                 "RW-E403",
                 f"executor saw {len(sigs)} new abstract input "
-                "signature(s) after warmup — every one re-traces its "
-                "fused step (recompile storm on TPU)",
+                "signature(s) after warmup in capacity bucket(s) "
+                f"{sorted({b for b, _ in sigs})} — every one re-traces "
+                "its fused step (recompile storm on TPU); cross-check "
+                "the static RW-E803 findings for this executor "
+                "(lint --fusion-report)",
                 executor=name,
                 severity="warning",
             )

@@ -217,6 +217,78 @@ def lint_sql_file(path: str) -> Dict[str, List[Diagnostic]]:
 
 
 # ---------------------------------------------------------------------------
+# fusion-feasibility surface (analysis/fusion_analyzer.py)
+# ---------------------------------------------------------------------------
+
+
+def fusion_findings_for_ddl(planned) -> List[Diagnostic]:
+    """The CREATE-MV fusion hook: SHALLOW analysis (trace contracts +
+    host-sync AST scan, no jaxpr tracing — stays inside the DDL lint
+    budget) filtered to the strict-relevant hazard class: RW-E803,
+    the unbucketed shape-polymorphic window (the class that wedges
+    real TPUs; ROADMAP item 2). Full reports are a CLI/CI surface
+    (``lint --fusion-report``).
+
+    Graph pipelines are analyzed through their LIVE checkpoint
+    registry (every stateful — hence every window-keyed — executor is
+    in it) instead of re-shadow-building each fragment spec: the plan
+    verifier already paid for one shadow build this DDL; a second one
+    per CREATE MV would double the lint cost for nothing E803 needs."""
+    from risingwave_tpu.analysis.fusion_analyzer import (
+        analyze_chain,
+        analyze_planned,
+    )
+
+    pipeline = getattr(planned, "pipeline", planned)
+    name = getattr(planned, "name", "mv")
+    if hasattr(pipeline, "_specs") and hasattr(pipeline, "graph"):
+        # a parallel plan's registry holds PartitionedStateViews —
+        # analyze one underlying instance (identical plan shape across
+        # instances, so one carries the whole contract)
+        chain = [
+            getattr(e, "_instances", [e])[0]
+            for e in getattr(pipeline, "_executors", ())
+        ]
+        reports = [
+            analyze_chain(chain, None, f"{name}:ckpt", deep=False)
+        ]
+    else:
+        reports = analyze_planned(planned, deep=False)
+    out: List[Diagnostic] = []
+    for rep in reports:
+        out.extend(
+            d for d in rep.diagnostics if d.code == "RW-E803"
+        )
+    return out
+
+
+def _committed_profile() -> Optional[dict]:
+    """The committed BENCH artifact's profiler blocks, when present —
+    ranks fusion blockers by measured dispatch-wall cost."""
+    import json
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+        "BENCH_partial.json",
+    )
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def run_fusion_report() -> dict:
+    """``lint --fusion-report --all-nexmark``: per-query fusion
+    reports, blockers ranked by the committed profile when one
+    exists."""
+    from risingwave_tpu.analysis.fusion_analyzer import analyze_nexmark
+
+    return analyze_nexmark(deep=True, profile_bench=_committed_profile())
+
+
+# ---------------------------------------------------------------------------
 # CLI driver (python -m risingwave_tpu lint ...)
 # ---------------------------------------------------------------------------
 
@@ -225,6 +297,21 @@ def run_cli(args) -> int:
     """Returns the process exit code: 0 = no error findings."""
     import json as _json
 
+    fusion_report = getattr(args, "fusion_report", False)
+    if fusion_report and not (args.all_nexmark or args.paths):
+        # a bare --fusion-report means "the built-in corpus"
+        args.all_nexmark = True
+    if fusion_report and not args.all_nexmark:
+        # never silently drop the flag: SQL-file fusion analysis is
+        # not a surface (the DDL hook covers planned MVs) — exit 2 so
+        # CI cannot mistake "no fusion section" for "no blockers"
+        msg = (
+            "--fusion-report analyzes the built-in corpus: add "
+            "--all-nexmark (SQL files get fusion findings through "
+            "the CREATE-MV lint hook, not this flag)"
+        )
+        print(_json.dumps({"error": msg}) if args.json else f"rwlint: {msg}")
+        return 2
     if not args.all_nexmark and not args.paths:
         # exit-code contract: 2 = usage/input (CI tells this apart
         # from 1 = lint errors), never an interpreter traceback — and
@@ -251,6 +338,9 @@ def run_cli(args) -> int:
             continue
         for name, diags in per_file.items():
             findings.setdefault(f"{path}:{name}", []).extend(diags)
+    fusion: Optional[Dict[str, dict]] = None
+    if fusion_report and args.all_nexmark:
+        fusion = run_fusion_report()
     n_err = 0
     if args.json:
         out = {
@@ -268,6 +358,8 @@ def run_cli(args) -> int:
         }
         if usage_errors:
             out["__errors__"] = usage_errors
+        if fusion is not None:
+            out["__fusion__"] = fusion
         print(_json.dumps(out))
         n_err = sum(
             1
@@ -284,6 +376,22 @@ def run_cli(args) -> int:
             print(f"{name}: {status}")
             for d in diags:
                 print(f"  {d.render()}")
+        if fusion is not None:
+            for q in sorted(fusion):
+                s = fusion[q]["summary"]
+                print(
+                    f"{q} fusion: {s['fusible_fragments']}/"
+                    f"{s['fragments']} fragments fusible, prefix "
+                    f"{s['fusible_prefix_total']}/{s['chain_len_total']}"
+                    f" executors, {s['host_sync_points']} host-sync "
+                    f"point(s), blockers {s['blockers_by_code']}"
+                )
+                for fr in fusion[q]["fragments"]:
+                    for b in fr["blockers"]:
+                        print(
+                            f"  {b['code']} [frag={fr['fragment']} "
+                            f"ex={b['executor']}] {b['message']}"
+                        )
         total = len(findings)
         for msg in usage_errors:
             print(f"rwlint: {msg}")

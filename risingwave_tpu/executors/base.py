@@ -113,6 +113,59 @@ class Executor:
         """
         return None
 
+    def trace_contract(self):
+        """Static COMPILABILITY metadata for the fusion analyzer
+        (analysis/fusion_analyzer.py), or None = opaque (no trace
+        contract: the analyzer cannot prove anything about this
+        executor and it hard-stops a fragment's fusible prefix).
+
+        The default derives a contract from ``pure_step()``: a
+        stateless executor exposing a pure chunk->chunk step is
+        trivially device-fusible. Stateful executors override and
+        declare honestly what their apply/barrier path does TODAY —
+        the analyzer verifies the claim (abstract tracing + an AST
+        scan of the hot methods for host-sync markers), it does not
+        trust it. Keys:
+
+        - ``kind``: "device" (math staged in pure jitted kernels over
+          (state, chunk) — abstractly traceable) or "host" (the data
+          path leaves the device: NumPy fallback, dict probes).
+        - ``trace_step``: chunk -> pytree callable CLOSED OVER the
+          executor's current state, pure for tracing purposes (calls
+          the underlying jitted kernel without mutating self); the
+          analyzer make_jaxpr/eval_shape's it over the chunk-size
+          bucket lattice. None when nothing is traceable.
+        - ``state``: the donated state pytree, or None (stateless).
+        - ``donate``: True when the step kernel donates its state
+          buffers (donate_argnums) — False + state => RW-E804.
+        - ``emission``: flush-chunk capacity behavior — "none" (never
+          emits), "passthrough" (output capacity is a pure function
+          of input capacity), "fixed"/"bucketed" (a declared, closed
+          capacity set: ``emission_caps``), or "data_dependent"
+          (capacity derives from live-row counts => RW-E802).
+        - ``emission_caps``: tuple of declared emission capacities
+          (fixed/bucketed kinds).
+        - ``window_buckets``: for window-keyed executors, the declared
+          bucket lattice of the per-window shape domain, or None =
+          unbucketed (window churn re-traces without bound =>
+          RW-E803, the q7 wedge class).
+        - ``host_reason``: one-line reason for kind="host" (the AST
+          scan adds exact file:line provenance).
+        - ``hot_methods``: extra method names the host-sync scan must
+          cover beyond apply/apply_left/apply_right/on_barrier/
+          on_watermark.
+        """
+        step = self.pure_step()
+        if step is None:
+            return None
+        return {
+            "kind": "device",
+            "trace_step": step,
+            "state": None,
+            "donate": True,
+            "emission": "passthrough",
+        }
+
     def pure_step(self):
         """A pure device function chunk -> chunk equivalent to this
         executor's ``apply`` (exactly one output chunk, no state), or

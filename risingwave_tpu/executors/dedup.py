@@ -110,6 +110,20 @@ class AppendOnlyDedupExecutor(Executor, Checkpointable):
             "window_key": self.window_key[0] if self.window_key else None,
         }
 
+    def trace_contract(self):
+        return {
+            "kind": "device",
+            "trace_step": lambda c: _dedup_step(
+                self.table, self.sdirty, c, self.keys
+            ),
+            "state": (self.table, self.sdirty),
+            "donate": True,
+            "emission": "passthrough",
+            # the key table rehash-grows with no declared bucket cap
+            # (window churn keeps minting fresh window keys)
+            "window_buckets": None,
+        }
+
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         for k in self.keys:
             if k in chunk.nulls:

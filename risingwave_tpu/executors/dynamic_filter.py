@@ -132,6 +132,25 @@ class DynamicMaxFilterExecutor(Executor, Checkpointable):
             "window_key": self.window_key[0] if self.window_key else None,
         }
 
+    def trace_contract(self):
+        return {
+            "kind": "device",
+            "trace_step": lambda c: _filter_step(
+                self.table,
+                self.maxes,
+                self.sdirty,
+                c,
+                self.group_col,
+                self.value_col,
+            ),
+            "state": (self.table, self.maxes),
+            "donate": True,
+            "emission": "passthrough",
+            # per-window max state rehash-grows with no declared
+            # bucket cap (the q7 pre-filter sits right on the wedge)
+            "window_buckets": None,
+        }
+
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         if self.group_col in chunk.nulls or self.value_col in chunk.nulls:
             raise ValueError("dynamic filter columns must be non-nullable")

@@ -90,6 +90,34 @@ class EpochBatchedAggExecutor(Executor):
         self._buf: List[StreamChunk] = []
         self._sig = None
 
+    # -- static metadata --------------------------------------------------
+    def lint_info(self):
+        """The composition of the members' metadata: the wrapper IS
+        ``prefix... ; agg`` to the verifier. Opacity propagates — if
+        any member exposes nothing, the wrapper exposes nothing (the
+        verifier never guesses)."""
+        infos = []
+        for m in list(self.prefix) + [self.agg]:
+            fn = getattr(m, "lint_info", None)
+            info = fn() if fn is not None else None
+            if info is None:
+                return None
+            infos.append(info)
+        return _compose_lint_infos(infos)
+
+    def trace_contract(self):
+        inner = self.agg.trace_contract()
+        if inner is None:
+            return None
+        contract = dict(inner)
+        # the fused epoch program IS apply_stacked: prefix pure steps
+        # trace into the agg's program; the per-chunk trace_step stays
+        # the agg's (same kernels, same state)
+        contract["hot_methods"] = tuple(
+            contract.get("hot_methods", ())
+        ) + ("flush",)
+        return contract
+
     # -- data path --------------------------------------------------------
     @staticmethod
     def _signature(c: StreamChunk):
@@ -161,6 +189,82 @@ class EpochBatchedAggExecutor(Executor):
         # pipelined barriers: the actor seals the wrapped agg's delta
         # (the agg object is the one the checkpoint registry holds)
         self.agg.capture_checkpoint()
+
+
+def _compose_lint_infos(infos):
+    """Fold a member sequence's lint_info dicts into ONE equivalent
+    dict (the wrapper's view). Conservative by construction: anything
+    that cannot be traced back to the wrapper's input column space is
+    dropped rather than guessed, so a composed plan can only LOSE
+    checks relative to walking the members individually, never gain
+    false positives."""
+    rmap = {}  # current-schema col -> wrapper-input col (None=computed)
+
+    def back(col):
+        return rmap.get(col, col)
+
+    requires, expects = set(), {}
+    table_ids: List[str] = []
+    wmap = {}
+    window_key = None
+    emits_final, renames_final, keys_final = None, None, None
+    for pos, info in enumerate(infos):
+        reqs = set(info.get("requires") or ()) | set(
+            info.get("expects") or {}
+        )
+        for r in sorted(reqs):
+            src = back(r)
+            if src is not None:
+                requires.add(src)
+                dt = (info.get("expects") or {}).get(r)
+                if dt is not None and src not in expects:
+                    expects[src] = dt
+        table_ids.extend(info.get("table_ids") or ())
+        wk = info.get("window_key")
+        if wk is not None and window_key is None and pos == 0:
+            # only a first-member window key is expressible at the
+            # wrapper boundary (later members see internally-derived
+            # watermark columns the boundary cannot name)
+            window_key = wk
+        for in_col, out_col in (info.get("watermark_map") or {}).items():
+            src = back(in_col)
+            if src is not None:
+                wmap[src] = out_col
+        emits = info.get("emits")
+        if emits is not None:
+            renames = info.get("renames") or {}
+            new_rmap = {}
+            for out in emits:
+                src = renames.get(out)
+                new_rmap[out] = back(src) if src is not None else None
+            rmap = new_rmap
+            emits_final = dict(emits)
+            renames_final = dict(rmap)
+            ks = info.get("keys")
+            if ks:
+                mapped = tuple(back(k) for k in ks)
+                keys_final = (
+                    mapped if all(m is not None for m in mapped) else None
+                )
+        else:
+            for col in info.get("adds") or {}:
+                rmap = dict(rmap)
+                rmap[col] = None  # computed mid-composition
+    out = {
+        "requires": tuple(sorted(requires)),
+        "expects": expects,
+        "table_ids": tuple(table_ids),
+    }
+    if emits_final is not None:
+        out["emits"] = emits_final
+        out["renames"] = renames_final or {}
+    if keys_final:
+        out["keys"] = keys_final
+    if window_key is not None:
+        out["window_key"] = window_key
+    if wmap:
+        out["watermark_map"] = wmap
+    return out
 
 
 def fuse_epoch_batch(chain: Sequence[Executor]) -> List[Executor]:

@@ -73,6 +73,28 @@ class ExpandExecutor(Executor):
         )
         self.flag_col = flag_col
 
+    def lint_info(self):
+        import jax.numpy as _jnp
+
+        return {
+            "requires": self.names,
+            "adds": {self.flag_col: _jnp.int64},
+            "table_ids": (),
+        }
+
+    def trace_contract(self):
+        return {
+            "kind": "device",
+            "trace_step": lambda c: _expand_step(
+                c, self.subsets, self.names, self.flag_col
+            ),
+            "state": None,
+            "donate": True,
+            # output capacity is input capacity x len(subsets): a pure
+            # function of the input bucket
+            "emission": "passthrough",
+        }
+
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         missing = [n for n in self.names if n not in chunk.columns]
         if missing:

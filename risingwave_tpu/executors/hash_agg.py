@@ -493,6 +493,30 @@ class HashAggExecutor(Executor, Checkpointable):
             "window_key": self.window_key[0] if self.window_key else None,
         }
 
+    def trace_contract(self):
+        # flush quantizes every delta chunk to exactly two capacities
+        # (_delta_to_chunk: small | full) — that pair IS the declared
+        # bucket lattice that keeps the windowed agg shape-stable
+        full = 2 * self.out_cap
+        caps = tuple(sorted({min(256, full), full}))
+        return {
+            "kind": "device",
+            "trace_step": lambda c: _agg_step(
+                self.table,
+                self.state,
+                self.dropped,
+                c,
+                self.calls,
+                self.group_keys,
+                self.nullable,
+            ),
+            "state": (self.table, self.state),
+            "donate": True,
+            "emission": "bucketed",
+            "emission_caps": caps,
+            "window_buckets": caps,
+        }
+
     # -- data ------------------------------------------------------------
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         for k, nb in zip(self.group_keys, self.nullable):

@@ -383,6 +383,33 @@ class HashJoinExecutor(Executor, Checkpointable):
             "window_cols": self.window_cols,
         }
 
+    def trace_contract(self):
+        return {
+            "kind": "device",
+            "trace_step": lambda c: _join_step(
+                self.left,
+                self.right,
+                c,
+                self.left_keys,
+                self.right_keys,
+                self.left_names,
+                self.right_names,
+                self.out_cap,
+                self.join_type,
+                "l",
+                self.out_names,
+            ),
+            "state": (self.left, self.right),
+            "donate": True,
+            "emission": "fixed",
+            "emission_caps": (self.out_cap,),
+            # JoinSide rehash-grows with no declared bucket cap: under
+            # window churn (fresh window keys every slide) the expiry/
+            # growth cycle re-traces every program touching the side
+            # tables — the q7 wedge class (RW-E803 when window_cols)
+            "window_buckets": None,
+        }
+
     # -- data ------------------------------------------------------------
     def apply_left(self, chunk: StreamChunk) -> List[StreamChunk]:
         return self._apply("l", chunk)

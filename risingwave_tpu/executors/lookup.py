@@ -144,6 +144,34 @@ class DeltaJoinExecutor(Executor):
         self.right_out = tuple(right_out)
         self.out_cap = out_cap
 
+    def lint_info(self):
+        # join-shaped metadata (plan_verifier._verify_join): the delta
+        # join emits the configured output projection; dtypes are
+        # whatever the arrangements store (int64 lanes in _emit)
+        emits = {n: None for n, _ in self.left_out}
+        emits.update({n: None for n, _ in self.right_out})
+        return {
+            "left_keys": self.left_keys,
+            "right_keys": self.right_keys,
+            "expects_left": {k: None for k in self.left_keys},
+            "expects_right": {k: None for k in self.right_keys},
+            "emits": emits,
+            "table_ids": (),  # state lives in the shared arrangements
+        }
+
+    def trace_contract(self):
+        return {
+            "kind": "host",
+            "trace_step": None,
+            "state": None,
+            "donate": False,
+            # emission capacity is the pow2 envelope of the match
+            # count — data-dependent
+            "emission": "data_dependent",
+            "host_reason": "delta join probes shared host-side "
+            "IndexArrangements row by row (lookup.rs analogue)",
+        }
+
     # -- the two delta paths --------------------------------------------
     def _rows_of(self, chunk: StreamChunk, names):
         data = chunk.to_numpy(with_ops=True)

@@ -79,6 +79,18 @@ class MaterializeExecutor(Executor, Checkpointable):
             "table_ids": (self.table_id,),
         }
 
+    def trace_contract(self):
+        return {
+            "kind": "host",
+            "trace_step": None,
+            "state": None,
+            "donate": False,
+            "emission": "passthrough",
+            "host_reason": "host-map materializer: python dict row "
+            "store pulls every chunk to host (device-resident MVs use "
+            "DeviceMaterializeExecutor)",
+        }
+
     # -- backend selection ----------------------------------------------
     _force_python = False  # subclasses needing row hooks pin the dict
 
@@ -659,6 +671,17 @@ class DeviceMaterializeExecutor(MvDeviceReadMixin, Executor, Checkpointable):
             "expects": dict(self.dtypes),
             "state_pk": tuple(self.pk),
             "table_ids": (self.table_id,),
+        }
+
+    def trace_contract(self):
+        return {
+            "kind": "device",
+            "trace_step": lambda c: _mv_step(
+                self.table, self.state, c, self.pk, self.columns
+            ),
+            "state": (self.table, self.state),
+            "donate": True,
+            "emission": "passthrough",
         }
 
     # -- data -------------------------------------------------------------

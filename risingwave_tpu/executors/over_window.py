@@ -624,6 +624,21 @@ class EowcOverWindowExecutor(ArenaBufferedExecutor):
                 )
         super().__init__(schema_dtypes, capacity, nullable, table_id)
 
+    def lint_info(self):
+        info = super().lint_info()
+        # complete-partition compute appends every call's output lane
+        info["adds"] = {c.output: jnp.int64 for c in self.calls}
+        info["keys"] = self.part_keys
+        # EOWC contract: partitions only close when a watermark on the
+        # window column passes them
+        info["window_key"] = self.win_col
+        return info
+
+    def trace_contract(self):
+        contract = super().trace_contract()
+        contract["hot_methods"] = ("on_watermark",)
+        return contract
+
     def on_watermark(self, watermark):
         if watermark.column != self.win_col:
             return watermark, []
@@ -685,6 +700,9 @@ class OverWindowExecutor(Executor, Checkpointable):
                     "EowcOverWindowExecutor for lag(k)"
                 )
         self.table_id = table_id
+        self._dtypes = {
+            k: jnp.dtype(v) for k, v in schema_dtypes.items()
+        }
         self.table = HashTable.create(
             capacity,
             tuple(jnp.dtype(schema_dtypes[k]) for k in self.part_keys),
@@ -702,6 +720,39 @@ class OverWindowExecutor(Executor, Checkpointable):
         self._saw_delete = jnp.zeros((), jnp.bool_)
         self._dropped = jnp.zeros((), jnp.bool_)
         self._ooo = jnp.zeros((), jnp.bool_)
+
+    def lint_info(self):
+        requires = set(self.part_keys)
+        for c in self.calls:
+            if c.input is not None:
+                requires.add(c.input)
+        return {
+            "requires": tuple(sorted(requires)),
+            "expects": {
+                k: self._dtypes[k]
+                for k in sorted(requires)
+                if k in self._dtypes
+            },
+            "adds": {c.output: jnp.int64 for c in self.calls},
+            "keys": self.part_keys,
+            "table_ids": (self.table_id,),
+        }
+
+    def trace_contract(self):
+        return {
+            "kind": "device",
+            "trace_step": lambda c: _over_step(
+                self.table,
+                self.accums,
+                self.sdirty,
+                c,
+                self.calls,
+                self.part_keys,
+            ),
+            "state": (self.table, self.accums),
+            "donate": True,
+            "emission": "passthrough",
+        }
 
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         for c in self.calls:
@@ -1300,6 +1351,52 @@ class GeneralOverWindowExecutor(Executor, Checkpointable):
         self._dropped = jnp.zeros((), jnp.bool_)
         self._bad_delete = jnp.zeros((), jnp.bool_)
         self._bound = 0
+
+    def lint_info(self):
+        requires = set(self.part_keys) | set(self.pk) | {self.order_col}
+        for c in self.calls:
+            if c.input is not None:
+                requires.add(c.input)
+        return {
+            "requires": tuple(sorted(requires)),
+            "expects": {
+                k: self.schema_dtypes[k]
+                for k in sorted(requires)
+                if k in self.schema_dtypes
+            },
+            "adds": {c.output: jnp.int64 for c in self.calls},
+            "keys": self.part_keys,
+            "state_pk": tuple(self.pk),
+            "table_ids": (self.table_id,),
+        }
+
+    def trace_contract(self):
+        return {
+            "kind": "device",
+            "trace_step": lambda c: _general_over_step(
+                self.table,
+                self.buf,
+                self.bnulls,
+                self.present,
+                self.seq,
+                self.em,
+                self.emnulls,
+                self.em_valid,
+                self.sdirty,
+                jnp.int64(self._seq_base),
+                c,
+                self.calls,
+                self.part_keys,
+                self.order_col,
+                self.pk,
+                self.lane_names,
+            ),
+            "state": (self.table, self.buf, self.em),
+            "donate": True,
+            # retract/re-emit diff chunks are arena-capacity lanes
+            "emission": "fixed",
+            "emission_caps": (self.capacity,),
+        }
 
     def _alloc(self, cap: int):
         self.table = HashTable.create(

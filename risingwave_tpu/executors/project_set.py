@@ -101,6 +101,46 @@ class ProjectSetExecutor(Executor):
         self.ordinal = ordinal
         self._truncated = jnp.zeros((), jnp.bool_)
 
+    def lint_info(self):
+        adds = {self.out: None}
+        if self.ordinal:
+            adds["projected_row_id"] = jnp.int64
+        if self.fn == "generate_series":
+            adds[self.out] = jnp.int64
+            return {
+                "requires": (self.start_col, self.stop_col),
+                "adds": adds,
+                "table_ids": (),
+            }
+        # unnest reads the composite list lanes (col.0..col.k, col.#)
+        # whose names the catalog schema does not carry column-wise —
+        # declare only what is provable (the outputs), require nothing
+        return {"adds": adds, "table_ids": ()}
+
+    def trace_contract(self):
+        if self.fn == "unnest":
+            step = lambda c: _unnest_step(
+                c, self.list_col, self.out, self.list_cap, self.ordinal
+            )
+        else:
+            step = lambda c: _series_step(
+                c,
+                self.start_col,
+                self.stop_col,
+                self.out,
+                self.max_steps,
+                self.ordinal,
+            )
+        return {
+            "kind": "device",
+            "trace_step": step,
+            "state": None,
+            "donate": True,
+            # static expansion factor (list_cap / max_steps): output
+            # capacity is a pure function of the input bucket
+            "emission": "passthrough",
+        }
+
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         if self.fn == "unnest":
             # lists longer than the configured expansion silently drop

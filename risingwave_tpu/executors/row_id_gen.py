@@ -35,6 +35,23 @@ class RowIdGenExecutor(Executor, Checkpointable):
             "table_ids": (self.table_id,),
         }
 
+    def trace_contract(self):
+        return {
+            "kind": "device",
+            # same math as apply with the host counter as a traced
+            # zero-d base — the counter is trivially convertible to
+            # carried device state in a fused step
+            "trace_step": lambda c: c.with_columns(
+                **{
+                    self.out_col: jnp.zeros((), jnp.int64)
+                    + jnp.arange(c.capacity, dtype=jnp.int64)
+                }
+            ),
+            "state": None,
+            "donate": True,
+            "emission": "passthrough",
+        }
+
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         if self.out_col in chunk.columns:
             # DML deletes/updates address existing rows BY id — never

@@ -72,6 +72,41 @@ class SimpleAggExecutor(Executor, Checkpointable):
         )
         self._last: Optional[Tuple] = None  # what downstream has
 
+    def lint_info(self):
+        requires = sorted(
+            {c.input for c in self.calls if c.input is not None}
+        )
+        emits = {}
+        for c in self.calls:
+            if c.kind in ("count", "count_star"):
+                emits[c.output] = jnp.int64
+            elif c.kind in ("min", "max") and c.input in self._dtypes:
+                emits[c.output] = self._dtypes[c.input]
+            else:
+                emits[c.output] = None  # sum/avg widen by kind rules
+        return {
+            "requires": tuple(requires),
+            "expects": {
+                k: self._dtypes[k] for k in requires if k in self._dtypes
+            },
+            "emits": emits,
+            "renames": {k: None for k in emits},  # all computed
+            "table_ids": (self.table_id,),
+        }
+
+    def trace_contract(self):
+        return {
+            "kind": "device",
+            "trace_step": lambda c: _simple_step(
+                self.state, c, self.calls
+            ),
+            "state": self.state,
+            "donate": True,
+            # _row_chunk sizes its emission by the rows emitted
+            # (max(2, len(ops))) — data-dependent output shape
+            "emission": "data_dependent",
+        }
+
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         self.state = _simple_step(self.state, chunk, self.calls)
         return []

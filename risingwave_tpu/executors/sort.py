@@ -129,6 +129,34 @@ class ArenaBufferedExecutor(Executor, Checkpointable):
         self._overflow = jnp.zeros((), jnp.bool_)
         self._saw_delete = jnp.zeros((), jnp.bool_)
 
+    def lint_info(self):
+        return {
+            "requires": tuple(self.names),
+            "expects": {n: self.buf[n].dtype for n in self.names},
+            "table_ids": (self.table_id,),
+        }
+
+    def trace_contract(self):
+        return {
+            "kind": "device",
+            "trace_step": lambda c: _sort_append(
+                self.buf,
+                self.bnulls,
+                self.valid,
+                self.seq,
+                self.next_seq,
+                c,
+                self.names,
+            ),
+            "state": (self.buf, self.valid, self.seq),
+            "donate": True,
+            # window-close emissions are arena-capacity chunks: one
+            # declared bucket
+            "emission": "fixed",
+            "emission_caps": (self.capacity,),
+            "window_buckets": (self.capacity,),
+        }
+
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         self._saw_delete = self._saw_delete | jnp.any(
             chunk.valid & (chunk.signs() < 0)
@@ -289,6 +317,14 @@ class SortExecutor(ArenaBufferedExecutor):
     ):
         super().__init__(schema_dtypes, capacity, nullable, table_id)
         self.ts_col = ts_col
+
+    def lint_info(self):
+        info = super().lint_info()
+        # EOWC contract: rows only ever leave the arena when a
+        # watermark on ts_col closes them — an unreachable ts_col
+        # means the buffer grows forever and nothing is emitted
+        info["window_key"] = self.ts_col
+        return info
 
     def on_watermark(self, watermark: Watermark):
         if watermark.column != self.ts_col:

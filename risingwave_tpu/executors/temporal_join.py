@@ -73,6 +73,66 @@ class TemporalJoinExecutor(Executor):
         self.output_cols = tuple(output_cols)
         self.join_type = join_type
 
+    def lint_info(self):
+        # probes never drop/append stream columns; matched table value
+        # columns are appended (nullable on a "left" miss)
+        out_dtypes = {}
+        if isinstance(self.right, DeviceMaterializeExecutor):
+            out_dtypes = {
+                c: self.right.dtypes.get(c) for c in self.output_cols
+            }
+        return {
+            "requires": tuple(self.left_keys),
+            "adds": {
+                c: out_dtypes.get(c) for c in self.output_cols
+            },
+            "table_ids": (),  # the right side owns its own state table
+        }
+
+    def trace_contract(self):
+        if not isinstance(self.right, DeviceMaterializeExecutor):
+            return {
+                "kind": "host",
+                "trace_step": None,
+                "state": None,
+                "donate": False,
+                "emission": "passthrough",
+                "host_reason": "temporal probe against a host-map "
+                "materializer snapshot dict (device path needs a "
+                "DeviceMaterializeExecutor right side)",
+            }
+
+        def step(c):
+            key_lanes = tuple(
+                c.col(k).astype(tk.dtype)
+                for k, tk in zip(self.left_keys, self.right.table.keys)
+            )
+            key_ok = jnp.ones(c.capacity, jnp.bool_)
+            for k in self.left_keys:
+                key_ok = key_ok & ~c.null_of(k)
+            return _probe_step(
+                self.right.table,
+                self.right.state.values,
+                self.right.state.vnulls,
+                c,
+                key_lanes,
+                key_ok,
+                self.output_cols,
+                self.join_type,
+            )
+
+        return {
+            "kind": "device",
+            "trace_step": step,
+            # the probe only READS the right table: nothing to donate
+            "state": None,
+            "donate": True,
+            "emission": "passthrough",
+            # the host-fallback probe is statically present in apply
+            # but dead on this configuration (right side is device)
+            "scan_exclude": ("_probe_host",),
+        }
+
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         if isinstance(self.right, DeviceMaterializeExecutor):
             if len(self.right.pk) != len(self.left_keys):

@@ -251,6 +251,41 @@ class GroupTopNExecutor(Executor, Checkpointable):
         self._dropped = jnp.zeros((), jnp.bool_)
         self._overflow = jnp.zeros((), jnp.bool_)
 
+    def lint_info(self):
+        cols = self.group_keys + (self.order_col,) + self.payload
+        return {
+            "expects": {
+                c: self._dtypes[c] for c in cols if c in self._dtypes
+            },
+            "emits": {c: self._dtypes.get(c) for c in cols},
+            "renames": {c: c for c in cols},
+            "keys": self.group_keys,
+            "table_ids": (self.table_id,),
+            "window_key": self.window_key[0] if self.window_key else None,
+        }
+
+    def trace_contract(self):
+        return {
+            "kind": "device",
+            "trace_step": lambda c: _topn_step(
+                self.table,
+                self.state,
+                c,
+                self.group_keys,
+                self.order_col,
+                self.desc,
+                self.k,
+                self.payload,
+                self.out_cap,
+            ),
+            "state": (self.table, self.state),
+            "donate": True,
+            "emission": "fixed",
+            "emission_caps": (self.out_cap,),
+            # the group table rehash-grows with no declared bucket cap
+            "window_buckets": None,
+        }
+
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         for c in self.group_keys + (self.order_col,) + self.payload:
             if c in chunk.nulls:

@@ -133,6 +133,19 @@ class TopNExecutor(Executor, Checkpointable):
             "table_ids": (self.table_id,),
         }
 
+    def trace_contract(self):
+        return {
+            "kind": "device",
+            "trace_step": lambda c: _upsert_step(
+                self.table, self.rows, self.sdirty, c, self.pk, self.names
+            ),
+            "state": (self.table, self.rows),
+            "donate": True,
+            # the barrier diff against the host mirror emits chunks
+            # sized by the changed-row count
+            "emission": "data_dependent",
+        }
+
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         for k in self.pk + (self.order_col,):
             if k in chunk.nulls:
@@ -483,6 +496,26 @@ class RetractableGroupTopNExecutor(Executor, Checkpointable):
             "state_pk": tuple(self.store_keys),
             "table_ids": (self.table_id,),
             "window_key": self.window_key[0] if self.window_key else None,
+        }
+
+    def trace_contract(self):
+        return {
+            "kind": "device",
+            "trace_step": lambda c: _upsert_step_ed(
+                self.table,
+                self.rows,
+                self.sdirty,
+                self.epoch_dirty,
+                c,
+                self.store_keys,
+                self.names,
+            ),
+            "state": (self.table, self.rows),
+            "donate": True,
+            # the barrier ranks on device but diffs against a host
+            # mirror: emission is sized by changed groups x k
+            "emission": "data_dependent",
+            "window_buckets": None,
         }
 
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:

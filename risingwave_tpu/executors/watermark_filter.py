@@ -83,6 +83,20 @@ class WatermarkFilterExecutor(Executor):
             "watermark_src": self.column,
         }
 
+    def trace_contract(self):
+        return {
+            "kind": "device",
+            "trace_step": lambda c: _wm_step(
+                c, self._running_max, self.column, self._running_max
+            ),
+            "state": self._running_max,
+            "donate": True,
+            "emission": "passthrough",
+            # watermark generation reads the running max once per
+            # barrier — a real (if small) host sync, reported honestly
+            "hot_methods": ("emit_watermark",),
+        }
+
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         floor = jnp.asarray(
             self._wm if self._wm is not None else jnp.iinfo(jnp.int64).min,

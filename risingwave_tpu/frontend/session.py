@@ -440,6 +440,33 @@ class SqlSession:
         for p in tuple(getattr(planned, "aux", ())) + (planned,):
             diags = lint_planned(p, catalog=self.catalog, strict=strict)
             self.lint_findings.extend((p.name, d) for d in diags)
+            self._fusion_lint(p, strict=strict)
+
+    def _fusion_lint(self, planned, strict: bool) -> None:
+        """Fusion-feasibility findings at CREATE-MV time (analysis/
+        fusion_analyzer.py, shallow pass): REPORT-ONLY by default —
+        RW-E803 (unbucketed shape-polymorphic window, the class that
+        wedges real TPUs) lands in ``lint_findings`` as a warning;
+        the RW_STRICT_FUSION=1 env knob (env-only, like the other
+        escape hatches) refuses the DDL on window-keyed plans, same
+        path as strict_lint."""
+        import os
+
+        from risingwave_tpu.analysis.diagnostics import PlanLintError
+        from risingwave_tpu.analysis.lint import fusion_findings_for_ddl
+
+        try:
+            diags = fusion_findings_for_ddl(planned)
+        except Exception:  # noqa: BLE001 — analysis must never brick DDL
+            return
+        if not diags:
+            return
+        self.lint_findings.extend((planned.name, d) for d in diags)
+        strict_fusion = os.environ.get(
+            "RW_STRICT_FUSION", "0"
+        ).strip().lower() not in ("0", "off", "false", "")
+        if strict and strict_fusion:
+            raise PlanLintError(diags, name=planned.name)
 
     def _rollback_aux_catalog(self, planned) -> None:
         """The planner adds hidden aux entries to the catalog during

@@ -847,6 +847,67 @@ def _shard_side_chain(chain, mesh):
 
 
 # ---------------------------------------------------------------------------
+# fragment -> chain extraction (static analysis surface)
+# ---------------------------------------------------------------------------
+
+
+def fragment_chains(pipeline) -> Dict[str, Dict[str, List[object]]]:
+    """Normalize ANY pipeline shape into ``{fragment: {section:
+    executor chain}}`` for static analysis (plan verifier / fusion
+    analyzer). Sections name the input side feeding the chain:
+    ``single``/``left``/``right`` (source-fed — the analyzer can seed
+    an abstract schema), ``join_tail`` (the join executor + tail of a
+    two-input shape), or ``chain`` (a graph fragment fed by other
+    fragments — schema threads through lint_info, not sources).
+
+    GraphPipeline fragments are SHADOW-built (``spec.build(0)``) on the
+    host device only to read static metadata — the live actors hold
+    their own executors; nothing here touches HBM or actor state."""
+    if hasattr(pipeline, "_specs") and hasattr(pipeline, "graph"):
+        from risingwave_tpu.analysis.plan_verifier import _host_device
+
+        out: Dict[str, Dict[str, List[object]]] = {}
+        frag_side = {
+            frag: side for side, frag in pipeline._sources.items()
+        }
+        for s in pipeline._specs:
+            try:
+                with _host_device():
+                    built = s.build(0)
+            except Exception:  # noqa: BLE001 — builder needs live inputs
+                built = None
+            if isinstance(built, dict):
+                out[s.name] = {
+                    "left": list(built.get("left", ())),
+                    "right": list(built.get("right", ())),
+                    "join_tail": (
+                        [built["join"]]
+                        if built.get("join") is not None
+                        else []
+                    )
+                    + list(built.get("tail", ())),
+                }
+            elif isinstance(built, (list, tuple)):
+                side = frag_side.get(s.name)
+                key = side or ("single" if not s.inputs else "chain")
+                out[s.name] = {key: list(built)}
+            else:
+                out[s.name] = {}
+        return out
+    if hasattr(pipeline, "join") and hasattr(pipeline, "left"):
+        return {
+            "left": {"left": list(pipeline.left)},
+            "right": {"right": list(pipeline.right)},
+            "out": {
+                "join_tail": [pipeline.join] + list(pipeline.tail)
+            },
+        }
+    if hasattr(pipeline, "executors"):
+        return {"mv": {"single": list(pipeline.executors)}}
+    return {}
+
+
+# ---------------------------------------------------------------------------
 # planner output -> fragment graph
 # ---------------------------------------------------------------------------
 

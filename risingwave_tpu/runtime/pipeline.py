@@ -57,7 +57,12 @@ def walk_chain(chain: Sequence[Executor], chunks, barrier=None):
 
 def _pcall(ex, phase, fn, *args):
     """Profiler-gated call for executor entry points OUTSIDE walk_chain
-    (join apply_left/right, on_barrier in two-input shapes)."""
+    (join apply_left/right, on_barrier in two-input shapes) — also the
+    recompile-hazard fingerprint tap for those paths: serial AND
+    graph-mode join executors feed SignatureWatch here, so two-input
+    shapes get the same shape-stability coverage as chain executors."""
+    if SIGNATURES.enabled and phase == "apply" and args:
+        SIGNATURES.observe(ex, args[0])
     if PROFILER.enabled:
         return PROFILER.run(ex, phase, fn, *args)
     return fn(*args)

@@ -12,10 +12,15 @@ Stages (all must pass; exit code is the OR of their failures):
    F401 class) + byte-compilation of every file (syntax errors).
 2. ``python -m risingwave_tpu lint --all-nexmark --deep`` — the static
    plan verifier + jaxpr sanitizer over q5/q7/q8.
-3. ``python scripts/perf_gate.py --smoke`` — the dispatch-cost
+3. ``python -m risingwave_tpu lint --all-nexmark --fusion-report`` —
+   the fusion-feasibility analyzer: per-fragment fusible prefixes +
+   RW-E8xx blockers with provenance.
+4. ``python scripts/perf_gate.py --smoke --fusion`` — the dispatch-cost
    regression gate: committed BENCH artifacts vs
-   scripts/perf_budgets.json, plus the CPU q5 steady-state microbench
-   (bounded device dispatches/barrier + host-python ms/row).
+   scripts/perf_budgets.json, the CPU q5 steady-state microbench
+   (bounded device dispatches/barrier + host-python ms/row), and the
+   fusion ratchet vs FUSION_REPORT.json (fusible prefixes must not
+   shrink, host-sync counts must not grow).
 """
 
 from __future__ import annotations
@@ -133,21 +138,67 @@ def stage_rwlint() -> int:
     )
 
 
-def stage_perf_gate() -> int:
-    print("[lint_all] perf_gate --smoke (dispatch-cost budgets)")
+def stage_fusion_report(out_path: str) -> int:
+    """Produce the fusion analysis ONCE (JSON to ``out_path``); stage
+    4's perf_gate consumes it via --fusion-current instead of paying
+    for a second corpus build + jaxpr trace."""
+    print("[lint_all] rwlint --fusion-report (fusion feasibility)")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
-    return subprocess.call(
-        [sys.executable, os.path.join(ROOT, "scripts", "perf_gate.py"),
-         "--smoke"],
-        cwd=ROOT,
-        env=env,
-    )
+    try:
+        with open(out_path, "w") as f:
+            rc = subprocess.call(
+                [sys.executable, "-m", "risingwave_tpu", "lint",
+                 "--all-nexmark", "--fusion-report", "--json"],
+                cwd=ROOT,
+                env=env,
+                stdout=f,
+            )
+    except OSError as e:
+        print(f"[lint_all] cannot write {out_path}: {e}")
+        return 1
+    if rc == 0:
+        try:
+            import json
+
+            with open(out_path) as f:
+                fus = json.load(f).get("__fusion__", {})
+            for q in sorted(fus):
+                s = fus[q]["summary"]
+                print(
+                    f"[lint_all]   {q}: "
+                    f"{s['fusible_fragments']}/{s['fragments']} "
+                    f"fragments fusible, "
+                    f"{s['host_sync_points']} host-sync point(s), "
+                    f"blockers {s['blockers_by_code']}"
+                )
+        except (OSError, ValueError, KeyError):
+            pass
+    return rc
+
+
+def stage_perf_gate(fusion_current: str = None) -> int:
+    print("[lint_all] perf_gate --smoke + fusion ratchet "
+          "(dispatch-cost + fusion-regression budgets)")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, os.path.join(ROOT, "scripts", "perf_gate.py"),
+           "--smoke"]
+    if fusion_current and os.path.exists(fusion_current):
+        cmd += ["--fusion-current", fusion_current]
+    else:
+        cmd += ["--fusion"]
+    return subprocess.call(cmd, cwd=ROOT, env=env)
 
 
 def main() -> int:
+    import tempfile
+
     rc = stage_host_lint()
     rc |= stage_rwlint()
-    rc |= stage_perf_gate()
+    with tempfile.TemporaryDirectory() as tmp:
+        fusion_json = os.path.join(tmp, "fusion_report.json")
+        frc = stage_fusion_report(fusion_json)
+        rc |= frc
+        rc |= stage_perf_gate(fusion_json if frc == 0 else None)
     print(f"[lint_all] {'FAIL' if rc else 'ok'}")
     return rc
 

@@ -158,6 +158,107 @@ def executor_coverage(bench: dict, q: str):
 
 
 # ---------------------------------------------------------------------------
+# mode 3: fusion-feasibility regression gate (static, CPU, in-process)
+# ---------------------------------------------------------------------------
+
+DEFAULT_FUSION_BASELINE = os.path.join(ROOT, "FUSION_REPORT.json")
+
+
+def run_fusion_gate(
+    budgets: dict,
+    baseline_path: str = None,
+    current_path: str = None,
+):
+    """Re-run the fusion analyzer over the Nexmark corpus and compare
+    against the committed FUSION_REPORT.json baseline: per fragment,
+    the fusible executor prefix must not SHRINK and the host-sync
+    count must not GROW (plus the optional absolute per-fragment
+    ``max_host_sync_points`` budget). This is the ratchet for ROADMAP
+    item 1 — every fusion PR moves prefixes up and sync counts down,
+    and nothing moves them back silently. Returns (violations,
+    skipped)."""
+    baseline_path = baseline_path or DEFAULT_FUSION_BASELINE
+    try:
+        baseline = _load(baseline_path)
+    except (OSError, json.JSONDecodeError) as e:
+        return [], [f"fusion baseline unreadable ({e}) — gate skipped"]
+    if current_path:
+        # reuse an analysis another CI stage already paid for (the
+        # `lint --fusion-report --json` output, or its __fusion__ key)
+        try:
+            current = _load(current_path)
+        except (OSError, json.JSONDecodeError) as e:
+            return [f"fusion current-report unreadable: {e}"], []
+        current = current.get("__fusion__", current)
+    else:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        if ROOT not in sys.path:
+            sys.path.insert(0, ROOT)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from risingwave_tpu.analysis.fusion_analyzer import (
+            analyze_nexmark,
+        )
+
+        current = analyze_nexmark(deep=True)
+    fb = budgets.get("fusion", {})
+    max_sync = fb.get("max_host_sync_points", {})
+    violations, skipped = [], []
+    for q, base_rep in baseline.items():
+        if q.startswith("_"):
+            continue
+        if q not in current:
+            # a vanished query loses ALL its ratchet coverage — that
+            # is a regression, not a skip (fragments two checks below
+            # get the same treatment)
+            violations.append(
+                f"fusion: query {q!r} vanished from the analysis "
+                "(baseline still lists it)"
+            )
+            continue
+        base_frags = {
+            f["fragment"]: f for f in base_rep.get("fragments", ())
+        }
+        cur_frags = {
+            f["fragment"]: f for f in current[q]["fragments"]
+        }
+        for name, bf in base_frags.items():
+            cf = cur_frags.get(name)
+            if cf is None:
+                violations.append(
+                    f"fusion {q}: fragment {name!r} vanished from the "
+                    "analysis (baseline still lists it)"
+                )
+                continue
+            if cf["fusible_prefix"] < bf["fusible_prefix"]:
+                violations.append(
+                    f"fusion {q}/{name}: fusible prefix regressed "
+                    f"{bf['fusible_prefix']} -> {cf['fusible_prefix']}"
+                )
+            if cf["host_sync_points"] > bf["host_sync_points"]:
+                violations.append(
+                    f"fusion {q}/{name}: host-sync points grew "
+                    f"{bf['host_sync_points']} -> "
+                    f"{cf['host_sync_points']}"
+                )
+            if bf.get("whole_chain_fusible") and not cf.get(
+                "whole_chain_fusible"
+            ):
+                violations.append(
+                    f"fusion {q}/{name}: whole-chain fusible proof lost"
+                )
+        mx = max_sync.get(q)
+        if mx is not None:
+            total = current[q]["summary"]["host_sync_points"]
+            if total > mx:
+                violations.append(
+                    f"fusion {q}: {total} host-sync points > budget {mx}"
+                )
+    return violations, skipped
+
+
+# ---------------------------------------------------------------------------
 # mode 2: steady-state smoke microbench (CPU, in-process)
 # ---------------------------------------------------------------------------
 
@@ -244,6 +345,24 @@ def main(argv=None) -> int:
         action="store_true",
         help="run the CPU steady-state microbench gate",
     )
+    ap.add_argument(
+        "--fusion",
+        action="store_true",
+        help="re-run the fusion analyzer and fail on fusible-prefix "
+        "or host-sync-count regressions vs FUSION_REPORT.json",
+    )
+    ap.add_argument(
+        "--fusion-baseline",
+        default=None,
+        help="baseline report (default: FUSION_REPORT.json)",
+    )
+    ap.add_argument(
+        "--fusion-current",
+        default=None,
+        help="reuse an existing `lint --fusion-report --json` output "
+        "as the current analysis instead of re-tracing (CI passes "
+        "the stage-3 artifact here)",
+    )
     args = ap.parse_args(argv)
     try:
         budgets = _load(args.budgets)
@@ -254,6 +373,13 @@ def main(argv=None) -> int:
     if args.smoke:
         v, report = run_smoke(budgets)
         print(f"[perf_gate] smoke: {json.dumps(report)}")
+        violations += v
+    if args.fusion or args.fusion_current:
+        v, skipped = run_fusion_gate(
+            budgets, args.fusion_baseline, args.fusion_current
+        )
+        for s in skipped:
+            print(f"[perf_gate] skip: {s}")
         violations += v
     bench_path = args.bench or DEFAULT_BENCH
     # --smoke without an explicit artifact still gates the committed
