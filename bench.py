@@ -209,6 +209,42 @@ def _profile_fields(prefix, prof, n_barriers, rows):
     return fields
 
 
+def _arm_blackbox(smoke: bool) -> None:
+    """Child-mode black box: the flight recorder persists every barrier
+    to an append-only BLACKBOX_*.jsonl (so a SIGKILLed/wedged child
+    still leaves a per-barrier timeline on disk), and — on a real
+    device — the wedge sentinel heartbeats the device and converts a
+    wedge into a prompt structured ``DeviceWedged`` (via the existing
+    SIGALRM unwind) instead of sitting out the full child alarm.
+    Smoke/CPU runs keep the in-memory ring only (no repo litter)."""
+    import os
+    import signal
+
+    from risingwave_tpu import blackbox
+
+    if os.environ.get("RW_BENCH_BLACKBOX", "1") == "0":
+        return
+    if not smoke:
+        blackbox.RECORDER.configure(
+            dir=os.environ.get("RW_BLACKBOX_DIR", "."),
+            fsync_interval_s=2.0,
+        )
+
+        def on_wedge(err):
+            # the main thread may be blocked inside a device call no
+            # Python raise can reach: ride the child's SIGALRM handler
+            # (see _expire — it surfaces the sentinel's DeviceWedged)
+            signal.alarm(5)
+
+        blackbox.SENTINEL.start(
+            interval_s=float(os.environ.get("RW_BLACKBOX_HEARTBEAT_S", 10)),
+            slow_ms=float(os.environ.get("RW_BLACKBOX_SLOW_MS", 2000)),
+            deadline_s=float(os.environ.get("RW_BLACKBOX_DEADLINE_S", 60)),
+            on_wedge=on_wedge,
+            dir=os.environ.get("RW_BLACKBOX_DIR", "."),
+        )
+
+
 def _state_cap(expected_rows: int, floor: int) -> int:
     """Table capacity whose growth margin covers the expected volume:
     growth REBUILDS tables at new capacities, and every new capacity
@@ -873,6 +909,8 @@ def _dump_bench_stall(query: str, tier: str, err) -> str:
                     "child_stall_dumps": sorted(
                         p for p in os.listdir(".")
                         if p.startswith("STALL_DUMP_")
+                        or p.startswith("WEDGE_")
+                        or p.startswith("BLACKBOX_")
                     ),
                 },
                 f,
@@ -892,6 +930,25 @@ def _bank_partial(merged: dict) -> None:
     with open(tmp, "w") as f:
         json.dump(merged, f)
     os.replace(tmp, PARTIAL_PATH)
+
+
+def _bank_query(query: str, tier: str, sub: dict) -> None:
+    """Per-query summary artifact, flushed the moment the query's
+    child returns (probe-early, SNIPPETS.md [1]): a mid-round tunnel
+    loss like r04/r05 still leaves every completed query's numbers in
+    its own ``BENCH_<q>.json``, not only the merged partial."""
+    import os
+
+    path = f"BENCH_{query}.json"
+    try:
+        doc = {"query": query, "tier": tier, "ts": time.time()}
+        doc.update(sub)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass  # banking is forensic, never fatal
 
 
 def _child_timeout(query: str, tier: str) -> int:
@@ -1026,9 +1083,26 @@ def main():
     if args.alarm_s:
         import signal
 
+        alarm_deadline = time.monotonic() + args.alarm_s
+
         def _expire(signum, frame):
-            # raise in the main thread: python unwinds, JAX client
-            # detaches cleanly, parent reads rc != 0
+            # a sentinel-detected wedge surfaces as the STRUCTURED
+            # DeviceWedged (forensic bundle already on disk) rather
+            # than a generic timeout; either way python unwinds, the
+            # JAX client detaches cleanly, parent reads rc != 0
+            from risingwave_tpu import blackbox
+
+            wedged = blackbox.SENTINEL.wedged_error()
+            if wedged is not None:
+                raise wedged
+            remaining = alarm_deadline - time.monotonic()
+            if remaining > 1:
+                # the sentinel's on_wedge pulled the alarm forward but
+                # the wedge HEALED before it fired (a completed beat
+                # disarms): restore the original budget, don't kill a
+                # healthy run with a misleading timeout
+                signal.alarm(int(remaining) + 1)
+                return
             raise TimeoutError(f"self-timeout after {args.alarm_s}s")
 
         signal.signal(signal.SIGALRM, _expire)
@@ -1046,13 +1120,24 @@ def main():
         jax.config.update("jax_platforms", "cpu")
 
     if args.only:
-        # child mode: one query, one shape, in-process
+        # child mode: one query, one shape, in-process — with the
+        # black box armed so even a SIGKILL/wedge leaves per-barrier
+        # telemetry and a forensic bundle behind
+        _arm_blackbox(args.smoke)
         epochs = args.epochs or 3
         events = args.events_per_epoch or 20_000
         chunk = args.chunk_events or 2_048
         result = _bench_one(
             args.only, epochs, events, chunk, args.smoke, args.agg_mode
         )
+        from risingwave_tpu import blackbox
+
+        if blackbox.RECORDER.segment_path:
+            result[f"{args.only}_blackbox_segment"] = (
+                blackbox.RECORDER.segment_path
+            )
+        blackbox.SENTINEL.stop()
+        blackbox.RECORDER.close()
         print(json.dumps(result))
         return
 
@@ -1160,6 +1245,7 @@ def main():
         if sub is not None:
             sub[f"{query}_tier" if query != "q5" else "tier"] = tier
             merged.update(sub)  # larger tier overwrites smaller
+            _bank_query(query, tier, sub)  # per-query artifact, NOW
         else:
             errors.append(err)
             failed.add(query)

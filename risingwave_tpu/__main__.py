@@ -166,6 +166,27 @@ def main() -> None:
     )
     ln.add_argument("--json", action="store_true")
     ln.set_defaults(fn=_lint)
+    bb = sub.add_parser(
+        "blackbox",
+        help="read a crash-surviving flight-recorder segment "
+        "(BLACKBOX_*.jsonl, or a directory holding one): reconstruct "
+        "the last-N-barrier timeline, optionally emit a Perfetto "
+        "trace (exit 0 = parsed, 1 = timeline broken, 2 = unreadable)",
+    )
+    bb.add_argument(
+        "path", help="segment file or the directory that holds it"
+    )
+    bb.add_argument(
+        "--last", type=int, default=None, help="only the last N barriers"
+    )
+    bb.add_argument(
+        "--trace",
+        default=None,
+        metavar="OUT.json",
+        help="write a chrome://tracing / Perfetto trace of the timeline",
+    )
+    bb.add_argument("--json", action="store_true")
+    bb.set_defaults(fn=_blackbox_read)
     cn = sub.add_parser(
         "compute-node",
         help="start a compute-node role behind a TCP wire "
@@ -183,6 +204,76 @@ def _compute_node(args) -> None:
     from risingwave_tpu.cluster.compute_node import run
 
     run(args.port, args.state_dir, args.device)
+
+
+def _blackbox_read(args) -> None:
+    """Black-box reader: a post-mortem tool that must work when the
+    process that wrote the segment is gone (SIGKILL, OOM, wedged
+    device). Parses torn tails, merges a rotated .old sibling, prints
+    the barrier timeline, and flags non-monotonic epochs."""
+    import json as _json
+    import os
+    import sys
+
+    # a post-mortem read must never touch the (possibly still-wedged)
+    # device — same CPU pin as the lint CLI
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from risingwave_tpu.blackbox import read_segment, records_to_trace_events
+
+    try:
+        doc = read_segment(args.path, last=args.last)
+    except (OSError, FileNotFoundError) as e:
+        print(f"blackbox: cannot read {args.path!r}: {e}", file=sys.stderr)
+        sys.exit(2)
+    if args.trace:
+        from risingwave_tpu.trace import render_chrome_trace
+
+        with open(args.trace, "w") as f:
+            f.write(
+                render_chrome_trace(
+                    records_to_trace_events(doc["records"]),
+                    {1: "barrier", 2: "stages"},
+                )
+            )
+    if args.json:
+        print(_json.dumps(doc, default=str))
+    else:
+        recs = doc["records"]
+        hdr = doc["header"] or {}
+        print(
+            f"blackbox: {len(recs)} barrier(s) from {doc['source']}"
+            + (f" (pid {hdr.get('pid')})" if hdr else "")
+            + (
+                f", {doc['torn_lines']} torn line(s) tolerated"
+                if doc["torn_lines"]
+                else ""
+            )
+        )
+        for r in recs:
+            stages = " ".join(
+                f"{k}={v:.1f}" for k, v in (r["stages_ms"] or {}).items()
+            )
+            extra = ""
+            if "dispatches_delta" in r:
+                extra += f" disp+{r['dispatches_delta']}"
+            if r.get("sentinel"):
+                extra += f" sen={r['sentinel']}"
+            if "channel_depths" in r:
+                extra += f" depths={r['channel_depths']}"
+            print(
+                f"  epoch {r['epoch']} seq {r['seq']} "
+                f"{'ckpt' if r['checkpoint'] else '    '} "
+                f"wall {r['wall_ms']:.1f}ms  {stages}{extra}"
+            )
+        if not doc["monotonic"]:
+            print("blackbox: WARNING — epoch timeline is NOT monotonic")
+        if args.trace:
+            print(f"blackbox: Perfetto trace -> {args.trace}")
+    sys.exit(0 if doc["monotonic"] else 1)
 
 
 def _lint(args) -> None:

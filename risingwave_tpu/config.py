@@ -88,12 +88,37 @@ class ProfilerConfig:
 
 
 @dataclass
+class BlackboxConfig:
+    """Black-box flight recorder + device-wedge sentinel knobs
+    (blackbox.py). The in-memory ring is always on (``enabled``
+    disables even that); ``dir`` arms the crash-surviving JSONL
+    segment persistence with a bounded fsync cadence; ``sentinel``
+    starts the heartbeat watchdog that converts a wedged device into a
+    structured ``DeviceWedged`` + ``WEDGE_*.json`` forensic bundle.
+    Env knobs (RW_BLACKBOX, RW_BLACKBOX_DIR, RW_BLACKBOX_RING,
+    RW_BLACKBOX_FSYNC_S, RW_BLACKBOX_SEGMENT_MAX,
+    RW_BLACKBOX_SENTINEL, RW_BLACKBOX_HEARTBEAT_S, RW_BLACKBOX_SLOW_MS,
+    RW_BLACKBOX_DEADLINE_S) win over the file."""
+
+    enabled: bool = True
+    dir: str = ""  # "" = ring only, no disk persistence
+    ring_barriers: int = 256
+    fsync_interval_s: float = 2.0
+    segment_max_bytes: int = 8_000_000
+    sentinel: bool = False
+    sentinel_interval_s: float = 5.0
+    sentinel_slow_ms: float = 1000.0
+    sentinel_deadline_s: float = 20.0
+
+
+@dataclass
 class RwConfig:
     streaming: StreamingConfig = field(default_factory=StreamingConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
     system: SystemParams = field(default_factory=SystemParams)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     profiler: ProfilerConfig = field(default_factory=ProfilerConfig)
+    blackbox: BlackboxConfig = field(default_factory=BlackboxConfig)
     unrecognized: Dict[str, Any] = field(default_factory=dict)
 
 
@@ -116,7 +141,8 @@ def load_config(
         with open(path, "rb") as f:
             data = tomllib.load(f)
         for section in (
-            "streaming", "storage", "system", "resilience", "profiler"
+            "streaming", "storage", "system", "resilience", "profiler",
+            "blackbox",
         ):
             if section in data:
                 _apply(

@@ -217,6 +217,17 @@ def dump_stalls(
         doc["device"] = device_forensics()
     except Exception as e:
         doc["device"] = repr(e)
+    try:
+        # black-box context: the last barriers BEFORE the stall (what
+        # the flight recorder saw) + the sentinel's device classification
+        from risingwave_tpu.blackbox import RECORDER, SENTINEL
+
+        doc["blackbox"] = {
+            "recorder_tail": RECORDER.snapshot_tail(32),
+            "sentinel": SENTINEL.snapshot(),
+        }
+    except Exception as e:
+        doc["blackbox"] = repr(e)
     fallback_err = None
     if path is None:
         d = os.environ.get("RW_STALL_DIR", ".")

@@ -37,6 +37,10 @@ Recording sites (grow as subsystems need them):
 - ``restored``       — degraded spill fully replayed, store healthy
 - ``degraded_discard`` — recovery discarded a stale degraded spill
                        (sources replay those epochs instead)
+- ``device_state``   — blackbox sentinel (or the out-of-process tunnel
+                       prober) observed an ALIVE/SLOW/WEDGED transition
+- ``wedge_dump``     — blackbox sentinel captured a WEDGE_*.json
+                       forensic bundle for a wedged device
 """
 
 from __future__ import annotations

@@ -38,6 +38,14 @@ class Counter:
     def get(self, **labels: str) -> float:
         return self._values.get(_labels(labels), 0.0)
 
+    def total(self) -> float:
+        """Sum across every label set, snapshotted under the registry
+        lock (safe against a hot-path label insertion mid-iteration) —
+        the public surface forensic readers use instead of touching
+        ``_values`` directly."""
+        with self._lock:
+            return sum(self._values.values())
+
 
 class Histogram:
     """Windowed histogram: quantiles come from a bounded per-label-set
@@ -245,6 +253,34 @@ class MetricsRegistry:
                 )
             except Exception:
                 prof_rows = ""
+        # black box + device sentinel (blackbox.py): the device-health
+        # classification and flight-recorder state — the first look
+        # when a barrier stalls or the TPU tunnel goes quiet
+        bb_rows = ""
+        try:
+            from risingwave_tpu.blackbox import RECORDER, SENTINEL
+
+            sen = SENTINEL.snapshot()
+            rec = RECORDER.snapshot()
+            for k, v in (
+                ("device state", sen["state"]),
+                (
+                    "last heartbeat ms",
+                    sen["last_latency_ms"]
+                    and round(sen["last_latency_ms"], 1),
+                ),
+                ("heartbeats", sen["beats"]),
+                ("wedges", sen["wedges"]),
+                ("sentinel running", sen["running"]),
+                ("recorder records", rec["records"]),
+                ("recorder segment", rec["segment"] or "-"),
+            ):
+                bb_rows += (
+                    f"<tr><td>{escape(str(k))}</td>"
+                    f"<td>{escape(str(v))}</td></tr>"
+                )
+        except Exception:
+            bb_rows = ""
         # resilience health: retry pressure + breaker states + degraded
         # mode (resilience.py) — the operator's first look when the
         # store flakes
@@ -290,6 +326,7 @@ td,th{{border:1px solid #999;padding:2px 8px}}h2{{margin-top:1.5em}}</style></he
 <h2>device state (top 40)</h2><table><tr><th>executor</th><th>table</th><th>bytes</th></tr>{state_rows}</table>
 <h2>barrier stages (ms)</h2><table><tr><th>stage</th><th>p50</th><th>p99</th><th>n</th></tr>{stage_rows or '<tr><td>no barriers traced</td></tr>'}</table>
 <h2>dispatch profile (top executors)</h2><table><tr><th>executor</th><th>host ms</th><th>device-wait ms</th><th>dispatches</th></tr>{prof_rows or '<tr><td>profiler not armed (RW_PROFILE=1)</td></tr>'}</table>
+<h2>black box &amp; device sentinel</h2><table>{bb_rows or '<tr><td>blackbox unavailable</td></tr>'}</table>
 <h2>resilience</h2><table><tr><th>metric</th><th>labels</th><th>value</th></tr>{res_rows or '<tr><td>no retries / breakers yet</td></tr>'}</table>
 <h2>events (last 25)</h2><table><tr><th>#</th><th>kind</th><th>detail</th></tr>{event_rows or '<tr><td>none</td></tr>'}</table>
 <p><a href="/metrics">/metrics</a> &middot; <a href="/heap">/heap</a> &middot; <a href="/events">/events</a></p>

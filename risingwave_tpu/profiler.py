@@ -646,6 +646,15 @@ def device_forensics() -> Dict:
         }
     except Exception as e:  # degrade independently, like every section
         out["profiler"] = repr(e)
+    try:
+        # the sentinel's device classification is device evidence too:
+        # a forensic artifact should say whether the heartbeat lane
+        # considered the device ALIVE/SLOW/WEDGED when it was taken
+        from risingwave_tpu.blackbox import SENTINEL
+
+        out["sentinel"] = SENTINEL.snapshot()
+    except Exception as e:
+        out["sentinel"] = repr(e)
     return out
 
 

@@ -1029,14 +1029,55 @@ class GraphRuntime:
         XLA compiles, so device benches raise it via the env var."""
         if timeout is None:
             timeout = _default_barrier_timeout()
+        from risingwave_tpu import blackbox
+
+        deadline = time.perf_counter() + timeout
+        pred = (
+            lambda: self._failure is not None
+            or len(self._collected.get(epoch, ())) == len(self.actors)
+        )
         with self._collect_lock:
             try:
-                ok = self._collect_lock.wait_for(
-                    lambda: self._failure is not None
-                    or len(self._collected.get(epoch, ()))
-                    == len(self.actors),
-                    timeout=timeout,
-                )
+                # sliced wait: the full deadman stands, but an armed
+                # device-wedge sentinel converts the hang into a
+                # structured DeviceWedged within ~a slice instead of
+                # burning the whole barrier timeout (the q7 wedge used
+                # to sit here for 360s and then die evidence-free)
+                while True:
+                    remain = deadline - time.perf_counter()
+                    ok = self._collect_lock.wait_for(
+                        pred, timeout=max(0.0, min(1.0, remain))
+                    )
+                    if ok or remain <= 0:
+                        break
+                    wedged = blackbox.SENTINEL.wedged_error()
+                    if wedged is not None:
+                        got = self._collected.get(epoch, set())
+                        stuck = sorted(
+                            a.actor_name
+                            for a in self.actors
+                            if a.actor_name not in got
+                        )
+                        # forensics on a SIDE thread, raise NOW: the
+                        # dump's device sections (memory_stats, array
+                        # census) can block on the very wedge being
+                        # reported, and it must not do so holding the
+                        # collect lock — fail-fast first, evidence
+                        # best-effort (same arm-first rule the
+                        # sentinel's bundle capture follows)
+                        from risingwave_tpu.epoch_trace import dump_stalls
+
+                        threading.Thread(
+                            target=dump_stalls,
+                            args=(
+                                f"device wedged while barrier {epoch} "
+                                f"awaited {stuck}: {wedged}",
+                            ),
+                            kwargs={"graph": self},
+                            daemon=True,
+                            name="rw-wedge-dump",
+                        ).start()
+                        raise wedged
                 if self._failure is not None:
                     raise RuntimeError("actor failed") from self._failure
                 if not ok:

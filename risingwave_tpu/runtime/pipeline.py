@@ -22,6 +22,7 @@ from typing import List, Optional, Sequence
 
 from risingwave_tpu.analysis.jax_sanitizer import SIGNATURES, transfer_guard
 from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.blackbox import RECORDER
 from risingwave_tpu.executors.base import Barrier, Epoch, Executor, Watermark
 from risingwave_tpu.profiler import PROFILER
 
@@ -120,8 +121,15 @@ class Pipeline:
         # device fence
         from risingwave_tpu.epoch_trace import record_stage
 
+        t2 = time.perf_counter()
         record_stage("dispatch", (t1 - t0) * 1e3)
-        record_stage("device_step", (time.perf_counter() - t1) * 1e3)
+        record_stage("device_step", (t2 - t1) * 1e3)
+        # standalone pipelines (bench drivers, tests) feed the black
+        # box directly — a runtime-driven barrier records via its
+        # EpochTrace instead
+        RECORDER.record_pipeline_barrier(
+            self._epoch, (t1 - t0) * 1e3, (t2 - t1) * 1e3
+        )
         return pending
 
     def watermark(self, column: str, value: int) -> List[StreamChunk]:
@@ -219,8 +227,12 @@ class TwoInputPipeline:
                     ex.finish_barrier()
         from risingwave_tpu.epoch_trace import record_stage
 
+        t2 = time.perf_counter()
         record_stage("dispatch", (t1 - t0) * 1e3)
-        record_stage("device_step", (time.perf_counter() - t1) * 1e3)
+        record_stage("device_step", (t2 - t1) * 1e3)
+        RECORDER.record_pipeline_barrier(
+            self._epoch, (t1 - t0) * 1e3, (t2 - t1) * 1e3
+        )
         return outs
 
     def _generated_watermarks(self) -> List[StreamChunk]:

@@ -39,6 +39,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from risingwave_tpu import blackbox
 from risingwave_tpu import utils_sync_point as sync_point
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.epoch_trace import EpochTrace, chunk_nbytes, dump_stalls
@@ -98,6 +99,11 @@ class StreamingRuntime:
             # [profiler] section arms the dispatch-wall profiler for
             # the process (env RW_PROFILE_* wins inside configure)
             PROFILER.configure(prof)
+        bb = getattr(cfg, "blackbox", None)
+        if bb is not None:
+            # [blackbox] section arms the flight recorder's segment
+            # persistence and/or the device sentinel (env wins inside)
+            blackbox.configure(bb)
         return cls(
             store,
             barrier_interval_ms=cfg.system.barrier_interval_ms,
@@ -132,6 +138,8 @@ class StreamingRuntime:
         # (serve without --config, compute_node, direct construction),
         # not only from_config; a no-op when the env var is unset
         PROFILER.from_env()
+        # same contract for the black box (RW_BLACKBOX_*)
+        blackbox.from_env()
         # state >> HBM control (the reference's LRU memory controller,
         # src/compute/src/memory/controller.rs role): when accounted
         # device state exceeds the budget, fully-durable groups are
@@ -662,6 +670,14 @@ class StreamingRuntime:
         # jax.profiler session surviving a recovery would hold the
         # device and poison the next capture (watchdog-orphan audit)
         PROFILER.abort_captures()
+        # a DeviceWedged is handled like an actor fault, not a crash:
+        # abort the sentinel's capture window and disarm the wedge so
+        # the recovered runtime's next barrier proceeds — a device that
+        # is STILL wedged re-arms on the next missed heartbeat, and the
+        # consecutive-recovery ladder surfaces it as deterministic
+        blackbox.SENTINEL.abort_capture()
+        if isinstance(cause, blackbox.DeviceWedged):
+            blackbox.SENTINEL.clear_wedge()
         # a latched capacity overflow needs the full path's grow-and-
         # replay cure; everything else may be partial-eligible
         latched = any(
@@ -921,25 +937,28 @@ class StreamingRuntime:
             if ev[0] == "barrier" and ev[1] <= covered:
                 start = i + 1
         replayed = 0
-        for ev in log[start:]:
-            if ev[0] == "push":
-                _k, chunk, side = ev
-                if side == "left":
-                    p.push_left(chunk)
-                elif side == "right":
-                    p.push_right(chunk)
-                elif side == "both":
-                    p.push_left(chunk)
-                    p.push_right(chunk)
+        # replay re-runs ALREADY-SEEN epochs: recording them would
+        # break the black box's monotonic timeline — suppress
+        with blackbox.RECORDER.suppress_pipeline_records():
+            for ev in log[start:]:
+                if ev[0] == "push":
+                    _k, chunk, side = ev
+                    if side == "left":
+                        p.push_left(chunk)
+                    elif side == "right":
+                        p.push_right(chunk)
+                    elif side == "both":
+                        p.push_left(chunk)
+                        p.push_right(chunk)
+                    else:
+                        p.push(chunk)
+                    replayed += 1
                 else:
-                    p.push(chunk)
-                replayed += 1
-            else:
-                _k, epoch, _ck = ev
-                # mutation-style rejoin boundary: the rebuilt subtree
-                # re-aligns at the SAME epoch fence the healthy graph
-                # already passed
-                p.barrier(checkpoint=False, epoch=epoch)
+                    _k, epoch, _ck = ev
+                    # mutation-style rejoin boundary: the rebuilt
+                    # subtree re-aligns at the SAME epoch fence the
+                    # healthy graph already passed
+                    p.barrier(checkpoint=False, epoch=epoch)
         if replayed or start < len(log):
             REGISTRY.counter("replay_events_total").inc(
                 len(log) - start, fragment=name
@@ -1144,6 +1163,12 @@ class StreamingRuntime:
         return float(np.percentile(self.epoch_close_ms, 99))
 
     def _barrier_locked(self) -> Dict[str, List[StreamChunk]]:
+        # device-wedge fail-fast: an armed sentinel wedge raises the
+        # structured DeviceWedged HERE instead of letting the barrier
+        # walk dispatch into a dead device and hang until an outer
+        # alarm (the q7 wedge path); auto_recover routes it like any
+        # other barrier fault
+        blackbox.SENTINEL.check()
         # degraded-mode probe rides the barrier clock: the breaker's
         # cooldown gates actual store touches, so a down store costs
         # nothing per barrier and a healed one replays the spill here
@@ -1164,27 +1189,36 @@ class StreamingRuntime:
         pending = self._pending_partial
         # registration order is topological (downstreams register after
         # their upstream), so an upstream's barrier-flush deltas reach a
-        # subscriber BEFORE the subscriber's own barrier runs
-        for name, p in self.fragments.items():
-            if pending is not None and name in pending["scope"]:
-                continue  # fenced: the deferred recovery owns this subtree
-            p._epoch = prev  # fragments share the runtime's clock
-            # non-checkpoint barriers must NOT commit sinks (exactly-
-            # once: sink commits may never run ahead of durability);
-            # the runtime's epoch is passed down so held sink batches
-            # key by the exact epoch _commit/_on_epoch_durable will use
-            tf = time.perf_counter()
-            with span(
-                "barrier.fragment", fragment=name, epoch=self._epoch
-            ), PROFILER.barrier_window(fragment=name):
-                outs[name] = p.barrier(checkpoint=is_ckpt, epoch=self._epoch)
-            self._route(name, outs[name])
-            # replay-buffer epoch fence: everything recorded before this
-            # marker belongs to epochs <= self._epoch for this fragment
-            self._record_barrier(name, self._epoch, is_ckpt)
-            tr.add_stage(
-                "dispatch", (time.perf_counter() - tf) * 1e3, fragment=name
-            )
+        # subscriber BEFORE the subscriber's own barrier runs.
+        # Suppression spans the whole walk: this barrier records ONCE
+        # via its EpochTrace in _end_trace, not per fragment pipeline
+        with blackbox.RECORDER.suppress_pipeline_records():
+            for name, p in self.fragments.items():
+                if pending is not None and name in pending["scope"]:
+                    continue  # fenced: deferred recovery owns this subtree
+                p._epoch = prev  # fragments share the runtime's clock
+                # non-checkpoint barriers must NOT commit sinks
+                # (exactly-once: sink commits may never run ahead of
+                # durability); the runtime's epoch is passed down so
+                # held sink batches key by the exact epoch
+                # _commit/_on_epoch_durable will use
+                tf = time.perf_counter()
+                with span(
+                    "barrier.fragment", fragment=name, epoch=self._epoch
+                ), PROFILER.barrier_window(fragment=name):
+                    outs[name] = p.barrier(
+                        checkpoint=is_ckpt, epoch=self._epoch
+                    )
+                self._route(name, outs[name])
+                # replay-buffer epoch fence: everything recorded before
+                # this marker belongs to epochs <= self._epoch for this
+                # fragment
+                self._record_barrier(name, self._epoch, is_ckpt)
+                tr.add_stage(
+                    "dispatch",
+                    (time.perf_counter() - tf) * 1e3,
+                    fragment=name,
+                )
         if is_ckpt:
             self._commit(self._epoch, tr)
         if self.memory_budget_bytes is not None:
@@ -1219,6 +1253,9 @@ class StreamingRuntime:
         self._prev_state_bytes = state_bytes
         self.epoch_traces.append(tr)
         self.last_epoch_trace = tr
+        # flight recorder: the finalized trace is exactly one black-box
+        # record (ring always; segment file when a dir is configured)
+        blackbox.RECORDER.record_barrier(tr, runtime=self)
         if tr.checkpoint:
             EVENT_LOG.record(
                 "barrier_commit",
@@ -1577,6 +1614,8 @@ class StreamingRuntime:
             raise RuntimeError("no object store configured")
         # manual recovery mirrors the auto path's capture hygiene
         PROFILER.abort_captures()
+        blackbox.SENTINEL.abort_capture()
+        blackbox.SENTINEL.clear_wedge()
         if fragments is not None:
             scope = set(fragments)
             unknown = scope - set(self.fragments)

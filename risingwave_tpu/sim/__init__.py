@@ -24,14 +24,20 @@ from risingwave_tpu.sim.chaos import (
     FlakyStore,
     chaos_seed,
 )
+from risingwave_tpu.sim.fake_device import (
+    BlockingKernelExecutor,
+    WedgeableDevice,
+)
 
 __all__ = [
     "ActorChaosRunner",
     "ActorCrash",
+    "BlockingKernelExecutor",
     "ChaosRunner",
     "CrashPoint",
     "CrashingExecutor",
     "CrashingStore",
     "FlakyStore",
+    "WedgeableDevice",
     "chaos_seed",
 ]

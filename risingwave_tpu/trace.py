@@ -135,85 +135,7 @@ class Tracer:
         events (ph:"s"/"t") linking one barrier across actor threads."""
         with self._lock:
             events = list(self._events)
-        # the ring appends at span COMPLETION; flow binding needs start
-        # order so the "s" (first) event of an epoch precedes its "t"s
-        events.sort(key=lambda e: e[2])
-        out = []
-        # pid lanes: 1 = host/unattributed; each fragment its own pid
-        frag_pids: dict = {}
-        pids_seen = {1}
-        tids_by_pid: dict = {}  # pid -> set(tid)
-        epochs_seen: dict = {}  # epoch -> first-event flag
-        for name, tid, t0, dur, args in events:
-            pid = 1
-            if args and "fragment" in args:
-                frag = str(args["fragment"])
-                pid = frag_pids.setdefault(frag, 2 + len(frag_pids))
-                pids_seen.add(pid)
-            tids_by_pid.setdefault(pid, set()).add(tid)
-            ev = {
-                "name": name,
-                "ph": "X",
-                "pid": pid,
-                "tid": tid,
-                "ts": t0 * 1e6,
-                "dur": dur * 1e6,
-            }
-            if args:
-                ev["args"] = args
-            out.append(ev)
-            epoch = (args or {}).get("epoch")
-            if epoch is not None:
-                # flow arrows: first span of the epoch starts the flow,
-                # every later span binds to it (enclosing-slice binding)
-                first = epoch not in epochs_seen
-                epochs_seen[epoch] = True
-                out.append(
-                    {
-                        "name": f"epoch {epoch}",
-                        "cat": "epoch",
-                        # string id: epochs are ms<<16, so truncating
-                        # to 32 bits would alias barriers ~65s apart
-                        # into one bogus flow chain
-                        "ph": "s" if first else "t",
-                        "id": str(epoch),
-                        "pid": pid,
-                        "tid": tid,
-                        "ts": t0 * 1e6,
-                        "bp": "e",
-                    }
-                )
-        # metadata: process names (fragment lanes) + thread names
-        names = _thread_names()
-        meta = [
-            {
-                "name": "process_name",
-                "ph": "M",
-                "pid": 1,
-                "args": {"name": "host"},
-            }
-        ]
-        for frag, pid in sorted(frag_pids.items(), key=lambda kv: kv[1]):
-            meta.append(
-                {
-                    "name": "process_name",
-                    "ph": "M",
-                    "pid": pid,
-                    "args": {"name": f"fragment:{frag}"},
-                }
-            )
-        for pid in sorted(pids_seen):
-            for tid in sorted(tids_by_pid.get(pid, ())):
-                meta.append(
-                    {
-                        "name": "thread_name",
-                        "ph": "M",
-                        "pid": pid,
-                        "tid": tid,
-                        "args": {"name": names.get(tid, f"thread-{tid}")},
-                    }
-                )
-        return json.dumps({"traceEvents": meta + out})
+        return render_chrome_trace(events, _thread_names())
 
     def dump(self, path: str) -> None:
         with open(path, "w") as f:
@@ -222,6 +144,92 @@ class Tracer:
     def clear(self) -> None:
         with self._lock:
             self._events.clear()
+
+
+def render_chrome_trace(events, thread_names=None) -> str:
+    """Render ``(name, tid, t0, dur, args)`` event tuples as chrome://
+    tracing / Perfetto JSON. Shared by the live Tracer ring and
+    offline renderers (the black-box reader CLI reconstructs barrier
+    timelines from a crash-surviving segment through this same path)."""
+    names = dict(thread_names or {})
+    # the ring appends at span COMPLETION; flow binding needs start
+    # order so the "s" (first) event of an epoch precedes its "t"s
+    events = sorted(events, key=lambda e: e[2])
+    out = []
+    # pid lanes: 1 = host/unattributed; each fragment its own pid
+    frag_pids: dict = {}
+    pids_seen = {1}
+    tids_by_pid: dict = {}  # pid -> set(tid)
+    epochs_seen: dict = {}  # epoch -> first-event flag
+    for name, tid, t0, dur, args in events:
+        pid = 1
+        if args and "fragment" in args:
+            frag = str(args["fragment"])
+            pid = frag_pids.setdefault(frag, 2 + len(frag_pids))
+            pids_seen.add(pid)
+        tids_by_pid.setdefault(pid, set()).add(tid)
+        ev = {
+            "name": name,
+            "ph": "X",
+            "pid": pid,
+            "tid": tid,
+            "ts": t0 * 1e6,
+            "dur": dur * 1e6,
+        }
+        if args:
+            ev["args"] = args
+        out.append(ev)
+        epoch = (args or {}).get("epoch")
+        if epoch is not None:
+            # flow arrows: first span of the epoch starts the flow,
+            # every later span binds to it (enclosing-slice binding)
+            first = epoch not in epochs_seen
+            epochs_seen[epoch] = True
+            out.append(
+                {
+                    "name": f"epoch {epoch}",
+                    "cat": "epoch",
+                    # string id: epochs are ms<<16, so truncating
+                    # to 32 bits would alias barriers ~65s apart
+                    # into one bogus flow chain
+                    "ph": "s" if first else "t",
+                    "id": str(epoch),
+                    "pid": pid,
+                    "tid": tid,
+                    "ts": t0 * 1e6,
+                    "bp": "e",
+                }
+            )
+    # metadata: process names (fragment lanes) + thread names
+    meta = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": 1,
+            "args": {"name": "host"},
+        }
+    ]
+    for frag, pid in sorted(frag_pids.items(), key=lambda kv: kv[1]):
+        meta.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "args": {"name": f"fragment:{frag}"},
+            }
+        )
+    for pid in sorted(pids_seen):
+        for tid in sorted(tids_by_pid.get(pid, ())):
+            meta.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {"name": names.get(tid, f"thread-{tid}")},
+                }
+            )
+    return json.dumps({"traceEvents": meta + out})
 
 
 TRACER = Tracer()

@@ -4,9 +4,15 @@ The tunnel wedges for hours and revives unpredictably (r05 log: two OK
 probes at 01:03/01:18 between dead stretches); a human-paced check
 misses those windows. This watcher polls the probe monitor's
 ``.tpu_healthy`` marker every 45s and launches ``python bench.py``
-(which banks every success to BENCH_partial.json immediately and
-maintains ``.bench_running`` so the prober stands down) as soon as the
-marker appears. Results are left on disk for the builder to commit;
+(which banks every success to BENCH_partial.json + per-query
+BENCH_<q>.json immediately and maintains ``.bench_running`` so the
+prober stands down) as soon as the marker appears.
+
+While a bench runs, the watcher TAILS the child's wedge-sentinel
+heartbeats (the SENTINEL_STATE.json status file bench children rewrite
+every beat) into BENCH_WATCH.log — so the round log shows the device's
+ALIVE/SLOW/WEDGED trajectory even when the child is later killed and
+its stdout lost. Results are left on disk for the builder to commit;
 BENCH_WATCH.log records every attempt either way.
 
 Usage: python scripts/bench_on_healthy.py  (backgrounded, SIGTERM-safe)
@@ -15,6 +21,7 @@ Usage: python scripts/bench_on_healthy.py  (backgrounded, SIGTERM-safe)
 from __future__ import annotations
 
 import datetime
+import json
 import os
 import subprocess
 import sys
@@ -24,7 +31,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 MARKER = os.path.join(REPO, ".tpu_healthy")
 BUSY = os.path.join(REPO, ".bench_running")
 LOG = os.path.join(REPO, "BENCH_WATCH.log")
+SENTINEL_STATE = os.path.join(REPO, "SENTINEL_STATE.json")
 COOLDOWN_S = 1800  # after a bench attempt, let the prober re-establish
+HEARTBEAT_POLL_S = 15
 
 
 def log(msg: str) -> None:
@@ -36,21 +45,63 @@ def log(msg: str) -> None:
     print(msg, flush=True)
 
 
+def tail_sentinel(last: dict) -> dict:
+    """One poll of the bench child's sentinel status file; logs state
+    transitions (always) and a periodic pulse (every ~60s) so the
+    round log carries the heartbeat trajectory. Returns updated
+    bookkeeping. Never raises — the watcher outlives a torn file."""
+    try:
+        with open(SENTINEL_STATE) as f:
+            st = json.load(f)
+    except (OSError, ValueError):
+        return last
+    if st.get("ts") == last.get("ts"):
+        return last  # stale: child not beating (compiling, or gone)
+    state = st.get("state", "?")
+    changed = state != last.get("state")
+    pulse = time.monotonic() - last.get("logged_at", 0.0) >= 60
+    if changed or pulse:
+        log(
+            f"sentinel: {state} latency={st.get('latency_ms')}ms "
+            f"beats={st.get('beats')} wedges={st.get('wedges')}"
+            + (" [transition]" if changed else "")
+        )
+        last = dict(st, logged_at=time.monotonic())
+    else:
+        last = dict(last, ts=st.get("ts"))
+    return last
+
+
+def run_bench() -> int:
+    """Launch bench.py and babysit it: poll + tail the sentinel status
+    while it runs; SIGTERM (never SIGKILL — a murdered client wedges
+    the relay) at the 90min backstop."""
+    t0 = time.monotonic()
+    proc = subprocess.Popen([sys.executable, "bench.py"], cwd=REPO)
+    last: dict = {}
+    while True:
+        rc = proc.poll()
+        if rc is not None:
+            return rc
+        if time.monotonic() - t0 > 5400:
+            log("bench.py exceeded 90min backstop (SIGTERMed)")
+            proc.terminate()
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                pass  # an orphan that eventually exits beats a SIGKILL
+            return -15
+        last = tail_sentinel(last)
+        time.sleep(HEARTBEAT_POLL_S)
+
+
 def main() -> None:
     log("watcher up")
     while True:
         if os.path.exists(MARKER) and not os.path.exists(BUSY):
             log("tunnel healthy -> launching bench.py")
             t0 = time.monotonic()
-            try:
-                rc = subprocess.call(
-                    [sys.executable, "bench.py"], cwd=REPO, timeout=5400
-                )
-            except subprocess.TimeoutExpired:
-                # bench.py budgets itself; this is a backstop. SIGTERM
-                # only (a SIGKILLed tunnel client wedges the relay).
-                log("bench.py exceeded 90min backstop (SIGTERMed)")
-                rc = -15
+            rc = run_bench()
             log(
                 f"bench.py exited rc={rc} after "
                 f"{time.monotonic() - t0:.0f}s — check BENCH_partial.json"

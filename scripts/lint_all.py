@@ -15,11 +15,13 @@ Stages (all must pass; exit code is the OR of their failures):
 3. ``python -m risingwave_tpu lint --all-nexmark --fusion-report`` —
    the fusion-feasibility analyzer: per-fragment fusible prefixes +
    RW-E8xx blockers with provenance.
-4. ``python scripts/perf_gate.py --smoke --fusion`` — the dispatch-cost
-   regression gate: committed BENCH artifacts vs
+4. ``python scripts/perf_gate.py --smoke --blackbox --fusion`` — the
+   dispatch-cost regression gate: committed BENCH artifacts vs
    scripts/perf_budgets.json, the CPU q5 steady-state microbench
-   (bounded device dispatches/barrier + host-python ms/row), and the
-   fusion ratchet vs FUSION_REPORT.json (fusible prefixes must not
+   (bounded device dispatches/barrier + host-python ms/row), the
+   black-box recorder gate (host ms/barrier + fsync-stall budgets, and
+   the write-ring -> SIGKILL -> reader-CLI crash-survival smoke), and
+   the fusion ratchet vs FUSION_REPORT.json (fusible prefixes must not
    shrink, host-sync counts must not grow).
 """
 
@@ -177,11 +179,11 @@ def stage_fusion_report(out_path: str) -> int:
 
 
 def stage_perf_gate(fusion_current: str = None) -> int:
-    print("[lint_all] perf_gate --smoke + fusion ratchet "
-          "(dispatch-cost + fusion-regression budgets)")
+    print("[lint_all] perf_gate --smoke --blackbox + fusion ratchet "
+          "(dispatch-cost + recorder/fsync + fusion-regression budgets)")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     cmd = [sys.executable, os.path.join(ROOT, "scripts", "perf_gate.py"),
-           "--smoke"]
+           "--smoke", "--blackbox"]
     if fusion_current and os.path.exists(fusion_current):
         cmd += ["--fusion-current", fusion_current]
     else:

@@ -259,6 +259,154 @@ def run_fusion_gate(
 
 
 # ---------------------------------------------------------------------------
+# mode 4: black-box recorder gate (host cost + crash-survival smoke)
+# ---------------------------------------------------------------------------
+
+
+def run_blackbox_gate(budgets: dict):
+    """Two checks so the black box can never silently rot:
+
+    1. Recorder cost microbench: N records through the REAL
+       record+persist path (worst case: fsync every record) — the host
+       ms/barrier the recorder adds and the fsync-stall p99 must stay
+       under ``blackbox.host_ms_per_barrier_max`` /
+       ``fsync_p99_ms_max`` (the recorder rides EVERY barrier; the
+       <1%-of-steady-barrier contract from PROFILE.md round 10).
+    2. Reader smoke (write ring -> kill -> parse): a subprocess writes
+       a segment in a loop, the parent SIGKILLs it mid-write (safe: a
+       CPU-pinned process, not a tunnel client) and the reader CLI
+       must still reconstruct a monotonic timeline.
+
+    Returns (violations, report)."""
+    import signal
+    import subprocess
+    import tempfile
+    import time
+    from types import SimpleNamespace
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if ROOT not in sys.path:
+        sys.path.insert(0, ROOT)
+    from risingwave_tpu.blackbox import FlightRecorder, read_segment
+    from risingwave_tpu.metrics import REGISTRY
+
+    bb = budgets.get("blackbox", {})
+    violations = []
+    report = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        rec = FlightRecorder()
+        rec.configure(dir=tmp, fsync_interval_s=0.0)  # worst case
+        REGISTRY.histograms.pop("blackbox_fsync_ms", None)
+        n = 300
+        t0 = time.perf_counter()
+        for i in range(n):
+            rec.record_barrier(
+                SimpleNamespace(
+                    epoch=i + 1,
+                    seq=i + 1,
+                    checkpoint=i % 4 == 0,
+                    wall_ms=10.0,
+                    stages_ms={"ingest": 1.0, "dispatch": 8.0},
+                    achieved_bw_frac=0.01,
+                    chunk_bytes=1 << 20,
+                    state_bytes=1 << 22,
+                )
+            )
+        ms_per_rec = (time.perf_counter() - t0) / n * 1e3
+        rec.close()
+        h = REGISTRY.histograms.get("blackbox_fsync_ms")
+        fsync_p99 = h.percentile(99) if h is not None else 0.0
+        report["host_ms_per_barrier"] = round(ms_per_rec, 4)
+        report["fsync_p99_ms"] = round(fsync_p99, 3)
+        mx = bb.get("host_ms_per_barrier_max")
+        if mx is not None and ms_per_rec > mx:
+            violations.append(
+                f"blackbox: {ms_per_rec:.3f} recorder ms/barrier > "
+                f"budget {mx} (the recorder rides EVERY barrier)"
+            )
+        mx = bb.get("fsync_p99_ms_max")
+        if mx is not None and fsync_p99 > mx:
+            violations.append(
+                f"blackbox: fsync stall p99 {fsync_p99:.1f}ms > budget {mx}"
+            )
+        doc = read_segment(tmp)
+        if len(doc["records"]) != n or not doc["monotonic"]:
+            violations.append(
+                f"blackbox: clean segment misparsed "
+                f"({len(doc['records'])}/{n} records, "
+                f"monotonic={doc['monotonic']})"
+            )
+    # -- reader smoke: write ring -> SIGKILL -> parse --------------------
+    with tempfile.TemporaryDirectory() as tmp:
+        child_code = (
+            "import os, sys\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "sys.path.insert(0, %r)\n"
+            "from types import SimpleNamespace\n"
+            "from risingwave_tpu.blackbox import FlightRecorder\n"
+            "rec = FlightRecorder()\n"
+            "rec.configure(dir=%r, fsync_interval_s=0.1)\n"
+            "i = 0\n"
+            "while True:\n"
+            "    i += 1\n"
+            "    rec.record_barrier(SimpleNamespace(\n"
+            "        epoch=i, seq=i, checkpoint=False, wall_ms=1.0,\n"
+            "        stages_ms={'dispatch': 1.0}, achieved_bw_frac=0,\n"
+            "        chunk_bytes=0, state_bytes=0))\n"
+            "    if i == 40:\n"
+            "        print('WROTE40', flush=True)\n"
+        ) % (ROOT, tmp)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-c", child_code],
+            stdout=subprocess.PIPE,
+            text=True,
+            env=env,
+        )
+        try:
+            line = proc.stdout.readline()
+            # mid-write murder: exactly the r04/r05 failure mode
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+        except Exception:
+            proc.kill()
+            proc.wait(timeout=10)
+            line = ""
+        if "WROTE40" not in line:
+            violations.append("blackbox: reader-smoke child never wrote")
+        else:
+            cli = subprocess.run(
+                [
+                    sys.executable,
+                    "-m",
+                    "risingwave_tpu",
+                    "blackbox",
+                    tmp,
+                    "--json",
+                ],
+                capture_output=True,
+                text=True,
+                env=env,
+                cwd=ROOT,
+            )
+            ok = False
+            if cli.returncode == 0:
+                try:
+                    doc = json.loads(cli.stdout.strip().splitlines()[-1])
+                    ok = doc["monotonic"] and len(doc["records"]) >= 40
+                    report["killed_segment_records"] = len(doc["records"])
+                except (ValueError, KeyError, IndexError):
+                    ok = False
+            if not ok:
+                violations.append(
+                    "blackbox: reader CLI failed to reconstruct a "
+                    f"SIGKILLed segment (rc={cli.returncode}, "
+                    f"stderr={cli.stderr[-200:]!r})"
+                )
+    return violations, report
+
+
+# ---------------------------------------------------------------------------
 # mode 2: steady-state smoke microbench (CPU, in-process)
 # ---------------------------------------------------------------------------
 
@@ -357,6 +505,12 @@ def main(argv=None) -> int:
         help="baseline report (default: FUSION_REPORT.json)",
     )
     ap.add_argument(
+        "--blackbox",
+        action="store_true",
+        help="gate the flight recorder: host ms/barrier + fsync-stall "
+        "budgets, and the write-ring -> SIGKILL -> reader-CLI smoke",
+    )
+    ap.add_argument(
         "--fusion-current",
         default=None,
         help="reuse an existing `lint --fusion-report --json` output "
@@ -373,6 +527,10 @@ def main(argv=None) -> int:
     if args.smoke:
         v, report = run_smoke(budgets)
         print(f"[perf_gate] smoke: {json.dumps(report)}")
+        violations += v
+    if args.blackbox:
+        v, report = run_blackbox_gate(budgets)
+        print(f"[perf_gate] blackbox: {json.dumps(report)}")
         violations += v
     if args.fusion or args.fusion_current:
         v, skipped = run_fusion_gate(

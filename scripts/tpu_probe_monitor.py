@@ -7,6 +7,11 @@ probes the tunnel all round on a gentle cadence and leaves a forensic
 trail either way:
 
   - TPU_PROBE_r05.log   — timestamped probe results for the whole round
+  - TPU_PROBE_events.jsonl — ``device_state`` transition events (the
+                          meta event-log spill; the same ALIVE/SLOW/
+                          WEDGED vocabulary the in-process blackbox
+                          sentinel uses, so an operator can splice both
+                          timelines)
   - .tpu_healthy        — marker file (touched when the last probe passed,
                           removed when it failed) so the builder can react
 
@@ -26,10 +31,25 @@ import subprocess
 import sys
 import time
 
+# this process never touches the device itself (probes are fresh
+# subprocesses); pin its own jax to CPU so importing risingwave_tpu
+# (for the shared blackbox classification + event log) cannot grab the
+# single-client tunnel. The PROBE children must NOT inherit the pin —
+# a CPU-pinned probe always "passes" and would green-light bench
+# rounds against a dead tunnel — so remember the original value and
+# restore it in their env (probe_once).
+_ORIG_JAX_PLATFORMS = os.environ.get("JAX_PLATFORMS")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(REPO, "TPU_PROBE_r05.log")
+EVENTS = os.path.join(REPO, "TPU_PROBE_events.jsonl")
 MARKER = os.path.join(REPO, ".tpu_healthy")
 BUSY = os.path.join(REPO, ".bench_running")
+
+# a completed probe slower than this is a congested (SLOW) tunnel —
+# same threshold family as the in-process sentinel's slow_ms
+SLOW_PROBE_S = 30.0
 
 
 def probe_once(timeout_s: int = 90) -> tuple[bool, float, str]:
@@ -42,6 +62,12 @@ def probe_once(timeout_s: int = 90) -> tuple[bool, float, str]:
         "d = jax.devices()\n"
         "print(len(d), d[0].platform)\n"
     )
+    # the child probes the REAL platform: undo this process's CPU pin
+    env = dict(os.environ)
+    if _ORIG_JAX_PLATFORMS is None:
+        env.pop("JAX_PLATFORMS", None)
+    else:
+        env["JAX_PLATFORMS"] = _ORIG_JAX_PLATFORMS
     t0 = time.monotonic()
     proc = subprocess.Popen(
         [sys.executable, "-c", code],
@@ -49,6 +75,7 @@ def probe_once(timeout_s: int = 90) -> tuple[bool, float, str]:
         stderr=subprocess.DEVNULL,
         text=True,
         cwd=REPO,
+        env=env,
     )
     try:
         out, _ = proc.communicate(timeout=timeout_s + 15)
@@ -63,6 +90,49 @@ def probe_once(timeout_s: int = 90) -> tuple[bool, float, str]:
         except subprocess.TimeoutExpired:
             pass
         return False, time.monotonic() - t0, "hang (SIGTERMed)"
+
+
+def classify(ok: bool, dt: float, timeout_s: int) -> str:
+    """Map a probe result onto the sentinel's ALIVE/SLOW/WEDGED states
+    (blackbox.classify_latency — ONE vocabulary for both observers)."""
+    from risingwave_tpu.blackbox import classify_latency
+
+    return classify_latency(
+        dt * 1e3 if ok else None, SLOW_PROBE_S * 1e3, timeout_s * 1e3
+    )
+
+
+_LAST_STATE = ["UNKNOWN"]
+
+
+def record_transition(state: str, dt: float, detail: str) -> None:
+    """Emit a ``device_state`` event into the meta event log on every
+    transition (ring + JSONL spill -> TPU_PROBE_events.jsonl; `/events`
+    and the dashboard pick these up when the monitor shares a process
+    with a served runtime)."""
+    prev = _LAST_STATE[0]
+    if state == prev:
+        return
+    _LAST_STATE[0] = state
+    try:
+        from risingwave_tpu.event_log import EVENT_LOG
+        from risingwave_tpu.metrics import REGISTRY
+
+        if EVENT_LOG.spill_path is None:
+            EVENT_LOG.set_spill(os.environ.get("RW_EVENT_LOG_PATH", EVENTS))
+        EVENT_LOG.record(
+            "device_state",
+            state=state,
+            prev=prev,
+            latency_ms=round(dt * 1e3, 1),
+            detail=detail,
+            source="probe_monitor",
+        )
+        from risingwave_tpu.blackbox import _STATE_GAUGE
+
+        REGISTRY.gauge("device_state").set(_STATE_GAUGE.get(state, -1.0))
+    except Exception:
+        pass  # the probe log is the floor; events are best-effort
 
 
 def dump_stalls(dt: float, detail: str) -> str:
@@ -101,6 +171,16 @@ def dump_stalls(dt: float, detail: str) -> str:
             doc["probe_log_tail"] = f.readlines()[-20:]
     except OSError:
         pass
+    # the bench child's own black box (if the wedging client was ours):
+    # point the reader at the freshest segment + any wedge bundles
+    try:
+        doc["blackbox_artifacts"] = sorted(
+            p
+            for p in os.listdir(REPO)
+            if p.startswith("BLACKBOX_") or p.startswith("WEDGE_")
+        )[-10:]
+    except OSError:
+        pass
     path = os.path.join(REPO, f"STALL_DUMP_probe_{int(time.time())}.json")
     try:
         with open(path, "w") as f:
@@ -110,14 +190,15 @@ def dump_stalls(dt: float, detail: str) -> str:
     return path
 
 
-def log_line(ok: bool, dt: float, detail: str) -> None:
+def log_line(state: str, dt: float, detail: str) -> None:
     stamp = datetime.datetime.now(datetime.timezone.utc).isoformat(
         timespec="seconds"
     )
-    line = f"{stamp} {'OK' if ok else 'DEAD'} {dt:.1f}s {detail}\n"
+    line = f"{stamp} {state} {dt:.1f}s {detail}\n"
     with open(LOG, "a") as f:
         f.write(line)
-    if ok:
+    if state in ("ALIVE", "SLOW"):
+        # the device answers (possibly slowly): bench can run
         with open(MARKER, "w") as f:
             f.write(stamp + "\n")
     elif os.path.exists(MARKER):
@@ -143,15 +224,14 @@ def main() -> None:
             print("probe: BUSY (bench running)", flush=True)
         else:
             ok, dt, detail = probe_once(args.timeout)
-            log_line(ok, dt, detail)
-            if not ok:
+            state = classify(ok, dt, args.timeout)
+            log_line(state, dt, detail)
+            record_transition(state, dt, detail)
+            if state == "WEDGED":
                 path = dump_stalls(dt, detail)
                 if path:
                     print(f"probe: stall dump -> {path}", flush=True)
-            print(
-                f"probe: {'OK' if ok else 'DEAD'} ({dt:.1f}s) {detail}",
-                flush=True,
-            )
+            print(f"probe: {state} ({dt:.1f}s) {detail}", flush=True)
         if args.once:
             break
         time.sleep(args.interval)

@@ -285,9 +285,19 @@ def test_actor_kill_chaos_converges_to_undisturbed():
 
     # profiler armed with an open capture across the storm: partial
     # recovery must close it (orphan-window audit, extends the PR-5
-    # watchdog audit to profiler capture sessions)
+    # watchdog audit to profiler capture sessions); the blackbox
+    # sentinel rides the same storm — actor kills must neither arm a
+    # spurious wedge nor orphan its capture window (PR 8 audit)
+    from risingwave_tpu import blackbox
+
     PROFILER.enable(fence=False)
     PROFILER.start_capture(tag="chaos-audit")
+    saved_sentinel = blackbox.SENTINEL  # fresh instance: no config leak
+    blackbox.SENTINEL = blackbox.DeviceSentinel()
+    blackbox.SENTINEL.start(
+        interval_s=0.05, slow_ms=1e6, deadline_s=5.0,
+        heartbeat_fn=lambda: None,
+    )
     try:
         runner = ActorChaosRunner(
             _ActorKillWorkload, seed=seed, kill_prob=0.45, kill_site="mixed"
@@ -295,9 +305,14 @@ def test_actor_kill_chaos_converges_to_undisturbed():
         obj = runner.run(n_epochs)
         # no orphaned profiler capture windows survived the recoveries
         assert PROFILER.active_captures == []
+        # actor faults are NOT device wedges: nothing armed, no window
+        assert blackbox.SENTINEL.wedged_error() is None
+        assert blackbox.SENTINEL.abort_capture() == 0
     finally:
         PROFILER.disable()
         PROFILER.reset()
+        blackbox.SENTINEL.stop()
+        blackbox.SENTINEL = saved_sentinel
     kills = sum(cp.kills for cp in obj.crash_points)
     assert kills >= 1, (
         f"no actor was ever killed — raise kill_prob (seed={seed})"

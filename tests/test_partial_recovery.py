@@ -630,14 +630,34 @@ def _watchdog_threads():
     ]
 
 
+def _sentinel_threads():
+    return [
+        t
+        for t in threading.enumerate()
+        if t.name.startswith("rw-sentinel") and t.is_alive()
+    ]
+
+
 def test_no_orphan_stall_watchdog_timers_across_recoveries():
     """Every barrier arms a stall-watchdog Timer; success, partial
     recovery, full recovery, AND the escalation raise must all cancel
     it — repeated recoveries may not pile up live timers. Same audit
-    for profiler capture windows: a capture open when the fault fires
-    must be closed by recovery, never orphaned."""
+    for profiler capture windows and (PR 8) the blackbox sentinel: a
+    capture open when the fault fires must be closed by recovery, the
+    sentinel's wedge-capture window must never survive a recovery, and
+    stopping the sentinel must leave no rw-sentinel threads."""
+    from risingwave_tpu import blackbox
     from risingwave_tpu.profiler import PROFILER
 
+    # a healthy sentinel rides across every recovery below — a FRESH
+    # instance swapped in for the singleton, so the tuned heartbeat/
+    # interval never leak into later tests (restored in the finally)
+    saved_sentinel = blackbox.SENTINEL
+    blackbox.SENTINEL = blackbox.DeviceSentinel()
+    blackbox.SENTINEL.start(
+        interval_s=0.05, slow_ms=1e6, deadline_s=5.0,
+        heartbeat_fn=lambda: None,
+    )
     rt = StreamingRuntime(
         MemObjectStore(), async_checkpoint=False, auto_recover=True
     )
@@ -676,8 +696,20 @@ def test_no_orphan_stall_watchdog_timers_across_recoveries():
         # no orphaned profiler capture windows either: the first
         # recovery closed the pre-fault window, none re-opened
         assert PROFILER.active_captures == []
+        # blackbox sentinel audit: recoveries never left a wedge-
+        # capture window open, no spurious wedge was armed, and the
+        # sentinel kept beating across every recovery
+        assert blackbox.SENTINEL.abort_capture() == 0
+        assert blackbox.SENTINEL.wedged_error() is None
+        assert blackbox.SENTINEL.beats > 0
     finally:
         PROFILER.disable()
         PROFILER.reset()
         gpa.close()
         gpb.close()
+        blackbox.SENTINEL.stop()
+        blackbox.SENTINEL = saved_sentinel
+    deadline = time.time() + 5
+    while time.time() < deadline and _sentinel_threads():
+        time.sleep(0.05)
+    assert _sentinel_threads() == []  # stop() reaps sentinel threads
