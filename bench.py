@@ -151,6 +151,62 @@ def _recompile_watch():
     return w
 
 
+_BENCH_GOV = None
+
+
+def _shape_watch_begin():
+    """Arm SignatureWatch BEFORE warmup (the warmup pass registers the
+    legitimate signature set; only post-warmup novelty is a hazard)
+    plus a fresh ShapeGovernor for this query. RW_BENCH_SHAPEWATCH=0
+    opts out."""
+    global _BENCH_GOV
+    import os
+
+    if os.environ.get("RW_BENCH_SHAPEWATCH", "1") == "0":
+        _BENCH_GOV = None
+        return
+    from risingwave_tpu.analysis.jax_sanitizer import SIGNATURES
+    from risingwave_tpu.runtime.bucketing import ShapeGovernor
+
+    SIGNATURES.start()
+    _BENCH_GOV = ShapeGovernor()
+
+
+def _shape_watch_stable():
+    """End of warmup: every later novel abstract input signature is a
+    recompile hazard (the governor may pin on it)."""
+    from risingwave_tpu.analysis.jax_sanitizer import SIGNATURES
+
+    if SIGNATURES.enabled:
+        SIGNATURES.mark_stable()
+
+
+def _shape_fields(prefix, executors):
+    """Steady-state shape evidence for the BENCH JSON: post-warmup
+    recompile-hazard count (perf_gate budget: zero), governor actions,
+    and the padding overhead of the bucketed state buffers
+    (wasted-lane fraction — the price paid for shape stability)."""
+    from risingwave_tpu.analysis.jax_sanitizer import SIGNATURES
+    from risingwave_tpu.runtime.bucketing import padding_stats
+
+    out = {f"{prefix}_padding": padding_stats(executors)}
+    if SIGNATURES.enabled:
+        if _BENCH_GOV is not None:
+            # final sweep so trailing-barrier hazards still pin + count
+            _BENCH_GOV.observe_barrier(list(executors))
+            out[f"{prefix}_shape_governor"] = _BENCH_GOV.snapshot()
+        out[f"{prefix}_recompile_hazards"] = SIGNATURES.hazard_total()
+        SIGNATURES.stop()
+    return out
+
+
+def _governor_tick(executors):
+    """Per-barrier governor hook for the raw-pipeline bench paths (the
+    unified q5u path rides StreamingRuntime's built-in hook)."""
+    if _BENCH_GOV is not None:
+        _BENCH_GOV.observe_barrier(executors)
+
+
 def _profile_begin():
     """Arm the dispatch-wall profiler for the measured run: every BENCH
     JSON carries the per-executor decomposition of the dispatch stage
@@ -266,6 +322,7 @@ def bench_q8(gen_cfg, epochs, events_per_epoch, chunk_events):
     from risingwave_tpu.queries.nexmark_q import Q8_WINDOW_MS, build_q8
 
     fusion = _rwlint_gate("q8")  # static: fail BEFORE the event stream
+    _shape_watch_begin()  # dynamic: warmup registers the legal shapes
     gen = NexmarkGenerator(NexmarkConfig(**gen_cfg))
     host_stream = []  # [(side, cols)] in arrival order, per epoch
     epochs_stream = []
@@ -342,6 +399,7 @@ def bench_q8(gen_cfg, epochs, events_per_epoch, chunk_events):
     q8.pipeline.barrier()
     q8 = build_q8(capacity=c8, fanout=8, out_cap=1 << 14)
     recompiles = _recompile_watch()
+    _shape_watch_stable()  # post-warmup novelty = recompile hazard
     from risingwave_tpu.metrics import REGISTRY
 
     REGISTRY.histograms.pop("barrier_stage_ms", None)  # drop warmup obs
@@ -355,6 +413,9 @@ def bench_q8(gen_cfg, epochs, events_per_epoch, chunk_events):
         tb = time.perf_counter()
         q8.pipeline.barrier()
         barrier_times.append(time.perf_counter() - tb)
+        _governor_tick(
+            list(q8.pipeline.left) + list(q8.pipeline.right) + [q8.join]
+        )
     jax.block_until_ready(q8.join.left.row_valid)
     dt = time.perf_counter() - t0
 
@@ -380,6 +441,13 @@ def bench_q8(gen_cfg, epochs, events_per_epoch, chunk_events):
         "q8_fusion": fusion,
         "q8_barrier_stage_ms": stage_breakdown(),
         **_profile_fields("q8", prof, len(barrier_times), total_rows),
+        **_shape_fields(
+            "q8",
+            list(q8.pipeline.left)
+            + list(q8.pipeline.right)
+            + [q8.join]
+            + list(q8.pipeline.tail),
+        ),
     }
 
 
@@ -421,6 +489,7 @@ def bench_q7(gen_cfg, epochs, events_per_epoch, chunk_events):
     from risingwave_tpu.queries.nexmark_q import build_q7
 
     fusion = _rwlint_gate("q7")  # static: fail BEFORE the event stream
+    _shape_watch_begin()  # dynamic: warmup registers the legal shapes
     window_ms = 10_000
     gen = NexmarkGenerator(NexmarkConfig(**gen_cfg))
     host_epochs = []
@@ -448,6 +517,12 @@ def bench_q7(gen_cfg, epochs, events_per_epoch, chunk_events):
     ]
 
     def run(q7, chunks):
+        execs = (
+            list(q7.pipeline.left)
+            + list(q7.pipeline.right)
+            + [q7.join]
+            + list(q7.pipeline.tail)
+        )
         barrier_times = []
         max_ts = 0
         t0 = time.perf_counter()
@@ -461,6 +536,9 @@ def bench_q7(gen_cfg, epochs, events_per_epoch, chunk_events):
             tb = time.perf_counter()
             q7.pipeline.barrier()
             barrier_times.append(time.perf_counter() - tb)
+            # recompile-storm governor: hazard deltas per barrier; over
+            # budget (or SLOW sentinel) pins the offender's buckets
+            _governor_tick(execs)
             q7.pipeline.watermark("date_time", max_ts)
         jax.block_until_ready(q7.join.left.row_valid)
         return time.perf_counter() - t0, barrier_times
@@ -479,6 +557,7 @@ def bench_q7(gen_cfg, epochs, events_per_epoch, chunk_events):
     run(q7, mk()[:1])  # warmup epoch: compile everything
 
     recompiles = _recompile_watch()
+    _shape_watch_stable()  # post-warmup novelty = recompile hazard
     # build + host->device conversion BEFORE arming the profiler: the
     # measured dispatch/transfer counts describe steady-state barriers,
     # not one-time construction (same protocol as q5/q8)
@@ -512,6 +591,15 @@ def bench_q7(gen_cfg, epochs, events_per_epoch, chunk_events):
         "q7_fusion": fusion,
         "q7_barrier_stage_ms": stage_breakdown(),
         **_profile_fields("q7", prof, len(barrier_times), total_bids),
+        # AFTER profiler disarm: padding stats read device occupancy
+        # counters and must not pollute the steady-state transfer counts
+        **_shape_fields(
+            "q7",
+            list(q7.pipeline.left)
+            + list(q7.pipeline.right)
+            + [q7.join]
+            + list(q7.pipeline.tail),
+        ),
     }
 
 
@@ -562,6 +650,7 @@ def bench_q5_unified(epochs, events_per_epoch, chunk_events, smoke):
         flat, Q5_WINDOW_MS, Q5_SLIDE_MS
     )
 
+    _shape_watch_begin()  # warmup registers the legal shape set
     c5 = _state_cap(2 * events_per_epoch, 1 << 16)
     catalog = Catalog({"bid": BID_SCHEMA})
     factory = lambda: StreamPlanner(catalog, capacity=c5)
@@ -576,6 +665,7 @@ def bench_q5_unified(epochs, events_per_epoch, chunk_events, smoke):
     mv.pipeline.barrier()
     mv.pipeline.close()
     mv = graph_planned_mv(factory, Q5_SQL, parallelism=1)
+    _shape_watch_stable()  # post-warmup novelty = recompile hazard
     # drop warmup-epoch observations (first-epoch compile would
     # dominate the reported per-stage p99 and defeat the breakdown)
     from risingwave_tpu.metrics import REGISTRY
@@ -592,6 +682,7 @@ def bench_q5_unified(epochs, events_per_epoch, chunk_events, smoke):
         tb = time.perf_counter()
         mv.pipeline.barrier()
         barrier_times.append(time.perf_counter() - tb)
+        _governor_tick(list(mv.pipeline.executors))
     dt = time.perf_counter() - t0
     # measured roofline (PROFILE.md "measured vs modeled"): HBM bytes
     # actually moved this run = chunks pushed + live executor state
@@ -613,6 +704,8 @@ def bench_q5_unified(epochs, events_per_epoch, chunk_events, smoke):
     # pipelined phase below runs unprofiled — the breakdown must
     # describe the same run as stages_sync)
     prof_fields = _profile_fields("q5u", prof, len(barrier_times), total_bids)
+    # before close(): padding stats read live executor occupancy
+    shape_fields = _shape_fields("q5u", list(mv.pipeline.executors))
     snap = mv.mview.snapshot()  # {(auction, window_start): (num,)}
     ok = snap == {k: (v,) for k, v in cpu_counts.items()}
     mv.pipeline.close()
@@ -674,6 +767,7 @@ def bench_q5_unified(epochs, events_per_epoch, chunk_events, smoke):
         "hbm_peak_gbps": rf["hbm_peak_gbps"],
         "hbm_bytes_touched": rf["hbm_bytes_touched"],
         **prof_fields,
+        **shape_fields,
     }
 
 
@@ -684,6 +778,7 @@ def bench_q5(args_epochs, events_per_epoch, chunk_events, smoke, agg_mode):
         jax.config.update("jax_platforms", "cpu")
 
     fusion = _rwlint_gate("q5")  # static: fail BEFORE the event stream
+    _shape_watch_begin()  # dynamic: warmup registers the legal shapes
 
     import numpy as np
 
@@ -779,6 +874,7 @@ def bench_q5(args_epochs, events_per_epoch, chunk_events, smoke, agg_mode):
 
     REGISTRY.histograms.pop("barrier_stage_ms", None)  # drop warmup obs
     recompiles = _recompile_watch()
+    _shape_watch_stable()  # post-warmup novelty = recompile hazard
     # build + conversion outside the profiled window (steady-state
     # dispatch counts, not construction)
     stacked = mk_stacked()
@@ -828,6 +924,7 @@ def bench_q5(args_epochs, events_per_epoch, chunk_events, smoke, agg_mode):
         "q5_recompiles": recompiles.deltas(),
         "q5_fusion": fusion,
         **_profile_fields("q5", prof, len(barrier_times), total_bids),
+        **_shape_fields("q5", list(q5.pipeline.executors)),
     }
 
 
@@ -1179,6 +1276,58 @@ def main():
     merged = {}
     errors = []
     dead = False
+    # -- round resume (tunnel-loss recovery; r04/r05 lost everything) --
+    # RW_BENCH_RESUME=1 (set by bench_on_healthy after a failed attempt
+    # of the SAME round): seed `merged` from the queries already banked
+    # to BENCH_<q>.json since the round started, skip their completed
+    # tiers in the schedule, and stamp the final artifact with a
+    # `resumed_from` marker naming what was reused.
+    resume = os.environ.get("RW_BENCH_RESUME", "0") not in ("", "0")
+    try:
+        round_start = float(os.environ.get("RW_BENCH_ROUND_START", "0"))
+    except ValueError:
+        round_start = 0.0
+    if resume and round_start <= 0:
+        # without a round anchor every banked artifact would pass the
+        # freshness check — arbitrarily stale numbers must never be
+        # stamped into today's round; re-measure everything instead
+        print(
+            "RW_BENCH_RESUME set without a valid RW_BENCH_ROUND_START: "
+            "refusing to reuse banked artifacts (re-measuring all)",
+            file=sys.stderr,
+        )
+        resume = False
+    banked: dict = {}
+    if resume:
+        for q in ("q5u", "q5", "q8", "q7"):
+            try:
+                with open(f"BENCH_{q}.json") as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                continue
+            tier_b = doc.get("tier")
+            if tier_b not in TIER_ORDER:
+                continue
+            if round_start and float(doc.get("ts", 0)) < round_start:
+                continue  # a PREVIOUS round's artifact: re-measure
+            banked[q] = tier_b
+            merged.update(
+                {
+                    k: v
+                    for k, v in doc.items()
+                    if k not in ("query", "tier", "ts")
+                }
+            )
+        if banked:
+            merged["resumed_from"] = {
+                "queries": dict(banked),
+                "round_start": round_start,
+            }
+            print(
+                f"resuming round: banked {banked} reused, re-measuring "
+                "the rest",
+                file=sys.stderr,
+            )
     if not args.smoke:
         # tell the round's tunnel-health monitor we legitimately hold
         # the single-client device (it skips probing while this exists)
@@ -1229,6 +1378,10 @@ def main():
     for tier, query in schedule:
         if dead or query in failed:
             continue
+        if query in banked and TIER_ORDER.index(tier) <= TIER_ORDER.index(
+            banked[query]
+        ):
+            continue  # this round already banked the query at >= tier
         # worst case this child costs: its (per-query multiplied)
         # timeout + 45s communicate grace + 30s SIGTERM drain + a 75s
         # post-failure device probe — all before the finalize reserve

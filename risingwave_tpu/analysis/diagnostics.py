@@ -69,6 +69,10 @@ CODES = {
     "HBM",
     "RW-E805": "fused-step jaxpr count exceeds the recompile budget "
     "across the declared chunk-size buckets",
+    "RW-E806": "window-keyed executor declares a window_buckets lattice "
+    "the bucketing layer cannot satisfy (not pow2 / not increasing / "
+    "out of allocator bounds / empty) — the shape-stability proof is "
+    "vacuous",
 }
 
 

@@ -447,7 +447,7 @@ def classify_executor(
             "distinct size compiles a fresh downstream program",
         )
 
-    # -- window bucket lattice (RW-E803, the q7 wedge class) -------------
+    # -- window bucket lattice (RW-E803/E806, the q7 wedge class) --------
     if _is_window_keyed(ex, info):
         wb = contract.get("window_buckets")
         if wb is None:
@@ -457,6 +457,17 @@ def classify_executor(
                 "lattice: state rebuilds/emissions under window churn "
                 "re-trace the fused step without bound",
             )
+        else:
+            from risingwave_tpu.runtime.bucketing import validate_lattice
+
+            why = validate_lattice(wb)
+            if why is not None:
+                blocker(
+                    "RW-E806",
+                    "declared window_buckets lattice is unsatisfiable "
+                    f"by the bucketing layer ({why}): the shape-"
+                    "stability proof is vacuous",
+                )
 
     # -- donation (RW-E804) ----------------------------------------------
     if contract.get("state") is not None and not contract.get(

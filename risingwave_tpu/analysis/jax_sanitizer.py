@@ -261,21 +261,39 @@ class SignatureWatch:
     the warmup window; any NEW signature after that is a recompile
     hazard: the executor's inputs are shape-unstable, so its fused step
     re-traces. Hazards go to ``recompile_hazard_total{executor=...}``,
-    the meta event log, and ``report()`` as RW-E403."""
+    the meta event log, and ``report()`` as RW-E403.
+
+    Novelty is judged per executor CLASS, not per instance: the XLA
+    jit cache keys on (function, abstract signature), so a shape one
+    instance legitimized during warmup costs nothing on a fresh
+    instance of the same class (bench protocol: measure on a freshly
+    built pipeline after a warmup twin compiled everything; recovery:
+    rebuilt actors re-present their old shapes). Only shapes NO
+    instance ever presented before stability are hazards."""
 
     def __init__(self):
+        import threading
+
         self.enabled = False
         self._stable = False
         self._sigs: Dict[int, Set[tuple]] = {}
         self._names: Dict[int, str] = {}
+        self._class_sigs: Dict[str, Set[tuple]] = {}
         self._hazards: Dict[str, List[tuple]] = {}
+        self._taken: Dict[str, int] = {}
+        # hazards are appended from actor/closer threads while the
+        # barrier thread reads deltas (ShapeGovernor): guard the
+        # hazard dict — the no-hazard hot path never takes the lock
+        self._haz_lock = threading.Lock()
 
     def start(self) -> "SignatureWatch":
         self.enabled = True
         self._stable = False
         self._sigs.clear()
         self._names.clear()
+        self._class_sigs.clear()
         self._hazards.clear()
+        self._taken.clear()
         return self
 
     def mark_stable(self) -> None:
@@ -303,9 +321,12 @@ class SignatureWatch:
         if sig in seen:
             return
         seen.add(sig)
-        self._names[key] = type(ex).__name__
-        if self._stable:
-            name = self._names[key]
+        name = type(ex).__name__
+        self._names[key] = name
+        cls_seen = self._class_sigs.setdefault(name, set())
+        known_to_class = sig in cls_seen
+        cls_seen.add(sig)
+        if self._stable and not known_to_class:
             from risingwave_tpu.analysis.shape_domain import (
                 capacity_bucket,
             )
@@ -317,7 +338,8 @@ class SignatureWatch:
             # a runtime hazard whose executor also carries a static
             # RW-E803 finding names the same bucket in both reports
             bucket = capacity_bucket(int(chunk.valid.shape[-1]))
-            self._hazards.setdefault(name, []).append((bucket, sig))
+            with self._haz_lock:
+                self._hazards.setdefault(name, []).append((bucket, sig))
             REGISTRY.counter("recompile_hazard_total").inc(executor=name)
             REGISTRY.counter("recompile_hazard_bucket_total").inc(
                 executor=name, bucket=str(bucket)
@@ -329,6 +351,26 @@ class SignatureWatch:
                 code="RW-E803",
                 signature=repr(sig)[:200],
             )
+
+    def take_hazard_deltas(self) -> Dict[str, int]:
+        """Post-warmup hazards per executor class since the last take —
+        the runtime ShapeGovernor's per-barrier feed (consuming: a
+        second call within the same barrier returns {})."""
+        out: Dict[str, int] = {}
+        with self._haz_lock:
+            for name, sigs in self._hazards.items():
+                n = len(sigs)
+                d = n - self._taken.get(name, 0)
+                if d > 0:
+                    out[name] = d
+                    self._taken[name] = n
+        return out
+
+    def hazard_total(self) -> int:
+        """Cumulative post-warmup hazards (NON-consuming — bench/test
+        assertion surface; take_hazard_deltas() is the governor's)."""
+        with self._haz_lock:
+            return sum(len(s) for s in self._hazards.values())
 
     def report(self) -> List[Diagnostic]:
         return [

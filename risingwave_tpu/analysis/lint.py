@@ -224,9 +224,11 @@ def lint_sql_file(path: str) -> Dict[str, List[Diagnostic]]:
 def fusion_findings_for_ddl(planned) -> List[Diagnostic]:
     """The CREATE-MV fusion hook: SHALLOW analysis (trace contracts +
     host-sync AST scan, no jaxpr tracing — stays inside the DDL lint
-    budget) filtered to the strict-relevant hazard class: RW-E803,
-    the unbucketed shape-polymorphic window (the class that wedges
-    real TPUs; ROADMAP item 2). Full reports are a CLI/CI surface
+    budget) filtered to the strict-relevant hazard classes: RW-E803
+    (unbucketed shape-polymorphic window — the class that wedges real
+    TPUs; ROADMAP item 2) and RW-E806 (a declared window_buckets
+    lattice the bucketing layer cannot satisfy — the proof is
+    vacuous). Full reports are a CLI/CI surface
     (``lint --fusion-report``).
 
     Graph pipelines are analyzed through their LIVE checkpoint
@@ -257,7 +259,9 @@ def fusion_findings_for_ddl(planned) -> List[Diagnostic]:
     out: List[Diagnostic] = []
     for rep in reports:
         out.extend(
-            d for d in rep.diagnostics if d.code == "RW-E803"
+            d
+            for d in rep.diagnostics
+            if d.code in ("RW-E803", "RW-E806")
         )
     return out
 

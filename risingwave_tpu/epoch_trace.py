@@ -288,6 +288,14 @@ def _runtime_snapshot(rt) -> Dict:
         ),
         "fragments": {},
     }
+    # shape-stability forensics: a wedge-adjacent stall with pinned
+    # executors or accumulated hazards names its own cause
+    gov = getattr(rt, "shape_governor", None)
+    if gov is not None:
+        try:
+            snap["shape_governor"] = gov.snapshot()
+        except Exception as e:  # noqa: BLE001 — forensics never fault
+            snap["shape_governor"] = repr(e)
     for name, p in getattr(rt, "fragments", {}).items():
         frag = {"epoch": getattr(p, "_epoch", None)}
         g = getattr(p, "graph", None)

@@ -41,6 +41,13 @@ Recording sites (grow as subsystems need them):
                        prober) observed an ALIVE/SLOW/WEDGED transition
 - ``wedge_dump``     — blackbox sentinel captured a WEDGE_*.json
                        forensic bundle for a wedged device
+- ``recompile_hazard`` — SignatureWatch saw a post-warmup novel
+                       abstract input signature (shape escaped the
+                       bucket lattice; RW-E403/E803 cross-reference)
+- ``shape_governor`` — runtime/bucketing.ShapeGovernor throttled a
+                       recompile storm: the named executor class was
+                       pinned to its max bucket (reason
+                       budget_exceeded | slow_device)
 """
 
 from __future__ import annotations

@@ -22,7 +22,13 @@ import numpy as np
 
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Barrier, Executor, Watermark
-from risingwave_tpu.ops.hash_table import HashTable, first_occurrence_mask, lookup_or_insert, plan_rehash, read_scalars, stage_scalars, set_live
+from risingwave_tpu.ops.hash_table import HashTable, first_occurrence_mask, lookup_or_insert, read_scalars, stage_scalars, set_live
+from risingwave_tpu.runtime.bucketing import (
+    BucketAllocator,
+    BucketPolicy,
+    needs_plan,
+    plan_capacity,
+)
 from risingwave_tpu.storage.state_table import (
     Checkpointable,
     StateDelta,
@@ -86,6 +92,8 @@ class AppendOnlyDedupExecutor(Executor, Checkpointable):
         capacity: int = 1 << 16,
         window_key: Optional[Tuple[str, int]] = None,
         table_id: str = "dedup",
+        bucket_policy: Optional[BucketPolicy] = None,
+        bucketed: bool = True,
     ):
         self.keys = tuple(keys)
         self.table_id = table_id
@@ -95,6 +103,16 @@ class AppendOnlyDedupExecutor(Executor, Checkpointable):
         self.sdirty = jnp.zeros(capacity, jnp.bool_)
         self.stored = jnp.zeros(capacity, jnp.bool_)
         self.window_key = window_key
+        # shape-stability: capacities drawn from a declared pow2
+        # lattice (runtime/bucketing) — ``bucketed=False`` is the
+        # legacy unbounded-rehash twin (tests, soak baselines)
+        self._buckets = (
+            BucketAllocator(
+                bucket_policy or BucketPolicy.from_capacity(capacity, grow_at=GROW_AT)
+            )
+            if bucketed
+            else None
+        )
         self._bound = 0
         self._saw_delete = jnp.zeros((), jnp.bool_)
         self._dropped = jnp.zeros((), jnp.bool_)
@@ -119,9 +137,28 @@ class AppendOnlyDedupExecutor(Executor, Checkpointable):
             "state": (self.table, self.sdirty),
             "donate": True,
             "emission": "passthrough",
-            # the key table rehash-grows with no declared bucket cap
-            # (window churn keeps minting fresh window keys)
-            "window_buckets": None,
+            # the seen-set's capacities are drawn from the allocator's
+            # declared pow2 lattice: window churn is bounded to one
+            # trace per bucket (None only on the legacy unbucketed twin)
+            "window_buckets": (
+                self._buckets.lattice if self._buckets is not None else None
+            ),
+        }
+
+    def pin_max_bucket(self):
+        """ShapeGovernor hook: freeze the seen-set at its high-water
+        bucket (shrink disabled; applied by the next apply)."""
+        if self._buckets is None:
+            return {"pinned": False}
+        return {
+            "table_id": self.table_id,
+            "pinned_cap": self._buckets.pin(),
+        }
+
+    def padding_stats(self):
+        return {
+            "capacity": self.table.capacity,
+            "live": int(self.table.num_live()),
         }
 
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
@@ -141,14 +178,16 @@ class AppendOnlyDedupExecutor(Executor, Checkpointable):
 
     def _maybe_grow(self, incoming: int):
         cap = self.table.capacity
-        if self._bound + incoming <= cap * GROW_AT:
+        if not needs_plan(self._buckets, cap, self._bound, incoming, GROW_AT):
             return
         # ONE packed read: tunneled-TPU round-trips dominate
         claimed, survivors = read_scalars(
             self.table.occupancy(),
             jnp.sum((self.table.live | self.sdirty).astype(jnp.int32)),
         )
-        new_cap = plan_rehash(cap, incoming, claimed, survivors, GROW_AT)
+        new_cap = plan_capacity(
+            self._buckets, cap, incoming, claimed, survivors, GROW_AT
+        )
         if new_cap is not None:
             self.table, self.sdirty, self.stored = _rebuild(
                 self.table, self.sdirty, self.stored, new_cap
@@ -168,6 +207,8 @@ class AppendOnlyDedupExecutor(Executor, Checkpointable):
     def _on_barrier_scalars(self, vals) -> None:
         saw_delete, dropped, claimed = vals
         self._bound = int(claimed)
+        if self._buckets is not None:
+            self._buckets.note_barrier(self.table.capacity, int(claimed))
         if saw_delete:
             raise RuntimeError("append-only dedup received a DELETE")
         if dropped:

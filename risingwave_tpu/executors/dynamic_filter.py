@@ -30,7 +30,14 @@ import numpy as np
 
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Barrier, Executor, Watermark
-from risingwave_tpu.ops.hash_table import HashTable, lookup_or_insert, plan_rehash, read_scalars, stage_scalars, set_live
+from risingwave_tpu.ops.hash_table import HashTable, lookup_or_insert, read_scalars, stage_scalars, set_live
+from risingwave_tpu.runtime.bucketing import (
+    BucketAllocator,
+    BucketPolicy,
+    emission_bucket,
+    needs_plan,
+    plan_capacity,
+)
 from risingwave_tpu.storage.state_table import (
     Checkpointable,
     StateDelta,
@@ -105,6 +112,8 @@ class DynamicMaxFilterExecutor(Executor, Checkpointable):
         capacity: int = 1 << 14,
         window_key: Optional[Tuple[str, int]] = None,
         table_id: str = "dynfilter",
+        bucket_policy: Optional[BucketPolicy] = None,
+        bucketed: bool = True,
     ):
         self.group_col = group_col
         self.value_col = value_col
@@ -117,6 +126,17 @@ class DynamicMaxFilterExecutor(Executor, Checkpointable):
         self.sdirty = jnp.zeros(capacity, jnp.bool_)
         self.stored = jnp.zeros(capacity, jnp.bool_)
         self.window_key = window_key
+        # shape-stability: the per-window max state walks a declared
+        # pow2 bucket lattice (grow-eager/shrink-lazy hysteresis);
+        # bucketed=False keeps the legacy unbounded-rehash twin (the
+        # RW-E803 wedge class, for tests and soak baselines)
+        self._buckets = (
+            BucketAllocator(
+                bucket_policy or BucketPolicy.from_capacity(capacity, grow_at=GROW_AT)
+            )
+            if bucketed
+            else None
+        )
         self._bound = 0
         self._saw_delete = jnp.zeros((), jnp.bool_)
         self._dropped = jnp.zeros((), jnp.bool_)
@@ -146,9 +166,28 @@ class DynamicMaxFilterExecutor(Executor, Checkpointable):
             "state": (self.table, self.maxes),
             "donate": True,
             "emission": "passthrough",
-            # per-window max state rehash-grows with no declared
-            # bucket cap (the q7 pre-filter sits right on the wedge)
-            "window_buckets": None,
+            # the per-window max state draws its capacities from the
+            # allocator's declared pow2 lattice — the q7 pre-filter is
+            # off the wedge class (None only on the unbucketed twin)
+            "window_buckets": (
+                self._buckets.lattice if self._buckets is not None else None
+            ),
+        }
+
+    def pin_max_bucket(self):
+        """ShapeGovernor hook: freeze the max-state at its high-water
+        bucket (shrink disabled; regrow applied by the next apply)."""
+        if self._buckets is None:
+            return {"pinned": False}
+        return {
+            "table_id": self.table_id,
+            "pinned_cap": self._buckets.pin(),
+        }
+
+    def padding_stats(self):
+        return {
+            "capacity": self.table.capacity,
+            "live": int(self.table.num_live()),
         }
 
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
@@ -177,14 +216,16 @@ class DynamicMaxFilterExecutor(Executor, Checkpointable):
 
     def _maybe_grow(self, incoming: int):
         cap = self.table.capacity
-        if self._bound + incoming <= cap * GROW_AT:
+        if not needs_plan(self._buckets, cap, self._bound, incoming, GROW_AT):
             return
         # ONE packed read: tunneled-TPU round-trips dominate
         claimed, survivors = read_scalars(
             self.table.occupancy(),
             jnp.sum((self.table.live | self.sdirty).astype(jnp.int32)),
         )
-        new_cap = plan_rehash(cap, incoming, claimed, survivors, GROW_AT)
+        new_cap = plan_capacity(
+            self._buckets, cap, incoming, claimed, survivors, GROW_AT
+        )
         if new_cap is not None:
             self.table, self.maxes, self.sdirty, self.stored = _rebuild(
                 self.table, self.maxes, self.sdirty, self.stored, new_cap
@@ -203,6 +244,8 @@ class DynamicMaxFilterExecutor(Executor, Checkpointable):
     def _on_barrier_scalars(self, vals) -> None:
         saw_delete, dropped, claimed = vals
         self._bound = int(claimed)
+        if self._buckets is not None:
+            self._buckets.note_barrier(self.table.capacity, int(claimed))
         if saw_delete:
             raise RuntimeError("dynamic max filter received a DELETE")
         if dropped:
@@ -335,9 +378,18 @@ class DynamicFilterExecutor(Executor, Checkpointable):
         schema_dtypes: Dict[str, object],
         capacity: int = 1 << 14,
         table_id: str = "dynfilter_general",
+        bucket_policy: Optional[BucketPolicy] = None,
+        bucketed: bool = True,
     ):
         if op not in _CMP:
             raise ValueError(f"unsupported comparator {op!r}")
+        self._buckets = (
+            BucketAllocator(
+                bucket_policy or BucketPolicy.from_capacity(capacity, grow_at=GROW_AT)
+            )
+            if bucketed
+            else None
+        )
         self.op = op
         self.value_col = value_col
         self.pk = tuple(pk)
@@ -422,15 +474,32 @@ class DynamicFilterExecutor(Executor, Checkpointable):
         self._staged_rv = (new_v, new_valid)
         return []
 
+    def pin_max_bucket(self):
+        """ShapeGovernor hook (see DynamicMaxFilterExecutor)."""
+        if self._buckets is None:
+            return {"pinned": False}
+        return {
+            "table_id": self.table_id,
+            "pinned_cap": self._buckets.pin(),
+        }
+
+    def padding_stats(self):
+        return {
+            "capacity": self.table.capacity,
+            "live": int(self.table.num_live()),
+        }
+
     def _maybe_grow(self, incoming: int):
         cap = self.table.capacity
-        if self._bound + incoming <= cap * GROW_AT:
+        if not needs_plan(self._buckets, cap, self._bound, incoming, GROW_AT):
             return
         claimed, survivors = read_scalars(
             self.table.occupancy(),
             jnp.sum((self.table.live | self.sdirty).astype(jnp.int32)),
         )
-        new_cap = plan_rehash(cap, incoming, claimed, survivors, GROW_AT)
+        new_cap = plan_capacity(
+            self._buckets, cap, incoming, claimed, survivors, GROW_AT
+        )
         if new_cap is not None:
             keep = self.table.live | self.sdirty
             new = HashTable.create(
@@ -460,6 +529,10 @@ class DynamicFilterExecutor(Executor, Checkpointable):
             raise RuntimeError(
                 "dynamic filter row store overflowed; grow capacity"
             )
+        if self._buckets is not None:
+            # host-tracked bound (an upper estimate of claimed): lazy
+            # shrink stays conservative without an extra device read
+            self._buckets.note_barrier(self.table.capacity, self._bound)
         if self._staged_rv is None:
             return []
         self.rv, self.rv_valid = self._staged_rv
@@ -500,7 +573,13 @@ class DynamicFilterExecutor(Executor, Checkpointable):
             outs.append(
                 StreamChunk.from_numpy(
                     cols,
-                    max(2, int(m.sum())),
+                    # pow2-padded emission (masked lanes): downstream
+                    # programs see a log-bounded capacity set, not one
+                    # shape per distinct flip count (legacy max(2, n)
+                    # on the unbucketed twin)
+                    emission_bucket(int(m.sum()))
+                    if self._buckets is not None
+                    else max(2, int(m.sum())),
                     ops=np.full(
                         int(m.sum()),
                         int(Op.INSERT if promote else Op.DELETE),

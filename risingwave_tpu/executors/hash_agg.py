@@ -517,6 +517,16 @@ class HashAggExecutor(Executor, Checkpointable):
             "window_buckets": caps,
         }
 
+    def padding_stats(self):
+        """Wasted-lane accounting (runtime/bucketing.padding_stats —
+        bench/PROFILE surface; reads device occupancy)."""
+        import jax.numpy as jnp
+
+        return {
+            "capacity": self.table.capacity,
+            "live": int(jnp.sum(self.table.live.astype(jnp.int32))),
+        }
+
     # -- data ------------------------------------------------------------
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         for k, nb in zip(self.group_keys, self.nullable):

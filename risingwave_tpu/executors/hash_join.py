@@ -39,8 +39,14 @@ import numpy as np
 
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Barrier, Executor, Watermark
-from risingwave_tpu.ops.hash_table import plan_rehash, read_scalars, stage_scalars
+from risingwave_tpu.ops.hash_table import read_scalars, stage_scalars
 from risingwave_tpu.ops.hash_table import lookup_or_insert, set_live
+from risingwave_tpu.runtime.bucketing import (
+    BucketAllocator,
+    BucketPolicy,
+    needs_plan,
+    plan_capacity,
+)
 from risingwave_tpu.storage.state_table import (
     host_key_view,
     lanes_from_host_keys,
@@ -310,6 +316,8 @@ class HashJoinExecutor(Executor, Checkpointable):
         window_cols: Optional[Tuple[str, str]] = None,
         join_type: str = "inner",
         table_id: str = "hash_join",
+        bucket_policy: Optional[BucketPolicy] = None,
+        bucketed: bool = True,
     ):
         self.table_id = table_id
         if join_type not in JOIN_TYPES:
@@ -360,6 +368,18 @@ class HashJoinExecutor(Executor, Checkpointable):
             {n: jnp.dtype(right_dtypes[n]) for n in self.right_names},
             nullable=right_nullable,
         )
+        # shape-stability: each side's key table walks a declared pow2
+        # bucket lattice (one allocator per side — the sides churn
+        # independently); bucketed=False keeps the legacy unbounded-
+        # rehash twin (the RW-E803 wedge class under window churn)
+        if bucketed:
+            policy = bucket_policy or BucketPolicy.from_capacity(capacity, grow_at=GROW_AT)
+            self._buckets = {
+                "l": BucketAllocator(policy),
+                "r": BucketAllocator(policy),
+            }
+        else:
+            self._buckets = None
         self._bound = {"l": 0, "r": 0}
         self._em_overflow = jnp.zeros((), jnp.bool_)
         self._wm = {"l": None, "r": None, "out": None}
@@ -403,11 +423,33 @@ class HashJoinExecutor(Executor, Checkpointable):
             "donate": True,
             "emission": "fixed",
             "emission_caps": (self.out_cap,),
-            # JoinSide rehash-grows with no declared bucket cap: under
-            # window churn (fresh window keys every slide) the expiry/
-            # growth cycle re-traces every program touching the side
-            # tables — the q7 wedge class (RW-E803 when window_cols)
-            "window_buckets": None,
+            # both JoinSides draw their capacities from the declared
+            # pow2 lattice: the window-churn expiry/growth cycle costs
+            # at most one trace per bucket per side (None only on the
+            # legacy unbucketed twin — the RW-E803 wedge class)
+            "window_buckets": (
+                self._buckets["l"].lattice
+                if self._buckets is not None
+                else None
+            ),
+        }
+
+    def pin_max_bucket(self):
+        """ShapeGovernor hook: freeze BOTH sides at their high-water
+        buckets (shrink disabled; regrow applied on the next apply)."""
+        if self._buckets is None:
+            return {"pinned": False}
+        return {
+            "table_id": self.table_id,
+            "pinned_cap_left": self._buckets["l"].pin(),
+            "pinned_cap_right": self._buckets["r"].pin(),
+        }
+
+    def padding_stats(self):
+        return {
+            "capacity": self.left.capacity + self.right.capacity,
+            "live": int(self.left.table.num_live())
+            + int(self.right.table.num_live()),
         }
 
     # -- data ------------------------------------------------------------
@@ -459,14 +501,17 @@ class HashJoinExecutor(Executor, Checkpointable):
 
     def _maybe_grow(self, side: str, own: JoinSide, incoming: int) -> JoinSide:
         cap = own.capacity
-        if self._bound[side] + incoming <= cap * GROW_AT:
+        alloc = self._buckets[side] if self._buckets is not None else None
+        if not needs_plan(alloc, cap, self._bound[side], incoming, GROW_AT):
             return own
         # ONE packed read: tunneled-TPU round-trips dominate
         claimed, survivors = read_scalars(
             own.table.occupancy(),
             jnp.sum((own.table.live | own.sdirty).astype(jnp.int32)),
         )
-        new_cap = plan_rehash(cap, incoming, claimed, survivors, GROW_AT)
+        new_cap = plan_capacity(
+            alloc, cap, incoming, claimed, survivors, GROW_AT
+        )
         if new_cap is not None:
             own = regrow(own, new_cap, own.fanout)
             claimed = int(own.table.occupancy())
@@ -683,6 +728,9 @@ class HashJoinExecutor(Executor, Checkpointable):
         em, lo, li, ro, ri, cl, cr = vals
         self._bound["l"] = int(cl)
         self._bound["r"] = int(cr)
+        if self._buckets is not None:
+            self._buckets["l"].note_barrier(self.left.capacity, int(cl))
+            self._buckets["r"].note_barrier(self.right.capacity, int(cr))
         if em:
             raise RuntimeError(
                 "join emission overflowed out_cap within one chunk; "

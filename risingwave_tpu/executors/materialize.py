@@ -684,6 +684,14 @@ class DeviceMaterializeExecutor(MvDeviceReadMixin, Executor, Checkpointable):
             "emission": "passthrough",
         }
 
+    def padding_stats(self):
+        """Wasted-lane accounting (runtime/bucketing.padding_stats —
+        bench/PROFILE surface; reads device occupancy)."""
+        return {
+            "capacity": self.table.capacity,
+            "live": int(self.table.num_live()),
+        }
+
     # -- data -------------------------------------------------------------
     def apply(self, chunk: StreamChunk):
         self._maybe_grow(chunk)  # also advances the insert bound

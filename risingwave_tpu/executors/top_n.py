@@ -39,8 +39,13 @@ from risingwave_tpu.ops.hash_table import (
     HashTable,
     first_occurrence_mask,
     lookup_or_insert,
-    plan_rehash,
     set_live,
+)
+from risingwave_tpu.runtime.bucketing import (
+    BucketAllocator,
+    BucketPolicy,
+    needs_plan,
+    plan_capacity,
 )
 from risingwave_tpu.storage.state_table import (
     Checkpointable,
@@ -223,7 +228,16 @@ class GroupTopNExecutor(Executor, Checkpointable):
         out_cap: int = 1 << 13,
         window_key: Optional[Tuple[str, int]] = None,
         table_id: str = "group_top_n",
+        bucket_policy: Optional[BucketPolicy] = None,
+        bucketed: bool = True,
     ):
+        self._buckets = (
+            BucketAllocator(
+                bucket_policy or BucketPolicy.from_capacity(capacity, grow_at=GROW_AT)
+            )
+            if bucketed
+            else None
+        )
         self.group_keys = tuple(group_keys)
         self.order_col = order_col
         self.k = k
@@ -282,8 +296,27 @@ class GroupTopNExecutor(Executor, Checkpointable):
             "donate": True,
             "emission": "fixed",
             "emission_caps": (self.out_cap,),
-            # the group table rehash-grows with no declared bucket cap
-            "window_buckets": None,
+            # group table + band capacities walk the allocator's
+            # declared pow2 lattice (None only on the unbucketed twin)
+            "window_buckets": (
+                self._buckets.lattice if self._buckets is not None else None
+            ),
+        }
+
+    def pin_max_bucket(self):
+        """ShapeGovernor hook: freeze the group bands at their
+        high-water bucket (shrink disabled)."""
+        if self._buckets is None:
+            return {"pinned": False}
+        return {
+            "table_id": self.table_id,
+            "pinned_cap": self._buckets.pin(),
+        }
+
+    def padding_stats(self):
+        return {
+            "capacity": self.table.capacity,
+            "live": int(self.table.num_live()),
         }
 
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
@@ -310,13 +343,15 @@ class GroupTopNExecutor(Executor, Checkpointable):
 
     def _maybe_grow(self, incoming: int):
         cap = self.table.capacity
-        if self._bound + incoming <= cap * GROW_AT:
+        if not needs_plan(self._buckets, cap, self._bound, incoming, GROW_AT):
             return
         claimed = int(self.table.occupancy())
         survivors = int(
             jnp.sum((self.table.live | self.state["sdirty"]).astype(jnp.int32))
         )
-        new_cap = plan_rehash(cap, incoming, claimed, survivors, GROW_AT)
+        new_cap = plan_capacity(
+            self._buckets, cap, incoming, claimed, survivors, GROW_AT
+        )
         if new_cap is not None:
             self.table, self.state = _topn_rebuild(
                 self.table, self.state, new_cap
@@ -325,6 +360,10 @@ class GroupTopNExecutor(Executor, Checkpointable):
         self._bound = claimed
 
     def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
+        if self._buckets is not None:
+            # host-tracked bound (upper estimate): shrink stays lazy
+            # and conservative without an extra device read
+            self._buckets.note_barrier(self.table.capacity, self._bound)
         if bool(self._saw_delete):
             raise RuntimeError("append-only TopN received a DELETE")
         if bool(self._dropped):

@@ -26,8 +26,16 @@ from risingwave_tpu.executors.base import Barrier, Executor
 from risingwave_tpu.ops.hash_table import (
     HashTable,
     lookup_or_insert,
-    plan_rehash,
     set_live,
+)
+from risingwave_tpu.runtime.bucketing import (
+    BucketAllocator,
+    BucketPolicy,
+    emission_bucket,
+    lattice_between,
+    needs_plan,
+    plan_capacity,
+    pow2_at_least,
 )
 from risingwave_tpu.storage.state_table import (
     Checkpointable,
@@ -104,7 +112,16 @@ class TopNExecutor(Executor, Checkpointable):
         desc: bool = False,
         capacity: int = 1 << 14,
         table_id: str = "top_n",
+        bucket_policy: Optional[BucketPolicy] = None,
+        bucketed: bool = True,
     ):
+        self._buckets = (
+            BucketAllocator(
+                bucket_policy or BucketPolicy.from_capacity(capacity, grow_at=GROW_AT)
+            )
+            if bucketed
+            else None
+        )
         self.order_col = order_col
         self.limit = int(limit)
         self.desc = desc
@@ -141,9 +158,36 @@ class TopNExecutor(Executor, Checkpointable):
             ),
             "state": (self.table, self.rows),
             "donate": True,
-            # the barrier diff against the host mirror emits chunks
-            # sized by the changed-row count
-            "emission": "data_dependent",
+            # the barrier diff against the host mirror now pads its
+            # emissions to pow2 buckets (<= limit rows per op chunk):
+            # a declared, closed capacity set instead of one shape per
+            # distinct delta count (data_dependent on the legacy twin)
+            **(
+                {
+                    "emission": "bucketed",
+                    "emission_caps": lattice_between(
+                        2, pow2_at_least(max(self.limit, 2))
+                    ),
+                }
+                if self._buckets is not None
+                else {"emission": "data_dependent"}
+            ),
+        }
+
+    def pin_max_bucket(self):
+        """ShapeGovernor hook: freeze the row store at its high-water
+        bucket (shrink disabled)."""
+        if self._buckets is None:
+            return {"pinned": False}
+        return {
+            "table_id": self.table_id,
+            "pinned_cap": self._buckets.pin(),
+        }
+
+    def padding_stats(self):
+        return {
+            "capacity": self.table.capacity,
+            "live": int(self.table.num_live()),
         }
 
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
@@ -160,13 +204,15 @@ class TopNExecutor(Executor, Checkpointable):
 
     def _maybe_grow(self, incoming: int):
         cap = self.table.capacity
-        if self._bound + incoming <= cap * GROW_AT:
+        if not needs_plan(self._buckets, cap, self._bound, incoming, GROW_AT):
             return
         claimed = int(self.table.occupancy())
         survivors = int(
             jnp.sum((self.table.live | self.sdirty).astype(jnp.int32))
         )
-        new_cap = plan_rehash(cap, incoming, claimed, survivors, GROW_AT)
+        new_cap = plan_capacity(
+            self._buckets, cap, incoming, claimed, survivors, GROW_AT
+        )
         if new_cap is not None:
             keep = self.table.live | self.sdirty
             new = HashTable.create(
@@ -193,6 +239,8 @@ class TopNExecutor(Executor, Checkpointable):
         self._bound = claimed
 
     def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
+        if self._buckets is not None:
+            self._buckets.note_barrier(self.table.capacity, self._bound)
         if bool(self._dropped):
             raise RuntimeError("TopN row store overflowed; grow capacity")
         idx, alive = _rank_top(
@@ -221,7 +269,10 @@ class TopNExecutor(Executor, Checkpointable):
             outs.append(
                 StreamChunk.from_numpy(
                     cols,
-                    max(2, len(vals)),
+                    # pow2-padded emission: a closed downstream shape set
+                    emission_bucket(len(vals))
+                    if self._buckets is not None
+                    else max(2, len(vals)),
                     ops=np.full(len(vals), int(op), np.int32),
                 )
             )
@@ -410,7 +461,7 @@ def _diff_touched_groups(
     return dels, ins
 
 
-def _emit_diffs(dels, ins, names, dtypes) -> List[StreamChunk]:
+def _emit_diffs(dels, ins, names, dtypes, bucketed=True) -> List[StreamChunk]:
     outs = []
     for vals, op in ((dels, Op.DELETE), (ins, Op.INSERT)):
         if not vals:
@@ -422,7 +473,13 @@ def _emit_diffs(dels, ins, names, dtypes) -> List[StreamChunk]:
         outs.append(
             StreamChunk.from_numpy(
                 cols,
-                max(2, len(vals)),
+                # pow2-padded emission (masked lanes): downstream sees
+                # a log-bounded capacity set, not one per delta count;
+                # the bucketed=False twin keeps the legacy max(2, n)
+                # shape per distinct count (RW-E803 baseline behavior)
+                emission_bucket(len(vals))
+                if bucketed
+                else max(2, len(vals)),
                 ops=np.full(len(vals), int(op), np.int32),
             )
         )
@@ -452,7 +509,16 @@ class RetractableGroupTopNExecutor(Executor, Checkpointable):
         capacity: int = 1 << 14,
         window_key: Optional[Tuple[str, int]] = None,
         table_id: str = "group_top_n",
+        bucket_policy: Optional[BucketPolicy] = None,
+        bucketed: bool = True,
     ):
+        self._buckets = (
+            BucketAllocator(
+                bucket_policy or BucketPolicy.from_capacity(capacity, grow_at=GROW_AT)
+            )
+            if bucketed
+            else None
+        )
         self.group_by = tuple(group_by)
         self.order_col = order_col
         self.limit = int(limit)
@@ -513,9 +579,39 @@ class RetractableGroupTopNExecutor(Executor, Checkpointable):
             "state": (self.table, self.rows),
             "donate": True,
             # the barrier ranks on device but diffs against a host
-            # mirror: emission is sized by changed groups x k
-            "emission": "data_dependent",
-            "window_buckets": None,
+            # mirror; emissions are pow2-padded (bucketed) and the row
+            # store walks the allocator's declared lattice (legacy
+            # data_dependent/None only on the unbucketed twin)
+            **(
+                {
+                    "emission": "bucketed",
+                    "emission_caps": lattice_between(
+                        2, self._buckets.policy.max_cap
+                    ),
+                    "window_buckets": self._buckets.lattice,
+                }
+                if self._buckets is not None
+                else {
+                    "emission": "data_dependent",
+                    "window_buckets": None,
+                }
+            ),
+        }
+
+    def pin_max_bucket(self):
+        """ShapeGovernor hook: freeze the row store at its high-water
+        bucket (shrink disabled)."""
+        if self._buckets is None:
+            return {"pinned": False}
+        return {
+            "table_id": self.table_id,
+            "pinned_cap": self._buckets.pin(),
+        }
+
+    def padding_stats(self):
+        return {
+            "capacity": self.table.capacity,
+            "live": int(self.table.num_live()),
         }
 
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
@@ -544,7 +640,7 @@ class RetractableGroupTopNExecutor(Executor, Checkpointable):
 
     def _maybe_grow(self, incoming: int):
         cap = self.table.capacity
-        if self._bound + incoming <= cap * GROW_AT:
+        if not needs_plan(self._buckets, cap, self._bound, incoming, GROW_AT):
             return
         from risingwave_tpu.ops.hash_table import read_scalars
 
@@ -552,7 +648,9 @@ class RetractableGroupTopNExecutor(Executor, Checkpointable):
             self.table.occupancy(),
             jnp.sum((self.table.live | self.sdirty).astype(jnp.int32)),
         )
-        new_cap = plan_rehash(cap, incoming, claimed, survivors, GROW_AT)
+        new_cap = plan_capacity(
+            self._buckets, cap, incoming, claimed, survivors, GROW_AT
+        )
         if new_cap is not None:
             keep = self.table.live | self.sdirty
             new = HashTable.create(
@@ -578,11 +676,14 @@ class RetractableGroupTopNExecutor(Executor, Checkpointable):
     def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
         from risingwave_tpu.ops.hash_table import read_scalars
 
-        # ONE packed read for the latch + the dirty short-circuit
-        # (tunneled-TPU round-trips dominate)
-        dropped, any_dirty = read_scalars(
-            self._dropped, jnp.any(self.epoch_dirty)
+        # ONE packed read for the latch + the dirty short-circuit +
+        # occupancy (tunneled-TPU round-trips dominate)
+        dropped, any_dirty, claimed = read_scalars(
+            self._dropped, jnp.any(self.epoch_dirty), self.table.occupancy()
         )
+        self._bound = int(claimed)
+        if self._buckets is not None:
+            self._buckets.note_barrier(self.table.capacity, int(claimed))
         if dropped:
             raise RuntimeError("GroupTopN row store overflowed; grow capacity")
         if not any_dirty:
@@ -603,7 +704,13 @@ class RetractableGroupTopNExecutor(Executor, Checkpointable):
             self.group_by, self.pk, self.names, gdirty, self._emitted,
         )
         self.epoch_dirty = jnp.zeros_like(self.epoch_dirty)
-        return _emit_diffs(dels, ins, self.names, self._dtypes)
+        return _emit_diffs(
+            dels,
+            ins,
+            self.names,
+            self._dtypes,
+            bucketed=self._buckets is not None,
+        )
 
     def on_watermark(self, watermark):
         """Window-bounded groups expire silently below the watermark

@@ -444,12 +444,15 @@ class SqlSession:
 
     def _fusion_lint(self, planned, strict: bool) -> None:
         """Fusion-feasibility findings at CREATE-MV time (analysis/
-        fusion_analyzer.py, shallow pass): REPORT-ONLY by default —
-        RW-E803 (unbucketed shape-polymorphic window, the class that
-        wedges real TPUs) lands in ``lint_findings`` as a warning;
-        the RW_STRICT_FUSION=1 env knob (env-only, like the other
-        escape hatches) refuses the DDL on window-keyed plans, same
-        path as strict_lint."""
+        fusion_analyzer.py, shallow pass): STRICT BY DEFAULT now that
+        the bucketing layer exists (runtime/bucketing.py) — RW-E803
+        (unbucketed shape-polymorphic window, the class that wedges
+        real TPUs) and RW-E806 (unsatisfiable declared lattice) refuse
+        the DDL on window-keyed plans, same path as strict_lint; every
+        built-in window-keyed executor declares a satisfiable lattice,
+        so the Nexmark corpus walks free. RW_STRICT_FUSION=0 (env-only,
+        like the other escape hatches) restores report-only mode —
+        findings land in ``lint_findings`` as warnings."""
         import os
 
         from risingwave_tpu.analysis.diagnostics import PlanLintError
@@ -463,7 +466,7 @@ class SqlSession:
             return
         self.lint_findings.extend((planned.name, d) for d in diags)
         strict_fusion = os.environ.get(
-            "RW_STRICT_FUSION", "0"
+            "RW_STRICT_FUSION", "1"
         ).strip().lower() not in ("0", "off", "false", "")
         if strict and strict_fusion:
             raise PlanLintError(diags, name=planned.name)

@@ -182,6 +182,7 @@ def build_q7(
     state_cleaning: bool = True,
     agg_capacity: Optional[int] = None,
     filter_capacity: Optional[int] = None,
+    bucketed: bool = True,
 ) -> Q7:
     """Highest bid per 10s tumble window (Nexmark q7, e2e_test/nexmark/).
 
@@ -221,6 +222,10 @@ def build_q7(
             capacity=filter_capacity or max(1 << 10, capacity >> 6),
             window_key=("wstart", 0) if state_cleaning else None,
             table_id="q7.maxfilter",
+            # bucketed=False is the legacy unbounded-rehash twin (the
+            # RW-E803 wedge class): soak baselines and the analyzer's
+            # detection tests build it deliberately
+            bucketed=bucketed,
         ),
     ]
     right_chain = [
@@ -253,6 +258,7 @@ def build_q7(
         right_nullable=("maxprice",),
         window_cols=("wstart", "mwstart") if state_cleaning else None,
         table_id="q7.join",
+        bucketed=bucketed,
     )
     mview = DeviceMaterializeExecutor(
         pk=("wstart", "auction", "bidder"),

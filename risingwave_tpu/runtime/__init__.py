@@ -6,10 +6,7 @@
 # other barrier faults)
 from risingwave_tpu.blackbox import DeviceWedged
 from risingwave_tpu.runtime.pipeline import Pipeline, TwoInputPipeline
-from risingwave_tpu.runtime.dml import DmlManager
 from risingwave_tpu.runtime.runtime import StreamingRuntime
-from risingwave_tpu.runtime.notification import NotificationHub
-from risingwave_tpu.runtime.source_manager import SourceManager
 
 __all__ = [
     "DeviceWedged",
@@ -20,3 +17,31 @@ __all__ = [
     "SourceManager",
     "NotificationHub",
 ]
+
+# Lazy (PEP 562) exports: DmlManager pulls in the SQL planner, which
+# imports the executors package — and executors now import
+# runtime.bucketing at module level (the shape-stability layer), so an
+# eager import here would close a cycle through a partially
+# initialized executors package.
+_LAZY = {
+    "DmlManager": ("risingwave_tpu.runtime.dml", "DmlManager"),
+    "SourceManager": (
+        "risingwave_tpu.runtime.source_manager",
+        "SourceManager",
+    ),
+    "NotificationHub": (
+        "risingwave_tpu.runtime.notification",
+        "NotificationHub",
+    ),
+}
+
+
+def __getattr__(name):
+    entry = _LAZY.get(name)
+    if entry is None:
+        raise AttributeError(name)
+    import importlib
+
+    value = getattr(importlib.import_module(entry[0]), entry[1])
+    globals()[name] = value
+    return value

@@ -1,0 +1,528 @@
+"""Shape-stability layer: pow2 bucket allocation + the recompile-storm
+governor.
+
+XLA compiles one program per abstract input signature, and on the
+tunneled TPU one compile costs ~30-40s — so a state buffer whose
+capacity wanders freely re-traces every fused program that touches it
+until the device queue deadlocks (the q7 wedge, RW-E803; BENCH_TPU_2/3
+"device wedged; stopping").  The fix is the fixed-capacity
+region-padded state model (PAPERS.md, "Streaming Computations with
+Region-Based State on SIMD Architectures"): every device-visible
+shape is drawn from a small DECLARED pow2 lattice, buffers are padded
+to their bucket with validity masks, and capacity transitions follow a
+grow-eagerly / shrink-lazily hysteresis so steady-state churn can
+never oscillate across a bucket boundary.
+
+Three layers live here:
+
+- :class:`BucketPolicy` / :class:`BucketAllocator` — the capacity
+  planner every window-keyed executor routes its ``_maybe_grow`` /
+  barrier bookkeeping through.  The allocator's ``lattice`` is exactly
+  what the executor declares as ``window_buckets`` in its
+  ``trace_contract()`` (analysis/shape_domain.py), so the fusion
+  analyzer's static proof and the runtime's actual shape set are the
+  same object: total traces <= lattice size, one per bucket, never one
+  per shape.
+- emission bucketing helpers (:func:`emission_bucket`) — host-diff
+  executors (dynamic filter rv flips, plain/retractable TopN) used to
+  emit ``max(2, n)``-sized chunks, minting a fresh downstream program
+  per distinct delta count; padding the emission to a pow2 bucket with
+  masked lanes closes that set too.
+- :class:`ShapeGovernor` — the runtime back-stop for when stability is
+  violated anyway: per-barrier ``SignatureWatch`` hazard deltas feed a
+  budget (``RW_FUSION_RECOMPILE_BUDGET``); exceeding it pins the
+  offending executor to its max (high-water) bucket — shrink disabled,
+  capacity immediately restored to the largest bucket it ever used —
+  with a ``shape_governor`` event + metric, instead of letting the
+  re-trace storm pile onto the device.  A SLOW device heartbeat
+  (blackbox.DeviceSentinel) drops the budget to zero: the first
+  hazard on a struggling tunnel throttles proactively, before WEDGED.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "BucketAllocator",
+    "BucketPolicy",
+    "ShapeGovernor",
+    "emission_bucket",
+    "lattice_between",
+    "needs_plan",
+    "padding_stats",
+    "plan_capacity",
+    "pow2_at_least",
+    "validate_lattice",
+]
+
+# lattice span above the configured capacity: initial_cap << STEPS is
+# the largest bucket growth may reach before the existing overflow
+# latches ("grow capacity") fire. 8 doublings = 256x headroom, and a
+# <= 9-entry lattice bounds worst-case traces per kernel.
+DEFAULT_MAX_STEPS = 8
+# a declared lattice may never exceed this capacity (2^26 slots of one
+# int64 lane = 512 MiB: past any sane single-buffer HBM budget)
+ABS_MAX_CAP = 1 << 26
+
+
+def pow2_at_least(n: int) -> int:
+    """Smallest power of two >= max(n, 1)."""
+    n = max(int(n), 1)
+    return 1 << (n - 1).bit_length()
+
+
+def lattice_between(lo: int, hi: int) -> Tuple[int, ...]:
+    """All pow2 capacities in [lo, hi] (lo/hi rounded up to pow2)."""
+    lo = pow2_at_least(lo)
+    hi = max(pow2_at_least(hi), lo)
+    out = []
+    c = lo
+    while c <= hi:
+        out.append(c)
+        c <<= 1
+    return tuple(out)
+
+
+def emission_bucket(n: int, floor: int = 2) -> int:
+    """Pow2 emission capacity for an n-row host-built delta chunk.
+    Downstream programs then see at most log2(max_delta) distinct
+    shapes instead of one per distinct count."""
+    return pow2_at_least(max(int(n), floor))
+
+
+def validate_lattice(buckets) -> Optional[str]:
+    """Why the bucketing layer cannot satisfy a declared
+    ``window_buckets`` lattice, or None when it can (RW-E806's
+    predicate). Satisfiable = non-empty, all power-of-two ints,
+    strictly increasing, and within the absolute allocator bound."""
+    try:
+        caps = tuple(int(b) for b in buckets)
+    except (TypeError, ValueError):
+        return f"lattice is not a capacity sequence: {buckets!r}"
+    if not caps:
+        return "lattice is empty"
+    for b in caps:
+        if b <= 0 or b & (b - 1):
+            return f"capacity {b} is not a power of two"
+        if b > ABS_MAX_CAP:
+            return (
+                f"capacity {b} exceeds the allocator bound {ABS_MAX_CAP}"
+            )
+    if any(b >= c for b, c in zip(caps, caps[1:])):
+        return f"lattice is not strictly increasing: {caps}"
+    return None
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+@dataclass(frozen=True)
+class BucketPolicy:
+    """Hysteresis parameters of one buffer's bucket walk.
+
+    ``grow_at`` is the load factor that triggers eager growth (shared
+    with the hash tables' rehash contract); shrink is LAZY: occupancy
+    must sit below ``shrink_at * capacity`` for ``patience``
+    consecutive barriers before the buffer compacts down — a window
+    churning right at a bucket boundary therefore grows once and stays,
+    it can never flap."""
+
+    min_cap: int
+    max_cap: int
+    grow_at: float = 0.5
+    shrink_at: float = 0.125
+    patience: int = 4
+
+    def __post_init__(self):
+        if self.min_cap & (self.min_cap - 1) or self.min_cap <= 0:
+            raise ValueError(f"min_cap {self.min_cap} not a power of two")
+        if self.max_cap < self.min_cap:
+            raise ValueError("max_cap < min_cap")
+        if not (0.0 < self.shrink_at < self.grow_at <= 1.0):
+            raise ValueError(
+                "need 0 < shrink_at < grow_at <= 1 for hysteresis"
+            )
+
+    @staticmethod
+    def from_capacity(
+        capacity: int,
+        max_steps: Optional[int] = None,
+        grow_at: float = 0.5,
+    ) -> "BucketPolicy":
+        """The default policy for an executor configured with
+        ``capacity``: lattice spans capacity .. capacity << steps
+        (``RW_BUCKET_MAX_STEPS`` overrides; shrink floor = the
+        configured capacity, honoring the operator's sizing)."""
+        steps = (
+            max_steps
+            if max_steps is not None
+            else _env_int("RW_BUCKET_MAX_STEPS", DEFAULT_MAX_STEPS)
+        )
+        # a configured capacity beyond the allocator bound clamps the
+        # LATTICE (never raises: the capacity was legal before this
+        # layer existed) — plan() tolerates cap > max_cap, so the
+        # buffer simply never grows, and the declared lattice stays
+        # satisfiable (no self-inflicted RW-E806)
+        lo = min(pow2_at_least(capacity), ABS_MAX_CAP)
+        hi = min(lo << max(steps, 0), ABS_MAX_CAP)
+        return BucketPolicy(
+            min_cap=lo,
+            max_cap=max(hi, lo),
+            grow_at=grow_at,
+            patience=_env_int("RW_BUCKET_SHRINK_PATIENCE", 4),
+        )
+
+    def lattice(self) -> Tuple[int, ...]:
+        return lattice_between(self.min_cap, self.max_cap)
+
+
+class BucketAllocator:
+    """Capacity planner for one (or one family of) padded state
+    buffer(s). The owning executor calls:
+
+    - ``should_plan(cap, bound, incoming)`` — the cheap pre-check its
+      ``_maybe_grow`` already does, extended with pending-shrink and
+      governor-pin wakeups;
+    - ``plan(cap, incoming, claimed, survivors)`` — the
+      ``plan_rehash`` replacement: next capacity drawn from the
+      lattice (grow eagerly, clamped at ``max_cap``; pinned buffers
+      jump back to their high-water bucket), or None;
+    - ``note_barrier(cap, claimed)`` — per-barrier occupancy
+      bookkeeping driving the lazy-shrink streak;
+    - ``pin()`` — the governor hook: shrink disabled, next plan()
+      returns the high-water bucket.
+    """
+
+    def __init__(self, policy: BucketPolicy):
+        self.policy = policy
+        self.pinned = False
+        self.high_water = policy.min_cap
+        self._streak = 0
+        self._pending_shrink: Optional[int] = None
+        # saturated = demand exceeds the lattice max and a same-cap
+        # rebuild cannot relieve it; gates the load-factor trigger so
+        # the apply path stops paying a device read + rebuild per
+        # chunk (re-checked once per barrier via note_barrier)
+        self._saturated = False
+
+    @property
+    def lattice(self) -> Tuple[int, ...]:
+        return self.policy.lattice()
+
+    # -- apply-path hooks -------------------------------------------------
+    def should_plan(self, cap: int, bound: int, incoming: int) -> bool:
+        if (
+            not self._saturated
+            and bound + incoming > cap * self.policy.grow_at
+        ):
+            return True
+        if self.pinned and cap < self.high_water:
+            return True
+        return (
+            self._pending_shrink is not None
+            and self._pending_shrink < cap
+        )
+
+    def plan(
+        self, cap: int, incoming: int, claimed: int, survivors: int
+    ) -> Optional[int]:
+        """Next capacity, or None (current bucket still fits). A
+        returned value == cap is a pure tombstone compaction (the
+        plan_rehash contract). Growth beyond ``max_cap`` clamps: the
+        executor's existing overflow latch ("grow capacity") then
+        reports genuine overflow at the barrier instead of the device
+        re-tracing through unbounded fresh shapes."""
+        p = self.policy
+        self.high_water = max(self.high_water, cap)
+        if self.pinned and cap < self.high_water:
+            # governor pin: jump straight back to the high-water bucket
+            self._pending_shrink = None
+            return self.high_water
+        if claimed + incoming > cap * p.grow_at:
+            need = cap
+            while survivors + incoming > need * p.grow_at:
+                need <<= 1
+            new_cap = min(max(need, p.min_cap), max(p.max_cap, cap))
+            self._pending_shrink = None
+            self._streak = 0
+            if new_cap == cap and survivors + incoming > cap * p.grow_at:
+                # saturated at the lattice max: a same-capacity rebuild
+                # cannot relieve the load (unlike a genuine tombstone
+                # compaction, where survivors fit) — stop planning per
+                # chunk and let the overflow latch report if the table
+                # genuinely fills. note_barrier re-checks each barrier.
+                self._saturated = True
+                return None
+            self.high_water = max(self.high_water, new_cap)
+            return new_cap
+        t = self._pending_shrink
+        if t is not None and not self.pinned:
+            self._pending_shrink = None
+            self._streak = 0
+            # never shrink below what this chunk (or the survivors)
+            # need — re-growing next chunk would be the exact
+            # oscillation this layer exists to prevent
+            while survivors + incoming > t * p.grow_at:
+                t <<= 1
+            if t < cap:
+                return t
+        return None
+
+    # -- barrier hook -----------------------------------------------------
+    def note_barrier(self, cap: int, claimed: int) -> None:
+        p = self.policy
+        self.high_water = max(self.high_water, cap)
+        # saturation is re-evaluated once per barrier (expiry may have
+        # freed load), never per chunk
+        self._saturated = False
+        if (
+            self.pinned
+            or cap <= p.min_cap
+            or claimed > cap * p.shrink_at
+        ):
+            self._streak = 0
+            self._pending_shrink = None
+            return
+        self._streak += 1
+        if self._streak >= p.patience:
+            target = pow2_at_least(
+                max(p.min_cap, int(claimed / p.grow_at) + 1)
+            )
+            if target < cap:
+                self._pending_shrink = target
+
+    # -- governor hook ----------------------------------------------------
+    def pin(self) -> int:
+        """Disable shrink and freeze the buffer at its high-water
+        bucket (applied by the next plan()). Returns the pinned
+        capacity."""
+        self.pinned = True
+        self._pending_shrink = None
+        self._streak = 0
+        return self.high_water
+
+    def snapshot(self) -> Dict:
+        return {
+            "lattice": list(self.lattice),
+            "pinned": self.pinned,
+            "high_water": self.high_water,
+            "pending_shrink": self._pending_shrink,
+            "saturated": self._saturated,
+        }
+
+
+def needs_plan(
+    alloc: Optional[BucketAllocator],
+    cap: int,
+    bound: int,
+    incoming: int,
+    grow_at: float = 0.5,
+) -> bool:
+    """The apply-path pre-check shared by every ``_maybe_grow``:
+    allocator-driven when bucketed, the legacy load-factor check on
+    the unbucketed twin (alloc=None)."""
+    if alloc is None:
+        return bound + incoming > cap * grow_at
+    return alloc.should_plan(cap, bound, incoming)
+
+
+def plan_capacity(
+    alloc: Optional[BucketAllocator],
+    cap: int,
+    incoming: int,
+    claimed: int,
+    survivors: int,
+    grow_at: float = 0.5,
+) -> Optional[int]:
+    """``plan_rehash`` with the bucket lattice in the loop; falls back
+    to the raw unbounded rehash policy on the unbucketed twin."""
+    if alloc is None:
+        from risingwave_tpu.ops.hash_table import plan_rehash
+
+        return plan_rehash(cap, incoming, claimed, survivors, grow_at)
+    return alloc.plan(cap, incoming, claimed, survivors)
+
+
+def padding_stats(executors) -> Dict[str, object]:
+    """Wasted-lane accounting over every padded state buffer the given
+    executors expose via ``padding_stats()`` (bench/PROFILE surface —
+    this READS device occupancy counters; never call it per barrier).
+    Returns totals + the worst per-executor fraction."""
+    total_lanes = 0
+    live_lanes = 0
+    per: Dict[str, Dict] = {}
+    for ex in executors:
+        fn = getattr(ex, "padding_stats", None)
+        if fn is None:
+            continue
+        try:
+            st = fn()
+        except Exception:  # noqa: BLE001 — accounting must never fault
+            continue
+        cap, live = int(st.get("capacity", 0)), int(st.get("live", 0))
+        if cap <= 0:
+            continue
+        total_lanes += cap
+        live_lanes += live
+        name = type(ex).__name__
+        agg = per.setdefault(name, {"capacity": 0, "live": 0})
+        agg["capacity"] += cap
+        agg["live"] += live
+    for st in per.values():
+        st["wasted_frac"] = round(
+            1.0 - st["live"] / max(st["capacity"], 1), 4
+        )
+    return {
+        "capacity_lanes": total_lanes,
+        "live_lanes": live_lanes,
+        # no padded buffers = nothing wasted (not 100% wasted)
+        "wasted_lane_frac": (
+            round(1.0 - live_lanes / total_lanes, 4) if total_lanes else 0.0
+        ),
+        "per_executor": per,
+    }
+
+
+# ---------------------------------------------------------------------------
+# recompile-storm governor
+# ---------------------------------------------------------------------------
+
+
+class ShapeGovernor:
+    """Degrade gracefully instead of wedging when shape stability is
+    violated at runtime anyway (a workload the static lattice proof
+    did not anticipate, an unbucketed third-party executor, ...).
+
+    Fed per barrier from :data:`analysis.jax_sanitizer.SIGNATURES`
+    hazard deltas (one hazard = one post-warmup novel abstract input
+    signature = one future re-trace). Cumulative hazards per executor
+    CLASS above ``RW_FUSION_RECOMPILE_BUDGET`` pin every instance of
+    that class to its max bucket via ``pin_max_bucket()``; while the
+    device sentinel reports SLOW the budget is zero (first hazard
+    throttles — proactive, before the heartbeat goes WEDGED). Each
+    action lands in the meta event log (``shape_governor``) and in
+    ``shape_governor_actions_total{executor,action,reason}``."""
+
+    def __init__(
+        self,
+        budget: Optional[int] = None,
+        enabled: Optional[bool] = None,
+    ):
+        if enabled is None:
+            enabled = os.environ.get(
+                "RW_SHAPE_GOVERNOR", "1"
+            ).strip().lower() not in ("0", "off", "false")
+        self.enabled = enabled
+        self._budget = budget
+        self.hazards: Dict[str, int] = {}
+        self.pinned: Dict[str, Dict] = {}
+
+    @property
+    def budget(self) -> int:
+        if self._budget is not None:
+            return self._budget
+        from risingwave_tpu.analysis.shape_domain import recompile_budget
+
+        return recompile_budget()
+
+    # -- the per-barrier hook --------------------------------------------
+    def observe_barrier(self, target) -> List[str]:
+        """Consume this barrier's hazard deltas and act. ``target`` is
+        a runtime (``.executors()``) or a plain executor list. Costs
+        one attribute check per barrier while SignatureWatch is
+        disarmed. Returns the executor class names pinned this call."""
+        if not self.enabled:
+            return []
+        from risingwave_tpu.analysis.jax_sanitizer import SIGNATURES
+
+        if not SIGNATURES.enabled:
+            return []
+        deltas = SIGNATURES.take_hazard_deltas()
+        if not deltas:
+            return []
+        slow = self._device_slow()
+        budget = 0 if slow else self.budget
+        acted = []
+        for name, n in deltas.items():
+            total = self.hazards.get(name, 0) + n
+            self.hazards[name] = total
+            if name in self.pinned:
+                continue
+            if total > budget:
+                self._pin(
+                    target,
+                    name,
+                    total,
+                    "slow_device" if slow else "budget_exceeded",
+                )
+                acted.append(name)
+        return acted
+
+    @staticmethod
+    def _device_slow() -> bool:
+        try:
+            from risingwave_tpu import blackbox
+
+            return blackbox.SENTINEL.state == blackbox.SLOW
+        except Exception:  # noqa: BLE001 — the governor never faults
+            return False
+
+    def _pin(self, target, name: str, hazards: int, reason: str) -> None:
+        from risingwave_tpu.event_log import EVENT_LOG
+        from risingwave_tpu.metrics import REGISTRY
+
+        executors = (
+            target.executors() if hasattr(target, "executors") else target
+        )
+        pins: List[Dict] = []
+        for ex in executors or ():
+            if type(ex).__name__ != name:
+                continue
+            fn = getattr(ex, "pin_max_bucket", None)
+            if fn is None:
+                continue
+            try:
+                pins.append(fn())
+            except Exception:  # noqa: BLE001 — throttling is best-effort
+                continue
+        action = "pin_max_bucket" if pins else "no_pin_surface"
+        self.pinned[name] = {
+            "hazards": hazards,
+            "reason": reason,
+            "action": action,
+            "pins": pins,
+        }
+        REGISTRY.counter("shape_governor_actions_total").inc(
+            executor=name, action=action, reason=reason
+        )
+        REGISTRY.gauge("shape_governor_pinned").set(float(len(self.pinned)))
+        EVENT_LOG.record(
+            "shape_governor",
+            executor=name,
+            action=action,
+            reason=reason,
+            hazards=hazards,
+            budget=self.budget,
+        )
+
+    def snapshot(self) -> Dict:
+        return {
+            "enabled": self.enabled,
+            "budget": self.budget,
+            "hazards": dict(self.hazards),
+            "pinned": {
+                k: {kk: vv for kk, vv in v.items() if kk != "pins"}
+                for k, v in self.pinned.items()
+            },
+        }
+
+    def reset(self) -> None:
+        self.hazards.clear()
+        self.pinned.clear()

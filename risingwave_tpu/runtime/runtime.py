@@ -32,6 +32,7 @@ fall back to it (and three consecutive fulls raise).
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -140,6 +141,43 @@ class StreamingRuntime:
         PROFILER.from_env()
         # same contract for the black box (RW_BLACKBOX_*)
         blackbox.from_env()
+        # recompile-storm governor (runtime/bucketing.py): per-barrier
+        # SignatureWatch hazard deltas vs RW_FUSION_RECOMPILE_BUDGET;
+        # over budget (or ANY hazard while the device sentinel reports
+        # SLOW) pins the offending executors to their max bucket. Own
+        # instance per runtime — pin state never leaks across runtimes.
+        from risingwave_tpu.runtime.bucketing import ShapeGovernor
+
+        self.shape_governor = ShapeGovernor()
+        # RW_SHAPE_WATCH_WARMUP=<N>: arm SignatureWatch from construction
+        # and mark it stable after N barriers — the env-only way to run
+        # the governor hot in production/soak without code changes
+        self._shape_watch_warmup = 0
+        try:
+            self._shape_watch_warmup = int(
+                os.environ.get("RW_SHAPE_WATCH_WARMUP", "0")
+            )
+        except ValueError:
+            pass
+        if self._shape_watch_warmup > 0:
+            from risingwave_tpu.analysis.jax_sanitizer import SIGNATURES
+
+            if SIGNATURES.enabled:
+                # another runtime (or the bench harness) already owns
+                # the process-global watch: starting it again would
+                # wipe the legitimized shape set mid-run and mint
+                # false hazards — this runtime stands down from watch
+                # management (its governor still consumes deltas)
+                self._shape_watch_warmup = 0
+            else:
+                # pipelined runtimes admit barrier N while epochs
+                # N-k..N-1 are still executing in the closer lane:
+                # stretch warmup by the in-flight depth so mark_stable
+                # only fires once every warmup epoch has actually run
+                # (admission control proves barrier N closed before
+                # N+k is admitted)
+                self._shape_watch_warmup += max(0, in_flight_barriers - 1)
+                SIGNATURES.start()
         # state >> HBM control (the reference's LRU memory controller,
         # src/compute/src/memory/controller.rs role): when accounted
         # device state exceeds the budget, fully-durable groups are
@@ -299,8 +337,6 @@ class StreamingRuntime:
         # (RW_BARRIER_TIMEOUT_S, which device benches raise to cover
         # first-epoch XLA compiles) so a legitimately-compiling barrier
         # never writes a false stall artifact.
-        import os
-
         from risingwave_tpu.runtime.graph import _default_barrier_timeout
 
         try:
@@ -1073,6 +1109,9 @@ class StreamingRuntime:
                 or bool(self._closer_err)
             )
         self._raise_closer_error()
+        # recompile-storm governor rides the admission clock too
+        self._shape_watch_tick()
+        self.shape_governor.observe_barrier(self)
         # the trace is NOT finalized here: admission wall time would
         # inflate achieved_bw to nonsense — the closer lane finalizes
         # it once the epoch actually closed (commit stages land later)
@@ -1223,6 +1262,11 @@ class StreamingRuntime:
             self._commit(self._epoch, tr)
         if self.memory_budget_bytes is not None:
             self._enforce_memory_budget()
+        # recompile-storm governor: consume this barrier's hazard
+        # deltas; over budget (or SLOW device) → pin to max bucket.
+        # One attribute check while SignatureWatch is disarmed.
+        self._shape_watch_tick()
+        self.shape_governor.observe_barrier(self)
         self._end_trace(tr)
         ms = (time.perf_counter() - t0) * 1e3
         self.barrier_latencies_ms.append(ms)
@@ -1233,6 +1277,18 @@ class StreamingRuntime:
             # threshold leaves a PROFILE_* artifact + forensic dump
             PROFILER.observe_barrier(ms, runtime=self)
         return outs
+
+    def _shape_watch_tick(self) -> None:
+        """RW_SHAPE_WATCH_WARMUP bookkeeping: after N barriers the
+        armed SignatureWatch turns stable — every later novel shape is
+        a hazard the governor may act on."""
+        if self._shape_watch_warmup <= 0:
+            return
+        self._shape_watch_warmup -= 1
+        if self._shape_watch_warmup == 0:
+            from risingwave_tpu.analysis.jax_sanitizer import SIGNATURES
+
+            SIGNATURES.mark_stable()
 
     # -- EpochTrace plumbing ---------------------------------------------
     def _begin_trace(self, is_ckpt: bool) -> EpochTrace:

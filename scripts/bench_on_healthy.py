@@ -15,6 +15,16 @@ ALIVE/SLOW/WEDGED trajectory even when the child is later killed and
 its stdout lost. Results are left on disk for the builder to commit;
 BENCH_WATCH.log records every attempt either way.
 
+Round resume: a bench attempt that dies mid-round (tunnel loss — r04
+and r05 lost ALL artifacts this way) no longer abandons the round. The
+watcher keeps a ``.bench_round.json`` marker (round start time +
+attempt count); the next healthy window relaunches bench.py with
+``RW_BENCH_RESUME=1`` + ``RW_BENCH_ROUND_START`` so it re-probes the
+device, SKIPS the queries already banked to ``BENCH_<q>.json`` since
+the round began, measures only what is missing, and stamps the merged
+artifact with a ``resumed_from`` marker. A clean exit closes the
+round; the next launch starts fresh (everything re-measured).
+
 Usage: python scripts/bench_on_healthy.py  (backgrounded, SIGTERM-safe)
 """
 
@@ -32,8 +42,10 @@ MARKER = os.path.join(REPO, ".tpu_healthy")
 BUSY = os.path.join(REPO, ".bench_running")
 LOG = os.path.join(REPO, "BENCH_WATCH.log")
 SENTINEL_STATE = os.path.join(REPO, "SENTINEL_STATE.json")
+ROUND_STATE = os.path.join(REPO, ".bench_round.json")
 COOLDOWN_S = 1800  # after a bench attempt, let the prober re-establish
 HEARTBEAT_POLL_S = 15
+MAX_RESUME_ATTEMPTS = 4  # then the round is abandoned and starts fresh
 
 
 def log(msg: str) -> None:
@@ -72,12 +84,44 @@ def tail_sentinel(last: dict) -> dict:
     return last
 
 
-def run_bench() -> int:
+def load_round() -> dict:
+    """The in-flight round marker, or {} (no round open / torn file)."""
+    try:
+        with open(ROUND_STATE) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def save_round(state: dict) -> None:
+    try:
+        tmp = ROUND_STATE + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, ROUND_STATE)
+    except OSError:
+        pass  # round tracking is best-effort; a fresh round still works
+
+
+def close_round() -> None:
+    try:
+        os.remove(ROUND_STATE)
+    except OSError:
+        pass
+
+
+def run_bench(resume: bool, round_start: float) -> int:
     """Launch bench.py and babysit it: poll + tail the sentinel status
     while it runs; SIGTERM (never SIGKILL — a murdered client wedges
-    the relay) at the 90min backstop."""
+    the relay) at the 90min backstop. ``resume`` re-enters the current
+    round: bench.py re-probes and skips queries banked since
+    ``round_start``."""
     t0 = time.monotonic()
-    proc = subprocess.Popen([sys.executable, "bench.py"], cwd=REPO)
+    env = dict(os.environ)
+    if resume:
+        env["RW_BENCH_RESUME"] = "1"
+    env["RW_BENCH_ROUND_START"] = repr(round_start)
+    proc = subprocess.Popen([sys.executable, "bench.py"], cwd=REPO, env=env)
     last: dict = {}
     while True:
         rc = proc.poll()
@@ -99,13 +143,43 @@ def main() -> None:
     log("watcher up")
     while True:
         if os.path.exists(MARKER) and not os.path.exists(BUSY):
-            log("tunnel healthy -> launching bench.py")
+            rnd = load_round()
+            resume = bool(rnd)
+            if resume and rnd.get("attempts", 0) >= MAX_RESUME_ATTEMPTS:
+                log(
+                    f"round abandoned after {rnd['attempts']} attempts; "
+                    "starting fresh"
+                )
+                close_round()
+                rnd, resume = {}, False
+            if not resume:
+                rnd = {"started": time.time(), "attempts": 0}
+            rnd["attempts"] = rnd.get("attempts", 0) + 1
+            save_round(rnd)
+            log(
+                "tunnel healthy -> launching bench.py"
+                + (
+                    f" (RESUMING round started {rnd['started']:.0f}, "
+                    f"attempt {rnd['attempts']}: banked BENCH_<q>.json "
+                    "queries will be skipped)"
+                    if resume
+                    else ""
+                )
+            )
             t0 = time.monotonic()
-            rc = run_bench()
+            rc = run_bench(resume, float(rnd.get("started", 0.0)))
             log(
                 f"bench.py exited rc={rc} after "
                 f"{time.monotonic() - t0:.0f}s — check BENCH_partial.json"
             )
+            if rc == 0:
+                close_round()
+                log("round complete")
+            else:
+                log(
+                    "round INCOMPLETE — will resume (skipping banked "
+                    "queries) on the next healthy window"
+                )
             time.sleep(COOLDOWN_S)
         time.sleep(45)
 

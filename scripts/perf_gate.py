@@ -110,6 +110,41 @@ def check_bench(bench: dict, budgets: dict, verbose=True):
             )
         else:
             note(f"{q}: {got} dispatches/barrier <= {mx} ok")
+    # steady-state recompile-hazard budget (PR 9): after warmup, ZERO
+    # novel abstract input signatures per query — a nonzero count means
+    # a shape escaped the bucket lattice and the run was re-tracing
+    for q, mx in b.get("recompile_hazards_max", {}).items():
+        key = f"{q}_recompile_hazards"
+        if key not in bench:
+            skipped.append(f"{key}: absent from artifact")
+            continue
+        got = float(bench[key])
+        if got > mx:
+            violations.append(
+                f"{q}: {got:.0f} post-warmup recompile hazards > budget "
+                f"{mx} (shape escaped the bucket lattice — see "
+                f"{q}_shape_governor in the artifact)"
+            )
+        else:
+            note(f"{q}: {got:.0f} recompile hazards <= {mx} ok")
+    # padding-overhead backstop: the price of bucketed shapes is
+    # masked dead lanes; a pathological wasted-lane fraction (e.g. the
+    # governor pinning everything at a huge bucket) must not land
+    # silently. Calibrated loose: pow2 tables at <=50% load are >=50%
+    # padding BY DESIGN.
+    for q, mx in b.get("padding_wasted_frac_max", {}).items():
+        blk = bench.get(f"{q}_padding")
+        if not isinstance(blk, dict) or "wasted_lane_frac" not in blk:
+            skipped.append(f"{q}_padding: absent from artifact")
+            continue
+        got = float(blk["wasted_lane_frac"])
+        if got > mx:
+            violations.append(
+                f"{q}: padded-state wasted-lane fraction {got} > "
+                f"budget {mx}"
+            )
+        else:
+            note(f"{q}: wasted-lane fraction {got} <= {mx} ok")
     # executor-attribution coverage: when the artifact carries the
     # per-executor decomposition it must actually explain the dispatch
     # stage (≥ coverage_min of the stage total), or the breakdown has
@@ -254,6 +289,32 @@ def run_fusion_gate(
             if total > mx:
                 violations.append(
                     f"fusion {q}: {total} host-sync points > budget {mx}"
+                )
+        # shape-stability ratchet (PR 9): per-code blocker ceilings —
+        # RW-E803/E806 are pinned at ZERO for the whole corpus (q7's
+        # wedge class must never return), and no code may regress
+        # above its committed-baseline count
+        cur_codes = current[q]["summary"].get("blockers_by_code", {})
+        base_codes = base_rep.get("summary", {}).get(
+            "blockers_by_code", {}
+        )
+        for code, mx in fb.get("max_blocker_codes", {}).items():
+            got = int(cur_codes.get(code, 0))
+            if got > mx:
+                violations.append(
+                    f"fusion {q}: {got} {code} finding(s) > budget {mx}"
+                    + (
+                        " (the q7 wedge class regressed: an executor "
+                        "lost its window_buckets lattice)"
+                        if code in ("RW-E803", "RW-E806")
+                        else ""
+                    )
+                )
+        for code, n in cur_codes.items():
+            if int(n) > int(base_codes.get(code, 0)):
+                violations.append(
+                    f"fusion {q}: blocker {code} count grew "
+                    f"{base_codes.get(code, 0)} -> {n} vs baseline"
                 )
     return violations, skipped
 

@@ -143,17 +143,24 @@ def test_fully_fusible_chain_whole_fragment_proof():
 
 
 def test_e803_q7_window_path():
-    """The q7 wedge class statically: the unbucketed-window plan must
-    yield RW-E803 with exact executor provenance on both the dynamic
-    max filter and the join."""
+    """The q7 wedge class statically: the deliberately-UNBUCKETED twin
+    (``build_q7(bucketed=False)`` — the legacy unbounded-rehash path)
+    must yield RW-E803 with exact executor provenance on both the
+    dynamic max filter and the join; the SHIPPED bucketed q7 (the lint
+    corpus) must be clean — its executors declare the allocator's pow2
+    lattice (runtime/bucketing.py)."""
     from risingwave_tpu.analysis.lint import (
         NEXMARK_SOURCE_SCHEMAS,
         build_nexmark_corpus,
     )
+    from risingwave_tpu.queries.nexmark_q import build_q7
 
-    q7 = build_nexmark_corpus(only="q7")["q7"]
+    twin = build_q7(
+        capacity=1 << 8, agg_capacity=1 << 8, filter_capacity=1 << 8,
+        out_cap=1 << 8, bucketed=False,
+    )
     reports = analyze_pipeline(
-        q7.pipeline, NEXMARK_SOURCE_SCHEMAS["q7"], "q7"
+        twin.pipeline, NEXMARK_SOURCE_SCHEMAS["q7"], "q7twin"
     )
     e803 = [
         d
@@ -165,6 +172,18 @@ def test_e803_q7_window_path():
     provs = {d.executor for d in e803}
     assert any("DynamicMaxFilterExecutor" in p for p in provs), provs
     assert any("HashJoinExecutor" in p for p in provs), provs
+    # the shipped (bucketed) corpus q7 walks free of the wedge class —
+    # the PR-9 acceptance bar: zero RW-E803/E806 on q7's fragments
+    q7 = build_nexmark_corpus(only="q7")["q7"]
+    q7_reports = analyze_pipeline(
+        q7.pipeline, NEXMARK_SOURCE_SCHEMAS["q7"], "q7"
+    )
+    assert not [
+        d
+        for r in q7_reports
+        for d in r.diagnostics
+        if d.code in ("RW-E803", "RW-E806")
+    ]
     # q5's windowed agg declares its two-capacity flush lattice: the
     # SAME window machinery, bucketed, must NOT flag
     q5 = build_nexmark_corpus(only="q5")["q5"]
@@ -257,9 +276,10 @@ def test_perf_gate_fusion_clean_and_regression(tmp_path):
 
 
 def test_ddl_fusion_findings_and_strict_gate(monkeypatch):
-    """Report-only by default; RW_STRICT_FUSION=1 refuses E803 plans
-    at CREATE MV (only on window-keyed plans — that is the only code
-    the DDL hook records)."""
+    """Strict-fusion is ON BY DEFAULT now that the bucketing layer
+    exists: an unbucketed (E803) window-keyed plan is refused at
+    CREATE MV; the shipped bucketed q7 sails through; and
+    RW_STRICT_FUSION=0 restores report-only mode."""
     from risingwave_tpu.analysis.diagnostics import PlanLintError
     from risingwave_tpu.analysis.lint import fusion_findings_for_ddl
     from risingwave_tpu.frontend.session import SqlSession
@@ -267,18 +287,35 @@ def test_ddl_fusion_findings_and_strict_gate(monkeypatch):
     from risingwave_tpu.runtime import StreamingRuntime
     from risingwave_tpu.sql import Catalog
 
-    q7 = build_q7(capacity=1 << 8, agg_capacity=1 << 8,
-                  filter_capacity=1 << 8, out_cap=1 << 8)
+    twin = build_q7(capacity=1 << 8, agg_capacity=1 << 8,
+                    filter_capacity=1 << 8, out_cap=1 << 8,
+                    bucketed=False)
 
     class Shim:
         name = "q7"
-        pipeline = q7.pipeline
+        pipeline = twin.pipeline
 
     diags = fusion_findings_for_ddl(Shim())
     assert diags and all(d.code == "RW-E803" for d in diags)
 
+    q7 = build_q7(capacity=1 << 8, agg_capacity=1 << 8,
+                  filter_capacity=1 << 8, out_cap=1 << 8)
+
+    class CleanShim:
+        name = "q7clean"
+        pipeline = q7.pipeline
+
+    assert fusion_findings_for_ddl(CleanShim()) == []
+
     session = SqlSession(Catalog({}), StreamingRuntime(store=None))
-    # report-only default: records, never raises
+    monkeypatch.delenv("RW_STRICT_FUSION", raising=False)
+    # strict by default: the wedge class is refused at CREATE MV
+    with pytest.raises(PlanLintError):
+        session._fusion_lint(Shim(), strict=True)
+    # ... but the bucketed plan is not
+    session._fusion_lint(CleanShim(), strict=True)
+    # RW_STRICT_FUSION=0: report-only (records, never raises)
+    monkeypatch.setenv("RW_STRICT_FUSION", "0")
     session._fusion_lint(Shim(), strict=True)
     assert any(
         d.code == "RW-E803" for _n, d in session.lint_findings
@@ -328,7 +365,9 @@ def test_signature_watch_records_shape_bucket():
 
 def test_lint_cli_fusion_report_json(capsys):
     """python -m risingwave_tpu lint --fusion-report --all-nexmark
-    --json: classifies every fragment; q7 statically yields RW-E803."""
+    --json: classifies every fragment; the bucketed corpus carries
+    ZERO RW-E803/E806 (the PR-9 acceptance bar) while the E801
+    host-sync worklist remains visible."""
     import argparse
 
     from risingwave_tpu.analysis.lint import run_cli
@@ -345,8 +384,14 @@ def test_lint_cli_fusion_report_json(capsys):
     assert rc == 0
     fus = out["__fusion__"]
     assert set(fus) == {"q5", "q7", "q8"}
+    for q in fus:
+        assert not any(
+            b["code"] in ("RW-E803", "RW-E806")
+            for fr in fus[q]["fragments"]
+            for b in fr["blockers"]
+        ), q
     assert any(
-        b["code"] == "RW-E803"
+        b["code"] == "RW-E801"
         for fr in fus["q7"]["fragments"]
         for b in fr["blockers"]
     )
