@@ -207,31 +207,56 @@ def _governor_tick(executors):
         _BENCH_GOV.observe_barrier(executors)
 
 
+def _arm_fusion(pipeline, label):
+    """Arm the fused per-barrier step (runtime/fused_step) on a bench
+    pipeline — serial pipelines fuse here; the unified q5u path fuses
+    inside the graph runtime automatically. RW_FUSED_STEP=0 opts out
+    (the interpreted-twin baseline runs)."""
+    from risingwave_tpu.runtime.fused_step import fuse_pipeline, fused_enabled
+
+    if not fused_enabled():
+        return []
+    return fuse_pipeline(pipeline, label=label)
+
+
+def _fused_fields(prefix, pipeline):
+    """Every BENCH JSON carries ``{q}_fused_fragments`` (count +
+    whole-chain flag + fragment labels): the artifact says how much of
+    the measured pipeline ran as one donated device program."""
+    from risingwave_tpu.runtime.fused_step import fused_fragments
+
+    return {f"{prefix}_fused_fragments": fused_fragments(pipeline)}
+
+
+def _expand(executors):
+    """Fused wrappers hide their members from plain executor lists;
+    padding/governor surfaces need the members themselves."""
+    from risingwave_tpu.runtime.fused_step import expand_fused
+
+    return expand_fused(executors)
+
+
 def _profile_begin():
     """Arm the dispatch-wall profiler for the measured run: every BENCH
     JSON carries the per-executor decomposition of the dispatch stage
     (executor_ms + device-wait), dispatches-per-barrier/row, and
     host<->device transfer counts — the ranked fusion worklist for
     ROADMAP open item 1. Fencing (per-call block_until_ready — the
-    host/device split) is armed ONLY on CPU: on a real device it would
-    serialize the async overlap the pipeline engineered and make the
-    timed numbers incomparable with unfenced artifacts. Force it with
-    RW_BENCH_PROFILE_FENCE=1; opt out of profiling entirely with
+    host/device split) is OFF by default on every backend: it
+    serializes the async dispatch the fused step exists to exploit,
+    re-attributing device compute into the walk and poisoning the
+    ``barrier_stage_ms`` dispatch/device_step split the perf gate
+    ratchets. Force it with RW_BENCH_PROFILE_FENCE=1 when the per-
+    executor device-wait decomposition matters more than honest stage
+    attribution; opt out of profiling entirely with
     RW_BENCH_PROFILE=0."""
     import os
 
     if os.environ.get("RW_BENCH_PROFILE", "1") == "0":
         return None
-    import jax
-
     from risingwave_tpu.profiler import PROFILER
 
-    fence_env = os.environ.get("RW_BENCH_PROFILE_FENCE")
-    fence = (
-        fence_env != "0"
-        if fence_env is not None
-        else jax.default_backend() == "cpu"
-    )
+    fence = os.environ.get("RW_BENCH_PROFILE_FENCE") == "1"
     PROFILER.reset()
     return PROFILER.enable(fence=fence)
 
@@ -393,11 +418,13 @@ def bench_q8(gen_cfg, epochs, events_per_epoch, chunk_events):
     # persons+auctions ~8%% of events, all retained
     c8 = _state_cap(int(epochs * events_per_epoch * 0.09), 1 << 16)
     q8 = build_q8(capacity=c8, fanout=8, out_cap=1 << 14)
+    _arm_fusion(q8.pipeline, "q8")
     # warmup epoch compiles every kernel, then fresh state + warm caches
     for side, c in chunks[0]:
         (q8.pipeline.push_left if side == "p" else q8.pipeline.push_right)(c)
     q8.pipeline.barrier()
     q8 = build_q8(capacity=c8, fanout=8, out_cap=1 << 14)
+    _arm_fusion(q8.pipeline, "q8")
     recompiles = _recompile_watch()
     _shape_watch_stable()  # post-warmup novelty = recompile hazard
     from risingwave_tpu.metrics import REGISTRY
@@ -414,7 +441,8 @@ def bench_q8(gen_cfg, epochs, events_per_epoch, chunk_events):
         q8.pipeline.barrier()
         barrier_times.append(time.perf_counter() - tb)
         _governor_tick(
-            list(q8.pipeline.left) + list(q8.pipeline.right) + [q8.join]
+            _expand(list(q8.pipeline.left) + list(q8.pipeline.right))
+            + [q8.join]
         )
     jax.block_until_ready(q8.join.left.row_valid)
     dt = time.perf_counter() - t0
@@ -441,12 +469,15 @@ def bench_q8(gen_cfg, epochs, events_per_epoch, chunk_events):
         "q8_fusion": fusion,
         "q8_barrier_stage_ms": stage_breakdown(),
         **_profile_fields("q8", prof, len(barrier_times), total_rows),
+        **_fused_fields("q8", q8.pipeline),
         **_shape_fields(
             "q8",
-            list(q8.pipeline.left)
-            + list(q8.pipeline.right)
-            + [q8.join]
-            + list(q8.pipeline.tail),
+            _expand(
+                list(q8.pipeline.left)
+                + list(q8.pipeline.right)
+                + [q8.join]
+                + list(q8.pipeline.tail)
+            ),
         ),
     }
 
@@ -517,7 +548,7 @@ def bench_q7(gen_cfg, epochs, events_per_epoch, chunk_events):
     ]
 
     def run(q7, chunks):
-        execs = (
+        execs = _expand(
             list(q7.pipeline.left)
             + list(q7.pipeline.right)
             + [q7.join]
@@ -546,13 +577,17 @@ def bench_q7(gen_cfg, epochs, events_per_epoch, chunk_events):
     # watermarks bound q7 state to open windows, but the growth
     # heuristic is volume-driven: margin must cover one epoch's pushes
     c7 = _state_cap(events_per_epoch, 1 << 16)
-    mk_q7 = lambda: build_q7(
-        capacity=c7,
-        fanout=16,
-        out_cap=1 << 14,
-        agg_capacity=c7,
-        filter_capacity=c7,
-    )
+    def mk_q7():
+        q7 = build_q7(
+            capacity=c7,
+            fanout=16,
+            out_cap=1 << 14,
+            agg_capacity=c7,
+            filter_capacity=c7,
+        )
+        _arm_fusion(q7.pipeline, "q7")
+        return q7
+
     q7 = mk_q7()
     run(q7, mk()[:1])  # warmup epoch: compile everything
 
@@ -591,14 +626,17 @@ def bench_q7(gen_cfg, epochs, events_per_epoch, chunk_events):
         "q7_fusion": fusion,
         "q7_barrier_stage_ms": stage_breakdown(),
         **_profile_fields("q7", prof, len(barrier_times), total_bids),
+        **_fused_fields("q7", q7.pipeline),
         # AFTER profiler disarm: padding stats read device occupancy
         # counters and must not pollute the steady-state transfer counts
         **_shape_fields(
             "q7",
-            list(q7.pipeline.left)
-            + list(q7.pipeline.right)
-            + [q7.join]
-            + list(q7.pipeline.tail),
+            _expand(
+                list(q7.pipeline.left)
+                + list(q7.pipeline.right)
+                + [q7.join]
+                + list(q7.pipeline.tail)
+            ),
         ),
     }
 
@@ -682,7 +720,7 @@ def bench_q5_unified(epochs, events_per_epoch, chunk_events, smoke):
         tb = time.perf_counter()
         mv.pipeline.barrier()
         barrier_times.append(time.perf_counter() - tb)
-        _governor_tick(list(mv.pipeline.executors))
+        _governor_tick(_expand(list(mv.pipeline.executors)))
     dt = time.perf_counter() - t0
     # measured roofline (PROFILE.md "measured vs modeled"): HBM bytes
     # actually moved this run = chunks pushed + live executor state
@@ -704,8 +742,10 @@ def bench_q5_unified(epochs, events_per_epoch, chunk_events, smoke):
     # pipelined phase below runs unprofiled — the breakdown must
     # describe the same run as stages_sync)
     prof_fields = _profile_fields("q5u", prof, len(barrier_times), total_bids)
-    # before close(): padding stats read live executor occupancy
-    shape_fields = _shape_fields("q5u", list(mv.pipeline.executors))
+    # before close(): fused evidence scans live actors, padding stats
+    # read live executor occupancy
+    fused_fields = _fused_fields("q5u", mv.pipeline)
+    shape_fields = _shape_fields("q5u", _expand(list(mv.pipeline.executors)))
     snap = mv.mview.snapshot()  # {(auction, window_start): (num,)}
     ok = snap == {k: (v,) for k, v in cpu_counts.items()}
     mv.pipeline.close()
@@ -767,6 +807,7 @@ def bench_q5_unified(epochs, events_per_epoch, chunk_events, smoke):
         "hbm_peak_gbps": rf["hbm_peak_gbps"],
         "hbm_bytes_touched": rf["hbm_bytes_touched"],
         **prof_fields,
+        **fused_fields,
         **shape_fields,
     }
 
@@ -845,6 +886,7 @@ def bench_q5(args_epochs, events_per_epoch, chunk_events, smoke, agg_mode):
 
         if q5 is None:
             q5 = build_q5_lite(capacity=c5, state_cleaning=False)
+            _arm_fusion(q5.pipeline, "q5")
         barrier_times = []
         t0 = time.perf_counter()
         for stacked in epochs_chunks:
@@ -879,6 +921,7 @@ def bench_q5(args_epochs, events_per_epoch, chunk_events, smoke, agg_mode):
     # dispatch counts, not construction)
     stacked = mk_stacked()
     q5_fresh = build_q5_lite(capacity=c5, state_cleaning=False)
+    _arm_fusion(q5_fresh.pipeline, "q5")
     prof = _profile_begin()
     q5, dt, barrier_times = run_q5(stacked, q5_fresh)
 
@@ -924,7 +967,8 @@ def bench_q5(args_epochs, events_per_epoch, chunk_events, smoke, agg_mode):
         "q5_recompiles": recompiles.deltas(),
         "q5_fusion": fusion,
         **_profile_fields("q5", prof, len(barrier_times), total_bids),
-        **_shape_fields("q5", list(q5.pipeline.executors)),
+        **_fused_fields("q5", q5.pipeline),
+        **_shape_fields("q5", _expand(list(q5.pipeline.executors))),
     }
 
 

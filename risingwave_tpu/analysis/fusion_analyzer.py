@@ -330,6 +330,11 @@ class ExecutorClass:
     sync_points: List[SyncPoint] = field(default_factory=list)
     signatures: int = 0  # distinct jaxpr signatures over the lattice
     staged_reads: int = 0
+    # host syncs inside contract-declared ``fallback_syncs`` methods:
+    # the fused per-barrier step compiles a device-side replacement
+    # for those methods, so their reads exist only on the interpreted
+    # fallback path — reported, never a fusibility blocker
+    fallback_sync_points: List[SyncPoint] = field(default_factory=list)
     est_cost_ms: Optional[float] = None  # measured, when profile given
     est_dispatches: Optional[float] = None  # measured device dispatches
 
@@ -341,6 +346,9 @@ class ExecutorClass:
             "fusible": self.fusible,
             "signatures": self.signatures,
             "staged_reads": self.staged_reads,
+            "fallback_sync_points": [
+                s.render() for s in self.fallback_sync_points
+            ],
             "est_cost_ms": self.est_cost_ms,
             "est_dispatches": self.est_dispatches,
             "blockers": [
@@ -426,11 +434,27 @@ def classify_executor(
     ec.staged_reads = staged_reads(ex)
 
     # -- host-sync scan (both kinds: a "device" claim is verified) ----
+    # ``fallback_syncs`` methods are scanned SEPARATELY: the fused
+    # per-barrier step compiles a device-resident replacement for them
+    # (e.g. HashAgg's flush -> fused_step's in-program delta
+    # extraction, proven equivalent by the fused-vs-interpreted twin
+    # suite), so the fusibility verdict excludes them. NOTE the
+    # verdict is a CAPABILITY claim — "this chain can compile into
+    # one step" — not a promise the runtime fuses it: fuse_chain may
+    # still pick the interpreted/epoch-batched fallback (e.g. an agg
+    # feeding an interpreted join), where these reads DO run per
+    # barrier. They stay visible as ``fallback_sync_points`` and
+    # perf_gate ratchets them (must never grow vs the baseline).
+    fallback = tuple(contract.get("fallback_syncs", ()))
     ec.sync_points = scan_host_syncs(
         ex,
         contract.get("hot_methods", ()),
-        contract.get("scan_exclude", ()),
+        tuple(contract.get("scan_exclude", ())) + fallback,
     )
+    for m in fallback:
+        ec.fallback_sync_points.extend(
+            _scan_method(type(ex), m, set())
+        )
     for s in ec.sync_points:
         blocker("RW-E801", s.render())
     if ec.kind == "host":
@@ -623,6 +647,9 @@ class FragmentReport:
             "chain_len": len(self.executors),
             "whole_chain_fusible": self.whole_chain_fusible,
             "host_sync_points": self.host_sync_points,
+            "fallback_sync_points": sum(
+                len(e.fallback_sync_points) for e in self.executors
+            ),
             "est_savings_ms": (
                 round(sum(blocked), 3) if blocked else None
             ),

@@ -154,6 +154,13 @@ class Executor:
         - ``hot_methods``: extra method names the host-sync scan must
           cover beyond apply/apply_left/apply_right/on_barrier/
           on_watermark.
+        - ``fallback_syncs``: method names whose host syncs exist ONLY
+          on the interpreted fallback path because the fused
+          per-barrier step (runtime/fused_step) compiles a
+          device-resident replacement for them (equivalence enforced
+          by the fused-vs-interpreted twin tests). The analyzer
+          reports them as ``fallback_sync_points`` instead of
+          fusibility blockers.
         """
         step = self.pure_step()
         if step is None:

@@ -15,6 +15,15 @@ Emission semantics are unchanged: HashAgg emits only at barriers /
 watermarks, and the wrapper flushes its buffer before delegating either,
 so downstream executors observe byte-identical streams.
 
+Since the fused per-barrier step landed (runtime/fused_step.py), this
+wrapper is the designated FALLBACK for agg runs the fused program
+cannot absorb whole: an agg whose flush EXITS to an interpreted
+consumer (a join) keeps its exact-sliced interpreted flush but still
+gets the one-device-program-per-epoch apply path through this
+wrapper. ``ComposedSteps`` and ``_compose_lint_infos`` below are
+shared with the fused step (same value-hashing compile discipline,
+same composed-metadata rules).
+
 Compile discipline (see docs in array/chunk.py): the stacked leading
 axis is padded to a power of two, so at most log2(max chunks/epoch)
 distinct programs exist per chunk signature.

@@ -62,4 +62,8 @@ class FilterExecutor(Executor):
         return {"requires": tuple(sorted(collect_columns(self.pred)))}
 
     def pure_step(self):
+        # the fused-chain contract (runtime/fused_step + epoch_batch):
+        # a module-level partial with hashable bound args, so the predicate
+        # traces into the fused per-barrier program and compiles once
+        # per plan shape, not once per executor instance
         return partial(_filter_step, pred=self._spred)

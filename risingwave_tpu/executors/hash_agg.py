@@ -45,9 +45,17 @@ from risingwave_tpu.storage.state_table import (
 from risingwave_tpu.ops import agg as agg_ops
 from risingwave_tpu.ops import minput as mi_ops
 from risingwave_tpu.ops.agg import AggCall, AggState
-from risingwave_tpu.ops.hash_table import HashTable, lookup, lookup_or_insert, plan_rehash, read_scalars, stage_scalars, set_live
+from risingwave_tpu.ops.hash_table import HashTable, lookup, lookup_or_insert, stage_scalars, set_live
+from risingwave_tpu.runtime.bucketing import BucketAllocator, BucketPolicy
 
 GROW_AT = 0.5  # rehash when claimed slots may exceed this load factor
+# mid-epoch rebuild only when the HOST insert bound nears the table
+# itself (genuine MAX_PROBE overflow risk): padded upstream chunks
+# (agg/join full-pad emissions) make the mid-epoch bound wildly
+# pessimistic, so ordinary load-factor growth resolves at the barrier
+# from the TRUE occupancy note instead. MAX_PROBE=64 keeps inserts
+# safe well past this load.
+HARD_GROW_AT = 0.75
 
 
 def _build_key_lanes(
@@ -403,6 +411,40 @@ def _expire(
     return table, state
 
 
+def delta_to_chunk(
+    delta: dict,
+    group_keys: Tuple[str, ...],
+    nullable: Tuple[bool, ...],
+    calls: Tuple[AggCall, ...],
+    pad: Optional[int] = None,
+) -> StreamChunk:
+    """``agg_ops.flush`` delta dict -> StreamChunk, optionally sliced
+    to ``pad`` lanes. The ONE decoder of the flush delta lane-naming
+    contract (key{i} interleaving, ``<output>__isnull`` companions,
+    ops/valid lanes): the interpreted ``_delta_to_chunk`` slicing and
+    the fused per-barrier program's in-trace twin
+    (runtime/fused_step._fused_barrier_fn) both call it, so the two
+    paths cannot drift apart. Pure over jnp arrays — traceable."""
+    sl = (lambda a: a[:pad]) if pad is not None else (lambda a: a)
+    cols, nulls = {}, {}
+    i = 0
+    for name, nb in zip(group_keys, nullable):
+        cols[name] = sl(delta[f"key{i}"])
+        i += 1
+        if nb:
+            nulls[name] = sl(delta[f"key{i}"])
+            i += 1
+    for c in calls:
+        cols[c.output] = sl(delta[c.output])
+        lane = delta.get(c.output + "__isnull")
+        if lane is not None:
+            nulls[c.output] = sl(lane)
+    return StreamChunk(
+        columns=cols, valid=sl(delta["valid"]), nulls=nulls,
+        ops=sl(delta["ops"]),
+    )
+
+
 class HashAggExecutor(Executor, Checkpointable):
     """Streaming GROUP BY.
 
@@ -446,6 +488,21 @@ class HashAggExecutor(Executor, Checkpointable):
         self.state = agg_ops.create_state(capacity, self.calls, self._dtypes)
         self.dropped = jnp.zeros((), jnp.bool_)
         self._insert_bound = 0  # host-side upper bound of claimed slots
+        self._occ_note = 0  # true claimed at the last barrier (staged read)
+        # host-side upper bound of dirty (unflushed) groups: rows
+        # absorbed since the last flush + conservatively the whole
+        # table on a retracting expiry. Drives the fixed flush-round
+        # count so the per-barrier flush needs ZERO device reads (the
+        # old status-read loop was RW-E801 at the top of the fusion
+        # worklist).
+        self._dirty_bound = 0
+        # shape-stability: capacity walks the allocator's pow2 lattice;
+        # growth decisions consume the occupancy note staged at the
+        # previous barrier (see _maybe_grow) instead of a synchronous
+        # device read
+        self._buckets = BucketAllocator(
+            BucketPolicy.from_capacity(capacity, grow_at=GROW_AT)
+        )
         self.window_key = window_key
         self._float_extremes = agg_ops.float_extreme_meta(
             self.calls, {k: jnp.dtype(v) for k, v in self._dtypes.items()}
@@ -457,12 +514,35 @@ class HashAggExecutor(Executor, Checkpointable):
         )
         self.mi_bad = jnp.zeros((), jnp.bool_)
         # cold tier: set by the runtime to CheckpointManager.get_rows so
-        # evicted (durable) groups fold back in on their next touch
-        self.cold_reader = None
+        # evicted (durable) groups fold back in on their next touch.
+        # Assigning the ``cold_reader`` property binds the cold-tier
+        # hooks below; while it is None the hot path (apply/on_barrier/
+        # on_watermark) is provably host-sync free — the fault-in /
+        # merge helpers with their NumPy fallbacks are unreachable, so
+        # the fusion analyzer's AST scan of the hot methods holds for
+        # exactly the configurations it analyzes.
+        self._cold_reader = None
+        self._cold_apply_hook = None  # _fault_in when armed
+        self._cold_stacked_hook = None  # _fault_in_all when armed
+        self._cold_barrier_hook = None  # _merge_cold when armed
+        self._cold_expire_hook = None  # _expire_evicted when armed
         # with minput, merge-at-barrier cannot fold multisets back in
         # (a delete pre-merge would falsely latch inconsistent), so
         # evicted keys fault in ON TOUCH via this host-side set
         self._evicted: set = set()
+
+    @property
+    def cold_reader(self):
+        return self._cold_reader
+
+    @cold_reader.setter
+    def cold_reader(self, fn) -> None:
+        self._cold_reader = fn
+        armed = fn is not None
+        self._cold_apply_hook = self._fault_in if armed else None
+        self._cold_stacked_hook = self._fault_in_all if armed else None
+        self._cold_barrier_hook = self._merge_cold if armed else None
+        self._cold_expire_hook = self._expire_evicted if armed else None
 
     def lint_info(self):
         emits = {k: self._dtypes.get(k) for k in self.group_keys}
@@ -499,7 +579,7 @@ class HashAggExecutor(Executor, Checkpointable):
         # bucket lattice that keeps the windowed agg shape-stable
         full = 2 * self.out_cap
         caps = tuple(sorted({min(256, full), full}))
-        return {
+        contract = {
             "kind": "device",
             "trace_step": lambda c: _agg_step(
                 self.table,
@@ -515,6 +595,31 @@ class HashAggExecutor(Executor, Checkpointable):
             "emission": "bucketed",
             "emission_caps": caps,
             "window_buckets": caps,
+            # the interpreted flush pays one packed status read per
+            # round; the fused per-barrier step compiles its own
+            # device-side flush (runtime/fused_step._fused_barrier_fn)
+            # and never calls this method — the analyzer scores its
+            # syncs as fallback-only, outside the fusibility verdict
+            "fallback_syncs": ("_flush_all",),
+        }
+        if self._cold_reader is not None:
+            # the cold tier splices host-side fault-in/merge back into
+            # the data path: an ARMED instance must be scanned honestly
+            # (the corpus twins the analyzer proves are never armed)
+            contract["hot_methods"] = (
+                "_fault_in",
+                "_fault_in_all",
+                "_merge_cold",
+                "_expire_evicted",
+            )
+        return contract
+
+    def pin_max_bucket(self):
+        """ShapeGovernor hook: freeze the group table at its high-water
+        bucket (shrink disabled; regrow applied by the next apply)."""
+        return {
+            "table_id": self.table_id,
+            "pinned_cap": self._buckets.pin(),
         }
 
     def padding_stats(self):
@@ -535,10 +640,11 @@ class HashAggExecutor(Executor, Checkpointable):
                     f"group key {k!r} carries a null lane but was not "
                     "declared in nullable_keys"
                 )
-        if self._evicted:
-            self._fault_in(chunk)
+        if self._cold_apply_hook is not None:
+            self._cold_apply_hook(chunk)
         self._maybe_grow(chunk.capacity)
         self._insert_bound += chunk.capacity
+        self._dirty_bound += chunk.capacity
         if self.minput:
             (
                 self.table,
@@ -587,11 +693,11 @@ class HashAggExecutor(Executor, Checkpointable):
             kept for differential testing and for plans that need
             strict intra-epoch chunk ordering.
         """
-        if self._evicted:
+        if self._cold_stacked_hook is not None:
             # the epoch-batched path cannot see per-chunk keys before
             # the fused program runs (pre is traced in): restore every
             # evicted group up front — correct, if conservative
-            self._fault_in_all()
+            self._cold_stacked_hook()
         n_chunks, cap = stacked.valid.shape[:2]
         probe = jax.eval_shape(
             pre if pre is not None else (lambda c: c),
@@ -599,6 +705,7 @@ class HashAggExecutor(Executor, Checkpointable):
         )
         self._maybe_grow(n_chunks * probe.valid.shape[0])
         self._insert_bound += n_chunks * probe.valid.shape[0]
+        self._dirty_bound += n_chunks * probe.valid.shape[0]
         if self.minput:
             if mode != "reduce":
                 raise ValueError(
@@ -651,22 +758,38 @@ class HashAggExecutor(Executor, Checkpointable):
         )
 
     def _maybe_grow(self, incoming: int):
+        """Capacity planning with ZERO device reads on the hot path.
+
+        The old code refreshed the bound with a blocking
+        ``read_scalars`` round-trip when the load-factor trigger
+        tripped (~100ms on a tunneled TPU; RW-E801 ×2 at the top of
+        the fusion worklist). Now ordinary growth resolves AT THE
+        BARRIER from the staged occupancy note — the bucketing
+        allocator's true claimed count (see ``_on_barrier_scalars``) —
+        and the only mid-epoch rebuild is the overflow guard: when the
+        host insert bound (note + inserts since, a true upper bound)
+        nears the table itself, rebuild pessimistically BEFORE the
+        MAX_PROBE latch can trip. Padded upstream chunks overstate the
+        bound, so the guard threshold is deliberately high; one epoch
+        of margin in the NEED sizing makes the rebuild converge in one
+        step, and the barrier-note lazy shrink reclaims overshoot."""
         cap = self.table.capacity
-        if self._insert_bound + incoming <= cap * GROW_AT:
+        # occupancy can never exceed the table: clamp the carried
+        # bound so padded upstream chunks cannot accrete an unbounded
+        # bound across chunks and ratchet growth step after step (the
+        # caller adds this chunk's incoming after we return)
+        self._insert_bound = min(self._insert_bound, cap)
+        if self._insert_bound + incoming <= cap * HARD_GROW_AT:
             return
-        # refresh the bound with the true claimed count before deciding
-        # to pay for a rebuild — ONE packed device read (every sync is a
-        # full round-trip on a tunneled TPU, ~100ms)
-        claimed, keep = read_scalars(
-            self.table.occupancy(), self._survivor_count()
-        )
-        new_cap = plan_rehash(cap, incoming, claimed, keep, GROW_AT)
-        if new_cap is not None:
+        claimed = self._insert_bound
+        # no extra margin: the 0.75 guard vs 0.5 sizing gap IS the
+        # hysteresis, so the guard cannot re-trip right after a rebuild
+        new_cap = self._buckets.plan(cap, incoming, claimed, claimed)
+        if new_cap is not None and new_cap != cap:
             self.table, self.state, self.minput = _rehash(
                 self.table, self.state, self.minput, self.calls, new_cap
             )
-            claimed = int(self.table.occupancy())
-        self._insert_bound = claimed
+            self._insert_bound = min(claimed, new_cap)
 
     # -- control ---------------------------------------------------------
     def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
@@ -674,22 +797,24 @@ class HashAggExecutor(Executor, Checkpointable):
         # the blocking materialization to finish_barrier — every
         # executor's transfer is then in flight concurrently, so a
         # chain pays ~one tunneled-TPU round-trip per barrier, with
-        # values sampled at this exact point of the walk.
+        # values sampled at this executor's position of the walk
+        # (staged AFTER the flush, which changes none of them: the
+        # latches are monotonic and flush never claims slots).
         # NOTE: with a tripped latch the flush below still emits and
         # pollutes downstream IN-PROCESS state before finish_barrier
         # raises — covered by the existing contract that any barrier
         # error requires recover() (runtime.py module docstring); the
         # epoch is never checkpointed and sinks never deliver it
         # (SinkExecutor delivery also lives in finish_barrier).
+        if self._cold_barrier_hook is not None:
+            self._cold_barrier_hook()
+        outs = self._flush_all()
         self._staged_scalars = stage_scalars(
             self.dropped,
             self.state.minmax_retracted,
             self.mi_bad,
             self.table.occupancy(),
         )
-        if self.cold_reader is not None:
-            self._merge_cold()
-        outs = self._flush_all()
         if barrier is None:  # direct drive: checks fire inline
             self.finish_barrier()
         return outs
@@ -697,8 +822,12 @@ class HashAggExecutor(Executor, Checkpointable):
     def _on_barrier_scalars(self, vals) -> None:
         dropped, mret, mi_bad, claimed = vals
         # occupancy refreshes _insert_bound so the NEXT epoch's
-        # _maybe_grow usually decides without its own round-trip
+        # _maybe_grow decides without any round-trip (the allocator's
+        # occupancy note), and feeds the lazy-shrink streak
+        epoch_inc = max(self._insert_bound - self._occ_note, 0)
+        self._occ_note = int(claimed)
         self._insert_bound = int(claimed)
+        self._plan_at_barrier(int(claimed), epoch_inc)
         if dropped:
             raise RuntimeError(
                 "hash table overflowed MAX_PROBE mid-epoch; grow capacity"
@@ -718,6 +847,25 @@ class HashAggExecutor(Executor, Checkpointable):
                 "materialized MIN/MAX state overflowed minput_k distinct "
                 "values per group, or a value was retracted that was never "
                 "inserted"
+            )
+
+    def _plan_at_barrier(self, claimed: int, epoch_inc: int) -> None:
+        """Barrier-boundary capacity planning from the TRUE occupancy
+        note: grow past the load factor, apply the allocator's pending
+        lazy shrink, honor a governor pin — all between epochs, zero
+        mid-epoch device reads. The margin keeps both growth and the
+        shrink's regrow guard honest against next epoch's volume (the
+        larger of true occupancy and the last epoch's insert bound),
+        so a shrink can never land below what the mid-epoch overflow
+        guard would immediately regrow."""
+        cap = self.table.capacity
+        self._buckets.note_barrier(cap, claimed)
+        new_cap = self._buckets.plan(
+            cap, 0, claimed, claimed, margin=max(claimed, epoch_inc)
+        )
+        if new_cap is not None and new_cap != cap:
+            self.table, self.state, self.minput = _rehash(
+                self.table, self.state, self.minput, self.calls, new_cap
             )
 
     # -- cold tier (state >> HBM) -----------------------------------------
@@ -804,6 +952,8 @@ class HashAggExecutor(Executor, Checkpointable):
         return {tuple(int(v[i]) for v in views) for i in sel}
 
     def _fault_in(self, chunk: StreamChunk) -> None:
+        if not self._evicted:
+            return  # nothing evicted: never pull the chunk to host
         hits = self._chunk_key_tuples(chunk) & self._evicted
         if hits:
             self._restore_cold_groups(sorted(hits))
@@ -858,6 +1008,7 @@ class HashAggExecutor(Executor, Checkpointable):
             {k: jnp.asarray(v) for k, v in cold.items()},
             self.calls,
         )
+        self._dirty_bound += int(found.sum())  # merged slots are dirtied
         # liveness may have flipped (e.g. deletes landed on a fresh slot
         # before the merge restored the cold row_count)
         slots = jnp.asarray(hit.astype(np.int32))
@@ -866,7 +1017,26 @@ class HashAggExecutor(Executor, Checkpointable):
         )
         return int(found.sum())
 
+    def flush_rounds(self) -> int:
+        """Upper bound of flush rounds this barrier needs, from the
+        HOST dirty bound (each round drains up to out_cap dirty
+        groups). The fused per-barrier step compiles this many rounds
+        into its program — zero device reads; a trailing round on an
+        over-estimate emits an all-invalid chunk, a no-op downstream."""
+        bound = min(self._dirty_bound, self.table.capacity)
+        return max(1, -(-bound // self.out_cap))
+
     def _flush_all(self) -> List[StreamChunk]:
+        """INTERPRETED-path flush: exact-sliced delta chunks, one
+        packed status read per round. The fused step replaces this
+        whole method with device-side delta extraction (its program
+        flushes, slices by the host dirty bound and feeds the device
+        MV without any host read) — the contract declares it under
+        ``fallback_syncs`` so the fusion analyzer scores the read as
+        fallback-only, not a fusibility blocker. Interpreted consumers
+        (joins, host materializers) keep the tight exact slices: a
+        bound-quantized pad here would hand them padded 2*out_cap
+        chunks and multiply their per-barrier compute."""
         outs = []
         while True:
             self.state, delta = agg_ops.flush(
@@ -879,6 +1049,7 @@ class HashAggExecutor(Executor, Checkpointable):
             outs.append(self._delta_to_chunk(delta, n_take))
             if not overflow:
                 break
+        self._dirty_bound = 0
         return outs
 
     def cleaning_watermarks(self):
@@ -887,31 +1058,36 @@ class HashAggExecutor(Executor, Checkpointable):
         wm = getattr(self, "_cleaning_watermark", None)
         return [(self.table_id, wm[0], wm[1])] if wm else []
 
+    def _expire_evicted(self, watermark: Watermark) -> None:
+        """A cold-evicted group past the cutoff must still close —
+        fault expiring groups back in so the normal expiry path
+        retracts/tombstones them (the join's analogue; expiry is rare,
+        the fault-in cost is fine). Reached only through the cold-tier
+        hook: the unarmed hot path never touches this host code."""
+        if not self._evicted:
+            return
+        colname, retention, _emit = self.window_key
+        ki = self._key_lane_index(colname)
+        cut = int(watermark.value) - retention
+        dt = np.dtype(self.table.keys[ki].dtype)
+        if dt.kind == "f":
+            # evicted tuples hold host_key_view bit patterns:
+            # compare in the numeric domain (hash_join does the
+            # same in _expire_evicted)
+            itype = np.int32 if dt.itemsize == 4 else np.int64
+            conv = lambda x: float(np.array(x, itype).view(dt))
+        else:
+            conv = lambda x: x
+        expiring = [t for t in self._evicted if conv(t[ki]) < cut]
+        if expiring:
+            self._restore_cold_groups(sorted(expiring))
+
     def on_watermark(self, watermark: Watermark):
         if self.window_key is None or watermark.column != self.window_key[0]:
             return watermark, []
         colname, retention, emit_deletes = self.window_key
-        if self._evicted:
-            # a cold-evicted group past the cutoff must still close —
-            # fault expiring groups back in so the normal expiry path
-            # retracts/tombstones them (the join's _expire_evicted
-            # analogue; expiry is rare, the fault-in cost is fine)
-            ki = self._key_lane_index(colname)
-            cut = int(watermark.value) - retention
-            dt = np.dtype(self.table.keys[ki].dtype)
-            if dt.kind == "f":
-                # evicted tuples hold host_key_view bit patterns:
-                # compare in the numeric domain (hash_join does the
-                # same in _expire_evicted)
-                itype = np.int32 if dt.itemsize == 4 else np.int64
-                conv = lambda x: float(np.array(x, itype).view(dt))
-            else:
-                conv = lambda x: x
-            expiring = [
-                t for t in self._evicted if conv(t[ki]) < cut
-            ]
-            if expiring:
-                self._restore_cold_groups(sorted(expiring))
+        if self._cold_expire_hook is not None:
+            self._cold_expire_hook(watermark)
         outs: List[StreamChunk] = []
         if not emit_deletes:
             # EOWC finalization silently frees state — any dirty (not yet
@@ -939,6 +1115,11 @@ class HashAggExecutor(Executor, Checkpointable):
                 name: mi_ops.minput_clear(v, c, slots)
                 for name, (v, c) in self.minput.items()
             }
+        if emit_deletes:
+            # retracting expiry can dirty up to every live group; the
+            # host cannot count them without a sync — bound by capacity
+            # (flush_rounds clamps there anyway)
+            self._dirty_bound = self.table.capacity
         self.table, self.state = _expire(
             self.table, self.state, cutoff, self.calls, key_index, emit_deletes
         )
@@ -957,7 +1138,7 @@ class HashAggExecutor(Executor, Checkpointable):
 
     def _delta_to_chunk(self, delta, n_take: Optional[int] = None) -> StreamChunk:
         if n_take is None:
-            sl = lambda a: a
+            pad = None
         else:
             # every emitted row sits in the first 2*n_take slots (dirty
             # slots compact to the front); slice before transfer so the
@@ -969,23 +1150,8 @@ class HashAggExecutor(Executor, Checkpointable):
             full = 2 * self.out_cap
             small = min(256, full)
             pad = small if 2 * n_take <= small else full
-            sl = lambda a: a[:pad]
-        cols, nulls = {}, {}
-        i = 0
-        for name, nb in zip(self.group_keys, self.nullable):
-            cols[name] = sl(delta[f"key{i}"])
-            i += 1
-            if nb:
-                nulls[name] = sl(delta[f"key{i}"])
-                i += 1
-        for c in self.calls:
-            cols[c.output] = sl(delta[c.output])
-            lane = delta.get(c.output + "__isnull")
-            if lane is not None:
-                nulls[c.output] = sl(lane)
-        return StreamChunk(
-            columns=cols, valid=sl(delta["valid"]), nulls=nulls,
-            ops=sl(delta["ops"]),
+        return delta_to_chunk(
+            delta, self.group_keys, self.nullable, self.calls, pad
         )
 
 
@@ -1254,6 +1420,7 @@ def _agg_restore_state(self, table_id, key_cols, value_cols) -> None:
     self.dropped = jnp.zeros((), jnp.bool_)
     self.mi_bad = jnp.zeros((), jnp.bool_)
     self._insert_bound = int(n)
+    self._dirty_bound = 0  # restored groups carry no unflushed change
     # recovery restored every durable group as RESIDENT state
     self._evicted = set()
 

@@ -90,6 +90,10 @@ class HopWindowExecutor(Executor):
         }
 
     def pure_step(self):
+        # the fused-chain contract (runtime/fused_step + epoch_batch):
+        # a module-level partial with hashable bound args, so the hop expansion
+        # traces into the fused per-barrier program and compiles once
+        # per plan shape, not once per executor instance
         return partial(
             hop_step_fn,
             ts_col=self.ts_col,

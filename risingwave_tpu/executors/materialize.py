@@ -486,7 +486,8 @@ import jax.numpy as jnp
 from dataclasses import dataclass
 from functools import partial
 
-from risingwave_tpu.ops.hash_table import HashTable, last_occurrence_mask, lookup_or_insert, plan_rehash, read_scalars, stage_scalars
+from risingwave_tpu.ops.hash_table import HashTable, last_occurrence_mask, lookup_or_insert, stage_scalars
+from risingwave_tpu.runtime.bucketing import BucketAllocator, BucketPolicy
 from risingwave_tpu.storage.state_table import (
     grow_pow2,
     pull_rows,
@@ -494,6 +495,10 @@ from risingwave_tpu.storage.state_table import (
 )
 
 GROW_AT = 0.5
+# mid-epoch rebuild only when the HOST insert bound nears the table
+# itself (MAX_PROBE overflow risk); ordinary growth resolves at the
+# barrier from the true occupancy note (see HashAgg's twin constant)
+HARD_GROW_AT = 0.75
 
 
 @jax.tree_util.register_pytree_node_class
@@ -664,6 +669,13 @@ class DeviceMaterializeExecutor(MvDeviceReadMixin, Executor, Checkpointable):
             dropped=jnp.zeros((), jnp.bool_),
         )
         self._bound = 0
+        self._occ_note = 0  # true claimed at the last barrier (staged read)
+        # shape-stability: capacity walks the allocator's pow2 lattice;
+        # growth decisions consume the occupancy note staged at the
+        # previous barrier instead of a synchronous device read
+        self._buckets = BucketAllocator(
+            BucketPolicy.from_capacity(capacity, grow_at=GROW_AT)
+        )
         self.checkpoint_enabled = False
 
     def lint_info(self):
@@ -694,42 +706,49 @@ class DeviceMaterializeExecutor(MvDeviceReadMixin, Executor, Checkpointable):
 
     # -- data -------------------------------------------------------------
     def apply(self, chunk: StreamChunk):
-        self._maybe_grow(chunk)  # also advances the insert bound
+        self._maybe_grow(chunk.capacity)  # also advances the insert bound
         self.table, self.state = _mv_step(
             self.table, self.state, chunk, self.pk, self.columns
         )
         return [chunk]
 
-    def _maybe_grow(self, chunk: StreamChunk) -> None:
+    def _maybe_grow(self, incoming: int) -> None:
+        """Capacity planning with ZERO device reads on the hot path.
+
+        Agg/join flush chunks arrive padded (few live rows at a large
+        capacity), so the host bound wildly overstates inserts
+        mid-epoch. The old code paid a blocking ``read_scalars``
+        round-trip to learn the truth (RW-E801 ×3 on the fusion
+        worklist); now ordinary growth resolves AT THE BARRIER from
+        the staged occupancy note (``_on_barrier_scalars`` plans with
+        true claimed), and the only mid-epoch rebuild is the overflow
+        guard: a bound nearing the table itself rebuilds
+        pessimistically BEFORE the MAX_PROBE latch can trip."""
         cap = self.table.capacity
-        if self._bound + chunk.capacity <= cap * GROW_AT:
-            self._bound += chunk.capacity
+        # occupancy can never exceed the table: clamping the carried
+        # bound at the capacity stops padded flush chunks (whose
+        # capacities wildly overstate live rows) from accreting an
+        # unbounded bound across chunks and ratcheting growth step
+        # after step (code-review finding)
+        claimed = min(self._bound, cap)
+        self._bound = claimed + incoming
+        if self._bound <= cap * HARD_GROW_AT:
             return
-        # agg flush chunks arrive at the agg's FULL state capacity with
-        # few live rows; taking capacity at face value would rebuild
-        # (= recompile, ~30-40s on a tunneled TPU) long before real
-        # load demands it. The cheap host-side bound uses capacity; at
-        # the trip point, ONE packed transfer (tunnel RTT dominates)
-        # refreshes true occupancy AND the chunk's true live count —
-        # the honest insert upper bound for the growth decision.
-        claimed, survivors, live = read_scalars(
-            self.table.occupancy(),
-            jnp.sum(
-                (
-                    self.table.live
-                    | self.state.sdirty
-                    | self.state.stored
-                ).astype(jnp.int32)
-            ),
-            jnp.sum(chunk.valid.astype(jnp.int32)),
-        )
-        new_cap = plan_rehash(cap, int(live), claimed, survivors, GROW_AT)
-        if new_cap is not None:
+        # no extra margin: the 0.75 guard vs 0.5 sizing gap IS the
+        # hysteresis, so the guard cannot re-trip right after a rebuild
+        new_cap = self._buckets.plan(cap, incoming, claimed, claimed)
+        if new_cap is not None and new_cap != cap:
             self.table, self.state = _mv_rebuild(
                 self.table, self.state, new_cap
             )
-            claimed = survivors
-        self._bound = claimed + int(live)
+
+    def pin_max_bucket(self):
+        """ShapeGovernor hook: freeze the MV table at its high-water
+        bucket (shrink disabled; regrow applied by the next apply)."""
+        return {
+            "table_id": self.table_id,
+            "pinned_cap": self._buckets.pin(),
+        }
 
     # -- control ----------------------------------------------------------
     def on_barrier(self, barrier) -> list:
@@ -743,8 +762,25 @@ class DeviceMaterializeExecutor(MvDeviceReadMixin, Executor, Checkpointable):
     def _on_barrier_scalars(self, vals) -> None:
         dropped, claimed = vals
         # occupancy refreshes the growth bound so steady state has no
-        # mid-epoch refresh syncs
+        # mid-epoch refresh syncs; barrier-boundary planning from the
+        # TRUE note: grow past the load factor, apply pending lazy
+        # shrink, honor a governor pin — all between epochs
+        epoch_inc = max(self._bound - self._occ_note, 0)
+        self._occ_note = int(claimed)
         self._bound = int(claimed)
+        cap = self.table.capacity
+        self._buckets.note_barrier(cap, int(claimed))
+        # margin: the larger of true occupancy and last epoch's insert
+        # bound — a shrink can never land below what the mid-epoch
+        # overflow guard would immediately regrow
+        new_cap = self._buckets.plan(
+            cap, 0, int(claimed), int(claimed),
+            margin=max(int(claimed), epoch_inc),
+        )
+        if new_cap is not None and new_cap != cap:
+            self.table, self.state = _mv_rebuild(
+                self.table, self.state, new_cap
+            )
         if dropped:
             raise RuntimeError(
                 "device MV hash table overflowed MAX_PROBE; grow capacity"

@@ -59,4 +59,8 @@ class ProjectExecutor(Executor):
         }
 
     def pure_step(self):
+        # the fused-chain contract (runtime/fused_step + epoch_batch):
+        # a module-level partial with hashable bound args, so the projection
+        # traces into the fused per-barrier program and compiles once
+        # per plan shape, not once per executor instance
         return partial(_project_step, outputs=self._souts)

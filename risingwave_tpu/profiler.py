@@ -357,6 +357,21 @@ class DispatchProfiler:
         except Exception:
             pass
 
+    @contextmanager
+    def attribute(self, label: str):
+        """Attribute device dispatches inside the block to ``label``
+        instead of the enclosing executor class — the fused per-barrier
+        step reports as ONE ``device_dispatches_total{executor=
+        "fused:<fragment>"}`` entry, so dispatches/barrier stays
+        auditable after fusion collapses a chain into one program."""
+        tls = self._tls
+        prev = getattr(tls, "executor", None)
+        tls.executor = label
+        try:
+            yield
+        finally:
+            tls.executor = prev
+
     def record_device_wait(
         self, ex, ms: float, phase: str = "finish", fragment: str = None
     ) -> None:

@@ -11,11 +11,14 @@ from risingwave_tpu.runtime.runtime import StreamingRuntime
 __all__ = [
     "DeviceWedged",
     "DmlManager",
+    "FusedChainExecutor",
     "Pipeline",
     "TwoInputPipeline",
     "StreamingRuntime",
     "SourceManager",
     "NotificationHub",
+    "fuse_chain",
+    "fuse_pipeline",
 ]
 
 # Lazy (PEP 562) exports: DmlManager pulls in the SQL planner, which
@@ -25,6 +28,17 @@ __all__ = [
 # initialized executors package.
 _LAZY = {
     "DmlManager": ("risingwave_tpu.runtime.dml", "DmlManager"),
+    # the fused per-barrier step imports the executors package (it
+    # composes their pure steps), so it must stay lazy here too
+    "FusedChainExecutor": (
+        "risingwave_tpu.runtime.fused_step",
+        "FusedChainExecutor",
+    ),
+    "fuse_chain": ("risingwave_tpu.runtime.fused_step", "fuse_chain"),
+    "fuse_pipeline": (
+        "risingwave_tpu.runtime.fused_step",
+        "fuse_pipeline",
+    ),
     "SourceManager": (
         "risingwave_tpu.runtime.source_manager",
         "SourceManager",

@@ -231,14 +231,25 @@ class BucketAllocator:
         )
 
     def plan(
-        self, cap: int, incoming: int, claimed: int, survivors: int
+        self,
+        cap: int,
+        incoming: int,
+        claimed: int,
+        survivors: int,
+        margin: int = 0,
     ) -> Optional[int]:
         """Next capacity, or None (current bucket still fits). A
         returned value == cap is a pure tombstone compaction (the
         plan_rehash contract). Growth beyond ``max_cap`` clamps: the
         executor's existing overflow latch ("grow capacity") then
         reports genuine overflow at the barrier instead of the device
-        re-tracing through unbounded fresh shapes."""
+        re-tracing through unbounded fresh shapes.
+
+        ``margin`` is extra headroom folded into the NEED sizing only
+        (never the trigger): executors planning from note-based
+        occupancy estimates pass their per-epoch incoming here so
+        growth converges in one rebuild instead of re-tripping at the
+        next bucket's boundary once the true note lands."""
         p = self.policy
         self.high_water = max(self.high_water, cap)
         if self.pinned and cap < self.high_water:
@@ -247,7 +258,7 @@ class BucketAllocator:
             return self.high_water
         if claimed + incoming > cap * p.grow_at:
             need = cap
-            while survivors + incoming > need * p.grow_at:
+            while survivors + incoming + margin > need * p.grow_at:
                 need <<= 1
             new_cap = min(max(need, p.min_cap), max(p.max_cap, cap))
             self._pending_shrink = None
@@ -269,7 +280,7 @@ class BucketAllocator:
             # never shrink below what this chunk (or the survivors)
             # need — re-growing next chunk would be the exact
             # oscillation this layer exists to prevent
-            while survivors + incoming > t * p.grow_at:
+            while survivors + incoming + margin > t * p.grow_at:
                 t <<= 1
             if t < cap:
                 return t

@@ -1,0 +1,674 @@
+"""Fused device-resident barrier step — compile a fragment's fusible
+executor run into ONE donated jitted program per barrier.
+
+PR 6's profiler pinned the 10x-throughput gap on the host dispatch
+wall (~319ms/barrier of Python walking executor chains vs 0.24ms of
+device compute), and the fusion analyzer's FUSION_REPORT.json named
+the blockers per executor. This module is the engine that cashes the
+analysis in (ROADMAP item 1, the TiLT direction from PAPERS.md:
+compile whole time-centric queries instead of interpreting
+per-operator):
+
+- :func:`fuse_chain` rewrites an actor chain's maximal fusible run —
+  ``stateless-pure*  [HashAgg]  stateless-pure*  [DeviceMaterialize]
+  stateless-pure*`` — into a :class:`FusedChainExecutor`. Anything
+  the run cannot absorb (joins, dedup, host materializers, watermark
+  generators, subclasses) passes through untouched and keeps the
+  per-executor interpreted path: interpretation IS the automatic
+  fallback, per run, not per process.
+- :class:`FusedChainExecutor` buffers the epoch's chunks (the
+  EpochBatchedAgg discipline: pow2-padded stacked batches, signature
+  changes flush) and, at the barrier, runs ONE jitted
+  ``fused_step(state_pytree, chunks) -> (state_pytree, deltas,
+  scalars)`` with ``donate_argnums`` on the state pytree — keyed agg
+  state and the device MV live in HBM across barriers; the host
+  touches only ingest and the staged-scalar commit read.
+- State ownership never moves: the member executors keep their state
+  between programs (the wrapper reads it per barrier and writes the
+  donated program's outputs back), so checkpoint/restore, recovery
+  rebuilds, cold-tier hooks, snapshots and the shape governor all
+  keep working against the original objects.
+
+Compile discipline: the program's statics are value-hashable
+(:class:`FusedPlan` hashes the member steps' ``functools.partial``
+keys, the ComposedSteps contract), so graph rebuilds and recovery
+re-fuse into the SAME compiled program; distinct (flush_rounds, pads,
+has_data) combinations are a small closed set in steady state.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.executors.base import Barrier, Executor, Watermark
+from risingwave_tpu.executors.epoch_batch import (
+    ComposedSteps,
+    _compose_lint_infos,
+)
+from risingwave_tpu.executors.hash_agg import (
+    HashAggExecutor,
+    _epoch_reduced_fn,
+    delta_to_chunk,
+)
+from risingwave_tpu.executors.materialize import (
+    DeviceMaterializeExecutor,
+    mv_step_fn,
+)
+from risingwave_tpu.ops import agg as agg_ops
+from risingwave_tpu.parallel.sharded_agg import stack_chunks
+from risingwave_tpu.profiler import PROFILER
+
+__all__ = [
+    "FusedChainExecutor",
+    "expand_fused",
+    "fuse_chain",
+    "fuse_pipeline",
+    "fused_enabled",
+    "fused_fragments",
+]
+
+
+def fused_enabled() -> bool:
+    """RW_FUSED_STEP=0 is the kill switch: the graph runtime then
+    falls back to the per-epoch batched (still interpreted) path."""
+    return os.environ.get("RW_FUSED_STEP", "1").strip().lower() not in (
+        "0",
+        "off",
+        "false",
+    )
+
+
+# ---------------------------------------------------------------------------
+# static plan (jit cache key)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class AggStatics:
+    """The HashAgg member's jit statics (all value-hashable)."""
+
+    calls: tuple
+    group_keys: tuple
+    nullable: tuple
+    out_cap: int
+    float_extremes: tuple
+    has_minput: bool
+
+
+@dataclass(frozen=True)
+class FusedPlan:
+    """The fused program's static shape: pure-step segments around at
+    most one HashAgg and at most one DeviceMaterialize (agg strictly
+    before mv). ``pre``/``mid``/``post`` are ComposedSteps (value-
+    hashable compositions of the members' ``pure_step()`` partials),
+    so two plans over equal step sequences share one compiled
+    program."""
+
+    pre: Optional[ComposedSteps]
+    agg: Optional[AggStatics]
+    mid: Optional[ComposedSteps]
+    mv_pk: Optional[tuple]
+    mv_cols: Optional[tuple]
+    post: Optional[ComposedSteps]
+
+    @property
+    def has_mv(self) -> bool:
+        return self.mv_pk is not None
+
+
+def _delta_chunk(delta: dict, a: AggStatics, pad: Optional[int]) -> StreamChunk:
+    """The flush delta -> chunk decode, shared with the interpreted
+    path (hash_agg.delta_to_chunk is the one lane-contract decoder),
+    with the host-chosen static pad slice."""
+    return delta_to_chunk(delta, a.group_keys, a.nullable, a.calls, pad)
+
+
+def _fused_barrier_fn(states, stacked, plan, flush_rounds, pads, has_data):
+    """The whole fragment-barrier as one pure function over
+    ``states = (agg_state, mv_state)``:
+
+    data phase  — the epoch's stacked chunks through the pure prefix
+                  into the agg's flatten+reduce epoch path (ONE table
+                  touch per distinct key), or — agg-less runs —
+                  through the steps into the device MV as one
+                  flattened batch;
+    flush phase — ``flush_rounds`` device flushes of the agg's dirty
+                  groups, each delta walking mid-steps -> device MV ->
+                  post-steps (the fragment's per-barrier emission);
+    scalars     — the members' barrier latches + occupancy counters
+                  packed into one int64 lane for the overlapped
+                  finish_barrier read.
+    """
+    agg_st, mv_st = states
+    outs: List[StreamChunk] = []
+
+    def _through_mv(chunk):
+        nonlocal mv_st
+        if plan.mid is not None:
+            chunk = plan.mid(chunk)
+        if plan.has_mv:
+            mtable, mstate = mv_st
+            mtable, mstate = mv_step_fn(
+                mtable, mstate, chunk, plan.mv_pk, plan.mv_cols
+            )
+            mv_st = (mtable, mstate)
+        if plan.post is not None:
+            chunk = plan.post(chunk)
+        return chunk
+
+    if has_data:
+        if plan.agg is not None:
+            a = plan.agg
+            table, st, dropped, minput, mi_bad = agg_st
+            if a.has_minput:
+                table, st, dropped, minput, mi_bad = _epoch_reduced_fn(
+                    table, st, dropped, stacked, a.calls, a.group_keys,
+                    a.nullable, plan.pre, minput, mi_bad,
+                )
+            else:
+                table, st, dropped = _epoch_reduced_fn(
+                    table, st, dropped, stacked, a.calls, a.group_keys,
+                    a.nullable, plan.pre,
+                )
+            agg_st = (table, st, dropped, minput, mi_bad)
+        else:
+            chunks = (
+                jax.vmap(plan.pre)(stacked)
+                if plan.pre is not None
+                else stacked
+            )
+            # flatten the epoch into one batch: the MV's last-
+            # occurrence-per-pk mask makes one flat step equivalent to
+            # applying the chunks in order
+            flat = jax.tree.map(
+                lambda x: x.reshape((-1,) + x.shape[2:]), chunks
+            )
+            outs.append(_through_mv(flat))
+
+    if plan.agg is not None and flush_rounds:
+        a = plan.agg
+        table, st, dropped, minput, mi_bad = agg_st
+        for r in range(flush_rounds):
+            st, delta = agg_ops.flush(
+                st, table.keys, a.out_cap, a.float_extremes
+            )
+            outs.append(_through_mv(_delta_chunk(delta, a, pads[r])))
+        agg_st = (table, st, dropped, minput, mi_bad)
+
+    scal = []
+    if plan.agg is not None:
+        table, st, dropped, minput, mi_bad = agg_st
+        scal += [dropped, st.minmax_retracted, mi_bad, table.occupancy()]
+    if plan.has_mv:
+        mtable, mstate = mv_st
+        scal += [mstate.dropped, mtable.occupancy()]
+    packed = (
+        jnp.stack([jnp.asarray(x).astype(jnp.int64) for x in scal])
+        if scal
+        else None
+    )
+    return (agg_st, mv_st), tuple(outs), packed
+
+
+_fused_barrier_step = partial(
+    jax.jit,
+    static_argnames=("plan", "flush_rounds", "pads", "has_data"),
+    donate_argnums=(0,),
+)(_fused_barrier_fn)
+
+
+# ---------------------------------------------------------------------------
+# the wrapper executor
+# ---------------------------------------------------------------------------
+
+
+def _is_pure(ex: Executor) -> bool:
+    """A stateless member the fused program can absorb: pure step, no
+    generated watermarks, no barrier behavior (the wrapper never calls
+    member.on_barrier for pure members)."""
+    return (
+        ex.pure_step() is not None
+        and type(ex).emit_watermark is Executor.emit_watermark
+        and type(ex).on_barrier is Executor.on_barrier
+    )
+
+
+class FusedChainExecutor(Executor):
+    """One fusible run ``[pure*, HashAgg?, pure*, DeviceMaterialize?,
+    pure*]`` executed as a single donated device program per barrier.
+
+    Drop-in chain element (the EpochBatchedAggExecutor integration
+    contract): ``apply`` buffers, ``on_barrier`` runs the program and
+    returns the fragment's per-barrier emission, ``finish_barrier``
+    materializes the packed member scalars and runs every member's
+    latch checks at their original raise points. The member executor
+    OBJECTS stay the system of record — checkpoint registries,
+    recovery restores, the cold tier and the shape governor all keep
+    talking to them; this wrapper is an execution strategy, not a
+    state owner.
+    """
+
+    def __init__(
+        self,
+        members: Sequence[Executor],
+        label: str = "fragment",
+        covers_whole_chain: bool = False,
+    ):
+        self.members = list(members)
+        self.label = label
+        self.covers_whole_chain = covers_whole_chain
+        self.agg: Optional[HashAggExecutor] = None
+        self.mv: Optional[DeviceMaterializeExecutor] = None
+        pre: List[Executor] = []
+        mid: List[Executor] = []
+        post: List[Executor] = []
+        for ex in self.members:
+            if type(ex) is HashAggExecutor:
+                if self.agg is not None or self.mv is not None:
+                    raise ValueError(
+                        "fused run supports one HashAgg, before the MV"
+                    )
+                self.agg = ex
+            elif type(ex) is DeviceMaterializeExecutor:
+                if self.mv is not None:
+                    raise ValueError("fused run supports one device MV")
+                self.mv = ex
+            elif _is_pure(ex):
+                (post if self.mv is not None
+                 else mid if self.agg is not None
+                 else pre).append(ex)
+            else:
+                raise ValueError(f"{type(ex).__name__} is not fusible")
+        steps = lambda exs: (
+            ComposedSteps([e.pure_step() for e in exs]) if exs else None
+        )
+        agg_statics = None
+        if self.agg is not None:
+            agg_statics = AggStatics(
+                calls=self.agg.calls,
+                group_keys=self.agg.group_keys,
+                nullable=self.agg.nullable,
+                out_cap=self.agg.out_cap,
+                float_extremes=self.agg._float_extremes,
+                has_minput=bool(self.agg.minput),
+            )
+        self.plan = FusedPlan(
+            pre=steps(pre),
+            agg=agg_statics,
+            mid=steps(mid),
+            mv_pk=self.mv.pk if self.mv is not None else None,
+            mv_cols=self.mv.columns if self.mv is not None else None,
+            post=steps(post),
+        )
+        self._buf: List[StreamChunk] = []
+        self._sig = None
+        # the previous program's consumed inputs, held until the
+        # barrier fence: dropping a buffer an in-flight async program
+        # still reads BLOCKS the host until the program completes (the
+        # deallocation sync) — exactly the dispatch-wall stall the
+        # fused step exists to remove. finish_barrier (which awaits the
+        # program anyway) retires them instead.
+        self._retired = None
+
+    # -- static metadata --------------------------------------------------
+    def lint_info(self):
+        infos = []
+        for m in self.members:
+            fn = getattr(m, "lint_info", None)
+            info = fn() if fn is not None else None
+            if info is None:
+                return None  # opacity propagates; never guess
+            infos.append(info)
+        return _compose_lint_infos(infos)
+
+    # -- data path --------------------------------------------------------
+    @staticmethod
+    def _signature(c: StreamChunk):
+        return (
+            c.capacity,
+            tuple(sorted((k, str(v.dtype)) for k, v in c.columns.items())),
+            tuple(sorted(c.nulls)),
+        )
+
+    def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
+        outs: List[StreamChunk] = []
+        sig = self._signature(chunk)
+        if self._sig is not None and sig != self._sig:
+            # shape change mid-epoch: flush the homogeneous batch (the
+            # stacking discipline); any MV passthrough surfaces here
+            outs = self._run(flush=False, stage=False)
+        self._sig = sig
+        self._buf.append(chunk)
+        return outs
+
+    # -- control path -----------------------------------------------------
+    def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
+        if self.agg is not None and self.agg._cold_barrier_hook is not None:
+            self.agg._cold_barrier_hook()
+        outs = self._run(flush=True, stage=True)
+        if barrier is None:  # direct drive: checks fire inline
+            self.finish_barrier()
+        return outs
+
+    def on_watermark(self, watermark: Watermark):
+        # buffered rows precede the watermark in stream order; the
+        # watermark itself walks the members interpreted (state lives
+        # in the members between programs, so interop is exact)
+        from risingwave_tpu.runtime.pipeline import _walk_watermark
+
+        outs: List[StreamChunk] = []
+        if self._buf:
+            outs = self._run(flush=False, stage=False)
+        wm, o = _walk_watermark(self.members, watermark)
+        return wm, outs + o
+
+    def finish_barrier(self) -> None:
+        super().finish_barrier()
+        for m in self.members:
+            m.finish_barrier()  # no-op: members never stage under fusion
+        # the fence above awaited the program: retiring its inputs is
+        # now a plain free, not a hidden synchronization point
+        self._retired = None
+
+    def _on_barrier_scalars(self, vals) -> None:
+        i = 0
+        if self.agg is not None:
+            self.agg._on_barrier_scalars(tuple(vals[0:4]))
+            i = 4
+        if self.mv is not None:
+            self.mv._on_barrier_scalars(tuple(vals[i:i + 2]))
+
+    def capture_checkpoint(self) -> None:
+        for m in self.members:
+            cap = getattr(m, "capture_checkpoint", None)
+            if cap is not None:
+                cap()
+
+    # -- the program ------------------------------------------------------
+    def _run(self, flush: bool, stage: bool) -> List[StreamChunk]:
+        buf, self._buf, self._sig = self._buf, [], None
+        has_data = bool(buf)
+        stacked = None
+        if has_data:
+            n = len(buf)
+            target = 1 << (n - 1).bit_length() if n > 1 else 1
+            if target > n:
+                c0 = buf[0]
+                empty = StreamChunk(
+                    c0.columns, jnp.zeros_like(c0.valid), c0.nulls, c0.ops
+                )
+                buf = buf + [empty] * (target - n)
+            stacked = stack_chunks(buf)
+            probe = jax.eval_shape(
+                self.plan.pre if self.plan.pre is not None else (lambda c: c),
+                jax.tree.map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype),
+                    stacked,
+                ),
+            )
+            incoming = len(buf) * probe.valid.shape[0]
+            # host bookkeeping BEFORE the program: growth may rebuild
+            # member state, and the program must see the final buffers
+            if self.agg is not None:
+                if self.agg._cold_stacked_hook is not None:
+                    self.agg._cold_stacked_hook()
+                self.agg._maybe_grow(incoming)
+                self.agg._insert_bound += incoming
+                self.agg._dirty_bound += incoming
+            elif self.mv is not None:
+                self.mv._maybe_grow(incoming)
+        # the round count must be derived AFTER the buffered epoch's
+        # incoming landed in the dirty bound — deriving it earlier
+        # under-flushes any epoch touching more distinct groups than
+        # one round drains (silent MV divergence; code-review finding).
+        # Rounds and pads come from the PLAN's out_cap (the value the
+        # compiled flush actually drains per round), never the agg's
+        # live attribute: a post-fuse out_cap mutation must not
+        # desynchronize the slice from the program.
+        flush_rounds = 0
+        pads: Tuple[int, ...] = ()
+        if flush and self.agg is not None:
+            out_cap = self.plan.agg.out_cap
+            bound = min(self.agg._dirty_bound, self.agg.table.capacity)
+            flush_rounds = max(1, -(-bound // out_cap))
+            # the SAME two-bucket slice quantization the interpreted
+            # _flush_all applies, from the same host dirty bound
+            full = 2 * out_cap
+            small = min(256, full)
+            pads = tuple(
+                (
+                    small
+                    if 2 * min(
+                        max(bound - r * out_cap, 0), out_cap
+                    ) <= small
+                    else full
+                )
+                for r in range(flush_rounds)
+            )
+            if self.mv is not None:
+                for p in pads:
+                    self.mv._maybe_grow(p)
+        if not has_data and not flush_rounds and (
+            not stage or (self.agg is None and self.mv is None)
+        ):
+            return []  # nothing to run, nothing to stage
+        states = (self._agg_state(), self._mv_state())
+        if PROFILER.enabled:
+            with PROFILER.attribute(f"fused:{self.label}"):
+                (agg_st, mv_st), outs, packed = _fused_barrier_step(
+                    states, stacked, self.plan, flush_rounds, pads, has_data
+                )
+        else:
+            (agg_st, mv_st), outs, packed = _fused_barrier_step(
+                states, stacked, self.plan, flush_rounds, pads, has_data
+            )
+        if self.agg is not None:
+            (
+                self.agg.table,
+                self.agg.state,
+                self.agg.dropped,
+                self.agg.minput,
+                self.agg.mi_bad,
+            ) = agg_st
+            if flush_rounds:
+                self.agg._dirty_bound = 0
+        if self.mv is not None:
+            self.mv.table, self.mv.state = mv_st
+        if stage and packed is not None:
+            try:
+                packed.copy_to_host_async()
+            except AttributeError:  # backend without async copies
+                pass
+            self._staged_scalars = packed
+        # keep the program's input refs alive past this frame: their
+        # deallocation would synchronize on the still-running program
+        self._retired = (buf, stacked, states)
+        return list(outs)
+
+    def _agg_state(self):
+        if self.agg is None:
+            return ()
+        return (
+            self.agg.table,
+            self.agg.state,
+            self.agg.dropped,
+            self.agg.minput,
+            self.agg.mi_bad,
+        )
+
+    def _mv_state(self):
+        if self.mv is None:
+            return ()
+        return (self.mv.table, self.mv.state)
+
+
+# ---------------------------------------------------------------------------
+# chain rewriting
+# ---------------------------------------------------------------------------
+
+
+def fuse_chain(
+    chain: Sequence[Executor],
+    label: str = "fragment",
+    defer_pure: bool = False,
+) -> List[Executor]:
+    """Rewrite every maximal fusible run in an actor chain into a
+    FusedChainExecutor; everything else passes through untouched (the
+    interpreted fallback, per run, not per process).
+
+    A run fuses when the whole per-barrier data path — agg apply,
+    flush-delta extraction AND the device-MV write — lands inside one
+    donated program (the q5 shape: ``pure* agg pure* mv pure*``):
+    the flush never leaves the device, so its bound-padded delta
+    capacity costs one masked device op, not an interpreted
+    consumer's compute.
+
+    Everything else keeps today's paths:
+
+    - agg WITHOUT a downstream device MV in the run: the flush chunk
+      EXITS to an interpreted consumer (a join) that wants the
+      exact-sliced small chunks only the interpreted flush's status
+      read can produce — fall back to the per-epoch batched wrapper
+      (one fused apply program per epoch, interpreted exact flush).
+    - device MV without an agg (join tails): interpreted per chunk.
+      Stacking a join's heterogeneous emission chunks (capacities and
+      null lanes vary) would mint a fresh compiled program per
+      distinct (signature, count) batch — a compile storm, not a win.
+    - pure-only runs >= 2 fuse only with ``defer_pure`` (they emit
+      during ``apply`` interpreted; deferring to the barrier is only
+      epoch-equivalent, so it is opt-in)."""
+    from risingwave_tpu.executors.epoch_batch import (
+        EpochBatchedAggExecutor,
+    )
+
+    out: List[Executor] = []
+    run: List[Executor] = []
+
+    def close() -> None:
+        nonlocal run
+        if not run:
+            return
+        agg_idx = next(
+            (
+                i
+                for i, m in enumerate(run)
+                if type(m) is HashAggExecutor
+            ),
+            None,
+        )
+        has_mv_after_agg = agg_idx is not None and any(
+            type(m) is DeviceMaterializeExecutor for m in run[agg_idx:]
+        )
+        if has_mv_after_agg:
+            out.append(FusedChainExecutor(run, label=label))
+        elif agg_idx is not None:
+            # flush exits to an interpreted consumer: epoch-batch the
+            # [pure*, agg] head, pass the tail pures through raw
+            out.append(
+                EpochBatchedAggExecutor(run[:agg_idx], run[agg_idx])
+            )
+            out.extend(run[agg_idx + 1 :])
+        elif (
+            defer_pure
+            and len(run) >= 2
+            and not any(
+                type(m) is DeviceMaterializeExecutor for m in run
+            )
+        ):
+            # PURE runs only: a join-fed device MV must stay
+            # interpreted per chunk even under defer_pure (see the
+            # docstring's compile-storm rule)
+            out.append(FusedChainExecutor(run, label=label))
+        else:
+            out.extend(run)
+        run = []
+
+    for ex in chain:
+        if type(ex) is HashAggExecutor:
+            if any(
+                type(m) in (HashAggExecutor, DeviceMaterializeExecutor)
+                for m in run
+            ):
+                close()
+            run.append(ex)
+        elif type(ex) is DeviceMaterializeExecutor:
+            if any(type(m) is DeviceMaterializeExecutor for m in run):
+                close()
+            run.append(ex)
+        elif _is_pure(ex):
+            run.append(ex)
+        else:
+            close()
+            out.append(ex)
+    close()
+    if (
+        len(out) == 1
+        and isinstance(out[0], FusedChainExecutor)
+        and len(out[0].members) == len(list(chain))
+    ):
+        out[0].covers_whole_chain = True
+    return out
+
+
+def fuse_pipeline(pipeline, label: str = "mv", defer_pure: bool = False):
+    """Arm fusion on a SERIAL Pipeline / TwoInputPipeline in place
+    (bench drivers and twin tests; the graph runtime fuses its actor
+    chains automatically). Returns the wrappers created. Note: the
+    pipeline's ``executors`` enumeration then yields wrappers instead
+    of members — use on driver-owned pipelines, not runtime-registered
+    ones (those fuse through the graph path, which keeps its own
+    checkpoint registry of member objects)."""
+    created: List[FusedChainExecutor] = []
+
+    def rewrite(chain, lbl):
+        new = fuse_chain(chain, label=lbl, defer_pure=defer_pure)
+        created.extend(
+            e for e in new if isinstance(e, FusedChainExecutor)
+        )
+        return new
+
+    if hasattr(pipeline, "join") and hasattr(pipeline, "left"):
+        pipeline.left = rewrite(pipeline.left, f"{label}/left")
+        pipeline.right = rewrite(pipeline.right, f"{label}/right")
+        pipeline.tail = rewrite(pipeline.tail, f"{label}/tail")
+    elif hasattr(pipeline, "executors"):
+        pipeline.executors = rewrite(pipeline.executors, label)
+    return created
+
+
+def expand_fused(executors) -> List[Executor]:
+    """Flatten fused wrappers back to their member executors (bench
+    padding/governor surfaces read per-executor state)."""
+    out: List[Executor] = []
+    for ex in executors or ():
+        if isinstance(ex, FusedChainExecutor):
+            out.extend(ex.members)
+        else:
+            out.append(ex)
+    return out
+
+
+def fused_fragments(pipeline) -> dict:
+    """BENCH-JSON evidence: how much of the pipeline actually fused
+    (count + whole-chain flag + labels). Accepts serial pipelines and
+    GraphPipeline (scans the live actors)."""
+    graph = getattr(pipeline, "graph", None)
+    exs = graph.executors if graph is not None else (
+        list(getattr(pipeline, "executors", []) or [])
+    )
+    wrappers = [e for e in exs if isinstance(e, FusedChainExecutor)]
+    return {
+        "count": len(wrappers),
+        "whole_chain": bool(wrappers)
+        and all(w.covers_whole_chain for w in wrappers),
+        "fragments": sorted(
+            {f"{w.label}[{len(w.members)}]" for w in wrappers}
+        ),
+    }

@@ -735,23 +735,34 @@ class GraphRuntime:
     def _spawn_actor(self, s: FragmentSpec, inst: int) -> FragmentActor:
         built = s.build(inst)
         if self._epoch_batch:
-            # fuse [stateless*, HashAgg] runs into per-epoch
-            # batched ops — the actor's data path only; the
-            # pipeline's checkpoint registry keeps holding the
-            # original executor objects
+            # collapse each chain's maximal fusible run into ONE
+            # donated device program per barrier (runtime/fused_step);
+            # RW_FUSED_STEP=0 falls back to the per-epoch batched
+            # (interpreted) path. Either way the actor's data path
+            # only changes — the pipeline's checkpoint registry keeps
+            # holding the original executor objects, so recovery
+            # rebuilds re-fuse around restored state automatically.
             from risingwave_tpu.executors.epoch_batch import (
                 fuse_epoch_batch,
             )
+            from risingwave_tpu.runtime.fused_step import (
+                fuse_chain,
+                fused_enabled,
+            )
 
+            if fused_enabled():
+                fuse = lambda ch, lbl: fuse_chain(ch, label=lbl)
+            else:
+                fuse = lambda ch, lbl: fuse_epoch_batch(ch)
             if isinstance(built, dict):
                 built = dict(
                     built,
-                    left=fuse_epoch_batch(built.get("left", [])),
-                    right=fuse_epoch_batch(built.get("right", [])),
-                    tail=fuse_epoch_batch(built.get("tail", [])),
+                    left=fuse(built.get("left", []), f"{s.name}/left"),
+                    right=fuse(built.get("right", []), f"{s.name}/right"),
+                    tail=fuse(built.get("tail", []), f"{s.name}/tail"),
                 )
             else:
-                built = fuse_epoch_batch(built)
+                built = fuse(built, s.name)
         downstream = self._out_edges[s.name][inst]
         if downstream:
             # one dispatcher fanning to every downstream edge:

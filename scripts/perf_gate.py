@@ -277,6 +277,19 @@ def run_fusion_gate(
                     f"{bf['host_sync_points']} -> "
                     f"{cf['host_sync_points']}"
                 )
+            # fallback syncs are outside the fusibility verdict (the
+            # fused step compiles them away) but still run per barrier
+            # wherever the fallback path executes (e.g. an epoch-
+            # batched agg feeding a join) — a regression adding reads
+            # there must not slip past the gate
+            if cf.get("fallback_sync_points", 0) > bf.get(
+                "fallback_sync_points", 0
+            ):
+                violations.append(
+                    f"fusion {q}/{name}: fallback-sync points grew "
+                    f"{bf.get('fallback_sync_points', 0)} -> "
+                    f"{cf.get('fallback_sync_points', 0)}"
+                )
             if bf.get("whole_chain_fusible") and not cf.get(
                 "whole_chain_fusible"
             ):
@@ -472,26 +485,25 @@ def run_blackbox_gate(budgets: dict):
 # ---------------------------------------------------------------------------
 
 
-def run_smoke(budgets: dict, epochs: int = 4, events: int = 2_000):
-    """q5 steady state with the profiler armed: bounded device
-    dispatches per barrier + bounded host-python ms per row. Returns
-    (violations, report dict)."""
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    if ROOT not in sys.path:  # runnable as a script from anywhere
-        sys.path.insert(0, ROOT)
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
+def _smoke_leg(budgets: dict, fused: bool, epochs: int, events: int):
+    """One q5 steady-state microbench leg (interpreted or fused) with
+    the profiler armed. Returns (violations, report)."""
+    from risingwave_tpu.metrics import REGISTRY
+    from risingwave_tpu.profiler import PROFILER
     from risingwave_tpu.connectors.nexmark import (
         NexmarkConfig,
         NexmarkGenerator,
     )
-    from risingwave_tpu.metrics import REGISTRY
-    from risingwave_tpu.profiler import PROFILER
     from risingwave_tpu.queries.nexmark_q import build_q5_lite
 
     sb = budgets.get("smoke", {})
+    leg = "fused" if fused else "smoke"
     q5 = build_q5_lite(capacity=1 << 12, state_cleaning=False)
+    wrappers = []
+    if fused:
+        from risingwave_tpu.runtime.fused_step import fuse_pipeline
+
+        wrappers = fuse_pipeline(q5.pipeline, label="q5")
     gen = NexmarkGenerator(NexmarkConfig(first_event_rate=50_000))
     # STEADY state: the same chunk every epoch (fresh keys would grow
     # the table — a legitimate recompile, not the regression here)
@@ -516,32 +528,77 @@ def run_smoke(budgets: dict, epochs: int = 4, events: int = 2_000):
             per_epoch.append(PROFILER.total_dispatches() - base)
         h = REGISTRY.histograms.get("executor_ms")
         host_ms = sum(h._sum.values()) if h is not None else 0.0
+        fused_labels = [
+            k for k in PROFILER.dispatch_counts() if k.startswith("fused:")
+        ]
     finally:
         PROFILER.disable()
         PROFILER.reset()
     dpb = max(per_epoch) if per_epoch else 0.0
     ms_per_row = host_ms / max(rows * epochs, 1)
     report = {
-        "dispatches_per_barrier": per_epoch,
-        "python_ms_per_row": round(ms_per_row, 5),
+        f"{leg}_dispatches_per_barrier": per_epoch,
+        f"{leg}_python_ms_per_row": round(ms_per_row, 5),
         "rows_per_epoch": rows,
     }
     violations = []
-    mx = sb.get("dispatches_per_barrier_max")
+    mx = sb.get(
+        "fused_dispatches_per_barrier_max"
+        if fused
+        else "dispatches_per_barrier_max"
+    )
     if mx is not None and dpb > mx:
         violations.append(
-            f"smoke: {dpb} device dispatches/barrier > budget {mx}"
+            f"{leg}: {dpb} device dispatches/barrier > budget {mx}"
         )
     mx = sb.get("python_ms_per_row_max")
     if mx is not None and ms_per_row > mx:
         violations.append(
-            f"smoke: {ms_per_row:.5f} host-python ms/row > budget {mx}"
+            f"{leg}: {ms_per_row:.5f} host-python ms/row > budget {mx}"
         )
     if len(set(per_epoch)) > 1:
         violations.append(
-            f"smoke: steady-state dispatch count not stable: {per_epoch} "
+            f"{leg}: steady-state dispatch count not stable: {per_epoch} "
             "(shape-unstable epoch — recompile hazard)"
         )
+    if fused:
+        # a silently de-fused fragment would fall back to interpretation
+        # and only get SLOWER — fail CI loudly instead
+        report["fused_fragments"] = len(wrappers)
+        report["fused_whole_chain"] = bool(wrappers) and all(
+            w.covers_whole_chain for w in wrappers
+        )
+        if not wrappers or not report["fused_whole_chain"]:
+            violations.append(
+                "fused: the q5 chain did not fuse whole "
+                f"({len(wrappers)} wrappers) — fragment silently de-fused"
+            )
+        elif not fused_labels:
+            violations.append(
+                "fused: no fused:<fragment> dispatch attribution recorded "
+                "— the fused program never ran (de-fused fallback?)"
+            )
+    return violations, report
+
+
+def run_smoke(budgets: dict, epochs: int = 4, events: int = 2_000):
+    """q5 steady state with the profiler armed, TWO legs: the
+    interpreted per-executor walk (bounded device dispatches per
+    barrier + host-python ms per row) and the fused per-barrier step
+    (runtime/fused_step — bounded at its own, tighter budget, plus a
+    de-fusion tripwire: the chain must actually fuse whole and the
+    ``fused:`` dispatch attribution must appear). Returns
+    (violations, report dict)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if ROOT not in sys.path:  # runnable as a script from anywhere
+        sys.path.insert(0, ROOT)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    violations, report = _smoke_leg(budgets, False, epochs, events)
+    v2, r2 = _smoke_leg(budgets, True, epochs, events)
+    violations += v2
+    report.update(r2)
     return violations, report
 
 

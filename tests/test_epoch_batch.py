@@ -78,6 +78,26 @@ def test_fuse_rewrites_stateless_agg_runs(catalog):
 
 
 def test_actor_chain_is_batched(catalog):
+    """Actors batch the epoch by default: the fused per-barrier step
+    (runtime/fused_step) when enabled, else the epoch-batch wrapper."""
+    from risingwave_tpu.runtime.fused_step import FusedChainExecutor
+
+    mv = graph_planned_mv(_factory(catalog), Q5_SQL, parallelism=1)
+    try:
+        chains = [a.chain for a in mv.pipeline.graph.actors]
+        assert any(
+            isinstance(e, (EpochBatchedAggExecutor, FusedChainExecutor))
+            for ch in chains
+            for e in ch
+        )
+    finally:
+        mv.pipeline.close()
+
+
+def test_actor_chain_falls_back_to_epoch_batch(catalog, monkeypatch):
+    """RW_FUSED_STEP=0 is the kill switch: actors keep the per-epoch
+    batched interpreted path."""
+    monkeypatch.setenv("RW_FUSED_STEP", "0")
     mv = graph_planned_mv(_factory(catalog), Q5_SQL, parallelism=1)
     try:
         chains = [a.chain for a in mv.pipeline.graph.actors]

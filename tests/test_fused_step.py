@@ -1,0 +1,500 @@
+"""Fused device-resident barrier step (runtime/fused_step).
+
+Twin discipline: the fused program must be BIT-IDENTICAL to the
+interpreted per-executor walk — same seeds, same epochs, identical MV
+snapshots at every barrier — across q5 (hop->agg->MV), q7 (two-input
+join with a fusible hop->maxagg side and a fused MV tail) and q8
+(dedup join with a fused MV tail). Plus the operational contracts:
+one device dispatch per barrier attributed as ``fused:<fragment>``,
+donation leaves no orphaned state buffers, rebuilt fragments re-fuse,
+latch checks still raise at finish_barrier, and RW_FUSED_STEP=0 falls
+back to the epoch-batched interpreted path.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from risingwave_tpu.connectors.nexmark import (
+    BID_SCHEMA,
+    NexmarkConfig,
+    NexmarkGenerator,
+)
+from risingwave_tpu.profiler import PROFILER
+from risingwave_tpu.queries.nexmark_q import (
+    build_q5_lite,
+    build_q7,
+    build_q8,
+)
+from risingwave_tpu.runtime.fused_step import (
+    FusedChainExecutor,
+    expand_fused,
+    fuse_chain,
+    fuse_pipeline,
+    fused_fragments,
+)
+
+Q5_SQL = (
+    "CREATE MATERIALIZED VIEW q5 AS "
+    "SELECT auction, window_start, count(*) AS num "
+    "FROM HOP(bid, date_time, INTERVAL '2' SECOND, INTERVAL '10' SECOND) "
+    "GROUP BY auction, window_start"
+)
+
+
+# ---------------------------------------------------------------------------
+# fused-vs-interpreted twins (bit-identity per barrier)
+# ---------------------------------------------------------------------------
+
+
+def _drive_q5(q5, *, fuse, watermarks, epochs=4, chunks_per_epoch=3):
+    if fuse:
+        wrappers = fuse_pipeline(q5.pipeline, label="q5")
+        assert len(wrappers) == 1 and wrappers[0].covers_whole_chain
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=5_000))
+    snaps, mx = [], 0
+    for _ in range(epochs):
+        for _ in range(chunks_per_epoch):
+            c = gen.next_chunks(800, 1024)["bid"]
+            if c is None:
+                continue
+            q5.pipeline.push(c)
+            mx = max(mx, int(c.to_numpy()["date_time"].max()))
+        q5.pipeline.barrier()
+        if watermarks:
+            q5.pipeline.watermark("date_time", mx)
+        snaps.append(q5.mview.snapshot())
+    return snaps
+
+
+@pytest.mark.parametrize("watermarks", [False, True])
+def test_q5_fused_bit_identical_to_interpreted_twin(watermarks):
+    mk = lambda: build_q5_lite(
+        capacity=1 << 12, state_cleaning=watermarks
+    )
+    interp = _drive_q5(mk(), fuse=False, watermarks=watermarks)
+    fused = _drive_q5(mk(), fuse=True, watermarks=watermarks)
+    for e, (a, b) in enumerate(zip(interp, fused)):
+        assert a == b, f"epoch {e}: fused MV diverged from interpreted"
+    assert len(interp[-1]) > 0
+
+
+def _drive_q7(q7, *, fuse, epochs=4):
+    if fuse:
+        from risingwave_tpu.executors.epoch_batch import (
+            EpochBatchedAggExecutor,
+        )
+
+        wrappers = fuse_pipeline(q7.pipeline, label="q7")
+        # nothing on q7 forms the agg->MV shape: the hop->maxagg side
+        # feeds the INTERPRETED join so it epoch-batches (the fused
+        # flush would hand the join bound-padded chunks), and the
+        # join-fed MV tail stays interpreted (stacking a join's
+        # heterogeneous emissions would compile-storm) — fusion armed
+        # must still be bit-identical through all the fallbacks
+        assert wrappers == []
+        assert any(
+            isinstance(e, EpochBatchedAggExecutor) for e in q7.pipeline.right
+        )
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=10_000))
+    snaps, mx = [], 0
+    for _ in range(epochs):
+        for _ in range(2):
+            bid = gen.next_chunks(1200, 2048)["bid"]
+            if bid is None:
+                continue
+            bid = bid.select(["auction", "bidder", "price", "date_time"])
+            q7.pipeline.push_left(bid)
+            q7.pipeline.push_right(bid)
+            mx = max(mx, int(bid.to_numpy()["date_time"].max()))
+        q7.pipeline.barrier()
+        q7.pipeline.watermark("date_time", mx)
+        snaps.append(q7.mview.snapshot())
+    return snaps
+
+
+def test_q7_fused_bit_identical_to_interpreted_twin():
+    mk = lambda: build_q7(
+        capacity=1 << 13,
+        agg_capacity=1 << 11,
+        filter_capacity=1 << 11,
+        out_cap=1 << 11,
+    )
+    interp = _drive_q7(mk(), fuse=False)
+    fused = _drive_q7(mk(), fuse=True)
+    for e, (a, b) in enumerate(zip(interp, fused)):
+        assert a == b, f"epoch {e}: fused q7 MV diverged"
+
+
+def _drive_q8(q8, *, fuse, epochs=4):
+    if fuse:
+        wrappers = fuse_pipeline(q8.pipeline, label="q8")
+        assert wrappers == []  # dedup/join/mv-tail: all interpreted
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=10_000))
+    snaps = []
+    for _ in range(epochs):
+        for _ in range(2):
+            ev = gen.next_chunks(3000, 8192)
+            p, a = ev["person"], ev["auction"]
+            if p is not None:
+                q8.pipeline.push_left(p.select(["id", "name", "date_time"]))
+            if a is not None:
+                q8.pipeline.push_right(a.select(["seller", "date_time"]))
+        q8.pipeline.barrier()
+        snaps.append(q8.mview.snapshot())
+    return snaps
+
+
+def test_q8_fused_bit_identical_to_interpreted_twin():
+    mk = lambda: build_q8(capacity=1 << 12, out_cap=1 << 11)
+    interp = _drive_q8(mk(), fuse=False)
+    fused = _drive_q8(mk(), fuse=True)
+    for e, (a, b) in enumerate(zip(interp, fused)):
+        assert a == b, f"epoch {e}: fused q8 MV diverged"
+    assert len(interp[-1]) > 0
+
+
+# ---------------------------------------------------------------------------
+# dispatch-wall evidence: ONE program per barrier, attributed
+# ---------------------------------------------------------------------------
+
+
+def test_fused_q5_one_dispatch_per_barrier_with_fused_label():
+    q5 = build_q5_lite(capacity=1 << 12, state_cleaning=False)
+    fuse_pipeline(q5.pipeline, label="q5")
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=50_000))
+    bid = gen.next_chunks(2000, 1 << 11)["bid"].select(
+        ["auction", "date_time"]
+    )
+
+    def epoch():
+        q5.pipeline.push(bid)
+        q5.pipeline.barrier()
+
+    epoch()
+    epoch()  # warm: compiles + growth transitions
+    PROFILER.reset()
+    PROFILER.enable(fence=False)
+    try:
+        per = []
+        for _ in range(3):
+            base = PROFILER.total_dispatches()
+            epoch()
+            per.append(PROFILER.total_dispatches() - base)
+        counts = PROFILER.dispatch_counts()
+    finally:
+        PROFILER.disable()
+        PROFILER.reset()
+    # steady state: the whole hop->agg->flush->MV barrier is ONE
+    # Python-level device dispatch, attributed to the fused fragment
+    assert per == [1.0, 1.0, 1.0], per
+    assert counts.get("fused:q5", 0) >= 3, counts
+
+
+def test_fused_fragments_report_shapes():
+    q5 = build_q5_lite(capacity=1 << 10, state_cleaning=False)
+    fuse_pipeline(q5.pipeline, label="q5")
+    rep = fused_fragments(q5.pipeline)
+    assert rep["count"] == 1 and rep["whole_chain"] is True
+    assert rep["fragments"] == ["q5[3]"]
+
+
+def test_no_orphaned_state_buffers_across_fused_barriers():
+    """Donation contract: steady-state fused barriers must not leak
+    device buffers (the donated state is consumed, the returned state
+    replaces it — live-array count stays flat)."""
+    q5 = build_q5_lite(capacity=1 << 10, state_cleaning=False)
+    fuse_pipeline(q5.pipeline)
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=20_000))
+    bid = gen.next_chunks(500, 512)["bid"].select(["auction", "date_time"])
+
+    def epoch():
+        q5.pipeline.push(bid)
+        q5.pipeline.barrier()
+
+    for _ in range(3):  # warm: compiles + capacity transitions
+        epoch()
+    counts = []
+    for _ in range(4):
+        epoch()
+        counts.append(len(jax.live_arrays()))
+    assert max(counts) - min(counts) <= 2, (
+        f"live device arrays grew across fused barriers: {counts}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# wrapper mechanics
+# ---------------------------------------------------------------------------
+
+
+def _bid_chunk(gen, n=400, cap=512):
+    c = None
+    while c is None:
+        c = gen.next_chunks(n, cap)["bid"]
+    return c.select(["auction", "date_time"])
+
+
+def test_fused_flush_rounds_cover_small_out_cap():
+    """Regression (code-review finding): the fused flush-round count
+    must be derived AFTER the buffered epoch lands in the dirty bound.
+    With out_cap far below the epoch's distinct groups, an early round
+    count silently dropped every group past the first round — the
+    fused MV diverged from the interpreted twin permanently."""
+    mk = lambda: build_q5_lite(capacity=1 << 10, state_cleaning=False)
+
+    def drive(q5, fuse):
+        q5.agg.out_cap = 128  # << distinct (auction, window) groups
+        if fuse:  # fuse AFTER sizing: the plan captures out_cap
+            fuse_pipeline(q5.pipeline)
+        gen = NexmarkGenerator(NexmarkConfig(first_event_rate=20_000))
+        for _ in range(2):
+            q5.pipeline.push(_bid_chunk(gen, 800, 1024))
+            q5.pipeline.barrier()
+        return q5.mview.snapshot()
+
+    interp = drive(mk(), fuse=False)
+    fused = drive(mk(), fuse=True)
+    assert len(interp) > 128  # the workload actually exceeds out_cap
+    assert fused == interp
+
+
+def test_signature_change_mid_epoch_flushes_buffer():
+    mk = lambda: build_q5_lite(capacity=1 << 10, state_cleaning=False)
+    a, b = mk(), mk()
+    fuse_pipeline(b.pipeline)
+    gen1 = NexmarkGenerator(NexmarkConfig(first_event_rate=20_000))
+    gen2 = NexmarkGenerator(NexmarkConfig(first_event_rate=20_000))
+    for q5, gen in ((a, gen1), (b, gen2)):
+        c1 = _bid_chunk(gen, 400, 512)
+        c2 = _bid_chunk(gen, 900, 1024)  # different capacity: new sig
+        q5.pipeline.push(c1)
+        q5.pipeline.push(c2)
+        q5.pipeline.push(_bid_chunk(gen, 400, 512))
+        q5.pipeline.barrier()
+    assert a.mview.snapshot() == b.mview.snapshot()
+
+
+def test_overflow_latch_still_raises_at_finish_barrier():
+    """The agg's MAX_PROBE overflow latch rides the fused program's
+    packed scalars and raises at the wrapper's finish_barrier — same
+    raise point as the interpreted path."""
+    q5 = build_q5_lite(capacity=1 << 10, state_cleaning=False)
+    (wrapper,) = fuse_pipeline(q5.pipeline)
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=20_000))
+    q5.pipeline.push(_bid_chunk(gen))
+    q5.pipeline.barrier()
+    # force the latch: a poisoned dropped flag must surface as the
+    # hash-table overflow error when the staged scalars materialize
+    q5.agg.dropped = jnp.ones((), jnp.bool_)
+    with pytest.raises(RuntimeError, match="overflowed MAX_PROBE"):
+        q5.pipeline.push(_bid_chunk(gen))
+        q5.pipeline.barrier()
+    assert wrapper.agg is q5.agg  # members stayed the system of record
+
+
+def test_fuse_chain_falls_back_around_unfusible_ops():
+    """Host-bound / opaque members break the run: interpretation is
+    the automatic per-run fallback, not a process-wide switch — and an
+    agg whose flush exits to an interpreted consumer epoch-batches
+    instead of fusing (the exact-sliced flush stays)."""
+    from risingwave_tpu.executors.base import Executor
+    from risingwave_tpu.executors.epoch_batch import (
+        EpochBatchedAggExecutor,
+    )
+    from risingwave_tpu.executors.hash_agg import HashAggExecutor
+    from risingwave_tpu.ops.agg import AggCall
+
+    class HostOp(Executor):  # no pure_step -> not fusible
+        pass
+
+    agg = HashAggExecutor(
+        group_keys=("k",),
+        calls=(AggCall("count_star", None, "n"),),
+        schema_dtypes={"k": jnp.int64},
+        capacity=64,
+        out_cap=32,
+    )
+    host = HostOp()
+    out = fuse_chain([host, agg], label="t")
+    assert out[0] is host
+    assert isinstance(out[1], EpochBatchedAggExecutor)
+    assert out[1].agg is agg
+    # pure-only runs stay interpreted unless defer_pure opts in
+    from risingwave_tpu.executors.hop_window import HopWindowExecutor
+
+    hop = HopWindowExecutor("t", 10, 10)
+    assert fuse_chain([hop, host], label="t") == [hop, host]
+
+
+def test_expand_fused_exposes_members_for_padding_and_governor():
+    q5 = build_q5_lite(capacity=1 << 10, state_cleaning=False)
+    fuse_pipeline(q5.pipeline)
+    exs = expand_fused(q5.pipeline.executors)
+    names = [type(e).__name__ for e in exs]
+    assert "HashAggExecutor" in names
+    assert "DeviceMaterializeExecutor" in names
+    assert all(not isinstance(e, FusedChainExecutor) for e in exs)
+
+
+def test_governor_bucket_pin_holds_fused_shapes_steady():
+    """After a governor pin, steady fused barriers mint ZERO new
+    compiled programs (exactly the recompile-storm throttle the fused
+    step needs: pinned buckets = closed shape set)."""
+    from risingwave_tpu.analysis.jax_sanitizer import RecompileWatch
+
+    q5 = build_q5_lite(capacity=1 << 10, state_cleaning=False)
+    fuse_pipeline(q5.pipeline)
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=20_000))
+    bid = _bid_chunk(gen)
+
+    def epoch():
+        q5.pipeline.push(bid)
+        q5.pipeline.barrier()
+
+    epoch()
+    epoch()
+    pin_agg = q5.agg.pin_max_bucket()
+    pin_mv = q5.mview.pin_max_bucket()
+    assert pin_agg["pinned_cap"] == q5.agg.table.capacity
+    assert pin_mv["pinned_cap"] == q5.mview.table.capacity
+    watch = RecompileWatch()
+    watch.snapshot()
+    for _ in range(3):
+        epoch()
+    assert watch.deltas() == {}, watch.deltas()
+
+
+# ---------------------------------------------------------------------------
+# graph runtime: auto-fusion, rebuild re-fuses, recovery with fusion armed
+# ---------------------------------------------------------------------------
+
+
+def _catalog_factory(capacity=1 << 11):
+    from risingwave_tpu.sql import Catalog, StreamPlanner
+
+    catalog = Catalog({"bid": BID_SCHEMA})
+    return lambda: StreamPlanner(catalog, capacity=capacity)
+
+
+def _graph_mv(parallelism=1):
+    from risingwave_tpu.runtime.fragmenter import graph_planned_mv
+
+    return graph_planned_mv(
+        _catalog_factory(), Q5_SQL, parallelism=parallelism
+    )
+
+
+def _fused_in_actors(gp):
+    return [
+        e
+        for a in gp.graph.actors
+        for e in a.executors
+        if isinstance(e, FusedChainExecutor)
+    ]
+
+
+def test_graph_actors_fuse_by_default_and_rebuild_refuses():
+    mv = _graph_mv()
+    try:
+        assert _fused_in_actors(mv.pipeline), "graph chain did not fuse"
+        gen = NexmarkGenerator(NexmarkConfig(first_event_rate=20_000))
+        bid = _bid_chunk(gen, 600, 1024)
+        mv.pipeline.push(bid)
+        mv.pipeline.barrier()
+        before = mv.mview.snapshot()
+        assert before
+        # rebuild (the recovery path's actor replacement): fresh actors
+        # around the SAME executor objects must RE-FUSE automatically
+        mv.pipeline.rebuild()
+        assert _fused_in_actors(mv.pipeline), "rebuilt actors lost fusion"
+        assert mv.mview.snapshot() == before  # state survived the rebuild
+        mv.pipeline.push(bid)
+        mv.pipeline.barrier()
+        after = mv.mview.snapshot()
+        assert set(after) == set(before)
+        assert all(after[k][0] == 2 * before[k][0] for k in before)
+    finally:
+        mv.pipeline.close()
+
+
+class _PoisonOnce:
+    """Raises at the first armed barrier, then behaves forever after
+    (the transient-fault model of the recovery suites)."""
+
+    def __init__(self):
+        self.armed = False
+        self.fired = 0
+
+    def apply(self, chunk):
+        return [chunk]
+
+    def on_barrier(self, b):
+        if self.armed:
+            self.armed = False
+            self.fired += 1
+            raise RuntimeError("poisoned epoch (injected)")
+        return []
+
+    def on_watermark(self, wm):
+        return wm, []
+
+    def emit_watermark(self):
+        return None
+
+    def finish_barrier(self):
+        return None
+
+    def pure_step(self):
+        return None
+
+
+def test_actor_kill_recovery_with_fusion_armed():
+    """Actor-kill chaos with the fused step armed: the poisoned
+    barrier kills the actor thread, the watchdog rebuilds the graph,
+    the rebuilt fragment RE-FUSES around the restored state, and the
+    stream continues exact (the serial interpreted twin is the
+    oracle)."""
+    from risingwave_tpu.runtime.fragmenter import GraphPipeline
+    from risingwave_tpu.runtime.graph import FragmentSpec
+    from risingwave_tpu.runtime.runtime import StreamingRuntime
+    from risingwave_tpu.storage.object_store import MemObjectStore
+
+    poison = _PoisonOnce()
+    q5 = build_q5_lite(capacity=1 << 11, state_cleaning=False)
+    chain = [poison] + list(q5.pipeline.executors)
+    gp = GraphPipeline(
+        [FragmentSpec("gq5", lambda i, ch=tuple(chain): list(ch))],
+        {"single": "gq5"},
+        "gq5",
+        [q5.agg, q5.mview],
+    )
+    rt = StreamingRuntime(
+        MemObjectStore(), async_checkpoint=False, auto_recover=True
+    )
+    rt.register("gq5", gp)
+    twin = build_q5_lite(capacity=1 << 11, state_cleaning=False)
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=20_000))
+    first_graph = gp.graph
+    try:
+        assert _fused_in_actors(gp), "poisoned chain's fusible run lost"
+        for epoch in range(5):
+            chunk = _bid_chunk(gen, 500, 1024)
+            if epoch == 2:
+                poison.armed = True
+            for _attempt in range(4):
+                rt.push("gq5", chunk)
+                before = rt.mgr.max_committed_epoch
+                rt.barrier()
+                if rt.mgr.max_committed_epoch > before:
+                    break
+            else:
+                raise AssertionError("epoch never committed")
+            twin.pipeline.push(chunk)
+            twin.pipeline.barrier()
+        assert rt.auto_recoveries == 1 and poison.fired == 1
+        assert gp.graph is not first_graph  # actors were rebuilt
+        assert _fused_in_actors(gp), "recovered graph lost fusion"
+        assert q5.mview.snapshot() == twin.mview.snapshot()
+    finally:
+        gp.close()

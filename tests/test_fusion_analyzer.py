@@ -210,10 +210,17 @@ def test_every_nexmark_fragment_classified():
                 b["code"].startswith("RW-E8") and b["executor"]
                 for b in fr["blockers"]
             ), (q, fr)
-    # ranked worklist sanity: q5's agg flush is blocker #1 by measured
-    # cost when the committed profile is attached
+    # the fused-step PR burned q5's blockers down: the hop->agg->MV
+    # fragment carries a whole-chain fusible proof with zero host syncs
+    q5_frag = out["q5"]["fragments"][0]
+    assert q5_frag["whole_chain_fusible"], q5_frag
+    assert q5_frag["host_sync_points"] == 0
+    # the remaining worklist stays visible: q7's filter/join path still
+    # carries ranked RW-E801 blockers
     assert any(
-        b["code"] == "RW-E801" for b in out["q5"]["fragments"][0]["blockers"]
+        b["code"] == "RW-E801"
+        for fr in out["q7"]["fragments"]
+        for b in fr["blockers"]
     )
 
 
@@ -259,12 +266,19 @@ def test_perf_gate_fusion_clean_and_regression(tmp_path):
     budgets = _load("scripts/perf_budgets.json")
     v, skipped = run_fusion_gate(budgets, "FUSION_REPORT.json")
     assert v == [], v  # committed baseline is green
-    # injected regression: baseline claims a longer fusible prefix and
-    # fewer sync points than reality -> the ratchet trips
+    # injected regression: baseline claims a longer fusible prefix
+    # (q5, already whole-chain) and fewer sync points than reality
+    # (q7's filter/join fragments still carry real syncs) -> the
+    # ratchet trips on both axes
     base = _load("FUSION_REPORT.json")
     frag = base["q5"]["fragments"][0]
     frag["fusible_prefix"] += 1
-    frag["host_sync_points"] = 0
+    synced = next(
+        f
+        for f in base["q7"]["fragments"]
+        if f["host_sync_points"] > 0
+    )
+    synced["host_sync_points"] = 0
     p = tmp_path / "base.json"
     p.write_text(json.dumps(base))
     v, _ = run_fusion_gate(budgets, str(p))

@@ -448,10 +448,16 @@ def test_perf_gate_smoke_budgets_in_process():
     )
     violations, report = perf_gate.run_smoke(budgets, epochs=3)
     assert violations == [], (violations, report)
-    assert report["dispatches_per_barrier"]
+    assert report["smoke_dispatches_per_barrier"]
     assert (
-        max(report["dispatches_per_barrier"])
+        max(report["smoke_dispatches_per_barrier"])
         <= budgets["smoke"]["dispatches_per_barrier_max"]
+    )
+    # the fused leg: one donated program per barrier, actually fused
+    assert report["fused_whole_chain"] is True
+    assert (
+        max(report["fused_dispatches_per_barrier"])
+        <= budgets["smoke"]["fused_dispatches_per_barrier_max"]
     )
 
 
