@@ -236,6 +236,45 @@ def _expand(executors):
     return expand_fused(executors)
 
 
+def _arm_deviceprof():
+    """Arm the compiled-artifact roofline (deviceprof): every fused
+    program bucket the measured run dispatches gets introspected ONCE
+    via AOT lower+compile — FLOPs, bytes accessed, HBM footprint,
+    compile ms, executable size — so the artifact's byte accounting
+    comes from the executable, not host guesses. Armed BEFORE warmup
+    so steady-state buckets analyze during warmup, not mid-measurement
+    (a cache miss there costs one extra compile). RW_BENCH_DEVICEPROF=0
+    opts out."""
+    import os
+
+    if os.environ.get("RW_BENCH_DEVICEPROF", "1") == "0":
+        return None
+    from risingwave_tpu.deviceprof import DEVICEPROF
+
+    DEVICEPROF.reset()
+    return DEVICEPROF.arm()
+
+
+def _roofline_fields(prefix, n_barriers, seconds):
+    """The ``{q}_roofline`` BENCH block: modeled bytes per barrier
+    from the compiled executable, decomposed into useful vs padding
+    traffic via the telemetry lanes — the explanation half of
+    ``achieved_bw_frac``."""
+    from risingwave_tpu.deviceprof import DEVICEPROF
+
+    if not DEVICEPROF.enabled:
+        return {}
+    return DEVICEPROF.roofline_fields(prefix, n_barriers, seconds)
+
+
+def _provenance_fields():
+    """git_sha / pr_tag / engine_generation for every artifact —
+    perf_gate warns when ratcheting against an older generation."""
+    from risingwave_tpu.provenance import stamp
+
+    return stamp()
+
+
 def _profile_begin():
     """Arm the dispatch-wall profiler for the measured run: every BENCH
     JSON carries the per-executor decomposition of the dispatch stage
@@ -689,6 +728,7 @@ def bench_q5_unified(epochs, events_per_epoch, chunk_events, smoke):
     )
 
     _shape_watch_begin()  # warmup registers the legal shape set
+    _arm_deviceprof()  # roofline: analyze buckets from warmup on
     c5 = _state_cap(2 * events_per_epoch, 1 << 16)
     catalog = Catalog({"bid": BID_SCHEMA})
     factory = lambda: StreamPlanner(catalog, capacity=c5)
@@ -746,6 +786,7 @@ def bench_q5_unified(epochs, events_per_epoch, chunk_events, smoke):
     # read live executor occupancy
     fused_fields = _fused_fields("q5u", mv.pipeline)
     shape_fields = _shape_fields("q5u", _expand(list(mv.pipeline.executors)))
+    roofline_fields = _roofline_fields("q5u", len(barrier_times), dt)
     snap = mv.mview.snapshot()  # {(auction, window_start): (num,)}
     ok = snap == {k: (v,) for k, v in cpu_counts.items()}
     mv.pipeline.close()
@@ -809,6 +850,7 @@ def bench_q5_unified(epochs, events_per_epoch, chunk_events, smoke):
         **prof_fields,
         **fused_fields,
         **shape_fields,
+        **roofline_fields,
     }
 
 
@@ -820,6 +862,7 @@ def bench_q5(args_epochs, events_per_epoch, chunk_events, smoke, agg_mode):
 
     fusion = _rwlint_gate("q5")  # static: fail BEFORE the event stream
     _shape_watch_begin()  # dynamic: warmup registers the legal shapes
+    _arm_deviceprof()  # roofline: analyze buckets from warmup on
 
     import numpy as np
 
@@ -969,6 +1012,7 @@ def bench_q5(args_epochs, events_per_epoch, chunk_events, smoke, agg_mode):
         **_profile_fields("q5", prof, len(barrier_times), total_bids),
         **_fused_fields("q5", q5.pipeline),
         **_shape_fields("q5", _expand(list(q5.pipeline.executors))),
+        **_roofline_fields("q5", len(barrier_times), dt),
     }
 
 
@@ -1047,6 +1091,7 @@ def _dump_bench_stall(query: str, tier: str, err) -> str:
                     "tier": tier,
                     "error": str(err),
                     "ts": time.time(),
+                    **_provenance_fields(),
                     "child_stall_dumps": sorted(
                         p for p in os.listdir(".")
                         if p.startswith("STALL_DUMP_")
@@ -1067,6 +1112,7 @@ def _bank_partial(merged: dict) -> None:
     the numbers on disk (r3 lost everything to an rc=124)."""
     import os
 
+    merged.update(_provenance_fields())
     tmp = PARTIAL_PATH + ".tmp"
     with open(tmp, "w") as f:
         json.dump(merged, f)
@@ -1083,6 +1129,7 @@ def _bank_query(query: str, tier: str, sub: dict) -> None:
     path = f"BENCH_{query}.json"
     try:
         doc = {"query": query, "tier": tier, "ts": time.time()}
+        doc.update(_provenance_fields())
         doc.update(sub)
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
@@ -1279,6 +1326,7 @@ def main():
             )
         blackbox.SENTINEL.stop()
         blackbox.RECORDER.close()
+        result.update(_provenance_fields())
         print(json.dumps(result))
         return
 
@@ -1304,6 +1352,7 @@ def main():
         result.setdefault(
             "barrier_stage_ms", result.get("q5_barrier_stage_ms", {})
         )
+        result.update(_provenance_fields())
         print(json.dumps(result))
         return
 

@@ -185,6 +185,13 @@ def main() -> None:
         metavar="OUT.json",
         help="write a chrome://tracing / Perfetto trace of the timeline",
     )
+    bb.add_argument(
+        "--roofline",
+        action="store_true",
+        help="add a roofline summary column per barrier (modeled HBM "
+        "bytes from the compiled executable, padding-bytes fraction, "
+        "fused telemetry) and a timeline summary footer",
+    )
     bb.add_argument("--json", action="store_true")
     bb.set_defaults(fn=_blackbox_read)
     cn = sub.add_parser(
@@ -264,11 +271,43 @@ def _blackbox_read(args) -> None:
                 extra += f" sen={r['sentinel']}"
             if "channel_depths" in r:
                 extra += f" depths={r['channel_depths']}"
+            if args.roofline and "modeled_bytes" in r:
+                extra += (
+                    f" model={r['modeled_bytes'] / 1e6:.1f}MB"
+                    f" pad={r.get('padding_bytes_frac', 0.0):.2%}"
+                )
+                tel = r.get("telemetry") or {}
+                for frag, t in tel.items():
+                    extra += f" {frag}[dirty={t.get('dirty', 0)}]"
             print(
                 f"  epoch {r['epoch']} seq {r['seq']} "
                 f"{'ckpt' if r['checkpoint'] else '    '} "
                 f"wall {r['wall_ms']:.1f}ms  {stages}{extra}"
             )
+        if args.roofline:
+            # timeline summary: modeled traffic vs wall time — the
+            # post-mortem roofline (what the fused programs moved, and
+            # how much of it was masked-lane waste)
+            modeled = [r for r in recs if r.get("modeled_bytes")]
+            if modeled:
+                total_b = sum(r["modeled_bytes"] for r in modeled)
+                total_s = sum(r["wall_ms"] or 0.0 for r in modeled) / 1e3
+                pad = sum(
+                    r["modeled_bytes"] * r.get("padding_bytes_frac", 0.0)
+                    for r in modeled
+                )
+                bw = total_b / total_s / 1e9 if total_s > 0 else 0.0
+                print(
+                    f"blackbox roofline: {len(modeled)} modeled "
+                    f"barrier(s), {total_b / 1e6:.1f}MB modeled traffic "
+                    f"({pad / max(total_b, 1):.1%} padding), "
+                    f"~{bw:.2f} GB/s over barrier wall time"
+                )
+            else:
+                print(
+                    "blackbox roofline: no modeled-bytes records "
+                    "(deviceprof was not armed in the writing process)"
+                )
         if not doc["monotonic"]:
             print("blackbox: WARNING — epoch timeline is NOT monotonic")
         if args.trace:

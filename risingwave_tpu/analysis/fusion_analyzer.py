@@ -853,4 +853,12 @@ def analyze_nexmark(
             disp = profile_bench.get(f"{key}_device_dispatches")
         attach_costs(reports, prof, disp)
         out[qname] = report_to_json(reports)
+    # provenance rides every regenerated FUSION report ("_"-prefixed:
+    # the perf_gate ratchet skips it; the generation check reads it)
+    try:
+        from risingwave_tpu.provenance import stamp
+
+        out["_provenance"] = stamp()
+    except Exception:  # noqa: BLE001 — provenance is best effort
+        pass
     return out

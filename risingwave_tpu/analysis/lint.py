@@ -382,6 +382,8 @@ def run_cli(args) -> int:
                 print(f"  {d.render()}")
         if fusion is not None:
             for q in sorted(fusion):
+                if q.startswith("_"):
+                    continue  # _provenance and friends: not a query
                 s = fusion[q]["summary"]
                 print(
                     f"{q} fusion: {s['fusible_fragments']}/"

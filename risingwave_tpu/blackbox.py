@@ -147,7 +147,10 @@ class FlightRecorder:
            d (cumulative device dispatches), x ({d2h,h2d} cumulative),
            hz (cumulative recompile hazards), dep ({fragment: total
            input-channel depth}), sen (sentinel state), mem (sampled
-           device memory_stats)
+           device memory_stats), mb (modeled bytes per barrier from the
+           compiled-executable roofline), pf (padding-bytes fraction of
+           the modeled traffic), tel ({fragment: fused telemetry-lane
+           scalars: per-member rows + dirty groups})
 
     Counters are recorded CUMULATIVE (cheap snapshot, no per-record
     subtraction on the hot path); the reader derives per-barrier
@@ -282,6 +285,30 @@ class FlightRecorder:
             total = hz.total()
             if total:
                 rec["hz"] = int(total)
+        # fused-engine tail (PR 11): an EpochTrace finalize() already
+        # CONSUMED its barrier's deviceprof model (modeled bytes +
+        # telemetry of the fragments that ran in it) — read it off the
+        # trace; standalone pipeline barriers (no EpochTrace) consume
+        # here instead. Either way a record only ever shows what THIS
+        # barrier did — never a stale echo of an earlier one.
+        mb = int(getattr(trace, "modeled_bytes", 0))
+        pf = float(getattr(trace, "padding_bytes_frac", 0.0))
+        tel = getattr(trace, "telemetry", None)
+        if tel is None:
+            try:
+                from risingwave_tpu.deviceprof import DEVICEPROF
+
+                tail = DEVICEPROF.consume_barrier()
+                mb = mb or tail["modeled_bytes"]
+                pf = pf or tail["padding_frac"]
+                tel = tail["tel"]
+            except Exception:
+                tel = None
+        if tel:
+            rec["tel"] = tel
+        if mb:
+            rec["mb"] = mb
+            rec["pf"] = pf
         # per-fragment channel depth (graph-backed fragments): the
         # wedge question "where is the data stuck" answered per barrier
         if runtime is not None:
@@ -980,6 +1007,11 @@ def read_segment(path: str, last: Optional[int] = None) -> Dict:
             out["channel_depths"] = rec["dep"]
         if "mem" in rec:
             out["memory_stats"] = rec["mem"]
+        if "mb" in rec:
+            out["modeled_bytes"] = rec["mb"]
+            out["padding_bytes_frac"] = rec.get("pf", 0.0)
+        if "tel" in rec:
+            out["telemetry"] = rec["tel"]
         if "d" in rec:
             out["dispatches_total"] = rec["d"]
             out["dispatches_delta"] = (

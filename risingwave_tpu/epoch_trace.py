@@ -116,8 +116,23 @@ class EpochTrace:
     state_bytes: int = 0
     state_delta_bytes: int = 0
     hbm_bytes_touched: int = 0
+    # byte-accounting provenance (PR 11): the legacy host guess
+    # (state-delta + chunk bytes — it never saw state-table READ
+    # traffic), the compiled-executable model that replaces it when
+    # deviceprof has analyzed the barrier's programs, and the modeled
+    # traffic's padding/useful decomposition
+    hbm_bytes_touched_legacy: int = 0
+    modeled_bytes: int = 0
+    padding_bytes_frac: float = 0.0
+    useful_bytes: int = 0
+    padding_bytes: int = 0
+    # compact fused telemetry of the fragments that ran THIS barrier
+    # (consumed from deviceprof at finalize; the flight recorder's
+    # `tel` field — never a stale echo of an earlier barrier)
+    telemetry: Dict = field(default_factory=dict)
     achieved_bw_gbps: float = 0.0
     achieved_bw_frac: float = 0.0
+    useful_bw_frac: float = 0.0
     committed_at: Optional[float] = None
 
     def add_stage(self, stage: str, ms: float, fragment: str = "-") -> None:
@@ -129,18 +144,63 @@ class EpochTrace:
         state_bytes: int,
         prev_state_bytes: int,
         platform: Optional[str] = None,
+        modeled_bytes: Optional[int] = None,
+        padding_frac: Optional[float] = None,
     ) -> None:
         """Close the trace: wall time + device telemetry. Called once
         the barrier's synchronous part is done (async commit stages may
-        still land afterwards — they mutate stages_ms in place)."""
+        still land afterwards — they mutate stages_ms in place).
+
+        Byte accounting: ``hbm_bytes_touched`` prefers the MODELED
+        bytes of the barrier's compiled programs (deviceprof's XLA
+        cost analysis — what the donated program actually reads and
+        writes, state-table reads included) and falls back to the
+        legacy state-delta + chunk sum, which is always kept as
+        ``hbm_bytes_touched_legacy`` for artifact continuity. The
+        modeled traffic decomposes into useful vs padding bytes using
+        the telemetry lanes' live/capacity accounting, so
+        ``achieved_bw_frac`` finally splits into "how busy was HBM"
+        (achieved) vs "how much of that was masked-lane waste"
+        (padding_bytes_frac -> useful_bw_frac)."""
         self.wall_ms = (time.perf_counter() - self.t_start) * 1e3
         self.state_bytes = int(state_bytes)
         self.state_delta_bytes = abs(int(state_bytes) - int(prev_state_bytes))
-        self.hbm_bytes_touched = self.state_delta_bytes + self.chunk_bytes
+        self.hbm_bytes_touched_legacy = (
+            self.state_delta_bytes + self.chunk_bytes
+        )
+        if modeled_bytes is None:
+            try:
+                from risingwave_tpu.deviceprof import DEVICEPROF
+
+                # CONSUME the barrier's model: only fragments that
+                # actually dispatched since the previous barrier count
+                # (an idle barrier models zero traffic — no phantom
+                # bandwidth), and their telemetry rides this trace
+                # into the flight-recorder record
+                tail = DEVICEPROF.consume_barrier()
+                modeled_bytes = tail["modeled_bytes"]
+                self.telemetry = tail["tel"]
+                if padding_frac is None:
+                    padding_frac = tail["padding_frac"]
+            except Exception:  # noqa: BLE001 — accounting never faults
+                modeled_bytes = 0
+        self.modeled_bytes = int(modeled_bytes or 0)
+        self.padding_bytes_frac = float(padding_frac or 0.0)
+        self.hbm_bytes_touched = (
+            self.modeled_bytes or self.hbm_bytes_touched_legacy
+        )
+        self.useful_bytes = int(
+            self.hbm_bytes_touched * (1.0 - self.padding_bytes_frac)
+        )
+        self.padding_bytes = self.hbm_bytes_touched - self.useful_bytes
         rf = roofline(self.hbm_bytes_touched, self.wall_ms / 1e3, platform)
         self.achieved_bw_gbps = rf["achieved_bw_gbps"]
         self.achieved_bw_frac = rf["achieved_bw_frac"]
+        self.useful_bw_frac = round(
+            self.achieved_bw_frac * (1.0 - self.padding_bytes_frac), 6
+        )
         REGISTRY.gauge("achieved_bw_frac").set(self.achieved_bw_frac)
+        REGISTRY.gauge("useful_bw_frac").set(self.useful_bw_frac)
         REGISTRY.gauge("hbm_bytes_touched").set(float(self.hbm_bytes_touched))
 
     def to_dict(self) -> Dict:
@@ -154,8 +214,14 @@ class EpochTrace:
             "state_bytes": self.state_bytes,
             "state_delta_bytes": self.state_delta_bytes,
             "hbm_bytes_touched": self.hbm_bytes_touched,
+            "hbm_bytes_touched_legacy": self.hbm_bytes_touched_legacy,
+            "modeled_bytes": self.modeled_bytes,
+            "padding_bytes_frac": self.padding_bytes_frac,
+            "useful_bytes": self.useful_bytes,
+            "padding_bytes": self.padding_bytes,
             "achieved_bw_gbps": self.achieved_bw_gbps,
             "achieved_bw_frac": self.achieved_bw_frac,
+            "useful_bw_frac": self.useful_bw_frac,
         }
 
 

@@ -281,6 +281,40 @@ class MetricsRegistry:
                 )
         except Exception:
             bb_rows = ""
+        # device roofline + fused telemetry (deviceprof.py): what the
+        # compiled programs MODEL (bytes/flops/compile cost per bucket)
+        # and what the telemetry lanes MEASURED last barrier — the
+        # inside-the-fused-program view PR 10 took away from the
+        # per-executor tables above
+        dp_rows = tel_rows = ""
+        try:
+            from risingwave_tpu.deviceprof import DEVICEPROF
+
+            # snapshot WITHOUT flushing: a dashboard page load must
+            # never run deferred AOT compiles (seconds on CPU, tens of
+            # seconds over a TPU tunnel, possibly mid-measurement)
+            rep = DEVICEPROF.report(flush=False)
+            for key, p in sorted(rep["programs"].items()):
+                if "error" in p:
+                    continue
+                dp_rows += (
+                    f"<tr><td>{escape(key)}</td>"
+                    f"<td>{p['compile_ms']}</td>"
+                    f"<td style='text-align:right'>{p['bytes_accessed']:,}</td>"
+                    f"<td style='text-align:right'>{p['flops']:,.0f}</td>"
+                    f"<td style='text-align:right'>{p['temp_bytes']:,}</td></tr>"
+                )
+            for frag, t in sorted(rep["telemetry"].items()):
+                tel_rows += (
+                    f"<tr><td>{escape(frag)}</td>"
+                    f"<td>{t.get('rows_in', 0)}</td>"
+                    f"<td>{t.get('dirty_groups', 0)}</td>"
+                    f"<td>{t.get('mv_rows', 0)}</td>"
+                    f"<td>{t.get('lane_fill_frac', 0.0)}</td>"
+                    f"<td>{t.get('padding_bytes_frac', 0.0)}</td></tr>"
+                )
+        except Exception:
+            dp_rows = tel_rows = ""
         # resilience health: retry pressure + breaker states + degraded
         # mode (resilience.py) — the operator's first look when the
         # store flakes
@@ -327,6 +361,8 @@ td,th{{border:1px solid #999;padding:2px 8px}}h2{{margin-top:1.5em}}</style></he
 <h2>barrier stages (ms)</h2><table><tr><th>stage</th><th>p50</th><th>p99</th><th>n</th></tr>{stage_rows or '<tr><td>no barriers traced</td></tr>'}</table>
 <h2>dispatch profile (top executors)</h2><table><tr><th>executor</th><th>host ms</th><th>device-wait ms</th><th>dispatches</th></tr>{prof_rows or '<tr><td>profiler not armed (RW_PROFILE=1)</td></tr>'}</table>
 <h2>black box &amp; device sentinel</h2><table>{bb_rows or '<tr><td>blackbox unavailable</td></tr>'}</table>
+<h2>device roofline (compiled programs)</h2><table><tr><th>program|bucket</th><th>compile ms</th><th>bytes accessed</th><th>flops</th><th>temp bytes</th></tr>{dp_rows or '<tr><td>deviceprof not armed (RW_DEVICEPROF=1)</td></tr>'}</table>
+<h2>fused telemetry (last barrier)</h2><table><tr><th>fragment</th><th>rows in</th><th>dirty groups</th><th>mv rows</th><th>lane fill</th><th>padding frac</th></tr>{tel_rows or '<tr><td>no fused barriers yet</td></tr>'}</table>
 <h2>resilience</h2><table><tr><th>metric</th><th>labels</th><th>value</th></tr>{res_rows or '<tr><td>no retries / breakers yet</td></tr>'}</table>
 <h2>events (last 25)</h2><table><tr><th>#</th><th>kind</th><th>detail</th></tr>{event_rows or '<tr><td>none</td></tr>'}</table>
 <p><a href="/metrics">/metrics</a> &middot; <a href="/heap">/heap</a> &middot; <a href="/events">/events</a></p>

@@ -536,6 +536,26 @@ class DispatchProfiler:
             "top_executors": self.top_executors(),
             "device": device_forensics(),
         }
+        # provenance: a PROFILE artifact must say which engine wrote it
+        # (stale-artifact confusion is mechanically detectable)
+        try:
+            from risingwave_tpu.provenance import stamp
+
+            doc.update(stamp())
+        except Exception:
+            pass
+        # fused-stage attribution: a jax_trace capture segments the ONE
+        # fused program via its named scopes — parse the trace back
+        # into the per-stage split (deviceprof leg 3)
+        if win.get("trace_dir"):
+            try:
+                from risingwave_tpu.deviceprof import parse_fused_stages
+
+                parsed = parse_fused_stages(win["trace_dir"])
+                if parsed["stages_ms"]:
+                    doc["fused_stage_ms"] = parsed
+            except Exception:  # noqa: BLE001 — capture must still land
+                pass
         if extra:
             doc.update(extra)
         path = os.path.join(

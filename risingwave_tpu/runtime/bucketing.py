@@ -52,6 +52,7 @@ __all__ = [
     "emission_bucket",
     "lattice_between",
     "needs_plan",
+    "padding_fraction",
     "padding_stats",
     "plan_capacity",
     "pow2_at_least",
@@ -359,6 +360,25 @@ def plan_capacity(
 
         return plan_rehash(cap, incoming, claimed, survivors, grow_at)
     return alloc.plan(cap, incoming, claimed, survivors)
+
+
+def padding_fraction(entries) -> float:
+    """Weighted wasted-lane fraction over ``(capacity, live,
+    weight_bytes)`` triples — the ZERO-device-read twin of
+    :func:`padding_stats`, fed from occupancy scalars that already
+    rode a packed barrier read (the fused telemetry lane). Weighting
+    by state bytes makes the fraction a traffic model: a padded lane
+    of a wide table wastes more HBM bandwidth than one of a narrow
+    table. Empty/degenerate input -> 0.0 (nothing padded = nothing
+    wasted, the padding_stats convention)."""
+    num = den = 0.0
+    for cap, live, weight in entries:
+        cap, weight = int(cap), float(weight)
+        if cap <= 0 or weight <= 0.0:
+            continue
+        num += weight * (1.0 - min(int(live), cap) / cap)
+        den += weight
+    return round(num / den, 6) if den else 0.0
 
 
 def padding_stats(executors) -> Dict[str, object]:

@@ -39,6 +39,7 @@ has_data) combinations are a small closed set in steady state.
 from __future__ import annotations
 
 import os
+from contextlib import nullcontext
 from dataclasses import dataclass
 from functools import partial
 from typing import List, Optional, Sequence, Tuple
@@ -143,77 +144,104 @@ def _fused_barrier_fn(states, stacked, plan, flush_rounds, pads, has_data):
                   groups, each delta walking mid-steps -> device MV ->
                   post-steps (the fragment's per-barrier emission);
     scalars     — the members' barrier latches + occupancy counters
-                  packed into one int64 lane for the overlapped
-                  finish_barrier read.
+                  PLUS the device-computed telemetry lane (rows
+                  applied, dirty groups drained, MV rows written) —
+                  all packed into one int64 lane for the overlapped
+                  finish_barrier read: per-member visibility at zero
+                  extra dispatches and zero new host syncs.
+
+    Each phase carries a ``jax.named_scope`` (fused/apply, fused/flush,
+    fused/mv_write, fused/scalar_pack) so a ``jax_trace`` capture
+    segments the ONE compiled program back into stages
+    (deviceprof.parse_fused_stages).
     """
     agg_st, mv_st = states
     outs: List[StreamChunk] = []
+    mv_rows = jnp.zeros((), jnp.int32)
 
     def _through_mv(chunk):
-        nonlocal mv_st
+        nonlocal mv_st, mv_rows
         if plan.mid is not None:
             chunk = plan.mid(chunk)
         if plan.has_mv:
-            mtable, mstate = mv_st
-            mtable, mstate = mv_step_fn(
-                mtable, mstate, chunk, plan.mv_pk, plan.mv_cols
-            )
-            mv_st = (mtable, mstate)
+            with jax.named_scope("fused/mv_write"):
+                mv_rows = mv_rows + jnp.sum(chunk.valid.astype(jnp.int32))
+                mtable, mstate = mv_st
+                mtable, mstate = mv_step_fn(
+                    mtable, mstate, chunk, plan.mv_pk, plan.mv_cols
+                )
+                mv_st = (mtable, mstate)
         if plan.post is not None:
             chunk = plan.post(chunk)
         return chunk
 
+    rows_in = jnp.zeros((), jnp.int32)
     if has_data:
-        if plan.agg is not None:
-            a = plan.agg
-            table, st, dropped, minput, mi_bad = agg_st
-            if a.has_minput:
-                table, st, dropped, minput, mi_bad = _epoch_reduced_fn(
-                    table, st, dropped, stacked, a.calls, a.group_keys,
-                    a.nullable, plan.pre, minput, mi_bad,
-                )
+        rows_in = jnp.sum(stacked.valid.astype(jnp.int32))
+        with jax.named_scope("fused/apply"):
+            if plan.agg is not None:
+                a = plan.agg
+                table, st, dropped, minput, mi_bad = agg_st
+                if a.has_minput:
+                    table, st, dropped, minput, mi_bad = _epoch_reduced_fn(
+                        table, st, dropped, stacked, a.calls, a.group_keys,
+                        a.nullable, plan.pre, minput, mi_bad,
+                    )
+                else:
+                    table, st, dropped = _epoch_reduced_fn(
+                        table, st, dropped, stacked, a.calls, a.group_keys,
+                        a.nullable, plan.pre,
+                    )
+                agg_st = (table, st, dropped, minput, mi_bad)
             else:
-                table, st, dropped = _epoch_reduced_fn(
-                    table, st, dropped, stacked, a.calls, a.group_keys,
-                    a.nullable, plan.pre,
+                chunks = (
+                    jax.vmap(plan.pre)(stacked)
+                    if plan.pre is not None
+                    else stacked
                 )
-            agg_st = (table, st, dropped, minput, mi_bad)
-        else:
-            chunks = (
-                jax.vmap(plan.pre)(stacked)
-                if plan.pre is not None
-                else stacked
-            )
-            # flatten the epoch into one batch: the MV's last-
-            # occurrence-per-pk mask makes one flat step equivalent to
-            # applying the chunks in order
-            flat = jax.tree.map(
-                lambda x: x.reshape((-1,) + x.shape[2:]), chunks
-            )
-            outs.append(_through_mv(flat))
+                # flatten the epoch into one batch: the MV's last-
+                # occurrence-per-pk mask makes one flat step equivalent
+                # to applying the chunks in order
+                flat = jax.tree.map(
+                    lambda x: x.reshape((-1,) + x.shape[2:]), chunks
+                )
+                outs.append(_through_mv(flat))
+
+    # dirty groups pending at the barrier, sampled AFTER the epoch's
+    # applies and BEFORE the flush drains them — the device-computed
+    # twin of the interpreted agg's jnp.sum(state.dirty) at flush time
+    dirty_groups = jnp.zeros((), jnp.int32)
+    if plan.agg is not None:
+        dirty_groups = jnp.sum(agg_st[1].dirty.astype(jnp.int32))
 
     if plan.agg is not None and flush_rounds:
         a = plan.agg
         table, st, dropped, minput, mi_bad = agg_st
-        for r in range(flush_rounds):
-            st, delta = agg_ops.flush(
-                st, table.keys, a.out_cap, a.float_extremes
-            )
-            outs.append(_through_mv(_delta_chunk(delta, a, pads[r])))
+        with jax.named_scope("fused/flush"):
+            for r in range(flush_rounds):
+                st, delta = agg_ops.flush(
+                    st, table.keys, a.out_cap, a.float_extremes
+                )
+                outs.append(_through_mv(_delta_chunk(delta, a, pads[r])))
         agg_st = (table, st, dropped, minput, mi_bad)
 
-    scal = []
-    if plan.agg is not None:
-        table, st, dropped, minput, mi_bad = agg_st
-        scal += [dropped, st.minmax_retracted, mi_bad, table.occupancy()]
-    if plan.has_mv:
-        mtable, mstate = mv_st
-        scal += [mstate.dropped, mtable.occupancy()]
-    packed = (
-        jnp.stack([jnp.asarray(x).astype(jnp.int64) for x in scal])
-        if scal
-        else None
-    )
+    with jax.named_scope("fused/scalar_pack"):
+        scal = []
+        if plan.agg is not None:
+            table, st, dropped, minput, mi_bad = agg_st
+            scal += [dropped, st.minmax_retracted, mi_bad, table.occupancy()]
+        if plan.has_mv:
+            mtable, mstate = mv_st
+            scal += [mstate.dropped, mtable.occupancy()]
+        if scal:
+            # telemetry tail rides the same staged read the barrier
+            # already pays: rows applied, dirty groups, MV rows
+            scal += [rows_in, dirty_groups, mv_rows]
+        packed = (
+            jnp.stack([jnp.asarray(x).astype(jnp.int64) for x in scal])
+            if scal
+            else None
+        )
     return (agg_st, mv_st), tuple(outs), packed
 
 
@@ -309,6 +337,11 @@ class FusedChainExecutor(Executor):
         )
         self._buf: List[StreamChunk] = []
         self._sig = None
+        # telemetry bookkeeping: padded lane count of the last staged
+        # program's stacked input (masked-lane fill denominator) and
+        # the last materialized telemetry dict (deviceprof mirror)
+        self._last_lanes = 0
+        self._telemetry: Optional[dict] = None
         # the previous program's consumed inputs, held until the
         # barrier fence: dropping a buffer an in-flight async program
         # still reads BLOCKS the host until the program completes (the
@@ -378,12 +411,132 @@ class FusedChainExecutor(Executor):
         self._retired = None
 
     def _on_barrier_scalars(self, vals) -> None:
+        # telemetry FIRST: a tripped member latch raises below, and the
+        # flight recorder must still see what the barrier did
+        base = (4 if self.agg is not None else 0) + (
+            2 if self.mv is not None else 0
+        )
+        if len(vals) >= base + 3:
+            self._note_telemetry(vals, vals[base:base + 3])
         i = 0
         if self.agg is not None:
             self.agg._on_barrier_scalars(tuple(vals[0:4]))
             i = 4
         if self.mv is not None:
             self.mv._on_barrier_scalars(tuple(vals[i:i + 2]))
+
+    def _note_telemetry(self, vals, tail) -> None:
+        """Decode the packed telemetry lane into the deviceprof
+        registry (host-side bookkeeping over values the barrier read
+        anyway — zero extra device IO; never faults the barrier)."""
+        try:
+            rows_in, dirty_groups, mv_rows = (int(x) for x in tail)
+            member_rows = {}
+            occupancy = {}
+            seen_agg = False
+            for idx, m in enumerate(self.members):
+                name = f"{idx}:{type(m).__name__}"
+                if m is self.agg:
+                    member_rows[name] = rows_in
+                    occupancy["agg"] = int(vals[3])
+                    seen_agg = True
+                elif m is self.mv:
+                    member_rows[name] = mv_rows
+                    occupancy["mv"] = int(
+                        vals[5 if self.agg is not None else 1]
+                    )
+                else:
+                    # pure members see the input rows before the agg
+                    # collapses them, the flush-delta rows after
+                    member_rows[name] = mv_rows if seen_agg else rows_in
+            # padded-lane waste over the members' state tables, from
+            # the occupancies that rode the packed read (live lanes)
+            # weighted by each member's state bytes — the live/capacity
+            # accounting runtime/bucketing.padding_stats reads from the
+            # device, here for free
+            from risingwave_tpu.runtime.bucketing import padding_fraction
+
+            pad_frac = padding_fraction(
+                (ex.table.capacity, occupancy[key], ex.state_nbytes())
+                for key, ex in (("agg", self.agg), ("mv", self.mv))
+                if ex is not None and key in occupancy
+            )
+            lanes = self._last_lanes
+            tel = {
+                "rows_in": rows_in,
+                "dirty_groups": dirty_groups,
+                "mv_rows": mv_rows,
+                "member_rows": member_rows,
+                "occupancy": occupancy,
+                "lanes_total": lanes,
+                "lane_fill_frac": (
+                    round(rows_in / lanes, 6) if lanes else 0.0
+                ),
+                "padding_bytes_frac": pad_frac,
+            }
+            self._telemetry = tel
+            from risingwave_tpu.deviceprof import DEVICEPROF
+
+            DEVICEPROF.note_telemetry(self.label, tel)
+        except Exception:  # noqa: BLE001 — forensic, never load-bearing
+            pass
+
+    def _deviceprof_hook(
+        self, states, stacked, flush_rounds, pads, has_data
+    ) -> None:
+        """Compiled-artifact roofline: analyze this (plan, bucket)
+        combination ONCE via AOT lower+compile over abstract args —
+        FLOPs / bytes-accessed / HBM footprint / compile ms for the
+        exact program this barrier dispatches. Gated on the one
+        DEVICEPROF.enabled check; never raises."""
+        from risingwave_tpu.deviceprof import DEVICEPROF
+
+        if not DEVICEPROF.enabled:
+            return
+        try:
+            shape = (
+                "x".join(map(str, stacked.valid.shape[:2]))
+                if has_data
+                else "-"
+            )
+            # member table capacities are part of the program's input
+            # avals: growth mints a NEW compiled program, so it must
+            # mint a new bucket too or the fragment keeps reporting
+            # the pre-growth executable's modeled bytes
+            caps = ".".join(
+                str(ex.table.capacity)
+                for ex in (self.agg, self.mv)
+                if ex is not None
+            )
+            bucket = (
+                f"fr{flush_rounds}_p{'.'.join(map(str, pads)) or '-'}"
+                f"_d{int(has_data)}_n{shape}_c{caps or '-'}"
+            )
+            abstract = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                (states, stacked),
+            )
+            # the deferred thunk closes over LOCALS only (abstract
+            # shapes + the plan AS DISPATCHED): capturing self would
+            # pin the whole executor (and its retired device buffers)
+            # in the pending queue, and a post-rebuild plan mutation
+            # would lower a program that no longer matches this bucket
+            plan = self.plan
+            DEVICEPROF.ensure_program(
+                f"fused:{self.label}",
+                bucket,
+                lambda: _fused_barrier_step.lower(
+                    abstract[0],
+                    abstract[1],
+                    plan,
+                    flush_rounds,
+                    pads,
+                    has_data,
+                ),
+                fragment=self.label,
+            )
+        except Exception:  # noqa: BLE001 — observability never faults
+            pass
 
     def capture_checkpoint(self) -> None:
         for m in self.members:
@@ -460,12 +613,23 @@ class FusedChainExecutor(Executor):
         ):
             return []  # nothing to run, nothing to stage
         states = (self._agg_state(), self._mv_state())
+        if stage:
+            self._last_lanes = (
+                int(stacked.valid.shape[0] * stacked.valid.shape[1])
+                if has_data
+                else 0
+            )
+        self._deviceprof_hook(states, stacked, flush_rounds, pads, has_data)
+        # attribution contexts: dispatch counting (PROFILER.attribute)
+        # and — under an armed jax_trace capture — a TraceAnnotation so
+        # the device trace carries the fragment label next to the
+        # program's fused/<stage> named scopes
+        attr = ann = nullcontext()
         if PROFILER.enabled:
-            with PROFILER.attribute(f"fused:{self.label}"):
-                (agg_st, mv_st), outs, packed = _fused_barrier_step(
-                    states, stacked, self.plan, flush_rounds, pads, has_data
-                )
-        else:
+            attr = PROFILER.attribute(f"fused:{self.label}")
+            if PROFILER.jax_trace:
+                ann = jax.profiler.TraceAnnotation(f"fused:{self.label}")
+        with attr, ann:
             (agg_st, mv_st), outs, packed = _fused_barrier_step(
                 states, stacked, self.plan, flush_rounds, pads, has_data
             )

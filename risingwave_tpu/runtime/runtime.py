@@ -706,6 +706,13 @@ class StreamingRuntime:
         # jax.profiler session surviving a recovery would hold the
         # device and poison the next capture (watchdog-orphan audit)
         PROFILER.abort_captures()
+        # deviceprof re-arms across the rebuild: stale per-barrier
+        # telemetry drops, program analyses survive (the rebuilt
+        # fragments re-fuse into the SAME compiled programs), and no
+        # capture window can orphan — deviceprof never opens one
+        from risingwave_tpu.deviceprof import DEVICEPROF
+
+        DEVICEPROF.on_recovery()
         # a DeviceWedged is handled like an actor fault, not a crash:
         # abort the sentinel's capture window and disarm the wedge so
         # the recovered runtime's next barrier proceeds — a device that
@@ -1672,6 +1679,9 @@ class StreamingRuntime:
         PROFILER.abort_captures()
         blackbox.SENTINEL.abort_capture()
         blackbox.SENTINEL.clear_wedge()
+        from risingwave_tpu.deviceprof import DEVICEPROF
+
+        DEVICEPROF.on_recovery()
         if fragments is not None:
             scope = set(fragments)
             unknown = scope - set(self.fragments)

@@ -15,7 +15,8 @@ Stages (all must pass; exit code is the OR of their failures):
 3. ``python -m risingwave_tpu lint --all-nexmark --fusion-report`` —
    the fusion-feasibility analyzer: per-fragment fusible prefixes +
    RW-E8xx blockers with provenance.
-4. ``python scripts/perf_gate.py --smoke --blackbox --fusion`` — the
+4. ``python scripts/perf_gate.py --smoke --blackbox --roofline
+   --fusion`` — the
    dispatch-cost regression gate: committed BENCH artifacts vs
    scripts/perf_budgets.json, the CPU q5 steady-state microbench
    (bounded device dispatches/barrier + host-python ms/row), the
@@ -165,6 +166,8 @@ def stage_fusion_report(out_path: str) -> int:
             with open(out_path) as f:
                 fus = json.load(f).get("__fusion__", {})
             for q in sorted(fus):
+                if q.startswith("_"):
+                    continue  # _provenance and friends: not a query
                 s = fus[q]["summary"]
                 print(
                     f"[lint_all]   {q}: "
@@ -179,11 +182,12 @@ def stage_fusion_report(out_path: str) -> int:
 
 
 def stage_perf_gate(fusion_current: str = None) -> int:
-    print("[lint_all] perf_gate --smoke --blackbox + fusion ratchet "
-          "(dispatch-cost + recorder/fsync + fusion-regression budgets)")
+    print("[lint_all] perf_gate --smoke --blackbox --roofline + fusion "
+          "ratchet (dispatch-cost + recorder/fsync + device-roofline + "
+          "fusion-regression budgets)")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     cmd = [sys.executable, os.path.join(ROOT, "scripts", "perf_gate.py"),
-           "--smoke", "--blackbox"]
+           "--smoke", "--blackbox", "--roofline"]
     if fusion_current and os.path.exists(fusion_current):
         cmd += ["--fusion-current", fusion_current]
     else:

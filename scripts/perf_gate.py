@@ -145,6 +145,41 @@ def check_bench(bench: dict, budgets: dict, verbose=True):
             )
         else:
             note(f"{q}: wasted-lane fraction {got} <= {mx} ok")
+    # roofline budgets (PR 11): the modeled-traffic padding fraction
+    # per query (the price of bucketed shapes, now measured from the
+    # compiled executable + telemetry lanes instead of a device scan)
+    # and the per-bucket compile cost of every analyzed program
+    rb = budgets.get("roofline", {})
+    for q, mx in rb.get("padding_bytes_frac_max", {}).items():
+        blk = bench.get(f"{q}_roofline")
+        if not isinstance(blk, dict) or "padding_bytes_frac" not in blk:
+            skipped.append(f"{q}_roofline: absent from artifact")
+            continue
+        got = float(blk["padding_bytes_frac"])
+        if got > mx:
+            violations.append(
+                f"{q}: modeled padding-bytes fraction {got} > budget "
+                f"{mx} (masked-lane waste dominates the fused "
+                "program's traffic)"
+            )
+        else:
+            note(f"{q}: padding-bytes fraction {got} <= {mx} ok")
+    cms = rb.get("compile_ms_max")
+    if cms:
+        for q in ("q5", "q5u", "q7", "q8"):
+            blk = bench.get(f"{q}_roofline")
+            progs = (blk or {}).get("programs")
+            if not isinstance(progs, dict):
+                continue
+            for key, p in progs.items():
+                got = float(p.get("compile_ms", 0.0))
+                if got > cms:
+                    violations.append(
+                        f"{q}: program {key} compiled in {got:.0f}ms > "
+                        f"budget {cms}ms per bucket"
+                    )
+                else:
+                    note(f"{q}: {key} compile {got:.0f}ms <= {cms}ms ok")
     # executor-attribution coverage: when the artifact carries the
     # per-executor decomposition it must actually explain the dispatch
     # stage (≥ coverage_min of the stage total), or the breakdown has
@@ -481,23 +516,22 @@ def run_blackbox_gate(budgets: dict):
 
 
 # ---------------------------------------------------------------------------
-# mode 2: steady-state smoke microbench (CPU, in-process)
+# mode 5: device-roofline gate (telemetry overhead + modeled bytes)
 # ---------------------------------------------------------------------------
 
 
-def _smoke_leg(budgets: dict, fused: bool, epochs: int, events: int):
-    """One q5 steady-state microbench leg (interpreted or fused) with
-    the profiler armed. Returns (violations, report)."""
-    from risingwave_tpu.metrics import REGISTRY
-    from risingwave_tpu.profiler import PROFILER
+def _q5_steady_setup(events: int, fused: bool):
+    """The q5 steady-state harness SHARED by the smoke and roofline
+    gates: one pipeline, optional fusion, one fixed chunk pushed every
+    epoch (fresh keys would grow the table — a legitimate recompile,
+    not the regression these gates hunt). Returns ``(q5, wrappers,
+    epoch_fn, rows_per_epoch)``."""
     from risingwave_tpu.connectors.nexmark import (
         NexmarkConfig,
         NexmarkGenerator,
     )
     from risingwave_tpu.queries.nexmark_q import build_q5_lite
 
-    sb = budgets.get("smoke", {})
-    leg = "fused" if fused else "smoke"
     q5 = build_q5_lite(capacity=1 << 12, state_cleaning=False)
     wrappers = []
     if fused:
@@ -505,8 +539,6 @@ def _smoke_leg(budgets: dict, fused: bool, epochs: int, events: int):
 
         wrappers = fuse_pipeline(q5.pipeline, label="q5")
     gen = NexmarkGenerator(NexmarkConfig(first_event_rate=50_000))
-    # STEADY state: the same chunk every epoch (fresh keys would grow
-    # the table — a legitimate recompile, not the regression here)
     bid = gen.next_chunks(events, 1 << 11)["bid"].select(
         ["auction", "date_time"]
     )
@@ -516,6 +548,148 @@ def _smoke_leg(budgets: dict, fused: bool, epochs: int, events: int):
         q5.pipeline.push(bid)
         q5.pipeline.barrier()
 
+    return q5, wrappers, epoch, rows
+
+
+def run_roofline_gate(budgets: dict, epochs: int = 4, events: int = 2_000):
+    """Three checks so the device-observability layer can never
+    silently rot or get expensive:
+
+    1. Telemetry host overhead: the fused telemetry lanes ride the
+       existing staged-scalar read, so their ONLY cost is host-side
+       decode+record — measured here against the steady fused-barrier
+       wall and budgeted < ``telemetry_overhead_frac_max`` (the <1%
+       contract).
+    2. Modeled bytes exist: an armed deviceprof must produce a nonzero
+       modeled-traffic figure for the fused q5 program (the byte
+       accounting the roofline replaces host guesses with).
+    3. Dispatch neutrality: telemetry+analysis armed, the steady fused
+       barrier still costs exactly ONE device dispatch.
+
+    Returns (violations, report)."""
+    import time
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if ROOT not in sys.path:
+        sys.path.insert(0, ROOT)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from risingwave_tpu.deviceprof import DEVICEPROF
+    from risingwave_tpu.profiler import PROFILER
+
+    rb = budgets.get("roofline", {})
+    violations, report = [], {}
+    DEVICEPROF.reset()
+    DEVICEPROF.arm()
+    _q5, _wrappers, epoch, _rows = _q5_steady_setup(events, fused=True)
+    try:
+        epoch()
+        epoch()  # warm: compiles land outside the window
+        DEVICEPROF.flush_analyses()  # deferred AOT analyses too
+        DEVICEPROF.telemetry_host_ms = 0.0
+        PROFILER.reset()
+        PROFILER.enable(fence=False)
+        per = []
+        t0 = time.perf_counter()
+        for _ in range(epochs):
+            base = PROFILER.total_dispatches()
+            epoch()
+            per.append(PROFILER.total_dispatches() - base)
+        wall_ms = (time.perf_counter() - t0) * 1e3
+    finally:
+        PROFILER.disable()
+        PROFILER.reset()
+    tel_ms = DEVICEPROF.telemetry_host_ms
+    frac = tel_ms / wall_ms if wall_ms > 0 else 0.0
+    DEVICEPROF.flush_analyses()  # any bucket the steady window minted
+    model = DEVICEPROF.steady_model()
+    report = {
+        "telemetry_host_ms": round(tel_ms, 4),
+        "steady_wall_ms": round(wall_ms, 2),
+        "telemetry_overhead_frac": round(frac, 5),
+        "modeled_bytes": model["modeled_bytes"],
+        "padding_frac": model["padding_frac"],
+        "dispatches_per_barrier": per,
+    }
+    mx = rb.get("telemetry_overhead_frac_max")
+    if mx is not None and frac > mx:
+        violations.append(
+            f"roofline: telemetry host overhead {frac:.4f} of the "
+            f"steady barrier > budget {mx} (the lanes must ride the "
+            "existing staged read, not become a new cost)"
+        )
+    if not model["modeled_bytes"]:
+        violations.append(
+            "roofline: armed deviceprof produced NO modeled bytes for "
+            "the fused q5 program — the byte accounting regressed to "
+            "host guesses"
+        )
+    if per and max(per) > 1:
+        violations.append(
+            f"roofline: telemetry armed, steady fused barrier costs "
+            f"{max(per):.0f} dispatches (must stay 1 — observability "
+            "added a dispatch)"
+        )
+    DEVICEPROF.disarm()
+    DEVICEPROF.reset()
+    return violations, report
+
+
+def _engine_generation() -> int:
+    """Load provenance.py BY PATH: the pure-JSON gate mode must stay
+    jax-free, and importing the package would pull jax in via
+    __init__."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_rw_provenance",
+        os.path.join(ROOT, "risingwave_tpu", "provenance.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.ENGINE_GENERATION
+
+
+def generation_warnings(artifact: dict, label: str):
+    """Provenance check: ratcheting against an artifact written by an
+    OLDER engine generation is exactly the stale-artifact confusion
+    that cost a re-anchor — warn loudly (not a violation: old
+    artifacts stay comparable for the fields they carry)."""
+    ENGINE_GENERATION = _engine_generation()
+    # bench artifacts stamp at top level; fusion reports under the
+    # "_"-prefixed key the ratchet loop skips
+    prov = artifact.get("_provenance") or artifact
+    gen = prov.get("engine_generation")
+    if gen is None:
+        return [
+            f"{label}: no engine_generation stamp (predates PR 11 "
+            "provenance) — treat its numbers as a DIFFERENT engine's"
+        ]
+    if int(gen) < ENGINE_GENERATION:
+        return [
+            f"{label}: written by engine generation {gen} < current "
+            f"{ENGINE_GENERATION} (sha {prov.get('git_sha', '?')[:12]}"
+            f", tag {prov.get('pr_tag', '?')}) — numbers may not "
+            "be comparable"
+        ]
+    return []
+
+
+# ---------------------------------------------------------------------------
+# mode 2: steady-state smoke microbench (CPU, in-process)
+# ---------------------------------------------------------------------------
+
+
+def _smoke_leg(budgets: dict, fused: bool, epochs: int, events: int):
+    """One q5 steady-state microbench leg (interpreted or fused) with
+    the profiler armed. Returns (violations, report)."""
+    from risingwave_tpu.metrics import REGISTRY
+    from risingwave_tpu.profiler import PROFILER
+
+    sb = budgets.get("smoke", {})
+    leg = "fused" if fused else "smoke"
+    _q5, wrappers, epoch, rows = _q5_steady_setup(events, fused)
     epoch()
     epoch()  # warm: compiles + first-flush paths
     PROFILER.reset()
@@ -629,6 +803,14 @@ def main(argv=None) -> int:
         "budgets, and the write-ring -> SIGKILL -> reader-CLI smoke",
     )
     ap.add_argument(
+        "--roofline",
+        action="store_true",
+        help="gate the device-observability layer: fused telemetry "
+        "host overhead < 1%% of the steady barrier, modeled bytes "
+        "present, dispatches/barrier still 1 (plus the artifact "
+        "padding/compile budgets, which always run with --bench)",
+    )
+    ap.add_argument(
         "--fusion-current",
         default=None,
         help="reuse an existing `lint --fusion-report --json` output "
@@ -650,7 +832,17 @@ def main(argv=None) -> int:
         v, report = run_blackbox_gate(budgets)
         print(f"[perf_gate] blackbox: {json.dumps(report)}")
         violations += v
+    if args.roofline:
+        v, report = run_roofline_gate(budgets)
+        print(f"[perf_gate] roofline: {json.dumps(report)}")
+        violations += v
     if args.fusion or args.fusion_current:
+        try:
+            baseline = _load(args.fusion_baseline or DEFAULT_FUSION_BASELINE)
+            for w in generation_warnings(baseline, "fusion baseline"):
+                print(f"[perf_gate] WARNING: {w}")
+        except (OSError, json.JSONDecodeError):
+            pass  # run_fusion_gate reports unreadable baselines itself
         v, skipped = run_fusion_gate(
             budgets, args.fusion_baseline, args.fusion_current
         )
@@ -666,6 +858,10 @@ def main(argv=None) -> int:
         except (OSError, json.JSONDecodeError) as e:
             print(f"[perf_gate] cannot read bench: {e}", file=sys.stderr)
             return 2
+        for w in generation_warnings(
+            bench, os.path.basename(bench_path)
+        ):
+            print(f"[perf_gate] WARNING: {w}")
         v, skipped = check_bench(bench, budgets)
         for s in skipped:
             print(f"[perf_gate] skip: {s}")

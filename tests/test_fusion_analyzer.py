@@ -202,6 +202,10 @@ def test_every_nexmark_fragment_classified():
     """Acceptance shape: every fragment carries a whole-chain fusible
     proof or >=1 named RW-E8xx blocker with executor provenance."""
     out = analyze_nexmark(deep=True)
+    # provenance rides every regenerated report (stale-artifact
+    # detection, PR 11) under a "_"-prefixed key the ratchet skips
+    prov = out.pop("_provenance")
+    assert prov["engine_generation"] >= 11
     assert set(out) == {"q5", "q7", "q8"}
     for q, rep in out.items():
         assert rep["fragments"], q
@@ -397,8 +401,11 @@ def test_lint_cli_fusion_report_json(capsys):
     out = json.loads(capsys.readouterr().out)
     assert rc == 0
     fus = out["__fusion__"]
-    assert set(fus) == {"q5", "q7", "q8"}
-    for q in fus:
+    assert "_provenance" in fus  # stamped for stale-artifact detection
+    assert set(fus) - {"_provenance"} == {"q5", "q7", "q8"}
+    for q in list(fus):
+        if q.startswith("_"):
+            continue
         assert not any(
             b["code"] in ("RW-E803", "RW-E806")
             for fr in fus[q]["fragments"]
