@@ -164,6 +164,16 @@ def main() -> None:
         "provenance, estimated dispatch savings (implies "
         "--all-nexmark when no SQL files are given)",
     )
+    ln.add_argument(
+        "--sharing-report",
+        action="store_true",
+        dest="sharing_report",
+        help="share-key fingerprints per keyed state table + the "
+        "corpus' sharing opportunities (Shared Arrangements candidates; "
+        "RW-E703 flags would-share tables split only by an incompatible "
+        "bucket lattice). Analyzes the built-in corpus incl. the "
+        "SQL-planned q5u twin",
+    )
     ln.add_argument("--json", action="store_true")
     ln.set_defaults(fn=_lint)
     bb = sub.add_parser(

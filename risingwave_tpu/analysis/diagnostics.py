@@ -52,6 +52,10 @@ CODES = {
     # state tables
     "RW-E701": "state-table primary key not covered by the input schema",
     "RW-E702": "duplicate state table_id within one plan",
+    "RW-E703": "would-share state tables differ ONLY by an incompatible "
+    "bucket lattice: same index key columns, dtypes and window spec, but "
+    "the declared capacity lattices disagree — aligning capacities would "
+    "let one shared arrangement serve both (runtime/arrangements.py)",
     # fusion feasibility (analysis/fusion_analyzer.py): what blocks
     # fusing a fragment's executor chain into ONE jitted per-barrier
     # device step (ROADMAP item 1), proven statically

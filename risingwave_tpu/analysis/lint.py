@@ -301,6 +301,40 @@ def run_cli(args) -> int:
     """Returns the process exit code: 0 = no error findings."""
     import json as _json
 
+    if getattr(args, "sharing_report", False):
+        # the sharing report is its own corpus analysis (it builds the
+        # SQL-planned q5u twin next to the hand-built queries) — run it
+        # standalone so CI can consume one clean JSON document
+        from risingwave_tpu.analysis.sharing import run_sharing_report
+
+        rep = run_sharing_report()
+        if args.json:
+            print(_json.dumps(rep, default=str))
+        else:
+            s = rep["summary"]
+            print(
+                f"sharing: {s['plans']} plan(s), {s['state_tables']} "
+                f"keyed state table(s), {s['exact_shareable_groups']} "
+                f"exact-shareable group(s), {s['index_opportunities']} "
+                f"index opportunity(ies), {s['lattice_mismatches']} "
+                "lattice mismatch(es)"
+            )
+            for t in rep["tables"]:
+                print(
+                    f"  {t['plan']}:{t['table_id']} [{t['executor']}] "
+                    f"keys={t['keys']} index={t['index_fingerprint']} "
+                    f"share={t['share_fingerprint']}"
+                )
+            for o in rep["opportunities"]:
+                print(
+                    f"  OPPORTUNITY keys={o['keys']}: "
+                    f"{', '.join(o['tables'])}"
+                )
+            for d in rep["diagnostics"]:
+                print(f"  {d['code']} [{d['severity']}] {d['message']}")
+        # lattice mismatches are warnings (advisory), never exit-fatal
+        return 0
+
     fusion_report = getattr(args, "fusion_report", False)
     if fusion_report and not (args.all_nexmark or args.paths):
         # a bare --fusion-report means "the built-in corpus"

@@ -1,0 +1,310 @@
+"""Sharing analysis — per-table share-key fingerprints + the corpus'
+sharing opportunities (``lint --sharing-report``).
+
+The Shared Arrangements insight (PAPERS.md, arxiv 1812.02639) is that
+maintained keyed indexes are REUSABLE across queries; the runtime half
+lives in ``runtime/arrangements.py`` (whole-plan attach at CREATE-MV
+time). This module is the STATIC half: walk every plan's stateful
+executors, fingerprint each keyed state table (index key columns,
+dtypes, window spec, bucket lattice, upstream chain signature), and
+report:
+
+- **exact** duplicates — same everything including the upstream step
+  chain: physically shareable today (the DDL registry would attach);
+- **index** opportunities — same (class, keys, dtypes, window spec)
+  reached through different chains: the classic shared-arrangement
+  candidate set (Nexmark q5 and the unified q5u report the same
+  window-agg index here);
+- **RW-E703** — a would-share pair that differs ONLY by an
+  incompatible bucket lattice: the one knob (capacity) stands between
+  the plans and one shared device index.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional, Tuple
+
+from risingwave_tpu.analysis.diagnostics import Diagnostic
+
+__all__ = [
+    "run_sharing_report",
+    "sharing_report",
+    "table_share_keys",
+]
+
+
+def _stable_hash(value) -> str:
+    return hashlib.sha1(repr(value).encode()).hexdigest()[:12]
+
+
+def _dtype_name(v) -> str:
+    """Normalize the zoo of dtype spellings (np.dtype, jnp scalar
+    classes, strings) to one canonical name — fingerprints must not
+    split on representation."""
+    try:
+        import numpy as np
+
+        return str(np.dtype(v))
+    except Exception:  # noqa: BLE001 — exotic dtype object
+        return str(v)
+
+
+def _step_key(ex) -> Tuple:
+    """A stable identity for one upstream executor in the chain prefix
+    (the data transformation feeding the state table)."""
+    fn = getattr(ex, "pure_step", None)
+    step = fn() if fn is not None else None
+    if step is not None:
+        try:
+            return (
+                step.func.__name__,
+                tuple(repr(a) for a in step.args),
+                tuple(
+                    (k, repr(v)) for k, v in sorted(step.keywords.items())
+                ),
+            )
+        except Exception:  # noqa: BLE001 — fall through to class identity
+            pass
+    info = None
+    fn = getattr(ex, "lint_info", None)
+    if fn is not None:
+        try:
+            info = fn()
+        except Exception:  # noqa: BLE001 — opaque
+            info = None
+    return (type(ex).__name__, repr(sorted((info or {}).items())))
+
+
+def _window_buckets(ex) -> Optional[Tuple[int, ...]]:
+    """The declared bucket lattice backing the executor's window-keyed
+    shapes (the PR 9 pow2 lattice), read from the trace contract; the
+    live allocator snapshot is the fallback."""
+    fn = getattr(ex, "trace_contract", None)
+    if fn is not None:
+        try:
+            wb = (fn() or {}).get("window_buckets")
+            if wb:
+                return tuple(int(b) for b in wb)
+        except Exception:  # noqa: BLE001 — contract is best-effort here
+            pass
+    alloc = getattr(ex, "_buckets", None)
+    lat = getattr(alloc, "lattice", None)
+    if lat:
+        return tuple(int(b) for b in lat)
+    return None
+
+
+def table_share_keys(pipeline, name: str = "mv") -> List[Dict]:
+    """One record per keyed state table in the plan: the share-key
+    fingerprint components plus the derived exact/index hashes."""
+    from risingwave_tpu.runtime.fragmenter import fragment_chains
+    from risingwave_tpu.runtime.fused_step import expand_fused
+
+    out: List[Dict] = []
+    for frag, sections in fragment_chains(pipeline).items():
+        for section, chain in sections.items():
+            chain = expand_fused(chain)
+            prefix: List[Tuple] = []
+            for ex in chain:
+                info = None
+                fn = getattr(ex, "lint_info", None)
+                if fn is not None:
+                    try:
+                        info = fn()
+                    except Exception:  # noqa: BLE001
+                        info = None
+                table_ids = (info or {}).get("table_ids", ())
+                if not table_ids and not hasattr(ex, "table_id"):
+                    prefix.append(_step_key(ex))
+                    continue
+                table_ids = table_ids or (ex.table_id,)
+                keys = tuple(
+                    (info or {}).get("state_pk")
+                    or (info or {}).get("keys")
+                    or ()
+                )
+                dtypes = (info or {}).get("expects") or {}
+                key_dtypes = tuple(
+                    (k, _dtype_name(dtypes[k])) for k in keys if k in dtypes
+                )
+                window_key = (info or {}).get("window_key")
+                lattice = _window_buckets(ex)
+                # index identity = WHAT the index is keyed by; the
+                # window_key is a state-CLEANING knob (watermark wiring
+                # differs between a hand-built plan and the SQL-planned
+                # twin without changing the maintained index) so it is
+                # reported but not part of the identity
+                index_key = (
+                    type(ex).__name__,
+                    keys,
+                    key_dtypes,
+                )
+                for tid in table_ids:
+                    out.append(
+                        {
+                            "plan": name,
+                            "fragment": f"{frag}/{section}",
+                            "table_id": tid,
+                            "executor": type(ex).__name__,
+                            "keys": list(keys),
+                            "key_dtypes": dict(key_dtypes),
+                            "window_key": window_key,
+                            "lattice": list(lattice) if lattice else None,
+                            # the classic shared-arrangement candidate
+                            # identity: WHAT the index is keyed by
+                            "index_fingerprint": _stable_hash(index_key),
+                            # physical-share identity: index + lattice
+                            # + the exact upstream transformation chain
+                            "share_fingerprint": _stable_hash(
+                                (index_key, lattice, tuple(prefix))
+                            ),
+                        }
+                    )
+                prefix.append(_step_key(ex))
+    return out
+
+
+def sharing_report(corpus: Dict[str, object]) -> Dict:
+    """``{plan_name: pipeline}`` -> the full sharing report: per-plan
+    table fingerprints, cross-plan opportunities, E703 diagnostics."""
+    tables: List[Dict] = []
+    for name, pipeline in corpus.items():
+        tables.extend(table_share_keys(pipeline, name))
+
+    by_exact: Dict[str, List[Dict]] = {}
+    by_index: Dict[str, List[Dict]] = {}
+    for t in tables:
+        by_exact.setdefault(t["share_fingerprint"], []).append(t)
+        by_index.setdefault(t["index_fingerprint"], []).append(t)
+
+    exact = [
+        {
+            "fingerprint": fp,
+            "tables": [f"{t['plan']}:{t['table_id']}" for t in ts],
+        }
+        for fp, ts in sorted(by_exact.items())
+        if len(ts) > 1
+    ]
+    opportunities = []
+    diags: List[Diagnostic] = []
+    for fp, ts in sorted(by_index.items()):
+        plans = sorted({t["plan"] for t in ts})
+        if len(ts) < 2 or not ts[0]["keys"]:
+            continue  # keyless state: nothing to share an index ON
+        opportunities.append(
+            {
+                "index_fingerprint": fp,
+                "keys": ts[0]["keys"],
+                "window_key": ts[0]["window_key"],
+                "plans": plans,
+                "tables": sorted(
+                    f"{t['plan']}:{t['table_id']}" for t in ts
+                ),
+            }
+        )
+        # would-share pairs broken by the lattice: same index identity
+        # AND the same window spec (the CODES contract — a pair that
+        # also differs in window wiring would not share even with
+        # aligned capacities, so flagging it would send the operator
+        # on a false errand), but incompatible declared lattices
+        by_window: Dict[object, List[Dict]] = {}
+        for t in ts:
+            by_window.setdefault(t["window_key"], []).append(t)
+        for wts in by_window.values():
+            lattices = {tuple(t["lattice"] or ()) for t in wts}
+            if len(wts) < 2 or len(lattices) < 2:
+                continue
+            members = sorted(
+                f"{t['plan']}:{t['table_id']}"
+                f"[lattice={t['lattice'] and t['lattice'][:1]}"
+                f"..{t['lattice'] and t['lattice'][-1:]}]"
+                for t in wts
+            )
+            diags.append(
+                Diagnostic(
+                    code="RW-E703",
+                    message=(
+                        "would-share index "
+                        f"(keys={wts[0]['keys']}, window_key="
+                        f"{wts[0]['window_key']}) split by incompatible "
+                        f"bucket lattices across {members} — align "
+                        "capacities to share one arrangement"
+                    ),
+                    fragment=wts[0]["fragment"],
+                    executor=wts[0]["executor"],
+                    severity="warning",
+                )
+            )
+    return {
+        "tables": tables,
+        "exact_duplicates": exact,
+        "opportunities": opportunities,
+        "diagnostics": diags,
+        "summary": {
+            "plans": len(corpus),
+            "state_tables": len(tables),
+            "exact_shareable_groups": len(exact),
+            "index_opportunities": len(opportunities),
+            "lattice_mismatches": sum(
+                1 for d in diags if d.code == "RW-E703"
+            ),
+        },
+    }
+
+
+def _q5u_pipeline(capacity: int = 1 << 10):
+    """The unified q5 twin — the SAME Nexmark q5 query built through
+    the SQL planner's graph path (what bench's q5u tier runs). Its
+    window-agg index must fingerprint onto q5's (the ISSUE's shared
+    window-agg evidence). Shadow-built on the host device."""
+    from risingwave_tpu.analysis.plan_verifier import _host_device
+    from risingwave_tpu.connectors.nexmark import BID_SCHEMA
+    from risingwave_tpu.runtime.fragmenter import graph_planned_mv
+    from risingwave_tpu.sql import Catalog, StreamPlanner
+
+    sql = (
+        "CREATE MATERIALIZED VIEW q5u AS "
+        "SELECT auction, window_start, count(*) AS num "
+        "FROM HOP(bid, date_time, INTERVAL '2' SECOND, "
+        "INTERVAL '10' SECOND) "
+        "GROUP BY auction, window_start"
+    )
+    catalog = Catalog({"bid": BID_SCHEMA})
+    factory = lambda: StreamPlanner(catalog, capacity=capacity)
+    with _host_device():
+        planned = graph_planned_mv(factory, sql, parallelism=1)
+    return planned
+
+
+def run_sharing_report() -> Dict:
+    """``lint --sharing-report``: the built-in corpus (q5/q7/q8 twins
+    + the SQL-planned q5u) through ``sharing_report``, JSON-ready."""
+    from risingwave_tpu.analysis.lint import build_nexmark_corpus
+    from risingwave_tpu.provenance import stamp
+
+    built = build_nexmark_corpus()
+    corpus = {name: q.pipeline for name, q in built.items()}
+    q5u = _q5u_pipeline()
+    corpus["q5u"] = q5u.pipeline
+    try:
+        rep = sharing_report(corpus)
+    finally:
+        close = getattr(q5u.pipeline, "close", None)
+        if close is not None:
+            try:
+                close()
+            except BaseException:
+                pass
+    rep["diagnostics"] = [
+        {
+            "code": d.code,
+            "severity": d.severity,
+            "fragment": d.fragment,
+            "executor": d.executor,
+            "message": d.message,
+        }
+        for d in rep["diagnostics"]
+    ]
+    rep["_provenance"] = stamp()
+    return rep

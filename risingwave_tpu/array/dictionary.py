@@ -22,18 +22,28 @@ must decode host-side (or use a future sorted-dictionary build).
 
 from __future__ import annotations
 
+import threading
 from typing import Iterable, List, Sequence
 
 import numpy as np
 
 
 class StringDictionary:
-    """Bidirectional append-only str <-> int32 code mapping."""
+    """Bidirectional append-only str <-> int32 code mapping.
+
+    Thread-safe on the encode path: the serving tier typechecks
+    SELECTs (which may encode novel string literals) OUTSIDE the
+    runtime lock, concurrently with DML encoding under it — the
+    check-then-act code assignment must be atomic or two threads can
+    mint the same code for different strings (permanent corruption of
+    everything keyed on the code). Decode stays lock-free: codes are
+    append-only and list reads are atomic under the GIL."""
 
     def __init__(self, values: Iterable[str] = ()):  # restore path
         self._strings: List[str] = []
         self._codes: dict[str, int] = {}
         self._table: np.ndarray | None = None  # decode cache
+        self._lock = threading.Lock()
         for s in values:
             self.encode_one(s)
 
@@ -41,11 +51,14 @@ class StringDictionary:
         return len(self._strings)
 
     def encode_one(self, s: str) -> int:
-        code = self._codes.get(s)
+        code = self._codes.get(s)  # lock-free hit: codes never change
         if code is None:
-            code = len(self._strings)
-            self._codes[s] = code
-            self._strings.append(s)
+            with self._lock:
+                code = self._codes.get(s)
+                if code is None:
+                    code = len(self._strings)
+                    self._codes[s] = code
+                    self._strings.append(s)
         return code
 
     def encode(self, values: Sequence[str]) -> np.ndarray:

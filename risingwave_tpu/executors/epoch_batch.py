@@ -60,6 +60,26 @@ class ComposedSteps:
         )
 
     def __call__(self, chunk):
+        # Under an ACTIVE lifted-literal param scope, inline the
+        # UNJITTED step bodies: a nested pjit call caches its jaxpr
+        # keyed by (statics, avals) ONLY, so an ambient value read
+        # during tracing (expr.LiftedLit -> param_scope) would be
+        # baked into that cached jaxpr as a leaked tracer const and
+        # poison the next trace. Inlining makes the ambient read an
+        # ordinary intermediate of the outer trace. Without params the
+        # nested-jit jaxpr cache is safe AND cheaper (baked plans
+        # re-trace the cached jaxpr instead of the step bodies).
+        from risingwave_tpu.expr.expr import params_active
+
+        if params_active():
+            for f in self.steps:
+                inner = getattr(f.func, "__wrapped__", None)
+                chunk = (
+                    inner(chunk, *f.args, **f.keywords)
+                    if inner is not None
+                    else f(chunk)
+                )
+            return chunk
         for f in self.steps:
             chunk = f(chunk)
         return chunk

@@ -191,6 +191,102 @@ class Lit(Expr):
         return jnp.full(chunk.capacity, self.value), None
 
 
+# -- lifted literals (multi-tenant compile sharing) ---------------------
+#
+# Two structurally-identical plans that differ ONLY in literal values
+# (q5 twins with different thresholds, per-tenant parameterized MVs)
+# would compile two distinct fused programs — the literal is baked
+# into the jit-static expression tree. ``lift_literals`` rewrites
+# numeric Lits into slot references against an ambient parameter
+# vector that enters the fused program as a RUNTIME OPERAND, so K
+# parameter variants share ONE compiled executable. The fused step
+# proves dtype-equivalence (eval_shape) before trusting a lifted tree
+# — weak-vs-strong scalar promotion can differ, and a mismatch falls
+# back to the baked literal (correctness over sharing).
+
+import threading as _threading
+from contextlib import contextmanager
+
+_PARAM_ENV = _threading.local()
+
+
+def params_active() -> bool:
+    """True while a (non-empty) lifted-literal param scope is bound —
+    the one situation where a nested jit call must be inlined (its
+    jaxpr cache cannot key on the ambient params; see ComposedSteps)."""
+    return getattr(_PARAM_ENV, "params", None) is not None
+
+
+@contextmanager
+def param_scope(params):
+    """Bind the lifted-literal parameter vectors for the duration of a
+    trace (the fused program wraps its whole body in this; on a jit
+    cache HIT the scope is never consulted — the compiled program
+    reads the operand directly)."""
+    prev = getattr(_PARAM_ENV, "params", None)
+    _PARAM_ENV.params = params
+    try:
+        yield
+    finally:
+        _PARAM_ENV.params = prev
+
+
+@dataclass(frozen=True, eq=False)
+class LiftedLit(Expr):
+    """A literal lifted to ``params[lane][slot]``: structurally equal
+    across plans regardless of the VALUE, which rides in the dynamic
+    parameter operand."""
+
+    slot: int
+    lane: str  # "i" (int64) | "f" (float64)
+
+    def eval(self, chunk: DataChunk) -> EvalResult:
+        params = getattr(_PARAM_ENV, "params", None)
+        if params is None:
+            raise RuntimeError(
+                "LiftedLit evaluated outside a param_scope (lifted "
+                "plans only run inside the fused barrier program)"
+            )
+        return jnp.full(chunk.capacity, params[self.lane][self.slot]), None
+
+
+def lift_literals(value, ints: list, floats: list):
+    """Rebuild an Expr-bearing structure with numeric Lits replaced by
+    LiftedLit slots, appending the values to ``ints``/``floats`` in
+    traversal order (the order is part of the structure, so equal
+    shapes assign equal slots). Non-numeric literals (None/str/bool)
+    stay baked — they steer trace-time control flow."""
+    import dataclasses as _dc
+
+    import numpy as _np
+
+    def walk(v):
+        if isinstance(v, LiftedLit):
+            return v  # idempotent
+        if isinstance(v, Lit):
+            x = v.value
+            if isinstance(x, bool) or isinstance(x, _np.bool_):
+                return v
+            if isinstance(x, (int, _np.integer)):
+                ints.append(int(x))
+                return LiftedLit(len(ints) - 1, "i")
+            if isinstance(x, (float, _np.floating)):
+                floats.append(float(x))
+                return LiftedLit(len(floats) - 1, "f")
+            return v
+        if isinstance(v, Expr) and _dc.is_dataclass(v):
+            return type(v)(
+                *(walk(getattr(v, f.name)) for f in _dc.fields(v))
+            )
+        if isinstance(v, (tuple, list)):
+            return tuple(walk(x) for x in v)
+        if isinstance(v, dict):
+            return {k: walk(x) for k, x in v.items()}
+        return v
+
+    return walk(value)
+
+
 @dataclass(frozen=True, eq=False)
 class AssumeNotNull(Expr):
     """Drop the NULL lane. The planner inserts this only AFTER a

@@ -17,6 +17,7 @@ reference's per-session task) is the right shape.
 
 from __future__ import annotations
 
+import socket
 import socketserver
 import struct
 import threading
@@ -133,6 +134,13 @@ class _Conn(socketserver.BaseRequestHandler):
         return _re.sub(r"\$(\d+)", lit, sql)
 
     def handle(self):
+        # protocol turns are many small writes (RowDescription, rows,
+        # CommandComplete, ReadyForQuery): with Nagle armed they batch
+        # behind the peer's delayed ACK — a flat ~40ms floor on every
+        # query. Serving-tier readers need the real latency.
+        self.request.setsockopt(
+            socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+        )
         if not self._startup():
             return
         out = self.request.sendall
@@ -169,8 +177,13 @@ class _Conn(socketserver.BaseRequestHandler):
             try:
                 if tag == b"Q":
                     sql = body.rstrip(b"\0").decode()
-                    with self.server.lock:  # type: ignore[attr-defined]
-                        cols, tag_str = session.execute(sql)
+                    # concurrency is the SESSION's contract now: DDL/
+                    # DML/stateful reads serialize on the runtime lock
+                    # inside execute(), and shared-arrangement SELECTs
+                    # serve lock-free off published versions — a global
+                    # server lock here would put every reader back in
+                    # one file line (the pre-serving-tier behavior)
+                    cols, tag_str = session.execute(sql)
                     if cols:
                         out(self._row_description(cols))
                         out(self._data_rows(cols))
@@ -259,8 +272,7 @@ class _Conn(socketserver.BaseRequestHandler):
                     if name not in portals:
                         raise KeyError(f"unknown portal {name!r}")
                     sql, t_sent = portals[name]
-                    with self.server.lock:  # type: ignore[attr-defined]
-                        cols, tag_str = session.execute(sql)
+                    cols, tag_str = session.execute(sql)
                     if cols:
                         if not t_sent:
                             out(self._row_description(cols))
@@ -350,7 +362,6 @@ class PgServer:
 
         self._srv = _Srv(("127.0.0.1", port), _Conn)
         self._srv.session = session  # type: ignore[attr-defined]
-        self._srv.lock = threading.Lock()  # type: ignore[attr-defined]
         self.port = self._srv.server_address[1]
         self._thread = threading.Thread(
             target=self._srv.serve_forever, daemon=True

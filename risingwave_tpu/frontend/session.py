@@ -13,6 +13,7 @@ talks to meta/batch/stream. Here it ties together:
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -58,6 +59,24 @@ def _parse_type_word(cname: str, tword: str):
         parts = args.rstrip(")").split(",")
         scale = int(parts[1]) if len(parts) > 1 else 0
     return Field(cname, dt, scale=scale)
+
+
+class _AttachedMV:
+    """Catalog marker for an MV attached to a shared arrangement
+    (runtime/arrangements.py): it owns no pipeline and no state —
+    reads go through the published-version facade, DROP decrements
+    the arrangement refcount. ``mview`` quacks enough like a
+    MaterializeExecutor (pk/columns/to_numpy/snapshot) for the batch
+    engine and MV-on-MV planning."""
+
+    def __init__(self, name, arrangement, facade):
+        self.name = name
+        self.arrangement = arrangement
+        self.mview = facade
+        self.pipeline = None
+        self.inputs: Dict[str, str] = {}
+        self.aux = ()
+        self.schema = arrangement.schema
 
 
 class SqlSession:
@@ -128,6 +147,17 @@ class SqlSession:
             self._hub_oid = hub.subscribe(self._apply_notification)
         self._register_string_builtins()
         self._replaying = False
+        # catalog/batch-registry mutation guard: the shared-arrangement
+        # read path serves SELECTs WITHOUT the runtime lock, so every
+        # catalog/batch mutation (CREATE/DROP) must be atomic against
+        # those concurrent readers — mutations take this lock briefly;
+        # readers re-check under it only on a race (fallback path)
+        self._registry_guard = threading.RLock()
+        # attached-name -> dependent MV names: an MV built OVER an
+        # attached shared MV subscribes to the WRITER fragment, so the
+        # runtime's _subs edges never carry the attached name — this
+        # map keeps the DROP dependency guard honest for it
+        self._attached_deps: Dict[str, set] = {}
         self.meta = None
         if getattr(self.runtime, "mgr", None) is not None:
             # durable meta: DDL log + dictionary snapshots ride the
@@ -247,7 +277,16 @@ class SqlSession:
 
     def execute(self, sql: str) -> Tuple[Dict[str, np.ndarray], str]:
         """Returns (result columns, command tag). Non-queries return an
-        empty column dict."""
+        empty column dict.
+
+        SELECTs over shared-arrangement subscriber MVs are served OFF
+        the published per-barrier version WITHOUT the runtime lock (the
+        serving tier: N concurrent pgwire readers never contend with
+        the barrier clock or each other) — everything else serializes
+        through the runtime lock as before."""
+        fast = self._execute_shared_read(sql)
+        if fast is not None:
+            return fast
         with self.runtime.lock:
             out, tag = self._execute_locked(sql)
         if tag.startswith(("CREATE_", "DROP_", "ALTER_")):
@@ -256,6 +295,50 @@ class SqlSession:
 
             EVENT_LOG.record("ddl", tag=tag, sql=sql.strip()[:200])
         return out, tag
+
+    def _execute_shared_read(
+        self, sql: str
+    ) -> Optional[Tuple[Dict[str, np.ndarray], str]]:
+        """The lock-free serving path: a plain SELECT whose FROM is a
+        shared-arrangement subscriber evaluates against the published
+        (immutable, barrier-consistent) snapshot — no runtime lock, no
+        torn reads, no contention with streaming. Returns None for
+        anything this path does not cover (the locked path then runs
+        it, including raising its real errors)."""
+        stripped = sql.lstrip()
+        if stripped[:7].lower() != "select ":
+            return None
+        reg = getattr(self.runtime, "arrangements", None)
+        if reg is None or not reg._facades:
+            return None
+        # cheap eligibility probe BEFORE the speculative parse: reads
+        # over non-served relations must not pay a double parse+
+        # typecheck on the hot path (the locked path parses again)
+        import re as _re
+
+        m = _re.search(r"(?is)\bfrom\s+([A-Za-z_]\w*)", stripped)
+        if m is None or not reg.serves(m.group(1)):
+            return None
+        try:
+            stmt = P.parse(sql)
+            if not isinstance(stmt, P.Select) or not isinstance(
+                stmt.from_, P.TableRef
+            ):
+                return None
+            if not reg.serves(stmt.from_.name):
+                return None
+            from risingwave_tpu.sql.typing import typecheck_select
+
+            stmt = typecheck_select(stmt, self.catalog, self.strings)
+            out = self.batch.query(sql, stmt=stmt)
+            out = self._decode_output(stmt, out)
+        except Exception:  # noqa: BLE001 — races/feature gaps fall back
+            # anything surprising (a DROP racing this read, a shape the
+            # fast path mishandles) re-runs under the runtime lock,
+            # which either serves it or raises the genuine error
+            return None
+        n = len(next(iter(out.values()))) if out else 0
+        return out, f"SELECT {n}"
 
     def _execute_locked(self, sql: str) -> Tuple[Dict[str, np.ndarray], str]:
         stripped = sql.lstrip()
@@ -485,22 +568,57 @@ class SqlSession:
         at PLAN time. A wedged/dead graph must not mask the original
         error (GraphPipeline.rebuild guards its stop() identically)."""
         self._rollback_aux_catalog(planned)
-        close = getattr(planned.pipeline, "close", None)
+        self._close_pipeline(planned.pipeline)
+
+    @staticmethod
+    def _close_pipeline(pipeline) -> None:
+        """Guarded pipeline teardown (graph pipelines spawn actor
+        threads at PLAN time): a wedged/dead graph must never mask the
+        caller's real error or stall a DROP."""
+        close = getattr(pipeline, "close", None)
         if close is not None:
             try:
                 close()
             except BaseException:
                 pass
 
+    def _free_arrangement(self, arr) -> None:
+        """Refcount hit zero: unregister the (possibly renamed) writer
+        fragments, detach their DML routes, and reap actor threads —
+        after this, the live-array census must be back to baseline."""
+        for frag in arr.fragments:
+            if frag in self.runtime.fragments:
+                self.runtime.unregister(frag)
+            self.dml.detach_fragment(frag)
+        for sub in reversed(getattr(arr.planned, "aux", ())):
+            self._close_pipeline(getattr(sub, "pipeline", None))
+        self._close_pipeline(getattr(arr.planned, "pipeline", None))
+
     def _register_planned(self, planned) -> None:
         """Runtime-register one planned MV: subscribe fragment inputs
         (tables / MVs) with the correct join side + backfill; attach
         DML targets for raw base streams; expose to batch reads.
         Shared by top-level MVs and lowered-join aux MVs."""
+        # an input that is an ATTACHED shared-MV name has no fragment
+        # of its own: route the subscription to the arrangement's
+        # writer fragment (whose emission is exactly the attached MV's
+        # change stream)
+        reg = getattr(self.runtime, "arrangements", None)
+        alias = {}
+        if reg is not None:
+            for s in planned.inputs:
+                real = reg.fragment_for(s)
+                if real is not None:
+                    alias[s] = real
+                    # the dependency is logically on the attached NAME
+                    # (the _subs edge will carry the writer fragment)
+                    self._attached_deps.setdefault(s, set()).add(
+                        planned.name
+                    )
         frag_inputs = {
-            s: side
+            alias.get(s, s): side
             for s, side in planned.inputs.items()
-            if s in self.runtime.fragments
+            if alias.get(s, s) in self.runtime.fragments
         }
         # a delta join's arrangements are PRE-POPULATED (shared with
         # CREATE INDEX): replaying both base snapshots through the join
@@ -575,6 +693,63 @@ class SqlSession:
         self.runtime.unregister(planned.name)
         self.dml.detach_fragment(planned.name)
         self.batch.tables.pop(planned.name, None)
+        self._drop_attached_dep(planned.name)
+
+    def _drop_attached_dep(self, name: str) -> None:
+        """``name`` is gone: it no longer depends on any attached MV."""
+        for dep_of, deps in list(self._attached_deps.items()):
+            deps.discard(name)
+            if not deps:
+                del self._attached_deps[dep_of]
+
+    def _share_fingerprint(self, stmt):
+        """The CREATE-MV share key (runtime/arrangements.py), or None
+        when sharing is off / the statement is not share-eligible."""
+        from risingwave_tpu.runtime.arrangements import (
+            plan_share_fingerprint,
+        )
+
+        reg = getattr(self.runtime, "arrangements", None)
+        if reg is None or not reg.enabled:
+            return None, None
+        fp = plan_share_fingerprint(
+            stmt,
+            self.catalog,
+            capacity=self.capacity,
+            exec_mode=self.exec_mode,
+            parallelism=self.parallelism,
+            # string literals encode against THIS session's dictionary:
+            # sharing never crosses a dictionary boundary
+            session_token=id(self.strings),
+        )
+        return reg, fp
+
+    def _attach_shared(self, stmt, sql, arr, reg):
+        """Registry HIT: bind the new MV name to the existing
+        refcounted arrangement — no planning, no executors, no device
+        state, no compiles. Reads serve off the per-barrier published
+        version (snapshot-consistent by construction)."""
+        name = stmt.name
+        if (
+            name in self.runtime.fragments
+            or name in self.catalog.tables
+        ):
+            raise ValueError(f"relation {name!r} already exists")
+        facade = reg.attach(arr, name)
+        with self._registry_guard:
+            self.catalog.tables[name] = arr.schema
+            self.catalog.mvs[name] = _AttachedMV(name, arr, facade)
+            self.batch.register(name, facade)
+        self._log_ddl(sql)
+        self._notify(
+            "add", "mv", name, schema=arr.schema, mview=facade,
+            planned=None,
+        )
+        if not self._replaying:
+            # CREATE returns once a published version exists for the
+            # new reader (the attach analogue of backfill visibility)
+            self.runtime.barrier()
+        return {}, "CREATE_MATERIALIZED_VIEW"
 
     def _execute_create_mv_or_rest(self, stmt, sql):
         if isinstance(stmt, P.CreateMaterializedView):
@@ -585,6 +760,14 @@ class SqlSession:
                 isinstance(stmt.select.from_.left, P.Join)
                 or isinstance(stmt.select.from_.right, P.Join)
             )
+            # shared arrangements: a structurally-identical live MV
+            # already maintains this exact index — attach instead of
+            # building (and compiling) a private twin
+            reg, fp = self._share_fingerprint(stmt)
+            if fp is not None:
+                arr = reg.lookup(fp)
+                if arr is not None:
+                    return self._attach_shared(stmt, sql, arr, reg)
             if self.exec_mode == "graph" and not nested_join and not is_union:
                 from risingwave_tpu.runtime.fragmenter import graph_planned_mv
 
@@ -621,17 +804,22 @@ class SqlSession:
                     self._unregister_planned(sub)
                 self._discard_planned(planned)
                 raise
-            self.catalog.add_mv(planned)
-            # overlay inferred LOGICAL types (decimal scale, varchar,
-            # jsonb) over the MV's physical schema so SELECTs over it
-            # decode correctly (sql/typing.py)
             from risingwave_tpu.sql.typing import infer_output_fields
 
-            inferred = infer_output_fields(stmt.select, self.catalog)
-            sch = self.catalog.tables[planned.name]
-            self.catalog.tables[planned.name] = Schema(
-                tuple(inferred.get(f.name, f) for f in sch.fields)
-            )
+            with self._registry_guard:
+                self.catalog.add_mv(planned)
+                # overlay inferred LOGICAL types (decimal scale,
+                # varchar, jsonb) over the MV's physical schema so
+                # SELECTs over it decode correctly (sql/typing.py)
+                inferred = infer_output_fields(stmt.select, self.catalog)
+                sch = self.catalog.tables[planned.name]
+                self.catalog.tables[planned.name] = Schema(
+                    tuple(inferred.get(f.name, f) for f in sch.fields)
+                )
+            if fp is not None:
+                # record the new MV as the share target for later
+                # structurally-identical CREATEs
+                reg.adopt(fp, planned, self.catalog.tables[planned.name])
             self._log_ddl(sql)
             self._notify(
                 "add", "mv", planned.name,
@@ -1171,11 +1359,28 @@ class SqlSession:
             if name not in self.sources:
                 raise KeyError(f"unknown source {name!r}")
         # dependency guard: subscribers (MV-on-MV / MVs over the table)
-        # or DML-attached MVs reading a source
-        if self.runtime._subs.get(name):
+        # or DML-attached MVs reading a source. An arrangement OWNER
+        # with other references is exempt: its drop HANDS the fragment
+        # off to an internal alias (subscription edges re-key with the
+        # rename), so dependents keep their dataflow
+        will_handoff = (
+            kind == "mv"
+            and (arr := self.runtime.arrangements._by_name.get(name))
+            is not None
+            and len(arr.refs) > 1
+        )
+        if self.runtime._subs.get(name) and not will_handoff:
             deps = [d for d, _ in self.runtime._subs[name]]
             raise ValueError(
                 f"cannot drop {name!r}: {deps} depend on it"
+            )
+        # MVs built over an ATTACHED shared MV subscribe to the writer
+        # fragment, so _subs never carries the attached name — the
+        # alias-dependency map holds its dependents
+        if self._attached_deps.get(name):
+            raise ValueError(
+                f"cannot drop {name!r}: "
+                f"{sorted(self._attached_deps[name])} depend on it"
             )
         if kind == "source" and self.dml._targets.get(name):
             deps = [f for f, _ in self.dml._targets[name]]
@@ -1183,21 +1388,75 @@ class SqlSession:
                 f"cannot drop {name!r}: {deps} depend on it"
             )
         if kind == "mv":
-            planned = self.catalog.mvs.pop(name)
-            self.runtime.unregister(name)
-            self.dml.detach_fragment(name)
-            self.batch.tables.pop(name, None)
-            self.catalog.tables.pop(name, None)
-            # hidden aux MVs (lowered joins) die with their top MV
-            # unless another MV still subscribes to them
-            for sub in reversed(getattr(planned, "aux", ())):
-                if self.runtime._subs.get(sub.name):
-                    continue
-                self.runtime.unregister(sub.name)
-                self.dml.detach_fragment(sub.name)
-                self.batch.tables.pop(sub.name, None)
-                self.catalog.tables.pop(sub.name, None)
-                self.catalog.mvs.pop(sub.name, None)
+            # dependency guard for arrangement-backed MVs: freeing the
+            # LAST reference tears the writer fragment down, so any
+            # MV-on-MV subscribed to that fragment (possibly through an
+            # attached alias of it) blocks the drop — same contract as
+            # the plain `_subs` guard above, which only sees the
+            # user-visible name
+            arr = self.runtime.arrangements._by_name.get(name)
+            if arr is not None and len(arr.refs) == 1:
+                deps = [
+                    d
+                    for frag in arr.fragments
+                    for d, _ in self.runtime._subs.get(frag, ())
+                ]
+                if deps:
+                    raise ValueError(
+                        f"cannot drop {name!r}: {deps} depend on it"
+                    )
+            res = self.runtime.arrangements.detach(name)
+            if res.kind in ("subscriber", "subscriber_free"):
+                with self._registry_guard:
+                    self.catalog.mvs.pop(name, None)
+                    self.catalog.tables.pop(name, None)
+                    self.batch.tables.pop(name, None)
+                if res.kind == "subscriber_free":
+                    # the LAST reference was a reader and the owner is
+                    # long gone: the hidden writer tears down now —
+                    # the refcount-zero free
+                    self._free_arrangement(res.arrangement)
+            elif res.kind == "handoff":
+                # owner dropped with live subscribers: the writer keeps
+                # streaming under the registry's internal alias; only
+                # the user-visible name (and its now-stale aux catalog
+                # entries) free up
+                planned = self.catalog.mvs.pop(name)
+                for old, new in res.renames:
+                    self.dml.rename_fragment(old, new)
+                with self._registry_guard:
+                    self.catalog.tables.pop(name, None)
+                    self.batch.tables.pop(name, None)
+                    for sub in reversed(getattr(planned, "aux", ())):
+                        self.batch.tables.pop(sub.name, None)
+                        self.catalog.tables.pop(sub.name, None)
+                        self.catalog.mvs.pop(sub.name, None)
+            else:
+                planned = self.catalog.mvs.pop(name)
+                self.runtime.unregister(name)
+                self.dml.detach_fragment(name)
+                with self._registry_guard:
+                    self.batch.tables.pop(name, None)
+                    self.catalog.tables.pop(name, None)
+                # hidden aux MVs (lowered joins) die with their top MV
+                # unless another MV still subscribes to them
+                for sub in reversed(getattr(planned, "aux", ())):
+                    if self.runtime._subs.get(sub.name):
+                        continue
+                    self.runtime.unregister(sub.name)
+                    self.dml.detach_fragment(sub.name)
+                    with self._registry_guard:
+                        self.batch.tables.pop(sub.name, None)
+                        self.catalog.tables.pop(sub.name, None)
+                        self.catalog.mvs.pop(sub.name, None)
+                    self._close_pipeline(getattr(sub, "pipeline", None))
+                # device-state leak fix: a dropped graph-mode MV used
+                # to leave its actor threads alive, and the threads
+                # kept every executor (and its HBM slabs) reachable —
+                # the live-array census never returned to baseline.
+                # Reap them with the same guarded close the discard
+                # path uses.
+                self._close_pipeline(getattr(planned, "pipeline", None))
         elif kind == "table":
             self.runtime.unregister(name)
             self.dml.detach_fragment(name)
@@ -1211,6 +1470,8 @@ class SqlSession:
             self.catalog.watermarks.pop(name, None)
             if src is not None:
                 self.runtime.unregister_state(src)
+        # the dropped relation no longer depends on any attached MV
+        self._drop_attached_dep(name)
         self._log_ddl(sql)
         self._notify("drop", kind, name)
         return {}, f"DROP_{kind.upper()}"

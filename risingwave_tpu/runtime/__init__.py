@@ -9,6 +9,7 @@ from risingwave_tpu.runtime.pipeline import Pipeline, TwoInputPipeline
 from risingwave_tpu.runtime.runtime import StreamingRuntime
 
 __all__ = [
+    "ArrangementRegistry",
     "DeviceWedged",
     "DmlManager",
     "FusedChainExecutor",
@@ -27,6 +28,10 @@ __all__ = [
 # eager import here would close a cycle through a partially
 # initialized executors package.
 _LAZY = {
+    "ArrangementRegistry": (
+        "risingwave_tpu.runtime.arrangements",
+        "ArrangementRegistry",
+    ),
     "DmlManager": ("risingwave_tpu.runtime.dml", "DmlManager"),
     # the fused per-barrier step imports the executors package (it
     # composes their pure steps), so it must stay lazy here too

@@ -59,6 +59,15 @@ class DmlManager:
         own materializing fragment; MVs over it ride subscriptions)."""
         self._targets.setdefault(stream, []).append((fragment, side))
 
+    def rename_fragment(self, old: str, new: str) -> None:
+        """Re-point every DML route at a renamed fragment (the shared-
+        arrangement owner-drop handoff: the writer keeps consuming its
+        base streams under the internal alias)."""
+        for stream, targets in self._targets.items():
+            self._targets[stream] = [
+                ((new if f == old else f), s) for f, s in targets
+            ]
+
     def detach_fragment(self, fragment: str) -> None:
         """Drop every target routing into ``fragment`` — the rollback
         path when a multi-MV registration fails halfway (a stale target

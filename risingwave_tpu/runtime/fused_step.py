@@ -62,6 +62,7 @@ from risingwave_tpu.executors.materialize import (
     DeviceMaterializeExecutor,
     mv_step_fn,
 )
+from risingwave_tpu.expr.expr import StaticTree, lift_literals, param_scope
 from risingwave_tpu.ops import agg as agg_ops
 from risingwave_tpu.parallel.sharded_agg import stack_chunks
 from risingwave_tpu.profiler import PROFILER
@@ -71,8 +72,11 @@ __all__ = [
     "expand_fused",
     "fuse_chain",
     "fuse_pipeline",
+    "fused_cache_stats",
     "fused_enabled",
     "fused_fragments",
+    "lift_enabled",
+    "lift_plan",
 ]
 
 
@@ -80,6 +84,17 @@ def fused_enabled() -> bool:
     """RW_FUSED_STEP=0 is the kill switch: the graph runtime then
     falls back to the per-epoch batched (still interpreted) path."""
     return os.environ.get("RW_FUSED_STEP", "1").strip().lower() not in (
+        "0",
+        "off",
+        "false",
+    )
+
+
+def lift_enabled() -> bool:
+    """RW_FUSED_LIFT=0 disables multi-tenant constant lifting: every
+    parameter variant then compiles its own fused program (the
+    pre-PR-12 behavior)."""
+    return os.environ.get("RW_FUSED_LIFT", "1").strip().lower() not in (
         "0",
         "off",
         "false",
@@ -131,7 +146,9 @@ def _delta_chunk(delta: dict, a: AggStatics, pad: Optional[int]) -> StreamChunk:
     return delta_to_chunk(delta, a.group_keys, a.nullable, a.calls, pad)
 
 
-def _fused_barrier_fn(states, stacked, plan, flush_rounds, pads, has_data):
+def _fused_barrier_fn(
+    states, stacked, params, plan, flush_rounds, pads, has_data
+):
     """The whole fragment-barrier as one pure function over
     ``states = (agg_state, mv_state)``:
 
@@ -155,6 +172,17 @@ def _fused_barrier_fn(states, stacked, plan, flush_rounds, pads, has_data):
     segments the ONE compiled program back into stages
     (deviceprof.parse_fused_stages).
     """
+    # lifted-literal parameter vectors (``params``) bind for the whole
+    # trace: plan segments containing LiftedLit slots read them as a
+    # RUNTIME operand, so K parameter variants of one plan shape share
+    # this single compiled program (multi-tenant compile sharing)
+    with param_scope(params):
+        return _fused_barrier_body(
+            states, stacked, plan, flush_rounds, pads, has_data
+        )
+
+
+def _fused_barrier_body(states, stacked, plan, flush_rounds, pads, has_data):
     agg_st, mv_st = states
     outs: List[StreamChunk] = []
     mv_rows = jnp.zeros((), jnp.int32)
@@ -253,6 +281,75 @@ _fused_barrier_step = partial(
 
 
 # ---------------------------------------------------------------------------
+# multi-tenant compile sharing: lift per-MV constants to runtime operands
+# ---------------------------------------------------------------------------
+
+_LIFT_STATS = {"lifted": 0, "rejected": 0}
+
+
+def lift_plan(plan: FusedPlan):
+    """Rewrite the plan's pure segments with numeric literals lifted
+    into parameter slots. Returns ``(lifted_plan, params)`` — params
+    being the ``{"i": int64[...], "f": float64[...]}`` operand the
+    fused program receives at dispatch — or ``(None, None)`` when the
+    plan carries no liftable constants. Two plans that differ only in
+    literal VALUES produce EQUAL lifted plans (same slot structure),
+    so the jit cache serves both from one compiled executable."""
+    ints: List[int] = []
+    floats: List[float] = []
+
+    def lift_arg(a):
+        if isinstance(a, StaticTree):
+            return StaticTree(lift_literals(a.value, ints, floats))
+        return a
+
+    def lift_steps(cs: Optional[ComposedSteps]) -> Optional[ComposedSteps]:
+        if cs is None:
+            return None
+        return ComposedSteps(
+            [
+                partial(
+                    s.func,
+                    *(lift_arg(a) for a in s.args),
+                    **{k: lift_arg(v) for k, v in s.keywords.items()},
+                )
+                for s in cs.steps
+            ]
+        )
+
+    import dataclasses as _dc
+
+    lifted = _dc.replace(
+        plan,
+        pre=lift_steps(plan.pre),
+        mid=lift_steps(plan.mid),
+        post=lift_steps(plan.post),
+    )
+    if not ints and not floats:
+        return None, None
+    params = {
+        "i": jnp.asarray(ints, jnp.int64),
+        "f": jnp.asarray(floats, jnp.float64),
+    }
+    return lifted, params
+
+
+def fused_cache_stats() -> dict:
+    """The compile-sharing evidence: how many distinct fused programs
+    the process actually compiled (jit cache entries) vs how many
+    wrappers lifted constants into a shared shape."""
+    try:
+        compiled = int(_fused_barrier_step._cache_size())
+    except Exception:  # noqa: BLE001 — jax-internal surface
+        compiled = -1
+    return {
+        "compiled_programs": compiled,
+        "plans_lifted": _LIFT_STATS["lifted"],
+        "plans_lift_rejected": _LIFT_STATS["rejected"],
+    }
+
+
+# ---------------------------------------------------------------------------
 # the wrapper executor
 # ---------------------------------------------------------------------------
 
@@ -335,6 +432,18 @@ class FusedChainExecutor(Executor):
             mv_cols=self.mv.columns if self.mv is not None else None,
             post=steps(post),
         )
+        # multi-tenant compile sharing: literals lifted to runtime
+        # operands, accepted only after a dtype-equivalence proof at
+        # the first data barrier (weak-vs-strong scalar promotion can
+        # change result dtypes — correctness beats sharing)
+        self._exec_plan = self.plan
+        self._params = None
+        self._lift_state = "off"
+        if lift_enabled():
+            lifted, params = lift_plan(self.plan)
+            if lifted is not None:
+                self._lift_candidate = (lifted, params)
+                self._lift_state = "pending"
         self._buf: List[StreamChunk] = []
         self._sig = None
         # telemetry bookkeeping: padded lane count of the last staged
@@ -481,6 +590,56 @@ class FusedChainExecutor(Executor):
         except Exception:  # noqa: BLE001 — forensic, never load-bearing
             pass
 
+    def _prove_lift(self, states, stacked, flush_rounds, pads) -> None:
+        """Accept the lifted plan only when it is provably
+        dtype-equivalent to the baked one over THIS input signature:
+        abstract-trace both programs (eval_shape — no XLA) and compare
+        every output aval. A weak-typed literal promoting differently
+        than its strong int64/float64 parameter slot shows up here as
+        a dtype mismatch — fall back to the baked plan for good."""
+        lifted, params = self._lift_candidate
+        ok = False
+        try:
+            abstract = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                (states, stacked),
+            )
+            pav = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params
+            )
+            base = jax.eval_shape(
+                lambda s, c: _fused_barrier_fn(
+                    s, c, None, self.plan, flush_rounds, pads, True
+                ),
+                abstract[0],
+                abstract[1],
+            )
+            lift = jax.eval_shape(
+                lambda s, c, p: _fused_barrier_fn(
+                    s, c, p, lifted, flush_rounds, pads, True
+                ),
+                abstract[0],
+                abstract[1],
+                pav,
+            )
+            ok = jax.tree.structure(base) == jax.tree.structure(
+                lift
+            ) and all(
+                x.shape == y.shape and x.dtype == y.dtype
+                for x, y in zip(
+                    jax.tree.leaves(base), jax.tree.leaves(lift)
+                )
+            )
+        except Exception:  # noqa: BLE001 — any trace surprise: keep baked
+            ok = False
+        if ok:
+            self._exec_plan, self._params = lifted, params
+            self._lift_state = "on"
+            _LIFT_STATS["lifted"] += 1
+        else:
+            self._lift_state = "off"
+            _LIFT_STATS["rejected"] += 1
+
     def _deviceprof_hook(
         self, states, stacked, flush_rounds, pads, has_data
     ) -> None:
@@ -521,13 +680,22 @@ class FusedChainExecutor(Executor):
             # pin the whole executor (and its retired device buffers)
             # in the pending queue, and a post-rebuild plan mutation
             # would lower a program that no longer matches this bucket
-            plan = self.plan
+            plan = self._exec_plan
+            pav = (
+                jax.tree.map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                    self._params,
+                )
+                if self._params is not None
+                else None
+            )
             DEVICEPROF.ensure_program(
                 f"fused:{self.label}",
                 bucket,
                 lambda: _fused_barrier_step.lower(
                     abstract[0],
                     abstract[1],
+                    pav,
                     plan,
                     flush_rounds,
                     pads,
@@ -619,6 +787,8 @@ class FusedChainExecutor(Executor):
                 if has_data
                 else 0
             )
+        if self._lift_state == "pending" and has_data:
+            self._prove_lift(states, stacked, flush_rounds, pads)
         self._deviceprof_hook(states, stacked, flush_rounds, pads, has_data)
         # attribution contexts: dispatch counting (PROFILER.attribute)
         # and — under an armed jax_trace capture — a TraceAnnotation so
@@ -631,7 +801,13 @@ class FusedChainExecutor(Executor):
                 ann = jax.profiler.TraceAnnotation(f"fused:{self.label}")
         with attr, ann:
             (agg_st, mv_st), outs, packed = _fused_barrier_step(
-                states, stacked, self.plan, flush_rounds, pads, has_data
+                states,
+                stacked,
+                self._params,
+                self._exec_plan,
+                flush_rounds,
+                pads,
+                has_data,
             )
         if self.agg is not None:
             (

@@ -188,6 +188,18 @@ class StreamingRuntime:
         from risingwave_tpu import utils_heap
 
         utils_heap.attach_runtime(self)
+        # shared arrangements (runtime/arrangements.py): the registry
+        # of refcounted device indexes serving N structurally-identical
+        # MVs off one writer fragment; the barrier publishes a version
+        # per arrangement (one attribute check when nothing is shared)
+        from risingwave_tpu.runtime.arrangements import ArrangementRegistry
+
+        self.arrangements = ArrangementRegistry(self)
+        # monotonic write counter: every chunk entering ANY fragment
+        # bumps it, so a published arrangement version can prove the
+        # live state still sits at its barrier boundary (lazy snapshot
+        # materialization without a torn-read window)
+        self._write_gen = 0
         self.fragments: Dict[str, object] = {}
         # upstream -> [(downstream, side)]; side targets one input of a
         # two-input fragment ("left"/"right") or "single"
@@ -478,6 +490,31 @@ class StreamingRuntime:
             else:
                 del self._subs[up]
 
+    def rename_fragment(self, old: str, new: str) -> None:
+        """Re-key a fragment (and every edge/replay record touching
+        it) without disturbing its pipeline, state, or the topological
+        registration order — the shared-arrangement owner-drop handoff
+        (the writer keeps streaming under an internal alias while the
+        user-visible name frees up)."""
+        if old not in self.fragments:
+            raise KeyError(f"unknown fragment {old!r}")
+        if new in self.fragments:
+            raise ValueError(f"fragment {new!r} already registered")
+        # rebuilt in place so the barrier walk's topological order holds
+        self.fragments = {
+            (new if k == old else k): v for k, v in self.fragments.items()
+        }
+        if old in self._subs:
+            self._subs[new] = self._subs.pop(old)
+        for up, edges in self._subs.items():
+            self._subs[up] = [
+                ((new if n == old else n), s) for n, s in edges
+            ]
+        with self._replay_lock:
+            for m in (self._replay, self._replay_floor, self._replay_covered):
+                if old in m:
+                    m[new] = m.pop(old)
+
     def _fragment_mview(self, name: str):
         from risingwave_tpu.executors.materialize import (
             DeviceMaterializeExecutor,
@@ -552,6 +589,7 @@ class StreamingRuntime:
         # subscriber absorbed the chunk, a later one did not) is the
         # half-applied-epoch window the compute node must roll back
         sync_point.hit(f"push_into:{name}:{side}")
+        self._write_gen += 1
         self._record_push(name, chunk, side)
         pp = self._pending_partial
         if pp is not None and name in pp["scope"]:
@@ -957,6 +995,9 @@ class StreamingRuntime:
         self._closer_abort.clear()
         self._work_err.clear()
         self._closer_err.clear()
+        # shared arrangements must not keep serving snapshots that
+        # postdate the restored state — republish off the recovery
+        self.arrangements.on_recovery(committed)
         EVENT_LOG.record(
             "recovery",
             mode="partial_done",
@@ -1316,6 +1357,9 @@ class StreamingRuntime:
         self._prev_state_bytes = state_bytes
         self.epoch_traces.append(tr)
         self.last_epoch_trace = tr
+        # shared arrangements: swap in this barrier's published version
+        # (pointer swap; materializes only under active read demand)
+        self.arrangements.publish(tr.epoch)
         # flight recorder: the finalized trace is exactly one black-box
         # record (ring always; segment file when a dir is configured)
         blackbox.RECORDER.record_barrier(tr, runtime=self)
@@ -1780,4 +1824,6 @@ class StreamingRuntime:
             fn = getattr(ex, "on_recover", None)
             if fn is not None:
                 fn(self._epoch)
+        # stale published snapshots may postdate the restored epoch
+        self.arrangements.on_recovery(self._epoch)
         EVENT_LOG.record("recovery", mode="restore", epoch=self._epoch)

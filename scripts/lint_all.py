@@ -16,14 +16,16 @@ Stages (all must pass; exit code is the OR of their failures):
    the fusion-feasibility analyzer: per-fragment fusible prefixes +
    RW-E8xx blockers with provenance.
 4. ``python scripts/perf_gate.py --smoke --blackbox --roofline
-   --fusion`` — the
+   --serving --fusion`` — the
    dispatch-cost regression gate: committed BENCH artifacts vs
    scripts/perf_budgets.json, the CPU q5 steady-state microbench
    (bounded device dispatches/barrier + host-python ms/row), the
    black-box recorder gate (host ms/barrier + fsync-stall budgets, and
-   the write-ring -> SIGKILL -> reader-CLI crash-survival smoke), and
-   the fusion ratchet vs FUSION_REPORT.json (fusible prefixes must not
-   shrink, host-sync counts must not grow).
+   the write-ring -> SIGKILL -> reader-CLI crash-survival smoke), the
+   shared-arrangement serving gate (CI-scale registration storm with
+   O(families) compile count + concurrent pgwire readers under
+   budget), and the fusion ratchet vs FUSION_REPORT.json (fusible
+   prefixes must not shrink, host-sync counts must not grow).
 """
 
 from __future__ import annotations
@@ -182,12 +184,13 @@ def stage_fusion_report(out_path: str) -> int:
 
 
 def stage_perf_gate(fusion_current: str = None) -> int:
-    print("[lint_all] perf_gate --smoke --blackbox --roofline + fusion "
-          "ratchet (dispatch-cost + recorder/fsync + device-roofline + "
+    print("[lint_all] perf_gate --smoke --blackbox --roofline --serving "
+          "+ fusion ratchet (dispatch-cost + recorder/fsync + "
+          "device-roofline + shared-arrangement serving + "
           "fusion-regression budgets)")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     cmd = [sys.executable, os.path.join(ROOT, "scripts", "perf_gate.py"),
-           "--smoke", "--blackbox", "--roofline"]
+           "--smoke", "--blackbox", "--roofline", "--serving"]
     if fusion_current and os.path.exists(fusion_current):
         cmd += ["--fusion-current", fusion_current]
     else:

@@ -636,6 +636,80 @@ def run_roofline_gate(budgets: dict, epochs: int = 4, events: int = 2_000):
     return violations, report
 
 
+def run_serving_gate(budgets: dict):
+    """The shared-arrangement serving gate (ROADMAP item 4, PR 12):
+    run a CI-scale registration storm + concurrent-reader serving
+    phase in-process (scripts/bench_serving.run_serving) and hold the
+    structural invariants:
+
+    - compile count bounded by plan-shape families, NOT MV count
+      (constant lifting + arrangement attach);
+    - arrangements == families (every further CREATE attached);
+    - N shared MVs hold ~1x one private MV's device state
+      (bytes_per_mv_ratio);
+    - barrier p99 stays flat after the storm and bounded under
+      concurrent reader load;
+    - registry publish overhead < 1%% of the steady barrier;
+    - zero reader errors (the lock-free path never serves torn or
+      failed reads)."""
+    b = budgets.get("serving", {})
+    sys.path.insert(0, os.path.join(ROOT, "scripts"))
+    from bench_serving import run_serving
+
+    rep = run_serving(
+        mvs=int(b.get("storm_mvs", 48)),
+        families=int(b.get("families", 3)),
+        readers=int(b.get("readers", 8)),
+        read_seconds=float(b.get("read_seconds", 1.2)),
+        exec_mode="graph",
+        verbose=False,
+    )
+    v = []
+
+    def gate(metric, budget_key, default, cmp="<="):
+        if budget_key not in b and default is None:
+            return
+        budget = float(b.get(budget_key, default))
+        val = float(rep[metric])
+        bad = val > budget if cmp == "<=" else val < budget
+        if bad:
+            v.append(
+                f"serving {metric} {val} violates budget "
+                f"{budget_key}={budget}"
+            )
+
+    if rep["compile_programs"] < 0:
+        # the compile-count invariant is this gate's headline: an
+        # unreadable jit cache must fail loudly, not pass vacuously
+        v.append(
+            "serving compile_programs unreadable (jax jit cache API "
+            "changed?) — the O(families) compile invariant cannot be "
+            "gated"
+        )
+    else:
+        gate("compile_programs", "compile_programs_max", 10)
+    gate("arrangements", "arrangements_max", rep["families"])
+    gate("bytes_per_mv_ratio", "bytes_per_mv_ratio_max", 0.2)
+    gate(
+        "barrier_p99_ms_post_storm", "post_storm_barrier_p99_ms_max", 150
+    )
+    gate(
+        "barrier_p99_ms_under_read_load",
+        "under_read_barrier_p99_ms_max",
+        400,
+    )
+    gate("reader_p99_ms", "reader_p99_ms_max", 150)
+    gate("registry_overhead_frac", "registry_overhead_frac_max", 0.01)
+    gate("reads_per_s", "reads_per_s_min", 50, cmp=">=")
+    gate("reader_error_count", "reader_errors_max", 0)
+    if rep["arrangement_refs"] != rep["mvs"]:
+        v.append(
+            f"serving arrangement_refs {rep['arrangement_refs']} != "
+            f"storm mvs {rep['mvs']} (an attach was lost)"
+        )
+    return v, rep
+
+
 def _engine_generation() -> int:
     """Load provenance.py BY PATH: the pure-JSON gate mode must stay
     jax-free, and importing the package would pull jax in via
@@ -811,6 +885,14 @@ def main(argv=None) -> int:
         "padding/compile budgets, which always run with --bench)",
     )
     ap.add_argument(
+        "--serving",
+        action="store_true",
+        help="gate the shared-arrangement serving tier: CI-scale "
+        "registration storm (compile count O(families), flat barrier "
+        "p99, ~1x shared device state) + concurrent pgwire readers "
+        "(p99 + zero errors + registry overhead < 1%% of the barrier)",
+    )
+    ap.add_argument(
         "--fusion-current",
         default=None,
         help="reuse an existing `lint --fusion-report --json` output "
@@ -835,6 +917,10 @@ def main(argv=None) -> int:
     if args.roofline:
         v, report = run_roofline_gate(budgets)
         print(f"[perf_gate] roofline: {json.dumps(report)}")
+        violations += v
+    if args.serving:
+        v, report = run_serving_gate(budgets)
+        print(f"[perf_gate] serving: {json.dumps(report)}")
         violations += v
     if args.fusion or args.fusion_current:
         try:

@@ -253,7 +253,9 @@ def test_fused_program_traces_with_named_scopes():
     # scope names live in op metadata, which survives into the
     # compiled executable's HLO (exactly what a device trace reports)
     txt = (
-        _fused_barrier_step.lower(abstract, None, w.plan, 1, (256,), False)
+        _fused_barrier_step.lower(
+            abstract, None, None, w.plan, 1, (256,), False
+        )
         .compile()
         .as_text()
     )
