@@ -456,12 +456,16 @@ def bench_q8(gen_cfg, epochs, events_per_epoch, chunk_events):
     # q8 state accumulates across the run (no watermarks driven here):
     # persons+auctions ~8%% of events, all retained
     c8 = _state_cap(int(epochs * events_per_epoch * 0.09), 1 << 16)
+    _arm_deviceprof()  # roofline: analyze buckets from warmup on
     q8 = build_q8(capacity=c8, fanout=8, out_cap=1 << 14)
     _arm_fusion(q8.pipeline, "q8")
     # warmup epoch compiles every kernel, then fresh state + warm caches
-    for side, c in chunks[0]:
-        (q8.pipeline.push_left if side == "p" else q8.pipeline.push_right)(c)
-    q8.pipeline.barrier()
+    # warm over ALL epochs' chunk layouts (the fused two-input program
+    # compiles per batch-count family — see the q7 warmup note)
+    for ep in chunks:
+        for side, c in ep:
+            (q8.pipeline.push_left if side == "p" else q8.pipeline.push_right)(c)
+        q8.pipeline.barrier()
     q8 = build_q8(capacity=c8, fanout=8, out_cap=1 << 14)
     _arm_fusion(q8.pipeline, "q8")
     recompiles = _recompile_watch()
@@ -509,6 +513,7 @@ def bench_q8(gen_cfg, epochs, events_per_epoch, chunk_events):
         "q8_barrier_stage_ms": stage_breakdown(),
         **_profile_fields("q8", prof, len(barrier_times), total_rows),
         **_fused_fields("q8", q8.pipeline),
+        **_roofline_fields("q8", len(barrier_times), dt),
         **_shape_fields(
             "q8",
             _expand(
@@ -616,6 +621,8 @@ def bench_q7(gen_cfg, epochs, events_per_epoch, chunk_events):
     # watermarks bound q7 state to open windows, but the growth
     # heuristic is volume-driven: margin must cover one epoch's pushes
     c7 = _state_cap(events_per_epoch, 1 << 16)
+    _arm_deviceprof()  # roofline: analyze buckets from warmup on
+
     def mk_q7():
         q7 = build_q7(
             capacity=c7,
@@ -628,7 +635,11 @@ def bench_q7(gen_cfg, epochs, events_per_epoch, chunk_events):
         return q7
 
     q7 = mk_q7()
-    run(q7, mk()[:1])  # warmup epoch: compile everything
+    # warm over ALL epochs' chunk layouts: the fused two-input program
+    # compiles per (batch count, chunk signature) family, and a
+    # 2-epoch smoke tier would otherwise pay a fresh compile INSIDE
+    # the measured window whenever epoch 2's chunk count differs
+    run(q7, mk())
 
     recompiles = _recompile_watch()
     _shape_watch_stable()  # post-warmup novelty = recompile hazard
@@ -666,6 +677,7 @@ def bench_q7(gen_cfg, epochs, events_per_epoch, chunk_events):
         "q7_barrier_stage_ms": stage_breakdown(),
         **_profile_fields("q7", prof, len(barrier_times), total_bids),
         **_fused_fields("q7", q7.pipeline),
+        **_roofline_fields("q7", len(barrier_times), dt),
         # AFTER profiler disarm: padding stats read device occupancy
         # counters and must not pollute the steady-state transfer counts
         **_shape_fields(

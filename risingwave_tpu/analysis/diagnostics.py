@@ -77,6 +77,12 @@ CODES = {
     "the bucketing layer cannot satisfy (not pow2 / not increasing / "
     "out of allocator bounds / empty) — the shape-stability proof is "
     "vacuous",
+    "RW-E807": "fusion refused with provenance (runtime/fused_step "
+    "fusion_refusals): a chain or two-input pipeline the planner left "
+    "interpreted — lattice-incompatible member, unbucketed join side, "
+    "unsupported shape, or a join-fed MV tail whose feeder's emission "
+    "shape family is not closed. Policy decisions are recorded, never "
+    "silent",
 }
 
 

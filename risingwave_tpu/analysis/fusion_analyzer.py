@@ -414,6 +414,16 @@ def classify_executor(
     info = _lint_info(ex)
     contract = _contract(ex)
     ec = ExecutorClass(index=index, name=name, kind="opaque", fusible=False)
+    if spec is None and contract is not None:
+        # a contract-declared input schema seeds tracing when nothing
+        # threads one in (two-input joins heading a join_tail fragment
+        # declare their probe-side schema: the executor knows its own
+        # input exactly, the fragment extractor does not)
+        decl = contract.get("input_schema")
+        if decl:
+            spec = ChunkSpec.from_schema(
+                decl, nulls=tuple(contract.get("input_nulls", ()))
+            )
 
     def blocker(code: str, message: str, severity: str = "warning"):
         ec.blockers.append(

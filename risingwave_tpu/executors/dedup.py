@@ -38,6 +38,10 @@ from risingwave_tpu.storage.state_table import (
 )
 
 GROW_AT = 0.5
+# mid-epoch rebuild only when the HOST insert bound nears the table
+# itself (MAX_PROBE overflow risk); ordinary growth resolves at the
+# barrier from the true occupancy note (HashAgg's twin constant)
+HARD_GROW_AT = 0.75
 
 
 def dedup_step_fn(
@@ -114,6 +118,8 @@ class AppendOnlyDedupExecutor(Executor, Checkpointable):
             else None
         )
         self._bound = 0
+        self._occ_note = 0  # true claimed at the last barrier (staged)
+        self._grew_midepoch = False  # one overflow-guard bump per epoch
         self._saw_delete = jnp.zeros((), jnp.bool_)
         self._dropped = jnp.zeros((), jnp.bool_)
 
@@ -129,7 +135,7 @@ class AppendOnlyDedupExecutor(Executor, Checkpointable):
         }
 
     def trace_contract(self):
-        return {
+        contract = {
             "kind": "device",
             "trace_step": lambda c: _dedup_step(
                 self.table, self.sdirty, c, self.keys
@@ -144,6 +150,13 @@ class AppendOnlyDedupExecutor(Executor, Checkpointable):
                 self._buckets.lattice if self._buckets is not None else None
             ),
         }
+        if self._buckets is not None:
+            # the interpreted growth path's packed read exists only
+            # where interpretation runs: the fused program's wrapper
+            # plans from barrier notes instead (_grow_hint) — the
+            # analyzer scores it as fallback-only, not a blocker
+            contract["fallback_syncs"] = ("_maybe_grow",)
+        return contract
 
     def pin_max_bucket(self):
         """ShapeGovernor hook: freeze the seen-set at its high-water
@@ -176,7 +189,43 @@ class AppendOnlyDedupExecutor(Executor, Checkpointable):
         self._dropped = self._dropped | dropped
         return [out]
 
+    def _grow_hint(self, incoming: int):
+        """The FUSED wrapper's pre-dispatch growth bookkeeping: ZERO
+        device reads. The host bound counts padded chunk capacities —
+        letting the exact planner size from it over-grows by buckets —
+        so the fused path bumps ONE bucket, at most once per epoch,
+        purely as MAX_PROBE headroom (BucketAllocator.bump); ordinary
+        growth/shrink resolves at the barrier from the staged true
+        occupancy note (_on_barrier_scalars). A genuinely faster
+        blow-up still trips the overflow latch, the existing
+        contract."""
+        if self._buckets is None:
+            return self._maybe_grow(incoming)
+        cap = self.table.capacity
+        self._bound = min(self._bound, cap)
+        if self._grew_midepoch or (
+            self._bound + incoming <= cap * HARD_GROW_AT
+        ):
+            return
+        new_cap = self._buckets.bump(cap)
+        if new_cap is not None:
+            self.table, self.sdirty, self.stored = _rebuild(
+                self.table, self.sdirty, self.stored, new_cap
+            )
+            self._bound = min(self._bound, new_cap)
+        self._grew_midepoch = True
+
     def _maybe_grow(self, incoming: int):
+        """INTERPRETED-path growth: the exact legacy policy — when the
+        load-factor trigger (or a pending shrink / governor-pin
+        wakeup) trips, ONE packed blocking read learns the true
+        occupancy and plans from it. Declared under the contract's
+        ``fallback_syncs`` on bucketed instances: the fused per-
+        barrier program never calls this method (the wrapper's
+        _grow_hint + barrier-note planning are its replacement), so
+        the read runs only where interpretation runs — the analyzer
+        scores it as fallback_sync_points, outside the fusibility
+        verdict (the HashAgg _flush_all discipline)."""
         cap = self.table.capacity
         if not needs_plan(self._buckets, cap, self._bound, incoming, GROW_AT):
             return
@@ -198,17 +247,35 @@ class AppendOnlyDedupExecutor(Executor, Checkpointable):
     def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
         # staged read; finish_barrier materializes after the walk
         self._staged_scalars = stage_scalars(
-            self._saw_delete, self._dropped, self.table.occupancy()
+            self._saw_delete,
+            self._dropped,
+            self.table.occupancy(),
+            jnp.sum((self.table.live | self.sdirty).astype(jnp.int32)),
         )
         if barrier is None:  # direct drive: checks fire inline
             self.finish_barrier()
         return []
 
     def _on_barrier_scalars(self, vals) -> None:
-        saw_delete, dropped, claimed = vals
+        saw_delete, dropped, claimed, survivors = vals
+        self._grew_midepoch = False
+        epoch_inc = max(self._bound - self._occ_note, 0)
+        self._occ_note = int(claimed)
         self._bound = int(claimed)
         if self._buckets is not None:
-            self._buckets.note_barrier(self.table.capacity, int(claimed))
+            cap = self.table.capacity
+            self._buckets.note_barrier(cap, int(claimed))
+            new_cap = self._buckets.plan(
+                cap,
+                0,
+                int(claimed),
+                int(survivors),
+                margin=max(int(claimed), epoch_inc),
+            )
+            if new_cap is not None and new_cap != cap:
+                self.table, self.sdirty, self.stored = _rebuild(
+                    self.table, self.sdirty, self.stored, new_cap
+                )
         if saw_delete:
             raise RuntimeError("append-only dedup received a DELETE")
         if dropped:

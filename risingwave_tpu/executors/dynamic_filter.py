@@ -47,12 +47,13 @@ from risingwave_tpu.storage.state_table import (
 )
 
 GROW_AT = 0.5
+# mid-epoch rebuild only when the HOST insert bound nears the table
+# itself (MAX_PROBE overflow risk); ordinary growth resolves at the
+# barrier from the true occupancy note (HashAgg's twin constant)
+HARD_GROW_AT = 0.75
 
 
-@partial(
-    jax.jit, static_argnames=("group_col", "value_col"), donate_argnums=(0, 1, 2)
-)
-def _filter_step(
+def filter_step_fn(
     table: HashTable,
     maxes: jnp.ndarray,
     sdirty: jnp.ndarray,
@@ -83,6 +84,14 @@ def _filter_step(
     maxes = cleared.at[idx].max(value, mode="drop")
     sdirty = sdirty.at[idx].set(True, mode="drop")
     return table, maxes, sdirty, chunk.mask(ok), saw_delete, dropped
+
+
+# the un-jitted body (filter_step_fn) is what the fused two-input
+# program scans over a stacked epoch (runtime/fused_step); this jitted
+# form is the interpreted per-chunk path
+_filter_step = partial(
+    jax.jit, static_argnames=("group_col", "value_col"), donate_argnums=(0, 1, 2)
+)(filter_step_fn)
 
 
 @partial(jax.jit, static_argnames=("new_cap",))
@@ -138,6 +147,8 @@ class DynamicMaxFilterExecutor(Executor, Checkpointable):
             else None
         )
         self._bound = 0
+        self._occ_note = 0  # true claimed at the last barrier (staged)
+        self._grew_midepoch = False  # one overflow-guard bump per epoch
         self._saw_delete = jnp.zeros((), jnp.bool_)
         self._dropped = jnp.zeros((), jnp.bool_)
 
@@ -153,7 +164,7 @@ class DynamicMaxFilterExecutor(Executor, Checkpointable):
         }
 
     def trace_contract(self):
-        return {
+        contract = {
             "kind": "device",
             "trace_step": lambda c: _filter_step(
                 self.table,
@@ -173,6 +184,12 @@ class DynamicMaxFilterExecutor(Executor, Checkpointable):
                 self._buckets.lattice if self._buckets is not None else None
             ),
         }
+        if self._buckets is not None:
+            # the interpreted growth path's packed read exists only
+            # where interpretation runs (the fused wrapper plans from
+            # barrier notes instead) — fallback-only, not a blocker
+            contract["fallback_syncs"] = ("_maybe_grow",)
+        return contract
 
     def pin_max_bucket(self):
         """ShapeGovernor hook: freeze the max-state at its high-water
@@ -214,7 +231,35 @@ class DynamicMaxFilterExecutor(Executor, Checkpointable):
         self._dropped = self._dropped | dropped
         return [out]
 
+    def _grow_hint(self, incoming: int):
+        """The FUSED wrapper's pre-dispatch growth bookkeeping: ZERO
+        device reads — one emergency bucket bump per epoch at most
+        (BucketAllocator.bump; the host bound counts padded chunk
+        capacities, so exact sizing from it over-grows); ordinary
+        growth/shrink resolves at the barrier from the staged true
+        occupancy note."""
+        if self._buckets is None:
+            return self._maybe_grow(incoming)
+        cap = self.table.capacity
+        self._bound = min(self._bound, cap)
+        if self._grew_midepoch or (
+            self._bound + incoming <= cap * HARD_GROW_AT
+        ):
+            return
+        new_cap = self._buckets.bump(cap)
+        if new_cap is not None:
+            self.table, self.maxes, self.sdirty, self.stored = _rebuild(
+                self.table, self.maxes, self.sdirty, self.stored, new_cap
+            )
+            self._bound = min(self._bound, new_cap)
+        self._grew_midepoch = True
+
     def _maybe_grow(self, incoming: int):
+        """INTERPRETED-path growth: the exact legacy policy (one
+        packed blocking read when the trigger trips). Declared under
+        ``fallback_syncs`` on bucketed instances — the fused program
+        replaces it with _grow_hint + barrier-note planning, so the
+        read runs only where interpretation runs."""
         cap = self.table.capacity
         if not needs_plan(self._buckets, cap, self._bound, incoming, GROW_AT):
             return
@@ -235,17 +280,45 @@ class DynamicMaxFilterExecutor(Executor, Checkpointable):
 
     def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
         self._staged_scalars = stage_scalars(
-            self._saw_delete, self._dropped, self.table.occupancy()
+            self._saw_delete,
+            self._dropped,
+            self.table.occupancy(),
+            jnp.sum((self.table.live | self.sdirty).astype(jnp.int32)),
         )
         if barrier is None:  # direct drive: checks fire inline
             self.finish_barrier()
         return []
 
     def _on_barrier_scalars(self, vals) -> None:
-        saw_delete, dropped, claimed = vals
+        saw_delete, dropped, claimed, survivors = vals
+        self._grew_midepoch = False
+        epoch_inc = max(self._bound - self._occ_note, 0)
+        self._occ_note = int(claimed)
         self._bound = int(claimed)
         if self._buckets is not None:
-            self._buckets.note_barrier(self.table.capacity, int(claimed))
+            cap = self.table.capacity
+            self._buckets.note_barrier(cap, int(claimed))
+            # barrier-boundary planning from the TRUE note: grow past
+            # the load factor, apply pending lazy shrink, honor a
+            # governor pin — zero mid-epoch device reads. The margin
+            # keeps a shrink from landing below what the mid-epoch
+            # overflow guard would immediately regrow.
+            new_cap = self._buckets.plan(
+                cap,
+                0,
+                int(claimed),
+                int(survivors),
+                margin=max(int(claimed), epoch_inc),
+            )
+            if new_cap is not None and new_cap != cap:
+                (
+                    self.table,
+                    self.maxes,
+                    self.sdirty,
+                    self.stored,
+                ) = _rebuild(
+                    self.table, self.maxes, self.sdirty, self.stored, new_cap
+                )
         if saw_delete:
             raise RuntimeError("dynamic max filter received a DELETE")
         if dropped:

@@ -50,7 +50,7 @@ class ComposedSteps:
     planner passes hit the cache; a recompile is ~30-40s on the
     tunneled TPU)."""
 
-    __slots__ = ("steps", "_key", "__weakref__")
+    __slots__ = ("steps", "_key", "_hash", "__weakref__")
 
     def __init__(self, steps):
         self.steps = tuple(steps)
@@ -58,6 +58,10 @@ class ComposedSteps:
             (s.func, s.args, tuple(sorted(s.keywords.items())))
             for s in self.steps
         )
+        # the composition is a STATIC jit argument hashed on every
+        # fused dispatch: pay the partial-tuple hash once, not per
+        # barrier (tuples do not cache their hash)
+        self._hash = hash(self._key)
 
     def __call__(self, chunk):
         # Under an ACTIVE lifted-literal param scope, inline the
@@ -85,7 +89,7 @@ class ComposedSteps:
         return chunk
 
     def __hash__(self):
-        return hash(self._key)
+        return self._hash
 
     def __eq__(self, other):
         return (

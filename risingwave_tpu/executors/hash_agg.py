@@ -1142,14 +1142,17 @@ class HashAggExecutor(Executor, Checkpointable):
         else:
             # every emitted row sits in the first 2*n_take slots (dirty
             # slots compact to the front); slice before transfer so the
-            # device->host copy is O(emitted). Quantize to exactly TWO
-            # capacities (small | full): every DOWNSTREAM device program
-            # (device MV step, join step) compiles once per distinct
-            # input capacity — pow2 bucketing here caused a recompile
-            # (~30s on TPU) on first sight of each bucket.
-            full = 2 * self.out_cap
-            small = min(256, full)
-            pad = small if 2 * n_take <= small else full
+            # device->host copy is O(emitted). Quantized to exactly TWO
+            # capacities (small | full) by the shared flush-lane lattice
+            # (runtime/bucketing.flush_pad): every DOWNSTREAM device
+            # program (device MV step, join step) compiles once per
+            # distinct input capacity — pow2 bucketing here caused a
+            # recompile (~30s on TPU) on first sight of each bucket,
+            # and the fused programs' pads MUST agree with this slicer
+            # or the two paths mint disjoint compile sets.
+            from risingwave_tpu.runtime.bucketing import flush_pad
+
+            pad = flush_pad(self.out_cap, n_take)
         return delta_to_chunk(
             delta, self.group_keys, self.nullable, self.calls, pad
         )

@@ -70,6 +70,10 @@ from risingwave_tpu.ops.join import (
 from risingwave_tpu.types import Op
 
 GROW_AT = 0.5
+# mid-epoch rebuild only when the HOST insert bound nears the table
+# itself (MAX_PROBE overflow risk); ordinary growth resolves at the
+# barrier from the true occupancy note (HashAgg's twin constant)
+HARD_GROW_AT = 0.75
 
 
 JOIN_TYPES = (
@@ -341,6 +345,8 @@ class HashJoinExecutor(Executor, Checkpointable):
             self.out_names = self.left_names + self.right_names
         self.out_cap = out_cap
         self.window_cols = window_cols
+        self.left_nullable = tuple(left_nullable)
+        self.right_nullable = tuple(right_nullable)
 
         lk_dtypes = tuple(jnp.dtype(left_dtypes[k]) for k in self.left_keys)
         rk_dtypes = tuple(jnp.dtype(right_dtypes[k]) for k in self.right_keys)
@@ -381,14 +387,32 @@ class HashJoinExecutor(Executor, Checkpointable):
         else:
             self._buckets = None
         self._bound = {"l": 0, "r": 0}
+        self._occ_note = {"l": 0, "r": 0}  # true claimed at last barrier
+        self._grew_midepoch = {"l": False, "r": False}  # one bump/epoch
         self._em_overflow = jnp.zeros((), jnp.bool_)
         self._wm = {"l": None, "r": None, "out": None}
         # cold tier (state >> HBM): the runtime wires cold_get_rows to
         # CheckpointManager.get_rows; evicted durable keys are recorded
-        # host-side per side and fault back in when touched
-        self.cold_get_rows = None
+        # host-side per side and fault back in when touched. The
+        # property setter binds the host-side fault-in/expire HOOKS —
+        # while unarmed (None) the hot path is provably host-sync free
+        # (the NumPy helpers are unreachable), the HashAgg discipline.
         self._evicted = {"left": set(), "right": set()}
         self._cold_tombstones: Dict[str, list] = {}
+        self._cold_apply_hook = None  # _fault_in when armed
+        self._cold_expire_hook = None  # _expire_evicted when armed
+        self.cold_get_rows = None
+
+    @property
+    def cold_get_rows(self):
+        return self._cold_get_rows
+
+    @cold_get_rows.setter
+    def cold_get_rows(self, fn) -> None:
+        self._cold_get_rows = fn
+        armed = fn is not None
+        self._cold_apply_hook = self._fault_in if armed else None
+        self._cold_expire_hook = self._expire_evicted if armed else None
 
     def lint_info(self):
         dtypes = dict(self._lint_left_dtypes)
@@ -404,7 +428,7 @@ class HashJoinExecutor(Executor, Checkpointable):
         }
 
     def trace_contract(self):
-        return {
+        contract = {
             "kind": "device",
             "trace_step": lambda c: _join_step(
                 self.left,
@@ -423,6 +447,21 @@ class HashJoinExecutor(Executor, Checkpointable):
             "donate": True,
             "emission": "fixed",
             "emission_caps": (self.out_cap,),
+            # the trace_step probes as a LEFT arrival: its input schema
+            # is the declared left side — the analyzer seeds tracing
+            # from this when the join heads a fragment (join_tail
+            # sections have no source schema to thread)
+            "input_schema": dict(self._lint_left_dtypes),
+            "input_nulls": self.left_nullable,
+            # two-input fusibility: the fused two-input program
+            # (runtime/fused_step) can absorb this join — per-side
+            # probe/build kernels are mask-aware (padded rows provably
+            # inert, proven by the masked-lane twin tests), so bucket-
+            # padded flush lanes cost one masked device op. Requires
+            # the bucket lattice on both sides (the unbucketed twin is
+            # the RW-E803 wedge class and stays interpreted).
+            "two_input": True,
+            "two_input_fusible": self._buckets is not None,
             # both JoinSides draw their capacities from the declared
             # pow2 lattice: the window-churn expiry/growth cycle costs
             # at most one trace per bucket per side (None only on the
@@ -433,6 +472,17 @@ class HashJoinExecutor(Executor, Checkpointable):
                 else None
             ),
         }
+        if self._buckets is not None:
+            # the interpreted growth path's packed read exists only
+            # where interpretation runs (the fused wrapper plans from
+            # barrier notes instead) — fallback-only, not a blocker
+            contract["fallback_syncs"] = ("_maybe_grow",)
+        if self._cold_get_rows is not None:
+            # an ARMED cold tier splices host fault-in/expire back into
+            # the data path — scan it honestly (the corpus twins the
+            # analyzer proves are never armed)
+            contract["hot_methods"] = ("_fault_in", "_expire_evicted")
+        return contract
 
     def pin_max_bucket(self):
         """ShapeGovernor hook: freeze BOTH sides at their high-water
@@ -463,11 +513,11 @@ class HashJoinExecutor(Executor, Checkpointable):
         raise TypeError("HashJoin is two-input: use apply_left/apply_right")
 
     def _apply(self, side: str, chunk: StreamChunk) -> List[StreamChunk]:
-        if self._evicted["left"] or self._evicted["right"]:
+        if self._cold_apply_hook is not None:
             # merge-on-return BEFORE the step: an arriving chunk probes
             # the other side and appends to its own — both sides' cold
             # buckets for its keys must be resident or matches are lost
-            self._fault_in(side, chunk)
+            self._cold_apply_hook(side, chunk)
         own = self.left if side == "l" else self.right
         own = self._maybe_grow(side, own, chunk.capacity)
         other = self.right if side == "l" else self.left
@@ -499,7 +549,35 @@ class HashJoinExecutor(Executor, Checkpointable):
         self._em_overflow = self._em_overflow | em_overflow
         return [StreamChunk(columns=cols, valid=valid, nulls=nulls, ops=ops)]
 
+    def _grow_hint(self, side: str, own: JoinSide, incoming: int) -> JoinSide:
+        """The FUSED wrapper's pre-dispatch growth bookkeeping: ZERO
+        device reads — one emergency bucket bump per side per epoch at
+        most (BucketAllocator.bump; the host bound counts padded
+        chunk capacities, so exact sizing from it over-grows);
+        ordinary growth/shrink resolves at the barrier from the
+        staged true occupancy+survivor notes."""
+        if self._buckets is None:
+            return self._maybe_grow(side, own, incoming)
+        cap = own.capacity
+        bound = min(self._bound[side], cap)
+        self._bound[side] = bound
+        if self._grew_midepoch[side] or (
+            bound + incoming <= cap * HARD_GROW_AT
+        ):
+            return own
+        new_cap = self._buckets[side].bump(cap)
+        if new_cap is not None:
+            own = regrow(own, new_cap, own.fanout)
+            self._bound[side] = min(bound, new_cap)
+        self._grew_midepoch[side] = True
+        return own
+
     def _maybe_grow(self, side: str, own: JoinSide, incoming: int) -> JoinSide:
+        """INTERPRETED-path growth: the exact legacy policy (one
+        packed blocking read when the trigger trips). Declared under
+        ``fallback_syncs`` on bucketed instances — the fused program
+        replaces it with _grow_hint + barrier-note planning, so the
+        read runs only where interpretation runs."""
         cap = own.capacity
         alloc = self._buckets[side] if self._buckets is not None else None
         if not needs_plan(alloc, cap, self._bound[side], incoming, GROW_AT):
@@ -640,6 +718,8 @@ class HashJoinExecutor(Executor, Checkpointable):
             self._cold_tombstones.setdefault(name, []).extend(closed)
 
     def _fault_in(self, side: str, chunk: StreamChunk) -> None:
+        if not (self._evicted["left"] or self._evicted["right"]):
+            return  # armed but nothing evicted: never pull the chunk
         own_keys = self.left_keys if side == "l" else self.right_keys
         cols = [
             host_key_view(np.asarray(chunk.col(k))) for k in own_keys
@@ -719,18 +799,45 @@ class HashJoinExecutor(Executor, Checkpointable):
             self.right.inconsistent,
             self.left.table.occupancy(),
             self.right.table.occupancy(),
+            jnp.sum((self.left.table.live | self.left.sdirty).astype(jnp.int32)),
+            jnp.sum((self.right.table.live | self.right.sdirty).astype(jnp.int32)),
         )
         if barrier is None:  # direct drive: checks fire inline
             self.finish_barrier()
         return []
 
+    def _plan_side_at_barrier(
+        self, side: str, claimed: int, survivors: int
+    ) -> None:
+        """Barrier-boundary capacity planning from the TRUE occupancy
+        note (grow past the load factor, apply pending lazy shrink,
+        honor a governor pin) — zero mid-epoch device reads."""
+        own = self.left if side == "l" else self.right
+        cap = own.capacity
+        epoch_inc = max(self._bound[side] - self._occ_note[side], 0)
+        self._occ_note[side] = claimed
+        self._bound[side] = claimed
+        alloc = self._buckets[side]
+        alloc.note_barrier(cap, claimed)
+        new_cap = alloc.plan(
+            cap, 0, claimed, survivors, margin=max(claimed, epoch_inc)
+        )
+        if new_cap is not None and new_cap != cap:
+            own = regrow(own, new_cap, own.fanout)
+            if side == "l":
+                self.left = own
+            else:
+                self.right = own
+
     def _on_barrier_scalars(self, vals) -> None:
-        em, lo, li, ro, ri, cl, cr = vals
-        self._bound["l"] = int(cl)
-        self._bound["r"] = int(cr)
+        em, lo, li, ro, ri, cl, cr, sl, sr = vals
+        self._grew_midepoch = {"l": False, "r": False}
         if self._buckets is not None:
-            self._buckets["l"].note_barrier(self.left.capacity, int(cl))
-            self._buckets["r"].note_barrier(self.right.capacity, int(cr))
+            self._plan_side_at_barrier("l", int(cl), int(sl))
+            self._plan_side_at_barrier("r", int(cr), int(sr))
+        else:
+            self._bound["l"] = int(cl)
+            self._bound["r"] = int(cr)
         if em:
             raise RuntimeError(
                 "join emission overflowed out_cap within one chunk; "
@@ -759,12 +866,14 @@ class HashJoinExecutor(Executor, Checkpointable):
         if watermark.column == self.window_cols[0]:
             pos = self._key_index("l", self.window_cols[0])
             self.left = expire_keys(self.left, pos, cutoff)
-            self._expire_evicted("left", pos, int(watermark.value))
+            if self._cold_expire_hook is not None:
+                self._cold_expire_hook("left", pos, int(watermark.value))
             self._wm["l"] = watermark.value
         else:
             pos = self._key_index("r", self.window_cols[1])
             self.right = expire_keys(self.right, pos, cutoff)
-            self._expire_evicted("right", pos, int(watermark.value))
+            if self._cold_expire_hook is not None:
+                self._cold_expire_hook("right", pos, int(watermark.value))
             self._wm["r"] = watermark.value
         if self._wm["l"] is None or self._wm["r"] is None:
             return None, []

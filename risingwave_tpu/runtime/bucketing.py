@@ -50,6 +50,8 @@ __all__ = [
     "BucketPolicy",
     "ShapeGovernor",
     "emission_bucket",
+    "flush_pad",
+    "flush_pad_schedule",
     "lattice_between",
     "needs_plan",
     "padding_fraction",
@@ -92,6 +94,37 @@ def emission_bucket(n: int, floor: int = 2) -> int:
     Downstream programs then see at most log2(max_delta) distinct
     shapes instead of one per distinct count."""
     return pow2_at_least(max(int(n), floor))
+
+
+def flush_pad(out_cap: int, emitted_bound: int) -> int:
+    """The agg-flush emission lattice: one delta chunk's capacity,
+    quantized to exactly TWO buckets (small | full) from a bound on
+    its emitted rows. Every consumer of a flush lane — the interpreted
+    exact slicer (hash_agg._delta_to_chunk), the fused single-input
+    program and the fused two-input join programs — draws pads from
+    THIS function, so the flush-lane shape family is one closed
+    {small, full} pair per out_cap and the downstream compile set
+    cannot drift apart between paths."""
+    full = 2 * int(out_cap)
+    small = min(256, full)
+    return small if 2 * int(emitted_bound) <= small else full
+
+
+def flush_pad_schedule(
+    dirty_bound: int, capacity: int, out_cap: int
+) -> Tuple[int, ...]:
+    """Per-round flush pads for one barrier, from the HOST dirty bound
+    (zero device reads): round r drains up to ``out_cap`` dirty
+    groups, so its emitted-rows bound is what remains of the clamped
+    dirty bound. Always at least one round (a trailing over-estimate
+    emits an all-invalid chunk — masked lanes, a no-op downstream)."""
+    out_cap = int(out_cap)
+    bound = min(int(dirty_bound), int(capacity))
+    rounds = max(1, -(-bound // out_cap))
+    return tuple(
+        flush_pad(out_cap, min(max(bound - r * out_cap, 0), out_cap))
+        for r in range(rounds)
+    )
 
 
 def validate_lattice(buckets) -> Optional[str]:
@@ -286,6 +319,27 @@ class BucketAllocator:
             if t < cap:
                 return t
         return None
+
+    def bump(self, cap: int) -> Optional[int]:
+        """ONE-bucket emergency growth for a mid-epoch overflow guard.
+
+        The guard's host insert bound counts padded chunk CAPACITIES,
+        not true inserts — letting ``plan()`` size from it over-grows
+        by several buckets and re-compiles every program touching the
+        buffer (measured +68%% wall on the join-heavy CPU suites).
+        The guard only needs to stay ahead of MAX_PROBE until the next
+        barrier's true-note planning, so it doubles once (clamped at
+        the lattice max; a genuine faster-than-2x single-epoch blow-up
+        still trips the executor's overflow latch, the pre-existing
+        contract). Shrink state resets like any growth."""
+        p = self.policy
+        if cap >= p.max_cap:
+            return None
+        new_cap = min(cap << 1, p.max_cap)
+        self.high_water = max(self.high_water, new_cap)
+        self._pending_shrink = None
+        self._streak = 0
+        return new_cap
 
     # -- barrier hook -----------------------------------------------------
     def note_barrier(self, cap: int, claimed: int) -> None:

@@ -40,7 +40,7 @@ from __future__ import annotations
 
 import os
 from contextlib import nullcontext
-from dataclasses import dataclass
+from dataclasses import dataclass, fields as _dc_fields
 from functools import partial
 from typing import List, Optional, Sequence, Tuple
 
@@ -49,6 +49,14 @@ import jax.numpy as jnp
 
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.executors.base import Barrier, Executor, Watermark
+from risingwave_tpu.executors.dedup import (
+    AppendOnlyDedupExecutor,
+    dedup_step_fn,
+)
+from risingwave_tpu.executors.dynamic_filter import (
+    DynamicMaxFilterExecutor,
+    filter_step_fn,
+)
 from risingwave_tpu.executors.epoch_batch import (
     ComposedSteps,
     _compose_lint_infos,
@@ -58,6 +66,10 @@ from risingwave_tpu.executors.hash_agg import (
     _epoch_reduced_fn,
     delta_to_chunk,
 )
+from risingwave_tpu.executors.hash_join import (
+    HashJoinExecutor,
+    join_step_fn,
+)
 from risingwave_tpu.executors.materialize import (
     DeviceMaterializeExecutor,
     mv_step_fn,
@@ -66,17 +78,23 @@ from risingwave_tpu.expr.expr import StaticTree, lift_literals, param_scope
 from risingwave_tpu.ops import agg as agg_ops
 from risingwave_tpu.parallel.sharded_agg import stack_chunks
 from risingwave_tpu.profiler import PROFILER
+from risingwave_tpu.runtime.bucketing import flush_pad_schedule
 
 __all__ = [
     "FusedChainExecutor",
+    "FusedTwoInputExecutor",
     "expand_fused",
     "fuse_chain",
     "fuse_pipeline",
+    "fuse_two_input",
     "fused_cache_stats",
     "fused_enabled",
     "fused_fragments",
+    "fusion_refusals",
     "lift_enabled",
     "lift_plan",
+    "pipeline_depth",
+    "two_input_enabled",
 ]
 
 
@@ -99,6 +117,77 @@ def lift_enabled() -> bool:
         "off",
         "false",
     )
+
+
+def two_input_enabled() -> bool:
+    """RW_FUSED_TWO_INPUT=0 disables whole-pipeline two-input fusion:
+    two-input pipelines then fall back to the PR 10 per-chain policy
+    (epoch-batched agg side, interpreted join, fused-or-interpreted MV
+    tail) — the differential-testing twin of the fused path."""
+    return os.environ.get(
+        "RW_FUSED_TWO_INPUT", "1"
+    ).strip().lower() not in ("0", "off", "false")
+
+
+def pipeline_depth(explicit: Optional[int] = None) -> int:
+    """K-barrier device pipelining depth: the fused wrapper defers its
+    blocking staged-scalar materialization (and latch checks, telemetry
+    decode, input retirement) to every K-th barrier, so K consecutive
+    barriers' donated programs sit queued on the device back-to-back
+    with ZERO host synchronization between them — the host enqueues
+    barrier N+1 while N still runs and leaves the steady state
+    entirely. Watermark/checkpoint walks stay at the K-boundary;
+    members remain the system of record with per-barrier state
+    write-back (the written-back arrays are futures of the in-flight
+    program, so recovery/governor/cold-tier contracts see exactly the
+    state they always did once they materialize). K=1 (default) is the
+    per-barrier fused behavior."""
+    if explicit is not None:
+        return max(1, int(explicit))
+    try:
+        return max(1, int(os.environ.get("RW_FUSED_PIPELINE_DEPTH", "1")))
+    except ValueError:
+        return 1
+
+
+# ---------------------------------------------------------------------------
+# fusion-refusal provenance (the anti-silent-fallback contract)
+# ---------------------------------------------------------------------------
+
+_REFUSALS: List[dict] = []
+_REFUSALS_CAP = 256  # bounded: graph rebuilds re-refuse per spawn
+
+
+def _refuse(label: str, reason: str, executor: Optional[str] = None):
+    """Record WHY a chain/pipeline was left interpreted (RW-E807):
+    fusion policy must never fall back silently — every refusal
+    carries fragment + executor provenance, queryable via
+    :func:`fusion_refusals` and mirrored into the meta event log."""
+    rec = {
+        "code": "RW-E807",
+        "fragment": label,
+        "executor": executor,
+        "message": reason,
+    }
+    if len(_REFUSALS) >= _REFUSALS_CAP:
+        del _REFUSALS[: _REFUSALS_CAP // 2]
+    _REFUSALS.append(rec)
+    try:
+        from risingwave_tpu.event_log import EVENT_LOG
+
+        EVENT_LOG.record("fusion_refused", **rec)
+    except Exception:  # noqa: BLE001 — provenance is best effort
+        pass
+    return None
+
+
+def fusion_refusals(clear: bool = False) -> List[dict]:
+    """Every recorded fusion refusal (RW-E807 provenance) since process
+    start (or the last ``clear=True`` call)."""
+    out = list(_REFUSALS)
+    if clear:
+        _REFUSALS.clear()
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -850,14 +939,1109 @@ class FusedChainExecutor(Executor):
 
 
 # ---------------------------------------------------------------------------
+# the two-input fused program (q7/q8: side chains + join + MV, one
+# donated device program per barrier)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class SidePlan:
+    """One input side's statics: a pure prefix (ComposedSteps) feeding
+    at most one stateful member — the two-input shapes' side chains:
+    q7 ``hop -> DynamicMaxFilter`` (left) / ``hop -> HashAgg`` (right),
+    q8 ``hop -> dedup`` (both)."""
+
+    pre: Optional[ComposedSteps]
+    kind: Optional[str]  # None | "filter" | "dedup" | "agg"
+    keys: tuple = ()  # filter: (group_col, value_col); dedup: key names
+    agg: Optional[AggStatics] = None
+
+
+@dataclass(frozen=True)
+class TwoInputPlan:
+    """The fused two-input program's static shape (jit cache key):
+    two side plans around one hash join, then a pure/mv/pure tail.
+    Value-hashable (ComposedSteps contract), so rebuilds and recovery
+    re-fuse into the SAME compiled program."""
+
+    left: SidePlan
+    right: SidePlan
+    j_left_keys: tuple
+    j_right_keys: tuple
+    j_left_names: tuple
+    j_right_names: tuple
+    j_out_names: tuple
+    j_out_cap: int
+    j_type: str
+    tail_pre: Optional[ComposedSteps]
+    mv_pk: Optional[tuple]
+    mv_cols: Optional[tuple]
+    tail_post: Optional[ComposedSteps]
+
+    def __hash__(self):
+        # hashed as a STATIC jit argument on every barrier dispatch:
+        # cache it (frozen dataclasses re-derive the field-tuple hash
+        # per call; equality stays field-based for program sharing)
+        h = self.__dict__.get("_hash")
+        if h is None:
+            h = hash(tuple(getattr(self, f.name) for f in _dc_fields(self)))
+            object.__setattr__(self, "_hash", h)
+        return h
+
+
+def _two_input_side_scan(st, jl, jr, seg, side_plan, plan, arrival):
+    """lax.scan one side's homogeneous stacked batch through the side's
+    stateful step (if any) and the join arrival step, chunk by chunk in
+    arrival order — the DynamicMaxFilter's pass-iff->=pre-chunk-max
+    decision and the join's per-chunk ``out_cap`` emission compaction
+    are both order-dependent, so the scan preserves the interpreted
+    walk's exact semantics (bit-identity, not just epoch-equivalence).
+    Returns ``(st, jl, jr, flat_emission, (saw_delete, dropped),
+    em_overflow)`` with the per-chunk emissions flattened in order."""
+    own_keys = plan.j_left_keys if arrival == "l" else plan.j_right_keys
+    other_keys = plan.j_right_keys if arrival == "l" else plan.j_left_keys
+    own_names = plan.j_left_names if arrival == "l" else plan.j_right_names
+    other_names = plan.j_right_names if arrival == "l" else plan.j_left_names
+    jown, jother = (jl, jr) if arrival == "l" else (jr, jl)
+    F = jnp.zeros((), jnp.bool_)
+
+    def body(carry, chunk):
+        st, jown, jother, sd, dp, ovf = carry
+        if side_plan.pre is not None:
+            chunk = side_plan.pre(chunk)
+        if side_plan.kind == "filter":
+            table, maxes, sdirty = st
+            table, maxes, sdirty, chunk, d1, d2 = filter_step_fn(
+                table,
+                maxes,
+                sdirty,
+                chunk,
+                side_plan.keys[0],
+                side_plan.keys[1],
+            )
+            st = (table, maxes, sdirty)
+            sd, dp = sd | d1, dp | d2
+        elif side_plan.kind == "dedup":
+            table, sdirty = st
+            table, sdirty, chunk, d1, d2 = dedup_step_fn(
+                table, sdirty, chunk, side_plan.keys
+            )
+            st = (table, sdirty)
+            sd, dp = sd | d1, dp | d2
+        jown, jother, cols, nulls, ops, valid, o = join_step_fn(
+            jown,
+            jother,
+            chunk,
+            own_keys,
+            other_keys,
+            own_names,
+            other_names,
+            plan.j_out_cap,
+            plan.j_type,
+            arrival,
+            plan.j_out_names,
+        )
+        em = StreamChunk(columns=cols, valid=valid, nulls=nulls, ops=ops)
+        return (st, jown, jother, sd, dp, ovf | o), em
+
+    # segments arrive as pow2-padded chunk TUPLES and stack INSIDE the
+    # traced program: host-eager jnp.stack cost ~9ms/barrier of pure
+    # dispatch overhead on the q7 smoke tier — in-trace it fuses into
+    # the compiled program for free
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *seg)
+    (st, jown, jother, sd, dp, ovf), ems = jax.lax.scan(
+        body, (st, jown, jother, F, F, F), stacked
+    )
+    jl, jr = (jown, jother) if arrival == "l" else (jother, jown)
+    flat = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), ems)
+    return st, jl, jr, flat, (sd, dp), ovf
+
+
+def _fused_two_input_fn(
+    states, left_batches, right_batches, params, plan, flush_rounds, pads
+):
+    """The whole two-input fragment-barrier as one pure function over
+    ``states = (left_state, right_state, (join_left, join_right),
+    mv_state, latches)``:
+
+    apply phase — the epoch's buffered LEFT batches scan through the
+                  left side's step + the join's left-arrival kernel
+                  (probe right, fold into left), each batch's per-chunk
+                  ``out_cap`` emissions walking tail -> device MV; then
+                  the RIGHT batches likewise (or, agg sides, into the
+                  agg's flatten+reduce epoch path);
+    flush phase — ``flush_rounds`` device flushes of the agg's dirty
+                  groups, each delta PADDED TO A LATTICE BUCKET with a
+                  validity mask (runtime/bucketing.flush_pad — the
+                  "padded flush made the join 80x slower" objection
+                  predates masked lanes: the join's probe/build kernels
+                  treat masked rows as provably inert, so the pad costs
+                  one masked device op instead of an interpreted
+                  consumer's compute), probing the join as a
+                  right-arrival and walking tail -> MV;
+    scalars     — every member's latches + occupancy/survivor counters
+                  PLUS the device-computed telemetry lane (left/right
+                  rows, join emissions, dirty groups, MV rows) packed
+                  into ONE int64 lane for the (possibly K-deferred)
+                  overlapped finish read.
+
+    Interpreted-twin equivalence: mid-epoch, left applies touch only
+    {left step state, join.left, MV} and right applies only {right
+    step state, join.right-or-agg} — disjoint — and the join's
+    barrier-time flush deltas probe a left side that already absorbed
+    the whole epoch either way, so batching sides in (left, right,
+    flush) order reproduces the interpreted walk's emissions exactly
+    for the per-barrier MV.
+    """
+    with param_scope(params):
+        return _fused_two_input_body(
+            states, left_batches, right_batches, plan, flush_rounds, pads
+        )
+
+
+def _fused_two_input_body(
+    states, left_batches, right_batches, plan, flush_rounds, pads
+):
+    l_st, r_st, (jl, jr), mv_st, latches = states
+    l_saw, l_drop, r_saw, r_drop, em_latch = latches
+    Z = jnp.zeros((), jnp.int64)
+    rows_l = rows_r = join_rows = mv_rows = Z
+    em_ovf = em_latch
+    outs: List[StreamChunk] = []
+
+    def through_tail(chunk):
+        nonlocal mv_st, mv_rows, join_rows
+        join_rows = join_rows + jnp.sum(chunk.valid.astype(jnp.int64))
+        if plan.tail_pre is not None:
+            chunk = plan.tail_pre(chunk)
+        if plan.mv_pk is not None:
+            with jax.named_scope("fused/mv_write"):
+                mv_rows = mv_rows + jnp.sum(chunk.valid.astype(jnp.int64))
+                mtable, mstate = mv_st
+                mtable, mstate = mv_step_fn(
+                    mtable, mstate, chunk, plan.mv_pk, plan.mv_cols
+                )
+                mv_st = (mtable, mstate)
+        if plan.tail_post is not None:
+            chunk = plan.tail_post(chunk)
+        return chunk
+
+    with jax.named_scope("fused/apply"):
+        for seg in left_batches:
+            for c in seg:
+                rows_l = rows_l + jnp.sum(c.valid.astype(jnp.int64))
+            l_st, jl, jr, flat, fl, ovf = _two_input_side_scan(
+                l_st, jl, jr, seg, plan.left, plan, "l"
+            )
+            l_saw, l_drop = l_saw | fl[0], l_drop | fl[1]
+            em_ovf = em_ovf | ovf
+            outs.append(through_tail(flat))
+        for seg in right_batches:
+            for c in seg:
+                rows_r = rows_r + jnp.sum(c.valid.astype(jnp.int64))
+            if plan.right.kind == "agg":
+                stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *seg)
+                a = plan.right.agg
+                table, ast, dropped, minput, mi_bad = r_st
+                if a.has_minput:
+                    table, ast, dropped, minput, mi_bad = _epoch_reduced_fn(
+                        table, ast, dropped, stacked, a.calls,
+                        a.group_keys, a.nullable, plan.right.pre,
+                        minput, mi_bad,
+                    )
+                else:
+                    table, ast, dropped = _epoch_reduced_fn(
+                        table, ast, dropped, stacked, a.calls,
+                        a.group_keys, a.nullable, plan.right.pre,
+                    )
+                r_st = (table, ast, dropped, minput, mi_bad)
+            else:
+                r_st, jl, jr, flat, fr, ovf = _two_input_side_scan(
+                    r_st, jl, jr, seg, plan.right, plan, "r"
+                )
+                r_saw, r_drop = r_saw | fr[0], r_drop | fr[1]
+                em_ovf = em_ovf | ovf
+                outs.append(through_tail(flat))
+
+    # dirty groups pending at the barrier, sampled AFTER the epoch's
+    # applies and BEFORE the flush drains them (telemetry twin)
+    dirty_groups = Z
+    if plan.right.kind == "agg":
+        dirty_groups = jnp.sum(r_st[1].dirty.astype(jnp.int64))
+
+    if flush_rounds and plan.right.kind == "agg":
+        a = plan.right.agg
+        table, ast, dropped, minput, mi_bad = r_st
+        with jax.named_scope("fused/flush"):
+            for r in range(flush_rounds):
+                ast, delta = agg_ops.flush(
+                    ast, table.keys, a.out_cap, a.float_extremes
+                )
+                chunk = delta_to_chunk(
+                    delta, a.group_keys, a.nullable, a.calls, pads[r]
+                )
+                jr, jl, cols, nulls, ops, valid, o = join_step_fn(
+                    jr,
+                    jl,
+                    chunk,
+                    plan.j_right_keys,
+                    plan.j_left_keys,
+                    plan.j_right_names,
+                    plan.j_left_names,
+                    plan.j_out_cap,
+                    plan.j_type,
+                    "r",
+                    plan.j_out_names,
+                )
+                em_ovf = em_ovf | o
+                outs.append(
+                    through_tail(
+                        StreamChunk(
+                            columns=cols, valid=valid, nulls=nulls, ops=ops
+                        )
+                    )
+                )
+        r_st = (table, ast, dropped, minput, mi_bad)
+
+    with jax.named_scope("fused/scalar_pack"):
+        scal = []
+
+        def side_scal(st, kind, saw, drop):
+            if kind in ("filter", "dedup"):
+                table = st[0]
+                sdirty = st[2] if kind == "filter" else st[1]
+                scal.extend(
+                    [
+                        saw,
+                        drop,
+                        table.occupancy(),
+                        jnp.sum((table.live | sdirty).astype(jnp.int32)),
+                    ]
+                )
+            elif kind == "agg":
+                table, ast, dropped, _minput, mi_bad = st
+                scal.extend(
+                    [dropped, ast.minmax_retracted, mi_bad,
+                     table.occupancy()]
+                )
+
+        side_scal(l_st, plan.left.kind, l_saw, l_drop)
+        side_scal(r_st, plan.right.kind, r_saw, r_drop)
+        scal += [
+            em_ovf,
+            jl.overflow,
+            jl.inconsistent,
+            jr.overflow,
+            jr.inconsistent,
+            jl.table.occupancy(),
+            jr.table.occupancy(),
+            jnp.sum((jl.table.live | jl.sdirty).astype(jnp.int32)),
+            jnp.sum((jr.table.live | jr.sdirty).astype(jnp.int32)),
+        ]
+        if plan.mv_pk is not None:
+            mtable, mstate = mv_st
+            scal += [mstate.dropped, mtable.occupancy()]
+        # telemetry tail rides the same staged read the barrier pays
+        # anyway: zero extra lanes dispatched, zero new host syncs
+        scal += [rows_l, rows_r, join_rows, dirty_groups, mv_rows]
+        packed = jnp.stack(
+            [jnp.asarray(x).astype(jnp.int64) for x in scal]
+        )
+    latches_out = (l_saw, l_drop, r_saw, r_drop, em_ovf)
+    return (l_st, r_st, (jl, jr), mv_st, latches_out), tuple(outs), packed
+
+
+_fused_two_input_step = partial(
+    jax.jit,
+    static_argnames=("plan", "flush_rounds", "pads"),
+    donate_argnums=(0,),
+)(_fused_two_input_fn)
+
+
+_ZERO_VALID_CACHE: dict = {}
+
+
+def _zero_valid(shape) -> jnp.ndarray:
+    """A cached all-False valid lane for pad chunks: padding is a
+    steady-state per-barrier operation and the zero lane is immutable
+    and never donated — minting a fresh device buffer per barrier was
+    measurable eager-dispatch cost."""
+    arr = _ZERO_VALID_CACHE.get(shape)
+    if arr is None:
+        arr = jnp.zeros(shape, jnp.bool_)
+        _ZERO_VALID_CACHE[shape] = arr
+    return arr
+
+
+def _pad_segment(seg: List[StreamChunk]) -> Tuple[StreamChunk, ...]:
+    """Pow2-pad a homogeneous chunk list (the epoch-batch compile
+    discipline: at most log2(max chunks/epoch) distinct batch shapes
+    per chunk signature). The chunks stay a TUPLE — the fused program
+    stacks them in-trace, where the stack fuses into the compiled
+    program instead of costing host-eager dispatches."""
+    n = len(seg)
+    target = 1 << (n - 1).bit_length() if n > 1 else 1
+    if target > n:
+        c0 = seg[0]
+        empty = StreamChunk(
+            c0.columns, _zero_valid(c0.valid.shape), c0.nulls, c0.ops
+        )
+        seg = seg + [empty] * (target - n)
+    return tuple(seg)
+
+
+class FusedTwoInputExecutor(Executor):
+    """A whole two-input pipeline — ``pure* [filter|dedup|agg]`` per
+    side, HashJoin, ``pure* [DeviceMV] pure*`` tail — executed as ONE
+    donated device program per barrier (q7/q8's shape; the TiLT
+    endgame: compile the query, not the operators).
+
+    Driver contract (TwoInputPipeline routes here when armed):
+    ``buffer_left``/``buffer_right`` stage raw source chunks,
+    ``on_barrier`` dispatches the barrier program and returns the
+    fragment's emission, ``finish_barrier`` materializes the packed
+    member scalars and fires every member's latch checks at their
+    original raise points — deferred to every K-th barrier under
+    ``RW_FUSED_PIPELINE_DEPTH=K`` (K barriers' programs queue on the
+    device back-to-back with zero host syncs between them).
+
+    The member executor OBJECTS stay the system of record: state is
+    written back after every program (as async futures of the in-flight
+    dispatch), so checkpoint/restore, recovery, the shape governor and
+    the cold tier keep talking to the originals, and the interpreted
+    watermark walk interoperates exactly.
+    """
+
+    def __init__(
+        self,
+        members: Sequence[Executor],
+        plan: TwoInputPlan,
+        l_stateful: Optional[Executor],
+        r_stateful: Optional[Executor],
+        join: HashJoinExecutor,
+        mv: Optional[DeviceMaterializeExecutor],
+        label: str = "fragment",
+        depth: Optional[int] = None,
+        n_left: Optional[int] = None,
+    ):
+        self.members = list(members)
+        self.plan = plan
+        # index boundary between the left and right chains inside
+        # ``members`` (telemetry row attribution)
+        self._n_left = n_left if n_left is not None else len(members)
+        self.l_stateful = l_stateful
+        self.r_stateful = r_stateful
+        self.agg = r_stateful if type(r_stateful) is HashAggExecutor else None
+        self.join = join
+        self.mv = mv
+        self.label = label
+        self.covers_whole_chain = True
+        self.depth = pipeline_depth(depth)
+        self._segs = {"l": [], "r": []}  # homogeneous chunk segments
+        self._sig = {"l": None, "r": None}
+        self._probe_caps = {}  # (side, chunk sig) -> post-pre capacity
+        self._pending: List = []  # staged packed scalars (K-deferred)
+        self._retired: List = []  # program inputs held to the K-fence
+        self._barriers = 0
+        self._last_lanes = 0
+        self._telemetry: Optional[dict] = None
+
+    # -- data path --------------------------------------------------------
+    def buffer_left(self, chunk: StreamChunk) -> List[StreamChunk]:
+        return self._buffer("l", chunk)
+
+    def buffer_right(self, chunk: StreamChunk) -> List[StreamChunk]:
+        return self._buffer("r", chunk)
+
+    def _buffer(self, side: str, chunk: StreamChunk) -> List[StreamChunk]:
+        sig = FusedChainExecutor._signature(chunk)
+        segs = self._segs[side]
+        if not segs or self._sig[side] != sig:
+            segs.append([])
+            self._sig[side] = sig
+        segs[-1].append(chunk)
+        return []
+
+    def flush_data(self) -> List[StreamChunk]:
+        """Apply everything buffered WITHOUT the agg flush (the
+        pre-watermark data barrier: buffered rows precede the watermark
+        in stream order, and the watermark walk then runs over member
+        state interpreted)."""
+        if not self._segs["l"] and not self._segs["r"]:
+            return []
+        return self._run(flush=False, stage=False)
+
+    # -- control path -----------------------------------------------------
+    def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
+        if self.agg is not None and self.agg._cold_barrier_hook is not None:
+            self.agg._cold_barrier_hook()
+        outs = self._run(flush=True, stage=True)
+        self._barriers += 1
+        if barrier is None:  # direct drive: checks fire inline
+            self.finish_barrier(force=True)
+        return outs
+
+    def on_watermark(self, watermark: Watermark):
+        # handled at the pipeline level (flush_data + interpreted
+        # member walk); kept for Executor-protocol completeness
+        outs = self.flush_data()
+        return watermark, outs
+
+    def finish_barrier(self, force: bool = False) -> None:
+        """Materialize every pending barrier's packed scalars and run
+        the member latch checks — at the K-boundary (or forced: direct
+        drive, checkpoint staging, close). Between boundaries the host
+        never blocks on the device: barrier N+1's program is enqueued
+        while N still runs."""
+        if not self._pending:
+            return
+        if not force and (self._barriers % self.depth) != 0:
+            return
+        import time
+
+        from risingwave_tpu.ops.hash_table import finish_scalars
+        from risingwave_tpu.trace import span
+
+        pending, self._pending = self._pending, []
+        retired, self._retired = self._retired, []
+        try:
+            for i, packed in enumerate(pending):
+                t0 = time.perf_counter()
+                with span(
+                    "executor.device_step", executor=type(self).__name__
+                ):
+                    vals = finish_scalars(packed)
+                if PROFILER.enabled:
+                    PROFILER.record_device_wait(
+                        self, (time.perf_counter() - t0) * 1e3
+                    )
+                # member scalars decode from the LAST pack only: the
+                # latch lanes are monotonic and CARRIED through the
+                # chained programs (each barrier's latches_in are the
+                # previous write-back), so the final pack subsumes
+                # every earlier one — and one K-window must feed the
+                # bucket allocators ONE hysteresis observation, not K
+                # at once (K stale notes burned the lazy-shrink
+                # patience in a single boundary and flapped capacities
+                # across the window — the exact oscillation PR 9's
+                # hysteresis exists to prevent). Earlier packs still
+                # decode their telemetry lanes (per-barrier forensics).
+                self._on_barrier_scalars(
+                    vals, members=(i == len(pending) - 1)
+                )
+        finally:
+            for m in self.members:
+                m.finish_barrier()  # no-op: members never stage here
+            del retired  # the fence above ran: retiring is a plain free
+
+    def capture_checkpoint(self) -> None:
+        for m in self.members:
+            cap = getattr(m, "capture_checkpoint", None)
+            if cap is not None:
+                cap()
+
+    def lint_info(self):
+        return None  # the pipeline's chains stay the lint surface
+
+    # -- scalar decode ----------------------------------------------------
+    def _scalar_layout(self):
+        layout = []
+        if self.l_stateful is not None:
+            layout.append(("l", 4))
+        if self.r_stateful is not None:
+            layout.append(("r", 4))
+        layout.append(("join", 9))
+        if self.mv is not None:
+            layout.append(("mv", 2))
+        layout.append(("tel", 5))
+        return layout
+
+    def _on_barrier_scalars(self, vals, members: bool = True) -> None:
+        i = 0
+        slices = {}
+        for name, width in self._scalar_layout():
+            slices[name] = tuple(vals[i : i + width])
+            i += width
+        # telemetry FIRST: a tripped member latch raises below, and the
+        # flight recorder must still see what the barrier did
+        self._note_telemetry(slices)
+        if not members:
+            return
+        if self.l_stateful is not None:
+            self.l_stateful._on_barrier_scalars(slices["l"])
+        if self.r_stateful is not None:
+            self.r_stateful._on_barrier_scalars(slices["r"])
+        self.join._on_barrier_scalars(slices["join"])
+        if self.mv is not None:
+            self.mv._on_barrier_scalars(slices["mv"])
+
+    def _note_telemetry(self, slices) -> None:
+        """Decode the packed telemetry lane into the deviceprof
+        registry (host bookkeeping over values the barrier read anyway
+        — zero extra device IO; never faults the barrier)."""
+        try:
+            rows_l, rows_r, join_rows, dirty_groups, mv_rows = (
+                int(x) for x in slices["tel"]
+            )
+            member_rows = {}
+            occupancy = {}
+            for idx, m in enumerate(self.members):
+                name = f"{idx}:{type(m).__name__}"
+                if m is self.join:
+                    member_rows[name] = join_rows
+                elif m is self.mv or idx > self.members.index(self.join):
+                    member_rows[name] = mv_rows
+                elif idx >= self._n_left:
+                    member_rows[name] = rows_r
+                else:
+                    member_rows[name] = rows_l
+            occupancy["join_left"] = int(slices["join"][5])
+            occupancy["join_right"] = int(slices["join"][6])
+
+            def side_occ(ex, lanes):
+                # agg lanes: [dropped, mret, mi_bad, occupancy];
+                # filter/dedup: [saw, drop, occupancy, survivors]
+                return int(
+                    lanes[3] if type(ex) is HashAggExecutor else lanes[2]
+                )
+
+            if self.l_stateful is not None:
+                occupancy["left"] = side_occ(self.l_stateful, slices["l"])
+            if self.r_stateful is not None:
+                occupancy["right"] = side_occ(self.r_stateful, slices["r"])
+            if self.mv is not None:
+                occupancy["mv"] = int(slices["mv"][1])
+            from risingwave_tpu.runtime.bucketing import padding_fraction
+
+            def nbytes(ex):
+                return sum(
+                    leaf.nbytes
+                    for leaf in jax.tree.leaves(
+                        getattr(ex, "table", None)
+                        if type(ex).__name__ not in ("HashJoinExecutor",)
+                        else (ex.left, ex.right)
+                    )
+                    if hasattr(leaf, "nbytes")
+                )
+
+            entries = [
+                (
+                    self.join.left.capacity,
+                    occupancy["join_left"],
+                    sum(
+                        leaf.nbytes
+                        for leaf in jax.tree.leaves(self.join.left)
+                    ),
+                ),
+                (
+                    self.join.right.capacity,
+                    occupancy["join_right"],
+                    sum(
+                        leaf.nbytes
+                        for leaf in jax.tree.leaves(self.join.right)
+                    ),
+                ),
+            ]
+            for key, ex in (
+                ("left", self.l_stateful),
+                ("right", self.r_stateful),
+            ):
+                if ex is not None and key in occupancy:
+                    entries.append(
+                        (
+                            ex.table.capacity,
+                            occupancy[key],
+                            nbytes(ex),
+                        )
+                    )
+            if self.mv is not None and "mv" in occupancy:
+                entries.append(
+                    (
+                        self.mv.table.capacity,
+                        occupancy["mv"],
+                        self.mv.state_nbytes(),
+                    )
+                )
+            pad_frac = padding_fraction(entries)
+            lanes = self._last_lanes
+            rows_in = rows_l + rows_r
+            tel = {
+                "rows_in": rows_in,
+                "rows_left": rows_l,
+                "rows_right": rows_r,
+                "join_rows": join_rows,
+                "dirty_groups": dirty_groups,
+                "mv_rows": mv_rows,
+                "member_rows": member_rows,
+                "occupancy": occupancy,
+                "lanes_total": lanes,
+                "lane_fill_frac": (
+                    round(rows_in / lanes, 6) if lanes else 0.0
+                ),
+                "padding_bytes_frac": pad_frac,
+            }
+            self._telemetry = tel
+            from risingwave_tpu.deviceprof import DEVICEPROF
+
+            DEVICEPROF.note_telemetry(self.label, tel)
+        except Exception:  # noqa: BLE001 — forensic, never load-bearing
+            pass
+
+    # -- member state plumbing --------------------------------------------
+    def _side_state(self, ex):
+        if ex is None:
+            return ()
+        if type(ex) is DynamicMaxFilterExecutor:
+            return (ex.table, ex.maxes, ex.sdirty)
+        if type(ex) is AppendOnlyDedupExecutor:
+            return (ex.table, ex.sdirty)
+        return (ex.table, ex.state, ex.dropped, ex.minput, ex.mi_bad)
+
+    def _write_side_state(self, ex, st) -> None:
+        if ex is None:
+            return
+        if type(ex) is DynamicMaxFilterExecutor:
+            ex.table, ex.maxes, ex.sdirty = st
+        elif type(ex) is AppendOnlyDedupExecutor:
+            ex.table, ex.sdirty = st
+        else:
+            ex.table, ex.state, ex.dropped, ex.minput, ex.mi_bad = st
+
+    def _latches(self):
+        def pair(ex):
+            if ex is None or type(ex) is HashAggExecutor:
+                # fresh zero buffers per slot: the states pytree is
+                # DONATED whole, and donating one buffer twice is an
+                # XLA error
+                return (
+                    jnp.zeros((), jnp.bool_),
+                    jnp.zeros((), jnp.bool_),
+                )
+            return (ex._saw_delete, ex._dropped)
+
+        return pair(self.l_stateful) + pair(self.r_stateful) + (
+            self.join._em_overflow,
+        )
+
+    def _write_latches(self, latches) -> None:
+        l_saw, l_drop, r_saw, r_drop, em = latches
+        for ex, saw, drop in (
+            (self.l_stateful, l_saw, l_drop),
+            (self.r_stateful, r_saw, r_drop),
+        ):
+            if ex is not None and type(ex) is not HashAggExecutor:
+                ex._saw_delete, ex._dropped = saw, drop
+        self.join._em_overflow = em
+
+    # -- the program ------------------------------------------------------
+    def _prepare_side(self, side: str, side_plan: SidePlan):
+        """Stack the side's buffered segments and run the members' host
+        growth bookkeeping (rebuilds must land BEFORE states are read).
+        Returns (batches, post_pre_rows)."""
+        segs, self._segs[side] = self._segs[side], []
+        self._sig[side] = None
+        batches = []
+        rows = 0
+        ex = self.l_stateful if side == "l" else self.r_stateful
+        for seg in segs:
+            if not seg:
+                continue
+            padded = _pad_segment(seg)
+            key = (side, FusedChainExecutor._signature(seg[0]))
+            cap = self._probe_caps.get(key)
+            if cap is None:
+                # the post-pre row capacity (hop expansion factor),
+                # memoized per chunk signature: re-tracing the pure
+                # prefix abstractly EVERY barrier was measurable host
+                # dispatch cost
+                probe = jax.eval_shape(
+                    side_plan.pre
+                    if side_plan.pre is not None
+                    else (lambda c: c),
+                    jax.tree.map(
+                        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                        seg[0],
+                    ),
+                )
+                cap = probe.valid.shape[0]
+                self._probe_caps[key] = cap
+            rows += len(padded) * cap
+            batches.append(padded)
+        if ex is not None and rows:
+            if type(ex) is HashAggExecutor:
+                if ex._cold_stacked_hook is not None:
+                    ex._cold_stacked_hook()
+                ex._maybe_grow(rows)
+                ex._insert_bound += rows
+                ex._dirty_bound += rows
+            else:
+                ex._grow_hint(rows)
+                ex._bound += rows
+        return tuple(batches), rows
+
+    def _run(self, flush: bool, stage: bool) -> List[StreamChunk]:
+        if self.join._cold_apply_hook is not None:
+            # armed cold tier: the program probes the join sides
+            # directly, so every evicted bucket must be RESIDENT before
+            # dispatch or matches are silently lost — restore them all
+            # up front (conservative, the agg _cold_stacked_hook
+            # discipline; code-review finding)
+            for name in ("left", "right"):
+                ev = self.join._evicted[name]
+                if ev:
+                    self.join._restore_cold_keys(name, sorted(ev))
+        left_batches, l_rows = self._prepare_side("l", self.plan.left)
+        right_batches, r_rows = self._prepare_side("r", self.plan.right)
+        has_data = bool(left_batches or right_batches)
+
+        flush_rounds = 0
+        pads: Tuple[int, ...] = ()
+        if flush and self.agg is not None:
+            # rounds/pads from the PLAN's out_cap (the value the
+            # compiled flush drains per round) AFTER the buffered epoch
+            # landed in the dirty bound — the single-input lessons
+            pads = flush_pad_schedule(
+                self.agg._dirty_bound,
+                self.agg.table.capacity,
+                self.plan.right.agg.out_cap,
+            )
+            flush_rounds = len(pads)
+        if not has_data and not flush_rounds and not stage:
+            return []
+
+        # join-side insert bounds: left arrivals fold into the left
+        # side; right arrivals (scanned side or flush deltas) into the
+        # right
+        join = self.join
+        if l_rows:
+            join.left = join._grow_hint("l", join.left, l_rows)
+            join._bound["l"] += l_rows
+        r_join_rows = (
+            sum(pads) if self.agg is not None else r_rows
+        )
+        if r_join_rows:
+            join.right = join._grow_hint("r", join.right, r_join_rows)
+            join._bound["r"] += r_join_rows
+        if self.mv is not None:
+            # every emission chunk reaching the MV has j_out_cap lanes
+            # — INCLUDING flush rounds (a small-pad delta can still
+            # match up to out_cap join rows), so the flush contribution
+            # is rounds * out_cap, not the delta pad sum: the MV's
+            # insert bound must stay a true upper bound or its
+            # MAX_PROBE pre-grow guard goes blind (code-review finding)
+            em_rows = (
+                sum(len(seg) for seg in left_batches)
+                + (
+                    0
+                    if self.agg is not None
+                    else sum(len(seg) for seg in right_batches)
+                )
+                + flush_rounds
+            ) * self.plan.j_out_cap
+            if em_rows:
+                self.mv._maybe_grow(em_rows)
+
+        states = (
+            self._side_state(self.l_stateful),
+            self._side_state(self.r_stateful),
+            (join.left, join.right),
+            (self.mv.table, self.mv.state) if self.mv is not None else (),
+            self._latches(),
+        )
+        if stage:
+            self._last_lanes = sum(
+                len(seg) * int(seg[0].valid.shape[0])
+                for seg in left_batches + right_batches
+            )
+        self._deviceprof_hook(
+            states, left_batches, right_batches, flush_rounds, pads
+        )
+        attr = ann = nullcontext()
+        if PROFILER.enabled:
+            attr = PROFILER.attribute(f"fused:{self.label}")
+            if PROFILER.jax_trace:
+                ann = jax.profiler.TraceAnnotation(f"fused:{self.label}")
+        with attr, ann:
+            (l_st, r_st, (jl, jr), mv_st, latches), outs, packed = (
+                _fused_two_input_step(
+                    states,
+                    left_batches,
+                    right_batches,
+                    None,
+                    self.plan,
+                    flush_rounds,
+                    pads,
+                )
+            )
+        self._write_side_state(self.l_stateful, l_st)
+        self._write_side_state(self.r_stateful, r_st)
+        join.left, join.right = jl, jr
+        if self.mv is not None:
+            self.mv.table, self.mv.state = mv_st
+        self._write_latches(latches)
+        if self.agg is not None and flush_rounds:
+            self.agg._dirty_bound = 0
+        if stage:
+            try:
+                packed.copy_to_host_async()
+            except AttributeError:  # backend without async copies
+                pass
+            self._pending.append(packed)
+        # keep the program's input refs alive past this frame: their
+        # deallocation would synchronize on the still-running program
+        # (held to the K-boundary fence under pipelining)
+        self._retired.append((left_batches, right_batches, states, outs))
+        return list(outs)
+
+    def _deviceprof_hook(
+        self, states, left_batches, right_batches, flush_rounds, pads
+    ) -> None:
+        """Compiled-artifact roofline for the two-input program:
+        analyze each (plan, bucket) combination ONCE via AOT
+        lower+compile over abstract args (deferred off the dispatch
+        path). Never raises."""
+        from risingwave_tpu.deviceprof import DEVICEPROF
+
+        if not DEVICEPROF.enabled:
+            return
+        try:
+            def shapes(batches):
+                return ".".join(
+                    f"{len(seg)}x{seg[0].valid.shape[0]}"
+                    for seg in batches
+                ) or "-"
+
+            caps = ".".join(
+                str(c)
+                for c in (
+                    self.join.left.capacity,
+                    self.join.right.capacity,
+                )
+                + (
+                    (self.mv.table.capacity,)
+                    if self.mv is not None
+                    else ()
+                )
+            )
+            bucket = (
+                f"fr{flush_rounds}_p{'.'.join(map(str, pads)) or '-'}"
+                f"_l{shapes(left_batches)}_r{shapes(right_batches)}"
+                f"_c{caps}"
+            )
+            abstract = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                (states, left_batches, right_batches),
+            )
+            plan = self.plan
+            DEVICEPROF.ensure_program(
+                f"fused:{self.label}",
+                bucket,
+                lambda: _fused_two_input_step.lower(
+                    abstract[0],
+                    abstract[1],
+                    abstract[2],
+                    None,
+                    plan,
+                    flush_rounds,
+                    pads,
+                ),
+                fragment=self.label,
+            )
+        except Exception:  # noqa: BLE001 — observability never faults
+            pass
+
+
+# ---------------------------------------------------------------------------
 # chain rewriting
 # ---------------------------------------------------------------------------
+
+
+def _parse_side(chain, label: str, side: str):
+    """Split one input-side chain into ``(pure prefix, stateful
+    member)`` for the two-input plan, or None (with RW-E807
+    provenance) when the side cannot be absorbed."""
+    pres: List[Executor] = []
+    stateful = None
+    for ex in chain:
+        if stateful is not None:
+            return _refuse(
+                f"{label}/{side}",
+                "executors after the side's stateful member are not "
+                "absorbable by the two-input program",
+                executor=type(ex).__name__,
+            )
+        if _is_pure(ex):
+            pres.append(ex)
+        elif type(ex) in (
+            DynamicMaxFilterExecutor,
+            AppendOnlyDedupExecutor,
+            HashAggExecutor,
+        ):
+            stateful = ex
+        else:
+            return _refuse(
+                f"{label}/{side}",
+                "not fusible in a two-input side chain",
+                executor=type(ex).__name__,
+            )
+    if stateful is not None and type(stateful) is not HashAggExecutor:
+        if stateful._buckets is None:
+            return _refuse(
+                f"{label}/{side}",
+                "side state is not on a bucket lattice (the legacy "
+                "unbucketed twin — the RW-E803 wedge class stays "
+                "interpreted)",
+                executor=type(stateful).__name__,
+            )
+    return pres, stateful
+
+
+def _side_plan(pres, stateful) -> SidePlan:
+    pre = (
+        ComposedSteps([p.pure_step() for p in pres]) if pres else None
+    )
+    if stateful is None:
+        return SidePlan(pre=pre, kind=None)
+    if type(stateful) is DynamicMaxFilterExecutor:
+        return SidePlan(
+            pre=pre,
+            kind="filter",
+            keys=(stateful.group_col, stateful.value_col),
+        )
+    if type(stateful) is AppendOnlyDedupExecutor:
+        return SidePlan(pre=pre, kind="dedup", keys=stateful.keys)
+    return SidePlan(
+        pre=pre,
+        kind="agg",
+        agg=AggStatics(
+            calls=stateful.calls,
+            group_keys=stateful.group_keys,
+            nullable=stateful.nullable,
+            out_cap=stateful.out_cap,
+            float_extremes=stateful._float_extremes,
+            has_minput=bool(stateful.minput),
+        ),
+    )
+
+
+def fuse_two_input(
+    pipeline, label: str = "mv", depth: Optional[int] = None
+) -> Optional[FusedTwoInputExecutor]:
+    """Plan whole-pipeline fusion for a TwoInputPipeline — q7's
+    ``hop -> maxagg -> [flush] -> DynamicMaxFilter x HashJoin -> mv``
+    and q8's ``dedup x join -> mv`` shapes — or None with RW-E807
+    provenance (never a silent interpret fallback). Requirements, each
+    refused with provenance when unmet:
+
+    - the join is a bucketed HashJoin whose trace contract declares
+      ``two_input_fusible`` (both sides' capacities on the declared
+      pow2 lattice — flush lanes pad to lattice buckets with masks,
+      so the emission shape family is closed);
+    - each side is ``pure*`` + at most one of {DynamicMaxFilter,
+      AppendOnlyDedup, HashAgg} (bucketed), the agg (at most one, and
+      on the right side) flushing INTO the join as lattice-padded
+      masked right-arrivals — `_flush_all`'s exact-slicing status read
+      never runs on this path;
+    - the tail is ``pure* [DeviceMaterialize] pure*``.
+    """
+    join = getattr(pipeline, "join", None)
+    if type(join) is not HashJoinExecutor:
+        return _refuse(
+            label,
+            "two-input executor is not a HashJoin",
+            executor=type(join).__name__,
+        )
+    contract = join.trace_contract()
+    if not contract.get("two_input_fusible"):
+        return _refuse(
+            label,
+            "join does not declare bucketed two-input fusibility "
+            "(unbucketed sides: lattice-incompatible)",
+            executor=type(join).__name__,
+        )
+    left = _parse_side(pipeline.left, label, "left")
+    if left is None:
+        return None
+    right = _parse_side(pipeline.right, label, "right")
+    if right is None:
+        return None
+    l_pres, l_stateful = left
+    r_pres, r_stateful = right
+    aggs = [
+        e
+        for e in (l_stateful, r_stateful)
+        if type(e) is HashAggExecutor
+    ]
+    if len(aggs) > 1:
+        return _refuse(label, "two agg sides are not fusible")
+    if aggs and type(l_stateful) is HashAggExecutor:
+        # one flush phase, ordered after both sides' applies: the agg
+        # must sit on the RIGHT side (q7's shape); a left-side agg
+        # would need its flush deltas applied as left arrivals BEFORE
+        # the right batches to match the interpreted barrier order
+        return _refuse(
+            label,
+            "agg on the left side: flush ordering not supported yet "
+            "(swap the inputs)",
+            executor="HashAggExecutor",
+        )
+    # tail: pure* [DeviceMaterialize] pure*
+    tail_pre: List[Executor] = []
+    tail_post: List[Executor] = []
+    mv = None
+    for ex in pipeline.tail:
+        if type(ex) is DeviceMaterializeExecutor and mv is None:
+            mv = ex
+        elif _is_pure(ex):
+            (tail_post if mv is not None else tail_pre).append(ex)
+        else:
+            return _refuse(
+                f"{label}/tail",
+                "not fusible in the two-input tail",
+                executor=type(ex).__name__,
+            )
+    steps = lambda exs: (
+        ComposedSteps([e.pure_step() for e in exs]) if exs else None
+    )
+    plan = TwoInputPlan(
+        left=_side_plan(l_pres, l_stateful),
+        right=_side_plan(r_pres, r_stateful),
+        j_left_keys=join.left_keys,
+        j_right_keys=join.right_keys,
+        j_left_names=join.left_names,
+        j_right_names=join.right_names,
+        j_out_names=join.out_names,
+        j_out_cap=join.out_cap,
+        j_type=join.join_type,
+        tail_pre=steps(tail_pre),
+        mv_pk=mv.pk if mv is not None else None,
+        mv_cols=mv.columns if mv is not None else None,
+        tail_post=steps(tail_post),
+    )
+    members = (
+        list(pipeline.left)
+        + list(pipeline.right)
+        + [join]
+        + list(pipeline.tail)
+    )
+    return FusedTwoInputExecutor(
+        members,
+        plan,
+        l_stateful,
+        r_stateful,
+        join,
+        mv,
+        label=label,
+        depth=depth,
+        n_left=len(pipeline.left),
+    )
 
 
 def fuse_chain(
     chain: Sequence[Executor],
     label: str = "fragment",
     defer_pure: bool = False,
+    upstream: Optional[Executor] = None,
 ) -> List[Executor]:
     """Rewrite every maximal fusible run in an actor chain into a
     FusedChainExecutor; everything else passes through untouched (the
@@ -877,10 +2061,18 @@ def fuse_chain(
       exact-sliced small chunks only the interpreted flush's status
       read can produce — fall back to the per-epoch batched wrapper
       (one fused apply program per epoch, interpreted exact flush).
-    - device MV without an agg (join tails): interpreted per chunk.
-      Stacking a join's heterogeneous emission chunks (capacities and
-      null lanes vary) would mint a fresh compiled program per
-      distinct (signature, count) batch — a compile storm, not a win.
+      (A FUSIBLE two-input consumer absorbs the flush instead — see
+      fuse_two_input, which runs before this per-chain pass.)
+    - device MV without an agg (join-fed MV tails): fusible IFF the
+      feeder's declared emission shape family is CLOSED ("fixed" /
+      "bucketed" trace contract — a bucketed join emits one out_cap
+      shape, a bucketed dynamic filter a pow2 lattice), so stacking
+      its chunks is compile-bounded. The old hard carve-out ("stacking
+      heterogeneous join emissions = compile storm") is replaced by
+      this lattice-compatibility check; a refusal records RW-E807
+      provenance (fusion_refusals) — never a silent fallback. The
+      feeder is the nearest unfused upstream in the chain, or the
+      caller-passed ``upstream`` executor for chain-head runs.
     - pure-only runs >= 2 fuse only with ``defer_pure`` (they emit
       during ``apply`` interpreted; deferring to the barrier is only
       epoch-equivalent, so it is opt-in)."""
@@ -890,6 +2082,19 @@ def fuse_chain(
 
     out: List[Executor] = []
     run: List[Executor] = []
+    feeder = upstream
+
+    def _feeder_emission():
+        if feeder is None:
+            return "unknown"
+        fn = getattr(feeder, "trace_contract", None)
+        try:
+            contract = fn() if fn is not None else None
+        except Exception:  # noqa: BLE001 — policy must never crash
+            contract = None
+        if contract is None:
+            return "unknown"
+        return contract.get("emission", "unknown")
 
     def close() -> None:
         nonlocal run
@@ -903,6 +2108,9 @@ def fuse_chain(
             ),
             None,
         )
+        has_mv = any(
+            type(m) is DeviceMaterializeExecutor for m in run
+        )
         has_mv_after_agg = agg_idx is not None and any(
             type(m) is DeviceMaterializeExecutor for m in run[agg_idx:]
         )
@@ -915,16 +2123,25 @@ def fuse_chain(
                 EpochBatchedAggExecutor(run[:agg_idx], run[agg_idx])
             )
             out.extend(run[agg_idx + 1 :])
-        elif (
-            defer_pure
-            and len(run) >= 2
-            and not any(
-                type(m) is DeviceMaterializeExecutor for m in run
-            )
-        ):
-            # PURE runs only: a join-fed device MV must stay
-            # interpreted per chunk even under defer_pure (see the
-            # docstring's compile-storm rule)
+        elif has_mv:
+            em = _feeder_emission()
+            if em in ("fixed", "bucketed"):
+                out.append(FusedChainExecutor(run, label=label))
+            else:
+                _refuse(
+                    label,
+                    "join-fed MV tail left interpreted: feeder "
+                    f"emission shape family is {em!r}, not a closed "
+                    "fixed/bucketed lattice (stacking would mint one "
+                    "program per distinct batch shape)",
+                    executor=(
+                        type(feeder).__name__
+                        if feeder is not None
+                        else None
+                    ),
+                )
+                out.extend(run)
+        elif defer_pure and len(run) >= 2:
             out.append(FusedChainExecutor(run, label=label))
         else:
             out.extend(run)
@@ -947,6 +2164,7 @@ def fuse_chain(
         else:
             close()
             out.append(ex)
+            feeder = ex
     close()
     if (
         len(out) == 1
@@ -957,27 +2175,53 @@ def fuse_chain(
     return out
 
 
-def fuse_pipeline(pipeline, label: str = "mv", defer_pure: bool = False):
+def fuse_pipeline(
+    pipeline,
+    label: str = "mv",
+    defer_pure: bool = False,
+    pipeline_depth: Optional[int] = None,
+):
     """Arm fusion on a SERIAL Pipeline / TwoInputPipeline in place
     (bench drivers and twin tests; the graph runtime fuses its actor
-    chains automatically). Returns the wrappers created. Note: the
-    pipeline's ``executors`` enumeration then yields wrappers instead
-    of members — use on driver-owned pipelines, not runtime-registered
-    ones (those fuse through the graph path, which keeps its own
-    checkpoint registry of member objects)."""
-    created: List[FusedChainExecutor] = []
+    chains automatically). Returns the wrappers created.
 
-    def rewrite(chain, lbl):
-        new = fuse_chain(chain, label=lbl, defer_pure=defer_pure)
+    Two-input pipelines fuse WHOLE first (fuse_two_input: side chains
+    + join + MV tail into one donated program per barrier, with
+    ``RW_FUSED_PIPELINE_DEPTH``/``pipeline_depth`` K-barrier device
+    pipelining); when that is refused (RW-E807 provenance recorded)
+    each chain falls back to the per-chain policy, with the join's
+    contract passed as the tail's upstream so a lattice-compatible
+    join-fed MV tail still fuses.
+
+    Note: a serial pipeline's ``executors`` enumeration then yields
+    wrappers instead of members — use on driver-owned pipelines, not
+    runtime-registered ones; a two-input pipeline's chains are NOT
+    rewritten under whole fusion (members stay enumerable), the
+    wrapper rides ``pipeline._fused``."""
+    created: List[Executor] = []
+
+    def rewrite(chain, lbl, upstream=None):
+        new = fuse_chain(
+            chain, label=lbl, defer_pure=defer_pure, upstream=upstream
+        )
         created.extend(
             e for e in new if isinstance(e, FusedChainExecutor)
         )
         return new
 
     if hasattr(pipeline, "join") and hasattr(pipeline, "left"):
+        if two_input_enabled():
+            w = fuse_two_input(
+                pipeline, label=label, depth=pipeline_depth
+            )
+            if w is not None:
+                pipeline._fused = w
+                return [w]
         pipeline.left = rewrite(pipeline.left, f"{label}/left")
         pipeline.right = rewrite(pipeline.right, f"{label}/right")
-        pipeline.tail = rewrite(pipeline.tail, f"{label}/tail")
+        pipeline.tail = rewrite(
+            pipeline.tail, f"{label}/tail", upstream=pipeline.join
+        )
     elif hasattr(pipeline, "executors"):
         pipeline.executors = rewrite(pipeline.executors, label)
     return created
@@ -988,7 +2232,7 @@ def expand_fused(executors) -> List[Executor]:
     padding/governor surfaces read per-executor state)."""
     out: List[Executor] = []
     for ex in executors or ():
-        if isinstance(ex, FusedChainExecutor):
+        if isinstance(ex, (FusedChainExecutor, FusedTwoInputExecutor)):
             out.extend(ex.members)
         else:
             out.append(ex)
@@ -997,8 +2241,19 @@ def expand_fused(executors) -> List[Executor]:
 
 def fused_fragments(pipeline) -> dict:
     """BENCH-JSON evidence: how much of the pipeline actually fused
-    (count + whole-chain flag + labels). Accepts serial pipelines and
-    GraphPipeline (scans the live actors)."""
+    (count + whole-chain flag + labels). Accepts serial pipelines,
+    two-input pipelines under whole fusion (the ``_fused`` wrapper)
+    and GraphPipeline (scans the live actors)."""
+    fused = getattr(pipeline, "_fused", None)
+    if isinstance(fused, FusedTwoInputExecutor):
+        return {
+            "count": 1,
+            "whole_chain": fused.covers_whole_chain,
+            "fragments": [
+                f"{fused.label}[{len(fused.members)}]"
+            ],
+            "pipeline_depth": fused.depth,
+        }
     graph = getattr(pipeline, "graph", None)
     exs = graph.executors if graph is not None else (
         list(getattr(pipeline, "executors", []) or [])

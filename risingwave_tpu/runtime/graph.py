@@ -755,11 +755,23 @@ class GraphRuntime:
             else:
                 fuse = lambda ch, lbl: fuse_epoch_batch(ch)
             if isinstance(built, dict):
+                if fused_enabled():
+                    # the tail is fed by the actor's join: pass it as
+                    # the upstream so a lattice-compatible join-fed MV
+                    # tail fuses (fixed out_cap emission = closed shape
+                    # family) instead of interpreting per chunk
+                    tail = fuse_chain(
+                        built.get("tail", []),
+                        label=f"{s.name}/tail",
+                        upstream=built.get("join"),
+                    )
+                else:
+                    tail = fuse(built.get("tail", []), f"{s.name}/tail")
                 built = dict(
                     built,
                     left=fuse(built.get("left", []), f"{s.name}/left"),
                     right=fuse(built.get("right", []), f"{s.name}/right"),
-                    tail=fuse(built.get("tail", []), f"{s.name}/tail"),
+                    tail=tail,
                 )
             else:
                 built = fuse(built, s.name)

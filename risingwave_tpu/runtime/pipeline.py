@@ -181,17 +181,27 @@ class TwoInputPipeline:
         self.join = join
         self.tail = list(tail)
         self._epoch = 0
+        # whole-pipeline fusion overlay (runtime/fused_step
+        # fuse_two_input): when set, pushes buffer into the wrapper and
+        # the barrier runs ONE donated device program — the member
+        # chains above stay intact as the checkpoint/lint/watermark
+        # surface (the wrapper is an execution strategy, not an owner)
+        self._fused = None
 
     def _through(self, chain, chunks, barrier=None):
         return walk_chain(chain, chunks, barrier)
 
     def push_left(self, chunk: StreamChunk) -> List[StreamChunk]:
+        if self._fused is not None:
+            return self._fused.buffer_left(chunk)
         outs = []
         for c in self._through(self.left, [chunk]):
             outs.extend(_pcall(self.join, "apply", self.join.apply_left, c))
         return self._through(self.tail, outs)
 
     def push_right(self, chunk: StreamChunk) -> List[StreamChunk]:
+        if self._fused is not None:
+            return self._fused.buffer_right(chunk)
         outs = []
         for c in self._through(self.right, [chunk]):
             outs.extend(_pcall(self.join, "apply", self.join.apply_right, c))
@@ -209,22 +219,36 @@ class TwoInputPipeline:
         b = Barrier(Epoch(prev, self._epoch), checkpoint)
         t0 = time.perf_counter()
         with PROFILER.barrier_window():
-            joined: List[StreamChunk] = []
-            for c in self._through(self.left, [], barrier=b):
-                joined.extend(
-                    _pcall(self.join, "apply", self.join.apply_left, c)
+            if self._fused is not None:
+                # ONE donated device program for the whole fragment
+                # barrier; finish defers to the K-boundary under
+                # RW_FUSED_PIPELINE_DEPTH (the wrapper decides)
+                outs = _pcall(
+                    self._fused, "flush", self._fused.on_barrier, b
                 )
-            for c in self._through(self.right, [], barrier=b):
+                outs.extend(self._generated_watermarks())
+                t1 = time.perf_counter()
+                with transfer_guard():
+                    self._fused.finish_barrier()
+            else:
+                joined: List[StreamChunk] = []
+                for c in self._through(self.left, [], barrier=b):
+                    joined.extend(
+                        _pcall(self.join, "apply", self.join.apply_left, c)
+                    )
+                for c in self._through(self.right, [], barrier=b):
+                    joined.extend(
+                        _pcall(self.join, "apply", self.join.apply_right, c)
+                    )
                 joined.extend(
-                    _pcall(self.join, "apply", self.join.apply_right, c)
+                    _pcall(self.join, "flush", self.join.on_barrier, b)
                 )
-            joined.extend(_pcall(self.join, "flush", self.join.on_barrier, b))
-            outs = self._through(self.tail, joined, barrier=b)
-            outs.extend(self._generated_watermarks())
-            t1 = time.perf_counter()
-            with transfer_guard():
-                for ex in self.executors:
-                    ex.finish_barrier()
+                outs = self._through(self.tail, joined, barrier=b)
+                outs.extend(self._generated_watermarks())
+                t1 = time.perf_counter()
+                with transfer_guard():
+                    for ex in self.executors:
+                        ex.finish_barrier()
         from risingwave_tpu.epoch_trace import record_stage
 
         t2 = time.perf_counter()
@@ -274,6 +298,13 @@ class TwoInputPipeline:
         watermark (min over both inputs) once both sides advanced —
         which then walks the tail chain (reference: per-input watermark
         alignment on multi-input executors)."""
+        if self._fused is not None:
+            # buffered rows precede the watermark in stream order: the
+            # fused wrapper applies them (data-only program), then the
+            # walk below runs over member state interpreted — state
+            # lives in the members between programs, so interop is
+            # exact (the FusedChainExecutor.on_watermark discipline)
+            self._fused.flush_data()
         outs: List[StreamChunk] = []
         aligned: Optional[Watermark] = None
         for side_chain, feed in (

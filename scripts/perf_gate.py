@@ -829,13 +829,200 @@ def _smoke_leg(budgets: dict, fused: bool, epochs: int, events: int):
     return violations, report
 
 
+def _two_input_leg(budgets: dict, query: str, epochs: int = 3):
+    """One fused two-input steady-state leg (q7 or q8): the whole
+    side-chains x join x MV barrier must cost at most
+    ``two_input_dispatches_per_barrier_max`` device dispatches (the
+    de-fusion tripwire: a silently-interpreted q7 costs ~31), with the
+    ``fused:`` attribution present and the pipeline actually carrying
+    the whole-fusion wrapper."""
+    from risingwave_tpu.connectors.nexmark import (
+        NexmarkConfig,
+        NexmarkGenerator,
+    )
+    from risingwave_tpu.profiler import PROFILER
+    from risingwave_tpu.queries.nexmark_q import build_q7, build_q8
+    from risingwave_tpu.runtime.fused_step import fuse_pipeline
+
+    sb = budgets.get("smoke", {})
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=10_000))
+    if query == "q7":
+        q = build_q7(
+            capacity=1 << 13,
+            agg_capacity=1 << 11,
+            filter_capacity=1 << 11,
+            out_cap=1 << 11,
+        )
+
+        def epoch(measure):
+            bid = None
+            while bid is None:
+                bid = gen.next_chunks(1000, 1024)["bid"]
+            bid = bid.select(["auction", "bidder", "price", "date_time"])
+            q.pipeline.push_left(bid)
+            q.pipeline.push_right(bid)
+            mx = int(bid.to_numpy()["date_time"].max())
+            if measure is not None:
+                base = PROFILER.total_dispatches()
+                q.pipeline.barrier()
+                measure.append(PROFILER.total_dispatches() - base)
+            else:
+                q.pipeline.barrier()
+            q.pipeline.watermark("date_time", mx)
+    else:
+        q = build_q8(capacity=1 << 12, out_cap=1 << 11)
+
+        def epoch(measure):
+            ev = gen.next_chunks(2000, 4096)
+            p, a = ev["person"], ev["auction"]
+            if p is not None:
+                q.pipeline.push_left(
+                    p.select(["id", "name", "date_time"])
+                )
+            if a is not None:
+                q.pipeline.push_right(a.select(["seller", "date_time"]))
+            if measure is not None:
+                base = PROFILER.total_dispatches()
+                q.pipeline.barrier()
+                measure.append(PROFILER.total_dispatches() - base)
+            else:
+                q.pipeline.barrier()
+
+    wrappers = fuse_pipeline(q.pipeline, label=query)
+    violations, report = [], {}
+    fused_whole = (
+        getattr(q.pipeline, "_fused", None) is not None
+        and len(wrappers) == 1
+        and wrappers[0].covers_whole_chain
+    )
+    report[f"{query}_fused_whole_chain"] = fused_whole
+    if not fused_whole:
+        violations.append(
+            f"{query}: two-input pipeline did not fuse whole "
+            "(silent de-fusion — see fusion_refusals())"
+        )
+        return violations, report
+    for _ in range(4):
+        epoch(None)  # warm: compiles + growth transitions
+    PROFILER.reset()
+    PROFILER.enable(fence=False)
+    try:
+        per = []
+        for _ in range(epochs):
+            epoch(per)
+        fused_labels = [
+            k
+            for k in PROFILER.dispatch_counts()
+            if k.startswith("fused:")
+        ]
+    finally:
+        PROFILER.disable()
+        PROFILER.reset()
+    report[f"{query}_dispatches_per_barrier"] = per
+    mx = sb.get("two_input_dispatches_per_barrier_max")
+    if mx is not None and per and max(per) > mx:
+        violations.append(
+            f"{query}: {max(per)} device dispatches/barrier > budget "
+            f"{mx} (two-input de-fusion regression)"
+        )
+    if not fused_labels:
+        violations.append(
+            f"{query}: no fused:<fragment> dispatch attribution — the "
+            "two-input program never ran"
+        )
+    return violations, report
+
+
+def _pipelining_leg(budgets: dict):
+    """K-barrier pipelining microbench (q8, K=1 vs K=2): mid-window
+    barriers must defer the blocking staged-scalar read, so their
+    host barrier-call latency sits WELL below the K=1 per-barrier
+    latency (``k_midwindow_barrier_p50_frac_max``); the full host
+    ms/row of both modes is reported for the PROFILE ledger."""
+    import time
+
+    import numpy as np
+
+    from risingwave_tpu.connectors.nexmark import (
+        NexmarkConfig,
+        NexmarkGenerator,
+    )
+    from risingwave_tpu.queries.nexmark_q import build_q8
+    from risingwave_tpu.runtime.fused_step import fuse_pipeline
+
+    sb = budgets.get("smoke", {})
+
+    def run(depth, nb=16, warm=8):
+        gen = NexmarkGenerator(NexmarkConfig(first_event_rate=10_000))
+        q8 = build_q8(capacity=1 << 12, out_cap=1 << 11)
+        (w,) = fuse_pipeline(
+            q8.pipeline, label="q8", pipeline_depth=depth
+        )
+        lat = []
+        rows = 0
+
+        def epoch(measure):
+            nonlocal rows
+            ev = gen.next_chunks(2000, 4096)
+            p, a = ev["person"], ev["auction"]
+            if p is not None:
+                c = p.select(["id", "name", "date_time"])
+                q8.pipeline.push_left(c)
+                if measure:
+                    rows += int(c.to_numpy()["id"].shape[0])
+            if a is not None:
+                c = a.select(["seller", "date_time"])
+                q8.pipeline.push_right(c)
+                if measure:
+                    rows += int(c.to_numpy()["seller"].shape[0])
+            t0 = time.perf_counter()
+            q8.pipeline.barrier()
+            if measure:
+                lat.append((time.perf_counter() - t0) * 1e3)
+
+        for _ in range(warm):
+            epoch(False)
+        w.finish_barrier(force=True)
+        t0 = time.perf_counter()
+        for _ in range(nb - warm):
+            epoch(True)
+        w.finish_barrier(force=True)
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        return lat, wall_ms / max(rows, 1), w.depth
+
+    lat1, row_ms1, _ = run(1)
+    lat2, row_ms2, depth = run(2)
+    # non-boundary barriers only: under K=2 every other barrier defers
+    midwindow = lat2[0::2]
+    p50_k1 = float(np.percentile(lat1, 50))
+    p50_mid = float(np.percentile(midwindow, 50))
+    report = {
+        "pipelining_depth": depth,
+        "k1_barrier_p50_ms": round(p50_k1, 3),
+        "k2_midwindow_barrier_p50_ms": round(p50_mid, 3),
+        "k1_host_ms_per_row": round(row_ms1, 6),
+        "k2_host_ms_per_row": round(row_ms2, 6),
+    }
+    violations = []
+    frac = sb.get("k_midwindow_barrier_p50_frac_max")
+    if frac is not None and p50_k1 > 0 and p50_mid > p50_k1 * frac:
+        violations.append(
+            f"pipelining: K=2 mid-window barrier p50 {p50_mid:.2f}ms "
+            f"not below {frac} x K=1 p50 {p50_k1:.2f}ms — the deferred "
+            "finish stopped deferring"
+        )
+    return violations, report
+
+
 def run_smoke(budgets: dict, epochs: int = 4, events: int = 2_000):
-    """q5 steady state with the profiler armed, TWO legs: the
+    """Steady state with the profiler armed, FOUR legs: the q5
     interpreted per-executor walk (bounded device dispatches per
-    barrier + host-python ms per row) and the fused per-barrier step
-    (runtime/fused_step — bounded at its own, tighter budget, plus a
-    de-fusion tripwire: the chain must actually fuse whole and the
-    ``fused:`` dispatch attribution must appear). Returns
+    barrier + host-python ms per row), the q5 fused per-barrier step
+    (tighter budget + de-fusion tripwire), the fused TWO-INPUT legs
+    (q7/q8: whole side-chains x join x MV barriers at <=
+    ``two_input_dispatches_per_barrier_max`` dispatches — q7 costs ~31
+    interpreted), and the K-barrier pipelining microbench (mid-window
+    barriers must actually defer the blocking read). Returns
     (violations, report dict)."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     if ROOT not in sys.path:  # runnable as a script from anywhere
@@ -847,6 +1034,13 @@ def run_smoke(budgets: dict, epochs: int = 4, events: int = 2_000):
     v2, r2 = _smoke_leg(budgets, True, epochs, events)
     violations += v2
     report.update(r2)
+    for q in ("q7", "q8"):
+        v3, r3 = _two_input_leg(budgets, q)
+        violations += v3
+        report.update(r3)
+    v4, r4 = _pipelining_leg(budgets)
+    violations += v4
+    report.update(r4)
     return violations, report
 
 

@@ -28,10 +28,12 @@ from risingwave_tpu.queries.nexmark_q import (
 )
 from risingwave_tpu.runtime.fused_step import (
     FusedChainExecutor,
+    FusedTwoInputExecutor,
     expand_fused,
     fuse_chain,
     fuse_pipeline,
     fused_fragments,
+    fusion_refusals,
 )
 
 Q5_SQL = (
@@ -79,23 +81,20 @@ def test_q5_fused_bit_identical_to_interpreted_twin(watermarks):
     assert len(interp[-1]) > 0
 
 
-def _drive_q7(q7, *, fuse, epochs=4):
+def _drive_q7(q7, *, fuse, epochs=4, depth=None):
     if fuse:
-        from risingwave_tpu.executors.epoch_batch import (
-            EpochBatchedAggExecutor,
+        wrappers = fuse_pipeline(
+            q7.pipeline, label="q7", pipeline_depth=depth
         )
-
-        wrappers = fuse_pipeline(q7.pipeline, label="q7")
-        # nothing on q7 forms the agg->MV shape: the hop->maxagg side
-        # feeds the INTERPRETED join so it epoch-batches (the fused
-        # flush would hand the join bound-padded chunks), and the
-        # join-fed MV tail stays interpreted (stacking a join's
-        # heterogeneous emissions would compile-storm) — fusion armed
-        # must still be bit-identical through all the fallbacks
-        assert wrappers == []
-        assert any(
-            isinstance(e, EpochBatchedAggExecutor) for e in q7.pipeline.right
-        )
+        # the WHOLE two-input pipeline fuses: hop -> maxagg ->
+        # [bucket-masked flush] -> DynamicMaxFilter x HashJoin -> MV
+        # is ONE donated program per barrier (PR 13); the old
+        # epoch-batch + interpreted-join fallback is now the
+        # RW_FUSED_TWO_INPUT=0 twin
+        assert len(wrappers) == 1
+        assert isinstance(wrappers[0], FusedTwoInputExecutor)
+        assert q7.pipeline._fused is wrappers[0]
+        assert wrappers[0].covers_whole_chain
     gen = NexmarkGenerator(NexmarkConfig(first_event_rate=10_000))
     snaps, mx = [], 0
     for _ in range(epochs):
@@ -126,10 +125,15 @@ def test_q7_fused_bit_identical_to_interpreted_twin():
         assert a == b, f"epoch {e}: fused q7 MV diverged"
 
 
-def _drive_q8(q8, *, fuse, epochs=4):
+def _drive_q8(q8, *, fuse, epochs=4, depth=None):
     if fuse:
-        wrappers = fuse_pipeline(q8.pipeline, label="q8")
-        assert wrappers == []  # dedup/join/mv-tail: all interpreted
+        wrappers = fuse_pipeline(
+            q8.pipeline, label="q8", pipeline_depth=depth
+        )
+        # dedup x join -> MV: one donated two-input program per barrier
+        assert len(wrappers) == 1
+        assert isinstance(wrappers[0], FusedTwoInputExecutor)
+        assert wrappers[0].covers_whole_chain
     gen = NexmarkGenerator(NexmarkConfig(first_event_rate=10_000))
     snaps = []
     for _ in range(epochs):
@@ -498,3 +502,387 @@ def test_actor_kill_recovery_with_fusion_armed():
         assert q5.mview.snapshot() == twin.mview.snapshot()
     finally:
         gp.close()
+
+
+# ---------------------------------------------------------------------------
+# two-input fusion (PR 13): q7/q8 whole-pipeline programs, masked-lane
+# padding proofs, K-barrier pipelining, recovery, refusal provenance
+# ---------------------------------------------------------------------------
+
+import numpy as np
+
+from risingwave_tpu.array.chunk import StreamChunk
+
+
+def test_two_input_fallback_twin_bit_identical(monkeypatch):
+    """RW_FUSED_TWO_INPUT=0: the pre-PR-13 per-chain fallback
+    (epoch-batched agg side, interpreted join) armed on q7 must stay
+    bit-identical — and the join-fed MV tail now FUSES under the
+    lattice-compatibility rule (the old hard carve-out is gone: the
+    join's fixed out_cap emission is a closed shape family)."""
+    from risingwave_tpu.executors.epoch_batch import (
+        EpochBatchedAggExecutor,
+    )
+
+    mk = lambda: build_q7(
+        capacity=1 << 13,
+        agg_capacity=1 << 11,
+        filter_capacity=1 << 11,
+        out_cap=1 << 11,
+    )
+    interp = _drive_q7(mk(), fuse=False)
+    monkeypatch.setenv("RW_FUSED_TWO_INPUT", "0")
+    q7 = mk()
+    wrappers = fuse_pipeline(q7.pipeline, label="q7")
+    assert q7.pipeline._fused is None
+    assert any(
+        isinstance(e, EpochBatchedAggExecutor) for e in q7.pipeline.right
+    )
+    # the satellite bugfix: the MV tail fed by the (fixed-emission)
+    # join fuses instead of staying interpreted
+    assert len(wrappers) == 1 and isinstance(
+        wrappers[0], FusedChainExecutor
+    )
+    assert wrappers[0].members == [q7.mview]
+    monkeypatch.delenv("RW_FUSED_TWO_INPUT")
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=10_000))
+    snaps, mx = [], 0
+    for _ in range(4):
+        for _ in range(2):
+            bid = gen.next_chunks(1200, 2048)["bid"]
+            if bid is None:
+                continue
+            bid = bid.select(["auction", "bidder", "price", "date_time"])
+            q7.pipeline.push_left(bid)
+            q7.pipeline.push_right(bid)
+            mx = max(mx, int(bid.to_numpy()["date_time"].max()))
+        q7.pipeline.barrier()
+        q7.pipeline.watermark("date_time", mx)
+        snaps.append(q7.mview.snapshot())
+    for e, (a, b) in enumerate(zip(interp, snaps)):
+        assert a == b, f"epoch {e}: fallback q7 MV diverged"
+
+
+def test_two_input_refusal_records_provenance():
+    """An unbucketed join (the RW-E803 wedge twin) must be REFUSED
+    with RW-E807 provenance — never a silent interpret fallback."""
+    fusion_refusals(clear=True)
+    q7 = build_q7(capacity=1 << 10, bucketed=False)
+    fuse_pipeline(q7.pipeline, label="q7twin")
+    assert q7.pipeline._fused is None
+    recs = fusion_refusals()
+    assert any(
+        r["code"] == "RW-E807"
+        and r["fragment"] == "q7twin"
+        and "lattice" in r["message"]
+        for r in recs
+    ), recs
+
+
+def test_masked_lane_padding_inert():
+    """The join's probe/build kernels must treat padded (invalid)
+    lanes as provably inert: the same logical rows arriving at an
+    exact-full 2^k capacity and padded into the one-over 2^(k+1)
+    bucket produce identical emissions and identical downstream MVs —
+    the proof that lattice-padded flush lanes cost one masked device
+    op, not wrong answers (the pre-bucketing '80x slower exact-slice'
+    contract is retired)."""
+    from risingwave_tpu.executors.hash_join import HashJoinExecutor
+    import jax.numpy as jnp
+
+    def mk_join():
+        return HashJoinExecutor(
+            left_keys=("w", "p"),
+            right_keys=("mw", "mp"),
+            left_dtypes={"w": jnp.int64, "p": jnp.int64, "b": jnp.int64},
+            right_dtypes={"mw": jnp.int64, "mp": jnp.int64},
+            capacity=1 << 8,
+            fanout=4,
+            out_cap=1 << 6,
+        )
+
+    k = 3  # 2^3 = 8 rows
+    n = 1 << k
+    left = {
+        "w": np.arange(n, dtype=np.int64),
+        "p": np.full(n, 7, np.int64),
+        "b": np.arange(n, dtype=np.int64) + 100,
+    }
+    right = {
+        "mw": np.arange(n, dtype=np.int64),
+        "mp": np.full(n, 7, np.int64),
+    }
+
+    def rows_of(chunks):
+        out = []
+        for c in chunks:
+            d = c.to_numpy(with_ops=True)
+            sel = np.flatnonzero(np.asarray(c.valid))
+            out.extend(
+                tuple(int(d[nm][i]) for nm in sorted(d))
+                for i in sel
+            )
+        return sorted(out)
+
+    emitted = {}
+    for cap in (n, 2 * n):  # exact-full 2^k vs one-over bucket 2^k+1
+        j = mk_join()
+        j.apply_left(StreamChunk.from_numpy(left, n))
+        outs = j.apply_right(StreamChunk.from_numpy(right, cap))
+        j.on_barrier(None)
+        emitted[cap] = rows_of(outs)
+    assert emitted[n] == emitted[2 * n]
+    assert len(emitted[n]) == n  # every pair matched exactly once
+
+
+def test_two_input_flush_rounds_exact_and_one_over():
+    """Fused q7 flush lanes: an epoch with dirty groups exactly filling
+    one flush round (2^k) and one with a single group over (2^k + 1,
+    padded into a second, mostly-masked round) must both be
+    bit-identical to the interpreted twin — masked trailing rounds are
+    no-ops, never data."""
+
+    def bid_chunk(rows, cap=64):
+        cols = {
+            "auction": np.array([r[0] for r in rows], np.int64),
+            "bidder": np.array([r[1] for r in rows], np.int64),
+            "price": np.array([r[2] for r in rows], np.int64),
+            "date_time": np.array([r[3] for r in rows], np.int64),
+        }
+        return StreamChunk.from_numpy(cols, cap)
+
+    def drive(fuse, n_windows):
+        q7 = build_q7(capacity=1 << 10, fanout=8, out_cap=1 << 10)
+        q7.agg.out_cap = 8  # flush drains 8 dirty groups per round
+        if fuse:
+            (w,) = fuse_pipeline(q7.pipeline, label="q7small")
+            assert w.plan.right.agg.out_cap == 8
+        snaps = []
+        for epoch in range(2):
+            rows = [
+                (w_, 10 + w_, 100 + w_ + epoch, w_ * 10_000 + 5)
+                for w_ in range(n_windows)
+            ]
+            c = bid_chunk(rows)
+            q7.pipeline.push_left(c)
+            q7.pipeline.push_right(c)
+            q7.pipeline.barrier()
+            snaps.append(q7.mview.snapshot())
+        return snaps
+
+    for n_windows in (8, 9):  # 2^k exact-full, 2^k + 1 one-over
+        a = drive(False, n_windows)
+        b = drive(True, n_windows)
+        assert a == b, f"{n_windows} windows: fused flush diverged"
+        assert len(a[-1]) == n_windows
+
+
+def test_fused_two_input_one_dispatch_per_barrier():
+    """Steady state: the whole q8 barrier — dedup x join x MV — is ONE
+    device dispatch, attributed ``fused:<fragment>`` (q7's twin check
+    lives in perf_gate --smoke; 31 -> 1 on this image)."""
+    q8 = build_q8(capacity=1 << 12, out_cap=1 << 11)
+    fuse_pipeline(q8.pipeline, label="q8")
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=10_000))
+
+    def epoch():
+        ev = gen.next_chunks(2000, 4096)
+        p, a = ev["person"], ev["auction"]
+        if p is not None:
+            q8.pipeline.push_left(p.select(["id", "name", "date_time"]))
+        if a is not None:
+            q8.pipeline.push_right(a.select(["seller", "date_time"]))
+        q8.pipeline.barrier()
+
+    for _ in range(4):
+        epoch()  # warm: compiles + growth transitions
+    PROFILER.reset()
+    PROFILER.enable(fence=False)
+    try:
+        per = []
+        for _ in range(3):
+            base = PROFILER.total_dispatches()
+            epoch()
+            per.append(PROFILER.total_dispatches() - base)
+        counts = PROFILER.dispatch_counts()
+    finally:
+        PROFILER.disable()
+        PROFILER.reset()
+    assert per == [1.0, 1.0, 1.0], per
+    assert counts.get("fused:q8", 0) >= 3, counts
+
+
+def test_two_input_donation_census_flat():
+    """Donation contract: steady fused two-input barriers must not
+    leak device buffers (donated state consumed, returned state
+    replaces it)."""
+    q8 = build_q8(capacity=1 << 10, out_cap=1 << 9)
+    fuse_pipeline(q8.pipeline, label="q8")
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=10_000))
+
+    def epoch():
+        ev = gen.next_chunks(800, 1024)
+        p, a = ev["person"], ev["auction"]
+        if p is not None:
+            q8.pipeline.push_left(p.select(["id", "name", "date_time"]))
+        if a is not None:
+            q8.pipeline.push_right(a.select(["seller", "date_time"]))
+        q8.pipeline.barrier()
+
+    for _ in range(4):
+        epoch()
+    counts = []
+    for _ in range(4):
+        epoch()
+        counts.append(len(jax.live_arrays()))
+    assert max(counts) - min(counts) <= 4, (
+        f"live device arrays grew across fused two-input barriers: "
+        f"{counts}"
+    )
+
+
+def _feed_q8(q8, gen, n):
+    for _ in range(n):
+        chunks = gen.next_chunks(2000, 2048)
+        if chunks["person"] is not None:
+            q8.pipeline.push_left(
+                chunks["person"].select(["id", "name", "date_time"])
+            )
+        if chunks["auction"] is not None:
+            q8.pipeline.push_right(
+                chunks["auction"].select(["seller", "date_time"])
+            )
+        q8.pipeline.barrier()
+
+
+def test_two_input_recovery_refuses():
+    """Kill-and-recover with the two-input program armed: members stay
+    the system of record, so checkpoint/restore work unchanged and a
+    FRESH build re-fuses into the same compiled program (value-hashable
+    plan statics)."""
+    from risingwave_tpu.connectors.nexmark import NexmarkGenerator
+    from risingwave_tpu.storage import CheckpointManager, MemObjectStore
+
+    store = MemObjectStore()
+    mgr = CheckpointManager(store)
+    dicts = NexmarkGenerator.make_dictionaries()
+    gen = NexmarkGenerator(NexmarkConfig(), dictionaries=dicts)
+
+    mk = lambda: build_q8(capacity=1 << 12, fanout=8, out_cap=1 << 14)
+    q8 = mk()
+    fuse_pipeline(q8.pipeline, label="q8")
+    for _ in range(3):
+        _feed_q8(q8, gen, 1)
+        mgr.commit_epoch(q8.pipeline.epoch, q8.pipeline.executors)
+    snap = q8.mview.snapshot()
+    assert len(snap) > 20
+
+    q8b = mk()
+    CheckpointManager(store).recover(q8b.pipeline.executors)
+    wrappers = fuse_pipeline(q8b.pipeline, label="q8")
+    assert len(wrappers) == 1  # restored members re-fuse
+    assert q8b.mview.snapshot() == snap
+
+    gen_b = NexmarkGenerator(NexmarkConfig(), dictionaries=dicts)
+    for _ in range(3):
+        gen_b.next_chunks(2000, 2048)
+    _feed_q8(q8, gen, 2)
+    _feed_q8(q8b, gen_b, 2)
+    assert q8b.mview.snapshot() == q8.mview.snapshot()
+
+
+@pytest.mark.parametrize("depth", [2, 4])
+def test_pipeline_depth_twins_and_checkpoint_boundary(depth):
+    """K-barrier pipelining: K in {1, K} produce bit-identical MVs at
+    EVERY barrier; mid-window barriers defer the blocking scalar read
+    (the host leaves the steady state), the K-boundary drains; and a
+    checkpoint taken at the K-boundary recovers exactly."""
+    from risingwave_tpu.connectors.nexmark import NexmarkGenerator
+    from risingwave_tpu.storage import CheckpointManager, MemObjectStore
+
+    dicts = NexmarkGenerator.make_dictionaries()
+
+    def drive(d, nb=8):
+        gen = NexmarkGenerator(
+            NexmarkConfig(first_event_rate=10_000), dictionaries=dicts
+        )
+        q8 = build_q8(capacity=1 << 12, out_cap=1 << 11)
+        (w,) = fuse_pipeline(
+            q8.pipeline, label="q8", pipeline_depth=d
+        )
+        assert w.depth == d
+        snaps = []
+        for i in range(nb):
+            _feed_q8(q8, gen, 1)
+            # mid-window barriers hold their staged pack (no blocking
+            # read); the K-boundary drains them all
+            expect = 0 if (i + 1) % d == 0 else (i + 1) % d
+            assert len(w._pending) == expect, (i, d, len(w._pending))
+            snaps.append(q8.mview.snapshot())
+        return q8, w, snaps
+
+    _q1, _w1, s1 = drive(1)
+    q8k, wk, sk = drive(depth)
+    for e in range(8):
+        assert s1[e] == sk[e], f"K={depth} diverged at barrier {e}"
+
+    # checkpoint at the K-boundary (pending drained), then recover
+    store = MemObjectStore()
+    mgr = CheckpointManager(store)
+    assert wk._pending == []  # 8 % depth == 0: boundary just drained
+    mgr.commit_epoch(q8k.pipeline.epoch, q8k.pipeline.executors)
+    q8r = build_q8(capacity=1 << 12, out_cap=1 << 11)
+    CheckpointManager(store).recover(q8r.pipeline.executors)
+    assert q8r.mview.snapshot() == sk[-1]
+
+
+def test_two_input_governor_pin_holds_shapes_steady():
+    """After a governor pin on every two-input member, steady fused
+    barriers mint ZERO new compiled programs."""
+    from risingwave_tpu.analysis.jax_sanitizer import RecompileWatch
+
+    q8 = build_q8(capacity=1 << 10, out_cap=1 << 9)
+    fuse_pipeline(q8.pipeline, label="q8")
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=10_000))
+
+    def epoch():
+        ev = gen.next_chunks(800, 1024)
+        p, a = ev["person"], ev["auction"]
+        if p is not None:
+            q8.pipeline.push_left(p.select(["id", "name", "date_time"]))
+        if a is not None:
+            q8.pipeline.push_right(a.select(["seller", "date_time"]))
+        q8.pipeline.barrier()
+
+    epoch()
+    epoch()
+    for ex in expand_fused([q8.pipeline._fused]):
+        pin = getattr(ex, "pin_max_bucket", None)
+        if pin is not None:
+            pin()
+    watch = RecompileWatch()
+    watch.snapshot()
+    for _ in range(3):
+        epoch()
+    assert watch.deltas() == {}, watch.deltas()
+
+
+def test_two_input_overflow_latch_raises_at_finish():
+    """A poisoned member latch must surface at finish_barrier through
+    the packed scalar lane — same raise point as interpreted."""
+    import jax.numpy as jnp
+
+    q8 = build_q8(capacity=1 << 10, out_cap=1 << 9)
+    (w,) = fuse_pipeline(q8.pipeline, label="q8")
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=10_000))
+    ev = gen.next_chunks(800, 1024)
+    q8.pipeline.push_left(ev["person"].select(["id", "name", "date_time"]))
+    q8.pipeline.barrier()
+    dedup = q8.pipeline.left[1]
+    dedup._dropped = jnp.ones((), jnp.bool_)
+    with pytest.raises(RuntimeError, match="dedup table overflowed"):
+        q8.pipeline.push_left(
+            ev["person"].select(["id", "name", "date_time"])
+        )
+        q8.pipeline.barrier()
+    assert w.l_stateful is dedup  # members stayed the system of record
