@@ -228,6 +228,38 @@ def _fused_fields(prefix, pipeline):
     return {f"{prefix}_fused_fragments": fused_fragments(pipeline)}
 
 
+def _freshness_fields(prefix, pipeline):
+    """Every BENCH JSON carries ``{q}_freshness``: p50/p99/n per lane
+    (commit->visible, source->visible, event-time lag) summarized from
+    the pipeline's own per-barrier FreshnessSurface samples — the
+    artifact records how fresh the MV actually was while the bench ran,
+    and perf_gate holds the commit->visible p99 to the SLO budget
+    (``bench_commit_to_visible_p99_ms_max``)."""
+    samples = list(getattr(pipeline, "freshness_samples", ()) or ())
+    out = {}
+    for lane in (
+        "commit_to_visible_ms",
+        "source_to_visible_ms",
+        "event_time_lag_ms",
+    ):
+        vals = sorted(
+            s[lane]
+            for s in samples
+            if isinstance(s.get(lane), (int, float))
+        )
+        if vals:
+            out[lane] = {
+                "n": len(vals),
+                "p50": round(vals[len(vals) // 2], 3),
+                "p99": round(
+                    vals[min(len(vals) - 1, int(0.99 * len(vals)))], 3
+                ),
+            }
+        else:
+            out[lane] = {"n": 0}
+    return {f"{prefix}_freshness": out}
+
+
 def _expand(executors):
     """Fused wrappers hide their members from plain executor lists;
     padding/governor surfaces need the members themselves."""
@@ -513,6 +545,7 @@ def bench_q8(gen_cfg, epochs, events_per_epoch, chunk_events):
         "q8_barrier_stage_ms": stage_breakdown(),
         **_profile_fields("q8", prof, len(barrier_times), total_rows),
         **_fused_fields("q8", q8.pipeline),
+        **_freshness_fields("q8", q8.pipeline),
         **_roofline_fields("q8", len(barrier_times), dt),
         **_shape_fields(
             "q8",
@@ -677,6 +710,7 @@ def bench_q7(gen_cfg, epochs, events_per_epoch, chunk_events):
         "q7_barrier_stage_ms": stage_breakdown(),
         **_profile_fields("q7", prof, len(barrier_times), total_bids),
         **_fused_fields("q7", q7.pipeline),
+        **_freshness_fields("q7", q7.pipeline),
         **_roofline_fields("q7", len(barrier_times), dt),
         # AFTER profiler disarm: padding stats read device occupancy
         # counters and must not pollute the steady-state transfer counts
@@ -797,6 +831,7 @@ def bench_q5_unified(epochs, events_per_epoch, chunk_events, smoke):
     # before close(): fused evidence scans live actors, padding stats
     # read live executor occupancy
     fused_fields = _fused_fields("q5u", mv.pipeline)
+    fresh_fields = _freshness_fields("q5u", mv.pipeline)
     shape_fields = _shape_fields("q5u", _expand(list(mv.pipeline.executors)))
     roofline_fields = _roofline_fields("q5u", len(barrier_times), dt)
     snap = mv.mview.snapshot()  # {(auction, window_start): (num,)}
@@ -861,6 +896,7 @@ def bench_q5_unified(epochs, events_per_epoch, chunk_events, smoke):
         "hbm_bytes_touched": rf["hbm_bytes_touched"],
         **prof_fields,
         **fused_fields,
+        **fresh_fields,
         **shape_fields,
         **roofline_fields,
     }
@@ -1023,6 +1059,7 @@ def bench_q5(args_epochs, events_per_epoch, chunk_events, smoke, agg_mode):
         "q5_fusion": fusion,
         **_profile_fields("q5", prof, len(barrier_times), total_bids),
         **_fused_fields("q5", q5.pipeline),
+        **_freshness_fields("q5", q5.pipeline),
         **_shape_fields("q5", _expand(list(q5.pipeline.executors))),
         **_roofline_fields("q5", len(barrier_times), dt),
     }

@@ -329,6 +329,34 @@ class FlightRecorder:
                     continue
             if dep:
                 rec["dep"] = dep
+        # freshness deltas as published (ISSUE 16): per-MV
+        # commit->visible / source->visible / event-time-lag, compacted
+        # to cv/sv/lag; plus the barrier's backpressure verdict
+        fr = getattr(trace, "freshness", None)
+        if fr:
+            compact = {}
+            for mv, ent in fr.items():
+                row = {}
+                for key, short in (
+                    ("commit_to_visible_ms", "cv"),
+                    ("source_to_visible_ms", "sv"),
+                    ("event_time_lag_ms", "lag"),
+                ):
+                    v = ent.get(key)
+                    if v is not None:
+                        row[short] = round(float(v), 3)
+                if row:
+                    compact[mv] = row
+            if compact:
+                rec["fr"] = compact
+        bpf = getattr(trace, "backpressure_fragment", None)
+        if bpf:
+            rec["bp"] = {
+                "f": bpf,
+                "ms": round(
+                    float(getattr(trace, "backpressure_ms", 0.0)), 3
+                ),
+            }
         sen = SENTINEL
         if sen.running or sen.state != UNKNOWN:
             rec["sen"] = sen.state
@@ -1005,6 +1033,10 @@ def read_segment(path: str, last: Optional[int] = None) -> Dict:
         }
         if "dep" in rec:
             out["channel_depths"] = rec["dep"]
+        if "fr" in rec:
+            out["freshness"] = rec["fr"]
+        if "bp" in rec:
+            out["backpressure"] = rec["bp"]
         if "mem" in rec:
             out["memory_stats"] = rec["mem"]
         if "mb" in rec:

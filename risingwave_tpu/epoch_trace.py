@@ -134,9 +134,23 @@ class EpochTrace:
     achieved_bw_frac: float = 0.0
     useful_bw_frac: float = 0.0
     committed_at: Optional[float] = None
+    # freshness + backpressure (ISSUE 16): wall clock when the barrier
+    # opened (the commit->visible anchor), per-MV freshness deltas as
+    # published, per-fragment dispatch walls, and the barrier's
+    # bottleneck verdict — all host-side, stamped by runtime._end_trace
+    barrier_open_wall: Optional[float] = None
+    fragment_ms: Dict[str, float] = field(default_factory=dict)
+    freshness: Dict = field(default_factory=dict)
+    backpressure_fragment: Optional[str] = None
+    backpressure_ms: float = 0.0
+    backpressure: Dict = field(default_factory=dict)
 
     def add_stage(self, stage: str, ms: float, fragment: str = "-") -> None:
         self.stages_ms[stage] = self.stages_ms.get(stage, 0.0) + ms
+        if fragment != "-":
+            self.fragment_ms[fragment] = (
+                self.fragment_ms.get(fragment, 0.0) + ms
+            )
         record_stage(stage, ms, fragment)
 
     def finalize(
@@ -222,6 +236,12 @@ class EpochTrace:
             "achieved_bw_gbps": self.achieved_bw_gbps,
             "achieved_bw_frac": self.achieved_bw_frac,
             "useful_bw_frac": self.useful_bw_frac,
+            "fragment_ms": {
+                k: round(v, 3) for k, v in self.fragment_ms.items()
+            },
+            "freshness": self.freshness,
+            "backpressure_fragment": self.backpressure_fragment,
+            "backpressure_ms": round(self.backpressure_ms, 3),
         }
 
 
@@ -272,6 +292,19 @@ def dump_stalls(
         doc["recent_events"] = EVENT_LOG.events(limit=20)
         if extra:
             doc["extra"] = extra
+        # freshness state + last bottleneck verdict: a stall dump says
+        # how STALE every MV already is and which fragment was the
+        # bottleneck on the barriers leading in
+        from risingwave_tpu.freshness import FRESHNESS
+
+        doc["freshness"] = FRESHNESS.snapshot()
+        tr = getattr(runtime, "last_epoch_trace", None)
+        if tr is not None and getattr(tr, "backpressure_fragment", None):
+            doc["backpressure"] = {
+                "fragment": tr.backpressure_fragment,
+                "ms": round(tr.backpressure_ms, 3),
+                "detail": tr.backpressure,
+            }
     except Exception as e:  # partial dump beats no dump
         doc["snapshot_error"] = repr(e)
     try:

@@ -158,6 +158,13 @@ class SqlSession:
         # runtime's _subs edges never carry the attached name — this
         # map keeps the DROP dependency guard honest for it
         self._attached_deps: Dict[str, set] = {}
+        # rw_ system tables (sys_tables.py): the runtime's own state as
+        # read-only relations, served over the SAME lock-free shared-
+        # read path as attached arrangements
+        from risingwave_tpu.frontend.sys_tables import install_sys_tables
+
+        with self._registry_guard:
+            install_sys_tables(self)
         self.meta = None
         if getattr(self.runtime, "mgr", None) is not None:
             # durable meta: DDL log + dictionary snapshots ride the
@@ -309,15 +316,25 @@ class SqlSession:
         if stripped[:7].lower() != "select ":
             return None
         reg = getattr(self.runtime, "arrangements", None)
-        if reg is None or not reg._facades:
-            return None
         # cheap eligibility probe BEFORE the speculative parse: reads
         # over non-served relations must not pay a double parse+
-        # typecheck on the hot path (the locked path parses again)
+        # typecheck on the hot path (the locked path parses again).
+        # Served names: shared-arrangement subscribers AND rw_ system
+        # tables (sys_tables.py — introspection snapshots are immutable
+        # per call, so they need the runtime lock even less)
         import re as _re
 
         m = _re.search(r"(?is)\bfrom\s+([A-Za-z_]\w*)", stripped)
-        if m is None or not reg.serves(m.group(1)):
+        if m is None:
+            return None
+        name = m.group(1)
+
+        def _served(n: str) -> bool:
+            if n.startswith("rw_") and n in self.batch.tables:
+                return True
+            return reg is not None and reg._facades and reg.serves(n)
+
+        if not _served(name):
             return None
         try:
             stmt = P.parse(sql)
@@ -325,7 +342,7 @@ class SqlSession:
                 stmt.from_, P.TableRef
             ):
                 return None
-            if not reg.serves(stmt.from_.name):
+            if not _served(stmt.from_.name):
                 return None
             from risingwave_tpu.sql.typing import typecheck_select
 
@@ -1344,6 +1361,9 @@ class SqlSession:
         if not m:
             raise SyntaxError("DROP MATERIALIZED VIEW|TABLE|SOURCE <name>")
         kword, name = m.group(1).lower(), m.group(2)
+        if name.startswith("rw_"):
+            # system tables (sys_tables.py) are read-only and reserved
+            raise ValueError(f"cannot drop system table {name!r}")
         kind = {"materialized view": "mv"}.get(
             " ".join(kword.split()), kword
         )

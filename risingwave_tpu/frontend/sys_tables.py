@@ -1,0 +1,357 @@
+"""``rw_`` system tables: the runtime's own state as SQL relations.
+
+Reference: the reference catalog's ``rw_catalog`` schema
+(src/frontend/src/catalog/system_catalog/rw_catalog/ — rw_fragments,
+rw_materialized_views, rw_ddl_progress, ...): read-only virtual
+relations the frontend serves straight from meta/introspection state.
+Shared Arrangements' dogfooding argument (PAPERS.md) applies verbatim:
+introspection should be served THROUGH the system, off the same
+versioned snapshots queries read — so these tables ride the exact
+lock-free ``_execute_shared_read`` path PR 12 built for shared MVs.
+
+Each table is a ``SysTable``: a Schema plus a rows() builder over live
+process state (runtime fragments, the arrangement registry, the
+freshness tracker, epoch traces, permit channels, the event log). The
+batch engine only ever calls ``to_numpy()`` on a scan target, so a
+SysTable quacks exactly like a MaterializeExecutor snapshot: a dict of
+numpy columns, VARCHAR as dictionary codes in the session's
+StringDictionary. Builders read with plain attribute access + defensive
+copies and NEVER take the runtime lock — a wedged barrier must remain
+SELECT-able (that is the point of a stall-forensics surface).
+
+Registration happens once per session under ``_registry_guard``
+(``install_sys_tables``); the names are reserved — DDL against ``rw_``
+raises in the session.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List
+
+import numpy as np
+
+from risingwave_tpu.types import DataType, Schema
+
+# (name, dtype) per table; VARCHAR lanes carry dictionary codes like
+# every other relation (batch._decode_output decodes them back)
+SYS_SCHEMAS: Dict[str, Schema] = {
+    "rw_fragments": Schema(
+        [
+            ("name", DataType.VARCHAR),
+            ("kind", DataType.VARCHAR),
+            ("executors", DataType.INT64),
+            ("fused", DataType.INT64),
+            ("epoch", DataType.INT64),
+            ("subscribers", DataType.VARCHAR),
+        ]
+    ),
+    "rw_arrangements": Schema(
+        [
+            ("owner", DataType.VARCHAR),
+            ("fragment", DataType.VARCHAR),
+            ("refs", DataType.INT64),
+            ("shared", DataType.INT64),
+            ("published_epoch", DataType.INT64),
+            ("readers", DataType.VARCHAR),
+        ]
+    ),
+    "rw_mv_freshness": Schema(
+        [
+            ("mv", DataType.VARCHAR),
+            ("epoch", DataType.INT64),
+            ("checkpoint", DataType.INT64),
+            ("commit_to_visible_ms", DataType.FLOAT64),
+            ("source_to_visible_ms", DataType.FLOAT64),
+            ("event_time_lag_ms", DataType.FLOAT64),
+            ("staleness_ms", DataType.FLOAT64),
+            ("barriers", DataType.INT64),
+        ]
+    ),
+    "rw_barrier_latency": Schema(
+        [
+            ("epoch", DataType.INT64),
+            ("seq", DataType.INT64),
+            ("checkpoint", DataType.INT64),
+            ("wall_ms", DataType.FLOAT64),
+            ("dispatch_ms", DataType.FLOAT64),
+            ("device_step_ms", DataType.FLOAT64),
+            ("backpressure_fragment", DataType.VARCHAR),
+            ("backpressure_ms", DataType.FLOAT64),
+        ]
+    ),
+    "rw_channel_depths": Schema(
+        [
+            ("fragment", DataType.VARCHAR),
+            ("actor", DataType.VARCHAR),
+            ("channel", DataType.INT64),
+            ("depth", DataType.INT64),
+            ("oldest_age_ms", DataType.FLOAT64),
+            ("oldest_epoch", DataType.INT64),
+        ]
+    ),
+    "rw_fusion_status": Schema(
+        [
+            ("fragment", DataType.VARCHAR),
+            ("kind", DataType.VARCHAR),
+            ("fused", DataType.INT64),
+            ("fused_executors", DataType.INT64),
+            ("executors", DataType.INT64),
+        ]
+    ),
+    "rw_recovery_events": Schema(
+        [
+            ("seq", DataType.INT64),
+            ("ts_ms", DataType.INT64),
+            ("mode", DataType.VARCHAR),
+            ("epoch", DataType.INT64),
+            ("detail", DataType.VARCHAR),
+        ]
+    ),
+}
+
+
+class SysTable:
+    """A read-only virtual relation over live introspection state.
+
+    Quacks like a registered MV for the batch engine's scan path: the
+    only method the engine calls on a ``P.TableRef`` target is
+    ``to_numpy()``. A failing builder degrades to an empty relation —
+    introspection never turns a SELECT into a 500."""
+
+    def __init__(
+        self, name: str, schema: Schema, rows: Callable, session
+    ):
+        self.name = name
+        self.schema = schema
+        self._rows = rows
+        self._session = session
+
+    def to_numpy(self) -> Dict[str, np.ndarray]:
+        try:
+            rows = self._rows(self._session)
+        except Exception:  # noqa: BLE001 — introspection never faults
+            rows = []
+        enc = self._session.strings.encode_one
+        out: Dict[str, np.ndarray] = {}
+        for f in self.schema.fields:
+            vals = [r.get(f.name) for r in rows]
+            if f.dtype is DataType.VARCHAR:
+                out[f.name] = np.asarray(
+                    [enc("" if v is None else str(v)) for v in vals],
+                    np.int32,
+                )
+            elif f.dtype is DataType.FLOAT64:
+                out[f.name] = np.asarray(
+                    [float(v) if v is not None else -1.0 for v in vals],
+                    np.float64,
+                )
+            else:
+                out[f.name] = np.asarray(
+                    [int(v) if v is not None else 0 for v in vals],
+                    np.int64,
+                )
+        return out
+
+
+# -- row builders (one per table) -------------------------------------------
+
+
+def _fused_count(p) -> int:
+    """Fused wrappers visible in a fragment: the in-place serial/two-
+    input wrappers plus any inside a graph's actor chains."""
+    n = 0
+    if getattr(p, "_fused", None) is not None:
+        n += 1
+    for ex in getattr(p, "executors", ()) or ():
+        if type(ex).__name__.startswith("Fused"):
+            n += 1
+    g = getattr(p, "graph", None)
+    if g is not None:
+        for a in getattr(g, "actors", ()) or ():
+            for ex in getattr(a, "executors", ()) or ():
+                if type(ex).__name__.startswith("Fused"):
+                    n += 1
+    return n
+
+
+def _rows_fragments(session) -> List[dict]:
+    rt = session.runtime
+    rows = []
+    for name in sorted(getattr(rt, "fragments", {})):
+        p = rt.fragments[name]
+        subs = [d for d, _s in getattr(rt, "_subs", {}).get(name, ())]
+        rows.append(
+            {
+                "name": name,
+                "kind": type(p).__name__,
+                "executors": len(getattr(p, "executors", ()) or ()),
+                "fused": 1 if _fused_count(p) else 0,
+                "epoch": getattr(p, "_epoch", 0),
+                "subscribers": ",".join(subs),
+            }
+        )
+    return rows
+
+
+def _rows_arrangements(session) -> List[dict]:
+    reg = getattr(session.runtime, "arrangements", None)
+    if reg is None:
+        return []
+    rows = []
+    for arr in list(getattr(reg, "_live", ()) or ()):
+        ver = getattr(arr, "version", None)
+        rows.append(
+            {
+                "owner": getattr(arr, "owner", ""),
+                "fragment": getattr(arr, "fragment", ""),
+                "refs": len(getattr(arr, "refs", ()) or ()),
+                "shared": int(
+                    len(getattr(arr, "refs", ()) or ()) > 1
+                    or getattr(arr, "hidden", False)
+                ),
+                "published_epoch": getattr(ver, "epoch", 0) or 0,
+                "readers": ",".join(sorted(getattr(arr, "refs", ()) or ())),
+            }
+        )
+    rows.sort(key=lambda r: r["owner"])
+    return rows
+
+
+def _rows_mv_freshness(session) -> List[dict]:
+    from risingwave_tpu.freshness import FRESHNESS
+
+    now = time.time()
+    rows = []
+    for r in FRESHNESS.snapshot():
+        rows.append(
+            {
+                "mv": r["mv"],
+                "epoch": r["epoch"],
+                "checkpoint": int(r["checkpoint"]),
+                "commit_to_visible_ms": r["commit_to_visible_ms"],
+                "source_to_visible_ms": r["source_to_visible_ms"],
+                "event_time_lag_ms": r["event_time_lag_ms"],
+                # live staleness: how long ago this MV's snapshot became
+                # visible — monotone between barriers, resets at publish
+                "staleness_ms": round((now - r["visible_at"]) * 1e3, 3),
+                "barriers": r["barriers"],
+            }
+        )
+    return rows
+
+
+def _rows_barrier_latency(session) -> List[dict]:
+    rt = session.runtime
+    traces = list(getattr(rt, "epoch_traces", ()) or ())[-128:]
+    rows = []
+    for tr in traces:
+        st = getattr(tr, "stages_ms", {}) or {}
+        rows.append(
+            {
+                "epoch": getattr(tr, "epoch", 0),
+                "seq": getattr(tr, "seq", 0),
+                "checkpoint": int(getattr(tr, "checkpoint", False)),
+                "wall_ms": round(getattr(tr, "wall_ms", 0.0), 3),
+                "dispatch_ms": round(st.get("dispatch", 0.0), 3),
+                "device_step_ms": round(st.get("device_step", 0.0), 3),
+                "backpressure_fragment": getattr(
+                    tr, "backpressure_fragment", None
+                )
+                or "",
+                "backpressure_ms": round(
+                    getattr(tr, "backpressure_ms", 0.0), 3
+                ),
+            }
+        )
+    return rows
+
+
+def _rows_channel_depths(session) -> List[dict]:
+    rt = session.runtime
+    rows = []
+    for name in sorted(getattr(rt, "fragments", {})):
+        g = getattr(rt.fragments[name], "graph", None)
+        if g is None:
+            continue
+        for a in getattr(g, "actors", ()) or ():
+            for i, (_port, ch) in enumerate(a.inputs):
+                op = ch.oldest_pending()
+                rows.append(
+                    {
+                        "fragment": name,
+                        "actor": a.actor_name,
+                        "channel": i,
+                        "depth": len(ch),
+                        "oldest_age_ms": (
+                            round(op["age_ms"], 3) if op else None
+                        ),
+                        "oldest_epoch": op["epoch"] if op else None,
+                    }
+                )
+    return rows
+
+
+def _rows_fusion_status(session) -> List[dict]:
+    rt = session.runtime
+    rows = []
+    for name in sorted(getattr(rt, "fragments", {})):
+        p = rt.fragments[name]
+        fused = _fused_count(p)
+        rows.append(
+            {
+                "fragment": name,
+                "kind": type(p).__name__,
+                "fused": int(fused > 0),
+                "fused_executors": fused,
+                "executors": len(getattr(p, "executors", ()) or ()),
+            }
+        )
+    return rows
+
+
+def _rows_recovery_events(session) -> List[dict]:
+    from risingwave_tpu.event_log import EVENT_LOG
+
+    rows = []
+    for e in EVENT_LOG.events(kind="recovery", limit=256):
+        detail = ",".join(
+            f"{k}={v}"
+            for k, v in sorted(e.items())
+            if k not in ("seq", "ts", "kind", "mode", "epoch")
+        )
+        rows.append(
+            {
+                "seq": e["seq"],
+                "ts_ms": int(e["ts"] * 1000),
+                "mode": e.get("mode", ""),
+                "epoch": e.get("epoch"),
+                "detail": detail,
+            }
+        )
+    return rows
+
+
+_BUILDERS: Dict[str, Callable] = {
+    "rw_fragments": _rows_fragments,
+    "rw_arrangements": _rows_arrangements,
+    "rw_mv_freshness": _rows_mv_freshness,
+    "rw_barrier_latency": _rows_barrier_latency,
+    "rw_channel_depths": _rows_channel_depths,
+    "rw_fusion_status": _rows_fusion_status,
+    "rw_recovery_events": _rows_recovery_events,
+}
+
+
+def install_sys_tables(session) -> None:
+    """Register every ``rw_`` relation into the session's catalog +
+    batch engine (idempotent; called from SqlSession.__init__ under
+    ``_registry_guard``). The catalog entry makes typecheck_select see
+    them; the batch entry makes the scan path find them; the
+    ``_execute_shared_read`` branch serves them without the session
+    lock."""
+    for name, schema in SYS_SCHEMAS.items():
+        session.catalog.tables[name] = schema
+        session.batch.register(
+            name, SysTable(name, schema, _BUILDERS[name], session)
+        )

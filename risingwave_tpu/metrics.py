@@ -171,6 +171,11 @@ class MetricsRegistry:
                 lines.append(f"{base}_sum {h._sum.get(labels, sum(win))}")
         return "\n".join(lines) + "\n"
 
+    # the scrape-surface name (ISSUE 16 satellite): freshness and
+    # backpressure gauges read as plain prometheus text without custom
+    # JSON parsing — same exposition render() always produced
+    render_prometheus = render
+
     def render_dashboard(self) -> str:
         """One self-contained HTML ops page (the reference ships a
         React dashboard from the meta node; this collapses the same
@@ -351,6 +356,40 @@ class MetricsRegistry:
                     f"<tr><td>breaker_state</td><td>{escape(lbl)}</td>"
                     f"<td>{escape(names.get(v, str(v)))}</td></tr>"
                 )
+        # per-MV freshness (freshness.py): the latest commit->visible /
+        # source->visible / event-time-lag per MV — the SLO the BASELINE
+        # north star is written in
+        fresh_rows = ""
+        try:
+            from risingwave_tpu.freshness import FRESHNESS
+
+            def _f(v):
+                return "-" if v is None else f"{v:.1f}"
+
+            fresh_rows = "".join(
+                f"<tr><td>{escape(r['mv'])}</td><td>{r['epoch']}</td>"
+                f"<td>{_f(r['commit_to_visible_ms'])}</td>"
+                f"<td>{_f(r['source_to_visible_ms'])}</td>"
+                f"<td>{_f(r['event_time_lag_ms'])}</td>"
+                f"<td>{r['barriers']}</td></tr>"
+                for r in FRESHNESS.snapshot()
+            )
+        except Exception:
+            fresh_rows = ""
+        # backpressure attribution: per-fragment verdict histogram +
+        # live channel depths (which fragment slow barriers name)
+        bp_rows = ""
+        hbp = self.histograms.get("backpressure_ms")
+        if hbp is not None:
+            depth = self.gauges.get("channel_depth")
+            for lbl, s in sorted(hbp.summary().items()):
+                frag = lbl.split("=", 1)[-1]
+                d = depth.get(fragment=frag) if depth is not None else 0.0
+                bp_rows += (
+                    f"<tr><td>{escape(frag)}</td><td>{s['p50']}</td>"
+                    f"<td>{s['p99']}</td><td>{s['count']}</td>"
+                    f"<td>{d:g}</td></tr>"
+                )
         return f"""<!doctype html><html><head><title>risingwave_tpu</title>
 <style>body{{font-family:monospace;margin:2em}}table{{border-collapse:collapse;margin:1em 0}}
 td,th{{border:1px solid #999;padding:2px 8px}}h2{{margin-top:1.5em}}</style></head><body>
@@ -363,9 +402,11 @@ td,th{{border:1px solid #999;padding:2px 8px}}h2{{margin-top:1.5em}}</style></he
 <h2>black box &amp; device sentinel</h2><table>{bb_rows or '<tr><td>blackbox unavailable</td></tr>'}</table>
 <h2>device roofline (compiled programs)</h2><table><tr><th>program|bucket</th><th>compile ms</th><th>bytes accessed</th><th>flops</th><th>temp bytes</th></tr>{dp_rows or '<tr><td>deviceprof not armed (RW_DEVICEPROF=1)</td></tr>'}</table>
 <h2>fused telemetry (last barrier)</h2><table><tr><th>fragment</th><th>rows in</th><th>dirty groups</th><th>mv rows</th><th>lane fill</th><th>padding frac</th></tr>{tel_rows or '<tr><td>no fused barriers yet</td></tr>'}</table>
+<h2>freshness (per MV)</h2><table><tr><th>mv</th><th>epoch</th><th>commit&rarr;visible ms</th><th>source&rarr;visible ms</th><th>event-time lag ms</th><th>barriers</th></tr>{fresh_rows or '<tr><td>no published barriers yet</td></tr>'}</table>
+<h2>backpressure attribution</h2><table><tr><th>fragment</th><th>p50 ms</th><th>p99 ms</th><th>verdicts</th><th>channel depth</th></tr>{bp_rows or '<tr><td>no verdicts yet</td></tr>'}</table>
 <h2>resilience</h2><table><tr><th>metric</th><th>labels</th><th>value</th></tr>{res_rows or '<tr><td>no retries / breakers yet</td></tr>'}</table>
 <h2>events (last 25)</h2><table><tr><th>#</th><th>kind</th><th>detail</th></tr>{event_rows or '<tr><td>none</td></tr>'}</table>
-<p><a href="/metrics">/metrics</a> &middot; <a href="/heap">/heap</a> &middot; <a href="/events">/events</a></p>
+<p><a href="/metrics">/metrics</a> (prometheus text, <code>render_prometheus()</code>) &middot; <a href="/heap">/heap</a> &middot; <a href="/events">/events</a></p>
 </body></html>"""
 
     def serve(self, port: int = 0) -> int:
@@ -445,6 +486,12 @@ td,th{{border:1px solid #999;padding:2px 8px}}h2{{margin-top:1.5em}}</style></he
 
 # the process-default registry (reference: GLOBAL_METRICS_REGISTRY)
 REGISTRY = MetricsRegistry()
+
+
+def render_prometheus() -> str:
+    """Module-level scrape shorthand: the default registry's prometheus
+    text exposition (``metrics.render_prometheus()``)."""
+    return REGISTRY.render_prometheus()
 
 
 def record_recompiles(deltas: Dict[str, int]) -> None:

@@ -42,7 +42,11 @@ from risingwave_tpu.executors.project import ProjectExecutor
 from risingwave_tpu.expr import expr as E
 from risingwave_tpu.ops.hashing import VNODE_COUNT, hash_columns
 from risingwave_tpu.runtime.graph import FragmentSpec, GraphRuntime
-from risingwave_tpu.runtime.pipeline import Pipeline, TwoInputPipeline
+from risingwave_tpu.runtime.pipeline import (
+    FreshnessSurface,
+    Pipeline,
+    TwoInputPipeline,
+)
 from risingwave_tpu.storage.state_table import Checkpointable, StateDelta
 
 # stateless executors a hash exchange may commute past (rows travel
@@ -263,7 +267,7 @@ class PartitionedStateView(Checkpointable):
                 i.cold_reader = fn
 
 
-class GraphPipeline:
+class GraphPipeline(FreshnessSurface):
     """Pipeline-compatible facade over a ``GraphRuntime`` actor graph:
     the object a StreamingRuntime registers, barriers, checkpoints, and
     recovers — while pushes flow through dispatchers, permit channels,
@@ -309,6 +313,7 @@ class GraphPipeline:
             list(ckpt_fragments) if ckpt_fragments is not None else None
         )
         self.__dict__["_epoch_val"] = 0
+        self._init_freshness()
 
     def rebuild(self, fragments: Optional[Sequence[str]] = None) -> None:
         """Replace dead actors: fresh threads + channels around the
@@ -410,26 +415,33 @@ class GraphPipeline:
 
     # -- message surface --------------------------------------------------
     def push(self, chunk: StreamChunk, start: int = 0) -> List[StreamChunk]:
+        self._note_ingest()
         self.graph.inject_chunk(self._sources["single"], chunk)
         return []
 
     def push_left(self, chunk: StreamChunk) -> List[StreamChunk]:
+        self._note_ingest()
         self.graph.inject_chunk(self._sources["left"], chunk)
         return []
 
     def push_right(self, chunk: StreamChunk) -> List[StreamChunk]:
+        self._note_ingest()
         self.graph.inject_chunk(self._sources["right"], chunk)
         return []
 
     def watermark(self, column: str, value: int) -> List[StreamChunk]:
+        self._note_watermark(value)
         self.graph.inject_watermark(column, value)
         return []  # flushed output surfaces at the next barrier drain
 
     def barrier(
         self, checkpoint: bool = True, epoch: Optional[int] = None
     ) -> List[StreamChunk]:
+        t0 = time.perf_counter()
         target = self.barrier_nowait(checkpoint=checkpoint, epoch=epoch)
-        return self.wait_barrier(target)
+        outs = self.wait_barrier(target)
+        self._sample_freshness((time.perf_counter() - t0) * 1e3)
+        return outs
 
     # -- pipelined barriers (in-flight epochs, barrier/mod.rs:538) -------
     def barrier_nowait(

@@ -109,12 +109,12 @@ class PermitChannel:
             if self._fence is not None and self._fence.is_set():
                 return
             self._avail -= cost
-            self._q.append((CHUNK, chunk, cost))
+            self._q.append((CHUNK, chunk, cost, time.perf_counter()))
             self._cv.notify_all()
 
     def send_control(self, kind: str, payload=None) -> None:
         with self._cv:
-            self._q.append((kind, payload, 0))
+            self._q.append((kind, payload, 0, time.perf_counter()))
             self._cv.notify_all()
 
     def recv(self, block: bool = True):
@@ -125,7 +125,7 @@ class PermitChannel:
                 if not block:
                     return None
                 self._cv.wait()
-            kind, payload, cost = self._q.popleft()
+            kind, payload, cost, _enq = self._q.popleft()
             if cost:
                 self._avail += cost
             self._cv.notify_all()
@@ -134,6 +134,30 @@ class PermitChannel:
     def peek_kind(self) -> Optional[str]:
         with self._cv:
             return self._q[0][0] if self._q else None
+
+    def oldest_pending(self) -> Optional[dict]:
+        """Age of the head message + the first pending barrier's epoch,
+        or None when empty — backpressure attribution's raw signal: a
+        deep channel whose head is FRESH is draining; one whose head
+        has been sitting since epoch N is stuck behind a slow consumer
+        (the distinction a bare depth count cannot make)."""
+        with self._cv:
+            if not self._q:
+                return None
+            head_ts = self._q[0][3]
+            epoch = None
+            # bounded scan for the first barrier's epoch (channels are
+            # permit-bounded; typical depth is tiny at barrier edges)
+            for kind, payload, _cost, _ts in self._q:
+                if kind == BARRIER:
+                    epoch = getattr(
+                        getattr(payload, "epoch", None), "curr", None
+                    )
+                    break
+        return {
+            "age_ms": (time.perf_counter() - head_ts) * 1e3,
+            "epoch": epoch,
+        }
 
     def __len__(self) -> int:
         with self._cv:
@@ -1182,6 +1206,20 @@ class GraphRuntime:
             errors = {a: repr(e) for a, e in self.actor_errors.items()}
         actors = []
         for a in self.actors:
+            # oldest-pending AGE per input channel (not just depth): a
+            # deep-but-draining channel shows age ~0; one stuck since
+            # epoch N names the epoch it has been holding
+            oldest = []
+            for _p, ch in a.inputs:
+                op = ch.oldest_pending()
+                oldest.append(
+                    None
+                    if op is None
+                    else {
+                        "age_ms": round(op["age_ms"], 3),
+                        "epoch": op["epoch"],
+                    }
+                )
             actors.append(
                 {
                     "actor": a.actor_name,
@@ -1194,6 +1232,7 @@ class GraphRuntime:
                     "alive": a.is_alive(),
                     "last_collected_epoch": last.get(a.actor_name, 0),
                     "input_depths": [len(ch) for _p, ch in a.inputs],
+                    "input_oldest": oldest,
                     "error": repr(a.error) if a.error else None,
                 }
             )

@@ -18,6 +18,7 @@ The epoch counter follows the reference epoch encoding
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import List, Optional, Sequence
 
 from risingwave_tpu.analysis.jax_sanitizer import SIGNATURES, transfer_guard
@@ -25,6 +26,57 @@ from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.blackbox import RECORDER
 from risingwave_tpu.executors.base import Barrier, Epoch, Executor, Watermark
 from risingwave_tpu.profiler import PROFILER
+
+
+class FreshnessSurface:
+    """Host-side freshness sampling shared by every fragment shape
+    (Pipeline / TwoInputPipeline / GraphPipeline): the wall time of the
+    epoch's FIRST ingest, the max event-time watermark frontier seen,
+    and one sample per barrier (freshness.py consumes these at
+    ``runtime._end_trace``; bench.py summarizes them per query). Pure
+    host timestamps and dict appends — zero device dispatches.
+    """
+
+    FRESHNESS_WINDOW = 512
+
+    def _init_freshness(self) -> None:
+        self._ingest_wall: Optional[float] = None
+        self.low_watermark: Optional[int] = None
+        self.freshness_samples: deque = deque(maxlen=self.FRESHNESS_WINDOW)
+        self.last_freshness: Optional[dict] = None
+
+    def _note_ingest(self) -> None:
+        if self._ingest_wall is None:
+            self._ingest_wall = time.time()
+
+    def _note_watermark(self, value) -> None:
+        try:
+            v = int(value)
+        except (TypeError, ValueError):
+            return
+        if self.low_watermark is None or v > self.low_watermark:
+            self.low_watermark = v
+
+    def _sample_freshness(self, barrier_ms: float) -> dict:
+        now = time.time()
+        ingest, self._ingest_wall = self._ingest_wall, None
+        s = {
+            "epoch": self._epoch,
+            "ingest_wall": ingest,
+            "low_watermark": self.low_watermark,
+            "commit_to_visible_ms": round(barrier_ms, 3),
+            "source_to_visible_ms": (
+                round((now - ingest) * 1e3, 3) if ingest else None
+            ),
+            "event_time_lag_ms": (
+                round(now * 1000.0 - self.low_watermark, 3)
+                if self.low_watermark is not None
+                else None
+            ),
+        }
+        self.last_freshness = s
+        self.freshness_samples.append(s)
+        return s
 
 
 def walk_chain(chain: Sequence[Executor], chunks, barrier=None):
@@ -69,16 +121,18 @@ def _pcall(ex, phase, fn, *args):
     return fn(*args)
 
 
-class Pipeline:
+class Pipeline(FreshnessSurface):
     """An ordered chain of executors driven by the host epoch loop."""
 
     def __init__(self, executors: Sequence[Executor]):
         self.executors = list(executors)
         self._epoch = 0
+        self._init_freshness()
 
     # -- message plumbing -------------------------------------------------
     def push(self, chunk: StreamChunk, start: int = 0) -> List[StreamChunk]:
         """Feed one data chunk into the chain; returns what falls out."""
+        self._note_ingest()
         return walk_chain(self.executors[start:], [chunk])
 
     def barrier(
@@ -104,6 +158,7 @@ class Pipeline:
             for i, ex in enumerate(self.executors):
                 wm = ex.emit_watermark()
                 if wm is not None:
+                    self._note_watermark(wm.value)
                     _, outs = _walk_watermark(self.executors[i + 1 :], wm)
                     pending.extend(outs)
             t1 = time.perf_counter()
@@ -124,6 +179,7 @@ class Pipeline:
         t2 = time.perf_counter()
         record_stage("dispatch", (t1 - t0) * 1e3)
         record_stage("device_step", (t2 - t1) * 1e3)
+        self._sample_freshness((t2 - t0) * 1e3)
         # standalone pipelines (bench drivers, tests) feed the black
         # box directly — a runtime-driven barrier records via its
         # EpochTrace instead
@@ -136,6 +192,7 @@ class Pipeline:
         """Propagate a watermark; executors may transform it (e.g. hop
         window: event time -> window_start) or consume it; their flush
         outputs flow downstream as data."""
+        self._note_watermark(value)
         _, pending = _walk_watermark(self.executors, Watermark(column, value))
         return pending
 
@@ -160,7 +217,7 @@ def _walk_watermark(chain: Sequence[Executor], wm: Optional[Watermark]):
     return wm, pending
 
 
-class TwoInputPipeline:
+class TwoInputPipeline(FreshnessSurface):
     """Two upstream chains joined by a two-input executor, then a tail.
 
     Reference shape: a join actor's two MergeExecutor inputs aligned on
@@ -181,6 +238,7 @@ class TwoInputPipeline:
         self.join = join
         self.tail = list(tail)
         self._epoch = 0
+        self._init_freshness()
         # whole-pipeline fusion overlay (runtime/fused_step
         # fuse_two_input): when set, pushes buffer into the wrapper and
         # the barrier runs ONE donated device program — the member
@@ -192,6 +250,7 @@ class TwoInputPipeline:
         return walk_chain(chain, chunks, barrier)
 
     def push_left(self, chunk: StreamChunk) -> List[StreamChunk]:
+        self._note_ingest()
         if self._fused is not None:
             return self._fused.buffer_left(chunk)
         outs = []
@@ -200,6 +259,7 @@ class TwoInputPipeline:
         return self._through(self.tail, outs)
 
     def push_right(self, chunk: StreamChunk) -> List[StreamChunk]:
+        self._note_ingest()
         if self._fused is not None:
             return self._fused.buffer_right(chunk)
         outs = []
@@ -254,6 +314,7 @@ class TwoInputPipeline:
         t2 = time.perf_counter()
         record_stage("dispatch", (t1 - t0) * 1e3)
         record_stage("device_step", (t2 - t1) * 1e3)
+        self._sample_freshness((t2 - t0) * 1e3)
         RECORDER.record_pipeline_barrier(
             self._epoch, (t1 - t0) * 1e3, (t2 - t1) * 1e3
         )
@@ -273,6 +334,7 @@ class TwoInputPipeline:
                 wm = ex.emit_watermark()
                 if wm is None:
                     continue
+                self._note_watermark(wm.value)
                 wm, pending = _walk_watermark(chain[i + 1 :], wm)
                 for c in pending:
                     outs.extend(feed(c))
@@ -298,6 +360,7 @@ class TwoInputPipeline:
         watermark (min over both inputs) once both sides advanced —
         which then walks the tail chain (reference: per-input watermark
         alignment on multi-input executors)."""
+        self._note_watermark(value)
         if self._fused is not None:
             # buffered rows precede the watermark in stream order: the
             # fused wrapper applies them (data-only program), then the

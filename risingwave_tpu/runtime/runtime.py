@@ -45,6 +45,7 @@ from risingwave_tpu import utils_sync_point as sync_point
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.epoch_trace import EpochTrace, chunk_nbytes, dump_stalls
 from risingwave_tpu.event_log import EVENT_LOG
+from risingwave_tpu.freshness import FRESHNESS, attribute_backpressure
 from risingwave_tpu.metrics import REGISTRY
 from risingwave_tpu.resilience import (
     STORE_UNAVAILABLE,
@@ -480,6 +481,7 @@ class StreamingRuntime:
         ddl_controller.rs + barrier/recovery.rs 'clean dirty jobs')."""
         self.fragments.pop(name, None)
         self._subs.pop(name, None)
+        FRESHNESS.drop(name)
         with self._replay_lock:
             self._replay.pop(name, None)
             self._replay_floor.pop(name, None)
@@ -1341,6 +1343,9 @@ class StreamingRuntime:
     # -- EpochTrace plumbing ---------------------------------------------
     def _begin_trace(self, is_ckpt: bool) -> EpochTrace:
         tr = EpochTrace(self._epoch, self._barrier_seq, is_ckpt)
+        # commit->visible anchor (freshness.py): wall clock at barrier
+        # open; _end_trace measures to the post-publish visible point
+        tr.barrier_open_wall = time.time()
         # charge accumulated push() time/bytes to this epoch's ingest
         tr.add_stage("ingest", self._ingest_s * 1e3)
         tr.chunk_bytes = self._ingest_bytes
@@ -1360,6 +1365,13 @@ class StreamingRuntime:
         # shared arrangements: swap in this barrier's published version
         # (pointer swap; materializes only under active read demand)
         self.arrangements.publish(tr.epoch)
+        # freshness + backpressure attribution (ISSUE 16): NOW the
+        # epoch's snapshots are what a reader sees — measure to here.
+        # Host timestamps and dict folds only; never faults a barrier.
+        try:
+            self._observe_freshness(tr)
+        except Exception:  # noqa: BLE001 — accounting never faults
+            pass
         # flight recorder: the finalized trace is exactly one black-box
         # record (ring always; segment file when a dir is configured)
         blackbox.RECORDER.record_barrier(tr, runtime=self)
@@ -1370,6 +1382,57 @@ class StreamingRuntime:
                 wall_ms=round(tr.wall_ms, 2),
                 achieved_bw_frac=tr.achieved_bw_frac,
             )
+
+    def _observe_freshness(self, tr: EpochTrace) -> None:
+        """Per-MV freshness deltas at the VISIBLE point + the barrier's
+        backpressure verdict (freshness.py). commit->visible runs from
+        the barrier-open wall clock to after ``arrangements.publish`` —
+        the first instant a lock-free reader can see the epoch; the
+        fragments contribute their own ingest wall + watermark frontier
+        via FreshnessSurface samples keyed by this epoch."""
+        visible = time.time()
+        c2v = (
+            round((visible - tr.barrier_open_wall) * 1e3, 3)
+            if tr.barrier_open_wall
+            else None
+        )
+        fr: Dict[str, dict] = {}
+        for name, p in list(self.fragments.items()):
+            ent: Dict[str, float] = {}
+            if c2v is not None:
+                ent["commit_to_visible_ms"] = c2v
+            s = getattr(p, "last_freshness", None)
+            if s is not None and s.get("epoch") == tr.epoch:
+                iw = s.get("ingest_wall")
+                if iw:
+                    ent["source_to_visible_ms"] = round(
+                        (visible - iw) * 1e3, 3
+                    )
+                lw = s.get("low_watermark")
+                if lw is not None:
+                    ent["event_time_lag_ms"] = round(
+                        visible * 1000.0 - lw, 3
+                    )
+            FRESHNESS.observe(name, tr.epoch, tr.checkpoint, **ent)
+            fr[name] = ent
+        # attached shared-arrangement names become visible at the SAME
+        # publish: they inherit their backing fragment's deltas
+        reg = self.arrangements
+        for mv in list(reg._facades):
+            if mv in fr:
+                continue
+            frag = reg.fragment_for(mv)
+            base = fr.get(
+                frag,
+                {"commit_to_visible_ms": c2v} if c2v is not None else {},
+            )
+            FRESHNESS.observe(mv, tr.epoch, tr.checkpoint, **base)
+            fr[mv] = base
+        tr.freshness = fr
+        verdict = attribute_backpressure(self, tr)
+        tr.backpressure_fragment = verdict["fragment"]
+        tr.backpressure_ms = verdict["ms"]
+        tr.backpressure = verdict["detail"]
 
     def state_nbytes(self) -> int:
         """Accounted device state across all fragments (host estimate)."""

@@ -185,12 +185,13 @@ def stage_fusion_report(out_path: str) -> int:
 
 def stage_perf_gate(fusion_current: str = None) -> int:
     print("[lint_all] perf_gate --smoke --blackbox --roofline --serving "
-          "+ fusion ratchet (dispatch-cost + recorder/fsync + "
-          "device-roofline + shared-arrangement serving + "
-          "fusion-regression budgets)")
+          "--freshness + fusion ratchet (dispatch-cost + recorder/fsync "
+          "+ device-roofline + shared-arrangement serving + freshness "
+          "SLO + fusion-regression budgets)")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     cmd = [sys.executable, os.path.join(ROOT, "scripts", "perf_gate.py"),
-           "--smoke", "--blackbox", "--roofline", "--serving"]
+           "--smoke", "--blackbox", "--roofline", "--serving",
+           "--freshness"]
     if fusion_current and os.path.exists(fusion_current):
         cmd += ["--fusion-current", fusion_current]
     else:

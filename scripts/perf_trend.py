@@ -1,0 +1,260 @@
+#!/usr/bin/env python
+"""Consolidate the committed BENCH artifacts into ONE provenance-aware
+performance trajectory.
+
+The repo accumulates heterogeneous bench evidence: ``BENCH_r0*.json``
+(device-run retry wrappers: ``{n, cmd, rc, tail, parsed}`` where
+``parsed`` is the bench's own JSON — or null when the run crashed),
+``BENCH_TPU_*.json`` (flat bench dicts from TPU sessions),
+``BENCH_partial.json`` / ``BENCH.json`` (CPU smoke baselines) and
+``BENCH_SERVING.json`` (the PR 12 serving storm). Reading the
+trajectory by hand means re-discovering every wrapper shape and —
+worse — comparing numbers produced by DIFFERENT engine generations as
+if they were one series (the stale-artifact confusion that forced a
+ROADMAP re-anchor).
+
+This tool flattens all of them into one table, one row per artifact:
+
+- headline metric (value, unit, vs_baseline) + per-query
+  ``{q}_vs_baseline`` / ``{q}_p99_barrier_ms`` where stamped;
+- freshness evidence where stamped (``{q}_freshness`` commit->visible
+  p99, PR 16);
+- the artifact's ``engine_generation`` (from ``_provenance`` or the
+  top level), with a LOUD warning column when it predates the current
+  generation — those numbers are a different engine's.
+
+Usage::
+
+    python scripts/perf_trend.py            # table on stdout
+    python scripts/perf_trend.py --json     # machine-readable rows
+    python scripts/perf_trend.py A.json B.json   # explicit artifacts
+
+Exit code is 0 even with warnings: this is a ledger, not a gate
+(perf_gate owns pass/fail).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+QUERIES = ("q5", "q5u", "q7", "q8")
+
+
+def _engine_generation() -> int:
+    """Load provenance.py BY PATH (jax-free, same trick as perf_gate):
+    the trend tool must run on artifact JSON alone."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_rw_provenance",
+        os.path.join(ROOT, "risingwave_tpu", "provenance.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.ENGINE_GENERATION
+
+
+def default_artifacts() -> list:
+    """The committed trajectory, oldest-ish first: numbered retry
+    wrappers, then numbered TPU sessions, then the CPU baselines."""
+
+    def _numbered(pattern):
+        def key(p):
+            m = re.search(r"(\d+)", os.path.basename(p))
+            return int(m.group(1)) if m else 0
+
+        return sorted(glob.glob(os.path.join(ROOT, pattern)), key=key)
+
+    paths = _numbered("BENCH_r[0-9]*.json")
+    paths += _numbered("BENCH_TPU_*.json")
+    for name in ("BENCH_partial.json", "BENCH.json", "BENCH_SERVING.json"):
+        p = os.path.join(ROOT, name)
+        if os.path.exists(p):
+            paths.append(p)
+    return paths
+
+
+def load_artifact(path: str):
+    """Read one artifact; unwrap retry wrappers. Returns
+    ``(bench_dict_or_None, note)`` — a null/crashed wrapper yields
+    (None, reason) instead of raising, so one bad file never hides the
+    rest of the trajectory."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return None, f"unreadable ({e})"
+    if not isinstance(doc, dict):
+        return None, f"unexpected shape ({type(doc).__name__})"
+    if set(doc) >= {"n", "cmd", "rc", "parsed"}:
+        # retry wrapper: the bench's own JSON lives under "parsed"
+        parsed = doc.get("parsed")
+        note = f"retry wrapper n={doc.get('n')} rc={doc.get('rc')}"
+        if not isinstance(parsed, dict):
+            tail = (doc.get("tail") or "").strip().splitlines()
+            last = tail[-1][:100] if tail else ""
+            return None, f"{note}: no parsed bench output ({last!r})"
+        return parsed, note
+    return doc, ""
+
+
+def _fresh_p99(bench: dict, q: str):
+    blk = bench.get(f"{q}_freshness")
+    if not isinstance(blk, dict):
+        return None
+    c2v = blk.get("commit_to_visible_ms") or {}
+    return c2v.get("p99") if c2v.get("n") else None
+
+
+def summarize(path: str, current_gen: int) -> dict:
+    """One trajectory row for one artifact."""
+    bench, note = load_artifact(path)
+    row = {
+        "artifact": os.path.basename(path),
+        "note": note,
+        "ok": bench is not None,
+    }
+    if bench is None:
+        return row
+    prov = bench.get("_provenance") or bench
+    gen = prov.get("engine_generation")
+    row["engine_generation"] = gen
+    if gen is None:
+        row["warning"] = "no engine_generation (predates PR 11)"
+    elif int(gen) < current_gen:
+        row["warning"] = (
+            f"engine generation {gen} < current {current_gen} "
+            f"(sha {str(prov.get('git_sha', '?'))[:12]}) — numbers "
+            "may not be comparable"
+        )
+    if "metric" in bench:
+        row["metric"] = bench.get("metric")
+        row["value"] = bench.get("value")
+        row["unit"] = bench.get("unit")
+        row["vs_baseline"] = bench.get("vs_baseline")
+        row["tier"] = bench.get("tier")
+        if "p99_barrier_ms" in bench:
+            row["p99_barrier_ms"] = bench.get("p99_barrier_ms")
+    # serving-storm artifacts carry their own vocabulary
+    if "reads_per_s" in bench and "compile_programs" in bench:
+        row["metric"] = row.get("metric") or "serving_storm"
+        row["serving"] = {
+            k: bench.get(k)
+            for k in (
+                "compile_programs",
+                "reader_p99_ms",
+                "reads_per_s",
+                "bytes_per_mv_ratio",
+            )
+        }
+    queries = {}
+    for q in QUERIES:
+        ent = {}
+        for key, out in (
+            (f"{q}_throughput", "throughput"),
+            (f"{q}_vs_baseline", "vs_baseline"),
+            (f"{q}_p99_barrier_ms", "p99_barrier_ms"),
+        ):
+            if key in bench:
+                ent[out] = bench[key]
+        fp = _fresh_p99(bench, q)
+        if fp is not None:
+            ent["freshness_p99_ms"] = fp
+        if ent:
+            queries[q] = ent
+    if queries:
+        row["queries"] = queries
+    if bench.get("errors"):
+        row["errors"] = bench["errors"]
+    return row
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.3g}"
+    return str(v)
+
+
+def render(rows: list, current_gen: int) -> str:
+    out = [
+        f"perf trajectory ({len(rows)} artifacts, current engine "
+        f"generation {current_gen})",
+        "",
+    ]
+    hdr = (
+        f"{'artifact':<22} {'gen':>4} {'metric':<28} {'value':>10} "
+        f"{'vs_base':>8} {'p99 ms':>8}  queries"
+    )
+    out.append(hdr)
+    out.append("-" * len(hdr))
+    warnings = []
+    for r in rows:
+        if not r["ok"]:
+            out.append(f"{r['artifact']:<22}  -- {r['note']}")
+            continue
+        qbits = []
+        for q, ent in (r.get("queries") or {}).items():
+            bits = []
+            if "vs_baseline" in ent:
+                bits.append(f"x{_fmt(ent['vs_baseline'])}")
+            if "p99_barrier_ms" in ent:
+                bits.append(f"p99={_fmt(ent['p99_barrier_ms'])}ms")
+            if "freshness_p99_ms" in ent:
+                bits.append(f"fresh={_fmt(ent['freshness_p99_ms'])}ms")
+            if bits:
+                qbits.append(f"{q}({','.join(bits)})")
+        if "serving" in r:
+            s = r["serving"]
+            qbits.append(
+                f"serving(programs={_fmt(s.get('compile_programs'))},"
+                f"reader_p99={_fmt(s.get('reader_p99_ms'))}ms)"
+            )
+        out.append(
+            f"{r['artifact']:<22} {_fmt(r.get('engine_generation')):>4} "
+            f"{_fmt(r.get('metric'))[:28]:<28} {_fmt(r.get('value')):>10} "
+            f"{_fmt(r.get('vs_baseline')):>8} "
+            f"{_fmt(r.get('p99_barrier_ms')):>8}  {' '.join(qbits)}"
+        )
+        if r.get("warning"):
+            warnings.append(f"{r['artifact']}: {r['warning']}")
+    if warnings:
+        out.append("")
+        out.append("provenance warnings (treat these rows as a DIFFERENT")
+        out.append("engine's numbers — do not ratchet against them):")
+        for w in warnings:
+            out.append(f"  ! {w}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="*", help="explicit artifacts")
+    ap.add_argument(
+        "--json", action="store_true", help="emit rows as JSON"
+    )
+    args = ap.parse_args(argv)
+    paths = args.paths or default_artifacts()
+    current_gen = _engine_generation()
+    rows = [summarize(p, current_gen) for p in paths]
+    if args.json:
+        print(
+            json.dumps(
+                {"engine_generation": current_gen, "rows": rows}, indent=2
+            )
+        )
+    else:
+        print(render(rows, current_gen))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

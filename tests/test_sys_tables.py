@@ -1,0 +1,416 @@
+"""``rw_`` system tables + end-to-end freshness (the PR 16 surface).
+
+The introspection contract: the runtime's own state — fragments,
+arrangements, per-MV freshness, barrier latency + backpressure verdict,
+channel depths, fusion status, recovery events — is queryable as plain
+SQL relations through the SAME lock-free snapshot path shared MVs ride,
+while streaming continues and across partial recovery. Plus the
+freshness twin discipline: the fused and interpreted q5 twins must
+agree not just on MV content but on the freshness frontier itself
+(same epochs, same low-watermark values).
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from risingwave_tpu.array.chunk import StreamChunk
+from risingwave_tpu.event_log import EVENT_LOG
+from risingwave_tpu.executors.hash_agg import HashAggExecutor
+from risingwave_tpu.executors.materialize import MaterializeExecutor
+from risingwave_tpu.frontend import PgServer, SqlSession
+from risingwave_tpu.ops.agg import AggCall
+from risingwave_tpu.runtime.fragmenter import GraphPipeline
+from risingwave_tpu.runtime.graph import FragmentSpec
+from risingwave_tpu.runtime.runtime import StreamingRuntime
+from risingwave_tpu.sim import CrashingExecutor
+from risingwave_tpu.sql import Catalog
+from risingwave_tpu.storage.object_store import MemObjectStore
+
+pytestmark = pytest.mark.smoke
+
+RW_TABLES = (
+    "rw_fragments",
+    "rw_arrangements",
+    "rw_mv_freshness",
+    "rw_barrier_latency",
+    "rw_channel_depths",
+    "rw_fusion_status",
+    "rw_recovery_events",
+)
+
+
+# ---------------------------------------------------------------------------
+# direct-session surface
+# ---------------------------------------------------------------------------
+
+
+def _session():
+    s = SqlSession(Catalog({}), capacity=1 << 8)
+    s.execute("CREATE TABLE t (k BIGINT, v BIGINT)")
+    s.execute(
+        "CREATE MATERIALIZED VIEW m AS "
+        "SELECT k, sum(v) AS sv FROM t GROUP BY k"
+    )
+    s.execute("INSERT INTO t VALUES (1, 10), (2, 5), (1, 32)")
+    return s
+
+
+def test_every_rw_table_selectable():
+    """All seven relations answer SELECT * (a failing builder degrades
+    to empty rows, never an error)."""
+    s = _session()
+    for name in RW_TABLES:
+        out, tag = s.execute(f"SELECT * FROM {name}")
+        assert tag.startswith("SELECT"), name
+        assert isinstance(out, dict) and out, name
+
+
+def test_rw_fragments_and_fusion_status_describe_the_mv():
+    s = _session()
+    out, _ = s.execute("SELECT name, kind, executors FROM rw_fragments")
+    names = [str(x) for x in out["name"]]
+    assert "m" in names
+    i = names.index("m")
+    assert int(out["executors"][i]) >= 1
+    out, _ = s.execute("SELECT fragment, executors FROM rw_fusion_status")
+    assert "m" in [str(x) for x in out["fragment"]]
+
+
+def test_rw_mv_freshness_tracks_barriers():
+    """Every INSERT-driven barrier publishes a freshness row: the
+    commit->visible wall is measured (>= 0), the epoch advances with
+    further barriers, and barriers counts them."""
+    s = _session()
+    out, _ = s.execute(
+        "SELECT mv, epoch, commit_to_visible_ms, barriers, staleness_ms "
+        "FROM rw_mv_freshness"
+    )
+    mvs = [str(x) for x in out["mv"]]
+    assert "m" in mvs
+    i = mvs.index("m")
+    e0 = int(out["epoch"][i])
+    assert float(out["commit_to_visible_ms"][i]) >= 0.0
+    assert float(out["staleness_ms"][i]) >= 0.0
+    b0 = int(out["barriers"][i])
+    s.execute("INSERT INTO t VALUES (3, 7)")
+    out, _ = s.execute(
+        "SELECT mv, epoch, barriers FROM rw_mv_freshness"
+    )
+    mvs = [str(x) for x in out["mv"]]
+    i = mvs.index("m")
+    assert int(out["epoch"][i]) > e0  # freshness is monotone in epoch
+    assert int(out["barriers"][i]) == b0 + 1
+
+
+def test_rw_barrier_latency_carries_backpressure_verdict():
+    s = _session()
+    out, _ = s.execute(
+        "SELECT epoch, wall_ms, backpressure_fragment, backpressure_ms "
+        "FROM rw_barrier_latency"
+    )
+    assert len(out["epoch"]) >= 1
+    assert all(float(w) >= 0.0 for w in out["wall_ms"])
+    # at least the latest barrier names its bottleneck fragment
+    frags = [str(x) for x in out["backpressure_fragment"]]
+    assert any(f for f in frags)
+
+
+def test_rw_ddl_guard():
+    """The rw_ namespace is reserved: DROP refuses, CREATE collides."""
+    s = _session()
+    with pytest.raises(ValueError, match="system table"):
+        s.execute("DROP TABLE rw_fragments")
+    with pytest.raises(ValueError, match="exists"):
+        s.execute("CREATE TABLE rw_fragments (x BIGINT)")
+
+
+def test_render_prometheus_exposed():
+    """metrics.render_prometheus() is the module-level scrape surface
+    the dashboard links to."""
+    from risingwave_tpu import metrics
+
+    metrics.REGISTRY.counter("sys_tables_probe_total").inc()
+    text = metrics.render_prometheus()
+    assert isinstance(text, str)
+    assert "sys_tables_probe_total" in text
+    assert metrics.REGISTRY.render_prometheus() == text
+
+
+# ---------------------------------------------------------------------------
+# pgwire: lock-free rw_ reads while streaming continues
+# ---------------------------------------------------------------------------
+
+
+class PgClient:
+    def __init__(self, port):
+        self.sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+        params = b"user\0test\0database\0dev\0\0"
+        body = struct.pack("!I", 196608) + params
+        self.sock.sendall(struct.pack("!I", len(body) + 4) + body)
+        self._drain_until_ready()
+
+    def _recv_exact(self, n):
+        buf = b""
+        while len(buf) < n:
+            got = self.sock.recv(n - len(buf))
+            assert got, "server closed"
+            buf += got
+        return buf
+
+    def _read_msg(self):
+        head = self._recv_exact(5)
+        tag = head[:1]
+        (length,) = struct.unpack("!I", head[1:])
+        return tag, self._recv_exact(length - 4)
+
+    def _drain_until_ready(self):
+        msgs = []
+        while True:
+            tag, body = self._read_msg()
+            msgs.append((tag, body))
+            if tag == b"Z":
+                return msgs
+
+    def query(self, sql):
+        body = sql.encode() + b"\0"
+        self.sock.sendall(b"Q" + struct.pack("!I", len(body) + 4) + body)
+        rows, names, tagline, err = [], [], None, None
+        for tag, body in self._drain_until_ready():
+            if tag == b"T":
+                (ncols,) = struct.unpack("!h", body[:2])
+                at = 2
+                for _ in range(ncols):
+                    end = body.index(b"\0", at)
+                    names.append(body[at:end].decode())
+                    at = end + 1 + 18
+            elif tag == b"D":
+                (ncols,) = struct.unpack("!h", body[:2])
+                at = 2
+                row = []
+                for _ in range(ncols):
+                    (ln,) = struct.unpack("!i", body[at : at + 4])
+                    at += 4
+                    if ln < 0:
+                        row.append(None)
+                    else:
+                        row.append(body[at : at + ln].decode())
+                        at += ln
+                rows.append(tuple(row))
+            elif tag == b"C":
+                tagline = body.rstrip(b"\0").decode()
+            elif tag == b"E":
+                err = body
+        return names, rows, tagline, err
+
+    def close(self):
+        self.sock.sendall(b"X" + struct.pack("!I", 4))
+        self.sock.close()
+
+
+def test_pgwire_rw_selects_under_concurrent_streaming():
+    """A reader connection hammers rw_mv_freshness / rw_barrier_latency
+    while a writer connection streams INSERT-driven barriers: every
+    read decodes cleanly (no torn rows off the lock-free path) and the
+    MV's freshness epoch is MONOTONE across reads."""
+    srv = PgServer(SqlSession(Catalog({}), capacity=1 << 8)).start()
+    writer = reader = None
+    try:
+        writer = PgClient(srv.port)
+        reader = PgClient(srv.port)
+        _, _, _, err = writer.query("CREATE TABLE t (k BIGINT, v BIGINT)")
+        assert err is None
+        _, _, _, err = writer.query(
+            "CREATE MATERIALIZED VIEW m AS "
+            "SELECT k, sum(v) AS sv FROM t GROUP BY k"
+        )
+        assert err is None
+        write_errs = []
+
+        def feed():
+            for i in range(30):
+                _, _, _, e = writer.query(
+                    f"INSERT INTO t VALUES ({i % 5}, {i})"
+                )
+                if e is not None:
+                    write_errs.append(e)
+                    return
+
+        th = threading.Thread(target=feed)
+        th.start()
+        last_epoch, reads = -1, 0
+        while th.is_alive() or reads == 0:
+            names, rows, tag, err = reader.query(
+                "SELECT mv, epoch, commit_to_visible_ms FROM rw_mv_freshness"
+            )
+            assert err is None, err
+            for r in rows:
+                if r[0] == "m":
+                    e = int(r[1])
+                    assert e >= last_epoch, "freshness epoch went BACK"
+                    last_epoch = e
+                    assert float(r[2]) >= 0.0
+            reads += 1
+            if reads > 500:  # safety valve, never spins forever
+                break
+        th.join(timeout=30)
+        assert not th.is_alive() and write_errs == []
+        assert reads > 0 and last_epoch > 0
+        _, rows, _, err = reader.query(
+            "SELECT epoch, wall_ms, backpressure_fragment "
+            "FROM rw_barrier_latency"
+        )
+        assert err is None and len(rows) >= 1
+        for r in rows:
+            assert float(r[1]) >= 0.0
+    finally:
+        for c in (writer, reader):
+            if c is not None:
+                c.close()
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# partial recovery: events land in rw_recovery_events, freshness survives
+# ---------------------------------------------------------------------------
+
+
+def _mk_singleton(name, crash=None):
+    agg = HashAggExecutor(
+        group_keys=("k",),
+        calls=(AggCall("sum", "v", "s"), AggCall("count_star", None, "c")),
+        schema_dtypes={"k": jnp.int64, "v": jnp.int64},
+        capacity=1 << 8,
+        table_id=f"{name}.agg",
+    )
+    mv = MaterializeExecutor(
+        pk=("k",), columns=("s", "c"), table_id=f"{name}.mview"
+    )
+    chain = ([crash] if crash is not None else []) + [agg, mv]
+    specs = [
+        FragmentSpec("src", lambda i: []),
+        FragmentSpec(
+            "work", lambda i, c=tuple(chain): list(c), inputs=[("src", 0)]
+        ),
+    ]
+    gp = GraphPipeline(
+        specs, {"single": "src"}, "work", chain,
+        ckpt_fragments=["work"] * len(chain),
+    )
+    return gp, mv
+
+
+def test_recovery_events_and_freshness_across_partial_recovery():
+    """Crash one MV's fragment mid-stream: the partial recovery lands
+    in rw_recovery_events (partial + partial_done, seq-ordered), both
+    MVs keep freshness rows, and the healthy MV's freshness epoch keeps
+    advancing across the recovery window (monotone, never reset)."""
+    rt = StreamingRuntime(
+        MemObjectStore(), async_checkpoint=False, auto_recover=True
+    )
+    s = SqlSession(Catalog({}), rt, capacity=1 << 8)
+    crash = CrashingExecutor("mv_b")
+    gpa, _mva = _mk_singleton("mv_a")
+    gpb, _mvb = _mk_singleton("mv_b", crash=crash)
+    rt.register("mv_a", gpa)
+    rt.register("mv_b", gpb)
+    seq0 = max((e["seq"] for e in EVENT_LOG.events()), default=0)
+    rng = np.random.default_rng(31)
+
+    def feed():
+        n = int(rng.integers(4, 10))
+        c = StreamChunk.from_numpy(
+            {"k": rng.integers(0, 4, n).astype(np.int64),
+             "v": rng.integers(0, 40, n).astype(np.int64)}, 16,
+        )
+        rt.push("mv_a", c)
+        rt.push("mv_b", c)
+
+    epochs_a = []
+    try:
+        for i in range(5):
+            if i == 3:
+                crash.arm("apply", after=1)
+            feed()
+            before = rt.mgr.max_committed_epoch
+            rt.barrier()
+            if rt.mgr.max_committed_epoch == before:
+                assert rt.last_recovery_mode == "partial"
+                rt.barrier()
+            out, _ = s.execute("SELECT mv, epoch FROM rw_mv_freshness")
+            mvs = [str(x) for x in out["mv"]]
+            assert "mv_a" in mvs and "mv_b" in mvs
+            epochs_a.append(int(out["epoch"][mvs.index("mv_a")]))
+        rt.wait_checkpoints()
+    finally:
+        gpa.close()
+        gpb.close()
+    assert crash.kills == 1 and rt.partial_recoveries == 1
+    assert epochs_a == sorted(epochs_a)  # monotone ACROSS the recovery
+    assert epochs_a[-1] > epochs_a[0]
+    out, _ = s.execute("SELECT seq, mode, epoch FROM rw_recovery_events")
+    new = [
+        (int(q), str(m))
+        for q, m in zip(out["seq"], out["mode"])
+        if int(q) > seq0
+    ]
+    modes = [m for _q, m in new]
+    assert "partial" in modes and "partial_done" in modes
+    assert [q for q, _m in new] == sorted(q for q, _m in new)
+
+
+# ---------------------------------------------------------------------------
+# twin discipline: freshness frontier identical fused vs interpreted
+# ---------------------------------------------------------------------------
+
+
+def test_freshness_frontier_bit_identical_fused_vs_interpreted():
+    """The fused q5 twin must agree with the interpreted twin on MV
+    content AND the freshness surface itself: same epochs, same
+    low-watermark frontier per barrier (commit->visible walls are wall
+    clock and legitimately differ), with every barrier sampled."""
+    from risingwave_tpu.connectors.nexmark import (
+        NexmarkConfig,
+        NexmarkGenerator,
+    )
+    from risingwave_tpu.queries.nexmark_q import build_q5_lite
+    from risingwave_tpu.runtime.fused_step import fuse_pipeline
+
+    def drive(fuse):
+        q5 = build_q5_lite(capacity=1 << 10, state_cleaning=False)
+        if fuse:
+            wrappers = fuse_pipeline(q5.pipeline, label="q5")
+            assert wrappers and wrappers[0].covers_whole_chain
+        gen = NexmarkGenerator(NexmarkConfig(first_event_rate=5_000))
+        mx = 0
+        for _ in range(3):
+            c = None
+            while c is None:
+                c = gen.next_chunks(400, 512)["bid"]
+            q5.pipeline.push(c)
+            mx = max(mx, int(c.to_numpy()["date_time"].max()))
+            q5.pipeline.watermark("date_time", mx)
+            q5.pipeline.barrier()
+        return q5.mview.snapshot(), list(q5.pipeline.freshness_samples)
+
+    snap_i, fr_i = drive(False)
+    snap_f, fr_f = drive(True)
+    assert snap_i == snap_f
+    assert len(fr_i) == len(fr_f) == 3  # every barrier sampled
+    # the low-watermark frontier is data-derived and must be bit-equal;
+    # epochs are physical-time stamps — monotone within a twin, not
+    # comparable across twins
+    frontier = lambda fr: [x["low_watermark"] for x in fr]
+    assert frontier(fr_i) == frontier(fr_f)
+    for fr in (fr_i, fr_f):
+        es = [x["epoch"] for x in fr]
+        assert es == sorted(es) and len(set(es)) == len(es)
+    for x in fr_f:
+        assert x["commit_to_visible_ms"] >= 0.0
+        assert x["source_to_visible_ms"] is not None
+        assert x["low_watermark"] is not None
