@@ -56,6 +56,12 @@ CODES = {
     "bucket lattice: same index key columns, dtypes and window spec, but "
     "the declared capacity lattices disagree — aligning capacities would "
     "let one shared arrangement serve both (runtime/arrangements.py)",
+    "RW-E708": "stateful executor invisible to the memory ledger: it "
+    "registers state table_ids but exposes neither a state_nbytes()/"
+    "state_bytes() accounting contract nor an allocator-backed capacity "
+    "note (_buckets) — its device state dodges the HBM budget the "
+    "memory governor enforces (runtime/memory_governor.py). Report-only "
+    "by default; refused when RW_STRICT_LINT is explicitly set",
     # fusion feasibility (analysis/fusion_analyzer.py): what blocks
     # fusing a fragment's executor chain into ONE jitted per-barrier
     # device step (ROADMAP item 1), proven statically

@@ -90,6 +90,48 @@ def _prov(idx: int, ex) -> str:
     return f"{idx}:{type(ex).__name__}"
 
 
+def _e708_severity() -> str:
+    """RW-E708 is report-only by DEFAULT even though sessions default
+    strict (RW_STRICT_LINT unset = strict): promoting it to an error
+    for every pre-existing DDL would refuse plans that were legal
+    yesterday. Only an EXPLICITLY-set truthy RW_STRICT_LINT (the
+    __main__.py opt-in convention) makes unaccounted state a refusal."""
+    import os
+
+    v = os.environ.get("RW_STRICT_LINT")
+    if v is not None and v.strip().lower() not in ("", "0", "off", "false"):
+        return "error"
+    return "warning"
+
+
+def _check_ledger_visible(ex, info, fragment, prov, rep) -> None:
+    """RW-E708: an executor that registers state table_ids with the
+    runtime but is invisible to the memory governor's ledger — no
+    ``state_nbytes()``/``state_bytes()`` accounting contract and no
+    allocator-backed capacity note (``_buckets``). Unaccounted device
+    state cannot be budgeted, vetoed or spilled: under overload it is
+    exactly the state that OOMs the device while the governor reports
+    headroom."""
+    if not (info.get("table_ids") or ()):
+        return
+    if (
+        hasattr(ex, "state_nbytes")
+        or hasattr(ex, "state_bytes")
+        or getattr(ex, "_buckets", None) is not None
+    ):
+        return
+    rep.add(
+        "RW-E708",
+        f"{type(ex).__name__} registers state table(s) "
+        f"{tuple(info.get('table_ids') or ())!r} but exposes neither "
+        "state_nbytes()/state_bytes() nor an allocator capacity note — "
+        "its device state is invisible to the HBM memory ledger",
+        fragment=fragment,
+        executor=prov,
+        severity=_e708_severity(),
+    )
+
+
 class _TableIds:
     """Plan-wide table_id uniqueness (RW-E702). Parallel instances of
     one logical fragment share table_ids BY DESIGN (disjoint vnode
@@ -137,6 +179,7 @@ def _walk_chain(
             schema, wm = None, None
             continue
         tids.add(instance, info.get("table_ids", ()), fragment, prov)
+        _check_ledger_visible(ex, info, fragment, prov, rep)
 
         expects = {k: _dt(v) for k, v in (info.get("expects") or {}).items()}
         requires = set(info.get("requires") or ()) | set(expects)
@@ -270,6 +313,7 @@ def _verify_join(
         tids.add(instance, (tid,) if tid else (), fragment, prov)
         return None, None
     tids.add(instance, info.get("table_ids", ()), fragment, prov)
+    _check_ledger_visible(join, info, fragment, prov, rep)
     lkeys = tuple(info.get("left_keys") or ())
     rkeys = tuple(info.get("right_keys") or ())
     for side, schema, expects in (

@@ -780,24 +780,35 @@ class DeviceSentinel:
             if d is None:
                 return
             path = os.path.join(d, "SENTINEL_STATE.json")
+        doc = {
+            "ts": round(time.time(), 3),
+            "state": self.state,
+            "latency_ms": (
+                round(latency_ms, 1) if latency_ms is not None else None
+            ),
+            "beats": self.beats,
+            "wedges": self.wedges,
+            "pid": os.getpid(),
+        }
+        try:
+            # overload ladder rung (the memory governor's gauge), so
+            # bench_on_healthy can tail THROTTLED/SHEDDING windows into
+            # BENCH_WATCH.log alongside the device heartbeat
+            from risingwave_tpu.metrics import REGISTRY
+            from risingwave_tpu.runtime.memory_governor import LADDER
+
+            g = REGISTRY.gauges.get("overload_state")
+            if g is not None:
+                i = int(g.get())
+                doc["overload_state"] = (
+                    LADDER[i] if 0 <= i < len(LADDER) else str(i)
+                )
+        except Exception:  # noqa: BLE001 — status stays heartbeat-only
+            pass
         try:
             tmp = path + ".tmp"
             with open(tmp, "w") as f:
-                json.dump(
-                    {
-                        "ts": round(time.time(), 3),
-                        "state": self.state,
-                        "latency_ms": (
-                            round(latency_ms, 1)
-                            if latency_ms is not None
-                            else None
-                        ),
-                        "beats": self.beats,
-                        "wedges": self.wedges,
-                        "pid": os.getpid(),
-                    },
-                    f,
-                )
+                json.dump(doc, f)
             os.replace(tmp, path)
         except OSError:
             pass

@@ -138,6 +138,12 @@ class EpochBatchedAggExecutor(Executor):
             infos.append(info)
         return _compose_lint_infos(infos)
 
+    def state_nbytes(self) -> int:
+        """Memory-ledger contract: all state lives in the wrapped agg
+        (the prefix is stateless-pure by construction)."""
+        fn = getattr(self.agg, "state_nbytes", None)
+        return int(fn()) if fn is not None else 0
+
     def trace_contract(self):
         inner = self.agg.trace_contract()
         if inner is None:

@@ -79,6 +79,14 @@ class MaterializeExecutor(Executor, Checkpointable):
             "table_ids": (self.table_id,),
         }
 
+    def state_nbytes(self) -> int:
+        """Memory-ledger contract: a host-map MV holds NO device
+        bytes — only the host row store (estimated at 8B per pk/value
+        cell so the ledger can still rank it)."""
+        width = len(self.pk) + len(self.columns)
+        n = len(self._native) if self._native is not None else len(self.rows)
+        return int(n) * width * 8
+
     def trace_contract(self):
         return {
             "kind": "host",

@@ -738,6 +738,15 @@ class OverWindowExecutor(Executor, Checkpointable):
             "table_ids": (self.table_id,),
         }
 
+    def state_nbytes(self) -> int:
+        """Device bytes held (host-side estimate; no sync)."""
+        return sum(
+            leaf.nbytes
+            for leaf in jax.tree.leaves(
+                (self.table, self.accums, self.sdirty, self.stored)
+            )
+        )
+
     def trace_contract(self):
         return {
             "kind": "device",
@@ -1421,6 +1430,17 @@ class GeneralOverWindowExecutor(Executor, Checkpointable):
     @property
     def capacity(self) -> int:
         return self.present.shape[0]
+
+    def state_nbytes(self) -> int:
+        """Device bytes held (host-side estimate; no sync)."""
+        return sum(
+            leaf.nbytes
+            for leaf in jax.tree.leaves((
+                self.table, self.buf, self.bnulls, self.present,
+                self.seq, self.em, self.emnulls, self.em_valid,
+                self.sdirty, self.stored,
+            ))
+        )
 
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         for c in self.calls:

@@ -35,6 +35,11 @@ class RowIdGenExecutor(Executor, Checkpointable):
             "table_ids": (self.table_id,),
         }
 
+    def state_nbytes(self) -> int:
+        """Memory-ledger contract: the only state is two host
+        counters — no device bytes beyond the bookkeeping."""
+        return 16
+
     def trace_contract(self):
         return {
             "kind": "device",

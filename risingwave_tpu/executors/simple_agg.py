@@ -94,6 +94,13 @@ class SimpleAggExecutor(Executor, Checkpointable):
             "table_ids": (self.table_id,),
         }
 
+    def state_nbytes(self) -> int:
+        """Device bytes held (host-side estimate; no sync)."""
+        return sum(
+            getattr(leaf, "nbytes", 0)
+            for leaf in jax.tree.leaves(self.state)
+        )
+
     def trace_contract(self):
         return {
             "kind": "device",

@@ -136,6 +136,15 @@ class ArenaBufferedExecutor(Executor, Checkpointable):
             "table_ids": (self.table_id,),
         }
 
+    def state_nbytes(self) -> int:
+        """Device bytes held (host-side estimate; no sync)."""
+        return sum(
+            leaf.nbytes
+            for leaf in jax.tree.leaves(
+                (self.buf, self.bnulls, self.valid, self.seq)
+            )
+        )
+
     def trace_contract(self):
         return {
             "kind": "device",

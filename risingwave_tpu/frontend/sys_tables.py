@@ -108,6 +108,39 @@ SYS_SCHEMAS: Dict[str, Schema] = {
             ("detail", DataType.VARCHAR),
         ]
     ),
+    # memory governor ledger: one row per accounted state table plus a
+    # "_total" row carrying the global reconciliation (ledger vs
+    # deviceprof-modeled vs sampled memory_stats) and the budget math
+    "rw_memory": Schema(
+        [
+            ("table_id", DataType.VARCHAR),
+            ("executor", DataType.VARCHAR),
+            ("ledger_bytes", DataType.INT64),
+            ("modeled_bytes", DataType.INT64),
+            ("sampled_bytes", DataType.INT64),
+            ("budget_bytes", DataType.INT64),
+            ("headroom_bytes", DataType.INT64),
+            ("high_water", DataType.INT64),
+            ("pinned", DataType.INT64),
+            ("vetoes", DataType.INT64),
+        ]
+    ),
+    # overload ladder + admission credits: one row per fragment credit
+    # window (or a single "-" row before any throttling), each carrying
+    # the ladder's current rung, score, flap count and last transition
+    "rw_overload_state": Schema(
+        [
+            ("fragment", DataType.VARCHAR),
+            ("credit", DataType.FLOAT64),
+            ("state", DataType.VARCHAR),
+            ("score", DataType.FLOAT64),
+            ("flaps", DataType.INT64),
+            ("last_from", DataType.VARCHAR),
+            ("last_to", DataType.VARCHAR),
+            ("last_ts_ms", DataType.INT64),
+            ("last_epoch", DataType.INT64),
+        ]
+    ),
 }
 
 
@@ -332,6 +365,71 @@ def _rows_recovery_events(session) -> List[dict]:
     return rows
 
 
+def _rows_memory(session) -> List[dict]:
+    gov = getattr(session.runtime, "memory_governor", None)
+    if gov is None:
+        return []
+    snap = gov.snapshot()
+    rows = []
+    for t in gov.ledger_snapshot():
+        rows.append(
+            {
+                "table_id": t["table_id"],
+                "executor": t["executor"],
+                "ledger_bytes": t["ledger_bytes"],
+                "modeled_bytes": None,
+                "sampled_bytes": None,
+                "budget_bytes": None,
+                "headroom_bytes": None,
+                "high_water": t["high_water"],
+                "pinned": int(t["pinned"]),
+                "vetoes": t["vetoes"],
+            }
+        )
+    rows.sort(key=lambda r: -r["ledger_bytes"])
+    rows.append(
+        {
+            "table_id": "_total",
+            "executor": "-",
+            "ledger_bytes": snap["ledger_bytes"],
+            "modeled_bytes": snap["modeled_bytes"],
+            "sampled_bytes": snap["sampled_bytes"],
+            "budget_bytes": snap["budget_bytes"],
+            "headroom_bytes": snap["headroom_bytes"],
+            "high_water": None,
+            "pinned": None,
+            "vetoes": snap["vetoes"],
+        }
+    )
+    return rows
+
+
+def _rows_overload_state(session) -> List[dict]:
+    gov = getattr(session.runtime, "memory_governor", None)
+    if gov is None:
+        return []
+    lad = gov.ladder.snapshot()
+    last = (lad["transitions"] or [{}])[-1]
+    base = {
+        "state": lad["state"],
+        "score": lad["score"],
+        "flaps": lad["flaps"],
+        "last_from": last.get("from", ""),
+        "last_to": last.get("to", ""),
+        "last_ts_ms": (
+            int(last["ts"] * 1000) if last.get("ts") is not None else None
+        ),
+        "last_epoch": last.get("epoch"),
+    }
+    credits = gov.admission.credits
+    if not credits:
+        return [dict(base, fragment="-", credit=1.0)]
+    return [
+        dict(base, fragment=frag, credit=c)
+        for frag, c in sorted(credits.items())
+    ]
+
+
 _BUILDERS: Dict[str, Callable] = {
     "rw_fragments": _rows_fragments,
     "rw_arrangements": _rows_arrangements,
@@ -340,6 +438,8 @@ _BUILDERS: Dict[str, Callable] = {
     "rw_channel_depths": _rows_channel_depths,
     "rw_fusion_status": _rows_fusion_status,
     "rw_recovery_events": _rows_recovery_events,
+    "rw_memory": _rows_memory,
+    "rw_overload_state": _rows_overload_state,
 }
 
 

@@ -376,6 +376,50 @@ class MetricsRegistry:
             )
         except Exception:
             fresh_rows = ""
+        # memory & overload (runtime/memory_governor.py): the device-
+        # state ledger vs budget, the overload ladder's rung and the
+        # per-fragment admission credits — the operator's first look
+        # when sources start lagging on purpose
+        mem_rows = ov_rows = ""
+        try:
+            gov = getattr(rt, "memory_governor", None) if rt else None
+            if gov is not None and gov.enabled:
+                snap = gov.snapshot()
+                lad, adm = snap["ladder"], snap["admission"]
+                for k, v in (
+                    ("overload state", lad["state"]),
+                    ("pressure score", lad["score"]),
+                    ("ladder flaps", lad["flaps"]),
+                    ("ledger bytes", f"{snap['ledger_bytes']:,}"),
+                    (
+                        "budget bytes",
+                        f"{snap['budget_bytes']:,}"
+                        if snap["budget_bytes"] is not None
+                        else "-",
+                    ),
+                    (
+                        "headroom bytes",
+                        f"{snap['headroom_bytes']:,}"
+                        if snap["headroom_bytes"] is not None
+                        else "-",
+                    ),
+                    ("modeled bytes", f"{snap['modeled_bytes']:,}"),
+                    ("sampled bytes", snap["sampled_bytes"] or "-"),
+                    ("grow vetoes", snap["vetoes"]),
+                    ("spills", snap["spills"]),
+                    ("parked polls", adm["parked_polls"]),
+                    ("governor host ms", snap["host_ms"]),
+                ):
+                    mem_rows += (
+                        f"<tr><td>{escape(str(k))}</td>"
+                        f"<td>{escape(str(v))}</td></tr>"
+                    )
+                ov_rows = "".join(
+                    f"<tr><td>{escape(frag)}</td><td>{c}</td></tr>"
+                    for frag, c in sorted(adm["credits"].items())
+                )
+        except Exception:
+            mem_rows = ov_rows = ""
         # backpressure attribution: per-fragment verdict histogram +
         # live channel depths (which fragment slow barriers name)
         bp_rows = ""
@@ -404,6 +448,8 @@ td,th{{border:1px solid #999;padding:2px 8px}}h2{{margin-top:1.5em}}</style></he
 <h2>fused telemetry (last barrier)</h2><table><tr><th>fragment</th><th>rows in</th><th>dirty groups</th><th>mv rows</th><th>lane fill</th><th>padding frac</th></tr>{tel_rows or '<tr><td>no fused barriers yet</td></tr>'}</table>
 <h2>freshness (per MV)</h2><table><tr><th>mv</th><th>epoch</th><th>commit&rarr;visible ms</th><th>source&rarr;visible ms</th><th>event-time lag ms</th><th>barriers</th></tr>{fresh_rows or '<tr><td>no published barriers yet</td></tr>'}</table>
 <h2>backpressure attribution</h2><table><tr><th>fragment</th><th>p50 ms</th><th>p99 ms</th><th>verdicts</th><th>channel depth</th></tr>{bp_rows or '<tr><td>no verdicts yet</td></tr>'}</table>
+<h2>memory &amp; overload</h2><table>{mem_rows or '<tr><td>governor not armed (RW_HBM_BUDGET_BYTES / RW_OVERLOAD_LADDER)</td></tr>'}</table>
+<table><tr><th>fragment</th><th>admission credit</th></tr>{ov_rows or '<tr><td>no credit windows derived</td></tr>'}</table>
 <h2>resilience</h2><table><tr><th>metric</th><th>labels</th><th>value</th></tr>{res_rows or '<tr><td>no retries / breakers yet</td></tr>'}</table>
 <h2>events (last 25)</h2><table><tr><th>#</th><th>kind</th><th>detail</th></tr>{event_rows or '<tr><td>none</td></tr>'}</table>
 <p><a href="/metrics">/metrics</a> (prometheus text, <code>render_prometheus()</code>) &middot; <a href="/heap">/heap</a> &middot; <a href="/events">/events</a></p>

@@ -393,6 +393,13 @@ class ArrangementRegistry:
         self._lock = threading.RLock()
         self.attaches = 0
         self.frees = 0
+        # overload-ladder SHEDDING hook (runtime/memory_governor.py):
+        # while set, publish is pointer-swap-only — eager in-barrier
+        # materialization pauses, readers fall back to the lock path
+        # (lazy per-demand snapshots / the last stable version: a
+        # lagged-but-consistent view) and demand re-latches once the
+        # ladder recovers below SHEDDING
+        self.shed_eager = False
 
     @property
     def runtime(self):
@@ -552,6 +559,15 @@ class ArrangementRegistry:
             # feature (sessions always run in_flight=1)
             return
         gen = rt._write_gen
+        if self.shed_eager:
+            # SHEDDING: no in-barrier materialization — swap the
+            # version pointer only. Read demand stays latched in the
+            # arrangement, so the first post-shed publish materializes
+            # again for its readers.
+            for arr in self._live:
+                arr._reads_since_publish = 0
+                arr.version = _Version(epoch, None, gen)
+            return
         for arr in self._live:
             arr.publish(epoch, gen)
 

@@ -245,6 +245,18 @@ class BucketAllocator:
         # the apply path stops paying a device read + rebuild per
         # chunk (re-checked once per barrier via note_barrier)
         self._saturated = False
+        # memory-governor veto surface (runtime/memory_governor.py):
+        # when set, grow_gate(cap, new_cap) must approve every grow
+        # plan() would return. A refusal latches _veto_hold so the
+        # apply path stops re-asking per chunk (same per-chunk-storm
+        # reasoning as _saturated); note_barrier re-probes. The veto
+        # MUST fire before plan() touches hysteresis state: a vetoed
+        # grow that later succeeds applies its _pending_shrink/_streak
+        # resets exactly once, at the grow that actually happens —
+        # the PR 13 K-stale-pack double-tick class of bug otherwise.
+        self.grow_gate = None
+        self._veto_hold = False
+        self.vetoes = 0
 
     @property
     def lattice(self) -> Tuple[int, ...]:
@@ -254,6 +266,7 @@ class BucketAllocator:
     def should_plan(self, cap: int, bound: int, incoming: int) -> bool:
         if (
             not self._saturated
+            and not self._veto_hold
             and bound + incoming > cap * self.policy.grow_at
         ):
             return True
@@ -295,6 +308,20 @@ class BucketAllocator:
             while survivors + incoming + margin > need * p.grow_at:
                 need <<= 1
             new_cap = min(max(need, p.min_cap), max(p.max_cap, cap))
+            if new_cap > cap and self.grow_gate is not None:
+                # governor veto gates GENUINE growth only (a same-cap
+                # tombstone compaction frees memory — always allowed)
+                try:
+                    allowed = bool(self.grow_gate(cap, new_cap))
+                except Exception:  # noqa: BLE001 — a broken gate never wedges
+                    allowed = True
+                if not allowed:
+                    # deferred, not denied: hysteresis state untouched —
+                    # the resets below belong to the grow that actually
+                    # runs, so a veto/release cycle ticks them once
+                    self._veto_hold = True
+                    self.vetoes += 1
+                    return None
             self._pending_shrink = None
             self._streak = 0
             if new_cap == cap and survivors + incoming > cap * p.grow_at:
@@ -345,9 +372,10 @@ class BucketAllocator:
     def note_barrier(self, cap: int, claimed: int) -> None:
         p = self.policy
         self.high_water = max(self.high_water, cap)
-        # saturation is re-evaluated once per barrier (expiry may have
-        # freed load), never per chunk
+        # saturation and the governor-veto hold are re-evaluated once
+        # per barrier (expiry/spill may have freed load), never per chunk
         self._saturated = False
+        self._veto_hold = False
         if (
             self.pinned
             or cap <= p.min_cap
@@ -381,6 +409,8 @@ class BucketAllocator:
             "high_water": self.high_water,
             "pending_shrink": self._pending_shrink,
             "saturated": self._saturated,
+            "veto_hold": self._veto_hold,
+            "vetoes": self.vetoes,
         }
 
 

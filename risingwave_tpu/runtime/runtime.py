@@ -150,6 +150,19 @@ class StreamingRuntime:
         from risingwave_tpu.runtime.bucketing import ShapeGovernor
 
         self.shape_governor = ShapeGovernor()
+        # HBM memory governor + overload ladder (runtime/
+        # memory_governor.py): global device-state ledger enforcing
+        # RW_HBM_BUDGET_BYTES via BucketAllocator grow vetoes + cold-
+        # tier spill, credit-based source admission, and the NORMAL ->
+        # THROTTLED -> SHEDDING -> DEGRADED ladder. Dormant (one
+        # attribute check per barrier) unless a budget or
+        # RW_OVERLOAD_LADDER arms it. Own instance per runtime.
+        from risingwave_tpu.runtime.memory_governor import MemoryGovernor
+
+        self.memory_governor = MemoryGovernor()
+        # the admission controller is the governor's: SourceManager
+        # attaches to THIS to have its polls credit-clamped
+        self.admission = self.memory_governor.admission
         # RW_SHAPE_WATCH_WARMUP=<N>: arm SignatureWatch from construction
         # and mark it stable after N barriers — the env-only way to run
         # the governor hot in production/soak without code changes
@@ -1372,6 +1385,12 @@ class StreamingRuntime:
             self._observe_freshness(tr)
         except Exception:  # noqa: BLE001 — accounting never faults
             pass
+        # memory governor + overload ladder: consumes the fresh state
+        # bytes and this barrier's backpressure verdict, applies veto/
+        # spill/ladder/credit actions. Runs on BOTH barrier paths (the
+        # pipelined closer lane finalizes traces here too); dormant =
+        # one attribute check. Never faults a barrier (self-guarded).
+        self.memory_governor.observe_barrier(self, tr)
         # flight recorder: the finalized trace is exactly one black-box
         # record (ring always; segment file when a dir is configured)
         blackbox.RECORDER.record_barrier(tr, runtime=self)

@@ -36,6 +36,13 @@ class SourceManager:
         # name -> (executor, parallelism, {split_id: worker})
         self._sources: Dict[str, Tuple[object, int, Dict[str, int]]] = {}
         self.changes_log: List[Tuple[str, str, int]] = []  # (src, split, worker)
+        # credit-based admission (runtime/memory_governor.py): when
+        # attached, every poll's max_rows_per_split is scaled by the
+        # feeding fragment's credit window; credit 0 parks the source
+        # (a zero-row poll — offsets stay anchored, exactly-once
+        # untouched: lag, never loss)
+        self._admission = None
+        self._fragment_of: Dict[str, str] = {}
 
     def register(self, name: str, executor, parallelism: int = 1) -> None:
         if parallelism < 1:
@@ -46,6 +53,27 @@ class SourceManager:
 
     def unregister(self, name: str) -> None:
         self._sources.pop(name, None)
+        self._fragment_of.pop(name, None)
+
+    # -- admission ---------------------------------------------------------
+    def attach_admission(
+        self, admission, fragment_of: Optional[Dict[str, str]] = None
+    ) -> None:
+        """Wire an :class:`AdmissionController` (usually
+        ``runtime.admission``) into the poll path. ``fragment_of``
+        maps source name -> the runtime fragment it feeds, so the
+        per-fragment credit window applies; an unmapped source is
+        governed by the tightest window (conservative)."""
+        self._admission = admission
+        if fragment_of:
+            self._fragment_of.update(fragment_of)
+
+    def _admit(self, name: str, requested: int) -> int:
+        if self._admission is None:
+            return requested
+        return self._admission.admit_rows(
+            self._fragment_of.get(name), requested
+        )
 
     def __contains__(self, name: str) -> bool:
         return name in self._sources
@@ -144,6 +172,9 @@ class SourceManager:
         None). Disjoint slots never double-read: the assignment
         partitions the split set."""
         executor, par, _ = self._sources[name]
+        # admission clamp: credits scale the poll window; 0 rows is a
+        # legitimate parked poll (offsets do not advance)
+        max_rows_per_split = self._admit(name, max_rows_per_split)
         if worker is None:
             return executor.poll(max_rows_per_split, capacity)
         if not 0 <= worker < par:

@@ -22,6 +22,7 @@ from risingwave_tpu.sim.chaos import (
     CrashingStore,
     CrashPoint,
     FlakyStore,
+    OverloadChaosRunner,
     chaos_seed,
 )
 from risingwave_tpu.sim.fake_device import (
@@ -38,6 +39,7 @@ __all__ = [
     "CrashingExecutor",
     "CrashingStore",
     "FlakyStore",
+    "OverloadChaosRunner",
     "WedgeableDevice",
     "chaos_seed",
 ]

@@ -425,3 +425,275 @@ class ChaosRunner:
         if flaky is not None:
             self.faults_injected += flaky.faults
         return obj
+
+
+class OverloadChaosRunner:
+    """Overload chaos: seeded ingest bursts + skewed key storms whose
+    cardinality ramp rides the bucket lattice's pow2 boundaries, driven
+    against a MEMORY-GOVERNED runtime (runtime/memory_governor.py). The
+    acceptance contract this runner holds:
+
+    - the degradation ladder walks the FULL arc — NORMAL -> THROTTLED
+      -> SHEDDING -> DEGRADED — and walks BACK to NORMAL once relief
+      lands (the commit lane catches up, cold-tier spill evicts the
+      durable groups, allocators shrink, windows close);
+    - the device-state ledger NEVER exceeds the HBM budget on any
+      governed barrier (zero OOM by construction: growth past the
+      budget is vetoed, sources lag at anchored offsets instead);
+    - the run never wedges: every offered row is eventually ingested
+      (lag, never loss) within a bounded barrier budget;
+    - the governed run's final MV is BIT-IDENTICAL to an unthrottled,
+      fault-free twin fed the same event prefix (exactly-once
+      untouched by admission control).
+
+    Two-pass, self-calibrating: pass 1 runs the TWIN (governor dormant)
+    over the same seeded schedule, recording the per-barrier footprint
+    trajectory (sum of ``state_nbytes()`` contracts — the same walk the
+    governor's ledger does). The budget is then set just above the
+    twin's peak (so deferral, never denial, and no emergency ``bump``)
+    and the ladder thresholds are calibrated INSIDE the measured
+    (warm floor, peak) band so the storm provably crosses every rung
+    and post-relief footprint provably descends below them. Pass 2
+    replays the schedule governed. Real bytes, real veto/spill/shrink
+    mechanics — only the thresholds adapt to the workload's scale.
+
+    ``make()`` returns a fresh workload object exposing:
+
+    - ``runtime``   — a StreamingRuntime (governor dormant at build);
+    - ``sources``   — a SourceManager owning every source (admission
+      attaches here, so throttling rides the REAL poll path);
+    - ``ingest(max_rows) -> int`` — poll the sources THROUGH
+      ``sources.poll`` (offered window = max_rows; admission clamps
+      it) and push into the runtime; returns rows actually ingested;
+    - ``drain()``    — the workload's drain action (close windows via
+      a watermark, flush the commit lane, ...) — a pure function of
+      the data ingested so far, so both passes drain identically;
+    - ``barrier()``  — one runtime barrier;
+    - ``mv()``       — the MV snapshot for the bit-identity compare;
+    - ``fragment_of`` (optional) — source name -> fragment map for
+      per-fragment credit windows.
+
+    Failure messages carry the seed (replay: ``RW_CHAOS_SEED=<seed>``).
+    """
+
+    def __init__(
+        self,
+        make: Callable[[], object],
+        seed: int = 0,
+        warm_epochs: int = 2,
+        storm_rows: int = 12_000,
+        burst_rows: int = 3_000,
+        drain_epochs: int = 60,
+        max_epochs: int = 400,
+        cooldown: int = 2,
+        budget_slack: float = 1.02,
+        require_full_ladder: bool = True,
+    ):
+        self.make = make
+        self.seed = seed
+        self.warm_epochs = warm_epochs
+        self.storm_rows = storm_rows
+        self.burst_rows = burst_rows
+        self.drain_epochs = drain_epochs
+        self.max_epochs = max_epochs
+        self.cooldown = cooldown
+        self.budget_slack = budget_slack
+        # how deep a rung the storm stacks before relief lands is
+        # seed-dependent; replay-contract tests relax this
+        self.require_full_ladder = require_full_ladder
+        # filled by run()
+        self.budget_bytes = 0
+        self.thresholds = {}
+        self.states_seen: list = []
+        self.report: dict = {}
+
+    def _fail(self, what: str) -> RuntimeError:
+        return RuntimeError(
+            f"overload chaos: {what} (seed={self.seed}; rerun with "
+            f"RW_CHAOS_SEED={self.seed} to replay; report={self.report})"
+        )
+
+    def _bursts(self):
+        """The seeded burst schedule: offered rows per storm epoch.
+        Bursty by construction — the rng alternates heavy bursts with
+        near-idle epochs, so the governed pass sees both the ramp and
+        the boundary-riding flap pressure."""
+        rng = random.Random(self.seed ^ 0xB00F)
+        offered, total = [], 0
+        while total < self.storm_rows:
+            if rng.random() < 0.3:
+                n = rng.randint(1, max(2, self.burst_rows // 20))
+            else:
+                n = rng.randint(self.burst_rows // 2, self.burst_rows)
+            n = min(n, self.storm_rows - total)
+            offered.append(n)
+            total += n
+        return offered
+
+    @staticmethod
+    def _footprint(runtime) -> int:
+        total = 0
+        for ex in runtime.executors():
+            fn = getattr(ex, "state_nbytes", None)
+            if fn is None:
+                continue
+            try:
+                total += int(fn())
+            except Exception:  # noqa: BLE001
+                pass
+        return total
+
+    def _twin_pass(self, offered):
+        """Unthrottled, fault-free twin: same schedule, governor
+        dormant. Returns (mv_snapshot, warm footprint, peak)."""
+        obj = self.make()
+        traj = []
+        for _ in range(self.warm_epochs):
+            obj.ingest(0)
+            obj.barrier()
+            traj.append(self._footprint(obj.runtime))
+        warm = max(traj) if traj else 0
+        for n in offered:
+            got = obj.ingest(n)
+            if got != n:
+                raise self._fail(
+                    f"twin ingest lagged ({got}/{n} rows) — the twin "
+                    "must be unthrottled"
+                )
+            obj.barrier()
+            traj.append(self._footprint(obj.runtime))
+        obj.drain()
+        for _ in range(self.drain_epochs):
+            obj.ingest(0)
+            obj.barrier()
+        return obj.mv(), warm, max(traj)
+
+    def _calibrate(self, warm, peak):
+        """Budget just above the twin's peak; ladder thresholds inside
+        the measured (warm floor, peak) band. The governed pass's
+        post-relief footprint returns to the warm level (spill evicts
+        the durable groups, allocators shrink), so descent below every
+        exit threshold (enter * exit_margin 0.85) is by construction."""
+        budget = int(peak * self.budget_slack)
+        floor = (warm / 0.85) / budget
+        hi = (peak / budget) - 0.01
+        span = hi - floor
+        if span < 0.15:
+            self.report.update(warm=warm, peak=peak, budget=budget)
+            raise self._fail(
+                f"calibration band too thin (floor={floor:.3f} "
+                f"peak_frac={hi:.3f}) — the storm must grow state well "
+                "past the warm steady footprint"
+            )
+        self.budget_bytes = budget
+        self.thresholds = {
+            "throttle_at": floor + 0.15 * span,
+            "shed_at": floor + 0.50 * span,
+            "degrade_at": floor + 0.85 * span,
+        }
+
+    def run(self):
+        """Run both passes; returns (governed_mv, twin_mv) for the
+        caller's bit-identity assert (the runner already asserted the
+        ladder walk, the budget bound and the no-wedge bound)."""
+        from risingwave_tpu.runtime.memory_governor import (
+            LADDER,
+            NORMAL,
+            OverloadLadder,
+        )
+
+        offered = self._bursts()
+        want, warm, peak = self._twin_pass(offered)
+        self._calibrate(warm, peak)
+
+        obj = self.make()
+        gov = obj.runtime.memory_governor
+        gov.budget_bytes = self.budget_bytes
+        gov.enabled = True
+        gov.ladder = OverloadLadder(
+            cooldown=self.cooldown, **self.thresholds
+        )
+        # the spill watermark must sit BELOW the DEGRADED rung: a
+        # parked source freezes the pressure it created, so relief has
+        # to keep firing on the barrier clock while parked (each pass
+        # frees whatever the commit lane has made durable since)
+        gov.spill_at = min(
+            gov.spill_at, self.thresholds["degrade_at"] * 0.95
+        )
+        obj.sources.attach_admission(
+            gov.admission, getattr(obj, "fragment_of", None)
+        )
+        self.states_seen = [NORMAL]
+        ledger_high = 0
+
+        def _barrier(ingested=0):
+            obj.barrier()
+            st = gov.ladder.state
+            if st != self.states_seen[-1]:
+                self.states_seen.append(st)
+            nonlocal ledger_high
+            ledger_high = max(ledger_high, gov.ledger_high)
+            if gov.ledger_high > gov.budget_bytes:
+                self.report.update(
+                    ledger=gov.ledger_high, budget=gov.budget_bytes
+                )
+                raise self._fail(
+                    "ledger exceeded the HBM budget — the grow gate "
+                    "leaked (emergency bump or ungated allocator)"
+                )
+            return ingested
+
+        for _ in range(self.warm_epochs):
+            obj.ingest(0)
+            _barrier()
+        # storm: offer each burst until ADMISSION lets it fully in —
+        # lag, never loss; a parked source retries the same offer
+        epochs = self.warm_epochs
+        for n in offered:
+            remaining = n
+            while remaining > 0:
+                remaining -= _barrier(obj.ingest(remaining))
+                epochs += 1
+                if epochs > self.max_epochs:
+                    raise self._fail(
+                        f"wedged: {remaining} rows of a {n}-row burst "
+                        f"still unadmitted after {epochs} barriers"
+                    )
+        obj.drain()
+        drained = 0
+        while drained < self.max_epochs:
+            obj.ingest(0)
+            _barrier()
+            drained += 1
+            if (
+                drained >= self.drain_epochs
+                and gov.ladder.state == NORMAL
+            ):
+                break
+        self.report = {
+            "warm": warm,
+            "peak": peak,
+            "final": self._footprint(obj.runtime),
+            "budget": self.budget_bytes,
+            "ledger_high": ledger_high,
+            "states_seen": list(self.states_seen),
+            "vetoes": gov.vetoes,
+            "spills": gov.spills,
+            "parked_polls": gov.admission.parked_polls,
+            "flaps": gov.ladder.flaps,
+            "epochs": epochs,
+            "drain_barriers": drained,
+            "thresholds": dict(self.thresholds),
+        }
+        if self.require_full_ladder and set(self.states_seen) != set(LADDER):
+            raise self._fail(
+                f"ladder did not walk every rung: saw {self.states_seen}"
+            )
+        if len(set(self.states_seen)) < 2:
+            raise self._fail("the storm never raised the ladder at all")
+        if gov.ladder.state != NORMAL:
+            raise self._fail(
+                f"ladder never recovered: stuck at {gov.ladder.state} "
+                f"after the drain"
+            )
+        return obj.mv(), want

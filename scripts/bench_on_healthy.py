@@ -60,8 +60,12 @@ def log(msg: str) -> None:
 def tail_sentinel(last: dict) -> dict:
     """One poll of the bench child's sentinel status file; logs state
     transitions (always) and a periodic pulse (every ~60s) so the
-    round log carries the heartbeat trajectory. Returns updated
-    bookkeeping. Never raises — the watcher outlives a torn file."""
+    round log carries the heartbeat trajectory — and, when the memory
+    governor is armed in the child, every ``overload_state`` ladder
+    transition (NORMAL/THROTTLED/SHEDDING/DEGRADED), so a bench round
+    that ran under overload protection says so in BENCH_WATCH.log.
+    Returns updated bookkeeping. Never raises — the watcher outlives a
+    torn file."""
     try:
         with open(SENTINEL_STATE) as f:
             st = json.load(f)
@@ -69,6 +73,12 @@ def tail_sentinel(last: dict) -> dict:
         return last
     if st.get("ts") == last.get("ts"):
         return last  # stale: child not beating (compiling, or gone)
+    ov = st.get("overload_state")
+    if ov is not None and ov != last.get("overload_state"):
+        log(
+            f"overload: {last.get('overload_state') or 'NORMAL'} -> {ov} "
+            "[ladder transition]"
+        )
     state = st.get("state", "?")
     changed = state != last.get("state")
     pulse = time.monotonic() - last.get("logged_at", 0.0) >= 60
@@ -76,11 +86,12 @@ def tail_sentinel(last: dict) -> dict:
         log(
             f"sentinel: {state} latency={st.get('latency_ms')}ms "
             f"beats={st.get('beats')} wedges={st.get('wedges')}"
+            + (f" overload={ov}" if ov is not None else "")
             + (" [transition]" if changed else "")
         )
         last = dict(st, logged_at=time.monotonic())
     else:
-        last = dict(last, ts=st.get("ts"))
+        last = dict(last, ts=st.get("ts"), overload_state=ov)
     return last
 
 

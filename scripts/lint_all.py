@@ -16,7 +16,7 @@ Stages (all must pass; exit code is the OR of their failures):
    the fusion-feasibility analyzer: per-fragment fusible prefixes +
    RW-E8xx blockers with provenance.
 4. ``python scripts/perf_gate.py --smoke --blackbox --roofline
-   --serving --fusion`` — the
+   --serving --freshness --overload --fusion`` — the
    dispatch-cost regression gate: committed BENCH artifacts vs
    scripts/perf_budgets.json, the CPU q5 steady-state microbench
    (bounded device dispatches/barrier + host-python ms/row), the
@@ -24,8 +24,11 @@ Stages (all must pass; exit code is the OR of their failures):
    the write-ring -> SIGKILL -> reader-CLI crash-survival smoke), the
    shared-arrangement serving gate (CI-scale registration storm with
    O(families) compile count + concurrent pgwire readers under
-   budget), and the fusion ratchet vs FUSION_REPORT.json (fusible
-   prefixes must not shrink, host-sync counts must not grow).
+   budget), the overload-protection gate (seeded chaos storm against
+   the memory-governed runtime: zero OOM/wedge, twin bit-identity,
+   bounded flaps + recovery, governor overhead < 1%), and the fusion
+   ratchet vs FUSION_REPORT.json (fusible prefixes must not shrink,
+   host-sync counts must not grow).
 """
 
 from __future__ import annotations
@@ -185,13 +188,14 @@ def stage_fusion_report(out_path: str) -> int:
 
 def stage_perf_gate(fusion_current: str = None) -> int:
     print("[lint_all] perf_gate --smoke --blackbox --roofline --serving "
-          "--freshness + fusion ratchet (dispatch-cost + recorder/fsync "
-          "+ device-roofline + shared-arrangement serving + freshness "
-          "SLO + fusion-regression budgets)")
+          "--freshness --overload + fusion ratchet (dispatch-cost + "
+          "recorder/fsync + device-roofline + shared-arrangement serving "
+          "+ freshness SLO + overload-protection + fusion-regression "
+          "budgets)")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     cmd = [sys.executable, os.path.join(ROOT, "scripts", "perf_gate.py"),
            "--smoke", "--blackbox", "--roofline", "--serving",
-           "--freshness"]
+           "--freshness", "--overload"]
     if fusion_current and os.path.exists(fusion_current):
         cmd += ["--fusion-current", fusion_current]
     else:

@@ -908,6 +908,301 @@ def run_freshness_gate(budgets: dict, epochs: int = 6, events: int = 2_000):
     return violations, report
 
 
+def _overload_workload():
+    """A compact governed workload for the overload gate: skewed-key
+    storm source (offset-addressed, checkpointable) -> HashAgg(count,
+    sum) -> host MV on a real StreamingRuntime, with the agg wired to
+    the cold tier and a lagging commit lane — the same physics the
+    tier-1 chaos tests drive, at CI scale. Returns a ``make`` thunk
+    satisfying the OverloadChaosRunner workload contract."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from risingwave_tpu.array.chunk import StreamChunk
+    from risingwave_tpu.executors.hash_agg import HashAggExecutor
+    from risingwave_tpu.executors.materialize import MaterializeExecutor
+    from risingwave_tpu.ops.agg import AggCall
+    from risingwave_tpu.runtime import SourceManager, StreamingRuntime
+    from risingwave_tpu.runtime.pipeline import Pipeline
+    from risingwave_tpu.storage.object_store import MemObjectStore
+    from risingwave_tpu.storage.state_table import (
+        CheckpointManager,
+        Checkpointable,
+        StateDelta,
+    )
+
+    cap = 1 << 9
+
+    class _Split:
+        split_id = "storm-0"
+
+    class _Storm(Checkpointable):
+        table_id = "storm.src"
+
+        def __init__(self, seed, hot=48):
+            self.seed = seed
+            self.hot = hot
+            self.offset = 0
+            self._committed = 0
+            self.splits = [_Split()]
+
+        def discover(self):
+            pass
+
+        def _key(self, i):
+            h = (i * 2654435761 + self.seed * 40503) & 0xFFFFFFFF
+            if h % 3 == 0:
+                return h % self.hot
+            return self.hot + (h % (256 + i // 3))
+
+        def poll(self, max_rows_per_split, capacity, only=None):
+            n, chunks = int(max_rows_per_split), []
+            while n > 0:
+                take = min(n, capacity)
+                idx = np.arange(
+                    self.offset, self.offset + take, dtype=np.int64
+                )
+                keys = np.asarray(
+                    [self._key(int(i)) for i in idx], np.int64
+                )
+                chunks.append(
+                    StreamChunk.from_numpy(
+                        {"k": keys, "v": (idx % 97).astype(np.int64)},
+                        capacity,
+                    )
+                )
+                self.offset += take
+                n -= take
+            return chunks
+
+        def checkpoint_delta(self):
+            if self.offset == self._committed:
+                return []
+            self._committed = self.offset
+            return [
+                StateDelta(
+                    "storm.src",
+                    {"k": np.zeros(1, np.int64)},
+                    {"offset": np.asarray([self.offset], np.int64)},
+                    np.zeros(1, bool),
+                    ("k",),
+                )
+            ]
+
+        def restore_state(self, table_id, key_cols, value_cols):
+            off = value_cols.get("offset") if value_cols else None
+            self.offset = (
+                int(off[0]) if off is not None and len(off) else 0
+            )
+            self._committed = self.offset
+
+    class _Governed:
+        K_COMMIT = 8
+
+        def __init__(self, seed):
+            self.agg = HashAggExecutor(
+                group_keys=("k",),
+                calls=(
+                    AggCall("count_star", None, "cnt"),
+                    AggCall("sum", "v", "s"),
+                ),
+                schema_dtypes={"k": jnp.int64, "v": jnp.int64},
+                capacity=cap,
+                out_cap=1 << 11,
+                table_id="storm.agg",
+            )
+            self.mview = MaterializeExecutor(
+                pk=("k",), columns=("cnt", "s"), table_id="storm.mv"
+            )
+            self.runtime = StreamingRuntime(store=None)
+            self.runtime.register(
+                "storm", Pipeline([self.agg, self.mview])
+            )
+            self.sources = SourceManager()
+            self.src = _Storm(seed)
+            self.sources.register("bids", self.src)
+            self.fragment_of = {"bids": "storm"}
+            self.mgr = CheckpointManager(MemObjectStore())
+            self.agg.cold_reader = lambda keys: self.mgr.get_rows(
+                "storm.agg", keys
+            )
+            self._epoch = 0
+
+        def ingest(self, max_rows):
+            if max_rows <= 0:
+                return 0
+            before = self.src.offset
+            for ch in self.sources.poll(
+                "bids", max_rows_per_split=max_rows, capacity=cap
+            ):
+                self.runtime.push("storm", ch)
+            return self.src.offset - before
+
+        def barrier(self):
+            self.runtime.barrier()
+            self._epoch += 1
+            if self._epoch % self.K_COMMIT == 0:
+                self.mgr.commit_epoch(
+                    self._epoch << 16,
+                    [self.agg, self.mview, self.src],
+                )
+
+        def drain(self):
+            self._epoch += 1
+            self.mgr.commit_epoch(
+                self._epoch << 16, [self.agg, self.mview, self.src]
+            )
+
+        def mv(self):
+            return self.mview.snapshot()
+
+    return _Governed
+
+
+def run_overload_gate(
+    budgets: dict, storm_rows: int = 4_000, burst_rows: int = 1_000
+):
+    """The overload-protection gate (ROADMAP robustness, PR 17), two
+    legs:
+
+    1. CHAOS LEG — the seeded OverloadChaosRunner at CI scale: a
+       bursty skewed-key storm against the memory-governed runtime.
+       The runner itself enforces zero OOM (ledger <= budget on every
+       governed barrier), zero wedge (lag, never loss), and descent
+       back to NORMAL; the gate additionally holds the governed MV
+       bit-identical to the unthrottled twin, bounds ladder flapping
+       (``throttle_flaps_max``) and bounds how many post-storm
+       barriers recovery may take (``recover_within_barriers_max``).
+    2. STEADY LEG — a calm governed run with generous budget: the
+       governor's self-measured host_ms must stay under
+       ``governor_overhead_frac_max`` of the steady barrier wall (the
+       same <1% class as freshness tracking and the blackbox ring),
+       and the ledger must reconcile against an independent
+       ``state_nbytes()`` walk within ``ledger_drift_frac_max`` (a
+       stale or double-charged ledger is an OOM-by-lies).
+
+    Returns (violations, report)."""
+    import time
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if ROOT not in sys.path:
+        sys.path.insert(0, ROOT)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from risingwave_tpu.sim import OverloadChaosRunner, chaos_seed
+
+    ob = budgets.get("overload", {})
+    violations, report = [], {}
+    make = _overload_workload()
+    seed = chaos_seed(11)
+
+    # -- leg 1: the storm ------------------------------------------------
+    runner = OverloadChaosRunner(
+        make=lambda: make(seed),
+        seed=seed,
+        storm_rows=storm_rows,
+        burst_rows=burst_rows,
+        drain_epochs=40,
+        max_epochs=300,
+        # how deep the ladder stacks before relief lands is scale-
+        # dependent; the gate requires the ladder to BITE (>=2 states,
+        # runner-enforced) and to fully recover, not a fixed depth
+        require_full_ladder=False,
+    )
+    try:
+        got, want = runner.run()
+    except RuntimeError as e:
+        # the runner's own contract failed: OOM, wedge, or no recovery
+        violations.append(f"overload: {e}")
+        return violations, report
+    rep = runner.report
+    report.update(
+        {
+            "states_seen": rep.get("states_seen"),
+            "storm_epochs": rep.get("epochs"),
+            "drain_barriers": rep.get("drain_barriers"),
+            "budget_bytes": rep.get("budget"),
+            "ledger_high": rep.get("ledger_high"),
+            "vetoes": rep.get("vetoes"),
+            "spills": rep.get("spills"),
+            "parked_polls": rep.get("parked_polls"),
+            "flaps": rep.get("flaps"),
+        }
+    )
+    if got != want:
+        violations.append(
+            "overload: governed MV diverged from the unthrottled twin "
+            "— admission control broke exactly-once"
+        )
+    mx = ob.get("throttle_flaps_max")
+    if mx is not None and rep.get("flaps", 0) > mx:
+        violations.append(
+            f"overload: ladder flapped {rep['flaps']}x > budget {mx} "
+            "(thrashing between rungs — hysteresis regressed)"
+        )
+    mx = ob.get("recover_within_barriers_max")
+    if mx is not None and rep.get("drain_barriers", 0) > mx:
+        violations.append(
+            f"overload: {rep['drain_barriers']} post-storm barriers to "
+            f"reach NORMAL > budget {mx} (recovery stalled)"
+        )
+
+    # -- leg 2: steady overhead + ledger reconciliation ------------------
+    obj = make(seed)
+    gov = obj.runtime.memory_governor
+    gov.budget_bytes = 1 << 30  # generous: governed but never pressed
+    gov.enabled = True
+    obj.sources.attach_admission(gov.admission, obj.fragment_of)
+    obj.ingest(512)
+    obj.barrier()
+    obj.barrier()  # warm: compiles + gate attachment out of the window
+    gov.host_ms = 0.0
+    epochs = 24
+    t0 = time.perf_counter()
+    for _ in range(epochs):
+        obj.ingest(256)
+        obj.barrier()
+    wall_ms = (time.perf_counter() - t0) * 1e3
+    frac = gov.host_ms / wall_ms if wall_ms > 0 else 0.0
+    walk = 0
+    for ex in obj.runtime.executors():
+        fn = getattr(ex, "state_nbytes", None)
+        if fn is not None:
+            try:
+                walk += int(fn())
+            except Exception:  # noqa: BLE001
+                pass
+    drift = (
+        abs(gov.ledger_total - walk) / walk if walk > 0 else 0.0
+    )
+    report.update(
+        {
+            "steady_wall_ms": round(wall_ms, 2),
+            "governor_host_ms": round(gov.host_ms, 4),
+            "governor_overhead_frac": round(frac, 5),
+            "ledger_bytes": gov.ledger_total,
+            "ledger_walk_bytes": walk,
+            "ledger_drift_frac": round(drift, 5),
+        }
+    )
+    mx = ob.get("governor_overhead_frac_max")
+    if mx is not None and frac > mx:
+        violations.append(
+            f"overload: governor host overhead {frac:.4f} of the "
+            f"steady barrier > budget {mx} (the ledger walk must stay "
+            "host-cheap)"
+        )
+    mx = ob.get("ledger_drift_frac_max")
+    if mx is not None and drift > mx:
+        violations.append(
+            f"overload: ledger {gov.ledger_total}B vs independent "
+            f"state_nbytes walk {walk}B — drift {drift:.4f} > budget "
+            f"{mx} (a lying ledger un-guards the budget)"
+        )
+    return violations, report
+
+
 def _engine_generation() -> int:
     """Load provenance.py BY PATH: the pure-JSON gate mode must stay
     jax-free, and importing the package would pull jax in via
@@ -1294,6 +1589,15 @@ def main(argv=None) -> int:
         "overhead < 1%% of the steady barrier",
     )
     ap.add_argument(
+        "--overload",
+        action="store_true",
+        help="gate overload protection: seeded chaos storm against the "
+        "memory-governed runtime (zero OOM, zero wedge, MV bit-"
+        "identical to the unthrottled twin, bounded flaps + recovery) "
+        "plus the steady leg (governor host overhead < 1%% of the "
+        "barrier, ledger reconciles against state_nbytes)",
+    )
+    ap.add_argument(
         "--fusion-current",
         default=None,
         help="reuse an existing `lint --fusion-report --json` output "
@@ -1326,6 +1630,10 @@ def main(argv=None) -> int:
     if args.freshness:
         v, report = run_freshness_gate(budgets)
         print(f"[perf_gate] freshness: {json.dumps(report)}")
+        violations += v
+    if args.overload:
+        v, report = run_overload_gate(budgets)
+        print(f"[perf_gate] overload: {json.dumps(report)}")
         violations += v
     if args.fusion or args.fusion_current:
         try:

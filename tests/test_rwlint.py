@@ -409,6 +409,66 @@ def test_e702_duplicate_table_id():
     _ddl_reject(Pipeline(chain), "RW-E702", fragment="bad")
 
 
+from risingwave_tpu.executors.base import Executor as _ExecutorBase
+
+
+class _GhostState(_ExecutorBase):
+    """Registers a state table but is INVISIBLE to the memory ledger:
+    no state_nbytes()/state_bytes() contract, no allocator capacity
+    note. The RW-E708 target."""
+
+    def apply(self, chunk):
+        return [chunk]
+
+    def lint_info(self):
+        return {"table_ids": ("ghost.t",)}
+
+
+def test_e708_unaccounted_state_reports_only_by_default(monkeypatch):
+    """RW-E708 defaults to report-only even in strict sessions
+    (promoting it would refuse pre-existing DDL): the CREATE MV goes
+    through, the finding lands in lint_findings as a warning."""
+    monkeypatch.delenv("RW_STRICT_LINT", raising=False)
+    session = _session()
+    chain = [_GhostState(), _agg(keys=("a",))]
+    session.planner.plan = lambda sql: _planned(Pipeline(chain))
+    session.execute("CREATE MATERIALIZED VIEW bad AS SELECT a FROM src")
+    assert "bad" in session.runtime.fragments  # DDL accepted
+    found = [d for _n, d in session.lint_findings if d.code == "RW-E708"]
+    assert found and found[0].severity == "warning"
+    assert "ghost.t" in found[0].message
+
+
+def test_e708_refused_under_explicit_strict_lint(monkeypatch):
+    """An EXPLICITLY-set truthy RW_STRICT_LINT (the __main__ opt-in)
+    promotes unaccounted state to a refusal."""
+    monkeypatch.setenv("RW_STRICT_LINT", "1")
+    chain = [_GhostState(), _agg(keys=("a",))]
+    msg = _ddl_reject(Pipeline(chain), "RW-E708", fragment="bad")
+    assert "ghost.t" in msg and "ledger" in msg
+
+
+def test_e708_builtin_stateful_executors_are_ledger_visible():
+    """Every shipped stateful executor exposes the accounting contract
+    the governor budgets from — the Nexmark corpus must walk free of
+    RW-E708 (covered by test_all_nexmark_builders_clean) and the
+    canonical state-holders answer state_nbytes() directly."""
+    from risingwave_tpu.executors.materialize import MaterializeExecutor
+
+    agg = _agg(keys=("a",))
+    assert int(agg.state_nbytes()) >= 0
+    mv = MaterializeExecutor(pk=("a",), columns=("n",), table_id="m.t")
+    assert int(mv.state_nbytes()) >= 0
+    dmv = DeviceMaterializeExecutor(
+        pk=("a",),
+        columns=("n",),
+        schema_dtypes={"a": I64, "n": I64},
+        table_id="m.d",
+        capacity=64,
+    )
+    assert int(dmv.state_nbytes()) > 0
+
+
 def test_non_strict_records_instead_of_raising():
     session = _session(strict=False)
     chain = [_agg(keys=("zz",))]  # 'zz' not in src

@@ -13,7 +13,6 @@ agree not just on MV content but on the freshness frontier itself
 import socket
 import struct
 import threading
-import time
 
 import jax.numpy as jnp
 import numpy as np
@@ -42,6 +41,8 @@ RW_TABLES = (
     "rw_channel_depths",
     "rw_fusion_status",
     "rw_recovery_events",
+    "rw_memory",
+    "rw_overload_state",
 )
 
 
@@ -119,6 +120,59 @@ def test_rw_barrier_latency_carries_backpressure_verdict():
     # at least the latest barrier names its bottleneck fragment
     frags = [str(x) for x in out["backpressure_fragment"]]
     assert any(f for f in frags)
+
+
+def test_rw_memory_carries_the_ledger_and_total_row():
+    """rw_memory surfaces the governor's per-table device-state ledger
+    plus a ``_total`` reconciliation row (ledger vs deviceprof modeled
+    vs sampled memory_stats). The ledger is walked on the barrier
+    clock while ARMED (dormant by default: tier-1 untouched)."""
+    s = _session()
+    gov = s.runtime.memory_governor
+    assert gov.enabled is False  # dormant by default
+    gov.budget_bytes = 1 << 30
+    gov.enabled = True
+    s.execute("INSERT INTO t VALUES (4, 1)")  # a governed barrier
+    out, _ = s.execute(
+        "SELECT table_id, executor, ledger_bytes, vetoes FROM rw_memory"
+    )
+    tids = [str(x) for x in out["table_id"]]
+    assert "_total" in tids
+    i = tids.index("_total")
+    total = int(out["ledger_bytes"][i])
+    per_table = [
+        int(b) for t, b in zip(tids, out["ledger_bytes"]) if t != "_total"
+    ]
+    assert per_table, "no per-table ledger rows — executors unaccounted"
+    assert total == sum(per_table) >= 0
+    assert all(int(v) == 0 for v in out["vetoes"])  # ample budget
+
+
+def test_rw_overload_state_tracks_the_ladder_and_credits():
+    """rw_overload_state reflects the ladder rung and per-fragment
+    credit windows; a raised ladder with derived credits produces one
+    row per fragment."""
+    from risingwave_tpu.runtime.memory_governor import THROTTLED
+
+    s = _session()
+    out, _ = s.execute(
+        "SELECT fragment, credit, state, score, flaps FROM rw_overload_state"
+    )
+    assert [str(x) for x in out["state"]] == ["NORMAL"]
+    assert float(out["credit"][0]) == 1.0
+
+    gov = s.runtime.memory_governor
+    gov.ladder.step(0.99)  # raise the ladder directly
+    gov.admission.rederive(THROTTLED, 0.8, fragments=("m",))
+    out, _ = s.execute(
+        "SELECT fragment, credit, state, last_to FROM rw_overload_state"
+    )
+    frags = [str(x) for x in out["fragment"]]
+    assert "m" in frags
+    i = frags.index("m")
+    assert 0.0 <= float(out["credit"][i]) <= 1.0
+    assert str(out["state"][i]) == "DEGRADED"
+    assert str(out["last_to"][i]) == "DEGRADED"
 
 
 def test_rw_ddl_guard():
