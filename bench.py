@@ -1315,6 +1315,19 @@ def main():
         help="child self-timeout: exit via normal teardown (never "
         "leaves a wedged TPU client behind)",
     )
+    ap.add_argument(
+        "--multichip",
+        type=int,
+        nargs="?",
+        const=8,
+        default=None,
+        metavar="N",
+        help="run the N-virtual-device sharded dryrun (q5/q8/q7 MV "
+        "parity vs serial + mid-stream kill/recover) with MESHPROF "
+        "armed and stamp the structured MULTICHIP.json artifact: "
+        "provenance + per-query per-shard attribution, exchange "
+        "matrix, and skew verdicts",
+    )
     args = ap.parse_args()
 
     if args.alarm_s:
@@ -1355,6 +1368,35 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+
+    if args.multichip:
+        # the sharded dryrun is self-contained (forces virtual CPU
+        # devices + arms MESHPROF internally); the artifact carries
+        # the structured mesh doc so perf_trend can chart per-shard
+        # attribution and skew across rounds, replacing the old
+        # stdout-tail wrapper (MULTICHIP_r0*.json)
+        import os
+
+        import __graft_entry__ as graft
+
+        doc = {"multichip": True, "ts": time.time()}
+        doc.update(_provenance_fields())
+        try:
+            doc.update(graft.dryrun_multichip(args.multichip))
+            doc["ok"] = True
+        except Exception as e:  # noqa: BLE001 — artifact carries the failure
+            doc["ok"] = False
+            doc["error"] = repr(e)
+        finally:
+            from risingwave_tpu.parallel.meshprof import MESHPROF
+
+            MESHPROF.disable()
+        tmp = "MULTICHIP.json.tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, "MULTICHIP.json")
+        print(json.dumps(doc))
+        return 0 if doc["ok"] else 1
 
     if args.only:
         # child mode: one query, one shape, in-process — with the
@@ -1608,4 +1650,4 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

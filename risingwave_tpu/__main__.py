@@ -281,6 +281,19 @@ def _blackbox_read(args) -> None:
                 extra += f" sen={r['sentinel']}"
             if "channel_depths" in r:
                 extra += f" depths={r['channel_depths']}"
+            if "mesh" in r:
+                m = r["mesh"]
+                extra += (
+                    f" mesh[n={m.get('n_shards')}"
+                    f" cov={m.get('coverage_frac', 0.0):.0%}"
+                )
+                sk = m.get("skew")
+                if sk:
+                    extra += (
+                        f" SKEW shard{sk.get('shard')}"
+                        f" x{sk.get('ratio', 0.0):.1f}"
+                    )
+                extra += "]"
             if args.roofline and "modeled_bytes" in r:
                 extra += (
                     f" model={r['modeled_bytes'] / 1e6:.1f}MB"
@@ -318,6 +331,26 @@ def _blackbox_read(args) -> None:
                     "blackbox roofline: no modeled-bytes records "
                     "(deviceprof was not armed in the writing process)"
                 )
+        meshed = [r for r in recs if r.get("mesh")]
+        if meshed:
+            # mesh footer: the last sharded barrier's per-shard locals
+            # + (src,dst) exchange-row matrix — the post-mortem answer
+            # to "which shard was hot when the segment ended"
+            m = meshed[-1]["mesh"]
+            loc = " ".join(
+                f"s{i}={v:.1f}"
+                for i, v in enumerate(m.get("shard_local_ms") or [])
+            )
+            print(
+                f"blackbox mesh: {len(meshed)} sharded barrier(s), "
+                f"last n={m.get('n_shards')} "
+                f"cov={m.get('coverage_frac', 0.0):.0%}  {loc}"
+            )
+            xm = m.get("exchange_rows")
+            if xm:
+                for src, row in enumerate(xm):
+                    cells = " ".join(f"{int(v):>7d}" for v in row)
+                    print(f"  exchange src{src}: {cells}")
         if not doc["monotonic"]:
             print("blackbox: WARNING — epoch timeline is NOT monotonic")
         if args.trace:

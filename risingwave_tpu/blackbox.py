@@ -357,6 +357,35 @@ class FlightRecorder:
                     float(getattr(trace, "backpressure_ms", 0.0)), 3
                 ),
             }
+        # mesh observability (ISSUE 18): compact per-shard attribution
+        # for sharded barriers — shard count, coverage, phase split,
+        # per-shard local ms, (src,dst) row matrix, skew verdict
+        msh = getattr(trace, "mesh", None)
+        if msh:
+            try:
+                rec["msh"] = {
+                    "n": msh.get("n_shards"),
+                    "wall": round(float(msh.get("wall_ms", 0.0)), 3),
+                    "att": round(
+                        float(msh.get("attributed_ms", 0.0)), 3
+                    ),
+                    "cov": round(
+                        float(msh.get("coverage_frac", 0.0)), 4
+                    ),
+                    "ph": {
+                        k: round(float(v), 3)
+                        for k, v in (msh.get("phases_ms") or {}).items()
+                        if v
+                    },
+                    "loc": [
+                        round(float(v), 3)
+                        for v in (msh.get("shard_local_ms") or [])
+                    ],
+                    "xm": msh.get("exchange", {}).get("rows"),
+                    "skew": msh.get("skew"),
+                }
+            except Exception:  # noqa: BLE001 — recorder never faults
+                pass
         sen = SENTINEL
         if sen.running or sen.state != UNKNOWN:
             rec["sen"] = sen.state
@@ -806,6 +835,28 @@ class DeviceSentinel:
         except Exception:  # noqa: BLE001 — status stays heartbeat-only
             pass
         try:
+            # mesh skew + exchange pressure (ISSUE 18): sharded runs
+            # surface the hot-shard fraction and cumulative exchange
+            # rows so bench_on_healthy can tail skew transitions
+            from risingwave_tpu.metrics import REGISTRY as _REG
+            from risingwave_tpu.parallel.meshprof import MESHPROF
+
+            if MESHPROF.enabled:
+                g = _REG.gauges.get("shard_skew_frac")
+                if g is not None:
+                    doc["shard_skew_frac"] = round(float(g.get()), 4)
+                g = _REG.gauges.get("mesh_coverage_frac")
+                if g is not None:
+                    doc["mesh_coverage_frac"] = round(float(g.get()), 4)
+                snap = MESHPROF.table_snapshot()
+                ex = snap.get("exchange") or {}
+                if ex.get("rows"):
+                    doc["exchange_rows_total"] = int(
+                        sum(sum(r) for r in ex["rows"])
+                    )
+        except Exception:  # noqa: BLE001 — status stays heartbeat-only
+            pass
+        try:
             tmp = path + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(doc, f)
@@ -1048,6 +1099,18 @@ def read_segment(path: str, last: Optional[int] = None) -> Dict:
             out["freshness"] = rec["fr"]
         if "bp" in rec:
             out["backpressure"] = rec["bp"]
+        if "msh" in rec:
+            m = rec["msh"]
+            out["mesh"] = {
+                "n_shards": m.get("n"),
+                "wall_ms": m.get("wall"),
+                "attributed_ms": m.get("att"),
+                "coverage_frac": m.get("cov"),
+                "phases_ms": m.get("ph", {}),
+                "shard_local_ms": m.get("loc", []),
+                "exchange_rows": m.get("xm"),
+                "skew": m.get("skew"),
+            }
         if "mem" in rec:
             out["memory_stats"] = rec["mem"]
         if "mb" in rec:

@@ -144,6 +144,11 @@ class EpochTrace:
     backpressure_fragment: Optional[str] = None
     backpressure_ms: float = 0.0
     backpressure: Dict = field(default_factory=dict)
+    # mesh observability (ISSUE 18): per-shard barrier attribution +
+    # exchange (src,dst) traffic matrix + hot-shard skew verdict for
+    # the multi-chip path, folded by MESHPROF.observe_barrier. None on
+    # serial barriers (the common case costs one attribute slot).
+    mesh: Optional[Dict] = None
 
     def add_stage(self, stage: str, ms: float, fragment: str = "-") -> None:
         self.stages_ms[stage] = self.stages_ms.get(stage, 0.0) + ms
@@ -242,6 +247,7 @@ class EpochTrace:
             "freshness": self.freshness,
             "backpressure_fragment": self.backpressure_fragment,
             "backpressure_ms": round(self.backpressure_ms, 3),
+            "mesh": self.mesh,
         }
 
 
@@ -305,6 +311,21 @@ def dump_stalls(
                 "ms": round(tr.backpressure_ms, 3),
                 "detail": tr.backpressure,
             }
+        # mesh section: when a sharded runtime is active, a stall dump
+        # names the hot shard — per-shard occupancy/state depths + the
+        # last (src,dst) exchange matrix and skew verdict
+        from risingwave_tpu.parallel.meshprof import MESHPROF
+
+        if MESHPROF.enabled:
+            msnap = MESHPROF.table_snapshot()
+            if msnap.get("tables") or msnap.get("last_barrier"):
+                doc["mesh"] = {
+                    "tables": msnap.get("tables"),
+                    "last_barrier": msnap.get("last_barrier"),
+                    "exchange": msnap.get("exchange"),
+                }
+        if tr is not None and getattr(tr, "mesh", None):
+            doc.setdefault("mesh", {})["trace"] = tr.mesh
     except Exception as e:  # partial dump beats no dump
         doc["snapshot_error"] = repr(e)
     try:

@@ -48,6 +48,10 @@ Recording sites (grow as subsystems need them):
                        recompile storm: the named executor class was
                        pinned to its max bucket (reason
                        budget_exceeded | slow_device)
+- ``skew``           — parallel/meshprof.py hot-shard verdict: one
+                       shard's routed rows exceeded RW_SKEW_RATIO x
+                       the per-shard mean this barrier (fields:
+                       table_id, shard, ratio, frac, rows)
 """
 
 from __future__ import annotations

@@ -141,6 +141,36 @@ SYS_SCHEMAS: Dict[str, Schema] = {
             ("last_epoch", DataType.INT64),
         ]
     ),
+    # mesh observability (ISSUE 18): one row per (sharded table, shard)
+    # — key occupancy, rows routed in, state bytes and local-apply wall
+    # from the last closed barrier window (MESHPROF.table_snapshot)
+    "rw_shards": Schema(
+        [
+            ("table_id", DataType.VARCHAR),
+            ("executor", DataType.VARCHAR),
+            ("fragment", DataType.VARCHAR),
+            ("shard", DataType.INT64),
+            ("occupancy", DataType.INT64),
+            ("rows_in", DataType.INT64),
+            ("rows_in_total", DataType.INT64),
+            ("state_bytes", DataType.INT64),
+            ("local_ms", DataType.FLOAT64),
+            ("skew_ratio", DataType.FLOAT64),
+            ("is_hot", DataType.INT64),
+        ]
+    ),
+    # exchange-cost matrix: one row per (src, dst) shard pair with
+    # cumulative and last-barrier routed rows/bytes over all-to-all
+    "rw_exchange": Schema(
+        [
+            ("src", DataType.INT64),
+            ("dst", DataType.INT64),
+            ("rows_total", DataType.INT64),
+            ("bytes_total", DataType.INT64),
+            ("rows_last", DataType.INT64),
+            ("bytes_last", DataType.INT64),
+        ]
+    ),
 }
 
 
@@ -387,6 +417,26 @@ def _rows_memory(session) -> List[dict]:
             }
         )
     rows.sort(key=lambda r: -r["ledger_bytes"])
+    # per-shard breakdown (ISSUE 18): sharded tables get one sub-row
+    # per shard after the table rows, keyed "<table_id>/shard<i>"
+    shard_rows = []
+    for t in gov.ledger_snapshot():
+        for i, b in enumerate(t.get("shards") or ()):
+            shard_rows.append(
+                {
+                    "table_id": f"{t['table_id']}/shard{i}",
+                    "executor": t["executor"],
+                    "ledger_bytes": b,
+                    "modeled_bytes": None,
+                    "sampled_bytes": None,
+                    "budget_bytes": None,
+                    "headroom_bytes": None,
+                    "high_water": None,
+                    "pinned": None,
+                    "vetoes": None,
+                }
+            )
+    rows.extend(shard_rows)
     rows.append(
         {
             "table_id": "_total",
@@ -402,6 +452,75 @@ def _rows_memory(session) -> List[dict]:
         }
     )
     return rows
+
+
+def _rows_shards(session) -> List[dict]:
+    from risingwave_tpu.parallel.meshprof import MESHPROF
+
+    snap = MESHPROF.table_snapshot()
+    last = snap.get("last_barrier") or {}
+    skew = last.get("skew") or {}
+    rows = []
+    for tid, t in (snap.get("tables") or {}).items():
+        n = int(t.get("n_shards") or 0)
+        rin_last = t.get("rows_in_last") or []
+        rin_tot = t.get("rows_in_total") or []
+        occ = t.get("occupancy") or []
+        sb = t.get("state_bytes_per_shard") or []
+        loc = (last.get("shard_local_ms") or []) if last else []
+        for i in range(n):
+            hot = int(
+                skew.get("table_id") == tid and skew.get("shard") == i
+            )
+            rows.append(
+                {
+                    "table_id": tid,
+                    "executor": t.get("executor", ""),
+                    "fragment": t.get("pipeline", ""),
+                    "shard": i,
+                    "occupancy": occ[i] if i < len(occ) else None,
+                    "rows_in": rin_last[i] if i < len(rin_last) else 0,
+                    "rows_in_total": (
+                        rin_tot[i] if i < len(rin_tot) else 0
+                    ),
+                    "state_bytes": sb[i] if i < len(sb) else None,
+                    "local_ms": loc[i] if i < len(loc) else None,
+                    "skew_ratio": t.get("skew_ratio_last"),
+                    "is_hot": hot,
+                }
+            )
+    return rows
+
+
+def _rows_exchange(session) -> List[dict]:
+    from risingwave_tpu.parallel.meshprof import MESHPROF
+
+    ex = MESHPROF.table_snapshot().get("exchange") or {}
+    rows_m = ex.get("rows") or []
+    bytes_m = ex.get("bytes") or []
+    rows_l = ex.get("rows_last") or []
+    bytes_l = ex.get("bytes_last") or []
+
+    def _cell(m, i, j):
+        try:
+            return int(m[i][j])
+        except (IndexError, TypeError):
+            return 0
+
+    out = []
+    for i, row in enumerate(rows_m):
+        for j in range(len(row)):
+            out.append(
+                {
+                    "src": i,
+                    "dst": j,
+                    "rows_total": _cell(rows_m, i, j),
+                    "bytes_total": _cell(bytes_m, i, j),
+                    "rows_last": _cell(rows_l, i, j),
+                    "bytes_last": _cell(bytes_l, i, j),
+                }
+            )
+    return out
 
 
 def _rows_overload_state(session) -> List[dict]:
@@ -440,6 +559,8 @@ _BUILDERS: Dict[str, Callable] = {
     "rw_recovery_events": _rows_recovery_events,
     "rw_memory": _rows_memory,
     "rw_overload_state": _rows_overload_state,
+    "rw_shards": _rows_shards,
+    "rw_exchange": _rows_exchange,
 }
 
 

@@ -434,6 +434,61 @@ class MetricsRegistry:
                     f"<td>{s['p99']}</td><td>{s['count']}</td>"
                     f"<td>{d:g}</td></tr>"
                 )
+        # mesh observability (ISSUE 18): per-shard attribution + skew
+        # + the (src,dst) exchange matrix for the multi-chip path
+        mesh_rows = mesh_xm_rows = ""
+        try:
+            from risingwave_tpu.parallel.meshprof import MESHPROF
+
+            if MESHPROF.enabled:
+                msnap = MESHPROF.table_snapshot()
+                lb = msnap.get("last_barrier") or {}
+                cov = self.gauges.get("mesh_coverage_frac")
+                skg = self.gauges.get("shard_skew_frac")
+                for k, v in (
+                    ("shards", lb.get("n_shards", "-")),
+                    (
+                        "last coverage",
+                        f"{cov.get():.1%}" if cov is not None else "-",
+                    ),
+                    (
+                        "skew frac (max/mean-1)",
+                        f"{skg.get():.3f}" if skg is not None else "-",
+                    ),
+                    (
+                        "last skew verdict",
+                        lb.get("skew") or "-",
+                    ),
+                    ("mesh host ms", msnap.get("host_ms", 0.0)),
+                    (
+                        "calibration ms",
+                        msnap.get("calibration_ms", 0.0),
+                    ),
+                    ("errors", msnap.get("errors", 0)),
+                ):
+                    mesh_rows += (
+                        f"<tr><td>{escape(str(k))}</td>"
+                        f"<td>{escape(str(v))}</td></tr>"
+                    )
+                xm = (msnap.get("exchange") or {}).get("rows")
+                if xm:
+                    n = len(xm)
+                    hdr_cells = "".join(
+                        f"<th>dst{j}</th>" for j in range(n)
+                    )
+                    mesh_xm_rows = (
+                        f"<tr><th>rows</th>{hdr_cells}</tr>"
+                    )
+                    for src, row in enumerate(xm):
+                        cells = "".join(
+                            f"<td style='text-align:right'>{int(v):,}</td>"
+                            for v in row
+                        )
+                        mesh_xm_rows += (
+                            f"<tr><td>src{src}</td>{cells}</tr>"
+                        )
+        except Exception:
+            mesh_rows = mesh_xm_rows = ""
         return f"""<!doctype html><html><head><title>risingwave_tpu</title>
 <style>body{{font-family:monospace;margin:2em}}table{{border-collapse:collapse;margin:1em 0}}
 td,th{{border:1px solid #999;padding:2px 8px}}h2{{margin-top:1.5em}}</style></head><body>
@@ -450,6 +505,8 @@ td,th{{border:1px solid #999;padding:2px 8px}}h2{{margin-top:1.5em}}</style></he
 <h2>backpressure attribution</h2><table><tr><th>fragment</th><th>p50 ms</th><th>p99 ms</th><th>verdicts</th><th>channel depth</th></tr>{bp_rows or '<tr><td>no verdicts yet</td></tr>'}</table>
 <h2>memory &amp; overload</h2><table>{mem_rows or '<tr><td>governor not armed (RW_HBM_BUDGET_BYTES / RW_OVERLOAD_LADDER)</td></tr>'}</table>
 <table><tr><th>fragment</th><th>admission credit</th></tr>{ov_rows or '<tr><td>no credit windows derived</td></tr>'}</table>
+<h2>mesh (multi-chip)</h2><table>{mesh_rows or '<tr><td>mesh profiler not armed (MESHPROF.enable())</td></tr>'}</table>
+<table>{mesh_xm_rows or '<tr><td>no exchange traffic recorded</td></tr>'}</table>
 <h2>resilience</h2><table><tr><th>metric</th><th>labels</th><th>value</th></tr>{res_rows or '<tr><td>no retries / breakers yet</td></tr>'}</table>
 <h2>events (last 25)</h2><table><tr><th>#</th><th>kind</th><th>detail</th></tr>{event_rows or '<tr><td>none</td></tr>'}</table>
 <p><a href="/metrics">/metrics</a> (prometheus text, <code>render_prometheus()</code>) &middot; <a href="/heap">/heap</a> &middot; <a href="/events">/events</a></p>

@@ -39,6 +39,18 @@ def dest_shard(key_lanes, n_shards: int) -> jnp.ndarray:
     return vnode % n_shards
 
 
+def exchange_cols(chunk: StreamChunk) -> Dict[str, jnp.ndarray]:
+    """The lane set ``exchange_chunk`` actually ships: every column
+    plus the ops lane and null lanes as extra columns. Shared with the
+    meshprof phase probes so they pack exactly what the real exchange
+    packs."""
+    cols = dict(chunk.columns)
+    cols["__ops__"] = chunk.ops
+    for name, lane in chunk.nulls.items():
+        cols["__null__" + name] = lane
+    return cols
+
+
 def pack_buckets(
     chunk_cols: Dict[str, jnp.ndarray], valid, dest, n_shards, bucket_cap
 ):
@@ -47,7 +59,10 @@ def pack_buckets(
     Position within a destination bucket = number of earlier valid rows
     with the same destination (a cumsum per destination — n_shards is
     static and small, so this is n_shards vectorized passes, no sort).
-    Returns (buffers, valid_buffer, overflow).
+    Returns (buffers, valid_buffer, overflow, counts) where ``counts``
+    is the (n_shards,) per-destination valid-row vector — the
+    exchange-cost observability lane (meshprof); XLA drops it when a
+    caller ignores it.
     """
     n = valid.shape[0]
     pos = jnp.zeros(n, jnp.int32)
@@ -56,7 +71,8 @@ def pack_buckets(
         m = valid & (dest == d)
         pos = jnp.where(m, jnp.cumsum(m.astype(jnp.int32)) - 1, pos)
         counts.append(jnp.sum(m.astype(jnp.int32)))
-    overflow = jnp.any(jnp.stack(counts) > bucket_cap)
+    counts = jnp.stack(counts)
+    overflow = jnp.any(counts > bucket_cap)
 
     in_cap = valid & (pos < bucket_cap)
     flat = dest * bucket_cap + pos  # index into (n_shards*bucket_cap,)
@@ -74,7 +90,7 @@ def pack_buckets(
         .set(in_cap, mode="drop")
         .reshape(n_shards, bucket_cap)
     )
-    return out, vbuf, overflow
+    return out, vbuf, overflow, counts
 
 
 def exchange_chunk(
@@ -83,22 +99,23 @@ def exchange_chunk(
     n_shards: int,
     bucket_cap: int,
     axis: str,
-) -> Tuple[StreamChunk, jnp.ndarray]:
+) -> Tuple[StreamChunk, jnp.ndarray, jnp.ndarray]:
     """Route a per-shard chunk's rows to their key-owning shards.
 
     Call INSIDE a shard_map-ed program (per-shard view, no leading
     shard axis). Ops and null lanes ride the same buckets as extra
     columns. Returns (received_chunk of capacity n_shards*bucket_cap,
-    overflow_flag). Every row of the result lives on the shard that
-    owns vnode(key), so downstream keyed state is shard-local.
+    overflow_flag, counts) where ``counts`` is this shard's
+    (n_shards,) routed-valid-row histogram — already live in the
+    program for overflow detection, so threading it out costs one tiny
+    output buffer and gives meshprof its exchange-cost matrix row
+    without a second hash pass. Every row of the result lives on the
+    shard that owns vnode(key), so downstream keyed state is
+    shard-local.
     """
     dest = dest_shard(key_lanes, n_shards)
-    cols = dict(chunk.columns)
-    cols["__ops__"] = chunk.ops
-    for name, lane in chunk.nulls.items():
-        cols["__null__" + name] = lane
-    bufs, vbuf, overflow = pack_buckets(
-        cols, chunk.valid, dest, n_shards, bucket_cap
+    bufs, vbuf, overflow, counts = pack_buckets(
+        exchange_cols(chunk), chunk.valid, dest, n_shards, bucket_cap
     )
     ex = {
         n: jax.lax.all_to_all(b, axis, 0, 0, tiled=False)
@@ -121,4 +138,4 @@ def exchange_chunk(
         },
         ops=flatten(ex["__ops__"]),
     )
-    return received, overflow
+    return received, overflow, counts

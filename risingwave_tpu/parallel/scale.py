@@ -77,6 +77,12 @@ class ScaleController:
         """Double a sharded fragment's parallelism when any shard's
         table load crosses ``max_shard_load`` (the auto-parallelism
         policy; the reference reacts to worker join/leave instead).
+        Since ISSUE 18 every sharded executor class exposes
+        ``shard_occupancy`` (agg, dedup, join, mv, top_n) — not just
+        the agg — so the load scan sees the whole chain; an armed mesh
+        profiler's hot-shard verdict for one of this fragment's tables
+        also triggers the reshard (router imbalance is a scale signal
+        even while absolute occupancy is low).
         ``rebuild_at(n_shards)`` builds the fragment at that
         parallelism. Returns the new pipeline or None."""
         import numpy as np
@@ -85,17 +91,32 @@ class ScaleController:
         pipeline = rt.fragments[fragment]
         worst = 0.0
         n_shards = None
+        table_ids = set()
         for ex in pipeline.executors:
             occ = getattr(ex, "shard_occupancy", None)
             cap = getattr(ex, "capacity", None)
             if occ is None or not cap:
                 continue
+            if getattr(ex, "table_id", None) is not None:
+                table_ids.add(str(ex.table_id))
             load = float(np.asarray(occ()).max()) / cap
             if load > worst:
                 # n_shards follows the executor that actually set the
                 # worst load (a cooler sibling must not pick the size)
                 worst = load
                 n_shards = getattr(ex, "n_shards", None)
-        if n_shards is None or worst <= max_shard_load:
+        skewed = False
+        if n_shards is not None:
+            try:
+                from risingwave_tpu.parallel.meshprof import MESHPROF
+
+                if MESHPROF.enabled and MESHPROF.barriers:
+                    sk = MESHPROF.barriers[-1].get("skew")
+                    skewed = bool(
+                        sk and str(sk.get("table_id")) in table_ids
+                    )
+            except Exception:  # noqa: BLE001 — advisory signal only
+                skewed = False
+        if n_shards is None or (worst <= max_shard_load and not skewed):
             return None
         return self.reschedule(fragment, lambda _old: rebuild_at(2 * n_shards))

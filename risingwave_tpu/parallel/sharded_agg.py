@@ -50,6 +50,7 @@ from risingwave_tpu.ops.agg import AggCall
 from risingwave_tpu.ops.hash_table import HashTable, lookup_or_insert, set_live
 from risingwave_tpu.parallel.sharded_join import (
     double_bucket_cap,
+    stacked_state_nbytes_per_shard,
     track_bucket_cap,
 )
 from risingwave_tpu.parallel.exchange import (
@@ -150,6 +151,7 @@ class ShardedHashAgg(Executor, Checkpointable):
         self._step = None  # built lazily (needs bucket_cap from chunk)
         self._insert_bound = 0  # per-shard upper bound of claimed slots
         self._built_bucket_cap: Optional[int] = None
+        self.ex_counts_last = None  # (n, n) routed-row histogram, device
 
     # -- the sharded step -------------------------------------------------
     def _build_step(self, chunk_cap: int):
@@ -168,7 +170,7 @@ class ShardedHashAgg(Executor, Checkpointable):
 
             # 1-3) vnode route + bucket pack + all_to_all ICI shuffle
             keys = _build_key_lanes(chunk, group_keys, nullable)
-            rchunk, overflow = exchange_chunk(
+            rchunk, overflow, ex_counts = exchange_chunk(
                 chunk, keys, n_shards, bucket_cap, axis
             )
 
@@ -197,6 +199,7 @@ class ShardedHashAgg(Executor, Checkpointable):
                 jax.tree.map(expand, table),
                 jax.tree.map(expand, state),
                 dropped[None],
+                ex_counts[None],  # (1, n): this shard's routing row
             )
 
         spec = P(self.axis)
@@ -204,7 +207,7 @@ class ShardedHashAgg(Executor, Checkpointable):
             local_step,
             mesh=self.mesh,
             in_specs=(spec, spec, spec, spec),
-            out_specs=(spec, spec, spec),
+            out_specs=(spec, spec, spec, spec),
             check_vma=False,
         )
         return jax.jit(shmapped, donate_argnums=(0, 1))
@@ -225,8 +228,8 @@ class ShardedHashAgg(Executor, Checkpointable):
         bucket_cap = self.bucket_cap or max(64, (2 * chunk_cap) // self.n_shards)
         self._maybe_grow(self.n_shards * bucket_cap)
         self._insert_bound += self.n_shards * bucket_cap
-        self.table, self.state, self.dropped = self._step(
-            self.table, self.state, self.dropped, chunk
+        self.table, self.state, self.dropped, self.ex_counts_last = (
+            self._step(self.table, self.state, self.dropped, chunk)
         )
         return []
 
@@ -470,9 +473,22 @@ def _sharded_agg_restore_state(self, table_id, key_cols, value_cols) -> None:
     ) if n else 0
 
 
+def _sharded_agg_state_nbytes(self) -> int:
+    """Stacked device bytes across all shards (memory-governor ledger
+    + meshprof state_bytes lane)."""
+    return int(
+        sum(
+            leaf.nbytes
+            for leaf in jax.tree.leaves((self.table, self.state))
+        )
+    )
+
+
 ShardedHashAgg.checkpoint_delta = _sharded_agg_checkpoint_delta
 ShardedHashAgg.shard_occupancy = _sharded_agg_shard_occupancy
 ShardedHashAgg.restore_state = _sharded_agg_restore_state
+ShardedHashAgg.state_nbytes = _sharded_agg_state_nbytes
+ShardedHashAgg.state_nbytes_per_shard = stacked_state_nbytes_per_shard
 
 
 def stack_chunks(chunks: Sequence[StreamChunk]) -> StreamChunk:

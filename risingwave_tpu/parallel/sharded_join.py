@@ -126,6 +126,7 @@ class ShardedDedup(Executor, Checkpointable):
         )  # [saw_delete, dropped|overflow]
         self._step = None
         self._built_bucket_cap: Optional[int] = None
+        self.ex_counts_last = None  # (n, n) routed-row histogram, device
 
     def _build_step(self, chunk_cap: int):
         n, axis, keys = self.n_shards, self.axis, self.keys
@@ -137,13 +138,15 @@ class ShardedDedup(Executor, Checkpointable):
                 lambda a: a[0], (table, sdirty, flags, chunk)
             )
             lanes = tuple(chunk.col(k) for k in keys)
-            rchunk, ex_ovf = exchange_chunk(chunk, lanes, n, bucket_cap, axis)
+            rchunk, ex_ovf, ex_counts = exchange_chunk(
+                chunk, lanes, n, bucket_cap, axis
+            )
             table, sdirty, out, saw_delete, dropped = dedup_step_fn(
                 table, sdirty, rchunk, keys
             )
             flags = flags | jnp.stack([saw_delete, dropped | ex_ovf])
             ex = lambda t: jax.tree.map(lambda a: a[None], t)
-            return ex(table), ex(sdirty), ex(flags), ex(out)
+            return ex(table), ex(sdirty), ex(flags), ex(out), ex_counts[None]
 
         spec = P(self.axis)
         return jax.jit(
@@ -151,7 +154,7 @@ class ShardedDedup(Executor, Checkpointable):
                 local,
                 mesh=self.mesh,
                 in_specs=(spec,) * 4,
-                out_specs=(spec,) * 4,
+                out_specs=(spec,) * 5,
                 check_vma=False,
             ),
             donate_argnums=(0, 1, 2),
@@ -160,8 +163,8 @@ class ShardedDedup(Executor, Checkpointable):
     def apply(self, chunk: StreamChunk) -> List[StreamChunk]:
         if self._step is None:
             self._step = self._build_step(chunk.valid.shape[-1])
-        self.table, self.sdirty, self.flags, out = self._step(
-            self.table, self.sdirty, self.flags, chunk
+        self.table, self.sdirty, self.flags, out, self.ex_counts_last = (
+            self._step(self.table, self.sdirty, self.flags, chunk)
         )
         return [out]
 
@@ -342,6 +345,7 @@ class ShardedHashJoin(Executor, Checkpointable):
         )
         self._steps: Dict[Tuple[str, int], object] = {}
         self._built_bucket_cap: Optional[int] = None
+        self.ex_counts_last = None  # (n, n) routed-row histogram, device
 
     def _build_step(self, arrival: str, chunk_cap: int):
         n, axis = self.n_shards, self.axis
@@ -362,7 +366,9 @@ class ShardedHashJoin(Executor, Checkpointable):
                 lambda a: a[0], (own, other, em_ovf, chunk)
             )
             lanes = tuple(chunk.col(k) for k in own_keys)
-            rchunk, ex_ovf = exchange_chunk(chunk, lanes, n, bucket_cap, axis)
+            rchunk, ex_ovf, ex_counts = exchange_chunk(
+                chunk, lanes, n, bucket_cap, axis
+            )
             own, other, cols, nulls, ops, valid, ovf = join_step_fn(
                 own,
                 other,
@@ -379,7 +385,7 @@ class ShardedHashJoin(Executor, Checkpointable):
             out = StreamChunk(columns=cols, valid=valid, nulls=nulls, ops=ops)
             em_ovf = em_ovf | ovf | ex_ovf
             ex = lambda t: jax.tree.map(lambda a: a[None], t)
-            return ex(own), ex(other), ex(em_ovf), ex(out)
+            return ex(own), ex(other), ex(em_ovf), ex(out), ex_counts[None]
 
         spec = P(self.axis)
         return jax.jit(
@@ -387,7 +393,7 @@ class ShardedHashJoin(Executor, Checkpointable):
                 local,
                 mesh=self.mesh,
                 in_specs=(spec,) * 4,
-                out_specs=(spec,) * 4,
+                out_specs=(spec,) * 5,
                 check_vma=False,
             ),
             donate_argnums=(0, 1, 2),
@@ -403,7 +409,7 @@ class ShardedHashJoin(Executor, Checkpointable):
             if arrival == "l"
             else (self.right, self.left)
         )
-        own, other, self._em_overflow, out = step(
+        own, other, self._em_overflow, out, self.ex_counts_last = step(
             own, other, self._em_overflow, chunk
         )
         if arrival == "l":
@@ -584,3 +590,58 @@ class ShardedHashJoin(Executor, Checkpointable):
             jnp.zeros((), jnp.bool_), self.mesh, self.axis
         )
         self._steps = {}  # capacities may have changed: recompile
+
+
+# -- mesh observability surface (meshprof / scale / memory governor) ------
+def stacked_state_nbytes_per_shard(self) -> List[int]:
+    """Uniform split of the stacked device state: every per-slot array
+    carries the same ``(n_shards, ...)`` shape, so per-shard bytes are
+    exactly total/n with NO device read — the rw_memory per-shard rows
+    and meshprof's state_bytes lane."""
+    n = self.n_shards
+    return [self.state_nbytes() // n] * n
+
+
+def _sharded_dedup_state_nbytes(self) -> int:
+    return int(
+        sum(
+            leaf.nbytes
+            for leaf in jax.tree.leaves(
+                (self.table, self.sdirty, self.flags)
+            )
+        )
+    )
+
+
+def _sharded_dedup_shard_occupancy(self):
+    """Per-shard claimed-slot counts (autoscale + skew input). One
+    packed device read."""
+    return np.asarray(
+        jnp.sum((self.table.fp1 != jnp.uint32(0)).astype(jnp.int32), axis=1)
+    )
+
+
+def _sharded_join_state_nbytes(self) -> int:
+    return int(
+        sum(
+            leaf.nbytes
+            for leaf in jax.tree.leaves((self.left, self.right))
+        )
+    )
+
+
+def _sharded_join_shard_occupancy(self):
+    occ = jnp.sum(
+        (self.left.table.fp1 != jnp.uint32(0)).astype(jnp.int32), axis=1
+    ) + jnp.sum(
+        (self.right.table.fp1 != jnp.uint32(0)).astype(jnp.int32), axis=1
+    )
+    return np.asarray(occ)
+
+
+ShardedDedup.state_nbytes = _sharded_dedup_state_nbytes
+ShardedDedup.state_nbytes_per_shard = stacked_state_nbytes_per_shard
+ShardedDedup.shard_occupancy = _sharded_dedup_shard_occupancy
+ShardedHashJoin.state_nbytes = _sharded_join_state_nbytes
+ShardedHashJoin.state_nbytes_per_shard = stacked_state_nbytes_per_shard
+ShardedHashJoin.shard_occupancy = _sharded_join_shard_occupancy

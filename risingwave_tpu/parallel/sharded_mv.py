@@ -39,6 +39,7 @@ from risingwave_tpu.parallel.exchange import dest_shard, exchange_chunk
 from risingwave_tpu.parallel.sharded_join import (
     double_bucket_cap,
     stack_for_mesh,
+    stacked_state_nbytes_per_shard,
     track_bucket_cap,
 )
 from risingwave_tpu.storage.state_table import (
@@ -109,6 +110,7 @@ class ShardedMaterialize(MvDeviceReadMixin, Executor, Checkpointable):
         self.state = stack_for_mesh(state1, mesh, self.axis)
         self._steps: Dict[int, object] = {}
         self.checkpoint_enabled = False
+        self.ex_counts_last = None  # (n, n) routed-row histogram, device
 
     # -- the sharded step -------------------------------------------------
     def _build_step(self, chunk_cap: int):
@@ -121,7 +123,9 @@ class ShardedMaterialize(MvDeviceReadMixin, Executor, Checkpointable):
                 lambda a: a[0], (table, state, chunk)
             )
             lanes = tuple(chunk.col(k) for k in pk)
-            rchunk, ex_ovf = exchange_chunk(chunk, lanes, n, bucket_cap, axis)
+            rchunk, ex_ovf, ex_counts = exchange_chunk(
+                chunk, lanes, n, bucket_cap, axis
+            )
             table, state = mv_step_fn(table, state, rchunk, pk, cols)
             state = MvDeviceState(
                 state.values,
@@ -131,7 +135,7 @@ class ShardedMaterialize(MvDeviceReadMixin, Executor, Checkpointable):
                 state.dropped | ex_ovf,
             )
             ex = lambda t: jax.tree.map(lambda a: a[None], t)
-            return ex(table), ex(state)
+            return ex(table), ex(state), ex_counts[None]
 
         spec = P(self.axis)
         return jax.jit(
@@ -139,7 +143,7 @@ class ShardedMaterialize(MvDeviceReadMixin, Executor, Checkpointable):
                 local,
                 mesh=self.mesh,
                 in_specs=(spec,) * 3,
-                out_specs=(spec,) * 2,
+                out_specs=(spec,) * 3,
                 check_vma=False,
             ),
             donate_argnums=(0, 1),
@@ -150,7 +154,9 @@ class ShardedMaterialize(MvDeviceReadMixin, Executor, Checkpointable):
         step = self._steps.get(cap)
         if step is None:
             step = self._steps[cap] = self._build_step(cap)
-        self.table, self.state = step(self.table, self.state, chunk)
+        self.table, self.state, self.ex_counts_last = step(
+            self.table, self.state, chunk
+        )
         return [chunk]
 
     def on_barrier(self, barrier: Barrier) -> List[StreamChunk]:
@@ -379,3 +385,16 @@ class ShardedMaterialize(MvDeviceReadMixin, Executor, Checkpointable):
         self.state = jax.device_put(jax.tree.map(stack, *states), sharding)
         self.capacity = cap
         self._steps = {}  # capacity may have changed: recompile
+
+
+# -- mesh observability surface (meshprof / scale / memory governor) ------
+def _sharded_mv_shard_occupancy(self):
+    """Per-shard claimed pk-slot counts (autoscale + skew input). One
+    packed device read."""
+    return np.asarray(
+        jnp.sum((self.table.fp1 != jnp.uint32(0)).astype(jnp.int32), axis=1)
+    )
+
+
+ShardedMaterialize.shard_occupancy = _sharded_mv_shard_occupancy
+ShardedMaterialize.state_nbytes_per_shard = stacked_state_nbytes_per_shard

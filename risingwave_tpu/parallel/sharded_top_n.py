@@ -40,6 +40,7 @@ from risingwave_tpu.ops.hash_table import (
 from risingwave_tpu.parallel.exchange import dest_shard, exchange_chunk
 from risingwave_tpu.parallel.sharded_join import (
     stack_for_mesh,
+    stacked_state_nbytes_per_shard,
     track_bucket_cap,
 )
 from risingwave_tpu.storage.state_table import (
@@ -100,6 +101,7 @@ class ShardedGroupTopN(Executor, Checkpointable):
         self.dropped = stack_for_mesh(jnp.zeros((), jnp.bool_), mesh, self.axis)
         self._step = None
         self._built_bucket_cap: Optional[int] = None
+        self.ex_counts_last = None  # (n, n) routed-row histogram, device
         # per-shard host mirrors of what was emitted
         self._emitted: List[Dict[Tuple, Dict[Tuple, Tuple]]] = [
             {} for _ in range(self.n_shards)
@@ -122,7 +124,7 @@ class ShardedGroupTopN(Executor, Checkpointable):
                 (table, rows, sdirty, edirty, dropped, chunk),
             )
             lanes = tuple(chunk.col(g) for g in group_by)
-            rchunk, ex_ovf = exchange_chunk(
+            rchunk, ex_ovf, ex_counts = exchange_chunk(
                 chunk, lanes, n, bucket_cap, axis
             )
             table, rows, sdirty, edirty, dr = _upsert_step_ed(
@@ -132,6 +134,7 @@ class ShardedGroupTopN(Executor, Checkpointable):
             ex = lambda t: jax.tree.map(lambda a: a[None], t)
             return (
                 ex(table), ex(rows), ex(sdirty), ex(edirty), ex(dropped),
+                ex_counts[None],
             )
 
         spec = P(self.axis)
@@ -140,7 +143,7 @@ class ShardedGroupTopN(Executor, Checkpointable):
                 local,
                 mesh=self.mesh,
                 in_specs=(spec,) * 6,
-                out_specs=(spec,) * 5,
+                out_specs=(spec,) * 6,
                 check_vma=False,
             ),
             donate_argnums=(0, 1, 2, 3, 4),
@@ -155,6 +158,7 @@ class ShardedGroupTopN(Executor, Checkpointable):
             self.sdirty,
             self.epoch_dirty,
             self.dropped,
+            self.ex_counts_last,
         ) = self._step(
             self.table,
             self.rows,
@@ -362,3 +366,34 @@ class ShardedGroupTopN(Executor, Checkpointable):
                     pulled[n][i].item() for n in self.names
                 )
         self._step = None
+
+
+# -- mesh observability surface (meshprof / scale / memory governor) ------
+def _sharded_top_n_state_nbytes(self) -> int:
+    return int(
+        sum(
+            leaf.nbytes
+            for leaf in jax.tree.leaves(
+                (
+                    self.table,
+                    self.rows,
+                    self.sdirty,
+                    self.stored,
+                    self.epoch_dirty,
+                )
+            )
+        )
+    )
+
+
+def _sharded_top_n_shard_occupancy(self):
+    """Per-shard claimed group-slot counts (autoscale + skew input).
+    One packed device read."""
+    return np.asarray(
+        jnp.sum((self.table.fp1 != jnp.uint32(0)).astype(jnp.int32), axis=1)
+    )
+
+
+ShardedGroupTopN.state_nbytes = _sharded_top_n_state_nbytes
+ShardedGroupTopN.state_nbytes_per_shard = stacked_state_nbytes_per_shard
+ShardedGroupTopN.shard_occupancy = _sharded_top_n_shard_occupancy

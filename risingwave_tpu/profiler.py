@@ -556,6 +556,16 @@ class DispatchProfiler:
                     doc["fused_stage_ms"] = parsed
             except Exception:  # noqa: BLE001 — capture must still land
                 pass
+        # mesh attribution (ISSUE 18): a slow-barrier capture on a
+        # sharded runtime names the hot shard and the exchange phase
+        # split without a separate reader pass
+        try:
+            from risingwave_tpu.parallel.meshprof import MESHPROF
+
+            if MESHPROF.enabled and MESHPROF.barriers:
+                doc["mesh"] = MESHPROF.barriers[-1]
+        except Exception:  # noqa: BLE001 — capture must still land
+            pass
         if extra:
             doc.update(extra)
         path = os.path.join(

@@ -41,6 +41,7 @@ from risingwave_tpu.executors.hop_window import HopWindowExecutor
 from risingwave_tpu.executors.project import ProjectExecutor
 from risingwave_tpu.expr import expr as E
 from risingwave_tpu.ops.hashing import VNODE_COUNT, hash_columns
+from risingwave_tpu.parallel.meshprof import MESHPROF
 from risingwave_tpu.runtime.graph import FragmentSpec, GraphRuntime
 from risingwave_tpu.runtime.pipeline import (
     FreshnessSurface,
@@ -465,7 +466,13 @@ class GraphPipeline(FreshnessSurface):
         """Block until every actor collected ``epoch``; drain what the
         terminal fragment emitted."""
         self.graph.wait_barrier(epoch)
-        return self.graph.drain(self._out)
+        outs = self.graph.drain(self._out)
+        # mesh observability: close this pipeline's per-shard window
+        # (one matrix read + phase split; no-op unless armed AND this
+        # graph carries sharded executors that were watched)
+        if MESHPROF.enabled:
+            MESHPROF.pipeline_barrier(self)
+        return outs
 
     def set_capture(self, enabled: bool) -> None:
         """Actors seal checkpoint deltas at the barrier (pipelined

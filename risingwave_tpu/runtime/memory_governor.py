@@ -493,6 +493,16 @@ class MemoryGovernor:
                         self._attach_gate(ex, alloc)
             if nb is None and not allocs:
                 continue
+            # per-shard ledger breakdown (ISSUE 18): sharded executors
+            # expose state_nbytes_per_shard() — the mesh rw_memory rows
+            # and hot-shard forensics read it from here, not the device
+            shards = None
+            sfn = getattr(ex, "state_nbytes_per_shard", None)
+            if sfn is not None:
+                try:
+                    shards = [int(v) for v in sfn()]
+                except Exception:  # noqa: BLE001
+                    shards = None
             tables.append(
                 {
                     "table_id": str(getattr(ex, "table_id", "")) or "-",
@@ -504,6 +514,7 @@ class MemoryGovernor:
                     "pinned": any(a.pinned for a in allocs),
                     "vetoes": sum(a.vetoes for a in allocs),
                     "saturated": any(a._saturated for a in allocs),
+                    "shards": shards,
                 }
             )
             total += nb or 0

@@ -186,6 +186,14 @@ class Pipeline(FreshnessSurface):
         RECORDER.record_pipeline_barrier(
             self._epoch, (t1 - t0) * 1e3, (t2 - t1) * 1e3
         )
+        # mesh observability: close this pipeline's per-shard window
+        # (no-op unless MESHPROF is armed and watched this chain; the
+        # import is deferred — meshprof pulls in the parallel package,
+        # which imports the executors this module's package feeds)
+        from risingwave_tpu.parallel.meshprof import MESHPROF
+
+        if MESHPROF.enabled:
+            MESHPROF.pipeline_barrier(self)
         return pending
 
     def watermark(self, column: str, value: int) -> List[StreamChunk]:
@@ -318,6 +326,10 @@ class TwoInputPipeline(FreshnessSurface):
         RECORDER.record_pipeline_barrier(
             self._epoch, (t1 - t0) * 1e3, (t2 - t1) * 1e3
         )
+        from risingwave_tpu.parallel.meshprof import MESHPROF
+
+        if MESHPROF.enabled:
+            MESHPROF.pipeline_barrier(self)
         return outs
 
     def _generated_watermarks(self) -> List[StreamChunk]:

@@ -418,6 +418,14 @@ class StreamingRuntime:
                 # table per read
                 if hasattr(ex, "cold_get_rows"):
                     ex.cold_get_rows = self.mgr.get_rows
+        # mesh observability: instrument sharded chains as they come up
+        # (no-op unless MESHPROF is armed AND the chain carries sharded
+        # executors — serial fragments stay byte-for-byte untouched;
+        # deferred import, same cycle as runtime/__init__'s lazy list)
+        from risingwave_tpu.parallel.meshprof import MESHPROF
+
+        if MESHPROF.enabled:
+            MESHPROF.watch(pipeline, name=name)
         if upstream is not None:
             self.subscribe(upstream, name, backfill=backfill)
 
@@ -1391,6 +1399,13 @@ class StreamingRuntime:
         # pipelined closer lane finalizes traces here too); dormant =
         # one attribute check. Never faults a barrier (self-guarded).
         self.memory_governor.observe_barrier(self, tr)
+        # mesh observability: fold the per-pipeline shard windows closed
+        # this barrier into one mesh doc on the trace (per-shard stage
+        # lanes + exchange matrix + skew verdict). Dormant = one
+        # attribute check; self-guarded, never faults a barrier.
+        from risingwave_tpu.parallel.meshprof import MESHPROF
+
+        MESHPROF.observe_barrier(self, tr)
         # flight recorder: the finalized trace is exactly one black-box
         # record (ring always; segment file when a dir is configured)
         blackbox.RECORDER.record_barrier(tr, runtime=self)

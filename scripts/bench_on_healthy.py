@@ -64,8 +64,14 @@ def tail_sentinel(last: dict) -> dict:
     governor is armed in the child, every ``overload_state`` ladder
     transition (NORMAL/THROTTLED/SHEDDING/DEGRADED), so a bench round
     that ran under overload protection says so in BENCH_WATCH.log.
-    Returns updated bookkeeping. Never raises — the watcher outlives a
-    torn file."""
+    When the child runs sharded with MESHPROF armed the sentinel also
+    carries ``shard_skew_frac`` / ``mesh_coverage_frac`` /
+    ``exchange_rows_total``: hot-shard onset and clearance (the skew
+    gauge leaving / returning to 0) are logged as transitions, and the
+    periodic pulse carries the exchange-row flow so a bench round's
+    mesh pressure survives in BENCH_WATCH.log even when the child's
+    stdout is lost. Returns updated bookkeeping. Never raises — the
+    watcher outlives a torn file."""
     try:
         with open(SENTINEL_STATE) as f:
             st = json.load(f)
@@ -79,19 +85,49 @@ def tail_sentinel(last: dict) -> dict:
             f"overload: {last.get('overload_state') or 'NORMAL'} -> {ov} "
             "[ladder transition]"
         )
+    skew = st.get("shard_skew_frac")
+    if skew is not None:
+        was_hot = (last.get("shard_skew_frac") or 0.0) > 0.0
+        if (skew > 0.0) != was_hot:
+            log(
+                f"mesh skew: {'cleared' if was_hot else 'HOT shard'} "
+                f"(shard_skew_frac {last.get('shard_skew_frac') or 0.0} "
+                f"-> {skew}) [skew transition]"
+            )
+    xr = st.get("exchange_rows_total")
     state = st.get("state", "?")
     changed = state != last.get("state")
     pulse = time.monotonic() - last.get("logged_at", 0.0) >= 60
     if changed or pulse:
+        mesh_bits = ""
+        if skew is not None or xr is not None:
+            mesh_bits = (
+                f" skew={skew} cover={st.get('mesh_coverage_frac')}"
+                f" xrows={xr}"
+                + (
+                    f" (+{xr - last['exchange_rows_total']})"
+                    if xr is not None
+                    and last.get("exchange_rows_total") is not None
+                    and xr >= last["exchange_rows_total"]
+                    else ""
+                )
+            )
         log(
             f"sentinel: {state} latency={st.get('latency_ms')}ms "
             f"beats={st.get('beats')} wedges={st.get('wedges')}"
             + (f" overload={ov}" if ov is not None else "")
+            + mesh_bits
             + (" [transition]" if changed else "")
         )
         last = dict(st, logged_at=time.monotonic())
     else:
-        last = dict(last, ts=st.get("ts"), overload_state=ov)
+        last = dict(
+            last,
+            ts=st.get("ts"),
+            overload_state=ov,
+            shard_skew_frac=skew,
+            exchange_rows_total=xr,
+        )
     return last
 
 

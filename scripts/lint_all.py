@@ -16,7 +16,7 @@ Stages (all must pass; exit code is the OR of their failures):
    the fusion-feasibility analyzer: per-fragment fusible prefixes +
    RW-E8xx blockers with provenance.
 4. ``python scripts/perf_gate.py --smoke --blackbox --roofline
-   --serving --freshness --overload --fusion`` — the
+   --serving --freshness --overload --mesh --fusion`` — the
    dispatch-cost regression gate: committed BENCH artifacts vs
    scripts/perf_budgets.json, the CPU q5 steady-state microbench
    (bounded device dispatches/barrier + host-python ms/row), the
@@ -26,9 +26,13 @@ Stages (all must pass; exit code is the OR of their failures):
    O(families) compile count + concurrent pgwire readers under
    budget), the overload-protection gate (seeded chaos storm against
    the memory-governed runtime: zero OOM/wedge, twin bit-identity,
-   bounded flaps + recovery, governor overhead < 1%), and the fusion
-   ratchet vs FUSION_REPORT.json (fusible prefixes must not shrink,
-   host-sync counts must not grow).
+   bounded flaps + recovery, governor overhead < 1%), the mesh-
+   observability gate (8-virtual-device child: per-shard attribution
+   covers >=90% of the sharded q5/q8 barrier wall, armed-vs-unarmed
+   bit-identity, seeded hot-shard skew verdict names the right shard,
+   mesh telemetry host overhead < 1%), and the fusion ratchet vs
+   FUSION_REPORT.json (fusible prefixes must not shrink, host-sync
+   counts must not grow).
 """
 
 from __future__ import annotations
@@ -188,14 +192,14 @@ def stage_fusion_report(out_path: str) -> int:
 
 def stage_perf_gate(fusion_current: str = None) -> int:
     print("[lint_all] perf_gate --smoke --blackbox --roofline --serving "
-          "--freshness --overload + fusion ratchet (dispatch-cost + "
-          "recorder/fsync + device-roofline + shared-arrangement serving "
-          "+ freshness SLO + overload-protection + fusion-regression "
-          "budgets)")
+          "--freshness --overload --mesh + fusion ratchet (dispatch-cost "
+          "+ recorder/fsync + device-roofline + shared-arrangement "
+          "serving + freshness SLO + overload-protection + mesh-"
+          "observability + fusion-regression budgets)")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     cmd = [sys.executable, os.path.join(ROOT, "scripts", "perf_gate.py"),
            "--smoke", "--blackbox", "--roofline", "--serving",
-           "--freshness", "--overload"]
+           "--freshness", "--overload", "--mesh"]
     if fusion_current and os.path.exists(fusion_current):
         cmd += ["--fusion-current", fusion_current]
     else:

@@ -1203,6 +1203,295 @@ def run_overload_gate(
     return violations, report
 
 
+def run_mesh_gate(budgets: dict):
+    """The mesh-observability gate (ROADMAP multi-chip, ISSUE 18), run
+    in a SUBPROCESS: the child pins ``JAX_PLATFORMS=cpu`` with
+    ``--xla_force_host_platform_device_count=8`` so a REAL 8-virtual-
+    device mesh drives the sharded q5/q8 fragments — while this
+    parent's other gates never see the forced device count. Contracts
+    (child-measured, parent-compared against ``budgets["mesh"]``):
+
+    1. Attribution coverage: per-shard + exchange-phase attribution
+       covers >= ``attribution_coverage_min`` of the measured sharded
+       barrier wall on q5 AND q8.
+    2. Bit-identity: the telemetry-armed q5 run's MV content equals an
+       unarmed twin fed identical chunks (observability may never
+       touch results).
+    3. Overhead: MESHPROF's self-measured host_ms over the steady
+       armed window < ``mesh_overhead_frac_max`` of the window wall
+       (calibration probes are booked separately and excluded).
+    4. Skew teeth: a seeded constant-key workload fires a hot-shard
+       verdict naming exactly the shard the router sends the key to.
+    5. Zero profiler errors.
+
+    Returns (violations, report)."""
+    import subprocess
+
+    mb = budgets.get("mesh", {})
+    violations, report = [], {}
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    cmd = [
+        sys.executable,
+        os.path.abspath(__file__),
+        "--mesh-child",
+    ]
+    try:
+        proc = subprocess.run(
+            cmd,
+            env=env,
+            cwd=ROOT,
+            capture_output=True,
+            text=True,
+            timeout=900,
+        )
+    except subprocess.TimeoutExpired:
+        violations.append("mesh: child timed out (900s)")
+        return violations, report
+    tail = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("MESH_CHILD_JSON: "):
+            tail = line[len("MESH_CHILD_JSON: "):]
+    if tail is None:
+        violations.append(
+            f"mesh: child produced no report (rc {proc.returncode}); "
+            f"stderr tail: {proc.stderr[-500:]!r}"
+        )
+        return violations, report
+    try:
+        report = json.loads(tail)
+    except json.JSONDecodeError as e:
+        violations.append(f"mesh: unparseable child report: {e}")
+        return violations, report
+    if report.get("fatal"):
+        violations.append(f"mesh: child failed: {report['fatal']}")
+        return violations, report
+
+    mn = mb.get("attribution_coverage_min")
+    if mn is not None:
+        for q in ("q5", "q8"):
+            cov = report.get(f"{q}_coverage_frac")
+            if cov is None:
+                violations.append(f"mesh: no {q} coverage measured")
+            elif cov < mn:
+                violations.append(
+                    f"mesh: {q} attribution covers {cov:.1%} of the "
+                    f"sharded barrier wall < budget {mn:.0%} (per-"
+                    "shard/exchange accounting lost track of the wall)"
+                )
+    if mb.get("require_bit_identical") and not report.get(
+        "bit_identical"
+    ):
+        violations.append(
+            "mesh: telemetry-armed q5 MV diverged from the unarmed "
+            "twin — observability touched results"
+        )
+    mx = mb.get("mesh_overhead_frac_max")
+    frac = report.get("overhead_frac")
+    if mx is not None and frac is not None and frac > mx:
+        violations.append(
+            f"mesh: profiler host overhead {frac:.4f} of the steady "
+            f"armed barrier > budget {mx} (per-shard accounting must "
+            "stay host-cheap)"
+        )
+    if mb.get("require_skew_verdict"):
+        if not report.get("skew_detected"):
+            violations.append(
+                "mesh: seeded constant-key workload fired NO hot-"
+                "shard verdict (skew detection regressed)"
+            )
+        elif report.get("skew_shard") != report.get("expected_shard"):
+            violations.append(
+                f"mesh: skew verdict named shard "
+                f"{report.get('skew_shard')} but the router sends the "
+                f"seeded key to shard {report.get('expected_shard')}"
+            )
+    mx = mb.get("errors_max")
+    if mx is not None and report.get("errors", 0) > mx:
+        violations.append(
+            f"mesh: {report['errors']} profiler errors > budget {mx}"
+        )
+    return violations, report
+
+
+def run_mesh_child() -> int:
+    """In-process body of the mesh gate (``--mesh-child``): assumes the
+    parent exported the 8-virtual-device CPU env. Prints one
+    ``MESH_CHILD_JSON:`` line; exit code 0 unless the workload itself
+    crashed (budget comparison happens in the parent)."""
+    import time
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if ROOT not in sys.path:
+        sys.path.insert(0, ROOT)
+    report: dict = {}
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        jax.config.update("jax_platforms", "cpu")
+        if jax.device_count() < 8:
+            raise RuntimeError(
+                f"need 8 virtual devices, got {jax.device_count()}"
+            )
+        from risingwave_tpu.connectors.nexmark import (
+            AUCTION_SCHEMA,
+            BID_SCHEMA,
+            PERSON_SCHEMA,
+            NexmarkConfig,
+            NexmarkGenerator,
+        )
+        from risingwave_tpu.parallel.exchange import dest_shard
+        from risingwave_tpu.parallel.meshprof import MESHPROF, _key_fn_for
+        from risingwave_tpu.parallel.sharded_agg import ShardedHashAgg
+        from risingwave_tpu.runtime.fragmenter import sharded_planned_mv
+        from risingwave_tpu.sql import Catalog, StreamPlanner
+
+        q5_sql = (
+            "CREATE MATERIALIZED VIEW q5 AS "
+            "SELECT auction, window_start, count(*) AS num "
+            "FROM HOP(bid, date_time, INTERVAL '2' SECOND, "
+            "INTERVAL '10' SECOND) GROUP BY auction, window_start"
+        )
+        q8_sql = (
+            "CREATE MATERIALIZED VIEW q8 AS "
+            "SELECT p.id, p.name, p.starttime FROM "
+            "(SELECT id, name, window_start AS starttime "
+            " FROM TUMBLE(person, date_time, INTERVAL '10' SECOND) "
+            " GROUP BY id, name, window_start) AS p "
+            "JOIN "
+            "(SELECT seller, window_start AS astarttime "
+            " FROM TUMBLE(auction, date_time, INTERVAL '10' SECOND) "
+            " GROUP BY seller, window_start) AS a "
+            "ON p.id = a.seller AND p.starttime = a.astarttime"
+        )
+        catalog = Catalog(
+            {
+                "bid": BID_SCHEMA,
+                "person": PERSON_SCHEMA,
+                "auction": AUCTION_SCHEMA,
+            }
+        )
+
+        def factory():
+            return lambda: StreamPlanner(catalog, capacity=1 << 12)
+
+        gen = NexmarkGenerator(NexmarkConfig())
+        bids = []
+        while len(bids) < 6:
+            c = gen.next_chunks(1_500, 1 << 11)["bid"]
+            if c is not None:
+                bids.append(c)
+
+        # -- leg 1: unarmed q5 twin (the bit-identity baseline) -------
+        unarmed = sharded_planned_mv(factory(), q5_sql, n_shards=8)
+        try:
+            for c in bids:
+                unarmed.pipeline.push(c)
+                unarmed.pipeline.barrier()
+            want = unarmed.mview.snapshot()
+        finally:
+            unarmed.pipeline.close()
+
+        # -- leg 2: armed q5 — coverage + overhead + bit-identity -----
+        MESHPROF.enable(probes=True)
+        q5 = sharded_planned_mv(factory(), q5_sql, n_shards=8)
+        MESHPROF.watch(q5.pipeline, name="q5")
+        try:
+            for c in bids[:2]:  # warm: compiles + probe calibration
+                q5.pipeline.push(c)
+                q5.pipeline.barrier()
+            MESHPROF.host_ms = 0.0
+            t0 = time.perf_counter()
+            for c in bids[2:]:
+                q5.pipeline.push(c)
+                q5.pipeline.barrier()
+            steady_ms = (time.perf_counter() - t0) * 1e3
+            got = q5.mview.snapshot()
+        finally:
+            q5.pipeline.close()
+        doc = MESHPROF.barriers[-1]
+        report["q5_coverage_frac"] = doc["coverage_frac"]
+        report["q5_wall_ms"] = doc["wall_ms"]
+        report["q5_phases_ms"] = doc["phases_ms"]
+        report["q5_shard_local_ms"] = doc["shard_local_ms"]
+        report["q5_exchange_rows"] = doc["exchange"]["rows"]
+        report["bit_identical"] = got == want
+        report["steady_wall_ms"] = round(steady_ms, 2)
+        report["mesh_host_ms"] = round(MESHPROF.host_ms, 3)
+        report["calibration_ms"] = round(MESHPROF.calibration_ms, 2)
+        report["overhead_frac"] = round(
+            MESHPROF.host_ms / steady_ms if steady_ms > 0 else 0.0, 5
+        )
+
+        # -- leg 3: armed q8 (join shape) — coverage ------------------
+        MESHPROF.reset_stats()
+        MESHPROF.enable(probes=False)
+        q8 = sharded_planned_mv(factory(), q8_sql, n_shards=8)
+        MESHPROF.watch(q8.pipeline, name="q8")
+        gen8 = NexmarkGenerator(NexmarkConfig())
+        try:
+            for _ in range(4):
+                chunks = gen8.next_chunks(2_000, 2048)
+                if chunks["person"] is not None:
+                    q8.pipeline.push_left(chunks["person"])
+                if chunks["auction"] is not None:
+                    q8.pipeline.push_right(chunks["auction"])
+                q8.pipeline.barrier()
+        finally:
+            q8.pipeline.close()
+        doc8 = MESHPROF.barriers[-1]
+        report["q8_coverage_frac"] = doc8["coverage_frac"]
+        report["q8_wall_ms"] = doc8["wall_ms"]
+
+        # -- leg 4: seeded skew — constant grouping key ---------------
+        MESHPROF.reset_stats()
+        hot_sql = (
+            "CREATE MATERIALIZED VIEW hot AS "
+            "SELECT auction, count(*) AS n FROM bid GROUP BY auction"
+        )
+        hot = sharded_planned_mv(factory(), hot_sql, n_shards=8)
+        MESHPROF.watch(hot.pipeline, name="hot")
+        agg = next(
+            ex
+            for ex in hot.pipeline.executors
+            if isinstance(ex, ShardedHashAgg)
+        )
+        skew_key = 1007
+        try:
+            for c in bids[:3]:
+                auc = np.asarray(c.col("auction"))
+                c = c.with_columns(
+                    auction=jnp.asarray(
+                        np.full(auc.shape, skew_key, auc.dtype)
+                    )
+                )
+                if "expected_shard" not in report:
+                    kf = _key_fn_for(agg, "agg", None)
+                    dest = np.asarray(dest_shard(kf(c), 8))
+                    live = np.asarray(c.valid)
+                    report["expected_shard"] = int(dest[live][0])
+                hot.pipeline.push(c)
+                hot.pipeline.barrier()
+        finally:
+            hot.pipeline.close()
+        sk = MESHPROF.barriers[-1]["skew"]
+        report["skew_detected"] = sk is not None
+        report["skew_shard"] = sk["shard"] if sk else None
+        report["skew_ratio"] = sk["ratio"] if sk else None
+        report["errors"] = MESHPROF.errors
+        MESHPROF.disable()
+    except Exception as e:  # noqa: BLE001 — parent turns this into a violation
+        report["fatal"] = repr(e)
+    print(f"MESH_CHILD_JSON: {json.dumps(report)}")
+    return 0
+
+
 def _engine_generation() -> int:
     """Load provenance.py BY PATH: the pure-JSON gate mode must stay
     jax-free, and importing the package would pull jax in via
@@ -1598,6 +1887,21 @@ def main(argv=None) -> int:
         "barrier, ledger reconciles against state_nbytes)",
     )
     ap.add_argument(
+        "--mesh",
+        action="store_true",
+        help="gate the mesh-observability layer on a real 8-virtual-"
+        "device sim: per-shard attribution covers >=90%% of the "
+        "sharded barrier wall on q5 and q8, armed-vs-unarmed MVs are "
+        "bit-identical, a seeded skewed workload yields the correct "
+        "skew_shard verdict, and mesh telemetry host overhead stays "
+        "< 1%% of the steady barrier",
+    )
+    ap.add_argument(
+        "--mesh-child",
+        action="store_true",
+        help=argparse.SUPPRESS,  # internal: 8-device subprocess leg
+    )
+    ap.add_argument(
         "--fusion-current",
         default=None,
         help="reuse an existing `lint --fusion-report --json` output "
@@ -1605,6 +1909,8 @@ def main(argv=None) -> int:
         "the stage-3 artifact here)",
     )
     args = ap.parse_args(argv)
+    if args.mesh_child:
+        return run_mesh_child()
     try:
         budgets = _load(args.budgets)
     except (OSError, json.JSONDecodeError) as e:
@@ -1634,6 +1940,10 @@ def main(argv=None) -> int:
     if args.overload:
         v, report = run_overload_gate(budgets)
         print(f"[perf_gate] overload: {json.dumps(report)}")
+        violations += v
+    if args.mesh:
+        v, report = run_mesh_gate(budgets)
+        print(f"[perf_gate] mesh: {json.dumps(report)}")
         violations += v
     if args.fusion or args.fusion_current:
         try:

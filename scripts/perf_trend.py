@@ -6,8 +6,13 @@ The repo accumulates heterogeneous bench evidence: ``BENCH_r0*.json``
 (device-run retry wrappers: ``{n, cmd, rc, tail, parsed}`` where
 ``parsed`` is the bench's own JSON — or null when the run crashed),
 ``BENCH_TPU_*.json`` (flat bench dicts from TPU sessions),
-``BENCH_partial.json`` / ``BENCH.json`` (CPU smoke baselines) and
-``BENCH_SERVING.json`` (the PR 12 serving storm). Reading the
+``BENCH_partial.json`` / ``BENCH.json`` (CPU smoke baselines),
+``BENCH_SERVING.json`` (the PR 12 serving storm) and
+``MULTICHIP_r0*.json`` / ``MULTICHIP.json`` (the sharded dryrun:
+pre-PR 18 rounds are stdout-tail wrappers ``{n_devices, rc, ok,
+skipped, tail}`` with no provenance or numbers, the current
+``bench.py --multichip`` form carries per-query mesh blocks —
+attribution coverage, exchange matrix, skew verdicts). Reading the
 trajectory by hand means re-discovering every wrapper shape and —
 worse — comparing numbers produced by DIFFERENT engine generations as
 if they were one series (the stale-artifact confusion that forced a
@@ -74,7 +79,13 @@ def default_artifacts() -> list:
 
     paths = _numbered("BENCH_r[0-9]*.json")
     paths += _numbered("BENCH_TPU_*.json")
-    for name in ("BENCH_partial.json", "BENCH.json", "BENCH_SERVING.json"):
+    paths += _numbered("MULTICHIP_r[0-9]*.json")
+    for name in (
+        "BENCH_partial.json",
+        "BENCH.json",
+        "BENCH_SERVING.json",
+        "MULTICHIP.json",
+    ):
         p = os.path.join(ROOT, name)
         if os.path.exists(p):
             paths.append(p)
@@ -102,6 +113,21 @@ def load_artifact(path: str):
             last = tail[-1][:100] if tail else ""
             return None, f"{note}: no parsed bench output ({last!r})"
         return parsed, note
+    if "n_devices" in doc and "tail" in doc and "queries" not in doc:
+        # pre-PR 18 multichip stdout-tail wrapper: pass/fail only (the
+        # structured form — bench.py --multichip — carries mesh blocks
+        # and falls through as a plain dict)
+        note = (
+            f"multichip tail wrapper n_devices={doc.get('n_devices')} "
+            f"rc={doc.get('rc')}"
+        )
+        if doc.get("skipped"):
+            return None, f"{note}: dryrun skipped (no device window)"
+        if not doc.get("ok"):
+            tail = (doc.get("tail") or "").strip().splitlines()
+            last = tail[-1][:100] if tail else ""
+            return None, f"{note}: dryrun failed ({last!r})"
+        return dict(doc, multichip=True), note
     return doc, ""
 
 
@@ -142,6 +168,30 @@ def summarize(path: str, current_gen: int) -> dict:
         row["tier"] = bench.get("tier")
         if "p99_barrier_ms" in bench:
             row["p99_barrier_ms"] = bench.get("p99_barrier_ms")
+    # multichip dryrun artifacts: MV-parity pass/fail + (structured
+    # form only) per-query mesh evidence — attribution coverage and
+    # the skew verdict shard
+    if bench.get("multichip") or (
+        "n_devices" in bench and isinstance(bench.get("queries"), dict)
+    ):
+        row["metric"] = "multichip_dryrun"
+        row["value"] = bench.get("n_devices")
+        row["unit"] = "devices"
+        mq = {}
+        for q, ent in (bench.get("queries") or {}).items():
+            if not isinstance(ent, dict):
+                continue
+            sub = {"match": ent.get("match")}
+            mesh = ent.get("mesh")
+            if isinstance(mesh, dict):
+                sub["mesh_coverage"] = mesh.get("coverage_frac")
+                sk = mesh.get("skew")
+                if isinstance(sk, dict):
+                    sub["skew_shard"] = sk.get("shard")
+            mq[q] = sub
+        if mq:
+            row["queries"] = mq
+        return row
     # serving-storm artifacts carry their own vocabulary
     if "reads_per_s" in bench and "compile_programs" in bench:
         row["metric"] = row.get("metric") or "serving_storm"
@@ -210,6 +260,12 @@ def render(rows: list, current_gen: int) -> str:
                 bits.append(f"p99={_fmt(ent['p99_barrier_ms'])}ms")
             if "freshness_p99_ms" in ent:
                 bits.append(f"fresh={_fmt(ent['freshness_p99_ms'])}ms")
+            if "mesh_coverage" in ent and ent["mesh_coverage"] is not None:
+                bits.append(f"cov={_fmt(ent['mesh_coverage'])}")
+            if ent.get("skew_shard") is not None:
+                bits.append(f"skew@{ent['skew_shard']}")
+            if ent.get("match") and not bits:
+                bits.append("match")
             if bits:
                 qbits.append(f"{q}({','.join(bits)})")
         if "serving" in r:
