@@ -174,6 +174,17 @@ def main() -> None:
         "bucket lattice). Analyzes the built-in corpus incl. the "
         "SQL-planned q5u twin",
     )
+    ln.add_argument(
+        "--mesh-report",
+        action="store_true",
+        dest="mesh_report",
+        help="mesh-readiness analysis of the sharded corpus (q5/q7/q8 "
+        "over the 8-virtual-device sim mesh): SPMD-fusibility proofs "
+        "per sharded fragment, RW-E9xx blockers with file:line "
+        "provenance, ranked by the committed multichip phase splits. "
+        "Standalone: sets up its own mesh; exits 2 if jax was already "
+        "initialized with fewer devices",
+    )
     ln.add_argument("--json", action="store_true")
     ln.set_defaults(fn=_lint)
     bb = sub.add_parser(
@@ -365,6 +376,20 @@ def _lint(args) -> None:
     import sys
 
     os.environ["JAX_PLATFORMS"] = "cpu"
+    if getattr(args, "mesh_report", False):
+        # the virtual-device flag only takes effect if it lands before
+        # the first backend init — claim it here, before importing jax
+        from risingwave_tpu.analysis.mesh_domain import (
+            DEFAULT_MESH_SHARDS,
+            MeshUnavailable,
+            ensure_virtual_devices,
+        )
+
+        try:
+            ensure_virtual_devices(DEFAULT_MESH_SHARDS)
+        except MeshUnavailable as e:
+            print(f"rwlint: {e}", file=sys.stderr)
+            sys.exit(2)
     import jax
 
     jax.config.update("jax_platforms", "cpu")

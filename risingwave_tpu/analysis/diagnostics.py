@@ -11,6 +11,7 @@ README tables them); add new ones, never renumber. Families:
 - RW-E6xx  fragment-graph wiring (channels, cycles, reachability)
 - RW-E7xx  state tables (pk coverage, table-id uniqueness)
 - RW-E8xx  fusion feasibility (host-sync blockers, shape stability)
+- RW-E9xx  mesh / SPMD-collective readiness (analysis/mesh_analyzer.py)
 """
 
 from __future__ import annotations
@@ -89,6 +90,34 @@ CODES = {
     "unsupported shape, or a join-fed MV tail whose feeder's emission "
     "shape family is not closed. Policy decisions are recorded, never "
     "silent",
+    # mesh / SPMD-collective readiness (analysis/mesh_analyzer.py):
+    # what blocks fusing a sharded fragment's barrier into ONE SPMD
+    # dispatch across the device mesh (ROADMAP item 3), proven
+    # statically against the executors' mesh_contract() declarations
+    "RW-E901": "host-routed exchange edge: rows cross shards through "
+    "host memory (stack/split/flatten or per-shard device_get) instead "
+    "of an on-device collective inside the sharded program",
+    "RW-E902": "hash-dispatch key is not provably a pure function of "
+    "the mesh axis: dest_shard disagrees with the declared vnode axis "
+    "or the dispatch key is computed outside the consistent-hash path, "
+    "so an all_to_all would route rows to the wrong shard",
+    "RW-E903": "shard-local step not shard_map-traceable: per-shard "
+    "shape polymorphism outside the declared bucket lattice (each "
+    "shard would compile its own program family, defeating SPMD)",
+    "RW-E904": "replicated state mutated shard-locally: a leaf the "
+    "contract declares replicated across the mesh is written inside "
+    "the per-shard step (silent cross-shard divergence hazard)",
+    "RW-E905": "exchange output shape is data-dependent: the received "
+    "row count reaches the host before the next step can run, so the "
+    "collective cannot fuse into the donated program without a host "
+    "recount",
+    "RW-E906": "cross-shard reduction order is nondeterministic: the "
+    "merge of per-shard partials is not order-insensitive, so the "
+    "mesh result cannot be bit-identical to the serial twin",
+    "RW-E907": "per-destination dispatch fan-out: the executor issues "
+    "one host-driven device call per destination shard (the "
+    "dispatch-wall x N mechanism the multichip dry-runs measured) "
+    "instead of one program over the stacked mesh axis",
 }
 
 

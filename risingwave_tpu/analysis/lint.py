@@ -293,6 +293,157 @@ def run_fusion_report() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# mesh-readiness surface (analysis/mesh_analyzer.py)
+# ---------------------------------------------------------------------------
+
+# the sharded corpus plans REAL SQL through the planner and shards it
+# (runtime.fragmenter.sharded_planned_mv) — the same q5/q7/q8 shapes
+# the sharded-equivalence tests and the multichip dry-runs exercise
+NEXMARK_SHARDED_SQL = {
+    "q5": (
+        "CREATE MATERIALIZED VIEW q5 AS "
+        "SELECT auction, window_start, count(*) AS num "
+        "FROM HOP(bid, date_time, INTERVAL '2' SECOND, "
+        "INTERVAL '10' SECOND) "
+        "GROUP BY auction, window_start"
+    ),
+    "q7": (
+        "CREATE MATERIALIZED VIEW q7 AS "
+        "SELECT b.auction, b.bidder, b.price, b.wstart "
+        "FROM (SELECT auction, bidder, price, window_start AS wstart "
+        "FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND)) AS b "
+        "JOIN (SELECT max(price) AS maxprice, window_start AS mwstart "
+        "FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND) "
+        "GROUP BY window_start) AS m "
+        "ON b.wstart = m.mwstart AND b.price = m.maxprice"
+    ),
+    "q8": (
+        "CREATE MATERIALIZED VIEW q8 AS "
+        "SELECT p.id, p.name, p.starttime "
+        "FROM (SELECT id, name, window_start AS starttime "
+        "FROM TUMBLE(person, date_time, INTERVAL '10' SECOND) "
+        "GROUP BY id, name, window_start) AS p "
+        "JOIN (SELECT seller, window_start AS astarttime "
+        "FROM TUMBLE(auction, date_time, INTERVAL '10' SECOND) "
+        "GROUP BY seller, window_start) AS a "
+        "ON p.id = a.seller AND p.starttime = a.astarttime"
+    ),
+}
+
+
+def build_sharded_nexmark_corpus(
+    n_shards: int = 8, capacity: int = 1 << 11, only: str = None
+):
+    """The SHARDED Nexmark corpus: q5/q7/q8 planned from SQL and run
+    through the mesh sharding pass over an ``n_shards``-device mesh —
+    the mesh analyzer's acceptance corpus. Requires that many devices
+    (the CLI path arranges the 8-virtual-device sim mesh before any
+    backend init; tests get it from conftest's XLA_FLAGS). Small
+    capacities: the analysis is static, plan shape is all that
+    matters. Callers own ``pipeline.close()`` (graph actors spawn at
+    plan time)."""
+    from risingwave_tpu.connectors.nexmark import (
+        AUCTION_SCHEMA,
+        BID_SCHEMA,
+        PERSON_SCHEMA,
+    )
+    from risingwave_tpu.runtime.fragmenter import sharded_planned_mv
+    from risingwave_tpu.sql import Catalog
+    from risingwave_tpu.sql.planner import StreamPlanner
+
+    catalog = Catalog(
+        {
+            "bid": BID_SCHEMA,
+            "person": PERSON_SCHEMA,
+            "auction": AUCTION_SCHEMA,
+        }
+    )
+
+    def factory():
+        return StreamPlanner(catalog, capacity=capacity)
+
+    names = (only,) if only is not None else tuple(NEXMARK_SHARDED_SQL)
+    return {
+        n: sharded_planned_mv(factory, NEXMARK_SHARDED_SQL[n], n_shards)
+        for n in names
+        if n in NEXMARK_SHARDED_SQL
+    }
+
+
+def _committed_multichip() -> Optional[dict]:
+    """The committed multichip dry-run artifact (PR 18's meshprof
+    matrix + phase splits), when present — ranks mesh blockers by
+    measured exchange-boundary cost."""
+    import json
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+        "MULTICHIP.json",
+    )
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def run_mesh_report(n_shards: int = 8) -> dict:
+    """``lint --mesh-report``: per-query mesh-readiness reports over
+    the sharded corpus, blockers ranked by MULTICHIP.json's measured
+    phase splits. The caller must have arranged >= n_shards devices
+    (``mesh_domain.ensure_virtual_devices``)."""
+    from risingwave_tpu.analysis.mesh_analyzer import (
+        analyze_sharded_nexmark,
+    )
+
+    return analyze_sharded_nexmark(
+        deep=True, multichip=_committed_multichip(), n_shards=n_shards
+    )
+
+
+def mesh_findings_for_ddl(planned) -> List[Diagnostic]:
+    """The CREATE-MV mesh hook: SHALLOW analysis (mesh contracts + the
+    memoized loop-classified host-routing scan — no tracing, no mesh,
+    no devices) of any plan that actually contains mesh-resident
+    executors. Plans with none (every serial/graph plan a session
+    builds today) cost one O(executors) scan and return [] — the DDL
+    budget is untouched. Findings are report-only by default
+    (warnings); RW_STRICT_MESH=1 upgrades them to refusals in the
+    session hook."""
+    from risingwave_tpu.analysis.mesh_analyzer import (
+        analyze_sharded_pipeline,
+    )
+    from risingwave_tpu.runtime.fragmenter import (
+        is_mesh_boundary,
+        is_mesh_executor,
+    )
+
+    pipeline = getattr(planned, "pipeline", planned)
+    name = getattr(planned, "name", "mv")
+    exs = list(getattr(pipeline, "executors", ()) or ())
+    # cheap gate BEFORE the fragment shadow-build: a plan with no mesh
+    # executor anywhere cannot have sharded fragments
+    if not any(
+        is_mesh_executor(e) or is_mesh_boundary(e) for e in exs
+    ):
+        return []
+    out: List[Diagnostic] = []
+    for rep in analyze_sharded_pipeline(pipeline, name=name, deep=False):
+        for b in rep.blockers:
+            out.append(
+                Diagnostic(
+                    code=b.code,
+                    message=f"{b.message} at {b.file}:{b.line}",
+                    fragment=rep.fragment,
+                    executor=b.executor,
+                    severity="warning",
+                )
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
 # CLI driver (python -m risingwave_tpu lint ...)
 # ---------------------------------------------------------------------------
 
@@ -333,6 +484,62 @@ def run_cli(args) -> int:
             for d in rep["diagnostics"]:
                 print(f"  {d['code']} [{d['severity']}] {d['message']}")
         # lattice mismatches are warnings (advisory), never exit-fatal
+        return 0
+
+    if getattr(args, "mesh_report", False):
+        # the mesh report owns its mesh: it sets up the 8-virtual-
+        # device sim mesh itself, BEFORE any jax backend init — and
+        # refuses loudly (exit 2, the usage/input code) when some
+        # earlier import already initialized jax with fewer devices,
+        # because silently analyzing a 1-device "mesh" would mint
+        # worthless proofs
+        from risingwave_tpu.analysis.mesh_domain import (
+            DEFAULT_MESH_SHARDS,
+            MeshUnavailable,
+            ensure_virtual_devices,
+        )
+
+        try:
+            ensure_virtual_devices(DEFAULT_MESH_SHARDS)
+        except MeshUnavailable as e:
+            msg = str(e)
+            print(
+                _json.dumps({"error": msg})
+                if args.json
+                else f"rwlint: {msg}"
+            )
+            return 2
+        rep = run_mesh_report(n_shards=DEFAULT_MESH_SHARDS)
+        if args.json:
+            print(_json.dumps(rep, default=str))
+        else:
+            for q in sorted(rep):
+                if q.startswith("_") or q in ("ranking", "top_cost"):
+                    continue
+                s = rep[q]["summary"]
+                print(
+                    f"{q} mesh: {s['spmd_fusible_fragments']}/"
+                    f"{s['fragments']} fragments SPMD-fusible, "
+                    f"{s['host_routed_edges']} host-routed edge(s), "
+                    f"blockers {s['blockers_by_code']}"
+                )
+            top = rep.get("top_cost") or {}
+            print(
+                f"top cost: phase={top.get('phase')} "
+                f"est_ms={top.get('est_ms')} over "
+                f"{top.get('blockers')} blocker(s)"
+            )
+            for r in (rep.get("ranking") or [])[:8]:
+                est = r["est_exchange_ms"]
+                print(
+                    f"  #{r['rank']} {r['code']} [{r['query']} "
+                    f"{r['fragment']} {r['executor']}] "
+                    f"est={est if est is not None else '-'}ms "
+                    f"{r['file']}:{r['line']}"
+                )
+        # the report is an inventory, not a gate: blockers are the
+        # expected state until the collective-exchange arc lands —
+        # perf_gate --mesh-static owns the ratchet
         return 0
 
     fusion_report = getattr(args, "fusion_report", False)

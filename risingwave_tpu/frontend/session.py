@@ -541,6 +541,7 @@ class SqlSession:
             diags = lint_planned(p, catalog=self.catalog, strict=strict)
             self.lint_findings.extend((p.name, d) for d in diags)
             self._fusion_lint(p, strict=strict)
+            self._mesh_lint(p, strict=strict)
 
     def _fusion_lint(self, planned, strict: bool) -> None:
         """Fusion-feasibility findings at CREATE-MV time (analysis/
@@ -569,6 +570,36 @@ class SqlSession:
             "RW_STRICT_FUSION", "1"
         ).strip().lower() not in ("0", "off", "false", "")
         if strict and strict_fusion:
+            raise PlanLintError(diags, name=planned.name)
+
+    def _mesh_lint(self, planned, strict: bool) -> None:
+        """Mesh-readiness findings at CREATE-MV time (analysis/
+        mesh_analyzer.py, shallow pass): RW-E9xx SPMD-fusion blockers
+        for plans carrying mesh-resident sharded executors. REPORT-ONLY
+        by default — every sharded plan today has host-routed exchange
+        edges by construction, so refusing on E9xx would refuse the
+        whole sharded mode; findings land in ``lint_findings`` as
+        warnings, same surface the CLI and tests read. RW_STRICT_MESH=1
+        (env-only opt-in, the inverse default of RW_STRICT_FUSION)
+        upgrades findings to DDL refusal for deployments that only
+        accept proven-SPMD plans — replay-safe like every other lint:
+        ``strict`` is already False during DDL-log replay."""
+        import os
+
+        from risingwave_tpu.analysis.diagnostics import PlanLintError
+        from risingwave_tpu.analysis.lint import mesh_findings_for_ddl
+
+        try:
+            diags = mesh_findings_for_ddl(planned)
+        except Exception:  # noqa: BLE001 — analysis must never brick DDL
+            return
+        if not diags:
+            return
+        self.lint_findings.extend((planned.name, d) for d in diags)
+        strict_mesh = os.environ.get(
+            "RW_STRICT_MESH", "0"
+        ).strip().lower() in ("1", "on", "true", "yes")
+        if strict and strict_mesh:
             raise PlanLintError(diags, name=planned.name)
 
     def _rollback_aux_catalog(self, planned) -> None:

@@ -28,6 +28,21 @@ import jax.numpy as jnp
 from risingwave_tpu.array.chunk import StreamChunk
 from risingwave_tpu.ops.hashing import VNODE_COUNT, hash_columns
 
+# The exchange's static contract (analysis/mesh_analyzer.py): every
+# sharded executor that declares ``dispatch.fn == DISPATCH_FN`` routes
+# rows through THIS module's consistent-hash path, so its destination
+# shard is provably ``vnode(key) % n_shards`` — a pure function of the
+# key lanes and the mesh size (RW-E902's proof obligation).  Rows then
+# cross shards via ``EXCHANGE_COLLECTIVE`` inside the shard_map-ed
+# program (never through host memory; RW-E901's obligation).
+DISPATCH_FN = "dest_shard"
+EXCHANGE_COLLECTIVE = "all_to_all"
+EXCHANGE_MESH_CONTRACT = {
+    "dispatch_fn": DISPATCH_FN,
+    "collective": EXCHANGE_COLLECTIVE,
+    "vnode_count": VNODE_COUNT,
+}
+
 
 def dest_shard(key_lanes, n_shards: int) -> jnp.ndarray:
     """Row -> owning shard via vnode (vnode.rs:34 + vnode mapping):

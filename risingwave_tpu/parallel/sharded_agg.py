@@ -385,6 +385,111 @@ class ShardedHashAgg(Executor, Checkpointable):
             ops=jnp.asarray(flat(delta["ops"])),
         )
 
+    # -- static contracts (analysis/) -------------------------------------
+    def lint_info(self):
+        emits = {k: self._dtypes.get(k) for k in self.group_keys}
+        renames = {k: k for k in self.group_keys}
+        requires = set(self.group_keys)
+        for c in self.calls:
+            if c.input is not None:
+                requires.add(c.input)
+            if c.kind in ("count", "count_star"):
+                out_dt = jnp.int64
+            elif c.kind in ("min", "max") and c.input in self._dtypes:
+                out_dt = self._dtypes[c.input]
+            else:
+                out_dt = None  # sum/avg widen by kind-specific rules
+            emits[c.output] = out_dt
+            renames[c.output] = None
+        return {
+            "requires": tuple(sorted(requires)),
+            "expects": {
+                k: self._dtypes[k]
+                for k in sorted(requires)
+                if k in self._dtypes
+            },
+            "emits": emits,
+            "renames": renames,
+            "keys": self.group_keys,
+            "table_ids": (self.table_id,),
+            "window_key": None,
+        }
+
+    def trace_contract(self):
+        # mesh-resident: the per-chunk step IS one jitted shard_map
+        # dispatch, but single-chip fusion cannot absorb it — whether
+        # the whole sharded fragment collapses into one SPMD dispatch
+        # is the mesh analyzer's E9xx question (mesh_contract below).
+        # The host reads (flush drain, growth planning, occupancy) are
+        # declared as fallback_syncs so the fusion corpus accounts for
+        # the parallel path instead of skipping it as opaque.
+        full = self.out_cap
+        return {
+            "kind": "host",
+            "host_reason": "mesh-resident sharded step: per-fragment "
+            "SPMD fusion is tracked by the mesh analyzer (RW-E9xx), "
+            "not the single-chip fuser",
+            "state": (self.table, self.state),
+            "donate": True,
+            "emission": "bucketed",
+            "emission_caps": (
+                (full,) if self.stacked_out else (self.n_shards * full,)
+            ),
+            "fallback_syncs": (
+                "on_barrier",
+                "_delta_to_chunk",
+                "_maybe_grow",
+                "shard_occupancy",
+            ),
+        }
+
+    def mesh_contract(self):
+        def trace_steps(abs_chunk):
+            from risingwave_tpu.analysis.mesh_domain import abstract_tree
+
+            step = self._build_step(int(abs_chunk.valid.shape[-1]))
+            return [
+                (
+                    "apply",
+                    step,
+                    (
+                        abstract_tree(self.table),
+                        abstract_tree(self.state),
+                        abstract_tree(self.dropped),
+                        abs_chunk,
+                    ),
+                )
+            ]
+
+        return {
+            "axis": self.axis,
+            "n_shards": self.n_shards,
+            "state": {
+                "table": "sharded",
+                "state": "sharded",
+                "dropped": "sharded",
+            },
+            "updates": ("table", "state", "dropped"),
+            "dispatch": {
+                "fn": "dest_shard",
+                "keys": self.group_keys,
+                "vnode_axis": self.axis,
+            },
+            "exchange": "all_to_all",
+            "donate": True,
+            # per-slot merges apply in received-bucket order, which the
+            # deterministic all_to_all layout fixes per (src, lane)
+            "order_insensitive": True,
+            "trace_steps": trace_steps,
+            "barrier_methods": (
+                "on_barrier",
+                "_delta_to_chunk",
+                "_maybe_grow",
+                "shard_occupancy",
+            ),
+            "emission": "stacked" if self.stacked_out else "host",
+        }
+
 
 def _sharded_agg_shard_occupancy(self):
     """Per-shard claimed-slot counts (autoscale policy input,

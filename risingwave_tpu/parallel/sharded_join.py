@@ -179,6 +179,70 @@ class ShardedDedup(Executor, Checkpointable):
             )
         return []
 
+    # -- static contracts (analysis/) -------------------------------------
+    def lint_info(self):
+        return {
+            "expects": {
+                k: lane.dtype for k, lane in zip(self.keys, self.table.keys)
+            },
+            "keys": self.keys,
+            "table_ids": (self.table_id,),
+            "window_key": None,
+        }
+
+    def trace_contract(self):
+        return {
+            "kind": "host",
+            "host_reason": "mesh-resident sharded step: per-fragment "
+            "SPMD fusion is tracked by the mesh analyzer (RW-E9xx), "
+            "not the single-chip fuser",
+            "state": (self.table, self.sdirty, self.flags),
+            "donate": True,
+            "emission": "stacked",
+            "fallback_syncs": ("on_barrier", "shard_occupancy"),
+        }
+
+    def mesh_contract(self):
+        def trace_steps(abs_chunk):
+            from risingwave_tpu.analysis.mesh_domain import abstract_tree
+
+            step = self._build_step(int(abs_chunk.valid.shape[-1]))
+            return [
+                (
+                    "apply",
+                    step,
+                    (
+                        abstract_tree(self.table),
+                        abstract_tree(self.sdirty),
+                        abstract_tree(self.flags),
+                        abs_chunk,
+                    ),
+                )
+            ]
+
+        return {
+            "axis": self.axis,
+            "n_shards": self.n_shards,
+            "state": {
+                "table": "sharded",
+                "sdirty": "sharded",
+                "flags": "sharded",
+            },
+            "updates": ("table", "sdirty", "flags"),
+            "dispatch": {
+                "fn": "dest_shard",
+                "keys": self.keys,
+                "vnode_axis": self.axis,
+            },
+            "exchange": "all_to_all",
+            "donate": True,
+            "order_insensitive": True,  # first-seen is per-slot, and
+            # slot ownership is deterministic under the vnode route
+            "trace_steps": trace_steps,
+            "barrier_methods": ("on_barrier", "shard_occupancy"),
+            "emission": "stacked",
+        }
+
     # -- capacity escape (watchdog replay, scale.rs:453 analogue) ---------
     def capacity_overflow_latched(self) -> bool:
         return bool(jnp.any(self.flags, axis=0)[1])
@@ -338,6 +402,14 @@ class ShardedHashJoin(Executor, Checkpointable):
             {n: jnp.dtype(right_dtypes[n]) for n in self.right_names},
             nullable=right_nullable,
         )
+        self._lint_left_nulls = tuple(left_nullable)
+        self._lint_right_nulls = tuple(right_nullable)
+        self._lint_left_dtypes = {
+            n: jnp.dtype(left_dtypes[n]) for n in self.left_names
+        }
+        self._lint_right_dtypes = {
+            n: jnp.dtype(right_dtypes[n]) for n in self.right_names
+        }
         self.left = stack_for_mesh(left1, mesh, self.axis)
         self.right = stack_for_mesh(right1, mesh, self.axis)
         self._em_overflow = stack_for_mesh(
@@ -445,6 +517,96 @@ class ShardedHashJoin(Executor, Checkpointable):
                     "stored row"
                 )
         return []
+
+    # -- static contracts (analysis/) -------------------------------------
+    def lint_info(self):
+        dtypes = dict(self._lint_left_dtypes)
+        dtypes.update(self._lint_right_dtypes)
+        return {
+            "left_keys": self.left_keys,
+            "right_keys": self.right_keys,
+            "expects_left": dict(self._lint_left_dtypes),
+            "expects_right": dict(self._lint_right_dtypes),
+            "emits": {n: dtypes.get(n) for n in self.out_names},
+            "table_ids": (self.table_id,),
+        }
+
+    def trace_contract(self):
+        return {
+            "kind": "host",
+            "host_reason": "mesh-resident sharded step: per-fragment "
+            "SPMD fusion is tracked by the mesh analyzer (RW-E9xx), "
+            "not the single-chip fuser",
+            "state": (self.left, self.right),
+            "donate": True,
+            "emission": "fixed",
+            "emission_caps": (self.out_cap,),
+            "two_input": True,
+            "two_input_fusible": False,
+            "fallback_syncs": ("on_barrier", "shard_occupancy"),
+        }
+
+    def mesh_contract(self):
+        def trace_steps(abs_chunk):
+            # self-seeded: each arrival's chunk carries that SIDE's
+            # lanes (the threaded source spec can't describe both), so
+            # build the abstract chunks from the declared schemas and
+            # take only capacity/shard count from the caller's chunk
+            from risingwave_tpu.analysis.mesh_domain import (
+                abstract_tree,
+                stacked_schema_chunk,
+            )
+
+            cap = int(abs_chunk.valid.shape[-1])
+            n = (
+                int(abs_chunk.valid.shape[0])
+                if getattr(abs_chunk.valid, "ndim", 1) > 1
+                else self.n_shards
+            )
+            left = abstract_tree(self.left)
+            right = abstract_tree(self.right)
+            em = abstract_tree(self._em_overflow)
+            lchunk = stacked_schema_chunk(
+                self._lint_left_dtypes, self._lint_left_nulls, cap, n
+            )
+            rchunk = stacked_schema_chunk(
+                self._lint_right_dtypes, self._lint_right_nulls, cap, n
+            )
+            return [
+                (
+                    "apply_left",
+                    self._build_step("l", cap),
+                    (left, right, em, lchunk),
+                ),
+                (
+                    "apply_right",
+                    self._build_step("r", cap),
+                    (right, left, em, rchunk),
+                ),
+            ]
+
+        return {
+            "axis": self.axis,
+            "n_shards": self.n_shards,
+            "state": {
+                "left": "sharded",
+                "right": "sharded",
+                "_em_overflow": "sharded",
+            },
+            "updates": ("left", "right", "_em_overflow"),
+            "dispatch": {
+                "fn": "dest_shard",
+                "keys": {"l": self.left_keys, "r": self.right_keys},
+                "vnode_axis": self.axis,
+            },
+            "exchange": "all_to_all",
+            "donate": True,
+            "order_insensitive": True,  # emission slots are ordered by
+            # (bucket lane, stored slot), both deterministic
+            "trace_steps": trace_steps,
+            "barrier_methods": ("on_barrier", "shard_occupancy"),
+            "emission": "stacked",
+        }
 
     # -- capacity escape (watchdog replay, scale.rs:453 analogue) ---------
     def capacity_overflow_latched(self) -> bool:

@@ -167,6 +167,72 @@ class ShardedMaterialize(MvDeviceReadMixin, Executor, Checkpointable):
             )
         return []
 
+    # -- static contracts (analysis/) -------------------------------------
+    def lint_info(self):
+        return {
+            "expects": dict(self.dtypes),
+            "state_pk": tuple(self.pk),
+            "keys": self.pk,
+            "table_ids": (self.table_id,),
+        }
+
+    def trace_contract(self):
+        return {
+            "kind": "host",
+            "host_reason": "mesh-resident sharded step: per-fragment "
+            "SPMD fusion is tracked by the mesh analyzer (RW-E9xx), "
+            "not the single-chip fuser",
+            "state": (self.table, self.state),
+            "donate": True,
+            "emission": "passthrough",
+            "fallback_syncs": (
+                "on_barrier",
+                "_host_rows",
+                "get_rows",
+                "shard_occupancy",
+            ),
+        }
+
+    def mesh_contract(self):
+        def trace_steps(abs_chunk):
+            from risingwave_tpu.analysis.mesh_domain import abstract_tree
+
+            step = self._build_step(int(abs_chunk.valid.shape[-1]))
+            return [
+                (
+                    "apply",
+                    step,
+                    (
+                        abstract_tree(self.table),
+                        abstract_tree(self.state),
+                        abs_chunk,
+                    ),
+                )
+            ]
+
+        return {
+            "axis": self.axis,
+            "n_shards": self.n_shards,
+            "state": {"table": "sharded", "state": "sharded"},
+            "updates": ("table", "state"),
+            "dispatch": {
+                "fn": "dest_shard",
+                "keys": self.pk,
+                "vnode_axis": self.axis,
+            },
+            "exchange": "all_to_all",
+            "donate": True,
+            "order_insensitive": True,  # pk upserts: last writer per
+            # slot, and arrival order within a chunk is preserved by
+            # the bucket layout
+            "trace_steps": trace_steps,
+            "barrier_methods": ("on_barrier", "shard_occupancy"),
+            # the serving reads fan out one device probe per
+            # destination shard — the E907 scan targets
+            "fanout_methods": ("get_rows", "_host_rows"),
+            "emission": "passthrough",
+        }
+
     # -- capacity escape (watchdog replay, scale.rs:453 analogue) ---------
     def capacity_overflow_latched(self) -> bool:
         return bool(jnp.any(self.state.dropped))

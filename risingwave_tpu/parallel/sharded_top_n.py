@@ -218,6 +218,80 @@ class ShardedGroupTopN(Executor, Checkpointable):
         )
         return _emit_diffs(dels, ins, self.names, self._dtypes)
 
+    # -- static contracts (analysis/) -------------------------------------
+    def lint_info(self):
+        cols = self.names
+        return {
+            "expects": {c: self._dtypes[c] for c in cols},
+            "emits": {c: self._dtypes.get(c) for c in cols},
+            "renames": {c: c for c in cols},
+            "keys": self.group_by,
+            "table_ids": (self.table_id,),
+            "window_key": None,
+        }
+
+    def trace_contract(self):
+        return {
+            "kind": "host",
+            "host_reason": "mesh-resident sharded step: per-fragment "
+            "SPMD fusion is tracked by the mesh analyzer (RW-E9xx), "
+            "not the single-chip fuser",
+            "state": (self.table, self.rows),
+            "donate": True,
+            # the barrier diff emits exactly the touched top-k rows —
+            # a host-built, count-dependent chunk
+            "emission": "data_dependent",
+            "fallback_syncs": ("on_barrier", "shard_occupancy"),
+        }
+
+    def mesh_contract(self):
+        def trace_steps(abs_chunk):
+            from risingwave_tpu.analysis.mesh_domain import abstract_tree
+
+            step = self._build_step(int(abs_chunk.valid.shape[-1]))
+            return [
+                (
+                    "apply",
+                    step,
+                    (
+                        abstract_tree(self.table),
+                        abstract_tree(self.rows),
+                        abstract_tree(self.sdirty),
+                        abstract_tree(self.epoch_dirty),
+                        abstract_tree(self.dropped),
+                        abs_chunk,
+                    ),
+                )
+            ]
+
+        return {
+            "axis": self.axis,
+            "n_shards": self.n_shards,
+            "state": {
+                "table": "sharded",
+                "rows": "sharded",
+                "sdirty": "sharded",
+                "epoch_dirty": "sharded",
+                "dropped": "sharded",
+            },
+            "updates": ("table", "rows", "sdirty", "epoch_dirty", "dropped"),
+            "dispatch": {
+                "fn": "dest_shard",
+                "keys": self.group_by,
+                "vnode_axis": self.axis,
+            },
+            "exchange": "all_to_all",
+            "donate": True,
+            "order_insensitive": True,  # top-k membership is an
+            # order-statistic of the stored set, not of arrival order
+            "trace_steps": trace_steps,
+            # the barrier walk pulls each dirty shard's slice to host
+            # and diffs against the _emitted mirrors — the E901/E907
+            # scan targets
+            "barrier_methods": ("on_barrier", "shard_occupancy"),
+            "emission": "host",
+        }
+
     # -- capacity escape ---------------------------------------------------
     def capacity_overflow_latched(self) -> bool:
         return bool(jnp.any(self.dropped))

@@ -516,6 +516,11 @@ class StackSplitExecutor(Executor):
             )
         ]
 
+    def lint_info(self):
+        # layout-only boundary: same lanes in and out (schema threading
+        # through sharded chains survives the stacking edge)
+        return {}
+
 
 class FlattenExecutor(Executor):
     """Stacked (n, cap) chunk -> flat (n*cap,) chunk (host boundary)."""
@@ -526,6 +531,10 @@ class FlattenExecutor(Executor):
         if chunk.valid.ndim == 1:
             return [chunk]  # already flat (e.g. a sharded agg flush)
         return [flatten_stacked(chunk)]
+
+    def lint_info(self):
+        # layout-only boundary: same lanes in and out
+        return {}
 
 
 def _sharded_equiv(ex, mesh, stacked_out: bool = False):
@@ -924,6 +933,41 @@ def fragment_chains(pipeline) -> Dict[str, Dict[str, List[object]]]:
     if hasattr(pipeline, "executors"):
         return {"mv": {"single": list(pipeline.executors)}}
     return {}
+
+
+def is_mesh_executor(ex) -> bool:
+    """True for mesh-resident executors (those declaring a
+    ``mesh_contract()``) — the sharded ops the mesh analyzer proves."""
+    return callable(getattr(ex, "mesh_contract", None))
+
+
+def is_mesh_boundary(ex) -> bool:
+    """True for the host-routing stack/flatten boundary executors — the
+    edges where rows cross between flat host chunks and the stacked
+    mesh layout (the RW-E901 exchange edges a fully SPMD fragment would
+    absorb into its program)."""
+    return isinstance(ex, (StackSplitExecutor, FlattenExecutor))
+
+
+def sharded_chains(pipeline) -> Dict[str, Dict[str, List[object]]]:
+    """``fragment_chains`` restricted to the SHARDED fragments: those
+    whose chains contain at least one mesh-resident executor (or one of
+    the stack/flatten boundary adapters feeding it). This is the mesh
+    analyzer's extraction surface — per fragment, per section, the
+    executor chain with the mesh ops and their host boundaries in
+    source order."""
+    out: Dict[str, Dict[str, List[object]]] = {}
+    for frag, sections in fragment_chains(pipeline).items():
+        kept = {
+            sec: list(chain)
+            for sec, chain in sections.items()
+            if any(
+                is_mesh_executor(e) or is_mesh_boundary(e) for e in chain
+            )
+        }
+        if kept:
+            out[frag] = kept
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -15,8 +15,13 @@ Stages (all must pass; exit code is the OR of their failures):
 3. ``python -m risingwave_tpu lint --all-nexmark --fusion-report`` —
    the fusion-feasibility analyzer: per-fragment fusible prefixes +
    RW-E8xx blockers with provenance.
+3b. ``python -m risingwave_tpu lint --mesh-report`` — the mesh-
+   readiness analyzer over the sharded q5/q7/q8 corpus (fresh
+   subprocess owning the 8-virtual-device sim mesh): per-fragment
+   SPMD-fusibility proofs + RW-E9xx blockers with provenance.
 4. ``python scripts/perf_gate.py --smoke --blackbox --roofline
-   --serving --freshness --overload --mesh --fusion`` — the
+   --serving --freshness --overload --mesh --fusion
+   --mesh-static`` — the
    dispatch-cost regression gate: committed BENCH artifacts vs
    scripts/perf_budgets.json, the CPU q5 steady-state microbench
    (bounded device dispatches/barrier + host-python ms/row), the
@@ -30,9 +35,11 @@ Stages (all must pass; exit code is the OR of their failures):
    observability gate (8-virtual-device child: per-shard attribution
    covers >=90% of the sharded q5/q8 barrier wall, armed-vs-unarmed
    bit-identity, seeded hot-shard skew verdict names the right shard,
-   mesh telemetry host overhead < 1%), and the fusion ratchet vs
+   mesh telemetry host overhead < 1%), the fusion ratchet vs
    FUSION_REPORT.json (fusible prefixes must not shrink, host-sync
-   counts must not grow).
+   counts must not grow), and the mesh-static ratchet vs
+   MESH_REPORT.json (host-routed exchange edges and per-code E9xx
+   blocker counts must not grow, SPMD proofs must not shrink).
 """
 
 from __future__ import annotations
@@ -190,12 +197,63 @@ def stage_fusion_report(out_path: str) -> int:
     return rc
 
 
-def stage_perf_gate(fusion_current: str = None) -> int:
+def stage_mesh_report(out_path: str) -> int:
+    """Produce the mesh-readiness analysis ONCE (JSON to ``out_path``)
+    in a fresh subprocess — ``lint --mesh-report`` claims its own
+    8-virtual-device mesh, which cannot be conjured in a process that
+    already initialized jax. Stage 4's perf_gate consumes it via
+    --mesh-current (the --mesh-static ratchet vs MESH_REPORT.json)."""
+    print("[lint_all] rwlint --mesh-report (SPMD mesh readiness)")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)  # the child claims its own mesh
+    try:
+        with open(out_path, "w") as f:
+            rc = subprocess.call(
+                [sys.executable, "-m", "risingwave_tpu", "lint",
+                 "--mesh-report", "--json"],
+                cwd=ROOT,
+                env=env,
+                stdout=f,
+            )
+    except OSError as e:
+        print(f"[lint_all] cannot write {out_path}: {e}")
+        return 1
+    if rc == 0:
+        try:
+            import json
+
+            with open(out_path) as f:
+                rep = json.load(f)
+            for q in sorted(rep):
+                if q.startswith("_") or q in ("ranking", "top_cost"):
+                    continue
+                s = rep[q]["summary"]
+                print(
+                    f"[lint_all]   {q}: "
+                    f"{s['spmd_fusible_fragments']}/{s['fragments']} "
+                    f"fragments SPMD-fusible, "
+                    f"{s['host_routed_edges']} host-routed edge(s), "
+                    f"blockers {s['blockers_by_code']}"
+                )
+            top = rep.get("top_cost") or {}
+            print(
+                f"[lint_all]   top cost: phase={top.get('phase')} "
+                f"est_ms={top.get('est_ms')}"
+            )
+        except (OSError, ValueError, KeyError):
+            pass
+    return rc
+
+
+def stage_perf_gate(
+    fusion_current: str = None, mesh_current: str = None
+) -> int:
     print("[lint_all] perf_gate --smoke --blackbox --roofline --serving "
-          "--freshness --overload --mesh + fusion ratchet (dispatch-cost "
-          "+ recorder/fsync + device-roofline + shared-arrangement "
-          "serving + freshness SLO + overload-protection + mesh-"
-          "observability + fusion-regression budgets)")
+          "--freshness --overload --mesh + fusion ratchet + mesh-static "
+          "ratchet (dispatch-cost + recorder/fsync + device-roofline + "
+          "shared-arrangement serving + freshness SLO + overload-"
+          "protection + mesh-observability + fusion-regression + mesh-"
+          "readiness budgets)")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     cmd = [sys.executable, os.path.join(ROOT, "scripts", "perf_gate.py"),
            "--smoke", "--blackbox", "--roofline", "--serving",
@@ -204,6 +262,10 @@ def stage_perf_gate(fusion_current: str = None) -> int:
         cmd += ["--fusion-current", fusion_current]
     else:
         cmd += ["--fusion"]
+    if mesh_current and os.path.exists(mesh_current):
+        cmd += ["--mesh-current", mesh_current]
+    else:
+        cmd += ["--mesh-static"]
     return subprocess.call(cmd, cwd=ROOT, env=env)
 
 
@@ -216,7 +278,13 @@ def main() -> int:
         fusion_json = os.path.join(tmp, "fusion_report.json")
         frc = stage_fusion_report(fusion_json)
         rc |= frc
-        rc |= stage_perf_gate(fusion_json if frc == 0 else None)
+        mesh_json = os.path.join(tmp, "mesh_report.json")
+        mrc = stage_mesh_report(mesh_json)
+        rc |= mrc
+        rc |= stage_perf_gate(
+            fusion_json if frc == 0 else None,
+            mesh_json if mrc == 0 else None,
+        )
     print(f"[lint_all] {'FAIL' if rc else 'ok'}")
     return rc
 

@@ -396,6 +396,128 @@ def run_fusion_gate(
 
 
 # ---------------------------------------------------------------------------
+# mode 3b: mesh-readiness regression gate (static, CPU, subprocess)
+# ---------------------------------------------------------------------------
+
+DEFAULT_MESH_BASELINE = os.path.join(ROOT, "MESH_REPORT.json")
+
+
+def run_mesh_static_gate(
+    budgets: dict,
+    baseline_path: str = None,
+    current_path: str = None,
+):
+    """Re-run the mesh analyzer over the sharded corpus and compare
+    against the committed MESH_REPORT.json baseline: per fragment, the
+    host-routed exchange-edge count (RW-E901 + RW-E907) must not GROW
+    and an SPMD-fusibility proof must not be LOST; per query, no E9xx
+    code's blocker count may grow past its committed count. This is
+    the ratchet for ROADMAP item 3 — the collective-exchange arc moves
+    edge counts down and proofs up, and nothing moves them back
+    silently. Without ``current_path`` the analysis runs in a fresh
+    subprocess (``lint --mesh-report`` owns its 8-virtual-device
+    mesh, which cannot be conjured after this process touched jax).
+    Returns (violations, skipped)."""
+    baseline_path = baseline_path or DEFAULT_MESH_BASELINE
+    try:
+        baseline = _load(baseline_path)
+    except (OSError, json.JSONDecodeError) as e:
+        return [], [f"mesh baseline unreadable ({e}) — gate skipped"]
+    if current_path:
+        try:
+            current = _load(current_path)
+        except (OSError, json.JSONDecodeError) as e:
+            return [f"mesh current-report unreadable: {e}"], []
+        current = current.get("__mesh__", current)
+    else:
+        import subprocess
+
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)  # the child claims its own mesh
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "risingwave_tpu",
+                "lint",
+                "--mesh-report",
+                "--json",
+            ],
+            capture_output=True,
+            text=True,
+            cwd=ROOT,
+            env=env,
+        )
+        if proc.returncode != 0:
+            return [
+                "mesh: `lint --mesh-report` failed "
+                f"(exit {proc.returncode}): "
+                f"{(proc.stderr or proc.stdout).strip()[-400:]}"
+            ], []
+        try:
+            current = json.loads(proc.stdout)
+        except json.JSONDecodeError as e:
+            return [f"mesh: analyzer emitted unparsable JSON: {e}"], []
+    violations, skipped = [], []
+    for q, base_rep in baseline.items():
+        if q.startswith("_") or q in ("ranking", "top_cost"):
+            continue
+        if q not in current:
+            violations.append(
+                f"mesh: query {q!r} vanished from the analysis "
+                "(baseline still lists it)"
+            )
+            continue
+        base_frags = {
+            f["fragment"]: f for f in base_rep.get("fragments", ())
+        }
+        cur_frags = {
+            f["fragment"]: f for f in current[q]["fragments"]
+        }
+        for name, bf in base_frags.items():
+            cf = cur_frags.get(name)
+            if cf is None:
+                violations.append(
+                    f"mesh {q}: fragment {name!r} vanished from the "
+                    "analysis (baseline still lists it)"
+                )
+                continue
+            if cf["host_routed_edges"] > bf["host_routed_edges"]:
+                violations.append(
+                    f"mesh {q}/{name}: host-routed exchange edges grew "
+                    f"{bf['host_routed_edges']} -> "
+                    f"{cf['host_routed_edges']}"
+                )
+            if bf.get("spmd_fusible") and not cf.get("spmd_fusible"):
+                violations.append(
+                    f"mesh {q}/{name}: SPMD-fusibility proof lost"
+                )
+        # per-code ratchet: no E9xx class may grow past its committed
+        # count (the committed blockers are the worklist, not a quota)
+        cur_codes = current[q]["summary"].get("blockers_by_code", {})
+        base_codes = base_rep.get("summary", {}).get(
+            "blockers_by_code", {}
+        )
+        for code, n in cur_codes.items():
+            if int(n) > int(base_codes.get(code, 0)):
+                violations.append(
+                    f"mesh {q}: blocker {code} count grew "
+                    f"{base_codes.get(code, 0)} -> {n} vs baseline"
+                )
+        bsum = base_rep.get("summary", {})
+        csum = current[q]["summary"]
+        if csum.get("spmd_fusible_fragments", 0) < bsum.get(
+            "spmd_fusible_fragments", 0
+        ):
+            violations.append(
+                f"mesh {q}: SPMD-fusible fragments shrank "
+                f"{bsum.get('spmd_fusible_fragments', 0)} -> "
+                f"{csum.get('spmd_fusible_fragments', 0)}"
+            )
+    return violations, skipped
+
+
+# ---------------------------------------------------------------------------
 # mode 4: black-box recorder gate (host cost + crash-survival smoke)
 # ---------------------------------------------------------------------------
 
@@ -1908,6 +2030,26 @@ def main(argv=None) -> int:
         "as the current analysis instead of re-tracing (CI passes "
         "the stage-3 artifact here)",
     )
+    ap.add_argument(
+        "--mesh-static",
+        action="store_true",
+        help="re-run the mesh-readiness analyzer over the sharded "
+        "corpus and fail on host-routed-edge growth, per-code E9xx "
+        "blocker growth, or lost SPMD-fusibility proofs vs "
+        "MESH_REPORT.json",
+    )
+    ap.add_argument(
+        "--mesh-baseline",
+        default=None,
+        help="baseline report (default: MESH_REPORT.json)",
+    )
+    ap.add_argument(
+        "--mesh-current",
+        default=None,
+        help="reuse an existing `lint --mesh-report --json` output as "
+        "the current analysis instead of re-analyzing (CI passes the "
+        "lint-stage artifact here)",
+    )
     args = ap.parse_args(argv)
     if args.mesh_child:
         return run_mesh_child()
@@ -1944,6 +2086,19 @@ def main(argv=None) -> int:
     if args.mesh:
         v, report = run_mesh_gate(budgets)
         print(f"[perf_gate] mesh: {json.dumps(report)}")
+        violations += v
+    if args.mesh_static or args.mesh_current:
+        try:
+            mbase = _load(args.mesh_baseline or DEFAULT_MESH_BASELINE)
+            for w in generation_warnings(mbase, "mesh baseline"):
+                print(f"[perf_gate] WARNING: {w}")
+        except (OSError, json.JSONDecodeError):
+            pass  # run_mesh_static_gate reports unreadable baselines
+        v, skipped = run_mesh_static_gate(
+            budgets, args.mesh_baseline, args.mesh_current
+        )
+        for s in skipped:
+            print(f"[perf_gate] skip: {s}")
         violations += v
     if args.fusion or args.fusion_current:
         try:

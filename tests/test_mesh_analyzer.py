@@ -1,0 +1,524 @@
+"""Mesh-readiness analyzer (analysis/mesh_analyzer.py): seeded RW-E9xx
+violations classify with exact code + file:line provenance, a
+hand-built shard_map-clean fragment earns a positive SPMD proof, the
+blocker ranking uses the measured meshprof costs, the CLI emits JSON
+on every exit path, and the shallow DDL pass stays inside its budget.
+"""
+
+import inspect
+import json
+import os
+import time
+from types import SimpleNamespace
+
+import pytest
+
+import risingwave_tpu  # noqa: F401 — installs the jax.shard_map shim
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from risingwave_tpu.analysis.diagnostics import PlanLintError
+from risingwave_tpu.analysis.mesh_analyzer import (
+    analyze_mesh_chain,
+    attach_mesh_costs,
+    classify_mesh_executor,
+    _ranking,
+    _top_cost,
+)
+from risingwave_tpu.analysis.shape_domain import ChunkSpec
+
+N = 8
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+THIS_FILE = "tests/test_mesh_analyzer.py"
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < N, reason=f"needs {N} (virtual) devices"
+)
+
+
+def _line_in(fn, marker: str) -> int:
+    src, start = inspect.getsourcelines(fn)
+    return start + next(i for i, ln in enumerate(src) if marker in ln)
+
+
+def _contract(**over):
+    base = {
+        "axis": "shard",
+        "n_shards": N,
+        "state": {"t": "sharded"},
+        "updates": ("t",),
+        "dispatch": {
+            "fn": "dest_shard",
+            "keys": ("k",),
+            "vnode_axis": "shard",
+        },
+        "exchange": "all_to_all",
+        "donate": True,
+        "order_insensitive": True,
+        "trace_steps": None,
+        "barrier_methods": ("on_barrier",),
+        "emission": "stacked",
+    }
+    base.update(over)
+    return base
+
+
+# ---------------------------------------------------------------------------
+# seeded violations, one archetype per code
+# ---------------------------------------------------------------------------
+
+
+class _HostRoutedTwin:
+    """The host-routed exchange archetype: barrier drain through
+    np.asarray (E901) + one host-driven device pull per shard (E907)."""
+
+    n_shards = N
+
+    def mesh_contract(self):
+        return _contract()
+
+    def apply(self, chunk):
+        return [chunk]
+
+    def on_barrier(self):
+        outs = self._drain()
+        rows = np.asarray(outs)  # <- E901: host flatten
+        parts = []
+        for s in range(self.n_shards):  # <- E907: per-dest fan-out
+            parts.append(jax.device_get(outs))
+        return rows, parts
+
+
+def test_e901_host_routed_exchange_twin():
+    ec = classify_mesh_executor(_HostRoutedTwin(), None, "t", 0, deep=False)
+    e901 = [b for b in ec.blockers if b.code == "RW-E901"]
+    assert e901, [b.code for b in ec.blockers]
+    want = _line_in(_HostRoutedTwin.on_barrier, "np.asarray")
+    assert any(
+        b.file == THIS_FILE and b.line == want for b in e901
+    ), [(b.file, b.line) for b in e901]
+
+
+def test_e907_per_destination_fanout_twin():
+    ec = classify_mesh_executor(_HostRoutedTwin(), None, "t", 0, deep=False)
+    e907 = [b for b in ec.blockers if b.code == "RW-E907"]
+    assert e907
+    want = _line_in(_HostRoutedTwin.on_barrier, "for s in range")
+    assert any(
+        b.file == THIS_FILE and b.line == want for b in e907
+    ), [(b.file, b.line) for b in e907]
+    assert all(b.phase == "exchange_route" for b in e907)
+
+
+class _MisKeyedAgg:
+    """E902 archetype: dispatch outside the consistent-hash dest_shard
+    path, axis mismatch, and no declared keys."""
+
+    def mesh_contract(self):
+        return _contract(
+            dispatch={"fn": "my_hash", "keys": (), "vnode_axis": "x"}
+        )
+
+    def apply(self, chunk):
+        return [chunk]
+
+    def on_barrier(self):
+        return []
+
+
+def test_e902_miskeyed_sharded_agg():
+    ec = classify_mesh_executor(_MisKeyedAgg(), None, "t", 0, deep=False)
+    assert {b.code for b in ec.blockers} == {"RW-E902"}
+    assert len(ec.blockers) == 3  # fn, axis, keys
+    want = inspect.getsourcelines(_MisKeyedAgg)[1]
+    assert all(
+        b.file == THIS_FILE and b.line == want for b in ec.blockers
+    )
+    assert any("dest_shard" in b.message for b in ec.blockers)
+
+
+class _UnbucketedShardWindow:
+    """E903 archetype: the per-shard step branches on a traced value
+    (a data-dependent window extent) — shard_map cannot trace it."""
+
+    def mesh_contract(self):
+        def trace_steps(abs_chunk):
+            cap = int(abs_chunk.valid.shape[-1])
+
+            def step(x):
+                if x.sum() > 0:  # concretizes a tracer
+                    return x
+                return x * 2
+
+            return [
+                (
+                    "apply",
+                    step,
+                    (jax.ShapeDtypeStruct((N, cap), jnp.int32),),
+                )
+            ]
+
+        return _contract(trace_steps=trace_steps, barrier_methods=())
+
+    def apply(self, chunk):
+        return [chunk]
+
+
+def test_e903_untraceable_per_shard_window():
+    ec = classify_mesh_executor(
+        _UnbucketedShardWindow(), None, "t", 0, deep=True
+    )
+    e903 = [b for b in ec.blockers if b.code == "RW-E903"]
+    assert e903, [b.code for b in ec.blockers]
+    assert not ec.spmd_proven and not ec.traced
+    want = inspect.getsourcelines(_UnbucketedShardWindow)[1]
+    assert e903[0].file == THIS_FILE and e903[0].line == want
+    assert "Tracer" in e903[0].message or "Concretization" in e903[0].message
+
+
+class _ReplicatedWriter:
+    """E904 archetype: replicated state leaf in the update set."""
+
+    def mesh_contract(self):
+        return _contract(
+            state={"t": "sharded", "cfg": "replicated"},
+            updates=("t", "cfg"),
+            barrier_methods=(),
+        )
+
+    def apply(self, chunk):
+        return [chunk]
+
+
+class _OrderSensitive:
+    """E906 archetype: merge order not declared order-insensitive."""
+
+    def mesh_contract(self):
+        return _contract(order_insensitive=False, barrier_methods=())
+
+    def apply(self, chunk):
+        return [chunk]
+
+
+def test_e904_replicated_state_written():
+    ec = classify_mesh_executor(_ReplicatedWriter(), None, "t", 0, deep=False)
+    assert {b.code for b in ec.blockers} == {"RW-E904"}
+    assert ec.blockers[0].line == inspect.getsourcelines(_ReplicatedWriter)[1]
+    assert "cfg" in ec.blockers[0].message
+
+
+def test_e906_order_sensitive_merge():
+    ec = classify_mesh_executor(_OrderSensitive(), None, "t", 0, deep=False)
+    assert {b.code for b in ec.blockers} == {"RW-E906"}
+    assert ec.blockers[0].file == THIS_FILE
+
+
+class _RecountFlush:
+    """E905 archetype: the flush drain loop's exit is gated by a device
+    read — the exchange/flush output shape is data-dependent."""
+
+    def mesh_contract(self):
+        return _contract()
+
+    def apply(self, chunk):
+        return [chunk]
+
+    def on_barrier(self):
+        outs = []
+        for _ in range(4):
+            delta = self._flush()
+            outs.append(delta)
+            if not bool(jnp.any(delta)):  # <- E905: host recount
+                break
+        return outs
+
+
+def test_e905_data_dependent_flush_shape():
+    ec = classify_mesh_executor(_RecountFlush(), None, "t", 0, deep=False)
+    e905 = [b for b in ec.blockers if b.code == "RW-E905"]
+    assert e905, [b.code for b in ec.blockers]
+    want = _line_in(_RecountFlush.on_barrier, "if not bool")
+    assert e905[0].file == THIS_FILE and e905[0].line == want
+    assert e905[0].phase == "host_recount"
+
+
+def test_boundary_executor_is_e901_edge():
+    from risingwave_tpu.runtime.fragmenter import StackSplitExecutor
+
+    ec = classify_mesh_executor(StackSplitExecutor(N), None, "t", 0)
+    assert ec.kind == "boundary"
+    assert [b.code for b in ec.blockers] == ["RW-E901"]
+    b = ec.blockers[0]
+    assert b.file == "risingwave_tpu/runtime/fragmenter.py"
+    assert b.line == inspect.getsourcelines(StackSplitExecutor.apply)[1]
+
+
+# ---------------------------------------------------------------------------
+# positive proof: a hand-built shard_map-clean fragment
+# ---------------------------------------------------------------------------
+
+
+class _CleanSpmd:
+    """Honest contract + a step that traces under shard_map over the
+    8-device mesh with a real collective and no host routing."""
+
+    def __init__(self):
+        from risingwave_tpu.analysis.mesh_domain import virtual_mesh
+
+        self.mesh = virtual_mesh(N, "shard")
+        self.state = jnp.zeros((N, 1), jnp.int64)
+
+    def apply(self, chunk):
+        return [chunk]
+
+    def mesh_contract(self):
+        def trace_steps(abs_chunk):
+            from risingwave_tpu.analysis.mesh_domain import abstract_tree
+
+            def local(state, vals):
+                total = jax.lax.psum(jnp.sum(vals), "shard")
+                return state + total
+
+            step = jax.jit(
+                jax.shard_map(
+                    local,
+                    mesh=self.mesh,
+                    in_specs=(P("shard"), P("shard")),
+                    out_specs=P("shard"),
+                )
+            )
+            return [
+                (
+                    "apply",
+                    step,
+                    (abstract_tree(self.state), abs_chunk.columns["v"]),
+                )
+            ]
+
+        return _contract(
+            state={"state": "sharded"},
+            updates=("state",),
+            dispatch={
+                "fn": "dest_shard",
+                "keys": ("v",),
+                "vnode_axis": "shard",
+            },
+            trace_steps=trace_steps,
+            barrier_methods=(),
+        )
+
+
+def test_positive_proof_on_clean_fragment():
+    spec = ChunkSpec.from_schema({"v": "int64"})
+    rep = analyze_mesh_chain([_CleanSpmd()], spec, "clean", deep=True)
+    assert not rep.blockers
+    assert rep.executors[0].spmd_proven
+    assert rep.executors[0].signatures >= 1
+    assert rep.spmd_fusible and rep.proof is not None
+    assert "psum" in rep.proof["collectives"]
+
+
+def test_shallow_pass_never_mints_a_proof():
+    spec = ChunkSpec.from_schema({"v": "int64"})
+    rep = analyze_mesh_chain([_CleanSpmd()], spec, "clean", deep=False)
+    assert not rep.blockers
+    assert not rep.spmd_fusible and rep.proof is None
+
+
+# ---------------------------------------------------------------------------
+# measured-cost ranking
+# ---------------------------------------------------------------------------
+
+
+def test_ranking_uses_meshprof_costs():
+    rep = analyze_mesh_chain([_HostRoutedTwin()], None, "t:frag", deep=False)
+    mesh_block = {
+        "phases_ms": {
+            "host_split": 5.0,
+            "host_flatten": 3.0,
+            "host_other": 2.0,
+        }
+    }
+    attach_mesh_costs([rep], mesh_block, n_shards=N)
+    route = [b for b in rep.blockers if b.phase == "exchange_route"]
+    assert route
+    share = round(10.0 / len(route), 3)
+    assert all(b.est_exchange_ms == share for b in route)
+    assert all(
+        b.est_dispatches_saved == N - 1
+        for b in route
+        if b.code == "RW-E907"
+    )
+    rows = _ranking({"q": [rep]})
+    assert rows[0]["rank"] == 1 and rows[0]["est_exchange_ms"] == share
+    top = _top_cost(rows)
+    assert top["phase"] == "exchange_route"
+    assert top["est_ms"] == pytest.approx(10.0, abs=0.01)
+
+
+def test_committed_mesh_report_ranks_exchange_route():
+    """The committed baseline satisfies the acceptance bar: every
+    sharded fragment proves or carries provenance-bearing blockers,
+    and the static ranking names the exchange route as top cost."""
+    with open(os.path.join(ROOT, "MESH_REPORT.json")) as f:
+        rep = json.load(f)
+    assert rep["top_cost"]["phase"] == "exchange_route"
+    for q in ("q5", "q7", "q8"):
+        assert rep[q]["fragments"]
+        for fr in rep[q]["fragments"]:
+            assert fr["spmd_fusible"] or fr["blockers"]
+            for b in fr["blockers"]:
+                assert b["code"].startswith("RW-E")
+                assert b["file"] and b["line"] > 0
+    assert any(r["est_exchange_ms"] for r in rep["ranking"])
+
+
+# ---------------------------------------------------------------------------
+# the sharded corpus + DDL surfaces
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def q5_sharded():
+    from risingwave_tpu.analysis.lint import build_sharded_nexmark_corpus
+
+    corpus = build_sharded_nexmark_corpus(N, only="q5")
+    yield corpus["q5"]
+    corpus["q5"].pipeline.close()
+
+
+def test_sharded_q5_classifies_with_blockers(q5_sharded):
+    from risingwave_tpu.analysis.mesh_analyzer import (
+        analyze_sharded_pipeline,
+    )
+    from risingwave_tpu.analysis.lint import NEXMARK_SOURCE_SCHEMAS
+
+    reports = analyze_sharded_pipeline(
+        q5_sharded.pipeline, NEXMARK_SOURCE_SCHEMAS["q5"], "q5", deep=False
+    )
+    assert reports
+    for rep in reports:
+        assert rep.spmd_fusible or rep.blockers
+        for b in rep.blockers:
+            assert b.file and b.line > 0
+    codes = {b.code for rep in reports for b in rep.blockers}
+    assert "RW-E901" in codes  # the stack/flatten boundary edges
+
+
+def test_sharded_executors_declare_mesh_and_fallback_contracts(q5_sharded):
+    from risingwave_tpu.runtime.fragmenter import (
+        is_mesh_executor,
+        sharded_chains,
+    )
+
+    mesh_exs = [
+        ex
+        for secs in sharded_chains(q5_sharded.pipeline).values()
+        for chain in secs.values()
+        for ex in chain
+        if is_mesh_executor(ex)
+    ]
+    assert mesh_exs
+    for ex in mesh_exs:
+        tc = ex.trace_contract()
+        assert tc["kind"] == "host"
+        assert tc["fallback_syncs"], type(ex).__name__
+        mc = ex.mesh_contract()
+        assert mc["n_shards"] == N
+        assert mc["dispatch"]["fn"] == "dest_shard"
+        assert callable(mc["trace_steps"])
+
+
+def test_boundary_lint_info_threads_schema():
+    from risingwave_tpu.analysis.fusion_analyzer import (
+        _lint_info,
+        _thread_spec,
+    )
+    from risingwave_tpu.runtime.fragmenter import (
+        FlattenExecutor,
+        StackSplitExecutor,
+    )
+
+    spec = ChunkSpec.from_schema({"a": "int64"})
+    for ex in (StackSplitExecutor(N), FlattenExecutor()):
+        assert _thread_spec(spec, ex, _lint_info(ex)) == spec
+
+
+def test_shallow_ddl_pass_budget(q5_sharded):
+    from risingwave_tpu.analysis.lint import mesh_findings_for_ddl
+
+    diags = mesh_findings_for_ddl(q5_sharded)  # warm the scan memo
+    assert diags and all(d.severity == "warning" for d in diags)
+    assert all(d.code.startswith("RW-E9") for d in diags)
+    t0 = time.perf_counter()
+    mesh_findings_for_ddl(q5_sharded)
+    assert (time.perf_counter() - t0) < 0.1  # the <100ms/plan budget
+
+
+def test_ddl_hook_noop_for_unsharded_plans():
+    from risingwave_tpu.analysis.lint import (
+        build_nexmark_corpus,
+        mesh_findings_for_ddl,
+    )
+
+    q5 = build_nexmark_corpus(only="q5")["q5"]
+    assert mesh_findings_for_ddl(q5) == []
+
+
+def test_session_mesh_hook_reports_then_refuses(q5_sharded, monkeypatch):
+    from risingwave_tpu.frontend.session import SqlSession
+    from risingwave_tpu.runtime import StreamingRuntime
+    from risingwave_tpu.sql import Catalog
+
+    session = SqlSession(
+        Catalog({}), StreamingRuntime(store=None), strict_lint=False
+    )
+    monkeypatch.delenv("RW_STRICT_MESH", raising=False)
+    session._mesh_lint(q5_sharded, strict=True)  # report-only default
+    codes = {d.code for _name, d in session.lint_findings}
+    assert any(c.startswith("RW-E9") for c in codes)
+    monkeypatch.setenv("RW_STRICT_MESH", "1")
+    with pytest.raises(PlanLintError):
+        session._mesh_lint(q5_sharded, strict=True)
+    # replay-safe: strict=False (the replay path) records, never raises
+    session._mesh_lint(q5_sharded, strict=False)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _cli_args(**over):
+    base = dict(sharing_report=False, mesh_report=True, json=True)
+    base.update(over)
+    return SimpleNamespace(**base)
+
+
+def test_cli_exits_2_when_mesh_unavailable(monkeypatch, capsys):
+    from risingwave_tpu.analysis import mesh_domain
+    from risingwave_tpu.analysis.lint import run_cli
+
+    def _boom(n):
+        raise mesh_domain.MeshUnavailable("jax already initialized")
+
+    monkeypatch.setattr(mesh_domain, "ensure_virtual_devices", _boom)
+    rc = run_cli(_cli_args())
+    assert rc == 2
+    out = json.loads(capsys.readouterr().out)  # JSON on EVERY exit path
+    assert "already initialized" in out["error"]
+
+
+@pytest.mark.slow
+def test_cli_mesh_report_json(capsys):
+    from risingwave_tpu.analysis.lint import run_cli
+
+    rc = run_cli(_cli_args())
+    assert rc == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert set(rep) >= {"q5", "q7", "q8", "ranking", "top_cost"}
+    assert rep["top_cost"]["phase"] == "exchange_route"
+    for q in ("q5", "q7", "q8"):
+        assert rep[q]["summary"]["fragments"] >= 1
