@@ -107,6 +107,24 @@ def ctl(args) -> None:
         dst = LocalFsObjectStore(args.dest)
         n = restore_backup(store, args.backup_id, dst)
         print(f"restored {n} blobs into {args.dest}")
+    elif args.ctl_cmd == "scrub":
+        from risingwave_tpu.storage.state_table import CheckpointManager
+
+        rows = CheckpointManager(store).scrub(deep=args.deep)
+        bad = 0
+        for r in rows:
+            line = (
+                f"{r['status']:<12} {r['artifact']}  "
+                f"table={r['table_id'] or '-'} "
+                f"level={r['level']} epoch={r['epoch']}"
+            )
+            if r["detail"]:
+                line += f"  {r['detail']}"
+            print(line)
+            bad += r["status"] == "corrupt"
+        print(f"{len(rows)} artifacts, {bad} corrupt")
+        if bad:
+            raise SystemExit(1)
 
 
 def main() -> None:
@@ -121,6 +139,15 @@ def main() -> None:
             cc.add_argument("--backup-id", required=True)
         if name == "backup-restore":
             cc.add_argument("--dest", required=True)
+    sc = csub.add_parser(
+        "scrub", help="verify every checkpoint artifact (crc + digest)"
+    )
+    sc.add_argument("--state-dir", required=True)
+    sc.add_argument(
+        "--deep",
+        action="store_true",
+        help="also verify every per-block crc inside block SSTs",
+    )
     c.set_defaults(fn=ctl)
     s = sub.add_parser("serve", help="start a single-node cluster")
     s.add_argument("--port", type=int, default=4566)

@@ -63,6 +63,14 @@ CODES = {
     "note (_buckets) — its device state dodges the HBM budget the "
     "memory governor enforces (runtime/memory_governor.py). Report-only "
     "by default; refused when RW_STRICT_LINT is explicitly set",
+    "RW-E709": "stateful executor without state-digest coverage: it "
+    "registers state table_ids but implements no state_digest() "
+    "contract (or its digest_lanes() expose lanes the fold cannot "
+    "cover) — silent device-state corruption in this executor is "
+    "undetectable to the integrity layer (integrity.py): no fused-vs-"
+    "interpreted cross-check, no checkpoint digest, no scrub coverage. "
+    "Report-only by default; refused when RW_STRICT_LINT is explicitly "
+    "set",
     # fusion feasibility (analysis/fusion_analyzer.py): what blocks
     # fusing a fragment's executor chain into ONE jitted per-barrier
     # device step (ROADMAP item 1), proven statically

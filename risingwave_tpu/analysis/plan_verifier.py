@@ -132,6 +132,50 @@ def _check_ledger_visible(ex, info, fragment, prov, rep) -> None:
     )
 
 
+def _check_digest_coverage(ex, info, fragment, prov, rep) -> None:
+    """RW-E709: an executor that registers state table_ids but has no
+    working ``state_digest()`` — its device state sits OUTSIDE the
+    integrity layer's corruption checks (no fused-vs-interpreted
+    cross-check, no checkpoint digest, no scrub coverage), so a silent
+    in-HBM bit-flip there is undetectable by construction. Severity
+    follows the E708 convention: report-only unless RW_STRICT_LINT is
+    explicitly set truthy."""
+    if not (info.get("table_ids") or ()):
+        return
+    from risingwave_tpu.storage.state_table import Checkpointable
+
+    fn = getattr(type(ex), "state_digest", None)
+    if fn is None or fn is Checkpointable.state_digest:
+        rep.add(
+            "RW-E709",
+            f"{type(ex).__name__} registers state table(s) "
+            f"{tuple(info.get('table_ids') or ())!r} but implements no "
+            "state_digest() — silent corruption of its device state is "
+            "invisible to the integrity layer",
+            fragment=fragment,
+            executor=prov,
+            severity=_e708_severity(),
+        )
+        return
+    lanes_fn = getattr(ex, "digest_lanes", None)
+    if callable(lanes_fn):
+        try:
+            from risingwave_tpu.integrity import foldable_dtypes
+
+            bad = list(foldable_dtypes(lanes_fn()[0]))
+        except Exception:  # noqa: BLE001 — lanes need built state;
+            return  # runtime digest paths still exercise them
+        if bad:
+            rep.add(
+                "RW-E709",
+                f"{type(ex).__name__} digest_lanes() exposes lanes the "
+                f"fold cannot cover: {bad!r}",
+                fragment=fragment,
+                executor=prov,
+                severity=_e708_severity(),
+            )
+
+
 class _TableIds:
     """Plan-wide table_id uniqueness (RW-E702). Parallel instances of
     one logical fragment share table_ids BY DESIGN (disjoint vnode
@@ -180,6 +224,7 @@ def _walk_chain(
             continue
         tids.add(instance, info.get("table_ids", ()), fragment, prov)
         _check_ledger_visible(ex, info, fragment, prov, rep)
+        _check_digest_coverage(ex, info, fragment, prov, rep)
 
         expects = {k: _dt(v) for k, v in (info.get("expects") or {}).items()}
         requires = set(info.get("requires") or ()) | set(expects)
@@ -314,6 +359,7 @@ def _verify_join(
         return None, None
     tids.add(instance, info.get("table_ids", ()), fragment, prov)
     _check_ledger_visible(join, info, fragment, prov, rep)
+    _check_digest_coverage(join, info, fragment, prov, rep)
     lkeys = tuple(info.get("left_keys") or ())
     rkeys = tuple(info.get("right_keys") or ())
     for side, schema, expects in (

@@ -157,6 +157,19 @@ class CdcBackfillExecutor(Checkpointable):
             self.offsets[sid] = new_off
         return out
 
+    # -- integrity ---------------------------------------------------------
+    def state_digest(self) -> int:
+        """Durable logical state: backfill cursor + upstream offsets."""
+        from risingwave_tpu.integrity import host_obj_digest
+
+        return host_obj_digest(
+            {
+                "pk_pos": self.pk_pos,
+                "done": self.done,
+                "offsets": dict(self.offsets),
+            }
+        )
+
     # -- checkpoint --------------------------------------------------------
     def checkpoint_delta(self) -> List[StateDelta]:
         cur = (self.pk_pos, self.done, dict(self.offsets))

@@ -647,6 +647,12 @@ class GenericSourceExecutor(Executor, Checkpointable):
         return self.parser.schema
 
     # -- checkpoint/restore ----------------------------------------------
+    def state_digest(self) -> int:
+        """Durable logical state is the per-split offset map."""
+        from risingwave_tpu.integrity import host_obj_digest
+
+        return host_obj_digest(dict(self.offsets))
+
     def checkpoint_delta(self) -> List[StateDelta]:
         if self.offsets == self._committed:
             return []

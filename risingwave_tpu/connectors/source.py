@@ -84,6 +84,13 @@ class NexmarkSourceExecutor(Executor, Checkpointable):
                     out[stream].append(c)
         return out
 
+    # -- integrity --------------------------------------------------------
+    def state_digest(self) -> int:
+        """Durable logical state is the per-split offset vector."""
+        from risingwave_tpu.integrity import host_obj_digest
+
+        return host_obj_digest([g.offset for g in self.splits])
+
     # -- checkpoint/restore ----------------------------------------------
     def checkpoint_delta(self) -> List[StateDelta]:
         offsets = [g.offset for g in self.splits]

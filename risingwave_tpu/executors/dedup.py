@@ -296,6 +296,18 @@ class AppendOnlyDedupExecutor(Executor, Checkpointable):
         self.sdirty = self.sdirty | expired
         return watermark, []
 
+    # -- integrity --------------------------------------------------------
+    def digest_lanes(self):
+        from risingwave_tpu.integrity import dedup_lanes
+
+        return dedup_lanes(self.table)
+
+    def state_digest(self) -> int:
+        """Host twin of the fused digest lane (integrity.dedup_lanes)."""
+        from risingwave_tpu.integrity import host_digest
+
+        return host_digest(*self.digest_lanes())
+
     # -- checkpoint/restore ----------------------------------------------
     def checkpoint_delta(self):
         import numpy as np

@@ -339,6 +339,18 @@ class DynamicMaxFilterExecutor(Executor, Checkpointable):
         self.sdirty = self.sdirty | expired
         return watermark, []
 
+    # -- integrity --------------------------------------------------------
+    def digest_lanes(self):
+        from risingwave_tpu.integrity import filter_lanes
+
+        return filter_lanes(self.table, self.maxes)
+
+    def state_digest(self) -> int:
+        """Host twin of the fused digest lane (integrity.filter_lanes)."""
+        from risingwave_tpu.integrity import host_digest
+
+        return host_digest(*self.digest_lanes())
+
     # -- checkpoint/restore ----------------------------------------------
     def checkpoint_delta(self):
         import numpy as np
@@ -661,6 +673,26 @@ class DynamicFilterExecutor(Executor, Checkpointable):
                 )
             )
         return outs
+
+    # -- integrity --------------------------------------------------------
+    def digest_lanes(self):
+        lanes = {f"k{i}": k for i, k in enumerate(self.table.keys)}
+        live = self.table.live
+        for n in self.names:
+            lanes[f"r_{n}"] = self.rows[n]
+        lanes["pass"] = self.passing
+        # the 1-row right value folds in as broadcast scalars so the
+        # fold stays a single masked reduction
+        lanes["rv"] = jnp.where(
+            live, self.rv, jnp.zeros((), self.rv.dtype)
+        )
+        lanes["rvv"] = jnp.where(live, self.rv_valid, False)
+        return lanes, live
+
+    def state_digest(self) -> int:
+        from risingwave_tpu.integrity import host_digest
+
+        return host_digest(*self.digest_lanes())
 
     # -- checkpoint/restore ----------------------------------------------
     def checkpoint_table_ids(self):

@@ -1428,5 +1428,21 @@ def _agg_restore_state(self, table_id, key_cols, value_cols) -> None:
     self._evicted = set()
 
 
+def _agg_digest_lanes(self):
+    from risingwave_tpu.integrity import agg_lanes
+
+    return agg_lanes(self.table, self.state)
+
+
+def _agg_state_digest(self) -> int:
+    """Host twin of the fused digest lane (integrity.agg_lanes fold)."""
+    from risingwave_tpu.integrity import host_digest
+
+    lanes, live = _agg_digest_lanes(self)
+    return host_digest(lanes, live)
+
+
 HashAggExecutor.checkpoint_delta = _agg_checkpoint_delta
 HashAggExecutor.restore_state = _agg_restore_state
+HashAggExecutor.digest_lanes = _agg_digest_lanes
+HashAggExecutor.state_digest = _agg_state_digest

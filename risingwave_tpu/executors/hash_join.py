@@ -1099,6 +1099,34 @@ def _join_restore_state(self, table_id, key_cols, value_cols):
     self._evicted = {"left": set(), "right": set()}
 
 
+def _join_digest_lanes(self):
+    """Both sides folded as one lane set (``l_``/``r_`` prefixes keep
+    the seeds distinct); bucket lanes are pre-masked by row_valid
+    inside integrity.join_side_lanes."""
+    from risingwave_tpu.integrity import join_side_lanes
+
+    ll, llive = join_side_lanes(self.left, jnp.where)
+    rl, rlive = join_side_lanes(self.right, jnp.where)
+    lanes = {f"l_{k}": v for k, v in ll.items()}
+    lanes.update({f"r_{k}": v for k, v in rl.items()})
+    return lanes, llive, rlive
+
+
+def _join_state_digest(self) -> int:
+    """Host twin of the fused per-side digest lanes: the two sides'
+    digests XOR together (each side digest is what the fused program
+    stages, so cross-checks stay per-side)."""
+    from risingwave_tpu.integrity import host_digest, join_side_lanes
+
+    import numpy as np
+
+    ld = host_digest(*join_side_lanes(self.left, np.where))
+    rd = host_digest(*join_side_lanes(self.right, np.where))
+    return ld ^ rd
+
+
 HashJoinExecutor.checkpoint_table_ids = _join_checkpoint_table_ids
 HashJoinExecutor.checkpoint_delta = _join_checkpoint_delta
 HashJoinExecutor.restore_state = _join_restore_state
+HashJoinExecutor.digest_lanes = _join_digest_lanes
+HashJoinExecutor.state_digest = _join_state_digest

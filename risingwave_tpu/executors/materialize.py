@@ -418,6 +418,15 @@ class MaterializeExecutor(Executor, Checkpointable):
             )
         ]
 
+    def state_digest(self) -> int:
+        """Durable logical state = the row map (backend-independent:
+        native and python snapshots digest identically)."""
+        from risingwave_tpu.integrity import host_obj_digest
+
+        return host_obj_digest(
+            sorted(self.snapshot().items(), key=repr)
+        )
+
     def restore_state(self, table_id, key_cols, value_cols):
         self.rows = {}
         self._changed = set()
@@ -814,6 +823,18 @@ class DeviceMaterializeExecutor(MvDeviceReadMixin, Executor, Checkpointable):
         return sel, pull_rows(lanes, sel)
 
     # snapshot()/to_numpy() come from MvDeviceReadMixin
+
+    # -- integrity --------------------------------------------------------
+    def digest_lanes(self):
+        from risingwave_tpu.integrity import mv_lanes
+
+        return mv_lanes(self.table, self.state)
+
+    def state_digest(self) -> int:
+        """Host twin of the fused digest lane (integrity.mv_lanes)."""
+        from risingwave_tpu.integrity import host_digest
+
+        return host_digest(*self.digest_lanes())
 
     # -- checkpoint/restore -----------------------------------------------
     def checkpoint_delta(self):

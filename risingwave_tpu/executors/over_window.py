@@ -844,6 +844,18 @@ class OverWindowExecutor(Executor, Checkpointable):
                 "ORDER BY (sort upstream, e.g. with the EOWC sort)"
             )
 
+    # -- integrity --------------------------------------------------------
+    def digest_lanes(self):
+        lanes = {f"k{i}": k for i, k in enumerate(self.table.keys)}
+        for name, a in self.accums.items():
+            lanes[f"acc_{name}"] = a
+        return lanes, self.table.fp1 != 0
+
+    def state_digest(self) -> int:
+        from risingwave_tpu.integrity import host_digest
+
+        return host_digest(*self.digest_lanes())
+
     # -- checkpoint/restore ----------------------------------------------
     def checkpoint_delta(self) -> List[StateDelta]:
         sdirty = np.asarray(self.sdirty)
@@ -1552,6 +1564,26 @@ class GeneralOverWindowExecutor(Executor, Checkpointable):
                 "general OverWindow received a DELETE for an unknown pk "
                 "(inconsistent upstream)"
             )
+
+    # -- integrity --------------------------------------------------------
+    def digest_lanes(self):
+        lanes = {f"k{i}": k for i, k in enumerate(self.table.keys)}
+        for n in self.lane_names:
+            lanes[f"c_{n}"] = self.buf[n]
+        for n, a in self.bnulls.items():
+            lanes[f"cn_{n}"] = a
+        for n, a in self.em.items():
+            lanes[f"e_{n}"] = a
+        for n, a in self.emnulls.items():
+            lanes[f"en_{n}"] = a
+        lanes["seq"] = self.seq
+        lanes["present"] = self.present
+        return lanes, self.present | self.em_valid
+
+    def state_digest(self) -> int:
+        from risingwave_tpu.integrity import host_digest
+
+        return host_digest(*self.digest_lanes())
 
     # -- checkpoint/restore ----------------------------------------------
     def checkpoint_delta(self) -> List[StateDelta]:

@@ -67,6 +67,13 @@ class RowIdGenExecutor(Executor, Checkpointable):
         self._base += chunk.capacity
         return [chunk.with_columns(**{self.out_col: ids})]
 
+    # -- integrity --------------------------------------------------------
+    def state_digest(self) -> int:
+        """Durable logical state is the id watermark (one counter)."""
+        from risingwave_tpu.integrity import host_obj_digest
+
+        return host_obj_digest({"base": int(self._base)})
+
     # -- checkpoint/restore ----------------------------------------------
     def checkpoint_delta(self) -> List[StateDelta]:
         if self._base == self._committed:

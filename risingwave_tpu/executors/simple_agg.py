@@ -189,6 +189,20 @@ class SimpleAggExecutor(Executor, Checkpointable):
         self._last = cur
         return [out]
 
+    # -- integrity --------------------------------------------------------
+    def digest_lanes(self):
+        lanes = {"row_count": self.state.row_count}
+        for n, a in self.state.accums.items():
+            lanes[f"acc_{n}"] = a
+        for n, a in self.state.nonnull.items():
+            lanes[f"nn_{n}"] = a
+        return lanes, None
+
+    def state_digest(self) -> int:
+        from risingwave_tpu.integrity import host_digest
+
+        return host_digest(*self.digest_lanes())
+
     # -- checkpoint -------------------------------------------------------
     def checkpoint_delta(self) -> List[StateDelta]:
         if not bool(np.asarray(self.state.sdirty[:1])[0]):

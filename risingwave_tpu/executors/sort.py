@@ -210,6 +210,19 @@ class ArenaBufferedExecutor(Executor, Checkpointable):
             )
 
 
+    # -- integrity --------------------------------------------------------
+    def digest_lanes(self):
+        lanes = {f"c_{n}": self.buf[n] for n in self.names}
+        for n, a in self.bnulls.items():
+            lanes[f"cn_{n}"] = a
+        lanes["seq"] = self.seq
+        return lanes, self.valid
+
+    def state_digest(self) -> int:
+        from risingwave_tpu.integrity import host_digest
+
+        return host_digest(*self.digest_lanes())
+
     # -- checkpoint/restore ----------------------------------------------
     def checkpoint_delta(self) -> List[StateDelta]:
         """Incremental staging keyed by seq: upsert only rows APPENDED

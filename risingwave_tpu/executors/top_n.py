@@ -387,6 +387,24 @@ class GroupTopNExecutor(Executor, Checkpointable):
         self.state["sdirty"] = self.state["sdirty"] | expired
         return watermark, []
 
+    # -- integrity --------------------------------------------------------
+    def digest_lanes(self):
+        bv = self.state["band_valid"]
+        lanes = {f"k{i}": x for i, x in enumerate(self.table.keys)}
+        lanes["bv"] = bv
+        # band entries pre-masked by band_valid: stale bytes in vacated
+        # band positions must not shift the digest
+        lanes["order"] = jnp.where(bv, self.state["order"], 0)
+        for p in self.payload:
+            a = self.state[p]
+            lanes[f"p_{p}"] = jnp.where(bv, a, jnp.zeros((), a.dtype))
+        return lanes, self.table.live
+
+    def state_digest(self) -> int:
+        from risingwave_tpu.integrity import host_digest
+
+        return host_digest(*self.digest_lanes())
+
     # -- checkpoint/restore ----------------------------------------------
     def checkpoint_delta(self):
         sdirty = np.asarray(self.state["sdirty"])

@@ -279,6 +279,18 @@ class TopNExecutor(Executor, Checkpointable):
         self._emitted = top
         return outs
 
+    # -- integrity --------------------------------------------------------
+    def digest_lanes(self):
+        lanes = {f"k{i}": k for i, k in enumerate(self.table.keys)}
+        for n in self.names:
+            lanes[f"r_{n}"] = self.rows[n]
+        return lanes, self.table.live
+
+    def state_digest(self) -> int:
+        from risingwave_tpu.integrity import host_digest
+
+        return host_digest(*self.digest_lanes())
+
     # -- checkpoint -------------------------------------------------------
     def checkpoint_delta(self) -> List[StateDelta]:
         sdirty = np.asarray(self.sdirty)
@@ -735,6 +747,17 @@ class RetractableGroupTopNExecutor(Executor, Checkpointable):
         return watermark, []
 
     # -- checkpoint/restore (pk-keyed row store, plain-TopN layout) -------
+    def digest_lanes(self):
+        lanes = {f"k{i}": k for i, k in enumerate(self.table.keys)}
+        for n in self.names:
+            lanes[f"r_{n}"] = self.rows[n]
+        return lanes, self.table.live
+
+    def state_digest(self) -> int:
+        from risingwave_tpu.integrity import host_digest
+
+        return host_digest(*self.digest_lanes())
+
     def checkpoint_delta(self) -> List[StateDelta]:
         sdirty = np.asarray(self.sdirty)
         if not sdirty.any():

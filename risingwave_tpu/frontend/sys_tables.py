@@ -99,6 +99,20 @@ SYS_SCHEMAS: Dict[str, Schema] = {
             ("executors", DataType.INT64),
         ]
     ),
+    # integrity scrub: one row per checkpoint artifact the current
+    # manifest references (plus the manifest pointer itself); status
+    # in {ok, corrupt, unverified, unavailable}. A healthy store reads
+    # all-ok; no store at all reads empty.
+    "rw_integrity": Schema(
+        [
+            ("artifact", DataType.VARCHAR),
+            ("table_id", DataType.VARCHAR),
+            ("level", DataType.INT64),
+            ("epoch", DataType.INT64),
+            ("status", DataType.VARCHAR),
+            ("detail", DataType.VARCHAR),
+        ]
+    ),
     "rw_recovery_events": Schema(
         [
             ("seq", DataType.INT64),
@@ -395,6 +409,13 @@ def _rows_recovery_events(session) -> List[dict]:
     return rows
 
 
+def _rows_integrity(session) -> List[dict]:
+    mgr = getattr(session.runtime, "mgr", None)
+    if mgr is None:
+        return []
+    return mgr.scrub()
+
+
 def _rows_memory(session) -> List[dict]:
     gov = getattr(session.runtime, "memory_governor", None)
     if gov is None:
@@ -556,6 +577,7 @@ _BUILDERS: Dict[str, Callable] = {
     "rw_barrier_latency": _rows_barrier_latency,
     "rw_channel_depths": _rows_channel_depths,
     "rw_fusion_status": _rows_fusion_status,
+    "rw_integrity": _rows_integrity,
     "rw_recovery_events": _rows_recovery_events,
     "rw_memory": _rows_memory,
     "rw_overload_state": _rows_overload_state,

@@ -589,10 +589,26 @@ def _sharded_agg_state_nbytes(self) -> int:
     )
 
 
+def _sharded_agg_state_digest(self) -> int:
+    """Shard-flattened agg fold (integrity.agg_lanes over the stacked
+    pytree): equal to the single-chip twin's digest for the same
+    logical groups — slot order and shard placement cancel out."""
+    from risingwave_tpu.integrity import agg_lanes, host_digest
+
+    lanes, live = agg_lanes(self.table, self.state)
+
+    def flat(a):
+        a = np.asarray(a)
+        return a.reshape((-1,) + a.shape[2:])
+
+    return host_digest({k: flat(v) for k, v in lanes.items()}, flat(live))
+
+
 ShardedHashAgg.checkpoint_delta = _sharded_agg_checkpoint_delta
 ShardedHashAgg.shard_occupancy = _sharded_agg_shard_occupancy
 ShardedHashAgg.restore_state = _sharded_agg_restore_state
 ShardedHashAgg.state_nbytes = _sharded_agg_state_nbytes
+ShardedHashAgg.state_digest = _sharded_agg_state_digest
 ShardedHashAgg.state_nbytes_per_shard = stacked_state_nbytes_per_shard
 
 

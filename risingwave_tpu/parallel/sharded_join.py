@@ -265,6 +265,19 @@ class ShardedDedup(Executor, Checkpointable):
         )
         self._step = None
 
+    # -- integrity --------------------------------------------------------
+    def state_digest(self) -> int:
+        """Shard-flattened dedup fold: slot-order invariance makes this
+        digest equal to the single-chip twin's for the same key set."""
+        from risingwave_tpu.integrity import host_digest
+
+        def flat(a):
+            a = np.asarray(a)
+            return a.reshape((-1,) + a.shape[2:])
+
+        lanes = {f"k{i}": flat(k) for i, k in enumerate(self.table.keys)}
+        return host_digest(lanes, flat(self.table.live))
+
     # -- checkpoint/restore (one logical table across shards) ------------
     def checkpoint_delta(self) -> List[StateDelta]:
         """Same lane naming as the single-chip dedup (k{i}), keys
@@ -647,6 +660,39 @@ class ShardedHashJoin(Executor, Checkpointable):
             jnp.zeros((), jnp.bool_), self.mesh, self.axis
         )
         self._steps = {}
+
+    # -- integrity --------------------------------------------------------
+    def state_digest(self) -> int:
+        """Shard-flattened twin of the single-chip join digest (the
+        per-side folds XOR, like HashJoinExecutor.state_digest)."""
+        from types import SimpleNamespace
+
+        from risingwave_tpu.integrity import host_digest, join_side_lanes
+
+        def flat(a):
+            a = np.asarray(a)
+            return a.reshape((-1,) + a.shape[2:])
+
+        def flat_side(side):
+            table = SimpleNamespace(
+                keys=tuple(flat(k) for k in side.table.keys),
+                live=flat(side.table.live),
+            )
+            return SimpleNamespace(
+                table=table,
+                rows={n: flat(a) for n, a in side.rows.items()},
+                row_nulls={
+                    n: flat(a) for n, a in side.row_nulls.items()
+                },
+                row_valid=flat(side.row_valid),
+                degree=flat(side.degree),
+            )
+
+        ld = host_digest(*join_side_lanes(flat_side(self.left), np.where))
+        rd = host_digest(
+            *join_side_lanes(flat_side(self.right), np.where)
+        )
+        return ld ^ rd
 
     # -- checkpoint/restore (two logical tables across shards) -----------
     def checkpoint_table_ids(self) -> List[str]:

@@ -341,6 +341,22 @@ class ShardedMaterialize(MvDeviceReadMixin, Executor, Checkpointable):
                 )
         return out
 
+    # -- integrity --------------------------------------------------------
+    def state_digest(self) -> int:
+        """Shard-flattened MV fold (integrity.mv_lanes): equal to the
+        single-chip twin's digest for the same row set."""
+        from risingwave_tpu.integrity import host_digest, mv_lanes
+
+        lanes, live = mv_lanes(self.table, self.state)
+
+        def flat(a):
+            a = np.asarray(a)
+            return a.reshape((-1,) + a.shape[2:])
+
+        return host_digest(
+            {k: flat(v) for k, v in lanes.items()}, flat(live)
+        )
+
     # -- checkpoint/restore (one logical table across shards) ------------
     def checkpoint_delta(self) -> List[StateDelta]:
         shape = self.state.sdirty.shape

@@ -320,6 +320,20 @@ class ShardedGroupTopN(Executor, Checkpointable):
         self._emitted = [{} for _ in range(self.n_shards)]
         self._step = None
 
+    # -- integrity --------------------------------------------------------
+    def state_digest(self) -> int:
+        """Shard-flattened row-store fold (single-chip lane naming)."""
+        from risingwave_tpu.integrity import host_digest
+
+        def flat(a):
+            a = np.asarray(a)
+            return a.reshape((-1,) + a.shape[2:])
+
+        lanes = {f"k{i}": flat(k) for i, k in enumerate(self.table.keys)}
+        for n in self.names:
+            lanes[f"r_{n}"] = flat(self.rows[n])
+        return host_digest(lanes, flat(self.table.live))
+
     # -- checkpoint/restore (single-chip lane naming) ---------------------
     def checkpoint_delta(self) -> List[StateDelta]:
         sdirty = np.asarray(self.sdirty).reshape(-1)

@@ -152,6 +152,16 @@ class PartitionedStateView(Checkpointable):
     def checkpoint_table_ids(self) -> List[str]:
         return self._instances[0].checkpoint_table_ids()
 
+    def state_digest(self) -> int:
+        """Wrapping sum over instance digests (disjoint key spaces;
+        sum — not xor — so equal-state instances don't cancel)."""
+        from risingwave_tpu.integrity import U64_MASK
+
+        d = 0
+        for inst in self._instances:
+            d = (d + inst.state_digest()) & U64_MASK
+        return d
+
     def checkpoint_delta(self) -> List[StateDelta]:
         by_tid: Dict[str, List[StateDelta]] = {}
         order: List[str] = []

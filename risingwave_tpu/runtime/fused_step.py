@@ -74,6 +74,7 @@ from risingwave_tpu.executors.materialize import (
     DeviceMaterializeExecutor,
     mv_step_fn,
 )
+from risingwave_tpu import integrity
 from risingwave_tpu.expr.expr import StaticTree, lift_literals, param_scope
 from risingwave_tpu.ops import agg as agg_ops
 from risingwave_tpu.parallel.sharded_agg import stack_chunks
@@ -354,6 +355,24 @@ def _fused_barrier_body(states, stacked, plan, flush_rounds, pads, has_data):
             # telemetry tail rides the same staged read the barrier
             # already pays: rows applied, dirty groups, MV rows
             scal += [rows_in, dirty_groups, mv_rows]
+            # state digests ride the SAME lane (integrity layer): the
+            # fused twin of each member's host state_digest(), decoded
+            # in _on_barrier_scalars — zero extra dispatches
+            with jax.named_scope("fused/digest"):
+                if plan.agg is not None:
+                    table, st = agg_st[0], agg_st[1]
+                    scal.append(
+                        integrity.device_digest(
+                            *integrity.agg_lanes(table, st)
+                        )
+                    )
+                if plan.has_mv:
+                    mtable, mstate = mv_st
+                    scal.append(
+                        integrity.device_digest(
+                            *integrity.mv_lanes(mtable, mstate)
+                        )
+                    )
         packed = (
             jnp.stack([jnp.asarray(x).astype(jnp.int64) for x in scal])
             if scal
@@ -540,6 +559,9 @@ class FusedChainExecutor(Executor):
         # the last materialized telemetry dict (deviceprof mirror)
         self._last_lanes = 0
         self._telemetry: Optional[dict] = None
+        # device digests decoded at the last barrier (integrity layer):
+        # member key -> uint64 fold, the fused twin of state_digest()
+        self.last_digests: dict = {}
         # the previous program's consumed inputs, held until the
         # barrier fence: dropping a buffer an in-flight async program
         # still reads BLOCKS the host until the program completes (the
@@ -616,12 +638,35 @@ class FusedChainExecutor(Executor):
         )
         if len(vals) >= base + 3:
             self._note_telemetry(vals, vals[base:base + 3])
+        # digest tail (after the 3 telemetry scalars): the fused twin
+        # of each member's state_digest(), in member order agg -> mv
+        digs = {}
+        j = base + 3
+        if self.agg is not None and j < len(vals):
+            digs["agg"] = integrity.digest_from_scalar(vals[j])
+            j += 1
+        if self.mv is not None and j < len(vals):
+            digs["mv"] = integrity.digest_from_scalar(vals[j])
+        self.last_digests = digs
+        self._note_digests(digs)
         i = 0
         if self.agg is not None:
             self.agg._on_barrier_scalars(tuple(vals[0:4]))
             i = 4
         if self.mv is not None:
             self.mv._on_barrier_scalars(tuple(vals[i:i + 2]))
+
+    def _note_digests(self, digs) -> None:
+        """Land the per-barrier device digests in the telemetry dict
+        (flight recorder + EpochTrace read it from there). Forensic,
+        never load-bearing."""
+        try:
+            if digs and self._telemetry is not None:
+                self._telemetry["state_digests"] = {
+                    k: f"{v:016x}" for k, v in digs.items()
+                }
+        except Exception:  # noqa: BLE001
+            pass
 
     def _note_telemetry(self, vals, tail) -> None:
         """Decode the packed telemetry lane into the deviceprof
@@ -1244,6 +1289,49 @@ def _fused_two_input_body(
         # telemetry tail rides the same staged read the barrier pays
         # anyway: zero extra lanes dispatched, zero new host syncs
         scal += [rows_l, rows_r, join_rows, dirty_groups, mv_rows]
+        # state digests ride the SAME lane (integrity layer): fused
+        # twins of the members' state_digest(), decoded per the
+        # _scalar_layout "dig" tail — zero extra dispatches
+        with jax.named_scope("fused/digest"):
+            def side_digest(st, kind):
+                if kind == "filter":
+                    scal.append(
+                        integrity.device_digest(
+                            *integrity.filter_lanes(st[0], st[1])
+                        )
+                    )
+                elif kind == "dedup":
+                    scal.append(
+                        integrity.device_digest(
+                            *integrity.dedup_lanes(st[0])
+                        )
+                    )
+                elif kind == "agg":
+                    scal.append(
+                        integrity.device_digest(
+                            *integrity.agg_lanes(st[0], st[1])
+                        )
+                    )
+
+            side_digest(l_st, plan.left.kind)
+            side_digest(r_st, plan.right.kind)
+            scal.append(
+                integrity.device_digest(
+                    *integrity.join_side_lanes(jl, jnp.where)
+                )
+            )
+            scal.append(
+                integrity.device_digest(
+                    *integrity.join_side_lanes(jr, jnp.where)
+                )
+            )
+            if plan.mv_pk is not None:
+                mtable, mstate = mv_st
+                scal.append(
+                    integrity.device_digest(
+                        *integrity.mv_lanes(mtable, mstate)
+                    )
+                )
         packed = jnp.stack(
             [jnp.asarray(x).astype(jnp.int64) for x in scal]
         )
@@ -1345,6 +1433,7 @@ class FusedTwoInputExecutor(Executor):
         self._barriers = 0
         self._last_lanes = 0
         self._telemetry: Optional[dict] = None
+        self.last_digests: dict = {}
 
     # -- data path --------------------------------------------------------
     def buffer_left(self, chunk: StreamChunk) -> List[StreamChunk]:
@@ -1454,6 +1543,16 @@ class FusedTwoInputExecutor(Executor):
         if self.mv is not None:
             layout.append(("mv", 2))
         layout.append(("tel", 5))
+        # digest tail mirrors the pack's fused/digest scope exactly:
+        # one per stateful side, both join sides, one for the MV
+        n_dig = 2
+        if self.l_stateful is not None:
+            n_dig += 1
+        if self.r_stateful is not None:
+            n_dig += 1
+        if self.mv is not None:
+            n_dig += 1
+        layout.append(("dig", n_dig))
         return layout
 
     def _on_barrier_scalars(self, vals, members: bool = True) -> None:
@@ -1465,6 +1564,7 @@ class FusedTwoInputExecutor(Executor):
         # telemetry FIRST: a tripped member latch raises below, and the
         # flight recorder must still see what the barrier did
         self._note_telemetry(slices)
+        self._note_digests(slices.get("dig", ()))
         if not members:
             return
         if self.l_stateful is not None:
@@ -1474,6 +1574,31 @@ class FusedTwoInputExecutor(Executor):
         self.join._on_barrier_scalars(slices["join"])
         if self.mv is not None:
             self.mv._on_barrier_scalars(slices["mv"])
+
+    def _note_digests(self, dig) -> None:
+        """Decode the fused digest tail (integrity layer twins of the
+        members' state_digest()) — forensic, never load-bearing."""
+        try:
+            names = []
+            if self.l_stateful is not None:
+                names.append("left")
+            if self.r_stateful is not None:
+                names.append("right")
+            names += ["join_left", "join_right"]
+            if self.mv is not None:
+                names.append("mv")
+            digs = {
+                n: integrity.digest_from_scalar(v)
+                for n, v in zip(names, dig)
+            }
+            if digs:
+                self.last_digests = digs
+                if self._telemetry is not None:
+                    self._telemetry["state_digests"] = {
+                        k: f"{v:016x}" for k, v in digs.items()
+                    }
+        except Exception:  # noqa: BLE001 — forensic, never load-bearing
+            pass
 
     def _note_telemetry(self, slices) -> None:
         """Decode the packed telemetry lane into the deviceprof

@@ -18,12 +18,14 @@ from risingwave_tpu.sim.chaos import (
     ActorChaosRunner,
     ActorCrash,
     ChaosRunner,
+    CorruptingStore,
     CrashingExecutor,
     CrashingStore,
     CrashPoint,
     FlakyStore,
     OverloadChaosRunner,
     chaos_seed,
+    corrupt_device_state,
 )
 from risingwave_tpu.sim.fake_device import (
     BlockingKernelExecutor,
@@ -35,6 +37,7 @@ __all__ = [
     "ActorCrash",
     "BlockingKernelExecutor",
     "ChaosRunner",
+    "CorruptingStore",
     "CrashPoint",
     "CrashingExecutor",
     "CrashingStore",
@@ -42,4 +45,5 @@ __all__ = [
     "OverloadChaosRunner",
     "WedgeableDevice",
     "chaos_seed",
+    "corrupt_device_state",
 ]

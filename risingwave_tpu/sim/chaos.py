@@ -175,6 +175,182 @@ class FlakyStore(ObjectStore):
         self.inner.delete(path)
 
 
+class CorruptingStore(ObjectStore):
+    """Seeded SILENT-corruption injector: bit-flips or truncates blob
+    bytes, on the read path (the store returns bytes that differ from
+    what was written) or at rest (``corrupt_at_rest`` rewrites the
+    stored bytes in place). Unlike CrashingStore/FlakyStore the fault
+    is by construction UNDETECTABLE at the store boundary — no
+    exception, no missing object — so only the integrity layer's
+    checksums and digests can catch it. Every injection is recorded in
+    ``injected`` as ``(path, mode)``; storm tests assert that each one
+    was DETECTED (quarantined or scrub-flagged), i.e. zero corruptions
+    survive silently.
+
+    ``rate`` is the per-read probability; ``prefix`` restricts
+    injection to matching paths (e.g. only SSTs); quarantine copies
+    are never corrupted (they exist post-detection, and destroying
+    forensics would let a detected fault masquerade as an undetected
+    one)."""
+
+    def __init__(
+        self,
+        inner: ObjectStore,
+        rate: float = 0.0,
+        seed: int = 0,
+        rng: Optional[random.Random] = None,
+        modes: Sequence[str] = ("bitflip", "truncate"),
+        prefix: Optional[str] = None,
+        ops: Sequence[str] = ("read",),
+    ):
+        self.inner = inner
+        self.rate = rate
+        self.rng = rng if rng is not None else random.Random(seed)
+        self.modes = tuple(modes)
+        self.prefix = prefix
+        self.ops = frozenset(ops)
+        self.injected: list = []  # (path, mode) — the detection ledger
+
+    def _eligible(self, path: str) -> bool:
+        from risingwave_tpu.integrity import QUARANTINE_PREFIX
+
+        if path.startswith(QUARANTINE_PREFIX + "/"):
+            return False
+        return self.prefix is None or path.startswith(self.prefix)
+
+    def _corrupt(self, data: bytes, mode: str) -> bytes:
+        if not data:
+            return data
+        if mode == "truncate":
+            # drop a seeded tail — at least one byte, never the whole
+            # blob (an absent object is a DETECTABLE fault; silence is
+            # the point)
+            keep = self.rng.randrange(0, len(data))
+            return data[:keep]
+        b = bytearray(data)
+        i = self.rng.randrange(len(b))
+        b[i] ^= 1 << self.rng.randrange(8)
+        return bytes(b)
+
+    def _maybe(self, op: str, path: str, data: bytes) -> bytes:
+        if op not in self.ops or not self._eligible(path):
+            return data
+        if not data or self.rng.random() >= self.rate:
+            return data
+        mode = self.modes[self.rng.randrange(len(self.modes))]
+        self.injected.append((path, mode))
+        return self._corrupt(data, mode)
+
+    def corrupt_at_rest(
+        self, path: Optional[str] = None, mode: Optional[str] = None
+    ) -> Optional[str]:
+        """Corrupt one committed blob IN PLACE (latent media fault: the
+        damage persists across re-reads and process respawns). With no
+        ``path``, picks a seeded eligible blob. Returns the path hit,
+        or None if nothing is eligible."""
+        if path is None:
+            cands = [p for p in self.inner.list("") if self._eligible(p)]
+            if not cands:
+                return None
+            path = cands[self.rng.randrange(len(cands))]
+        if mode is None:
+            mode = self.modes[self.rng.randrange(len(self.modes))]
+        data = self.inner.read(path)
+        if not data:
+            return None
+        self.injected.append((path, mode))
+        self.inner.put(path, self._corrupt(data, mode))
+        return path
+
+    def put(self, path: str, data: bytes) -> None:
+        self.inner.put(path, data)
+
+    def read(self, path: str) -> bytes:
+        return self._maybe("read", path, self.inner.read(path))
+
+    def read_range(self, path: str, off: int, length: int) -> bytes:
+        return self._maybe(
+            "read_range", path, self.inner.read_range(path, off, length)
+        )
+
+    def exists(self, path: str) -> bool:
+        return self.inner.exists(path)
+
+    def list(self, prefix: str):
+        return self.inner.list(prefix)
+
+    def delete(self, path: str) -> None:
+        self.inner.delete(path)
+
+
+def corrupt_device_state(ex, attr: str = "table", seed: int = 0):
+    """Flip one LIVE element of a device-resident state pytree (the
+    in-HBM bit-flip the digest contract exists to catch). Picks a
+    seeded live slot so the flip provably lands inside digest coverage
+    — flipping a padding slot would (correctly) not move the digest.
+    Returns ``(leaf_index, slot_index)`` for the failure message."""
+    import numpy as np
+
+    import jax
+
+    obj = getattr(ex, attr)
+    rng = random.Random(seed)
+    leaves, treedef = jax.tree.flatten(obj)
+    live = getattr(obj, "live", None)
+    slot = None
+    if live is not None:
+        nz = np.flatnonzero(np.asarray(live))
+        if nz.size:
+            slot = int(nz[rng.randrange(nz.size)])
+    # restrict to DIGEST-COVERED lanes when the contract names them
+    # (lane builders pass state arrays by identity): flipping a
+    # bookkeeping lane would — correctly — not move the digest, and
+    # this hook exists to plant faults the digest MUST catch
+    covered = None
+    lanes_fn = getattr(ex, "digest_lanes", None)
+    if callable(lanes_fn):
+        try:
+            covered = {id(a) for a in lanes_fn()[0].values()}
+        except Exception:  # noqa: BLE001 — fall back to any leaf
+            covered = None
+
+    def pick(ids):
+        return [
+            i
+            for i, a in enumerate(leaves)
+            if hasattr(a, "dtype")
+            and getattr(a, "size", 0)
+            and a is not live
+            and (ids is None or id(a) in ids)
+            and (
+                slot is None
+                or (a.ndim >= 1 and live is not None
+                    and a.shape[0] == live.shape[0])
+            )
+        ]
+
+    cands = pick(covered) or pick(None)
+    if not cands:
+        raise ValueError(f"no corruptible leaf on {type(ex).__name__}")
+    k = cands[rng.randrange(len(cands))]
+    a = leaves[k]
+    idx = (
+        (slot,) + (0,) * (a.ndim - 1)
+        if slot is not None
+        else tuple(rng.randrange(d) for d in a.shape)
+    )
+    old = a[idx]
+    if a.dtype == bool:
+        new = ~old
+    elif a.dtype.kind in "iu":
+        new = old ^ 1
+    else:
+        new = old + 1.0
+    leaves[k] = a.at[idx].set(new)
+    setattr(ex, attr, jax.tree.unflatten(treedef, leaves))
+    return (k, idx[0] if idx else 0)
+
+
 class ActorCrash(RuntimeError):
     """Injected ACTOR death. Deliberately a RuntimeError (not a
     BaseException like CrashPoint): it must ride the normal executor-

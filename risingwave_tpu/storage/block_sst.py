@@ -35,6 +35,10 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from risingwave_tpu.integrity import (
+    crc32_bytes,
+    raise_corruption,
+)
 from risingwave_tpu.storage.sstable import (
     Sst,
     SstMeta,
@@ -92,6 +96,9 @@ def build_block_sst(
                 "n": hi - at,
                 "first": [int(a[at]) for a in okeys] if n else [],
                 "last": [int(a[hi - 1]) for a in okeys] if n else [],
+                # content checksum, verified on EVERY block read (the
+                # reference's per-block xxhash footer, as crc32 here)
+                "crc": crc32_bytes(blob),
             }
         )
         blobs.append(blob)
@@ -124,7 +131,7 @@ def build_block_sst(
         for bm, blob in zip(blocks_meta, blobs):
             bm["off"] = off
             off += len(blob)
-        header["bloom"] = {"off": off, "len": len(bloom)}
+        header["bloom"] = {"off": off, "len": len(bloom), "crc": crc32_bytes(bloom)}
         if len(render(header)) == hl:
             break
     else:  # pad with spaces (valid JSON whitespace) to stabilize
@@ -135,7 +142,7 @@ def build_block_sst(
         for bm, blob in zip(blocks_meta, blobs):
             bm["off"] = off
             off += len(blob)
-        header["bloom"] = {"off": off, "len": len(bloom)}
+        header["bloom"] = {"off": off, "len": len(bloom), "crc": crc32_bytes(bloom)}
         raw2 = render(header)
         assert len(raw2) <= hl
         out = [MAGIC, struct.pack("<Q", hl), raw2 + b" " * (hl - len(raw2))]
@@ -153,6 +160,47 @@ def is_block_sst(head: bytes) -> bool:
     return head[:8] == MAGIC
 
 
+def verify_block_blob(blob: bytes) -> List[str]:
+    """Audit every checksum a block-SST blob carries (scrub / backup
+    deep verification): returns a list of human-readable problems,
+    empty when the whole artifact verifies."""
+    problems: List[str] = []
+    if not is_block_sst(blob[:8]):
+        return ["not a block SST (bad magic)"]
+    try:
+        (hl,) = struct.unpack("<Q", blob[8:16])
+        hdr = json.loads(blob[16 : 16 + hl].decode())
+    except (struct.error, UnicodeDecodeError, ValueError) as e:
+        return [f"torn header: {e}"]
+    for i, bm in enumerate(hdr.get("blocks", [])):
+        want = bm.get("crc")
+        if want is None:
+            continue
+        got = crc32_bytes(blob[bm["off"] : bm["off"] + bm["len"]])
+        if got != want:
+            problems.append(
+                f"block {i} crc mismatch (expected {want}, got {got})"
+            )
+    bl = hdr.get("bloom", {})
+    want = bl.get("crc")
+    if want is not None:
+        got = crc32_bytes(blob[bl["off"] : bl["off"] + bl["len"]])
+        if got != want:
+            problems.append(
+                f"bloom crc mismatch (expected {want}, got {got})"
+            )
+    return problems
+
+
+def header_crc(blob: bytes) -> int:
+    """crc32 of a built block-SST's header bytes. The header itself
+    cannot carry its own checksum, so the manifest entry records it
+    (``hdr_crc``) and readers verify at open — rooting the per-block
+    crc chain in the manifest's own crc envelope."""
+    (hl,) = struct.unpack("<Q", blob[8:16])
+    return crc32_bytes(blob[16 : 16 + hl])
+
+
 def order_tuple(values: Sequence[object], dtypes) -> Tuple[int, ...]:
     """One key's order-key tuple (for block pruning comparisons)."""
     return tuple(
@@ -165,7 +213,7 @@ class BlockSst:
     """Reader over the block layout: header-only open, lazy bloom,
     per-block LRU cache, point/range/backward reads."""
 
-    def __init__(self, store, path: str):
+    def __init__(self, store, path: str, expected_hdr_crc: int = None):
         self.store = store
         self.path = path
         head = store.read_range(path, 0, 16)
@@ -173,7 +221,20 @@ class BlockSst:
             raise ValueError(f"{path} is not a block SST")
         try:
             (hl,) = struct.unpack("<Q", head[8:16])
-            hdr = json.loads(store.read_range(path, 16, hl).decode())
+            raw_hdr = store.read_range(path, 16, hl)
+            if (
+                expected_hdr_crc is not None
+                and crc32_bytes(raw_hdr) != expected_hdr_crc
+            ):
+                # a WRONG header (vs a torn one, below) is corruption:
+                # its offsets/crcs can no longer be trusted to verify
+                # anything else, so fail the whole artifact here
+                raise_corruption(
+                    store, path, "sst-header-crc",
+                    expected=expected_hdr_crc,
+                    actual=crc32_bytes(raw_hdr),
+                )
+            hdr = json.loads(raw_hdr.decode())
         except (struct.error, UnicodeDecodeError) as e:
             # a torn/partial header read (flaky ranged GET) must surface
             # in the ValueError domain the storage retry loops classify
@@ -192,6 +253,7 @@ class BlockSst:
             np.dtype(d) for d in m.get("key_dtypes", [])
         ]
         self._bloom_span = (hdr["bloom"]["off"], hdr["bloom"]["len"])
+        self._bloom_crc = hdr["bloom"].get("crc")  # pre-crc files: None
         self._bloom: Optional[np.ndarray] = None
         self._cache: "OrderedDict[int, dict]" = OrderedDict()
         self._firsts = [tuple(b["first"]) for b in self.blocks]
@@ -201,9 +263,14 @@ class BlockSst:
     def bloom_bits(self) -> np.ndarray:
         if self._bloom is None:
             off, ln = self._bloom_span
-            self._bloom = np.frombuffer(
-                self.store.read_range(self.path, off, ln), np.uint8
-            )
+            raw = self.store.read_range(self.path, off, ln)
+            want = self._bloom_crc
+            if want is not None and crc32_bytes(raw) != want:
+                raise_corruption(
+                    self.store, self.path, "sst-bloom-crc",
+                    expected=want, actual=crc32_bytes(raw),
+                )
+            self._bloom = np.frombuffer(raw, np.uint8)
         return self._bloom
 
     def may_contain(self, key_cols: Sequence[np.ndarray]) -> np.ndarray:
@@ -221,9 +288,15 @@ class BlockSst:
             self._cache.move_to_end(i)
             return blk
         bm = self.blocks[i]
-        z = np.load(
-            io.BytesIO(self.store.read_range(self.path, bm["off"], bm["len"]))
-        )
+        raw = self.store.read_range(self.path, bm["off"], bm["len"])
+        want = bm.get("crc")  # pre-crc files carry no block checksum
+        if want is not None and crc32_bytes(raw) != want:
+            raise_corruption(
+                self.store, self.path, "sst-block-crc",
+                detail=f"block {i}", expected=want,
+                actual=crc32_bytes(raw),
+            )
+        z = np.load(io.BytesIO(raw))
         blk = {name: z[name] for name in z.files}
         self._cache[i] = blk
         if len(self._cache) > _BLOCK_CACHE_CAP:

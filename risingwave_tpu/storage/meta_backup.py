@@ -80,6 +80,11 @@ class DictionaryPersistor(Checkpointable):
             self._persisted_len = len(self.strings)
         return []
 
+    def state_digest(self) -> int:
+        from risingwave_tpu.integrity import host_obj_digest
+
+        return host_obj_digest(self.strings.dump())
+
     def restore_state(self, table_id, key_cols, value_cols):
         return None
 
@@ -92,8 +97,18 @@ class DictionaryPersistor(Checkpointable):
 def create_backup(store: ObjectStore, backup_id: str) -> dict:
     """Copy the meta snapshot + current manifest + every referenced SST
     into ``backup/<id>/`` (self-contained; reference: meta snapshot
-    backup, src/storage/backup/)."""
-    from risingwave_tpu.storage.state_table import MANIFEST
+    backup, src/storage/backup/).
+
+    Every SST is checksum-VERIFIED on the copy read: a faithfully
+    copied corrupt SST makes the backup worthless, so a wrong byte
+    fails the backup loudly (StateCorruption naming the artifact,
+    which is also quarantined) instead of laundering the corruption
+    into the backup prefix."""
+    from risingwave_tpu.integrity import decode_manifest
+    from risingwave_tpu.storage.state_table import (
+        MANIFEST,
+        verify_sst_entry,
+    )
 
     manifest_paths = [
         p
@@ -106,16 +121,19 @@ def create_backup(store: ObjectStore, backup_id: str) -> dict:
     copied = []
     ssts = 0
     for mp in manifest_paths:
-        manifest = json.loads(store.read(mp))
+        raw = store.read(mp)
+        # decode_manifest verifies the envelope crc (and unwraps the
+        # format-2 payload); a torn/corrupt manifest fails the backup
+        manifest = decode_manifest(raw, artifact=mp)
         dst = f"{BACKUP_PREFIX}/{backup_id}/{mp}"
-        store.put(dst, store.read(mp))
+        store.put(dst, raw)
         copied.append(mp)
-        # version["tables"]: table_id -> [{"path", "epoch"}, ...]
+        # version["tables"]: table_id -> [{"path", "epoch", "crc"}, ...]
         for entries in manifest.get("tables", {}).values():
             for e in entries:
                 store.put(
                     f"{BACKUP_PREFIX}/{backup_id}/{e['path']}",
-                    store.read(e["path"]),
+                    verify_sst_entry(store, e),
                 )
                 ssts += 1
     for p in (DDL_PATH, STRINGS_PATH):

@@ -34,7 +34,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from risingwave_tpu.integrity import (
+    StateCorruption,
+    crc32_bytes,
+    decode_manifest,
+    digest_enabled,
+    encode_manifest,
+    host_rows_digest,
+    note_corruption,
+    quarantine,
+    raise_corruption,
+)
 from risingwave_tpu.resilience import (
+    STORE_UNAVAILABLE,
     CircuitBreaker,
     RetryingObjectStore,
     RetryPolicy,
@@ -43,7 +55,9 @@ from risingwave_tpu.storage.object_store import ObjectStore
 from risingwave_tpu.storage.block_sst import (
     BlockSst,
     build_block_sst,
+    header_crc,
     order_tuple,
+    verify_block_blob,
 )
 from risingwave_tpu.storage.sstable import (
     _order_key,
@@ -54,6 +68,8 @@ from risingwave_tpu.storage.sstable import (
 )
 
 MANIFEST = "MANIFEST"
+MANIFEST_HISTORY = "manifests"  # per-epoch manifest copies (walk-back)
+MANIFEST_KEEP = 8  # history retention (walk-back depth)
 COMPACT_AT = 8  # L0 SSTs per table before a leveled compaction
 L1_FILE_ROWS = 1 << 16  # target rows per non-overlapping L1 file
 
@@ -197,6 +213,21 @@ class Checkpointable:
     ) -> None:
         raise NotImplementedError
 
+    # -- integrity: the state-digest contract (rwlint RW-E709) ---------
+    def state_digest(self) -> int:
+        """Order-insensitive fingerprint of this executor's DURABLE
+        LOGICAL state (integrity.host_digest over its lanes, or
+        integrity.host_obj_digest for host-dict state). Bookkeeping
+        lanes (sdirty/stored/latches) are excluded by contract — they
+        differ legitimately across a restore. Every Checkpointable
+        executor must override this (RW-E709 flags the ones that
+        don't); the fused engine computes the same fold on-device so
+        fused-vs-interpreted runs cross-check per barrier."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no state_digest() — "
+            "see rwlint RW-E709"
+        )
+
 
 class CheckpointManager:
     """Version authority + per-epoch committer (meta-lite).
@@ -274,14 +305,105 @@ class CheckpointManager:
     def _manifest_path(self) -> str:
         return f"{self.prefix}/{MANIFEST}"
 
+    def _history_path(self, epoch: int) -> str:
+        return f"{self.prefix}/{MANIFEST_HISTORY}/{epoch:020d}"
+
     def _load(self):
-        if self.store.exists(self._manifest_path()):
-            self.version = json.loads(self.store.read(self._manifest_path()))
+        """Read + verify the manifest pointer. A torn tail (the crash-
+        mid-write window) or a crc mismatch quarantines the pointer and
+        walks back through the per-epoch manifest history to the newest
+        copy that fully verifies — recovery lands on the previous
+        durable epoch instead of crashing on a half-written JSON."""
+        path = self._manifest_path()
+        if not self.store.exists(path):
+            return
+        raw = self.store.read(path)
+        try:
+            self.version = decode_manifest(raw, artifact=path)
+            return
+        except StateCorruption as exc:
+            exc.quarantined = quarantine(self.store, path, raw)
+            note_corruption(exc)
+            v = self._walk_back()
+            if v is None:
+                raise  # no verifying history: surface, never guess
+            self.version = v
+            self._persist_version()  # heal the pointer
+
+    def _walk_back(
+        self, bad_paths=frozenset(), deep: bool = False
+    ) -> Optional[dict]:
+        """Newest manifest-history copy whose checksum chain fully
+        verifies: the envelope crc, no reference to a known-bad
+        artifact, every referenced SST present (and, when ``deep``,
+        content-crc-verified). Returns the decoded version or None."""
+        try:
+            cands = sorted(
+                self.store.list(f"{self.prefix}/{MANIFEST_HISTORY}/"),
+                reverse=True,
+            )
+        except Exception:  # noqa: BLE001 — a dead store ends the walk
+            return None
+        for p in cands:
+            try:
+                v = decode_manifest(self.store.read(p), artifact=p)
+            except (StateCorruption, OSError, ValueError):
+                continue
+            entries = [
+                e
+                for es in v.get("tables", {}).values()
+                for e in es
+            ]
+            if any(e["path"] in bad_paths for e in entries):
+                continue
+            try:
+                ok = all(
+                    self._entry_verifies(e, deep=deep) for e in entries
+                )
+            except Exception:  # noqa: BLE001
+                ok = False
+            if ok:
+                return v
+        return None
+
+    def _entry_verifies(self, e: dict, deep: bool = False) -> bool:
+        if not self.store.exists(e["path"]):
+            return False
+        if not deep:
+            return True
+        data = self.store.read(e["path"])
+        want = e.get("crc")
+        if want is not None and crc32_bytes(data) != want:
+            return False
+        if e.get("format") == "block":
+            want_h = e.get("hdr_crc")
+            if want_h is not None and header_crc(data) != want_h:
+                return False
+            if verify_block_blob(data):
+                return False
+        return True
 
     def _persist_version(self):
-        self.store.put(
-            self._manifest_path(), json.dumps(self.version).encode()
-        )
+        blob = encode_manifest(self.version)
+        self.store.put(self._manifest_path(), blob)
+        # a per-epoch history copy makes walk-back possible: the
+        # pointer alone is one overwritten object — a torn write there
+        # would otherwise erase the only path back to durable state
+        ep = int(self.version["max_committed_epoch"])
+        self.store.put(self._history_path(ep), blob)
+        self._gc_history(ep)
+
+    def _gc_history(self, newest_epoch: int) -> None:
+        """Bounded retention: keep the newest MANIFEST_KEEP history
+        copies (best-effort — retention never fails a commit)."""
+        try:
+            hist = sorted(
+                self.store.list(f"{self.prefix}/{MANIFEST_HISTORY}/")
+            )
+            for p in hist[:-MANIFEST_KEEP]:
+                self.store.delete(p)
+        except Exception:  # noqa: BLE001
+            pass
 
     @property
     def max_committed_epoch(self) -> int:
@@ -357,7 +479,13 @@ class CheckpointManager:
             path = f"{self.prefix}/sst/{delta.table_id}/{epoch:020d}.sst"
             self.store.put(path, blob)
             new_entries.append(
-                (delta.table_id, {"path": path, "epoch": epoch})
+                (
+                    delta.table_id,
+                    # content crc written AT BUILD, verified on every
+                    # read path (_open_entry / scrub / backup)
+                    {"path": path, "epoch": epoch,
+                     "crc": crc32_bytes(blob)},
+                )
             )
             n += 1
         from risingwave_tpu import utils_sync_point as sync_point
@@ -392,6 +520,15 @@ class CheckpointManager:
                     if cur is None or cur[0] != key or cur[1] < val:
                         wms[tid] = [key, val]
                 self._pending_watermarks = {}
+            if digest_enabled():
+                # per-table epoch digest over the post-commit row image
+                # (order-insensitive; merge-on-read applied) — recovery
+                # verifies restored state against these
+                digs = self.version.setdefault("digests", {})
+                for table_id, _entry in new_entries:
+                    digs[table_id] = host_rows_digest(
+                        *self._read_table_once(table_id)
+                    )
             self._persist_version()
         sync_point.hit("after_manifest_commit")
         manifest_ms = (_time.perf_counter() - t_manifest) * 1e3
@@ -530,6 +667,11 @@ class CheckpointManager:
                         "format": "block",
                         "first": [int(a[at]) for a in okeys],
                         "last": [int(a[hi_i - 1]) for a in okeys],
+                        # whole-blob crc for scrub/backup; header crc
+                        # for the lazy read path (blocks carry their
+                        # own crcs inside the header)
+                        "crc": crc32_bytes(blob),
+                        "hdr_crc": header_crc(blob),
                     }
                 )
         untouched = [e for e in l1 if e not in overlapping]
@@ -554,6 +696,14 @@ class CheckpointManager:
             # newest epoch this compaction folded so readers can raise
             floors = self.version.setdefault("history_floor", {})
             floors[table_id] = max(floors.get(table_id, 0), src_epoch)
+            if digest_enabled():
+                # skip-watermark cleaning DROPS expired rows during the
+                # merge, so the table's row image (and hence its epoch
+                # digest) changes at compaction: refresh it in the same
+                # manifest write that publishes the folded run
+                self.version.setdefault("digests", {})[table_id] = (
+                    host_rows_digest(*self._read_table_once(table_id))
+                )
             self._persist_version()
         from risingwave_tpu import utils_sync_point as sync_point
 
@@ -624,9 +774,21 @@ class CheckpointManager:
         r = self._sst_cache.get(e["path"])
         if r is None:
             if e.get("format") == "block":
-                r = BlockSst(self.store, e["path"])
+                # header crc verified eagerly; per-block crcs verify
+                # lazily as blocks load (BlockSst._load_block)
+                r = BlockSst(
+                    self.store, e["path"],
+                    expected_hdr_crc=e.get("hdr_crc"),
+                )
             else:
-                r = read_sst(self.store.read(e["path"]))
+                blob = self.store.read(e["path"])
+                exp = e.get("crc")
+                if exp is not None and crc32_bytes(blob) != exp:
+                    raise_corruption(
+                        self.store, e["path"], "sst-crc", data=blob,
+                        expected=exp, actual=crc32_bytes(blob),
+                    )
+                r = read_sst(blob)
             if cache:
                 self._sst_cache[e["path"]] = r
         return r
@@ -916,10 +1078,162 @@ class CheckpointManager:
     def recover(self, executors: Sequence[object]) -> None:
         """Rebuild every Checkpointable executor's device state from
         the last committed version (recovery from max_committed_epoch,
-        barrier/recovery.rs:353)."""
+        barrier/recovery.rs:353).
+
+        Corruption-aware: a ``StateCorruption`` raised while reading
+        (crc/digest mismatch — the artifact is already quarantined)
+        walks the manifest history back to the NEWEST version whose
+        checksum chain deep-verifies without referencing the bad
+        artifact, adopts it, and retries — recovery lands on the newest
+        fully-verifying epoch instead of restoring a wrong byte."""
+        bad: set = set()
+        for _attempt in range(MANIFEST_KEEP + 1):
+            try:
+                self._recover_once(executors)
+                return
+            except StateCorruption as exc:
+                if exc.artifact:
+                    bad.add(exc.artifact)
+                v = self._walk_back(bad_paths=frozenset(bad), deep=True)
+                if v is None:
+                    raise  # nothing verifies: surface, never guess
+                with self._lock:
+                    self.version = v
+                    self._sst_cache.clear()
+                    self._persist_version()  # heal the pointer
+        raise RuntimeError(
+            "recovery exhausted the manifest history without finding a "
+            f"fully-verifying version (known-bad: {sorted(bad)!r})"
+        )
+
+    def _recover_once(self, executors: Sequence[object]) -> None:
         for ex in executors:
             if not isinstance(ex, Checkpointable):
                 continue
             for table_id in ex.checkpoint_table_ids():
                 keys, values = self.read_table(table_id)
+                self._verify_table_digest(table_id, keys, values)
                 ex.restore_state(table_id, keys, values)
+
+    def _verify_table_digest(self, table_id, keys, values) -> None:
+        """Compare the restored row image against the epoch digest the
+        manifest captured at commit (RW_STATE_DIGEST): catches a wrong
+        byte that still crc-verifies — e.g. corruption that happened
+        BEFORE the SST build, or a crc-less legacy entry."""
+        if not digest_enabled():
+            return
+        with self._lock:
+            want = self.version.get("digests", {}).get(table_id)
+            entries = list(self.version["tables"].get(table_id, []))
+        if want is None:
+            return
+        got = host_rows_digest(keys, values)
+        if got != want:
+            artifact = entries[-1]["path"] if entries else table_id
+            raise_corruption(
+                self.store, artifact, "table-digest",
+                detail=f"table {table_id!r} row-image digest mismatch",
+                expected=want, actual=got,
+            )
+
+    # -- scrub -----------------------------------------------------------
+    def scrub(self, deep: bool = False) -> List[dict]:
+        """On-demand audit of every artifact the current manifest
+        references (plus the manifest pointer itself). Returns one row
+        per artifact — ``status`` in {ok, corrupt, unverified,
+        unavailable} — suitable for the ``rw_integrity`` system table
+        and the ``ctl scrub`` CLI. Detection quarantines + records the
+        event but NEVER raises: a scrub is reconnaissance, not a fault.
+        ``deep`` additionally parses block SSTs and verifies every
+        per-block crc (not just the whole-blob one)."""
+        with self._lock:
+            version = json.loads(json.dumps(self.version))
+        rows: List[dict] = []
+        mpath = self._manifest_path()
+        mrow = {
+            "artifact": mpath, "table_id": "", "level": -1,
+            "epoch": int(version.get("max_committed_epoch", 0)),
+            "status": "ok", "detail": "",
+        }
+        try:
+            decode_manifest(self.store.read(mpath), artifact=mpath)
+        except StateCorruption as exc:
+            exc.quarantined = quarantine(self.store, mpath)
+            note_corruption(exc)
+            mrow.update(status="corrupt", detail=str(exc))
+        except STORE_UNAVAILABLE as exc:
+            mrow.update(status="unavailable", detail=str(exc))
+        except OSError as exc:
+            mrow.update(status="unavailable", detail=str(exc))
+        rows.append(mrow)
+        for table_id in sorted(version.get("tables", {})):
+            for e in version["tables"][table_id]:
+                rows.append(self._scrub_entry(table_id, e, deep))
+        return rows
+
+    def _scrub_entry(self, table_id: str, e: dict, deep: bool) -> dict:
+        row = {
+            "artifact": e["path"], "table_id": table_id,
+            "level": int(e.get("level", 0)), "epoch": int(e["epoch"]),
+            "status": "ok", "detail": "",
+        }
+        try:
+            blob = self.store.read(e["path"])
+        except STORE_UNAVAILABLE as exc:
+            row.update(status="unavailable", detail=str(exc))
+            return row
+        except OSError as exc:
+            row.update(status="unavailable", detail=str(exc))
+            return row
+        problems: List[str] = []
+        want = e.get("crc")
+        if want is None:
+            row["status"] = "unverified"
+            row["detail"] = "no checksum recorded (pre-integrity entry)"
+        elif crc32_bytes(blob) != want:
+            problems.append(
+                f"blob crc mismatch expected={want} "
+                f"actual={crc32_bytes(blob)}"
+            )
+        if e.get("format") == "block":
+            want_h = e.get("hdr_crc")
+            if want_h is not None and header_crc(blob) != want_h:
+                problems.append("header crc mismatch")
+            if deep:
+                problems.extend(verify_block_blob(blob))
+        if problems:
+            exc = StateCorruption(
+                e["path"], "scrub", detail="; ".join(problems),
+            )
+            exc.quarantined = quarantine(self.store, e["path"], blob)
+            note_corruption(exc)
+            row.update(status="corrupt", detail="; ".join(problems))
+        return row
+
+def verify_sst_entry(store: ObjectStore, e: dict) -> bytes:
+    """Read + verify one manifest SST entry, returning the VERIFIED
+    bytes. The backup tool's chokepoint (``meta_backup``): a faithfully
+    copied corrupt SST makes the backup worthless, so verification and
+    the copy read are the same read. Raises StateCorruption (and
+    quarantines) on a wrong byte."""
+    blob = store.read(e["path"])
+    want = e.get("crc")
+    if want is not None and crc32_bytes(blob) != want:
+        raise_corruption(
+            store, e["path"], "sst-crc", data=blob,
+            expected=want, actual=crc32_bytes(blob),
+        )
+    if e.get("format") == "block":
+        want_h = e.get("hdr_crc")
+        if want_h is not None and header_crc(blob) != want_h:
+            raise_corruption(
+                store, e["path"], "sst-header-crc", data=blob,
+                expected=want_h, actual=header_crc(blob),
+            )
+        problems = verify_block_blob(blob)
+        if problems:
+            raise_corruption(
+                store, e["path"], "sst-block-crc", data=blob,
+                detail="; ".join(problems),
+            )
+    return blob

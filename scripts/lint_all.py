@@ -249,15 +249,15 @@ def stage_perf_gate(
     fusion_current: str = None, mesh_current: str = None
 ) -> int:
     print("[lint_all] perf_gate --smoke --blackbox --roofline --serving "
-          "--freshness --overload --mesh + fusion ratchet + mesh-static "
-          "ratchet (dispatch-cost + recorder/fsync + device-roofline + "
-          "shared-arrangement serving + freshness SLO + overload-"
-          "protection + mesh-observability + fusion-regression + mesh-"
-          "readiness budgets)")
+          "--freshness --overload --mesh --integrity + fusion ratchet + "
+          "mesh-static ratchet (dispatch-cost + recorder/fsync + device-"
+          "roofline + shared-arrangement serving + freshness SLO + "
+          "overload-protection + mesh-observability + state-integrity + "
+          "fusion-regression + mesh-readiness budgets)")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     cmd = [sys.executable, os.path.join(ROOT, "scripts", "perf_gate.py"),
            "--smoke", "--blackbox", "--roofline", "--serving",
-           "--freshness", "--overload", "--mesh"]
+           "--freshness", "--overload", "--mesh", "--integrity"]
     if fusion_current and os.path.exists(fusion_current):
         cmd += ["--fusion-current", fusion_current]
     else:

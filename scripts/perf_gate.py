@@ -1948,6 +1948,212 @@ def run_smoke(budgets: dict, epochs: int = 4, events: int = 2_000):
     return violations, report
 
 
+def run_integrity_gate(budgets: dict, epochs: int = 4):
+    """The end-to-end state-integrity gate, four legs:
+
+    1. Dispatch neutrality: the device digest lanes are ALWAYS-ON in
+       the fused programs; the steady fused q5 barrier must still cost
+       at most ``q5_dispatches_per_barrier_max`` device dispatches,
+       and the q7/q8 two-input barriers must hold the smoke tier's
+       ``two_input_dispatches_per_barrier_max`` — the digests ride the
+       existing staged scalar read or they don't ship.
+    2. Host overhead: crc verification + host digests on the commit
+       path (``RW_STATE_DIGEST=1``) must stay under
+       ``host_overhead_frac_max`` of the steady barrier+commit wall.
+    3. Scrub smoke: the committed fixture scrubs all-ok; ONE flipped
+       byte at rest must be detected (corrupt + quarantined) by the
+       next scrub.
+    4. Verified recovery at CI scale: corrupt the NEWEST committed SST
+       at rest; a fresh manager must walk back to the newest fully-
+       verifying epoch, restore its exact row image, and emit a
+       ``state_corruption`` event naming the quarantined artifact.
+
+    Returns (violations, report)."""
+    import time
+
+    import numpy as np
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if ROOT not in sys.path:
+        sys.path.insert(0, ROOT)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from risingwave_tpu import integrity
+    from risingwave_tpu.event_log import EVENT_LOG
+    from risingwave_tpu.profiler import PROFILER
+    from risingwave_tpu.storage.object_store import MemObjectStore
+    from risingwave_tpu.storage.state_table import (
+        CheckpointManager,
+        Checkpointable,
+        StateDelta,
+    )
+
+    ib = budgets.get("integrity", {})
+    violations, report = [], {}
+
+    # -- legs 1+2: fused q5 steady window with per-epoch commits ----------
+    prev = os.environ.get("RW_STATE_DIGEST")
+    os.environ["RW_STATE_DIGEST"] = "1"
+    try:
+        q5, wrappers, epoch, _rows = _q5_steady_setup(2_000, fused=True)
+        store = MemObjectStore()
+        mgr = CheckpointManager(store)
+        # the fused wrapper replaces pipeline.executors; the MEMBER
+        # objects stay the checkpointing system of record
+        members = wrappers[0].members if wrappers else q5.pipeline.executors
+
+        def commit(ep):
+            mgr.commit_staged(ep << 16, mgr.stage(members))
+
+        epoch()
+        commit(1)
+        epoch()
+        commit(2)  # warm: compiles + first-flush outside the window
+        integrity.reset_host_ms()
+        PROFILER.reset()
+        PROFILER.enable(fence=False)
+        per = []
+        t0 = time.perf_counter()
+        try:
+            for i in range(epochs):
+                base = PROFILER.total_dispatches()
+                epoch()
+                per.append(PROFILER.total_dispatches() - base)
+                commit(3 + i)
+            wall_ms = (time.perf_counter() - t0) * 1e3
+        finally:
+            PROFILER.disable()
+            PROFILER.reset()
+        host = integrity.host_ms()
+        frac = host / wall_ms if wall_ms > 0 else 0.0
+        digs = wrappers[0].last_digests if wrappers else {}
+        report.update(
+            {
+                "q5_dispatches_per_barrier": per,
+                "integrity_host_ms": round(host, 3),
+                "steady_wall_ms": round(wall_ms, 2),
+                "host_overhead_frac": round(frac, 5),
+                "fused_digest_lanes": sorted(digs),
+            }
+        )
+        mx = ib.get("q5_dispatches_per_barrier_max")
+        if mx is not None and per and max(per) > mx:
+            violations.append(
+                f"integrity: digest lanes armed, steady fused q5 "
+                f"barrier costs {max(per)} dispatches > budget {mx} — "
+                "the digest fold added a dispatch"
+            )
+        mx = ib.get("host_overhead_frac_max")
+        if mx is not None and frac > mx:
+            violations.append(
+                f"integrity: digest+checksum host overhead {frac:.4f} "
+                f"of the steady barrier+commit wall > budget {mx}"
+            )
+        if not ("agg" in digs and "mv" in digs):
+            violations.append(
+                "integrity: fused q5 decoded no agg/mv digest "
+                f"(got {sorted(digs)!r}) — the digest lane is dead"
+            )
+
+        # -- leg 3: scrub smoke over the fixture just committed ----------
+        bad = [r for r in mgr.scrub() if r["status"] != "ok"]
+        if bad:
+            violations.append(
+                f"integrity: clean fixture scrubbed dirty: {bad!r}"
+            )
+        sst = [p for p in store.list("hummock/sst/")][0]
+        blob = bytearray(store.read(sst))
+        blob[len(blob) // 2] ^= 0x10
+        store.put(sst, bytes(blob))
+        hits = [
+            r
+            for r in mgr.scrub()
+            if r["status"] == "corrupt" and r["artifact"] == sst
+        ]
+        report["scrub_detected_flip"] = bool(hits)
+        if not hits:
+            violations.append(
+                f"integrity: scrub missed a flipped byte in {sst}"
+            )
+    finally:
+        if prev is None:
+            os.environ.pop("RW_STATE_DIGEST", None)
+        else:
+            os.environ["RW_STATE_DIGEST"] = prev
+
+    # -- leg 4: corrupted-newest-SST verified recovery --------------------
+    os.environ["RW_STATE_DIGEST"] = "1"
+    try:
+        store2 = MemObjectStore()
+        m2 = CheckpointManager(store2)
+        for ep in (1, 2, 3):
+            d = StateDelta(
+                "t.gate",
+                {"k": np.arange(6, dtype=np.int64)},
+                {"v": np.arange(6, dtype=np.int64) * ep},
+                np.zeros(6, bool),
+                ("k",),
+            )
+            m2.commit_staged(ep << 16, [d])
+        newest = max(store2.list("hummock/sst/"))
+        blob = bytearray(store2.read(newest))
+        blob[len(blob) // 2] ^= 0x10
+        store2.put(newest, bytes(blob))
+
+        class _Sink(Checkpointable):
+            table_id = "t.gate"
+            image = None
+
+            def restore_state(self, table_id, keys, values):
+                self.image = (keys, values)
+
+        sink = _Sink()
+        m3 = CheckpointManager(store2)
+        m3.recover([sink])
+        landed = m3.max_committed_epoch >> 16
+        report["recovery_landed_epoch"] = landed
+        if landed != 2:
+            violations.append(
+                f"integrity: recovery landed on epoch {landed}, "
+                "expected walk-back to 2 (newest fully-verifying)"
+            )
+        want = np.arange(6, dtype=np.int64) * 2
+        got = (
+            np.asarray(sink.image[1]["v"])
+            if sink.image is not None
+            else None
+        )
+        if got is None or not np.array_equal(np.sort(got), want):
+            violations.append(
+                f"integrity: recovered row image wrong: {got!r} "
+                f"(want permutation of {want!r})"
+            )
+        named = [
+            e
+            for e in EVENT_LOG.events(kind="state_corruption")
+            if e.get("artifact") == newest
+        ]
+        report["corruption_event_named_artifact"] = bool(named)
+        if not named:
+            violations.append(
+                "integrity: no state_corruption event names the "
+                f"corrupted artifact {newest}"
+            )
+    finally:
+        if prev is None:
+            os.environ.pop("RW_STATE_DIGEST", None)
+        else:
+            os.environ["RW_STATE_DIGEST"] = prev
+
+    # -- leg 1 (cont.): two-input dispatch neutrality ---------------------
+    for q in ("q7", "q8"):
+        v, r = _two_input_leg(budgets, q)
+        violations += [f"integrity/{x}" for x in v]
+        report.update({f"integrity_{k}": val for k, val in r.items()})
+    return violations, report
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--bench", default=None, help="BENCH JSON artifact")
@@ -2007,6 +2213,15 @@ def main(argv=None) -> int:
         "identical to the unthrottled twin, bounded flaps + recovery) "
         "plus the steady leg (governor host overhead < 1%% of the "
         "barrier, ledger reconciles against state_nbytes)",
+    )
+    ap.add_argument(
+        "--integrity",
+        action="store_true",
+        help="gate the state-integrity layer: digest-lane dispatch "
+        "neutrality on fused q5/q7/q8, digest+checksum host overhead "
+        "< 1%% of the steady barrier+commit wall, scrub flip "
+        "detection, and corrupted-newest-SST walk-back recovery with "
+        "the state_corruption event naming the quarantined artifact",
     )
     ap.add_argument(
         "--mesh",
@@ -2082,6 +2297,10 @@ def main(argv=None) -> int:
     if args.overload:
         v, report = run_overload_gate(budgets)
         print(f"[perf_gate] overload: {json.dumps(report)}")
+        violations += v
+    if args.integrity:
+        v, report = run_integrity_gate(budgets)
+        print(f"[perf_gate] integrity: {json.dumps(report)}")
         violations += v
     if args.mesh:
         v, report = run_mesh_gate(budgets)

@@ -1,0 +1,662 @@
+"""End-to-end state integrity (device digests -> checksummed
+checkpoints -> quarantine + verified recovery).
+
+Three layers under test, matching the integrity spine:
+
+1. The digest fold itself: bit-identical between the numpy twin and
+   the jax fold, order-insensitive over slots, provably blind to
+   padding (dead-slot bytes cannot move it).
+2. The checksum envelope on every durable artifact: SSTs and the
+   manifest verify on every read; a wrong byte raises StateCorruption
+   (a RuntimeError — it must never ride the transient-retry loop),
+   quarantines the evidence aside, and NEVER deletes the original.
+3. Recovery: a corrupted newest checkpoint walks back to the newest
+   fully-verifying epoch and replays to a result bit-identical to a
+   fault-free twin — including under a seeded corruption storm
+   composed with the crash + flaky storms.
+
+Failing storm schedules print their seed; rerun with
+``RW_CHAOS_SEED=<seed>`` to replay deterministically.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from risingwave_tpu import integrity
+from risingwave_tpu.connectors.nexmark import NexmarkConfig, NexmarkGenerator
+from risingwave_tpu.connectors.source import NexmarkSourceExecutor
+from risingwave_tpu.event_log import EVENT_LOG
+from risingwave_tpu.integrity import (
+    QUARANTINE_PREFIX,
+    StateCorruption,
+    decode_manifest,
+    device_digest,
+    digest_from_scalar,
+    encode_manifest,
+    host_digest,
+)
+from risingwave_tpu.queries.nexmark_q import build_q5_lite, build_q8
+from risingwave_tpu.resilience import (
+    STORE_UNAVAILABLE,
+    RetryingObjectStore,
+    RetryPolicy,
+)
+from risingwave_tpu.runtime.fused_step import fuse_pipeline
+from risingwave_tpu.sim import (
+    CorruptingStore,
+    CrashingStore,
+    CrashPoint,
+    FlakyStore,
+    chaos_seed,
+    corrupt_device_state,
+)
+from risingwave_tpu.storage.object_store import MemObjectStore
+from risingwave_tpu.storage.state_table import (
+    CheckpointManager,
+    Checkpointable,
+    StateDelta,
+)
+
+
+# ---------------------------------------------------------------------------
+# layer 1: the fold
+# ---------------------------------------------------------------------------
+
+
+def _lanes(n=8):
+    return {
+        "a": np.arange(n, dtype=np.int64) * 3 - 5,
+        "b": (np.arange(n) % 2 == 0),
+        "c": np.linspace(-0.5, 2.5, n),
+        "d": np.arange(n, dtype=np.int32) ^ 0x55,
+    }
+
+
+def test_fold_host_device_bit_identical():
+    lanes = _lanes()
+    live = np.arange(8) % 3 != 0
+    want = host_digest(lanes, live)
+    got = digest_from_scalar(
+        device_digest(
+            {k: jnp.asarray(v) for k, v in lanes.items()},
+            jnp.asarray(live),
+        )
+    )
+    assert got == want
+
+
+def test_fold_is_slot_order_insensitive():
+    lanes = _lanes()
+    live = np.arange(8) % 3 != 0
+    perm = np.random.default_rng(7).permutation(8)
+    permuted = {k: v[perm] for k, v in lanes.items()}
+    assert host_digest(permuted, live[perm]) == host_digest(lanes, live)
+
+
+def test_fold_excludes_padding_and_sees_live_rows():
+    lanes = _lanes()
+    live = np.arange(8) % 3 != 0
+    base = host_digest(lanes, live)
+    # scribble over every DEAD slot: the digest must not move
+    scribbled = {k: v.copy() for k, v in lanes.items()}
+    dead = ~live
+    scribbled["a"][dead] = -1
+    scribbled["d"][dead] = 0x7FFF
+    scribbled["c"][dead] = 1e9
+    assert host_digest(scribbled, live) == base
+    # flip ONE live value: the digest must move
+    moved = {k: v.copy() for k, v in lanes.items()}
+    moved["a"][np.flatnonzero(live)[0]] ^= 1
+    assert host_digest(moved, live) != base
+
+
+# ---------------------------------------------------------------------------
+# layer 2: checksums, quarantine, manifest envelope
+# ---------------------------------------------------------------------------
+
+
+def _delta(ep, tid="t.x", n=5):
+    return StateDelta(
+        tid,
+        {"k": np.arange(n, dtype=np.int64)},
+        {"v": np.arange(n, dtype=np.int64) * ep},
+        np.zeros(n, bool),
+        ("k",),
+    )
+
+
+def _commit_fixture(store, epochs=(1,), tid="t.x"):
+    mgr = CheckpointManager(store)
+    for ep in epochs:
+        mgr.commit_staged(ep << 16, [_delta(ep, tid)])
+    return mgr
+
+
+def test_corrupt_sst_read_quarantines_and_raises():
+    store = MemObjectStore()
+    _commit_fixture(store)
+    (sst,) = store.list("hummock/sst/")
+    good = store.read(sst)
+    blob = bytearray(good)
+    blob[len(blob) // 2] ^= 0x04
+    store.put(sst, bytes(blob))
+    n0 = integrity.corruption_count()
+    m2 = CheckpointManager(store)
+    with pytest.raises(StateCorruption) as ei:
+        m2.read_table("t.x")
+    assert ei.value.artifact == sst
+    assert integrity.corruption_count() > n0
+    # the corrupt original is still in place (recovery stops
+    # REFERENCING it; nothing ever deletes the evidence) ...
+    assert store.read(sst) == bytes(blob)
+    # ... and a quarantine copy preserves the exact corrupt bytes
+    qpath = f"{QUARANTINE_PREFIX}/{sst}"
+    assert store.exists(qpath)
+    assert store.read(qpath) == bytes(blob)
+
+
+def test_manifest_envelope_roundtrip_and_faults():
+    version = {"max_committed_epoch": 3 << 16, "tables": {"t": []}}
+    raw = encode_manifest(version)
+    assert decode_manifest(raw) == version
+    # torn tail — the mid-write crash window
+    with pytest.raises(StateCorruption) as ei:
+        decode_manifest(raw[: len(raw) // 2])
+    assert ei.value.kind == "torn-manifest"
+    # wrong payload byte under a stale crc
+    doc = raw.replace(b'"max_committed_epoch": ' + b"196608", b'"max_committed_epoch": 196609')
+    assert doc != raw
+    with pytest.raises(StateCorruption) as ei:
+        decode_manifest(doc)
+    assert ei.value.kind == "manifest-crc"
+    # a flipped bit in the "format" field must NOT launder the blob
+    # through the legacy path (the corruption storm found this one)
+    with pytest.raises(StateCorruption) as ei:
+        decode_manifest(raw.replace(b'"format": 2', b'"format": 3'))
+    assert ei.value.kind == "manifest-format"
+    # legacy format-1 (pre-envelope) decodes as-is: those bytes carry
+    # no checksum to hold them to
+    import json
+
+    legacy = json.dumps(version).encode()
+    assert decode_manifest(legacy) == version
+
+
+def test_torn_manifest_write_walks_back_one_epoch():
+    """Satellite regression: a crash mid-pointer-write. The commit
+    order is MANIFEST first, then the history copy — so the torn
+    window leaves a truncated pointer and NO newest history entry.
+    A fresh manager must land on the previous epoch and read its
+    exact image; a third manager must load cleanly (pointer healed)."""
+    store = MemObjectStore()
+    mgr = _commit_fixture(store, epochs=(1, 2))
+    raw = store.read(mgr._manifest_path())
+    store.put(mgr._manifest_path(), raw[: len(raw) - 7])
+    store.delete(mgr._history_path(2 << 16))
+    m2 = CheckpointManager(store)
+    assert m2.max_committed_epoch == 1 << 16
+    _k, v = m2.read_table("t.x")
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(v["v"])), np.arange(5, dtype=np.int64)
+    )
+    # the walk-back HEALED the pointer: a later manager loads clean
+    assert CheckpointManager(store).max_committed_epoch == 1 << 16
+
+
+def test_corrupted_newest_checkpoint_verified_recovery(monkeypatch):
+    """The acceptance bar: corrupt the newest checkpoint at rest ->
+    recovery lands on the newest fully-verifying epoch, emits a
+    ``state_corruption`` event naming the quarantined artifact, and a
+    replay of the lost epoch is bit-identical to a fault-free twin."""
+    monkeypatch.setenv("RW_STATE_DIGEST", "1")
+    # fault-free twin
+    tw = CheckpointManager(MemObjectStore())
+    for ep in (1, 2, 3):
+        tw.commit_staged(ep << 16, [_delta(ep)])
+    want_k, want_v = tw.read_table("t.x")
+
+    store = MemObjectStore()
+    _commit_fixture(store, epochs=(1, 2, 3))
+    newest = max(store.list("hummock/sst/"))
+    blob = bytearray(store.read(newest))
+    blob[len(blob) // 2] ^= 0x10
+    store.put(newest, bytes(blob))
+
+    class _Sink(Checkpointable):
+        table_id = "t.x"
+        image = None
+
+        def restore_state(self, table_id, keys, values):
+            self.image = (keys, values)
+
+    sink = _Sink()
+    m2 = CheckpointManager(store)
+    m2.recover([sink])
+    assert m2.max_committed_epoch >> 16 == 2
+    assert sink.image is not None
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(sink.image[1]["v"])),
+        np.arange(5, dtype=np.int64) * 2,
+    )
+    named = [
+        e
+        for e in EVENT_LOG.events(kind="state_corruption")
+        if e.get("artifact") == newest
+    ]
+    assert named, "no state_corruption event names the corrupt artifact"
+    assert named[-1]["quarantined"] == f"{QUARANTINE_PREFIX}/{newest}"
+    # replay the lost epoch exactly-once: bit-identical to the twin
+    m2.commit_staged(3 << 16, [_delta(3)])
+    got_k, got_v = m2.read_table("t.x")
+    order_w = np.argsort(np.asarray(want_k["k"]))
+    order_g = np.argsort(np.asarray(got_k["k"]))
+    np.testing.assert_array_equal(
+        np.asarray(got_k["k"])[order_g], np.asarray(want_k["k"])[order_w]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got_v["v"])[order_g], np.asarray(want_v["v"])[order_w]
+    )
+
+
+def test_meta_backup_refuses_corrupt_sst():
+    """Satellite 1: the backup tool VERIFIES checksums on the copy
+    read — a faithfully copied corrupt SST would make the backup
+    worthless, so it fails loudly instead."""
+    from risingwave_tpu.storage.meta_backup import create_backup
+
+    store = MemObjectStore()
+    _commit_fixture(store, epochs=(1, 2))
+    create_backup(store, "clean")  # a healthy store backs up fine
+    sst = max(store.list("hummock/sst/"))
+    blob = bytearray(store.read(sst))
+    blob[-3] ^= 0x40
+    store.put(sst, bytes(blob))
+    with pytest.raises(StateCorruption) as ei:
+        create_backup(store, "dirty")
+    assert ei.value.artifact == sst
+
+
+# ---------------------------------------------------------------------------
+# layer 1 <-> layer 2 cross-checks: fused lanes vs interpreted twins
+# ---------------------------------------------------------------------------
+
+
+def test_fused_q5_digest_matches_interpreted_twin():
+    """The fused one-dispatch barrier folds the same digest on-device
+    (staged scalar lane) that the interpreted path computes on host —
+    agg and MV must agree bit-for-bit every barrier."""
+    q5 = build_q5_lite(capacity=1 << 12, state_cleaning=False)
+    (w,) = fuse_pipeline(q5.pipeline, label="q5")
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=5_000))
+    for _ in range(2):
+        for _ in range(2):
+            c = gen.next_chunks(600, 1024)["bid"]
+            if c is not None:
+                q5.pipeline.push(c)
+        q5.pipeline.barrier()
+        assert w.last_digests["agg"] == w.agg.state_digest()
+        assert w.last_digests["mv"] == w.mv.state_digest()
+        assert "state_digests" in w._telemetry
+
+
+def test_fused_q8_two_input_digest_matches_interpreted_twin():
+    """Two-input path: per-side stateful digests plus the join's two
+    side lanes; the join's host twin is the XOR of the packed side
+    digests (XOR has no carries, so it commutes with the packing)."""
+    q8 = build_q8(capacity=1 << 12, out_cap=1 << 11)
+    (w,) = fuse_pipeline(q8.pipeline, label="q8")
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=10_000))
+    for _ in range(2):
+        for _ in range(2):
+            got = gen.next_chunks(1_000, 2048)
+            p, a = got.get("person"), got.get("auction")
+            if p is not None:
+                q8.pipeline.push_left(p.select(["id", "name", "date_time"]))
+            if a is not None:
+                q8.pipeline.push_right(a.select(["seller", "date_time"]))
+        q8.pipeline.barrier()
+        digs = w.last_digests
+        if w.l_stateful is not None:
+            assert digs["left"] == w.l_stateful.state_digest()
+        if w.r_stateful is not None:
+            assert digs["right"] == w.r_stateful.state_digest()
+        if w.mv is not None:
+            assert digs["mv"] == w.mv.state_digest()
+        assert (
+            digs["join_left"] ^ digs["join_right"]
+            == w.join.state_digest()
+        )
+
+
+def test_device_state_corruption_moves_the_digest():
+    """The sim hook flips one value in a LIVE, digest-covered slot —
+    the executor's own state_digest() must move, which is exactly the
+    signal the fused-vs-interpreted cross-check trips on."""
+    q5 = build_q5_lite(capacity=1 << 10, state_cleaning=False)
+    gen = NexmarkGenerator(NexmarkConfig(first_event_rate=5_000))
+    for _ in range(3):
+        c = gen.next_chunks(600, 1024)["bid"]
+        if c is not None:
+            q5.pipeline.push(c)
+    q5.pipeline.barrier()
+    agg = q5.agg
+    before = agg.state_digest()
+    leaf, slot = corrupt_device_state(agg, seed=3)
+    assert agg.state_digest() != before, (
+        f"flip at leaf={leaf} slot={slot} did not move the digest"
+    )
+
+
+# ---------------------------------------------------------------------------
+# rwlint RW-E709: digest coverage is part of the DDL contract
+# ---------------------------------------------------------------------------
+
+
+def _e709_env(monkeypatch, strict):
+    if strict:
+        monkeypatch.setenv("RW_STRICT_LINT", "1")
+    else:
+        monkeypatch.delenv("RW_STRICT_LINT", raising=False)
+
+
+def _e709_chain():
+    from risingwave_tpu.executors import HashAggExecutor
+    from risingwave_tpu.executors.base import Executor
+    from risingwave_tpu.ops.agg import AggCall
+
+    class _NoDigest(Executor):
+        """Ledger-visible (state_nbytes answers) but WITHOUT the
+        state_digest contract — the RW-E709 target, isolated from
+        RW-E708."""
+
+        def apply(self, chunk):
+            return [chunk]
+
+        def state_nbytes(self):
+            return 0
+
+        def lint_info(self):
+            return {"table_ids": ("nodigest.t",)}
+
+    agg = HashAggExecutor(
+        group_keys=("a",),
+        calls=(AggCall("count_star", None, "n"),),
+        schema_dtypes={"a": jnp.int64},
+        capacity=64,
+        out_cap=64,
+        table_id="t.agg",
+    )
+    return [_NoDigest(), agg]
+
+
+def _e709_session():
+    from risingwave_tpu.frontend.session import SqlSession
+    from risingwave_tpu.runtime import Pipeline, StreamingRuntime
+    from risingwave_tpu.sql import Catalog
+    from risingwave_tpu.sql.planner import PlannedMV
+    from risingwave_tpu.types import DataType, Field, Schema
+
+    catalog = Catalog({"src": Schema([Field("a", DataType.INT64)])})
+    session = SqlSession(
+        catalog, StreamingRuntime(store=None), strict_lint=True
+    )
+    planned = PlannedMV(
+        "bad",
+        Pipeline(_e709_chain()),
+        None,
+        {"src": "single"},
+        schema={"a": jnp.int64},
+    )
+    session.planner.plan = lambda sql: planned
+    return session
+
+
+def test_e709_reports_only_by_default(monkeypatch):
+    _e709_env(monkeypatch, strict=False)
+    session = _e709_session()
+    session.execute("CREATE MATERIALIZED VIEW bad AS SELECT a FROM src")
+    assert "bad" in session.runtime.fragments  # DDL accepted
+    found = [d for _n, d in session.lint_findings if d.code == "RW-E709"]
+    assert found and found[0].severity == "warning"
+    assert "nodigest.t" in found[0].message
+
+
+def test_e709_refused_under_explicit_strict_lint(monkeypatch):
+    from risingwave_tpu.analysis import PlanLintError
+
+    _e709_env(monkeypatch, strict=True)
+    session = _e709_session()
+    with pytest.raises(PlanLintError) as ei:
+        session.execute("CREATE MATERIALIZED VIEW bad AS SELECT a FROM src")
+    assert "RW-E709" in str(ei.value)
+    assert "nodigest.t" in str(ei.value)
+
+
+def test_builtin_stateful_executors_carry_digests():
+    """Every shipped stateful executor overrides state_digest — the
+    Nexmark corpus walks free of RW-E709 (covered by the rwlint suite's
+    all-builders test); here the canonical state-holders answer the
+    contract directly."""
+    from risingwave_tpu.executors import HashAggExecutor
+    from risingwave_tpu.executors.materialize import (
+        DeviceMaterializeExecutor,
+        MaterializeExecutor,
+    )
+
+    base = Checkpointable.state_digest
+    for cls in (
+        HashAggExecutor,
+        MaterializeExecutor,
+        DeviceMaterializeExecutor,
+        NexmarkSourceExecutor,
+    ):
+        fn = getattr(cls, "state_digest", None)
+        assert fn is not None and fn is not base, cls.__name__
+
+
+# ---------------------------------------------------------------------------
+# layer 3: the corruption storm (satellite 4)
+# ---------------------------------------------------------------------------
+
+EVENTS, CAP = 900, 1024
+
+
+class _Q5:
+    def __init__(self):
+        self.source = NexmarkSourceExecutor(NexmarkConfig(), split_num=2)
+        self.q5 = build_q5_lite(capacity=1 << 12, state_cleaning=False)
+
+    @property
+    def executors(self):
+        return self.q5.pipeline.executors + [self.source]
+
+    def feed(self):
+        for bid in self.source.poll(EVENTS, CAP)["bid"]:
+            self.q5.pipeline.push(bid.select(["auction", "date_time"]))
+        self.q5.pipeline.barrier()
+
+
+def _undisturbed(n_epochs):
+    obj = _Q5()
+    mgr = CheckpointManager(MemObjectStore())
+    for i in range(n_epochs):
+        obj.feed()
+        mgr.commit_epoch((i + 1) << 16, obj.executors)
+    return obj
+
+
+def _run_corruption_storm(seed, n_epochs, corrupt_rate, flaky_rate):
+    """ChaosRunner's kill-and-recover loop, extended with a seeded
+    CorruptingStore under the crash + flaky layers — and with
+    StateCorruption as a RESPAWN trigger (it escapes both the
+    transient-retry classifier and ChaosRunner's own handlers by
+    design: a wrong byte is never store weather)."""
+    disk = MemObjectStore()
+    corrupting = CorruptingStore(
+        disk, rate=corrupt_rate, seed=seed, ops=("read", "read_range")
+    )
+    rng = random.Random(seed)
+    policy = RetryPolicy(
+        max_attempts=8,
+        base_backoff_s=1e-3,
+        max_backoff_s=0.02,
+        deadline_s=10.0,
+        seed=seed,
+    )
+    flaky_rng = random.Random(seed ^ 0x5EED)
+
+    def spawn():
+        # recovery reads ride the same corrupting store: a detected
+        # wrong byte (or an exhausted retry budget) during restore is
+        # just another death — die and come back, bounded
+        for _ in range(40):
+            obj = _Q5()
+            crashing = CrashingStore(corrupting)
+            flaky = FlakyStore(crashing, rate=flaky_rate, rng=flaky_rng)
+            try:
+                mgr = CheckpointManager(
+                    RetryingObjectStore(flaky, policy), read_retry=policy
+                )
+                mgr.recover(obj.executors)
+                return obj, crashing, mgr
+            except (StateCorruption,) + STORE_UNAVAILABLE:
+                continue
+        raise AssertionError(
+            f"respawn never survived recovery (seed={seed})"
+        )
+
+    obj, crashing, mgr = spawn()
+    done = mgr.max_committed_epoch >> 16
+    stats = {"crashes": 0, "corruption_respawns": 0, "attempts": 0}
+    while done < n_epochs:
+        stats["attempts"] += 1
+        assert stats["attempts"] < 400, (
+            f"corruption storm did not converge (seed={seed}, "
+            f"stats={stats})"
+        )
+        if rng.random() < 0.30:
+            crashing.arm(rng.randint(1, 3))
+        try:
+            obj.feed()
+            mgr.commit_epoch((done + 1) << 16, obj.executors)
+            done = mgr.max_committed_epoch >> 16
+        except CrashPoint:
+            stats["crashes"] += 1
+            obj, crashing, mgr = spawn()
+            done = mgr.max_committed_epoch >> 16
+        except StateCorruption:
+            stats["corruption_respawns"] += 1
+            obj, crashing, mgr = spawn()
+            done = mgr.max_committed_epoch >> 16
+        except STORE_UNAVAILABLE:
+            obj, crashing, mgr = spawn()
+            done = mgr.max_committed_epoch >> 16
+    return obj, corrupting, stats
+
+
+def test_corruption_storm_zero_undetected(monkeypatch):
+    """Satellite 4 acceptance: a seeded ~10% on-read corruption storm
+    composed with the crash + flaky storms. Zero undetected
+    corruptions — proven the strong way: the final MV is bit-identical
+    to the fault-free twin's (a single laundered wrong byte would
+    diverge it), and every detection was counted on the way."""
+    monkeypatch.setenv("RW_STATE_DIGEST", "1")
+    seed = chaos_seed(11)
+    n_epochs = 4
+    want = _undisturbed(n_epochs).q5.mview.snapshot()
+    n0 = integrity.corruption_count()
+    obj, corrupting, stats = _run_corruption_storm(
+        seed, n_epochs, corrupt_rate=0.10, flaky_rate=0.15
+    )
+    assert corrupting.injected, (
+        f"the corruption storm never fired (seed={seed})"
+    )
+    assert integrity.corruption_count() > n0, (
+        f"injected corruption was never DETECTED (seed={seed}, "
+        f"injected={len(corrupting.injected)})"
+    )
+    got = obj.q5.mview.snapshot()
+    assert got == want, (
+        f"corruption storm diverged from the fault-free twin "
+        f"(seed={seed}; rerun with RW_CHAOS_SEED={seed}; stats={stats}, "
+        f"injected={len(corrupting.injected)})"
+    )
+    assert len(want) > 50
+
+
+@pytest.mark.slow
+def test_corruption_storm_heavy(monkeypatch):
+    """Longer storm at a higher corruption rate (nightly tier)."""
+    monkeypatch.setenv("RW_STATE_DIGEST", "1")
+    seed = chaos_seed(13)
+    n_epochs = 6
+    want = _undisturbed(n_epochs).q5.mview.snapshot()
+    # rate is bounded by progress: every epoch needs ONE fully-clean
+    # read window to commit, so past ~15% the storm starves rather
+    # than exercises (detection, not availability, is under test)
+    obj, corrupting, stats = _run_corruption_storm(
+        seed, n_epochs, corrupt_rate=0.12, flaky_rate=0.20
+    )
+    assert corrupting.injected
+    got = obj.q5.mview.snapshot()
+    assert got == want, (
+        f"heavy corruption storm diverged (seed={seed}, stats={stats})"
+    )
+
+
+# ---------------------------------------------------------------------------
+# surfaces: rw_integrity system table + the scrub CLI
+# ---------------------------------------------------------------------------
+
+
+def test_rw_integrity_rows_and_scrub():
+    from types import SimpleNamespace
+
+    from risingwave_tpu.frontend.sys_tables import _rows_integrity
+
+    store = MemObjectStore()
+    mgr = _commit_fixture(store, epochs=(1, 2))
+    shim = SimpleNamespace(runtime=SimpleNamespace(mgr=mgr))
+    rows = _rows_integrity(shim)
+    assert rows and all(r["status"] == "ok" for r in rows)
+    assert {r["artifact"] for r in rows} >= set(store.list("hummock/sst/"))
+    # no store at all reads empty, not an error
+    none_shim = SimpleNamespace(runtime=SimpleNamespace(mgr=None))
+    assert _rows_integrity(none_shim) == []
+    # one flipped byte at rest: the next scrub names the artifact
+    sst = max(store.list("hummock/sst/"))
+    blob = bytearray(store.read(sst))
+    blob[len(blob) // 2] ^= 0x08
+    store.put(sst, bytes(blob))
+    bad = [r for r in _rows_integrity(shim) if r["status"] == "corrupt"]
+    assert bad and bad[0]["artifact"] == sst
+
+
+def test_ctl_scrub_cli(tmp_path, monkeypatch, capsys):
+    import sys
+
+    from risingwave_tpu.__main__ import main
+    from risingwave_tpu.storage.object_store import LocalFsObjectStore
+
+    store = LocalFsObjectStore(str(tmp_path))
+    _commit_fixture(store, epochs=(1,))
+    argv = [
+        "risingwave_tpu", "ctl", "scrub", "--state-dir", str(tmp_path)
+    ]
+    monkeypatch.setattr(sys, "argv", argv)
+    main()  # clean store: exit 0 (no SystemExit)
+    out = capsys.readouterr().out
+    assert "0 corrupt" in out
+    (sst,) = store.list("hummock/sst/")
+    blob = bytearray(store.read(sst))
+    blob[len(blob) // 2] ^= 0x20
+    store.put(sst, bytes(blob))
+    with pytest.raises(SystemExit) as ei:
+        main()
+    assert ei.value.code == 1
+    out = capsys.readouterr().out
+    assert "corrupt" in out and sst in out
